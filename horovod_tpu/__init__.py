@@ -1,0 +1,96 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA framework with the full capability surface of the Horovod
+data-parallel example suite (``weikaolun/horovod-distributed-example``), built
+TPU-first: SPMD over a `jax.sharding.Mesh`, XLA collectives over ICI/DCN, and
+compiler-scheduled (not runtime-negotiated) gradient reduction.
+
+Public API mirrors the Horovod surface the reference exercises
+(see SURVEY.md §2.4; reference call sites tensorflow2_keras_mnist.py:25,32,55,58
+and mnist_keras.py:30,35,42,84,87):
+
+    import horovod_tpu as hvt
+
+    hvt.init()                      # hvd.init()       — process/device bootstrap
+    hvt.rank(), hvt.size()          # hvd.rank()/size  — topology queries
+    hvt.local_rank()                # hvd.local_rank() — per-host ordinal
+    hvt.DistributedOptimizer(opt)   # gradient-AVERAGING wrap of any optax optimizer
+    hvt.broadcast_parameters(tree)  # hvd.broadcast_global_variables(0)
+    hvt.callbacks.*                 # Broadcast / MetricAverage / LRWarmup callbacks
+
+Where Horovod needs a C++ coordinator thread, tensor-fusion buffers and NCCL
+rings to negotiate collectives between N independent processes, this framework
+expresses the training step as a single SPMD program: collective order is
+static, fusion is an XLA pass, and the "coordinator" is the compiler.
+"""
+
+from horovod_tpu import runtime
+from horovod_tpu.runtime import (
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    process_rank,
+    process_count,
+    is_primary,
+)
+from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    data_parallel_mesh,
+    scale_lr,
+    shard_steps,
+    shard_epochs,
+)
+from horovod_tpu.parallel import collectives
+from horovod_tpu.parallel.collectives import (
+    allreduce,
+    allgather,
+    broadcast,
+    pmean_pytree,
+    broadcast_pytree,
+)
+from horovod_tpu.training.optimizer import DistributedOptimizer
+from horovod_tpu.training import callbacks
+from horovod_tpu.training.trainer import Trainer, TrainState
+from horovod_tpu import checkpoint
+from horovod_tpu.checkpoint import broadcast_parameters
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "rank",
+    "size",
+    "local_rank",
+    "local_size",
+    "process_rank",
+    "process_count",
+    "is_primary",
+    "MeshSpec",
+    "build_mesh",
+    "data_parallel_mesh",
+    "scale_lr",
+    "shard_steps",
+    "shard_epochs",
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "pmean_pytree",
+    "broadcast_pytree",
+    "DistributedOptimizer",
+    "callbacks",
+    "Trainer",
+    "TrainState",
+    "checkpoint",
+    "broadcast_parameters",
+    "runtime",
+    "collectives",
+    "mesh_lib",
+]

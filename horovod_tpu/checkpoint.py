@@ -1,0 +1,172 @@
+"""Checkpoint / resume / serving export — single-writer, TPU-native.
+
+Parity surface (SURVEY.md §5.4): three artifacts, all primary-process-gated:
+
+1. *Training checkpoints*: per-epoch full state (params + optimizer slots +
+   step + rng) — the role of ``ModelCheckpoint('checkpoint-{epoch}.h5')``
+   (tensorflow2_keras_mnist.py:86-88). One msgpack file via flax
+   serialization; atomic rename so a crashed writer never leaves a torn file.
+2. *Final model*: ``save(path, state)`` anywhere — role of
+   ``model.save('keras-sample-model.h5')`` (mnist_keras.py:118-120).
+3. *Serving export*: a **timestamped directory** (versioning convention kept,
+   mnist_keras.py:126) holding serialized StableHLO of the jitted
+   ``input → prob`` function plus the weights — role of TF1
+   SavedModelBuilder with ``predict_signature_def(inputs={'input'},
+   outputs={'prob'})`` (mnist_keras.py:126-140), without TF anywhere.
+
+Resume is restore → broadcast: load on the primary, then
+``broadcast_parameters`` syncs all processes (the reference's implicit resume
+contract, tensorflow2_keras_mnist.py:68-71).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+from horovod_tpu import runtime
+from horovod_tpu.parallel import collectives, sharding
+
+PyTree = Any
+
+# Accept any extension so user-supplied templates ('checkpoint-{epoch}.h5',
+# Keras-style) are still discovered on resume.
+CHECKPOINT_RE = re.compile(r"checkpoint-(\d+)\.\w+$")
+
+
+def save(path: str, state: PyTree) -> str:
+    """Serialize a state pytree to one file, atomically. Caller gates rank
+    (callbacks do; direct users should check ``runtime.is_primary()``)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    data = serialization.to_bytes(jax.device_get(state))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on crash (§5.2)
+    return path
+
+
+def restore(path: str, template: PyTree) -> PyTree:
+    """Deserialize into the structure of ``template``."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return serialization.from_bytes(jax.device_get(template), data)
+
+
+def save_checkpoint(directory: str, state: PyTree, epoch: int) -> str:
+    """Epoch-numbered checkpoint (``checkpoint-{epoch}.msgpack``), parity
+    with the reference's per-epoch template (tensorflow2_keras_mnist.py:87).
+    Epochs are 1-based (epoch 0 means "no checkpoint" on resume)."""
+    return save(os.path.join(directory, f"checkpoint-{epoch}.msgpack"), state)
+
+
+def latest_checkpoint(directory: str) -> str | None:
+    """Highest-epoch checkpoint path, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_epoch = None, -1
+    for name in os.listdir(directory):
+        m = CHECKPOINT_RE.search(name)
+        if m and int(m.group(1)) > best_epoch:
+            best_epoch = int(m.group(1))
+            best = os.path.join(directory, name)
+    return best
+
+
+def broadcast_parameters(tree: PyTree, root_rank: int = 0, mesh=None) -> PyTree:
+    """``hvd.broadcast_global_variables(0)`` equivalent for any pytree:
+    every process adopts the root's values; with ``mesh`` given the result is
+    re-placed replicated on the mesh."""
+    if jax.process_count() > 1:
+        tree = collectives.broadcast_pytree(jax.device_get(tree), root=root_rank)
+    if mesh is not None:
+        tree = sharding.replicate(tree, mesh)
+    return tree
+
+
+def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None) -> tuple[PyTree, int]:
+    """The full resume path (§5.3): the primary loads the newest checkpoint,
+    all processes adopt it. Returns (state, epoch) — epoch 0 if none found.
+
+    Collective-safe under single-writer checkpoints: only the *primary's*
+    view of the directory decides (checkpoints may exist on its filesystem
+    only), and that decision is broadcast first so every process takes the
+    same branch — no process can skip a collective the others entered."""
+    primary = runtime.is_primary()
+    path = latest_checkpoint(directory) if primary else None
+    epoch = int(CHECKPOINT_RE.search(path).group(1)) if path else 0
+    if jax.process_count() > 1:
+        epoch = int(collectives.broadcast(np.int64(epoch), root=0))
+    if epoch == 0:
+        return template, 0
+    state = restore(path, template) if primary else template
+    return broadcast_parameters(state, mesh=mesh), epoch
+
+
+# --- Serving export (TF-free SavedModel role) ------------------------------
+
+SIGNATURE_FILE = "signature.json"
+GRAPH_FILE = "model.stablehlo"
+WEIGHTS_FILE = "weights.msgpack"
+
+
+def export_serving(
+    export_dir: str,
+    apply_fn,
+    params: PyTree,
+    input_shape: tuple,
+    input_dtype=np.float32,
+    timestamp: str | None = None,
+) -> str:
+    """Export a serving bundle into ``export_dir/<YYYYmmdd-HHMMSS>/``.
+
+    ``apply_fn(params, x)`` must return logits; the exported program is the
+    jitted ``x → softmax(logits)`` closure over the weights, serialized as
+    portable StableHLO via `jax.export` — the TPU-native stand-in for the TF1
+    SavedModel with signature ``{'input' → 'prob'}`` (mnist_keras.py:126-140).
+    Primary-process-only by convention (caller script gates, like the
+    reference's ``if hvd.rank() == 0``)."""
+    from jax import export as jax_export
+
+    stamp = timestamp or time.strftime("%Y%m%d-%H%M%S")
+    out_dir = os.path.join(export_dir, stamp)
+    os.makedirs(out_dir, exist_ok=True)
+
+    def predict(x):
+        return jax.nn.softmax(apply_fn(params, x), axis=-1)
+
+    spec = jax.ShapeDtypeStruct(input_shape, input_dtype)
+    exported = jax_export.export(jax.jit(predict))(spec)
+    with open(os.path.join(out_dir, GRAPH_FILE), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(out_dir, WEIGHTS_FILE), "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(params)))
+    with open(os.path.join(out_dir, SIGNATURE_FILE), "w") as f:
+        json.dump(
+            {
+                "signature": {"inputs": {"input": {"shape": list(input_shape),
+                                                   "dtype": np.dtype(input_dtype).name}},
+                              "outputs": {"prob": {}}},
+                "format": "stablehlo+msgpack",
+                "created": stamp,
+            },
+            f,
+            indent=2,
+        )
+    return out_dir
+
+
+def load_serving(bundle_dir: str):
+    """Reload an exported bundle; returns ``fn(input) -> prob``."""
+    from jax import export as jax_export
+
+    with open(os.path.join(bundle_dir, GRAPH_FILE), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    return lambda x: exported.call(x)

@@ -1,0 +1,4 @@
+"""Data layer: dataset registry + per-process sharded input pipeline."""
+
+from horovod_tpu.data.datasets import mnist, cifar10  # noqa: F401
+from horovod_tpu.data.loader import ArrayDataset  # noqa: F401

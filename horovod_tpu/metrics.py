@@ -1,0 +1,79 @@
+"""Observability: platform metric sink + primary-process logging (§5.5).
+
+The reference pushes scalars to its hosting platform via
+``gradient_utils.metrics.init(sync_tensorboard=True)`` (mnist_keras.py:22-23)
+and gates console/TB output on rank 0. Here the platform is pluggable: a
+`MetricsSink` interface with a JSONL file default, and a module-level
+``init()`` shim mirroring the reference's call shape so entry scripts read
+the same.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Protocol
+
+from horovod_tpu import runtime
+
+
+class MetricsSink(Protocol):
+    def push(self, name: str, value: float, step: int | None = None) -> None: ...
+    def close(self) -> None: ...
+
+
+class NullSink:
+    def push(self, name, value, step=None):
+        pass
+
+    def close(self):
+        pass
+
+
+class JsonlSink:
+    """Appends ``{"name", "value", "step", "wall_time"}`` lines; the CI gate
+    (`horovod_tpu.launch.ci_gate`) consumes this stream the way the Gradient
+    workflow consumes ``tensorflow:loss`` (config.yaml:8-11)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._fh = open(path, "a")
+
+    def push(self, name, value, step=None):
+        self._fh.write(
+            json.dumps(
+                {"name": name, "value": float(value), "step": step, "wall_time": time.time()}
+            )
+            + "\n"
+        )
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
+
+
+_sink: MetricsSink = NullSink()
+
+
+def init(sync_tensorboard: bool = False, path: str | None = None) -> None:
+    """Parity shim for ``gradient_utils.metrics.init`` (mnist_keras.py:23).
+
+    Primary process only (single-writer, §5.2); others keep the NullSink."""
+    global _sink
+    if not runtime.is_primary():
+        return
+    path = path or os.path.join(
+        os.environ.get("HVT_METRICS_DIR", os.environ.get("PS_MODEL_PATH", "./models")),
+        "metrics.jsonl",
+    )
+    _sink = JsonlSink(path)
+
+
+def push(name: str, value: float, step: int | None = None) -> None:
+    _sink.push(name, value, step)
+
+
+def set_sink(sink: MetricsSink) -> None:
+    global _sink
+    _sink = sink

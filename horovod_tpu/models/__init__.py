@@ -1,0 +1,4 @@
+"""Model zoo: the reference CNN, ResNet-20 (CIFAR), and the transformer
+flagship for long-context / tensor-parallel configurations."""
+
+from horovod_tpu.models.cnn import MnistCNN  # noqa: F401

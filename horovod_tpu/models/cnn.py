@@ -1,0 +1,41 @@
+"""The reference MNIST CNN, rebuilt in flax.
+
+Architecture parity (tensorflow2_keras_mnist.py:43-52 == mnist_keras.py:71-81):
+Conv2D(32,3x3,relu) → Conv2D(64,3x3,relu) → MaxPool(2x2) → Dropout(.25)
+→ Flatten → Dense(128,relu) → Dropout(.5) → Dense(10).
+
+TPU-first deviations (numerics-preserving):
+* Outputs **logits**, not softmax probabilities — losses use the fused
+  logsumexp path (stabler and fuses into one XLA kernel); softmax is applied
+  at predict/export time so the serving signature still maps input→prob
+  (mnist_keras.py:133-134).
+* Compute dtype is configurable (bfloat16 by default on TPU) with float32
+  params — MXU-friendly without changing the training math materially.
+* VALID padding, NHWC, exactly as Keras defaults gave the reference.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(32, (3, 3), padding="VALID", dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID", dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)

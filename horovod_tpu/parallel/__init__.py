@@ -1,0 +1,3 @@
+"""Parallelism core: mesh construction, collectives, sharding rules."""
+
+from horovod_tpu.parallel import mesh, collectives, sharding  # noqa: F401

@@ -1,0 +1,130 @@
+"""Average-semantics collective wrappers — the Horovod-core equivalent.
+
+This module is the moral counterpart of Horovod's entire C++ core
+(coordinator thread + tensor fusion + MPI/NCCL ops, SURVEY.md §2.3): on TPU
+it is ~100 lines because a single SPMD program makes collective order static
+and XLA's collective-combining pass does tensor fusion. What remains is the
+*semantics* the reference depends on:
+
+* **average, not sum** — ``hvd.allreduce(grad, average=True)`` divides by
+  world size after the ring reduction (SURVEY.md §3.5). Every reduction here
+  defaults to mean.
+* **root broadcast** — ``hvd.broadcast_global_variables(0)``
+  (tensorflow2_keras_mnist.py:71) for consistent init / checkpoint restore.
+* **metric averaging** — epoch-end cross-worker mean
+  (tensorflow2_keras_mnist.py:77).
+
+Two execution contexts, one API:
+
+1. **Traced** (inside `shard_map`/`pmap` with a named mesh axis): pass
+   ``axis_name=...`` — lowers to `lax.psum`/`pmean` → ICI collectives.
+2. **Eager host-level** (between steps, across processes): omit
+   ``axis_name`` — uses `jax.experimental.multihost_utils`; degrades to a
+   no-op at ``process_count() == 1`` exactly like Horovod collectives at
+   ``size()==1`` (README.md:49-52 single-instance mode).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import multihost_utils
+
+PyTree = Any
+
+
+def _axis_names(axis_name) -> Sequence:
+    if isinstance(axis_name, (tuple, list)):
+        return tuple(axis_name)
+    return (axis_name,)
+
+
+def allreduce(x, average: bool = True, axis_name=None):
+    """Allreduce one array. Mean by default (Horovod-parity semantics).
+
+    Traced context: reduction over the named mesh axis/axes.
+    Eager context: reduction across host processes (no-op single-process).
+    """
+    if axis_name is not None:
+        return lax.pmean(x, axis_name) if average else lax.psum(x, axis_name)
+    if jax.process_count() == 1:
+        return x
+    gathered = multihost_utils.process_allgather(jnp.asarray(x))
+    return gathered.mean(axis=0) if average else gathered.sum(axis=0)
+
+
+def allgather(x, axis_name=None, tiled: bool = True):
+    """Concatenate per-worker shards along the leading axis
+    (≈ ``hvd.allgather``, the third op in Horovod's kernel set,
+    SURVEY.md §2.3 TF-custom-ops row)."""
+    if axis_name is not None:
+        return lax.all_gather(x, axis_name, axis=0, tiled=tiled)
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    gathered = multihost_utils.process_allgather(jnp.asarray(x))
+    return gathered.reshape((-1,) + gathered.shape[2:]) if tiled else gathered
+
+
+def broadcast(x, root: int = 0, axis_name=None):
+    """Broadcast ``x`` from the root worker (≈ ``hvd.broadcast``).
+
+    Traced context: select root's shard via masked psum — every worker ends
+    with root's value; XLA lowers this to a single collective.
+    Eager context: `multihost_utils.broadcast_one_to_all` (root must be
+    process 0, matching the reference's only use: root=0)."""
+    if axis_name is not None:
+        x = jnp.asarray(x)
+        names = _axis_names(axis_name)
+        idx = lax.axis_index(names[0])
+        for name in names[1:]:
+            idx = idx * lax.axis_size(name) + lax.axis_index(name)
+        mask = (idx == root).astype(x.dtype)
+        return lax.psum(x * mask, axis_name)
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    if root != 0:
+        raise NotImplementedError("eager broadcast supports root=0 only")
+    return multihost_utils.broadcast_one_to_all(x)
+
+
+# --- PyTree conveniences (the DistributedOptimizer / broadcast-callback core)
+
+
+def pmean_pytree(tree: PyTree, axis_name=None) -> PyTree:
+    """Average every leaf across workers — the gradient-averaging heart of
+    ``hvd.DistributedOptimizer`` (tensorflow2_keras_mnist.py:58) as one line.
+
+    Under SPMD jit the per-tensor fusion/scheduling Horovod implements in C++
+    (SURVEY.md §3.5) is handled by XLA's collective combiner. In eager
+    host-level mode the whole tree goes through ONE fused collective (the
+    moral equivalent of Horovod's tensor-fusion buffer) rather than one
+    round-trip per leaf."""
+    if axis_name is None:
+        if jax.process_count() == 1:
+            return tree
+        gathered = multihost_utils.process_allgather(tree)
+        return jax.tree.map(lambda g: g.mean(axis=0), gathered)
+    return jax.tree.map(lambda g: allreduce(g, average=True, axis_name=axis_name), tree)
+
+
+def broadcast_pytree(tree: PyTree, root: int = 0, axis_name=None) -> PyTree:
+    """Broadcast every leaf from root — ``hvd.broadcast_global_variables(0)``
+    over an arbitrary pytree (model params AND optimizer state; the reference
+    broadcasts both, SURVEY.md §7.3)."""
+    if axis_name is None and jax.process_count() > 1 and root == 0:
+        # One fused host-level broadcast for the whole tree.
+        return multihost_utils.broadcast_one_to_all(tree)
+    return jax.tree.map(lambda x: broadcast(x, root=root, axis_name=axis_name), tree)
+
+
+def metric_mean(metrics: dict, axis_name=None) -> dict:
+    """Cross-worker mean of a metrics dict — MetricAverageCallback's op
+    (tensorflow2_keras_mnist.py:73-77)."""
+    averaged = pmean_pytree(
+        {k: jnp.asarray(v, jnp.float32) for k, v in metrics.items()},
+        axis_name=axis_name,
+    )
+    return {k: float(v) for k, v in averaged.items()}

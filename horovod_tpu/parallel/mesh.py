@@ -1,0 +1,129 @@
+"""Device-mesh construction and world-size-reactive scaling helpers.
+
+The reference's only topology is a flat ring of N single-GPU workers
+(distributed-keras-sample.yaml:3-9). The TPU-native generalization is a named
+`jax.sharding.Mesh` with up to five axes — data, fsdp, seq, model(tensor),
+expert — so data parallelism (the reference's capability) is the
+``('data',)`` special case, and TP/SP/EP slot in without breaking the API
+(SURVEY.md §2.2, §5.7).
+
+Axis naming convention used across the framework:
+
+* ``data``   — batch sharding; gradient psum rides this axis (DP).
+* ``fsdp``   — parameter/optimizer-state sharding across the data axis group.
+* ``seq``    — sequence/context parallelism (ring attention).
+* ``model``  — tensor parallelism (heads / hidden sharded).
+* ``expert`` — expert parallelism for MoE layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost (slowest, DCN-adjacent) first. Data/fsdp
+# outermost so cross-host traffic is the infrequent gradient reduction while
+# model/seq collectives (per-layer, per-step) stay on intra-host ICI.
+AXES = ("data", "fsdp", "seq", "model", "expert")
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape. -1 means "absorb all remaining devices".
+
+    ``MeshSpec()`` (all defaults) reproduces the reference's pure-DP world:
+    every chip is a data-parallel worker, exactly like the 1+3-GPU MPI ring
+    (SURVEY.md §2.2 row 1).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    seq: int = 1
+    model: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = dataclasses.asdict(self)
+        fixed = [ax for ax, s in sizes.items() if s != -1]
+        free = [ax for ax, s in sizes.items() if s == -1]
+        if len(free) > 1:
+            raise ValueError(f"At most one -1 axis allowed, got {free}")
+        prod = math.prod(sizes[ax] for ax in fixed)
+        if free:
+            if n_devices % prod != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[free[0]] = n_devices // prod
+        elif prod != n_devices:
+            raise ValueError(f"Mesh {sizes} wants {prod} devices, have {n_devices}")
+        return sizes
+
+
+def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all) per ``spec``.
+
+    Axis order is the canonical AXES order; size-1 axes are kept so sharding
+    rules can always name them — XLA elides trivial collectives, so unused
+    axes are free.
+    """
+    spec = spec or MeshSpec()
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(devices.size)
+    shape = tuple(sizes[ax] for ax in AXES)
+    return Mesh(devices.reshape(shape), AXES)
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """The reference-equivalent topology: all chips on the ``data`` axis."""
+    return build_mesh(MeshSpec(), devices)
+
+
+def dp_size(mesh: Mesh) -> int:
+    """Number of data-parallel workers (batch shards) in a mesh."""
+    return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+
+
+# --- World-size-reactive hyperparameter helpers (SURVEY.md §5.6) -----------
+
+
+def scale_lr(base_lr: float, world_size: int | None = None) -> float:
+    """Linear LR scaling: ``base × world_size``.
+
+    Reference: ``tf.optimizers.Adam(0.001 * hvd.size())``
+    (tensorflow2_keras_mnist.py:55) and ``Adadelta(1.0 * hvd.size())``
+    (mnist_keras.py:84), per Goyal et al., arXiv:1706.02677."""
+    if world_size is None:
+        world_size = jax.device_count()
+    return base_lr * world_size
+
+
+def shard_steps(total_steps: int, world_size: int | None = None) -> int:
+    """Per-worker steps so global work is constant: ``total // size``.
+
+    Reference idiom #1: ``steps_per_epoch=500 // hvd.size()``
+    (tensorflow2_keras_mnist.py:96)."""
+    if world_size is None:
+        world_size = jax.device_count()
+    return max(1, total_steps // world_size)
+
+
+def shard_epochs(total_epochs: float, world_size: int | None = None) -> int:
+    """Per-worker epochs: ``ceil(total / size)``.
+
+    Reference idiom #2: ``epochs = int(math.ceil(12.0 / hvd.size()))``
+    (mnist_keras.py:42). Both division idioms must be expressible
+    (SURVEY.md §7.3)."""
+    if world_size is None:
+        world_size = jax.device_count()
+    return max(1, int(math.ceil(total_epochs / world_size)))

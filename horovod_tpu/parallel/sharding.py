@@ -1,0 +1,63 @@
+"""NamedSharding helpers: how arrays meet the mesh.
+
+Replaces the reference's device-placement machinery (one-GPU-per-process
+pinning, tensorflow2_keras_mnist.py:28-32) with declarative shardings:
+parameters replicated (pure DP, the reference's model) or sharded (FSDP/TP),
+batches split along the ``data`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
+
+PyTree = Any
+
+
+def named_sharding(mesh: Mesh, *axes) -> NamedSharding:
+    """Shorthand: ``named_sharding(mesh, 'data', None)`` etc."""
+    return NamedSharding(mesh, P(*axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding — DP parameters (the reference's layout:
+    every worker holds the full model, SURVEY.md §2.2 row 1)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 0) -> NamedSharding:
+    """Batch split along the combined data axes, rest replicated."""
+    if ndim == 0:
+        return NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS)))
+    return NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS), *([None] * (ndim - 1))))
+
+
+def shard_batch(batch: PyTree, mesh: Mesh) -> PyTree:
+    """Place a host batch onto the mesh, split along the data axis.
+
+    Single-process: a plain sharded device_put. Multi-process: each process
+    contributes its local shard of the global batch
+    (`make_array_from_process_local_data` assembles the global logical array)
+    — this is the data-plane replacement for per-rank independent feeding
+    (the reference feeds each rank separately, tensorflow2_keras_mnist.py:41).
+    """
+
+    def put(x):
+        x = np.asarray(x)
+        sharding = batch_sharding(mesh, x.ndim)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(put, batch)
+
+
+def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Replicate a pytree across the mesh (params/opt state in pure DP)."""
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,190 @@
+"""Process/device bootstrap and topology queries.
+
+TPU-native replacement for ``hvd.init()`` and the rank/size surface
+(reference: tensorflow2_keras_mnist.py:25,28-32, mnist_keras.py:30,33-36;
+SURVEY.md §3.3).
+
+Design notes (vs the Horovod model):
+
+* Horovod: one process per GPU; ``hvd.init()`` runs MPI_Init, starts a C++
+  coordinator thread, and the script pins one GPU by ``local_rank()``.
+* Here: one process per *host*, each driving all its local TPU chips;
+  ``init()`` wires up `jax.distributed` over DCN when a coordinator is
+  configured and is a no-op for single-process runs — the reference's
+  "no-launcher degradation" requirement (README.md:49-52) holds: the same
+  script runs unlaunched with ``size() == 1`` on one chip/CPU.
+* Device pinning is obsolete: `jax.local_devices()` enumerates the chips and
+  SPMD sharding places data; there is nothing to pin.
+
+Topology mapping (the unit of data parallelism is the *chip*, not the
+process):
+
+===================  =========================================================
+Horovod concept      horovod_tpu equivalent
+===================  =========================================================
+``hvd.size()``       ``size()`` → ``jax.device_count()`` (total chips). This
+                     is the number LR scaling and work division react to
+                     (tensorflow2_keras_mnist.py:55,96).
+``hvd.rank()``       ``rank()`` → ``jax.process_index()``. Used for
+                     single-writer gating (checkpoints/TB on rank 0,
+                     tensorflow2_keras_mnist.py:86-92).
+``hvd.local_rank()`` ``local_rank()`` → this process's ordinal among
+                     processes on the same host (0 in the standard
+                     one-process-per-host deployment).
+``hvd.local_size()`` ``local_size()`` → number of chips attached to this
+                     process (``jax.local_device_count()``).
+===================  =========================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+
+import jax
+
+# Environment variables understood by init(), mirroring the role of
+# mpirun's `-x` env propagation + /generated/hostfile (README.md:57).
+ENV_COORDINATOR = "HVT_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "HVT_NUM_PROCESSES"
+ENV_PROCESS_ID = "HVT_PROCESS_ID"
+ENV_LOCAL_RANK = "HVT_LOCAL_RANK"
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class World:
+    """Snapshot of the distributed topology after init()."""
+
+    process_rank: int
+    process_count: int
+    local_rank: int
+    device_count: int
+    local_device_count: int
+    hostname: str
+    platform: str
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.process_count > 1
+
+
+def init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> World:
+    """Initialize the distributed runtime. Idempotent, like ``hvd.init()``.
+
+    Resolution order for each argument: explicit argument → HVT_* env var →
+    unset. If no coordinator is configured the run is single-process
+    (``process_count() == 1``) and every collective degrades to a local op —
+    the reference's bare ``python script.py`` mode (README.md:49-52).
+
+    Under a launcher (`horovod_tpu.launch`), the HVT_* env vars play the role
+    of mpirun's slot mapping: the launcher assigns process ids and propagates
+    the coordinator address, replacing `/generated/hostfile`
+    (distributed-keras-sample.yaml:8).
+    """
+    global _initialized
+    if _initialized:
+        return world()
+
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
+    if num_processes is None and os.environ.get(ENV_NUM_PROCESSES):
+        num_processes = int(os.environ[ENV_NUM_PROCESSES])
+    if process_id is None and os.environ.get(ENV_PROCESS_ID):
+        process_id = int(os.environ[ENV_PROCESS_ID])
+
+    if coordinator_address is not None:
+        # Multi-host control plane over DCN: replaces MPI_Init + the Horovod
+        # background coordinator thread (SURVEY.md §2.3 row 1) — after this,
+        # collective order is compiled statically, no runtime negotiation.
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    _initialized = True
+    return world()
+
+
+def shutdown() -> None:
+    """Tear down the distributed runtime (no-op if single-process)."""
+    global _initialized
+    if not _initialized:
+        return
+    try:
+        if jax.process_count() > 1:
+            jax.distributed.shutdown()
+    finally:
+        _initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def world() -> World:
+    return World(
+        process_rank=jax.process_index(),
+        process_count=jax.process_count(),
+        local_rank=local_rank(),
+        device_count=jax.device_count(),
+        local_device_count=jax.local_device_count(),
+        hostname=socket.gethostname(),
+        platform=jax.default_backend(),
+    )
+
+
+# --- Horovod-parity topology queries (SURVEY.md §2.4 row 2) ----------------
+
+
+def rank() -> int:
+    """Global rank for single-writer gating (≈ ``hvd.rank()``).
+
+    Returns the process index: exactly one process in the job returns 0, so
+    ``rank() == 0`` preserves the reference's rank-0-only checkpoint/log
+    convention (tensorflow2_keras_mnist.py:86-92)."""
+    return jax.process_index()
+
+
+def size() -> int:
+    """World size for LR scaling / work division (≈ ``hvd.size()``).
+
+    Returns the total chip count — the degree of data parallelism — which is
+    what `lr * size` (tensorflow2_keras_mnist.py:55) and `steps // size`
+    (:96) must react to."""
+    return jax.device_count()
+
+
+def local_rank() -> int:
+    """Ordinal of this process among co-located processes (≈ ``hvd.local_rank()``).
+
+    0 in the standard one-process-per-host deployment; launchers that place
+    several processes on one host set HVT_LOCAL_RANK. Note the reference uses
+    this only for GPU pinning (mnist_keras.py:35), which has no TPU analogue."""
+    return int(os.environ.get(ENV_LOCAL_RANK, "0"))
+
+
+def local_size() -> int:
+    """Number of chips driven by this process (≈ ``hvd.local_size()``)."""
+    return jax.local_device_count()
+
+
+def process_rank() -> int:
+    """Explicit process-level rank (same as rank(); here for clarity)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """Number of host processes in the job."""
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on exactly one process — the single writer for checkpoints,
+    TensorBoard and exports (reference convention, mnist_keras.py:100-105)."""
+    return jax.process_index() == 0

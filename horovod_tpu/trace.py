@@ -1,0 +1,51 @@
+"""Profiling — the Horovod-Timeline / NCCL_DEBUG role, TPU-native (§5.1).
+
+`jax.profiler` traces capture XLA op timing *and* ICI collective phases —
+strictly more than Horovod's Chrome-trace Timeline — viewable in
+TensorBoard/perfetto. Primary-process-gated like every writer in the
+framework.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+from horovod_tpu import runtime
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, primary_only: bool = True):
+    """``with trace('/tmp/trace'): step(...)`` — emits a profiler dump."""
+    active = runtime.is_primary() or not primary_only
+    if active:
+        jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        if active:
+            jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step/throughput accounting feeding the bench harness."""
+
+    def __init__(self):
+        self.times: list[float] = []
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+
+    @property
+    def mean_s(self) -> float:
+        return sum(self.times) / max(1, len(self.times))
+
+    def throughput(self, items_per_step: int) -> float:
+        return items_per_step / self.mean_s if self.times else 0.0

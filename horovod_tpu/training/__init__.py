@@ -1,0 +1,5 @@
+"""Training layer: optimizer wrap, Keras-like fit loop, callback protocol."""
+
+from horovod_tpu.training.optimizer import DistributedOptimizer  # noqa: F401
+from horovod_tpu.training import callbacks  # noqa: F401
+from horovod_tpu.training.trainer import Trainer, TrainState  # noqa: F401

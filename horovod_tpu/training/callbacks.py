@@ -1,0 +1,192 @@
+"""Callback protocol — parity surface for ``hvd.callbacks.*`` + Keras I/O.
+
+The four callbacks the reference exercises (SURVEY.md §2.4 rows 4-6 and the
+rank-0 I/O pair, tensorflow2_keras_mnist.py:67-92):
+
+* BroadcastGlobalVariablesCallback(0) — consistent init / restored-checkpoint
+  sync from the root worker.
+* MetricAverageCallback — epoch-end cross-worker metric mean; must run
+  before metric-consuming callbacks (ordering note at
+  tensorflow2_keras_mnist.py:75-76 — preserved here because callbacks run in
+  list order).
+* LearningRateWarmupCallback — ramp lr from base to base×size over the first
+  warmup epochs (Goyal et al. 1706.02677, cited at
+  tensorflow2_keras_mnist.py:81).
+* ModelCheckpoint / ScalarLogger — rank-0-only per-epoch checkpoints and
+  scalar logs ("save only on worker 0 to prevent other workers from
+  corrupting them", tensorflow2_keras_mnist.py:85).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+from horovod_tpu import runtime
+from horovod_tpu.parallel import collectives, sharding
+
+
+class Callback:
+    """Base callback; hooks mirror the Keras/Horovod set the reference uses."""
+
+    trainer = None
+
+    def set_trainer(self, trainer):
+        self.trainer = trainer
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        pass
+
+    def on_batch_end(self, batch: int, logs=None):
+        pass
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast the full TrainState (params AND optimizer state — the
+    reference's 'global variables' include optimizer slots, SURVEY.md §7.3)
+    from the root process at train begin.
+
+    Needed when training starts from random weights or a restored checkpoint
+    (comment parity: tensorflow2_keras_mnist.py:68-70). Within one process
+    SPMD replication already guarantees identical values on every chip; the
+    broadcast is the cross-process sync."""
+
+    def __init__(self, root_rank: int = 0):
+        if root_rank != 0:
+            raise NotImplementedError("root_rank=0 only (matches the reference)")
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        if jax.process_count() == 1:
+            return
+        state = collectives.broadcast_pytree(jax.device_get(self.trainer.state))
+        self.trainer.state = sharding.replicate(state, self.trainer.mesh)
+
+
+class MetricAverageCallback(Callback):
+    """Epoch-end cross-worker mean of logged metrics
+    (tensorflow2_keras_mnist.py:73-77).
+
+    Under SPMD jit, step metrics are already computed over the *global*
+    batch, so device metrics are identical on every process — this callback
+    additionally averages host-side entries (e.g. epoch_time_s) and is the
+    documented extension point for non-SPMD metrics. Keep it ahead of
+    metric-consuming callbacks in the list, as the reference requires."""
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        if logs is None or jax.process_count() == 1:
+            return
+        logs.update(collectives.metric_mean(logs))
+
+
+class LearningRateWarmupCallback(Callback):
+    """Ramp the effective LR from ``base`` to ``base × world_size`` over the
+    first ``warmup_epochs`` epochs (tensorflow2_keras_mnist.py:78-82).
+
+    The optimizer is constructed with the *scaled* LR (``scale_lr(base)``,
+    reference line :55); this callback multiplies the update by
+    s(e) ∈ [1/size, 1], so epoch 0 starts at the base LR and the ramp ends at
+    the scaled LR — the exact semantics of Horovod's warmup callback at
+    epoch granularity."""
+
+    def __init__(self, warmup_epochs: int = 3, world_size: int | None = None, verbose: int = 0):
+        self.warmup_epochs = warmup_epochs
+        self.world_size = world_size
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        size = self.world_size or runtime.size()
+        if epoch >= self.warmup_epochs or size == 1:
+            scale = 1.0
+        else:
+            frac = epoch / self.warmup_epochs
+            scale = (1.0 + frac * (size - 1)) / size
+        self.trainer.update_scale = scale
+        if self.verbose and runtime.is_primary() and epoch <= self.warmup_epochs:
+            print(f"LearningRateWarmup: epoch {epoch} lr scale {scale:.4f}")
+
+
+class ModelCheckpoint(Callback):
+    """Per-epoch full-state checkpoint, written by the primary process only
+    (tensorflow2_keras_mnist.py:86-88; single-writer discipline §5.2).
+
+    ``filepath`` may contain ``{epoch}`` like Keras's
+    ``'checkpoint-{epoch}.h5'`` template; the payload is always msgpack
+    regardless of extension, and resume discovery
+    (`checkpoint.latest_checkpoint`) accepts any extension."""
+
+    def __init__(self, filepath: str):
+        self.filepath = filepath
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        if not runtime.is_primary():
+            return
+        from horovod_tpu import checkpoint
+
+        path = self.filepath.format(epoch=epoch + 1)
+        checkpoint.save(path, self.trainer.state)
+
+
+class ScalarLogger(Callback):
+    """Rank-0 scalar event log (TensorBoard-role observability, §5.1).
+
+    Writes JSONL events (one line per scalar) compatible with simple
+    dashboards; per-batch or per-epoch frequency mirrors
+    ``TensorBoard(update_freq='batch')`` (tensorflow2_keras_mnist.py:89).
+    ``log_every`` thins batch records (1 = every batch); epoch records are
+    always written."""
+
+    def __init__(self, log_dir: str, update_freq: str = "epoch", log_every: int = 1):
+        self.log_dir = log_dir
+        self.update_freq = update_freq
+        self.log_every = max(1, log_every)
+        self._fh = None
+        self._step = 0
+
+    def _writer(self):
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "events.jsonl"), "a")
+        return self._fh
+
+    def _emit(self, tag_prefix: str, logs: dict, step: int):
+        if not runtime.is_primary() or not logs:
+            return
+        record = {"wall_time": time.time(), "step": step}
+        for k, v in logs.items():
+            try:
+                record[f"{tag_prefix}{k}"] = float(v)
+            except (TypeError, ValueError):
+                continue
+        fh = self._writer()
+        fh.write(json.dumps(record) + "\n")
+        fh.flush()
+
+    def on_batch_end(self, batch: int, logs=None):
+        self._step += 1
+        if self.update_freq == "batch" and self._step % self.log_every == 0:
+            self._emit("batch/", jax.device_get(logs) if logs else {}, self._step)
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        self._emit("epoch/", logs or {}, epoch + 1)
+
+    def on_train_end(self, logs=None):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+# Keras-name alias: the reference registers this under TensorBoard.
+TensorBoard = ScalarLogger

@@ -1,0 +1,57 @@
+"""DistributedOptimizer — gradient-averaging wrap of any optax optimizer.
+
+Parity target: ``hvd.DistributedOptimizer(opt)``
+(tensorflow2_keras_mnist.py:58, mnist_keras.py:87) whose contract is:
+intercept the gradients of any wrapped optimizer and **average** (never sum)
+them across workers before the update (SURVEY.md §3.5).
+
+TPU-native architecture note: under SPMD ``jit`` with a batch sharded along
+the ``data`` axis and a loss that is the mean over the *global* batch, XLA
+inserts (and fuses, and schedules) the gradient all-reduce automatically —
+Horovod's coordinator thread, readiness negotiation and tensor-fusion buffer
+(SURVEY.md §2.3) have no equivalent because there is nothing to negotiate at
+runtime. ``DistributedOptimizer(opt)`` with the default ``axis_name=None``
+therefore wraps for *API parity* and documents intent; pass an explicit
+``axis_name`` when stepping inside ``shard_map``/``pmap``, where the mean
+must be requested by name.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from horovod_tpu.parallel.collectives import allreduce, pmean_pytree
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    axis_name=None,
+    average: bool = True,
+) -> optax.GradientTransformation:
+    """Wrap ``optimizer`` so updates consume cross-worker-averaged gradients.
+
+    Args:
+      optimizer: any ``optax.GradientTransformation`` (the reference wraps
+        Adam and Adadelta; any optimizer must work — SURVEY.md §2.4 row 3).
+      axis_name: mesh axis (or tuple) to reduce over when used inside a
+        mapped context (``shard_map``/``pmap``). ``None`` = SPMD-jit mode:
+        the reduction is already implied by the sharded global-batch loss.
+      average: Horovod-parity default True (mean). False gives sum.
+    """
+
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(updates, state, params=None, **extra):
+        if axis_name is not None:
+            if average:
+                updates = pmean_pytree(updates, axis_name)
+            else:
+                updates = jax.tree.map(
+                    lambda g: allreduce(g, average=False, axis_name=axis_name),
+                    updates,
+                )
+        return optimizer.update(updates, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)

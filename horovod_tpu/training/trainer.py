@@ -1,0 +1,342 @@
+"""Keras-fit-like training loop around one jitted SPMD step.
+
+This is the L4+L3 replacement (SURVEY.md §1): what the reference assembles
+from Keras ``compile``/``fit`` + Horovod's DistributedOptimizer and callbacks
+(tensorflow2_keras_mnist.py:62-96) becomes a `Trainer` owning a single jitted
+train step: forward → loss(mean over **global** batch) → grad → update. With
+the batch sharded along the mesh's data axis and parameters replicated, XLA
+compiles the gradient all-reduce into the step (SURVEY.md §3.5: the entire
+Horovod C++ hot path collapses into the compiled program).
+
+Batch-size semantics (Horovod parity): ``batch_size`` is **per-worker**
+(per-chip), exactly like the reference's ``batch(128)`` on every rank
+(tensorflow2_keras_mnist.py:41); the global batch is
+``batch_size × dp_size``. LR scaling by ``size`` (mesh.scale_lr) therefore
+carries over unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu import runtime
+from horovod_tpu.data.loader import ArrayDataset
+from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.parallel import sharding as sharding_lib
+
+PyTree = Any
+
+
+@flax.struct.dataclass
+class TrainState:
+    """The full broadcastable training state.
+
+    Horovod's BroadcastGlobalVariablesCallback covers model *and* optimizer
+    variables (SURVEY.md §7.3); keeping them in one pytree makes
+    broadcast/checkpoint cover both by construction."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    rng: jax.Array
+
+
+def _resolve_loss(loss) -> Callable:
+    """Map Keras-style loss names to fused-logits implementations.
+
+    Covers both reference losses: SparseCategoricalCrossentropy
+    (tensorflow2_keras_mnist.py:63) and categorical_crossentropy
+    (mnist_keras.py:89)."""
+    if callable(loss):
+        return loss
+    if loss in ("sparse_categorical_crossentropy", "sparse_ce"):
+        return lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        )
+    if loss in ("categorical_crossentropy", "ce"):
+        return lambda logits, labels: optax.softmax_cross_entropy(logits, labels)
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+def _accuracy(logits, labels):
+    pred = jnp.argmax(logits, axis=-1)
+    if labels.ndim == logits.ndim:  # one-hot
+        labels = jnp.argmax(labels, axis=-1)
+    return (pred == labels).astype(jnp.float32).mean()
+
+
+class Trainer:
+    """compile+fit+evaluate+predict for a flax module over a device mesh.
+
+    Args:
+      module: a flax linen module; ``module.apply({'params': p}, x, train=...)``
+        must return logits. Modules may accept a ``train`` kwarg and a
+        ``dropout`` rng (both reference models use dropout).
+      optimizer: an optax transformation — typically
+        ``hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(1e-3)))``.
+      loss: Keras-style name or ``fn(logits, labels) -> per-example loss``.
+      mesh: device mesh; defaults to all chips on the data axis (the
+        reference's pure-DP topology).
+      seed: init/dropout seed.
+    """
+
+    def __init__(
+        self,
+        module,
+        optimizer: optax.GradientTransformation,
+        loss="sparse_categorical_crossentropy",
+        mesh=None,
+        seed: int = 0,
+    ):
+        self.module = module
+        self.tx = optimizer
+        self.loss_fn = _resolve_loss(loss)
+        self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+        self.seed = seed
+        self.state: TrainState | None = None
+        # Update scale multiplies the optimizer's update — the knob
+        # LearningRateWarmupCallback turns (scaling the update by s is
+        # equivalent to scaling the LR by s for the reference optimizers).
+        self.update_scale: float = 1.0
+        self.stop_training = False
+        self.history: list[dict] = []
+
+        def train_step(state: TrainState, batch, update_scale):
+            x, y = batch
+            step_rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_of(params):
+                logits = self.module.apply(
+                    {"params": params}, x, train=True, rngs={"dropout": step_rng}
+                )
+                loss = self.loss_fn(logits, y).mean()
+                return loss, _accuracy(logits, y)
+
+            (loss, acc), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                state.params
+            )
+            updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+            updates = jax.tree.map(lambda u: u * update_scale, updates)
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state
+            )
+            return new_state, {"loss": loss, "accuracy": acc}
+
+        def eval_step(state: TrainState, batch):
+            # Masked sums (mask zeroes padding) so full-dataset metrics are
+            # exact even when the tail batch is padded to the global shape.
+            x, y, mask = batch
+            logits = self.module.apply({"params": state.params}, x, train=False)
+            loss_vec = self.loss_fn(logits, y)
+            pred = jnp.argmax(logits, axis=-1)
+            labels = jnp.argmax(y, axis=-1) if y.ndim == logits.ndim else y
+            correct = (pred == labels).astype(jnp.float32)
+            return {
+                "loss_sum": (loss_vec * mask).sum(),
+                "correct_sum": (correct * mask).sum(),
+                "count": mask.sum(),
+            }
+
+        def predict_step(state: TrainState, x):
+            logits = self.module.apply({"params": state.params}, x, train=False)
+            return jax.nn.softmax(logits, axis=-1)
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step)
+        # Replicated output → fully addressable on every process, so
+        # device_get works in multi-host runs too.
+        self._predict_step = jax.jit(
+            predict_step, out_shardings=sharding_lib.replicated(self.mesh)
+        )
+
+    # --- state management ---------------------------------------------------
+
+    @property
+    def dp_size(self) -> int:
+        return mesh_lib.dp_size(self.mesh)
+
+    def build(self, sample_x: np.ndarray) -> TrainState:
+        """Initialize parameters (lazy, from the first batch — like Keras
+        building on first fit)."""
+        if self.state is not None:
+            return self.state
+        rng = jax.random.PRNGKey(self.seed)
+        init_rng, dropout_rng, state_rng = jax.random.split(rng, 3)
+        variables = self.module.init(
+            {"params": init_rng, "dropout": dropout_rng},
+            jnp.asarray(sample_x[:1]),
+            train=False,
+        )
+        params = variables["params"]
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.tx.init(params),
+            rng=state_rng,
+        )
+        self.state = sharding_lib.replicate(state, self.mesh)
+        return self.state
+
+    def _shard(self, batch):
+        return sharding_lib.shard_batch(batch, self.mesh)
+
+    def _local_slice(self, arr, global_batch: int):
+        """This process's 1/world share of a globally-indexed batch — what
+        `make_array_from_process_local_data` expects as the local
+        contribution (each example fed exactly once across the fleet)."""
+        world = runtime.process_count()
+        if world == 1:
+            return arr
+        local = global_batch // world
+        r = runtime.process_rank()
+        return arr[r * local : (r + 1) * local]
+
+    # --- Keras-parity verbs -------------------------------------------------
+
+    def fit(
+        self,
+        dataset=None,
+        *,
+        x=None,
+        y=None,
+        batch_size: int = 128,
+        epochs: int = 1,
+        steps_per_epoch: int | None = None,
+        callbacks: Sequence = (),
+        validation_data=None,
+        shuffle_buffer: int | None = None,
+        verbose: int | None = None,
+    ) -> list[dict]:
+        """Train. Either pass a batched ``ArrayDataset``/iterable of
+        ``(x, y)`` numpy batches (the TF2 script's idiom,
+        tensorflow2_keras_mnist.py:96) or raw ``x``/``y`` arrays with a
+        per-worker ``batch_size`` (the TF1 script's idiom,
+        mnist_keras.py:107-112)."""
+        if verbose is None:
+            verbose = 1 if runtime.is_primary() else 0
+
+        world = runtime.process_count()
+        if dataset is None:
+            if x is None or y is None:
+                raise ValueError("pass either dataset= or x=/y=")
+            ds = ArrayDataset((x, y)).shard(runtime.process_rank(), world)
+            n_local = ds.num_examples
+            # Global batch = per-worker batch × dp_size; each process feeds
+            # its 1/world share of it.
+            local_batch = batch_size * self.dp_size // world
+            if steps_per_epoch is None:
+                steps_per_epoch = max(1, n_local // local_batch)
+            dataset = (
+                ds.repeat()
+                .shuffle(shuffle_buffer or n_local, seed=self.seed)
+                .batch(local_batch)
+            )
+        elif steps_per_epoch is None:
+            raise ValueError("steps_per_epoch is required with a dataset")
+
+        it = iter(dataset)
+        first = next(it)
+        self.build(first[0])
+
+        for cb in callbacks:
+            cb.set_trainer(self)
+        for cb in callbacks:
+            cb.on_train_begin()
+
+        pending = first
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            t0 = time.perf_counter()
+            scale = jnp.asarray(self.update_scale, jnp.float32)
+            epoch_metrics = []
+            for step in range(steps_per_epoch):
+                batch = pending if pending is not None else next(it)
+                pending = None
+                self.state, metrics = self._train_step(
+                    self.state, self._shard(batch), scale
+                )
+                epoch_metrics.append(metrics)
+                for cb in callbacks:
+                    cb.on_batch_end(step, metrics)
+            # One host sync per epoch: average the per-step device scalars.
+            stacked = jax.device_get(epoch_metrics)
+            logs = {
+                k: float(np.mean([m[k] for m in stacked]))
+                for k in stacked[0]
+            }
+            logs["epoch_time_s"] = time.perf_counter() - t0
+            if validation_data is not None:
+                val = self.evaluate(
+                    validation_data[0], validation_data[1],
+                    batch_size=batch_size, verbose=0,
+                )
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            self.history.append(logs)
+            if verbose:
+                shown = {k: round(v, 4) for k, v in logs.items()}
+                print(f"Epoch {epoch + 1}/{epochs} - {shown}")
+        for cb in callbacks:
+            cb.on_train_end()
+        return self.history
+
+    def evaluate(self, x, y, batch_size: int = 128, verbose: int = 0) -> dict:
+        """Full-dataset eval on the mesh. Unlike the reference (every rank
+        redundantly evaluates the full test set, SURVEY.md §3.2), the eval
+        batch is sharded across chips — same result, 1/size the work."""
+        if self.state is None:
+            raise RuntimeError("call fit() or build() first")
+        n = len(x)
+        global_batch = batch_size * self.dp_size
+        loss_sum = correct_sum = count = 0.0
+        for start in range(0, n, global_batch):
+            xb = np.asarray(x[start : start + global_batch])
+            yb = np.asarray(y[start : start + global_batch])
+            bs = len(xb)
+            mask = np.ones((global_batch,), np.float32)
+            if bs < global_batch:  # pad to the compiled shape, mask it out
+                pad = global_batch - bs
+                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, 0)])
+                yb = np.concatenate([yb, np.repeat(yb[-1:], pad, 0)])
+                mask[bs:] = 0.0
+            batch = tuple(
+                self._local_slice(a, global_batch) for a in (xb, yb, mask)
+            )
+            m = jax.device_get(self._eval_step(self.state, self._shard(batch)))
+            loss_sum += float(m["loss_sum"])
+            correct_sum += float(m["correct_sum"])
+            count += float(m["count"])
+        result = {"loss": loss_sum / count, "accuracy": correct_sum / count}
+        if verbose and runtime.is_primary():
+            print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
+        return result
+
+    def predict(self, x, batch_size: int = 128) -> np.ndarray:
+        """Class probabilities (softmax applied here, keeping the serving
+        contract input→prob, mnist_keras.py:133-134)."""
+        if self.state is None:
+            raise RuntimeError("call fit() or build() first")
+        out = []
+        global_batch = batch_size * self.dp_size
+        n = len(x)
+        for start in range(0, n, global_batch):
+            xb = np.asarray(x[start : start + global_batch])
+            bs = len(xb)
+            if bs < global_batch:
+                xb = np.concatenate([xb, np.repeat(xb[-1:], global_batch - bs, 0)])
+            xb = self._local_slice(xb, global_batch)
+            probs = jax.device_get(self._predict_step(self.state, self._shard(xb)))
+            out.append(probs[:bs])
+        return np.concatenate(out, axis=0)

@@ -1,0 +1,35 @@
+"""Test harness: 8 fake CPU devices — the reference's
+"multi-process-without-a-cluster" test mode (SURVEY.md §4 implication (b)),
+TPU-native style: pmap/pjit/shard_map collectives run unmodified on a
+virtual 8-device mesh, so distributed semantics are unit-testable anywhere.
+"""
+
+import os
+import sys
+
+# Must run before jax initializes its backends (conftest imports precede
+# test-module imports under pytest). Env vars alone are not enough in this
+# image: a sitecustomize hook registers the TPU platform and rewrites the
+# jax_platforms config at interpreter start, so override the config directly.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tmp_cache(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hvt_cache")
+    os.environ["HVT_DATA_DIR"] = str(d)
+    return d

@@ -1,0 +1,90 @@
+"""Checkpoint/resume + serving export parity (SURVEY.md §5.4)."""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu import checkpoint
+from horovod_tpu.models import MnistCNN
+
+
+@pytest.fixture()
+def trainer_and_data():
+    hvt.init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.int64)
+    trainer = hvt.Trainer(MnistCNN(), optax.adam(1e-3), seed=0)
+    trainer.fit(x=x, y=y, batch_size=4, epochs=1)
+    return trainer, x, y
+
+
+def test_save_restore_roundtrip(trainer_and_data, tmp_path):
+    trainer, x, y = trainer_and_data
+    path = checkpoint.save(str(tmp_path / "state.msgpack"), trainer.state)
+    fresh = hvt.Trainer(MnistCNN(), optax.adam(1e-3), seed=123)
+    fresh.build(x)
+    restored = checkpoint.restore(path, fresh.state)
+    for a, b in zip(jax.tree.leaves(jax.device_get(trainer.state.params)),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(a, b)
+    # optimizer slots restored too (the 'global variables' include them, §7.3)
+    assert int(restored.step) == int(trainer.state.step)
+
+
+def test_resume_produces_identical_eval(trainer_and_data, tmp_path):
+    trainer, x, y = trainer_and_data
+    path = checkpoint.save(str(tmp_path / "s.msgpack"), trainer.state)
+    fresh = hvt.Trainer(MnistCNN(), optax.adam(1e-3), seed=9)
+    fresh.build(x)
+    fresh.state = checkpoint.broadcast_parameters(
+        checkpoint.restore(path, fresh.state), mesh=fresh.mesh
+    )
+    a = trainer.evaluate(x, y, batch_size=4)
+    b = fresh.evaluate(x, y, batch_size=4)
+    assert a["loss"] == pytest.approx(b["loss"], rel=1e-5)
+
+
+def test_latest_checkpoint_selection(tmp_path, trainer_and_data):
+    trainer, _, _ = trainer_and_data
+    for epoch in (1, 2, 10):
+        checkpoint.save_checkpoint(str(tmp_path), trainer.state, epoch)
+    assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("checkpoint-10.msgpack")
+    state, epoch = checkpoint.restore_latest_and_broadcast(
+        str(tmp_path), trainer.state, mesh=trainer.mesh
+    )
+    assert epoch == 10
+
+
+def test_restore_latest_empty_dir(tmp_path, trainer_and_data):
+    trainer, _, _ = trainer_and_data
+    state, epoch = checkpoint.restore_latest_and_broadcast(
+        str(tmp_path / "nope"), trainer.state
+    )
+    assert epoch == 0
+
+
+def test_serving_export_roundtrip(trainer_and_data, tmp_path):
+    """Timestamped dir + input->prob signature + reloadable compiled fn
+    (mnist_keras.py:126-140 parity, TF-free)."""
+    trainer, x, _ = trainer_and_data
+    params = jax.device_get(trainer.state.params)
+
+    def apply_fn(p, inp):
+        return trainer.module.apply({"params": p}, inp, train=False)
+
+    out_dir = checkpoint.export_serving(
+        str(tmp_path), apply_fn, params,
+        input_shape=(1, 28, 28, 1), timestamp="20260729-000000",
+    )
+    assert out_dir.endswith("20260729-000000")
+    assert os.path.exists(os.path.join(out_dir, "model.stablehlo"))
+    assert os.path.exists(os.path.join(out_dir, "signature.json"))
+    serve = checkpoint.load_serving(out_dir)
+    probs = np.asarray(serve(x[:1]))
+    expected = trainer.predict(x[:1], batch_size=1)
+    np.testing.assert_allclose(probs, expected[:1], rtol=1e-5, atol=1e-6)

@@ -1,0 +1,114 @@
+"""Collective semantics: the Horovod C++-core parity surface (SURVEY.md §3.5).
+
+Critical details under test: AVERAGE (not sum) reduction, root-selective
+broadcast, allgather concatenation — exercised through shard_map over the
+8-fake-device mesh, the traced context real training uses.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvt
+from horovod_tpu.parallel import collectives
+
+try:
+    from jax import shard_map
+
+    def smap(f, mesh, in_specs, out_specs):
+        return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+except ImportError:  # older spelling
+    from jax.experimental.shard_map import shard_map
+
+    def smap(f, mesh, in_specs, out_specs):
+        return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return hvt.data_parallel_mesh()
+
+
+def per_worker_values(mesh):
+    # worker i holds value i: [0..7], one element per data shard
+    return jnp.arange(8, dtype=jnp.float32)
+
+
+def test_allreduce_average_semantics(mesh):
+    x = per_worker_values(mesh)
+    out = smap(
+        lambda v: collectives.allreduce(v, average=True, axis_name="data"),
+        mesh, P("data"), P("data"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
+
+
+def test_allreduce_sum(mesh):
+    x = per_worker_values(mesh)
+    out = smap(
+        lambda v: collectives.allreduce(v, average=False, axis_name="data"),
+        mesh, P("data"), P("data"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_broadcast_from_root(mesh):
+    x = per_worker_values(mesh)
+    for root in (0, 3):
+        out = smap(
+            lambda v: collectives.broadcast(v, root=root, axis_name="data"),
+            mesh, P("data"), P("data"),
+        )(x)
+        np.testing.assert_allclose(np.asarray(out), np.full(8, float(root)))
+
+
+def test_allgather_concatenates(mesh):
+    x = jnp.arange(16, dtype=jnp.float32)  # 2 per worker
+    out = smap(
+        lambda v: collectives.allgather(v, axis_name="data"),
+        mesh, P("data"), P("data"),
+    )(x)
+    # every worker gets the full 16-vector; stacked along data -> (8*16,)
+    assert out.shape == (8 * 16,)
+    np.testing.assert_allclose(np.asarray(out)[:16], np.arange(16))
+
+
+def test_pmean_pytree(mesh):
+    tree = {"a": jnp.arange(8.0), "b": {"c": jnp.arange(8.0) * 2}}
+    out = smap(
+        lambda t: collectives.pmean_pytree(t, axis_name="data"),
+        mesh, P("data"), P("data"),
+    )(tree)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.full(8, 3.5))
+    np.testing.assert_allclose(np.asarray(out["b"]["c"]), np.full(8, 7.0))
+
+
+def test_eager_single_process_degradation():
+    # README.md:49-52 no-launcher mode: collectives are identity at size 1.
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(collectives.allreduce(x), x)
+    np.testing.assert_allclose(collectives.broadcast(x, root=0), x)
+    np.testing.assert_allclose(collectives.allgather(x), x)
+    m = collectives.metric_mean({"loss": 0.5, "acc": 0.9})
+    assert m == {"loss": 0.5, "acc": pytest.approx(0.9)}
+
+
+def test_distributed_optimizer_averages_grads(mesh):
+    """hvd.DistributedOptimizer parity: per-worker grads are averaged before
+    the update (tensorflow2_keras_mnist.py:58; average-not-sum §3.5)."""
+    import optax
+
+    tx = hvt.DistributedOptimizer(optax.sgd(1.0), axis_name="data")
+    params = jnp.zeros(8)
+
+    def step(p, g):
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        return optax.apply_updates(p, updates)
+
+    grads = jnp.arange(8, dtype=jnp.float32)  # worker i grad = i
+    new_params = smap(step, mesh, (P("data"), P("data")), P("data"))(params, grads)
+    # sgd(1.0): p - mean(grads) = -3.5 on every worker
+    np.testing.assert_allclose(np.asarray(new_params), np.full(8, -3.5))

@@ -1,0 +1,46 @@
+"""Mesh construction and world-size-reactive scaling helpers (SURVEY.md §5.6)."""
+
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu.parallel.mesh import AXES, MeshSpec, build_mesh, dp_size
+
+
+def test_default_mesh_is_pure_dp():
+    mesh = hvt.data_parallel_mesh()
+    assert mesh.axis_names == AXES
+    assert mesh.shape["data"] == 8
+    assert all(mesh.shape[ax] == 1 for ax in AXES if ax != "data")
+    assert dp_size(mesh) == 8
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(model=2).resolve(8) == {
+        "data": 4, "fsdp": 1, "seq": 1, "model": 2, "expert": 1,
+    }
+    assert MeshSpec(data=2, seq=2, model=2).resolve(8)["fsdp"] == 1
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=-1).resolve(8)
+
+
+def test_mixed_mesh_builds():
+    mesh = build_mesh(MeshSpec(data=2, model=2, seq=2))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["model"] == 2
+    assert mesh.shape["seq"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_scaling_helpers_match_reference_idioms():
+    # lr × size (tensorflow2_keras_mnist.py:55)
+    assert hvt.scale_lr(0.001, 8) == pytest.approx(0.008)
+    # steps // size (tensorflow2_keras_mnist.py:96)
+    assert hvt.shard_steps(500, 8) == 62
+    assert hvt.shard_steps(500, 1) == 500
+    # ceil(epochs / size) (mnist_keras.py:42)
+    assert hvt.shard_epochs(12, 8) == 2
+    assert hvt.shard_epochs(12, 1) == 12
+    # defaults react to the ambient world (8 fake chips)
+    assert hvt.scale_lr(1.0) == 8.0

@@ -1,0 +1,30 @@
+"""Bootstrap/topology parity tests (hvd.init()/rank/size surface, SURVEY.md §2.4)."""
+
+import jax
+
+import horovod_tpu as hvt
+
+
+def test_init_idempotent_single_process():
+    w1 = hvt.init()
+    w2 = hvt.init()
+    assert w1 == w2
+    assert hvt.is_initialized()
+
+
+def test_topology_queries():
+    hvt.init()
+    # 8 fake devices, one process: size() is chip count (what LR scaling
+    # reacts to), rank() is the single-writer gate.
+    assert hvt.size() == 8
+    assert hvt.rank() == 0
+    assert hvt.local_rank() == 0
+    assert hvt.local_size() == 8
+    assert hvt.process_count() == 1
+    assert hvt.is_primary()
+
+
+def test_world_snapshot():
+    w = hvt.runtime.world()
+    assert w.device_count == jax.device_count() == 8
+    assert not w.is_distributed
