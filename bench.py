@@ -1,0 +1,100 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.json): MNIST training images/sec/chip through the
+full distributed-training step — forward, loss, backward, gradient
+allreduce-mean (the DistributedOptimizer path), optimizer apply — on the
+reference's exact training config: the 2-conv CNN
+(tensorflow2_keras_mnist.py:43-52), per-worker batch 128
+(tensorflow2_keras_mnist.py:41), Adam (tensorflow2_keras_mnist.py:55).
+
+``vs_baseline`` is the ratio against the measured reference-equivalent
+TF2/Keras single-process run on this machine's CPU
+(``benchmarks/baseline_measured.json``, produced by
+``benchmarks/measure_reference_baseline.py`` — the reference publishes no
+numbers of its own, SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+BATCH = 128
+WARMUP_STEPS = 20
+MEASURE_STEPS = 400
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvt
+    from horovod_tpu.data import datasets
+    from horovod_tpu.models.cnn import MnistCNN
+
+    hvt.init()
+    n_chips = jax.device_count()
+
+    (x_train, y_train), _ = datasets.mnist()
+    x = (x_train.astype(np.float32) / 255.0)[..., None]
+    y = y_train.astype(np.int64)
+
+    trainer = hvt.Trainer(
+        MnistCNN(compute_dtype=jnp.bfloat16),
+        hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(1e-3, n_chips))),
+        loss="sparse_categorical_crossentropy",
+    )
+
+    global_batch = BATCH * n_chips
+    rng = np.random.RandomState(0)
+    n_prebatched = 64  # cycle through pre-sliced host batches
+    batches = []
+    for _ in range(n_prebatched):
+        idx = rng.randint(0, len(x), size=global_batch)
+        batches.append((x[idx], y[idx]))
+
+    state = trainer.build(batches[0][0])
+    state = hvt.broadcast_parameters(state, mesh=trainer.mesh)
+    scale = np.float32(1.0)
+
+    for i in range(WARMUP_STEPS):
+        state, metrics = trainer._train_step(
+            state, trainer._shard(batches[i % n_prebatched]), scale
+        )
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        state, metrics = trainer._train_step(
+            state, trainer._shard(batches[i % n_prebatched]), scale
+        )
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec_per_chip = MEASURE_STEPS * global_batch / elapsed / n_chips
+
+    baseline_path = os.path.join(REPO, "benchmarks", "baseline_measured.json")
+    vs_baseline = None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        vs_baseline = round(images_per_sec_per_chip / baseline["images_per_sec"], 2)
+
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_train_images_per_sec_per_chip",
+                "value": round(images_per_sec_per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": vs_baseline,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

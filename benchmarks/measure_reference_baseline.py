@@ -1,0 +1,109 @@
+"""Measure the reference's training math on this machine (baseline numbers).
+
+The reference publishes no performance figures (SURVEY.md §6), so the
+baseline must be measured: this script rebuilds the reference's model and
+input pipeline in TF/Keras — same architecture (tensorflow2_keras_mnist.py:
+43-52), same batch size (128), same optimizer family (Adam 1e-3) — and times
+steady-state training throughput on CPU (BASELINE.json config 1: the
+reference single-process mode, ``hvd.size()==1``, README.md:49-52).
+
+Writes ``benchmarks/baseline_measured.json``; ``bench.py`` reads it to
+compute ``vs_baseline``. Run once per machine:
+
+    python benchmarks/measure_reference_baseline.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCH = 128          # tensorflow2_keras_mnist.py:41
+WARMUP_STEPS = 30
+MEASURE_STEPS = 200
+
+
+def main() -> None:
+    import numpy as np
+    import tensorflow as tf
+
+    from horovod_tpu.data import datasets
+
+    tf.config.set_visible_devices([], "GPU")
+
+    (x_train, y_train), _ = datasets.mnist()
+    x = (x_train.astype("float32") / 255.0)[..., None]
+    y = y_train.astype("int64")
+
+    ds = (
+        tf.data.Dataset.from_tensor_slices((x, y))
+        .repeat()
+        .shuffle(10000)
+        .batch(BATCH)
+    )
+
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Conv2D(32, [3, 3], activation="relu",
+                                   input_shape=(28, 28, 1)),
+            tf.keras.layers.Conv2D(64, [3, 3], activation="relu"),
+            tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+            tf.keras.layers.Dropout(0.25),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(128, activation="relu"),
+            tf.keras.layers.Dropout(0.5),
+            tf.keras.layers.Dense(10, activation="softmax"),
+        ]
+    )
+    model.compile(
+        loss=tf.losses.SparseCategoricalCrossentropy(),
+        optimizer=tf.optimizers.Adam(0.001),
+        metrics=["accuracy"],
+    )
+
+    class Timer(tf.keras.callbacks.Callback):
+        def __init__(self):
+            self.t0 = None
+            self.elapsed = None
+
+        def on_train_batch_begin(self, batch, logs=None):
+            if batch == WARMUP_STEPS:
+                self.t0 = time.perf_counter()
+
+        def on_train_batch_end(self, batch, logs=None):
+            if batch == WARMUP_STEPS + MEASURE_STEPS - 1:
+                self.elapsed = time.perf_counter() - self.t0
+                self.model.stop_training = True
+
+    timer = Timer()
+    model.fit(
+        ds,
+        steps_per_epoch=WARMUP_STEPS + MEASURE_STEPS,
+        epochs=1,
+        callbacks=[timer],
+        verbose=2,
+    )
+
+    images_per_sec = MEASURE_STEPS * BATCH / timer.elapsed
+    result = {
+        "config": "reference-equivalent TF2/Keras MNIST CNN, single process",
+        "hardware": "CPU (this machine)",
+        "batch_size": BATCH,
+        "measure_steps": MEASURE_STEPS,
+        "images_per_sec": round(images_per_sec, 1),
+        "step_time_ms": round(1000 * timer.elapsed / MEASURE_STEPS, 2),
+        "tf_version": tf.__version__,
+    }
+    out = os.path.join(REPO, "benchmarks", "baseline_measured.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

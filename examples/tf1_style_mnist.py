@@ -1,0 +1,109 @@
+"""MNIST train → eval → serving export — the TF1-script capability set.
+
+Behavioral mirror of the reference's `mnist_keras.py` (citations are to that
+file): platform metrics init (:22-23), runtime bootstrap (:30-36), epoch-count
+work division ``ceil(12 / size)`` (:38-42), full-dataset normalize + one-hot
+labels (:48-69), the same CNN (:71-81), Adadelta with lr = 1.0 × size (:84)
+wrapped for gradient averaging (:87), categorical cross-entropy (:89-92),
+broadcast-from-0 callback only (:94-98), rank-0 checkpoints + event log
+(:100-105), per-epoch validation + final all-rank evaluate (:107-113), and the
+rank-0 export tail (:116-143): save final model, reload it, export a serving
+bundle with an ``input → prob`` signature into a timestamped directory, print
+test loss/accuracy (the CI gate's input, config.yaml:8-11).
+
+Smoke-test env knobs: DRIVE_EPOCHS, DRIVE_TRAIN_N, DRIVE_EVAL_N.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import optax
+
+import horovod_tpu as hvt
+from horovod_tpu import checkpoint, metrics
+from horovod_tpu.data import datasets
+from horovod_tpu.models.cnn import MnistCNN
+
+
+def main() -> None:
+    model_path = os.environ.get("PS_MODEL_PATH", "./models")  # :26-27
+    model_dir = os.path.join(model_path, "horovod-mnist")
+    export_dir = os.path.join(model_path, "horovod-mnist-export")
+
+    metrics.init(sync_tensorboard=True)  # :22-23
+    hvt.init()  # :30
+
+    batch_size = 128  # :39
+    num_classes = 10  # :40
+    # Work division idiom #2: epoch count ÷ world size (:42).
+    epochs = int(os.environ.get("DRIVE_EPOCHS", 0)) or hvt.shard_epochs(12)
+
+    # Full-dataset load + /255 normalize (:48-63); NHWC is the TPU-native
+    # layout (the reference's channels_first branch served Theano, :55-60).
+    (x_train, y_train), (x_test, y_test) = datasets.mnist()
+    x_train = (x_train.astype(np.float32) / 255.0)[..., None]
+    x_test = (x_test.astype(np.float32) / 255.0)[..., None]
+    if os.environ.get("DRIVE_TRAIN_N"):
+        n = int(os.environ["DRIVE_TRAIN_N"])
+        x_train, y_train = x_train[:n], y_train[:n]
+    if os.environ.get("DRIVE_EVAL_N"):
+        n = int(os.environ["DRIVE_EVAL_N"])
+        x_test, y_test = x_test[:n], y_test[:n]
+    # One-hot labels + categorical CE, exactly the reference pairing (:66-69,:89).
+    y_train_oh = np.eye(num_classes, dtype=np.float32)[y_train]
+    y_test_oh = np.eye(num_classes, dtype=np.float32)[y_test]
+
+    trainer = hvt.Trainer(
+        MnistCNN(num_classes=num_classes),
+        # Adadelta(1.0 × size) (:84) + gradient averaging (:87).
+        hvt.DistributedOptimizer(optax.adadelta(hvt.scale_lr(1.0))),
+        loss="categorical_crossentropy",  # :89
+    )
+
+    callbacks = [hvt.callbacks.BroadcastGlobalVariablesCallback(0)]  # :94-98
+    callbacks.append(hvt.callbacks.MetricsPushCallback())
+    if hvt.rank() == 0:  # :100-105
+        callbacks.append(
+            hvt.callbacks.ModelCheckpoint(os.path.join(model_dir, "checkpoint-{epoch}.msgpack"))
+        )
+        callbacks.append(
+            hvt.callbacks.ScalarLogger(os.path.join(model_dir, "eval"), update_freq="batch")
+        )
+
+    trainer.fit(  # :107-112
+        x=x_train,
+        y=y_train_oh,
+        batch_size=batch_size,
+        epochs=epochs,
+        callbacks=callbacks,
+        validation_data=(x_test, y_test_oh),
+        verbose=1 if hvt.rank() == 0 else 0,
+    )
+
+    score = trainer.evaluate(x_test, y_test_oh, batch_size=batch_size)  # :113
+
+    if hvt.rank() == 0:  # :116-140
+        # Final model save → reload round-trip (:118-124).
+        final_path = os.path.join(model_dir, "keras-sample-model.msgpack")
+        checkpoint.save(final_path, trainer.state)
+        restored = checkpoint.restore(final_path, trainer.state)
+        # Serving export: timestamped dir, input → prob signature (:126-140).
+        bundle = checkpoint.export_serving(
+            export_dir,
+            lambda params, x: trainer.module.apply({"params": params}, x, train=False),
+            restored.params,
+            input_shape=(1, 28, 28, 1),
+        )
+        print("Exported serving bundle:", bundle)
+
+    metrics.push("loss", score["loss"])
+    metrics.push("accuracy", score["accuracy"])
+    print("Test loss:", score["loss"])  # :142
+    print("Test accuracy:", score["accuracy"])  # :143
+
+
+if __name__ == "__main__":
+    main()

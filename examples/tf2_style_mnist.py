@@ -1,0 +1,108 @@
+"""MNIST data-parallel training — the TF2-script capability set, TPU-native.
+
+Behavioral mirror of the reference's `tensorflow2_keras_mnist.py` (every
+numbered behavior below cites the reference line it reproduces):
+
+* model/checkpoint dirs from ``PS_MODEL_PATH`` (default ``./models``)   :21-22
+* runtime bootstrap (the ``hvd.init()`` role; device pinning obsolete)  :25-32
+* per-rank dataset cache path avoiding filesystem races                 :34-35
+* infinite shuffled per-worker batches of 128                           :37-41
+* the 2-conv CNN                                                        :43-52
+* Adam with lr = 0.001 × world size                                     :55
+* gradient-averaging distributed optimizer                              :58
+* sparse categorical cross-entropy + accuracy                           :62-65
+* callbacks: broadcast-from-0, metric averaging, 3-epoch LR warmup      :67-83
+* rank-0-only per-epoch checkpoints + scalar event log                  :85-92
+* fit with steps_per_epoch = 500 // size, 24 epochs, rank-0 verbosity   :96
+
+Run it bare (single chip, no launcher — README.md:49-52), or under the
+launcher for multi-host:
+
+    python examples/tf2_style_mnist.py
+    python -m horovod_tpu.launch run --nprocs 4 -- python examples/tf2_style_mnist.py
+
+Smoke-test env knobs (used by tests/CI to shorten the run; full reference
+budget when unset): DRIVE_STEPS, DRIVE_EPOCHS.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvt
+from horovod_tpu import metrics
+from horovod_tpu.data import datasets
+from horovod_tpu.data.loader import ArrayDataset
+from horovod_tpu.models.cnn import MnistCNN
+
+
+def main() -> None:
+    model_dir = os.path.join(os.environ.get("PS_MODEL_PATH", "./models"), "horovod-mnist")
+
+    # Bootstrap: process/topology init. One call, idempotent, works launched
+    # and unlaunched (the reference's hvd.init(), :25).
+    hvt.init()
+    metrics.init(sync_tensorboard=True)
+
+    # Per-rank cache path: same race-avoidance convention as
+    # 'mnist-%d.npz' % hvd.rank() (:34-35).
+    (x_train, y_train), _ = datasets.mnist(path=f"mnist-{hvt.rank()}.npz")
+    x_train = (x_train.astype(np.float32) / 255.0)[..., None]
+    y_train = y_train.astype(np.int64)
+
+    # Input pipeline (:37-41): this process's shard → repeat → shuffle(10000)
+    # → per-process batch. Global batch is 128 × world chips; the reference
+    # feeds every rank the full dataset, we shard it (SURVEY.md §7.1 data.py
+    # improvement) — global work accounting is unchanged.
+    world = hvt.process_count()
+    per_process_batch = 128 * hvt.size() // world
+    dataset = (
+        ArrayDataset((x_train, y_train))
+        .shard(hvt.process_rank(), world)
+        .repeat()
+        .shuffle(10000, seed=hvt.process_rank())
+        .batch(per_process_batch)
+    )
+
+    trainer = hvt.Trainer(
+        MnistCNN(compute_dtype=jnp.bfloat16),
+        # Adam(0.001 × size) (:55) wrapped for gradient averaging (:58).
+        hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(0.001))),
+        loss="sparse_categorical_crossentropy",  # :63
+    )
+
+    callbacks = [
+        # Broadcast initial model+optimizer variables from rank 0 (:67-71).
+        hvt.callbacks.BroadcastGlobalVariablesCallback(0),
+        # Average metrics across workers; keep ahead of consumers (:73-77).
+        hvt.callbacks.MetricAverageCallback(),
+        # Scale lr ×size over the first 3 epochs (:78-83).
+        hvt.callbacks.LearningRateWarmupCallback(warmup_epochs=3, verbose=1),
+        hvt.callbacks.MetricsPushCallback(),
+    ]
+    # Rank-0-only artifacts (:85-92); other workers would corrupt them.
+    if hvt.rank() == 0:
+        callbacks.append(
+            hvt.callbacks.ModelCheckpoint(os.path.join(model_dir, "checkpoint-{epoch}.msgpack"))
+        )
+        callbacks.append(hvt.callbacks.ScalarLogger(model_dir, update_freq="batch"))
+
+    steps_per_epoch = int(os.environ.get("DRIVE_STEPS", 0)) or hvt.shard_steps(500)  # :96
+    epochs = int(os.environ.get("DRIVE_EPOCHS", 0)) or 24  # :96
+
+    trainer.fit(
+        dataset,
+        steps_per_epoch=steps_per_epoch,
+        epochs=epochs,
+        callbacks=callbacks,
+        verbose=1 if hvt.rank() == 0 else 0,  # :92
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Launcher / cluster orchestration — the L5+L6 replacement (SURVEY.md §1).
+
+The reference launches with ``mpirun --hostfile /generated/hostfile`` under a
+platform that provisions workers running ``sleep infinity`` (README.md:57,
+distributed-keras-sample.yaml:1-11) and gates CI on a metric range
+(config.yaml:8-11). TPU-native, that becomes:
+
+* `launcher.run_local` — N processes on this host (the "Docker-local mpirun"
+  test mode, README.md:53-58), coordinator address auto-assigned.
+* `launcher.run_hosts` — one process per host over ssh with env propagation
+  (the ``mpirun -x`` role), coordinator = first host.
+* `ci_gate` — aggregate a metric stream and assert a target range (the
+  Gradient workflow's ``checks`` block).
+* `job` — YAML job specs binding the two together (the `.ps_project` role).
+
+CLI:  python -m horovod_tpu.launch run --nprocs 4 -- python train.py
+      python -m horovod_tpu.launch pod --hostfile hosts.txt -- python train.py
+      python -m horovod_tpu.launch gate --metrics m.jsonl --check loss=0.0..0.3
+      python -m horovod_tpu.launch job launch/jobs/mnist-ci.yaml
+"""
+
+from horovod_tpu.launch.launcher import run_local, run_hosts
+from horovod_tpu.launch.ci_gate import check_metrics, parse_target
+
+__all__ = ["run_local", "run_hosts", "check_metrics", "parse_target"]

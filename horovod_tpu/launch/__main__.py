@@ -1,0 +1,3 @@
+from horovod_tpu.launch.launcher import main
+
+raise SystemExit(main())

@@ -1,0 +1,85 @@
+"""CI convergence gate — the Gradient workflow's ``checks`` block, natively.
+
+Reference semantics (config.yaml:8-11): after the multinode run, aggregate a
+named metric stream (``tensorflow:loss``, ``aggregate: mean``) and require it
+inside ``target: "0.0..0.3"``. Here the stream is the `horovod_tpu.metrics`
+JSONL file and the target grammar is the same ``lo..hi`` string.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def parse_target(target: str) -> tuple[float, float]:
+    """Parse the reference's range grammar: ``"0.0..0.3"`` → (0.0, 0.3)."""
+    lo, hi = target.split("..")
+    return float(lo), float(hi)
+
+
+def read_metric(path: str, name: str) -> list[float]:
+    values = []
+    if not os.path.exists(path):
+        return values
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("name") == name:
+                values.append(float(rec["value"]))
+    return values
+
+
+def aggregate(values: list[float], how: str = "mean") -> float:
+    if not values:
+        raise ValueError("no values to aggregate")
+    if how == "mean":
+        return sum(values) / len(values)
+    if how == "last":
+        return values[-1]
+    if how == "min":
+        return min(values)
+    if how == "max":
+        return max(values)
+    raise ValueError(f"unknown aggregate {how!r}")
+
+
+_aggregate = aggregate
+
+
+def check_metrics(
+    path: str,
+    name: str,
+    target: tuple[float, float],
+    aggregate: str = "mean",
+) -> tuple[bool, float]:
+    """Return (passed, aggregated value). Missing metric — or a missing
+    metrics file entirely — fails the gate rather than crashing it (a run
+    that logged nothing must not pass)."""
+    values = read_metric(path, name)
+    if not values:
+        return False, float("nan")
+    value = _aggregate(values, aggregate)
+    lo, hi = target
+    return lo <= value <= hi, value
+
+
+def run_checks(metrics_path: str, checks: dict) -> bool:
+    """Evaluate a ``{name: {target, aggregate}}`` block (the config.yaml:8-11
+    shape), printing one verdict line per check. Shared by the CLI and the
+    YAML job runner."""
+    ok = True
+    for name, rule in checks.items():
+        how = rule.get("aggregate", "mean")
+        passed, value = check_metrics(
+            metrics_path, name, parse_target(str(rule["target"])), aggregate=how
+        )
+        print(
+            f"check {name}: {how}={value:.6g} target={rule['target']} "
+            f"{'PASS' if passed else 'FAIL'}"
+        )
+        ok = ok and passed
+    return ok

@@ -1,0 +1,49 @@
+"""YAML job specs — the `.ps_project/` role, TPU-native.
+
+A spec binds a command, a topology (local nprocs or a host list), env, and
+post-run metric checks, mirroring what `distributed-keras-sample.yaml` (the
+experiment) + `config.yaml` (the workflow with its checks) express for the
+reference. See `horovod_tpu/launch/jobs/mnist-ci.yaml` for the shape.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+
+import yaml
+
+from horovod_tpu.launch import ci_gate, launcher
+
+
+def run_job(spec_path: str) -> int:
+    """Execute a job spec: launch, then gate. Returns a shell exit code."""
+    with open(spec_path) as f:
+        spec = yaml.safe_load(f)
+
+    job = spec.get("job", {})
+    command = job["command"]
+    argv = command if isinstance(command, list) else shlex.split(command)
+    env = {str(k): str(v) for k, v in (job.get("env") or {}).items()}
+
+    hosts = job.get("hosts")
+    if hosts:
+        code = launcher.run_hosts(
+            list(hosts), argv, env=env,
+            coordinator_port=int(job.get("coordinator_port", 9981)),
+            workdir=job.get("workdir"),
+        )
+    else:
+        code = launcher.run_local(int(job.get("nprocs", 1)), argv, env=env)
+    if code != 0:
+        print(f"job failed with exit code {code}")
+        return code
+
+    checks = spec.get("checks") or {}
+    if not checks:
+        return 0
+    metrics_path = spec.get(
+        "metrics",
+        os.path.join(env.get("PS_MODEL_PATH", "./models"), "metrics.jsonl"),
+    )
+    return 0 if ci_gate.run_checks(metrics_path, checks) else 1

@@ -1,0 +1,244 @@
+"""Process launchers: local multi-process and ssh multi-host.
+
+Replaces the reference's ``mpirun`` path (README.md:57): slot mapping becomes
+explicit ``HVT_PROCESS_ID`` assignment, ``-x`` env propagation becomes an env
+dict serialized into each remote command, and ``/generated/hostfile`` becomes
+an explicit host list. `horovod_tpu.runtime.init` on the worker side consumes
+the HVT_* variables (runtime.py ENV_*) and wires `jax.distributed`.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+
+from horovod_tpu.runtime import (
+    ENV_COORDINATOR,
+    ENV_LOCAL_RANK,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+
+
+def pick_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _wait_fail_stop(
+    procs: list[subprocess.Popen], grace_seconds: float = 30.0
+) -> int:
+    """Wait for all processes with fail-stop semantics: when any exits
+    nonzero, surviving peers get ``grace_seconds`` to finish on their own
+    (they may be blocked in a collective waiting for the dead rank — the MPI
+    abort analogue, SURVEY.md §5.3) and are then terminated. Returns the
+    first nonzero exit code, 0 if all succeeded."""
+    import time
+
+    first_failure: int | None = None
+    deadline = None
+    while True:
+        running = [p for p in procs if p.poll() is None]
+        if first_failure is None:
+            failed = next(
+                (p.returncode for p in procs
+                 if p.returncode not in (None, 0)), None
+            )
+            if failed is not None:
+                first_failure = failed
+                deadline = time.monotonic() + grace_seconds
+        if not running:
+            break
+        if deadline is not None and time.monotonic() > deadline:
+            for p in running:
+                p.terminate()
+            for p in running:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            break
+        time.sleep(0.1)
+    if first_failure is not None:
+        return first_failure
+    return next((p.returncode for p in procs if p.returncode != 0), 0)
+
+
+def _stream(proc: subprocess.Popen, tag: str) -> threading.Thread:
+    """Prefix-tag a child's merged output, like mpirun's rank tagging."""
+
+    def pump():
+        for line in proc.stdout:
+            sys.stdout.write(f"[{tag}] {line if isinstance(line, str) else line.decode()}")
+            sys.stdout.flush()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
+def run_local(
+    nprocs: int,
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    coordinator_port: int | None = None,
+    tag_output: bool = True,
+) -> int:
+    """Run ``argv`` as ``nprocs`` coordinated processes on this host.
+
+    The reference's single-container multi-slot test mode (README.md:53-58:
+    ``mpirun -np N`` inside one Docker image) without MPI: each child gets
+    the coordinator address and its process id via HVT_* env vars. Returns
+    the first nonzero exit code (0 if all succeeded) — fail-stop semantics,
+    like an MPI job aborting on any rank failure (SURVEY.md §5.3)."""
+    port = coordinator_port or pick_free_port()
+    base_env = dict(os.environ)
+    base_env.update(env or {})
+    procs = []
+    for i in range(nprocs):
+        child_env = dict(base_env)
+        if nprocs > 1:
+            # nprocs == 1 is the reference's bare no-launcher mode
+            # (README.md:49-52): no coordinator, collectives degrade locally.
+            child_env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+            child_env[ENV_NUM_PROCESSES] = str(nprocs)
+            child_env[ENV_PROCESS_ID] = str(i)
+        child_env[ENV_LOCAL_RANK] = str(i)
+        procs.append(
+            subprocess.Popen(
+                argv,
+                env=child_env,
+                stdout=subprocess.PIPE if tag_output else None,
+                stderr=subprocess.STDOUT if tag_output else None,
+                text=tag_output,
+            )
+        )
+    pumps = [_stream(p, f"rank {i}") for i, p in enumerate(procs) if tag_output]
+    code = _wait_fail_stop(procs)
+    for t in pumps:
+        t.join(timeout=5)
+    return code
+
+
+def run_hosts(
+    hosts: list[str],
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    coordinator_port: int = 9981,
+    ssh_args: tuple[str, ...] = ("-o", "StrictHostKeyChecking=no"),
+    workdir: str | None = None,
+) -> int:
+    """Run ``argv`` once per host over ssh — one process per TPU host.
+
+    The multi-host path (distributed-keras-sample.yaml topology): host 0 is
+    the coordinator (the 'master' whose address every worker dials, replacing
+    /generated/hostfile), env is propagated by injecting ``K=V`` exports into
+    the remote command (the ``mpirun -x`` role)."""
+    # Hostfile entries may be ssh-style 'user@host'; the coordinator address
+    # every rank dials must be the bare host.
+    coord_host = hosts[0].rsplit("@", 1)[-1]
+    coord = f"{coord_host}:{coordinator_port}"
+    procs = []
+    for i, host in enumerate(hosts):
+        remote_env = {
+            ENV_COORDINATOR: coord,
+            ENV_NUM_PROCESSES: str(len(hosts)),
+            ENV_PROCESS_ID: str(i),
+            ENV_LOCAL_RANK: "0",
+            **(env or {}),
+        }
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in remote_env.items())
+        cd = f"cd {shlex.quote(workdir)} && " if workdir else ""
+        remote_cmd = f"{cd}{exports} {' '.join(shlex.quote(a) for a in argv)}"
+        procs.append(
+            subprocess.Popen(
+                ["ssh", *ssh_args, host, remote_cmd],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    pumps = [_stream(p, f"{hosts[i]}") for i, p in enumerate(procs)]
+    code = _wait_fail_stop(procs)
+    for t in pumps:
+        t.join(timeout=5)
+    return code
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Everything after `--` is the launched command (run/pod only); the head
+    # is parsed strictly so typo'd flags error instead of being ignored.
+    command: list[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, command = argv[:split], argv[split + 1 :]
+
+    parser = argparse.ArgumentParser(prog="python -m horovod_tpu.launch")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="N coordinated processes on this host")
+    p_run.add_argument("--nprocs", type=int, required=True)
+    p_run.add_argument("--env", action="append", default=[], metavar="K=V")
+
+    p_pod = sub.add_parser("pod", help="one process per host over ssh")
+    p_pod.add_argument("--hostfile", help="file with one host per line")
+    p_pod.add_argument("--hosts", help="comma-separated host list")
+    p_pod.add_argument("--port", type=int, default=9981)
+    p_pod.add_argument("--workdir")
+    p_pod.add_argument("--env", action="append", default=[], metavar="K=V")
+
+    p_gate = sub.add_parser("gate", help="CI metric range check")
+    p_gate.add_argument("--metrics", required=True, help="metrics.jsonl path")
+    p_gate.add_argument("--check", action="append", required=True,
+                        metavar="NAME=LO..HI")
+    p_gate.add_argument("--aggregate", default="mean",
+                        choices=["mean", "last", "min", "max"])
+
+    p_job = sub.add_parser("job", help="run a YAML job spec")
+    p_job.add_argument("spec")
+
+    args = parser.parse_args(argv)
+    if args.cmd in ("run", "pod") and not command:
+        parser.error(f"{args.cmd} needs a command after `--`")
+    if args.cmd not in ("run", "pod") and command:
+        parser.error(f"{args.cmd} takes no trailing command")
+    if args.cmd == "run":
+        env = dict(kv.split("=", 1) for kv in args.env)
+        return run_local(args.nprocs, command, env=env)
+    if args.cmd == "pod":
+        if args.hostfile:
+            with open(args.hostfile) as f:
+                hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+        elif args.hosts:
+            hosts = args.hosts.split(",")
+        else:
+            parser.error("pod needs --hostfile or --hosts")
+        env = dict(kv.split("=", 1) for kv in args.env)
+        return run_hosts(hosts, command, env=env,
+                         coordinator_port=args.port, workdir=args.workdir)
+    if args.cmd == "gate":
+        from horovod_tpu.launch.ci_gate import run_checks
+
+        checks = {}
+        for spec in args.check:
+            name, target = spec.split("=", 1)
+            checks[name] = {"target": target, "aggregate": args.aggregate}
+        return 0 if run_checks(args.metrics, checks) else 1
+    if args.cmd == "job":
+        from horovod_tpu.launch.job import run_job
+
+        return run_job(args.spec)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

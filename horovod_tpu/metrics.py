@@ -53,27 +53,53 @@ class JsonlSink:
         self._fh.close()
 
 
-_sink: MetricsSink = NullSink()
+_sink: MetricsSink | None = None
+_configured_path: str | None = None
+_buffered: list[tuple[str, float, int | None]] = []
 
 
 def init(sync_tensorboard: bool = False, path: str | None = None) -> None:
     """Parity shim for ``gradient_utils.metrics.init`` (mnist_keras.py:23).
 
-    Primary process only (single-writer, §5.2); others keep the NullSink."""
-    global _sink
-    if not runtime.is_primary():
-        return
-    path = path or os.path.join(
+    Sink creation is deferred: the reference calls ``metrics.init`` *before*
+    ``hvd.init()`` (mnist_keras.py:22-30), and deciding the primary process
+    must not touch the JAX backend before `runtime.init` has configured
+    `jax.distributed`. Pushes that arrive before `runtime.init` are buffered
+    and flushed on the first post-init push."""
+    global _sink, _configured_path
+    _sink = None
+    _configured_path = path or os.path.join(
         os.environ.get("HVT_METRICS_DIR", os.environ.get("PS_MODEL_PATH", "./models")),
         "metrics.jsonl",
     )
-    _sink = JsonlSink(path)
+
+
+def _resolve() -> MetricsSink | None:
+    """The active sink, or None while the runtime isn't initialized yet
+    (single-writer identity is unknowable before then, §5.2)."""
+    global _sink
+    if _sink is None:
+        if _configured_path is not None:
+            if not runtime.is_initialized():
+                return None
+            # Primary process only; others get the NullSink.
+            _sink = JsonlSink(_configured_path) if runtime.is_primary() else NullSink()
+        else:
+            _sink = NullSink()
+    return _sink
 
 
 def push(name: str, value: float, step: int | None = None) -> None:
-    _sink.push(name, value, step)
+    sink = _resolve()
+    if sink is None:
+        _buffered.append((name, float(value), step))
+        return
+    while _buffered:
+        sink.push(*_buffered.pop(0))
+    sink.push(name, value, step)
 
 
 def set_sink(sink: MetricsSink) -> None:
-    global _sink
+    global _sink, _configured_path
     _sink = sink
+    _configured_path = None

@@ -50,6 +50,12 @@ ENV_COORDINATOR = "HVT_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "HVT_NUM_PROCESSES"
 ENV_PROCESS_ID = "HVT_PROCESS_ID"
 ENV_LOCAL_RANK = "HVT_LOCAL_RANK"
+# Platform override for launched children (testing the multi-process path on
+# CPU). JAX_PLATFORMS alone is not reliable when a site hook force-registers
+# an accelerator platform at interpreter start; init() applies these to
+# jax.config directly, which must happen before any backend use.
+ENV_PLATFORM = "HVT_PLATFORM"
+ENV_NUM_CPU_DEVICES = "HVT_NUM_CPU_DEVICES"
 
 _initialized = False
 
@@ -91,6 +97,11 @@ def init(
     global _initialized
     if _initialized:
         return world()
+
+    if os.environ.get(ENV_PLATFORM):
+        jax.config.update("jax_platforms", os.environ[ENV_PLATFORM])
+    if os.environ.get(ENV_NUM_CPU_DEVICES):
+        jax.config.update("jax_num_cpu_devices", int(os.environ[ENV_NUM_CPU_DEVICES]))
 
     coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
     if num_processes is None and os.environ.get(ENV_NUM_PROCESSES):

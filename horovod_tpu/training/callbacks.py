@@ -148,12 +148,25 @@ class ScalarLogger(Callback):
     ``log_every`` thins batch records (1 = every batch); epoch records are
     always written."""
 
-    def __init__(self, log_dir: str, update_freq: str = "epoch", log_every: int = 1):
+    def __init__(
+        self,
+        log_dir: str,
+        update_freq: str = "epoch",
+        log_every: int = 1,
+        flush_every: int = 100,
+    ):
         self.log_dir = log_dir
         self.update_freq = update_freq
         self.log_every = max(1, log_every)
+        self.flush_every = max(1, flush_every)
         self._fh = None
         self._step = 0
+        # Per-batch records hold device arrays until flushed — fetching
+        # (device_get) per batch would force a host sync every step and
+        # serialize the dispatch pipeline (the async-dispatch overlap is
+        # where TPU step-time hides). flush_every bounds how many batch
+        # records a mid-epoch crash can lose.
+        self._pending: list[tuple[int, float, dict]] = []
 
     def _writer(self):
         if self._fh is None:
@@ -161,31 +174,64 @@ class ScalarLogger(Callback):
             self._fh = open(os.path.join(self.log_dir, "events.jsonl"), "a")
         return self._fh
 
-    def _emit(self, tag_prefix: str, logs: dict, step: int):
+    def _emit(self, tag_prefix: str, logs: dict, step: int, wall_time=None):
         if not runtime.is_primary() or not logs:
             return
-        record = {"wall_time": time.time(), "step": step}
+        record = {"wall_time": wall_time or time.time(), "step": step}
         for k, v in logs.items():
             try:
                 record[f"{tag_prefix}{k}"] = float(v)
             except (TypeError, ValueError):
                 continue
-        fh = self._writer()
-        fh.write(json.dumps(record) + "\n")
-        fh.flush()
+        self._writer().write(json.dumps(record) + "\n")
+
+    def _flush_pending(self):
+        if self._pending:
+            fetched = jax.device_get([logs for _, _, logs in self._pending])
+            for (step, wall, _), logs in zip(self._pending, fetched):
+                self._emit("batch/", logs, step, wall_time=wall)
+            self._pending = []
+        if self._fh:
+            self._fh.flush()
 
     def on_batch_end(self, batch: int, logs=None):
         self._step += 1
-        if self.update_freq == "batch" and self._step % self.log_every == 0:
-            self._emit("batch/", jax.device_get(logs) if logs else {}, self._step)
+        if self.update_freq == "batch" and self._step % self.log_every == 0 and logs:
+            if runtime.is_primary():
+                self._pending.append((self._step, time.time(), logs))
+                if len(self._pending) >= self.flush_every:
+                    self._flush_pending()
 
     def on_epoch_end(self, epoch: int, logs=None):
+        self._flush_pending()
         self._emit("epoch/", logs or {}, epoch + 1)
+        if self._fh:
+            self._fh.flush()
 
     def on_train_end(self, logs=None):
+        self._flush_pending()
         if self._fh:
             self._fh.close()
             self._fh = None
+
+
+class MetricsPushCallback(Callback):
+    """Push epoch-end logs to the platform metrics sink (§5.5 channel 1).
+
+    The role gradient_utils plays in the reference (mnist_keras.py:22-23,
+    consumed by the CI loss gate, config.yaml:8-11): every epoch-end scalar
+    goes to `horovod_tpu.metrics`, whose JSONL stream the CI gate
+    (`horovod_tpu.launch.ci_gate`) aggregates. Place it AFTER
+    MetricAverageCallback so pushed values are fleet averages."""
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        from horovod_tpu import metrics
+
+        for k, v in (logs or {}).items():
+            try:
+                metrics.push(k, float(v), step=epoch + 1)
+            except (TypeError, ValueError):
+                continue
 
 
 # Keras-name alias: the reference registers this under TensorBoard.

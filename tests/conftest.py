@@ -28,6 +28,10 @@ if REPO_ROOT not in sys.path:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running end-to-end tests")
+
+
 @pytest.fixture(scope="session")
 def tmp_cache(tmp_path_factory):
     d = tmp_path_factory.mktemp("hvt_cache")

@@ -1,0 +1,80 @@
+"""End-to-end example-script smoke tests on the virtual 8-device CPU mesh.
+
+These exercise the two entry points the way the reference's CI exercises its
+scripts (SURVEY.md §4): a real subprocess run of the public surface, with
+DRIVE_* knobs shrinking the budget so CPU convolutions fit in test time.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENV = {
+    **os.environ,
+    "HVT_PLATFORM": "cpu",
+    "HVT_NUM_CPU_DEVICES": "8",
+}
+
+
+def _run(script, extra_env):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        env={**ENV, **extra_env},
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+def test_tf2_style_mnist(tmp_path):
+    res = _run(
+        "tf2_style_mnist.py",
+        {"PS_MODEL_PATH": str(tmp_path), "DRIVE_STEPS": "3", "DRIVE_EPOCHS": "2"},
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    model_dir = tmp_path / "horovod-mnist"
+    # Rank-0 artifacts: per-epoch checkpoints + batch-frequency event log.
+    assert (model_dir / "checkpoint-1.msgpack").exists()
+    assert (model_dir / "checkpoint-2.msgpack").exists()
+    events = [json.loads(l) for l in (model_dir / "events.jsonl").read_text().splitlines()]
+    assert any("batch/loss" in e for e in events)
+    assert any("epoch/loss" in e for e in events)
+    # Warmup ramps 1/8 → 1.0 on the 8-chip mesh.
+    assert "lr scale 0.1250" in res.stdout
+
+
+@pytest.mark.slow
+def test_tf1_style_mnist(tmp_path):
+    res = _run(
+        "tf1_style_mnist.py",
+        {
+            "PS_MODEL_PATH": str(tmp_path),
+            "DRIVE_EPOCHS": "1",
+            "DRIVE_TRAIN_N": "4096",
+            "DRIVE_EVAL_N": "1024",
+        },
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "Test loss:" in res.stdout and "Test accuracy:" in res.stdout
+    model_dir = tmp_path / "horovod-mnist"
+    assert (model_dir / "checkpoint-1.msgpack").exists()
+    assert (model_dir / "keras-sample-model.msgpack").exists()
+    # Timestamped serving export with the input→prob signature.
+    exports = list((tmp_path / "horovod-mnist-export").iterdir())
+    assert len(exports) == 1
+    sig = json.loads((exports[0] / "signature.json").read_text())
+    assert "input" in sig["signature"]["inputs"]
+    assert "prob" in sig["signature"]["outputs"]
+    assert (exports[0] / "model.stablehlo").exists()
+    # Platform metrics stream feeds the CI gate.
+    metrics = [
+        json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert any(m["name"] == "loss" for m in metrics)

@@ -1,0 +1,158 @@
+"""Launcher + CI-gate tests (SURVEY.md §3.4 launch path, §4 test modes)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.launch import ci_gate, launcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_metrics(path, values, name="loss"):
+    with open(path, "w") as f:
+        for i, v in enumerate(values):
+            f.write(json.dumps({"name": name, "value": v, "step": i}) + "\n")
+
+
+class TestCIGate:
+    def test_parse_target_reference_grammar(self):
+        # The exact string from config.yaml:10.
+        assert ci_gate.parse_target("0.0..0.3") == (0.0, 0.3)
+
+    def test_aggregates(self):
+        vals = [0.4, 0.2, 0.05]
+        assert ci_gate.aggregate(vals, "mean") == pytest.approx(0.21666, rel=1e-3)
+        assert ci_gate.aggregate(vals, "last") == 0.05
+        assert ci_gate.aggregate(vals, "min") == 0.05
+        assert ci_gate.aggregate(vals, "max") == 0.4
+
+    def test_check_pass_and_fail(self, tmp_path):
+        path = tmp_path / "metrics.jsonl"
+        _write_metrics(path, [0.25, 0.15, 0.08])
+        ok, value = ci_gate.check_metrics(str(path), "loss", (0.0, 0.3))
+        assert ok and value == pytest.approx(0.16)
+        ok, _ = ci_gate.check_metrics(str(path), "loss", (0.0, 0.1))
+        assert not ok
+
+    def test_missing_metric_fails_not_crashes(self, tmp_path):
+        path = tmp_path / "metrics.jsonl"
+        _write_metrics(path, [0.1], name="accuracy")
+        ok, value = ci_gate.check_metrics(str(path), "loss", (0.0, 0.3))
+        assert not ok
+
+    def test_gate_cli(self, tmp_path):
+        path = tmp_path / "metrics.jsonl"
+        _write_metrics(path, [0.2, 0.1])
+        assert launcher.main(["gate", "--metrics", str(path),
+                              "--check", "loss=0.0..0.3"]) == 0
+        assert launcher.main(["gate", "--metrics", str(path),
+                              "--check", "loss=0.0..0.01"]) == 1
+
+
+class TestRunLocal:
+    def test_single_process_no_coordinator(self, tmp_path):
+        """nprocs=1 is the bare no-launcher mode: no HVT coordinator env."""
+        out = tmp_path / "env.json"
+        code = launcher.run_local(
+            1,
+            [sys.executable, "-c", textwrap.dedent(f"""
+                import json, os
+                json.dump({{k: v for k, v in os.environ.items()
+                           if k.startswith('HVT_')}}, open({str(out)!r}, 'w'))
+            """)],
+            tag_output=False,
+        )
+        assert code == 0
+        env = json.load(open(out))
+        assert "HVT_COORDINATOR_ADDRESS" not in env
+
+    def test_multi_process_env_assignment(self, tmp_path):
+        code = launcher.run_local(
+            3,
+            [sys.executable, "-c", textwrap.dedent(f"""
+                import os
+                rank = os.environ['HVT_PROCESS_ID']
+                assert os.environ['HVT_NUM_PROCESSES'] == '3'
+                assert os.environ['HVT_COORDINATOR_ADDRESS'].startswith('127.0.0.1:')
+                open(os.path.join({str(tmp_path)!r}, f'rank-{{rank}}'), 'w').close()
+            """)],
+            tag_output=False,
+        )
+        assert code == 0
+        assert sorted(p.name for p in tmp_path.glob("rank-*")) == [
+            "rank-0", "rank-1", "rank-2"]
+
+    def test_failure_propagates(self):
+        code = launcher.run_local(
+            2, [sys.executable, "-c", "import os,sys; sys.exit(int(os.environ['HVT_PROCESS_ID']) * 7)"],
+            tag_output=False,
+        )
+        assert code == 7  # fail-stop: any rank's nonzero code surfaces
+
+
+class TestJob:
+    def test_job_runs_and_gates(self, tmp_path):
+        metrics = tmp_path / "metrics.jsonl"
+        spec = tmp_path / "job.yaml"
+        # The command itself writes the metric stream, standing in for a
+        # training run; checks then replicate config.yaml:8-11.
+        writer = (
+            "import json;"
+            f"f=open({str(metrics)!r},'w');"
+            "[f.write(json.dumps({'name':'loss','value':v})+'\\n') for v in (0.25,0.1)]"
+        )
+        spec.write_text(textwrap.dedent(f"""
+            name: test-job
+            job:
+              command: ["{sys.executable}", "-c", {json.dumps(writer)}]
+              nprocs: 1
+            metrics: {metrics}
+            checks:
+              loss:
+                target: "0.0..0.3"
+                aggregate: mean
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 0
+
+        spec2 = tmp_path / "job2.yaml"
+        spec2.write_text(spec.read_text().replace("0.0..0.3", "0.0..0.05"))
+        assert run_job(str(spec2)) == 1
+
+
+@pytest.mark.slow
+class TestDistributedLaunch:
+    def test_two_process_cpu_collectives(self, tmp_path):
+        """Full multi-process path: 2 coordinated CPU processes, broadcast +
+        allreduce agree — the 'Docker-local mpirun' test mode (README.md:53-58)."""
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {REPO!r})
+            import horovod_tpu as hvt
+            import numpy as np
+            w = hvt.init()
+            assert hvt.process_count() == 2, hvt.process_count()
+            from horovod_tpu.parallel import collectives
+            val = np.float32(hvt.process_rank() + 1.0)
+            mean = collectives.allreduce(val)
+            assert abs(float(mean) - 1.5) < 1e-6, mean
+            tree = collectives.broadcast_pytree(
+                {{'a': np.full((3,), hvt.process_rank(), np.float32)}})
+            assert float(tree['a'][0]) == 0.0
+            open({str(tmp_path)!r} + f'/ok-{{hvt.process_rank()}}', 'w').close()
+        """))
+        code = launcher.run_local(
+            2,
+            [sys.executable, str(script)],
+            env={"HVT_PLATFORM": "cpu", "HVT_NUM_CPU_DEVICES": "1"},
+            tag_output=False,
+        )
+        assert code == 0
+        assert (tmp_path / "ok-0").exists() and (tmp_path / "ok-1").exists()
