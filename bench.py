@@ -12,6 +12,9 @@ TF2/Keras single-process run on this machine's CPU
 (``benchmarks/baseline_measured.json``, produced by
 ``benchmarks/measure_reference_baseline.py`` — the reference publishes no
 numbers of its own, SURVEY.md §6).
+
+``BENCH_MODEL=resnet`` switches to the heavier-gradients config
+(BASELINE.json config 4: CIFAR-10 ResNet-20); default is the MNIST headline.
 """
 
 from __future__ import annotations
@@ -35,16 +38,26 @@ def main() -> None:
     import horovod_tpu as hvt
     from horovod_tpu.data import datasets
     from horovod_tpu.models.cnn import MnistCNN
+    from horovod_tpu.models.resnet import ResNetCIFAR
 
     hvt.init()
     n_chips = jax.device_count()
+    which = os.environ.get("BENCH_MODEL", "mnist")
 
-    (x_train, y_train), _ = datasets.mnist()
-    x = (x_train.astype(np.float32) / 255.0)[..., None]
+    if which == "resnet":
+        (x_train, y_train), _ = datasets.cifar10()
+        x = x_train.astype(np.float32) / 255.0
+        module = ResNetCIFAR(depth=20, compute_dtype=jnp.bfloat16)
+        metric = "cifar10_resnet20_train_images_per_sec_per_chip"
+    else:
+        (x_train, y_train), _ = datasets.mnist()
+        x = (x_train.astype(np.float32) / 255.0)[..., None]
+        module = MnistCNN(compute_dtype=jnp.bfloat16)
+        metric = "mnist_train_images_per_sec_per_chip"
     y = y_train.astype(np.int64)
 
     trainer = hvt.Trainer(
-        MnistCNN(compute_dtype=jnp.bfloat16),
+        module,
         hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(1e-3, n_chips))),
         loss="sparse_categorical_crossentropy",
     )
@@ -79,7 +92,7 @@ def main() -> None:
 
     baseline_path = os.path.join(REPO, "benchmarks", "baseline_measured.json")
     vs_baseline = None
-    if os.path.exists(baseline_path):
+    if which == "mnist" and os.path.exists(baseline_path):
         with open(baseline_path) as f:
             baseline = json.load(f)
         vs_baseline = round(images_per_sec_per_chip / baseline["images_per_sec"], 2)
@@ -87,7 +100,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "mnist_train_images_per_sec_per_chip",
+                "metric": metric,
                 "value": round(images_per_sec_per_chip, 1),
                 "unit": "images/sec/chip",
                 "vs_baseline": vs_baseline,

@@ -47,14 +47,11 @@ def aggregate(values: list[float], how: str = "mean") -> float:
     raise ValueError(f"unknown aggregate {how!r}")
 
 
-_aggregate = aggregate
-
-
 def check_metrics(
     path: str,
     name: str,
     target: tuple[float, float],
-    aggregate: str = "mean",
+    how: str = "mean",
 ) -> tuple[bool, float]:
     """Return (passed, aggregated value). Missing metric — or a missing
     metrics file entirely — fails the gate rather than crashing it (a run
@@ -62,7 +59,7 @@ def check_metrics(
     values = read_metric(path, name)
     if not values:
         return False, float("nan")
-    value = _aggregate(values, aggregate)
+    value = aggregate(values, how)
     lo, hi = target
     return lo <= value <= hi, value
 
@@ -75,7 +72,7 @@ def run_checks(metrics_path: str, checks: dict) -> bool:
     for name, rule in checks.items():
         how = rule.get("aggregate", "mean")
         passed, value = check_metrics(
-            metrics_path, name, parse_target(str(rule["target"])), aggregate=how
+            metrics_path, name, parse_target(str(rule["target"])), how=how
         )
         print(
             f"check {name}: {how}={value:.6g} target={rule['target']} "

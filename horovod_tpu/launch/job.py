@@ -26,6 +26,16 @@ def run_job(spec_path: str) -> int:
     argv = command if isinstance(command, list) else shlex.split(command)
     env = {str(k): str(v) for k, v in (job.get("env") or {}).items()}
 
+    checks = spec.get("checks") or {}
+    metrics_path = spec.get(
+        "metrics",
+        os.path.join(env.get("PS_MODEL_PATH", "./models"), "metrics.jsonl"),
+    )
+    # The sink appends; a leftover stream from a previous run must not feed
+    # this run's gate (a regressed run could pass on old values).
+    if checks and os.path.exists(metrics_path):
+        os.remove(metrics_path)
+
     hosts = job.get("hosts")
     if hosts:
         code = launcher.run_hosts(
@@ -39,11 +49,24 @@ def run_job(spec_path: str) -> int:
         print(f"job failed with exit code {code}")
         return code
 
-    checks = spec.get("checks") or {}
     if not checks:
         return 0
-    metrics_path = spec.get(
-        "metrics",
-        os.path.join(env.get("PS_MODEL_PATH", "./models"), "metrics.jsonl"),
-    )
+    if hosts:
+        # The primary process (rank 0 on hosts[0]) wrote the stream there;
+        # without shared storage it must be fetched before gating.
+        metrics_path = _fetch_remote_metrics(hosts[0], metrics_path)
     return 0 if ci_gate.run_checks(metrics_path, checks) else 1
+
+
+def _fetch_remote_metrics(host: str, remote_path: str) -> str:
+    """scp the metrics stream from the coordinator host; on failure fall back
+    to the local path (covers the shared-filesystem deployment)."""
+    import subprocess
+    import tempfile
+
+    local = os.path.join(tempfile.mkdtemp(prefix="hvt-gate-"), "metrics.jsonl")
+    res = subprocess.run(
+        ["scp", "-o", "StrictHostKeyChecking=no", f"{host}:{remote_path}", local],
+        capture_output=True,
+    )
+    return local if res.returncode == 0 else remote_path

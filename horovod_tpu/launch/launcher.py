@@ -217,7 +217,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "pod":
         if args.hostfile:
             with open(args.hostfile) as f:
-                hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+                hosts = [
+                    h.strip() for h in f
+                    if h.strip() and not h.strip().startswith("#")
+                ]
         elif args.hosts:
             hosts = args.hosts.split(",")
         else:

@@ -74,13 +74,31 @@ def init(sync_tensorboard: bool = False, path: str | None = None) -> None:
     )
 
 
+def _can_decide_primary() -> bool:
+    """Whether asking `jax.process_index()` is safe/meaningful now.
+
+    True once `runtime.init` ran, or once the JAX backend is already up for
+    any other reason (e.g. a bare script that trains without ever calling
+    ``hvt.init()`` — the backend exists by the time it pushes metrics, and
+    querying it can no longer break a later `jax.distributed.initialize`
+    because there won't be one)."""
+    if runtime.is_initialized():
+        return True
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:
+        return True  # decide now rather than buffer forever
+
+
 def _resolve() -> MetricsSink | None:
-    """The active sink, or None while the runtime isn't initialized yet
-    (single-writer identity is unknowable before then, §5.2)."""
+    """The active sink, or None while the single-writer identity is still
+    unknowable (§5.2) — before both `runtime.init` and first backend use."""
     global _sink
     if _sink is None:
         if _configured_path is not None:
-            if not runtime.is_initialized():
+            if not _can_decide_primary():
                 return None
             # Primary process only; others get the NullSink.
             _sink = JsonlSink(_configured_path) if runtime.is_primary() else NullSink()

@@ -2,3 +2,4 @@
 flagship for long-context / tensor-parallel configurations."""
 
 from horovod_tpu.models.cnn import MnistCNN  # noqa: F401
+from horovod_tpu.models.resnet import ResNetCIFAR  # noqa: F401

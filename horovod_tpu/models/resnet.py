@@ -1,0 +1,83 @@
+"""ResNet for CIFAR-10 — the heavier-gradients benchmark model family.
+
+BASELINE.json config 4 calls for "TF2 Keras CIFAR-10 ResNet-20 data-parallel
+(heavier grads, same DistributedOptimizer path)": a model whose gradient
+pytree stresses the allreduce path far more than the MNIST CNN. This is the
+classic CIFAR ResNet of He et al. (arXiv:1512.03385 §4.2): depth 6n+2, three
+stages of n basic blocks at 16/32/64 channels, global average pool.
+
+TPU-first notes:
+* BatchNorm statistics are computed inside the SPMD-jitted step, i.e. over
+  the **global** batch — sync-BN semantics by construction (GPU DP stacks
+  need an extra SyncBatchNorm op; here it is the default and XLA inserts the
+  cross-chip reduction).
+* Compute dtype configurable (bfloat16 on TPU) with float32 params and
+  float32 BN statistics — the standard mixed-precision recipe the MXU wants.
+* Identity shortcuts use 1x1 projection when shape changes (option B), which
+  keeps every residual add an MXU-friendly matmul/conv rather than a pad.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = lambda f, s: nn.Conv(  # noqa: E731
+            f, (3, 3), strides=(s, s), padding="SAME", use_bias=False,
+            dtype=self.compute_dtype,
+        )
+        bn = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=self.compute_dtype,
+        )
+        shortcut = x
+        y = conv(self.filters, self.strides)(x)
+        y = bn()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, 1)(y)
+        y = bn()(y)
+        if shortcut.shape[-1] != self.filters or self.strides != 1:
+            shortcut = nn.Conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, dtype=self.compute_dtype,
+            )(shortcut)
+            shortcut = bn()(shortcut)
+        return nn.relu(y + shortcut)
+
+
+class ResNetCIFAR(nn.Module):
+    """CIFAR ResNet, depth = 6n+2 (20 → n=3). Returns float32 logits."""
+
+    depth: int = 20
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        if (self.depth - 2) % 6 != 0:
+            raise ValueError(f"depth must be 6n+2, got {self.depth}")
+        n = (self.depth - 2) // 6
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.compute_dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        for stage, (filters, stride) in enumerate([(16, 1), (32, 2), (64, 2)]):
+            for block in range(n):
+                x = BasicBlock(
+                    filters,
+                    strides=stride if block == 0 else 1,
+                    compute_dtype=self.compute_dtype,
+                )(x, train=train)
+        x = x.mean(axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)

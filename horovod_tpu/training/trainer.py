@@ -40,12 +40,17 @@ class TrainState:
 
     Horovod's BroadcastGlobalVariablesCallback covers model *and* optimizer
     variables (SURVEY.md §7.3); keeping them in one pytree makes
-    broadcast/checkpoint cover both by construction."""
+    broadcast/checkpoint cover both by construction. ``model_state`` holds
+    non-parameter variable collections (e.g. BatchNorm ``batch_stats``);
+    under SPMD jit those statistics are computed over the *global* batch, so
+    cross-replica BN sync — an extra op in GPU data-parallel stacks — is the
+    default semantics here."""
 
     step: jax.Array
     params: PyTree
     opt_state: PyTree
     rng: jax.Array
+    model_state: PyTree = None
 
 
 def _resolve_loss(loss) -> Callable:
@@ -101,6 +106,10 @@ class Trainer:
         self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
         self.seed = seed
         self.state: TrainState | None = None
+        # Non-'params' variable collections to thread through training
+        # (e.g. ['batch_stats']); discovered at build() — before the first
+        # (lazily-traced) _train_step call, so the closures see it static.
+        self._mutable: list[str] = []
         # Update scale multiplies the optimizer's update — the knob
         # LearningRateWarmupCallback turns (scaling the update by s is
         # equivalent to scaling the LR by s for the reference optimizers).
@@ -113,28 +122,40 @@ class Trainer:
             step_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_of(params):
-                logits = self.module.apply(
-                    {"params": params}, x, train=True, rngs={"dropout": step_rng}
-                )
+                variables = {"params": params, **(state.model_state or {})}
+                if self._mutable:
+                    logits, new_ms = self.module.apply(
+                        variables, x, train=True,
+                        rngs={"dropout": step_rng}, mutable=self._mutable,
+                    )
+                else:
+                    logits = self.module.apply(
+                        variables, x, train=True, rngs={"dropout": step_rng}
+                    )
+                    new_ms = state.model_state
                 loss = self.loss_fn(logits, y).mean()
-                return loss, _accuracy(logits, y)
+                return loss, (_accuracy(logits, y), new_ms)
 
-            (loss, acc), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                state.params
-            )
+            (loss, (acc, model_state)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(state.params)
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             updates = jax.tree.map(lambda u: u * update_scale, updates)
             params = optax.apply_updates(state.params, updates)
             new_state = state.replace(
-                step=state.step + 1, params=params, opt_state=opt_state
+                step=state.step + 1, params=params, opt_state=opt_state,
+                model_state=model_state,
             )
             return new_state, {"loss": loss, "accuracy": acc}
+
+        def _eval_variables(state: TrainState):
+            return {"params": state.params, **(state.model_state or {})}
 
         def eval_step(state: TrainState, batch):
             # Masked sums (mask zeroes padding) so full-dataset metrics are
             # exact even when the tail batch is padded to the global shape.
             x, y, mask = batch
-            logits = self.module.apply({"params": state.params}, x, train=False)
+            logits = self.module.apply(_eval_variables(state), x, train=False)
             loss_vec = self.loss_fn(logits, y)
             pred = jnp.argmax(logits, axis=-1)
             labels = jnp.argmax(y, axis=-1) if y.ndim == logits.ndim else y
@@ -146,7 +167,7 @@ class Trainer:
             }
 
         def predict_step(state: TrainState, x):
-            logits = self.module.apply({"params": state.params}, x, train=False)
+            logits = self.module.apply(_eval_variables(state), x, train=False)
             return jax.nn.softmax(logits, axis=-1)
 
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
@@ -176,11 +197,14 @@ class Trainer:
             train=False,
         )
         params = variables["params"]
+        model_state = {k: v for k, v in variables.items() if k != "params"}
+        self._mutable = sorted(model_state.keys())
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=self.tx.init(params),
             rng=state_rng,
+            model_state=model_state or None,
         )
         self.state = sharding_lib.replicate(state, self.mesh)
         return self.state

@@ -125,6 +125,26 @@ class TestJob:
         spec2.write_text(spec.read_text().replace("0.0..0.3", "0.0..0.05"))
         assert run_job(str(spec2)) == 1
 
+    def test_job_resets_stale_metrics(self, tmp_path):
+        """A previous run's appended metrics must not feed this run's gate."""
+        metrics = tmp_path / "metrics.jsonl"
+        _write_metrics(metrics, [0.01, 0.01])  # stale, would pass
+        spec = tmp_path / "job.yaml"
+        # This run's command writes nothing → gate must FAIL.
+        spec.write_text(textwrap.dedent(f"""
+            name: stale
+            job:
+              command: ["{sys.executable}", "-c", "pass"]
+              nprocs: 1
+            metrics: {metrics}
+            checks:
+              loss:
+                target: "0.0..0.3"
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 1
+
 
 @pytest.mark.slow
 class TestDistributedLaunch:

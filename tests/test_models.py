@@ -1,0 +1,110 @@
+"""Model-zoo tests: architecture shapes, BatchNorm state threading, and the
+heavier-gradients ResNet through the full distributed training path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu.models.cnn import MnistCNN
+from horovod_tpu.models.resnet import ResNetCIFAR
+
+
+class TestResNetArchitecture:
+    def test_depth_validation(self):
+        model = ResNetCIFAR(depth=21)
+        with pytest.raises(ValueError, match="6n"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+
+    def test_forward_shape_and_param_count(self):
+        model = ResNetCIFAR(depth=20)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        x = jnp.zeros((4, 32, 32, 3))
+        logits = model.apply(variables, x)
+        assert logits.shape == (4, 10)
+        assert logits.dtype == jnp.float32
+        n_params = sum(p.size for p in jax.tree.leaves(variables["params"]))
+        # ResNet-20 CIFAR is ~0.27M params (He et al. table 6).
+        assert 0.25e6 < n_params < 0.30e6, n_params
+
+    def test_has_batch_stats(self):
+        model = ResNetCIFAR(depth=8)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        assert "batch_stats" in variables
+
+    def test_bf16_compute_f32_logits(self):
+        model = ResNetCIFAR(depth=8, compute_dtype=jnp.bfloat16)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        logits = model.apply(variables, jnp.ones((2, 32, 32, 3)))
+        assert logits.dtype == jnp.float32
+
+
+class TestResNetTraining:
+    """The BASELINE.json config-4 path: ResNet through Trainer +
+    DistributedOptimizer on the 8-device mesh."""
+
+    def _trainer(self):
+        return hvt.Trainer(
+            ResNetCIFAR(depth=8),
+            hvt.DistributedOptimizer(optax.adam(1e-2)),
+            loss="sparse_categorical_crossentropy",
+        )
+
+    def _batch(self, n=16, seed=0):
+        rng = np.random.RandomState(seed)
+        return (
+            rng.rand(n, 32, 32, 3).astype(np.float32),
+            rng.randint(0, 10, size=n).astype(np.int64),
+        )
+
+    def test_batch_stats_update_and_loss_decreases(self):
+        trainer = self._trainer()
+        x, y = self._batch()
+        state0 = trainer.build(x)
+        assert state0.model_state is not None
+        assert "batch_stats" in state0.model_state
+        # Snapshot to host: the train step donates its input state, so
+        # state0's device buffers are invalidated by fit().
+        stats0 = jax.tree.leaves(jax.device_get(state0.model_state))
+
+        history = trainer.fit(
+            x=x, y=y, batch_size=2, epochs=3, steps_per_epoch=8, verbose=0
+        )
+        assert history[-1]["loss"] < history[0]["loss"]
+        # Running statistics moved away from init (mean 0 / var 1).
+        stats1 = jax.tree.leaves(jax.device_get(trainer.state.model_state))
+        moved = any(
+            float(jnp.abs(a - b).max()) > 1e-6 for a, b in zip(stats0, stats1)
+        )
+        assert moved
+
+    def test_eval_uses_running_stats(self):
+        trainer = self._trainer()
+        x, y = self._batch(32)
+        trainer.fit(x=x, y=y, batch_size=2, epochs=1, steps_per_epoch=4, verbose=0)
+        result = trainer.evaluate(x, y, batch_size=2)
+        assert np.isfinite(result["loss"])
+
+    def test_checkpoint_roundtrip_covers_batch_stats(self, tmp_path):
+        from horovod_tpu import checkpoint
+
+        trainer = self._trainer()
+        x, y = self._batch(8)
+        trainer.fit(x=x, y=y, batch_size=1, epochs=1, steps_per_epoch=4, verbose=0)
+        path = checkpoint.save(str(tmp_path / "ck.msgpack"), trainer.state)
+        restored = checkpoint.restore(path, trainer.state)
+        for a, b in zip(
+            jax.tree.leaves(trainer.state.model_state),
+            jax.tree.leaves(restored.model_state),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestMnistCNNStillParamsOnly:
+    def test_no_model_state(self):
+        trainer = hvt.Trainer(MnistCNN(), optax.adam(1e-3))
+        x = np.zeros((8, 28, 28, 1), np.float32)
+        state = trainer.build(x)
+        assert state.model_state is None
