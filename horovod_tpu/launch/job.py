@@ -37,6 +37,16 @@ def run_job(spec_path: str) -> int:
         os.remove(metrics_path)
 
     hosts = job.get("hosts")
+    if hosts and checks:
+        # The purge above only covered the launcher's filesystem; the sink
+        # appends on the coordinator host, so reset it there too.
+        import subprocess
+
+        subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[0],
+             f"rm -f {shlex.quote(metrics_path)}"],
+            capture_output=True,
+        )
     if hosts:
         code = launcher.run_hosts(
             list(hosts), argv, env=env,

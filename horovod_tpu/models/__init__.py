@@ -3,3 +3,8 @@ flagship for long-context / tensor-parallel configurations."""
 
 from horovod_tpu.models.cnn import MnistCNN  # noqa: F401
 from horovod_tpu.models.resnet import ResNetCIFAR  # noqa: F401
+from horovod_tpu.models.transformer import (  # noqa: F401
+    ShardingConfig,
+    TransformerLM,
+    param_specs,
+)

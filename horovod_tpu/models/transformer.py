@@ -1,0 +1,209 @@
+"""Decoder-only transformer LM — the long-context / model-parallel flagship.
+
+The reference never goes past a 2-conv MNIST CNN (SURVEY.md §5.7: no
+sequence axis anywhere), but this framework treats long-context and
+multi-axis parallelism as first-class. This model composes every mesh axis:
+
+* ``data``/``fsdp`` — batch sharding (+ optional parameter sharding);
+* ``seq``  — sequence/context parallelism: activations sharded along the
+  token axis; attention runs as ring or Ulysses collectives (ops/attention)
+  inside a *partially-manual* `jax.shard_map` over only the ``seq`` axis,
+  leaving batch/TP sharding to the compiler;
+* ``model`` — tensor parallelism: QKV/MLP-up kernels column-sharded,
+  proj/MLP-down row-sharded (Megatron layout) via sharding constraints the
+  compiler turns into a single allreduce per residual join.
+
+Architecture: pre-LN blocks, RoPE positions (sequence-length extensible —
+what a long-context model wants), GELU MLP at 4×, tied-free LM head, logits
+in float32.
+
+`param_specs(params, mesh)` gives the explicit PartitionSpec tree for the
+TP/FSDP layout — path-based rules, no boxed-metadata machinery, so any
+optimizer/checkpoint code sees plain arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.ops import attention as attention_ops
+from horovod_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+def _rope(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding on [B, T, H, D] with global positions."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,T,1,half]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingConfig:
+    """How the model meets the mesh. ``attn='ring'|'ulysses'|'dense'``."""
+
+    mesh: Mesh | None = None
+    attn: str = "ring"
+
+    @property
+    def seq_parallel(self) -> bool:
+        return self.mesh is not None and self.mesh.shape.get(SEQ_AXIS, 1) > 1
+
+    def constrain(self, x, spec: P):
+        if self.mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(self.mesh, spec)
+        )
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    dropout: float
+    compute_dtype: jnp.dtype
+    sharding: ShardingConfig
+
+    @nn.compact
+    def __call__(self, x, positions, *, train: bool = False):
+        cfg = self.sharding
+        head_dim = self.d_model // self.n_heads
+        dense = functools.partial(
+            nn.DenseGeneral, dtype=self.compute_dtype, use_bias=False
+        )
+
+        # --- attention -----------------------------------------------------
+        h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
+        qkv_shape = (self.n_heads, 3 * head_dim)
+        qkv = dense(features=qkv_shape)(h)  # [B,T,H,3D] — column-parallel in TP
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k = _rope(q, positions), _rope(k, positions)
+
+        if cfg.seq_parallel:
+            impl = {
+                "ring": attention_ops.ring_attention,
+                "ulysses": attention_ops.ulysses_attention,
+            }[cfg.attn]
+            model_par = cfg.mesh.shape.get(MODEL_AXIS, 1)
+            if self.n_heads % model_par != 0:
+                raise ValueError(
+                    f"n_heads ({self.n_heads}) must divide over the model "
+                    f"axis ({model_par}) for sharded attention"
+                )
+            # Fully-manual region: batch stays split over data/fsdp, heads
+            # over model (attention never mixes batch or heads, so manual
+            # sharding there is free); the seq axis is the collective one.
+            spec = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
+            attend = jax.shard_map(
+                functools.partial(impl, axis_name=SEQ_AXIS, causal=True),
+                mesh=cfg.mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+            out = attend(q, k, v)
+        else:
+            out = attention_ops.dense_attention(q, k, v, causal=True)
+
+        out = dense(features=self.d_model, axis=(-2, -1))(out)  # row-parallel
+        out = nn.Dropout(self.dropout, deterministic=not train)(out)
+        x = x + out
+        x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
+
+        # --- MLP -----------------------------------------------------------
+        h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
+        h = dense(features=4 * self.d_model)(h)  # column-parallel
+        h = nn.gelu(h)
+        h = dense(features=self.d_model)(h)  # row-parallel
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+        return cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
+
+
+class TransformerLM(nn.Module):
+    """Causal LM over integer tokens: ``[B, T] -> [B, T, vocab]`` logits."""
+
+    vocab_size: int = 256
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    dropout: float = 0.1
+    compute_dtype: jnp.dtype = jnp.float32
+    sharding: ShardingConfig = ShardingConfig()
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        cfg = self.sharding
+        b, t = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.compute_dtype)(tokens)
+        x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
+        for _ in range(self.n_layers):
+            x = Block(
+                self.d_model, self.n_heads, self.dropout,
+                self.compute_dtype, cfg,
+            )(x, positions, train=train)
+        x = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
+        logits = nn.DenseGeneral(
+            features=self.vocab_size, dtype=self.compute_dtype, use_bias=False
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def param_specs(params, mesh: Mesh) -> dict:
+    """PartitionSpec tree for the Megatron TP (+FSDP) layout.
+
+    Path-based rules over the plain param pytree:
+
+    * QKV kernel   [d_model, H, 3·head] → heads on ``model`` (column);
+    * attn proj    [H, head, d_model]   → heads on ``model`` (row);
+    * MLP up       [d_model, 4·d]       → features on ``model`` (column);
+    * MLP down     [4·d, d_model]       → inputs on ``model`` (row);
+    * LM head      [d_model, vocab]     → vocab on ``model``;
+    * embedding / LayerNorm             → replicated on ``model``.
+
+    With an ``fsdp`` axis > 1, each kernel's first divisible non-model dim is
+    additionally sharded over ``fsdp`` (weight-gathered FSDP: XLA inserts the
+    gathers where the weights are consumed).
+    """
+    fsdp = mesh.shape.get(FSDP_AXIS, 1) > 1
+
+    def rule(path, leaf):
+        names = [
+            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+        ]
+        flat = "/".join(names)
+        spec: list = [None] * leaf.ndim
+        if leaf.ndim >= 2:
+            if "DenseGeneral_0" in flat and leaf.ndim == 3:  # QKV [dm,H,3hd]
+                spec[1] = MODEL_AXIS
+            elif "DenseGeneral_1" in flat and leaf.ndim == 3:  # proj [H,hd,dm]
+                spec[0] = MODEL_AXIS
+            elif "DenseGeneral_2" in flat:  # MLP up [dm, 4dm]
+                spec[1] = MODEL_AXIS
+            elif "DenseGeneral_3" in flat:  # MLP down [4dm, dm]
+                spec[0] = MODEL_AXIS
+            elif "Embed" not in flat and leaf.ndim == 2:  # LM head [dm, vocab]
+                spec[1] = MODEL_AXIS
+            if fsdp:
+                for dim in range(leaf.ndim):
+                    if spec[dim] is None and leaf.shape[dim] % mesh.shape[FSDP_AXIS] == 0:
+                        spec[dim] = FSDP_AXIS
+                        break
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(rule, params)

@@ -1,0 +1,8 @@
+"""TPU compute ops: attention implementations (dense / ring / Ulysses) and
+pallas kernels for the hot paths."""
+
+from horovod_tpu.ops.attention import (  # noqa: F401
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,142 @@
+"""Attention: dense reference, ring (sequence-parallel), Ulysses (head-swap).
+
+Long-context is first-class in this framework: sequences too long for one
+chip's HBM are sharded along the mesh's ``seq`` axis and attention runs as a
+collective. Two standard schemes, both expressed with XLA collectives so the
+compiler overlaps communication with compute:
+
+* **Ring attention** (Liu et al., arXiv:2310.01889): K/V shards rotate around
+  the ``seq`` ring via `lax.ppermute` while each device accumulates its
+  queries' attention with an online (streaming) softmax — full attention,
+  O(T/n) memory per chip, n-1 hops riding neighbor ICI links.
+* **Ulysses** (Jacobs et al., arXiv:2309.14509): `lax.all_to_all` re-shards
+  seq ↔ heads so each device holds the full sequence for H/n heads, runs
+  ordinary attention locally, and swaps back. One collective pair per layer,
+  needs heads % seq_parallelism == 0.
+
+All functions take ``[batch, seq, heads, head_dim]`` and return the same.
+`ring_attention`/`ulysses_attention` must be called **inside** `shard_map`
+with the sequence dimension sharded over ``axis_name`` (see
+`models/transformer.py` for the placement); with an axis of size 1 they
+degrade to exactly `dense_attention` — the reference's "no-launcher
+degradation" principle (README.md:49-52) applied to sequence parallelism.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Finite stand-in for -inf: keeps fully-masked softmax rows at p == 0 via
+# explicit mask multiplication without generating NaNs from inf - inf.
+_BIG_NEG = -1e30
+
+
+def _scores(q, k, scale):
+    """[B,Tq,H,D] x [B,Tk,H,D] -> [B,H,Tq,Tk] logits on the MXU."""
+    return jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+
+
+def dense_attention(q, k, v, *, causal: bool = True):
+    """Reference full-materialization attention (numerics ground truth).
+
+    float32 softmax regardless of input dtype — bf16 logits lose too much for
+    long sequences; the matmuls still run in the inputs' dtype on the MXU."""
+    scale = q.shape[-1] ** -0.5
+    s = _scores(q, k, scale)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        q_pos = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + (tk - tq)
+        k_pos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _BIG_NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+    """Exact blockwise attention over a sequence-sharded ring.
+
+    Inside `shard_map`: q/k/v are this device's ``[B, T/n, H, D]`` shard of
+    the global sequence. Each of the n ring steps attends the local queries
+    to one K/V block, folds the result into an online softmax accumulator
+    (running max m, normalizer l, unnormalized output o), and rotates the
+    K/V block to the next neighbor — `lax.ppermute`, which XLA lowers to
+    neighbor ICI sends that overlap with the attention matmuls of the
+    current block. `lax.scan` (not fori_loop) so reverse-mode AD works and
+    the backward pass replays the ring.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = d ** -0.5
+
+    q_pos = my * t_local + lax.broadcasted_iota(jnp.int32, (t_local, 1), 0)[:, 0]
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # Which global block we currently hold: blocks travel "rightward"
+        # (r → r+1), so after i hops we hold the block born at my - i.
+        j = (my - i) % n
+        k_pos = j * t_local + lax.broadcasted_iota(jnp.int32, (t_local, 1), 0)[:, 0]
+
+        s = _scores(q, k_blk, scale)  # [B,H,Tq,Tk] float32
+        if causal:
+            mask = (q_pos[:, None] >= k_pos[None, :]).astype(s.dtype)
+        else:
+            mask = jnp.ones((t_local, t_local), s.dtype)
+        s = s + (1.0 - mask) * _BIG_NEG
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)  # finite: both are ≥ _BIG_NEG
+        p = jnp.exp(s - m_new[..., None]) * mask  # zero masked lanes exactly
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        o_new = o * alpha[..., None] + pv
+
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_blk, v_blk), None
+
+    o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local), _BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    (o, _, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n)
+    )
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+    """All-to-all sequence parallelism: swap seq-sharding for head-sharding,
+    attend over the full sequence locally, swap back.
+
+    Inside `shard_map` with ``[B, T/n, H, D]`` shards; requires ``H % n == 0``.
+    Two `lax.all_to_all` pairs per call — cheaper than a ring when n is small
+    and heads are plentiful; the full-sequence [T] intermediate bounds the
+    max context per chip (ring has no such bound)."""
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the seq axis ({n})"
+        )
+
+    def to_heads(x):  # [B,T/n,H,D] -> [B,T,H/n,D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):  # [B,T,H/n,D] -> [B,T/n,H,D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    out = dense_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    return to_seq(out)

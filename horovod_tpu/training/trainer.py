@@ -99,12 +99,24 @@ class Trainer:
         loss="sparse_categorical_crossentropy",
         mesh=None,
         seed: int = 0,
+        param_specs=None,
+        batch_specs=None,
     ):
         self.module = module
         self.tx = optimizer
         self.loss_fn = _resolve_loss(loss)
         self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
         self.seed = seed
+        # param_specs: callable (params, mesh) -> PartitionSpec pytree, or a
+        # spec pytree — TP/FSDP parameter layout (e.g.
+        # models.transformer.param_specs). None = replicated (pure DP, the
+        # reference's layout).
+        self.param_specs = param_specs
+        self._param_shardings = None
+        # batch_specs: PartitionSpec pytree matching the batch structure —
+        # e.g. P(('data','fsdp'), 'seq') for sequence-sharded LM tokens.
+        # None = shard dim 0 along the data axes.
+        self.batch_specs = batch_specs
         self.state: TrainState | None = None
         # Non-'params' variable collections to thread through training
         # (e.g. ['batch_stats']); discovered at build() — before the first
@@ -142,6 +154,12 @@ class Trainer:
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             updates = jax.tree.map(lambda u: u * update_scale, updates)
             params = optax.apply_updates(state.params, updates)
+            if self._param_shardings is not None:
+                # Pin the TP/FSDP layout so XLA's propagation can't drift the
+                # updated params away from their declared placement.
+                params = jax.lax.with_sharding_constraint(
+                    params, self._param_shardings
+                )
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state,
                 model_state=model_state,
@@ -154,16 +172,21 @@ class Trainer:
         def eval_step(state: TrainState, batch):
             # Masked sums (mask zeroes padding) so full-dataset metrics are
             # exact even when the tail batch is padded to the global shape.
+            # The per-example mask broadcasts over any trailing loss dims
+            # (sequence models produce per-token losses [G, T]); `count`
+            # then counts tokens, keeping the mean per-token.
             x, y, mask = batch
             logits = self.module.apply(_eval_variables(state), x, train=False)
             loss_vec = self.loss_fn(logits, y)
+            w = mask.reshape(mask.shape + (1,) * (loss_vec.ndim - 1))
+            w = jnp.broadcast_to(w, loss_vec.shape)
             pred = jnp.argmax(logits, axis=-1)
             labels = jnp.argmax(y, axis=-1) if y.ndim == logits.ndim else y
             correct = (pred == labels).astype(jnp.float32)
             return {
-                "loss_sum": (loss_vec * mask).sum(),
-                "correct_sum": (correct * mask).sum(),
-                "count": mask.sum(),
+                "loss_sum": (loss_vec * w).sum(),
+                "correct_sum": (correct * w).sum(),
+                "count": w.sum(),
             }
 
         def predict_step(state: TrainState, x):
@@ -191,25 +214,103 @@ class Trainer:
             return self.state
         rng = jax.random.PRNGKey(self.seed)
         init_rng, dropout_rng, state_rng = jax.random.split(rng, 3)
+        # Init batch sized to the data-parallel degree: models that carry
+        # internal sharding constraints need the batch dim divisible by it.
+        sample = np.asarray(sample_x)
+        n = self.dp_size
+        if len(sample) < n:
+            reps = -(-n // len(sample))
+            sample = np.concatenate([sample] * reps)
         variables = self.module.init(
             {"params": init_rng, "dropout": dropout_rng},
-            jnp.asarray(sample_x[:1]),
+            jnp.asarray(sample[:n]),
             train=False,
         )
         params = variables["params"]
         model_state = {k: v for k, v in variables.items() if k != "params"}
         self._mutable = sorted(model_state.keys())
-        state = TrainState(
-            step=jnp.zeros((), jnp.int32),
-            params=params,
-            opt_state=self.tx.init(params),
-            rng=state_rng,
-            model_state=model_state or None,
-        )
-        self.state = sharding_lib.replicate(state, self.mesh)
+        if self.param_specs is not None:
+            specs = (
+                self.param_specs(params, self.mesh)
+                if callable(self.param_specs)
+                else self.param_specs
+            )
+            self._param_shardings = jax.tree.map(
+                lambda s: jax.sharding.NamedSharding(self.mesh, s),
+                specs,
+                is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
+            )
+            params = jax.device_put(params, self._param_shardings)
+            # Optimizer mirrors (momenta etc.) must carry the param layout.
+            # Sharding propagation can't deliver it — `init` is zeros_like,
+            # which reads only shapes, so XLA sees an input-free computation —
+            # hence explicit out_shardings: any opt-state subtree that is
+            # param-shaped gets the param shardings, the rest replicate.
+            params_def = jax.tree.structure(params)
+            params_shapes = jax.tree.leaves(
+                jax.tree.map(lambda p: p.shape, params)
+            )
+            rep = sharding_lib.replicated(self.mesh)
+
+            def param_shaped(subtree) -> bool:
+                try:
+                    if jax.tree.structure(subtree) != params_def:
+                        return False
+                    return (
+                        jax.tree.leaves(jax.tree.map(lambda l: l.shape, subtree))
+                        == params_shapes
+                    )
+                except Exception:
+                    return False
+
+            opt_shardings = jax.tree.map(
+                lambda sub: self._param_shardings if param_shaped(sub) else rep,
+                jax.eval_shape(self.tx.init, params),
+                is_leaf=param_shaped,
+            )
+            opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
+            state = TrainState(
+                step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+                params=params,
+                opt_state=opt_state,
+                rng=jax.device_put(state_rng, rep),
+                model_state=sharding_lib.replicate(model_state, self.mesh)
+                if model_state
+                else None,
+            )
+            self.state = state
+        else:
+            state = TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self.tx.init(params),
+                rng=state_rng,
+                model_state=model_state or None,
+            )
+            self.state = sharding_lib.replicate(state, self.mesh)
         return self.state
 
     def _shard(self, batch):
+        if self.batch_specs is not None:
+            specs = tuple(self.batch_specs)
+
+            def put(x, spec):
+                x = np.asarray(x)
+                s = jax.sharding.NamedSharding(self.mesh, spec)
+                if jax.process_count() == 1:
+                    return jax.device_put(x, s)
+                return jax.make_array_from_process_local_data(s, x)
+
+            if not isinstance(batch, (tuple, list)):
+                return put(batch, specs[0])  # predict: bare x
+            if len(batch) == len(specs) + 1:
+                # evaluate() appends a per-example mask: batch-sharded only.
+                last = tuple(specs[-1])
+                specs = specs + (
+                    jax.sharding.PartitionSpec(*last[:1]) if last
+                    else jax.sharding.PartitionSpec(),
+                )
+            return tuple(put(x, spec) for x, spec in zip(batch, specs))
         return sharding_lib.shard_batch(batch, self.mesh)
 
     def _local_slice(self, arr, global_batch: int):

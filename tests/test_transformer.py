@@ -1,0 +1,164 @@
+"""Transformer LM over the full mesh: dp × seq × model composition, TP param
+shardings, ring/Ulysses attention inside the training step, long-range
+recall actually learned."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvt
+from horovod_tpu.data import datasets
+from horovod_tpu.models.transformer import (
+    ShardingConfig,
+    TransformerLM,
+    param_specs,
+)
+from horovod_tpu.parallel import mesh as mesh_lib
+
+VOCAB = 32
+
+
+def _model(mesh=None, attn="ring", **kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("dropout", 0.0)
+    return TransformerLM(sharding=ShardingConfig(mesh=mesh, attn=attn), **kw)
+
+
+def _trainer(mesh, attn="ring"):
+    return hvt.Trainer(
+        _model(mesh=mesh, attn=attn),
+        hvt.DistributedOptimizer(optax.adam(3e-3)),
+        loss="sparse_categorical_crossentropy",
+        mesh=mesh,
+        param_specs=param_specs,
+        batch_specs=(P(("data", "fsdp"), "seq"), P(("data", "fsdp"), "seq")),
+    )
+
+
+class TestForward:
+    def test_logit_shape_unsharded(self):
+        model = _model()
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        logits = model.apply({"params": params}, tokens)
+        assert logits.shape == (2, 16, VOCAB)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        """Changing a future token must not change past logits."""
+        model = _model()
+        rng = np.random.RandomState(0)
+        toks = rng.randint(1, VOCAB, size=(1, 16)).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(0), jnp.asarray(toks))["params"]
+        out1 = model.apply({"params": params}, jnp.asarray(toks))
+        toks2 = toks.copy()
+        toks2[0, 10] = (toks2[0, 10] % (VOCAB - 1)) + 1
+        out2 = model.apply({"params": params}, jnp.asarray(toks2))
+        np.testing.assert_allclose(
+            np.asarray(out1[0, :10]), np.asarray(out2[0, :10]), atol=1e-5
+        )
+
+
+class TestMeshComposition:
+    """dp=2 × seq=2 × model=2 on the 8 virtual devices — every parallelism
+    axis live in one training step."""
+
+    def _mesh(self):
+        return mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=2, model=2))
+
+    @pytest.mark.parametrize("attn", ["ring", "ulysses"])
+    def test_train_step_runs_and_learns(self, attn):
+        mesh = self._mesh()
+        trainer = _trainer(mesh, attn=attn)
+        x, y = datasets.copy_task(512, 32, vocab_size=VOCAB, seed=0)
+        history = trainer.fit(
+            x=x, y=y, batch_size=8, epochs=2, steps_per_epoch=10, verbose=0
+        )
+        assert history[-1]["loss"] < history[0]["loss"]
+        assert np.isfinite(history[-1]["loss"])
+
+    def test_params_are_tp_sharded(self):
+        mesh = self._mesh()
+        trainer = _trainer(mesh)
+        x, _ = datasets.copy_task(8, 32, vocab_size=VOCAB)
+        state = trainer.build(x)
+        flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+        tp_sharded = [
+            (path, leaf) for path, leaf in flat
+            if any(
+                "model" in (ax if isinstance(ax, tuple) else (ax,))
+                for ax in leaf.sharding.spec if ax is not None
+            )
+        ]
+        # QKV, proj, MLP up/down per layer + LM head must carry the model axis.
+        assert len(tp_sharded) >= 4 * 2 + 1, [p for p, _ in flat]
+        # Optimizer mirrors inherit the layout (adam mu for a TP kernel).
+        opt_flat = jax.tree_util.tree_flatten_with_path(state.opt_state)[0]
+        opt_tp = [
+            1 for _, leaf in opt_flat
+            if hasattr(leaf, "sharding")
+            and any(
+                "model" in (ax if isinstance(ax, tuple) else (ax,))
+                for ax in getattr(leaf.sharding, "spec", P()) if ax is not None
+            )
+        ]
+        assert len(opt_tp) >= 2 * (4 * 2 + 1)  # mu and nu trees
+
+    def test_evaluate_per_token_loss_with_padding(self):
+        """evaluate() on a sequence model: per-token [G,T] losses weighted by
+        the per-example padding mask, counted in tokens."""
+        mesh = self._mesh()
+        trainer = _trainer(mesh)
+        x, y = datasets.copy_task(20, 32, vocab_size=VOCAB)  # 20 % 16 != 0 → padding
+        trainer.build(x)
+        result = trainer.evaluate(x, y, batch_size=4)
+        assert np.isfinite(result["loss"])
+        assert 0.0 <= result["accuracy"] <= 1.0
+
+    def test_matches_unsharded_forward(self):
+        """The sharded model must compute the same function."""
+        mesh = self._mesh()
+        sharded = _model(mesh=mesh)
+        plain = _model()
+        rng = np.random.RandomState(1)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(4, 32)).astype(np.int32))
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+        out_plain = plain.apply({"params": params}, toks)
+        out_sharded = jax.jit(
+            lambda p, t: sharded.apply({"params": p}, t)
+        )(params, toks)
+        np.testing.assert_allclose(
+            np.asarray(out_plain), np.asarray(out_sharded), rtol=5e-4, atol=5e-4
+        )
+
+
+@pytest.mark.slow
+class TestLongRangeRecall:
+    def test_copy_task_learned_through_ring(self):
+        """The functional long-context check: recall-half loss → small, which
+        is impossible without cross-shard attention (the copied token sits
+        T/2 positions back, on a different seq shard)."""
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=4))
+        trainer = hvt.Trainer(
+            _model(mesh=mesh, d_model=128, n_layers=2),
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            mesh=mesh,
+            param_specs=param_specs,
+            batch_specs=(P(("data", "fsdp"), "seq"), P(("data", "fsdp"), "seq")),
+        )
+        x, y = datasets.copy_task(2048, 32, vocab_size=VOCAB, seed=2)
+        trainer.fit(x=x, y=y, batch_size=16, epochs=3, steps_per_epoch=16, verbose=0)
+
+        # Per-position loss on held-out sequences.
+        xt, yt = datasets.copy_task(64, 32, vocab_size=VOCAB, seed=99)
+        logits = np.log(trainer.predict(xt, batch_size=8) + 1e-9)
+        ll = np.take_along_axis(logits, yt[..., None], axis=-1)[..., 0]
+        recall_loss = -ll[:, 16:].mean()  # second half: pure recall
+        first_loss = -ll[:, :14].mean()   # first half: irreducible ~log V
+        assert recall_loss < first_loss * 0.5, (recall_loss, first_loss)
