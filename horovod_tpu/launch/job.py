@@ -39,14 +39,24 @@ def run_job(spec_path: str) -> int:
     hosts = job.get("hosts")
     if hosts and checks:
         # The purge above only covered the launcher's filesystem; the sink
-        # appends on the coordinator host, so reset it there too.
+        # appends on the coordinator host, so reset it there too. A failed
+        # reset is fatal: gating against a possibly-stale stream could PASS
+        # a broken run.
         import subprocess
 
-        subprocess.run(
+        res = subprocess.run(
             ["ssh", "-o", "StrictHostKeyChecking=no", hosts[0],
              f"rm -f {shlex.quote(metrics_path)}"],
             capture_output=True,
+            text=True,
         )
+        if res.returncode != 0:
+            print(
+                f"cannot reset metrics on {hosts[0]} "
+                f"({res.stderr.strip()}); refusing to gate against a "
+                "possibly-stale stream"
+            )
+            return res.returncode or 1
     if hosts:
         code = launcher.run_hosts(
             list(hosts), argv, env=env,

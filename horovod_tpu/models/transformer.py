@@ -89,46 +89,75 @@ class Block(nn.Module):
         # --- attention -----------------------------------------------------
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
         qkv_shape = (self.n_heads, 3 * head_dim)
-        qkv = dense(features=qkv_shape)(h)  # [B,T,H,3D] — column-parallel in TP
+        # Explicit names: param_specs keys its TP rules on them, so layer
+        # additions/reorderings can't silently re-shard the wrong kernel.
+        qkv = dense(features=qkv_shape, name="qkv")(h)  # [B,T,H,3D] — column-parallel
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k = _rope(q, positions), _rope(k, positions)
 
-        if cfg.seq_parallel:
-            impl = {
-                "ring": attention_ops.ring_attention,
-                "ulysses": attention_ops.ulysses_attention,
-            }[cfg.attn]
+        if cfg.mesh is not None:
             model_par = cfg.mesh.shape.get(MODEL_AXIS, 1)
             if self.n_heads % model_par != 0:
                 raise ValueError(
                     f"n_heads ({self.n_heads}) must divide over the model "
                     f"axis ({model_par}) for sharded attention"
                 )
+
+        if cfg.seq_parallel:
+            impls = {
+                "ring": attention_ops.ring_attention,
+                "ulysses": attention_ops.ulysses_attention,
+            }
+            if cfg.attn not in impls:
+                raise ValueError(
+                    f"sequence-parallel attention needs attn in {sorted(impls)}, "
+                    f"got {cfg.attn!r}"
+                )
             # Fully-manual region: batch stays split over data/fsdp, heads
             # over model (attention never mixes batch or heads, so manual
             # sharding there is free); the seq axis is the collective one.
             spec = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
             attend = jax.shard_map(
-                functools.partial(impl, axis_name=SEQ_AXIS, causal=True),
+                functools.partial(impls[cfg.attn], axis_name=SEQ_AXIS, causal=True),
                 mesh=cfg.mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
                 check_vma=False,
             )
             out = attend(q, k, v)
-        else:
+        elif cfg.attn == "dense":
             out = attention_ops.dense_attention(q, k, v, causal=True)
+        else:
+            # Local path: the pallas flash kernel (O(T) memory, ~2-3x over
+            # XLA's materialized attention on v5e; falls back to dense when
+            # its tiling doesn't hold, interprets off-TPU). GSPMD cannot
+            # auto-partition a Mosaic custom call, so on a multi-device mesh
+            # it runs in a fully-manual shard_map (batch over data/fsdp,
+            # heads over model — attention mixes neither).
+            from horovod_tpu.ops.flash_attention import flash_attention
 
-        out = dense(features=self.d_model, axis=(-2, -1))(out)  # row-parallel
+            local = functools.partial(flash_attention, causal=True)
+            if cfg.mesh is not None and cfg.mesh.size > 1:
+                spec = P(BATCH_AXES, None, MODEL_AXIS, None)
+                local = jax.shard_map(
+                    local,
+                    mesh=cfg.mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                    check_vma=False,
+                )
+            out = local(q, k, v)
+
+        out = dense(features=self.d_model, axis=(-2, -1), name="attn_out")(out)  # row-parallel
         out = nn.Dropout(self.dropout, deterministic=not train)(out)
         x = x + out
         x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
 
         # --- MLP -----------------------------------------------------------
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
-        h = dense(features=4 * self.d_model)(h)  # column-parallel
+        h = dense(features=4 * self.d_model, name="mlp_up")(h)  # column-parallel
         h = nn.gelu(h)
-        h = dense(features=self.d_model)(h)  # row-parallel
+        h = dense(features=self.d_model, name="mlp_down")(h)  # row-parallel
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         return cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
@@ -159,7 +188,8 @@ class TransformerLM(nn.Module):
             )(x, positions, train=train)
         x = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
         logits = nn.DenseGeneral(
-            features=self.vocab_size, dtype=self.compute_dtype, use_bias=False
+            features=self.vocab_size, dtype=self.compute_dtype, use_bias=False,
+            name="lm_head",
         )(x)
         return logits.astype(jnp.float32)
 
@@ -182,28 +212,29 @@ def param_specs(params, mesh: Mesh) -> dict:
     """
     fsdp = mesh.shape.get(FSDP_AXIS, 1) > 1
 
+    # Rules keyed on the explicit layer names the model declares — immune to
+    # flax auto-numbering shifts when layers are added or reordered.
+    tp_dim = {
+        "qkv": 1,        # [dm, H, 3·hd] — heads (column-parallel)
+        "attn_out": 0,   # [H, hd, dm]  — heads (row-parallel)
+        "mlp_up": 1,     # [dm, 4·dm]   — features (column-parallel)
+        "mlp_down": 0,   # [4·dm, dm]   — inputs (row-parallel)
+        "lm_head": 1,    # [dm, vocab]  — vocab (column-parallel)
+    }
+
     def rule(path, leaf):
         names = [
             p.key for p in path if isinstance(p, jax.tree_util.DictKey)
         ]
-        flat = "/".join(names)
         spec: list = [None] * leaf.ndim
-        if leaf.ndim >= 2:
-            if "DenseGeneral_0" in flat and leaf.ndim == 3:  # QKV [dm,H,3hd]
-                spec[1] = MODEL_AXIS
-            elif "DenseGeneral_1" in flat and leaf.ndim == 3:  # proj [H,hd,dm]
-                spec[0] = MODEL_AXIS
-            elif "DenseGeneral_2" in flat:  # MLP up [dm, 4dm]
-                spec[1] = MODEL_AXIS
-            elif "DenseGeneral_3" in flat:  # MLP down [4dm, dm]
-                spec[0] = MODEL_AXIS
-            elif "Embed" not in flat and leaf.ndim == 2:  # LM head [dm, vocab]
-                spec[1] = MODEL_AXIS
-            if fsdp:
-                for dim in range(leaf.ndim):
-                    if spec[dim] is None and leaf.shape[dim] % mesh.shape[FSDP_AXIS] == 0:
-                        spec[dim] = FSDP_AXIS
-                        break
+        layer = next((n for n in names if n in tp_dim), None)
+        if layer is not None and leaf.ndim >= 2:
+            spec[tp_dim[layer]] = MODEL_AXIS
+        if fsdp and leaf.ndim >= 2:
+            for dim in range(leaf.ndim):
+                if spec[dim] is None and leaf.shape[dim] % mesh.shape[FSDP_AXIS] == 0:
+                    spec[dim] = FSDP_AXIS
+                    break
         return P(*spec)
 
     return jax.tree_util.tree_map_with_path(rule, params)

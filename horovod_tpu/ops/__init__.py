@@ -6,3 +6,7 @@ from horovod_tpu.ops.attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+
+# NOTE: the flash kernel lives in `horovod_tpu.ops.flash_attention` (module);
+# it is deliberately NOT re-exported here — a function named like its own
+# submodule would shadow the module attribute on the package.

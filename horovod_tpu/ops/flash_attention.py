@@ -1,0 +1,343 @@
+"""Flash attention — pallas TPU kernel for the local attention hot path.
+
+The single hottest op of the transformer family, implemented blockwise so
+the [Tq, Tk] score matrix never touches HBM: each grid step streams one K/V
+block through VMEM, folds it into an online-softmax accumulator (running
+max / normalizer / unnormalized output, the same recurrence
+`ops.attention.ring_attention` uses across chips — this kernel is the
+within-chip counterpart), and writes the normalized output once per Q block.
+O(T) memory instead of O(T²), matmuls on the MXU in the input dtype,
+statistics in float32.
+
+Backward is a custom VJP with the standard two-kernel recomputation scheme
+(dq swept over K blocks, dK/dV swept over Q blocks) using the saved
+logsumexp, so residual memory is O(T) as well.
+
+`flash_attention` is shape-checked and falls back to the dense reference
+(`ops.attention.dense_attention`) when the kernel's tiling constraints don't
+hold; `interpret=True` (auto on CPU) runs the same kernel in the pallas
+interpreter, which is how the unit tests validate it off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from horovod_tpu.ops.attention import dense_attention
+
+_BIG_NEG = -1e30
+# 512-square tiles: ~2.4x over XLA's materialized attention at T=2048 on
+# v5e (measured in BASELINE.md); still well inside VMEM for D ≤ 128 in f32.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _causal_mask(iq, ik, bq, bk):
+    """[bq, bk] 0/1 mask for global rows iq*bq+r ≥ cols ik*bk+c."""
+    rows = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return (rows >= cols).astype(jnp.float32)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _BIG_NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal block skip: a K block strictly above the diagonal contributes
+    # nothing — predicate the whole update away (half the FLOPs for causal).
+    needed = (not causal) or (ik * bk <= iq * bq + bq - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            mask = _causal_mask(iq, ik, bq, bk)
+            s = s + (1.0 - mask) * _BIG_NEG
+
+        m_prev = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = p * mask  # exact zeros on masked lanes
+        l_ref[:, 0:1] = l_ref[:, 0:1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, 0:1] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_ref[:, 0:1]
+        o_ref[0, 0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m_ref[:, 0:1] + jnp.log(l)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale, causal, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    needed = (not causal) or (ik * bk <= iq * bq + bq - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            # Mask BEFORE exp (as the forward does): a large masked score
+            # would overflow exp to inf, and the TPU's inf*0 is NaN — the
+            # post-hoc `p * mask` alone is only safe in interpret mode.
+            mask = _causal_mask(iq, ik, bq, bk)
+            s = s + (1.0 - mask) * _BIG_NEG
+        p = jnp.exp(s - lse)
+        if causal:
+            p = p * mask
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0, :, :] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = (not causal) or (ik * bk <= iq * bq + bq - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            mask = _causal_mask(iq, ik, bq, bk)
+            s = s + (1.0 - mask) * _BIG_NEG  # pre-exp: see _bwd_dq_kernel
+        p = jnp.exp(s - lse)
+        if causal:
+            p = p * mask
+        # dV += Pᵀ · dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        # dK += dSᵀ · Q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _block_spec(d, bt, *, inner: bool):
+    """BlockSpec for [B,H,T,D] arrays: one (1, 1, bt, D) tile per (b, h)
+    grid point — the (bt, D) tile sits in the trailing dims as the TPU
+    lowering requires. ``inner`` selects which grid coordinate walks this
+    tensor's T: the last (swept) one or the second-to-last (anchored) one."""
+    if inner:
+        return pl.BlockSpec((1, 1, bt, d), lambda ib, ih, i, j: (ib, ih, j, 0))
+    return pl.BlockSpec((1, 1, bt, d), lambda ib, ih, i, j: (ib, ih, i, 0))
+
+
+def _stat_spec(bq, *, inner: bool):
+    """[B,H,T,1] per-row statistics (lse / delta)."""
+    if inner:
+        return pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, i, j: (ib, ih, j, 0))
+    return pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, i, j: (ib, ih, i, 0))
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def _flash(q, k, v, causal, bq, bk, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, causal, bq, bk, interpret):
+    # Kernel layout is [B, H, T, D] so the (T-block, D) tile occupies the
+    # trailing dims; callers pass [B, T, H, D].
+    qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+    b, h, t, d = qt.shape
+    scale = d ** -0.5
+    grid = (b, h, t // bq, t // bk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _block_spec(d, bq, inner=False),
+            _block_spec(d, bk, inner=True),
+            _block_spec(d, bk, inner=True),
+        ],
+        out_specs=[
+            _block_spec(d, bq, inner=False),
+            _stat_spec(bq, inner=False),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+def _flash_fwd(q, k, v, causal, bq, bk, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, bq, bk, interpret, res, g):
+    q, k, v, out, lse = res
+    qt, kt, vt, gt = (
+        jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v, g)
+    )
+    b, h, t, d = qt.shape
+    scale = d ** -0.5
+    # delta_i = Σ_d dO·O — the softmax-jacobian row term, cheap outside.
+    delta = jnp.einsum(
+        "bthd,bthd->bht", g.astype(jnp.float32), out.astype(jnp.float32)
+    )[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=(b, h, t // bq, t // bk),
+        in_specs=[
+            _block_spec(d, bq, inner=False),
+            _block_spec(d, bk, inner=True),
+            _block_spec(d, bk, inner=True),
+            _block_spec(d, bq, inner=False),
+            _stat_spec(bq, inner=False),
+            _stat_spec(bq, inner=False),
+        ],
+        out_specs=_block_spec(d, bq, inner=False),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=(b, h, t // bk, t // bq),
+        in_specs=[
+            _block_spec(d, bq, inner=True),
+            _block_spec(d, bk, inner=False),
+            _block_spec(d, bk, inner=False),
+            _block_spec(d, bq, inner=True),
+            _stat_spec(bq, inner=True),
+            _stat_spec(bq, inner=True),
+        ],
+        out_specs=[
+            _block_spec(d, bk, inner=False),
+            _block_spec(d, bk, inner=False),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse, delta)
+    back = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
+    return back(dq), back(dk), back(dv)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def supported(q_shape, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K) -> bool:
+    """Whether the kernel's tiling holds for [B,T,H,D] q/k/v."""
+    b, t, h, d = q_shape
+    return t % bq == 0 and t % bk == 0 and d <= 256
+
+
+def flash_attention(
+    q, k, v, *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+):
+    """[B,T,H,D] attention via the pallas kernel; dense fallback when the
+    tiling doesn't hold. ``interpret=None`` auto-selects the pallas
+    interpreter off-TPU so tests/CPU paths run the same kernel code."""
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    if not supported(q.shape, block_q, block_k):
+        return dense_attention(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

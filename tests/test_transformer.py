@@ -110,6 +110,30 @@ class TestMeshComposition:
         ]
         assert len(opt_tp) >= 2 * (4 * 2 + 1)  # mu and nu trees
 
+    def test_pure_dp_mesh_uses_flash_in_shard_map(self):
+        """seq=1 multi-device mesh: the local flash kernel must run inside a
+        manual shard_map (GSPMD can't partition a Mosaic call) and train."""
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        trainer = _trainer(mesh)
+        x, y = datasets.copy_task(256, 32, vocab_size=VOCAB, seed=5)
+        history = trainer.fit(
+            x=x, y=y, batch_size=4, epochs=1, steps_per_epoch=6, verbose=0
+        )
+        assert np.isfinite(history[-1]["loss"])
+
+    def test_dense_attn_option(self):
+        """attn='dense' on an unsharded model takes the reference path."""
+        model = _model(attn="dense")
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        assert model.apply({"params": params}, tokens).shape == (2, 16, VOCAB)
+
+    def test_seq_parallel_rejects_dense(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=4))
+        model = _model(mesh=mesh, attn="dense")
+        with pytest.raises(ValueError, match="ring"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32), jnp.int32))
+
     def test_evaluate_per_token_loss_with_padding(self):
         """evaluate() on a sequence model: per-token [G,T] losses weighted by
         the per-example padding mask, counted in tokens."""
