@@ -73,17 +73,18 @@ def main() -> None:
     state = trainer.build(batches[0][0])
     state = hvt.broadcast_parameters(state, mesh=trainer.mesh)
     scale = np.float32(1.0)
+    acc = {"loss": np.float32(0), "accuracy": np.float32(0)}
 
     for i in range(WARMUP_STEPS):
-        state, metrics = trainer._train_step(
-            state, trainer._shard(batches[i % n_prebatched]), scale
+        state, metrics, acc = trainer._train_step(
+            state, trainer._shard(batches[i % n_prebatched]), scale, acc
         )
     jax.block_until_ready(state)
 
     t0 = time.perf_counter()
     for i in range(MEASURE_STEPS):
-        state, metrics = trainer._train_step(
-            state, trainer._shard(batches[i % n_prebatched]), scale
+        state, metrics, acc = trainer._train_step(
+            state, trainer._shard(batches[i % n_prebatched]), scale, acc
         )
     jax.block_until_ready(state)
     elapsed = time.perf_counter() - t0

@@ -1,0 +1,172 @@
+"""ctypes binding for the native batch-assembly engine (native/hvt_data.cc).
+
+The framework's native-runtime component (SURVEY.md §2.3: the reference's
+C++ layer is Horovod's core; the collective half of that role is owned by
+XLA here, the host-IO half is this): a C++ producer thread permutes,
+gathers and stages training batches into a ring of reusable buffers while
+the accelerator runs the previous step.
+
+`NativeBatchLoader` is a drop-in for the training-path `ArrayDataset`
+pipeline (full reshuffle each epoch, repeat-forever, drop-remainder — the
+same semantics `Trainer.fit(x=, y=)` builds). `available()` reports whether
+the shared library could be loaded/built; callers fall back to the Python
+pipeline when it can't, so the framework works without a toolchain.
+
+By default each yielded array is an owned copy (safe under any lifetime —
+JAX's async device_put may read host buffers after dispatch, and a GC'd
+loader frees its slots). The shuffle/gather still happens off-thread; the
+one extra memcpy per batch is noise. ``copy=False`` yields zero-copy views
+valid only until the next ``__next__`` call and only while the loader
+object is alive — for callers that consume synchronously.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhvt_data.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _load():
+    """Load (building on first use) the shared library; None on failure."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            if os.environ.get("HVT_NO_NATIVE"):
+                _load_failed = True
+                return None
+            try:
+                subprocess.run(
+                    ["make", "-s", "libhvt_data.so"],
+                    cwd=_NATIVE_DIR,
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.hvt_loader_create.restype = ctypes.c_void_p
+        lib.hvt_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.hvt_loader_next.restype = ctypes.c_int
+        lib.hvt_loader_next.argtypes = [ctypes.c_void_p]
+        lib.hvt_loader_slot_ptr.restype = ctypes.c_void_p
+        lib.hvt_loader_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.hvt_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.hvt_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeBatchLoader:
+    """Infinite iterator of ``(arr_0[batch], arr_1[batch], ...)`` tuples
+    assembled off-thread in C++. Fresh full permutation per epoch
+    (``shuffle=True``), batches never straddle the epoch remainder."""
+
+    def __init__(
+        self,
+        arrays: Sequence[np.ndarray],
+        batch_size: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        n_slots: int = 4,
+        copy: bool = True,
+    ):
+        self.copy = copy
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native loader unavailable (build native/libhvt_data.so)"
+            )
+        self._lib = lib
+        # Keep C-contiguous copies alive for the library's lifetime — it
+        # borrows these base pointers.
+        self._arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = self._arrays[0].shape[0]
+        if any(a.shape[0] != n for a in self._arrays):
+            raise ValueError("all arrays must share the leading dimension")
+        if batch_size > n:
+            raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+        self.batch_size = int(batch_size)
+        self._shapes = [(self.batch_size,) + a.shape[1:] for a in self._arrays]
+        self._dtypes = [a.dtype for a in self._arrays]
+
+        ptrs = (ctypes.c_void_p * len(self._arrays))(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays]
+        )
+        row_bytes = (ctypes.c_int64 * len(self._arrays))(
+            *[a.strides[0] for a in self._arrays]
+        )
+        self._handle = lib.hvt_loader_create(
+            ptrs, row_bytes, len(self._arrays), n, self.batch_size,
+            n_slots, seed, 1 if shuffle else 0,
+        )
+        if not self._handle:
+            raise RuntimeError("hvt_loader_create failed")
+        self._held_slot = -1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._handle is None:
+            raise StopIteration
+        if self._held_slot >= 0:
+            # Previous batch's buffers are recycled now (documented lifetime).
+            self._lib.hvt_loader_release(self._handle, self._held_slot)
+            self._held_slot = -1
+        slot = self._lib.hvt_loader_next(self._handle)
+        if slot < 0:
+            raise StopIteration
+        self._held_slot = slot
+        out = []
+        for idx, (shape, dtype) in enumerate(zip(self._shapes, self._dtypes)):
+            ptr = self._lib.hvt_loader_slot_ptr(self._handle, slot, idx)
+            size = int(np.prod(shape)) * dtype.itemsize
+            buf = (ctypes.c_char * size).from_address(ptr)
+            arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+            out.append(arr.copy() if self.copy else arr)
+        return tuple(out)
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.hvt_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -187,8 +187,8 @@ class ScalarLogger(Callback):
 
     def _flush_pending(self):
         if self._pending:
-            fetched = jax.device_get([logs for _, _, logs in self._pending])
-            for (step, wall, _), logs in zip(self._pending, fetched):
+            rows = jax.device_get([logs for _, _, logs in self._pending])
+            for (step, wall, _), logs in zip(self._pending, rows):
                 self._emit("batch/", logs, step, wall_time=wall)
             self._pending = []
         if self._fh:

@@ -129,7 +129,7 @@ class Trainer:
         self.stop_training = False
         self.history: list[dict] = []
 
-        def train_step(state: TrainState, batch, update_scale):
+        def train_step(state: TrainState, batch, update_scale, metric_acc):
             x, y = batch
             step_rng = jax.random.fold_in(state.rng, state.step)
 
@@ -164,7 +164,13 @@ class Trainer:
                 step=state.step + 1, params=params, opt_state=opt_state,
                 model_state=model_state,
             )
-            return new_state, {"loss": loss, "accuracy": acc}
+            metrics = {"loss": loss, "accuracy": acc}
+            # Epoch metric sums accumulate inside the compiled step: per-step
+            # host fetches (or even per-step host-side adds) each cost a
+            # dispatch/transfer round-trip, which dominates wall-clock on a
+            # networked TPU; this way an epoch ends with ONE 2-scalar fetch.
+            new_acc = jax.tree.map(jnp.add, metric_acc, metrics)
+            return new_state, metrics, new_acc
 
         def _eval_variables(state: TrainState):
             return {"params": state.params, **(state.model_state or {})}
@@ -377,6 +383,17 @@ class Trainer:
             cb.on_train_begin()
 
         pending = first
+        # Zero metric accumulator, committed to the mesh's replicated
+        # sharding ONCE: a fresh uncommitted jnp.zeros each epoch would give
+        # the first step of every epoch a different input-sharding signature
+        # than the chained steps, ping-ponging between two executables.
+        zero_acc = sharding_lib.replicate(
+            {
+                "loss": jnp.zeros((), jnp.float32),
+                "accuracy": jnp.zeros((), jnp.float32),
+            },
+            self.mesh,
+        )
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -384,22 +401,18 @@ class Trainer:
                 cb.on_epoch_begin(epoch)
             t0 = time.perf_counter()
             scale = jnp.asarray(self.update_scale, jnp.float32)
-            epoch_metrics = []
+            metric_acc = zero_acc
             for step in range(steps_per_epoch):
                 batch = pending if pending is not None else next(it)
                 pending = None
-                self.state, metrics = self._train_step(
-                    self.state, self._shard(batch), scale
+                self.state, metrics, metric_acc = self._train_step(
+                    self.state, self._shard(batch), scale, metric_acc
                 )
-                epoch_metrics.append(metrics)
                 for cb in callbacks:
                     cb.on_batch_end(step, metrics)
-            # One host sync per epoch: average the per-step device scalars.
-            stacked = jax.device_get(epoch_metrics)
-            logs = {
-                k: float(np.mean([m[k] for m in stacked]))
-                for k in stacked[0]
-            }
+            # ONE host fetch per epoch (see train_step's accumulator note).
+            sums = jax.device_get(metric_acc)
+            logs = {k: float(v) / steps_per_epoch for k, v in sums.items()}
             logs["epoch_time_s"] = time.perf_counter() - t0
             if validation_data is not None:
                 val = self.evaluate(

@@ -1,0 +1,204 @@
+// hvt_data — native batch-assembly engine for the input pipeline.
+//
+// The runtime-layer slot the reference fills with Horovod's C++ core
+// (SURVEY.md §2.3): where Horovod's native code coordinates collectives
+// (obsolete under SPMD/XLA — the compiler owns that), the host-side cost
+// that remains in this framework is batch assembly: per-epoch permutation,
+// row gather, and staging, all GIL-bound in pure Python. This library runs
+// them in a producer thread writing into a bounded ring of pre-allocated
+// slots, overlapping batch assembly with the accelerator step.
+//
+// Exposed as a tiny C ABI consumed via ctypes (no pybind11 in this image):
+//   hvt_loader_create(arr_ptrs, row_bytes, n_arrays, n_examples,
+//                     batch, n_slots, seed, shuffle)  -> handle
+//   hvt_loader_next(handle)             -> slot id (blocks until filled)
+//   hvt_loader_slot_ptr(handle, slot, array_idx) -> buffer pointer
+//   hvt_loader_release(handle, slot)    -> recycle a consumed slot
+//   hvt_loader_destroy(handle)
+//
+// Semantics match the Python ArrayDataset training path: a fresh full
+// permutation per epoch (the reference's shuffle(10000)-over-60k behaves
+// as one, tensorflow2_keras_mnist.py:40), repeating forever; batches never
+// straddle an epoch boundary remainder (drop_remainder=True).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// xorshift128+ — deterministic, seedable, fast; quality is ample for
+// shuffling (this is not a cryptographic context).
+struct XorShift128Plus {
+  uint64_t s0, s1;
+  explicit XorShift128Plus(uint64_t seed) {
+    // splitmix64 expansion of the seed into two non-zero words.
+    auto next = [&seed]() {
+      seed += 0x9E3779B97F4A7C15ULL;
+      uint64_t z = seed;
+      z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+      z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+      return z ^ (z >> 31);
+    };
+    s0 = next();
+    s1 = next();
+    if (s0 == 0 && s1 == 0) s0 = 1;
+  }
+  uint64_t operator()() {
+    uint64_t x = s0;
+    const uint64_t y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+  // Unbiased bounded sample via rejection.
+  uint64_t bounded(uint64_t n) {
+    const uint64_t limit = UINT64_MAX - UINT64_MAX % n;
+    uint64_t v;
+    do {
+      v = (*this)();
+    } while (v >= limit);
+    return v % n;
+  }
+};
+
+struct Loader {
+  std::vector<const uint8_t*> arrays;   // source base pointers (borrowed)
+  std::vector<int64_t> row_bytes;       // bytes per example, per array
+  int64_t n_examples = 0;
+  int64_t batch = 0;
+  int n_slots = 0;
+  bool shuffle = true;
+
+  // slot_buffers[slot][array] — owned staging buffers.
+  std::vector<std::vector<std::vector<uint8_t>>> slots;
+  std::vector<int> ready;   // filled slot ids, FIFO
+  std::vector<char> free_;  // free_[slot] == 1 → producer may fill it
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::atomic<bool> stop{false};
+  std::thread producer;
+  XorShift128Plus rng;
+
+  Loader(uint64_t seed) : rng(seed) {}
+
+  void fill(int slot, const std::vector<int64_t>& perm, int64_t offset) {
+    for (size_t a = 0; a < arrays.size(); ++a) {
+      const int64_t rb = row_bytes[a];
+      uint8_t* dst = slots[slot][a].data();
+      const uint8_t* src = arrays[a];
+      for (int64_t i = 0; i < batch; ++i) {
+        std::memcpy(dst + i * rb, src + perm[offset + i] * rb, rb);
+      }
+    }
+  }
+
+  void run() {
+    std::vector<int64_t> perm(n_examples);
+    for (int64_t i = 0; i < n_examples; ++i) perm[i] = i;
+    int64_t cursor = n_examples;  // force a reshuffle on first use
+    const int64_t usable = n_examples - n_examples % batch;
+    while (!stop.load(std::memory_order_relaxed)) {
+      if (cursor >= usable) {
+        if (shuffle) {
+          for (int64_t i = n_examples - 1; i > 0; --i) {
+            const int64_t j = static_cast<int64_t>(rng.bounded(i + 1));
+            std::swap(perm[i], perm[j]);
+          }
+        }
+        cursor = 0;
+      }
+      int slot = -1;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] {
+          if (stop.load(std::memory_order_relaxed)) return true;
+          for (int s = 0; s < n_slots; ++s)
+            if (free_[s]) return true;
+          return false;
+        });
+        if (stop.load(std::memory_order_relaxed)) return;
+        for (int s = 0; s < n_slots; ++s)
+          if (free_[s]) { slot = s; break; }
+        free_[slot] = 0;
+      }
+      fill(slot, perm, cursor);
+      cursor += batch;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready.push_back(slot);
+      }
+      cv_ready.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvt_loader_create(const uint8_t** arr_ptrs, const int64_t* row_bytes,
+                        int n_arrays, int64_t n_examples, int64_t batch,
+                        int n_slots, uint64_t seed, int shuffle) {
+  if (n_arrays <= 0 || n_examples < batch || batch <= 0 || n_slots < 2)
+    return nullptr;
+  auto* L = new Loader(seed);
+  L->arrays.assign(arr_ptrs, arr_ptrs + n_arrays);
+  L->row_bytes.assign(row_bytes, row_bytes + n_arrays);
+  L->n_examples = n_examples;
+  L->batch = batch;
+  L->n_slots = n_slots;
+  L->shuffle = shuffle != 0;
+  L->slots.resize(n_slots);
+  for (int s = 0; s < n_slots; ++s) {
+    L->slots[s].resize(n_arrays);
+    for (int a = 0; a < n_arrays; ++a)
+      L->slots[s][a].resize(static_cast<size_t>(batch) * row_bytes[a]);
+  }
+  L->free_.assign(n_slots, 1);
+  L->producer = std::thread([L] { L->run(); });
+  return L;
+}
+
+// Blocks until a slot is filled; returns its id (>= 0), or -1 after destroy.
+int hvt_loader_next(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_ready.wait(lk, [&] {
+    return L->stop.load(std::memory_order_relaxed) || !L->ready.empty();
+  });
+  if (L->ready.empty()) return -1;
+  const int slot = L->ready.front();
+  L->ready.erase(L->ready.begin());
+  return slot;
+}
+
+const uint8_t* hvt_loader_slot_ptr(void* handle, int slot, int array_idx) {
+  auto* L = static_cast<Loader*>(handle);
+  return L->slots[slot][array_idx].data();
+}
+
+void hvt_loader_release(void* handle, int slot) {
+  auto* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_[slot] = 1;
+  }
+  L->cv_free.notify_one();
+}
+
+void hvt_loader_destroy(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  L->stop.store(true);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  if (L->producer.joinable()) L->producer.join();
+  delete L;
+}
+
+}  // extern "C"
