@@ -1,4 +1,6 @@
 """Data layer: dataset registry + per-process sharded input pipeline."""
 
 from horovod_tpu.data.datasets import mnist, cifar10  # noqa: F401
-from horovod_tpu.data.loader import ArrayDataset  # noqa: F401
+from horovod_tpu.data.loader import ArrayDataset, training_pipeline  # noqa: F401
+from horovod_tpu.data.native_loader import NativeBatchLoader  # noqa: F401
+from horovod_tpu.data.native_loader import available as native_available  # noqa: F401

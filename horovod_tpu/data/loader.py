@@ -17,6 +17,7 @@ rather than a full permutation, so the behavior matches at any scale.
 
 from __future__ import annotations
 
+import os
 from typing import Iterator, Sequence
 
 import numpy as np
@@ -44,6 +45,10 @@ class ArrayDataset:
     @property
     def num_examples(self) -> int:
         return self._arrays[0].shape[0]
+
+    @property
+    def arrays(self) -> tuple:
+        return self._arrays
 
     def shard(self, index: int, count: int) -> "ArrayDataset":
         """Keep every count-th example starting at index (per-process split)."""
@@ -125,3 +130,44 @@ class ArrayDataset:
     def take(self, n_batches: int):
         it = iter(self)
         return [next(it) for _ in range(n_batches)]
+
+
+def training_pipeline(
+    arrays: Sequence[np.ndarray],
+    batch_size: int,
+    seed: int = 0,
+    shuffle_buffer: int | None = None,
+):
+    """The training-path input iterator: infinite shuffled batches of the
+    given arrays (the reference's ``repeat().shuffle().batch()`` chain,
+    tensorflow2_keras_mnist.py:37-41).
+
+    Routes to the native batch-assembly engine
+    (`horovod_tpu.data.native_loader`, the framework's C++ runtime slot —
+    SURVEY.md §2.3) when it is available and the requested shuffle covers the
+    whole dataset (a full per-epoch permutation, which is also what the
+    reference's shuffle(10000)-over-60k effectively does); falls back to the
+    pure-Python `ArrayDataset` chain otherwise — including under
+    ``HVT_NO_NATIVE=1`` or without a C++ toolchain.
+
+    Returns ``(iterator, close)``: call ``close()`` when done so the native
+    producer thread and its staging ring are torn down promptly rather than
+    at GC time.
+    """
+    n = len(arrays[0])
+    full_shuffle = shuffle_buffer is None or shuffle_buffer >= n
+    if full_shuffle and not os.environ.get("HVT_NO_NATIVE"):
+        from horovod_tpu.data import native_loader
+
+        if native_loader.available() and batch_size <= n:
+            loader = native_loader.NativeBatchLoader(
+                arrays, batch_size, seed=seed, shuffle=True
+            )
+            return iter(loader), loader.close
+    ds = (
+        ArrayDataset(arrays)
+        .repeat()
+        .shuffle(shuffle_buffer or n, seed=seed)
+        .batch(batch_size)
+    )
+    return iter(ds), lambda: None

@@ -49,19 +49,22 @@ def _load():
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            if os.environ.get("HVT_NO_NATIVE"):
-                _load_failed = True
-                return None
-            try:
-                subprocess.run(
-                    ["make", "-s", "libhvt_data.so"],
-                    cwd=_NATIVE_DIR,
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception:
+        if os.environ.get("HVT_NO_NATIVE"):
+            _load_failed = True
+            return None
+        # Always run make (a no-op when up to date) so the Makefile's source
+        # dependency governs rebuilds — a stale .so never shadows an edited
+        # hvt_data.cc.
+        try:
+            subprocess.run(
+                ["make", "-s", "libhvt_data.so"],
+                cwd=_NATIVE_DIR,
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            if not os.path.exists(_LIB_PATH):
                 _load_failed = True
                 return None
         try:

@@ -27,7 +27,13 @@ def read_metric(path: str, name: str) -> list[float]:
             line = line.strip()
             if not line:
                 continue
-            rec = json.loads(line)
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                # A torn final line (writer killed mid-append, or a reader
+                # racing the appender) must not crash the gate — the
+                # fail-on-empty-stream semantics still hold below.
+                continue
             if rec.get("name") == name:
                 values.append(float(rec["value"]))
     return values

@@ -89,7 +89,11 @@ def _can_decide_primary() -> bool:
 
         return xla_bridge.backends_are_initialized()
     except Exception:
-        return True  # decide now rather than buffer forever
+        # Fail closed: keep buffering until runtime.init. Deciding now would
+        # initialize the backend before jax.distributed is configured — the
+        # exact hazard the deferred-sink design exists to prevent. Buffered
+        # pushes flush on the first post-init push, so nothing is lost.
+        return False
 
 
 def _resolve() -> MetricsSink | None:

@@ -318,10 +318,32 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def supported(q_shape, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K) -> bool:
-    """Whether the kernel's tiling holds for [B,T,H,D] q/k/v."""
+def _sublane(dtype) -> int:
+    """Second-to-last-dim tile granule for the TPU vector layout: f32 packs
+    8 sublanes, 16-bit types 16, 8-bit types 32."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+
+
+def supported(q_shape, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K,
+              k_shape=None, dtype=jnp.float32) -> bool:
+    """Whether the kernel's tiling holds for [B,T,H,D] q/k/v.
+
+    Beyond divisibility, the blocks must be sublane-aligned for the dtype
+    (an unaligned tile fails Mosaic compilation on real TPU instead of
+    falling back), and K/V must share q's sequence length — the grid is
+    derived from q's T, so a cross-attention call with Tk != Tq would index
+    K/V blocks out of range (silent garbage in interpret mode).
+    """
     b, t, h, d = q_shape
-    return t % bq == 0 and t % bk == 0 and d <= 256
+    if k_shape is not None and k_shape[1] != t:
+        return False
+    granule = _sublane(dtype)
+    return (
+        t % bq == 0 and t % bk == 0
+        and bq % granule == 0 and bk % granule == 0
+        and d <= 256
+    )
 
 
 def flash_attention(
@@ -336,7 +358,7 @@ def flash_attention(
     interpreter off-TPU so tests/CPU paths run the same kernel code."""
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
-    if not supported(q.shape, block_q, block_k):
+    if not supported(q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype):
         return dense_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -73,8 +73,9 @@ def broadcast(x, root: int = 0, axis_name=None):
 
     Traced context: select root's shard via masked psum — every worker ends
     with root's value; XLA lowers this to a single collective.
-    Eager context: `multihost_utils.broadcast_one_to_all` (root must be
-    process 0, matching the reference's only use: root=0)."""
+    Eager context: `multihost_utils.broadcast_one_to_all` with the root
+    process as source (the reference only ever uses root=0,
+    tensorflow2_keras_mnist.py:71, but the API honors any root)."""
     if axis_name is not None:
         x = jnp.asarray(x)
         names = _axis_names(axis_name)
@@ -85,9 +86,9 @@ def broadcast(x, root: int = 0, axis_name=None):
         return lax.psum(x * mask, axis_name)
     if jax.process_count() == 1:
         return jnp.asarray(x)
-    if root != 0:
-        raise NotImplementedError("eager broadcast supports root=0 only")
-    return multihost_utils.broadcast_one_to_all(x)
+    return multihost_utils.broadcast_one_to_all(
+        x, is_source=jax.process_index() == root
+    )
 
 
 # --- PyTree conveniences (the DistributedOptimizer / broadcast-callback core)
@@ -114,9 +115,11 @@ def broadcast_pytree(tree: PyTree, root: int = 0, axis_name=None) -> PyTree:
     """Broadcast every leaf from root — ``hvd.broadcast_global_variables(0)``
     over an arbitrary pytree (model params AND optimizer state; the reference
     broadcasts both, SURVEY.md §7.3)."""
-    if axis_name is None and jax.process_count() > 1 and root == 0:
+    if axis_name is None and jax.process_count() > 1:
         # One fused host-level broadcast for the whole tree.
-        return multihost_utils.broadcast_one_to_all(tree)
+        return multihost_utils.broadcast_one_to_all(
+            tree, is_source=jax.process_index() == root
+        )
     return jax.tree.map(lambda x: broadcast(x, root=root, axis_name=axis_name), tree)
 
 

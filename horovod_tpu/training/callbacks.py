@@ -64,14 +64,14 @@ class BroadcastGlobalVariablesCallback(Callback):
     broadcast is the cross-process sync."""
 
     def __init__(self, root_rank: int = 0):
-        if root_rank != 0:
-            raise NotImplementedError("root_rank=0 only (matches the reference)")
         self.root_rank = root_rank
 
     def on_train_begin(self, logs=None):
         if jax.process_count() == 1:
             return
-        state = collectives.broadcast_pytree(jax.device_get(self.trainer.state))
+        state = collectives.broadcast_pytree(
+            jax.device_get(self.trainer.state), root=self.root_rank
+        )
         self.trainer.state = sharding.replicate(state, self.trainer.mesh)
 
 
@@ -146,7 +146,13 @@ class ScalarLogger(Callback):
     dashboards; per-batch or per-epoch frequency mirrors
     ``TensorBoard(update_freq='batch')`` (tensorflow2_keras_mnist.py:89).
     ``log_every`` thins batch records (1 = every batch); epoch records are
-    always written."""
+    always written.
+
+    Durability: batch records are buffered (fetching device values per batch
+    would serialize TPU async dispatch) and flushed when either
+    ``flush_every`` records accumulate or ``flush_secs`` seconds pass since
+    the last flush — so a mid-epoch crash loses at most ``flush_secs`` worth
+    of batch records, not an unbounded count."""
 
     def __init__(
         self,
@@ -154,11 +160,14 @@ class ScalarLogger(Callback):
         update_freq: str = "epoch",
         log_every: int = 1,
         flush_every: int = 100,
+        flush_secs: float = 10.0,
     ):
         self.log_dir = log_dir
         self.update_freq = update_freq
         self.log_every = max(1, log_every)
         self.flush_every = max(1, flush_every)
+        self.flush_secs = flush_secs
+        self._last_flush = time.time()
         self._fh = None
         self._step = 0
         # Per-batch records hold device arrays until flushed — fetching
@@ -193,13 +202,18 @@ class ScalarLogger(Callback):
             self._pending = []
         if self._fh:
             self._fh.flush()
+        self._last_flush = time.time()
 
     def on_batch_end(self, batch: int, logs=None):
         self._step += 1
         if self.update_freq == "batch" and self._step % self.log_every == 0 and logs:
             if runtime.is_primary():
-                self._pending.append((self._step, time.time(), logs))
-                if len(self._pending) >= self.flush_every:
+                now = time.time()
+                self._pending.append((self._step, now, logs))
+                if (
+                    len(self._pending) >= self.flush_every
+                    or now - self._last_flush >= self.flush_secs
+                ):
                     self._flush_pending()
 
     def on_epoch_end(self, epoch: int, logs=None):

@@ -27,7 +27,7 @@ import numpy as np
 import optax
 
 from horovod_tpu import runtime
-from horovod_tpu.data.loader import ArrayDataset
+from horovod_tpu.data.loader import ArrayDataset, training_pipeline
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.parallel import sharding as sharding_lib
 
@@ -355,6 +355,7 @@ class Trainer:
             verbose = 1 if runtime.is_primary() else 0
 
         world = runtime.process_count()
+        close_input = lambda: None  # noqa: E731
         if dataset is None:
             if x is None or y is None:
                 raise ValueError("pass either dataset= or x=/y=")
@@ -365,10 +366,12 @@ class Trainer:
             local_batch = batch_size * self.dp_size // world
             if steps_per_epoch is None:
                 steps_per_epoch = max(1, n_local // local_batch)
-            dataset = (
-                ds.repeat()
-                .shuffle(shuffle_buffer or n_local, seed=self.seed)
-                .batch(local_batch)
+            # Batch assembly runs in the native C++ producer thread when
+            # available (overlapping shuffle/gather with the device step),
+            # pure Python otherwise — same semantics either way.
+            dataset, close_input = training_pipeline(
+                ds.arrays, local_batch, seed=self.seed,
+                shuffle_buffer=shuffle_buffer,
             )
         elif steps_per_epoch is None:
             raise ValueError("steps_per_epoch is required with a dataset")
@@ -394,6 +397,21 @@ class Trainer:
             },
             self.mesh,
         )
+        try:
+            self._fit_epochs(
+                it, pending, zero_acc, epochs, steps_per_epoch, callbacks,
+                validation_data, batch_size, verbose,
+            )
+        finally:
+            close_input()
+        for cb in callbacks:
+            cb.on_train_end()
+        return self.history
+
+    def _fit_epochs(
+        self, it, pending, zero_acc, epochs, steps_per_epoch, callbacks,
+        validation_data, batch_size, verbose,
+    ):
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -426,9 +444,6 @@ class Trainer:
             if verbose:
                 shown = {k: round(v, 4) for k, v in logs.items()}
                 print(f"Epoch {epoch + 1}/{epochs} - {shown}")
-        for cb in callbacks:
-            cb.on_train_end()
-        return self.history
 
     def evaluate(self, x, y, batch_size: int = 128, verbose: int = 0) -> dict:
         """Full-dataset eval on the mesh. Unlike the reference (every rank

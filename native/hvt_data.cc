@@ -80,8 +80,9 @@ struct Loader {
   std::vector<int> ready;   // filled slot ids, FIFO
   std::vector<char> free_;  // free_[slot] == 1 → producer may fill it
   std::mutex mu;
-  std::condition_variable cv_ready, cv_free;
+  std::condition_variable cv_ready, cv_free, cv_quiesce;
   std::atomic<bool> stop{false};
+  int consumers_in_next = 0;  // guarded by mu; destroy waits for 0
   std::thread producer;
   XorShift128Plus rng;
 
@@ -169,12 +170,23 @@ void* hvt_loader_create(const uint8_t** arr_ptrs, const int64_t* row_bytes,
 int hvt_loader_next(void* handle) {
   auto* L = static_cast<Loader*>(handle);
   std::unique_lock<std::mutex> lk(L->mu);
+  ++L->consumers_in_next;
   L->cv_ready.wait(lk, [&] {
     return L->stop.load(std::memory_order_relaxed) || !L->ready.empty();
   });
-  if (L->ready.empty()) return -1;
-  const int slot = L->ready.front();
-  L->ready.erase(L->ready.begin());
+  int slot = -1;
+  // Stop wins even if batches are queued: a destroy() in flight is about to
+  // free the slot buffers this id would point into.
+  if (!L->stop.load(std::memory_order_relaxed) && !L->ready.empty()) {
+    slot = L->ready.front();
+    L->ready.erase(L->ready.begin());
+  }
+  --L->consumers_in_next;
+  if (L->consumers_in_next == 0 && L->stop.load(std::memory_order_relaxed)) {
+    // Notify UNDER the mutex: destroy() cannot re-acquire it (and delete
+    // this object) until we return and release — no use-after-free window.
+    L->cv_quiesce.notify_all();
+  }
   return slot;
 }
 
@@ -194,10 +206,21 @@ void hvt_loader_release(void* handle, int slot) {
 
 void hvt_loader_destroy(void* handle) {
   auto* L = static_cast<Loader*>(handle);
-  L->stop.store(true);
+  {
+    // stop must flip under the mutex: a waiter that has checked its
+    // predicate but not yet blocked would otherwise miss the notify and
+    // sleep forever.
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop.store(true);
+  }
   L->cv_free.notify_all();
   L->cv_ready.notify_all();
   if (L->producer.joinable()) L->producer.join();
+  {
+    // Wait for any consumer blocked in next() to drain before freeing.
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_quiesce.wait(lk, [&] { return L->consumers_in_next == 0; });
+  }
   delete L;
 }
 

@@ -50,6 +50,37 @@ class TestForward:
             np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
         )
 
+    def test_fallback_unaligned_sublane(self):
+        """T < block clamps blocks to T; a non-sublane-aligned T (e.g. 100)
+        must fall back rather than hit the kernel with unaligned tiles."""
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(1, 100, 2, 64).astype(np.float32))
+        # After clamping, block_q = block_k = 100, which divides T but is
+        # not a multiple of the f32 sublane granule (8).
+        assert not supported(q.shape, 100, 100)
+        out = flash_attention(q, q, q, causal=True)
+        expected = dense_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+        # bf16 needs 16-sublane tiles: an 8-aligned block is f32-only.
+        assert supported((1, 104, 2, 64), 8, 8, dtype=jnp.float32)
+        assert not supported((1, 104, 2, 64), 8, 8, dtype=jnp.bfloat16)
+
+    def test_fallback_cross_attention(self):
+        """Tk != Tq must not reach the kernel (its grid is derived from q's
+        T and would index K/V blocks out of range)."""
+        rng = np.random.RandomState(6)
+        q = jnp.asarray(rng.randn(1, 64, 2, 64).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 128, 2, 64).astype(np.float32))
+        v = k
+        assert not supported(q.shape, 32, 32, k_shape=k.shape)
+        out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
+        expected = dense_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
 
 class TestBackward:
     def test_grads_match_dense(self):
