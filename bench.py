@@ -13,8 +13,18 @@ TF2/Keras single-process run on this machine's CPU
 ``benchmarks/measure_reference_baseline.py`` — the reference publishes no
 numbers of its own, SURVEY.md §6).
 
-``BENCH_MODEL=resnet`` switches to the heavier-gradients config
-(BASELINE.json config 4: CIFAR-10 ResNet-20); default is the MNIST headline.
+Every run also reports the denominator "match or beat" needs: FLOPs/step from
+XLA's cost model on the compiled step, MFU against the chip's peak, and a
+step-time breakdown (compute = device-resident batches; input = host slice +
+transfer on top of it).
+
+Modes (BENCH_MODEL):
+  mnist       (default) reference CNN, per-chip batch 128 bf16
+  resnet      CIFAR-10 ResNet-20 — heavier gradients (BASELINE.json config 4)
+  transformer decoder LM (d512 x 8L, seq 1024, flash attention) — tokens/sec
+  input       host input pipeline A/B: native C++ batch assembly vs Python
+
+HVT_PROFILE=<dir> captures a jax.profiler trace of the measured loop.
 """
 
 from __future__ import annotations
@@ -29,85 +39,196 @@ MEASURE_STEPS = 400
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def main() -> None:
+def _measure(fn, steps, sync):
+    t0 = time.perf_counter()
+    out = None
+    for i in range(steps):
+        out = fn(i)
+    sync(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_train(which: str) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
     import horovod_tpu as hvt
+    from horovod_tpu import trace
     from horovod_tpu.data import datasets
-    from horovod_tpu.models.cnn import MnistCNN
-    from horovod_tpu.models.resnet import ResNetCIFAR
 
     hvt.init()
     n_chips = jax.device_count()
-    which = os.environ.get("BENCH_MODEL", "mnist")
 
     if which == "resnet":
+        from horovod_tpu.models.resnet import ResNetCIFAR
+
         (x_train, y_train), _ = datasets.cifar10()
         x = x_train.astype(np.float32) / 255.0
+        y = y_train.astype(np.int64)
         module = ResNetCIFAR(depth=20, compute_dtype=jnp.bfloat16)
         metric = "cifar10_resnet20_train_images_per_sec_per_chip"
+        per_chip_batch, unit_per_step = BATCH, BATCH * n_chips
+        lr = optax.adam(hvt.scale_lr(1e-3))
+        loss = "sparse_categorical_crossentropy"
+        unit = "images/sec/chip"
+    elif which == "transformer":
+        from horovod_tpu.models.transformer import TransformerLM
+
+        seq_len = int(os.environ.get("BENCH_SEQ_LEN", 1024))
+        per_chip_batch = int(os.environ.get("BENCH_LM_BATCH", 8))
+        x_np, y_np = datasets.copy_task(4096, seq_len, vocab_size=8192)
+        x, y = x_np, y_np
+        module = TransformerLM(
+            vocab_size=8192, d_model=512, n_heads=8, n_layers=8,
+            compute_dtype=jnp.bfloat16,
+        )
+        metric = "transformer_lm_train_tokens_per_sec_per_chip"
+        # copy_task returns [n, seq_len] next-token pairs: every position is
+        # a trained label.
+        unit_per_step = per_chip_batch * n_chips * seq_len
+        lr = optax.adamw(hvt.scale_lr(3e-4))
+        loss = "sparse_categorical_crossentropy"
+        unit = "tokens/sec/chip"
     else:
+        from horovod_tpu.models.cnn import MnistCNN
+
         (x_train, y_train), _ = datasets.mnist()
         x = (x_train.astype(np.float32) / 255.0)[..., None]
+        y = y_train.astype(np.int64)
         module = MnistCNN(compute_dtype=jnp.bfloat16)
         metric = "mnist_train_images_per_sec_per_chip"
-    y = y_train.astype(np.int64)
+        per_chip_batch, unit_per_step = BATCH, BATCH * n_chips
+        lr = optax.adam(hvt.scale_lr(1e-3))
+        loss = "sparse_categorical_crossentropy"
+        unit = "images/sec/chip"
 
-    trainer = hvt.Trainer(
-        module,
-        hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(1e-3, n_chips))),
-        loss="sparse_categorical_crossentropy",
-    )
+    trainer = hvt.Trainer(module, hvt.DistributedOptimizer(lr), loss=loss)
 
-    global_batch = BATCH * n_chips
+    global_batch = per_chip_batch * n_chips
     rng = np.random.RandomState(0)
-    n_prebatched = 64  # cycle through pre-sliced host batches
-    batches = []
+    n_prebatched = 32  # cycle through pre-sliced host batches
+    host_batches = []
     for _ in range(n_prebatched):
         idx = rng.randint(0, len(x), size=global_batch)
-        batches.append((x[idx], y[idx]))
+        host_batches.append((x[idx], y[idx]))
 
-    state = trainer.build(batches[0][0])
+    state = trainer.build(host_batches[0][0])
     state = hvt.broadcast_parameters(state, mesh=trainer.mesh)
     scale = np.float32(1.0)
-    acc = {"loss": np.float32(0), "accuracy": np.float32(0)}
+    zero_acc = {"loss": np.float32(0), "accuracy": np.float32(0)}
 
-    for i in range(WARMUP_STEPS):
-        state, metrics, acc = trainer._train_step(
-            state, trainer._shard(batches[i % n_prebatched]), scale, acc
+    # FLOPs of ONE compiled step (fwd + bwd + allreduce + optimizer), from
+    # XLA's cost model — the MFU numerator. The AOT-compiled object is also
+    # what the loops execute, so the step compiles exactly once.
+    dev_batches = [trainer._shard(b) for b in host_batches]
+    compiled_step = trainer._train_step.lower(
+        state, dev_batches[0], scale, zero_acc
+    ).compile()
+    flops = trace.compiled_cost_flops(compiled_step)
+
+    holder = {"state": state, "acc": zero_acc}
+
+    def step_device(i):
+        holder["state"], m, holder["acc"] = compiled_step(
+            holder["state"], dev_batches[i % n_prebatched], scale, holder["acc"]
         )
-    jax.block_until_ready(state)
+        return m["loss"]
 
-    t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        state, metrics, acc = trainer._train_step(
-            state, trainer._shard(batches[i % n_prebatched]), scale, acc
+    def step_e2e(i):
+        holder["state"], m, holder["acc"] = compiled_step(
+            holder["state"], trainer._shard(host_batches[i % n_prebatched]),
+            scale, holder["acc"],
         )
-    jax.block_until_ready(state)
-    elapsed = time.perf_counter() - t0
+        return m["loss"]
 
-    images_per_sec_per_chip = MEASURE_STEPS * global_batch / elapsed / n_chips
+    sync = jax.block_until_ready
+    _measure(step_device, WARMUP_STEPS, sync)  # compile + warm
+    with trace.maybe_trace(trace.profile_dir()):
+        compute_s = _measure(step_device, MEASURE_STEPS, sync)
+    e2e_s = _measure(step_e2e, MEASURE_STEPS, sync)
 
-    baseline_path = os.path.join(REPO, "benchmarks", "baseline_measured.json")
-    vs_baseline = None
-    if which == "mnist" and os.path.exists(baseline_path):
-        with open(baseline_path) as f:
-            baseline = json.load(f)
-        vs_baseline = round(images_per_sec_per_chip / baseline["images_per_sec"], 2)
+    per_sec_per_chip = unit_per_step / e2e_s / n_chips
+    return {
+        "metric": metric,
+        "value": round(per_sec_per_chip, 1),
+        "unit": unit,
+        "flops_per_step": flops,
+        "mfu": round(trace.mfu(flops, compute_s, n_chips), 4)
+        if trace.mfu(flops, compute_s, n_chips) is not None
+        else None,
+        "step_ms": {
+            "total": round(e2e_s * 1e3, 3),
+            "compute": round(compute_s * 1e3, 3),
+            "input": round((e2e_s - compute_s) * 1e3, 3),
+        },
+        "n_chips": n_chips,
+    }
 
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(images_per_sec_per_chip, 1),
-                "unit": "images/sec/chip",
-                "vs_baseline": vs_baseline,
-            }
-        )
-    )
+
+def bench_input() -> dict:
+    """Host input-pipeline A/B: native C++ batch assembly vs pure Python.
+
+    Times `training_pipeline` (shuffle + gather + stage) alone — the part the
+    native engine (native/hvt_data.cc) owns; no device work."""
+    import numpy as np
+
+    from horovod_tpu.data import datasets, native_loader
+    from horovod_tpu.data.loader import training_pipeline
+
+    (x_train, y_train), _ = datasets.mnist()
+    x = (x_train.astype(np.float32) / 255.0)[..., None]
+    arrays = (x, y_train.astype(np.int64))
+    steps = 400
+
+    def run(no_native: bool) -> float:
+        if no_native:
+            os.environ["HVT_NO_NATIVE"] = "1"
+        else:
+            os.environ.pop("HVT_NO_NATIVE", None)
+        it, close = training_pipeline(arrays, BATCH, seed=0)
+        try:
+            for _ in range(50):  # warm the producer
+                next(it)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                next(it)
+            return steps * BATCH / (time.perf_counter() - t0)
+        finally:
+            close()
+
+    python_ips = run(no_native=True)
+    # Without the native engine (no toolchain to build it), the "native" leg
+    # would silently rerun Python and publish "no speedup" — label it.
+    native = native_loader.available()
+    native_ips = run(no_native=False) if native else python_ips
+    return {
+        "metric": "input_pipeline_images_per_sec",
+        "value": round(native_ips, 1),
+        "unit": "images/sec",
+        "native": native,
+        "python_images_per_sec": round(python_ips, 1),
+        "vs_baseline": round(native_ips / python_ips, 2) if native else None,
+    }
+
+
+def main() -> None:
+    which = os.environ.get("BENCH_MODEL", "mnist")
+    if which == "input":
+        result = bench_input()
+    else:
+        result = bench_train(which)
+        vs = None
+        if which == "mnist":
+            baseline_path = os.path.join(
+                REPO, "benchmarks", "baseline_measured.json"
+            )
+            if os.path.exists(baseline_path):
+                with open(baseline_path) as f:
+                    vs = round(result["value"] / json.load(f)["images_per_sec"], 2)
+        result["vs_baseline"] = vs
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

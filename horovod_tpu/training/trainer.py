@@ -398,10 +398,16 @@ class Trainer:
             self.mesh,
         )
         try:
-            self._fit_epochs(
-                it, pending, zero_acc, epochs, steps_per_epoch, callbacks,
-                validation_data, batch_size, verbose,
-            )
+            # HVT_PROFILE=<dir> captures a jax.profiler trace of the training
+            # loop (XLA op + ICI collective timing) — the Horovod-Timeline
+            # env-var contract, primary-process-gated (trace.py).
+            from horovod_tpu import trace as trace_lib
+
+            with trace_lib.maybe_trace(trace_lib.profile_dir()):
+                self._fit_epochs(
+                    it, pending, zero_acc, epochs, steps_per_epoch, callbacks,
+                    validation_data, batch_size, verbose,
+                )
         finally:
             close_input()
         for cb in callbacks:

@@ -30,6 +30,9 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end tests")
+    config.addinivalue_line(
+        "markers", "ci_job: full CI-gated convergence runs (several minutes)"
+    )
 
 
 @pytest.fixture(scope="session")
