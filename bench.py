@@ -34,18 +34,26 @@ import os
 import time
 
 BATCH = 128
-WARMUP_STEPS = 20
-MEASURE_STEPS = 400
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def _measure(fn, steps, sync):
+def _timed(fn):
+    """Wall time of `fn` with HONEST completion: `fn` must return a device
+    scalar, which is fetched to the host before the clock stops.
+
+    On a networked/tunneled TPU runtime, `block_until_ready` on a chain of
+    per-step dispatches can return before the device actually finished (the
+    ready signal races the tunnel), inflating throughput by orders of
+    magnitude — measured here: a dispatch-loop "peak" of 7,000+ TFLOP/s on a
+    197 TFLOP/s chip. Fetching a value that data-depends on the whole chain
+    cannot lie. Benchmarks therefore time ONE fused scan over many steps
+    (plus this fetch), never a Python loop of step dispatches."""
+    import jax
+
     t0 = time.perf_counter()
-    out = None
-    for i in range(steps):
-        out = fn(i)
-    sync(out)
-    return (time.perf_counter() - t0) / steps
+    out = fn()
+    float(jax.device_get(out))
+    return time.perf_counter() - t0
 
 
 def bench_train(which: str) -> dict:
@@ -65,14 +73,17 @@ def bench_train(which: str) -> dict:
         from horovod_tpu.models.resnet import ResNetCIFAR
 
         (x_train, y_train), _ = datasets.cifar10()
-        x = x_train.astype(np.float32) / 255.0
-        y = y_train.astype(np.int64)
+        # Raw uint8 to the device; the model normalizes on-chip (4x less
+        # host->device traffic than pre-normalized float32).
+        x = x_train
+        y = y_train.astype(np.int32)
         module = ResNetCIFAR(depth=20, compute_dtype=jnp.bfloat16)
         metric = "cifar10_resnet20_train_images_per_sec_per_chip"
         per_chip_batch, unit_per_step = BATCH, BATCH * n_chips
         lr = optax.adam(hvt.scale_lr(1e-3))
         loss = "sparse_categorical_crossentropy"
         unit = "images/sec/chip"
+        default_steps = 256
     elif which == "transformer":
         from horovod_tpu.models.transformer import TransformerLM
 
@@ -91,63 +102,90 @@ def bench_train(which: str) -> dict:
         lr = optax.adamw(hvt.scale_lr(3e-4))
         loss = "sparse_categorical_crossentropy"
         unit = "tokens/sec/chip"
+        default_steps = 48
     else:
         from horovod_tpu.models.cnn import MnistCNN
 
         (x_train, y_train), _ = datasets.mnist()
-        x = (x_train.astype(np.float32) / 255.0)[..., None]
-        y = y_train.astype(np.int64)
+        x = x_train[..., None]  # uint8; on-device normalize (see resnet note)
+        y = y_train.astype(np.int32)
         module = MnistCNN(compute_dtype=jnp.bfloat16)
         metric = "mnist_train_images_per_sec_per_chip"
         per_chip_batch, unit_per_step = BATCH, BATCH * n_chips
         lr = optax.adam(hvt.scale_lr(1e-3))
         loss = "sparse_categorical_crossentropy"
         unit = "images/sec/chip"
+        default_steps = 1024
 
     trainer = hvt.Trainer(module, hvt.DistributedOptimizer(lr), loss=loss)
 
+    n_steps = int(os.environ.get("BENCH_STEPS", default_steps))
     global_batch = per_chip_batch * n_chips
     rng = np.random.RandomState(0)
-    n_prebatched = 32  # cycle through pre-sliced host batches
-    host_batches = []
-    for _ in range(n_prebatched):
-        idx = rng.randint(0, len(x), size=global_batch)
-        host_batches.append((x[idx], y[idx]))
 
-    state = trainer.build(host_batches[0][0])
+    def draw():
+        idx = rng.randint(0, len(x), size=global_batch)
+        return x[idx], y[idx]
+
+    sample = draw()
+    state = trainer.build(sample[0])
     state = hvt.broadcast_parameters(state, mesh=trainer.mesh)
     scale = np.float32(1.0)
     zero_acc = {"loss": np.float32(0), "accuracy": np.float32(0)}
 
-    # FLOPs of ONE compiled step (fwd + bwd + allreduce + optimizer), from
-    # XLA's cost model — the MFU numerator. The AOT-compiled object is also
-    # what the loops execute, so the step compiles exactly once.
-    dev_batches = [trainer._shard(b) for b in host_batches]
-    compiled_step = trainer._train_step.lower(
-        state, dev_batches[0], scale, zero_acc
+    # --- compute time: ONE fused scan over n_steps (see _timed's note on why
+    # a Python loop of dispatches cannot be trusted on tunneled runtimes) ---
+    steps = [draw() for _ in range(n_steps)]
+    mega = tuple(np.stack([s[i] for s in steps]) for i in range(2))
+    dev_mega = trainer._shard_chunk(mega)
+    compiled_mega = trainer._train_chunk.lower(
+        state, dev_mega, scale, zero_acc
     ).compile()
-    flops = trace.compiled_cost_flops(compiled_step)
+    # warm (compile already done; first run settles the runtime)
+    w_state, _, w_acc = compiled_mega(state, dev_mega, scale, zero_acc)
+    float(jax.device_get(w_acc["loss"]))
 
-    holder = {"state": state, "acc": zero_acc}
+    # The step donates its input state: always pass the PREVIOUS call's
+    # returned state, never a saved one (its buffers are consumed).
+    holder = {"state": w_state}
 
-    def step_device(i):
-        holder["state"], m, holder["acc"] = compiled_step(
-            holder["state"], dev_batches[i % n_prebatched], scale, holder["acc"]
+    def run_mega():
+        holder["state"], m, acc = compiled_mega(
+            holder["state"], dev_mega, scale, zero_acc
         )
-        return m["loss"]
+        return acc["loss"]
 
-    def step_e2e(i):
-        holder["state"], m, holder["acc"] = compiled_step(
-            holder["state"], trainer._shard(host_batches[i % n_prebatched]),
-            scale, holder["acc"],
-        )
-        return m["loss"]
-
-    sync = jax.block_until_ready
-    _measure(step_device, WARMUP_STEPS, sync)  # compile + warm
     with trace.maybe_trace(trace.profile_dir()):
-        compute_s = _measure(step_device, MEASURE_STEPS, sync)
-    e2e_s = _measure(step_e2e, MEASURE_STEPS, sync)
+        compute_s = _timed(run_mega) / n_steps
+
+    # FLOPs of one training step (fwd + bwd + allreduce + optimizer) from
+    # XLA's cost model — scan bodies are counted once, so the single-step
+    # compile gives the honest per-step count.
+    flops = trace.compiled_flops(
+        trainer._train_step, w_state, trainer._shard(sample), scale, zero_acc
+    )
+
+    # --- end-to-end: training WITH its input pipeline — the device-resident
+    # dataset path (`Trainer.fit(cache='device')`): dataset staged into HBM
+    # once, then shuffle + gather + train run inside one compiled epoch.
+    # e2e - compute = the on-device input pipeline's cost. -------------------
+    data, per_shard = trainer._stage_device_dataset(x[: len(y)], y)
+    epoch_steps = min(n_steps, per_shard // per_chip_batch)
+    seed = jax.random.PRNGKey(7)
+    compiled_epoch = trainer._train_epoch.lower(
+        w_state, data, seed, scale, zero_acc, epoch_steps, per_chip_batch
+    ).compile()
+
+    def run_e2e():
+        holder["state"], m, acc = compiled_epoch(
+            holder["state"], data, seed, scale, zero_acc
+        )
+        return acc["loss"]
+
+    # Warm WITH a fetch: un-fetched async work from the warm pass would still
+    # be executing when the timed pass starts (same tunnel hazard as _timed).
+    float(jax.device_get(run_e2e()))
+    e2e_s = _timed(run_e2e) / epoch_steps
 
     per_sec_per_chip = unit_per_step / e2e_s / n_chips
     return {
@@ -155,9 +193,7 @@ def bench_train(which: str) -> dict:
         "value": round(per_sec_per_chip, 1),
         "unit": unit,
         "flops_per_step": flops,
-        "mfu": round(trace.mfu(flops, compute_s, n_chips), 4)
-        if trace.mfu(flops, compute_s, n_chips) is not None
-        else None,
+        "mfu": round(m, 4) if (m := trace.mfu(flops, compute_s, n_chips)) is not None else None,
         "step_ms": {
             "total": round(e2e_s * 1e3, 3),
             "compute": round(compute_s * 1e3, 3),
@@ -182,6 +218,11 @@ def bench_input() -> dict:
     arrays = (x, y_train.astype(np.int64))
     steps = 400
 
+    # Decide native availability BEFORE touching HVT_NO_NATIVE: probing under
+    # the env var would permanently latch the loader's load-failed flag and
+    # the native leg could never run.
+    native = native_loader.available()
+
     def run(no_native: bool) -> float:
         if no_native:
             os.environ["HVT_NO_NATIVE"] = "1"
@@ -201,7 +242,6 @@ def bench_input() -> dict:
     python_ips = run(no_native=True)
     # Without the native engine (no toolchain to build it), the "native" leg
     # would silently rerun Python and publish "no speedup" — label it.
-    native = native_loader.available()
     native_ips = run(no_native=False) if native else python_ips
     return {
         "metric": "input_pipeline_images_per_sec",

@@ -57,6 +57,28 @@ def shard_batch(batch: PyTree, mesh: Mesh) -> PyTree:
     return jax.tree.map(put, batch)
 
 
+def chunk_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding for a [K, batch, ...] stack of K batches (steps_per_execution):
+    the scan axis K is unsharded, the batch axis splits over data."""
+    return NamedSharding(
+        mesh, P(None, (DATA_AXIS, FSDP_AXIS), *([None] * max(0, ndim - 2)))
+    )
+
+
+def shard_chunk(chunk: PyTree, mesh: Mesh) -> PyTree:
+    """Place a [K, batch, ...] host stack onto the mesh (see chunk_sharding);
+    multi-process, each process contributes its local slice of every batch."""
+
+    def put(x):
+        x = np.asarray(x)
+        sharding = chunk_sharding(mesh, x.ndim)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(put, chunk)
+
+
 def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
     """Replicate a pytree across the mesh (params/opt state in pure DP)."""
     sharding = replicated(mesh)

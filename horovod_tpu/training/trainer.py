@@ -101,6 +101,7 @@ class Trainer:
         seed: int = 0,
         param_specs=None,
         batch_specs=None,
+        steps_per_execution: int = 1,
     ):
         self.module = module
         self.tx = optimizer
@@ -128,6 +129,12 @@ class Trainer:
         self.update_scale: float = 1.0
         self.stop_training = False
         self.history: list[dict] = []
+        # Keras's steps_per_execution: K > 1 compiles a lax.scan over K train
+        # steps into ONE executable, so dispatch + input-transfer overhead is
+        # paid once per K steps instead of per step. Semantics trade-off
+        # (identical to Keras): on_batch_end callbacks fire once per
+        # execution, with the last step's metrics.
+        self.steps_per_execution = max(1, int(steps_per_execution))
 
         def train_step(state: TrainState, batch, update_scale, metric_acc):
             x, y = batch
@@ -172,6 +179,64 @@ class Trainer:
             new_acc = jax.tree.map(jnp.add, metric_acc, metrics)
             return new_state, metrics, new_acc
 
+        def train_epoch(
+            state: TrainState, data, epoch_seed, update_scale, metric_acc,
+            steps: int, per_chip_batch: int,
+        ):
+            """One epoch over a DEVICE-RESIDENT dataset, fully on-device.
+
+            ``data`` leaves are [n_shards, per_shard_n, ...], example axis
+            sharded over the data axes — the dataset lives in HBM. Each epoch
+            draws a fresh per-shard permutation (sharded RNG is
+            shard-local under partitionable threefry) and scans ``steps``
+            train steps, gathering each chip's ``per_chip_batch`` examples
+            from its own shard — zero host↔device traffic inside the epoch.
+            Per-shard independent shuffles are the reference's own sampling
+            semantics (every rank shuffles independently,
+            tensorflow2_keras_mnist.py:37-41), with the improvement that
+            shards partition the data so an epoch sees each example once."""
+            first = jax.tree.leaves(data)[0]
+            n_shards, per_n = first.shape[0], first.shape[1]
+            u = jax.random.uniform(epoch_seed, (n_shards, per_n))
+            order = jnp.argsort(u, axis=1)  # row-wise → shard-local
+
+            def body(carry, t):
+                state, acc = carry
+                idx = jax.lax.dynamic_slice_in_dim(
+                    order, t * per_chip_batch, per_chip_batch, axis=1
+                )
+                # Per-shard gather (vmap over the shard axis keeps it local),
+                # then collapse [n_shards, b, ...] into the global batch.
+                batch = jax.tree.map(
+                    lambda a: jax.vmap(lambda rows, ii: rows[ii])(a, idx).reshape(
+                        (n_shards * per_chip_batch,) + a.shape[2:]
+                    ),
+                    data,
+                )
+                state, metrics, acc = train_step(state, batch, update_scale, acc)
+                return (state, acc), metrics
+
+            (state, metric_acc), metrics = jax.lax.scan(
+                body, (state, metric_acc), jnp.arange(steps)
+            )
+            last = jax.tree.map(lambda m: m[-1], metrics)
+            return state, last, metric_acc
+
+        def train_chunk(state: TrainState, batches, update_scale, metric_acc):
+            """K stacked batches ([K, ...] leaves) through K chained steps in
+            one compiled program (scan keeps the trace size constant)."""
+
+            def body(carry, batch):
+                state, acc = carry
+                state, metrics, acc = train_step(state, batch, update_scale, acc)
+                return (state, acc), metrics
+
+            (state, metric_acc), metrics = jax.lax.scan(
+                body, (state, metric_acc), batches
+            )
+            last = jax.tree.map(lambda m: m[-1], metrics)
+            return state, last, metric_acc
+
         def _eval_variables(state: TrainState):
             return {"params": state.params, **(state.model_state or {})}
 
@@ -200,6 +265,10 @@ class Trainer:
             return jax.nn.softmax(logits, axis=-1)
 
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._train_chunk = jax.jit(train_chunk, donate_argnums=(0,))
+        self._train_epoch = jax.jit(
+            train_epoch, static_argnums=(5, 6), donate_argnums=(0,)
+        )
         self._eval_step = jax.jit(eval_step)
         # Replicated output → fully addressable on every process, so
         # device_get works in multi-host runs too.
@@ -345,14 +414,32 @@ class Trainer:
         validation_data=None,
         shuffle_buffer: int | None = None,
         verbose: int | None = None,
+        cache: str | None = None,
     ) -> list[dict]:
         """Train. Either pass a batched ``ArrayDataset``/iterable of
         ``(x, y)`` numpy batches (the TF2 script's idiom,
         tensorflow2_keras_mnist.py:96) or raw ``x``/``y`` arrays with a
         per-worker ``batch_size`` (the TF1 script's idiom,
-        mnist_keras.py:107-112)."""
+        mnist_keras.py:107-112).
+
+        ``cache='device'`` (with ``x``/``y``) stages the whole dataset into
+        HBM once, sharded over the data axes, and runs shuffling + batching +
+        training fully on-device: ONE dispatch and ONE metrics fetch per
+        epoch, zero per-step host involvement. This is the TPU-native answer
+        to input-bound training (datasets at MNIST/CIFAR scale are trivially
+        HBM-resident); on_batch_end callbacks fire once per epoch with the
+        last step's metrics."""
         if verbose is None:
             verbose = 1 if runtime.is_primary() else 0
+        if cache == "device":
+            if x is None or y is None:
+                raise ValueError("cache='device' needs x=/y= arrays")
+            return self._fit_device_cached(
+                x, y, batch_size, epochs, steps_per_epoch, callbacks,
+                validation_data, verbose,
+            )
+        if cache is not None:
+            raise ValueError(f"unknown cache mode {cache!r}")
 
         world = runtime.process_count()
         close_input = lambda: None  # noqa: E731
@@ -414,42 +501,193 @@ class Trainer:
             cb.on_train_end()
         return self.history
 
+    def _stage_device_dataset(self, x, y):
+        """Stage (x, y) into HBM as [n_shards, per_shard_n, ...] leaves,
+        example-sharded over the data axes. Multi-process, each process
+        contributes the rows for its own chips."""
+        n_shards = self.dp_size
+        world = runtime.process_count()
+        n = (len(x) // n_shards) * n_shards
+        if n == 0:
+            raise ValueError(f"need at least {n_shards} examples")
+        per_shard = n // n_shards
+        local_shards = n_shards // world
+        r = runtime.process_rank()
+
+        def stage(arr):
+            arr = np.asarray(arr)[:n]
+            # Shard s takes rows [s*per_shard, (s+1)*per_shard); this process
+            # owns shards [r*local_shards, (r+1)*local_shards).
+            lo = r * local_shards * per_shard
+            hi = (r + 1) * local_shards * per_shard
+            local = arr[lo:hi].reshape(
+                (local_shards, per_shard) + arr.shape[1:]
+            )
+            spec = jax.sharding.PartitionSpec(
+                (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
+                *([None] * arr.ndim),
+            )
+            s = jax.sharding.NamedSharding(self.mesh, spec)
+            if world == 1:
+                return jax.device_put(local, s)
+            return jax.make_array_from_process_local_data(s, local)
+
+        return (stage(x), stage(y)), per_shard
+
+    def _fit_device_cached(
+        self, x, y, batch_size, epochs, steps_per_epoch, callbacks,
+        validation_data, verbose,
+    ):
+        from horovod_tpu import trace as trace_lib
+
+        data, per_shard = self._stage_device_dataset(x, y)
+        max_steps = per_shard // batch_size
+        if max_steps == 0:
+            raise ValueError(
+                f"per-shard examples ({per_shard}) < per-chip batch "
+                f"({batch_size})"
+            )
+        steps = min(steps_per_epoch or max_steps, max_steps)
+        self.build(np.asarray(x[: self.dp_size]))
+
+        for cb in callbacks:
+            cb.set_trainer(self)
+        for cb in callbacks:
+            cb.on_train_begin()
+        zero_acc = sharding_lib.replicate(
+            {
+                "loss": jnp.zeros((), jnp.float32),
+                "accuracy": jnp.zeros((), jnp.float32),
+            },
+            self.mesh,
+        )
+        epoch_key = jax.random.PRNGKey(self.seed + 1)
+        with trace_lib.maybe_trace(trace_lib.profile_dir()):
+            for epoch in range(epochs):
+                if self.stop_training:
+                    break
+                for cb in callbacks:
+                    cb.on_epoch_begin(epoch)
+                t0 = time.perf_counter()
+                scale = jnp.asarray(self.update_scale, jnp.float32)
+                self.state, metrics, metric_acc = self._train_epoch(
+                    self.state, data, jax.random.fold_in(epoch_key, epoch),
+                    scale, zero_acc, steps, batch_size,
+                )
+                for cb in callbacks:
+                    cb.on_batch_end(steps - 1, metrics)
+                self._finish_epoch(
+                    epoch, epochs, metric_acc, steps, t0, callbacks,
+                    validation_data, batch_size, verbose,
+                )
+        for cb in callbacks:
+            cb.on_train_end()
+        return self.history
+
+    def _finish_epoch(
+        self, epoch, epochs, metric_acc, steps, t0, callbacks,
+        validation_data, batch_size, verbose,
+    ):
+        """Epoch bookkeeping shared by every fit path: ONE host fetch of the
+        in-step metric sums, optional validation, callbacks, history."""
+        sums = jax.device_get(metric_acc)
+        logs = {k: float(v) / steps for k, v in sums.items()}
+        logs["epoch_time_s"] = time.perf_counter() - t0
+        if validation_data is not None:
+            val = self.evaluate(
+                validation_data[0], validation_data[1],
+                batch_size=batch_size, verbose=0,
+            )
+            logs.update({f"val_{k}": v for k, v in val.items()})
+        for cb in callbacks:
+            cb.on_epoch_end(epoch, logs)
+        self.history.append(logs)
+        if verbose:
+            shown = {k: round(v, 4) for k, v in logs.items()}
+            print(f"Epoch {epoch + 1}/{epochs} - {shown}")
+
+    def _shard_chunk(self, chunk):
+        """Place a [K, batch, ...] stack of K batches (steps_per_execution)
+        onto the mesh — the scan axis stays unsharded."""
+        if self.batch_specs is not None:
+            specs = tuple(self.batch_specs)
+
+            def put(x, spec):
+                x = np.asarray(x)
+                s = jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec(None, *tuple(spec))
+                )
+                if jax.process_count() == 1:
+                    return jax.device_put(x, s)
+                return jax.make_array_from_process_local_data(s, x)
+
+            return tuple(put(x, spec) for x, spec in zip(chunk, specs))
+        return sharding_lib.shard_chunk(chunk, self.mesh)
+
     def _fit_epochs(
         self, it, pending, zero_acc, epochs, steps_per_epoch, callbacks,
         validation_data, batch_size, verbose,
     ):
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            for cb in callbacks:
-                cb.on_epoch_begin(epoch)
-            t0 = time.perf_counter()
-            scale = jnp.asarray(self.update_scale, jnp.float32)
-            metric_acc = zero_acc
-            for step in range(steps_per_epoch):
-                batch = pending if pending is not None else next(it)
-                pending = None
-                self.state, metrics, metric_acc = self._train_step(
-                    self.state, self._shard(batch), scale, metric_acc
-                )
+        from horovod_tpu.data.prefetch import DevicePrefetcher
+
+        # Per-epoch execution plan: full steps_per_execution chunks plus one
+        # remainder chunk (a second, smaller executable) when K doesn't
+        # divide the epoch.
+        spe = min(self.steps_per_execution, steps_per_epoch)
+        plan = [spe] * (steps_per_epoch // spe)
+        if steps_per_epoch % spe:
+            plan.append(steps_per_epoch % spe)
+        buffered = [pending]
+
+        def host_chunks():
+            # Host-side assembly of the execution units: single batches when
+            # K == 1, [K, ...] stacks otherwise.
+            for _ in range(epochs):
+                for k in plan:
+                    batches = [
+                        buffered.pop() if buffered else next(it)
+                        for _ in range(k)
+                    ]
+                    if spe == 1:
+                        yield batches[0]
+                    else:
+                        yield tuple(
+                            np.stack([b[i] for b in batches])
+                            for i in range(len(batches[0]))
+                        )
+
+        # Batches are staged onto the devices by a background thread while
+        # the current step computes — transfer enqueue never blocks dispatch.
+        run = self._train_step if spe == 1 else self._train_chunk
+        prefetcher = DevicePrefetcher(
+            host_chunks(), self._shard if spe == 1 else self._shard_chunk
+        )
+        try:
+            for epoch in range(epochs):
+                if self.stop_training:
+                    break
                 for cb in callbacks:
-                    cb.on_batch_end(step, metrics)
-            # ONE host fetch per epoch (see train_step's accumulator note).
-            sums = jax.device_get(metric_acc)
-            logs = {k: float(v) / steps_per_epoch for k, v in sums.items()}
-            logs["epoch_time_s"] = time.perf_counter() - t0
-            if validation_data is not None:
-                val = self.evaluate(
-                    validation_data[0], validation_data[1],
-                    batch_size=batch_size, verbose=0,
+                    cb.on_epoch_begin(epoch)
+                t0 = time.perf_counter()
+                scale = jnp.asarray(self.update_scale, jnp.float32)
+                metric_acc = zero_acc
+                step = 0
+                for k in plan:
+                    chunk = next(prefetcher)
+                    self.state, metrics, metric_acc = run(
+                        self.state, chunk, scale, metric_acc
+                    )
+                    step += k
+                    # Once per execution, with the last step's metrics —
+                    # Keras's steps_per_execution callback semantics.
+                    for cb in callbacks:
+                        cb.on_batch_end(step - 1, metrics)
+                self._finish_epoch(
+                    epoch, epochs, metric_acc, steps_per_epoch, t0, callbacks,
+                    validation_data, batch_size, verbose,
                 )
-                logs.update({f"val_{k}": v for k, v in val.items()})
-            for cb in callbacks:
-                cb.on_epoch_end(epoch, logs)
-            self.history.append(logs)
-            if verbose:
-                shown = {k: round(v, 4) for k, v in logs.items()}
-                print(f"Epoch {epoch + 1}/{epochs} - {shown}")
+        finally:
+            prefetcher.close()
 
     def evaluate(self, x, y, batch_size: int = 128, verbose: int = 0) -> dict:
         """Full-dataset eval on the mesh. Unlike the reference (every rank

@@ -162,6 +162,67 @@ class TestMeshComposition:
         )
 
 
+class TestFSDP:
+    """fsdp > 1 exercised for real: parameters and optimizer mirrors sharded
+    over the fsdp axis, and the training math identical to pure DP — FSDP is
+    a memory layout, not a different algorithm."""
+
+    def test_params_and_opt_state_fsdp_sharded(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, fsdp=2, model=2))
+        trainer = _trainer(mesh)
+        x, _ = datasets.copy_task(8, 32, vocab_size=VOCAB)
+        state = trainer.build(x)
+
+        def fsdp_leaves(tree):
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            return [
+                path for path, leaf in flat
+                if hasattr(leaf, "sharding")
+                and any(
+                    "fsdp" in (ax if isinstance(ax, tuple) else (ax,))
+                    for ax in getattr(leaf.sharding, "spec", P())
+                    if ax is not None
+                )
+            ]
+
+        # Every >=2D kernel has an fsdp-shardable dim at these sizes: all
+        # transformer matmul weights (2 layers x 4 + lm_head + embed).
+        assert len(fsdp_leaves(state.params)) >= 4 * 2 + 1
+        # Optimizer mirrors (adam mu/nu) carry the same layout.
+        assert len(fsdp_leaves(state.opt_state)) >= 2 * (4 * 2 + 1)
+
+    def test_fsdp_matches_pure_dp_math(self):
+        """Same data, same seed: a data=2 x fsdp=2 x model=2 run must produce
+        the same parameters as data=8 pure DP."""
+
+        def run(mesh):
+            trainer = _trainer(mesh)
+            x, y = datasets.copy_task(256, 32, vocab_size=VOCAB, seed=4)
+            trainer.fit(
+                x=x, y=y, batch_size=4, epochs=1, steps_per_epoch=6,
+                shuffle_buffer=1, verbose=0,
+            )
+            leaves = jax.tree.leaves(jax.device_get(trainer.state.params))
+            return float(sum(np.abs(l).sum() for l in leaves))
+
+        d_fsdp = run(mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, fsdp=2, model=2)))
+        d_dp = run(mesh_lib.build_mesh(mesh_lib.MeshSpec(data=8)))
+        # Tolerance: different mesh layouts reduce in different orders, and
+        # 6 adam steps amplify that float noise (measured ~2e-4 rel); a real
+        # sharding bug (wrong gather/reduce) diverges by orders of magnitude.
+        assert d_fsdp == pytest.approx(d_dp, rel=1e-3)
+
+    def test_fsdp4_train_step(self):
+        """The example's HVT_MESH='data=2,fsdp=4' shape trains."""
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, fsdp=4))
+        trainer = _trainer(mesh)
+        x, y = datasets.copy_task(128, 32, vocab_size=VOCAB, seed=6)
+        history = trainer.fit(
+            x=x, y=y, batch_size=2, epochs=1, steps_per_epoch=4, verbose=0
+        )
+        assert np.isfinite(history[-1]["loss"])
+
+
 @pytest.mark.slow
 class TestLongRangeRecall:
     def test_copy_task_learned_through_ring(self):
