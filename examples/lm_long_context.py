@@ -18,7 +18,10 @@ Mesh shape via HVT_MESH, e.g.:
     HVT_MESH="data=2,seq=2,model=2" python examples/lm_long_context.py
 
 Knobs: DRIVE_STEPS, DRIVE_EPOCHS, SEQ_LEN, VOCAB, DMODEL, NLAYERS, ATTN
-(ring|ulysses).
+(ring|ulysses), MOE_EVERY (0=dense; k = MoE MLP every k-th block),
+N_EXPERTS. MoE composes with the mesh's ``expert`` axis, e.g.:
+
+    HVT_MESH="data=2,expert=4" MOE_EVERY=2 python examples/lm_long_context.py
 """
 
 import os
@@ -65,6 +68,8 @@ def main() -> None:
         n_layers=int(os.environ.get("NLAYERS", 4)),
         dropout=0.0,
         sharding=ShardingConfig(mesh=mesh, attn=attn),
+        moe_every=int(os.environ.get("MOE_EVERY", 0)),
+        n_experts=int(os.environ.get("N_EXPERTS", 8)),
     )
     batch_spec = P((mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS), mesh_lib.SEQ_AXIS)
     trainer = hvt.Trainer(

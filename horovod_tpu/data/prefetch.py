@@ -64,6 +64,9 @@ class DevicePrefetcher:
             self._q.put(self._DONE)
         except BaseException as e:  # noqa: BLE001 — delivered to consumer
             self._q.put(e)
+            # Then terminate the stream: a consumer that catches the error
+            # and calls next() again must get StopIteration, not a hang.
+            self._q.put(self._DONE)
 
     def __iter__(self):
         return self

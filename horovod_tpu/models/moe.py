@@ -1,0 +1,155 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert`` axis.
+
+The reference has no MoE (SURVEY.md §2.2: dense MLP head only,
+tensorflow2_keras_mnist.py:49-51); this fills the framework's reserved
+``expert`` mesh axis (parallel/mesh.py) with a first-class layer so EP is a
+capability, not a name.
+
+TPU-first design — the GShard/Switch dense-dispatch formulation
+(arXiv:2006.16668, 2101.03961; PAPERS.md), which is the shape XLA partitions
+well:
+
+* **Static capacity.** Each expert processes a fixed ``capacity`` of tokens
+  per batch; routing builds a one-hot dispatch tensor ``[G, E, C]`` and the
+  data movement is two einsums. No dynamic shapes, no host round trips —
+  everything stays inside the jitted step, scan/vmap-friendly.
+* **Sharding, not message passing.** Expert weights are ``[E, ...]`` with E
+  sharded over the ``expert`` axis; constraining the dispatched activations
+  to ``P('expert', ...)`` makes GSPMD insert the all-to-all over ICI.
+* **Router in float32** (bf16 softmax routing is unstable), top-k gating
+  with renormalization, Switch-style load-balancing auxiliary loss published
+  via ``self.sow('losses', ...)`` — the Trainer adds any sown 'losses'
+  collection entries to the objective.
+* **Overflow drops are safe by construction**: the transformer block adds
+  the MoE output to the residual stream, so a token past capacity
+  contributes zero instead of garbage.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel.mesh import EXPERT_AXIS
+
+
+class MoEMlp(nn.Module):
+    """Routed MLP: ``[B, T, d] -> [B, T, d]`` through E expert FFNs.
+
+    Args:
+      d_model: model width.
+      n_experts: number of experts E (shardable over the ``expert`` axis).
+      mlp_ratio: expert hidden width multiplier (reference-style 4x).
+      k: experts per token (top-k routing; 1 = Switch, 2 = GShard default).
+      capacity_factor: per-expert slots = ``k * G / E * capacity_factor``.
+      aux_loss_coef: weight of the load-balancing loss sown into 'losses'.
+      sharding: the model's ShardingConfig (constrains via its mesh if set).
+    """
+
+    d_model: int
+    n_experts: int = 8
+    mlp_ratio: int = 4
+    k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 1e-2
+    compute_dtype: jnp.dtype = jnp.float32
+    sharding: object = None
+
+    # Dispatch group size (GShard's group axis): routing/dispatch one-hots
+    # are [S, E, C] with C ∝ S, so grouping keeps dispatch cost LINEAR in
+    # token count — one flat group would make it quadratic (C would grow with
+    # the whole batch).
+    group_size: int = 1024
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        b, t, d = x.shape
+        e = self.n_experts
+        g = b * t
+        n_groups = self._n_groups(g)
+        s = g // n_groups  # tokens per dispatch group
+        tokens = x.reshape(n_groups, s, d)
+        capacity = max(1, int(self.k * s / e * self.capacity_factor))
+
+        # --- routing (float32) ---------------------------------------------
+        router = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, name="router"
+        )(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(router, axis=-1)  # [n, S, E]
+
+        top_probs, top_idx = jax.lax.top_k(probs, self.k)  # [n, S, k]
+        top_probs = top_probs / (top_probs.sum(-1, keepdims=True) + 1e-9)
+
+        # Switch load-balancing loss: E * sum_e fraction_routed_e * mean_prob_e
+        # (top-1 assignment fraction, the standard formulation), meaned over
+        # dispatch groups.
+        assign1 = jax.nn.one_hot(top_idx[..., 0], e)  # [n, S, E]
+        frac = assign1.mean(1)
+        aux = (e * jnp.sum(frac * probs.mean(1), axis=-1)).mean()
+        if train:
+            self.sow("losses", "moe_load_balance", self.aux_loss_coef * aux)
+
+        # --- dispatch plan: position of each (token, choice) in its expert --
+        # Per group: one-hot choices [k, S, E] flattened to [k*S, E]; cumsum
+        # down the token axis gives each routed token its slot in the
+        # expert's capacity buffer; slots >= capacity overflow and drop.
+        choice = jnp.moveaxis(
+            jax.nn.one_hot(top_idx, e), -2, 1
+        )  # [n, k, S, E]
+        flat_choice = choice.reshape(n_groups, self.k * s, e)
+        pos = jnp.cumsum(flat_choice, axis=1) * flat_choice - 1.0
+        pos = pos.reshape(n_groups, self.k, s, e)
+        in_cap = (pos >= 0) & (pos < capacity)
+        slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+
+        # combine[n, S, E, C]: gate mass of each token at its expert slot;
+        # dispatch is its 0/1 skeleton.
+        slot_oh = jax.nn.one_hot(slot, capacity) * in_cap[..., None]  # [n,k,S,E,C]
+        combine = jnp.einsum(
+            "nksec,nsk->nsec", slot_oh, top_probs.astype(jnp.float32)
+        )
+        dispatch = slot_oh.sum(1)  # [n, S, E, C] (choices are disjoint experts)
+
+        # --- expert computation, E sharded over the expert axis -------------
+        cd = self.compute_dtype
+        expert_in = jnp.einsum(
+            "nsec,nsd->necd", dispatch.astype(cd), tokens.astype(cd)
+        )  # [n, E, C, d]
+        expert_in = self._constrain(expert_in, P(None, EXPERT_AXIS, None, None))
+
+        hidden = self.mlp_ratio * d
+        w_up = self.param(
+            "moe_up",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, hidden),
+        )
+        w_down = self.param(
+            "moe_down",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, hidden, d),
+        )
+        h = jnp.einsum("necd,edh->nech", expert_in, w_up.astype(cd))
+        h = nn.gelu(h)
+        out = jnp.einsum("nech,ehd->necd", h, w_down.astype(cd))
+        out = self._constrain(out, P(None, EXPERT_AXIS, None, None))
+
+        # --- combine back to token order -----------------------------------
+        mixed = jnp.einsum("nsec,necd->nsd", combine.astype(cd), out)
+        return mixed.reshape(b, t, d).astype(x.dtype)
+
+    def _n_groups(self, g: int) -> int:
+        """Smallest divisor of ``g`` whose group stays within group_size."""
+        for n in range(1, g + 1):
+            if g % n == 0 and g // n <= self.group_size:
+                return n
+        return g
+
+    def _constrain(self, v, spec):
+        cfg = self.sharding
+        if cfg is None or getattr(cfg, "mesh", None) is None:
+            return v
+        return jax.lax.with_sharding_constraint(
+            v, jax.sharding.NamedSharding(cfg.mesh, spec)
+        )

@@ -33,7 +33,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.ops import attention as attention_ops
-from horovod_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+from horovod_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+)
 
 BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
 
@@ -77,6 +83,13 @@ class Block(nn.Module):
     dropout: float
     compute_dtype: jnp.dtype
     sharding: ShardingConfig
+    # MoE (expert-parallel) MLP instead of the dense one: the EP capability,
+    # routed over the mesh's `expert` axis (models/moe.py).
+    use_moe: bool = False
+    n_experts: int = 8
+    moe_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
 
     @nn.compact
     def __call__(self, x, positions, *, train: bool = False):
@@ -153,11 +166,25 @@ class Block(nn.Module):
         x = x + out
         x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
 
-        # --- MLP -----------------------------------------------------------
+        # --- MLP (dense, or expert-parallel MoE) ---------------------------
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
-        h = dense(features=4 * self.d_model, name="mlp_up")(h)  # column-parallel
-        h = nn.gelu(h)
-        h = dense(features=self.d_model, name="mlp_down")(h)  # row-parallel
+        if self.use_moe:
+            from horovod_tpu.models.moe import MoEMlp
+
+            h = MoEMlp(
+                self.d_model,
+                n_experts=self.n_experts,
+                k=self.moe_k,
+                capacity_factor=self.capacity_factor,
+                aux_loss_coef=self.moe_aux_coef,
+                compute_dtype=self.compute_dtype,
+                sharding=cfg,
+                name="moe",
+            )(h, train=train)
+        else:
+            h = dense(features=4 * self.d_model, name="mlp_up")(h)  # column-parallel
+            h = nn.gelu(h)
+            h = dense(features=self.d_model, name="mlp_down")(h)  # row-parallel
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         return cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
@@ -173,6 +200,13 @@ class TransformerLM(nn.Module):
     dropout: float = 0.1
     compute_dtype: jnp.dtype = jnp.float32
     sharding: ShardingConfig = ShardingConfig()
+    # moe_every=k replaces every k-th block's MLP with an expert-parallel
+    # MoE (0 = dense everywhere, the default).
+    moe_every: int = 0
+    n_experts: int = 8
+    moe_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
@@ -181,10 +215,15 @@ class TransformerLM(nn.Module):
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.compute_dtype)(tokens)
         x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
-        for _ in range(self.n_layers):
+        for i in range(self.n_layers):
             x = Block(
                 self.d_model, self.n_heads, self.dropout,
                 self.compute_dtype, cfg,
+                use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
+                n_experts=self.n_experts,
+                moe_k=self.moe_k,
+                capacity_factor=self.capacity_factor,
+                moe_aux_coef=self.moe_aux_coef,
             )(x, positions, train=train)
         x = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
         logits = nn.DenseGeneral(
@@ -221,15 +260,27 @@ def param_specs(params, mesh: Mesh) -> dict:
         "mlp_down": 0,   # [4·dm, dm]   — inputs (row-parallel)
         "lm_head": 1,    # [dm, vocab]  — vocab (column-parallel)
     }
+    # Expert weights: experts over the `expert` axis, hidden over `model`
+    # (column for up, row for down) — EP × TP composition.
+    moe_dims = {
+        "moe_up": {0: EXPERT_AXIS, 2: MODEL_AXIS},    # [E, dm, hidden]
+        "moe_down": {0: EXPERT_AXIS, 1: MODEL_AXIS},  # [E, hidden, dm]
+    }
 
     def rule(path, leaf):
         names = [
             p.key for p in path if isinstance(p, jax.tree_util.DictKey)
         ]
         spec: list = [None] * leaf.ndim
-        layer = next((n for n in names if n in tp_dim), None)
-        if layer is not None and leaf.ndim >= 2:
-            spec[tp_dim[layer]] = MODEL_AXIS
+        moe = next((n for n in names if n in moe_dims), None)
+        if moe is not None:
+            for dim, axis in moe_dims[moe].items():
+                if leaf.shape[dim] % mesh.shape[axis] == 0:
+                    spec[dim] = axis
+        else:
+            layer = next((n for n in names if n in tp_dim), None)
+            if layer is not None and leaf.ndim >= 2:
+                spec[tp_dim[layer]] = MODEL_AXIS
         if fsdp and leaf.ndim >= 2:
             for dim in range(leaf.ndim):
                 if spec[dim] is None and leaf.shape[dim] % mesh.shape[FSDP_AXIS] == 0:

@@ -37,24 +37,31 @@ def batch_sharding(mesh: Mesh, ndim: int = 0) -> NamedSharding:
     return NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS), *([None] * (ndim - 1))))
 
 
+def put_global(x, sharding: NamedSharding):
+    """Place one host array under a sharding, single- or multi-process.
+
+    Single-process: a plain sharded device_put. Multi-process: this process
+    contributes its local slice and `make_array_from_process_local_data`
+    assembles the global logical array. Every staging path in the framework
+    funnels through here so the multi-process placement contract lives in
+    one place."""
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, x)
+
+
 def shard_batch(batch: PyTree, mesh: Mesh) -> PyTree:
     """Place a host batch onto the mesh, split along the data axis.
 
-    Single-process: a plain sharded device_put. Multi-process: each process
-    contributes its local shard of the global batch
-    (`make_array_from_process_local_data` assembles the global logical array)
-    — this is the data-plane replacement for per-rank independent feeding
+    Multi-process, each process contributes its local shard of the global
+    batch — the data-plane replacement for per-rank independent feeding
     (the reference feeds each rank separately, tensorflow2_keras_mnist.py:41).
     """
-
-    def put(x):
-        x = np.asarray(x)
-        sharding = batch_sharding(mesh, x.ndim)
-        if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        return jax.make_array_from_process_local_data(sharding, x)
-
-    return jax.tree.map(put, batch)
+    return jax.tree.map(
+        lambda x: put_global(x, batch_sharding(mesh, np.asarray(x).ndim)),
+        batch,
+    )
 
 
 def chunk_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
@@ -68,15 +75,10 @@ def chunk_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
 def shard_chunk(chunk: PyTree, mesh: Mesh) -> PyTree:
     """Place a [K, batch, ...] host stack onto the mesh (see chunk_sharding);
     multi-process, each process contributes its local slice of every batch."""
-
-    def put(x):
-        x = np.asarray(x)
-        sharding = chunk_sharding(mesh, x.ndim)
-        if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        return jax.make_array_from_process_local_data(sharding, x)
-
-    return jax.tree.map(put, chunk)
+    return jax.tree.map(
+        lambda x: put_global(x, chunk_sharding(mesh, np.asarray(x).ndim)),
+        chunk,
+    )
 
 
 def replicate(tree: PyTree, mesh: Mesh) -> PyTree:

@@ -142,17 +142,23 @@ class Trainer:
 
             def loss_of(params):
                 variables = {"params": params, **(state.model_state or {})}
-                if self._mutable:
-                    logits, new_ms = self.module.apply(
-                        variables, x, train=True,
-                        rngs={"dropout": step_rng}, mutable=self._mutable,
-                    )
-                else:
-                    logits = self.module.apply(
-                        variables, x, train=True, rngs={"dropout": step_rng}
-                    )
-                    new_ms = state.model_state
-                loss = self.loss_fn(logits, y).mean()
+                # 'losses' is the auxiliary-objective channel: any value a
+                # module sows there during training (e.g. MoE load-balance
+                # loss, models/moe.py) is added to the objective. Requested
+                # as mutable unconditionally — it costs nothing when unused,
+                # and is never carried in model_state (sown per-apply).
+                logits, updated = self.module.apply(
+                    variables, x, train=True,
+                    rngs={"dropout": step_rng},
+                    mutable=self._mutable + ["losses"],
+                )
+                sown = updated.pop("losses", {})
+                aux = sum(
+                    (jnp.sum(v) for v in jax.tree.leaves(sown)),
+                    jnp.zeros((), jnp.float32),
+                )
+                new_ms = dict(updated) if updated else state.model_state
+                loss = self.loss_fn(logits, y).mean() + aux
                 return loss, (_accuracy(logits, y), new_ms)
 
             (loss, (acc, model_state)), grads = jax.value_and_grad(
@@ -370,11 +376,9 @@ class Trainer:
             specs = tuple(self.batch_specs)
 
             def put(x, spec):
-                x = np.asarray(x)
-                s = jax.sharding.NamedSharding(self.mesh, spec)
-                if jax.process_count() == 1:
-                    return jax.device_put(x, s)
-                return jax.make_array_from_process_local_data(s, x)
+                return sharding_lib.put_global(
+                    x, jax.sharding.NamedSharding(self.mesh, spec)
+                )
 
             if not isinstance(batch, (tuple, list)):
                 return put(batch, specs[0])  # predict: bare x
@@ -527,10 +531,9 @@ class Trainer:
                 (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
                 *([None] * arr.ndim),
             )
-            s = jax.sharding.NamedSharding(self.mesh, spec)
-            if world == 1:
-                return jax.device_put(local, s)
-            return jax.make_array_from_process_local_data(s, local)
+            return sharding_lib.put_global(
+                local, jax.sharding.NamedSharding(self.mesh, spec)
+            )
 
         return (stage(x), stage(y)), per_shard
 
@@ -613,13 +616,12 @@ class Trainer:
             specs = tuple(self.batch_specs)
 
             def put(x, spec):
-                x = np.asarray(x)
-                s = jax.sharding.NamedSharding(
-                    self.mesh, jax.sharding.PartitionSpec(None, *tuple(spec))
+                return sharding_lib.put_global(
+                    x,
+                    jax.sharding.NamedSharding(
+                        self.mesh, jax.sharding.PartitionSpec(None, *tuple(spec))
+                    ),
                 )
-                if jax.process_count() == 1:
-                    return jax.device_put(x, s)
-                return jax.make_array_from_process_local_data(s, x)
 
             return tuple(put(x, spec) for x, spec in zip(chunk, specs))
         return sharding_lib.shard_chunk(chunk, self.mesh)

@@ -1,0 +1,207 @@
+"""Mixture-of-Experts layer + expert parallelism over the `expert` mesh axis.
+
+Covers: routing correctness (tokens reach the expert the router picked),
+capacity overflow drops (zero contribution, not garbage), the load-balancing
+aux loss reaching the training objective through the Trainer's 'losses'
+channel, EP sharding of expert weights and optimizer mirrors, and a
+MoE transformer actually training on an expert-parallel mesh.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvt
+from horovod_tpu.data import datasets
+from horovod_tpu.models.moe import MoEMlp
+from horovod_tpu.models.transformer import (
+    ShardingConfig,
+    TransformerLM,
+    param_specs,
+)
+from horovod_tpu.parallel import mesh as mesh_lib
+
+VOCAB = 32
+
+
+def _init(module, x, train=False):
+    return module.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, x, train=train)
+
+
+class TestRouting:
+    def test_tokens_reach_their_expert(self):
+        """Force the router with a hand-built kernel: token feature i routes
+        to expert i; give each expert a constant-output transform and check
+        every token carries its own expert's constant."""
+        d, e = 4, 4
+        layer = MoEMlp(d, n_experts=e, k=1, capacity_factor=4.0, mlp_ratio=1)
+        x = jnp.eye(e).reshape(1, e, d)  # token i = one-hot(i) → expert i
+        variables = _init(layer, x)
+        params = jax.device_get(variables["params"])
+        # Router kernel = large identity → softmax puts ~all mass on expert i.
+        params["router"]["kernel"] = np.eye(d, e, dtype=np.float32) * 50.0
+        # Expert j: w_up zeros→gelu(0)=0 trick won't distinguish; instead use
+        # w_up so hidden = tokens @ w_up = row sums, and w_down scaled by
+        # (j+1): output magnitude identifies the expert.
+        params["moe_up"] = np.ones((e, d, d), np.float32)
+        params["moe_down"] = np.stack(
+            [np.eye(d, dtype=np.float32) * (j + 1) for j in range(e)]
+        )
+        out = layer.apply({"params": params}, x)
+        # Token i (one-hot) → hidden = gelu(1,1,1,1 row? token·w_up = ones) →
+        # out = gelu(1)·(i+1) per dim; ratio across tokens identifies expert.
+        base = float(out[0, 0, 0])
+        for i in range(e):
+            np.testing.assert_allclose(
+                np.asarray(out[0, i]), base * (i + 1), rtol=1e-5
+            )
+
+    def test_capacity_overflow_drops_to_zero(self):
+        """All tokens prefer expert 0 with capacity 1: exactly one token gets
+        through, the rest contribute zero (safe with a residual add)."""
+        d, e, n_tok = 4, 2, 8
+        layer = MoEMlp(d, n_experts=e, k=1, capacity_factor=1e-9, mlp_ratio=1)
+        x = jnp.ones((1, n_tok, d))
+        variables = _init(layer, x)
+        params = jax.device_get(variables["params"])
+        params["router"]["kernel"] = np.zeros((d, e), np.float32)
+        params["router"]["kernel"][:, 0] = 50.0  # everyone → expert 0
+        params["moe_up"] = np.ones((e, d, d), np.float32)
+        params["moe_down"] = np.ones((e, d, d), np.float32)
+        out = np.asarray(layer.apply({"params": params}, x))
+        nonzero = np.abs(out).sum(-1) > 1e-6  # [1, n_tok]
+        assert nonzero.sum() == 1  # capacity 1 → exactly one survivor
+
+    def test_grouped_dispatch_matches_single_group(self):
+        """Dispatch groups are a cost optimization, not a semantics change:
+        with ample capacity, 4 groups and 1 group compute the same output."""
+        d, e = 8, 4
+        x = jnp.asarray(np.random.RandomState(7).rand(2, 8, d), jnp.float32)
+        one = MoEMlp(d, n_experts=e, k=2, capacity_factor=8.0, group_size=16)
+        four = MoEMlp(d, n_experts=e, k=2, capacity_factor=8.0, group_size=4)
+        variables = _init(one, x)
+        np.testing.assert_allclose(
+            np.asarray(one.apply(variables, x)),
+            np.asarray(four.apply(variables, x)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_top2_gates_renormalized(self):
+        d, e = 8, 4
+        layer = MoEMlp(d, n_experts=e, k=2, capacity_factor=4.0)
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 6, d), jnp.float32)
+        variables = _init(layer, x)
+        out = layer.apply(variables, x)
+        assert out.shape == x.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestAuxLoss:
+    def test_sown_during_train_only(self):
+        d = 8
+        layer = MoEMlp(d, n_experts=4, k=1)
+        x = jnp.ones((1, 4, d))
+        variables = _init(layer, x)
+        _, state = layer.apply(
+            variables, x, train=True, mutable=["losses"],
+            rngs={"dropout": jax.random.PRNGKey(0)},
+        )
+        assert "moe_load_balance" in state["losses"]
+        aux = jax.tree.leaves(state["losses"])[0]
+        assert float(np.asarray(aux)) >= 0.0
+        _, state_eval = layer.apply(variables, x, train=False, mutable=["losses"])
+        assert not state_eval.get("losses", {})
+
+    def test_trainer_adds_aux_to_objective(self):
+        """The same model with aux_loss_coef 0 vs large must report different
+        training loss — proof the sown value reaches the objective."""
+
+        def run(coef):
+            model = TransformerLM(
+                vocab_size=VOCAB, d_model=32, n_heads=2, n_layers=2,
+                dropout=0.0, moe_every=2, n_experts=4, moe_aux_coef=coef,
+            )
+            trainer = hvt.Trainer(
+                model, hvt.DistributedOptimizer(optax.sgd(0.0))
+            )
+            x, y = datasets.copy_task(64, 16, vocab_size=VOCAB, seed=0)
+            hist = trainer.fit(
+                x=x, y=y, batch_size=2, epochs=1, steps_per_epoch=2,
+                shuffle_buffer=1, verbose=0,
+            )
+            return hist[0]["loss"]
+
+        assert run(100.0) > run(0.0) + 1.0
+
+
+class TestExpertParallel:
+    def _mesh(self):
+        return mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, expert=4))
+
+    def _trainer(self, mesh, **model_kw):
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, dropout=0.0,
+            moe_every=2, n_experts=4,
+            sharding=ShardingConfig(mesh=mesh, attn="dense"),
+            **model_kw,
+        )
+        return hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            mesh=mesh,
+            param_specs=param_specs,
+            batch_specs=(P(("data", "fsdp"), "seq"), P(("data", "fsdp"), "seq")),
+        )
+
+    def test_expert_weights_sharded_on_expert_axis(self):
+        trainer = self._trainer(self._mesh())
+        x, _ = datasets.copy_task(8, 16, vocab_size=VOCAB)
+        state = trainer.build(x)
+
+        def expert_sharded(tree):
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            return [
+                path for path, leaf in flat
+                if hasattr(leaf, "sharding")
+                and any(
+                    "expert" in (ax if isinstance(ax, tuple) else (ax,))
+                    for ax in getattr(leaf.sharding, "spec", P())
+                    if ax is not None
+                )
+            ]
+
+        # moe_up + moe_down in the one MoE block.
+        assert len(expert_sharded(state.params)) == 2
+        # Optimizer mirrors (mu, nu) inherit the layout.
+        assert len(expert_sharded(state.opt_state)) == 4
+
+    def test_moe_transformer_trains_on_ep_mesh(self):
+        trainer = self._trainer(self._mesh())
+        x, y = datasets.copy_task(256, 16, vocab_size=VOCAB, seed=1)
+        history = trainer.fit(
+            x=x, y=y, batch_size=8, epochs=2, steps_per_epoch=8, verbose=0
+        )
+        assert np.isfinite(history[-1]["loss"])
+        assert history[-1]["loss"] < history[0]["loss"]
+
+    def test_moe_matches_unsharded(self):
+        """EP-sharded MoE must compute the same function as the unsharded
+        layer (same params, same tokens)."""
+        mesh = self._mesh()
+        d, e = 16, 4
+        plain = MoEMlp(d, n_experts=e, k=2, capacity_factor=2.0)
+        sharded = MoEMlp(
+            d, n_experts=e, k=2, capacity_factor=2.0,
+            sharding=ShardingConfig(mesh=mesh),
+        )
+        x = jnp.asarray(np.random.RandomState(3).rand(2, 8, d), jnp.float32)
+        variables = _init(plain, x)
+        out_plain = plain.apply(variables, x)
+        out_sharded = jax.jit(lambda v, t: sharded.apply(v, t))(variables, x)
+        np.testing.assert_allclose(
+            np.asarray(out_plain), np.asarray(out_sharded), rtol=1e-4, atol=1e-5
+        )

@@ -163,6 +163,17 @@ class TestDevicePrefetcher:
         with pytest.raises(RuntimeError, match="boom"):
             next(pf)
 
+    def test_next_after_exception_stops_not_hangs(self):
+        def bad():
+            raise RuntimeError("dead")
+            yield  # pragma: no cover
+
+        pf = DevicePrefetcher(bad(), lambda v: v)
+        with pytest.raises(RuntimeError):
+            next(pf)
+        with pytest.raises(StopIteration):  # not a deadlock
+            next(pf)
+
     def test_close_unblocks_producer(self):
         def infinite():
             i = 0
