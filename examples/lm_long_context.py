@@ -22,6 +22,11 @@ Knobs: DRIVE_STEPS, DRIVE_EPOCHS, SEQ_LEN, VOCAB, DMODEL, NLAYERS, ATTN
 N_EXPERTS. MoE composes with the mesh's ``expert`` axis, e.g.:
 
     HVT_MESH="data=2,expert=4" MOE_EVERY=2 python examples/lm_long_context.py
+
+Pipeline parallelism: a ``pipe`` axis switches to the pipelined model
+(GPipe microbatch schedule, models/pipelined_lm.py):
+
+    HVT_MESH="data=2,pipe=4" N_MICRO=8 python examples/lm_long_context.py
 """
 
 import os
@@ -61,25 +66,50 @@ def main() -> None:
     vocab = int(os.environ.get("VOCAB", 64))
     attn = os.environ.get("ATTN", "ring")
 
-    model = TransformerLM(
-        vocab_size=vocab,
-        d_model=int(os.environ.get("DMODEL", 256)),
-        n_heads=8,
-        n_layers=int(os.environ.get("NLAYERS", 4)),
-        dropout=0.0,
-        sharding=ShardingConfig(mesh=mesh, attn=attn),
-        moe_every=int(os.environ.get("MOE_EVERY", 0)),
-        n_experts=int(os.environ.get("N_EXPERTS", 8)),
-    )
-    batch_spec = P((mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS), mesh_lib.SEQ_AXIS)
-    trainer = hvt.Trainer(
-        model,
-        hvt.DistributedOptimizer(optax.adam(3e-3)),
-        loss="sparse_categorical_crossentropy",
-        mesh=mesh,
-        param_specs=param_specs,
-        batch_specs=(batch_spec, batch_spec),
-    )
+    if mesh.shape.get(mesh_lib.PIPE_AXIS, 1) > 1:
+        # pipe > 1 switches to the pipeline-parallel model: per-layer
+        # parameter stacks sharded over `pipe`, GPipe microbatch schedule
+        # (models/pipelined_lm.py). Composes with `data`; use TransformerLM
+        # for seq/model/expert axes instead.
+        from horovod_tpu.models import pipelined_lm
+
+        model = pipelined_lm.PipelinedLM(
+            vocab_size=vocab,
+            d_model=int(os.environ.get("DMODEL", 256)),
+            n_heads=8,
+            n_layers=int(os.environ.get("NLAYERS", 4)),
+            n_micro=int(os.environ.get("N_MICRO", 4)),
+            mesh=mesh,
+        )
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=pipelined_lm.param_specs,
+        )
+    else:
+        model = TransformerLM(
+            vocab_size=vocab,
+            d_model=int(os.environ.get("DMODEL", 256)),
+            n_heads=8,
+            n_layers=int(os.environ.get("NLAYERS", 4)),
+            dropout=0.0,
+            sharding=ShardingConfig(mesh=mesh, attn=attn),
+            moe_every=int(os.environ.get("MOE_EVERY", 0)),
+            n_experts=int(os.environ.get("N_EXPERTS", 8)),
+        )
+        batch_spec = P(
+            (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS), mesh_lib.SEQ_AXIS
+        )
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=param_specs,
+            batch_specs=(batch_spec, batch_spec),
+        )
 
     x, y = datasets.copy_task(4096, seq_len, vocab_size=vocab, seed=0)
     epochs = int(os.environ.get("DRIVE_EPOCHS", 0)) or 4

@@ -47,26 +47,28 @@ class DevicePrefetcher:
         )
         self._thread.start()
 
+    def _enqueue(self, item) -> None:
+        # Blocking put with a timeout so close() can't strand the producer
+        # on a full queue nobody will ever drain.
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
     def _produce(self, host_iter, put):
         try:
             for item in host_iter:
                 if self._stop.is_set():
                     return
-                staged = put(item)
-                # Blocking put with a timeout so close() can't strand us on a
-                # full queue nobody will ever drain.
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-            self._q.put(self._DONE)
+                self._enqueue(put(item))
+            self._enqueue(self._DONE)
         except BaseException as e:  # noqa: BLE001 — delivered to consumer
-            self._q.put(e)
+            self._enqueue(e)
             # Then terminate the stream: a consumer that catches the error
             # and calls next() again must get StopIteration, not a hang.
-            self._q.put(self._DONE)
+            self._enqueue(self._DONE)
 
     def __iter__(self):
         return self

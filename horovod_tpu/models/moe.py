@@ -67,6 +67,14 @@ class MoEMlp(nn.Module):
     def __call__(self, x, *, train: bool = False):
         b, t, d = x.shape
         e = self.n_experts
+        mesh = getattr(self.sharding, "mesh", None) if self.sharding else None
+        if mesh is not None:
+            ep = mesh.shape.get(EXPERT_AXIS, 1)
+            if e % ep != 0:
+                raise ValueError(
+                    f"n_experts ({e}) must be divisible by the expert mesh "
+                    f"axis ({ep})"
+                )
         g = b * t
         n_groups = self._n_groups(g)
         s = g // n_groups  # tokens per dispatch group
@@ -80,7 +88,11 @@ class MoEMlp(nn.Module):
         probs = jax.nn.softmax(router, axis=-1)  # [n, S, E]
 
         top_probs, top_idx = jax.lax.top_k(probs, self.k)  # [n, S, k]
-        top_probs = top_probs / (top_probs.sum(-1, keepdims=True) + 1e-9)
+        if self.k > 1:
+            # GShard-style renormalization over the chosen experts. NOT for
+            # k=1: p/p == 1 would make the gate constant and cut the router
+            # off from the task loss — Switch gating uses the raw prob.
+            top_probs = top_probs / (top_probs.sum(-1, keepdims=True) + 1e-9)
 
         # Switch load-balancing loss: E * sum_e fraction_routed_e * mean_prob_e
         # (top-1 assignment fraction, the standard formulation), meaned over

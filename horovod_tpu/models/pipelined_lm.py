@@ -1,0 +1,176 @@
+"""Decoder-only LM partitioned into pipeline stages over the ``pipe`` axis.
+
+Companion to `parallel/pipeline.py` (see its module docstring for the
+design): this model keeps every transformer-block parameter as a
+``[n_layers, ...]`` stack. Sharding dim 0 over ``pipe`` gives each pipe
+device a contiguous block of layers — its stage — and the GPipe schedule
+runs as one `shard_map`'d scan with `ppermute` handoffs. Embedding, final
+LayerNorm and the LM head stay replicated over ``pipe`` (they run on the
+broadcast pipeline output).
+
+The block math matches `transformer.Block` (pre-LN, RoPE, GELU MLP at 4x)
+but is written functionally over explicit parameter stacks: flax modules
+trace parameter creation structurally, which fights the stage-sliced manual
+region; plain `self.param` stacks are transparent to shard_map, to the
+optimizer, and to checkpointing.
+
+Composes with data parallelism (batch axes sharded by GSPMD outside the
+manual pipe region). TP/SP inside a stage is out of scope for this model —
+use `TransformerLM` when you want model/seq axes instead of pipe.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.models.transformer import _rope
+from horovod_tpu.ops.attention import dense_attention
+from horovod_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, PIPE_AXIS
+from horovod_tpu.parallel.pipeline import spmd_pipeline, stage_slice_size
+
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+def _layernorm(x, scale, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+class PipelinedLM(nn.Module):
+    """Causal LM ``[B, T] -> [B, T, vocab]`` with pipeline-parallel blocks.
+
+    ``n_micro`` microbatches per step (bubble fraction shrinks as it grows);
+    the global batch must be divisible by ``n_micro × dp``.
+    """
+
+    vocab_size: int = 256
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    n_micro: int = 4
+    compute_dtype: jnp.dtype = jnp.float32
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        d, h = self.d_model, self.n_heads
+        hd = d // h
+        L = self.n_layers
+        lecun = nn.initializers.lecun_normal()
+        ones = nn.initializers.ones
+
+        blocks = {
+            "ln1": self.param("ln1", ones, (L, d)),
+            "qkv": self.param("qkv", lecun, (L, d, 3 * d)),
+            "attn_out": self.param("attn_out", lecun, (L, d, d)),
+            "ln2": self.param("ln2", ones, (L, d)),
+            "mlp_up": self.param("mlp_up", lecun, (L, d, 4 * d)),
+            "mlp_down": self.param("mlp_down", lecun, (L, 4 * d, d)),
+        }
+        embed = self.param(
+            "embed", nn.initializers.normal(1.0), (self.vocab_size, d)
+        )
+        ln_f = self.param("ln_f", ones, (d,))
+        lm_head = self.param("lm_head", lecun, (d, self.vocab_size))
+
+        b, t = tokens.shape
+        cd = self.compute_dtype
+        x = embed[tokens].astype(cd)  # [B, T, d]
+
+        if self.mesh is None or self.mesh.shape.get(PIPE_AXIS, 1) == 1:
+            # No pipe axis: run the stack sequentially (the n_stages=1
+            # degenerate schedule) — same math, no manual region needed.
+            def body(xc, p):
+                return self._block(xc, p), None
+
+            x, _ = lax.scan(body, x, blocks)
+        else:
+            for ax in ("seq", "model", "expert"):
+                if self.mesh.shape.get(ax, 1) != 1:
+                    raise ValueError(
+                        f"PipelinedLM composes with data/pipe axes only; "
+                        f"mesh has {ax}={self.mesh.shape[ax]}"
+                    )
+            n_stages = self.mesh.shape[PIPE_AXIS]
+            stage_slice_size(L, n_stages)  # validates divisibility
+            # Tiny batches (e.g. the Trainer's dp-sized init probe) can't
+            # fill the microbatch queue; degrade the schedule, not the user.
+            # Each microbatch must still cover the data axes (its batch dim
+            # is sharded over them inside the manual region).
+            dp = self.mesh.shape[DATA_AXIS] * self.mesh.shape[FSDP_AXIS]
+            n_micro = max(1, min(self.n_micro, b // dp))
+            if b % (n_micro * dp) != 0:
+                raise ValueError(
+                    f"batch ({b}) must divide into n_micro ({n_micro}) x "
+                    f"data axes ({dp})"
+                )
+            mb = b // n_micro
+            x_micro = x.reshape(n_micro, mb, t, d)
+
+            act_spec = P(None, BATCH_AXES, None, None)
+            param_specs = jax.tree.map(
+                lambda l: P(PIPE_AXIS, *([None] * (l.ndim - 1))), blocks
+            )
+
+            def run(stage_params, xm):
+                def stage(act):
+                    def body(a, p):
+                        return self._block(a, p), None
+
+                    a, _ = lax.scan(body, act, stage_params)
+                    return a
+
+                return spmd_pipeline(stage, xm)
+
+            x_micro = jax.shard_map(
+                run,
+                mesh=self.mesh,
+                in_specs=(param_specs, act_spec),
+                out_specs=act_spec,
+                check_vma=False,
+            )(blocks, x_micro)
+            x = x_micro.reshape(b, t, d)
+
+        x = _layernorm(x, ln_f)
+        logits = x.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+        return logits
+
+    def _block(self, x, p):
+        """One pre-LN transformer block over a single layer's params."""
+        mb, t, d = x.shape
+        h_heads, hd = self.n_heads, d // self.n_heads
+        cd = self.compute_dtype
+
+        hidden = _layernorm(x, p["ln1"])
+        qkv = hidden @ p["qkv"].astype(cd)  # [mb, T, 3d]
+        qkv = qkv.reshape(mb, t, h_heads, 3 * hd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
+        q, k = _rope(q, positions), _rope(k, positions)
+        att = dense_attention(q, k, v, causal=True)  # [mb, T, H, hd]
+        out = att.reshape(mb, t, d) @ p["attn_out"].astype(cd)
+        x = x + out
+
+        hidden = _layernorm(x, p["ln2"])
+        hidden = nn.gelu(hidden @ p["mlp_up"].astype(cd))
+        return x + hidden @ p["mlp_down"].astype(cd)
+
+
+def param_specs(params, mesh: Mesh) -> dict:
+    """PartitionSpec tree for the pipelined layout: per-layer stacks sharded
+    over ``pipe`` on dim 0, everything else replicated."""
+    stacked = {"ln1", "qkv", "attn_out", "ln2", "mlp_up", "mlp_down"}
+
+    def rule(path, leaf):
+        names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
+        if any(n in stacked for n in names):
+            return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, params)

@@ -275,8 +275,15 @@ def param_specs(params, mesh: Mesh) -> dict:
         moe = next((n for n in names if n in moe_dims), None)
         if moe is not None:
             for dim, axis in moe_dims[moe].items():
-                if leaf.shape[dim] % mesh.shape[axis] == 0:
-                    spec[dim] = axis
+                if leaf.shape[dim] % mesh.shape[axis] != 0:
+                    # Silent replication would quietly discard the memory
+                    # scaling EP exists for — fail like MeshSpec.resolve.
+                    raise ValueError(
+                        f"{moe} dim {dim} ({leaf.shape[dim]}) is not "
+                        f"divisible by mesh axis {axis!r} "
+                        f"({mesh.shape[axis]})"
+                    )
+                spec[dim] = axis
         else:
             layer = next((n for n in names if n in tp_dim), None)
             if layer is not None and leaf.ndim >= 2:

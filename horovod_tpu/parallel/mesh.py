@@ -26,12 +26,15 @@ import numpy as np
 from jax.sharding import Mesh
 
 # Canonical axis order, outermost (slowest, DCN-adjacent) first. Data/fsdp
-# outermost so cross-host traffic is the infrequent gradient reduction while
-# model/seq collectives (per-layer, per-step) stay on intra-host ICI.
-AXES = ("data", "fsdp", "seq", "model", "expert")
+# outermost so cross-host traffic is the infrequent gradient reduction;
+# pipe next (stage handoffs are point-to-point, once per microbatch tick,
+# and tolerate DCN latency — the standard cross-slice axis); model/seq/expert
+# collectives (per-layer, per-step) stay on intra-host ICI.
+AXES = ("data", "fsdp", "pipe", "seq", "model", "expert")
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
@@ -48,6 +51,7 @@ class MeshSpec:
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
     seq: int = 1
     model: int = 1
     expert: int = 1

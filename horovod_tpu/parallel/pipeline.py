@@ -1,0 +1,106 @@
+"""Pipeline parallelism: a GPipe microbatch schedule as one SPMD program.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2: single-stage
+model); this fills the framework's ``pipe`` mesh axis the TPU-native way —
+no runtime stage processes, no send/recv threads, no schedule executor.
+Instead the whole pipeline is ONE differentiable jitted function:
+
+* **Stages are a sharding.** Per-layer parameter stacks ``[n_layers, ...]``
+  are sharded over ``pipe`` on dim 0, so each pipe device holds a contiguous
+  block of layers (its stage). There is no separate stage assignment
+  machinery — the partitioner IS the assignment.
+* **The schedule is a `lax.scan`.** Inside a `shard_map` over the mesh,
+  every device runs ``n_micro + n_stages - 1`` identical ticks; at tick t,
+  stage s processes microbatch ``t - s`` (a rotating activation register),
+  then hands its output to stage ``s+1`` via `lax.ppermute`. Bubbles are
+  ticks whose result is masked out — uniform control flow, exactly what XLA
+  wants.
+* **Backward is derived, not written.** The schedule is built from
+  differentiable primitives (`scan`, `ppermute`, `psum`), so `jax.grad`
+  mechanically produces the reverse pipeline (activations rematerialized per
+  the standard AD rules) — where a runtime-scheduler design (GPipe/
+  PipeDream's C++ executors) needs hand-written backward scheduling, here it
+  falls out of the autodiff transform.
+
+Cost notes: the GPipe bubble fraction is ``(S-1)/(T+S-1)`` for S stages and
+T microbatches — pick ``n_micro >= 4*n_stages`` to keep it under ~20%. The
+final broadcast of outputs off the last stage is a masked `psum` over
+``pipe`` (one activation-sized allreduce per step; simple and fully
+differentiable).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import PIPE_AXIS
+
+
+def spmd_pipeline(
+    stage_fn: Callable,
+    x_micro,
+    *,
+    axis_name: str = PIPE_AXIS,
+):
+    """Run ``stage_fn`` as a GPipe pipeline over the ``axis_name`` mesh axis.
+
+    Must be called INSIDE a manual region (`shard_map`) where ``axis_name``
+    is a collective axis and ``stage_fn`` closes over this device's stage
+    parameters (its slice of the layer stack).
+
+    Args:
+      stage_fn: ``activation [mb, ...] -> activation [mb, ...]`` — this
+        stage's chunk of the network, same signature on every stage.
+      x_micro: ``[n_micro, mb, ...]`` microbatched stage-0 input.
+
+    Returns:
+      ``[n_micro, mb, ...]`` outputs of the LAST stage, identical on every
+      pipe device (masked psum broadcast).
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.psum(1, axis_name)
+    n_micro = x_micro.shape[0]
+    ticks = n_micro + n_stages - 1
+
+    state = jnp.zeros(x_micro.shape[1:], x_micro.dtype)  # incoming activation
+    out_buf = jnp.zeros_like(x_micro)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, out_buf = carry
+        # Stage 0 feeds itself from the microbatch queue; later stages from
+        # the activation handed over the ring. Clipped reads/writes keep
+        # shapes static; bubble results are masked, never stored.
+        x_t = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        inp = jnp.where(s == 0, x_t, state)
+        out = stage_fn(inp)
+
+        widx = t - (n_stages - 1)  # microbatch finishing at the last stage
+        cidx = jnp.clip(widx, 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(out_buf, cidx, 0, keepdims=False)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(widx >= 0, out, cur), cidx, 0
+        )
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, out_buf), None
+
+    (_, out_buf), _ = lax.scan(tick, (state, out_buf), jnp.arange(ticks))
+
+    # Only the last stage holds real outputs; broadcast them to every pipe
+    # device so downstream (loss head) runs replicated over `pipe`.
+    return lax.psum(jnp.where(s == n_stages - 1, out_buf, 0.0), axis_name)
+
+
+def stage_slice_size(n_layers: int, n_stages: int) -> int:
+    """Layers per stage; n_layers must divide evenly."""
+    if n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers ({n_layers}) must be divisible by pipe ({n_stages})"
+        )
+    return n_layers // n_stages

@@ -16,7 +16,7 @@ def test_default_mesh_is_pure_dp():
 
 def test_mesh_spec_resolution():
     assert MeshSpec(model=2).resolve(8) == {
-        "data": 4, "fsdp": 1, "seq": 1, "model": 2, "expert": 1,
+        "data": 4, "fsdp": 1, "pipe": 1, "seq": 1, "model": 2, "expert": 1,
     }
     assert MeshSpec(data=2, seq=2, model=2).resolve(8)["fsdp"] == 1
     with pytest.raises(ValueError):

@@ -90,6 +90,51 @@ class TestRouting:
             rtol=1e-5, atol=1e-6,
         )
 
+    def test_switch_k1_router_gets_task_gradient(self):
+        """k=1 must use the RAW top probability as the gate (renormalizing
+        would make it constant 1.0 and freeze the router)."""
+        d, e = 8, 4
+        layer = MoEMlp(d, n_experts=e, k=1, capacity_factor=4.0)
+        x = jnp.asarray(np.random.RandomState(1).rand(1, 8, d), jnp.float32)
+        variables = _init(layer, x)
+
+        def task_loss(params):
+            out = layer.apply({"params": params}, x)
+            return (out ** 2).sum()
+
+        g = jax.grad(task_loss)(variables["params"])
+        router_grad = float(np.abs(np.asarray(g["router"]["kernel"])).sum())
+        assert router_grad > 1e-6  # not cut off from the task loss
+
+    def test_indivisible_experts_rejected(self):
+        """Misconfigured EP (experts not divisible by the expert axis) must
+        fail loudly — silent replication would quietly discard the memory
+        scaling EP exists for. Both the layer and param_specs guard it."""
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, expert=4))
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, dropout=0.0,
+            moe_every=2, n_experts=6,  # 6 % 4 != 0
+            sharding=ShardingConfig(mesh=mesh, attn="dense"),
+        )
+        toks = jnp.zeros((8, 16), jnp.int32)
+        with pytest.raises(ValueError, match="divisible"):
+            model.init(
+                {"params": jax.random.PRNGKey(0),
+                 "dropout": jax.random.PRNGKey(1)},
+                toks,
+            )
+        # param_specs guards independently (callers can hand-build params).
+        plain = TransformerLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, dropout=0.0,
+            moe_every=2, n_experts=6,
+        )
+        params = plain.init(
+            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+            toks,
+        )["params"]
+        with pytest.raises(ValueError, match="divisible"):
+            param_specs(params, mesh)
+
     def test_top2_gates_renormalized(self):
         d, e = 8, 4
         layer = MoEMlp(d, n_experts=e, k=2, capacity_factor=4.0)
