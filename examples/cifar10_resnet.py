@@ -12,9 +12,13 @@ Env knobs: DRIVE_STEPS, DRIVE_EPOCHS, DRIVE_EVAL_N.
 """
 
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import horovod_tpu  # noqa: F401 — installed (`pip install -e .`)
+except ModuleNotFoundError:  # bare source checkout: make the repo importable
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax.numpy as jnp
 import numpy as np

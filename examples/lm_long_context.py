@@ -30,9 +30,13 @@ Pipeline parallelism: a ``pipe`` axis switches to the pipelined model
 """
 
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import horovod_tpu  # noqa: F401 — installed (`pip install -e .`)
+except ModuleNotFoundError:  # bare source checkout: make the repo importable
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import numpy as np

@@ -15,9 +15,13 @@ Smoke-test env knobs: DRIVE_EPOCHS, DRIVE_TRAIN_N, DRIVE_EVAL_N.
 """
 
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import horovod_tpu  # noqa: F401 — installed (`pip install -e .`)
+except ModuleNotFoundError:  # bare source checkout: make the repo importable
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import optax
@@ -63,8 +67,10 @@ def main() -> None:
         loss="categorical_crossentropy",  # :89
     )
 
-    callbacks = [hvt.callbacks.BroadcastGlobalVariablesCallback(0)]  # :94-98
-    callbacks.append(hvt.callbacks.MetricsPushCallback())
+    # Broadcast only, like the reference (:94-98). Epoch scalars reach the
+    # platform sink through sync_tensorboard (metrics.init above) — the
+    # gradient_utils contract — so no explicit push callback is needed.
+    callbacks = [hvt.callbacks.BroadcastGlobalVariablesCallback(0)]
     if hvt.rank() == 0:  # :100-105
         callbacks.append(
             hvt.callbacks.ModelCheckpoint(os.path.join(model_dir, "checkpoint-{epoch}.msgpack"))
@@ -73,11 +79,21 @@ def main() -> None:
             hvt.callbacks.ScalarLogger(os.path.join(model_dir, "eval"), update_freq="batch")
         )
 
+    # Resume from the newest checkpoint, continuing epoch numbering (the
+    # reference's implicit restore contract, mnist_keras.py:95-96).
+    trainer.build(x_train[:1])
+    trainer.state, done_epochs = checkpoint.restore_latest_and_broadcast(
+        model_dir, trainer.state, mesh=trainer.mesh
+    )
+    if done_epochs and hvt.rank() == 0:
+        print(f"Resuming from checkpoint epoch {done_epochs}")
+
     trainer.fit(  # :107-112
         x=x_train,
         y=y_train_oh,
         batch_size=batch_size,
         epochs=epochs,
+        initial_epoch=done_epochs,
         callbacks=callbacks,
         validation_data=(x_test, y_test_oh),
         verbose=1 if hvt.rank() == 0 else 0,

@@ -26,16 +26,20 @@ budget when unset): DRIVE_STEPS, DRIVE_EPOCHS.
 """
 
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import horovod_tpu  # noqa: F401 — installed (`pip install -e .`)
+except ModuleNotFoundError:  # bare source checkout: make the repo importable
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax.numpy as jnp
 import numpy as np
 import optax
 
 import horovod_tpu as hvt
-from horovod_tpu import metrics
+from horovod_tpu import checkpoint, metrics
 from horovod_tpu.data import datasets
 from horovod_tpu.data.loader import ArrayDataset
 from horovod_tpu.models.cnn import MnistCNN
@@ -83,8 +87,10 @@ def main() -> None:
         hvt.callbacks.MetricAverageCallback(),
         # Scale lr ×size over the first 3 epochs (:78-83).
         hvt.callbacks.LearningRateWarmupCallback(warmup_epochs=3, verbose=1),
-        hvt.callbacks.MetricsPushCallback(),
     ]
+    # Epoch scalars reach the platform sink via sync_tensorboard (the
+    # metrics.init above) — an explicit MetricsPushCallback here would push
+    # every scalar twice.
     # Rank-0-only artifacts (:85-92); other workers would corrupt them.
     if hvt.rank() == 0:
         callbacks.append(
@@ -95,10 +101,22 @@ def main() -> None:
     steps_per_epoch = int(os.environ.get("DRIVE_STEPS", 0)) or hvt.shard_steps(500)  # :96
     epochs = int(os.environ.get("DRIVE_EPOCHS", 0)) or 24  # :96
 
+    # Resume: restore the newest checkpoint (primary loads, every process
+    # adopts via broadcast) and continue the epoch numbering — the
+    # reference's restore contract (tensorflow2_keras_mnist.py:68-71) made
+    # explicit. A fresh model_dir starts from epoch 0.
+    trainer.build(x_train[:1])
+    trainer.state, done_epochs = checkpoint.restore_latest_and_broadcast(
+        model_dir, trainer.state, mesh=trainer.mesh
+    )
+    if done_epochs and hvt.rank() == 0:
+        print(f"Resuming from checkpoint epoch {done_epochs}")
+
     trainer.fit(
         dataset,
         steps_per_epoch=steps_per_epoch,
         epochs=epochs,
+        initial_epoch=done_epochs,
         callbacks=callbacks,
         verbose=1 if hvt.rank() == 0 else 0,  # :92
     )

@@ -56,22 +56,33 @@ class JsonlSink:
 _sink: MetricsSink | None = None
 _configured_path: str | None = None
 _buffered: list[tuple[str, float, int | None]] = []
+_sync_tensorboard = False
 
 
 def init(sync_tensorboard: bool = False, path: str | None = None) -> None:
     """Parity shim for ``gradient_utils.metrics.init`` (mnist_keras.py:23).
+
+    ``sync_tensorboard=True`` mirrors the reference's behavior: scalars the
+    TensorBoard-role logger (`callbacks.ScalarLogger`) records at epoch
+    granularity are ALSO pushed to this platform sink, so the CI gate sees
+    them without an explicit push callback.
 
     Sink creation is deferred: the reference calls ``metrics.init`` *before*
     ``hvd.init()`` (mnist_keras.py:22-30), and deciding the primary process
     must not touch the JAX backend before `runtime.init` has configured
     `jax.distributed`. Pushes that arrive before `runtime.init` are buffered
     and flushed on the first post-init push."""
-    global _sink, _configured_path
+    global _sink, _configured_path, _sync_tensorboard
     _sink = None
+    _sync_tensorboard = bool(sync_tensorboard)
     _configured_path = path or os.path.join(
         os.environ.get("HVT_METRICS_DIR", os.environ.get("PS_MODEL_PATH", "./models")),
         "metrics.jsonl",
     )
+
+
+def sync_tensorboard_enabled() -> bool:
+    return _sync_tensorboard
 
 
 def _can_decide_primary() -> bool:

@@ -142,11 +142,15 @@ class ModelCheckpoint(Callback):
 class ScalarLogger(Callback):
     """Rank-0 scalar event log (TensorBoard-role observability, §5.1).
 
-    Writes JSONL events (one line per scalar) compatible with simple
-    dashboards; per-batch or per-epoch frequency mirrors
-    ``TensorBoard(update_freq='batch')`` (tensorflow2_keras_mnist.py:89).
-    ``log_every`` thins batch records (1 = every batch); epoch records are
-    always written.
+    Writes TWO formats side by side: real TensorBoard event files
+    (`horovod_tpu.tbevents`, so ``tensorboard --logdir`` plots the run —
+    format parity with ``TensorBoard(update_freq='batch')``,
+    tensorflow2_keras_mnist.py:89) and JSONL (one line per record, the CI
+    gate's input). ``log_every`` thins batch records (1 = every batch);
+    epoch records are always written. When ``metrics.init`` was called with
+    ``sync_tensorboard=True``, epoch scalars are additionally pushed to the
+    platform metrics sink (the gradient_utils sync contract,
+    mnist_keras.py:22-23).
 
     Durability: batch records are buffered (fetching device values per batch
     would serialize TPU async dispatch) and flushed when either
@@ -183,16 +187,40 @@ class ScalarLogger(Callback):
             self._fh = open(os.path.join(self.log_dir, "events.jsonl"), "a")
         return self._fh
 
+    def _tb(self):
+        if getattr(self, "_tb_writer", None) is None:
+            from horovod_tpu.tbevents import TBEventWriter
+
+            self._tb_writer = TBEventWriter(self.log_dir)
+        return self._tb_writer
+
     def _emit(self, tag_prefix: str, logs: dict, step: int, wall_time=None):
         if not runtime.is_primary() or not logs:
             return
-        record = {"wall_time": wall_time or time.time(), "step": step}
+        wall = wall_time or time.time()
+        record = {"wall_time": wall, "step": step}
+        scalars = {}
         for k, v in logs.items():
             try:
-                record[f"{tag_prefix}{k}"] = float(v)
+                scalars[k] = float(v)
             except (TypeError, ValueError):
                 continue
+            record[f"{tag_prefix}{k}"] = scalars[k]
         self._writer().write(json.dumps(record) + "\n")
+        if scalars:
+            self._tb().scalars(
+                {f"{tag_prefix}{k}": v for k, v in scalars.items()},
+                step, wall_time=wall,
+            )
+        if tag_prefix == "epoch/" and scalars:
+            from horovod_tpu import metrics
+
+            if metrics.sync_tensorboard_enabled():
+                # The gradient_utils sync contract: TB epoch scalars flow to
+                # the platform sink under their plain names (the CI gate
+                # consumes e.g. "loss", config.yaml:9-11).
+                for k, v in scalars.items():
+                    metrics.push(k, v, step=step)
 
     def _flush_pending(self):
         if self._pending:
@@ -202,6 +230,8 @@ class ScalarLogger(Callback):
             self._pending = []
         if self._fh:
             self._fh.flush()
+        if getattr(self, "_tb_writer", None) is not None:
+            self._tb_writer.flush()
         self._last_flush = time.time()
 
     def on_batch_end(self, batch: int, logs=None):
@@ -227,6 +257,9 @@ class ScalarLogger(Callback):
         if self._fh:
             self._fh.close()
             self._fh = None
+        if getattr(self, "_tb_writer", None) is not None:
+            self._tb_writer.close()
+            self._tb_writer = None
 
 
 class MetricsPushCallback(Callback):

@@ -413,6 +413,7 @@ class Trainer:
         y=None,
         batch_size: int = 128,
         epochs: int = 1,
+        initial_epoch: int = 0,
         steps_per_epoch: int | None = None,
         callbacks: Sequence = (),
         validation_data=None,
@@ -425,6 +426,10 @@ class Trainer:
         tensorflow2_keras_mnist.py:96) or raw ``x``/``y`` arrays with a
         per-worker ``batch_size`` (the TF1 script's idiom,
         mnist_keras.py:107-112).
+
+        ``initial_epoch`` is the Keras resume idiom: epoch numbering (and
+        LR-warmup position, checkpoint names) continues from a restored run —
+        pair it with `checkpoint.restore_latest_and_broadcast`.
 
         ``cache='device'`` (with ``x``/``y``) stages the whole dataset into
         HBM once, sharded over the data axes, and runs shuffling + batching +
@@ -439,8 +444,8 @@ class Trainer:
             if x is None or y is None:
                 raise ValueError("cache='device' needs x=/y= arrays")
             return self._fit_device_cached(
-                x, y, batch_size, epochs, steps_per_epoch, callbacks,
-                validation_data, verbose,
+                x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
+                callbacks, validation_data, verbose,
             )
         if cache is not None:
             raise ValueError(f"unknown cache mode {cache!r}")
@@ -496,8 +501,9 @@ class Trainer:
 
             with trace_lib.maybe_trace(trace_lib.profile_dir()):
                 self._fit_epochs(
-                    it, pending, zero_acc, epochs, steps_per_epoch, callbacks,
-                    validation_data, batch_size, verbose,
+                    it, pending, zero_acc, epochs, initial_epoch,
+                    steps_per_epoch, callbacks, validation_data, batch_size,
+                    verbose,
                 )
         finally:
             close_input()
@@ -538,8 +544,8 @@ class Trainer:
         return (stage(x), stage(y)), per_shard
 
     def _fit_device_cached(
-        self, x, y, batch_size, epochs, steps_per_epoch, callbacks,
-        validation_data, verbose,
+        self, x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
+        callbacks, validation_data, verbose,
     ):
         from horovod_tpu import trace as trace_lib
 
@@ -566,7 +572,7 @@ class Trainer:
         )
         epoch_key = jax.random.PRNGKey(self.seed + 1)
         with trace_lib.maybe_trace(trace_lib.profile_dir()):
-            for epoch in range(epochs):
+            for epoch in range(initial_epoch, epochs):
                 if self.stop_training:
                     break
                 for cb in callbacks:
@@ -627,8 +633,8 @@ class Trainer:
         return sharding_lib.shard_chunk(chunk, self.mesh)
 
     def _fit_epochs(
-        self, it, pending, zero_acc, epochs, steps_per_epoch, callbacks,
-        validation_data, batch_size, verbose,
+        self, it, pending, zero_acc, epochs, initial_epoch, steps_per_epoch,
+        callbacks, validation_data, batch_size, verbose,
     ):
         from horovod_tpu.data.prefetch import DevicePrefetcher
 
@@ -644,7 +650,7 @@ class Trainer:
         def host_chunks():
             # Host-side assembly of the execution units: single batches when
             # K == 1, [K, ...] stacks otherwise.
-            for _ in range(epochs):
+            for _ in range(initial_epoch, epochs):
                 for k in plan:
                     batches = [
                         buffered.pop() if buffered else next(it)
@@ -665,7 +671,7 @@ class Trainer:
             host_chunks(), self._shard if spe == 1 else self._shard_chunk
         )
         try:
-            for epoch in range(epochs):
+            for epoch in range(initial_epoch, epochs):
                 if self.stop_training:
                     break
                 for cb in callbacks:

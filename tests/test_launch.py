@@ -146,6 +146,134 @@ class TestJob:
         assert run_job(str(spec)) == 1
 
 
+@pytest.fixture
+def fake_ssh(tmp_path, monkeypatch):
+    """PATH-shimmed ssh/scp that exec locally — the multi-host launcher's
+    deployment path (the mpirun --hostfile replacement) testable on one
+    machine. Hosts containing 'bad' refuse the connection."""
+    bin_dir = tmp_path / "fakebin"
+    bin_dir.mkdir()
+    ssh = bin_dir / "ssh"
+    ssh.write_text(
+        "#!/bin/bash\n"
+        'while [[ "$1" == -* ]]; do\n'
+        '  if [[ "$1" == "-o" ]]; then shift 2; else shift; fi\n'
+        "done\n"
+        'host="$1"; shift\n'
+        'if [[ "$host" == *bad* ]]; then\n'
+        '  echo "ssh: connect to host $host: Connection refused" >&2\n'
+        "  exit 255\n"
+        "fi\n"
+        'exec sh -c "$*"\n'
+    )
+    ssh.chmod(0o755)
+    scp = bin_dir / "scp"
+    scp.write_text(
+        "#!/bin/bash\n"
+        'while [[ "$1" == -* ]]; do\n'
+        '  if [[ "$1" == "-o" ]]; then shift 2; else shift; fi\n'
+        "done\n"
+        'src="$1"; dst="$2"\n'
+        'if [[ "$src" == *bad*:* ]]; then exit 1; fi\n'
+        'exec cp "${src#*:}" "${dst#*:}"\n'
+    )
+    scp.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    return bin_dir
+
+
+class TestSshLauncher:
+    def test_run_hosts_assigns_ranks_and_coordinator(self, tmp_path, fake_ssh):
+        """One process per host: HVT_* env plays mpirun's slot-mapping role;
+        host 0 is the coordinator every rank dials."""
+        out = tmp_path / "envdump"
+        script = (
+            f"import json, os; json.dump({{k: v for k, v in os.environ.items()"
+            f" if k.startswith('HVT_')}},"
+            f" open({str(out)!r} + '.' + os.environ['HVT_PROCESS_ID'], 'w'))"
+        )
+        code = launcher.run_hosts(
+            ["alpha", "user@beta"],
+            [sys.executable, "-c", script],
+            env={"EXTRA": "propagated"},
+            coordinator_port=7700,
+        )
+        assert code == 0
+        envs = [json.load(open(f"{out}.{r}")) for r in range(2)]
+        for r, env in enumerate(envs):
+            assert env["HVT_PROCESS_ID"] == str(r)
+            assert env["HVT_NUM_PROCESSES"] == "2"
+            # ssh-style user@host entries: the dialed address is the bare host.
+            assert env["HVT_COORDINATOR_ADDRESS"] == "alpha:7700"
+
+    def test_run_hosts_env_propagation_and_workdir(self, tmp_path, fake_ssh):
+        script = (
+            "import os, pathlib; pathlib.Path('cwd.txt').write_text("
+            "os.getcwd() + '\\n' + os.environ['MY_FLAG'])"
+        )
+        code = launcher.run_hosts(
+            ["solo"],
+            [sys.executable, "-c", script],
+            env={"MY_FLAG": "on remote"},  # space → quoting must hold
+            workdir=str(tmp_path),
+        )
+        assert code == 0
+        cwd, flag = (tmp_path / "cwd.txt").read_text().splitlines()
+        assert cwd == str(tmp_path)
+        assert flag == "on remote"
+
+    def test_run_hosts_failure_propagates(self, fake_ssh):
+        code = launcher.run_hosts(
+            ["goodhost", "badhost"], ["true"],
+        )
+        assert code == 255  # fail-stop: the refused connection surfaces
+
+    def test_job_with_hosts_fetches_remote_metrics(self, tmp_path, fake_ssh):
+        """The full multi-host job path: reset stale metrics over ssh, run,
+        scp the stream back, gate on it."""
+        metrics = tmp_path / "metrics.jsonl"
+        _write_metrics(metrics, [0.9, 0.9])  # stale — must be reset
+        writer = (
+            "import json;"
+            f"open({str(metrics)!r}, 'w').write("
+            "json.dumps({'name': 'loss', 'value': 0.1}) + '\\n')"
+        )
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: pod-job
+            job:
+              command: ["{sys.executable}", "-c", {json.dumps(writer)}]
+              hosts: [podhost]
+            metrics: {metrics}
+            checks:
+              loss:
+                target: "0.0..0.3"
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 0
+
+    def test_job_refuses_gate_when_reset_fails(self, tmp_path, fake_ssh):
+        """If the remote metrics stream can't be reset, gating could pass on
+        stale values — the job must refuse instead."""
+        metrics = tmp_path / "metrics.jsonl"
+        _write_metrics(metrics, [0.01])  # stale pass-looking values
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: pod-job-bad
+            job:
+              command: ["true"]
+              hosts: [badhost]
+            metrics: {metrics}
+            checks:
+              loss:
+                target: "0.0..0.3"
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) != 0
+
+
 @pytest.mark.slow
 class TestDistributedLaunch:
     def test_two_process_cpu_collectives(self, tmp_path):
