@@ -223,7 +223,15 @@ def bench_input() -> dict:
     # the native leg could never run.
     native = native_loader.available()
 
-    def run(no_native: bool) -> float:
+    # The native engine's value is OVERLAP: its producer thread assembles
+    # batch k+1 while the consumer (a training loop dispatching device work)
+    # is busy with batch k. Measure both regimes: a tight next() loop (raw
+    # assembly speed — numpy's fancy-index gather is already memcpy-bound,
+    # so parity is expected) and a consumer that does `busy_s` of work per
+    # batch (the realistic loop, where background assembly hides under it).
+    busy_s = float(os.environ.get("BENCH_INPUT_BUSY_MS", 1.0)) / 1e3
+
+    def run(no_native: bool, busy: float) -> float:
         if no_native:
             os.environ["HVT_NO_NATIVE"] = "1"
         else:
@@ -235,21 +243,32 @@ def bench_input() -> dict:
             t0 = time.perf_counter()
             for _ in range(steps):
                 next(it)
+                if busy:
+                    end = time.perf_counter() + busy
+                    while time.perf_counter() < end:  # simulated step work
+                        pass
             return steps * BATCH / (time.perf_counter() - t0)
         finally:
             close()
 
-    python_ips = run(no_native=True)
-    # Without the native engine (no toolchain to build it), the "native" leg
+    python_raw = run(no_native=True, busy=0.0)
+    python_busy = run(no_native=True, busy=busy_s)
+    # Without the native engine (no toolchain to build it), the "native" legs
     # would silently rerun Python and publish "no speedup" — label it.
-    native_ips = run(no_native=False) if native else python_ips
+    native_raw = run(no_native=False, busy=0.0) if native else python_raw
+    native_busy = run(no_native=False, busy=busy_s) if native else python_busy
     return {
-        "metric": "input_pipeline_images_per_sec",
-        "value": round(native_ips, 1),
+        "metric": "input_pipeline_images_per_sec_overlapped",
+        "value": round(native_busy, 1),
         "unit": "images/sec",
         "native": native,
-        "python_images_per_sec": round(python_ips, 1),
-        "vs_baseline": round(native_ips / python_ips, 2) if native else None,
+        "busy_ms_per_batch": busy_s * 1e3,
+        "python_overlapped_images_per_sec": round(python_busy, 1),
+        "raw_images_per_sec": {
+            "native": round(native_raw, 1),
+            "python": round(python_raw, 1),
+        },
+        "vs_baseline": round(native_busy / python_busy, 2) if native else None,
     }
 
 

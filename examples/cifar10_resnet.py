@@ -68,8 +68,9 @@ def main() -> None:
         hvt.callbacks.BroadcastGlobalVariablesCallback(0),
         hvt.callbacks.MetricAverageCallback(),
         hvt.callbacks.LearningRateWarmupCallback(warmup_epochs=3, verbose=1),
-        hvt.callbacks.MetricsPushCallback(),
     ]
+    # Epoch scalars reach the platform sink via sync_tensorboard (metrics.init
+    # above); an explicit MetricsPushCallback would push everything twice.
     if hvt.rank() == 0:
         callbacks.append(
             hvt.callbacks.ModelCheckpoint(os.path.join(model_dir, "checkpoint-{epoch}.msgpack"))

@@ -116,14 +116,24 @@ def encode_record(payload: bytes) -> bytes:
     )
 
 
+_writer_seq = 0
+
+
 class TBEventWriter:
-    """Append-only scalar event writer for one run directory."""
+    """Scalar event writer for one run directory; each writer owns a fresh
+    uniquely-named file (time + hostname + pid + sequence — two writers in
+    the same second must not interleave streams in one file)."""
 
     def __init__(self, log_dir: str):
+        global _writer_seq
         os.makedirs(log_dir, exist_ok=True)
-        name = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        _writer_seq += 1
+        name = (
+            f"events.out.tfevents.{int(time.time())}."
+            f"{socket.gethostname()}.{os.getpid()}.{_writer_seq}"
+        )
         self.path = os.path.join(log_dir, name)
-        self._fh = open(self.path, "ab")
+        self._fh = open(self.path, "wb")
         self._write(
             encode_event(time.time(), file_version="brain.Event:2")
         )

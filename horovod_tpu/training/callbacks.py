@@ -234,6 +234,13 @@ class ScalarLogger(Callback):
             self._tb_writer.flush()
         self._last_flush = time.time()
 
+    def on_train_begin(self, logs=None):
+        # Resume continuity: batch step numbering picks up from the restored
+        # state's step counter, so a relaunched run's batch/* records extend
+        # the previous run's series instead of colliding with it.
+        if self._step == 0 and getattr(self.trainer, "state", None) is not None:
+            self._step = int(jax.device_get(self.trainer.state.step))
+
     def on_batch_end(self, batch: int, logs=None):
         self._step += 1
         if self.update_freq == "batch" and self._step % self.log_every == 0 and logs:
