@@ -60,7 +60,7 @@ from horovod_tpu.training.trainer import Trainer, TrainState
 from horovod_tpu import checkpoint
 from horovod_tpu.checkpoint import broadcast_parameters
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in sync with pyproject.toml
 
 __all__ = [
     "init",

@@ -37,26 +37,52 @@ def run_job(spec_path: str) -> int:
         os.remove(metrics_path)
 
     hosts = job.get("hosts")
-    if hosts and checks:
-        # The purge above only covered the launcher's filesystem; the sink
-        # appends on the coordinator host, so reset it there too. A failed
-        # reset is fatal: gating against a possibly-stale stream could PASS
-        # a broken run.
-        import subprocess
-
-        res = subprocess.run(
-            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[0],
-             f"rm -f {shlex.quote(metrics_path)}"],
-            capture_output=True,
-            text=True,
-        )
-        if res.returncode != 0:
-            print(
-                f"cannot reset metrics on {hosts[0]} "
-                f"({res.stderr.strip()}); refusing to gate against a "
-                "possibly-stale stream"
+    # `fresh: true`: wipe the job-owned model dir before launching. CI jobs
+    # reuse a fixed PS_MODEL_PATH across runs, and the entry scripts resume
+    # from the newest checkpoint by design — a gated convergence run must
+    # train from scratch, not resume a finished run (which would push no
+    # metrics and fail the gate on an empty stream). The wipe happens where
+    # the entry script will look: on hosts[0] (the single writer), with a
+    # relative path resolved against the job's workdir, exactly like the
+    # remote command itself.
+    if job.get("fresh"):
+        # Entry scripts default to ./models when PS_MODEL_PATH is unset.
+        raw = env.get("PS_MODEL_PATH", "./models")
+        if hosts:
+            target = raw if os.path.isabs(raw) else os.path.join(
+                job.get("workdir") or ".", raw
             )
-            return res.returncode or 1
+        else:
+            target = os.path.abspath(raw)
+        norm = os.path.normpath(target)
+        if norm in ("/", ".", os.path.expanduser("~")) or (
+            os.path.isabs(norm) and norm.count(os.sep) < 2
+        ):
+            print(f"refusing to wipe suspicious fresh dir {norm}")
+            return 1
+        if hosts:
+            code = _remote_rm(
+                hosts[0], norm, recursive=True,
+                why="a stale checkpoint would make the run resume instead "
+                "of train — refusing to gate",
+            )
+            if code != 0:
+                return code
+        else:
+            import shutil
+
+            shutil.rmtree(norm, ignore_errors=True)
+    if hosts and checks:
+        # The local purge above only covered the launcher's filesystem; the
+        # sink appends on the coordinator host, so reset it there too. A
+        # failed reset is fatal: gating against a possibly-stale stream
+        # could PASS a broken run.
+        code = _remote_rm(
+            hosts[0], metrics_path, recursive=False,
+            why="refusing to gate against a possibly-stale stream",
+        )
+        if code != 0:
+            return code
     if hosts:
         code = launcher.run_hosts(
             list(hosts), argv, env=env,
@@ -76,6 +102,24 @@ def run_job(spec_path: str) -> int:
         # without shared storage it must be fetched before gating.
         metrics_path = _fetch_remote_metrics(hosts[0], metrics_path)
     return 0 if ci_gate.run_checks(metrics_path, checks) else 1
+
+
+def _remote_rm(host: str, path: str, recursive: bool, why: str) -> int:
+    """Remove a path on a remote host over ssh; nonzero (with a message) on
+    failure — callers treat failure as fatal for gating correctness."""
+    import subprocess
+
+    flag = "-rf" if recursive else "-f"
+    res = subprocess.run(
+        ["ssh", "-o", "StrictHostKeyChecking=no", host,
+         f"rm {flag} {shlex.quote(path)}"],
+        capture_output=True,
+        text=True,
+    )
+    if res.returncode != 0:
+        print(f"cannot remove {path} on {host} ({res.stderr.strip()}); {why}")
+        return res.returncode or 1
+    return 0
 
 
 def _fetch_remote_metrics(host: str, remote_path: str) -> str:

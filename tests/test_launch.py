@@ -125,6 +125,53 @@ class TestJob:
         spec2.write_text(spec.read_text().replace("0.0..0.3", "0.0..0.05"))
         assert run_job(str(spec2)) == 1
 
+    def test_job_fresh_wipes_model_dir(self, tmp_path):
+        """fresh: true — a gated run must train from scratch: a stale
+        checkpoint in the job-owned PS_MODEL_PATH would make the entry
+        script resume (and push nothing to the gate)."""
+        model_dir = tmp_path / "models"
+        model_dir.mkdir()
+        (model_dir / "checkpoint-6.msgpack").write_bytes(b"stale")
+        metrics = tmp_path / "metrics.jsonl"
+        writer = (
+            "import json;"
+            f"open({str(metrics)!r},'w').write("
+            "json.dumps({'name':'loss','value':0.1}) + '\\n')"
+        )
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: fresh-job
+            job:
+              fresh: true
+              command: ["{sys.executable}", "-c", {json.dumps(writer)}]
+              nprocs: 1
+              env:
+                PS_MODEL_PATH: {model_dir}
+            metrics: {metrics}
+            checks:
+              loss:
+                target: "0.0..0.3"
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 0
+        assert not (model_dir / "checkpoint-6.msgpack").exists()
+
+    def test_job_fresh_refuses_suspicious_dir(self, tmp_path):
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: fresh-bad
+            job:
+              fresh: true
+              command: ["true"]
+              env:
+                PS_MODEL_PATH: /
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 1
+        assert (tmp_path / "job.yaml").exists()  # nothing was wiped
+
     def test_job_resets_stale_metrics(self, tmp_path):
         """A previous run's appended metrics must not feed this run's gate."""
         metrics = tmp_path / "metrics.jsonl"
