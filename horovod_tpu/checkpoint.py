@@ -53,6 +53,60 @@ def save(path: str, state: PyTree) -> str:
     return path
 
 
+class _SaveThread:
+    """Background save handle whose `join()` re-raises the thread's failure —
+    a checkpoint that silently failed to write must not look successful."""
+
+    def __init__(self, work):
+        import threading
+
+        self.exc: BaseException | None = None
+
+        def run():
+            try:
+                work()
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                self.exc = e
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def join(self, timeout=None):
+        self._t.join(timeout)
+        if self.exc is not None:
+            raise self.exc
+
+    def is_alive(self):
+        return self._t.is_alive()
+
+
+def save_async(path: str, state: PyTree) -> _SaveThread:
+    """`save` without blocking the training loop.
+
+    The state is first copied ON DEVICE (cheap, and immune to the training
+    step's buffer donation — the live state's buffers are consumed by the
+    next step), then the host fetch + serialization + atomic write run on a
+    daemon thread. Returns a handle; `join()` it (or let
+    `callbacks.ModelCheckpoint(async_save=True)` manage ordering) before
+    reading the file — join re-raises any write failure.
+
+    Multi-process safe for the replicated (DP) state this framework
+    checkpoints: fully-replicated leaves are snapshot from one local shard
+    (no cross-process computation may run on the primary alone)."""
+    import jax.numpy as jnp
+
+    def snap(a):
+        if isinstance(a, jax.Array) and a.is_fully_replicated:
+            # Local-shard copy: an eager global jnp.copy would be a
+            # collective computation only the primary enters (deadlock/error
+            # in multi-process runs).
+            return jnp.copy(a.addressable_data(0))
+        return jnp.copy(a)
+
+    snapshot = jax.tree.map(snap, state)
+    return _SaveThread(lambda: save(path, snapshot))
+
+
 def restore(path: str, template: PyTree) -> PyTree:
     """Deserialize into the structure of ``template``."""
     with open(path, "rb") as f:

@@ -125,10 +125,18 @@ class ModelCheckpoint(Callback):
     ``filepath`` may contain ``{epoch}`` like Keras's
     ``'checkpoint-{epoch}.h5'`` template; the payload is always msgpack
     regardless of extension, and resume discovery
-    (`checkpoint.latest_checkpoint`) accepts any extension."""
+    (`checkpoint.latest_checkpoint`) accepts any extension.
 
-    def __init__(self, filepath: str):
+    ``async_save=True`` hides the checkpoint stall: the state is snapshot on
+    device and fetched/serialized on a background thread while the next
+    epoch trains (`checkpoint.save_async`). At most one write is in flight —
+    the previous epoch's write is joined first, so files land in order — and
+    the final write is joined at train end."""
+
+    def __init__(self, filepath: str, async_save: bool = False):
         self.filepath = filepath
+        self.async_save = async_save
+        self._pending = None
 
     def on_epoch_end(self, epoch: int, logs=None):
         if not runtime.is_primary():
@@ -136,7 +144,17 @@ class ModelCheckpoint(Callback):
         from horovod_tpu import checkpoint
 
         path = self.filepath.format(epoch=epoch + 1)
-        checkpoint.save(path, self.trainer.state)
+        if self.async_save:
+            if self._pending is not None:
+                self._pending.join()
+            self._pending = checkpoint.save_async(path, self.trainer.state)
+        else:
+            checkpoint.save(path, self.trainer.state)
+
+    def on_train_end(self, logs=None):
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
 
 
 class ScalarLogger(Callback):

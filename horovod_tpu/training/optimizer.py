@@ -28,6 +28,8 @@ def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     axis_name=None,
     average: bool = True,
+    backward_passes_per_step: int = 1,
+    average_aggregated_gradients: bool = False,
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates consume cross-worker-averaged gradients.
 
@@ -38,6 +40,14 @@ def DistributedOptimizer(
         mapped context (``shard_map``/``pmap``). ``None`` = SPMD-jit mode:
         the reduction is already implied by the sharded global-batch loss.
       average: Horovod-parity default True (mean). False gives sum.
+      backward_passes_per_step: Horovod's gradient-accumulation argument —
+        N backward passes are aggregated before one optimizer update (the
+        effective batch is N× larger). Built on `optax.MultiSteps`, so the
+        result stays a plain GradientTransformation
+        (checkpoint/broadcast-friendly).
+      average_aggregated_gradients: Horovod-parity default False — the N
+        accumulated gradients are SUMMED (Horovod's
+        ``average_aggregated_gradients`` default); True averages them.
     """
 
     def init_fn(params):
@@ -54,4 +64,16 @@ def DistributedOptimizer(
                 )
         return optimizer.update(updates, state, params, **extra)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    tx = optax.GradientTransformation(init_fn, update_fn)
+    if backward_passes_per_step > 1:
+        # MultiSteps accumulates the MEAN of the N microbatch gradients and
+        # emits zero updates on the first N-1 passes. Horovod's default is
+        # the SUM of the N passes (average_aggregated_gradients=False), so
+        # the sum contract pre-scales the mean by N before the wrapped
+        # optimizer sees it.
+        if not average_aggregated_gradients:
+            tx = optax.chain(optax.scale(float(backward_passes_per_step)), tx)
+        return optax.MultiSteps(
+            tx, every_k_schedule=backward_passes_per_step
+        ).gradient_transformation()
+    return tx
