@@ -266,6 +266,30 @@ class Trainer:
                 "count": w.sum(),
             }
 
+        def eval_epoch(state: TrainState, data, steps: int, per_chip_batch: int):
+            """Whole-dataset eval over a DEVICE-RESIDENT (padded + masked)
+            eval set: one dispatch, one 3-scalar fetch — instead of
+            restreaming the test set from the host every epoch."""
+            xs, ys, masks = data  # [n_shards, per_n(, ...)] leaves
+
+            def body(acc, t):
+                def take(a):
+                    sl = jax.lax.dynamic_slice_in_dim(
+                        a, t * per_chip_batch, per_chip_batch, axis=1
+                    )
+                    return sl.reshape((-1,) + sl.shape[2:])
+
+                m = eval_step(state, (take(xs), take(ys), take(masks)))
+                return jax.tree.map(jnp.add, acc, m), None
+
+            zero = {
+                "loss_sum": jnp.zeros((), jnp.float32),
+                "correct_sum": jnp.zeros((), jnp.float32),
+                "count": jnp.zeros((), jnp.float32),
+            }
+            acc, _ = jax.lax.scan(body, zero, jnp.arange(steps))
+            return acc
+
         def predict_step(state: TrainState, x):
             logits = self.module.apply(_eval_variables(state), x, train=False)
             return jax.nn.softmax(logits, axis=-1)
@@ -276,6 +300,12 @@ class Trainer:
             train_epoch, static_argnums=(5, 6), donate_argnums=(0,)
         )
         self._eval_step = jax.jit(eval_step)
+        self._eval_epoch = jax.jit(eval_epoch, static_argnums=(2, 3))
+        # Staged eval sets for evaluate(cache='device'), keyed by the host
+        # arrays' identity. Entries hold strong references to those arrays,
+        # so a cached id cannot be recycled by the allocator while its
+        # staging is alive.
+        self._eval_cache: dict = {}
         # Replicated output → fully addressable on every process, so
         # device_get works in multi-host runs too.
         self._predict_step = jax.jit(
@@ -511,37 +541,38 @@ class Trainer:
             cb.on_train_end()
         return self.history
 
+    def _stage_sharded(self, arr, per_shard: int):
+        """Stage one host array as [n_shards, per_shard, ...] in HBM,
+        example-sharded over the data axes: shard s takes rows
+        [s*per_shard, (s+1)*per_shard); multi-process, each process
+        contributes the rows for its own chips."""
+        world = runtime.process_count()
+        local_shards = self.dp_size // world
+        r = runtime.process_rank()
+        arr = np.asarray(arr)
+        lo = r * local_shards * per_shard
+        hi = (r + 1) * local_shards * per_shard
+        local = arr[lo:hi].reshape((local_shards, per_shard) + arr.shape[1:])
+        spec = jax.sharding.PartitionSpec(
+            (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
+            *([None] * arr.ndim),
+        )
+        return sharding_lib.put_global(
+            local, jax.sharding.NamedSharding(self.mesh, spec)
+        )
+
     def _stage_device_dataset(self, x, y):
         """Stage (x, y) into HBM as [n_shards, per_shard_n, ...] leaves,
-        example-sharded over the data axes. Multi-process, each process
-        contributes the rows for its own chips."""
+        example-sharded over the data axes (truncated to divide evenly)."""
         n_shards = self.dp_size
-        world = runtime.process_count()
         n = (len(x) // n_shards) * n_shards
         if n == 0:
             raise ValueError(f"need at least {n_shards} examples")
         per_shard = n // n_shards
-        local_shards = n_shards // world
-        r = runtime.process_rank()
-
-        def stage(arr):
-            arr = np.asarray(arr)[:n]
-            # Shard s takes rows [s*per_shard, (s+1)*per_shard); this process
-            # owns shards [r*local_shards, (r+1)*local_shards).
-            lo = r * local_shards * per_shard
-            hi = (r + 1) * local_shards * per_shard
-            local = arr[lo:hi].reshape(
-                (local_shards, per_shard) + arr.shape[1:]
-            )
-            spec = jax.sharding.PartitionSpec(
-                (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
-                *([None] * arr.ndim),
-            )
-            return sharding_lib.put_global(
-                local, jax.sharding.NamedSharding(self.mesh, spec)
-            )
-
-        return (stage(x), stage(y)), per_shard
+        return (
+            self._stage_sharded(np.asarray(x)[:n], per_shard),
+            self._stage_sharded(np.asarray(y)[:n], per_shard),
+        ), per_shard
 
     def _fit_device_cached(
         self, x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
@@ -588,6 +619,8 @@ class Trainer:
                 self._finish_epoch(
                     epoch, epochs, metric_acc, steps, t0, callbacks,
                     validation_data, batch_size, verbose,
+                    # Device-cached training implies device-cached validation.
+                    val_cache="device",
                 )
         for cb in callbacks:
             cb.on_train_end()
@@ -595,7 +628,7 @@ class Trainer:
 
     def _finish_epoch(
         self, epoch, epochs, metric_acc, steps, t0, callbacks,
-        validation_data, batch_size, verbose,
+        validation_data, batch_size, verbose, val_cache=None,
     ):
         """Epoch bookkeeping shared by every fit path: ONE host fetch of the
         in-step metric sums, optional validation, callbacks, history."""
@@ -605,7 +638,7 @@ class Trainer:
         if validation_data is not None:
             val = self.evaluate(
                 validation_data[0], validation_data[1],
-                batch_size=batch_size, verbose=0,
+                batch_size=batch_size, verbose=0, cache=val_cache,
             )
             logs.update({f"val_{k}": v for k, v in val.items()})
         for cb in callbacks:
@@ -697,12 +730,74 @@ class Trainer:
         finally:
             prefetcher.close()
 
-    def evaluate(self, x, y, batch_size: int = 128, verbose: int = 0) -> dict:
+    def _evaluate_device_cached(self, x, y, batch_size: int) -> dict:
+        """evaluate() over a device-resident eval set: stage once (padded to
+        full batches, padding masked), then each call is ONE dispatch + one
+        3-scalar fetch. The per-epoch validation pass stops restreaming the
+        test set from the host every epoch.
+
+        Caching is by the host arrays' identity: do not mutate ``x``/``y``
+        in place while cached, or stale staged data is evaluated."""
+        key = (id(x), id(y), batch_size)
+        if key not in self._eval_cache:
+            n = len(x)
+            n_shards = self.dp_size
+            per = -(-n // (n_shards * batch_size)) * batch_size  # ceil→pad
+            pad_n = per * n_shards
+            mask = np.zeros(pad_n, np.float32)
+            mask[:n] = 1.0
+
+            def padded(a):
+                # Repeat a REAL example into the padded tail (like the
+                # streamed path): all-zero rows could produce non-finite
+                # losses in input-normalizing models, and NaN*0 = NaN would
+                # poison the masked sums.
+                a = np.asarray(a)
+                out = np.concatenate(
+                    [a, np.repeat(a[-1:], pad_n - n, axis=0)]
+                )
+                return out
+
+            data = (
+                self._stage_sharded(padded(x), per),
+                self._stage_sharded(padded(y), per),
+                self._stage_sharded(mask, per),
+            )
+            # Keep x/y referenced so their ids stay unique while cached.
+            self._eval_cache[key] = (data, per // batch_size, (x, y))
+            if len(self._eval_cache) > 4:  # bound device memory
+                self._eval_cache.pop(next(iter(self._eval_cache)))
+        data, steps, _ = self._eval_cache[key]
+        m = jax.device_get(
+            self._eval_epoch(self.state, data, steps, batch_size)
+        )
+        return {
+            "loss": float(m["loss_sum"]) / float(m["count"]),
+            "accuracy": float(m["correct_sum"]) / float(m["count"]),
+        }
+
+    def evaluate(
+        self, x, y, batch_size: int = 128, verbose: int = 0,
+        cache: str | None = None,
+    ) -> dict:
         """Full-dataset eval on the mesh. Unlike the reference (every rank
         redundantly evaluates the full test set, SURVEY.md §3.2), the eval
-        batch is sharded across chips — same result, 1/size the work."""
+        batch is sharded across chips — same result, 1/size the work.
+        ``cache='device'`` keeps the (padded, masked) eval set in HBM and
+        runs the whole pass as one compiled scan."""
         if self.state is None:
             raise RuntimeError("call fit() or build() first")
+        if cache == "device" and self.batch_specs is not None:
+            # Custom batch layouts (e.g. sequence-sharded tokens) need
+            # _shard's spec handling; the cached path stages batch-dim-only.
+            cache = None
+        if cache == "device":
+            result = self._evaluate_device_cached(x, y, batch_size)
+            if verbose and runtime.is_primary():
+                print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
+            return result
+        if cache is not None:
+            raise ValueError(f"unknown cache mode {cache!r}")
         n = len(x)
         global_batch = batch_size * self.dp_size
         loss_sum = correct_sum = count = 0.0

@@ -148,6 +148,47 @@ class TestDeviceCachedFit:
         assert sorted(xs.reshape(-1).tolist()) == list(range(n))
 
 
+class TestDeviceCachedEvaluate:
+    def _trainer(self, x, y):
+        trainer = hvt.Trainer(Probe(), hvt.DistributedOptimizer(optax.adam(5e-3)))
+        trainer.fit(x=x, y=y, batch_size=4, epochs=1, steps_per_epoch=4, verbose=0)
+        return trainer
+
+    def test_matches_streamed_evaluate(self):
+        """Device-cached eval must reproduce the streamed path exactly,
+        including the padded (non-divisible) tail."""
+        x, y = _data(n=200)  # 200 is not a multiple of 8 shards x 4 batch
+        trainer = self._trainer(x, y)
+        streamed = trainer.evaluate(x, y, batch_size=4)
+        cached = trainer.evaluate(x, y, batch_size=4, cache="device")
+        assert cached["loss"] == pytest.approx(streamed["loss"], rel=1e-5)
+        assert cached["accuracy"] == pytest.approx(streamed["accuracy"], rel=1e-6)
+        # Second call reuses the staged set (same ids → one cache entry).
+        trainer.evaluate(x, y, batch_size=4, cache="device")
+        assert len(trainer._eval_cache) == 1
+
+    def test_different_dataset_restages(self):
+        x, y = _data(n=64)
+        trainer = self._trainer(x, y)
+        a = trainer.evaluate(x, y, batch_size=4, cache="device")
+        x2, y2 = _data(n=64, seed=9)
+        b = trainer.evaluate(x2, y2, batch_size=4, cache="device")
+        assert len(trainer._eval_cache) == 2
+        assert a != b  # different data, different result
+
+    def test_validation_in_device_cached_fit(self):
+        x, y = _data(n=256)
+        xv, yv = _data(n=100, seed=5)
+        trainer = hvt.Trainer(Probe(), hvt.DistributedOptimizer(optax.adam(5e-3)))
+        hist = trainer.fit(
+            x=x, y=y, batch_size=4, epochs=2, cache="device",
+            validation_data=(xv, yv), verbose=0,
+        )
+        assert "val_loss" in hist[-1]
+        ref = trainer.evaluate(xv, yv, batch_size=4)
+        assert hist[-1]["val_loss"] == pytest.approx(ref["loss"], rel=1e-5)
+
+
 class TestDevicePrefetcher:
     def test_order_and_values(self):
         out = list(DevicePrefetcher(iter(range(10)), lambda v: v * 2))
