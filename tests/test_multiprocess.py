@@ -85,10 +85,13 @@ class TestMultiProcessTraining:
         # Final test-set loss reached the sink exactly once (single writer).
         assert sum(1 for m in metrics if m["name"] == "loss" and m["step"] is None) == 1
 
-    def test_multiprocess_matches_single_process(self, tmp_path):
+    @pytest.mark.parametrize("cache", ["stream", "device"])
+    def test_multiprocess_matches_single_process(self, tmp_path, cache):
         """Same data, same seed, same global batch: a 2-process x 2-device run
         and a 1-process x 4-device run must produce identical training math —
         the process boundary is a deployment detail, not a semantics change.
+        Covered for BOTH input paths: the streamed pipeline and the
+        device-resident dataset (each process staging its chips' shards).
         Each worker writes its final params' digest; digests must agree."""
         script = tmp_path / "digest.py"
         script.write_text(textwrap.dedent(f"""
@@ -121,11 +124,16 @@ class TestMultiProcessTraining:
                 hvt.DistributedOptimizer(optax.sgd(0.05)),
                 loss="sparse_categorical_crossentropy",
             )
+            fit_kw = (
+                {{"cache": "device"}}
+                if os.environ.get("DIGEST_CACHE") == "device"
+                else {{"shuffle_buffer": 1}}  # deterministic order
+            )
             trainer.fit(
                 x=x, y=y, batch_size=32, epochs=1, steps_per_epoch=4,
-                shuffle_buffer=1,  # deterministic order
                 callbacks=[hvt.callbacks.BroadcastGlobalVariablesCallback(0)],
                 verbose=0,
+                **fit_kw,
             )
             import jax
             leaves = jax.tree.leaves(jax.device_get(trainer.state.params))
@@ -140,7 +148,10 @@ class TestMultiProcessTraining:
             code = launcher.run_local(
                 nprocs,
                 [sys.executable, str(script)],
-                env=_mp_env(tmp_path, devices_per_proc=devs, DIGEST_OUT=out),
+                env=_mp_env(
+                    tmp_path, devices_per_proc=devs, DIGEST_OUT=out,
+                    DIGEST_CACHE=cache,
+                ),
                 tag_output=False,
             )
             assert code == 0
