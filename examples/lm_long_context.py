@@ -119,17 +119,33 @@ def main() -> None:
     epochs = int(os.environ.get("DRIVE_EPOCHS", 0)) or 4
     steps = int(os.environ.get("DRIVE_STEPS", 0)) or 64
 
+    # HVT_DEVICE_CACHE=1: HBM-resident dataset, one dispatch per epoch
+    # (pure-GSPMD meshes only — the seq-sharded batch layout needs the
+    # streamed path's batch_specs handling).
+    device_cache = hvt.runtime.env_flag("HVT_DEVICE_CACHE") and all(
+        mesh.shape.get(ax, 1) == 1
+        for ax in (
+            mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
+            mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS,
+        )
+    )
+    if device_cache:
+        fit_kwargs = {"cache": "device"}
+        if os.environ.get("DRIVE_STEPS"):  # honor an explicit step budget
+            fit_kwargs["steps_per_epoch"] = steps
+    else:
+        fit_kwargs = {"steps_per_epoch": steps}
     trainer.fit(
         x=x, y=y,
         batch_size=max(1, 16 // mesh_lib.dp_size(mesh)),
         epochs=epochs,
-        steps_per_epoch=steps,
         callbacks=[
             hvt.callbacks.BroadcastGlobalVariablesCallback(0),
             hvt.callbacks.MetricAverageCallback(),
             hvt.callbacks.MetricsPushCallback(),
         ],
         verbose=1 if hvt.rank() == 0 else 0,
+        **fit_kwargs,
     )
 
     # Recall-half report on held-out sequences.

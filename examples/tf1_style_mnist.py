@@ -93,10 +93,7 @@ def main() -> None:
     # drastically less host↔device traffic. Off by default to mirror the
     # reference's streaming pipeline.
     fit_kwargs = (
-        {"cache": "device"}
-        if os.environ.get("HVT_DEVICE_CACHE", "").lower()
-        not in ("", "0", "false", "no")
-        else {}
+        {"cache": "device"} if hvt.runtime.env_flag("HVT_DEVICE_CACHE") else {}
     )
     trainer.fit(  # :107-112
         x=x_train,

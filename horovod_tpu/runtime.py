@@ -60,6 +60,13 @@ ENV_NUM_CPU_DEVICES = "HVT_NUM_CPU_DEVICES"
 _initialized = False
 
 
+def env_flag(name: str) -> bool:
+    """Shared boolean env-var contract: unset/''/'0'/'false'/'no' are off
+    (case-insensitive), anything else is on. Used for every HVT_* switch so
+    the accepted spellings can't drift between call sites."""
+    return os.environ.get(name, "").lower() not in ("", "0", "false", "no")
+
+
 @dataclasses.dataclass(frozen=True)
 class World:
     """Snapshot of the distributed topology after init()."""
@@ -102,7 +109,7 @@ def init(
         jax.config.update("jax_platforms", os.environ[ENV_PLATFORM])
     if os.environ.get(ENV_NUM_CPU_DEVICES):
         jax.config.update("jax_num_cpu_devices", int(os.environ[ENV_NUM_CPU_DEVICES]))
-    if os.environ.get("HVT_FAST_RNG", "").lower() not in ("", "0", "false", "no"):
+    if env_flag("HVT_FAST_RNG"):
         # TPU hardware RNG for dropout/init keys: threefry (the reproducible
         # default) costs real step time when dropout is on (~12% on the LM
         # bench); 'rbg' makes it free. Opt-in — rbg streams are not

@@ -473,6 +473,20 @@ class Trainer:
         if cache == "device":
             if x is None or y is None:
                 raise ValueError("cache='device' needs x=/y= arrays")
+            if self.batch_specs is not None and any(
+                self.mesh.shape.get(ax, 1) > 1
+                for ax in (
+                    mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
+                    mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS,
+                )
+            ):
+                # The staged layout shards the batch dim only; custom batch
+                # layouts over live non-data axes (e.g. seq-sharded tokens)
+                # need the streamed path's batch_specs handling.
+                raise ValueError(
+                    "cache='device' supports data-sharded batches only; "
+                    "use the streamed fit path with batch_specs meshes"
+                )
             return self._fit_device_cached(
                 x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
                 callbacks, validation_data, verbose,
@@ -787,9 +801,17 @@ class Trainer:
         runs the whole pass as one compiled scan."""
         if self.state is None:
             raise RuntimeError("call fit() or build() first")
-        if cache == "device" and self.batch_specs is not None:
-            # Custom batch layouts (e.g. sequence-sharded tokens) need
-            # _shard's spec handling; the cached path stages batch-dim-only.
+        if cache == "device" and self.batch_specs is not None and any(
+            self.mesh.shape.get(ax, 1) > 1
+            for ax in (
+                mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
+                mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS,
+            )
+        ):
+            # Custom batch layouts over LIVE non-data axes (e.g. seq-sharded
+            # tokens) need _shard's spec handling; the cached path stages
+            # batch-dim-only. With those axes trivial the layouts coincide —
+            # same condition as fit(cache='device')'s guard.
             cache = None
         if cache == "device":
             result = self._evaluate_device_cached(x, y, batch_size)
