@@ -122,16 +122,12 @@ def main() -> None:
     # HVT_DEVICE_CACHE=1: HBM-resident dataset, one dispatch per epoch
     # (pure-GSPMD meshes only — the seq-sharded batch layout needs the
     # streamed path's batch_specs handling).
-    device_cache = hvt.runtime.env_flag("HVT_DEVICE_CACHE") and all(
-        mesh.shape.get(ax, 1) == 1
-        for ax in (
-            mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
-            mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS,
-        )
-    )
+    device_cache = hvt.runtime.env_flag(
+        "HVT_DEVICE_CACHE"
+    ) and not mesh_lib.has_live_model_axes(mesh)
     if device_cache:
         fit_kwargs = {"cache": "device"}
-        if os.environ.get("DRIVE_STEPS"):  # honor an explicit step budget
+        if int(os.environ.get("DRIVE_STEPS", 0)):  # honor an explicit budget
             fit_kwargs["steps_per_epoch"] = steps
     else:
         fit_kwargs = {"steps_per_epoch": steps}

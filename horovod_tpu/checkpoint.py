@@ -134,14 +134,47 @@ def latest_checkpoint(directory: str) -> str | None:
     return best
 
 
+def _host_syncable(leaf) -> bool:
+    """Whether a leaf's value can be host-gathered on any single process:
+    non-arrays, process-local arrays, and fully-REPLICATED global arrays
+    (device_get special-cases those even when they span hosts). Only arrays
+    genuinely SHARDED across processes (model-parallel stage/TP/FSDP shards)
+    are excluded — they cannot be gathered from one process and need no sync
+    either: every process materialized them from the same deterministic SPMD
+    init program."""
+    return (
+        not isinstance(leaf, jax.Array)
+        or leaf.is_fully_replicated
+        or leaf.is_fully_addressable
+    )
+
+
 def broadcast_parameters(tree: PyTree, root_rank: int = 0, mesh=None) -> PyTree:
     """``hvd.broadcast_global_variables(0)`` equivalent for any pytree:
-    every process adopts the root's values; with ``mesh`` given the result is
-    re-placed replicated on the mesh."""
+    every process adopts the root's values; with ``mesh`` given,
+    host-syncable leaves are re-placed replicated on the mesh, and with
+    ``mesh=None`` each leaf keeps its own sharding. Leaves sharded across
+    processes are left untouched (see `_host_syncable`)."""
     if jax.process_count() > 1:
-        tree = collectives.broadcast_pytree(jax.device_get(tree), root=root_rank)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        idx = [i for i, l in enumerate(leaves) if _host_syncable(l)]
+        synced = collectives.broadcast_pytree(
+            [jax.device_get(leaves[i]) for i in idx], root=root_rank
+        )
+        for i, host_val in zip(idx, synced):
+            old = leaves[i]
+            if isinstance(old, jax.Array) and mesh is None:
+                leaves[i] = jax.device_put(host_val, old.sharding)
+            else:
+                leaves[i] = host_val
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
     if mesh is not None:
-        tree = sharding.replicate(tree, mesh)
+        tree = jax.tree.map(
+            lambda l: jax.device_put(l, sharding.replicated(mesh))
+            if _host_syncable(l)
+            else l,
+            tree,
+        )
     return tree
 
 

@@ -98,6 +98,16 @@ def dp_size(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
 
 
+def has_live_model_axes(mesh: Mesh) -> bool:
+    """True when any non-data axis (pipe/seq/model/expert) is larger than 1 —
+    the condition under which batch layouts can involve more than plain
+    data-axis sharding (used to gate the device-cached fit/eval paths)."""
+    return any(
+        mesh.shape.get(ax, 1) > 1
+        for ax in (PIPE_AXIS, SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS)
+    )
+
+
 # --- World-size-reactive hyperparameter helpers (SURVEY.md §5.6) -----------
 
 

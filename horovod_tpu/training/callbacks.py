@@ -69,10 +69,16 @@ class BroadcastGlobalVariablesCallback(Callback):
     def on_train_begin(self, logs=None):
         if jax.process_count() == 1:
             return
-        state = collectives.broadcast_pytree(
-            jax.device_get(self.trainer.state), root=self.root_rank
+        from horovod_tpu import checkpoint
+
+        # Leaf-wise with each leaf keeping its own sharding: replicated
+        # leaves (the reference's DP state) sync from the root; leaves
+        # sharded ACROSS processes (pipe/TP stages) are left in place — they
+        # cannot be host-gathered and were materialized identically on every
+        # process by the deterministic SPMD init (checkpoint._host_syncable).
+        self.trainer.state = checkpoint.broadcast_parameters(
+            self.trainer.state, self.root_rank
         )
-        self.trainer.state = sharding.replicate(state, self.trainer.mesh)
 
 
 class MetricAverageCallback(Callback):

@@ -422,16 +422,41 @@ class Trainer:
             return tuple(put(x, spec) for x, spec in zip(batch, specs))
         return sharding_lib.shard_batch(batch, self.mesh)
 
-    def _local_slice(self, arr, global_batch: int):
-        """This process's 1/world share of a globally-indexed batch — what
-        `make_array_from_process_local_data` expects as the local
-        contribution (each example fed exactly once across the fleet)."""
+    def _feed_groups(self) -> tuple[int, int]:
+        """(n_groups, my_group): how processes map onto the data axis.
+
+        Processes feed batches in ``min(world, dp_size)`` distinct groups.
+        With dp >= world (the usual DP deployment) every process is its own
+        group. With dp < world (model-parallel-only meshes spanning
+        processes, e.g. pipe=2 over 2 hosts) several processes share one
+        data shard and MUST feed identical rows — the batch is logically
+        replicated across the non-data axes, and divergent per-process
+        contributions would silently give each device different contents
+        for the same global array."""
         world = runtime.process_count()
-        if world == 1:
+        dp = self.dp_size
+        groups = min(world, dp)
+        if world % groups != 0 or (dp >= world and dp % world != 0):
+            # e.g. 3 processes over dp=2: some rank would straddle two data
+            # shards and the grouping below would slice out-of-range rows —
+            # fail loudly instead of feeding wrong data.
+            raise ValueError(
+                f"process count ({world}) and data-parallel degree ({dp}) "
+                "must divide one another for a coherent feeding layout"
+            )
+        per_group = world // groups
+        return groups, runtime.process_rank() // per_group
+
+    def _local_slice(self, arr, global_batch: int):
+        """This feed-group's share of a globally-indexed batch — what
+        `make_array_from_process_local_data` expects as the local
+        contribution (each example fed exactly once across the data axis;
+        processes sharing a data shard contribute identical rows)."""
+        if runtime.process_count() == 1:
             return arr
-        local = global_batch // world
-        r = runtime.process_rank()
-        return arr[r * local : (r + 1) * local]
+        groups, group = self._feed_groups()
+        local = global_batch // groups
+        return arr[group * local : (group + 1) * local]
 
     # --- Keras-parity verbs -------------------------------------------------
 
@@ -473,12 +498,8 @@ class Trainer:
         if cache == "device":
             if x is None or y is None:
                 raise ValueError("cache='device' needs x=/y= arrays")
-            if self.batch_specs is not None and any(
-                self.mesh.shape.get(ax, 1) > 1
-                for ax in (
-                    mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
-                    mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS,
-                )
+            if self.batch_specs is not None and mesh_lib.has_live_model_axes(
+                self.mesh
             ):
                 # The staged layout shards the batch dim only; custom batch
                 # layouts over live non-data axes (e.g. seq-sharded tokens)
@@ -494,16 +515,17 @@ class Trainer:
         if cache is not None:
             raise ValueError(f"unknown cache mode {cache!r}")
 
-        world = runtime.process_count()
+        groups, group = self._feed_groups()
         close_input = lambda: None  # noqa: E731
         if dataset is None:
             if x is None or y is None:
                 raise ValueError("pass either dataset= or x=/y=")
-            ds = ArrayDataset((x, y)).shard(runtime.process_rank(), world)
+            ds = ArrayDataset((x, y)).shard(group, groups)
             n_local = ds.num_examples
-            # Global batch = per-worker batch × dp_size; each process feeds
-            # its 1/world share of it.
-            local_batch = batch_size * self.dp_size // world
+            # Global batch = per-worker batch × dp_size; each feed group
+            # contributes its share (see _feed_groups for the dp < world
+            # case, where processes sharing a shard feed identical rows).
+            local_batch = batch_size * self.dp_size // groups
             if steps_per_epoch is None:
                 steps_per_epoch = max(1, n_local // local_batch)
             # Batch assembly runs in the native C++ producer thread when
@@ -558,14 +580,14 @@ class Trainer:
     def _stage_sharded(self, arr, per_shard: int):
         """Stage one host array as [n_shards, per_shard, ...] in HBM,
         example-sharded over the data axes: shard s takes rows
-        [s*per_shard, (s+1)*per_shard); multi-process, each process
-        contributes the rows for its own chips."""
-        world = runtime.process_count()
-        local_shards = self.dp_size // world
-        r = runtime.process_rank()
+        [s*per_shard, (s+1)*per_shard); multi-process, each feed group
+        contributes the rows for its chips (processes sharing a data shard
+        stage identical rows — see _feed_groups)."""
+        groups, group = self._feed_groups()
+        local_shards = self.dp_size // groups
         arr = np.asarray(arr)
-        lo = r * local_shards * per_shard
-        hi = (r + 1) * local_shards * per_shard
+        lo = group * local_shards * per_shard
+        hi = (group + 1) * local_shards * per_shard
         local = arr[lo:hi].reshape((local_shards, per_shard) + arr.shape[1:])
         spec = jax.sharding.PartitionSpec(
             (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
@@ -801,12 +823,10 @@ class Trainer:
         runs the whole pass as one compiled scan."""
         if self.state is None:
             raise RuntimeError("call fit() or build() first")
-        if cache == "device" and self.batch_specs is not None and any(
-            self.mesh.shape.get(ax, 1) > 1
-            for ax in (
-                mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
-                mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS,
-            )
+        if (
+            cache == "device"
+            and self.batch_specs is not None
+            and mesh_lib.has_live_model_axes(self.mesh)
         ):
             # Custom batch layouts over LIVE non-data axes (e.g. seq-sharded
             # tokens) need _shard's spec handling; the cached path stages
