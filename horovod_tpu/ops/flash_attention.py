@@ -32,10 +32,17 @@ from jax.experimental.pallas import tpu as pltpu
 from horovod_tpu.ops.attention import dense_attention
 
 _BIG_NEG = -1e30
-# 512-square tiles: ~2.4x over XLA's materialized attention at T=2048 on
-# v5e (measured in BASELINE.md); still well inside VMEM for D ≤ 128 in f32.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# 1024-square tiles won the measured block sweep on v5e (benchmarks/
+# fa_tune.py): vs 512² they are 1.23x at T=1024 and 1.27-1.4x at T=8192
+# (fwd and fwd+bwd), because each K/V block amortizes the per-block
+# online-softmax statistics (max/renormalize) over 4x the scores. The
+# [bq, bk] f32 score tile is 4 MB — fine for VMEM at D ≤ 128; for wider
+# heads `flash_attention` drops to 512 to keep the working set bounded.
+# Tuned for v5e-class VMEM (16 MiB): on a smaller-VMEM TPU generation an
+# oversized tile fails LOUDLY at Mosaic compile time (not silent wrong
+# results) — pass block_q/block_k=512 there.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 
 
 def _causal_mask(iq, ik, bq, bk):
@@ -334,6 +341,10 @@ def supported(q_shape, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K,
     falling back), and K/V must share q's sequence length — the grid is
     derived from q's T, so a cross-attention call with Tk != Tq would index
     K/V blocks out of range (silent garbage in interpret mode).
+
+    This checks ONE given block config; it is not a will-the-kernel-run
+    predicate for `flash_attention`, which first degrades the config via
+    `pick_blocks` — probe with ``supported(shape, *pick_blocks(...))``.
     """
     b, t, h, d = q_shape
     if k_shape is not None and k_shape[1] != t:
@@ -346,6 +357,30 @@ def supported(q_shape, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K,
     )
 
 
+def pick_blocks(t: int, d: int, dtype, bq: int = DEFAULT_BLOCK_Q,
+                bk: int = DEFAULT_BLOCK_K) -> tuple[int, int]:
+    """Largest workable (block_q, block_k) ≤ the requested sizes for a
+    [*, t, *, d] attention call: clamp for wide heads (a 1024² f32 score
+    tile + wide q/k/v blocks would crowd VMEM), clamp to T, then halve until
+    the block divides T — so e.g. T=1536 runs 512² tiles instead of
+    regressing to the dense fallback just because 1536 % 1024 != 0."""
+    if d > 128:
+        bq, bk = min(bq, 512), min(bk, 512)
+    bq, bk = min(bq, t), min(bk, t)
+    # Degrade no further than 128: below that the kernel's tiny score tiles
+    # underfill the MXU and the dense fallback is faster — leaving a
+    # non-dividing block here makes `supported` reject and fall back.
+    # (Explicitly-passed smaller blocks are honored, not degraded-to; the
+    # `bq // 2 >= floor` guard keeps non-power-of-two explicit blocks from
+    # halving THROUGH the floor, e.g. 384 → 192 stops rather than → 96.)
+    floor = max(_sublane(dtype), 128)
+    while t % bq and bq // 2 >= floor:
+        bq //= 2
+    while t % bk and bk // 2 >= floor:
+        bk //= 2
+    return bq, bk
+
+
 def flash_attention(
     q, k, v, *,
     causal: bool = True,
@@ -356,8 +391,9 @@ def flash_attention(
     """[B,T,H,D] attention via the pallas kernel; dense fallback when the
     tiling doesn't hold. ``interpret=None`` auto-selects the pallas
     interpreter off-TPU so tests/CPU paths run the same kernel code."""
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    block_q, block_k = pick_blocks(
+        q.shape[1], q.shape[-1], q.dtype, block_q, block_k
+    )
     if not supported(q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype):
         return dense_attention(q, k, v, causal=causal)
     if interpret is None:

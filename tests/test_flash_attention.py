@@ -7,7 +7,11 @@ import numpy as np
 import pytest
 
 from horovod_tpu.ops.attention import dense_attention
-from horovod_tpu.ops.flash_attention import flash_attention, supported
+from horovod_tpu.ops.flash_attention import (
+    flash_attention,
+    pick_blocks,
+    supported,
+)
 
 B, T, H, D = 2, 128, 4, 64
 BLOCKS = dict(block_q=32, block_k=32)
@@ -79,6 +83,55 @@ class TestForward:
         expected = dense_attention(q, k, v, causal=False)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestPickBlocks:
+    """Block selection: the kernel must degrade block size, not fall back to
+    dense, for sequence lengths the default 1024² tiles don't divide."""
+
+    def test_divisor_fallthrough(self):
+        # 1536 % 1024 != 0 → halve to 512 (1536 % 512 == 0), both axes.
+        assert pick_blocks(1536, 64, jnp.bfloat16) == (512, 512)
+        bq, bk = pick_blocks(1536, 64, jnp.bfloat16)
+        assert supported((1, 1536, 2, 64), bq, bk, dtype=jnp.bfloat16)
+
+    def test_full_blocks_at_long_seq(self):
+        assert pick_blocks(8192, 64, jnp.bfloat16) == (1024, 1024)
+
+    def test_clamped_to_t(self):
+        assert pick_blocks(512, 64, jnp.bfloat16) == (512, 512)
+        assert pick_blocks(128, 64, jnp.float32) == (128, 128)
+
+    def test_wide_head_clamp(self):
+        # D > 128 keeps the f32 score tile + wide blocks inside VMEM.
+        assert pick_blocks(4096, 256, jnp.bfloat16) == (512, 512)
+
+    def test_degradation_floor(self):
+        """Awkward T (1040 = 16·65) must NOT degrade below 128 into tiny
+        MXU-underfilling tiles; the non-dividing 128 makes supported()
+        reject → dense fallback, which is faster there."""
+        bq, bk = pick_blocks(1040, 64, jnp.bfloat16)
+        assert (bq, bk) == (128, 128)
+        assert not supported((1, 1040, 2, 64), bq, bk, dtype=jnp.bfloat16)
+        # Explicit small blocks are honored, not degraded-to.
+        assert pick_blocks(128, 64, jnp.float32, 32, 32) == (32, 32)
+        # Non-power-of-two explicit blocks stop AT the floor boundary
+        # instead of halving through it (384 → 192, not → 96).
+        assert pick_blocks(1056, 64, jnp.float32, 384, 384) == (192, 192)
+
+    def test_odd_t_runs_kernel_via_smaller_blocks(self):
+        """T=1536 must run the pallas kernel (via 512² tiles), matching
+        dense numerics — previously this shape regressed to dense."""
+        rng = np.random.RandomState(7)
+        q, k, v = (
+            jnp.asarray(rng.randn(1, 1536, 2, 16).astype(np.float32))
+            for _ in range(3)
+        )
+        out = flash_attention(q, k, v, causal=True)
+        expected = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
         )
 
 
