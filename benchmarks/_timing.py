@@ -1,0 +1,50 @@
+"""Shared honest-timing helper for the on-chip benchmark scripts.
+
+One fused `lax.scan` chains N iterations of a step function with a carried
+perturbation; the clock stops only after fetching a scalar that
+data-depends on the whole chain. Two hazards this guards against on
+tunneled TPU runtimes (measured, see BASELINE.md "Measurement
+methodology"):
+
+* dispatch-loop timing: `block_until_ready` on chained dispatches can
+  return before the device finished — hence ONE compiled scan + a value
+  fetch;
+* XLA optimizing the chain away: a `0 * out` perturbation gets folded to
+  0, the carry becomes loop-invariant, and LICM hoists the body out of the
+  loop (a "305 TFLOP/s matmul" on a 197-peak chip); linear functionals of
+  a matmul (slices, sums) get rewritten into contractions of the operands
+  — consume outputs nonlinearly and fold with a tiny-but-NONZERO factor.
+
+The residual bias is one tunnel round-trip over the whole chain (~RTT/N);
+min-of-`repeats` filters RTT spikes. Two-point slope timing between chain
+lengths was tried and rejected: RTT jitter between runs exceeds the
+per-step work difference.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def timed_chain(step, x0, *, steps: int, repeats: int = 3) -> float:
+    """Seconds per iteration of ``step`` (carry -> device scalar)."""
+
+    def body(carry, _):
+        out_scalar = step(carry)
+        eps = (1.0 + 1e-30 * out_scalar).astype(carry.dtype)
+        return carry * eps, out_scalar
+
+    @jax.jit
+    def run(x):
+        carry, outs = jax.lax.scan(body, x, None, length=steps)
+        return outs[-1] + 0.0 * carry.sum()
+
+    float(jax.device_get(run(x0)))  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(jax.device_get(run(x0)))
+        best = min(best, time.perf_counter() - t0)
+    return best / steps

@@ -11,7 +11,7 @@ methodology").
 
 Usage: python benchmarks/fa_tune.py [case ...]
   cases: matmul dense ours stock  (default: all)
-Env: FA_SHAPES="B,T,H,D;..."  FA_STEPS=8
+Env: FA_SHAPES="B,T,H,D;..."  FA_STEPS=256
 """
 
 from __future__ import annotations
@@ -19,7 +19,6 @@ from __future__ import annotations
 import functools
 import os
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -28,39 +27,13 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-STEPS = int(os.environ.get("FA_STEPS", 8))
+STEPS = int(os.environ.get("FA_STEPS", 256))
 
 
 def timed_chain(step, x0):
-    """s/iter: one fused scan of STEPS iterations, min of 3 timed runs.
+    from _timing import timed_chain as _tc
 
-    Long chains shrink the tunnel's per-dispatch round-trip to RTT/STEPS
-    (~0.2 ms at 256) and min-of-3 filters RTT spikes; two-point slope timing
-    was tried and is unusable here — the RTT jitter between runs exceeds the
-    per-step work difference."""
-
-    def body(carry, _):
-        out_scalar = step(carry)
-        # fold the result back into the carry so iterations chain. The
-        # factor must be tiny-but-NONZERO: XLA's algebraic simplifier folds
-        # `0*x` to 0, which makes the carry loop-invariant and lets LICM
-        # hoist the whole body out of the scan (measured: a "305 TFLOP/s"
-        # matmul on a 197-peak chip).
-        eps = (1.0 + 1e-30 * out_scalar).astype(carry.dtype)
-        return carry * eps, out_scalar
-
-    @jax.jit
-    def run(x):
-        carry, outs = jax.lax.scan(body, x, None, length=STEPS)
-        return outs[-1] + 0.0 * carry.sum()
-
-    float(jax.device_get(run(x0)))  # compile + warm
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(jax.device_get(run(x0)))
-        best = min(best, time.perf_counter() - t0)
-    return best / STEPS
+    return _tc(step, x0, steps=STEPS)
 
 
 def attn_flops(b, t, h, d, causal=True, with_bwd=True):

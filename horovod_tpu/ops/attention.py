@@ -125,7 +125,12 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     Inside `shard_map` with ``[B, T/n, H, D]`` shards; requires ``H % n == 0``.
     Two `lax.all_to_all` pairs per call — cheaper than a ring when n is small
     and heads are plentiful; the full-sequence [T] intermediate bounds the
-    max context per chip (ring has no such bound)."""
+    max context per chip (ring has no such bound).
+
+    The local full-sequence attention runs the pallas flash kernel when its
+    tiling holds (O(T) memory — without it, the [T, T] score matrix would
+    cancel most of what head-swapping buys at long context), with the dense
+    path as fallback exactly like `flash_attention` itself."""
     n = lax.axis_size(axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(
@@ -138,5 +143,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     def to_seq(x):  # [B,T,H/n,D] -> [B,T/n,H,D]
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    out = dense_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    out = flash_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
     return to_seq(out)

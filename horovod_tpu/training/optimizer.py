@@ -19,9 +19,24 @@ must be requested by name.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import optax
 
-from horovod_tpu.parallel.collectives import allreduce, pmean_pytree
+from horovod_tpu.parallel.collectives import allreduce
+
+
+_COMPRESSION_DTYPES = {
+    # Horovod's `compression=Compression.fp16` knob (part of the 0.18.1
+    # DistributedOptimizer signature): halve the bytes each gradient moves
+    # over the interconnect. On TPU the native 16-bit format is bfloat16
+    # (same exponent range as f32 — no loss-scaling needed); fp16 is
+    # accepted for API familiarity.
+    "none": None,
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
 
 
 def DistributedOptimizer(
@@ -30,6 +45,7 @@ def DistributedOptimizer(
     average: bool = True,
     backward_passes_per_step: int = 1,
     average_aggregated_gradients: bool = False,
+    compression: str = "none",
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates consume cross-worker-averaged gradients.
 
@@ -48,20 +64,34 @@ def DistributedOptimizer(
       average_aggregated_gradients: Horovod-parity default False — the N
         accumulated gradients are SUMMED (Horovod's
         ``average_aggregated_gradients`` default); True averages them.
+      compression: ``'none'`` | ``'bf16'`` | ``'fp16'`` — cast each gradient
+        to the 16-bit dtype for the cross-worker reduction and back after
+        (Horovod's ``Compression.fp16`` role: half the ICI/DCN bytes).
+        Only meaningful with an explicit ``axis_name`` — in SPMD-jit mode
+        the gradient reduction is placed by XLA inside the backward pass,
+        before this wrapper ever sees a tensor, so there is nothing to
+        compress here and the argument (other than validation) is inert.
     """
+    if compression not in _COMPRESSION_DTYPES:
+        raise ValueError(
+            f"unknown compression {compression!r}; "
+            f"expected one of {sorted(_COMPRESSION_DTYPES)}"
+        )
+    comm_dtype = _COMPRESSION_DTYPES[compression]
 
     def init_fn(params):
         return optimizer.init(params)
 
+    def _reduce(g):
+        orig = g.dtype
+        if comm_dtype is not None and g.dtype == jnp.float32:
+            g = g.astype(comm_dtype)
+        g = allreduce(g, average=average, axis_name=axis_name)
+        return g.astype(orig)
+
     def update_fn(updates, state, params=None, **extra):
         if axis_name is not None:
-            if average:
-                updates = pmean_pytree(updates, axis_name)
-            else:
-                updates = jax.tree.map(
-                    lambda g: allreduce(g, average=False, axis_name=axis_name),
-                    updates,
-                )
+            updates = jax.tree.map(_reduce, updates)
         return optimizer.update(updates, state, params, **extra)
 
     tx = optax.GradientTransformation(init_fn, update_fn)

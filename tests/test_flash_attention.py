@@ -123,6 +123,10 @@ class TestPickBlocks:
     def test_odd_t_runs_kernel_via_smaller_blocks(self):
         """T=1536 must run the pallas kernel (via 512² tiles), matching
         dense numerics — previously this shape regressed to dense."""
+        # The kernel-actually-runs guard: the picked blocks must tile T
+        # (dense-vs-dense would trivially pass the parity check below).
+        bq, bk = pick_blocks(1536, 16, jnp.float32)
+        assert supported((1, 1536, 2, 16), bq, bk, dtype=jnp.float32)
         rng = np.random.RandomState(7)
         q, k, v = (
             jnp.asarray(rng.randn(1, 1536, 2, 16).astype(np.float32))
