@@ -60,7 +60,14 @@ def _rope(x, positions, *, base: float = 10000.0):
 
 @dataclasses.dataclass(frozen=True)
 class ShardingConfig:
-    """How the model meets the mesh. ``attn='ring'|'ulysses'|'dense'``."""
+    """How the model meets the mesh.
+
+    ``attn``: ``'ring'`` (sequence-parallel ring attention, flash-kernel
+    block compute), ``'ring_dense'`` (ring with dense per-hop scores — the
+    numerics ground truth), ``'ulysses'`` (all-to-all head swap), or
+    ``'dense'`` (materialized-score attention, the numerics reference —
+    NOT flash; on a mesh without a live ``seq`` axis the 'ring'/'ulysses'
+    settings take the local flash-kernel path instead)."""
 
     mesh: Mesh | None = None
     attn: str = "ring"
@@ -118,7 +125,8 @@ class Block(nn.Module):
 
         if cfg.seq_parallel:
             impls = {
-                "ring": attention_ops.ring_attention,
+                "ring": attention_ops.ring_flash_attention,
+                "ring_dense": attention_ops.ring_attention,
                 "ulysses": attention_ops.ulysses_attention,
             }
             if cfg.attn not in impls:

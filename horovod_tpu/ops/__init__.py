@@ -1,9 +1,10 @@
-"""TPU compute ops: attention implementations (dense / ring / Ulysses) and
-pallas kernels for the hot paths."""
+"""TPU compute ops: attention implementations (dense / ring / ring-flash /
+Ulysses) and pallas kernels for the hot paths."""
 
 from horovod_tpu.ops.attention import (  # noqa: F401
     dense_attention,
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )
 

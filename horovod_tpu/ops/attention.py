@@ -118,6 +118,69 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
 
 
+def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+    """Ring attention whose per-hop block attention is the pallas flash
+    kernel — the within-chip and cross-chip halves of the SAME online
+    softmax: each hop computes its block's ``(out, lse)`` in O(T/n) memory
+    on the MXU (`flash_attention_with_lse`), and the hop results merge by
+    the standard logsumexp recurrence. Versus `ring_attention` (dense
+    per-hop scores) this never materializes a [T/n, T/n] f32 score matrix
+    in HBM and skips — not just masks — the above-diagonal hops via
+    `lax.cond`, so a causal ring does ~half the block work.
+
+    Same contract as `ring_attention`: call inside `shard_map` with
+    ``[B, T/n, H, D]`` sequence shards; n == 1 degrades to exactly the
+    local flash/dense path."""
+    from horovod_tpu.ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+
+    def hop_contrib(j, k_blk, v_blk):
+        """(out, lse) of my queries against global block j."""
+
+        def diag(_):
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=True)
+
+        def full(_):
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=False)
+
+        def skip(_):
+            # Entirely above the diagonal: lse = -BIG weights it to zero in
+            # the merge without running any attention.
+            return (
+                jnp.zeros((b, t_local, h, d), q.dtype),
+                jnp.full((b, t_local, h), _BIG_NEG, jnp.float32),
+            )
+
+        if not causal:
+            return full(None)
+        return lax.cond(
+            j == my, diag, lambda x: lax.cond(j < my, full, skip, x), None
+        )
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        j = (my - i) % n  # the block born at rank j is here after i hops
+        o_j, lse_j = hop_contrib(j, k_blk, v_blk)
+        m_new = jnp.maximum(m, lse_j)
+        alpha = jnp.exp(m - m_new)
+        w = jnp.exp(lse_j - m_new)
+        l_new = l * alpha + w
+        o_new = o * alpha[..., None] + o_j.astype(jnp.float32) * w[..., None]
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_blk, v_blk), None
+
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    m0 = jnp.full((b, t_local, h), _BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((b, t_local, h), jnp.float32)
+    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    return (o / l[..., None]).astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     """All-to-all sequence parallelism: swap seq-sharding for head-sharding,
     attend over the full sequence locally, swap back.

@@ -265,6 +265,15 @@ def _flash_fwd(q, k, v, causal, bq, bk, interpret):
 
 
 def _flash_bwd(causal, bq, bk, interpret, res, g):
+    return _flash_bwd_core(causal, bq, bk, interpret, res, g, None)
+
+
+def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
+    """Shared backward: the lse cotangent (from `flash_attention_with_lse`
+    consumers like the ring merge) folds into the per-row jacobian term —
+    with s → p = exp(s−lse), o = p·v:  ds = p ⊙ (dp − (δ − dlse)) where
+    δ_i = Σ_d dO·O, because ∂lse/∂s = p. So the kernels run unchanged with
+    an adjusted δ."""
     q, k, v, out, lse = res
     qt, kt, vt, gt = (
         jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v, g)
@@ -275,6 +284,9 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
     delta = jnp.einsum(
         "bthd,bthd->bht", g.astype(jnp.float32), out.astype(jnp.float32)
     )[..., None]
+    if g_lse is not None:
+        # g_lse arrives in the caller-facing [B, T, H] layout.
+        delta = delta - jnp.transpose(g_lse, (0, 2, 1))[..., None]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
@@ -323,6 +335,77 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, bq, bk, interpret):
+    """Kernel entry that also RETURNS the per-row logsumexp — the statistic
+    a cross-chip online-softmax merge needs (ring attention: each hop's
+    (out, lse) pair is exactly one step of the recurrence)."""
+    out, lse = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
+    return out, jnp.transpose(lse[..., 0], (0, 2, 1))  # [B,H,T,1]→[B,T,H]
+
+
+def _flash_lse_fwd(q, k, v, causal, bq, bk, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
+    return (
+        (out, jnp.transpose(lse[..., 0], (0, 2, 1))),
+        (q, k, v, out, lse),
+    )
+
+
+def _flash_lse_bwd(causal, bq, bk, interpret, res, cotangents):
+    g, g_lse = cotangents
+    return _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _dense_with_lse(q, k, v, *, causal: bool):
+    """Dense (out, lse) fallback, numerically matching the kernel's
+    conventions: f32 statistics, fully-masked rows get lse = _BIG_NEG-ish
+    (so a merge weights them to zero), natively differentiable."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + (tk - tq)
+        cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(rows >= cols, s, _BIG_NEG)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", (p / l).astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    lse = (m + jnp.log(l))[..., 0]  # [B,H,Tq]
+    return out, jnp.transpose(lse, (0, 2, 1))  # [B,Tq,H]
+
+
+def flash_attention_with_lse(
+    q, k, v, *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+):
+    """[B,T,H,D] attention returning ``(out, lse)`` with ``lse`` [B,T,H] —
+    the building block for cross-chip softmax merges (ring attention).
+    Same kernel/fallback/interpret policy as `flash_attention`; gradients
+    flow through BOTH outputs (the lse cotangent folds into the kernel
+    backward's δ term)."""
+    block_q, block_k = pick_blocks(
+        q.shape[1], q.shape[-1], q.dtype, block_q, block_k
+    )
+    if not supported(q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype):
+        return _dense_with_lse(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_lse(q, k, v, causal, block_q, block_k, interpret)
 
 
 def _sublane(dtype) -> int:

@@ -9,6 +9,7 @@ import pytest
 from horovod_tpu.ops.attention import dense_attention
 from horovod_tpu.ops.flash_attention import (
     flash_attention,
+    flash_attention_with_lse,
     pick_blocks,
     supported,
 )
@@ -83,6 +84,73 @@ class TestForward:
         expected = dense_attention(q, k, v, causal=False)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestWithLse:
+    """The (out, lse) kernel entry that cross-chip merges build on."""
+
+    def _dense_ref(self, q, k, v, causal=True):
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            tq, tk = s.shape[-2:]
+            mask = (
+                jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+            )
+            s = jnp.where(mask, s, -1e30)
+        lse = jax.nn.logsumexp(s, axis=-1)  # [B,H,T]
+        return jnp.transpose(lse, (0, 2, 1))  # [B,T,H]
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_out_and_lse_match_dense(self, causal):
+        q, k, v = _qkv(5)
+        out, lse = flash_attention_with_lse(q, k, v, causal=causal, **BLOCKS)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(dense_attention(q, k, v, causal=causal)),
+            rtol=2e-5, atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse),
+            np.asarray(self._dense_ref(q, k, v, causal)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_lse_cotangent_flows(self):
+        """Gradients of a loss that CONSUMES lse must match the natively
+        differentiable dense computation — this is the δ-adjustment path in
+        the kernel's custom VJP."""
+        q, k, v = _qkv(6)
+
+        def loss_flash(q, k, v):
+            out, lse = flash_attention_with_lse(q, k, v, causal=True, **BLOCKS)
+            return (out ** 2).sum() + (lse ** 2).sum() * 0.1
+
+        def loss_dense(q, k, v):
+            out = dense_attention(q, k, v, causal=True)
+            lse = self._dense_ref(q, k, v, True)
+            return (out ** 2).sum() + (lse ** 2).sum() * 0.1
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_fallback_returns_lse_too(self):
+        """Shapes the kernel can't tile still honor the (out, lse) contract
+        through the dense fallback."""
+        rng = np.random.RandomState(11)
+        q = jnp.asarray(rng.randn(1, 100, 2, 16).astype(np.float32))
+        out, lse = flash_attention_with_lse(q, q, q, causal=True)
+        assert out.shape == (1, 100, 2, 16)
+        assert lse.shape == (1, 100, 2)
+        np.testing.assert_allclose(
+            np.asarray(lse),
+            np.asarray(self._dense_ref(q, q, q, True)),
+            rtol=1e-5, atol=1e-5,
         )
 
 
