@@ -124,6 +124,51 @@ class LearningRateWarmupCallback(Callback):
             print(f"LearningRateWarmup: epoch {epoch} lr scale {scale:.4f}")
 
 
+class LearningRateScheduleCallback(Callback):
+    """Scale the effective LR by ``multiplier`` within an epoch range —
+    the ``hvd.callbacks.LearningRateScheduleCallback`` surface (present in
+    Horovod 0.18.1 alongside the warmup callback, which subclasses it there;
+    the reference scripts use only the warmup form).
+
+    ``multiplier``: a float, or a callable ``epoch -> float`` (evaluated at
+    epoch granularity — the reference stack never drives sub-epoch
+    schedules). Outside ``[start_epoch, end_epoch)`` the callback leaves the
+    scale untouched.
+
+    Composition: MULTIPLIES into ``trainer.update_scale`` (which the Trainer
+    resets to 1.0 each epoch), so Horovod's documented stacking — a warmup
+    callback followed by schedule callbacks with later ``start_epoch`` —
+    composes in callback-list order. Horovod's ``momentum_correction`` knob
+    has no analogue here by construction: the scale multiplies the
+    optimizer's *update* (not a stored lr hyperparameter), which is exactly
+    the corrected behavior for momentum methods."""
+
+    def __init__(
+        self,
+        multiplier,
+        start_epoch: int = 0,
+        end_epoch: int | None = None,
+        verbose: int = 0,
+    ):
+        self.multiplier = multiplier
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        if epoch < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        m = self.multiplier(epoch) if callable(self.multiplier) else self.multiplier
+        self.trainer.update_scale *= float(m)
+        if self.verbose and runtime.is_primary():
+            print(
+                f"LearningRateSchedule: epoch {epoch} "
+                f"lr scale {self.trainer.update_scale:.4f}"
+            )
+
+
 class ModelCheckpoint(Callback):
     """Per-epoch full-state checkpoint, written by the primary process only
     (tensorflow2_keras_mnist.py:86-88; single-writer discipline §5.2).

@@ -123,9 +123,12 @@ class Trainer:
         # (e.g. ['batch_stats']); discovered at build() — before the first
         # (lazily-traced) _train_step call, so the closures see it static.
         self._mutable: list[str] = []
-        # Update scale multiplies the optimizer's update — the knob
-        # LearningRateWarmupCallback turns (scaling the update by s is
-        # equivalent to scaling the LR by s for the reference optimizers).
+        # Update scale multiplies the optimizer's update — the knob the LR
+        # callbacks turn (scaling the update by s is equivalent to scaling
+        # the LR by s for the reference optimizers). Reset to 1.0 at every
+        # epoch begin, before callbacks run: warmup ASSIGNS its ramp value,
+        # schedule callbacks MULTIPLY — so Horovod's warmup→decay stacking
+        # composes in callback-list order.
         self.update_scale: float = 1.0
         self.stop_training = False
         self.history: list[dict] = []
@@ -642,6 +645,9 @@ class Trainer:
             for epoch in range(initial_epoch, epochs):
                 if self.stop_training:
                     break
+                # Fresh scale each epoch: LR callbacks compose into it in
+                # list order (warmup assigns, schedules multiply).
+                self.update_scale = 1.0
                 for cb in callbacks:
                     cb.on_epoch_begin(epoch)
                 t0 = time.perf_counter()
@@ -743,6 +749,8 @@ class Trainer:
             for epoch in range(initial_epoch, epochs):
                 if self.stop_training:
                     break
+                # Fresh scale each epoch (see _fit_device_cached note).
+                self.update_scale = 1.0
                 for cb in callbacks:
                     cb.on_epoch_begin(epoch)
                 t0 = time.perf_counter()

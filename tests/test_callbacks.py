@@ -11,6 +11,7 @@ import horovod_tpu as hvt
 from horovod_tpu.models import MnistCNN
 from horovod_tpu.training.callbacks import (
     BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
     LearningRateWarmupCallback,
     MetricAverageCallback,
     ModelCheckpoint,
@@ -45,6 +46,66 @@ def test_warmup_noop_at_world_size_one():
     cb.trainer = t
     cb.on_epoch_begin(0)
     assert t.update_scale == 1.0
+
+
+def test_lr_schedule_constant_and_callable():
+    """hvd.callbacks.LearningRateScheduleCallback parity: float or
+    epoch->float multiplier, active only within [start_epoch, end_epoch)."""
+    t = _Recorder()
+    cb = LearningRateScheduleCallback(0.1, start_epoch=2, end_epoch=4)
+    cb.trainer = t
+    for epoch, expected in [(0, 1.0), (1, 1.0), (2, 0.1), (4, 1.0)]:
+        t.update_scale = 1.0  # the Trainer resets each epoch
+        cb.on_epoch_begin(epoch)
+        assert t.update_scale == pytest.approx(expected), epoch
+
+    cb = LearningRateScheduleCallback(lambda e: 0.5 ** e)
+    cb.trainer = t
+    t.update_scale = 1.0
+    cb.on_epoch_begin(3)
+    assert t.update_scale == pytest.approx(0.125)
+
+
+def test_lr_schedule_stacks_with_warmup():
+    """Horovod's documented stacking: warmup first, then decay schedules
+    with later start_epoch — composes in callback-list order because
+    warmup assigns and schedules multiply."""
+    t = _Recorder()
+    warmup = LearningRateWarmupCallback(warmup_epochs=3, world_size=8)
+    decay = LearningRateScheduleCallback(0.1, start_epoch=5)
+    warmup.trainer = decay.trainer = t
+    seen = {}
+    for epoch in range(7):
+        t.update_scale = 1.0
+        warmup.on_epoch_begin(epoch)
+        decay.on_epoch_begin(epoch)
+        seen[epoch] = t.update_scale
+    assert seen[0] == pytest.approx(1 / 8)  # warmup ramp start
+    assert seen[3] == seen[4] == 1.0  # between warmup and decay
+    assert seen[5] == seen[6] == pytest.approx(0.1)  # decayed
+
+
+def test_lr_schedule_drives_training_scale():
+    """End-to-end through Trainer.fit: a zero multiplier freezes params
+    (the update_scale plumbing, reset each epoch)."""
+    import jax
+
+    hvt.init()
+    rng = np.random.RandomState(1)
+    x = rng.rand(16, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, 16).astype(np.int64)
+    trainer = hvt.Trainer(MnistCNN(), hvt.DistributedOptimizer(optax.adam(1e-2)))
+    trainer.build(x)
+    before = jax.device_get(trainer.state.params)
+    trainer.fit(
+        x=x, y=y, batch_size=2, epochs=1,
+        callbacks=[LearningRateScheduleCallback(0.0)],
+    )
+    after = jax.device_get(trainer.state.params)
+    assert all(
+        np.allclose(a, b)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after))
+    )
 
 
 def test_metric_average_single_process_identity():
