@@ -68,7 +68,7 @@ def bench_train(which: str) -> dict:
     import optax
 
     import horovod_tpu as hvt
-    from horovod_tpu import trace
+    from horovod_tpu import runtime, trace
     from horovod_tpu.data import datasets
 
     hvt.init()
@@ -101,6 +101,11 @@ def bench_train(which: str) -> dict:
             compute_dtype=jnp.bfloat16,
             dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
             # ~12%/step — HVT_FAST_RNG=1 makes dropout free when wanted)
+            # Long-context memory knobs (BASELINE.md context-envelope rows):
+            remat=runtime.env_flag("BENCH_REMAT"),
+            logits_dtype=jnp.bfloat16
+            if os.environ.get("BENCH_LOGITS", "") == "bf16"
+            else jnp.float32,
         )
         metric = "transformer_lm_train_tokens_per_sec_per_chip"
         # copy_task returns [n, seq_len] next-token pairs: every position is

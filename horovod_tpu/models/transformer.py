@@ -15,7 +15,9 @@ multi-axis parallelism as first-class. This model composes every mesh axis:
 
 Architecture: pre-LN blocks, RoPE positions (sequence-length extensible —
 what a long-context model wants), GELU MLP at 4×, tied-free LM head, logits
-in float32.
+in float32 by default (``logits_dtype=bfloat16`` halves long-sequence HBM;
+the named Trainer losses upcast to f32 on the fly — a custom callable loss
+must do its own upcasting).
 
 `param_specs(params, mesh)` gives the explicit PartitionSpec tree for the
 TP/FSDP layout — path-based rules, no boxed-metadata machinery, so any
@@ -99,7 +101,7 @@ class Block(nn.Module):
     moe_aux_coef: float = 1e-2
 
     @nn.compact
-    def __call__(self, x, positions, *, train: bool = False):
+    def __call__(self, x, positions, train: bool = False):
         cfg = self.sharding
         head_dim = self.d_model // self.n_heads
         dense = functools.partial(
@@ -208,6 +210,15 @@ class TransformerLM(nn.Module):
     dropout: float = 0.1
     compute_dtype: jnp.dtype = jnp.float32
     sharding: ShardingConfig = ShardingConfig()
+    # Memory knobs for long context (HBM is the binding constraint on one
+    # chip — BASELINE.md context-envelope rows):
+    # * remat: rematerialize each block in the backward pass
+    #   (jax.checkpoint) — activations per layer drop to the block inputs;
+    # * logits_dtype: bf16 halves the [B, T, vocab] logits + cotangent that
+    #   dominate long-sequence HBM; the loss upcasts to f32 on the fly
+    #   (fused by XLA, never materialized), so logsumexp stays accurate.
+    remat: bool = False
+    logits_dtype: jnp.dtype = jnp.float32
     # moe_every=k replaces every k-th block's MLP with an expert-parallel
     # MoE (0 = dense everywhere, the default).
     moe_every: int = 0
@@ -223,8 +234,13 @@ class TransformerLM(nn.Module):
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.compute_dtype)(tokens)
         x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
+        # `train` is argnum 3 of Block.__call__ (self, x, positions, train)
+        # and must stay a static python bool through the remat boundary.
+        block_cls = (
+            nn.remat(Block, static_argnums=(3,)) if self.remat else Block
+        )
         for i in range(self.n_layers):
-            x = Block(
+            x = block_cls(
                 self.d_model, self.n_heads, self.dropout,
                 self.compute_dtype, cfg,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
@@ -232,13 +248,17 @@ class TransformerLM(nn.Module):
                 moe_k=self.moe_k,
                 capacity_factor=self.capacity_factor,
                 moe_aux_coef=self.moe_aux_coef,
-            )(x, positions, train=train)
+                # Explicit name = flax's auto-name, so the param tree is
+                # identical with and without remat (the remat wrapper would
+                # otherwise scope as CheckpointBlock_i).
+                name=f"Block_{i}",
+            )(x, positions, train)
         x = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
         logits = nn.DenseGeneral(
             features=self.vocab_size, dtype=self.compute_dtype, use_bias=False,
             name="lm_head",
         )(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(self.logits_dtype)
 
 
 def param_specs(params, mesh: Mesh) -> dict:

@@ -61,12 +61,18 @@ def _resolve_loss(loss) -> Callable:
     (mnist_keras.py:89)."""
     if callable(loss):
         return loss
+    # Upcast at the loss boundary: models may emit 16-bit logits to halve
+    # long-sequence HBM (TransformerLM logits_dtype) — the f32 cast fuses
+    # into the logsumexp chain, so statistics are f32-accurate without a
+    # materialized f32 copy. No-op for f32 logits.
     if loss in ("sparse_categorical_crossentropy", "sparse_ce"):
         return lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels
+            logits.astype(jnp.float32), labels
         )
     if loss in ("categorical_crossentropy", "ce"):
-        return lambda logits, labels: optax.softmax_cross_entropy(logits, labels)
+        return lambda logits, labels: optax.softmax_cross_entropy(
+            logits.astype(jnp.float32), labels
+        )
     raise ValueError(f"unknown loss {loss!r}")
 
 

@@ -224,6 +224,94 @@ class TestFSDP:
 
 
 @pytest.mark.slow
+class TestMemoryKnobs:
+    """Long-context memory options: remat must not change the math,
+    bf16 logits must keep an f32-accurate loss through the upcasting
+    built into the named losses."""
+
+    def _tokens(self, seed=0):
+        rng = np.random.RandomState(seed)
+        return jnp.asarray(rng.randint(0, VOCAB, (2, 16)), jnp.int32)
+
+    def test_remat_is_numerically_invisible(self):
+        toks = self._tokens()
+        base = _model()
+        remat = _model(remat=True)
+        params = base.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+
+        def loss(m, p):
+            logits = m.apply({"params": p}, toks, train=True,
+                             rngs={"dropout": jax.random.PRNGKey(1)})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, toks
+            ).mean()
+
+        l0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+        l1, g1 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_remat_invisible_with_dropout(self):
+        """RNG lifting through the remat boundary: the backward-pass
+        recomputation must fold in the SAME dropout keys, or remat silently
+        changes training math for any dropout>0 user."""
+        toks = self._tokens(2)
+        base = _model(dropout=0.3)
+        remat = _model(dropout=0.3, remat=True)
+        params = base.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+
+        def loss(m, p):
+            logits = m.apply({"params": p}, toks, train=True,
+                             rngs={"dropout": jax.random.PRNGKey(7)})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, toks
+            ).mean()
+
+        l0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+        l1, g1 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_bf16_logits_loss_close_to_f32(self):
+        toks = self._tokens(1)
+        f32 = _model()
+        bf16 = _model(logits_dtype=jnp.bfloat16)
+        params = f32.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+        from horovod_tpu.training.trainer import _resolve_loss
+
+        loss_fn = _resolve_loss("sparse_categorical_crossentropy")
+
+        def loss(m, p):
+            logits = m.apply({"params": p}, toks, train=False)
+            return float(loss_fn(logits, toks).mean())
+
+        assert bf16.apply({"params": params}, toks, train=False).dtype == jnp.bfloat16
+        # bf16 rounding of the logits themselves bounds the difference;
+        # the logsumexp math runs in f32 via the loss upcast.
+        assert abs(loss(f32, params) - loss(bf16, params)) < 2e-2
+
+    def test_remat_trains_through_trainer(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, seq=2))
+        trainer = hvt.Trainer(
+            _model(mesh=mesh, remat=True, logits_dtype=jnp.bfloat16),
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=param_specs,
+            batch_specs=(P(("data", "fsdp"), "seq"), P(("data", "fsdp"), "seq")),
+        )
+        x, y = datasets.copy_task(8, 16, vocab_size=VOCAB)
+        hist = trainer.fit(x=x, y=y, batch_size=4, epochs=2)
+        assert np.isfinite(hist[-1]["loss"])
+        assert hist[-1]["loss"] <= hist[0]["loss"] * 1.5  # sane training
+
+
 class TestLongRangeRecall:
     def test_copy_task_learned_through_ring(self):
         """The functional long-context check: recall-half loss → small, which
