@@ -97,7 +97,10 @@ def bench_train(which: str) -> dict:
         x_np, y_np = datasets.copy_task(4096, seq_len, vocab_size=8192)
         x, y = x_np, y_np
         module = TransformerLM(
-            vocab_size=8192, d_model=512, n_heads=8, n_layers=8,
+            vocab_size=8192,
+            d_model=int(os.environ.get("BENCH_DMODEL", 512)),
+            n_heads=int(os.environ.get("BENCH_HEADS", 8)),
+            n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
             compute_dtype=jnp.bfloat16,
             dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
             # ~12%/step — HVT_FAST_RNG=1 makes dropout free when wanted)
