@@ -17,6 +17,22 @@ Parity surface (SURVEY.md §5.4): three artifacts, all primary-process-gated:
 Resume is restore → broadcast: load on the primary, then
 ``broadcast_parameters`` syncs all processes (the reference's implicit resume
 contract, tensorflow2_keras_mnist.py:68-71).
+
+**Sharded (distributed) checkpoints**: when the state is sharded ACROSS
+processes (pipeline stages, cross-host TP/FSDP), no single process can
+host-gather it, so the single-file format is impossible. The sharded format
+is a ``checkpoint-{epoch}.shards/`` directory: every process writes exactly
+its addressable replica-0 shards (one ``shard-{p}.msgpack`` each — no
+communication), the primary writes ``index.json``, and completeness (index +
+all per-process files present) is validated at discovery time so a
+checkpoint torn by mid-write failure is skipped in favor of the newest
+complete one. Restore is also process-local: each process reads the shard
+bytes its template shardings need and re-places them with
+`jax.make_array_from_single_device_arrays`. Requires a filesystem all
+processes share — the same assumption the reference's ``PS_MODEL_PATH``
+persistent mount makes (tensorflow2_keras_mnist.py:21-22).
+`save_checkpoint`/`ModelCheckpoint`/`restore_latest_and_broadcast` pick the
+format automatically from the state's shardings.
 """
 
 from __future__ import annotations
@@ -41,15 +57,29 @@ PyTree = Any
 CHECKPOINT_RE = re.compile(r"checkpoint-(\d+)\.\w+$")
 
 
-def save(path: str, state: PyTree) -> str:
-    """Serialize a state pytree to one file, atomically. Caller gates rank
-    (callbacks do; direct users should check ``runtime.is_primary()``)."""
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    data = serialization.to_bytes(jax.device_get(state))
+def _atomic_write(path: str, data: bytes) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)  # atomic: no torn checkpoints on crash (§5.2)
+
+
+def save(path: str, state: PyTree) -> str:
+    """Serialize a state pytree to one file, atomically. Caller gates rank
+    (callbacks do; direct users should check ``runtime.is_primary()``).
+
+    Refuses cross-process-sharded state loudly: no single process holds it,
+    so a one-file checkpoint is impossible — use `save_sharded` (the
+    `save_checkpoint`/`ModelCheckpoint` paths route there automatically)."""
+    if is_cross_process_sharded(state):
+        raise ValueError(
+            "state contains arrays sharded across processes (model-parallel "
+            "leaves); a single-file checkpoint cannot represent them. Use "
+            "checkpoint.save_sharded(path, state) from every process — "
+            "save_checkpoint/ModelCheckpoint select it automatically."
+        )
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    _atomic_write(path, serialization.to_bytes(jax.device_get(state)))
     return path
 
 
@@ -95,6 +125,17 @@ def save_async(path: str, state: PyTree) -> _SaveThread:
     (no cross-process computation may run on the primary alone)."""
     import jax.numpy as jnp
 
+    if is_cross_process_sharded(state):
+        # Same loud refusal as `save`, but BEFORE the snapshot: the primary-
+        # only caller this function documents would otherwise hit a cryptic
+        # non-fully-addressable-array error (or desync its peers) right here
+        # on the caller thread, never reaching save()'s message at join().
+        raise ValueError(
+            "state contains arrays sharded across processes; use "
+            "checkpoint.save_sharded_async(path, state) from every process "
+            "— ModelCheckpoint(async_save=True) selects it automatically."
+        )
+
     def snap(a):
         if isinstance(a, jax.Array) and a.is_fully_replicated:
             # Local-shard copy: an eager global jnp.copy would be a
@@ -108,30 +149,232 @@ def save_async(path: str, state: PyTree) -> _SaveThread:
 
 
 def restore(path: str, template: PyTree) -> PyTree:
-    """Deserialize into the structure of ``template``."""
+    """Deserialize into the structure of ``template``. A directory path is a
+    sharded checkpoint and routes to `restore_sharded`."""
+    if os.path.isdir(path):
+        return restore_sharded(path, template)
     with open(path, "rb") as f:
         data = f.read()
     return serialization.from_bytes(jax.device_get(template), data)
 
 
+# --- Sharded (distributed) checkpoint format -------------------------------
+
+SHARDED_SUFFIX = ".shards"
+INDEX_FILE = "index.json"
+
+
+def is_cross_process_sharded(tree: PyTree) -> bool:
+    """True when any leaf is sharded across processes — the condition under
+    which checkpoints must use the sharded directory format."""
+    return any(
+        isinstance(l, jax.Array) and not _host_syncable(l)
+        for l in jax.tree.leaves(tree)
+    )
+
+
+def _fmt_index(index: tuple, shape: tuple) -> str:
+    """Canonical key for one shard's position in its global array:
+    ``'0:64,0:128'`` start:stop per dimension (empty string for scalars)."""
+    parts = []
+    for s, dim in zip(index, shape):
+        start, stop, step = s.indices(dim)
+        if step != 1:
+            raise ValueError(f"strided shard index unsupported: {index}")
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts)
+
+
+def save_sharded(path: str, state: PyTree) -> str:
+    """Distributed checkpoint: EVERY process calls this (unlike `save`).
+
+    Each process writes one ``shard-{p}.msgpack`` holding exactly the shard
+    bytes it is the owner of — its addressable shards with ``replica_id ==
+    0``, so each piece of the global state is stored once fleet-wide and
+    replicated leaves cost one copy, not ``n_processes``. No communication
+    happens: save never deadlocks and tolerates peers dying mid-write (the
+    torn checkpoint simply never validates as complete). The primary also
+    writes ``index.json`` recording the expected file count plus every
+    leaf's tree path (restore validates them — shard keys are positional, so
+    without names a same-shape rename/reorder would restore silently
+    swapped); host-side (non-array) leaves go in the primary's shard
+    file."""
+    paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    leaves = [l for _, l in paths_and_leaves]
+    os.makedirs(path, exist_ok=True)
+    payload = {}
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array):
+            for sh in leaf.addressable_shards:
+                if sh.replica_id == 0:
+                    payload[f"{i}|{_fmt_index(sh.index, leaf.shape)}"] = (
+                        np.asarray(sh.data)
+                    )
+        elif runtime.is_primary():
+            payload[f"{i}|host"] = np.asarray(leaf)
+    _atomic_write(
+        os.path.join(path, f"shard-{jax.process_index()}.msgpack"),
+        serialization.msgpack_serialize(payload),
+    )
+    if runtime.is_primary():
+        index = {
+            "format": 1,
+            "n_processes": jax.process_count(),
+            "leaf_count": len(leaves),
+            "leaf_names": [
+                jax.tree_util.keystr(p) for p, _ in paths_and_leaves
+            ],
+        }
+        _atomic_write(
+            os.path.join(path, INDEX_FILE), json.dumps(index).encode()
+        )
+    return path
+
+
+def save_sharded_async(path: str, state: PyTree) -> _SaveThread:
+    """`save_sharded` off the training loop: snapshot every array leaf on
+    device (buffer-donation immunity, same rationale as `save_async` — the
+    copy is a communication-free SPMD identity every process enters), then
+    write this process's shard file on a daemon thread."""
+    import jax.numpy as jnp
+
+    snapshot = jax.tree.map(
+        lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, state
+    )
+    return _SaveThread(lambda: save_sharded(path, snapshot))
+
+
+def _sharded_complete(path: str) -> bool:
+    """A sharded checkpoint is usable iff the index and every per-process
+    shard file landed (each lands atomically)."""
+    try:
+        with open(os.path.join(path, INDEX_FILE)) as f:
+            n = int(json.load(f)["n_processes"])
+    except (OSError, ValueError, KeyError):
+        return False
+    return all(
+        os.path.isfile(os.path.join(path, f"shard-{p}.msgpack"))
+        for p in range(n)
+    )
+
+
+def restore_sharded(path: str, template: PyTree) -> PyTree:
+    """Rebuild a sharded checkpoint onto the ``template``'s shardings.
+
+    EVERY process calls this. Shard files are read lazily, own-process first:
+    with an unchanged topology a process touches only its own file plus
+    whichever file owns the replicated leaves. Each needed piece is
+    device_put to its target device and the global arrays assembled with
+    `jax.make_array_from_single_device_arrays` — no collective traffic."""
+    with open(os.path.join(path, INDEX_FILE)) as f:
+        index = json.load(f)
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = [l for _, l in paths_and_leaves]
+    if len(leaves) != index["leaf_count"]:
+        raise ValueError(
+            f"checkpoint {path} holds {index['leaf_count']} leaves but the "
+            f"template has {len(leaves)} — model/optimizer structure changed"
+        )
+    names = [jax.tree_util.keystr(p) for p, _ in paths_and_leaves]
+    if names != index["leaf_names"]:
+        drift = [
+            f"{a!r} -> {b!r}"
+            for a, b in zip(index["leaf_names"], names)
+            if a != b
+        ]
+        raise ValueError(
+            f"checkpoint {path} leaf names differ from the template's "
+            f"(shard keys are positional, so this would restore the wrong "
+            f"weights): {', '.join(drift[:5])} — model/optimizer structure "
+            "changed"
+        )
+    me = jax.process_index()
+    read_order = [me] + [p for p in range(index["n_processes"]) if p != me]
+    store: dict[str, np.ndarray] = {}
+
+    def lookup(key):
+        while key not in store and read_order:
+            p = read_order.pop(0)
+            with open(os.path.join(path, f"shard-{p}.msgpack"), "rb") as f:
+                store.update(serialization.msgpack_restore(f.read()))
+        if key not in store:
+            raise ValueError(
+                f"shard {key!r} not found in {path}: the checkpoint was "
+                "saved under a different mesh or sharding layout than the "
+                "template's (resume must use the same parallel config)"
+            )
+        return store[key]
+
+    out = []
+    for i, leaf in enumerate(leaves):
+        if not isinstance(leaf, jax.Array):
+            out.append(lookup(f"{i}|host"))
+            continue
+        target, shape = leaf.sharding, leaf.shape
+        pieces = [
+            jax.device_put(
+                np.asarray(lookup(f"{i}|{_fmt_index(idx, shape)}"), leaf.dtype), d
+            )
+            for d, idx in target.addressable_devices_indices_map(shape).items()
+        ]
+        out.append(
+            jax.make_array_from_single_device_arrays(shape, target, pieces)
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def save_checkpoint(directory: str, state: PyTree, epoch: int) -> str:
     """Epoch-numbered checkpoint (``checkpoint-{epoch}.msgpack``), parity
     with the reference's per-epoch template (tensorflow2_keras_mnist.py:87).
-    Epochs are 1-based (epoch 0 means "no checkpoint" on resume)."""
+    Epochs are 1-based (epoch 0 means "no checkpoint" on resume).
+    Cross-process-sharded state routes to the sharded directory format
+    (``checkpoint-{epoch}.shards/``) — then ALL processes must call this."""
+    if is_cross_process_sharded(state):
+        return save_sharded(
+            os.path.join(directory, f"checkpoint-{epoch}{SHARDED_SUFFIX}"), state
+        )
     return save(os.path.join(directory, f"checkpoint-{epoch}.msgpack"), state)
 
 
 def latest_checkpoint(directory: str) -> str | None:
-    """Highest-epoch checkpoint path, or None."""
+    """Highest-epoch checkpoint path, or None. Sharded checkpoint dirs
+    count only when complete (a crash mid-save leaves a torn dir that must
+    lose to the previous epoch's complete one)."""
     if not os.path.isdir(directory):
         return None
     best, best_epoch = None, -1
     for name in os.listdir(directory):
         m = CHECKPOINT_RE.search(name)
-        if m and int(m.group(1)) > best_epoch:
-            best_epoch = int(m.group(1))
-            best = os.path.join(directory, name)
+        if not m or int(m.group(1)) <= best_epoch:
+            continue
+        full = os.path.join(directory, name)
+        if os.path.isdir(full) and not _sharded_complete(full):
+            continue
+        best_epoch = int(m.group(1))
+        best = full
     return best
+
+
+def _discard_future_checkpoints(directory: str, epoch: int) -> None:
+    """Primary-only, called on resume: delete checkpoint artifacts newer than
+    the epoch being resumed. They belong to an abandoned trajectory (a torn
+    sharded dir from the crash, or single-file checkpoints the rerun will
+    re-earn), and a stale sharded dir is actively dangerous: the retrained
+    epoch would re-save into it, and a second crash could leave a complete-
+    looking dir mixing shard files from two different trainings."""
+    import shutil
+
+    if not os.path.isdir(directory):
+        return
+    for name in os.listdir(directory):
+        m = CHECKPOINT_RE.search(name)
+        if not m or int(m.group(1)) <= epoch:
+            continue
+        full = os.path.join(directory, name)
+        if os.path.isdir(full):
+            shutil.rmtree(full, ignore_errors=True)
+        else:
+            os.remove(full)
 
 
 def _host_syncable(leaf) -> bool:
@@ -189,10 +432,35 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None) ->
     primary = runtime.is_primary()
     path = latest_checkpoint(directory) if primary else None
     epoch = int(CHECKPOINT_RE.search(path).group(1)) if path else 0
+    if primary:
+        # Kill abandoned-future artifacts before training overwrites them —
+        # see _discard_future_checkpoints for why this is load-bearing for
+        # the sharded format, not just hygiene.
+        _discard_future_checkpoints(directory, epoch)
+    # Sharded = directory (isdir on the primary's actual pick — names are
+    # user-controlled); non-primaries need the real NAME, not a guess, so
+    # broadcast it as fixed-width bytes alongside the epoch.
+    sharded = bool(path) and os.path.isdir(path)
+    name = np.zeros(256, np.uint8)
+    if path:
+        raw = os.path.basename(path).encode()
+        name[: len(raw)] = np.frombuffer(raw, np.uint8)
     if jax.process_count() > 1:
-        epoch = int(collectives.broadcast(np.int64(epoch), root=0))
+        hdr = collectives.broadcast(
+            np.array([epoch, int(sharded)], np.int64), root=0
+        )
+        name = collectives.broadcast(name, root=0)
+        epoch, sharded = int(hdr[0]), bool(hdr[1])
     if epoch == 0:
         return template, 0
+    if sharded:
+        # Collective restore: every process reads the shard bytes its own
+        # template shardings need — identical bytes for replicated leaves,
+        # so the post-restore broadcast is unnecessary by construction.
+        spath = os.path.join(
+            directory, bytes(name).rstrip(b"\0").decode()
+        )
+        return restore_sharded(spath, template), epoch
     state = restore(path, template) if primary else template
     return broadcast_parameters(state, mesh=mesh), epoch
 

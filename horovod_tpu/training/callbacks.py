@@ -182,7 +182,13 @@ class ModelCheckpoint(Callback):
     device and fetched/serialized on a background thread while the next
     epoch trains (`checkpoint.save_async`). At most one write is in flight —
     the previous epoch's write is joined first, so files land in order — and
-    the final write is joined at train end."""
+    the final write is joined at train end.
+
+    Cross-process-sharded state (pipeline/TP/FSDP spanning hosts) routes to
+    the sharded directory format: EVERY process writes its own shard file
+    (`checkpoint.save_sharded`), so the primary-only gate applies only to
+    single-file checkpoints — the single-writer discipline then holds
+    per-file (each process owns exactly one path, §5.2)."""
 
     def __init__(self, filepath: str, async_save: bool = False):
         self.filepath = filepath
@@ -190,17 +196,28 @@ class ModelCheckpoint(Callback):
         self._pending = None
 
     def on_epoch_end(self, epoch: int, logs=None):
-        if not runtime.is_primary():
-            return
         from horovod_tpu import checkpoint
 
+        state = self.trainer.state
+        sharded = checkpoint.is_cross_process_sharded(state)
+        if not sharded and not runtime.is_primary():
+            return
         path = self.filepath.format(epoch=epoch + 1)
+        if sharded:
+            # Consistent across processes: shardings are SPMD-global state.
+            root, _ = os.path.splitext(path)
+            path = root + checkpoint.SHARDED_SUFFIX
+            do_save = checkpoint.save_sharded
+            do_async = checkpoint.save_sharded_async
+        else:
+            do_save = checkpoint.save
+            do_async = checkpoint.save_async
         if self.async_save:
             if self._pending is not None:
                 self._pending.join()
-            self._pending = checkpoint.save_async(path, self.trainer.state)
+            self._pending = do_async(path, state)
         else:
-            checkpoint.save(path, self.trainer.state)
+            do_save(path, state)
 
     def on_train_end(self, logs=None):
         if self._pending is not None:

@@ -140,6 +140,175 @@ def test_model_checkpoint_async_orders_writes(tmp_path):
     assert int(restored.step) == 6
 
 
+class TestShardedCheckpoint:
+    """The distributed checkpoint format (VERDICT r2 #1): per-process shard
+    files + index, restore re-placing by the template's shardings. Exercised
+    here single-process on the 8-device mesh (format + placement mechanics);
+    the cross-process save/kill/resume proof lives in
+    test_multiprocess.py::TestModelParallelCheckpointResume."""
+
+    def _mesh(self):
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        return mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+
+    def _state(self, mesh, fill):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(val, *axes):
+            return jax.device_put(val, NamedSharding(mesh, P(*axes)))
+
+        rng = np.random.RandomState(3 if fill else 7)
+
+        def arr(*shape):
+            a = rng.rand(*shape).astype(np.float32)
+            return a if fill else np.zeros_like(a)
+
+        return {
+            "w_row": put(arr(8, 16), "data", None),
+            "w_col": put(arr(16, 8), None, "model"),
+            "w_2d": put(arr(8, 8), "data", "model"),
+            "bias": put(arr(16)),  # replicated
+            "step": put(jnp.asarray(123 if fill else 0)),  # 0-d
+            "host": np.int64(5 if fill else 0),  # non-jax leaf
+        }
+
+    def test_roundtrip_preserves_values_and_shardings(self, tmp_path):
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
+        assert checkpoint._sharded_complete(path)
+        restored = checkpoint.restore_sharded(path, self._state(mesh, fill=False))
+        for k in state:
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(restored[k])),
+                np.asarray(jax.device_get(state[k])),
+            )
+        for k in ("w_row", "w_col", "w_2d", "bias", "step"):
+            assert restored[k].sharding == state[k].sharding
+
+    def test_each_global_piece_stored_once(self, tmp_path):
+        """replica_id==0 dedup: total stored bytes for a replicated leaf are
+        ONE copy, and for sharded leaves exactly the global array."""
+        from flax import serialization
+
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
+        with open(os.path.join(path, "shard-0.msgpack"), "rb") as f:
+            store = serialization.msgpack_restore(f.read())
+        leaves, _ = jax.tree_util.tree_flatten(state)
+        by_leaf = {}
+        for key, val in store.items():
+            idx = int(key.split("|")[0])
+            by_leaf[idx] = by_leaf.get(idx, 0) + np.asarray(val).size
+        for i, leaf in enumerate(leaves):
+            assert by_leaf[i] == np.asarray(leaf).size  # once, exactly
+
+    def test_incomplete_sharded_dir_is_skipped(self, tmp_path):
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        checkpoint.save_checkpoint(str(tmp_path), state, 1)  # single-proc -> file
+        sh = checkpoint.save_sharded(str(tmp_path / "checkpoint-2.shards"), state)
+        assert checkpoint.latest_checkpoint(str(tmp_path)).endswith(
+            "checkpoint-2.shards"
+        )
+        os.remove(os.path.join(sh, "shard-0.msgpack"))  # tear it
+        assert checkpoint.latest_checkpoint(str(tmp_path)).endswith(
+            "checkpoint-1.msgpack"
+        )
+
+    def test_restore_routes_directories(self, tmp_path):
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
+        restored = checkpoint.restore(path, self._state(mesh, fill=False))
+        np.testing.assert_array_equal(
+            jax.device_get(restored["w_2d"]), jax.device_get(state["w_2d"])
+        )
+
+    def test_layout_mismatch_is_loud(self, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
+        template = self._state(mesh, fill=False)
+        # Resume under a DIFFERENT layout for w_row: model-sharded columns.
+        template["w_row"] = jax.device_put(
+            np.zeros((8, 16), np.float32), NamedSharding(mesh, P(None, "model"))
+        )
+        with pytest.raises(ValueError, match="different mesh or sharding"):
+            checkpoint.restore_sharded(path, template)
+
+    def test_structure_mismatch_is_loud(self, tmp_path):
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
+        template = self._state(mesh, fill=False)
+        del template["bias"]
+        with pytest.raises(ValueError, match="structure changed"):
+            checkpoint.restore_sharded(path, template)
+
+    def test_renamed_leaf_is_loud(self, tmp_path):
+        """Same leaf count, same shapes, different NAME: positional shard
+        keys would silently restore the wrong weights without the
+        leaf-name validation."""
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
+        template = self._state(mesh, fill=False)
+        template["aaa_renamed"] = template.pop("bias")  # same shape/sharding
+        with pytest.raises(ValueError, match="leaf names differ"):
+            checkpoint.restore_sharded(path, template)
+
+    def test_save_async_refuses_cross_process_sharded_loudly(self):
+        """The guard must fire on the CALLER thread, before jnp.copy touches
+        a non-fully-addressable array (single-process states are always
+        host-syncable, so fake the predicate)."""
+        import unittest.mock as mock
+
+        with mock.patch.object(
+            checkpoint, "is_cross_process_sharded", return_value=True
+        ):
+            with pytest.raises(ValueError, match="save_sharded_async"):
+                checkpoint.save_async("/tmp/nope.msgpack", {"w": np.ones(2)})
+            with pytest.raises(ValueError, match="save_sharded"):
+                checkpoint.save("/tmp/nope.msgpack", {"w": np.ones(2)})
+
+    def test_resume_discards_future_checkpoints(self, tmp_path):
+        """Resume at epoch N deletes artifacts for epochs > N: a torn sharded
+        dir from the crash must not survive to mix shard generations with the
+        retrained epoch's re-save (the silent-corruption scenario)."""
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        checkpoint.save_sharded(str(tmp_path / "checkpoint-2.shards"), state)
+        torn = checkpoint.save_sharded(
+            str(tmp_path / "checkpoint-3.shards"), state
+        )
+        os.remove(os.path.join(torn, "shard-0.msgpack"))
+        restored, epoch = checkpoint.restore_latest_and_broadcast(
+            str(tmp_path), self._state(mesh, fill=False)
+        )
+        assert epoch == 2
+        np.testing.assert_array_equal(
+            jax.device_get(restored["w_2d"]), jax.device_get(state["w_2d"])
+        )
+        assert not (tmp_path / "checkpoint-3.shards").exists()
+
+    def test_async_sharded_save_matches_sync(self, tmp_path):
+        mesh = self._mesh()
+        state = self._state(mesh, fill=True)
+        sync = checkpoint.save_sharded(str(tmp_path / "sync.shards"), state)
+        t = checkpoint.save_sharded_async(str(tmp_path / "async.shards"), state)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        a = open(os.path.join(sync, "shard-0.msgpack"), "rb").read()
+        b = open(str(tmp_path / "async.shards" / "shard-0.msgpack"), "rb").read()
+        assert a == b
+
+
 def test_backward_passes_per_step_accumulates():
     """Horovod's gradient-accumulation argument: N passes of batch B must
     equal 1 pass of batch N*B (mean semantics) for a linear model + SGD."""
