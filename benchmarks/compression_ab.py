@@ -1,0 +1,88 @@
+"""A/B: gradient wire compression on the compiled DP path, 8-device mesh.
+
+Measures the reference MNIST CNN's train step with
+``DistributedOptimizer(compression='none')`` vs ``'bf16'`` on the virtual
+8-device CPU mesh (the suite's multi-process-without-a-cluster mode,
+SURVEY.md §4b): steps/s, per-step gradient wire bytes (param count × wire
+dtype width — what crosses ICI/DCN per all-reduce), and the loss delta after
+a fixed number of steps. The wire-dtype change itself is proven at the HLO
+level in tests/test_compression_path.py; this script puts numbers on it for
+BASELINE.md.
+
+Run:  python benchmarks/compression_ab.py  [--steps 30]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvt  # noqa: E402
+from horovod_tpu.models.cnn import MnistCNN  # noqa: E402
+from horovod_tpu.parallel import sharding as sharding_lib  # noqa: E402
+from horovod_tpu.training.trainer import Trainer  # noqa: E402
+
+
+def run(compression: str, steps: int, x, y):
+    tx = hvt.DistributedOptimizer(optax.adam(1e-3), compression=compression)
+    tr = Trainer(MnistCNN(), tx)
+    state = tr.build(x[: tr.dp_size])
+    batch = tr._shard((x, y))
+    scale = jnp.asarray(1.0, jnp.float32)
+    acc = sharding_lib.replicate(
+        {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}, tr.mesh
+    )
+    n_params = sum(p.size for p in jax.tree.leaves(state.params))
+    # Warm up (compile) + 2 steps out of the timing window.
+    for _ in range(2):
+        state, metrics, acc = tr._train_step(state, batch, scale, acc)
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics, acc = tr._train_step(state, batch, scale, acc)
+    loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    wire_bytes = n_params * (2 if compression != "none" else 4)
+    return {
+        "compression": compression,
+        "steps_per_s": steps / dt,
+        "loss": loss,
+        "n_params": int(n_params),
+        "wire_bytes_per_allreduce": int(wire_bytes),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    # Global batch 256 over 8 shards of the reference's 28x28x1 images.
+    x = rng.rand(256, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.int64)
+    out = [run(c, args.steps, x, y) for c in ("none", "bf16")]
+    out[1]["loss_delta_vs_f32"] = abs(out[1]["loss"] - out[0]["loss"])
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -288,6 +288,15 @@ def restore_sharded(path: str, template: PyTree) -> PyTree:
             f"weights): {', '.join(drift[:5])} — model/optimizer structure "
             "changed"
         )
+    if index["n_processes"] != jax.process_count():
+        # Every process reads the same index, so all ranks raise together —
+        # a partial-restore desync (some ranks proceeding into collectives
+        # while others crash on a missing shard file) cannot happen.
+        raise ValueError(
+            f"checkpoint {path} was saved by {index['n_processes']} "
+            f"processes but this run has {jax.process_count()} — sharded "
+            "checkpoints resume only under the same process topology"
+        )
     me = jax.process_index()
     read_order = [me] + [p for p in range(index["n_processes"]) if p != me]
     store: dict[str, np.ndarray] = {}
@@ -353,6 +362,23 @@ def latest_checkpoint(directory: str) -> str | None:
         best_epoch = int(m.group(1))
         best = full
     return best
+
+
+def _torn_sharded_dirs(directory: str) -> list:
+    """Sharded checkpoint dirs that never validated as complete. One can be
+    a crash mid-save; ONLY torn ones across all epochs is the signature of a
+    rank-gated saver (e.g. ``if rank == 0: ModelCheckpoint(...)`` — valid for
+    replicated state, wrong for the sharded format, where EVERY process must
+    write its shard file)."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        os.path.join(directory, name)
+        for name in os.listdir(directory)
+        if CHECKPOINT_RE.search(name)
+        and os.path.isdir(os.path.join(directory, name))
+        and not _sharded_complete(os.path.join(directory, name))
+    )
 
 
 def _discard_future_checkpoints(directory: str, epoch: int) -> None:
@@ -432,6 +458,24 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None) ->
     primary = runtime.is_primary()
     path = latest_checkpoint(directory) if primary else None
     epoch = int(CHECKPOINT_RE.search(path).group(1)) if path else 0
+    if primary and not path:
+        torn = _torn_sharded_dirs(directory)
+        if torn:
+            # Without this, a directory holding ONLY torn sharded dirs (the
+            # signature of a rank-gated ModelCheckpoint on a model-parallel
+            # run — rank 0 wrote its shard every epoch, the other ranks
+            # never did) silently resumes from scratch, discarding all
+            # training progress. Fail loudly with both causes and fixes.
+            raise RuntimeError(
+                f"no complete checkpoint in {directory}, but "
+                f"{len(torn)} incomplete sharded checkpoint(s) exist "
+                f"(e.g. {os.path.basename(torn[-1])}). Causes: (a) the "
+                "saver was gated to one rank — for cross-process-sharded "
+                "state EVERY process must run ModelCheckpoint/"
+                "save_checkpoint; (b) a crash during the very first save. "
+                "Fix the gating (a) or delete the torn dir(s) to start "
+                "fresh (b)."
+            )
     if primary:
         # Kill abandoned-future artifacts before training overwrites them —
         # see _discard_future_checkpoints for why this is load-bearing for

@@ -67,10 +67,13 @@ def DistributedOptimizer(
       compression: ``'none'`` | ``'bf16'`` | ``'fp16'`` — cast each gradient
         to the 16-bit dtype for the cross-worker reduction and back after
         (Horovod's ``Compression.fp16`` role: half the ICI/DCN bytes).
-        Only meaningful with an explicit ``axis_name`` — in SPMD-jit mode
-        the gradient reduction is placed by XLA inside the backward pass,
-        before this wrapper ever sees a tensor, so there is nothing to
-        compress here and the argument (other than validation) is inert.
+        With an explicit ``axis_name`` the cast+reduce happens here in
+        ``update``. In the default SPMD-jit mode the gradient reduction is
+        placed by XLA inside the backward pass, before this wrapper sees a
+        tensor — so the request is *tagged* on the returned transformation
+        (see `compression_dtype`) and `Trainer` honours it by computing
+        gradients in an explicit-collective `shard_map` step whose psum
+        runs on the 16-bit wire dtype (trainer.py `_compressed_grads`).
     """
     if compression not in _COMPRESSION_DTYPES:
         raise ValueError(
@@ -103,7 +106,26 @@ def DistributedOptimizer(
         # optimizer sees it.
         if not average_aggregated_gradients:
             tx = optax.chain(optax.scale(float(backward_passes_per_step)), tx)
-        return optax.MultiSteps(
+        ms = optax.MultiSteps(
             tx, every_k_schedule=backward_passes_per_step
         ).gradient_transformation()
+
+        def ms_update(updates, state, params=None, **extra):
+            return ms.update(updates, state, params, **extra)
+
+        tx = optax.GradientTransformation(ms.init, ms_update)
+    if comm_dtype is not None and axis_name is None:
+        # SPMD-jit mode: the reduction this dtype applies to lives inside the
+        # compiled step, not here. Tag the transformation so Trainer selects
+        # its explicit-collective (shard_map) gradient path, where the psum
+        # really runs on 16-bit wire traffic. Tagging the plain update
+        # function keeps the result an ordinary GradientTransformation.
+        tx.update._hvt_compression = comm_dtype
     return tx
+
+
+def compression_dtype(tx: optax.GradientTransformation):
+    """The 16-bit wire dtype a `DistributedOptimizer` requested for the
+    compiled SPMD path, or None. Trainer uses this to switch its train step
+    to the explicit-collective gradient reduction."""
+    return getattr(tx.update, "_hvt_compression", None)

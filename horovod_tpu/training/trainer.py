@@ -30,6 +30,7 @@ from horovod_tpu import runtime
 from horovod_tpu.data.loader import ArrayDataset, training_pipeline
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.parallel import sharding as sharding_lib
+from horovod_tpu.training.optimizer import compression_dtype
 
 PyTree = Any
 
@@ -144,6 +145,99 @@ class Trainer:
         # (identical to Keras): on_batch_end callbacks fire once per
         # execution, with the last step's metrics.
         self.steps_per_execution = max(1, int(steps_per_execution))
+        # Gradient wire compression (DistributedOptimizer(compression=...)):
+        # honoured by computing gradients in an explicit-collective shard_map
+        # whose psum runs on the 16-bit dtype (_compressed_grads). Only the
+        # replicated-parameter (pure-DP/FSDP-free) layout is supported — with
+        # sharded params the gradient traffic is layout-dependent and the
+        # implicit SPMD reduction must stay in charge.
+        self._comm_dtype = compression_dtype(optimizer)
+        if self._comm_dtype is not None and param_specs is not None:
+            raise ValueError(
+                "DistributedOptimizer(compression=...) requires replicated "
+                "parameters (param_specs=None); sharded-parameter layouts "
+                "keep XLA's implicit f32 gradient reduction"
+            )
+
+        def compressed_grads(state: TrainState, x, y, step_rng):
+            """(loss, acc, model_state, grads) with the cross-worker gradient
+            reduction made explicit: a psum over the data axes on the 16-bit
+            wire dtype (Horovod Compression.fp16 semantics — compress, ring
+            allreduce-SUM on the wire, decompress, then average). Everything
+            else matches the SPMD loss_of path: per-shard loss means combine
+            to the global-batch mean because shards are equal-sized.
+
+            Contract deltas vs the SPMD path (both only observable with
+            non-iid extras, never with the plain CE objective):
+            * sown 'losses' must be batch-MEAN-style (magnitude independent
+              of batch size — like models/moe.py's load-balance mean): the
+              per-shard means average to the global mean exactly. A
+              batch-SUM-style sow would contribute 1/n_shards of its SPMD
+              weight here.
+            * BatchNorm running variance is the mean of per-shard batch
+              variances, which drops the between-shard-means term (law of
+              total variance) vs the SPMD path's exact global-batch
+              variance. Identical for iid shards (the sharded loader's
+              case); an underestimate only for systematically skewed
+              shards."""
+            comm = self._comm_dtype
+            data_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+
+            def local(params, ms, x, y):
+                # Distinct dropout per shard (the SPMD path's global mask is
+                # partitioned; here each shard must draw its own).
+                shard_rng = jax.random.fold_in(
+                    step_rng, jax.lax.axis_index(data_axes)
+                )
+
+                def loss_of(params):
+                    variables = {"params": params, **(ms or {})}
+                    logits, updated = self.module.apply(
+                        variables, x, train=True,
+                        rngs={"dropout": shard_rng},
+                        mutable=self._mutable + ["losses"],
+                    )
+                    sown = updated.pop("losses", {})
+                    aux = sum(
+                        (jnp.sum(v) for v in jax.tree.leaves(sown)),
+                        jnp.zeros((), jnp.float32),
+                    )
+                    new_ms = dict(updated) if updated else ms
+                    loss = self.loss_fn(logits, y).mean() + aux
+                    return loss, (_accuracy(logits, y), new_ms)
+
+                (loss, (acc, new_ms)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True
+                )(params)
+                inv_n = 1.0 / jax.lax.psum(1, data_axes)
+                grads = jax.tree.map(
+                    lambda g: jax.lax.psum(g.astype(comm), data_axes)
+                    .astype(g.dtype) * inv_n,
+                    grads,
+                )
+                loss = jax.lax.pmean(loss, data_axes)
+                acc = jax.lax.pmean(acc, data_axes)
+                if new_ms is not None:
+                    # Cross-shard mean of updated statistics; non-float
+                    # leaves (step counters) are shard-invariant already.
+                    # For BN this is mean-of-shard-means (exact) and
+                    # mean-of-shard-variances (iid-exact; see docstring).
+                    new_ms = jax.tree.map(
+                        lambda v: jax.lax.pmean(v, data_axes)
+                        if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                        else v,
+                        new_ms,
+                    )
+                return loss, acc, new_ms, grads
+
+            P = jax.sharding.PartitionSpec
+            return jax.shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(P(), P(), P(data_axes), P(data_axes)),
+                out_specs=(P(), P(), P(), P()),
+                check_vma=False,
+            )(state.params, state.model_state, x, y)
 
         def train_step(state: TrainState, batch, update_scale, metric_acc):
             x, y = batch
@@ -156,6 +250,9 @@ class Trainer:
                 # loss, models/moe.py) is added to the objective. Requested
                 # as mutable unconditionally — it costs nothing when unused,
                 # and is never carried in model_state (sown per-apply).
+                # Contract: sow batch-MEAN-style values (batch-size
+                # independent) so the compressed_grads path weights them
+                # identically (see its docstring).
                 logits, updated = self.module.apply(
                     variables, x, train=True,
                     rngs={"dropout": step_rng},
@@ -170,9 +267,14 @@ class Trainer:
                 loss = self.loss_fn(logits, y).mean() + aux
                 return loss, (_accuracy(logits, y), new_ms)
 
-            (loss, (acc, model_state)), grads = jax.value_and_grad(
-                loss_of, has_aux=True
-            )(state.params)
+            if self._comm_dtype is not None:
+                loss, acc, model_state, grads = compressed_grads(
+                    state, x, y, step_rng
+                )
+            else:
+                (loss, (acc, model_state)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True
+                )(state.params)
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             updates = jax.tree.map(lambda u: u * update_scale, updates)
             params = optax.apply_updates(state.params, updates)
