@@ -111,6 +111,29 @@ def bench_train(which: str) -> dict:
             else jnp.float32,
         )
         metric = "transformer_lm_train_tokens_per_sec_per_chip"
+        n_docs = int(os.environ.get("BENCH_PACK_DOCS", 0))
+        if n_docs:
+            # Packed-sequence pretraining: each row holds n_docs documents;
+            # the flash kernel's segment masking (block-level early-out)
+            # keeps cross-document tiles off the MXU. Fixed equal-length
+            # packing so the Trainer's (x, y) feed needs no extra channel.
+            import flax.linen as nn
+
+            class _PackedLM(nn.Module):
+                inner: TransformerLM
+                docs: int
+
+                @nn.compact
+                def __call__(self, tokens, *, train: bool = False):
+                    b, t = tokens.shape
+                    ids = jnp.repeat(
+                        jnp.arange(self.docs, dtype=jnp.int32), t // self.docs
+                    )
+                    ids = jnp.broadcast_to(ids, (b, t))
+                    return self.inner(tokens, train=train, segment_ids=ids)
+
+            module = _PackedLM(inner=module, docs=n_docs)
+            metric += "_packed"
         # copy_task returns [n, seq_len] next-token pairs: every position is
         # a trained label.
         unit_per_step = per_chip_batch * n_chips * seq_len

@@ -458,24 +458,15 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None) ->
     primary = runtime.is_primary()
     path = latest_checkpoint(directory) if primary else None
     epoch = int(CHECKPOINT_RE.search(path).group(1)) if path else 0
-    if primary and not path:
-        torn = _torn_sharded_dirs(directory)
-        if torn:
-            # Without this, a directory holding ONLY torn sharded dirs (the
-            # signature of a rank-gated ModelCheckpoint on a model-parallel
-            # run — rank 0 wrote its shard every epoch, the other ranks
-            # never did) silently resumes from scratch, discarding all
-            # training progress. Fail loudly with both causes and fixes.
-            raise RuntimeError(
-                f"no complete checkpoint in {directory}, but "
-                f"{len(torn)} incomplete sharded checkpoint(s) exist "
-                f"(e.g. {os.path.basename(torn[-1])}). Causes: (a) the "
-                "saver was gated to one rank — for cross-process-sharded "
-                "state EVERY process must run ModelCheckpoint/"
-                "save_checkpoint; (b) a crash during the very first save. "
-                "Fix the gating (a) or delete the torn dir(s) to start "
-                "fresh (b)."
-            )
+    # A directory holding ONLY torn sharded dirs (the signature of a
+    # rank-gated ModelCheckpoint on a model-parallel run — rank 0 wrote its
+    # shard every epoch, the other ranks never did) must NOT silently resume
+    # from scratch discarding all progress. The torn flag travels in the
+    # broadcast header so EVERY rank raises together — a primary-only raise
+    # would leave the other ranks blocked in the broadcast collective below.
+    torn = (
+        _torn_sharded_dirs(directory) if primary and not path else []
+    )
     if primary:
         # Kill abandoned-future artifacts before training overwrites them —
         # see _discard_future_checkpoints for why this is load-bearing for
@@ -491,10 +482,25 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None) ->
         name[: len(raw)] = np.frombuffer(raw, np.uint8)
     if jax.process_count() > 1:
         hdr = collectives.broadcast(
-            np.array([epoch, int(sharded)], np.int64), root=0
+            np.array([epoch, int(sharded), len(torn)], np.int64), root=0
         )
         name = collectives.broadcast(name, root=0)
-        epoch, sharded = int(hdr[0]), bool(hdr[1])
+        epoch, sharded, n_torn = int(hdr[0]), bool(hdr[1]), int(hdr[2])
+    else:
+        n_torn = len(torn)
+    if n_torn:
+        detail = (
+            f" (e.g. {os.path.basename(torn[-1])})" if torn else ""
+        )
+        raise RuntimeError(
+            f"no complete checkpoint in {directory}, but {n_torn} "
+            f"incomplete sharded checkpoint(s) exist{detail}. Causes: "
+            "(a) the saver was gated to one rank — for cross-process-"
+            "sharded state EVERY process must run ModelCheckpoint/"
+            "save_checkpoint; (b) a crash during the very first save. "
+            "Fix the gating (a) or delete the torn dir(s) to start "
+            "fresh (b)."
+        )
     if epoch == 0:
         return template, 0
     if sharded:

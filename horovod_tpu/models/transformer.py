@@ -60,6 +60,24 @@ def _rope(x, positions, *, base: float = 10000.0):
     return rotated.astype(x.dtype)
 
 
+def packed_positions(segment_ids):
+    """[B, T] within-document positions for contiguous-run packing: token i's
+    position is its offset from the start of its run, so RoPE treats each
+    packed document as starting at 0 (matching how the documents would embed
+    unpacked)."""
+    b, t = segment_ids.shape
+    ar = jnp.arange(t, dtype=jnp.int32)
+    changed = jnp.concatenate(
+        [
+            jnp.ones((b, 1), bool),
+            segment_ids[:, 1:] != segment_ids[:, :-1],
+        ],
+        axis=1,
+    )
+    starts = jax.lax.cummax(jnp.where(changed, ar[None, :], 0), axis=1)
+    return ar[None, :] - starts
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardingConfig:
     """How the model meets the mesh.
@@ -101,7 +119,7 @@ class Block(nn.Module):
     moe_aux_coef: float = 1e-2
 
     @nn.compact
-    def __call__(self, x, positions, train: bool = False):
+    def __call__(self, x, positions, train: bool = False, segment_ids=None):
         cfg = self.sharding
         head_dim = self.d_model // self.n_heads
         dense = functools.partial(
@@ -136,20 +154,35 @@ class Block(nn.Module):
                     f"sequence-parallel attention needs attn in {sorted(impls)}, "
                     f"got {cfg.attn!r}"
                 )
+            if segment_ids is not None and cfg.attn == "ring_dense":
+                raise ValueError(
+                    "packed sequences (segment_ids) need attn='ring' or "
+                    "'ulysses' — the dense-block ring is segment-unaware"
+                )
             # Fully-manual region: batch stays split over data/fsdp, heads
             # over model (attention never mixes batch or heads, so manual
             # sharding there is free); the seq axis is the collective one.
+            # The segment ids (when packing) shard with the tokens; ring
+            # rotates the kv ids, Ulysses all-gathers them (ops/attention).
             spec = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
-            attend = jax.shard_map(
-                functools.partial(impls[cfg.attn], axis_name=SEQ_AXIS, causal=True),
-                mesh=cfg.mesh,
-                in_specs=(spec, spec, spec),
-                out_specs=spec,
-                check_vma=False,
+            impl = functools.partial(
+                impls[cfg.attn], axis_name=SEQ_AXIS, causal=True
             )
-            out = attend(q, k, v)
+            if segment_ids is None:
+                fn, args, in_specs = impl, (q, k, v), (spec, spec, spec)
+            else:
+                fn = lambda q, k, v, ids: impl(q, k, v, segment_ids=ids)  # noqa: E731
+                args = (q, k, v, segment_ids)
+                in_specs = (spec, spec, spec, P(BATCH_AXES, SEQ_AXIS))
+            out = jax.shard_map(
+                fn, mesh=cfg.mesh, in_specs=in_specs, out_specs=spec,
+                check_vma=False,
+            )(*args)
         elif cfg.attn == "dense":
-            out = attention_ops.dense_attention(q, k, v, causal=True)
+            out = attention_ops.dense_attention(
+                q, k, v, causal=True,
+                q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+            )
         else:
             # Local path: the pallas flash kernel (O(T) memory, ~2-3x over
             # XLA's materialized attention on v5e; falls back to dense when
@@ -159,17 +192,23 @@ class Block(nn.Module):
             # heads over model — attention mixes neither).
             from horovod_tpu.ops.flash_attention import flash_attention
 
-            local = functools.partial(flash_attention, causal=True)
+            def local(q, k, v, ids=None):
+                return flash_attention(
+                    q, k, v, causal=True,
+                    q_segment_ids=ids, kv_segment_ids=ids,
+                )
+
+            args = (q, k, v) if segment_ids is None else (q, k, v, segment_ids)
             if cfg.mesh is not None and cfg.mesh.size > 1:
                 spec = P(BATCH_AXES, None, MODEL_AXIS, None)
+                in_specs = (spec, spec, spec)
+                if segment_ids is not None:
+                    in_specs += (P(BATCH_AXES, None),)
                 local = jax.shard_map(
-                    local,
-                    mesh=cfg.mesh,
-                    in_specs=(spec, spec, spec),
-                    out_specs=spec,
+                    local, mesh=cfg.mesh, in_specs=in_specs, out_specs=spec,
                     check_vma=False,
                 )
-            out = local(q, k, v)
+            out = local(*args)
 
         out = dense(features=self.d_model, axis=(-2, -1), name="attn_out")(out)  # row-parallel
         out = nn.Dropout(self.dropout, deterministic=not train)(out)
@@ -228,10 +267,17 @@ class TransformerLM(nn.Module):
     moe_aux_coef: float = 1e-2
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False, segment_ids=None):
         cfg = self.sharding
         b, t = tokens.shape
-        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        if segment_ids is None:
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        else:
+            # Packed sequences: RoPE positions restart at each document
+            # boundary, and attention is restricted to within-document pairs
+            # (the flash kernel's segment masking, with block-level
+            # early-out on disjoint tiles).
+            positions = packed_positions(segment_ids)
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.compute_dtype)(tokens)
         x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
         # `train` is argnum 3 of Block.__call__ (self, x, positions, train)
@@ -252,7 +298,7 @@ class TransformerLM(nn.Module):
                 # identical with and without remat (the remat wrapper would
                 # otherwise scope as CheckpointBlock_i).
                 name=f"Block_{i}",
-            )(x, positions, train)
+            )(x, positions, train, segment_ids)
         x = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
         logits = nn.DenseGeneral(
             features=self.vocab_size, dtype=self.compute_dtype, use_bias=False,

@@ -40,19 +40,36 @@ def _scores(q, k, scale):
     ) * scale
 
 
-def dense_attention(q, k, v, *, causal: bool = True):
+def dense_attention(q, k, v, *, causal: bool = True, q_segment_ids=None,
+                    kv_segment_ids=None):
     """Reference full-materialization attention (numerics ground truth).
 
     float32 softmax regardless of input dtype — bf16 logits lose too much for
-    long sequences; the matmuls still run in the inputs' dtype on the MXU."""
+    long sequences; the matmuls still run in the inputs' dtype on the MXU.
+    ``q_segment_ids``/``kv_segment_ids`` ([B,Tq]/[B,Tk]) restrict attention
+    to equal-id pairs (packed sequences) — the reference semantics the flash
+    kernel's segment masking is tested against."""
     scale = q.shape[-1] ** -0.5
     s = _scores(q, k, scale)
+    keep = None
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
         q_pos = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + (tk - tq)
         k_pos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        s = jnp.where(q_pos >= k_pos, s, _BIG_NEG)
+        keep = (q_pos >= k_pos)[None, None]
+    if q_segment_ids is not None:
+        seg = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
+        keep = seg if keep is None else keep & seg
+    if keep is not None:
+        s = jnp.where(keep, s, _BIG_NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if keep is not None:
+        # Exact zeros: a FULLY-masked row (a q segment with no kv tokens, or
+        # causal rows before the first key when Tk < Tq) would otherwise get
+        # softmax's uniform 1/Tk and average ALL values — a cross-segment
+        # leak. Zeroing matches the flash kernel's empty-row convention
+        # (zero output); already-zero lanes are unaffected.
+        p = jnp.where(keep, p, 0.0)
     out = jnp.einsum(
         "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
@@ -118,7 +135,8 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
 
 
-def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
+                         segment_ids=None):
     """Ring attention whose per-hop block attention is the pallas flash
     kernel — the within-chip and cross-chip halves of the SAME online
     softmax: each hop computes its block's ``(out, lse)`` in O(T/n) memory
@@ -130,21 +148,34 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
 
     Same contract as `ring_attention`: call inside `shard_map` with
     ``[B, T/n, H, D]`` sequence shards; n == 1 degrades to exactly the
-    local flash/dense path."""
+    local flash/dense path.
+
+    ``segment_ids`` ([B, T/n], this device's shard of the packed-sequence
+    ids) restricts attention to equal-id pairs: the kv ids rotate around the
+    ring with their K/V blocks, and within each hop the kernel's block-level
+    early-out prunes segment-disjoint tiles — so a packed ring pays ICI for
+    every hop but FLOPs only where documents actually overlap. Every token
+    belongs to its own segment and (causal) sees at least itself, so the
+    merge normalizer never vanishes."""
     from horovod_tpu.ops.flash_attention import flash_attention_with_lse
 
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
 
-    def hop_contrib(j, k_blk, v_blk):
+    def hop_contrib(j, k_blk, v_blk, ks_blk):
         """(out, lse) of my queries against global block j."""
+        seg_kw = (
+            dict(q_segment_ids=segment_ids, kv_segment_ids=ks_blk)
+            if segment_ids is not None
+            else {}
+        )
 
         def diag(_):
-            return flash_attention_with_lse(q, k_blk, v_blk, causal=True)
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=True, **seg_kw)
 
         def full(_):
-            return flash_attention_with_lse(q, k_blk, v_blk, causal=False)
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=False, **seg_kw)
 
         def skip(_):
             # Entirely above the diagonal: lse = -BIG weights it to zero in
@@ -161,9 +192,9 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
         )
 
     def step(carry, i):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_blk, v_blk, ks_blk = carry
         j = (my - i) % n  # the block born at rank j is here after i hops
-        o_j, lse_j = hop_contrib(j, k_blk, v_blk)
+        o_j, lse_j = hop_contrib(j, k_blk, v_blk, ks_blk)
         m_new = jnp.maximum(m, lse_j)
         alpha = jnp.exp(m - m_new)
         w = jnp.exp(lse_j - m_new)
@@ -172,16 +203,21 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
         perm = [(r, (r + 1) % n) for r in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, m_new, l_new, k_blk, v_blk), None
+        if ks_blk is not None:
+            ks_blk = lax.ppermute(ks_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_blk, v_blk, ks_blk), None
 
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
     m0 = jnp.full((b, t_local, h), _BIG_NEG, jnp.float32)
     l0 = jnp.zeros((b, t_local, h), jnp.float32)
-    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    (o, _, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, segment_ids), jnp.arange(n)
+    )
     return (o / l[..., None]).astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
+                      segment_ids=None):
     """All-to-all sequence parallelism: swap seq-sharding for head-sharding,
     attend over the full sequence locally, swap back.
 
@@ -208,5 +244,14 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
 
     from horovod_tpu.ops.flash_attention import flash_attention
 
-    out = flash_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    seg_kw = {}
+    if segment_ids is not None:
+        # Per-token ids ([B, T/n] shard) have no head axis to swap; after the
+        # head-swap every device attends over the FULL sequence, so it needs
+        # the full ids — one [B, T] int gather, negligible next to K/V.
+        full_ids = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        seg_kw = dict(q_segment_ids=full_ids, kv_segment_ids=full_ids)
+    out = flash_attention(
+        to_heads(q), to_heads(k), to_heads(v), causal=causal, **seg_kw
+    )
     return to_seq(out)

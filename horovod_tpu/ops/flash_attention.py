@@ -45,15 +45,56 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
-def _causal_mask(iq, ik, bq, bk):
-    """[bq, bk] 0/1 mask for global rows iq*bq+r ≥ cols ik*bk+c."""
-    rows = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+# Segment-id operand layout (Mosaic-friendly, no in-kernel transposes):
+# q ids ride the SUBLANE axis as [B, Tq, LANES] (value broadcast across the
+# 128 lanes), kv ids ride the LANE axis as [B, SUBLANES, Tk] — so the
+# [bq, bk] equality mask is a lane-tile of the q block against row 0 of the
+# k block, both already in their natural in-register orientation.
+_SEG_LANES = 128
+_SEG_SUBLANES = 8
+
+
+def _causal_mask(iq, ik, bq, bk, offset):
+    """[bq, bk] 0/1 mask for global rows iq*bq+r+offset ≥ cols ik*bk+c.
+
+    ``offset = Tk - Tq`` aligns the sequences at the END (the standard
+    cross-attention/decode convention, matching `_dense_with_lse`): query i
+    sees keys j ≤ i + Tk - Tq. Zero for self-attention."""
+    rows = iq * bq + offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return (rows >= cols).astype(jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk):
+def _tile_mask(iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref):
+    """(needed, mask): the block-skip predicate and the [bq, bk] 0/1 mask
+    (None when unmasked). ``needed`` is False when the whole tile is
+    provably masked — above the causal diagonal, or (segment early-out) the
+    q block's id range cannot intersect the k block's (a NECESSARY condition
+    for any equality match, so the skip is sound for arbitrary id layouts,
+    and tight for the contiguous runs packing produces)."""
+    needed = True
+    mask = None
+    if causal:
+        needed = ik * bk <= iq * bq + bq - 1 + offset
+        mask = _causal_mask(iq, ik, bq, bk, offset)
+    if segmented:
+        qs = qs_ref[0]  # [bq, LANES]
+        ks = ks_ref[0, 0:1, :]  # [1, bk]
+        q_ids = jnp.tile(qs, (1, bk // _SEG_LANES))  # [bq, bk]
+        smask = (q_ids == ks).astype(jnp.float32)
+        overlap = (jnp.min(ks) <= jnp.max(qs)) & (jnp.max(ks) >= jnp.min(qs))
+        needed = overlap if needed is True else (needed & overlap)
+        mask = smask if mask is None else mask * smask
+    return needed, mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
+                bq, bk, offset):
+    if segmented:
+        qs_ref, ks_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+        qs_ref = ks_ref = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -63,9 +104,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, _BIG_NEG)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal block skip: a K block strictly above the diagonal contributes
-    # nothing — predicate the whole update away (half the FLOPs for causal).
-    needed = (not causal) or (ik * bk <= iq * bq + bq - 1)
+    # Block skip: a K block strictly above the causal diagonal — or with no
+    # possible segment match — contributes nothing; predicate the whole
+    # update away (half the FLOPs for causal; one matmul per co-resident
+    # segment pair for packed sequences).
+    needed, mask = _tile_mask(
+        iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref
+    )
 
     @pl.when(needed)
     def _():
@@ -76,15 +121,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        if causal:
-            mask = _causal_mask(iq, ik, bq, bk)
+        if mask is not None:
             s = s + (1.0 - mask) * _BIG_NEG
 
         m_prev = m_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        if causal:
+        if mask is not None:
             p = p * mask  # exact zeros on masked lanes
         l_ref[:, 0:1] = l_ref[:, 0:1] * alpha + p.sum(axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -96,12 +140,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(ik == nk - 1)
     def _():
         l = l_ref[:, 0:1]
-        o_ref[0, 0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0, :, :] = m_ref[:, 0:1] + jnp.log(l)
+        # A row every key is masked away from (a padding segment with no kv
+        # tokens, or causal rows before the first key when Tk < Tq) has
+        # l == 0: emit 0 output and a -inf-like lse so any downstream
+        # online-softmax merge weights it to zero — never NaN.
+        empty = l == 0.0
+        l_safe = jnp.where(empty, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = jnp.where(
+            empty, _BIG_NEG, m_ref[:, 0:1] + jnp.log(l_safe)
+        )
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, bq, bk):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, segmented, bq, bk, offset):
+    if segmented:
+        qs_ref, ks_ref, dq_ref, acc_ref = rest
+    else:
+        dq_ref, acc_ref = rest
+        qs_ref = ks_ref = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -109,7 +166,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    needed = (not causal) or (ik * bk <= iq * bq + bq - 1)
+    needed, mask = _tile_mask(
+        iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref
+    )
 
     @pl.when(needed)
     def _():
@@ -123,14 +182,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if mask is not None:
             # Mask BEFORE exp (as the forward does): a large masked score
             # would overflow exp to inf, and the TPU's inf*0 is NaN — the
             # post-hoc `p * mask` alone is only safe in interpret mode.
-            mask = _causal_mask(iq, ik, bq, bk)
             s = s + (1.0 - mask) * _BIG_NEG
         p = jnp.exp(s - lse)
-        if causal:
+        if mask is not None:
             p = p * mask
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -147,8 +205,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0, :, :] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, segmented, bq, bk, offset):
+    if segmented:
+        qs_ref, ks_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        qs_ref = ks_ref = None
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -157,7 +220,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    needed = (not causal) or (ik * bk <= iq * bq + bq - 1)
+    needed, mask = _tile_mask(
+        iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref
+    )
 
     @pl.when(needed)
     def _():
@@ -171,11 +236,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            mask = _causal_mask(iq, ik, bq, bk)
+        if mask is not None:
             s = s + (1.0 - mask) * _BIG_NEG  # pre-exp: see _bwd_dq_kernel
         p = jnp.exp(s - lse)
-        if causal:
+        if mask is not None:
             p = p * mask
         # dV += Pᵀ · dO
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -215,39 +279,76 @@ def _stat_spec(bq, *, inner: bool):
     return pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, i, j: (ib, ih, i, 0))
 
 
+def _seg_q_spec(bq, *, inner: bool):
+    """[B, Tq, LANES] q segment ids (no head dim — shared across heads)."""
+    if inner:
+        return pl.BlockSpec((1, bq, _SEG_LANES), lambda ib, ih, i, j: (ib, j, 0))
+    return pl.BlockSpec((1, bq, _SEG_LANES), lambda ib, ih, i, j: (ib, i, 0))
+
+
+def _seg_kv_spec(bk, *, inner: bool):
+    """[B, SUBLANES, Tk] kv segment ids."""
+    if inner:
+        return pl.BlockSpec(
+            (1, _SEG_SUBLANES, bk), lambda ib, ih, i, j: (ib, 0, j)
+        )
+    return pl.BlockSpec((1, _SEG_SUBLANES, bk), lambda ib, ih, i, j: (ib, 0, i))
+
+
+def _seg_operands(q_seg, kv_seg, tq, tk):
+    """Lift [B, Tq]/[B, Tk] ids into the kernel's register-oriented layouts
+    (see _SEG_LANES note). int32; values are opaque labels."""
+    qs = lax.broadcast_in_dim(
+        q_seg.astype(jnp.int32), (q_seg.shape[0], tq, _SEG_LANES), (0, 1)
+    )
+    ks = lax.broadcast_in_dim(
+        kv_seg.astype(jnp.int32), (kv_seg.shape[0], _SEG_SUBLANES, tk), (0, 2)
+    )
+    return qs, ks
+
+
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
 )
-def _flash(q, k, v, causal, bq, bk, interpret):
-    out, _ = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
+def _flash(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
     return out
 
 
-def _flash_fwd_impl(q, k, v, causal, bq, bk, interpret):
+def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
     # Kernel layout is [B, H, T, D] so the (T-block, D) tile occupies the
-    # trailing dims; callers pass [B, T, H, D].
+    # trailing dims; callers pass [B, T, H, D]. K/V carry their own Tk
+    # (cross-attention); causality aligns the sequence ENDS via offset.
     qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
-    b, h, t, d = qt.shape
+    b, h, tq, d = qt.shape
+    tk = kt.shape[2]
+    segmented = q_seg is not None
     scale = d ** -0.5
-    grid = (b, h, t // bq, t // bk)
+    grid = (b, h, tq // bq, tk // bk)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        _fwd_kernel, scale=scale, causal=causal, segmented=segmented,
+        bq=bq, bk=bk, offset=tk - tq,
     )
+    in_specs = [
+        _block_spec(d, bq, inner=False),
+        _block_spec(d, bk, inner=True),
+        _block_spec(d, bk, inner=True),
+    ]
+    operands = [qt, kt, vt]
+    if segmented:
+        in_specs += [_seg_q_spec(bq, inner=False), _seg_kv_spec(bk, inner=True)]
+        operands += list(_seg_operands(q_seg, kv_seg, tq, tk))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            _block_spec(d, bq, inner=False),
-            _block_spec(d, bk, inner=True),
-            _block_spec(d, bk, inner=True),
-        ],
+        in_specs=in_specs,
         out_specs=[
             _block_spec(d, bq, inner=False),
             _stat_spec(bq, inner=False),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -255,13 +356,13 @@ def _flash_fwd_impl(q, k, v, causal, bq, bk, interpret):
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*operands)
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
-def _flash_fwd(q, k, v, causal, bq, bk, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
 def _flash_bwd(causal, bq, bk, interpret, res, g):
@@ -274,11 +375,13 @@ def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
     with s → p = exp(s−lse), o = p·v:  ds = p ⊙ (dp − (δ − dlse)) where
     δ_i = Σ_d dO·O, because ∂lse/∂s = p. So the kernels run unchanged with
     an adjusted δ."""
-    q, k, v, out, lse = res
+    q, k, v, q_seg, kv_seg, out, lse = res
     qt, kt, vt, gt = (
         jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v, g)
     )
-    b, h, t, d = qt.shape
+    b, h, tq, d = qt.shape
+    tk = kt.shape[2]
+    segmented = q_seg is not None
     scale = d ** -0.5
     # delta_i = Σ_d dO·O — the softmax-jacobian row term, cheap outside.
     delta = jnp.einsum(
@@ -287,35 +390,52 @@ def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
     if g_lse is not None:
         # g_lse arrives in the caller-facing [B, T, H] layout.
         delta = delta - jnp.transpose(g_lse, (0, 2, 1))[..., None]
+    seg_ops = list(_seg_operands(q_seg, kv_seg, tq, tk)) if segmented else []
 
+    dq_in_specs = [
+        _block_spec(d, bq, inner=False),
+        _block_spec(d, bk, inner=True),
+        _block_spec(d, bk, inner=True),
+        _block_spec(d, bq, inner=False),
+        _stat_spec(bq, inner=False),
+        _stat_spec(bq, inner=False),
+    ]
+    if segmented:
+        dq_in_specs += [
+            _seg_q_spec(bq, inner=False), _seg_kv_spec(bk, inner=True)
+        ]
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
-        grid=(b, h, t // bq, t // bk),
-        in_specs=[
-            _block_spec(d, bq, inner=False),
-            _block_spec(d, bk, inner=True),
-            _block_spec(d, bk, inner=True),
-            _block_spec(d, bq, inner=False),
-            _stat_spec(bq, inner=False),
-            _stat_spec(bq, inner=False),
-        ],
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, segmented=segmented,
+            bq=bq, bk=bk, offset=tk - tq,
+        ),
+        grid=(b, h, tq // bq, tk // bk),
+        in_specs=dq_in_specs,
         out_specs=_block_spec(d, bq, inner=False),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, gt, lse, delta)
+    )(qt, kt, vt, gt, lse, delta, *seg_ops)
 
+    dkv_in_specs = [
+        _block_spec(d, bq, inner=True),
+        _block_spec(d, bk, inner=False),
+        _block_spec(d, bk, inner=False),
+        _block_spec(d, bq, inner=True),
+        _stat_spec(bq, inner=True),
+        _stat_spec(bq, inner=True),
+    ]
+    if segmented:
+        dkv_in_specs += [
+            _seg_q_spec(bq, inner=True), _seg_kv_spec(bk, inner=False)
+        ]
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
-        grid=(b, h, t // bk, t // bq),
-        in_specs=[
-            _block_spec(d, bq, inner=True),
-            _block_spec(d, bk, inner=False),
-            _block_spec(d, bk, inner=False),
-            _block_spec(d, bq, inner=True),
-            _stat_spec(bq, inner=True),
-            _stat_spec(bq, inner=True),
-        ],
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, segmented=segmented,
+            bq=bq, bk=bk, offset=tk - tq,
+        ),
+        grid=(b, h, tk // bk, tq // bq),
+        in_specs=dkv_in_specs,
         out_specs=[
             _block_spec(d, bk, inner=False),
             _block_spec(d, bk, inner=False),
@@ -329,28 +449,29 @@ def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, gt, lse, delta)
+    )(qt, kt, vt, gt, lse, delta, *seg_ops)
     back = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
-    return back(dq), back(dk), back(dv)
+    # Integer segment-id operands take no gradient (None cotangent).
+    return back(dq), back(dk), back(dv), None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_lse(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
     """Kernel entry that also RETURNS the per-row logsumexp — the statistic
     a cross-chip online-softmax merge needs (ring attention: each hop's
     (out, lse) pair is exactly one step of the recurrence)."""
-    out, lse = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
+    out, lse = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
     return out, jnp.transpose(lse[..., 0], (0, 2, 1))  # [B,H,T,1]→[B,T,H]
 
 
-def _flash_lse_fwd(q, k, v, causal, bq, bk, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, causal, bq, bk, interpret)
+def _flash_lse_fwd(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
     return (
         (out, jnp.transpose(lse[..., 0], (0, 2, 1))),
-        (q, k, v, out, lse),
+        (q, k, v, q_seg, kv_seg, out, lse),
     )
 
 
@@ -362,28 +483,65 @@ def _flash_lse_bwd(causal, bq, bk, interpret, res, cotangents):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def _dense_with_lse(q, k, v, *, causal: bool):
+def _dense_with_lse(q, k, v, *, causal: bool, q_segment_ids=None,
+                    kv_segment_ids=None):
     """Dense (out, lse) fallback, numerically matching the kernel's
-    conventions: f32 statistics, fully-masked rows get lse = _BIG_NEG-ish
-    (so a merge weights them to zero), natively differentiable."""
+    conventions: f32 statistics, fully-masked rows get lse ≈ _BIG_NEG and
+    zero output (so a merge weights them to zero), natively differentiable.
+    Also the segment-mask REFERENCE the kernel parity tests compare to."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    masked = causal or q_segment_ids is not None
+    keep = None
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
         rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + (tk - tq)
         cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        s = jnp.where(rows >= cols, s, _BIG_NEG)
+        keep = rows >= cols  # [Tq, Tk], broadcasts over [B, H]
+    if q_segment_ids is not None:
+        seg = (
+            q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
+        )  # [B, 1, Tq, Tk]
+        keep = seg if keep is None else (keep & seg)
+    if masked:
+        s = jnp.where(keep, s, _BIG_NEG)
     m = s.max(axis=-1, keepdims=True)
     p = jnp.exp(s - m)
+    if masked:
+        # Exact zeros so a fully-masked row yields l == 0 (not tk) and the
+        # empty-row convention below matches the kernel's.
+        p = jnp.where(keep, p, 0.0)
     l = p.sum(axis=-1, keepdims=True)
+    empty = l == 0.0
+    l_safe = jnp.where(empty, 1.0, l)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", (p / l).astype(v.dtype), v,
+        "bhqk,bkhd->bqhd", (p / l_safe).astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)
-    lse = (m + jnp.log(l))[..., 0]  # [B,H,Tq]
+    lse = jnp.where(empty, _BIG_NEG, m + jnp.log(l_safe))[..., 0]  # [B,H,Tq]
     return out, jnp.transpose(lse, (0, 2, 1))  # [B,Tq,H]
+
+
+def _check_segment_shapes(q, k, q_segment_ids, kv_segment_ids):
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError(
+            "pass q_segment_ids and kv_segment_ids together (for packed "
+            "self-attention they are the same array)"
+        )
+    if q_segment_ids is None:
+        return
+    if q_segment_ids.shape != (q.shape[0], q.shape[1]):
+        raise ValueError(
+            f"q_segment_ids must be [B, Tq] = {(q.shape[0], q.shape[1])}, "
+            f"got {q_segment_ids.shape}"
+        )
+    if kv_segment_ids.shape != (k.shape[0], k.shape[1]):
+        raise ValueError(
+            f"kv_segment_ids must be [B, Tk] = {(k.shape[0], k.shape[1])}, "
+            f"got {kv_segment_ids.shape}"
+        )
 
 
 def flash_attention_with_lse(
@@ -391,21 +549,35 @@ def flash_attention_with_lse(
     causal: bool = True,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    q_segment_ids=None,
+    kv_segment_ids=None,
     interpret: bool | None = None,
 ):
-    """[B,T,H,D] attention returning ``(out, lse)`` with ``lse`` [B,T,H] —
+    """[B,Tq,H,D] attention returning ``(out, lse)`` with ``lse`` [B,Tq,H] —
     the building block for cross-chip softmax merges (ring attention).
     Same kernel/fallback/interpret policy as `flash_attention`; gradients
     flow through BOTH outputs (the lse cotangent folds into the kernel
     backward's δ term)."""
+    _check_segment_shapes(q, k, q_segment_ids, kv_segment_ids)
+    segmented = q_segment_ids is not None
     block_q, block_k = pick_blocks(
-        q.shape[1], q.shape[-1], q.dtype, block_q, block_k
+        q.shape[1], q.shape[-1], q.dtype, block_q, block_k, t_k=k.shape[1],
+        segmented=segmented,
     )
-    if not supported(q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype):
-        return _dense_with_lse(q, k, v, causal=causal)
+    if not supported(
+        q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype,
+        segmented=segmented,
+    ):
+        return _dense_with_lse(
+            q, k, v, causal=causal,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_lse(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_lse(
+        q, k, v, q_segment_ids, kv_segment_ids, causal, block_q, block_k,
+        interpret,
+    )
 
 
 def _sublane(dtype) -> int:
@@ -416,40 +588,51 @@ def _sublane(dtype) -> int:
 
 
 def supported(q_shape, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K,
-              k_shape=None, dtype=jnp.float32) -> bool:
-    """Whether the kernel's tiling holds for [B,T,H,D] q/k/v.
+              k_shape=None, dtype=jnp.float32, segmented=False) -> bool:
+    """Whether the kernel's tiling holds for [B,Tq,H,D] q and [B,Tk,H,D] k/v.
 
-    Beyond divisibility, the blocks must be sublane-aligned for the dtype
-    (an unaligned tile fails Mosaic compilation on real TPU instead of
-    falling back), and K/V must share q's sequence length — the grid is
-    derived from q's T, so a cross-attention call with Tk != Tq would index
-    K/V blocks out of range (silent garbage in interpret mode).
+    Beyond divisibility (q blocks against Tq, k blocks against K/V's own Tk —
+    cross-attention runs the kernel on a rectangular nq×nk grid), the blocks
+    must be sublane-aligned for the dtype (an unaligned tile fails Mosaic
+    compilation on real TPU instead of falling back), and segment-id masking
+    needs lane-aligned K blocks (the q-id tile is repeated in _SEG_LANES
+    units across the K axis).
 
     This checks ONE given block config; it is not a will-the-kernel-run
     predicate for `flash_attention`, which first degrades the config via
     `pick_blocks` — probe with ``supported(shape, *pick_blocks(...))``.
     """
     b, t, h, d = q_shape
-    if k_shape is not None and k_shape[1] != t:
-        return False
+    tk = k_shape[1] if k_shape is not None else t
     granule = _sublane(dtype)
+    if segmented and bk % _SEG_LANES:
+        return False
     return (
-        t % bq == 0 and t % bk == 0
+        t % bq == 0 and tk % bk == 0
         and bq % granule == 0 and bk % granule == 0
         and d <= 256
     )
 
 
 def pick_blocks(t: int, d: int, dtype, bq: int = DEFAULT_BLOCK_Q,
-                bk: int = DEFAULT_BLOCK_K) -> tuple[int, int]:
+                bk: int = DEFAULT_BLOCK_K, t_k: int | None = None,
+                segmented: bool = False) -> tuple[int, int]:
     """Largest workable (block_q, block_k) ≤ the requested sizes for a
-    [*, t, *, d] attention call: clamp for wide heads (a 1024² f32 score
-    tile + wide q/k/v blocks would crowd VMEM), clamp to T, then halve until
-    the block divides T — so e.g. T=1536 runs 512² tiles instead of
-    regressing to the dense fallback just because 1536 % 1024 != 0."""
+    [*, t, *, d] attention call (``t_k`` = K/V's own length for
+    cross-attention; default self-attention): clamp for wide heads (a 1024²
+    f32 score tile + wide q/k/v blocks would crowd VMEM), clamp to T, then
+    halve until the block divides its T — so e.g. T=1536 runs 512² tiles
+    instead of regressing to the dense fallback just because
+    1536 % 1024 != 0."""
+    t_k = t if t_k is None else t_k
     if d > 128:
         bq, bk = min(bq, 512), min(bk, 512)
-    bq, bk = min(bq, t), min(bk, t)
+    if segmented:
+        # The double-buffered segment-id tiles ([bq, LANES] i32 q-ids) push
+        # 1024² configs ~0.8 MB past v5e's 16 MB VMEM stack; 512² fits with
+        # headroom and measured within a few % of 1024² in the block sweep.
+        bq, bk = min(bq, 512), min(bk, 512)
+    bq, bk = min(bq, t), min(bk, t_k)
     # Degrade no further than 128: below that the kernel's tiny score tiles
     # underfill the MXU and the dense fallback is faster — leaving a
     # non-dividing block here makes `supported` reject and fall back.
@@ -459,7 +642,7 @@ def pick_blocks(t: int, d: int, dtype, bq: int = DEFAULT_BLOCK_Q,
     floor = max(_sublane(dtype), 128)
     while t % bq and bq // 2 >= floor:
         bq //= 2
-    while t % bk and bk // 2 >= floor:
+    while t_k % bk and bk // 2 >= floor:
         bk //= 2
     return bq, bk
 
@@ -469,16 +652,40 @@ def flash_attention(
     causal: bool = True,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    q_segment_ids=None,
+    kv_segment_ids=None,
     interpret: bool | None = None,
 ):
-    """[B,T,H,D] attention via the pallas kernel; dense fallback when the
+    """[B,Tq,H,D] attention via the pallas kernel; dense fallback when the
     tiling doesn't hold. ``interpret=None`` auto-selects the pallas
-    interpreter off-TPU so tests/CPU paths run the same kernel code."""
+    interpreter off-TPU so tests/CPU paths run the same kernel code.
+
+    ``q_segment_ids``/``kv_segment_ids`` ([B,Tq]/[B,Tk] ints) restrict
+    attention to equal-id pairs — the packed-sequence pretraining mask
+    (multiple documents per row, none attending across its neighbors), with
+    block-level early-out so disjoint tile pairs cost no FLOPs. K/V may
+    carry their own length Tk ≠ Tq (cross-attention); with ``causal`` the
+    sequences align at their ENDS (query i sees keys j ≤ i + Tk − Tq)."""
+    _check_segment_shapes(q, k, q_segment_ids, kv_segment_ids)
+    segmented = q_segment_ids is not None
     block_q, block_k = pick_blocks(
-        q.shape[1], q.shape[-1], q.dtype, block_q, block_k
+        q.shape[1], q.shape[-1], q.dtype, block_q, block_k, t_k=k.shape[1],
+        segmented=segmented,
     )
-    if not supported(q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype):
+    if not supported(
+        q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype,
+        segmented=segmented,
+    ):
+        if segmented or k.shape[1] != q.shape[1]:
+            out, _ = _dense_with_lse(
+                q, k, v, causal=causal,
+                q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            )
+            return out
         return dense_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(
+        q, k, v, q_segment_ids, kv_segment_ids, causal, block_q, block_k,
+        interpret,
+    )

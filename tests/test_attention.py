@@ -168,3 +168,96 @@ class TestDenseAttention:
         np.testing.assert_allclose(
             np.asarray(out[:, 0]), np.asarray(v[:, 0]), rtol=1e-5, atol=1e-5
         )
+
+
+class TestSegmentedSequenceParallel:
+    """Packed-sequence (segment-id) masking through the SP schemes — the ids
+    shard with the tokens; kv ids ride the ring / gather across the swap."""
+
+    def _ids(self, seed=30):
+        rng = np.random.RandomState(seed)
+        cuts = np.sort(rng.choice(np.arange(1, T), 3, replace=False))
+        ids = np.searchsorted(cuts, np.arange(T), side="right")
+        return np.broadcast_to(ids, (B, T)).astype(np.int32).copy()
+
+    def _global_ref(self, q, k, v, ids, causal):
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal,
+            q_segment_ids=jnp.asarray(ids), kv_segment_ids=jnp.asarray(ids),
+        )
+
+    def _sharded_seg(self, fn, mesh, **kwargs):
+        spec = P(None, "seq", None, None)
+        ispec = P(None, "seq")
+        return jax.jit(
+            shard_map(
+                lambda q, k, v, ids: fn(
+                    q, k, v, axis_name="seq", segment_ids=ids, **kwargs
+                ),
+                mesh=mesh,
+                in_specs=(spec, spec, spec, ispec),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_ring_flash_matches_global(self, causal):
+        q, k, v = _qkv(31)
+        ids = self._ids()
+        got = self._sharded_seg(ring_flash_attention, _seq_mesh(), causal=causal)(
+            q, k, v, ids
+        )
+        expected = self._global_ref(q, k, v, ids, causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_ulysses_matches_global(self, causal):
+        q, k, v = _qkv(32)
+        ids = self._ids(33)
+        got = self._sharded_seg(ulysses_attention, _seq_mesh(), causal=causal)(
+            q, k, v, ids
+        )
+        expected = self._global_ref(q, k, v, ids, causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_ring_flash_segment_grads(self):
+        q, k, v = _qkv(34)
+        ids = self._ids(35)
+        ring = self._sharded_seg(ring_flash_attention, _seq_mesh(), causal=True)
+
+        g_ring = jax.grad(
+            lambda q, k, v: (ring(q, k, v, ids) ** 2).sum(), argnums=(0, 1, 2)
+        )(*map(jnp.asarray, (q, k, v)))
+        g_ref = jax.grad(
+            lambda q, k, v: (self._global_ref(q, k, v, ids, True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(*map(jnp.asarray, (q, k, v)))
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+
+def test_dense_attention_empty_segment_rows_zero():
+    """A q row whose segment has no kv tokens must output ZERO from
+    dense_attention too (not softmax's uniform average of all values — a
+    cross-segment leak), matching the flash kernel's empty-row convention."""
+    rng = np.random.RandomState(40)
+    q = jnp.asarray(rng.randn(1, 8, 2, 4).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 8, 2, 4).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 8, 2, 4).astype(np.float32))
+    q_seg = jnp.asarray(np.array([[0, 0, 1, 1, 0, 0, 1, 1]], np.int32))
+    kv_seg = jnp.zeros((1, 8), jnp.int32)
+    out = dense_attention(
+        q, k, v, causal=False, q_segment_ids=q_seg, kv_segment_ids=kv_seg
+    )
+    empty = np.asarray(q_seg)[0] == 1
+    np.testing.assert_array_equal(np.asarray(out)[0, empty], 0.0)
+    assert np.isfinite(np.asarray(out)).all()

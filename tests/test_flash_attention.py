@@ -72,14 +72,14 @@ class TestForward:
         assert supported((1, 104, 2, 64), 8, 8, dtype=jnp.float32)
         assert not supported((1, 104, 2, 64), 8, 8, dtype=jnp.bfloat16)
 
-    def test_fallback_cross_attention(self):
-        """Tk != Tq must not reach the kernel (its grid is derived from q's
-        T and would index K/V blocks out of range)."""
+    def test_cross_attention_runs_kernel(self):
+        """Tk != Tq runs the kernel on a rectangular nq×nk grid (round-3:
+        previously this was a dense fallback) and matches dense exactly."""
         rng = np.random.RandomState(6)
         q = jnp.asarray(rng.randn(1, 64, 2, 64).astype(np.float32))
         k = jnp.asarray(rng.randn(1, 128, 2, 64).astype(np.float32))
-        v = k
-        assert not supported(q.shape, 32, 32, k_shape=k.shape)
+        v = jnp.asarray(rng.randn(1, 128, 2, 64).astype(np.float32))
+        assert supported(q.shape, 32, 32, k_shape=k.shape)
         out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
         expected = dense_attention(q, k, v, causal=False)
         np.testing.assert_allclose(
@@ -235,3 +235,239 @@ class TestBackward:
         np.testing.assert_allclose(
             np.asarray(gf), np.asarray(gd), rtol=1e-4, atol=1e-4
         )
+
+
+def _dense_masked(q, k, v, keep):
+    """Independent dense reference: explicit [B,Tq,Tk] boolean mask, exact
+    zero rows where nothing is kept (the kernel's empty-row convention)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.where(keep[:, None, :, :], s, -1e30)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.where(keep[:, None, :, :], jnp.exp(s - m), 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.where(l == 0, 1.0, l), v)
+    return out
+
+
+def _packed_segments(rng, b, t, max_docs=4):
+    """[B, T] contiguous-run segment ids, like sequence packing produces."""
+    ids = np.zeros((b, t), np.int32)
+    for i in range(b):
+        cuts = np.sort(rng.choice(np.arange(1, t), size=max_docs - 1, replace=False))
+        ids[i] = np.searchsorted(cuts, np.arange(t), side="right")
+    return jnp.asarray(ids)
+
+
+class TestSegments:
+    """Packed-sequence (segment-id) masking — round-3 feature. bk must be a
+    multiple of 128 (lane tiling of the q-id block), so blocks are 32×128."""
+
+    SEG_BLOCKS = dict(block_q=32, block_k=128)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_masked_dense(self, causal):
+        rng = np.random.RandomState(11)
+        q, k, v = _qkv(11)
+        seg = _packed_segments(rng, B, T)
+        out = flash_attention(
+            q, k, v, causal=causal,
+            q_segment_ids=seg, kv_segment_ids=seg, **self.SEG_BLOCKS,
+        )
+        keep = seg[:, :, None] == seg[:, None, :]
+        if causal:
+            tri = jnp.tril(jnp.ones((T, T), bool))
+            keep = keep & tri[None]
+        expected = _dense_masked(q, k, v, keep)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
+    def test_grads_match_masked_dense(self):
+        rng = np.random.RandomState(12)
+        q, k, v = _qkv(12)
+        seg = _packed_segments(rng, B, T)
+        keep = (seg[:, :, None] == seg[:, None, :]) & jnp.tril(
+            jnp.ones((T, T), bool)
+        )[None]
+
+        gf = jax.grad(
+            lambda q, k, v: (
+                flash_attention(
+                    q, k, v, causal=True,
+                    q_segment_ids=seg, kv_segment_ids=seg, **self.SEG_BLOCKS,
+                ) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (_dense_masked(q, k, v, keep) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_with_lse_segments(self):
+        """The lse entry (ring building block) honors segments too."""
+        rng = np.random.RandomState(13)
+        q, k, v = _qkv(13)
+        seg = _packed_segments(rng, B, T)
+        out, lse = flash_attention_with_lse(
+            q, k, v, causal=False,
+            q_segment_ids=seg, kv_segment_ids=seg, **self.SEG_BLOCKS,
+        )
+        keep = seg[:, :, None] == seg[:, None, :]
+        expected = _dense_masked(q, k, v, keep)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+        assert lse.shape == (B, T, H)
+        # lse really is log-sum-exp of the kept scores.
+        scale = D ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        s = jnp.where(keep[:, None, :, :], s, -jnp.inf)
+        ref = jax.nn.logsumexp(s, axis=-1)  # [B,H,T]
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(ref, (0, 2, 1))), np.asarray(lse),
+            rtol=1e-5, atol=1e-4,
+        )
+
+    def test_empty_rows_zero_not_nan(self):
+        """A q row whose segment has no kv tokens (cross-attention against a
+        filtered memory): zero output, finite lse, zero grads — never NaN."""
+        rng = np.random.RandomState(14)
+        q, k, v = _qkv(14)
+        q_seg = jnp.asarray(rng.randint(0, 2, (B, T)).astype(np.int32))
+        kv_seg = jnp.zeros((B, T), jnp.int32)  # only segment 0 has keys
+
+        def f(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=False,
+                q_segment_ids=q_seg, kv_segment_ids=kv_seg, **self.SEG_BLOCKS,
+            )
+            return out, (out ** 2).sum()
+
+        out, _ = f(q, k, v)
+        rows_empty = np.asarray(q_seg) == 1
+        np.testing.assert_array_equal(
+            np.asarray(out)[rows_empty], 0.0
+        )
+        grads = jax.grad(lambda *a: f(*a)[1], argnums=(0, 1, 2))(q, k, v)
+        for g in grads:
+            assert np.isfinite(np.asarray(g)).all()
+
+    def test_mismatched_segment_args_rejected(self):
+        q, k, v = _qkv(15)
+        seg = jnp.zeros((B, T), jnp.int32)
+        with pytest.raises(ValueError, match="together"):
+            flash_attention(q, k, v, q_segment_ids=seg)
+        with pytest.raises(ValueError, match="Tq"):
+            flash_attention(
+                q, k, v, q_segment_ids=seg[:, :64], kv_segment_ids=seg
+            )
+
+    def test_unaligned_block_falls_back_dense(self):
+        """Segmented with bk not lane-aligned must fall back (still correct)."""
+        rng = np.random.RandomState(16)
+        q, k, v = _qkv(16)
+        seg = _packed_segments(rng, B, T)
+        assert not supported(
+            q.shape, 32, 32, k_shape=q.shape, segmented=True
+        )
+        out = flash_attention(
+            q, k, v, causal=False, block_q=32, block_k=32,
+            q_segment_ids=seg, kv_segment_ids=seg,
+        )
+        keep = seg[:, :, None] == seg[:, None, :]
+        expected = _dense_masked(q, k, v, keep)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestCrossAttention:
+    """Tk != Tq on the kernel's rectangular grid — round-3 feature."""
+
+    def test_causal_offset_matches_dense(self):
+        """Causal cross-attention aligns sequence ENDS: query i sees keys
+        j <= i + Tk - Tq (the decode/suffix convention)."""
+        rng = np.random.RandomState(21)
+        tq, tk = 64, 192
+        q = jnp.asarray(rng.randn(B, tq, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        rows = np.arange(tq)[:, None] + (tk - tq)
+        keep = jnp.asarray(
+            np.broadcast_to(rows >= np.arange(tk)[None, :], (B, tq, tk))
+        )
+        expected = _dense_masked(q, k, v, keep)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
+    def test_cross_grads_match_dense(self):
+        rng = np.random.RandomState(22)
+        tq, tk = 96, 32
+        q = jnp.asarray(rng.randn(B, tq, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        keep = jnp.ones((B, tq, tk), bool)
+
+        gf = jax.grad(
+            lambda q, k, v: (
+                flash_attention(
+                    q, k, v, causal=False, block_q=32, block_k=32
+                ) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (_dense_masked(q, k, v, keep) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_cross_with_segments(self):
+        """Cross-attention + segment filtering compose (retrieval pattern:
+        each query row attends only its document's memory slice)."""
+        rng = np.random.RandomState(23)
+        tq, tk = 64, 128
+        q = jnp.asarray(rng.randn(B, tq, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        q_seg = jnp.asarray(rng.randint(0, 3, (B, tq)).astype(np.int32))
+        kv_seg = jnp.asarray(rng.randint(0, 3, (B, tk)).astype(np.int32))
+        out = flash_attention(
+            q, k, v, causal=False, block_q=32, block_k=128,
+            q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        )
+        keep = q_seg[:, :, None] == kv_seg[:, None, :]
+        expected = _dense_masked(q, k, v, keep)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
+    def test_causal_tk_smaller_empty_head_rows(self):
+        """Tk < Tq causal: the first Tq-Tk rows see no keys at all — they
+        must come out zero with finite grads (empty-row convention)."""
+        rng = np.random.RandomState(24)
+        tq, tk = 96, 32
+        q = jnp.asarray(rng.randn(B, tq, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, tk, H, D).astype(np.float32))
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_array_equal(np.asarray(out)[:, : tq - tk], 0.0)
+        g = jax.grad(
+            lambda q: (
+                flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+                ** 2
+            ).sum()
+        )(q)
+        assert np.isfinite(np.asarray(g)).all()
