@@ -28,9 +28,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.models.transformer import _rope
-from horovod_tpu.ops.attention import dense_attention
 from horovod_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, PIPE_AXIS
-from horovod_tpu.parallel.pipeline import spmd_pipeline, stage_slice_size
+from horovod_tpu.parallel.pipeline import (
+    spmd_pipeline,
+    spmd_pipeline_1f1b,
+    stage_slice_size,
+)
 
 BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
 
@@ -56,6 +59,11 @@ class PipelinedLM(nn.Module):
     n_micro: int = 4
     compute_dtype: jnp.dtype = jnp.float32
     mesh: Mesh | None = None
+    # 'gpipe' = AD-derived backward (parallel/pipeline.spmd_pipeline);
+    # '1f1b' = hand-scheduled staggered backward with per-microbatch
+    # rematerialization — the 1F1B activation-memory discipline
+    # (spmd_pipeline_1f1b). Identical math; parity-tested gradients.
+    schedule: str = "gpipe"
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
@@ -82,6 +90,14 @@ class PipelinedLM(nn.Module):
         b, t = tokens.shape
         cd = self.compute_dtype
         x = embed[tokens].astype(cd)  # [B, T, d]
+
+        # Validate unconditionally: a typo'd schedule on a pipe-less mesh
+        # would otherwise train silently via the sequential path and only
+        # error when the config moves to a real pipeline mesh.
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {self.schedule!r}"
+            )
 
         if self.mesh is None or self.mesh.shape.get(PIPE_AXIS, 1) == 1:
             # No pipe axis: run the stack sequentially (the n_stages=1
@@ -119,14 +135,18 @@ class PipelinedLM(nn.Module):
             )
 
             def run(stage_params, xm):
-                def stage(act):
+                def stage(params, act):
                     def body(a, p):
                         return self._block(a, p), None
 
-                    a, _ = lax.scan(body, act, stage_params)
+                    a, _ = lax.scan(body, act, params)
                     return a
 
-                return spmd_pipeline(stage, xm)
+                if self.schedule == "1f1b":
+                    return spmd_pipeline_1f1b(stage, stage_params, xm)
+                return spmd_pipeline(
+                    lambda act: stage(stage_params, act), xm
+                )
 
             x_micro = jax.shard_map(
                 run,
@@ -153,7 +173,13 @@ class PipelinedLM(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
         q, k = _rope(q, positions), _rope(k, positions)
-        att = dense_attention(q, k, v, causal=True)  # [mb, T, H, hd]
+        # Flash kernel (O(T) memory): without it a pipeline stage would
+        # materialize [T, T] scores per microbatch and PP could not compose
+        # with the long contexts it exists to serve; dense fallback applies
+        # automatically when the kernel's tiling doesn't hold (tiny tests).
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        att = flash_attention(q, k, v, causal=True)  # [mb, T, H, hd]
         out = att.reshape(mb, t, d) @ p["attn_out"].astype(cd)
         x = x + out
 

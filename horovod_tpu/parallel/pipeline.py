@@ -61,6 +61,15 @@ def spmd_pipeline(
       ``[n_micro, mb, ...]`` outputs of the LAST stage, identical on every
       pipe device (masked psum broadcast).
     """
+    out, _ = _run_schedule(stage_fn, x_micro, axis_name, record_inputs=False)
+    return out
+
+
+def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool):
+    """The GPipe tick loop shared by `spmd_pipeline` (mechanical-AD backward)
+    and `spmd_pipeline_1f1b`'s forward (which additionally records each
+    microbatch's stage input — its activation stash). Returns
+    ``(last-stage outputs broadcast over pipe, saved-inputs-or-None)``."""
     s = lax.axis_index(axis_name)
     n_stages = lax.psum(1, axis_name)
     n_micro = x_micro.shape[0]
@@ -68,10 +77,11 @@ def spmd_pipeline(
 
     state = jnp.zeros(x_micro.shape[1:], x_micro.dtype)  # incoming activation
     out_buf = jnp.zeros_like(x_micro)
+    saved = jnp.zeros_like(x_micro) if record_inputs else None
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def tick(carry, t):
-        state, out_buf = carry
+        state, out_buf, saved = carry
         # Stage 0 feeds itself from the microbatch queue; later stages from
         # the activation handed over the ring. Clipped reads/writes keep
         # shapes static; bubble results are masked, never stored.
@@ -79,7 +89,15 @@ def spmd_pipeline(
             x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
         )
         inp = jnp.where(s == 0, x_t, state)
-        out = stage_fn(inp)
+        if saved is not None:
+            m = t - s  # the microbatch this stage processes at tick t
+            mc = jnp.clip(m, 0, n_micro - 1)
+            valid = (m >= 0) & (m < n_micro)
+            cur_saved = lax.dynamic_index_in_dim(saved, mc, 0, keepdims=False)
+            saved = lax.dynamic_update_index_in_dim(
+                saved, jnp.where(valid, inp, cur_saved), mc, 0
+            )
+        out = apply(inp)
 
         widx = t - (n_stages - 1)  # microbatch finishing at the last stage
         cidx = jnp.clip(widx, 0, n_micro - 1)
@@ -88,13 +106,123 @@ def spmd_pipeline(
             out_buf, jnp.where(widx >= 0, out, cur), cidx, 0
         )
         state = lax.ppermute(out, axis_name, perm)
-        return (state, out_buf), None
+        return (state, out_buf, saved), None
 
-    (_, out_buf), _ = lax.scan(tick, (state, out_buf), jnp.arange(ticks))
+    (_, out_buf, saved), _ = lax.scan(
+        tick, (state, out_buf, saved), jnp.arange(ticks)
+    )
 
     # Only the last stage holds real outputs; broadcast them to every pipe
     # device so downstream (loss head) runs replicated over `pipe`.
-    return lax.psum(jnp.where(s == n_stages - 1, out_buf, 0.0), axis_name)
+    out = lax.psum(jnp.where(s == n_stages - 1, out_buf, 0.0), axis_name)
+    return out, saved
+
+
+def spmd_pipeline_1f1b(
+    stage_fn: Callable,
+    stage_params,
+    x_micro,
+    *,
+    axis_name: str = PIPE_AXIS,
+):
+    """GPipe-tick forward + hand-scheduled staggered backward (the 1F1B
+    memory discipline) as a `jax.custom_vjp`.
+
+    `spmd_pipeline` derives its backward mechanically from AD of the forward
+    scan — correct, but the scan's saved state makes the backward hold
+    every tick's stage internals. This variant instead saves ONLY each
+    microbatch's stage INPUT ([n_micro, mb, ...] per device — the 1F1B
+    activation stash) and runs a reverse pipeline scan that recomputes each
+    stage's VJP on the fly (per-microbatch rematerialization): at backward
+    tick τ, stage s processes the cotangent of microbatch ``τ-(S-1-s)`` —
+    the last stage drains first, exactly 1F1B's staggered order — and hands
+    ``d(input)`` to stage s-1 over the reversed ring. True fwd/bwd tick
+    interleaving is impossible under jit-level AD (the output cotangent
+    exists only after the whole forward), but the memory high-water mark —
+    what 1F1B exists for — matches: stage inputs + one in-flight VJP.
+
+    Unlike `spmd_pipeline`, parameters are EXPLICIT (``stage_fn(params,
+    act)``) — a closure's captures are constants to custom_vjp, so the
+    closed-over form would silently drop parameter gradients.
+
+    Cotangent conventions (why no psum appears in the backward): the
+    enclosing `shard_map`'s transpose already reduces per-device
+    contributions per in_spec — returning this device's raw ``d(params)``
+    (its stage slice / its data shard) and a ``d(x_micro)`` that is nonzero
+    only on stage 0 composes with that reduction; any manual psum here
+    would double-count.
+    """
+    s_axis = axis_name
+
+    @jax.custom_vjp
+    def pipe(params, xm):
+        out, _ = _fwd_impl(params, xm)
+        return out
+
+    def _fwd_impl(params, xm):
+        return _run_schedule(
+            lambda a: stage_fn(params, a), xm, s_axis, record_inputs=True
+        )
+
+    def fwd(params, xm):
+        out, saved = _fwd_impl(params, xm)
+        return out, (params, saved)
+
+    def bwd(res, g):
+        params, saved = res
+        s = lax.axis_index(s_axis)
+        n_stages = lax.psum(1, s_axis)
+        # The forward tail is `psum(masked)`; its VJP is a psum of the
+        # incoming cotangent over pipe (every device's output depended on
+        # the last stage's buffer). The mechanical-AD GPipe path gets this
+        # from the psum's own transpose rule; a hand-written backward must
+        # reproduce it or every gradient is 1/n_stages too small.
+        g = lax.psum(g, s_axis)
+        n_micro = saved.shape[0]
+        ticks = n_micro + n_stages - 1
+        # Reverse ring: stage s+1 hands d(input) back to stage s.
+        perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        cot0 = jnp.zeros(saved.shape[1:], jnp.float32)
+        dparams0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        dx0 = jnp.zeros(saved.shape, jnp.float32)
+
+        def tick(carry, tau):
+            cot_in, dparams, dx_buf = carry
+            m = tau - (n_stages - 1 - s)  # staggered: last stage drains first
+            mc = jnp.clip(m, 0, n_micro - 1)
+            valid = (m >= 0) & (m < n_micro)
+            x_in = lax.dynamic_index_in_dim(saved, mc, 0, keepdims=False)
+            g_m = lax.dynamic_index_in_dim(g, mc, 0, keepdims=False)
+            cot = jnp.where(s == n_stages - 1, g_m.astype(jnp.float32), cot_in)
+            _, vjp_fn = jax.vjp(stage_fn, params, x_in)
+            dp, dx = vjp_fn(cot.astype(x_in.dtype))
+            dparams = jax.tree.map(
+                lambda acc, d: acc + jnp.where(valid, d.astype(jnp.float32), 0.0),
+                dparams, dp,
+            )
+            cur = lax.dynamic_index_in_dim(dx_buf, mc, 0, keepdims=False)
+            dx_buf = lax.dynamic_update_index_in_dim(
+                dx_buf, jnp.where(valid, dx.astype(jnp.float32), cur), mc, 0
+            )
+            cot_out = lax.ppermute(dx.astype(jnp.float32), s_axis, perm_bwd)
+            return (cot_out, dparams, dx_buf), None
+
+        (_, dparams, dx_buf), _ = lax.scan(
+            tick, (cot0, dparams0, dx0), jnp.arange(ticks)
+        )
+        # x_micro is consumed by stage 0 only; other stages contribute zero
+        # (the shard_map transpose psums these per-device values over pipe).
+        dx = jnp.where(s == 0, dx_buf, 0.0).astype(saved.dtype)
+        dparams = jax.tree.map(
+            lambda p, d: d.astype(p.dtype), params, dparams
+        )
+        return dparams, dx
+
+    pipe.defvjp(fwd, bwd)
+    return pipe(stage_params, x_micro)
 
 
 def stage_slice_size(n_layers: int, n_stages: int) -> int:

@@ -185,3 +185,160 @@ class TestTraining:
         )
         with pytest.raises(ValueError, match="model"):
             piped.init(jax.random.PRNGKey(0), jnp.zeros((8, 16), jnp.int32))
+
+
+class Test1F1B:
+    """The hand-scheduled staggered backward (spmd_pipeline_1f1b) must be
+    math-identical to the AD-derived GPipe backward — the schedule changes
+    activation memory, never gradients."""
+
+    def _lm(self, schedule, mesh):
+        return PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
+            n_micro=4, mesh=mesh, schedule=schedule,
+        )
+
+    def test_forward_matches_gpipe(self):
+        mesh = _mesh()
+        rng = np.random.RandomState(11)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(8, 16)).astype(np.int32))
+        plain = self._lm("gpipe", None)
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+        out_g = jax.jit(
+            lambda p, t: self._lm("gpipe", mesh).apply({"params": p}, t)
+        )(params, toks)
+        out_1 = jax.jit(
+            lambda p, t: self._lm("1f1b", mesh).apply({"params": p}, t)
+        )(params, toks)
+        np.testing.assert_allclose(
+            np.asarray(out_g), np.asarray(out_1), rtol=1e-5, atol=1e-5
+        )
+
+    def test_gradients_match_gpipe_and_sequential(self):
+        mesh = _mesh()
+        rng = np.random.RandomState(12)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(8, 16)).astype(np.int32))
+        labels = jnp.asarray(rng.randint(1, VOCAB, size=(8, 16)).astype(np.int32))
+        plain = self._lm("gpipe", None)
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+
+        def loss_of(model):
+            def f(p):
+                logits = model.apply({"params": p}, toks)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+
+            return f
+
+        g_seq = jax.grad(loss_of(plain))(params)
+        g_1f1b = jax.jit(jax.grad(loss_of(self._lm("1f1b", mesh))))(params)
+        g_gpipe = jax.jit(jax.grad(loss_of(self._lm("gpipe", mesh))))(params)
+        for key in g_seq:
+            np.testing.assert_allclose(
+                np.asarray(g_1f1b[key]), np.asarray(g_gpipe[key]),
+                rtol=2e-4, atol=2e-6, err_msg=f"1f1b vs gpipe: {key}",
+            )
+            np.testing.assert_allclose(
+                np.asarray(g_1f1b[key]), np.asarray(g_seq[key]),
+                rtol=2e-3, atol=2e-5, err_msg=f"1f1b vs sequential: {key}",
+            )
+
+    def test_trains(self):
+        mesh = _mesh()
+        tr = hvt.Trainer(
+            self._lm("1f1b", mesh),
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=pipelined_lm.param_specs,
+        )
+        x, y = datasets.copy_task(128, 16, vocab_size=VOCAB)
+        hist = tr.fit(x=x, y=y, batch_size=8, epochs=2, steps_per_epoch=4)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_invalid_schedule_rejected(self):
+        mesh = _mesh()
+        rng = np.random.RandomState(13)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(8, 16)).astype(np.int32))
+        model = PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
+            n_micro=4, mesh=mesh, schedule="pipedream",
+        )
+        with pytest.raises(ValueError, match="schedule"):
+            model.init(jax.random.PRNGKey(0), toks)
+
+
+class TestBubbleAccounting:
+    """The GPipe bubble is measurable, not just documented: every device
+    computes ticks = n_micro + S - 1 stage passes but only n_micro are
+    useful, so the pipelined forward's total FLOPs must exceed the
+    sequential stack's by ≈ ticks/n_micro (the bubble fraction
+    (S-1)/(T+S-1) in efficiency terms)."""
+
+    @pytest.mark.parametrize("n_micro", [4, 8])
+    def test_flop_ratio_matches_tick_count(self, n_micro):
+        from horovod_tpu import trace
+
+        mesh = _mesh(data=2, pipe=4)
+        n_stages = 4
+        rng = np.random.RandomState(14)
+        b = 2 * n_micro  # mb covers the data axis (dp=2)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(b, 16)).astype(np.int32))
+        piped = PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
+            n_micro=n_micro, mesh=mesh,
+        )
+        plain = PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
+            n_micro=n_micro, mesh=None,
+        )
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+        f_piped = jax.jit(lambda p, t: piped.apply({"params": p}, t))
+        f_plain = jax.jit(lambda p, t: plain.apply({"params": p}, t))
+        fl_piped = trace.compiled_flops(f_piped, params, toks)
+        fl_plain = trace.compiled_flops(f_plain, params, toks)
+        if not fl_piped or not fl_plain:
+            pytest.skip("backend reports no cost analysis")
+        ticks = n_micro + n_stages - 1
+        # XLA's cost model reports PER-DEVICE flops: the pipelined program
+        # spreads the useful work over all 8 devices (pipe 4 x data 2) but
+        # every device computes `ticks` stage passes where n_micro would be
+        # useful — so per-device flops = ticks/(n_micro * 8) of the plain
+        # single-device stack (embed/head/LN add slack; generous band).
+        expected = ticks / (n_micro * mesh.size)
+        measured = fl_piped / fl_plain
+        assert measured == pytest.approx(expected, rel=0.35), (
+            f"FLOP ratio {measured:.2f} vs tick model {expected:.2f}"
+        )
+
+    def test_bubble_shrinks_with_more_micros(self):
+        from horovod_tpu import trace
+
+        mesh = _mesh(data=2, pipe=4)
+        rng = np.random.RandomState(15)
+
+        def flops(n_micro):
+            toks = jnp.asarray(
+                rng.randint(1, VOCAB, size=(2 * n_micro, 16)).astype(np.int32)
+            )
+            m = PipelinedLM(
+                vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
+                n_micro=n_micro, mesh=mesh,
+            )
+            plain = PipelinedLM(
+                vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
+                n_micro=n_micro, mesh=None,
+            )
+            params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+            f = jax.jit(lambda p, t: m.apply({"params": p}, t))
+            g = jax.jit(lambda p, t: plain.apply({"params": p}, t))
+            a, b = trace.compiled_flops(f, params, toks), trace.compiled_flops(
+                g, params, toks
+            )
+            if not a or not b:
+                pytest.skip("backend reports no cost analysis")
+            # per-token overhead ratio
+            return a / b
+
+        assert flops(8) < flops(2)
