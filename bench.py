@@ -22,6 +22,8 @@ Modes (BENCH_MODEL):
   mnist       (default) reference CNN, per-chip batch 128 bf16
   resnet      CIFAR-10 ResNet-20 — heavier gradients (BASELINE.json config 4)
   transformer decoder LM (d512 x 8L, seq 1024, flash attention) — tokens/sec
+  moe         same LM with MoE MLPs every 2nd block (8 experts, top-2) —
+              tokens/sec + router drop-rate observability
   input       host input pipeline A/B: native C++ batch assembly vs Python
 
 HVT_PROFILE=<dir> captures a jax.profiler trace of the measured loop.
@@ -89,7 +91,7 @@ def bench_train(which: str) -> dict:
         loss = "sparse_categorical_crossentropy"
         unit = "images/sec/chip"
         default_steps = 256
-    elif which == "transformer":
+    elif which in ("transformer", "moe"):
         from horovod_tpu.models.transformer import TransformerLM
 
         seq_len = int(os.environ.get("BENCH_SEQ_LEN", 1024))
@@ -102,6 +104,12 @@ def bench_train(which: str) -> dict:
             n_heads=int(os.environ.get("BENCH_HEADS", 8)),
             n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
             compute_dtype=jnp.bfloat16,
+            # moe mode: expert-parallel MLP every 2nd block (models/moe.py);
+            # tokens/sec + MFU + the sown router drop-rate metric.
+            moe_every=2 if which == "moe" else 0,
+            n_experts=int(os.environ.get("BENCH_EXPERTS", 8)),
+            moe_k=int(os.environ.get("BENCH_MOE_K", 2)),
+            capacity_factor=float(os.environ.get("BENCH_CAPACITY", 1.25)),
             dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
             # ~12%/step — HVT_FAST_RNG=1 makes dropout free when wanted)
             # Long-context memory knobs (BASELINE.md context-envelope rows):
@@ -110,7 +118,11 @@ def bench_train(which: str) -> dict:
             if os.environ.get("BENCH_LOGITS", "") == "bf16"
             else jnp.float32,
         )
-        metric = "transformer_lm_train_tokens_per_sec_per_chip"
+        metric = (
+            "moe_lm_train_tokens_per_sec_per_chip"
+            if which == "moe"
+            else "transformer_lm_train_tokens_per_sec_per_chip"
+        )
         n_docs = int(os.environ.get("BENCH_PACK_DOCS", 0))
         if n_docs:
             # Packed-sequence pretraining: each row holds n_docs documents;
@@ -169,7 +181,9 @@ def bench_train(which: str) -> dict:
     state = trainer.build(sample[0])
     state = hvt.broadcast_parameters(state, mesh=trainer.mesh)
     scale = np.float32(1.0)
-    zero_acc = {"loss": np.float32(0), "accuracy": np.float32(0)}
+    # Accumulator keys come from the trainer: models may sow extra metrics
+    # (e.g. the MoE router drop-rate) that travel with loss/accuracy.
+    zero_acc = {k: np.float32(0) for k in trainer.metric_names}
 
     # --- compute time: ONE fused scan over n_steps (see _timed's note on why
     # a Python loop of dispatches cannot be trusted on tunneled runtimes) ---
@@ -181,7 +195,12 @@ def bench_train(which: str) -> dict:
     ).compile()
     # warm (compile already done; first run settles the runtime)
     w_state, _, w_acc = compiled_mega(state, dev_mega, scale, zero_acc)
-    float(jax.device_get(w_acc["loss"]))
+    warm_sums = {k: float(v) for k, v in jax.device_get(w_acc).items()}
+    extra_metrics = {
+        k: round(warm_sums[k] / n_steps, 4)
+        for k in trainer.metric_names
+        if k not in ("loss", "accuracy")
+    }
 
     # The step donates its input state: always pass the PREVIOUS call's
     # returned state, never a saved one (its buffers are consumed).
@@ -240,6 +259,7 @@ def bench_train(which: str) -> dict:
             "input": round(max(0.0, e2e_s - compute_s) * 1e3, 3),
         },
         "n_chips": n_chips,
+        **extra_metrics,
     }
 
 

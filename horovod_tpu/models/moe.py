@@ -119,6 +119,17 @@ class MoEMlp(nn.Module):
         # combine[n, S, E, C]: gate mass of each token at its expert slot;
         # dispatch is its 0/1 skeleton.
         slot_oh = jax.nn.one_hot(slot, capacity) * in_cap[..., None]  # [n,k,S,E,C]
+        # Router drop-rate observability: overflow drops are SAFE (residual
+        # stream, zero contribution) but must never be silent — an EP config
+        # can be dropping a third of its routed tokens and still "train".
+        # Sown into the 'metrics' collection; Trainer averages any sown
+        # metrics into the step/epoch logs (train_step requests the
+        # collection as mutable; elsewhere the sow is a no-op).
+        routed = float(n_groups * self.k * s)
+        self.sow(
+            "metrics", "moe_drop_rate",
+            1.0 - jnp.sum(slot_oh.astype(jnp.float32)) / routed,
+        )
         combine = jnp.einsum(
             "nksec,nsk->nsec", slot_oh, top_probs.astype(jnp.float32)
         )

@@ -84,6 +84,22 @@ def _accuracy(logits, labels):
     return (pred == labels).astype(jnp.float32).mean()
 
 
+def _aggregate_sown_metrics(sown) -> dict:
+    """Collapse a sown 'metrics' collection to ``{name: scalar}``: leaves
+    sharing their final sow name (e.g. every MoE layer's 'moe_drop_rate')
+    are averaged. This is the module→Trainer observability channel — any
+    scalar a module sows into 'metrics' lands in the step metrics, the
+    epoch logs, and every metrics sink, with no Trainer changes."""
+    out: dict = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(sown)[0]:
+        names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
+        if names:
+            out.setdefault(names[-1], []).append(
+                jnp.asarray(leaf, jnp.float32)
+            )
+    return {k: jnp.mean(jnp.stack(v)) for k, v in out.items()}
+
+
 class Trainer:
     """compile+fit+evaluate+predict for a flax module over a device mesh.
 
@@ -145,6 +161,9 @@ class Trainer:
         # (identical to Keras): on_batch_end callbacks fire once per
         # execution, with the last step's metrics.
         self.steps_per_execution = max(1, int(steps_per_execution))
+        # Names of module-sown 'metrics' scalars (discovered at build());
+        # sizes the epoch metric accumulator alongside loss/accuracy.
+        self._metric_names: tuple = ()
         # Gradient wire compression (DistributedOptimizer(compression=...)):
         # honoured by computing gradients in an explicit-collective shard_map
         # whose psum runs on the 16-bit dtype (_compressed_grads). Only the
@@ -195,18 +214,19 @@ class Trainer:
                     logits, updated = self.module.apply(
                         variables, x, train=True,
                         rngs={"dropout": shard_rng},
-                        mutable=self._mutable + ["losses"],
+                        mutable=self._mutable + ["losses", "metrics"],
                     )
                     sown = updated.pop("losses", {})
+                    sm = _aggregate_sown_metrics(updated.pop("metrics", {}))
                     aux = sum(
                         (jnp.sum(v) for v in jax.tree.leaves(sown)),
                         jnp.zeros((), jnp.float32),
                     )
                     new_ms = dict(updated) if updated else ms
                     loss = self.loss_fn(logits, y).mean() + aux
-                    return loss, (_accuracy(logits, y), new_ms)
+                    return loss, (_accuracy(logits, y), new_ms, sm)
 
-                (loss, (acc, new_ms)), grads = jax.value_and_grad(
+                (loss, (acc, new_ms, sm)), grads = jax.value_and_grad(
                     loss_of, has_aux=True
                 )(params)
                 inv_n = 1.0 / jax.lax.psum(1, data_axes)
@@ -217,6 +237,7 @@ class Trainer:
                 )
                 loss = jax.lax.pmean(loss, data_axes)
                 acc = jax.lax.pmean(acc, data_axes)
+                sm = jax.tree.map(lambda v: jax.lax.pmean(v, data_axes), sm)
                 if new_ms is not None:
                     # Cross-shard mean of updated statistics; non-float
                     # leaves (step counters) are shard-invariant already.
@@ -228,14 +249,14 @@ class Trainer:
                         else v,
                         new_ms,
                     )
-                return loss, acc, new_ms, grads
+                return loss, acc, new_ms, sm, grads
 
             P = jax.sharding.PartitionSpec
             return jax.shard_map(
                 local,
                 mesh=self.mesh,
                 in_specs=(P(), P(), P(data_axes), P(data_axes)),
-                out_specs=(P(), P(), P(), P()),
+                out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False,
             )(state.params, state.model_state, x, y)
 
@@ -252,29 +273,33 @@ class Trainer:
                 # and is never carried in model_state (sown per-apply).
                 # Contract: sow batch-MEAN-style values (batch-size
                 # independent) so the compressed_grads path weights them
-                # identically (see its docstring).
+                # identically (see its docstring). 'metrics' is the sown
+                # OBSERVABILITY channel: scalar values land in the step
+                # metrics / epoch logs / sinks (e.g. MoE router drop-rate,
+                # models/moe.py) — see _aggregate_sown_metrics.
                 logits, updated = self.module.apply(
                     variables, x, train=True,
                     rngs={"dropout": step_rng},
-                    mutable=self._mutable + ["losses"],
+                    mutable=self._mutable + ["losses", "metrics"],
                 )
                 sown = updated.pop("losses", {})
+                sm = _aggregate_sown_metrics(updated.pop("metrics", {}))
                 aux = sum(
                     (jnp.sum(v) for v in jax.tree.leaves(sown)),
                     jnp.zeros((), jnp.float32),
                 )
                 new_ms = dict(updated) if updated else state.model_state
                 loss = self.loss_fn(logits, y).mean() + aux
-                return loss, (_accuracy(logits, y), new_ms)
+                return loss, (_accuracy(logits, y), new_ms, sm)
 
             if self._comm_dtype is not None:
-                loss, acc, model_state, grads = compressed_grads(
+                loss, acc, model_state, sown_metrics, grads = compressed_grads(
                     state, x, y, step_rng
                 )
             else:
-                (loss, (acc, model_state)), grads = jax.value_and_grad(
-                    loss_of, has_aux=True
-                )(state.params)
+                (loss, (acc, model_state, sown_metrics)), grads = (
+                    jax.value_and_grad(loss_of, has_aux=True)(state.params)
+                )
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             updates = jax.tree.map(lambda u: u * update_scale, updates)
             params = optax.apply_updates(state.params, updates)
@@ -288,11 +313,21 @@ class Trainer:
                 step=state.step + 1, params=params, opt_state=opt_state,
                 model_state=model_state,
             )
-            metrics = {"loss": loss, "accuracy": acc}
+            if tuple(sorted(sown_metrics)) != self._metric_names:
+                # Trace-time (keys are Python): a train-gated sow would
+                # otherwise surface as an opaque pytree mismatch in the
+                # accumulator add below.
+                raise ValueError(
+                    f"sown 'metrics' names at train time "
+                    f"{sorted(sown_metrics)} differ from those discovered "
+                    f"at build() {list(self._metric_names)} — 'metrics' "
+                    "sows must be unconditional (not gated on train)"
+                )
+            metrics = {"loss": loss, "accuracy": acc, **sown_metrics}
             # Epoch metric sums accumulate inside the compiled step: per-step
             # host fetches (or even per-step host-side adds) each cost a
             # dispatch/transfer round-trip, which dominates wall-clock on a
-            # networked TPU; this way an epoch ends with ONE 2-scalar fetch.
+            # networked TPU; this way an epoch ends with ONE few-scalar fetch.
             new_acc = jax.tree.map(jnp.add, metric_acc, metrics)
             return new_state, metrics, new_acc
 
@@ -429,6 +464,16 @@ class Trainer:
     def dp_size(self) -> int:
         return mesh_lib.dp_size(self.mesh)
 
+    @property
+    def metric_names(self) -> tuple:
+        """All per-step metric keys: loss/accuracy plus any module-sown
+        'metrics' scalars (available after build())."""
+        return ("loss", "accuracy") + self._metric_names
+
+    def zero_metrics(self) -> dict:
+        """A zero accumulator matching the step metrics' structure."""
+        return {n: jnp.zeros((), jnp.float32) for n in self.metric_names}
+
     def build(self, sample_x: np.ndarray) -> TrainState:
         """Initialize parameters (lazy, from the first batch — like Keras
         building on first fit)."""
@@ -449,7 +494,28 @@ class Trainer:
             train=False,
         )
         params = variables["params"]
-        model_state = {k: v for k, v in variables.items() if k != "params"}
+        # Sown per-apply channels never persist in the carried state: values
+        # are produced fresh each step ('losses' → objective, 'metrics' →
+        # observability). Their presence at init DOES reveal the metric
+        # names, which sizes the epoch accumulator — which is why 'metrics'
+        # sows must be UNCONDITIONAL (not train-gated): a name that appears
+        # only at train time couldn't be discovered here, and the step
+        # checks for that drift loudly (see train_step).
+        self._metric_names = tuple(
+            sorted(_aggregate_sown_metrics(variables.get("metrics", {})))
+        )
+        reserved = {"loss", "accuracy"} & set(self._metric_names)
+        if reserved:
+            raise ValueError(
+                f"module sows 'metrics' entries named {sorted(reserved)}, "
+                "which would silently overwrite the Trainer's own "
+                "loss/accuracy in every log and sink — rename the sow"
+            )
+        model_state = {
+            k: v
+            for k, v in variables.items()
+            if k not in ("params", "losses", "metrics")
+        }
         self._mutable = sorted(model_state.keys())
         if self.param_specs is not None:
             specs = (
@@ -663,13 +729,7 @@ class Trainer:
         # sharding ONCE: a fresh uncommitted jnp.zeros each epoch would give
         # the first step of every epoch a different input-sharding signature
         # than the chained steps, ping-ponging between two executables.
-        zero_acc = sharding_lib.replicate(
-            {
-                "loss": jnp.zeros((), jnp.float32),
-                "accuracy": jnp.zeros((), jnp.float32),
-            },
-            self.mesh,
-        )
+        zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
         try:
             # HVT_PROFILE=<dir> captures a jax.profiler trace of the training
             # loop (XLA op + ICI collective timing) — the Horovod-Timeline
@@ -741,13 +801,7 @@ class Trainer:
             cb.set_trainer(self)
         for cb in callbacks:
             cb.on_train_begin()
-        zero_acc = sharding_lib.replicate(
-            {
-                "loss": jnp.zeros((), jnp.float32),
-                "accuracy": jnp.zeros((), jnp.float32),
-            },
-            self.mesh,
-        )
+        zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
         epoch_key = jax.random.PRNGKey(self.seed + 1)
         with trace_lib.maybe_trace(trace_lib.profile_dir()):
             for epoch in range(initial_epoch, epochs):

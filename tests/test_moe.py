@@ -250,3 +250,106 @@ class TestExpertParallel:
         np.testing.assert_allclose(
             np.asarray(out_plain), np.asarray(out_sharded), rtol=1e-4, atol=1e-5
         )
+
+
+class TestDropRateObservability:
+    """Router overflow drops are safe but must be VISIBLE: the layer sows
+    'metrics'/'moe_drop_rate' and the Trainer surfaces it in the step
+    metrics and epoch logs (an EP config silently dropping a third of its
+    tokens was round-2's Weak #6)."""
+
+    def _train(self, capacity_factor, steps=2):
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=32, n_heads=2, n_layers=2,
+            dropout=0.0, moe_every=2, n_experts=4,
+            capacity_factor=capacity_factor,
+        )
+        trainer = hvt.Trainer(model, hvt.DistributedOptimizer(optax.sgd(0.0)))
+        x, y = datasets.copy_task(64, 16, vocab_size=VOCAB, seed=0)
+        hist = trainer.fit(
+            x=x, y=y, batch_size=2, epochs=1, steps_per_epoch=steps,
+            shuffle_buffer=1, verbose=0,
+        )
+        return trainer, hist
+
+    def test_drop_rate_in_epoch_logs(self):
+        trainer, hist = self._train(capacity_factor=1.25)
+        assert "moe_drop_rate" in trainer.metric_names
+        rate = hist[0]["moe_drop_rate"]
+        assert 0.0 <= rate <= 1.0
+
+    def test_tight_capacity_reports_high_drop_rate(self):
+        """capacity_factor well below 1 MUST drop tokens — with k=2 and
+        cf=0.25, at most 1/8 of routed pairs fit, so the reported rate must
+        be large; ample capacity must report (near) zero."""
+        _, starved = self._train(capacity_factor=0.25)
+        _, ample = self._train(capacity_factor=8.0)
+        assert starved[0]["moe_drop_rate"] > 0.5
+        assert ample[0]["moe_drop_rate"] < 0.05
+        assert starved[0]["moe_drop_rate"] > ample[0]["moe_drop_rate"]
+
+    def test_drop_rate_value_matches_direct_count(self):
+        """The sown scalar equals a direct recount of overflowed (token,
+        choice) pairs from the routing math on the same inputs."""
+        d, e, k, cf = 16, 4, 2, 0.5
+        layer = MoEMlp(d, n_experts=e, k=k, capacity_factor=cf)
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 16, d), jnp.float32)
+        variables = _init(layer, x)
+        # Init itself sows 'metrics'; apply with the bare params so the
+        # collection holds exactly this apply's sow.
+        _, state = layer.apply(
+            {"params": variables["params"]}, x, mutable=["metrics"]
+        )
+        sown = jax.tree.leaves(state["metrics"])
+        assert len(sown) == 1
+        reported = float(sown[0])
+
+        # Direct recount, mirroring the routing definition.
+        s = x.shape[0] * x.shape[1]  # one group at this size
+        probs = jax.nn.softmax(
+            x.reshape(1, s, d).astype(jnp.float32)
+            @ variables["params"]["router"]["kernel"],
+            axis=-1,
+        )
+        _, top_idx = jax.lax.top_k(probs, k)
+        capacity = max(1, int(k * s / e * cf))
+        choice = jnp.moveaxis(jax.nn.one_hot(top_idx, e), -2, 1)
+        flat = choice.reshape(1, k * s, e)
+        pos = jnp.cumsum(flat, axis=1) * flat - 1.0
+        kept = ((pos >= 0) & (pos < capacity)).sum()
+        expected = 1.0 - float(kept) / (k * s)
+        assert reported == pytest.approx(expected, abs=1e-6)
+
+    def test_train_gated_metric_sow_is_loud(self):
+        """'metrics' sows must be unconditional: a train-gated sow cannot be
+        discovered at build() and must fail with the explanatory error, not
+        an opaque pytree mismatch."""
+        import flax.linen as fnn
+
+        class Gated(fnn.Module):
+            @fnn.compact
+            def __call__(self, x, *, train=False):
+                y = fnn.Dense(4)(x.reshape((x.shape[0], -1)))
+                if train:
+                    self.sow("metrics", "gated", jnp.mean(y))
+                return y
+
+        tr = hvt.Trainer(Gated(), hvt.DistributedOptimizer(optax.sgd(0.1)))
+        x = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+        y = np.zeros(16, np.int64)
+        with pytest.raises(ValueError, match="unconditional"):
+            tr.fit(x=x, y=y, batch_size=2, epochs=1, steps_per_epoch=1)
+
+    def test_reserved_metric_name_is_loud(self):
+        import flax.linen as fnn
+
+        class BadName(fnn.Module):
+            @fnn.compact
+            def __call__(self, x, *, train=False):
+                y = fnn.Dense(4)(x.reshape((x.shape[0], -1)))
+                self.sow("metrics", "loss", jnp.mean(y))
+                return y
+
+        tr = hvt.Trainer(BadName(), hvt.DistributedOptimizer(optax.sgd(0.1)))
+        with pytest.raises(ValueError, match="rename the sow"):
+            tr.build(np.zeros((8, 4), np.float32))
