@@ -115,11 +115,15 @@ def main() -> None:
         checkpoint.save(final_path, trainer.state)
         restored = checkpoint.restore(final_path, trainer.state)
         # Serving export: timestamped dir, input → prob signature (:126-140).
+        # HVT_EXPORT_FORMAT=savedmodel emits a TF SavedModel (the
+        # reference's exact artifact) via jax2tf; default is the TF-free
+        # StableHLO bundle.
         bundle = checkpoint.export_serving(
             export_dir,
             lambda params, x: trainer.module.apply({"params": params}, x, train=False),
             restored.params,
             input_shape=(1, 28, 28, 1),
+            format=os.environ.get("HVT_EXPORT_FORMAT", "stablehlo"),
         )
         print("Exported serving bundle:", bundle)
 

@@ -529,24 +529,44 @@ def export_serving(
     input_shape: tuple,
     input_dtype=np.float32,
     timestamp: str | None = None,
+    format: str = "stablehlo",
 ) -> str:
     """Export a serving bundle into ``export_dir/<YYYYmmdd-HHMMSS>/``.
 
     ``apply_fn(params, x)`` must return logits; the exported program is the
-    jitted ``x → softmax(logits)`` closure over the weights, serialized as
-    portable StableHLO via `jax.export` — the TPU-native stand-in for the TF1
-    SavedModel with signature ``{'input' → 'prob'}`` (mnist_keras.py:126-140).
-    Primary-process-only by convention (caller script gates, like the
-    reference's ``if hvd.rank() == 0``)."""
-    from jax import export as jax_export
+    jitted ``x → softmax(logits)`` closure over the weights, with the
+    reference's serving signature ``{'input' → 'prob'}``
+    (mnist_keras.py:126-140). Primary-process-only by convention (caller
+    script gates, like the reference's ``if hvd.rank() == 0``).
 
+    Formats:
+      * ``'stablehlo'`` (default) — portable StableHLO via `jax.export`
+        plus msgpack weights and a JSON signature; reloadable by
+        `load_serving` with no TF anywhere.
+      * ``'savedmodel'`` — a TF SavedModel via ``jax2tf`` with a
+        ``serving_default`` signature (``input`` → ``prob``, dynamic batch
+        dim), loadable by any standard TF Serving stack — byte-for-role
+        parity with the reference's SavedModelBuilder export. Requires
+        TensorFlow importable.
+    """
     stamp = timestamp or time.strftime("%Y%m%d-%H%M%S")
     out_dir = os.path.join(export_dir, stamp)
-    os.makedirs(out_dir, exist_ok=True)
 
     def predict(x):
         return jax.nn.softmax(apply_fn(params, x), axis=-1)
 
+    if format == "savedmodel":
+        return _export_savedmodel(
+            out_dir, predict, input_shape, input_dtype
+        )
+    if format != "stablehlo":
+        raise ValueError(
+            f"unknown export format {format!r}; expected 'stablehlo' or "
+            "'savedmodel'"
+        )
+    from jax import export as jax_export
+
+    os.makedirs(out_dir, exist_ok=True)
     spec = jax.ShapeDtypeStruct(input_shape, input_dtype)
     exported = jax_export.export(jax.jit(predict))(spec)
     with open(os.path.join(out_dir, GRAPH_FILE), "wb") as f:
@@ -568,10 +588,57 @@ def export_serving(
     return out_dir
 
 
+def _export_savedmodel(out_dir, predict, input_shape, input_dtype) -> str:
+    """TF SavedModel export (the reference's interop contract,
+    mnist_keras.py:126-140): jax2tf-convert the predict closure, wrap the
+    output under the ``prob`` key, and save with a ``serving_default``
+    signature whose input tensor is named ``input``. The batch dim is
+    polymorphic so a serving stack can batch freely."""
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    converted = jax2tf.convert(
+        predict,
+        polymorphic_shapes=["(b, ...)"],
+        with_gradient=False,
+        # Embed lowerings for BOTH platforms: without this, an export made
+        # from a TPU-backed trainer pins the StableHLO module to TPU and a
+        # CPU TF-Serving stack refuses it with "platform CPU is not among
+        # the platforms required" (caught driving the real-chip example).
+        native_serialization_platforms=("cpu", "cuda", "tpu"),
+    )
+    tf_fn = tf.function(
+        lambda x: {"prob": converted(x)},
+        input_signature=[
+            tf.TensorSpec(
+                (None,) + tuple(input_shape[1:]),
+                tf.dtypes.as_dtype(np.dtype(input_dtype)),
+                name="input",
+            )
+        ],
+        autograph=False,
+    )
+    module = tf.Module()
+    module.predict = tf_fn
+    tf.saved_model.save(
+        module,
+        out_dir,
+        signatures={"serving_default": tf_fn.get_concrete_function()},
+    )
+    return out_dir
+
+
 def load_serving(bundle_dir: str):
-    """Reload an exported bundle; returns ``fn(input) -> prob``."""
+    """Reload an exported STABLEHLO bundle; returns ``fn(input) -> prob``.
+    (SavedModel bundles are TF's to load: ``tf.saved_model.load``.)"""
     from jax import export as jax_export
 
+    if os.path.exists(os.path.join(bundle_dir, "saved_model.pb")):
+        raise ValueError(
+            f"{bundle_dir} is a TF SavedModel export "
+            "(format='savedmodel'); load it with tf.saved_model.load, "
+            "not checkpoint.load_serving"
+        )
     with open(os.path.join(bundle_dir, GRAPH_FILE), "rb") as f:
         exported = jax_export.deserialize(f.read())
     return lambda x: exported.call(x)
