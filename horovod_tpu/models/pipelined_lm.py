@@ -15,8 +15,11 @@ region; plain `self.param` stacks are transparent to shard_map, to the
 optimizer, and to checkpointing.
 
 Composes with data parallelism (batch axes sharded by GSPMD outside the
-manual pipe region). TP/SP inside a stage is out of scope for this model —
-use `TransformerLM` when you want model/seq axes instead of pipe.
+manual pipe region) and, since round 3, with Megatron tensor parallelism
+INSIDE each stage: qkv/mlp_up column-parallel, attn_out/mlp_down
+row-parallel over the ``model`` axis, one psum per residual join
+(dp x pp x tp on one mesh). SP/EP inside a stage remain out of scope —
+use `TransformerLM` for seq/expert axes instead of pipe.
 """
 
 from __future__ import annotations
@@ -28,7 +31,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.models.transformer import _rope
-from horovod_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, PIPE_AXIS
+from horovod_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+)
 from horovod_tpu.parallel.pipeline import (
     spmd_pipeline,
     spmd_pipeline_1f1b,
@@ -107,12 +115,18 @@ class PipelinedLM(nn.Module):
 
             x, _ = lax.scan(body, x, blocks)
         else:
-            for ax in ("seq", "model", "expert"):
+            for ax in ("seq", "expert"):
                 if self.mesh.shape.get(ax, 1) != 1:
                     raise ValueError(
-                        f"PipelinedLM composes with data/pipe axes only; "
-                        f"mesh has {ax}={self.mesh.shape[ax]}"
+                        f"PipelinedLM composes with data/pipe/model axes "
+                        f"only; mesh has {ax}={self.mesh.shape[ax]}"
                     )
+            tp = self.mesh.shape.get(MODEL_AXIS, 1)
+            if tp > 1 and (h % tp or (4 * d) % tp):
+                raise ValueError(
+                    f"n_heads ({h}) and 4*d_model ({4 * d}) must divide "
+                    f"over the model axis ({tp}) for in-stage TP"
+                )
             n_stages = self.mesh.shape[PIPE_AXIS]
             stage_slice_size(L, n_stages)  # validates divisibility
             # Tiny batches (e.g. the Trainer's dp-sized init probe) can't
@@ -130,14 +144,19 @@ class PipelinedLM(nn.Module):
             x_micro = x.reshape(n_micro, mb, t, d)
 
             act_spec = P(None, BATCH_AXES, None, None)
-            param_specs = jax.tree.map(
-                lambda l: P(PIPE_AXIS, *([None] * (l.ndim - 1))), blocks
-            )
+            # Stage stacks over `pipe` on dim 0 + Megatron column/row TP
+            # over `model` inside each stage (_TP_DIM; activations stay
+            # replicated across model, each rank computing its head/feature
+            # slice with one psum per residual join in _block).
+            param_specs = {
+                k: P(PIPE_AXIS, *spec)
+                for k, spec in _stack_specs(tp > 1).items()
+            }
 
             def run(stage_params, xm):
                 def stage(params, act):
                     def body(a, p):
-                        return self._block(a, p), None
+                        return self._block(a, p, tp=tp), None
 
                     a, _ = lax.scan(body, act, params)
                     return a
@@ -161,15 +180,22 @@ class PipelinedLM(nn.Module):
         logits = x.astype(jnp.float32) @ lm_head.astype(jnp.float32)
         return logits
 
-    def _block(self, x, p):
-        """One pre-LN transformer block over a single layer's params."""
+    def _block(self, x, p, tp: int = 1):
+        """One pre-LN transformer block over a single layer's params.
+
+        ``tp > 1`` = Megatron TP inside the (fully-manual) pipeline region:
+        this model-rank's param slices are column-parallel for qkv/mlp_up
+        (each rank owns ``h/tp`` heads / ``4d/tp`` features) and
+        row-parallel for attn_out/mlp_down, with ONE `psum` over ``model``
+        per residual join restoring the replicated activation."""
         mb, t, d = x.shape
-        h_heads, hd = self.n_heads, d // self.n_heads
+        h_local = self.n_heads // tp
+        hd = d // self.n_heads
         cd = self.compute_dtype
 
         hidden = _layernorm(x, p["ln1"])
-        qkv = hidden @ p["qkv"].astype(cd)  # [mb, T, 3d]
-        qkv = qkv.reshape(mb, t, h_heads, 3 * hd)
+        qkv = hidden @ p["qkv"].astype(cd)  # [mb, T, 3d/tp]
+        qkv = qkv.reshape(mb, t, h_local, 3 * hd)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
         q, k = _rope(q, positions), _rope(k, positions)
@@ -179,24 +205,51 @@ class PipelinedLM(nn.Module):
         # automatically when the kernel's tiling doesn't hold (tiny tests).
         from horovod_tpu.ops.flash_attention import flash_attention
 
-        att = flash_attention(q, k, v, causal=True)  # [mb, T, H, hd]
-        out = att.reshape(mb, t, d) @ p["attn_out"].astype(cd)
+        att = flash_attention(q, k, v, causal=True)  # [mb, T, H/tp, hd]
+        out = att.reshape(mb, t, h_local * hd) @ p["attn_out"].astype(cd)
+        if tp > 1:
+            out = lax.psum(out, MODEL_AXIS)
         x = x + out
 
         hidden = _layernorm(x, p["ln2"])
         hidden = nn.gelu(hidden @ p["mlp_up"].astype(cd))
-        return x + hidden @ p["mlp_down"].astype(cd)
+        down = hidden @ p["mlp_down"].astype(cd)
+        if tp > 1:
+            down = lax.psum(down, MODEL_AXIS)
+        return x + down
+
+
+# Per-stack TP layout (dims AFTER the leading [n_layers] stack dim):
+# column-parallel kernels shard their OUTPUT dim over `model`, row-parallel
+# their INPUT dim; LayerNorm scales replicate.
+_TP_DIM = {"qkv": 1, "mlp_up": 1, "attn_out": 0, "mlp_down": 0}
+_STACKED = ("ln1", "qkv", "attn_out", "ln2", "mlp_up", "mlp_down")
+
+
+def _stack_specs(tp: bool) -> dict:
+    """{name: trailing-dims spec tuple} for each per-layer stack."""
+    out = {}
+    for name in _STACKED:
+        ndim = 1 if name.startswith("ln") else 2
+        spec = [None] * ndim
+        if tp and name in _TP_DIM:
+            spec[_TP_DIM[name]] = MODEL_AXIS
+        out[name] = tuple(spec)
+    return out
 
 
 def param_specs(params, mesh: Mesh) -> dict:
     """PartitionSpec tree for the pipelined layout: per-layer stacks sharded
-    over ``pipe`` on dim 0, everything else replicated."""
-    stacked = {"ln1", "qkv", "attn_out", "ln2", "mlp_up", "mlp_down"}
+    over ``pipe`` on dim 0 (+ Megatron column/row over ``model`` when that
+    axis is live), everything else replicated."""
+    tp = mesh.shape.get(MODEL_AXIS, 1) > 1
+    stack_specs = _stack_specs(tp)
 
     def rule(path, leaf):
         names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
-        if any(n in stacked for n in names):
-            return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
+        name = next((n for n in names if n in stack_specs), None)
+        if name is not None:
+            return P(PIPE_AXIS, *stack_specs[name])
         return P()
 
     return jax.tree_util.tree_map_with_path(rule, params)
