@@ -4,3 +4,8 @@ from horovod_tpu.data.datasets import mnist, cifar10  # noqa: F401
 from horovod_tpu.data.loader import ArrayDataset, training_pipeline  # noqa: F401
 from horovod_tpu.data.native_loader import NativeBatchLoader  # noqa: F401
 from horovod_tpu.data.native_loader import available as native_available  # noqa: F401
+from horovod_tpu.data.packing import (  # noqa: F401
+    next_token_pairs,
+    pack_documents,
+    packing_efficiency,
+)

@@ -148,7 +148,7 @@ class PipelinedLM(nn.Module):
             # over `model` inside each stage (_TP_DIM; activations stay
             # replicated across model, each rank computing its head/feature
             # slice with one psum per residual join in _block).
-            param_specs = {
+            stack_param_specs = {
                 k: P(PIPE_AXIS, *spec)
                 for k, spec in _stack_specs(tp > 1).items()
             }
@@ -170,7 +170,7 @@ class PipelinedLM(nn.Module):
             x_micro = jax.shard_map(
                 run,
                 mesh=self.mesh,
-                in_specs=(param_specs, act_spec),
+                in_specs=(stack_param_specs, act_spec),
                 out_specs=act_spec,
                 check_vma=False,
             )(blocks, x_micro)
