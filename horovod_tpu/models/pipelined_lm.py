@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from horovod_tpu.models.transformer import _rope
+from horovod_tpu.models.transformer import _rope, packed_positions
 from horovod_tpu.parallel.mesh import (
     DATA_AXIS,
     FSDP_AXIS,
@@ -74,7 +74,7 @@ class PipelinedLM(nn.Module):
     schedule: str = "gpipe"
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False, segment_ids=None):
         d, h = self.d_model, self.n_heads
         hd = d // h
         L = self.n_layers
@@ -98,6 +98,12 @@ class PipelinedLM(nn.Module):
         b, t = tokens.shape
         cd = self.compute_dtype
         x = embed[tokens].astype(cd)  # [B, T, d]
+        # Packed sequences: per-document RoPE restart + segment-masked
+        # attention inside every stage (the ids are per-microbatch CONSTANTS
+        # — they never ride the stage ring; see spmd_pipeline extras).
+        positions = (
+            packed_positions(segment_ids) if segment_ids is not None else None
+        )
 
         # Validate unconditionally: a typo'd schedule on a pipe-less mesh
         # would otherwise train silently via the sequential path and only
@@ -111,7 +117,9 @@ class PipelinedLM(nn.Module):
             # No pipe axis: run the stack sequentially (the n_stages=1
             # degenerate schedule) — same math, no manual region needed.
             def body(xc, p):
-                return self._block(xc, p), None
+                return self._block(
+                    xc, p, seg=segment_ids, positions=positions
+                ), None
 
             x, _ = lax.scan(body, x, blocks)
         else:
@@ -142,6 +150,12 @@ class PipelinedLM(nn.Module):
                 )
             mb = b // n_micro
             x_micro = x.reshape(n_micro, mb, t, d)
+            extras = None
+            if segment_ids is not None:
+                extras = (
+                    segment_ids.reshape(n_micro, mb, t),
+                    positions.reshape(n_micro, mb, t),
+                )
 
             act_spec = P(None, BATCH_AXES, None, None)
             # Stage stacks over `pipe` on dim 0 + Megatron column/row TP
@@ -153,34 +167,50 @@ class PipelinedLM(nn.Module):
                 for k, spec in _stack_specs(tp > 1).items()
             }
 
-            def run(stage_params, xm):
-                def stage(params, act):
+            def run(stage_params, xm, ex=None):
+                def stage(params, act, extra=None):
+                    seg, pos = extra if extra is not None else (None, None)
+
                     def body(a, p):
-                        return self._block(a, p, tp=tp), None
+                        return self._block(
+                            a, p, tp=tp, seg=seg, positions=pos
+                        ), None
 
                     a, _ = lax.scan(body, act, params)
                     return a
 
                 if self.schedule == "1f1b":
-                    return spmd_pipeline_1f1b(stage, stage_params, xm)
+                    return spmd_pipeline_1f1b(
+                        stage, stage_params, xm, extras=ex
+                    )
+                if ex is None:
+                    return spmd_pipeline(
+                        lambda act: stage(stage_params, act), xm
+                    )
                 return spmd_pipeline(
-                    lambda act: stage(stage_params, act), xm
+                    lambda act, e: stage(stage_params, act, e), xm, extras=ex
                 )
 
+            extra_spec = P(None, BATCH_AXES, None)
+            args = (blocks, x_micro)
+            in_specs = (stack_param_specs, act_spec)
+            if extras is not None:
+                args += (extras,)
+                in_specs += ((extra_spec, extra_spec),)
             x_micro = jax.shard_map(
                 run,
                 mesh=self.mesh,
-                in_specs=(stack_param_specs, act_spec),
+                in_specs=in_specs,
                 out_specs=act_spec,
                 check_vma=False,
-            )(blocks, x_micro)
+            )(*args)
             x = x_micro.reshape(b, t, d)
 
         x = _layernorm(x, ln_f)
         logits = x.astype(jnp.float32) @ lm_head.astype(jnp.float32)
         return logits
 
-    def _block(self, x, p, tp: int = 1):
+    def _block(self, x, p, tp: int = 1, seg=None, positions=None):
         """One pre-LN transformer block over a single layer's params.
 
         ``tp > 1`` = Megatron TP inside the (fully-manual) pipeline region:
@@ -197,7 +227,10 @@ class PipelinedLM(nn.Module):
         qkv = hidden @ p["qkv"].astype(cd)  # [mb, T, 3d/tp]
         qkv = qkv.reshape(mb, t, h_local, 3 * hd)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(t, dtype=jnp.int32), (mb, t)
+            )
         q, k = _rope(q, positions), _rope(k, positions)
         # Flash kernel (O(T) memory): without it a pipeline stage would
         # materialize [T, T] scores per microbatch and PP could not compose
@@ -205,7 +238,10 @@ class PipelinedLM(nn.Module):
         # automatically when the kernel's tiling doesn't hold (tiny tests).
         from horovod_tpu.ops.flash_attention import flash_attention
 
-        att = flash_attention(q, k, v, causal=True)  # [mb, T, H/tp, hd]
+        att = flash_attention(
+            q, k, v, causal=True,
+            q_segment_ids=seg, kv_segment_ids=seg,
+        )  # [mb, T, H/tp, hd]
         out = att.reshape(mb, t, h_local * hd) @ p["attn_out"].astype(cd)
         if tp > 1:
             out = lax.psum(out, MODEL_AXIS)

@@ -45,6 +45,7 @@ def spmd_pipeline(
     x_micro,
     *,
     axis_name: str = PIPE_AXIS,
+    extras=None,
 ):
     """Run ``stage_fn`` as a GPipe pipeline over the ``axis_name`` mesh axis.
 
@@ -54,18 +55,35 @@ def spmd_pipeline(
 
     Args:
       stage_fn: ``activation [mb, ...] -> activation [mb, ...]`` — this
-        stage's chunk of the network, same signature on every stage.
+        stage's chunk of the network, same signature on every stage. With
+        ``extras`` given, called as ``stage_fn(activation, extra)``.
       x_micro: ``[n_micro, mb, ...]`` microbatched stage-0 input.
+      extras: optional pytree of ``[n_micro, ...]`` per-microbatch
+        CONSTANTS (segment ids, positions, loss masks). Unlike activations
+        they are not transformed between stages, so they never ride the
+        ppermute ring — every stage indexes the microbatch it is currently
+        processing directly (replicated over pipe). Gradients do not flow
+        into extras.
 
     Returns:
       ``[n_micro, mb, ...]`` outputs of the LAST stage, identical on every
       pipe device (masked psum broadcast).
     """
-    out, _ = _run_schedule(stage_fn, x_micro, axis_name, record_inputs=False)
+    out, _ = _run_schedule(
+        stage_fn, x_micro, axis_name, record_inputs=False, extras=extras
+    )
     return out
 
 
-def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool):
+def _micro_extra(extras, mc):
+    """This tick's slice of the per-microbatch constants."""
+    return jax.tree.map(
+        lambda e: lax.dynamic_index_in_dim(e, mc, 0, keepdims=False), extras
+    )
+
+
+def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool,
+                  extras=None):
     """The GPipe tick loop shared by `spmd_pipeline` (mechanical-AD backward)
     and `spmd_pipeline_1f1b`'s forward (which additionally records each
     microbatch's stage input — its activation stash). Returns
@@ -89,15 +107,18 @@ def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool):
             x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
         )
         inp = jnp.where(s == 0, x_t, state)
+        m = t - s  # the microbatch this stage processes at tick t
+        mc = jnp.clip(m, 0, n_micro - 1)
         if saved is not None:
-            m = t - s  # the microbatch this stage processes at tick t
-            mc = jnp.clip(m, 0, n_micro - 1)
             valid = (m >= 0) & (m < n_micro)
             cur_saved = lax.dynamic_index_in_dim(saved, mc, 0, keepdims=False)
             saved = lax.dynamic_update_index_in_dim(
                 saved, jnp.where(valid, inp, cur_saved), mc, 0
             )
-        out = apply(inp)
+        if extras is None:
+            out = apply(inp)
+        else:
+            out = apply(inp, _micro_extra(extras, mc))
 
         widx = t - (n_stages - 1)  # microbatch finishing at the last stage
         cidx = jnp.clip(widx, 0, n_micro - 1)
@@ -124,6 +145,7 @@ def spmd_pipeline_1f1b(
     x_micro,
     *,
     axis_name: str = PIPE_AXIS,
+    extras=None,
 ):
     """GPipe-tick forward + hand-scheduled staggered backward (the 1F1B
     memory discipline) as a `jax.custom_vjp`.
@@ -142,8 +164,10 @@ def spmd_pipeline_1f1b(
     what 1F1B exists for — matches: stage inputs + one in-flight VJP.
 
     Unlike `spmd_pipeline`, parameters are EXPLICIT (``stage_fn(params,
-    act)``) — a closure's captures are constants to custom_vjp, so the
-    closed-over form would silently drop parameter gradients.
+    act)`` — or ``stage_fn(params, act, extra)`` with per-microbatch
+    ``extras``, which take no gradient) — a closure's captures are
+    constants to custom_vjp, so the closed-over form would silently drop
+    parameter gradients.
 
     Cotangent conventions (why no psum appears in the backward): the
     enclosing `shard_map`'s transpose already reduces per-device
@@ -155,21 +179,31 @@ def spmd_pipeline_1f1b(
     s_axis = axis_name
 
     @jax.custom_vjp
-    def pipe(params, xm):
-        out, _ = _fwd_impl(params, xm)
+    def pipe(params, xm, ex):
+        out, _ = _fwd_impl(params, xm, ex)
         return out
 
-    def _fwd_impl(params, xm):
+    def _stage(params, a, extra):
+        if extras is None:
+            return stage_fn(params, a)
+        return stage_fn(params, a, extra)
+
+    def _fwd_impl(params, xm, ex):
+        if ex is None:
+            return _run_schedule(
+                lambda a: stage_fn(params, a), xm, s_axis, record_inputs=True
+            )
         return _run_schedule(
-            lambda a: stage_fn(params, a), xm, s_axis, record_inputs=True
+            lambda a, e: stage_fn(params, a, e), xm, s_axis,
+            record_inputs=True, extras=ex,
         )
 
-    def fwd(params, xm):
-        out, saved = _fwd_impl(params, xm)
-        return out, (params, saved)
+    def fwd(params, xm, ex):
+        out, saved = _fwd_impl(params, xm, ex)
+        return out, (params, saved, ex)
 
     def bwd(res, g):
-        params, saved = res
+        params, saved, ex = res
         s = lax.axis_index(s_axis)
         n_stages = lax.psum(1, s_axis)
         # The forward tail is `psum(masked)`; its VJP is a psum of the
@@ -197,7 +231,10 @@ def spmd_pipeline_1f1b(
             x_in = lax.dynamic_index_in_dim(saved, mc, 0, keepdims=False)
             g_m = lax.dynamic_index_in_dim(g, mc, 0, keepdims=False)
             cot = jnp.where(s == n_stages - 1, g_m.astype(jnp.float32), cot_in)
-            _, vjp_fn = jax.vjp(stage_fn, params, x_in)
+            extra = None if ex is None else _micro_extra(ex, mc)
+            _, vjp_fn = jax.vjp(
+                lambda p, a: _stage(p, a, extra), params, x_in
+            )
             dp, dx = vjp_fn(cot.astype(x_in.dtype))
             dparams = jax.tree.map(
                 lambda acc, d: acc + jnp.where(valid, d.astype(jnp.float32), 0.0),
@@ -219,10 +256,11 @@ def spmd_pipeline_1f1b(
         dparams = jax.tree.map(
             lambda p, d: d.astype(p.dtype), params, dparams
         )
-        return dparams, dx
+        # extras are integer/constant side inputs: no cotangent.
+        return dparams, dx, None
 
     pipe.defvjp(fwd, bwd)
-    return pipe(stage_params, x_micro)
+    return pipe(stage_params, x_micro, extras)
 
 
 def stage_slice_size(n_layers: int, n_stages: int) -> int:
