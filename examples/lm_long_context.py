@@ -29,6 +29,7 @@ Pipeline parallelism: a ``pipe`` axis switches to the pipelined model
 (GPipe microbatch schedule, models/pipelined_lm.py):
 
     HVT_MESH="data=2,pipe=4" N_MICRO=8 python examples/lm_long_context.py
+    HVT_MESH="data=2,pipe=2,model=2" SCHEDULE=1f1b python examples/lm_long_context.py
 """
 
 import os
@@ -75,9 +76,10 @@ def main() -> None:
 
     if mesh.shape.get(mesh_lib.PIPE_AXIS, 1) > 1:
         # pipe > 1 switches to the pipeline-parallel model: per-layer
-        # parameter stacks sharded over `pipe`, GPipe microbatch schedule
-        # (models/pipelined_lm.py). Composes with `data`; use TransformerLM
-        # for seq/model/expert axes instead.
+        # parameter stacks sharded over `pipe`, GPipe (or SCHEDULE=1f1b
+        # staggered-backward) microbatch schedule, Megatron TP inside each
+        # stage when `model` > 1 (models/pipelined_lm.py). Composes with
+        # `data`/`model`; use TransformerLM for seq/expert axes instead.
         from horovod_tpu.models import pipelined_lm
 
         model = pipelined_lm.PipelinedLM(
@@ -87,6 +89,7 @@ def main() -> None:
             n_layers=int(os.environ.get("NLAYERS", 4)),
             n_micro=int(os.environ.get("N_MICRO", 4)),
             mesh=mesh,
+            schedule=os.environ.get("SCHEDULE", "gpipe"),
         )
         trainer = hvt.Trainer(
             model,

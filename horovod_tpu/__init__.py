@@ -53,8 +53,10 @@ from horovod_tpu.parallel.collectives import (
     broadcast,
     pmean_pytree,
     broadcast_pytree,
+    broadcast_object,
+    allgather_object,
 )
-from horovod_tpu.training.optimizer import DistributedOptimizer
+from horovod_tpu.training.optimizer import Compression, DistributedOptimizer
 from horovod_tpu.training import callbacks
 from horovod_tpu.training.trainer import Trainer, TrainState
 from horovod_tpu import checkpoint
@@ -84,6 +86,9 @@ __all__ = [
     "broadcast",
     "pmean_pytree",
     "broadcast_pytree",
+    "broadcast_object",
+    "allgather_object",
+    "Compression",
     "DistributedOptimizer",
     "callbacks",
     "Trainer",

@@ -123,6 +123,56 @@ def broadcast_pytree(tree: PyTree, root: int = 0, axis_name=None) -> PyTree:
     return jax.tree.map(lambda x: broadcast(x, root=root, axis_name=axis_name), tree)
 
 
+def broadcast_object(obj, root: int = 0):
+    """``hvd.broadcast_object``: every process adopts the root's arbitrary
+    picklable Python object (config dicts, vocabularies, epoch counters —
+    the host-side metadata Horovod moves alongside tensors). Pickle bytes
+    travel over ONE fused host-level broadcast; ``process_count()==1`` is
+    the identity, like every collective here."""
+    import pickle
+
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return obj
+    payload = pickle.dumps(obj) if jax.process_index() == root else b""
+    # Fixed-width header then the padded body: broadcast_one_to_all needs
+    # identical shapes on every process.
+    n = int(
+        multihost_utils.broadcast_one_to_all(
+            np.int64(len(payload)), is_source=jax.process_index() == root
+        )
+    )
+    buf = np.zeros(n, np.uint8)
+    if jax.process_index() == root:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(
+        buf, is_source=jax.process_index() == root
+    )
+    return pickle.loads(np.asarray(buf).tobytes())
+
+
+def allgather_object(obj) -> list:
+    """``hvd.allgather_object``: every process receives the list of all
+    processes' picklable objects, ordered by process index."""
+    import pickle
+
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return [obj]
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = multihost_utils.process_allgather(np.int64(len(payload)))
+    width = int(np.max(sizes))
+    buf = np.zeros(width, np.uint8)
+    buf[: len(payload)] = payload
+    gathered = multihost_utils.process_allgather(buf)
+    return [
+        pickle.loads(gathered[i, : int(sizes[i])].tobytes())
+        for i in range(jax.process_count())
+    ]
+
+
 def metric_mean(metrics: dict, axis_name=None) -> dict:
     """Cross-worker mean of a metrics dict — MetricAverageCallback's op
     (tensorflow2_keras_mnist.py:73-77)."""

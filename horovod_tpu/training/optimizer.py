@@ -39,6 +39,17 @@ _COMPRESSION_DTYPES = {
 }
 
 
+class Compression:
+    """Horovod's ``hvd.Compression`` enum, for drop-in familiarity:
+    ``DistributedOptimizer(opt, compression=hvt.Compression.fp16)``.
+    Values are the string knobs `DistributedOptimizer` accepts (bf16 is the
+    TPU-native 16-bit wire format; fp16 kept for API parity)."""
+
+    none = "none"
+    fp16 = "fp16"
+    bf16 = "bf16"
+
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     axis_name=None,

@@ -341,6 +341,14 @@ class TestDistributedLaunch:
             tree = collectives.broadcast_pytree(
                 {{'a': np.full((3,), hvt.process_rank(), np.float32)}})
             assert float(tree['a'][0]) == 0.0
+            # Object collectives (hvd.broadcast_object / allgather_object):
+            # arbitrary picklable payloads, variable size per process.
+            obj = collectives.broadcast_object(
+                {{'vocab': ['a', 'b'], 'rank': hvt.process_rank()}})
+            assert obj == {{'vocab': ['a', 'b'], 'rank': 0}}, obj
+            objs = collectives.allgather_object(
+                'r' * (hvt.process_rank() + 1))
+            assert objs == ['r', 'rr'], objs
             open({str(tmp_path)!r} + f'/ok-{{hvt.process_rank()}}', 'w').close()
         """))
         code = launcher.run_local(

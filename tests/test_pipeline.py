@@ -485,7 +485,8 @@ class TestPackedPipeline:
             np.asarray(out[:, 16:]), np.asarray(solo_b), rtol=3e-4, atol=3e-4
         )
 
-    def test_packed_gradients_finite_1f1b(self):
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_packed_gradients_match_sequential(self, schedule):
         mesh = _mesh(data=2, pipe=4)
         _, _, packed, seg = self._packed(32)
         labels = jnp.asarray(
@@ -493,7 +494,7 @@ class TestPackedPipeline:
         ).astype(jnp.int32)
         piped = PipelinedLM(
             vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
-            n_micro=2, mesh=mesh, schedule="1f1b",
+            n_micro=2, mesh=mesh, schedule=schedule,
         )
         plain = PipelinedLM(
             vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
