@@ -59,10 +59,7 @@ from horovod_tpu.parallel import mesh as mesh_lib
 
 
 def parse_mesh(spec: str | None) -> mesh_lib.MeshSpec:
-    if not spec:
-        return mesh_lib.MeshSpec()  # pure DP
-    sizes = dict(kv.split("=") for kv in spec.split(","))
-    return mesh_lib.MeshSpec(**{k: int(v) for k, v in sizes.items()})
+    return mesh_lib.MeshSpec.from_string(spec)
 
 
 def main() -> None:

@@ -56,6 +56,21 @@ class MeshSpec:
     model: int = 1
     expert: int = 1
 
+    @classmethod
+    def from_string(cls, spec: str | None) -> "MeshSpec":
+        """Parse the ``HVT_MESH`` grammar: ``"data=2,seq=4"`` (axis=size
+        pairs, missing axes default). None/empty = pure DP."""
+        if not spec:
+            return cls()
+        try:
+            sizes = dict(kv.split("=") for kv in spec.split(","))
+            return cls(**{k: int(v) for k, v in sizes.items()})
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"bad mesh spec {spec!r} (want 'axis=N,axis=N' with axes "
+                f"from {AXES}): {e}"
+            ) from None
+
     def resolve(self, n_devices: int) -> dict[str, int]:
         sizes = dataclasses.asdict(self)
         fixed = [ax for ax, s in sizes.items() if s != -1]

@@ -78,3 +78,22 @@ def test_tf1_style_mnist(tmp_path):
         json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
     ]
     assert any(m["name"] == "loss" for m in metrics)
+
+
+@pytest.mark.slow
+def test_lm_packed_pretraining(tmp_path):
+    """Packed-pretraining example: corpus -> packed rows -> segment-masked
+    training on a data x seq mesh, masked loss falls."""
+    res = _run(
+        "lm_packed_pretraining.py",
+        {
+            "HVT_MESH": "data=2,seq=4",
+            "SEQ_LEN": "64",
+            "DOCS": "400",
+            "DRIVE_EPOCHS": "3",
+            "DRIVE_STEPS": "4",
+        },
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "occupancy" in res.stdout
+    assert "LEARNING" in res.stdout, res.stdout[-800:]
