@@ -58,15 +58,13 @@ from horovod_tpu.models.transformer import (
 from horovod_tpu.parallel import mesh as mesh_lib
 
 
-def parse_mesh(spec: str | None) -> mesh_lib.MeshSpec:
-    return mesh_lib.MeshSpec.from_string(spec)
-
-
 def main() -> None:
     hvt.init()
     metrics.init()
 
-    mesh = mesh_lib.build_mesh(parse_mesh(os.environ.get("HVT_MESH")))
+    mesh = mesh_lib.build_mesh(
+        mesh_lib.MeshSpec.from_string(os.environ.get("HVT_MESH"))
+    )
     seq_len = int(os.environ.get("SEQ_LEN", 512))
     vocab = int(os.environ.get("VOCAB", 64))
     attn = os.environ.get("ATTN", "ring")

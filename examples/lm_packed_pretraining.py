@@ -143,7 +143,14 @@ def main() -> None:
         param_specs=param_specs,
         batch_specs=(batch_spec, batch_spec),
     )
-    n = (len(xs) // (8 * mesh_lib.dp_size(mesh))) * 8 * mesh_lib.dp_size(mesh)
+    rows_needed = 8 * mesh_lib.dp_size(mesh)
+    n = (len(xs) // rows_needed) * rows_needed
+    if n == 0:
+        raise SystemExit(
+            f"corpus packs to only {len(xs)} rows but one global batch "
+            f"needs {rows_needed} (batch 8 x dp {mesh_lib.dp_size(mesh)}) "
+            "- raise DOCS or lower SEQ_LEN"
+        )
     history = trainer.fit(
         x=xs[:n], y=ys[:n],
         batch_size=8,

@@ -250,13 +250,14 @@ class TestMultiProcessModelParallel:
         )
         assert code == 0
 
-    def test_pipeline_stages_across_processes(self, tmp_path):
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_pipeline_stages_across_processes(self, tmp_path, schedule):
         self._run(tmp_path, f"""
             from horovod_tpu.models import pipelined_lm
             mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=1, pipe=2))
             model = pipelined_lm.PipelinedLM(
                 vocab_size=16, d_model=16, n_heads=2, n_layers=2, n_micro=2,
-                mesh=mesh,
+                mesh=mesh, schedule={schedule!r},
             )
             trainer = hvt.Trainer(
                 model, hvt.DistributedOptimizer(optax.adam(1e-3)),
