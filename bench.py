@@ -243,16 +243,28 @@ def bench_train(which: str) -> dict:
         w_state, data, seed, scale, zero_acc, epoch_steps, per_chip_batch
     ).compile()
 
+    # Several epochs chain per timed fetch: each epoch's DONATED state feeds
+    # the next, so the final fetched loss data-depends on the whole chain
+    # (the _timed honesty requirement holds), while the tunnel's per-fetch
+    # round-trip — which would otherwise bill RTT/epoch_steps to every step
+    # as fake "input" time — is amortized across all of them.
+    e2e_reps = max(1, int(os.environ.get("BENCH_E2E_REPS", 4)))
+
     def run_e2e():
-        holder["state"], m, acc = compiled_epoch(
-            holder["state"], data, seed, scale, zero_acc
-        )
+        for _ in range(e2e_reps):
+            holder["state"], m, acc = compiled_epoch(
+                holder["state"], data, seed, scale, zero_acc
+            )
         return acc["loss"]
 
     # Warm WITH a fetch: un-fetched async work from the warm pass would still
     # be executing when the timed pass starts (same tunnel hazard as _timed).
-    float(jax.device_get(run_e2e()))
-    e2e_s = _timed(run_e2e) / epoch_steps
+    # ONE epoch suffices to settle the runtime — no need to burn e2e_reps.
+    holder["state"], _, warm_acc = compiled_epoch(
+        holder["state"], data, seed, scale, zero_acc
+    )
+    float(jax.device_get(warm_acc["loss"]))
+    e2e_s = _timed(run_e2e) / (epoch_steps * e2e_reps)
 
     per_sec_per_chip = unit_per_step / e2e_s / n_chips
     return {
