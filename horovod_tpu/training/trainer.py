@@ -352,25 +352,36 @@ class Trainer:
             u = jax.random.uniform(epoch_seed, (n_shards, per_n))
             order = jnp.argsort(u, axis=1)  # row-wise → shard-local
 
+            # Materialize the epoch's shuffle ONCE: one per-shard row gather
+            # of the rows this epoch will actually consume (bandwidth-bound,
+            # amortized over every step), so the per-step read is a
+            # contiguous dynamic slice — random per-step row gathers are
+            # latency-bound on TPU and were the e2e step's input cost
+            # (0.68 ms/step at CIFAR shapes vs ~0 after; round 2 measured
+            # them at 31% of the MNIST step). The gather runs over FLATTENED
+            # trailing dims (~9x a multi-dim-trailing gather,
+            # benchmarks/conv_profile.py). HBM cost: a second copy of the
+            # CONSUMED prefix (the full dataset when steps cover the epoch),
+            # live alongside `data` for the epoch — the device-cached path
+            # trades HBM for zero per-step host/latency cost by design; use
+            # the streamed fit path when the dataset crowds HBM.
+            need = steps * per_chip_batch
+            shuffled = jax.tree.map(
+                lambda a: jax.vmap(
+                    lambda rows, ii: jnp.take(rows, ii, axis=0)
+                )(
+                    a.reshape(a.shape[0], a.shape[1], -1), order[:, :need]
+                ).reshape((a.shape[0], need) + a.shape[2:]),
+                data,
+            )
+
             def body(carry, t):
                 state, acc = carry
-                idx = jax.lax.dynamic_slice_in_dim(
-                    order, t * per_chip_batch, per_chip_batch, axis=1
-                )
-                # Per-shard gather (vmap over the shard axis keeps it local),
-                # then collapse [n_shards, b, ...] into the global batch.
-                # The gather runs over FLATTENED trailing dims: a row gather
-                # of [N, F] is ~9x faster on TPU than the same gather with
-                # multi-dim trailing shape ([N, 28, 28, 1] — measured 83 vs
-                # 758 us at b128 f32, benchmarks/conv_profile.py gather) —
-                # this was 31% of the round-2 MNIST e2e step.
                 batch = jax.tree.map(
-                    lambda a: jax.vmap(
-                        lambda rows, ii: jnp.take(rows, ii, axis=0)
-                    )(
-                        a.reshape(a.shape[0], a.shape[1], -1), idx
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, t * per_chip_batch, per_chip_batch, axis=1
                     ).reshape((n_shards * per_chip_batch,) + a.shape[2:]),
-                    data,
+                    shuffled,
                 )
                 state, metrics, acc = train_step(state, batch, update_scale, acc)
                 return (state, acc), metrics
