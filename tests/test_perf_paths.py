@@ -262,3 +262,61 @@ class TestTraceAccounting:
     def test_maybe_trace_noop_without_dir(self):
         with trace.maybe_trace(None):
             pass
+
+
+class TestEpochShuffleMaterialization:
+    """The round-3 input-leg fix: the epoch permutation is applied ONCE as a
+    prefix gather and steps read contiguous slices — semantics must be
+    unchanged and the gather must cover only the consumed prefix."""
+
+    def test_capped_steps_consume_prefix_only(self):
+        """steps_per_epoch below the full epoch must still train (the
+        shuffled copy is sized to steps * batch, the review-found waste) and
+        produce finite falling loss."""
+        x, y = _data(n=512)
+        trainer = hvt.Trainer(
+            Probe(), hvt.DistributedOptimizer(optax.adam(5e-3))
+        )
+        hist = trainer.fit(
+            x=x, y=y, batch_size=4, epochs=2, steps_per_epoch=3,
+            cache="device", verbose=0,
+        )
+        assert len(hist) == 2
+        assert np.isfinite(hist[-1]["loss"])
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_device_cached_epoch_covers_each_example_once(self):
+        """One epoch of the device-cached path must see each example exactly
+        once (permutation through the materialized copy) — train a sum-probe
+        whose gradient accumulates the example tags; after one epoch the
+        param equals the sum over ALL tags regardless of order."""
+
+        class SumProbe(nn.Module):
+            @nn.compact
+            def __call__(self, x, *, train: bool = False):
+                w = self.param("w", nn.initializers.zeros, (1,))
+                # loss gradient d/dw = -mean(x) per batch; with SGD lr 1 and
+                # steps covering the epoch, w accumulates batch means.
+                return jnp.broadcast_to(
+                    (w * x.sum(-1, keepdims=True)), (x.shape[0], 2)
+                )
+
+        n = 64
+        x = np.arange(1, n + 1, dtype=np.float32).reshape(n, 1)
+        y = np.zeros(n, dtype=np.int32)
+
+        def loss(logits, labels):
+            return logits[:, 0]  # d/dw = x per example
+
+        tr = hvt.Trainer(
+            SumProbe(), hvt.DistributedOptimizer(optax.sgd(1.0)), loss=loss
+        )
+        tr.fit(
+            x=x, y=y, batch_size=2, epochs=1, cache="device", verbose=0,
+        )
+        # 4 steps x global batch 16 = the full epoch; each step's update is
+        # -lr * mean(batch tags); summed over a permutation of ALL tags the
+        # total is -sum(tags)/global_batch regardless of shuffle order.
+        expected = -np.sum(np.arange(1, n + 1)) / 16.0
+        got = float(np.asarray(jax.device_get(tr.state.params["w"]))[0])
+        np.testing.assert_allclose(got, expected, rtol=1e-5)
