@@ -16,10 +16,13 @@ optimizer, and to checkpointing.
 
 Composes with data parallelism (batch axes sharded by GSPMD outside the
 manual pipe region) and, since round 3, with Megatron tensor parallelism
-INSIDE each stage: qkv/mlp_up column-parallel, attn_out/mlp_down
-row-parallel over the ``model`` axis, one psum per residual join
-(dp x pp x tp on one mesh). SP/EP inside a stage remain out of scope —
-use `TransformerLM` for seq/expert axes instead of pipe.
+INSIDE each stage (qkv/mlp_up column-parallel, attn_out/mlp_down
+row-parallel over ``model``, one psum per residual join) AND with
+sequence/context parallelism: activations shard their token dim over
+``seq`` and every stage's attention runs as ring-flash collectives around
+the seq ring — dp x pp x tp x sp on ONE mesh, so a pipelined model serves
+the same long contexts the flat `TransformerLM` does. EP inside a stage
+remains out of scope — use `TransformerLM` for the expert axis.
 """
 
 from __future__ import annotations
@@ -36,6 +39,7 @@ from horovod_tpu.parallel.mesh import (
     FSDP_AXIS,
     MODEL_AXIS,
     PIPE_AXIS,
+    SEQ_AXIS,
 )
 from horovod_tpu.parallel.pipeline import (
     spmd_pipeline,
@@ -123,12 +127,16 @@ class PipelinedLM(nn.Module):
 
             x, _ = lax.scan(body, x, blocks)
         else:
-            for ax in ("seq", "expert"):
-                if self.mesh.shape.get(ax, 1) != 1:
-                    raise ValueError(
-                        f"PipelinedLM composes with data/pipe/model axes "
-                        f"only; mesh has {ax}={self.mesh.shape[ax]}"
-                    )
+            if self.mesh.shape.get("expert", 1) != 1:
+                raise ValueError(
+                    f"PipelinedLM composes with data/pipe/model/seq axes "
+                    f"only; mesh has expert={self.mesh.shape['expert']}"
+                )
+            sp = self.mesh.shape.get(SEQ_AXIS, 1)
+            if t % sp != 0:
+                raise ValueError(
+                    f"seq length ({t}) must divide over the seq axis ({sp})"
+                )
             tp = self.mesh.shape.get(MODEL_AXIS, 1)
             if tp > 1 and (h % tp or (4 * d) % tp):
                 raise ValueError(
@@ -157,7 +165,11 @@ class PipelinedLM(nn.Module):
                     positions.reshape(n_micro, mb, t),
                 )
 
-            act_spec = P(None, BATCH_AXES, None, None)
+            # Activations shard their token dim over `seq` inside the manual
+            # region; each stage's attention is then a ring-flash collective
+            # around the seq ring (_block), the pp handoffs ppermute only
+            # over `pipe` — same (pipe, seq) grid position, next stage.
+            act_spec = P(None, BATCH_AXES, SEQ_AXIS, None)
             # Stage stacks over `pipe` on dim 0 + Megatron column/row TP
             # over `model` inside each stage (_TP_DIM; activations stay
             # replicated across model, each rank computing its head/feature
@@ -173,7 +185,7 @@ class PipelinedLM(nn.Module):
 
                     def body(a, p):
                         return self._block(
-                            a, p, tp=tp, seg=seg, positions=pos
+                            a, p, tp=tp, sp=sp, seg=seg, positions=pos
                         ), None
 
                     a, _ = lax.scan(body, act, params)
@@ -191,7 +203,7 @@ class PipelinedLM(nn.Module):
                     lambda act, e: stage(stage_params, act, e), xm, extras=ex
                 )
 
-            extra_spec = P(None, BATCH_AXES, None)
+            extra_spec = P(None, BATCH_AXES, SEQ_AXIS)
             args = (blocks, x_micro)
             in_specs = (stack_param_specs, act_spec)
             if extras is not None:
@@ -210,14 +222,20 @@ class PipelinedLM(nn.Module):
         logits = x.astype(jnp.float32) @ lm_head.astype(jnp.float32)
         return logits
 
-    def _block(self, x, p, tp: int = 1, seg=None, positions=None):
+    def _block(self, x, p, tp: int = 1, sp: int = 1, seg=None, positions=None):
         """One pre-LN transformer block over a single layer's params.
 
         ``tp > 1`` = Megatron TP inside the (fully-manual) pipeline region:
         this model-rank's param slices are column-parallel for qkv/mlp_up
         (each rank owns ``h/tp`` heads / ``4d/tp`` features) and
         row-parallel for attn_out/mlp_down, with ONE `psum` over ``model``
-        per residual join restoring the replicated activation."""
+        per residual join restoring the replicated activation.
+
+        ``sp > 1`` = sequence parallelism inside the stage: ``x`` is this
+        device's ``[mb, T/sp, d]`` token shard, RoPE positions carry the
+        shard's global offset, and attention runs as `ring_flash_attention`
+        around the ``seq`` ring (packed ``seg`` ids ride the ring with
+        their K/V blocks)."""
         mb, t, d = x.shape
         h_local = self.n_heads // tp
         hd = d // self.n_heads
@@ -228,7 +246,8 @@ class PipelinedLM(nn.Module):
         qkv = qkv.reshape(mb, t, h_local, 3 * hd)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         if positions is None:
-            positions = jnp.broadcast_to(
+            base = lax.axis_index(SEQ_AXIS) * t if sp > 1 else 0
+            positions = base + jnp.broadcast_to(
                 jnp.arange(t, dtype=jnp.int32), (mb, t)
             )
         q, k = _rope(q, positions), _rope(k, positions)
@@ -236,12 +255,20 @@ class PipelinedLM(nn.Module):
         # materialize [T, T] scores per microbatch and PP could not compose
         # with the long contexts it exists to serve; dense fallback applies
         # automatically when the kernel's tiling doesn't hold (tiny tests).
+        # With a live seq axis the same kernel runs per-hop inside the ring
+        # (the within-chip and cross-chip halves of one online softmax).
+        from horovod_tpu.ops import attention as attention_ops
         from horovod_tpu.ops.flash_attention import flash_attention
 
-        att = flash_attention(
-            q, k, v, causal=True,
-            q_segment_ids=seg, kv_segment_ids=seg,
-        )  # [mb, T, H/tp, hd]
+        if sp > 1:
+            att = attention_ops.ring_flash_attention(
+                q, k, v, axis_name=SEQ_AXIS, causal=True, segment_ids=seg
+            )
+        else:
+            att = flash_attention(
+                q, k, v, causal=True,
+                q_segment_ids=seg, kv_segment_ids=seg,
+            )  # [mb, T, H/tp, hd]
         out = att.reshape(mb, t, h_local * hd) @ p["attn_out"].astype(cd)
         if tp > 1:
             out = lax.psum(out, MODEL_AXIS)

@@ -178,15 +178,17 @@ class TestTraining:
         with pytest.raises(ValueError, match="n_micro"):
             piped.init(jax.random.PRNGKey(0), toks)
 
-    def test_rejects_seq_mesh(self):
-        """model axes compose since round 3 (TestPipeTensorComposition);
-        seq/expert inside a pipeline stage remain out of scope and must be
-        rejected loudly."""
-        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, pipe=2, seq=2))
+    def test_rejects_expert_mesh(self):
+        """model (round 3) and seq (round 3, TestPipeSeqComposition) axes
+        compose; expert inside a pipeline stage remains out of scope and
+        must be rejected loudly."""
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, pipe=2, expert=2)
+        )
         piped = PipelinedLM(
             vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4, mesh=mesh
         )
-        with pytest.raises(ValueError, match="seq"):
+        with pytest.raises(ValueError, match="expert"):
             piped.init(jax.random.PRNGKey(0), jnp.zeros((8, 16), jnp.int32))
 
 
@@ -439,6 +441,113 @@ class TestPipeTensorComposition:
         )
         with pytest.raises(ValueError, match="divide"):
             model.init(jax.random.PRNGKey(0), toks)
+
+
+class TestPipeSeqComposition:
+    """PP × SP × DP on one mesh (round 3 continuation): every stage's
+    attention runs as ring-flash collectives around the ``seq`` ring while
+    activations shard their token dim — the long-context axis composed with
+    the pipeline schedule, under both schedules."""
+
+    def _mesh(self):
+        return mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, pipe=2, seq=2)
+        )
+
+    def _lm(self, mesh, schedule="gpipe"):
+        return PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=4,
+            n_micro=2, mesh=mesh, schedule=schedule,
+        )
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_forward_matches_sequential(self, schedule):
+        mesh = self._mesh()
+        rng = np.random.RandomState(41)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(4, 16)).astype(np.int32))
+        plain = self._lm(None)
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+        out_plain = plain.apply({"params": params}, toks)
+        out = jax.jit(
+            lambda p, t: self._lm(mesh, schedule).apply({"params": p}, t)
+        )(params, toks)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(out_plain), rtol=2e-4, atol=2e-4,
+        )
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_gradients_match_sequential(self, schedule):
+        mesh = self._mesh()
+        rng = np.random.RandomState(42)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(4, 16)).astype(np.int32))
+        labels = jnp.asarray(rng.randint(1, VOCAB, size=(4, 16)).astype(np.int32))
+        plain = self._lm(None)
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+
+        def loss_of(model):
+            def f(p):
+                logits = model.apply({"params": p}, toks)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+
+            return f
+
+        g_seq = jax.grad(loss_of(plain))(params)
+        g_pp = jax.jit(jax.grad(loss_of(self._lm(mesh, schedule))))(params)
+        for key in g_seq:
+            np.testing.assert_allclose(
+                np.asarray(g_pp[key]), np.asarray(g_seq[key]),
+                rtol=2e-3, atol=2e-5, err_msg=key,
+            )
+
+    def test_packed_through_pipe_and_seq(self):
+        """Packed documents + PP + SP together: segment ids shard over seq
+        and ride the ring inside each stage; each packed document must still
+        equal its solo run."""
+        mesh = self._mesh()
+        rng = np.random.RandomState(43)
+        doc_a = rng.randint(1, VOCAB, size=(4, 8)).astype(np.int32)
+        doc_b = rng.randint(1, VOCAB, size=(4, 8)).astype(np.int32)
+        packed = jnp.asarray(np.concatenate([doc_a, doc_b], axis=1))
+        seg = jnp.asarray(np.concatenate(
+            [np.ones((4, 8)), 2 * np.ones((4, 8))], axis=1
+        ).astype(np.int32))
+        plain = self._lm(None)
+        params = plain.init(jax.random.PRNGKey(0), packed)["params"]
+        out = jax.jit(
+            lambda p, tk, sg: self._lm(mesh, "1f1b").apply(
+                {"params": p}, tk, segment_ids=sg
+            )
+        )(params, packed, seg)
+        solo_a = plain.apply({"params": params}, jnp.asarray(doc_a))
+        solo_b = plain.apply({"params": params}, jnp.asarray(doc_b))
+        np.testing.assert_allclose(
+            np.asarray(out[:, :8]), np.asarray(solo_a), rtol=3e-4, atol=3e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, 8:]), np.asarray(solo_b), rtol=3e-4, atol=3e-4
+        )
+
+    def test_trains_on_dp_pp_sp_mesh(self):
+        mesh = self._mesh()
+        tr = hvt.Trainer(
+            self._lm(mesh, "1f1b"),
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=pipelined_lm.param_specs,
+            batch_specs=(P(("data", "fsdp"), "seq"), P(("data", "fsdp"), "seq")),
+        )
+        x, y = datasets.copy_task(64, 16, vocab_size=VOCAB)
+        hist = tr.fit(x=x, y=y, batch_size=4, epochs=2, steps_per_epoch=4)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_indivisible_seq_rejected(self):
+        mesh = self._mesh()
+        model = self._lm(mesh)
+        with pytest.raises(ValueError, match="seq axis"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((4, 15), jnp.int32))
 
 
 class TestPackedPipeline:
