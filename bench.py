@@ -24,6 +24,8 @@ Modes (BENCH_MODEL):
   transformer decoder LM (d512 x 8L, seq 1024, flash attention) — tokens/sec
   moe         same LM with MoE MLPs every 2nd block (8 experts, top-2) —
               tokens/sec + router drop-rate observability
+  decode      autoregressive generation (KV-cache prefill + scan decode
+              loop, models/decoding.py) — generated tokens/sec
   input       host input pipeline A/B: native C++ batch assembly vs Python
 
 HVT_PROFILE=<dir> captures a jax.profiler trace of the measured loop.
@@ -231,6 +233,33 @@ def bench_train(which: str) -> dict:
     flops = trace.compiled_flops(
         trainer._train_step, w_state, trainer._shard(sample), scale, zero_acc
     )
+    if flops and which in ("transformer", "moe"):
+        # The pallas flash kernel is a Mosaic custom call — opaque to XLA's
+        # cost model, so its matmuls (counted from the kernel's own block
+        # structure) are added per layer — but ONLY when the kernel path
+        # actually runs: on shapes where `flash_attention` degrades to the
+        # dense fallback, XLA's count already includes attention and adding
+        # the analytic term would double-count it.
+        from horovod_tpu.ops import flash_attention as fa_kernel
+
+        heads = int(os.environ.get("BENCH_HEADS", 8))
+        head_dim = int(os.environ.get("BENCH_DMODEL", 512)) // heads
+        q_shape = (per_chip_batch * n_chips, seq_len, heads, head_dim)
+        seg = bool(n_docs)
+        blocks = fa_kernel.pick_blocks(
+            seq_len, head_dim, jnp.bfloat16, segmented=seg
+        )
+        if fa_kernel.supported(
+            q_shape, *blocks, dtype=jnp.bfloat16, segmented=seg
+        ):
+            fa = trace.flash_attention_flops(
+                per_chip_batch * n_chips, seq_len, seq_len, heads, head_dim,
+            ) * int(os.environ.get("BENCH_NLAYERS", 8))
+            if n_docs:
+                # Segment block-skip: only same-document tiles execute —
+                # equal-length packing runs ~1/n_docs of the causal tiles.
+                fa /= n_docs
+            flops += fa
 
     # --- end-to-end: training WITH its input pipeline — the device-resident
     # dataset path (`Trainer.fit(cache='device')`): dataset staged into HBM
@@ -282,6 +311,84 @@ def bench_train(which: str) -> dict:
         },
         "n_chips": n_chips,
         **extra_metrics,
+    }
+
+
+def bench_decode() -> dict:
+    """Autoregressive generation: tokens/sec through ONE compiled program
+    (prompt prefill + the whole `lax.scan` decode loop — a per-token host
+    dispatch would be pure tunnel round-trip at this op size).
+
+    Decode is bandwidth-bound (every generated token streams all params +
+    the KV cache through the MXU as matvecs), so the companion number is
+    the model-bandwidth utilisation implied by params x tokens/sec."""
+    os.environ.setdefault("HVT_FAST_RNG", "1")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvt
+    from horovod_tpu.models.decoding import make_generate_fn
+    from horovod_tpu.models.transformer import TransformerLM
+
+    hvt.init()
+    n_chips = jax.device_count()
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", 8))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", 128))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", 512))
+    model = TransformerLM(
+        vocab_size=8192,
+        d_model=int(os.environ.get("BENCH_DMODEL", 512)),
+        n_heads=int(os.environ.get("BENCH_HEADS", 8)),
+        n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
+        compute_dtype=jnp.bfloat16,
+        dropout=0.0,
+    )
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(
+        rng.randint(0, 8192, size=(batch, prompt_len)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    fn = make_generate_fn(
+        model, max_new_tokens=new_tokens, include_prompt=False,
+        temperature=float(os.environ.get("BENCH_TEMPERATURE", 0.0)),
+    )
+    key = jax.random.PRNGKey(7)
+
+    def run():
+        return fn(params, prompt, key).sum()
+
+    float(jax.device_get(run()))  # compile + settle
+    reps = max(1, int(os.environ.get("BENCH_DECODE_REPS", 4)))
+
+    def run_reps():
+        total = jnp.int32(0)
+        for _ in range(reps):
+            total = total + run()
+        return total
+
+    elapsed = _timed(run_reps) / reps
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+    )
+    tok_per_sec = batch * new_tokens / elapsed
+    return {
+        "metric": "transformer_lm_decode_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec / n_chips, 1),
+        "unit": "tokens/sec/chip",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "ms_per_token": round(elapsed / new_tokens * 1e3, 4),
+        "n_params": n_params,
+        # Each decode step reads every weight once: the implied HBM traffic
+        # floor (2 bytes/param bf16, ignoring the KV cache) vs v5e's ~819
+        # GB/s — how close the matvec loop runs to the bandwidth roofline.
+        "model_bandwidth_gbps": round(
+            2 * n_params * (tok_per_sec / batch) / 1e9, 1
+        ),
+        "n_chips": n_chips,
     }
 
 
@@ -358,6 +465,8 @@ def main() -> None:
     which = os.environ.get("BENCH_MODEL", "mnist")
     if which == "input":
         result = bench_input()
+    elif which == "decode":
+        result = bench_decode()
     else:
         result = bench_train(which)
         vs = None

@@ -2,6 +2,7 @@
 flagship for long-context / tensor-parallel configurations."""
 
 from horovod_tpu.models.cnn import MnistCNN  # noqa: F401
+from horovod_tpu.models.decoding import generate, make_generate_fn  # noqa: F401
 from horovod_tpu.models.resnet import ResNetCIFAR  # noqa: F401
 from horovod_tpu.models.transformer import (  # noqa: F401
     ShardingConfig,

@@ -1,0 +1,120 @@
+"""Autoregressive inference: KV-cache prefill + `lax.scan` decode loop.
+
+The reference stops at a serving *export* (mnist_keras.py:126-140 — a
+SavedModel with a predict signature); for an LM-flagship framework the
+serving-side capability is token generation, so this module makes inference
+first-class the TPU way:
+
+* **one compiled program** — prompt prefill (flash-kernel causal attention,
+  K/V written into per-block caches) and the whole decode loop (a
+  `lax.scan` of single-token steps against the cache) live inside a single
+  `jit`, so the host dispatches once per generation, not once per token —
+  on a tunneled runtime a per-token dispatch would cost more than the
+  matvecs themselves;
+* **training shardings reused** — the cache carries the same Megatron
+  layout as training ([B, L, H, D] with heads over ``model``), so a
+  TP-sharded checkpoint decodes without resharding;
+* **static shapes** — the cache is sized `prompt_len + max_new_tokens` up
+  front; early stop on ``eos_id`` is a masked fill, not a dynamic shape.
+
+Sampling: greedy (``temperature=0``), temperature, and top-k — all inside
+the scan via `jax.random.categorical` with a split-per-step key.
+
+MoE caveat: expert capacity is enforced per *call* group, so a decode step
+routes only that step's tokens while a teacher-forced forward routes every
+position of the sequence at once. When capacity never binds (ample
+``capacity_factor``) the two are bit-identical; when it binds they drop
+*different* tokens, and decoded logits can legitimately diverge from a full
+recompute — same semantics Switch/GShard serving has.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """One next-token draw from [B, vocab] logits (f32 math)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
+                     top_k: int = 0, eos_id: int | None = None,
+                     include_prompt: bool = True):
+    """Build the compiled generator: ``(params, prompt, rng) -> tokens``.
+
+    ``model`` is the *training* `TransformerLM`; it is cloned into decode
+    mode (``decode=True``, dropout off) with the cache sized to
+    ``prompt.shape[1] + max_new_tokens``. The returned function is jitted
+    and reusable across calls of the same prompt shape — the handle to hold
+    when generating in a loop (a bare `generate` call per prompt re-traces).
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+
+    def run(params, prompt, rng):
+        prompt = prompt.astype(jnp.int32)
+        b, t0 = prompt.shape
+        dmodel = model.clone(
+            decode=True, max_decode_len=t0 + max_new_tokens, dropout=0.0,
+            remat=False,
+        )
+        # Prefill: one causal forward over the prompt; the mutable 'cache'
+        # collection is created here ([B, L, H, D] per block + the position
+        # index) and threaded through the scan as plain pytree state.
+        logits, vars_ = dmodel.apply({"params": params}, prompt, mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        tok = _sample(logits[:, -1], sub, temperature, top_k)
+        done = (
+            jnp.zeros((b,), bool) if eos_id is None else tok == eos_id
+        )
+        fill = jnp.int32(0 if eos_id is None else eos_id)
+
+        def body(carry, _):
+            cache, tok, rng, done = carry
+            step_logits, step_vars = dmodel.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"],
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(step_logits[:, -1], sub, temperature, top_k)
+            nxt = jnp.where(done, fill, nxt)
+            new_done = done if eos_id is None else done | (nxt == eos_id)
+            return (step_vars["cache"], nxt, rng, new_done), nxt
+
+        (_, _, _, _), rest = lax.scan(
+            body, (vars_["cache"], tok, rng, done), None,
+            length=max_new_tokens - 1,
+        )
+        gen = jnp.concatenate([tok[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+        return jnp.concatenate([prompt, gen], axis=1) if include_prompt else gen
+
+    return jax.jit(run)
+
+
+def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
+             temperature: float = 0.0, top_k: int = 0,
+             eos_id: int | None = None, include_prompt: bool = True):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, T0] ints).
+
+    Convenience wrapper over `make_generate_fn` (which see, for the handle
+    to keep when calling repeatedly). ``temperature=0`` = greedy; after a
+    row emits ``eos_id`` its remaining positions are filled with it.
+    """
+    fn = make_generate_fn(
+        model, max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, eos_id=eos_id, include_prompt=include_prompt,
+    )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return fn(params, jnp.asarray(prompt), rng)

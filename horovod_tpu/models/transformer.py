@@ -117,9 +117,14 @@ class Block(nn.Module):
     moe_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
+    # Autoregressive inference (models/decoding.py): K/V for past tokens live
+    # in a ``cache`` variable collection sized [B, max_decode_len, H, D].
+    decode: bool = False
+    max_decode_len: int = 0
 
     @nn.compact
-    def __call__(self, x, positions, train: bool = False, segment_ids=None):
+    def __call__(self, x, positions, train: bool = False, segment_ids=None,
+                 decode_index=None):
         cfg = self.sharding
         head_dim = self.d_model // self.n_heads
         dense = functools.partial(
@@ -142,6 +147,14 @@ class Block(nn.Module):
                     f"n_heads ({self.n_heads}) must divide over the model "
                     f"axis ({model_par}) for sharded attention"
                 )
+
+        if self.decode:
+            out = self._decode_attention(q, k, v, decode_index)
+            out = dense(features=self.d_model, axis=(-2, -1), name="attn_out")(out)
+            x = x + out
+            h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
+            h = self._mlp(h, dense, train=False)
+            return x + h
 
         if cfg.seq_parallel:
             impls = {
@@ -217,26 +230,101 @@ class Block(nn.Module):
 
         # --- MLP (dense, or expert-parallel MoE) ---------------------------
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
+        h = self._mlp(h, dense, train=train)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+        return cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
+
+    def _mlp(self, h, dense, *, train: bool):
         if self.use_moe:
             from horovod_tpu.models.moe import MoEMlp
 
-            h = MoEMlp(
+            return MoEMlp(
                 self.d_model,
                 n_experts=self.n_experts,
                 k=self.moe_k,
                 capacity_factor=self.capacity_factor,
                 aux_loss_coef=self.moe_aux_coef,
                 compute_dtype=self.compute_dtype,
-                sharding=cfg,
+                sharding=self.sharding,
                 name="moe",
             )(h, train=train)
-        else:
-            h = dense(features=4 * self.d_model, name="mlp_up")(h)  # column-parallel
-            h = nn.gelu(h)
-            h = dense(features=self.d_model, name="mlp_down")(h)  # row-parallel
-        h = nn.Dropout(self.dropout, deterministic=not train)(h)
-        x = x + h
-        return cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
+        h = dense(features=4 * self.d_model, name="mlp_up")(h)  # column-parallel
+        h = nn.gelu(h)
+        return dense(features=self.d_model, name="mlp_down")(h)  # row-parallel
+
+    def _decode_attention(self, q, k, v, decode_index):
+        """KV-cache attention for autoregressive inference.
+
+        The cache holds every past token's K/V ([B, max_decode_len, H, D],
+        heads sharded over ``model`` on a TP mesh — the same Megatron split
+        as training, so decode reuses the training shardings untouched).
+        Two static shapes arrive here:
+
+        * **prefill** (T > 1, ``decode_index == 0``): the prompt's K/V are
+          written at [0:T] and attention runs causally over the prompt alone
+          — exactly the training forward, so the flash kernel applies and no
+          [T, max_decode_len] scores are built;
+        * **decode step** (T == 1): the new token's K/V land at
+          ``decode_index`` and its query attends densely over the valid
+          cache prefix — a matvec per head, bandwidth-bound by design.
+        """
+        cfg = self.sharding
+        b, t, h, d = q.shape
+        if self.max_decode_len < t:
+            raise ValueError(
+                f"max_decode_len ({self.max_decode_len}) < input length ({t})"
+            )
+        cache_spec = P(BATCH_AXES, None, MODEL_AXIS, None)
+        zeros = lambda: jnp.zeros(  # noqa: E731
+            (b, self.max_decode_len, h, d), self.compute_dtype
+        )
+        ck = self.variable("cache", "k", zeros)
+        cv = self.variable("cache", "v", zeros)
+        idx = jnp.asarray(decode_index, jnp.int32)
+        ck.value = cfg.constrain(
+            jax.lax.dynamic_update_slice(
+                ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
+            ),
+            cache_spec,
+        )
+        cv.value = cfg.constrain(
+            jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
+            ),
+            cache_spec,
+        )
+        if t > 1:
+            # Prefill: the cache was empty below `idx` (generate() starts at
+            # 0), so causal attention over the fresh K/V is the full answer —
+            # the training forward's local flash path (O(T) memory), with the
+            # same manual-sharding treatment on a live mesh (GSPMD cannot
+            # auto-partition the Mosaic custom call).
+            from horovod_tpu.ops.flash_attention import flash_attention
+
+            local = functools.partial(flash_attention, causal=True)
+            if cfg.mesh is not None and cfg.mesh.size > 1:
+                spec = P(BATCH_AXES, None, MODEL_AXIS, None)
+                local = jax.shard_map(
+                    local, mesh=cfg.mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_vma=False,
+                )
+            return local(q, k, v)
+        # Single-step decode: q [B,1,H,D] against the cache prefix [0..idx].
+        scale = d ** -0.5
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, ck.value, preferred_element_type=jnp.float32
+        ) * scale
+        valid = (
+            jnp.arange(self.max_decode_len, dtype=jnp.int32) <= idx
+        )[None, None, None, :]
+        s = jnp.where(valid, s, attention_ops._BIG_NEG)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(cv.value.dtype), cv.value,
+            preferred_element_type=jnp.float32,
+        )
+        return out.astype(q.dtype)
 
 
 class TransformerLM(nn.Module):
@@ -265,12 +353,33 @@ class TransformerLM(nn.Module):
     moe_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
+    # Autoregressive inference (models/decoding.py `generate`): per-block K/V
+    # caches sized [B, max_decode_len, H, D] in the ``cache`` collection; the
+    # top-level ``cache/index`` counts consumed positions. T>1 = prefill,
+    # T==1 = one decode step.
+    decode: bool = False
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, segment_ids=None):
         cfg = self.sharding
         b, t = tokens.shape
-        if segment_ids is None:
+        decode_index = None
+        if self.decode:
+            if self.remat or train or segment_ids is not None:
+                raise ValueError(
+                    "decode mode is inference-only: remat/train/segment_ids "
+                    "do not apply"
+                )
+            idx_var = self.variable(
+                "cache", "index", lambda: jnp.zeros((), jnp.int32)
+            )
+            decode_index = idx_var.value
+            positions = decode_index + jnp.broadcast_to(
+                jnp.arange(t, dtype=jnp.int32), (b, t)
+            )
+            idx_var.value = decode_index + t
+        elif segment_ids is None:
             positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
         else:
             # Packed sequences: RoPE positions restart at each document
@@ -294,11 +403,13 @@ class TransformerLM(nn.Module):
                 moe_k=self.moe_k,
                 capacity_factor=self.capacity_factor,
                 moe_aux_coef=self.moe_aux_coef,
+                decode=self.decode,
+                max_decode_len=self.max_decode_len,
                 # Explicit name = flax's auto-name, so the param tree is
                 # identical with and without remat (the remat wrapper would
                 # otherwise scope as CheckpointBlock_i).
                 name=f"Block_{i}",
-            )(x, positions, train, segment_ids)
+            )(x, positions, train, segment_ids, decode_index)
         x = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
         logits = nn.DenseGeneral(
             features=self.vocab_size, dtype=self.compute_dtype, use_bias=False,

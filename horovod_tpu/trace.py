@@ -73,6 +73,25 @@ def compiled_cost_flops(compiled) -> float | None:
         return None
 
 
+def flash_attention_flops(batch: int, seq_q: int, seq_k: int, heads: int,
+                          head_dim: int, *, causal: bool = True,
+                          backward: bool = True) -> float:
+    """Matmul FLOPs one flash-attention call actually executes — the part
+    XLA's cost model cannot see (a Mosaic custom call is opaque to it;
+    BASELINE.md footnote 1).
+
+    Counted from the kernel's own structure (ops/flash_attention.py): the
+    forward runs 2 block dots per (q, k) tile pair (scores, P·V); the
+    backward runs 7 (dq pass: recomputed scores, dP, dQ; dkv pass:
+    recomputed scores, dV, dP, dK). Each full-sequence dot is
+    ``2·B·H·Tq·Tk·D`` FLOPs; causal block-skipping halves the executed
+    tiles. Training callers add this per flash call (per layer, per step)
+    to the XLA cost-model count."""
+    per_dot = 2.0 * batch * heads * seq_q * seq_k * head_dim
+    dots = 9 if backward else 2
+    return dots * per_dot * (0.5 if causal else 1.0)
+
+
 def mfu(flops_per_step: float | None, step_time_s: float, n_chips: int = 1,
         device=None) -> float | None:
     """Model FLOPs utilization: achieved FLOP/s ÷ fleet peak FLOP/s."""

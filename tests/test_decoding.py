@@ -1,0 +1,176 @@
+"""KV-cache autoregressive generation: cache decode must equal full
+recomputation, prefill must equal the training forward, sampling must be
+deterministic under a fixed key, and the whole loop must run TP-sharded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models.decoding import generate, make_generate_fn
+from horovod_tpu.models.transformer import ShardingConfig, TransformerLM
+from horovod_tpu.parallel import mesh as mesh_lib
+
+VOCAB = 32
+
+
+def _model(**kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("dropout", 0.0)
+    return TransformerLM(**kw)
+
+
+def _params(model, t=8, b=2):
+    tokens = jnp.zeros((b, t), jnp.int32)
+    return model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+
+def _greedy_no_cache(model, params, prompt, n):
+    """Reference decoder: full forward re-run per token, no cache."""
+    tokens = np.asarray(prompt)
+    for _ in range(n):
+        logits = model.apply({"params": params}, jnp.asarray(tokens))
+        nxt = np.argmax(np.asarray(logits[:, -1], np.float32), axis=-1)
+        tokens = np.concatenate([tokens, nxt[:, None].astype(tokens.dtype)], axis=1)
+    return tokens
+
+
+class TestGreedyParity:
+    def test_cache_decode_equals_full_recompute(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+        want = _greedy_no_cache(model, params, prompt, 12)
+        got = generate(model, params, prompt, 12)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_prefill_logits_match_training_forward(self):
+        model = _model()
+        params = _params(model)
+        prompt = jnp.arange(12, dtype=jnp.int32).reshape(2, 6) % VOCAB
+        train_logits = model.apply({"params": params}, prompt)
+        dmodel = model.clone(decode=True, max_decode_len=10)
+        decode_logits, _ = dmodel.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(decode_logits), np.asarray(train_logits),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_moe_blocks_decode(self):
+        # Ample capacity so routing never drops: a binding capacity is
+        # enforced per call group, so the per-step decode and the
+        # full-sequence recompute would legitimately drop DIFFERENT tokens
+        # and diverge (models/decoding.py MoE caveat). Exact equality is the
+        # contract only in the drop-free regime this test pins.
+        model = _model(
+            moe_every=2, n_experts=4, moe_k=2, capacity_factor=4.0
+        )
+        params = _params(model)
+        prompt = np.array([[1, 2, 3, 4]], np.int32)
+        want = _greedy_no_cache(model, params, prompt, 6)
+        got = generate(model, params, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_include_prompt_false(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[7, 8, 9]], np.int32)
+        full = generate(model, params, prompt, 5)
+        tail = generate(model, params, prompt, 5, include_prompt=False)
+        assert tail.shape == (1, 5)
+        np.testing.assert_array_equal(np.asarray(full)[:, 3:], np.asarray(tail))
+
+
+class TestSampling:
+    def test_fixed_key_deterministic(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[1, 2, 3]], np.int32)
+        key = jax.random.PRNGKey(42)
+        a = generate(model, params, prompt, 8, temperature=0.8, rng=key)
+        b = generate(model, params, prompt, 8, temperature=0.8, rng=key)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = generate(
+            model, params, prompt, 8, temperature=0.8,
+            rng=jax.random.PRNGKey(43),
+        )
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_tokens_in_vocab(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[0, 1], [2, 3]], np.int32)
+        out = np.asarray(generate(
+            model, params, prompt, 16, temperature=1.5, top_k=5,
+            rng=jax.random.PRNGKey(1),
+        ))
+        assert out.min() >= 0 and out.max() < VOCAB
+
+    def test_top_k_one_is_greedy(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[5, 6, 7]], np.int32)
+        greedy = generate(model, params, prompt, 8)
+        k1 = generate(
+            model, params, prompt, 8, temperature=0.7, top_k=1,
+            rng=jax.random.PRNGKey(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    def test_eos_fill(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[1, 2]], np.int32)
+        base = np.asarray(generate(model, params, prompt, 12, include_prompt=False))
+        eos = int(base[0, 3])  # force an id we know greedy emits at step 3
+        out = np.asarray(generate(
+            model, params, prompt, 12, eos_id=eos, include_prompt=False,
+        ))
+        stop = int(np.argmax(out[0] == eos))
+        np.testing.assert_array_equal(out[0, : stop + 1], base[0, : stop + 1])
+        assert (out[0, stop:] == eos).all()
+
+
+class TestSharded:
+    def test_tp_mesh_matches_single_device(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[3, 1, 4, 1], [2, 7, 1, 8]], np.int32)
+        want = np.asarray(generate(model, params, prompt, 10))
+
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, model=2), devices=jax.devices()[:4]
+        )
+        smodel = _model(sharding=ShardingConfig(mesh=mesh, attn="ring"))
+        got = np.asarray(generate(smodel, params, prompt, 10))
+        np.testing.assert_array_equal(got, want)
+
+    def test_reusable_compiled_fn(self):
+        model = _model()
+        params = _params(model)
+        fn = make_generate_fn(model, max_new_tokens=6)
+        p1 = np.array([[1, 2, 3]], np.int32)
+        p2 = np.array([[4, 5, 6]], np.int32)
+        a = fn(params, jnp.asarray(p1), jax.random.PRNGKey(0))
+        b = fn(params, jnp.asarray(p2), jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(generate(model, params, p1, 6))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(b), np.asarray(generate(model, params, p2, 6))
+        )
+
+    def test_decode_rejects_train_and_remat(self):
+        model = _model(remat=True)
+        params = _params(model)
+        dmodel = model.clone(decode=True, max_decode_len=8)
+        with pytest.raises(ValueError, match="inference-only"):
+            dmodel.apply(
+                {"params": params}, jnp.zeros((1, 2), jnp.int32),
+                mutable=["cache"],
+            )

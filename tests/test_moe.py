@@ -233,6 +233,37 @@ class TestExpertParallel:
         assert np.isfinite(history[-1]["loss"])
         assert history[-1]["loss"] < history[0]["loss"]
 
+    def test_ep_tp_composition(self):
+        """EP × TP on one mesh: expert weights shard dim 0 over `expert` AND
+        their hidden dim over `model` (param_specs moe rules); the function
+        must still match the unsharded layer and train end-to-end."""
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, expert=2, model=2)
+        )
+        d, e = 16, 4
+        plain = MoEMlp(d, n_experts=e, k=2, capacity_factor=2.0)
+        sharded = MoEMlp(
+            d, n_experts=e, k=2, capacity_factor=2.0,
+            sharding=ShardingConfig(mesh=mesh),
+        )
+        x = jnp.asarray(np.random.RandomState(7).rand(2, 8, d), jnp.float32)
+        variables = _init(plain, x)
+        out_plain = plain.apply(variables, x)
+        out_sharded = jax.jit(lambda v, t: sharded.apply(v, t))(variables, x)
+        np.testing.assert_allclose(
+            np.asarray(out_plain), np.asarray(out_sharded), rtol=1e-4, atol=1e-5
+        )
+        trainer = self._trainer(mesh)
+        xt, yt = datasets.copy_task(128, 16, vocab_size=VOCAB, seed=2)
+        hist = trainer.fit(
+            x=xt, y=yt, batch_size=8, epochs=1, steps_per_epoch=4, verbose=0
+        )
+        assert np.isfinite(hist[-1]["loss"])
+        state = trainer.state
+        up = state.params["Block_1"]["moe"]["moe_up"]
+        spec = up.sharding.spec
+        assert spec[0] == "expert" and spec[2] == "model", spec
+
     def test_moe_matches_unsharded(self):
         """EP-sharded MoE must compute the same function as the unsharded
         layer (same params, same tokens)."""
