@@ -41,6 +41,35 @@ BATCH = 128
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+def _lm_from_env(*, moe: bool = False):
+    """The bench transformer, one source of truth for its env knobs — the
+    decode rows must measure the same model the training rows do."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import runtime
+    from horovod_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(
+        vocab_size=8192,
+        d_model=int(os.environ.get("BENCH_DMODEL", 512)),
+        n_heads=int(os.environ.get("BENCH_HEADS", 8)),
+        n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
+        compute_dtype=jnp.bfloat16,
+        dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
+        # ~12%/step — HVT_FAST_RNG=1 makes dropout free when wanted)
+        # moe mode: expert-parallel MLP every 2nd block (models/moe.py).
+        moe_every=2 if moe else 0,
+        n_experts=int(os.environ.get("BENCH_EXPERTS", 8)),
+        moe_k=int(os.environ.get("BENCH_MOE_K", 2)),
+        capacity_factor=float(os.environ.get("BENCH_CAPACITY", 1.25)),
+        # Long-context memory knobs (BASELINE.md context-envelope rows):
+        remat=runtime.env_flag("BENCH_REMAT"),
+        logits_dtype=jnp.bfloat16
+        if os.environ.get("BENCH_LOGITS", "") == "bf16"
+        else jnp.float32,
+    )
+
+
 def _timed(fn):
     """Wall time of `fn` with HONEST completion: `fn` must return a device
     scalar, which is fetched to the host before the clock stops.
@@ -98,32 +127,11 @@ def bench_train(which: str) -> dict:
         unit = "images/sec/chip"
         default_steps = 256
     elif which in ("transformer", "moe"):
-        from horovod_tpu.models.transformer import TransformerLM
-
         seq_len = int(os.environ.get("BENCH_SEQ_LEN", 1024))
         per_chip_batch = int(os.environ.get("BENCH_LM_BATCH", 8))
         x_np, y_np = datasets.copy_task(4096, seq_len, vocab_size=8192)
         x, y = x_np, y_np
-        module = TransformerLM(
-            vocab_size=8192,
-            d_model=int(os.environ.get("BENCH_DMODEL", 512)),
-            n_heads=int(os.environ.get("BENCH_HEADS", 8)),
-            n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
-            compute_dtype=jnp.bfloat16,
-            # moe mode: expert-parallel MLP every 2nd block (models/moe.py);
-            # tokens/sec + MFU + the sown router drop-rate metric.
-            moe_every=2 if which == "moe" else 0,
-            n_experts=int(os.environ.get("BENCH_EXPERTS", 8)),
-            moe_k=int(os.environ.get("BENCH_MOE_K", 2)),
-            capacity_factor=float(os.environ.get("BENCH_CAPACITY", 1.25)),
-            dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
-            # ~12%/step — HVT_FAST_RNG=1 makes dropout free when wanted)
-            # Long-context memory knobs (BASELINE.md context-envelope rows):
-            remat=runtime.env_flag("BENCH_REMAT"),
-            logits_dtype=jnp.bfloat16
-            if os.environ.get("BENCH_LOGITS", "") == "bf16"
-            else jnp.float32,
-        )
+        module = _lm_from_env(moe=which == "moe")
         metric = (
             "moe_lm_train_tokens_per_sec_per_chip"
             if which == "moe"
@@ -330,21 +338,13 @@ def bench_decode() -> dict:
 
     import horovod_tpu as hvt
     from horovod_tpu.models.decoding import make_generate_fn
-    from horovod_tpu.models.transformer import TransformerLM
 
     hvt.init()
     n_chips = jax.device_count()
     batch = int(os.environ.get("BENCH_DECODE_BATCH", 8))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", 128))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", 512))
-    model = TransformerLM(
-        vocab_size=8192,
-        d_model=int(os.environ.get("BENCH_DMODEL", 512)),
-        n_heads=int(os.environ.get("BENCH_HEADS", 8)),
-        n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
-        compute_dtype=jnp.bfloat16,
-        dropout=0.0,
-    )
+    model = _lm_from_env()
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(
         rng.randint(0, 8192, size=(batch, prompt_len)), jnp.int32

@@ -42,8 +42,10 @@ from horovod_tpu.parallel.mesh import (
     SEQ_AXIS,
 )
 from horovod_tpu.parallel.pipeline import (
+    interleaved_layer_order,
     spmd_pipeline,
     spmd_pipeline_1f1b,
+    spmd_pipeline_interleaved,
     stage_slice_size,
 )
 
@@ -75,7 +77,14 @@ class PipelinedLM(nn.Module):
     # '1f1b' = hand-scheduled staggered backward with per-microbatch
     # rematerialization — the 1F1B activation-memory discipline
     # (spmd_pipeline_1f1b). Identical math; parity-tested gradients.
+    # 'interleaved' = virtual-stage schedule (spmd_pipeline_interleaved):
+    # each pipe device hosts `n_virtual` non-adjacent chunks, cutting the
+    # fill bubble to (S-1)/(v*T + S-1). NOTE: on a live pipe mesh the layer
+    # stacks are stored in PLACEMENT order (device-major) — convert with
+    # to_logical_order/to_interleaved_order when moving checkpoints between
+    # schedules.
     schedule: str = "gpipe"
+    n_virtual: int = 2
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, segment_ids=None):
@@ -112,9 +121,10 @@ class PipelinedLM(nn.Module):
         # Validate unconditionally: a typo'd schedule on a pipe-less mesh
         # would otherwise train silently via the sequential path and only
         # error when the config moves to a real pipeline mesh.
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"schedule must be 'gpipe' or '1f1b', got {self.schedule!r}"
+                f"schedule must be 'gpipe', '1f1b' or 'interleaved', "
+                f"got {self.schedule!r}"
             )
 
         if self.mesh is None or self.mesh.shape.get(PIPE_AXIS, 1) == 1:
@@ -179,6 +189,29 @@ class PipelinedLM(nn.Module):
                 for k, spec in _stack_specs(tp > 1).items()
             }
 
+            # Interleaved: L must split into S*v chunks, and the wrap
+            # register-file timing needs n_micro >= n_stages. Degrading v
+            # to 1 would apply the PLACEMENT-ordered stacks contiguously —
+            # a permuted layer composition, a different function — so it is
+            # allowed only during flax's shape-only init probe (values are
+            # discarded there); a real forward with too few microbatches
+            # fails loudly instead.
+            v_eff = 1
+            if self.schedule == "interleaved":
+                if L % (n_stages * self.n_virtual) != 0:
+                    raise ValueError(
+                        f"n_layers ({L}) must divide into pipe "
+                        f"({n_stages}) x n_virtual ({self.n_virtual}) chunks"
+                    )
+                if n_micro >= n_stages:
+                    v_eff = self.n_virtual
+                elif not self.is_initializing():
+                    raise ValueError(
+                        f"interleaved schedule needs n_micro ({n_micro}, "
+                        f"after batch clamping) >= pipe ({n_stages}); "
+                        f"raise the batch or n_micro"
+                    )
+
             def run(stage_params, xm, ex=None):
                 def stage(params, act, extra=None):
                     seg, pos = extra if extra is not None else (None, None)
@@ -191,6 +224,16 @@ class PipelinedLM(nn.Module):
                     a, _ = lax.scan(body, act, params)
                     return a
 
+                if self.schedule == "interleaved":
+                    chunked = jax.tree.map(
+                        lambda p: p.reshape(
+                            (v_eff, p.shape[0] // v_eff) + p.shape[1:]
+                        ),
+                        stage_params,
+                    )
+                    return spmd_pipeline_interleaved(
+                        stage, chunked, xm, n_virtual=v_eff, extras=ex
+                    )
                 if self.schedule == "1f1b":
                     return spmd_pipeline_1f1b(
                         stage, stage_params, xm, extras=ex
@@ -299,6 +342,42 @@ def _stack_specs(tp: bool) -> dict:
             spec[_TP_DIM[name]] = MODEL_AXIS
         out[name] = tuple(spec)
     return out
+
+
+def _reorder_stacks(params, order):
+    """Apply a row permutation to every per-layer stack leaf."""
+    import numpy as np
+
+    idx = jnp.asarray(np.asarray(order, dtype=np.int32))
+
+    def rule(path, leaf):
+        names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
+        if any(n in _STACKED for n in names):
+            return jnp.take(leaf, idx, axis=0)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def to_interleaved_order(params, n_layers: int, n_stages: int,
+                         n_virtual: int):
+    """Logical-order stacks → the placement order an interleaved pipe mesh
+    stores (physical row p = logical layer `interleaved_layer_order(...)[p]`).
+    Use when loading a sequential/gpipe checkpoint into an interleaved
+    config."""
+    return _reorder_stacks(
+        params, interleaved_layer_order(n_layers, n_stages, n_virtual)
+    )
+
+
+def to_logical_order(params, n_layers: int, n_stages: int, n_virtual: int):
+    """Inverse of `to_interleaved_order` — recover logical layer order from
+    an interleaved checkpoint (e.g. to resume it on a different mesh or
+    schedule)."""
+    import numpy as np
+
+    order = interleaved_layer_order(n_layers, n_stages, n_virtual)
+    return _reorder_stacks(params, np.argsort(order))
 
 
 def param_specs(params, mesh: Mesh) -> dict:

@@ -276,6 +276,17 @@ class Block(nn.Module):
                 f"max_decode_len ({self.max_decode_len}) < input length ({t})"
             )
         cache_spec = P(BATCH_AXES, None, MODEL_AXIS, None)
+        first_call = not self.has_variable("cache", "k")
+        if t > 1 and not first_call:
+            # Statically decidable: the cache collection exists, so a prior
+            # prefill already advanced the index — a T>1 call here would
+            # attend only among the fresh tokens and silently ignore the
+            # cached prefix. Chunked prompt extension is not supported;
+            # feed the full prompt in one apply (then T==1 steps).
+            raise ValueError(
+                "decode-mode prefill must be the first call on a fresh "
+                "cache; after it, feed one token at a time"
+            )
         zeros = lambda: jnp.zeros(  # noqa: E731
             (b, self.max_decode_len, h, d), self.compute_dtype
         )

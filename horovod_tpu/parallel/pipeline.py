@@ -263,6 +263,142 @@ def spmd_pipeline_1f1b(
     return pipe(stage_params, x_micro, extras)
 
 
+def spmd_pipeline_interleaved(
+    chunk_fn: Callable,
+    chunk_params,
+    x_micro,
+    *,
+    n_virtual: int,
+    axis_name: str = PIPE_AXIS,
+    extras=None,
+):
+    """Interleaved (virtual-stage) schedule: each device hosts ``n_virtual``
+    non-adjacent model chunks, so the pipeline fill costs S-1 *chunk* times
+    instead of S-1 *stage* times (Megatron's interleaved 1F1B insight,
+    arXiv:2104.04473 — here as the forward schedule with AD-derived
+    backward, matching `spmd_pipeline`'s design).
+
+    Logical chunks ``c = 0 .. S·v - 1`` map to device ``c mod S``; microbatch
+    ``m`` on round ``r`` (its ``r``-th lap around the ring) runs on device
+    ``d`` at tick ``r·T + m + d`` — for fixed ``d`` the (r, m) decomposition
+    of ``t - d`` is unique, so every device does exactly one chunk per tick
+    (uniform SPMD control flow) and the whole schedule is
+    ``v·T + S - 1`` ticks of 1/v-sized stage work:
+    relative overhead (v·T + S - 1)/(v·T) vs GPipe's (T + S - 1)/T.
+
+    The ring handoff (d → d+1) delivers the next round's input directly on
+    devices 1..S-1; the wrap S-1 → 0 arrives T - S ticks early and waits in
+    a per-microbatch register file (``buf``) until round r+1 reaches that
+    microbatch — the memory cost of interleaving is that [T, mb, ...]
+    waiting room (plus the extra in-flight activations AD saves).
+
+    Args:
+      chunk_fn: ``(one chunk's params, activation [mb, ...]) ->
+        activation`` (with ``extras``: ``(params, act, extra)``) — applies
+        ``n_layers / (S·v)`` layers.
+      chunk_params: this device's ``[v, layers_per_chunk, ...]`` stacks —
+        chunk ``r`` at index r, holding LOGICAL chunk ``r·S + d``.
+      x_micro: ``[n_micro, mb, ...]`` stage-0 inputs. Requires
+        ``n_micro >= S`` (the wrap must not outrun the schedule).
+
+    Must be called inside `shard_map` (like `spmd_pipeline`). Returns the
+    last logical chunk's outputs ``[n_micro, mb, ...]``, broadcast over pipe.
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.psum(1, axis_name)  # static: psum of a literal
+    n_micro = x_micro.shape[0]
+    v = n_virtual
+    if n_virtual > 1 and n_micro < int(n_stages):
+        # The wrap register-file entry for (m, r+1) is written at tick
+        # r·T + m + S but read at (r+1)·T + m — with T < S the read
+        # happens FIRST and consumes stale zeros. (v == 1 has no wrap
+        # reads at all, so any n_micro is safe there — the degenerate
+        # GPipe-style tick loop the init probe uses.)
+        raise ValueError(
+            f"interleaved schedule needs n_micro ({n_micro}) >= n_stages "
+            f"({int(n_stages)}) — the ring wrap would outrun the schedule"
+        )
+    ticks = v * n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    state = jnp.zeros(x_micro.shape[1:], x_micro.dtype)
+    buf = jnp.zeros_like(x_micro)      # wrap waiting room, keyed by microbatch
+    out_buf = jnp.zeros_like(x_micro)
+
+    def tick(carry, t):
+        state, buf, out_buf = carry
+        # Stash the arriving activation under its sender's microbatch id:
+        # sender (s-1 mod S) processed u' = (t-1) - sender at tick t-1.
+        sender = (s - 1) % n_stages
+        u_arr = (t - 1) - sender
+        m_arr = jnp.clip(u_arr % n_micro, 0, n_micro - 1)
+        arr_valid = (u_arr >= 0) & (u_arr < v * n_micro)
+        cur = lax.dynamic_index_in_dim(buf, m_arr, 0, keepdims=False)
+        buf = lax.dynamic_update_index_in_dim(
+            buf, jnp.where(arr_valid, state, cur), m_arr, 0
+        )
+
+        # This device's work item: u = t - s decomposes uniquely as
+        # r·T + m.
+        u = t - s
+        m = jnp.clip(u % n_micro, 0, n_micro - 1)
+        r = jnp.clip(u // n_micro, 0, v - 1)
+        valid = (u >= 0) & (u < v * n_micro)
+        x_t = lax.dynamic_index_in_dim(x_micro, m, 0, keepdims=False)
+        held = lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
+        first_round = (u // n_micro) == 0
+        inp = jnp.where((s == 0) & first_round, x_t, held)
+
+        chunk = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, r, 0, keepdims=False),
+            chunk_params,
+        )
+        if extras is None:
+            out = chunk_fn(chunk, inp)
+        else:
+            out = chunk_fn(chunk, inp, _micro_extra(extras, m))
+
+        # The last logical chunk (c = S·v - 1 lives on device S-1, round
+        # v-1) finishes microbatch m here.
+        is_final = valid & (s == n_stages - 1) & (r == v - 1)
+        cur_out = lax.dynamic_index_in_dim(out_buf, m, 0, keepdims=False)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(is_final, out, cur_out), m, 0
+        )
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, buf, out_buf), None
+
+    (_, _, out_buf), _ = lax.scan(
+        tick, (state, buf, out_buf), jnp.arange(ticks)
+    )
+    out = lax.psum(
+        jnp.where(s == n_stages - 1, out_buf, 0.0), axis_name
+    )
+    return out
+
+
+def interleaved_layer_order(n_layers: int, n_stages: int,
+                            n_virtual: int) -> list[int]:
+    """Physical row ``p`` → logical layer index, for the interleaved layout.
+
+    The pipe axis shards layer stacks contiguously (device d = rows
+    [d·L/S, (d+1)·L/S)), but interleaving needs device d to hold logical
+    chunks ``d, d+S, ..., d+(v-1)·S``. The model therefore stores stacks in
+    *placement order* — device-major, round-minor — and this mapping
+    converts: a stack built from logical layers ``[order[p] for p in
+    range(L)]`` places the right chunks on the right devices. Checkpoints of
+    an interleaved config carry this order; `pipelined_lm.to_logical_order`
+    / `to_interleaved_order` convert.
+    """
+    lpc = n_layers // (n_stages * n_virtual)
+    order = []
+    for d in range(n_stages):
+        for r in range(n_virtual):
+            c = r * n_stages + d
+            order.extend(range(c * lpc, (c + 1) * lpc))
+    return order
+
+
 def stage_slice_size(n_layers: int, n_stages: int) -> int:
     """Layers per stage; n_layers must divide evenly."""
     if n_layers % n_stages != 0:

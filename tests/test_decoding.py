@@ -165,6 +165,23 @@ class TestSharded:
             np.asarray(b), np.asarray(generate(model, params, p2, 6))
         )
 
+    def test_chunked_prefill_rejected(self):
+        """A T>1 apply on an EXISTING cache would attend only among the
+        fresh tokens and silently ignore the cached prefix — the
+        single-prefill contract is enforced statically."""
+        model = _model()
+        params = _params(model)
+        dmodel = model.clone(decode=True, max_decode_len=16)
+        _, vars_ = dmodel.apply(
+            {"params": params}, jnp.zeros((1, 4), jnp.int32),
+            mutable=["cache"],
+        )
+        with pytest.raises(ValueError, match="first call"):
+            dmodel.apply(
+                {"params": params, "cache": vars_["cache"]},
+                jnp.zeros((1, 3), jnp.int32), mutable=["cache"],
+            )
+
     def test_decode_rejects_train_and_remat(self):
         model = _model(remat=True)
         params = _params(model)

@@ -443,6 +443,160 @@ class TestPipeTensorComposition:
             model.init(jax.random.PRNGKey(0), toks)
 
 
+class TestInterleaved:
+    """Virtual-stage (Megatron-interleaved) schedule: each pipe device
+    hosts `n_virtual` non-adjacent chunks, so the fill bubble is S-1 CHUNK
+    times — relative overhead (v·T + S - 1)/(v·T) vs GPipe's (T + S - 1)/T.
+    Stacks live in placement order on the mesh; the to_interleaved_order /
+    to_logical_order helpers convert against sequential checkpoints."""
+
+    def _lm(self, mesh, n_layers=8, n_micro=4, v=2):
+        return PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=n_layers,
+            n_micro=n_micro, mesh=mesh, schedule="interleaved", n_virtual=v,
+        )
+
+    @pytest.mark.parametrize("pipe,v", [(2, 2), (4, 2)])
+    def test_forward_matches_sequential(self, pipe, v):
+        mesh = _mesh(data=8 // pipe, pipe=pipe)
+        rng = np.random.RandomState(51)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(16, 16)).astype(np.int32))
+        plain = PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=8,
+            n_micro=4, mesh=None,
+        )
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+        out_plain = plain.apply({"params": params}, toks)
+        inter = self._lm(mesh, v=v)
+        p_inter = pipelined_lm.to_interleaved_order(params, 8, pipe, v)
+        out = jax.jit(
+            lambda p, t: inter.apply({"params": p}, t)
+        )(p_inter, toks)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(out_plain), rtol=2e-4, atol=2e-4,
+        )
+
+    def test_order_roundtrip(self):
+        plain = PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=8, mesh=None,
+        )
+        params = plain.init(
+            jax.random.PRNGKey(1), jnp.zeros((4, 16), jnp.int32)
+        )["params"]
+        there = pipelined_lm.to_interleaved_order(params, 8, 2, 2)
+        back = pipelined_lm.to_logical_order(there, 8, 2, 2)
+        for key in params:
+            np.testing.assert_array_equal(
+                np.asarray(back[key]), np.asarray(params[key]), err_msg=key
+            )
+        # and the permutation is NOT the identity on the stacks
+        assert not np.array_equal(
+            np.asarray(there["qkv"]), np.asarray(params["qkv"])
+        )
+
+    def test_gradients_match_sequential(self):
+        mesh = _mesh(data=4, pipe=2)
+        rng = np.random.RandomState(52)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(16, 16)).astype(np.int32))
+        labels = jnp.asarray(rng.randint(1, VOCAB, size=(16, 16)).astype(np.int32))
+        plain = PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=8,
+            n_micro=4, mesh=None,
+        )
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+
+        def loss_of(model):
+            def f(p):
+                logits = model.apply({"params": p}, toks)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+
+            return f
+
+        g_seq = jax.grad(loss_of(plain))(params)
+        p_inter = pipelined_lm.to_interleaved_order(params, 8, 2, 2)
+        g_inter = jax.jit(jax.grad(loss_of(self._lm(mesh))))(p_inter)
+        g_inter = pipelined_lm.to_logical_order(g_inter, 8, 2, 2)
+        for key in g_seq:
+            np.testing.assert_allclose(
+                np.asarray(g_inter[key]), np.asarray(g_seq[key]),
+                rtol=2e-3, atol=2e-5, err_msg=key,
+            )
+
+    def test_bubble_matches_tick_model(self):
+        """Per-device FLOPs of the interleaved schedule must track its tick
+        model (v·T + S - 1)/(v·T · mesh.size) of the sequential stack —
+        the same anchoring TestBubbleAccounting gives GPipe. (A direct
+        fl_inter < fl_gpipe comparison is NOT asserted: XLA's cost analysis
+        is only band-accurate across different scan structures — GPipe
+        itself measures ~30% under its own tick model here — so the
+        schedule-vs-schedule claim rests on the tick counts both ratios are
+        anchored to: (v·T+S-1) chunk passes vs (T+S-1)·v, i.e. 11 vs 14
+        layer passes per device at S=4, T=4, v=2.)"""
+        from horovod_tpu import trace
+
+        mesh = _mesh(data=2, pipe=4)
+        S, T, v = 4, 4, 2
+        rng = np.random.RandomState(53)
+        toks = jnp.asarray(rng.randint(1, VOCAB, size=(8, 16)).astype(np.int32))
+        plain = PipelinedLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=8,
+            n_micro=T, mesh=None,
+        )
+        params = plain.init(jax.random.PRNGKey(0), toks)["params"]
+        p_inter = pipelined_lm.to_interleaved_order(params, 8, S, v)
+        fl_inter = trace.compiled_flops(
+            jax.jit(lambda p, t: self._lm(mesh, v=v).apply({"params": p}, t)),
+            p_inter, toks,
+        )
+        fl_plain = trace.compiled_flops(
+            jax.jit(lambda p, t: plain.apply({"params": p}, t)), params, toks
+        )
+        if not fl_inter or not fl_plain:
+            pytest.skip("backend reports no cost analysis")
+        expected_inter = (v * T + S - 1) / (v * T * mesh.size)
+        measured = fl_inter / fl_plain
+        assert measured == pytest.approx(expected_inter, rel=0.35), (
+            f"FLOP ratio {measured:.3f} vs interleaved tick model "
+            f"{expected_inter:.3f}"
+        )
+
+    def test_trains(self):
+        mesh = _mesh(data=4, pipe=2)
+        tr = hvt.Trainer(
+            self._lm(mesh, n_micro=4),
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=pipelined_lm.param_specs,
+        )
+        x, y = datasets.copy_task(64, 16, vocab_size=VOCAB)
+        hist = tr.fit(x=x, y=y, batch_size=8, epochs=2, steps_per_epoch=4)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_indivisible_chunks_rejected(self):
+        mesh = _mesh(data=4, pipe=2)
+        model = self._lm(mesh, n_layers=6, v=4)
+        with pytest.raises(ValueError, match="n_virtual"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32))
+
+    def test_too_few_micros_rejected_after_init(self):
+        """n_micro < n_stages must fail loudly on a REAL forward: degrading
+        v to 1 would run the placement-ordered stacks contiguously — a
+        permuted layer composition, not the trained function. Only flax's
+        shape-only init probe may degrade."""
+        mesh = _mesh(data=4, pipe=2)
+        model = self._lm(mesh, n_micro=4)
+        # init with a dp-sized probe batch (n_micro clamps to 1) is fine:
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32)
+        )["params"]
+        # a real apply at the same tiny batch is not:
+        with pytest.raises(ValueError, match="n_micro"):
+            model.apply({"params": params}, jnp.zeros((4, 16), jnp.int32))
+
+
 class TestPipeSeqComposition:
     """PP × SP × DP on one mesh (round 3 continuation): every stage's
     attention runs as ring-flash collectives around the ``seq`` ring while
