@@ -35,6 +35,17 @@ from jax.sharding import PartitionSpec as P
 from horovod_tpu.parallel.mesh import EXPERT_AXIS
 
 
+def dispatch_group_count(g: int, group_size: int) -> int:
+    """Smallest divisor of ``g`` whose groups stay within ``group_size`` —
+    the shared dispatch-grouping contract (used here and by
+    `models/pipelined_lm.PipelinedLM`'s in-pipeline MoE, which must group
+    identically for pipelined-vs-sequential parity)."""
+    for n in range(1, g + 1):
+        if g % n == 0 and g // n <= group_size:
+            return n
+    return g
+
+
 class MoEMlp(nn.Module):
     """Routed MLP: ``[B, T, d] -> [B, T, d]`` through E expert FFNs.
 
@@ -163,11 +174,7 @@ class MoEMlp(nn.Module):
         return mixed.reshape(b, t, d).astype(x.dtype)
 
     def _n_groups(self, g: int) -> int:
-        """Smallest divisor of ``g`` whose group stays within group_size."""
-        for n in range(1, g + 1):
-            if g % n == 0 and g // n <= self.group_size:
-                return n
-        return g
+        return dispatch_group_count(g, self.group_size)
 
     def _constrain(self, v, spec):
         cfg = self.sharding

@@ -21,8 +21,20 @@ row-parallel over ``model``, one psum per residual join) AND with
 sequence/context parallelism: activations shard their token dim over
 ``seq`` and every stage's attention runs as ring-flash collectives around
 the seq ring — dp x pp x tp x sp on ONE mesh, so a pipelined model serves
-the same long contexts the flat `TransformerLM` does. EP inside a stage
-remains out of scope — use `TransformerLM` for the expert axis.
+the same long contexts the flat `TransformerLM` does.
+
+``mlp='moe'`` swaps every block's dense MLP for a GShard dense-dispatch
+MoE (the `models/moe.py` formulation, Mixtral-style every-layer routing)
+written functionally over ``[n_layers, E, ...]`` expert stacks: E shards
+over the ``expert`` mesh axis INSIDE the manual pipeline region (each
+expert-rank routes identically in f32, slices its experts' columns of the
+dispatch/combine one-hots, runs its expert FFNs — hidden dim additionally
+Megatron-sharded over ``model`` when TP is live — and ONE
+psum(expert×model) per block restores the residual), so dp x pp x ep (x
+tp x sp) compose on ONE mesh. The router's load-balance aux loss and
+drop-rate counters ride the schedules' differentiable ``with_aux``
+channel out of the manual region (`parallel/pipeline.py`) and surface
+through the standard sown 'losses'/'metrics' collections.
 """
 
 from __future__ import annotations
@@ -36,6 +48,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from horovod_tpu.models.transformer import _rope, packed_positions
 from horovod_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
     PIPE_AXIS,
@@ -85,6 +98,24 @@ class PipelinedLM(nn.Module):
     # schedules.
     schedule: str = "gpipe"
     n_virtual: int = 2
+    # 'dense' = reference-style GELU MLP at 4x; 'moe' = every block's MLP
+    # routed through n_experts expert FFNs (GShard top-k dense dispatch,
+    # experts sharded over the `expert` mesh axis — see module docstring).
+    # All-blocks routing (not moe_every) because the schedule scans ONE
+    # homogeneous parameter stack per stage; alternate dense/MoE layers
+    # would make the stack heterogeneous. Use TransformerLM for moe_every.
+    mlp: str = "dense"
+    n_experts: int = 8
+    moe_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
+    # Dispatch group size: routing one-hots are [groups, S, E, C] with
+    # C ∝ S, so grouping keeps dispatch cost linear in token count (same
+    # contract as models/moe.py). Groups are contiguous chunks of this
+    # shard's token stream — for bit-parity between pipelined and
+    # sequential runs pick a size dividing every shard's tokens-per-
+    # microbatch the same way.
+    moe_group_size: int = 1024
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, segment_ids=None):
@@ -94,14 +125,37 @@ class PipelinedLM(nn.Module):
         lecun = nn.initializers.lecun_normal()
         ones = nn.initializers.ones
 
+        if self.mlp not in ("dense", "moe"):
+            raise ValueError(f"mlp must be 'dense' or 'moe', got {self.mlp!r}")
+        moe = self.mlp == "moe"
         blocks = {
             "ln1": self.param("ln1", ones, (L, d)),
             "qkv": self.param("qkv", lecun, (L, d, 3 * d)),
             "attn_out": self.param("attn_out", lecun, (L, d, d)),
             "ln2": self.param("ln2", ones, (L, d)),
-            "mlp_up": self.param("mlp_up", lecun, (L, d, 4 * d)),
-            "mlp_down": self.param("mlp_down", lecun, (L, 4 * d, d)),
         }
+        if moe:
+            e = self.n_experts
+            blocks["router"] = self.param(
+                "router",
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                (L, d, e),
+            )
+            blocks["moe_up"] = self.param(
+                "moe_up",
+                nn.initializers.lecun_normal(batch_axis=(0, 1)),
+                (L, e, d, 4 * d),
+            )
+            blocks["moe_down"] = self.param(
+                "moe_down",
+                nn.initializers.lecun_normal(batch_axis=(0, 1)),
+                (L, e, 4 * d, d),
+            )
+        else:
+            blocks["mlp_up"] = self.param("mlp_up", lecun, (L, d, 4 * d))
+            blocks["mlp_down"] = self.param(
+                "mlp_down", lecun, (L, 4 * d, d)
+            )
         embed = self.param(
             "embed", nn.initializers.normal(1.0), (self.vocab_size, d)
         )
@@ -127,21 +181,41 @@ class PipelinedLM(nn.Module):
                 f"got {self.schedule!r}"
             )
 
+        # Validate expert-axis compatibility unconditionally (like the
+        # schedule check above): a config must fail the same way whether it
+        # lands on a pipe mesh or the sequential path.
+        if self.mesh is not None:
+            mesh_ep = self.mesh.shape.get(EXPERT_AXIS, 1)
+            if mesh_ep > 1 and not moe:
+                raise ValueError(
+                    f"mesh has expert={mesh_ep} but mlp={self.mlp!r}; the "
+                    f"expert axis needs mlp='moe'"
+                )
+            if moe and self.n_experts % mesh_ep != 0:
+                raise ValueError(
+                    f"n_experts ({self.n_experts}) must divide over the "
+                    f"expert axis ({mesh_ep})"
+                )
+
+        aux_loss = fill = None
         if self.mesh is None or self.mesh.shape.get(PIPE_AXIS, 1) == 1:
             # No pipe axis: run the stack sequentially (the n_stages=1
             # degenerate schedule) — same math, no manual region needed.
+            # With MoE, expert stacks may still be GSPMD-sharded over
+            # `expert` via param_specs; the dispatch einsums partition
+            # automatically (ep=1 math, compiler-inserted collectives).
             def body(xc, p):
-                return self._block(
+                res = self._block(
                     xc, p, seg=segment_ids, positions=positions
-                ), None
-
-            x, _ = lax.scan(body, x, blocks)
-        else:
-            if self.mesh.shape.get("expert", 1) != 1:
-                raise ValueError(
-                    f"PipelinedLM composes with data/pipe/model/seq axes "
-                    f"only; mesh has expert={self.mesh.shape['expert']}"
                 )
+                return (res[0], res[1]) if moe else (res, None)
+
+            x, auxs = lax.scan(body, x, blocks)
+            if moe:
+                aux_loss = auxs["aux"].sum()      # per-layer sow semantics
+                fill = auxs["fill"].mean()
+        else:
+            ep = self.mesh.shape.get(EXPERT_AXIS, 1)
             sp = self.mesh.shape.get(SEQ_AXIS, 1)
             if t % sp != 0:
                 raise ValueError(
@@ -183,10 +257,11 @@ class PipelinedLM(nn.Module):
             # Stage stacks over `pipe` on dim 0 + Megatron column/row TP
             # over `model` inside each stage (_TP_DIM; activations stay
             # replicated across model, each rank computing its head/feature
-            # slice with one psum per residual join in _block).
+            # slice with one psum per residual join in _block) + expert
+            # stacks over `expert` on their E dim.
+            specs = _stack_specs(tp > 1)
             stack_param_specs = {
-                k: P(PIPE_AXIS, *spec)
-                for k, spec in _stack_specs(tp > 1).items()
+                k: P(PIPE_AXIS, *specs[k]) for k in blocks
             }
 
             # Interleaved: L must split into S*v chunks, and the wrap
@@ -217,11 +292,15 @@ class PipelinedLM(nn.Module):
                     seg, pos = extra if extra is not None else (None, None)
 
                     def body(a, p):
-                        return self._block(
-                            a, p, tp=tp, sp=sp, seg=seg, positions=pos
-                        ), None
+                        res = self._block(
+                            a, p, tp=tp, sp=sp, ep=ep, seg=seg, positions=pos
+                        )
+                        return (res[0], res[1]) if moe else (res, None)
 
-                    a, _ = lax.scan(body, act, params)
+                    a, auxs = lax.scan(body, act, params)
+                    if moe:
+                        # This stage's layers, summed (per-layer sow adds).
+                        return a, jax.tree.map(lambda v: v.sum(0), auxs)
                     return a
 
                 if self.schedule == "interleaved":
@@ -231,20 +310,39 @@ class PipelinedLM(nn.Module):
                         ),
                         stage_params,
                     )
-                    return spmd_pipeline_interleaved(
-                        stage, chunked, xm, n_virtual=v_eff, extras=ex
+                    res = spmd_pipeline_interleaved(
+                        stage, chunked, xm, n_virtual=v_eff, extras=ex,
+                        with_aux=moe,
                     )
-                if self.schedule == "1f1b":
-                    return spmd_pipeline_1f1b(
-                        stage, stage_params, xm, extras=ex
+                elif self.schedule == "1f1b":
+                    res = spmd_pipeline_1f1b(
+                        stage, stage_params, xm, extras=ex, with_aux=moe
                     )
-                if ex is None:
-                    return spmd_pipeline(
-                        lambda act: stage(stage_params, act), xm
+                elif ex is None:
+                    res = spmd_pipeline(
+                        lambda act: stage(stage_params, act), xm,
+                        with_aux=moe,
                     )
-                return spmd_pipeline(
-                    lambda act, e: stage(stage_params, act, e), xm, extras=ex
+                else:
+                    res = spmd_pipeline(
+                        lambda act, e: stage(stage_params, act, e), xm,
+                        extras=ex, with_aux=moe,
+                    )
+                if not moe:
+                    return res
+                xm_out, aux = res
+                # Stages hold disjoint layers: SUM over pipe. Shards hold
+                # disjoint token groups: MEAN over data/fsdp/seq. Expert and
+                # model ranks computed routing identically (pre-slice), so
+                # the result is replicated over every mesh axis.
+                aux = jax.tree.map(
+                    lambda v: lax.pmean(
+                        lax.psum(v, PIPE_AXIS),
+                        (DATA_AXIS, FSDP_AXIS, SEQ_AXIS),
+                    ),
+                    aux,
                 )
+                return xm_out, aux
 
             extra_spec = P(None, BATCH_AXES, SEQ_AXIS)
             args = (blocks, x_micro)
@@ -252,20 +350,35 @@ class PipelinedLM(nn.Module):
             if extras is not None:
                 args += (extras,)
                 in_specs += ((extra_spec, extra_spec),)
-            x_micro = jax.shard_map(
+            out = jax.shard_map(
                 run,
                 mesh=self.mesh,
                 in_specs=in_specs,
-                out_specs=act_spec,
+                out_specs=(act_spec, P()) if moe else act_spec,
                 check_vma=False,
             )(*args)
+            if moe:
+                x_micro, aux_tree = out
+                aux_loss = aux_tree["aux"] / n_micro
+                fill = aux_tree["fill"] / (L * n_micro)
+            else:
+                x_micro = out
             x = x_micro.reshape(b, t, d)
+
+        if moe:
+            if train:
+                self.sow(
+                    "losses", "moe_load_balance",
+                    self.moe_aux_coef * aux_loss,
+                )
+            self.sow("metrics", "moe_drop_rate", 1.0 - fill)
 
         x = _layernorm(x, ln_f)
         logits = x.astype(jnp.float32) @ lm_head.astype(jnp.float32)
         return logits
 
-    def _block(self, x, p, tp: int = 1, sp: int = 1, seg=None, positions=None):
+    def _block(self, x, p, tp: int = 1, sp: int = 1, ep: int = 1,
+               seg=None, positions=None):
         """One pre-LN transformer block over a single layer's params.
 
         ``tp > 1`` = Megatron TP inside the (fully-manual) pipeline region:
@@ -318,29 +431,122 @@ class PipelinedLM(nn.Module):
         x = x + out
 
         hidden = _layernorm(x, p["ln2"])
+        if "moe_up" in p:
+            mixed, aux = self._moe_mlp(hidden, p, ep=ep, tp=tp)
+            return x + mixed, aux
         hidden = nn.gelu(hidden @ p["mlp_up"].astype(cd))
         down = hidden @ p["mlp_down"].astype(cd)
         if tp > 1:
             down = lax.psum(down, MODEL_AXIS)
         return x + down
 
+    def _moe_mlp(self, x, p, ep: int, tp: int):
+        """GShard dense-dispatch MoE over one layer's expert stacks.
+
+        Functional mirror of `models/moe.py` (same routing, capacity and
+        aux-loss math — see its docstring for the design rationale), written
+        for the pipeline's manual region: ``p['moe_up']/['moe_down']`` are
+        this expert-rank's ``[E/ep, d, 4d/tp-or-4d]`` slices (sharded by the
+        shard_map in_specs), routing runs identically on every rank from the
+        replicated f32 router, and each rank contracts only its experts'
+        columns of the dispatch/combine one-hots — the cross-rank combine is
+        ONE psum over (expert, model) per block. Returns ``(mixed [mb,T,d],
+        {'aux': load-balance loss (group mean), 'fill': kept-slot
+        fraction})``.
+        """
+        mb, t, d = x.shape
+        e, k = self.n_experts, self.moe_k
+        g = mb * t
+        n = self._n_groups(g)
+        s = g // n
+        capacity = max(1, int(k * s / e * self.capacity_factor))
+        cd = self.compute_dtype
+        tokens = x.reshape(n, s, d)
+
+        # --- routing (float32, replicated across expert/model ranks) ------
+        logits = tokens.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)  # [n, S, E]
+        top_probs, top_idx = lax.top_k(probs, k)
+        if k > 1:
+            # GShard renormalization over the chosen experts; NOT for k=1 —
+            # Switch gating uses the raw prob so the router stays coupled
+            # to the task loss.
+            top_probs = top_probs / (top_probs.sum(-1, keepdims=True) + 1e-9)
+
+        assign1 = jax.nn.one_hot(top_idx[..., 0], e)
+        frac = assign1.mean(1)
+        aux = (e * jnp.sum(frac * probs.mean(1), axis=-1)).mean()
+
+        # --- dispatch plan (cumsum slotting; overflow past capacity drops) -
+        choice = jnp.moveaxis(jax.nn.one_hot(top_idx, e), -2, 1)  # [n,k,S,E]
+        flat_choice = choice.reshape(n, k * s, e)
+        pos = jnp.cumsum(flat_choice, axis=1) * flat_choice - 1.0
+        pos = pos.reshape(n, k, s, e)
+        in_cap = (pos >= 0) & (pos < capacity)
+        slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        slot_oh = jax.nn.one_hot(slot, capacity) * in_cap[..., None]
+        fill = jnp.sum(slot_oh.astype(jnp.float32)) / float(n * k * s)
+        combine = jnp.einsum(
+            "nksec,nsk->nsec", slot_oh, top_probs.astype(jnp.float32)
+        )
+        dispatch = slot_oh.sum(1)  # [n, S, E, C]
+
+        # --- this rank's experts only ---------------------------------------
+        if ep > 1:
+            e_loc = e // ep
+            off = lax.axis_index(EXPERT_AXIS) * e_loc
+            dispatch = lax.dynamic_slice_in_dim(dispatch, off, e_loc, axis=2)
+            combine = lax.dynamic_slice_in_dim(combine, off, e_loc, axis=2)
+        expert_in = jnp.einsum(
+            "nsec,nsd->necd", dispatch.astype(cd), tokens.astype(cd)
+        )
+        h = nn.gelu(
+            jnp.einsum("necd,edh->nech", expert_in, p["moe_up"].astype(cd))
+        )
+        out = jnp.einsum("nech,ehd->necd", h, p["moe_down"].astype(cd))
+        mixed = jnp.einsum("nsec,necd->nsd", combine.astype(cd), out)
+        if ep > 1 or tp > 1:
+            axes = tuple(
+                ax for ax, live in
+                ((EXPERT_AXIS, ep > 1), (MODEL_AXIS, tp > 1)) if live
+            )
+            mixed = lax.psum(mixed, axes)
+        return (
+            mixed.reshape(mb, t, d).astype(x.dtype),
+            {"aux": aux, "fill": fill},
+        )
+
+    def _n_groups(self, g: int) -> int:
+        from horovod_tpu.models.moe import dispatch_group_count
+
+        return dispatch_group_count(g, self.moe_group_size)
+
 
 # Per-stack TP layout (dims AFTER the leading [n_layers] stack dim):
 # column-parallel kernels shard their OUTPUT dim over `model`, row-parallel
-# their INPUT dim; LayerNorm scales replicate.
+# their INPUT dim; LayerNorm scales replicate. Expert stacks [E, ...] shard
+# E over `expert` (their hidden dim over `model` when TP is live); the tiny
+# router replicates.
 _TP_DIM = {"qkv": 1, "mlp_up": 1, "attn_out": 0, "mlp_down": 0}
-_STACKED = ("ln1", "qkv", "attn_out", "ln2", "mlp_up", "mlp_down")
+_STACKED = (
+    "ln1", "qkv", "attn_out", "ln2", "mlp_up", "mlp_down",
+    "router", "moe_up", "moe_down",
+)
 
 
 def _stack_specs(tp: bool) -> dict:
-    """{name: trailing-dims spec tuple} for each per-layer stack."""
+    """{name: trailing-dims spec tuple} for every possible per-layer stack
+    (dense and MoE alike — callers index by the stacks they created)."""
     out = {}
-    for name in _STACKED:
+    for name in ("ln1", "qkv", "attn_out", "ln2", "mlp_up", "mlp_down"):
         ndim = 1 if name.startswith("ln") else 2
         spec = [None] * ndim
         if tp and name in _TP_DIM:
             spec[_TP_DIM[name]] = MODEL_AXIS
         out[name] = tuple(spec)
+    out["router"] = (None, None)
+    out["moe_up"] = (EXPERT_AXIS, None, MODEL_AXIS if tp else None)
+    out["moe_down"] = (EXPERT_AXIS, MODEL_AXIS if tp else None, None)
     return out
 
 

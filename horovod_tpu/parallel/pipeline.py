@@ -46,6 +46,7 @@ def spmd_pipeline(
     *,
     axis_name: str = PIPE_AXIS,
     extras=None,
+    with_aux: bool = False,
 ):
     """Run ``stage_fn`` as a GPipe pipeline over the ``axis_name`` mesh axis.
 
@@ -64,15 +65,25 @@ def spmd_pipeline(
         ppermute ring — every stage indexes the microbatch it is currently
         processing directly (replicated over pipe). Gradients do not flow
         into extras.
+      with_aux: when True, ``stage_fn`` returns ``(activation, aux)`` where
+        ``aux`` is a pytree of per-invocation scalars (e.g. a MoE router's
+        load-balance loss); the schedule sums it over this device's VALID
+        ticks (bubble ticks masked out) and the call returns ``(out,
+        aux_sums)``. The per-device sums cover this stage's layers on every
+        microbatch — callers psum over ``axis_name`` to total the stages.
+        Differentiable: gradients flow back into the stage on the same
+        ticks the values came from.
 
     Returns:
       ``[n_micro, mb, ...]`` outputs of the LAST stage, identical on every
-      pipe device (masked psum broadcast).
+      pipe device (masked psum broadcast); with ``with_aux``, a ``(out,
+      aux_sums)`` pair.
     """
-    out, _ = _run_schedule(
-        stage_fn, x_micro, axis_name, record_inputs=False, extras=extras
+    out, _, aux = _run_schedule(
+        stage_fn, x_micro, axis_name, record_inputs=False, extras=extras,
+        with_aux=with_aux,
     )
-    return out
+    return (out, aux) if with_aux else out
 
 
 def _micro_extra(extras, mc):
@@ -82,12 +93,22 @@ def _micro_extra(extras, mc):
     )
 
 
+def _aux_zeros(apply, state, extras, with_aux):
+    """Zeros matching the aux pytree ``apply`` returns (None when unused)."""
+    if not with_aux:
+        return None
+    args = (state,) if extras is None else (state, _micro_extra(extras, 0))
+    _, aux_sd = jax.eval_shape(apply, *args)
+    return jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), aux_sd)
+
+
 def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool,
-                  extras=None):
+                  extras=None, with_aux: bool = False):
     """The GPipe tick loop shared by `spmd_pipeline` (mechanical-AD backward)
     and `spmd_pipeline_1f1b`'s forward (which additionally records each
     microbatch's stage input — its activation stash). Returns
-    ``(last-stage outputs broadcast over pipe, saved-inputs-or-None)``."""
+    ``(last-stage outputs broadcast over pipe, saved-inputs-or-None,
+    aux-sums-or-None)``."""
     s = lax.axis_index(axis_name)
     n_stages = lax.psum(1, axis_name)
     n_micro = x_micro.shape[0]
@@ -96,10 +117,11 @@ def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool,
     state = jnp.zeros(x_micro.shape[1:], x_micro.dtype)  # incoming activation
     out_buf = jnp.zeros_like(x_micro)
     saved = jnp.zeros_like(x_micro) if record_inputs else None
+    aux_acc = _aux_zeros(apply, state, extras, with_aux)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def tick(carry, t):
-        state, out_buf, saved = carry
+        state, out_buf, saved, aux_acc = carry
         # Stage 0 feeds itself from the microbatch queue; later stages from
         # the activation handed over the ring. Clipped reads/writes keep
         # shapes static; bubble results are masked, never stored.
@@ -109,8 +131,8 @@ def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool,
         inp = jnp.where(s == 0, x_t, state)
         m = t - s  # the microbatch this stage processes at tick t
         mc = jnp.clip(m, 0, n_micro - 1)
+        valid = (m >= 0) & (m < n_micro)
         if saved is not None:
-            valid = (m >= 0) & (m < n_micro)
             cur_saved = lax.dynamic_index_in_dim(saved, mc, 0, keepdims=False)
             saved = lax.dynamic_update_index_in_dim(
                 saved, jnp.where(valid, inp, cur_saved), mc, 0
@@ -119,6 +141,12 @@ def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool,
             out = apply(inp)
         else:
             out = apply(inp, _micro_extra(extras, mc))
+        if with_aux:
+            out, aux = out
+            # Bubble ticks run on garbage registers; their aux never lands.
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + jnp.where(valid, a, 0.0), aux_acc, aux
+            )
 
         widx = t - (n_stages - 1)  # microbatch finishing at the last stage
         cidx = jnp.clip(widx, 0, n_micro - 1)
@@ -127,16 +155,16 @@ def _run_schedule(apply, x_micro, axis_name, *, record_inputs: bool,
             out_buf, jnp.where(widx >= 0, out, cur), cidx, 0
         )
         state = lax.ppermute(out, axis_name, perm)
-        return (state, out_buf, saved), None
+        return (state, out_buf, saved, aux_acc), None
 
-    (_, out_buf, saved), _ = lax.scan(
-        tick, (state, out_buf, saved), jnp.arange(ticks)
+    (_, out_buf, saved, aux_acc), _ = lax.scan(
+        tick, (state, out_buf, saved, aux_acc), jnp.arange(ticks)
     )
 
     # Only the last stage holds real outputs; broadcast them to every pipe
     # device so downstream (loss head) runs replicated over `pipe`.
     out = lax.psum(jnp.where(s == n_stages - 1, out_buf, 0.0), axis_name)
-    return out, saved
+    return out, saved, aux_acc
 
 
 def spmd_pipeline_1f1b(
@@ -146,6 +174,7 @@ def spmd_pipeline_1f1b(
     *,
     axis_name: str = PIPE_AXIS,
     extras=None,
+    with_aux: bool = False,
 ):
     """GPipe-tick forward + hand-scheduled staggered backward (the 1F1B
     memory discipline) as a `jax.custom_vjp`.
@@ -180,8 +209,8 @@ def spmd_pipeline_1f1b(
 
     @jax.custom_vjp
     def pipe(params, xm, ex):
-        out, _ = _fwd_impl(params, xm, ex)
-        return out
+        out, _, aux = _fwd_impl(params, xm, ex)
+        return (out, aux) if with_aux else out
 
     def _stage(params, a, extra):
         if extras is None:
@@ -191,21 +220,29 @@ def spmd_pipeline_1f1b(
     def _fwd_impl(params, xm, ex):
         if ex is None:
             return _run_schedule(
-                lambda a: stage_fn(params, a), xm, s_axis, record_inputs=True
+                lambda a: stage_fn(params, a), xm, s_axis,
+                record_inputs=True, with_aux=with_aux,
             )
         return _run_schedule(
             lambda a, e: stage_fn(params, a, e), xm, s_axis,
-            record_inputs=True, extras=ex,
+            record_inputs=True, extras=ex, with_aux=with_aux,
         )
 
     def fwd(params, xm, ex):
-        out, saved = _fwd_impl(params, xm, ex)
-        return out, (params, saved, ex)
+        out, saved, aux = _fwd_impl(params, xm, ex)
+        return ((out, aux) if with_aux else out), (params, saved, ex)
 
     def bwd(res, g):
         params, saved, ex = res
         s = lax.axis_index(s_axis)
         n_stages = lax.psum(1, s_axis)
+        # Aux sums are per-device (callers psum over pipe OUTSIDE this vjp,
+        # so that psum's own transpose already replicated g_aux here); each
+        # valid tick's stage re-vjp receives it alongside the activation
+        # cotangent.
+        g_aux = None
+        if with_aux:
+            g, g_aux = g
         # The forward tail is `psum(masked)`; its VJP is a psum of the
         # incoming cotangent over pipe (every device's output depended on
         # the last stage's buffer). The mechanical-AD GPipe path gets this
@@ -235,7 +272,13 @@ def spmd_pipeline_1f1b(
             _, vjp_fn = jax.vjp(
                 lambda p, a: _stage(p, a, extra), params, x_in
             )
-            dp, dx = vjp_fn(cot.astype(x_in.dtype))
+            if with_aux:
+                gaux_t = jax.tree.map(
+                    lambda v: jnp.where(valid, v, 0.0).astype(v.dtype), g_aux
+                )
+                dp, dx = vjp_fn((cot.astype(x_in.dtype), gaux_t))
+            else:
+                dp, dx = vjp_fn(cot.astype(x_in.dtype))
             dparams = jax.tree.map(
                 lambda acc, d: acc + jnp.where(valid, d.astype(jnp.float32), 0.0),
                 dparams, dp,
@@ -271,6 +314,7 @@ def spmd_pipeline_interleaved(
     n_virtual: int,
     axis_name: str = PIPE_AXIS,
     extras=None,
+    with_aux: bool = False,
 ):
     """Interleaved (virtual-stage) schedule: each device hosts ``n_virtual``
     non-adjacent model chunks, so the pipeline fill costs S-1 *chunk* times
@@ -324,9 +368,13 @@ def spmd_pipeline_interleaved(
     state = jnp.zeros(x_micro.shape[1:], x_micro.dtype)
     buf = jnp.zeros_like(x_micro)      # wrap waiting room, keyed by microbatch
     out_buf = jnp.zeros_like(x_micro)
+    chunk0 = jax.tree.map(lambda p: p[0], chunk_params)
+    aux_acc = _aux_zeros(
+        lambda *a: chunk_fn(chunk0, *a), state, extras, with_aux
+    )
 
     def tick(carry, t):
-        state, buf, out_buf = carry
+        state, buf, out_buf, aux_acc = carry
         # Stash the arriving activation under its sender's microbatch id:
         # sender (s-1 mod S) processed u' = (t-1) - sender at tick t-1.
         sender = (s - 1) % n_stages
@@ -357,6 +405,11 @@ def spmd_pipeline_interleaved(
             out = chunk_fn(chunk, inp)
         else:
             out = chunk_fn(chunk, inp, _micro_extra(extras, m))
+        if with_aux:
+            out, aux = out
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + jnp.where(valid, a, 0.0), aux_acc, aux
+            )
 
         # The last logical chunk (c = S·v - 1 lives on device S-1, round
         # v-1) finishes microbatch m here.
@@ -366,15 +419,15 @@ def spmd_pipeline_interleaved(
             out_buf, jnp.where(is_final, out, cur_out), m, 0
         )
         state = lax.ppermute(out, axis_name, perm)
-        return (state, buf, out_buf), None
+        return (state, buf, out_buf, aux_acc), None
 
-    (_, _, out_buf), _ = lax.scan(
-        tick, (state, buf, out_buf), jnp.arange(ticks)
+    (_, _, out_buf, aux_acc), _ = lax.scan(
+        tick, (state, buf, out_buf, aux_acc), jnp.arange(ticks)
     )
     out = lax.psum(
         jnp.where(s == n_stages - 1, out_buf, 0.0), axis_name
     )
-    return out
+    return (out, aux_acc) if with_aux else out
 
 
 def interleaved_layer_order(n_layers: int, n_stages: int,
@@ -390,6 +443,11 @@ def interleaved_layer_order(n_layers: int, n_stages: int,
     an interleaved config carry this order; `pipelined_lm.to_logical_order`
     / `to_interleaved_order` convert.
     """
+    if n_layers % (n_stages * n_virtual) != 0:
+        raise ValueError(
+            f"n_layers ({n_layers}) must divide into n_stages ({n_stages}) "
+            f"x n_virtual ({n_virtual}) chunks"
+        )
     lpc = n_layers // (n_stages * n_virtual)
     order = []
     for d in range(n_stages):
