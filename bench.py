@@ -53,6 +53,9 @@ def _lm_from_env(*, moe: bool = False):
         vocab_size=8192,
         d_model=int(os.environ.get("BENCH_DMODEL", 512)),
         n_heads=int(os.environ.get("BENCH_HEADS", 8)),
+        # Grouped-query attention: 0/unset = MHA. Decode's KV-cache stream
+        # shrinks by n_heads/n_kv_heads (the BENCH_MODEL=decode A/B knob).
+        n_kv_heads=int(os.environ.get("BENCH_KV_HEADS", 0)) or None,
         n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
         compute_dtype=jnp.bfloat16,
         dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
@@ -378,6 +381,7 @@ def bench_decode() -> dict:
         "value": round(tok_per_sec / n_chips, 1),
         "unit": "tokens/sec/chip",
         "batch": batch,
+        "n_kv_heads": model.n_kv_heads or model.n_heads,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "ms_per_token": round(elapsed / new_tokens * 1e3, 4),

@@ -17,8 +17,9 @@ first-class the TPU way:
 * **static shapes** — the cache is sized `prompt_len + max_new_tokens` up
   front; early stop on ``eos_id`` is a masked fill, not a dynamic shape.
 
-Sampling: greedy (``temperature=0``), temperature, and top-k — all inside
-the scan via `jax.random.categorical` with a split-per-step key.
+Sampling: greedy (``temperature=0``), temperature, top-k and top-p
+(nucleus) — all inside the scan via `jax.random.categorical` with a
+split-per-step key.
 
 MoE caveat: expert capacity is enforced per *call* group, so a decode step
 routes only that step's tokens while a teacher-forced forward routes every
@@ -37,19 +38,34 @@ from jax import lax
 _NEG = -1e30
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
+def _sample(logits, rng, temperature: float, top_k: int, top_p: float = 0.0):
     """One next-token draw from [B, vocab] logits (f32 math)."""
+    if not 0.0 <= top_p <= 1.0:
+        # top_p < 0 would make the nucleus empty and the clamped kth index
+        # wrap to the minimum logit — silently UNfiltered sampling.
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     if top_k:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, _NEG, logits)
+    if top_p:
+        # Nucleus: keep the smallest prefix of descending-prob tokens whose
+        # EXCLUSIVE cumulative mass is < top_p (so the top token always
+        # survives), then sample the renormalized rest via categorical.
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        exclusive = jnp.cumsum(probs, axis=-1) - probs
+        n_keep = jnp.sum(exclusive < top_p, axis=-1, keepdims=True)
+        kth = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < kth, _NEG, logits)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
 def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
-                     top_k: int = 0, eos_id: int | None = None,
+                     top_k: int = 0, top_p: float = 0.0,
+                     eos_id: int | None = None,
                      include_prompt: bool = True):
     """Build the compiled generator: ``(params, prompt, rng) -> tokens``.
 
@@ -74,7 +90,7 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
         # index) and threaded through the scan as plain pytree state.
         logits, vars_ = dmodel.apply({"params": params}, prompt, mutable=["cache"])
         rng, sub = jax.random.split(rng)
-        tok = _sample(logits[:, -1], sub, temperature, top_k)
+        tok = _sample(logits[:, -1], sub, temperature, top_k, top_p)
         done = (
             jnp.zeros((b,), bool) if eos_id is None else tok == eos_id
         )
@@ -87,7 +103,7 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
                 mutable=["cache"],
             )
             rng, sub = jax.random.split(rng)
-            nxt = _sample(step_logits[:, -1], sub, temperature, top_k)
+            nxt = _sample(step_logits[:, -1], sub, temperature, top_k, top_p)
             nxt = jnp.where(done, fill, nxt)
             new_done = done if eos_id is None else done | (nxt == eos_id)
             return (step_vars["cache"], nxt, rng, new_done), nxt
@@ -103,7 +119,7 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
 
 
 def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
-             temperature: float = 0.0, top_k: int = 0,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              eos_id: int | None = None, include_prompt: bool = True):
     """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, T0] ints).
 
@@ -113,7 +129,8 @@ def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
     """
     fn = make_generate_fn(
         model, max_new_tokens=max_new_tokens, temperature=temperature,
-        top_k=top_k, eos_id=eos_id, include_prompt=include_prompt,
+        top_k=top_k, top_p=top_p, eos_id=eos_id,
+        include_prompt=include_prompt,
     )
     if rng is None:
         rng = jax.random.PRNGKey(0)

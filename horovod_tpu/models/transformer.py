@@ -110,6 +110,14 @@ class Block(nn.Module):
     dropout: float
     compute_dtype: jnp.dtype
     sharding: ShardingConfig
+    # Grouped-query attention (GQA, arXiv:2305.13245): n_kv_heads < n_heads
+    # shares each K/V head across n_heads/n_kv_heads query heads. Training
+    # repeats K/V up to H after projection (the FLOPs are identical; the
+    # win is the decode cache at [B, L, H_kv, D] — 1/group of the MHA
+    # bytes streamed per generated token, which is what bandwidth-bound
+    # decode pays for). None = MHA (the fused qkv projection, param-layout
+    # compatible with existing checkpoints).
+    n_kv_heads: int | None = None
     # MoE (expert-parallel) MLP instead of the dense one: the EP capability,
     # routed over the mesh's `expert` axis (models/moe.py).
     use_moe: bool = False
@@ -118,7 +126,8 @@ class Block(nn.Module):
     capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
     # Autoregressive inference (models/decoding.py): K/V for past tokens live
-    # in a ``cache`` variable collection sized [B, max_decode_len, H, D].
+    # in a ``cache`` variable collection sized [B, max_decode_len, H_kv, D]
+    # (H_kv == n_kv_heads, == H for MHA).
     decode: bool = False
     max_decode_len: int = 0
 
@@ -133,11 +142,23 @@ class Block(nn.Module):
 
         # --- attention -----------------------------------------------------
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
-        qkv_shape = (self.n_heads, 3 * head_dim)
+        h_kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % h_kv != 0:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must be a multiple of "
+                f"n_kv_heads ({h_kv})"
+            )
+        rep = self.n_heads // h_kv
         # Explicit names: param_specs keys its TP rules on them, so layer
         # additions/reorderings can't silently re-shard the wrong kernel.
-        qkv = dense(features=qkv_shape, name="qkv")(h)  # [B,T,H,3D] — column-parallel
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if rep == 1:
+            qkv_shape = (self.n_heads, 3 * head_dim)
+            qkv = dense(features=qkv_shape, name="qkv")(h)  # [B,T,H,3D] — column-parallel
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = dense(features=(self.n_heads, head_dim), name="q_proj")(h)
+            kv = dense(features=(h_kv, 2 * head_dim), name="kv_proj")(h)
+            k, v = jnp.split(kv, 2, axis=-1)  # [B, T, H_kv, D]
         q, k = _rope(q, positions), _rope(k, positions)
 
         if cfg.mesh is not None:
@@ -147,6 +168,12 @@ class Block(nn.Module):
                     f"n_heads ({self.n_heads}) must divide over the model "
                     f"axis ({model_par}) for sharded attention"
                 )
+            if h_kv % model_par != 0:
+                raise ValueError(
+                    f"n_kv_heads ({h_kv}) must divide over the model axis "
+                    f"({model_par}) — the kv projection and decode cache "
+                    f"shard their head dim"
+                )
 
         if self.decode:
             out = self._decode_attention(q, k, v, decode_index)
@@ -155,6 +182,15 @@ class Block(nn.Module):
             h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
             h = self._mlp(h, dense, train=False)
             return x + h
+
+        if rep > 1:
+            # Training/prefill attention runs at full H: repeating K/V heads
+            # keeps q-head i paired with kv-head i // rep under any TP
+            # sharding (contiguous H/tp slices of the repeated layout align
+            # with the q slices). The repeat is XLA-fused into the attention
+            # consumers; the cache (decode path above) never stores it.
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
         if cfg.seq_parallel:
             impls = {
@@ -256,9 +292,11 @@ class Block(nn.Module):
     def _decode_attention(self, q, k, v, decode_index):
         """KV-cache attention for autoregressive inference.
 
-        The cache holds every past token's K/V ([B, max_decode_len, H, D],
-        heads sharded over ``model`` on a TP mesh — the same Megatron split
-        as training, so decode reuses the training shardings untouched).
+        The cache holds every past token's K/V ([B, max_decode_len, H_kv,
+        D] — n_kv_heads, not H: under GQA it stores only the projected kv
+        heads — sharded over ``model`` on a TP mesh, the same Megatron
+        split as training, so decode reuses the training shardings
+        untouched).
         Two static shapes arrive here:
 
         * **prefill** (T > 1, ``decode_index == 0``): the prompt's K/V are
@@ -271,6 +309,8 @@ class Block(nn.Module):
         """
         cfg = self.sharding
         b, t, h, d = q.shape
+        h_kv = k.shape[2]  # < h under GQA: the cache stays at H_kv heads
+        rep = h // h_kv
         if self.max_decode_len < t:
             raise ValueError(
                 f"max_decode_len ({self.max_decode_len}) < input length ({t})"
@@ -288,7 +328,7 @@ class Block(nn.Module):
                 "cache; after it, feed one token at a time"
             )
         zeros = lambda: jnp.zeros(  # noqa: E731
-            (b, self.max_decode_len, h, d), self.compute_dtype
+            (b, self.max_decode_len, h_kv, d), self.compute_dtype
         )
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
@@ -313,6 +353,9 @@ class Block(nn.Module):
             # auto-partition the Mosaic custom call).
             from horovod_tpu.ops.flash_attention import flash_attention
 
+            if rep > 1:  # prefill attends at full H, like training
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
             local = functools.partial(flash_attention, causal=True)
             if cfg.mesh is not None and cfg.mesh.size > 1:
                 spec = P(BATCH_AXES, None, MODEL_AXIS, None)
@@ -322,20 +365,25 @@ class Block(nn.Module):
                 )
             return local(q, k, v)
         # Single-step decode: q [B,1,H,D] against the cache prefix [0..idx].
+        # Grouped einsum (g query heads share each cached kv head) so the
+        # cache streams ONCE per kv head — never materializing a repeated
+        # [B, L, H, D] copy, which would forfeit GQA's bandwidth saving.
         scale = d ** -0.5
+        q5 = q.reshape(b, t, h_kv, rep, d)
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, ck.value, preferred_element_type=jnp.float32
+            "bqhgd,bkhd->bhgqk", q5, ck.value,
+            preferred_element_type=jnp.float32,
         ) * scale
         valid = (
             jnp.arange(self.max_decode_len, dtype=jnp.int32) <= idx
-        )[None, None, None, :]
+        )[None, None, None, None, :]
         s = jnp.where(valid, s, attention_ops._BIG_NEG)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(cv.value.dtype), cv.value,
+            "bhgqk,bkhd->bqhgd", p.astype(cv.value.dtype), cv.value,
             preferred_element_type=jnp.float32,
         )
-        return out.astype(q.dtype)
+        return out.reshape(b, t, h, d).astype(q.dtype)
 
 
 class TransformerLM(nn.Module):
@@ -344,6 +392,11 @@ class TransformerLM(nn.Module):
     vocab_size: int = 256
     d_model: int = 256
     n_heads: int = 8
+    # Grouped-query attention: K/V projected to n_kv_heads < n_heads (each
+    # shared by n_heads/n_kv_heads query heads). Shrinks the decode cache —
+    # and the bytes streamed per generated token — by that group factor;
+    # training FLOPs are unchanged. None = MHA (fused qkv projection).
+    n_kv_heads: int | None = None
     n_layers: int = 4
     dropout: float = 0.1
     compute_dtype: jnp.dtype = jnp.float32
@@ -365,7 +418,7 @@ class TransformerLM(nn.Module):
     capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
     # Autoregressive inference (models/decoding.py `generate`): per-block K/V
-    # caches sized [B, max_decode_len, H, D] in the ``cache`` collection; the
+    # caches sized [B, max_decode_len, H_kv, D] in the ``cache`` collection; the
     # top-level ``cache/index`` counts consumed positions. T>1 = prefill,
     # T==1 = one decode step.
     decode: bool = False
@@ -409,6 +462,7 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 self.d_model, self.n_heads, self.dropout,
                 self.compute_dtype, cfg,
+                n_kv_heads=self.n_kv_heads,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts,
                 moe_k=self.moe_k,
@@ -451,6 +505,8 @@ def param_specs(params, mesh: Mesh) -> dict:
     # flax auto-numbering shifts when layers are added or reordered.
     tp_dim = {
         "qkv": 1,        # [dm, H, 3·hd] — heads (column-parallel)
+        "q_proj": 1,     # [dm, H, hd]   — heads (column-parallel, GQA)
+        "kv_proj": 1,    # [dm, H_kv, 2·hd] — kv heads (column-parallel, GQA)
         "attn_out": 0,   # [H, hd, dm]  — heads (row-parallel)
         "mlp_up": 1,     # [dm, 4·dm]   — features (column-parallel)
         "mlp_down": 0,   # [4·dm, dm]   — inputs (row-parallel)

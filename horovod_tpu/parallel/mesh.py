@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 
 import jax
 import numpy as np
@@ -89,18 +90,64 @@ class MeshSpec:
         return sizes
 
 
+def _device_array(devices: np.ndarray, shape: tuple, order: str | None = None):
+    """Physical device layout for the mesh.
+
+    ``order='auto'`` (default, or ``HVT_MESH_ORDER`` env): on multi-chip TPU,
+    delegate to `jax.experimental.mesh_utils.create_device_mesh`, which maps
+    mesh axes onto the physical ICI torus (rings for each axis ride actual
+    links instead of the arbitrary enumeration order a flat reshape gives —
+    on a pod slice, reshape-order neighbors can be several hops apart, and
+    every ppermute/psum pays that distance). Falls back to the flat reshape
+    when the topology solver rejects the shape, on CPU/virtual devices
+    (where "distance" is meaningless and tests rely on enumeration order),
+    or with ``order='flat'``.
+    """
+    order = order or os.environ.get("HVT_MESH_ORDER", "auto")
+    if order not in ("auto", "flat"):
+        raise ValueError(
+            f"HVT_MESH_ORDER must be 'auto' or 'flat', got {order!r}"
+        )
+    if (
+        order == "auto"
+        and devices.size > 1
+        and getattr(devices.flat[0], "platform", "") == "tpu"
+    ):
+        from jax.experimental import mesh_utils
+
+        try:
+            return np.asarray(
+                mesh_utils.create_device_mesh(
+                    shape, devices=list(devices.flat)
+                )
+            )
+        except (ValueError, NotImplementedError, AssertionError) as e:
+            import warnings
+
+            # Flat order is always *valid*; it is just potentially slow —
+            # say so, or a pod silently pays multi-hop ICI on every ring.
+            warnings.warn(
+                f"ICI-topology-aware mesh layout failed for shape {shape} "
+                f"({e}); falling back to enumeration order — collective "
+                f"rings may span multi-hop ICI paths",
+                stacklevel=3,
+            )
+    return devices.reshape(shape)
+
+
 def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
     """Build a Mesh over ``devices`` (default: all) per ``spec``.
 
     Axis order is the canonical AXES order; size-1 axes are kept so sharding
     rules can always name them — XLA elides trivial collectives, so unused
-    axes are free.
+    axes are free. On multi-chip TPU the physical layout is ICI-topology-
+    aware (see `_device_array`).
     """
     spec = spec or MeshSpec()
     devices = np.asarray(devices if devices is not None else jax.devices())
     sizes = spec.resolve(devices.size)
     shape = tuple(sizes[ax] for ax in AXES)
-    return Mesh(devices.reshape(shape), AXES)
+    return Mesh(_device_array(devices, shape), AXES)
 
 
 def data_parallel_mesh(devices=None) -> Mesh:

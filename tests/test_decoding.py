@@ -191,3 +191,79 @@ class TestSharded:
                 {"params": params}, jnp.zeros((1, 2), jnp.int32),
                 mutable=["cache"],
             )
+
+
+class TestTopP:
+    """Nucleus sampling: the kept set is the smallest descending-prob
+    prefix whose exclusive cumulative mass is < top_p (top token always
+    survives)."""
+
+    def test_support_is_the_nucleus(self):
+        from horovod_tpu.models.decoding import _sample
+
+        # probs [0.5, 0.3, 0.15, 0.05] -> top_p=0.6 keeps exactly {0, 1}
+        # (exclusive cumsums 0.0, 0.5, 0.8, 0.95).
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]], jnp.float32))
+        draws = jax.vmap(
+            lambda k: _sample(logits, k, 1.0, 0, 0.6)[0]
+        )(jax.random.split(jax.random.PRNGKey(0), 256))
+        support = set(np.asarray(draws).tolist())
+        assert support == {0, 1}
+
+    def test_tiny_top_p_is_greedy(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[5, 6, 7]], np.int32)
+        greedy = generate(model, params, prompt, 8)
+        p_tiny = generate(
+            model, params, prompt, 8, temperature=0.9, top_p=1e-6,
+            rng=jax.random.PRNGKey(3),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))
+
+    def test_composes_with_top_k_in_vocab(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[0, 1], [2, 3]], np.int32)
+        out = np.asarray(generate(
+            model, params, prompt, 12, temperature=1.2, top_k=8, top_p=0.9,
+            rng=jax.random.PRNGKey(4),
+        ))
+        assert out.min() >= 0 and out.max() < VOCAB
+
+
+class TestGQADecode:
+    """GQA decode: the cache stores n_kv_heads (< n_heads) — the bytes
+    streamed per token shrink by the group factor — and the grouped-einsum
+    decode step must still equal a full teacher-forced recompute."""
+
+    def test_cache_decode_equals_full_recompute(self):
+        model = _model(n_kv_heads=2)
+        params = _params(model)
+        prompt = np.array([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+        want = _greedy_no_cache(model, params, prompt, 12)
+        got = generate(model, params, prompt, 12)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_cache_holds_kv_heads_only(self):
+        model = _model(n_kv_heads=2)
+        params = _params(model)
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        dmodel = model.clone(decode=True, max_decode_len=8)
+        _, var = dmodel.apply({"params": params}, prompt, mutable=["cache"])
+        k = var["cache"]["Block_0"]["k"]
+        assert k.shape == (2, 8, 2, 8)  # [B, L, H_kv, hd], not H=4
+
+    def test_tp_mesh_matches_single_device(self):
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=1, model=2), devices=jax.devices()[:2]
+        )
+        plain = _model(n_kv_heads=2)
+        params = _params(plain)
+        prompt = np.array([[7, 8, 9, 1]], np.int32)
+        want = generate(plain, params, prompt, 10)
+        sharded = _model(
+            n_kv_heads=2, sharding=ShardingConfig(mesh=mesh, attn="flash")
+        )
+        got = generate(sharded, params, prompt, 10)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

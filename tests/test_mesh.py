@@ -1,5 +1,7 @@
 """Mesh construction and world-size-reactive scaling helpers (SURVEY.md §5.6)."""
 
+import jax
+import numpy as np
 import pytest
 
 import horovod_tpu as hvt
@@ -44,3 +46,75 @@ def test_scaling_helpers_match_reference_idioms():
     assert hvt.shard_epochs(12, 1) == 12
     # defaults react to the ambient world (8 fake chips)
     assert hvt.scale_lr(1.0) == 8.0
+
+
+class TestDeviceLayout:
+    """ICI-topology-aware device layout (mesh._device_array): multi-chip
+    TPU delegates to mesh_utils.create_device_mesh so mesh-axis rings ride
+    physical links; CPU/virtual devices and HVT_MESH_ORDER=flat keep the
+    deterministic enumeration-order reshape the tests (and multi-process
+    bit-parity) rely on."""
+
+    class _FakeTpu:
+        platform = "tpu"
+
+        def __init__(self, i):
+            self.id = i
+
+    def test_cpu_devices_use_flat_reshape(self):
+        from horovod_tpu.parallel.mesh import _device_array
+
+        devs = np.asarray(jax.devices())
+        shape = (2, 1, 2, 1, 2, 1)
+        out = _device_array(devs, shape)
+        assert [d.id for d in out.flat] == [d.id for d in devs.flat]
+
+    def test_tpu_devices_route_through_mesh_utils(self, monkeypatch):
+        from jax.experimental import mesh_utils
+
+        from horovod_tpu.parallel.mesh import _device_array
+
+        calls = {}
+
+        def fake_create(shape, devices=None, **kw):
+            calls["shape"] = tuple(shape)
+            calls["n"] = len(devices)
+            return np.asarray(devices).reshape(shape)[::-1]  # any permutation
+
+        monkeypatch.setattr(mesh_utils, "create_device_mesh", fake_create)
+        devs = np.asarray([self._FakeTpu(i) for i in range(8)])
+        out = _device_array(devs, (8,))
+        assert calls == {"shape": (8,), "n": 8}
+        assert [d.id for d in out.flat] == list(reversed(range(8)))
+
+    def test_flat_override_skips_mesh_utils(self, monkeypatch):
+        from jax.experimental import mesh_utils
+
+        from horovod_tpu.parallel.mesh import _device_array
+
+        def boom(*a, **kw):
+            raise AssertionError("must not be called with order='flat'")
+
+        monkeypatch.setattr(mesh_utils, "create_device_mesh", boom)
+        devs = np.asarray([self._FakeTpu(i) for i in range(8)])
+        out = _device_array(devs, (2, 4), order="flat")
+        assert [d.id for d in out.flat] == list(range(8))
+
+    def test_solver_rejection_falls_back_to_flat(self, monkeypatch):
+        from jax.experimental import mesh_utils
+
+        from horovod_tpu.parallel.mesh import _device_array
+
+        def reject(*a, **kw):
+            raise ValueError("no assignment for this topology")
+
+        monkeypatch.setattr(mesh_utils, "create_device_mesh", reject)
+        devs = np.asarray([self._FakeTpu(i) for i in range(6)])
+        out = _device_array(devs, (6,))
+        assert [d.id for d in out.flat] == list(range(6))
+
+    def test_bad_order_rejected(self):
+        from horovod_tpu.parallel.mesh import _device_array
+
+        with pytest.raises(ValueError, match="HVT_MESH_ORDER"):
+            _device_array(np.asarray(jax.devices()), (8,), order="torus")
