@@ -1,0 +1,188 @@
+"""Speculative decoding: draft cheap token chunks, verify with ONE target
+chunk-forward, accept the matching prefix — exact target-greedy output.
+
+The reference has no inference stack at all (its serving story ends at a
+SavedModel export, mnist_keras.py:126-140); `models/decoding.py` gives this
+framework per-token KV-cache generation, and this module removes that
+loop's fundamental limit: a decode step is a bandwidth-bound matvec, so
+tokens/sec is capped by how fast weights stream — UNLESS several positions
+are verified per weight pass. Speculative decoding (Leviathan et al.,
+arXiv:2211.17192) does exactly that, and it is a natural fit for the
+TPU/XLA model:
+
+* **the whole loop is one jitted `lax.while_loop`** — draft, verify
+  chunk-forward (the KV cache's chunk-extension path,
+  transformer.Block._decode_attention), acceptance, cache-index rollback —
+  with fully static shapes: one host dispatch per generation;
+* **verification rides the MXU**: a γ-token chunk forward has the same
+  weight traffic as ONE decode step but γ positions of compute — accepted
+  tokens are bandwidth-free;
+* **exactness by construction**: greedy acceptance keeps a drafted token
+  only while it equals the target's own argmax, so the output is
+  bit-identical to plain greedy decoding whatever the draft quality —
+  drafts change the speed, never the result. (Batch rows accept different
+  prefix lengths; the shared cache index advances by the row-minimum, so
+  extra row matches are simply re-derived next round — still exact.)
+
+The built-in draft is **prompt-lookup** (n-gram continuation: propose the
+tokens that followed the most recent earlier occurrence of the current
+n-gram suffix — "prompt lookup decoding", a draft-model-free scheme that
+excels on self-repetitive text: code, summarization-with-quotes, copy
+structure). A custom ``draft_fn(buf [B, Tmax], cur_len, n_draft) ->
+[B, n_draft]`` can be supplied — e.g. a small trained LM — with the same
+exactness guarantee.
+
+Restrictions: greedy only (``eos_id`` unsupported — use
+`decoding.generate` for sampled or eos-terminated generation), and dense
+models only: MoE expert capacity is enforced per call group, so a
+γ-token verify forward can route differently than the single-token steps
+it replaces and the exactness contract would silently break
+(`decoding.py`'s MoE caveat, made binding here) — rejected loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ngram_draft_fn(*, ngram: int = 3) -> Callable:
+    """Prompt-lookup draft: continue the most recent earlier occurrence of
+    the current ``ngram``-token suffix.
+
+    Returns ``draft_fn(buf [B, Tmax], cur_len, gamma) -> [B, gamma]``
+    proposals. When no earlier occurrence exists a row falls back to
+    repeating its last token — drafts are free to be wrong; verification
+    discards mismatches.
+    """
+
+    def draft_fn(buf, cur_len, n_draft: int):
+        b, tmax = buf.shape
+        # Suffix = the last `ngram` finalized tokens (dynamic_slice clamps
+        # the start when cur_len < ngram — the garbage suffix just drafts
+        # badly, which verification absorbs).
+        suffix = lax.dynamic_slice(
+            buf, (jnp.int32(0), cur_len - ngram), (b, ngram)
+        )  # [B, ngram]
+        n_windows = tmax - ngram
+        win_idx = (
+            jnp.arange(n_windows, dtype=jnp.int32)[:, None]
+            + jnp.arange(ngram, dtype=jnp.int32)[None, :]
+        )  # [S, ngram]
+        windows = buf[:, win_idx]  # [B, S, ngram]
+        starts = jnp.arange(n_windows, dtype=jnp.int32)
+        # An *earlier* occurrence: the window must end before the suffix
+        # starts (also excludes matching the suffix against itself).
+        eq = jnp.all(windows == suffix[:, None, :], axis=-1) & (
+            starts[None, :] < cur_len - ngram
+        )
+        s_star = jnp.max(
+            jnp.where(eq, starts[None, :], -1), axis=1
+        )  # [B] latest match, -1 = none
+        has = s_star >= 0
+        follow = jnp.clip(
+            s_star[:, None] + ngram + jnp.arange(n_draft, dtype=jnp.int32),
+            0, tmax - 1,
+        )
+        draft = jnp.take_along_axis(buf, follow, axis=1)  # [B, n_draft]
+        last = jnp.take_along_axis(buf, (cur_len - 1)[None, None].repeat(b, 0), 1)
+        return jnp.where(has[:, None], draft, last)
+
+    return draft_fn
+
+
+def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
+                        draft_fn: Callable | None = None,
+                        include_prompt: bool = True,
+                        return_stats: bool = False):
+    """Build the compiled speculative generator: ``(params, prompt) ->
+    tokens`` (greedy; bit-identical to `decoding.generate`'s greedy path).
+
+    ``gamma`` = tokens verified per target pass (1 known-exact token + γ-1
+    drafts): per round the target streams its weights once and commits
+    between 1 and γ tokens. ``return_stats`` appends a dict with
+    ``rounds`` and ``tokens`` (accepted-per-round = tokens/rounds; plain
+    decoding would use ``tokens`` rounds).
+    """
+    if gamma < 2:
+        raise ValueError("gamma must be >= 2 (1 exact token + >=1 draft)")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if getattr(model, "moe_every", 0):
+        raise ValueError(
+            "speculative decoding requires a dense model: MoE expert "
+            "capacity binds per call group, so a chunked verify forward "
+            "can legitimately route (and decode) differently than the "
+            "per-token steps it replaces — the exact-output contract "
+            "cannot hold; use decoding.generate for MoE models"
+        )
+    draft = draft_fn or ngram_draft_fn()
+
+    def run(params, prompt):
+        prompt = prompt.astype(jnp.int32)
+        b, t0 = prompt.shape
+        tmax = t0 + max_new_tokens + gamma  # chunk-overhang headroom
+        dmodel = model.clone(
+            decode=True, max_decode_len=tmax, dropout=0.0, remat=False,
+        )
+        logits, vars_ = dmodel.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        buf = jnp.zeros((b, tmax), jnp.int32)
+        buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+        def cond(carry):
+            _, _, n_gen, _, _, _ = carry
+            return n_gen < max_new_tokens
+
+        def body(carry):
+            buf, cur_len, n_gen, cache, next_tok, rounds = carry
+            # next_tok is already the target's exact output — commit it,
+            # then draft continuations for verification.
+            buf = lax.dynamic_update_slice(
+                buf, next_tok[:, None], (0, cur_len)
+            )
+            proposals = draft(buf, cur_len + 1, gamma - 1)
+            chunk = jnp.concatenate([next_tok[:, None], proposals], axis=1)
+            logits_c, new_vars = dmodel.apply(
+                {"params": params, "cache": cache}, chunk, mutable=["cache"]
+            )
+            a = jnp.argmax(logits_c, axis=-1).astype(jnp.int32)  # [B, gamma]
+            # chunk[:, j] (j >= 1) is correct iff it equals the target's
+            # argmax after chunk[:, :j]; accept the matching prefix.
+            match = (chunk[:, 1:] == a[:, :-1]).astype(jnp.int32)
+            m_row = 1 + jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            m = jnp.min(m_row)  # shared cache index ⇒ lockstep advance
+            # Commit accepted drafts (positions cur_len+1 .. cur_len+m-1):
+            # write the whole tail, then let positions >= cur_len+m be
+            # overwritten by later rounds — simpler than a dynamic-length
+            # write, and the [cur_len+m, ...) region is dead until then.
+            buf = lax.dynamic_update_slice(
+                buf, chunk[:, 1:], (0, cur_len + 1)
+            )
+            next_tok = jnp.take_along_axis(a, (m - 1)[None, None].repeat(b, 0), 1)[:, 0]
+            # Roll the cache back to the committed prefix: stale K/V above
+            # it are masked out by the attention's index test and will be
+            # overwritten by the next chunk write at exactly this index.
+            cache = dict(new_vars["cache"])
+            cache["index"] = cur_len + m
+            return (buf, cur_len + m, n_gen + m, cache, next_tok, rounds + 1)
+
+        carry = (
+            buf, jnp.int32(t0), jnp.int32(0), dict(vars_["cache"]),
+            next_tok, jnp.int32(0),
+        )
+        buf, cur_len, n_gen, _, _, rounds = lax.while_loop(cond, body, carry)
+        out = lax.dynamic_slice(
+            buf, (0, 0 if include_prompt else t0),
+            (b, (t0 if include_prompt else 0) + max_new_tokens),
+        )
+        if return_stats:
+            return out, {"rounds": rounds, "tokens": n_gen}
+        return out
+
+    return jax.jit(run)

@@ -299,13 +299,18 @@ class Block(nn.Module):
         untouched).
         Two static shapes arrive here:
 
-        * **prefill** (T > 1, ``decode_index == 0``): the prompt's K/V are
+        * **prefill** (T > 1 on a fresh cache): the prompt's K/V are
           written at [0:T] and attention runs causally over the prompt alone
           — exactly the training forward, so the flash kernel applies and no
           [T, max_decode_len] scores are built;
         * **decode step** (T == 1): the new token's K/V land at
           ``decode_index`` and its query attends densely over the valid
-          cache prefix — a matvec per head, bandwidth-bound by design.
+          cache prefix — a matvec per head, bandwidth-bound by design;
+        * **chunk extension** (T > 1 on a warm cache): T fresh tokens land
+          at ``decode_index`` and attend over the prefix plus themselves
+          (causal within the chunk) — chunked long-prompt prefill with
+          [T, L]-bounded scores, and the verify pass of speculative
+          decoding (models/speculative.py).
         """
         cfg = self.sharding
         b, t, h, d = q.shape
@@ -317,16 +322,6 @@ class Block(nn.Module):
             )
         cache_spec = P(BATCH_AXES, None, MODEL_AXIS, None)
         first_call = not self.has_variable("cache", "k")
-        if t > 1 and not first_call:
-            # Statically decidable: the cache collection exists, so a prior
-            # prefill already advanced the index — a T>1 call here would
-            # attend only among the fresh tokens and silently ignore the
-            # cached prefix. Chunked prompt extension is not supported;
-            # feed the full prompt in one apply (then T==1 steps).
-            raise ValueError(
-                "decode-mode prefill must be the first call on a fresh "
-                "cache; after it, feed one token at a time"
-            )
         zeros = lambda: jnp.zeros(  # noqa: E731
             (b, self.max_decode_len, h_kv, d), self.compute_dtype
         )
@@ -345,7 +340,7 @@ class Block(nn.Module):
             ),
             cache_spec,
         )
-        if t > 1:
+        if t > 1 and first_call:
             # Prefill: the cache was empty below `idx` (generate() starts at
             # 0), so causal attention over the fresh K/V is the full answer —
             # the training forward's local flash path (O(T) memory), with the
@@ -364,19 +359,24 @@ class Block(nn.Module):
                     out_specs=spec, check_vma=False,
                 )
             return local(q, k, v)
-        # Single-step decode: q [B,1,H,D] against the cache prefix [0..idx].
-        # Grouped einsum (g query heads share each cached kv head) so the
-        # cache streams ONCE per kv head — never materializing a repeated
-        # [B, L, H, D] copy, which would forfeit GQA's bandwidth saving.
+        # Decode step (t == 1) or chunk extension (t > 1 on a warm cache —
+        # chunked long-prompt prefill, and speculative decoding's verify
+        # pass): the t fresh queries attend over the cache prefix
+        # [0 .. idx + row], causal within the chunk. Scores are [t, L] per
+        # head — chunking is exactly what bounds that memory for long
+        # prompts. Grouped einsum (g query heads share each cached kv head)
+        # so the cache streams ONCE per kv head — never materializing a
+        # repeated [B, L, H, D] copy, which would forfeit GQA's bandwidth
+        # saving.
         scale = d ** -0.5
         q5 = q.reshape(b, t, h_kv, rep, d)
         s = jnp.einsum(
             "bqhgd,bkhd->bhgqk", q5, ck.value,
             preferred_element_type=jnp.float32,
         ) * scale
-        valid = (
-            jnp.arange(self.max_decode_len, dtype=jnp.int32) <= idx
-        )[None, None, None, None, :]
+        kpos = jnp.arange(self.max_decode_len, dtype=jnp.int32)
+        qpos = idx + jnp.arange(t, dtype=jnp.int32)
+        valid = (kpos[None, :] <= qpos[:, None])[None, None, None, :, :]
         s = jnp.where(valid, s, attention_ops._BIG_NEG)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum(

@@ -165,22 +165,46 @@ class TestSharded:
             np.asarray(b), np.asarray(generate(model, params, p2, 6))
         )
 
-    def test_chunked_prefill_rejected(self):
-        """A T>1 apply on an EXISTING cache would attend only among the
-        fresh tokens and silently ignore the cached prefix — the
-        single-prefill contract is enforced statically."""
+    def test_chunked_prefill_matches_single_prefill(self):
+        """T>1 on a warm cache extends it (round 3): the chunk attends over
+        the cached prefix plus itself causally, so feeding a prompt in two
+        chunks must give the same logits and the same downstream decode
+        steps as one prefill — the basis for chunked long-prompt prefill
+        and speculative decoding's verify pass."""
         model = _model()
         params = _params(model)
+        toks = jnp.asarray(
+            np.random.RandomState(21).randint(1, VOCAB, size=(2, 12)),
+            jnp.int32,
+        )
         dmodel = model.clone(decode=True, max_decode_len=16)
-        _, vars_ = dmodel.apply(
-            {"params": params}, jnp.zeros((1, 4), jnp.int32),
+        full_logits, v_full = dmodel.apply(
+            {"params": params}, toks, mutable=["cache"]
+        )
+        _, v1 = dmodel.apply(
+            {"params": params}, toks[:, :8], mutable=["cache"]
+        )
+        l2, v2 = dmodel.apply(
+            {"params": params, "cache": v1["cache"]}, toks[:, 8:],
             mutable=["cache"],
         )
-        with pytest.raises(ValueError, match="first call"):
-            dmodel.apply(
-                {"params": params, "cache": vars_["cache"]},
-                jnp.zeros((1, 3), jnp.int32), mutable=["cache"],
-            )
+        np.testing.assert_allclose(
+            np.asarray(l2), np.asarray(full_logits[:, 8:]),
+            rtol=1e-5, atol=1e-5,
+        )
+        assert int(v2["cache"]["index"]) == int(v_full["cache"]["index"])
+        nxt = jnp.argmax(full_logits[:, -1], -1)[:, None].astype(jnp.int32)
+        s_full, _ = dmodel.apply(
+            {"params": params, "cache": v_full["cache"]}, nxt,
+            mutable=["cache"],
+        )
+        s_chunk, _ = dmodel.apply(
+            {"params": params, "cache": v2["cache"]}, nxt,
+            mutable=["cache"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(s_full), np.asarray(s_chunk), rtol=1e-5, atol=1e-5
+        )
 
     def test_decode_rejects_train_and_remat(self):
         model = _model(remat=True)

@@ -1,0 +1,175 @@
+"""Speculative decoding (models/speculative.py): exactness is the contract —
+greedy speculative output must be bit-identical to plain greedy decoding for
+ANY draft quality; drafts change only the round count."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu.data import datasets
+from horovod_tpu.models.decoding import generate
+from horovod_tpu.models.speculative import make_speculative_fn, ngram_draft_fn
+from horovod_tpu.models.transformer import TransformerLM
+
+VOCAB = 32
+
+
+def _model(**kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("dropout", 0.0)
+    return TransformerLM(**kw)
+
+
+def _params(model):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))[
+        "params"
+    ]
+
+
+class TestExactness:
+    @pytest.mark.parametrize("gamma", [2, 4, 6])
+    def test_matches_plain_greedy(self, gamma):
+        model = _model()
+        params = _params(model)
+        prompt = jnp.asarray(
+            np.random.RandomState(5).randint(1, VOCAB, size=(2, 10)),
+            jnp.int32,
+        )
+        want = generate(model, params, prompt, 20)
+        got = make_speculative_fn(model, max_new_tokens=20, gamma=gamma)(
+            params, prompt
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_adversarial_draft_still_exact(self):
+        """A constant-garbage draft must not change the output — only the
+        acceptance rate (≈1 token/round)."""
+        model = _model()
+        params = _params(model)
+        prompt = jnp.asarray([[3, 1, 4, 1, 5, 9]], jnp.int32)
+        bad = lambda buf, cur_len, n: jnp.full(  # noqa: E731
+            (buf.shape[0], n), 11, jnp.int32
+        )
+        want = generate(model, params, prompt, 16)
+        fn = make_speculative_fn(
+            model, max_new_tokens=16, gamma=4, draft_fn=bad,
+            return_stats=True,
+        )
+        got, stats = fn(params, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(stats["tokens"]) >= 16
+
+    def test_gqa_model_exact(self):
+        model = _model(n_kv_heads=2)
+        params = _params(model)
+        prompt = jnp.asarray([[7, 8, 9, 1], [2, 2, 4, 6]], jnp.int32)
+        want = generate(model, params, prompt, 12)
+        got = make_speculative_fn(model, max_new_tokens=12, gamma=4)(
+            params, prompt
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_include_prompt_false(self):
+        model = _model()
+        params = _params(model)
+        prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        full = make_speculative_fn(model, max_new_tokens=8, gamma=3)(
+            params, prompt
+        )
+        tail = make_speculative_fn(
+            model, max_new_tokens=8, gamma=3, include_prompt=False
+        )(params, prompt)
+        np.testing.assert_array_equal(
+            np.asarray(full[:, 4:]), np.asarray(tail)
+        )
+
+    def test_validation(self):
+        model = _model()
+        with pytest.raises(ValueError, match="gamma"):
+            make_speculative_fn(model, max_new_tokens=8, gamma=1)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            make_speculative_fn(model, max_new_tokens=0)
+
+
+class TestNgramDraft:
+    def test_proposes_continuation_of_earlier_occurrence(self):
+        draft = ngram_draft_fn(ngram=2)
+        # buf: ... [4 5] 6 7 ... [4 5] <- suffix; expect proposal 6 7 8
+        buf = jnp.asarray(
+            [[1, 4, 5, 6, 7, 8, 2, 4, 5, 0, 0, 0]], jnp.int32
+        )
+        out = draft(buf, jnp.int32(9), 3)
+        np.testing.assert_array_equal(np.asarray(out), [[6, 7, 8]])
+
+    def test_latest_occurrence_wins(self):
+        draft = ngram_draft_fn(ngram=2)
+        buf = jnp.asarray(
+            [[4, 5, 1, 4, 5, 2, 9, 4, 5, 0, 0, 0]], jnp.int32
+        )
+        out = draft(buf, jnp.int32(9), 2)
+        # the match at positions 3-4 (followed by 2, 9) is later than 0-1
+        np.testing.assert_array_equal(np.asarray(out), [[2, 9]])
+
+    def test_no_match_repeats_last_token(self):
+        draft = ngram_draft_fn(ngram=3)
+        buf = jnp.asarray([[1, 2, 3, 4, 5, 0, 0, 0]], jnp.int32)
+        out = draft(buf, jnp.int32(5), 2)
+        np.testing.assert_array_equal(np.asarray(out), [[5, 5]])
+
+
+class TestSpeedup:
+    def test_trained_copy_model_accepts_drafts(self):
+        """On a model that has actually learned the copy task, the ngram
+        draft proposes the true continuation and the target accepts ~gamma
+        tokens per round — the mechanism behind the measured speedup
+        (BASELINE.md). Exactness still holds, and the round count must be
+        WELL under one-per-token."""
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        model = _model(d_model=64)
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            # 1-device mesh: this test is about decode acceptance, and the
+            # default 8-way virtual mesh makes the fit compile ~10x slower
+            # on a single-core host.
+            mesh=mesh_lib.build_mesh(
+                mesh_lib.MeshSpec(data=1), devices=jax.devices()[:1]
+            ),
+        )
+        x, y = datasets.copy_task(512, 32, vocab_size=VOCAB, seed=9)
+        trainer.fit(
+            x=x, y=y, batch_size=32, epochs=4, steps_per_epoch=16, verbose=0
+        )
+        params = trainer.state.params
+        xt, _ = datasets.copy_task(4, 32, vocab_size=VOCAB, seed=11)
+        prompt = jnp.asarray(xt[:2, :16])  # first half; continuation = copy
+        n_new = 15
+        want = generate(model, params, prompt, n_new)
+        fn = make_speculative_fn(
+            model, max_new_tokens=n_new, gamma=6, return_stats=True
+        )
+        got, stats = fn(params, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        rounds = int(stats["rounds"])
+        assert rounds <= (n_new * 2) // 3, (
+            f"{rounds} rounds for {n_new} tokens — drafts not being accepted"
+        )
+
+
+class TestMoERejected:
+    def test_moe_model_rejected(self):
+        """MoE capacity binds per call group: a chunked verify forward can
+        route differently than the per-token steps it replaces, so the
+        exact-output contract cannot hold — rejected loudly (confirmed
+        divergence repro: moe_every=1, capacity_factor=0.5, gamma=4)."""
+        model = _model(moe_every=2, n_experts=4)
+        with pytest.raises(ValueError, match="dense model"):
+            make_speculative_fn(model, max_new_tokens=8)
