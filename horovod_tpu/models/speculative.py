@@ -28,9 +28,19 @@ The built-in draft is **prompt-lookup** (n-gram continuation: propose the
 tokens that followed the most recent earlier occurrence of the current
 n-gram suffix — "prompt lookup decoding", a draft-model-free scheme that
 excels on self-repetitive text: code, summarization-with-quotes, copy
-structure). A custom ``draft_fn(buf [B, Tmax], cur_len, n_draft) ->
-[B, n_draft]`` can be supplied — e.g. a small trained LM — with the same
-exactness guarantee.
+structure). Two generalizations, same exactness guarantee:
+
+* a custom stateless ``draft_fn(buf [B, Tmax], cur_len, n_draft) ->
+  [B, n_draft]``;
+* a **draft model** (``draft_model=`` + ``draft_params=``: a smaller LM,
+  the classic two-model scheme) — it keeps its own KV cache inside the
+  loop. Static-shape subtlety: how far the draft cache trails the
+  committed prefix varies by round (full acceptance consumes one token
+  the draft never saw), so every round re-feeds the draft a fixed
+  2-token window ending at the committed head — cache writes are
+  idempotent for committed tokens, so the variable-length "catch-up" a
+  Python implementation would branch on becomes a constant-shape
+  overwrite — then scans γ-2 single-token draft steps.
 
 Restrictions: greedy only (``eos_id`` unsupported — use
 `decoding.generate` for sampled or eos-terminated generation), and dense
@@ -96,6 +106,7 @@ def ngram_draft_fn(*, ngram: int = 3) -> Callable:
 
 def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
                         draft_fn: Callable | None = None,
+                        draft_model=None, draft_params=None,
                         include_prompt: bool = True,
                         return_stats: bool = False):
     """Build the compiled speculative generator: ``(params, prompt) ->
@@ -103,23 +114,31 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
 
     ``gamma`` = tokens verified per target pass (1 known-exact token + γ-1
     drafts): per round the target streams its weights once and commits
-    between 1 and γ tokens. ``return_stats`` appends a dict with
-    ``rounds`` and ``tokens`` (accepted-per-round = tokens/rounds; plain
-    decoding would use ``tokens`` rounds).
+    between 1 and γ tokens. Drafts come from ``draft_fn`` (stateless), or
+    ``draft_model``/``draft_params`` (a smaller LM with its own in-loop KV
+    cache — see module docstring), or the default prompt-lookup n-gram.
+    ``return_stats`` appends a dict with ``rounds`` and ``tokens``
+    (accepted-per-round = tokens/rounds; plain decoding would use
+    ``tokens`` rounds).
     """
     if gamma < 2:
         raise ValueError("gamma must be >= 2 (1 exact token + >=1 draft)")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
-    if getattr(model, "moe_every", 0):
-        raise ValueError(
-            "speculative decoding requires a dense model: MoE expert "
-            "capacity binds per call group, so a chunked verify forward "
-            "can legitimately route (and decode) differently than the "
-            "per-token steps it replaces — the exact-output contract "
-            "cannot hold; use decoding.generate for MoE models"
-        )
-    draft = draft_fn or ngram_draft_fn()
+    if draft_fn is not None and draft_model is not None:
+        raise ValueError("pass draft_fn OR draft_model, not both")
+    if draft_model is not None and draft_params is None:
+        raise ValueError("draft_model needs draft_params")
+    for m, role in ((model, "target"), (draft_model, "draft")):
+        if m is not None and getattr(m, "moe_every", 0):
+            raise ValueError(
+                f"speculative decoding requires a dense model ({role}): MoE "
+                "expert capacity binds per call group, so a chunked verify "
+                "forward can legitimately route (and decode) differently "
+                "than the per-token steps it replaces — the exact-output "
+                "contract cannot hold; use decoding.generate for MoE models"
+            )
+    draft = draft_fn or (None if draft_model is not None else ngram_draft_fn())
 
     def run(params, prompt):
         prompt = prompt.astype(jnp.int32)
@@ -135,18 +154,78 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
         buf = jnp.zeros((b, tmax), jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
+        ddraft = None
+        dcache0 = None
+        if draft_model is not None:
+            if t0 < 2:
+                raise ValueError(
+                    "draft_model mode needs a prompt of >= 2 tokens (the "
+                    "catch-up window spans the last two committed tokens)"
+                )
+            ddraft = draft_model.clone(
+                decode=True, max_decode_len=tmax, dropout=0.0, remat=False,
+            )
+            # Prefill the draft on everything EXCEPT the prompt's last
+            # token: each round's 2-token catch-up window re-feeds
+            # [buf[cur_len-1], buf[cur_len]], so position t0-1 is covered
+            # by round 1's window (and double-writes are idempotent).
+            _, dvars = ddraft.apply(
+                {"params": draft_params}, prompt[:, :-1], mutable=["cache"]
+            )
+            dcache0 = dict(dvars["cache"])
+
+        def _model_draft(dcache, buf, cur_len):
+            """γ-1 greedy proposals from the draft LM, cache maintained.
+
+            ``buf[cur_len]`` is the committed head (next_tok). The catch-up
+            window [cur_len-1, cur_len] re-feeds whatever the draft cache
+            might be missing — its index is forced to cur_len-1 first, so
+            committed tokens are (re)written at their true positions.
+            """
+            dcache = dict(dcache)
+            dcache["index"] = cur_len - 1
+            window = lax.dynamic_slice(buf, (0, cur_len - 1), (b, 2))
+            dlogits, dvars = ddraft.apply(
+                {"params": draft_params, "cache": dcache}, window,
+                mutable=["cache"],
+            )
+            tok = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
+
+            def step(carry, _):
+                dcache, tok = carry
+                slog, svars = ddraft.apply(
+                    {"params": draft_params, "cache": dcache}, tok[:, None],
+                    mutable=["cache"],
+                )
+                nxt = jnp.argmax(slog[:, -1], axis=-1).astype(jnp.int32)
+                return (dict(svars["cache"]), nxt), tok
+
+            (dcache, last), toks = lax.scan(
+                step, (dict(dvars["cache"]), tok), None, length=gamma - 2
+            )
+            # ys = the tokens each step CONSUMED (tok_1..tok_{γ-2}); the
+            # final carry is tok_{γ-1}, proposed but never consumed — its
+            # missing draft-cache entry is exactly what the next round's
+            # catch-up window re-feeds if it gets accepted.
+            proposals = jnp.concatenate(
+                [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1
+            ) if gamma > 2 else tok[:, None]
+            return proposals, dcache
+
         def cond(carry):
-            _, _, n_gen, _, _, _ = carry
-            return n_gen < max_new_tokens
+            return carry[2] < max_new_tokens
 
         def body(carry):
-            buf, cur_len, n_gen, cache, next_tok, rounds = carry
+            buf, cur_len, n_gen, cache, dcache, next_tok, rounds = carry
             # next_tok is already the target's exact output — commit it,
             # then draft continuations for verification.
             buf = lax.dynamic_update_slice(
                 buf, next_tok[:, None], (0, cur_len)
             )
-            proposals = draft(buf, cur_len + 1, gamma - 1)
+            if ddraft is not None:
+                proposals, dcache = _model_draft(dcache, buf, cur_len)
+            else:
+                proposals = draft(buf, cur_len + 1, gamma - 1)
             chunk = jnp.concatenate([next_tok[:, None], proposals], axis=1)
             logits_c, new_vars = dmodel.apply(
                 {"params": params, "cache": cache}, chunk, mutable=["cache"]
@@ -170,13 +249,19 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             # overwritten by the next chunk write at exactly this index.
             cache = dict(new_vars["cache"])
             cache["index"] = cur_len + m
-            return (buf, cur_len + m, n_gen + m, cache, next_tok, rounds + 1)
+            return (
+                buf, cur_len + m, n_gen + m, cache, dcache, next_tok,
+                rounds + 1,
+            )
 
         carry = (
             buf, jnp.int32(t0), jnp.int32(0), dict(vars_["cache"]),
+            dcache0 if dcache0 is not None else jnp.int32(0),
             next_tok, jnp.int32(0),
         )
-        buf, cur_len, n_gen, _, _, rounds = lax.while_loop(cond, body, carry)
+        buf, cur_len, n_gen, _, _, _, rounds = lax.while_loop(
+            cond, body, carry
+        )
         out = lax.dynamic_slice(
             buf, (0, 0 if include_prompt else t0),
             (b, (t0 if include_prompt else 0) + max_new_tokens),

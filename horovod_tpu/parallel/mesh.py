@@ -90,18 +90,52 @@ class MeshSpec:
         return sizes
 
 
+def _hybrid_shapes(shape: tuple, n_slices: int):
+    """Factor ``n_slices`` out of the outermost divisible mesh axes.
+
+    Multi-slice systems connect slices over DCN (orders of magnitude less
+    bandwidth than ICI), so axes crossing slice boundaries must be the ones
+    with the *least* per-step traffic. AXES is already ordered
+    DCN-adjacent-first (data/fsdp: one gradient reduction per step; pipe:
+    point-to-point handoffs), so greedily assign the slice factor to the
+    earliest axes that divide it. Returns ``(dcn_shape, ici_shape)`` with
+    elementwise product == ``shape``, or None when no factoring exists.
+    """
+    dcn = [1] * len(shape)
+    remaining = n_slices
+    # Only data/fsdp/pipe (AXES[:3]) may cross DCN: per-layer model/seq/
+    # expert collectives over DCN would be catastrophic, so a shape that
+    # forces them across slices is refused (None -> caller warns + flat).
+    for i in range(min(3, len(shape))):
+        if remaining == 1:
+            break
+        g = math.gcd(shape[i], remaining)
+        dcn[i] = g
+        remaining //= g
+    if remaining != 1:
+        return None
+    ici = tuple(s // d for s, d in zip(shape, dcn))
+    return tuple(dcn), ici
+
+
 def _device_array(devices: np.ndarray, shape: tuple, order: str | None = None):
     """Physical device layout for the mesh.
 
     ``order='auto'`` (default, or ``HVT_MESH_ORDER`` env): on multi-chip TPU,
-    delegate to `jax.experimental.mesh_utils.create_device_mesh`, which maps
-    mesh axes onto the physical ICI torus (rings for each axis ride actual
-    links instead of the arbitrary enumeration order a flat reshape gives —
-    on a pod slice, reshape-order neighbors can be several hops apart, and
-    every ppermute/psum pays that distance). Falls back to the flat reshape
-    when the topology solver rejects the shape, on CPU/virtual devices
-    (where "distance" is meaningless and tests rely on enumeration order),
-    or with ``order='flat'``.
+    delegate to `jax.experimental.mesh_utils`, which maps mesh axes onto the
+    physical ICI torus (rings for each axis ride actual links instead of the
+    arbitrary enumeration order a flat reshape gives — on a pod slice,
+    reshape-order neighbors can be several hops apart, and every
+    ppermute/psum pays that distance). When the devices span multiple
+    *slices* (DCN-connected — `device.slice_index` differs), the slice
+    count is factored out of the outermost axes (data/fsdp/pipe — the
+    low-traffic ones, `_hybrid_shapes`) and `create_hybrid_device_mesh`
+    keeps every other axis's collectives inside a slice: model/seq/expert
+    traffic rides ICI, only the per-step gradient reduction (or pipe
+    handoff) crosses DCN. Falls back to the flat reshape when the topology
+    solver rejects the shape, on CPU/virtual devices (where "distance" is
+    meaningless and tests rely on enumeration order), or with
+    ``order='flat'``.
     """
     order = order or os.environ.get("HVT_MESH_ORDER", "auto")
     if order not in ("auto", "flat"):
@@ -115,7 +149,21 @@ def _device_array(devices: np.ndarray, shape: tuple, order: str | None = None):
     ):
         from jax.experimental import mesh_utils
 
+        slices = {getattr(d, "slice_index", 0) for d in devices.flat}
         try:
+            if len(slices) > 1:
+                hybrid = _hybrid_shapes(shape, len(slices))
+                if hybrid is None:
+                    raise ValueError(
+                        f"cannot factor {len(slices)} slices out of mesh "
+                        f"shape {shape} (no outermost axis divides it)"
+                    )
+                dcn_shape, ici_shape = hybrid
+                return np.asarray(
+                    mesh_utils.create_hybrid_device_mesh(
+                        ici_shape, dcn_shape, devices=list(devices.flat)
+                    )
+                )
             return np.asarray(
                 mesh_utils.create_device_mesh(
                     shape, devices=list(devices.flat)
@@ -125,11 +173,12 @@ def _device_array(devices: np.ndarray, shape: tuple, order: str | None = None):
             import warnings
 
             # Flat order is always *valid*; it is just potentially slow —
-            # say so, or a pod silently pays multi-hop ICI on every ring.
+            # say so, or a pod silently pays multi-hop ICI (or per-layer
+            # DCN) on every ring.
             warnings.warn(
-                f"ICI-topology-aware mesh layout failed for shape {shape} "
+                f"topology-aware mesh layout failed for shape {shape} "
                 f"({e}); falling back to enumeration order — collective "
-                f"rings may span multi-hop ICI paths",
+                f"rings may span multi-hop ICI or DCN paths",
                 stacklevel=3,
             )
     return devices.reshape(shape)

@@ -97,3 +97,22 @@ def test_lm_packed_pretraining(tmp_path):
     assert res.returncode == 0, res.stderr[-2000:]
     assert "occupancy" in res.stdout
     assert "LEARNING" in res.stdout, res.stdout[-800:]
+
+
+@pytest.mark.slow
+def test_lm_generate(tmp_path):
+    res = _run(
+        "lm_generate.py",
+        {
+            "PS_MODEL_PATH": str(tmp_path),
+            "DRIVE_EPOCHS": "1",
+            "DRIVE_STEPS": "4",
+            "SEQ_LEN": "32",
+            "DMODEL": "32",
+            "NLAYERS": "2",
+            "GAMMA": "3",
+        },
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert (tmp_path / "lm-generate" / "checkpoint-final.msgpack").exists()
+    assert "outputs identical: True" in res.stdout

@@ -118,3 +118,93 @@ class TestDeviceLayout:
 
         with pytest.raises(ValueError, match="HVT_MESH_ORDER"):
             _device_array(np.asarray(jax.devices()), (8,), order="torus")
+
+
+class TestHybridLayout:
+    """Multi-slice (DCN-connected) device sets: the slice count is factored
+    out of the outermost (low-traffic) axes and routed through
+    create_hybrid_device_mesh so model/seq/expert collectives stay on ICI."""
+
+    class _SliceDev:
+        platform = "tpu"
+
+        def __init__(self, i, slice_index):
+            self.id = i
+            self.slice_index = slice_index
+
+    def _devs(self, n=8, slices=2):
+        per = n // slices
+        return np.asarray(
+            [self._SliceDev(i, i // per) for i in range(n)]
+        )
+
+    def test_hybrid_shapes_factor_outermost(self):
+        from horovod_tpu.parallel.mesh import _hybrid_shapes
+
+        # data=4 absorbs 2 slices -> dcn (2,..), ici (2,..)
+        assert _hybrid_shapes((4, 1, 1, 2, 1, 1), 2) == (
+            (2, 1, 1, 1, 1, 1), (2, 1, 1, 2, 1, 1)
+        )
+        # data=1: slices fall through to pipe
+        assert _hybrid_shapes((1, 1, 2, 1, 2, 2), 2) == (
+            (1, 1, 2, 1, 1, 1), (1, 1, 1, 1, 2, 2)
+        )
+        # split across data AND fsdp (6 slices = 2 x 3)
+        assert _hybrid_shapes((2, 3, 1, 1, 4, 1), 6) == (
+            (2, 3, 1, 1, 1, 1), (1, 1, 1, 1, 4, 1)
+        )
+        # unfactorable
+        assert _hybrid_shapes((1, 1, 1, 1, 8, 1), 3) is None
+
+    def test_multi_slice_routes_through_hybrid(self, monkeypatch):
+        from jax.experimental import mesh_utils
+
+        from horovod_tpu.parallel.mesh import _device_array
+
+        calls = {}
+
+        def fake_hybrid(ici_shape, dcn_shape, devices=None, **kw):
+            calls["ici"] = tuple(ici_shape)
+            calls["dcn"] = tuple(dcn_shape)
+            full = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+            return np.asarray(devices).reshape(full)
+
+        monkeypatch.setattr(
+            mesh_utils, "create_hybrid_device_mesh", fake_hybrid
+        )
+        shape = (4, 1, 1, 1, 2, 1)  # data=4, model=2 over 2 slices
+        out = _device_array(self._devs(8, 2), shape)
+        assert out.shape == shape
+        assert calls == {
+            "dcn": (2, 1, 1, 1, 1, 1), "ici": (2, 1, 1, 1, 2, 1)
+        }
+
+    def test_single_slice_uses_plain_mesh(self, monkeypatch):
+        from jax.experimental import mesh_utils
+
+        from horovod_tpu.parallel.mesh import _device_array
+
+        def boom(*a, **kw):
+            raise AssertionError("hybrid must not be called for one slice")
+
+        monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", boom)
+        monkeypatch.setattr(
+            mesh_utils, "create_device_mesh",
+            lambda shape, devices=None, **kw: np.asarray(devices).reshape(shape),
+        )
+        out = _device_array(self._devs(8, 1), (8, 1, 1, 1, 1, 1))
+        assert out.shape == (8, 1, 1, 1, 1, 1)
+
+    def test_unfactorable_slices_warn_and_flatten(self, monkeypatch):
+        import warnings
+
+        from horovod_tpu.parallel.mesh import _device_array
+
+        devs = np.asarray(
+            [self._SliceDev(i, i // 2) for i in range(6)]  # 3 slices
+        )
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = _device_array(devs, (1, 1, 1, 1, 6, 1))
+        assert out.shape == (1, 1, 1, 1, 6, 1)
+        assert any("falling back" in str(x.message) for x in w)

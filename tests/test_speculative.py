@@ -173,3 +173,65 @@ class TestMoERejected:
         model = _model(moe_every=2, n_experts=4)
         with pytest.raises(ValueError, match="dense model"):
             make_speculative_fn(model, max_new_tokens=8)
+
+
+class TestModelDraft:
+    """Two-model speculative decoding: a smaller LM drafts with its own
+    in-loop KV cache (fixed 2-token catch-up window + scan steps). The
+    self-draft case (draft == target) is the machinery's proof: every
+    proposal is the target's own argmax, so acceptance must be total and
+    the round count exactly ceil(n/gamma) — any cache-index or catch-up
+    bug would break the draft's agreement with its own target."""
+
+    def _pair(self):
+        target = _model(n_layers=3)
+        draft = _model(d_model=16, n_heads=2, n_layers=1)
+        toks = jnp.zeros((2, 8), jnp.int32)
+        tp = target.init(jax.random.PRNGKey(0), toks)["params"]
+        dp = draft.init(jax.random.PRNGKey(1), toks)["params"]
+        return target, tp, draft, dp
+
+    @pytest.mark.parametrize("gamma", [2, 3, 5])
+    def test_exact_with_separate_draft(self, gamma):
+        target, tp, draft, dp = self._pair()
+        prompt = jnp.asarray(
+            np.random.RandomState(31).randint(1, VOCAB, size=(2, 8)),
+            jnp.int32,
+        )
+        want = generate(target, tp, prompt, 16)
+        got = make_speculative_fn(
+            target, max_new_tokens=16, gamma=gamma,
+            draft_model=draft, draft_params=dp,
+        )(tp, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_self_draft_full_acceptance(self):
+        target, tp, _, _ = self._pair()
+        prompt = jnp.asarray(
+            np.random.RandomState(32).randint(1, VOCAB, size=(2, 8)),
+            jnp.int32,
+        )
+        want = generate(target, tp, prompt, 16)
+        fn = make_speculative_fn(
+            target, max_new_tokens=16, gamma=5,
+            draft_model=target, draft_params=tp, return_stats=True,
+        )
+        got, stats = fn(tp, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(stats["rounds"]) == 4  # ceil(16/5): zero rejections
+
+    def test_validation(self):
+        target, tp, draft, dp = self._pair()
+        with pytest.raises(ValueError, match="not both"):
+            make_speculative_fn(
+                target, max_new_tokens=8,
+                draft_fn=lambda b, c, n: b[:, :n],
+                draft_model=draft, draft_params=dp,
+            )
+        with pytest.raises(ValueError, match="draft_params"):
+            make_speculative_fn(target, max_new_tokens=8, draft_model=draft)
+        fn = make_speculative_fn(
+            target, max_new_tokens=8, draft_model=draft, draft_params=dp
+        )
+        with pytest.raises(ValueError, match="2 tokens"):
+            fn(tp, jnp.zeros((1, 1), jnp.int32))
