@@ -38,14 +38,33 @@ from jax import lax
 _NEG = -1e30
 
 
-def _sample(logits, rng, temperature: float, top_k: int, top_p: float = 0.0):
-    """One next-token draw from [B, vocab] logits (f32 math)."""
+def check_sampling_params(temperature: float, top_p: float) -> None:
+    """The one place the sampling-knob ranges are enforced.
+
+    top_p < 0 would make the nucleus empty and the clamped kth index wrap
+    to the minimum logit (silently UNfiltered sampling); temperature < 0
+    would invert the distribution (anti-nucleus) — both must raise, not
+    silently misbehave.
+    """
     if not 0.0 <= top_p <= 1.0:
-        # top_p < 0 would make the nucleus empty and the clamped kth index
-        # wrap to the minimum logit — silently UNfiltered sampling.
         raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+
+
+def filter_logits(logits, temperature: float, top_k: int, top_p: float):
+    """Temperature/top-k/top-p filtering on [..., vocab] logits (f32 math).
+
+    Returns the filtered logits whose softmax is the sampling distribution
+    (`_NEG` on masked tokens). Shared by `_sample` and the speculative
+    decoder's rejection scheme, which needs the distribution itself, not a
+    draw. ``temperature`` must be > 0 here (greedy is its callers' fast
+    path).
+    """
+    check_sampling_params(temperature, top_p)
     if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        raise ValueError("filter_logits needs temperature > 0 (greedy is "
+                         "the callers' argmax fast path)")
     logits = logits.astype(jnp.float32) / temperature
     if top_k:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
@@ -60,7 +79,17 @@ def _sample(logits, rng, temperature: float, top_k: int, top_p: float = 0.0):
         n_keep = jnp.sum(exclusive < top_p, axis=-1, keepdims=True)
         kth = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
         logits = jnp.where(logits < kth, _NEG, logits)
-    return jax.random.categorical(rng, logits).astype(jnp.int32)
+    return logits
+
+
+def _sample(logits, rng, temperature: float, top_k: int, top_p: float = 0.0):
+    """One next-token draw from [B, vocab] logits (f32 math)."""
+    check_sampling_params(temperature, top_p)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filter_logits(logits, temperature, top_k, top_p)
+    ).astype(jnp.int32)
 
 
 def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,

@@ -42,12 +42,25 @@ structure). Two generalizations, same exactness guarantee:
   Python implementation would branch on becomes a constant-shape
   overwrite — then scans γ-2 single-token draft steps.
 
-Restrictions: greedy only (``eos_id`` unsupported — use
-`decoding.generate` for sampled or eos-terminated generation), and dense
-models only: MoE expert capacity is enforced per call group, so a
-γ-token verify forward can route differently than the single-token steps
-it replaces and the exactness contract would silently break
-(`decoding.py`'s MoE caveat, made binding here) — rejected loudly.
+**Sampling** (``temperature > 0``, with top-k/top-p): the rejection
+scheme of arXiv:2211.17192 specialized to deterministic drafts — accept
+draft token d with probability p(d) under the target's filtered
+distribution, else resample from p restricted to the other tokens; the
+committed law is exactly p per position, so sampled speculative output is
+*distributionally* identical to `decoding.generate`'s sampled path
+(bit-identity is impossible: the rng schedules differ). Randomness is
+keyed by ``(absolute position, draft token, batch row)``, never by round:
+a batch row that accepts past the lockstep minimum re-derives the same
+positions next round against possibly *different* draft proposals, and
+per-(position, token) keys keep the reused test independent of the
+discarded one — round-keyed draws would bias exactly that case.
+
+Restrictions: ``eos_id`` unsupported (use `decoding.generate` for
+eos-terminated generation), and dense models only: MoE expert capacity is
+enforced per call group, so a γ-token verify forward can route
+differently than the single-token steps it replaces and the exactness
+contract would silently break (`decoding.py`'s MoE caveat, made binding
+here) — rejected loudly.
 """
 
 from __future__ import annotations
@@ -57,6 +70,12 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from horovod_tpu.models.decoding import (
+    _NEG,
+    check_sampling_params,
+    filter_logits,
+)
 
 
 def ngram_draft_fn(*, ngram: int = 3) -> Callable:
@@ -107,10 +126,18 @@ def ngram_draft_fn(*, ngram: int = 3) -> Callable:
 def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
                         draft_fn: Callable | None = None,
                         draft_model=None, draft_params=None,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 0.0,
                         include_prompt: bool = True,
                         return_stats: bool = False):
-    """Build the compiled speculative generator: ``(params, prompt) ->
-    tokens`` (greedy; bit-identical to `decoding.generate`'s greedy path).
+    """Build the compiled speculative generator.
+
+    Greedy (``temperature=0``, default): ``(params, prompt) -> tokens``,
+    bit-identical to `decoding.generate`'s greedy path. Sampled
+    (``temperature > 0``, with top-k/top-p): ``(params, prompt, rng) ->
+    tokens``, distributionally identical to the sampled `generate` (see
+    module docstring — the rejection scheme commits exactly the target's
+    filtered distribution per position).
 
     ``gamma`` = tokens verified per target pass (1 known-exact token + γ-1
     drafts): per round the target streams its weights once and commits
@@ -125,6 +152,8 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
         raise ValueError("gamma must be >= 2 (1 exact token + >=1 draft)")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    check_sampling_params(temperature, top_p)
+    sampled = temperature != 0.0
     if draft_fn is not None and draft_model is not None:
         raise ValueError("pass draft_fn OR draft_model, not both")
     if draft_model is not None and draft_params is None:
@@ -140,17 +169,45 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             )
     draft = draft_fn or (None if draft_model is not None else ngram_draft_fn())
 
-    def run(params, prompt):
+    def run(params, prompt, rng=None):
         prompt = prompt.astype(jnp.int32)
         b, t0 = prompt.shape
         tmax = t0 + max_new_tokens + gamma  # chunk-overhang headroom
+        if sampled and rng is None:
+            raise ValueError(
+                "sampled speculative decoding (temperature > 0) needs an "
+                "rng: call fn(params, prompt, rng)"
+            )
         dmodel = model.clone(
             decode=True, max_decode_len=tmax, dropout=0.0, remat=False,
         )
         logits, vars_ = dmodel.apply(
             {"params": params}, prompt, mutable=["cache"]
         )
-        next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+        def _pkey(pos, tag, row):
+            """Draw key for (absolute position, tag, batch row) — round-
+            independent so lockstep re-derivation reuses the SAME draw for
+            the same decision and a FRESH one when the draft token at a
+            position changes between rounds (tag encodes it)."""
+            k = jax.random.fold_in(rng, pos)
+            k = jax.random.fold_in(k, tag)
+            return jax.random.fold_in(k, row)
+
+        rows = jnp.arange(b, dtype=jnp.int32)
+
+        if sampled:
+            # "No draft at this position" draws (prefill token, bonus) use
+            # tag 2*vocab — disjoint from the accept (tok) and resample
+            # (vocab+tok) tag ranges.
+            flt0 = filter_logits(logits[:, -1], temperature, top_k, top_p)
+            next_tok = jax.vmap(
+                lambda f, r: jax.random.categorical(
+                    _pkey(jnp.int32(t0), 2 * flt0.shape[-1], r), f
+                ).astype(jnp.int32)
+            )(flt0, rows)
+        else:
+            next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         buf = jnp.zeros((b, tmax), jnp.int32)
         buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
@@ -230,11 +287,27 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             logits_c, new_vars = dmodel.apply(
                 {"params": params, "cache": cache}, chunk, mutable=["cache"]
             )
-            a = jnp.argmax(logits_c, axis=-1).astype(jnp.int32)  # [B, gamma]
-            # chunk[:, j] (j >= 1) is correct iff it equals the target's
-            # argmax after chunk[:, :j]; accept the matching prefix.
-            match = (chunk[:, 1:] == a[:, :-1]).astype(jnp.int32)
-            m_row = 1 + jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            if sampled:
+                flt = filter_logits(logits_c, temperature, top_k, top_p)
+                probs = jax.nn.softmax(flt, axis=-1)  # [B, γ, V]
+                vocab = flt.shape[-1]
+                d = chunk[:, 1:]  # drafts at positions cur_len+1..+γ-1
+                pos_vec = cur_len + 1 + jnp.arange(gamma - 1, dtype=jnp.int32)
+                us = jax.vmap(  # [B, γ-1] position/token/row-keyed uniforms
+                    lambda drow, r: jax.vmap(
+                        lambda p_, t_: jax.random.uniform(_pkey(p_, t_, r))
+                    )(pos_vec, drow)
+                )(d, rows)
+                # Deterministic-draft rejection: accept d w.p. p(d) under
+                # the target's filtered distribution.
+                p_d = jnp.take_along_axis(probs[:, :-1], d[..., None], -1)
+                acc = (us < p_d[..., 0]).astype(jnp.int32)
+            else:
+                a = jnp.argmax(logits_c, axis=-1).astype(jnp.int32)
+                # chunk[:, j] (j >= 1) is correct iff it equals the
+                # target's argmax after chunk[:, :j].
+                acc = (chunk[:, 1:] == a[:, :-1]).astype(jnp.int32)
+            m_row = 1 + jnp.sum(jnp.cumprod(acc, axis=1), axis=1)
             m = jnp.min(m_row)  # shared cache index ⇒ lockstep advance
             # Commit accepted drafts (positions cur_len+1 .. cur_len+m-1):
             # write the whole tail, then let positions >= cur_len+m be
@@ -243,7 +316,37 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             buf = lax.dynamic_update_slice(
                 buf, chunk[:, 1:], (0, cur_len + 1)
             )
-            next_tok = jnp.take_along_axis(a, (m - 1)[None, None].repeat(b, 0), 1)[:, 0]
+            # The token at position cur_len + m (next round's committed
+            # head). Per row: rows at the lockstep minimum rejected their
+            # draft there (or have none at m == γ) and resample from the
+            # residual (target dist minus the rejected token — exactly p
+            # overall); rows that accepted beyond the minimum keep their
+            # accepted draft, which the next round re-commits.
+            if sampled:
+                flt_m = lax.dynamic_slice_in_dim(flt, m - 1, 1, axis=1)[:, 0]
+                has_draft = m < gamma
+                idx_d = jnp.clip(m, 1, gamma - 1)[None, None].repeat(b, 0)
+                d_m = jnp.take_along_axis(chunk, idx_d, 1)[:, 0]
+                idx_a = jnp.clip(m - 1, 0, gamma - 2)[None, None].repeat(b, 0)
+                acc_m = jnp.take_along_axis(acc, idx_a, 1)[:, 0].astype(bool)
+                masked = jnp.where(
+                    has_draft & jax.nn.one_hot(d_m, vocab, dtype=bool),
+                    _NEG, flt_m,
+                )
+                pos_m = cur_len + m
+
+                def res_one(f_row, tok, r):
+                    tag = jnp.where(has_draft, vocab + tok, 2 * vocab)
+                    return jax.random.categorical(
+                        _pkey(pos_m, tag, r), f_row
+                    ).astype(jnp.int32)
+
+                resampled = jax.vmap(res_one)(masked, d_m, rows)
+                next_tok = jnp.where(has_draft & acc_m, d_m, resampled)
+            else:
+                next_tok = jnp.take_along_axis(
+                    a, (m - 1)[None, None].repeat(b, 0), 1
+                )[:, 0]
             # Roll the cache back to the committed prefix: stale K/V above
             # it are masked out by the attention's index test and will be
             # overwritten by the next chunk write at exactly this index.

@@ -235,3 +235,93 @@ class TestModelDraft:
         )
         with pytest.raises(ValueError, match="2 tokens"):
             fn(tp, jnp.zeros((1, 1), jnp.int32))
+
+
+class TestSampledSpeculative:
+    """Sampled (temperature/top-k/top-p) speculative decoding: the
+    rejection scheme must commit exactly the target's filtered
+    distribution per position. Bit-identity with decoding.generate is
+    impossible (different rng schedules), so the contract is checked
+    distributionally: empirical per-position marginals over a FIXED key
+    set must match generate's — deterministic given the seeds, thresholds
+    ~4x the binomial se at these sample counts."""
+
+    def _setup(self, vocab=16, batch=2):
+        model = _model(vocab_size=vocab)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(1, vocab, size=(batch, 8)),
+            jnp.int32,
+        )
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        return model, params, toks[:, :6], vocab
+
+    def _worst_marginal_diff(self, a, b, vocab, n):
+        worst = 0.0
+        for pos in range(a.shape[2]):
+            for row in range(a.shape[1]):
+                ha = np.bincount(a[:, row, pos], minlength=vocab) / n
+                hb = np.bincount(b[:, row, pos], minlength=vocab) / n
+                worst = max(worst, float(np.abs(ha - hb).max()))
+        return worst
+
+    def test_marginals_match_generate(self):
+        from horovod_tpu.models.decoding import make_generate_fn
+
+        model, params, prompt, vocab = self._setup()
+        n, new = 800, 4
+        kw = dict(temperature=1.2, top_p=0.9)
+        spec = make_speculative_fn(
+            model, max_new_tokens=new, gamma=3, include_prompt=False, **kw
+        )
+        gen = make_generate_fn(
+            model, max_new_tokens=new, include_prompt=False, **kw
+        )
+        keys = jax.random.split(jax.random.PRNGKey(7), n)
+        so = np.asarray(jax.vmap(lambda k: spec(params, prompt, k))(keys))
+        go = np.asarray(jax.vmap(lambda k: gen(params, prompt, k))(keys))
+        assert self._worst_marginal_diff(so, go, vocab, n) < 0.08
+
+    def test_lockstep_rederivation_unbiased(self):
+        """Batch rows accepting past the lockstep minimum re-derive
+        positions next round — the case the (position, token, row)-keyed
+        draws exist for. Self-drafting makes acceptance common (prob =
+        p(argmax)), so partial acceptances and re-derivations happen
+        constantly; the committed marginals must still match generate."""
+        from horovod_tpu.models.decoding import make_generate_fn
+
+        model, params, _, vocab = self._setup(batch=4)
+        prompt = jnp.asarray(
+            np.random.RandomState(9).randint(1, vocab, size=(4, 6)),
+            jnp.int32,
+        )
+        n, new = 600, 4
+        kw = dict(temperature=1.0, top_k=8)
+        spec = make_speculative_fn(
+            model, max_new_tokens=new, gamma=4, include_prompt=False,
+            draft_model=model, draft_params=params, **kw,
+        )
+        gen = make_generate_fn(
+            model, max_new_tokens=new, include_prompt=False, **kw
+        )
+        keys = jax.random.split(jax.random.PRNGKey(11), n)
+        so = np.asarray(jax.vmap(lambda k: spec(params, prompt, k))(keys))
+        go = np.asarray(jax.vmap(lambda k: gen(params, prompt, k))(keys))
+        assert self._worst_marginal_diff(so, go, vocab, n) < 0.09
+
+    def test_rng_required(self):
+        model, params, prompt, _ = self._setup()
+        fn = make_speculative_fn(
+            model, max_new_tokens=4, temperature=0.8
+        )
+        with pytest.raises(ValueError, match="rng"):
+            fn(params, prompt)
+
+    def test_greedy_path_unchanged_by_sampling_args(self):
+        model, params, prompt, _ = self._setup()
+        a = make_speculative_fn(model, max_new_tokens=8, gamma=3)(
+            params, prompt
+        )
+        b = make_speculative_fn(
+            model, max_new_tokens=8, gamma=3, temperature=0.0, top_k=5,
+        )(params, prompt)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
