@@ -357,9 +357,17 @@ def bench_decode() -> dict:
         rng.randint(0, 8192, size=(batch, prompt_len)), jnp.int32
     )
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    # BENCH_WEIGHTS=int8: weight-only quantized decode (models/quant.py) —
+    # the bandwidth-bound step streams int8 weights instead of bf16.
+    quantized = os.environ.get("BENCH_WEIGHTS", "") == "int8"
+    if quantized:
+        from horovod_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)
     fn = make_generate_fn(
         model, max_new_tokens=new_tokens, include_prompt=False,
         temperature=float(os.environ.get("BENCH_TEMPERATURE", 0.0)),
+        quantized=quantized,
     )
     key = jax.random.PRNGKey(7)
 
@@ -379,22 +387,29 @@ def bench_decode() -> dict:
     n_params = sum(
         int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
     )
+    if quantized:
+        from horovod_tpu.models.quant import quantized_bytes
+
+        model_bytes = quantized_bytes(params)
+    else:
+        model_bytes = 2 * n_params  # bf16 compute copies
     tok_per_sec = batch * new_tokens / elapsed
     return {
         "metric": "transformer_lm_decode_tokens_per_sec_per_chip",
         "value": round(tok_per_sec / n_chips, 1),
         "unit": "tokens/sec/chip",
         "batch": batch,
+        "weights": "int8" if quantized else "bf16",
         "n_kv_heads": model.n_kv_heads or model.n_heads,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "ms_per_token": round(elapsed / new_tokens * 1e3, 4),
         "n_params": n_params,
         # Each decode step reads every weight once: the implied HBM traffic
-        # floor (2 bytes/param bf16, ignoring the KV cache) vs v5e's ~819
-        # GB/s — how close the matvec loop runs to the bandwidth roofline.
+        # floor (as-stored bytes — 2 B/param bf16, ~1 B/param for int8
+        # weights — ignoring the KV cache) vs v5e's ~819 GB/s.
         "model_bandwidth_gbps": round(
-            2 * n_params * (tok_per_sec / batch) / 1e9, 1
+            model_bytes * (tok_per_sec / batch) / 1e9, 1
         ),
         "n_chips": n_chips,
     }

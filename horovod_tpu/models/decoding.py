@@ -95,7 +95,8 @@ def _sample(logits, rng, temperature: float, top_k: int, top_p: float = 0.0):
 def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 0.0,
                      eos_id: int | None = None,
-                     include_prompt: bool = True):
+                     include_prompt: bool = True,
+                     quantized: bool = False):
     """Build the compiled generator: ``(params, prompt, rng) -> tokens``.
 
     ``model`` is the *training* `TransformerLM`; it is cloned into decode
@@ -103,6 +104,12 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
     ``prompt.shape[1] + max_new_tokens``. The returned function is jitted
     and reusable across calls of the same prompt shape — the handle to hold
     when generating in a loop (a bare `generate` call per prompt re-traces).
+
+    ``quantized=True``: ``params`` is a `models/quant.quantize_params`
+    tree (int8 weights + scales); each decode step dequantizes inside the
+    scan body so the per-token weight stream stays int8 in HBM — the
+    bandwidth-bound step reads half the bytes (quant.py; approximate:
+    outputs can differ from bf16 decoding near ties).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -110,6 +117,14 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
     def run(params, prompt, rng):
         prompt = prompt.astype(jnp.int32)
         b, t0 = prompt.shape
+        if quantized:
+            from horovod_tpu.models.quant import dequantize_params
+
+            unpack = lambda q: dequantize_params(q)  # noqa: E731
+        else:
+            unpack = lambda q: q  # noqa: E731
+        qparams = params
+        params = unpack(qparams)
         dmodel = model.clone(
             decode=True, max_decode_len=t0 + max_new_tokens, dropout=0.0,
             remat=False,
@@ -127,8 +142,11 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
 
         def body(carry, _):
             cache, tok, rng, done = carry
+            # Quantized mode: dequantize HERE, inside the scan body — the
+            # convert+scale fuses into this step's matmul reads, so the
+            # HBM weight stream stays int8 (quant.py docstring).
             step_logits, step_vars = dmodel.apply(
-                {"params": params, "cache": cache}, tok[:, None],
+                {"params": unpack(qparams), "cache": cache}, tok[:, None],
                 mutable=["cache"],
             )
             rng, sub = jax.random.split(rng)
@@ -149,7 +167,8 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
 
 def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
-             eos_id: int | None = None, include_prompt: bool = True):
+             eos_id: int | None = None, include_prompt: bool = True,
+             quantized: bool = False):
     """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, T0] ints).
 
     Convenience wrapper over `make_generate_fn` (which see, for the handle
@@ -159,7 +178,7 @@ def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
     fn = make_generate_fn(
         model, max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, top_p=top_p, eos_id=eos_id,
-        include_prompt=include_prompt,
+        include_prompt=include_prompt, quantized=quantized,
     )
     if rng is None:
         rng = jax.random.PRNGKey(0)

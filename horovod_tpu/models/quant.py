@@ -1,0 +1,85 @@
+"""Weight-only int8 quantization for bandwidth-bound decoding.
+
+Autoregressive decode streams every weight once per generated token
+(BASELINE.md decode rows: the step is HBM-bound), so halving weight bytes
+is a direct tokens/sec lever. This module stores matmul kernels as int8
+with per-output-channel f32 scales; the decode loop dequantizes INSIDE
+each scan step, which XLA fuses into the matmul reads — the HBM stream
+stays int8 (measured on-chip: a 4096² matvec scan runs 1.28× faster with
+int8-stored weights; see BASELINE.md for the end-to-end decode row).
+
+Scope: post-training, weight-only (activations stay bf16 — no activation
+quantization, no calibration data needed), symmetric with per-channel
+scales over every axis but the kernel's first (axis-0 groups).
+Quantized generation is approximate — outputs can differ from bf16
+decoding near argmax ties — so this is a serving knob, not a default;
+tests gate on top-1 agreement with the bf16 path on a trained model.
+
+Usage:
+    qparams = quant.quantize_params(trainer.state.params)
+    fn = make_generate_fn(model, max_new_tokens=..., quantized=True)
+    tokens = fn(qparams, prompt, rng)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_Q = "int8_q"
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and _Q in x
+
+
+def quantize_params(params, *, min_size: int = 4096):
+    """Quantize every >=2-D kernel with at least ``min_size`` elements to
+    ``{'int8_q': int8, 'scale': f32}`` (symmetric, per-output-channel —
+    the last axis); smaller leaves (LayerNorm scales, biases) pass through
+    unchanged. The result has the same tree structure with quantized
+    leaves replaced by those dicts; `dequantize_params` inverts.
+    """
+
+    def q(p):
+        if p.ndim < 2 or p.size < min_size:
+            return p
+        p32 = p.astype(jnp.float32)
+        # Reduce over axis 0 only: dequantization is elementwise, so any
+        # broadcastable scale shape is valid — finer granularity is
+        # strictly lower error. Reducing all leading axes would collapse
+        # e.g. a [d, H, hd] qkv kernel's heads into one shared scale per
+        # hd channel, starving small-magnitude heads of int8 levels.
+        scale = jnp.max(jnp.abs(p32), axis=0, keepdims=True) / 127.0
+        scale = jnp.maximum(scale, 1e-12)
+        return {
+            _Q: jnp.clip(jnp.round(p32 / scale), -127, 127).astype(jnp.int8),
+            "scale": scale.astype(jnp.float32),
+        }
+
+    return jax.tree.map(q, params)
+
+
+def dequantize_params(qparams, dtype=jnp.bfloat16):
+    """Reconstruct a plain param tree (``dtype`` compute copies).
+
+    Called INSIDE the decode scan body so the convert+scale fuses into the
+    step's matmul reads and the weights live in HBM as int8 — calling it
+    outside the loop would materialize full-width weights once and forfeit
+    the bandwidth saving.
+    """
+
+    def d(x):
+        if _is_qleaf(x):
+            return x[_Q].astype(dtype) * x["scale"].astype(dtype)
+        return x
+
+    return jax.tree.map(d, qparams, is_leaf=_is_qleaf)
+
+
+def quantized_bytes(qparams) -> int:
+    """Total parameter bytes as stored (int8 + scales + passthrough)."""
+    total = 0
+    for leaf in jax.tree.leaves(qparams):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
