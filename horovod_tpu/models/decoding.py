@@ -117,12 +117,9 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
     def run(params, prompt, rng):
         prompt = prompt.astype(jnp.int32)
         b, t0 = prompt.shape
-        if quantized:
-            from horovod_tpu.models.quant import dequantize_params
+        from horovod_tpu.models.quant import make_unpack
 
-            unpack = lambda q: dequantize_params(q)  # noqa: E731
-        else:
-            unpack = lambda q: q  # noqa: E731
+        unpack = make_unpack(quantized)
         qparams = params
         params = unpack(qparams)
         dmodel = model.clone(

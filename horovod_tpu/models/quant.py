@@ -77,6 +77,17 @@ def dequantize_params(qparams, dtype=jnp.bfloat16):
     return jax.tree.map(d, qparams, is_leaf=_is_qleaf)
 
 
+def make_unpack(quantized: bool):
+    """The decode-family dequant hook: identity for plain param trees,
+    `dequantize_params` for quantized ones. Shared by
+    decoding/speculative/beam so the dequant contract lives in ONE place —
+    each caller invokes it INSIDE its step/loop body (see
+    `dequantize_params` on why placement matters)."""
+    if quantized:
+        return dequantize_params
+    return lambda q: q
+
+
 def quantized_bytes(qparams) -> int:
     """Total parameter bytes as stored (int8 + scales + passthrough)."""
     total = 0

@@ -129,7 +129,8 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 0.0,
                         include_prompt: bool = True,
-                        return_stats: bool = False):
+                        return_stats: bool = False,
+                        quantized: bool = False):
     """Build the compiled speculative generator.
 
     Greedy (``temperature=0``, default): ``(params, prompt) -> tokens``,
@@ -147,6 +148,16 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
     ``return_stats`` appends a dict with ``rounds`` and ``tokens``
     (accepted-per-round = tokens/rounds; plain decoding would use
     ``tokens`` rounds).
+
+    ``quantized=True``: ``params`` is a `models/quant.quantize_params`
+    tree; every target pass dequantizes inside the loop body so the
+    weight stream stays int8 (decoding.make_generate_fn's contract).
+    The greedy exactness guarantee is UNCHANGED — it compares the
+    target's argmax against itself, and both the speculative verify and
+    the plain quantized decode consult the same quantized weights, so
+    speculative output is bit-identical to
+    ``make_generate_fn(quantized=True)``'s greedy path. (A quantized
+    draft_model is not supported — drafts take plain params.)
     """
     if gamma < 2:
         raise ValueError("gamma must be >= 2 (1 exact token + >=1 draft)")
@@ -178,11 +189,15 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
                 "sampled speculative decoding (temperature > 0) needs an "
                 "rng: call fn(params, prompt, rng)"
             )
+        from horovod_tpu.models.quant import make_unpack
+
+        unpack = make_unpack(quantized)
+        qparams = params
         dmodel = model.clone(
             decode=True, max_decode_len=tmax, dropout=0.0, remat=False,
         )
         logits, vars_ = dmodel.apply(
-            {"params": params}, prompt, mutable=["cache"]
+            {"params": unpack(qparams)}, prompt, mutable=["cache"]
         )
 
         def _pkey(pos, tag, row):
@@ -284,8 +299,11 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             else:
                 proposals = draft(buf, cur_len + 1, gamma - 1)
             chunk = jnp.concatenate([next_tok[:, None], proposals], axis=1)
+            # Quantized mode: dequantize per round, inside the loop body —
+            # the weight stream of each verify pass stays int8 in HBM.
             logits_c, new_vars = dmodel.apply(
-                {"params": params, "cache": cache}, chunk, mutable=["cache"]
+                {"params": unpack(qparams), "cache": cache}, chunk,
+                mutable=["cache"],
             )
             if sampled:
                 flt = filter_logits(logits_c, temperature, top_k, top_p)

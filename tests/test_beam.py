@@ -1,0 +1,168 @@
+"""Beam search (models/beam.py): width-W maximum-likelihood decode over
+the KV cache. The load-bearing check is score consistency — the
+incrementally-accumulated beam scores must equal a teacher-forced
+recompute of the returned sequence, which transitively proves the
+per-step cache reordering (a wrong gather would score later steps against
+the wrong prefix)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models.beam import make_beam_search_fn
+from horovod_tpu.models.decoding import generate
+from horovod_tpu.models.transformer import TransformerLM
+
+VOCAB = 32
+N = 10
+
+
+def _model(**kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("dropout", 0.0)
+    return TransformerLM(**kw)
+
+
+def _setup(seed=0, **kw):
+    model = _model(**kw)
+    toks = jnp.asarray(
+        np.random.RandomState(seed).randint(1, VOCAB, size=(2, 8)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    return model, params, toks[:, :6]
+
+
+def _seq_logprob(model, params, full, n):
+    logits = model.apply({"params": params}, full[:, :-1])
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    pick = jnp.take_along_axis(lp, full[:, 1:, None], -1)[..., 0]
+    return pick[:, -n:].sum(-1)
+
+
+class TestBeam:
+    def test_beam_one_is_greedy(self):
+        model, params, prompt = _setup()
+        g = generate(model, params, prompt, N)
+        b1 = make_beam_search_fn(model, max_new_tokens=N, beam_size=1)(
+            params, prompt
+        )
+        np.testing.assert_array_equal(np.asarray(b1), np.asarray(g))
+
+    def test_scores_match_teacher_forced_recompute(self):
+        model, params, prompt = _setup(1)
+        toks, scores = make_beam_search_fn(
+            model, max_new_tokens=N, beam_size=4, return_scores=True
+        )(params, prompt)
+        want = _seq_logprob(model, params, toks, N)
+        np.testing.assert_allclose(
+            np.asarray(scores), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+    def test_beats_or_matches_greedy_likelihood(self):
+        model, params, prompt = _setup(2)
+        g = generate(model, params, prompt, N)
+        toks = make_beam_search_fn(model, max_new_tokens=N, beam_size=4)(
+            params, prompt
+        )
+        lp_beam = _seq_logprob(model, params, toks, N)
+        lp_greedy = _seq_logprob(model, params, g, N)
+        assert (np.asarray(lp_beam) >= np.asarray(lp_greedy) - 1e-4).all()
+
+    def test_gqa_model(self):
+        model, params, prompt = _setup(3, n_kv_heads=2)
+        toks, scores = make_beam_search_fn(
+            model, max_new_tokens=N, beam_size=3, return_scores=True
+        )(params, prompt)
+        want = _seq_logprob(model, params, toks, N)
+        np.testing.assert_allclose(
+            np.asarray(scores), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+    def test_quantized_matches_quantized_greedy_at_beam_one(self):
+        from horovod_tpu.models.decoding import make_generate_fn
+        from horovod_tpu.models.quant import quantize_params
+
+        model, params, prompt = _setup(4)
+        q = quantize_params(params, min_size=64)
+        g = make_generate_fn(model, max_new_tokens=N, quantized=True)(
+            q, prompt, jax.random.PRNGKey(0)
+        )
+        b1 = make_beam_search_fn(
+            model, max_new_tokens=N, beam_size=1, quantized=True
+        )(q, prompt)
+        np.testing.assert_array_equal(np.asarray(b1), np.asarray(g))
+
+    def test_eos_freezes_and_pads(self):
+        """After a beam emits eos it expands only to eos at zero score
+        cost, and the returned row is eos-padded past the first eos."""
+        model, params, prompt = _setup(5)
+        eos = 7
+        toks = make_beam_search_fn(
+            model, max_new_tokens=N, beam_size=3, eos_id=eos,
+            include_prompt=False,
+        )(params, prompt)
+        arr = np.asarray(toks)
+        for row in arr:
+            hits = np.where(row == eos)[0]
+            if hits.size:
+                assert (row[hits[0]:] == eos).all()
+
+    def test_include_prompt_and_validation(self):
+        model, params, prompt = _setup(6)
+        full = make_beam_search_fn(model, max_new_tokens=4, beam_size=2)(
+            params, prompt
+        )
+        tail = make_beam_search_fn(
+            model, max_new_tokens=4, beam_size=2, include_prompt=False
+        )(params, prompt)
+        np.testing.assert_array_equal(
+            np.asarray(full[:, 6:]), np.asarray(tail)
+        )
+        with pytest.raises(ValueError, match="beam_size"):
+            make_beam_search_fn(model, max_new_tokens=4, beam_size=0)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            make_beam_search_fn(model, max_new_tokens=0, beam_size=2)
+
+    def test_length_penalty_prefers_longer(self):
+        """With eos in play, a positive length penalty divides scores by
+        ((5+len)/6)^alpha — the returned score must equal the penalized
+        recompute (bookkeeping check, not a behavioral claim)."""
+        model, params, prompt = _setup(7)
+        eos = 3
+        toks, scores = make_beam_search_fn(
+            model, max_new_tokens=N, beam_size=3, eos_id=eos,
+            length_penalty=0.8, return_scores=True, include_prompt=False,
+        )(params, prompt)
+        arr = np.asarray(toks)
+        # recompute: raw logprob of the kept tokens / penalty(len)
+        full = jnp.concatenate([prompt, toks], axis=1)
+        lp = np.asarray(_seq_logprob_masked(model, params, full, arr, eos))
+        lens = []
+        for row in arr:
+            hits = np.where(row == eos)[0]
+            lens.append(hits[0] + 1 if hits.size else N)
+        norm = ((5.0 + np.asarray(lens)) / 6.0) ** 0.8
+        np.testing.assert_allclose(
+            np.asarray(scores), lp / norm, rtol=1e-3, atol=1e-3
+        )
+
+
+def _seq_logprob_masked(model, params, full, gen_arr, eos):
+    """Raw log-prob of generated tokens up to and including the first eos
+    (positions after it were force-padded and contributed zero score)."""
+    n = gen_arr.shape[1]
+    logits = model.apply({"params": params}, full[:, :-1])
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    pick = np.asarray(
+        jnp.take_along_axis(lp, full[:, 1:, None], -1)[..., 0]
+    )[:, -n:]
+    out = []
+    for row_lp, row in zip(pick, gen_arr):
+        hits = np.where(row == eos)[0]
+        ln = hits[0] + 1 if hits.size else n
+        out.append(row_lp[:ln].sum())
+    return np.asarray(out)

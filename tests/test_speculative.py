@@ -325,3 +325,27 @@ class TestSampledSpeculative:
             model, max_new_tokens=8, gamma=3, temperature=0.0, top_k=5,
         )(params, prompt)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestQuantizedSpeculative:
+    def test_exact_vs_quantized_plain_greedy(self):
+        """int8 target + speculative: both paths consult the same quantized
+        weights, so the greedy exactness contract carries over bit-for-bit
+        against make_generate_fn(quantized=True)."""
+        from horovod_tpu.models.decoding import make_generate_fn
+        from horovod_tpu.models.quant import quantize_params
+
+        model = _model()
+        params = _params(model)
+        qparams = quantize_params(params, min_size=64)
+        prompt = jnp.asarray(
+            np.random.RandomState(41).randint(1, VOCAB, size=(2, 10)),
+            jnp.int32,
+        )
+        want = make_generate_fn(model, max_new_tokens=16, quantized=True)(
+            qparams, prompt, jax.random.PRNGKey(0)
+        )
+        got = make_speculative_fn(
+            model, max_new_tokens=16, gamma=4, quantized=True
+        )(qparams, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
