@@ -61,6 +61,10 @@ def _lm_from_env(*, moe: bool = False):
         # shrinks by n_heads/n_kv_heads (the BENCH_MODEL=decode A/B knob).
         n_kv_heads=int(os.environ.get("BENCH_KV_HEADS", 0)) or None,
         n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
+        # BENCH_WINDOW: sliding-window (local) attention — the flash kernel
+        # block-skips tiles outside the band, so long-seq steps get
+        # proportionally faster (and MFU accounts the executed band only).
+        window=int(os.environ.get("BENCH_WINDOW", 0)) or None,
         compute_dtype=jnp.bfloat16,
         dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
         # ~12%/step — HVT_FAST_RNG=1 makes dropout free when wanted)
@@ -267,13 +271,28 @@ def bench_train(which: str) -> dict:
         if fa_kernel.supported(
             q_shape, *blocks, dtype=jnp.bfloat16, segmented=seg
         ):
-            fa = trace.flash_attention_flops(
-                per_chip_batch * n_chips, seq_len, seq_len, heads, head_dim,
-            ) * int(os.environ.get("BENCH_NLAYERS", 8))
+            window = int(os.environ.get("BENCH_WINDOW", 0)) or None
+            n_layers = int(os.environ.get("BENCH_NLAYERS", 8))
             if n_docs:
-                # Segment block-skip: only same-document tiles execute —
-                # equal-length packing runs ~1/n_docs of the causal tiles.
-                fa /= n_docs
+                # Equal-length packed documents: executed score entries are
+                # the band ∩ same-document area — per doc of length L,
+                # w·L − w(w−1)/2 with w = min(window, L) (w = L is the
+                # plain causal triangle L(L+1)/2), summed over docs. Plain
+                # min() of the two discounts overstates it near window ≈ L
+                # (the band crosses doc boundaries, where the segment
+                # early-out skips tiles).
+                L = seq_len // n_docs
+                w = min(window or L, L)
+                per_doc = w * L - w * (w - 1) / 2.0
+                fa = trace.flash_attention_flops(
+                    per_chip_batch * n_chips, seq_len, seq_len, heads,
+                    head_dim, causal=False,
+                ) * n_layers * (n_docs * per_doc / float(seq_len) ** 2)
+            else:
+                fa = trace.flash_attention_flops(
+                    per_chip_batch * n_chips, seq_len, seq_len, heads,
+                    head_dim, window=window,
+                ) * n_layers
             flops += fa
 
     # --- end-to-end: training WITH its input pipeline — the device-resident

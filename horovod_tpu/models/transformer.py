@@ -118,6 +118,14 @@ class Block(nn.Module):
     # decode pays for). None = MHA (the fused qkv projection, param-layout
     # compatible with existing checkpoints).
     n_kv_heads: int | None = None
+    # Sliding-window attention (Mistral-style local attention,
+    # arXiv:2310.06825): each query sees only its `window` most recent
+    # keys. The flash kernel block-skips tiles outside the band (FLOPs
+    # scale with T·window, not T²/2), the ring variant skips whole
+    # out-of-band hops, and the decode path masks the stale cache prefix.
+    # Window counts ROW positions (token distance within a packed row),
+    # composing with segment masking by intersection. None = full causal.
+    window: int | None = None
     # MoE (expert-parallel) MLP instead of the dense one: the EP capability,
     # routed over the mesh's `expert` axis (models/moe.py).
     use_moe: bool = False
@@ -215,7 +223,8 @@ class Block(nn.Module):
             # rotates the kv ids, Ulysses all-gathers them (ops/attention).
             spec = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
             impl = functools.partial(
-                impls[cfg.attn], axis_name=SEQ_AXIS, causal=True
+                impls[cfg.attn], axis_name=SEQ_AXIS, causal=True,
+                window=self.window,
             )
             if segment_ids is None:
                 fn, args, in_specs = impl, (q, k, v), (spec, spec, spec)
@@ -229,7 +238,7 @@ class Block(nn.Module):
             )(*args)
         elif cfg.attn == "dense":
             out = attention_ops.dense_attention(
-                q, k, v, causal=True,
+                q, k, v, causal=True, window=self.window,
                 q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
             )
         else:
@@ -243,7 +252,7 @@ class Block(nn.Module):
 
             def local(q, k, v, ids=None):
                 return flash_attention(
-                    q, k, v, causal=True,
+                    q, k, v, causal=True, window=self.window,
                     q_segment_ids=ids, kv_segment_ids=ids,
                 )
 
@@ -351,7 +360,9 @@ class Block(nn.Module):
             if rep > 1:  # prefill attends at full H, like training
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            local = functools.partial(flash_attention, causal=True)
+            local = functools.partial(
+                flash_attention, causal=True, window=self.window
+            )
             if cfg.mesh is not None and cfg.mesh.size > 1:
                 spec = P(BATCH_AXES, None, MODEL_AXIS, None)
                 local = jax.shard_map(
@@ -376,7 +387,12 @@ class Block(nn.Module):
         ) * scale
         kpos = jnp.arange(self.max_decode_len, dtype=jnp.int32)
         qpos = idx + jnp.arange(t, dtype=jnp.int32)
-        valid = (kpos[None, :] <= qpos[:, None])[None, None, None, :, :]
+        valid = kpos[None, :] <= qpos[:, None]
+        if self.window is not None:
+            # Sliding window over the cache: a query at qpos sees cache
+            # rows in (qpos − window, qpos] — the same band training used.
+            valid &= kpos[None, :] > qpos[:, None] - self.window
+        valid = valid[None, None, None, :, :]
         s = jnp.where(valid, s, attention_ops._BIG_NEG)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum(
@@ -397,6 +413,9 @@ class TransformerLM(nn.Module):
     # and the bytes streamed per generated token — by that group factor;
     # training FLOPs are unchanged. None = MHA (fused qkv projection).
     n_kv_heads: int | None = None
+    # Sliding-window (local) attention: each query attends to its `window`
+    # most recent tokens only (see Block.window). None = full causal.
+    window: int | None = None
     n_layers: int = 4
     dropout: float = 0.1
     compute_dtype: jnp.dtype = jnp.float32
@@ -463,6 +482,7 @@ class TransformerLM(nn.Module):
                 self.d_model, self.n_heads, self.dropout,
                 self.compute_dtype, cfg,
                 n_kv_heads=self.n_kv_heads,
+                window=self.window,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts,
                 moe_k=self.moe_k,

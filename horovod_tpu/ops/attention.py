@@ -33,6 +33,20 @@ from jax import lax
 _BIG_NEG = -1e30
 
 
+def check_window(window, causal) -> None:
+    """Validate a sliding-window request (shared by every attention impl:
+    dense, ring, Ulysses, and the flash kernel)."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True — the "
+            "band is defined as each query's `window` most recent keys"
+        )
+    if window < 1:
+        raise ValueError(f"window must be a positive int, got {window}")
+
+
 def _scores(q, k, scale):
     """[B,Tq,H,D] x [B,Tk,H,D] -> [B,H,Tq,Tk] logits on the MXU."""
     return jnp.einsum(
@@ -41,14 +55,17 @@ def _scores(q, k, scale):
 
 
 def dense_attention(q, k, v, *, causal: bool = True, q_segment_ids=None,
-                    kv_segment_ids=None):
+                    kv_segment_ids=None, window: int | None = None):
     """Reference full-materialization attention (numerics ground truth).
 
     float32 softmax regardless of input dtype — bf16 logits lose too much for
     long sequences; the matmuls still run in the inputs' dtype on the MXU.
     ``q_segment_ids``/``kv_segment_ids`` ([B,Tq]/[B,Tk]) restrict attention
     to equal-id pairs (packed sequences) — the reference semantics the flash
-    kernel's segment masking is tested against."""
+    kernel's segment masking is tested against. ``window`` (requires
+    ``causal``) further restricts each query to its ``window`` most recent
+    keys (the sliding-window band the flash kernel block-skips)."""
+    check_window(window, causal)
     scale = q.shape[-1] ** -0.5
     s = _scores(q, k, scale)
     keep = None
@@ -57,6 +74,8 @@ def dense_attention(q, k, v, *, causal: bool = True, q_segment_ids=None,
         q_pos = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + (tk - tq)
         k_pos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
         keep = (q_pos >= k_pos)[None, None]
+        if window is not None:
+            keep &= (k_pos > q_pos - window)[None, None]
     if q_segment_ids is not None:
         seg = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
         keep = seg if keep is None else keep & seg
@@ -77,7 +96,8 @@ def dense_attention(q, k, v, *, causal: bool = True, q_segment_ids=None,
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
+                   window: int | None = None):
     """Exact blockwise attention over a sequence-sharded ring.
 
     Inside `shard_map`: q/k/v are this device's ``[B, T/n, H, D]`` shard of
@@ -88,7 +108,13 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     neighbor ICI sends that overlap with the attention matmuls of the
     current block. `lax.scan` (not fori_loop) so reverse-mode AD works and
     the backward pass replays the ring.
+
+    ``window`` (requires ``causal``): sliding-window band over GLOBAL
+    positions — queries see their ``window`` most recent keys across shard
+    boundaries; hops carrying only stale keys contribute zero (their lanes
+    mask away; the flash-ring variant additionally skips their FLOPs).
     """
+    check_window(window, causal)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
@@ -105,7 +131,10 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
 
         s = _scores(q, k_blk, scale)  # [B,H,Tq,Tk] float32
         if causal:
-            mask = (q_pos[:, None] >= k_pos[None, :]).astype(s.dtype)
+            keep = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:
+                keep &= k_pos[None, :] > q_pos[:, None] - window
+            mask = keep.astype(s.dtype)
         else:
             mask = jnp.ones((t_local, t_local), s.dtype)
         s = s + (1.0 - mask) * _BIG_NEG
@@ -136,7 +165,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
 
 
 def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
-                         segment_ids=None):
+                         segment_ids=None, window: int | None = None):
     """Ring attention whose per-hop block attention is the pallas flash
     kernel — the within-chip and cross-chip halves of the SAME online
     softmax: each hop computes its block's ``(out, lse)`` in O(T/n) memory
@@ -156,37 +185,75 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
     early-out prunes segment-disjoint tiles — so a packed ring pays ICI for
     every hop but FLOPs only where documents actually overlap. Every token
     belongs to its own segment and (causal) sees at least itself, so the
-    merge normalizer never vanishes."""
+    merge normalizer never vanishes.
+
+    ``window`` (requires ``causal``): sliding-window band over GLOBAL
+    positions. Each hop runs the kernel with ``q_offset = hop_distance ×
+    T/n`` so the band arithmetic sees true positions — hops entirely
+    outside the window become static skip branches (zero kernel calls, via
+    `lax.switch` over the hop distance), and a partially-covered hop
+    block-skips its stale tiles in-kernel. The ring itself still makes all
+    n − 1 ppermute hops (a collective must be uniform across the axis), so
+    a window prunes FLOPs, not ICI traffic."""
     from horovod_tpu.ops.flash_attention import flash_attention_with_lse
 
+    check_window(window, causal)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
 
-    def hop_contrib(j, k_blk, v_blk, ks_blk):
-        """(out, lse) of my queries against global block j."""
-        seg_kw = (
+    def seg_kw(ks_blk):
+        return (
             dict(q_segment_ids=segment_ids, kv_segment_ids=ks_blk)
             if segment_ids is not None
             else {}
         )
 
+    def skip(*_):
+        # Contributes nothing: lse = -BIG weights it to zero in the merge
+        # without running any attention.
+        return (
+            jnp.zeros((b, t_local, h, d), q.dtype),
+            jnp.full((b, t_local, h), _BIG_NEG, jnp.float32),
+        )
+
+    def hop_contrib(i, j, k_blk, v_blk, ks_blk):
+        """(out, lse) of my queries against the block born at rank j,
+        held here on hop i."""
+
         def diag(_):
-            return flash_attention_with_lse(q, k_blk, v_blk, causal=True, **seg_kw)
+            return flash_attention_with_lse(
+                q, k_blk, v_blk, causal=True, window=window, **seg_kw(ks_blk)
+            )
 
         def full(_):
-            return flash_attention_with_lse(q, k_blk, v_blk, causal=False, **seg_kw)
-
-        def skip(_):
-            # Entirely above the diagonal: lse = -BIG weights it to zero in
-            # the merge without running any attention.
-            return (
-                jnp.zeros((b, t_local, h, d), q.dtype),
-                jnp.full((b, t_local, h), _BIG_NEG, jnp.float32),
+            return flash_attention_with_lse(
+                q, k_blk, v_blk, causal=False, **seg_kw(ks_blk)
             )
 
         if not causal:
             return full(None)
+        if window is not None:
+            # Hop distance d = my − j (mod n) equals the scan index i for
+            # past blocks; wrapped hops (i > my, future blocks) route to the
+            # extra skip branch. Each past distance gets its own STATIC
+            # q_offset = d·T/n so the kernel's band arithmetic is global —
+            # and distances whose newest key is already stale collapse to
+            # skip at trace time (no kernel call compiled at all).
+            def past(dist):
+                if dist * t_local - (t_local - 1) >= window:
+                    return skip  # even (row 0, col T/n−1) is out of band
+
+                def branch(_):
+                    return flash_attention_with_lse(
+                        q, k_blk, v_blk, causal=True, window=window,
+                        q_offset=dist * t_local, **seg_kw(ks_blk)
+                    )
+
+                return branch
+
+            branches = [diag if dist == 0 else past(dist) for dist in range(n)]
+            return lax.switch(jnp.where(i <= my, i, n), branches + [skip], None)
         return lax.cond(
             j == my, diag, lambda x: lax.cond(j < my, full, skip, x), None
         )
@@ -194,7 +261,7 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
     def step(carry, i):
         o, m, l, k_blk, v_blk, ks_blk = carry
         j = (my - i) % n  # the block born at rank j is here after i hops
-        o_j, lse_j = hop_contrib(j, k_blk, v_blk, ks_blk)
+        o_j, lse_j = hop_contrib(i, j, k_blk, v_blk, ks_blk)
         m_new = jnp.maximum(m, lse_j)
         alpha = jnp.exp(m - m_new)
         w = jnp.exp(lse_j - m_new)
@@ -217,7 +284,7 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
-                      segment_ids=None):
+                      segment_ids=None, window: int | None = None):
     """All-to-all sequence parallelism: swap seq-sharding for head-sharding,
     attend over the full sequence locally, swap back.
 
@@ -252,6 +319,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
         full_ids = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
         seg_kw = dict(q_segment_ids=full_ids, kv_segment_ids=full_ids)
     out = flash_attention(
-        to_heads(q), to_heads(k), to_heads(v), causal=causal, **seg_kw
+        to_heads(q), to_heads(k), to_heads(v), causal=causal, window=window,
+        **seg_kw
     )
     return to_seq(out)

@@ -29,7 +29,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from horovod_tpu.ops.attention import dense_attention
+from horovod_tpu.ops.attention import check_window, dense_attention
 
 _BIG_NEG = -1e30
 # 1024-square tiles won the measured block sweep on v5e (benchmarks/
@@ -54,29 +54,42 @@ _SEG_LANES = 128
 _SEG_SUBLANES = 8
 
 
-def _causal_mask(iq, ik, bq, bk, offset):
+def _causal_mask(iq, ik, bq, bk, offset, window=None):
     """[bq, bk] 0/1 mask for global rows iq*bq+r+offset ≥ cols ik*bk+c.
 
     ``offset = Tk - Tq`` aligns the sequences at the END (the standard
     cross-attention/decode convention, matching `_dense_with_lse`): query i
-    sees keys j ≤ i + Tk - Tq. Zero for self-attention."""
+    sees keys j ≤ i + Tk - Tq. Zero for self-attention. ``window`` further
+    restricts to the sliding band row − col < window (Mistral-style local
+    attention: each query sees its ``window`` most recent keys, itself
+    included)."""
     rows = iq * bq + offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return (rows >= cols).astype(jnp.float32)
+    keep = rows >= cols
+    if window is not None:
+        keep &= cols > rows - window
+    return keep.astype(jnp.float32)
 
 
-def _tile_mask(iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref):
+def _tile_mask(iq, ik, causal, segmented, bq, bk, offset, window,
+               qs_ref, ks_ref):
     """(needed, mask): the block-skip predicate and the [bq, bk] 0/1 mask
     (None when unmasked). ``needed`` is False when the whole tile is
-    provably masked — above the causal diagonal, or (segment early-out) the
-    q block's id range cannot intersect the k block's (a NECESSARY condition
-    for any equality match, so the skip is sound for arbitrary id layouts,
-    and tight for the contiguous runs packing produces)."""
+    provably masked — above the causal diagonal, below the sliding-window
+    band, or (segment early-out) the q block's id range cannot intersect
+    the k block's (a NECESSARY condition for any equality match, so the
+    skip is sound for arbitrary id layouts, and tight for the contiguous
+    runs packing produces)."""
     needed = True
     mask = None
     if causal:
         needed = ik * bk <= iq * bq + bq - 1 + offset
-        mask = _causal_mask(iq, ik, bq, bk, offset)
+        if window is not None:
+            # The tile's newest key vs the tile's oldest query's horizon:
+            # every (row, col) has row − col ≥ (iq*bq + offset) − (ik*bk +
+            # bk − 1); when even that gap ≥ window the whole tile is stale.
+            needed &= ik * bk + bk - 1 > iq * bq + offset - window
+        mask = _causal_mask(iq, ik, bq, bk, offset, window)
     if segmented:
         qs = qs_ref[0]  # [bq, LANES]
         ks = ks_ref[0, 0:1, :]  # [1, bk]
@@ -88,17 +101,36 @@ def _tile_mask(iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref):
     return needed, mask
 
 
+def _band_lo_k(iq, bq, bk, offset, window):
+    """First k block holding any in-band column for q block ``iq`` (the
+    oldest visible key of the block's first row), clamped to 0. Floor
+    division handles a negative numerator (band starting before key 0)."""
+    return jnp.maximum(0, (iq * bq + offset - (window - 1)) // bk)
+
+
+def _band_lo_q(ik, bq, bk, offset, window):
+    """First q block holding any row that sees k block ``ik`` (rows r with
+    0 ≤ r + offset − c < window for some c in the block), clamped to 0."""
+    return jnp.maximum(0, (ik * bk - offset) // bq)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
-                bq, bk, offset):
+                bq, bk, offset, window, banded, nk):
     if segmented:
         qs_ref, ks_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
         qs_ref = ks_ref = None
-    iq, ik = pl.program_id(2), pl.program_id(3)
-    nk = pl.num_programs(3)
+    iq, jj = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+    # Banded (sliding-window) grids enumerate ONLY the k blocks near the
+    # band: grid coordinate jj walks lo(iq) .. lo(iq)+nj−1 — O(T·window)
+    # tiles (and, crucially, O(T·window) K/V DMA: a predicated-off tile in
+    # a full grid still streams its block; a tile the grid never names
+    # does not). The top-clipped DMA duplicates mask off via `needed`.
+    ik = _band_lo_k(iq, bq, bk, offset, window) + jj if banded else jj
 
-    @pl.when(ik == 0)
+    @pl.when(jj == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, _BIG_NEG)
@@ -109,8 +141,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
     # update away (half the FLOPs for causal; one matmul per co-resident
     # segment pair for packed sequences).
     needed, mask = _tile_mask(
-        iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref
+        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref
     )
+    if banded:
+        needed &= ik <= nk - 1  # clipped-DMA duplicates beyond the last block
 
     @pl.when(needed)
     def _():
@@ -137,7 +171,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
         )
         m_ref[:, 0:1] = m_new
 
-    @pl.when(ik == nk - 1)
+    @pl.when(jj == nj - 1)
     def _():
         l = l_ref[:, 0:1]
         # A row every key is masked away from (a padding segment with no kv
@@ -153,22 +187,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   scale, causal, segmented, bq, bk, offset):
+                   scale, causal, segmented, bq, bk, offset, window, banded,
+                   nk):
     if segmented:
         qs_ref, ks_ref, dq_ref, acc_ref = rest
     else:
         dq_ref, acc_ref = rest
         qs_ref = ks_ref = None
-    iq, ik = pl.program_id(2), pl.program_id(3)
-    nk = pl.num_programs(3)
+    iq, jj = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+    ik = _band_lo_k(iq, bq, bk, offset, window) + jj if banded else jj
 
-    @pl.when(ik == 0)
+    @pl.when(jj == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     needed, mask = _tile_mask(
-        iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref
+        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref
     )
+    if banded:
+        needed &= ik <= nk - 1
 
     @pl.when(needed)
     def _():
@@ -200,29 +238,33 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             preferred_element_type=jnp.float32,
         ) * scale
 
-    @pl.when(ik == nk - 1)
+    @pl.when(jj == nj - 1)
     def _():
         dq_ref[0, 0, :, :] = acc_ref[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                    scale, causal, segmented, bq, bk, offset):
+                    scale, causal, segmented, bq, bk, offset, window, banded,
+                    nq):
     if segmented:
         qs_ref, ks_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
         dk_ref, dv_ref, dk_acc, dv_acc = rest
         qs_ref = ks_ref = None
-    ik, iq = pl.program_id(2), pl.program_id(3)
-    nq = pl.num_programs(3)
+    ik, jj = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+    iq = _band_lo_q(ik, bq, bk, offset, window) + jj if banded else jj
 
-    @pl.when(iq == 0)
+    @pl.when(jj == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     needed, mask = _tile_mask(
-        iq, ik, causal, segmented, bq, bk, offset, qs_ref, ks_ref
+        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref
     )
+    if banded:
+        needed &= iq <= nq - 1
 
     @pl.when(needed)
     def _():
@@ -256,43 +298,57 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             preferred_element_type=jnp.float32,
         ) * scale
 
-    @pl.when(iq == nq - 1)
+    @pl.when(jj == nj - 1)
     def _():
         dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _block_spec(d, bt, *, inner: bool):
+# Grid-to-T-block selectors: the grid is (b, h, anchor, swept); a tensor's
+# T coordinate is either the anchored axis, the swept axis, or — for banded
+# (sliding-window) grids — a band around the anchor: lo(anchor) + swept,
+# clipped for the DMA (the kernels predicate the clipped duplicates off).
+def _anchor(i, j):
+    return i
+
+
+def _sweep(i, j):
+    return j
+
+
+def _sweep_banded(lo_fn, n_total):
+    return lambda i, j: jnp.clip(lo_fn(i) + j, 0, n_total - 1)
+
+
+def _block_spec(d, bt, tsel):
     """BlockSpec for [B,H,T,D] arrays: one (1, 1, bt, D) tile per (b, h)
     grid point — the (bt, D) tile sits in the trailing dims as the TPU
-    lowering requires. ``inner`` selects which grid coordinate walks this
-    tensor's T: the last (swept) one or the second-to-last (anchored) one."""
-    if inner:
-        return pl.BlockSpec((1, 1, bt, d), lambda ib, ih, i, j: (ib, ih, j, 0))
-    return pl.BlockSpec((1, 1, bt, d), lambda ib, ih, i, j: (ib, ih, i, 0))
+    lowering requires. ``tsel(i, j)`` maps the grid's (anchor, swept)
+    coordinates to this tensor's T-block index."""
+    return pl.BlockSpec(
+        (1, 1, bt, d), lambda ib, ih, i, j: (ib, ih, tsel(i, j), 0)
+    )
 
 
-def _stat_spec(bq, *, inner: bool):
+def _stat_spec(bq, tsel):
     """[B,H,T,1] per-row statistics (lse / delta)."""
-    if inner:
-        return pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, i, j: (ib, ih, j, 0))
-    return pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, i, j: (ib, ih, i, 0))
+    return pl.BlockSpec(
+        (1, 1, bq, 1), lambda ib, ih, i, j: (ib, ih, tsel(i, j), 0)
+    )
 
 
-def _seg_q_spec(bq, *, inner: bool):
+def _seg_q_spec(bq, tsel):
     """[B, Tq, LANES] q segment ids (no head dim — shared across heads)."""
-    if inner:
-        return pl.BlockSpec((1, bq, _SEG_LANES), lambda ib, ih, i, j: (ib, j, 0))
-    return pl.BlockSpec((1, bq, _SEG_LANES), lambda ib, ih, i, j: (ib, i, 0))
+    return pl.BlockSpec(
+        (1, bq, _SEG_LANES), lambda ib, ih, i, j: (ib, tsel(i, j), 0)
+    )
 
 
-def _seg_kv_spec(bk, *, inner: bool):
+def _seg_kv_spec(bk, tsel):
     """[B, SUBLANES, Tk] kv segment ids."""
-    if inner:
-        return pl.BlockSpec(
-            (1, _SEG_SUBLANES, bk), lambda ib, ih, i, j: (ib, 0, j)
-        )
-    return pl.BlockSpec((1, _SEG_SUBLANES, bk), lambda ib, ih, i, j: (ib, 0, i))
+    return pl.BlockSpec(
+        (1, _SEG_SUBLANES, bk), lambda ib, ih, i, j: (ib, 0, tsel(i, j))
+    )
 
 
 def _seg_operands(q_seg, kv_seg, tq, tk):
@@ -308,14 +364,18 @@ def _seg_operands(q_seg, kv_seg, tq, tk):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
 )
-def _flash(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
-    out, _ = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
+def _flash(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
+           interpret):
+    out, _ = _flash_fwd_impl(
+        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+    )
     return out
 
 
-def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
+def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
+                    interpret):
     # Kernel layout is [B, H, T, D] so the (T-block, D) tile occupies the
     # trailing dims; callers pass [B, T, H, D]. K/V carry their own Tk
     # (cross-attention); causality aligns the sequence ENDS via offset.
@@ -324,27 +384,40 @@ def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
     tk = kt.shape[2]
     segmented = q_seg is not None
     scale = d ** -0.5
-    grid = (b, h, tq // bq, tk // bk)
+    off = tk - tq if q_offset is None else q_offset
+    nq, nk = tq // bq, tk // bk
+    banded = window is not None
+    if banded:
+        # Sliding window: the swept grid axis walks only the ≤ nb k blocks
+        # that can intersect q block i's band (span bq + window − 1 cols,
+        # any alignment) — O(T·window) tiles AND K/V DMA instead of O(T²).
+        nb = min(nk, (bq + window - 2) // bk + 2)
+        ksel = _sweep_banded(
+            lambda i: _band_lo_k(i, bq, bk, off, window), nk
+        )
+    else:
+        nb, ksel = nk, _sweep
+    grid = (b, h, nq, nb)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, segmented=segmented,
-        bq=bq, bk=bk, offset=tk - tq,
+        bq=bq, bk=bk, offset=off, window=window, banded=banded, nk=nk,
     )
     in_specs = [
-        _block_spec(d, bq, inner=False),
-        _block_spec(d, bk, inner=True),
-        _block_spec(d, bk, inner=True),
+        _block_spec(d, bq, _anchor),
+        _block_spec(d, bk, ksel),
+        _block_spec(d, bk, ksel),
     ]
     operands = [qt, kt, vt]
     if segmented:
-        in_specs += [_seg_q_spec(bq, inner=False), _seg_kv_spec(bk, inner=True)]
+        in_specs += [_seg_q_spec(bq, _anchor), _seg_kv_spec(bk, ksel)]
         operands += list(_seg_operands(q_seg, kv_seg, tq, tk))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            _block_spec(d, bq, inner=False),
-            _stat_spec(bq, inner=False),
+            _block_spec(d, bq, _anchor),
+            _stat_spec(bq, _anchor),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qt.shape, q.dtype),
@@ -360,16 +433,22 @@ def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
-def _flash_fwd(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
+def _flash_fwd(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
+               interpret):
+    out, lse = _flash_fwd_impl(
+        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+    )
     return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
-def _flash_bwd(causal, bq, bk, interpret, res, g):
-    return _flash_bwd_core(causal, bq, bk, interpret, res, g, None)
+def _flash_bwd(causal, window, q_offset, bq, bk, interpret, res, g):
+    return _flash_bwd_core(
+        causal, window, q_offset, bq, bk, interpret, res, g, None
+    )
 
 
-def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
+def _flash_bwd_core(causal, window, q_offset, bq, bk, interpret, res, g,
+                    g_lse):
     """Shared backward: the lse cotangent (from `flash_attention_with_lse`
     consumers like the ring merge) folds into the per-row jacobian term —
     with s → p = exp(s−lse), o = p·v:  ds = p ⊙ (dp − (δ − dlse)) where
@@ -383,6 +462,21 @@ def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
     tk = kt.shape[2]
     segmented = q_seg is not None
     scale = d ** -0.5
+    off = tk - tq if q_offset is None else q_offset
+    nq, nk = tq // bq, tk // bk
+    banded = window is not None
+    if banded:
+        nb = min(nk, (bq + window - 2) // bk + 2)
+        ksel = _sweep_banded(
+            lambda i: _band_lo_k(i, bq, bk, off, window), nk
+        )
+        nbq = min(nq, (bk + window - 2) // bq + 2)
+        qsel = _sweep_banded(
+            lambda i: _band_lo_q(i, bq, bk, off, window), nq
+        )
+    else:
+        nb, ksel = nk, _sweep
+        nbq, qsel = nq, _sweep
     # delta_i = Σ_d dO·O — the softmax-jacobian row term, cheap outside.
     delta = jnp.einsum(
         "bthd,bthd->bht", g.astype(jnp.float32), out.astype(jnp.float32)
@@ -393,52 +487,52 @@ def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
     seg_ops = list(_seg_operands(q_seg, kv_seg, tq, tk)) if segmented else []
 
     dq_in_specs = [
-        _block_spec(d, bq, inner=False),
-        _block_spec(d, bk, inner=True),
-        _block_spec(d, bk, inner=True),
-        _block_spec(d, bq, inner=False),
-        _stat_spec(bq, inner=False),
-        _stat_spec(bq, inner=False),
+        _block_spec(d, bq, _anchor),
+        _block_spec(d, bk, ksel),
+        _block_spec(d, bk, ksel),
+        _block_spec(d, bq, _anchor),
+        _stat_spec(bq, _anchor),
+        _stat_spec(bq, _anchor),
     ]
     if segmented:
         dq_in_specs += [
-            _seg_q_spec(bq, inner=False), _seg_kv_spec(bk, inner=True)
+            _seg_q_spec(bq, _anchor), _seg_kv_spec(bk, ksel)
         ]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, segmented=segmented,
-            bq=bq, bk=bk, offset=tk - tq,
+            bq=bq, bk=bk, offset=off, window=window, banded=banded, nk=nk,
         ),
-        grid=(b, h, tq // bq, tk // bk),
+        grid=(b, h, nq, nb),
         in_specs=dq_in_specs,
-        out_specs=_block_spec(d, bq, inner=False),
+        out_specs=_block_spec(d, bq, _anchor),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, gt, lse, delta, *seg_ops)
 
     dkv_in_specs = [
-        _block_spec(d, bq, inner=True),
-        _block_spec(d, bk, inner=False),
-        _block_spec(d, bk, inner=False),
-        _block_spec(d, bq, inner=True),
-        _stat_spec(bq, inner=True),
-        _stat_spec(bq, inner=True),
+        _block_spec(d, bq, qsel),
+        _block_spec(d, bk, _anchor),
+        _block_spec(d, bk, _anchor),
+        _block_spec(d, bq, qsel),
+        _stat_spec(bq, qsel),
+        _stat_spec(bq, qsel),
     ]
     if segmented:
         dkv_in_specs += [
-            _seg_q_spec(bq, inner=True), _seg_kv_spec(bk, inner=False)
+            _seg_q_spec(bq, qsel), _seg_kv_spec(bk, _anchor)
         ]
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, segmented=segmented,
-            bq=bq, bk=bk, offset=tk - tq,
+            bq=bq, bk=bk, offset=off, window=window, banded=banded, nq=nq,
         ),
-        grid=(b, h, tk // bk, tq // bq),
+        grid=(b, h, nk, nbq),
         in_specs=dkv_in_specs,
         out_specs=[
-            _block_spec(d, bk, inner=False),
-            _block_spec(d, bk, inner=False),
+            _block_spec(d, bk, _anchor),
+            _block_spec(d, bk, _anchor),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(kt.shape, k.dtype),
@@ -458,37 +552,47 @@ def _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_lse(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
+               interpret):
     """Kernel entry that also RETURNS the per-row logsumexp — the statistic
     a cross-chip online-softmax merge needs (ring attention: each hop's
     (out, lse) pair is exactly one step of the recurrence)."""
-    out, lse = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
+    out, lse = _flash_fwd_impl(
+        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+    )
     return out, jnp.transpose(lse[..., 0], (0, 2, 1))  # [B,H,T,1]→[B,T,H]
 
 
-def _flash_lse_fwd(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, bq, bk, interpret)
+def _flash_lse_fwd(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
+                   interpret):
+    out, lse = _flash_fwd_impl(
+        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+    )
     return (
         (out, jnp.transpose(lse[..., 0], (0, 2, 1))),
         (q, k, v, q_seg, kv_seg, out, lse),
     )
 
 
-def _flash_lse_bwd(causal, bq, bk, interpret, res, cotangents):
+def _flash_lse_bwd(causal, window, q_offset, bq, bk, interpret, res,
+                   cotangents):
     g, g_lse = cotangents
-    return _flash_bwd_core(causal, bq, bk, interpret, res, g, g_lse)
+    return _flash_bwd_core(
+        causal, window, q_offset, bq, bk, interpret, res, g, g_lse
+    )
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _dense_with_lse(q, k, v, *, causal: bool, q_segment_ids=None,
-                    kv_segment_ids=None):
+                    kv_segment_ids=None, window=None, q_offset=None):
     """Dense (out, lse) fallback, numerically matching the kernel's
     conventions: f32 statistics, fully-masked rows get lse ≈ _BIG_NEG and
     zero output (so a merge weights them to zero), natively differentiable.
-    Also the segment-mask REFERENCE the kernel parity tests compare to."""
+    Also the segment/window-mask REFERENCE the kernel parity tests compare
+    to. ``window``/``q_offset`` as in `flash_attention`."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -497,9 +601,12 @@ def _dense_with_lse(q, k, v, *, causal: bool, q_segment_ids=None,
     keep = None
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + (tk - tq)
+        off = tk - tq if q_offset is None else q_offset
+        rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + off
         cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
         keep = rows >= cols  # [Tq, Tk], broadcasts over [B, H]
+        if window is not None:
+            keep &= cols > rows - window
     if q_segment_ids is not None:
         seg = (
             q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
@@ -551,18 +658,21 @@ def flash_attention_with_lse(
     block_k: int = DEFAULT_BLOCK_K,
     q_segment_ids=None,
     kv_segment_ids=None,
+    window: int | None = None,
+    q_offset: int | None = None,
     interpret: bool | None = None,
 ):
     """[B,Tq,H,D] attention returning ``(out, lse)`` with ``lse`` [B,Tq,H] —
     the building block for cross-chip softmax merges (ring attention).
     Same kernel/fallback/interpret policy as `flash_attention`; gradients
     flow through BOTH outputs (the lse cotangent folds into the kernel
-    backward's δ term)."""
+    backward's δ term). ``window``/``q_offset`` as in `flash_attention`."""
     _check_segment_shapes(q, k, q_segment_ids, kv_segment_ids)
+    check_window(window, causal)
     segmented = q_segment_ids is not None
     block_q, block_k = pick_blocks(
         q.shape[1], q.shape[-1], q.dtype, block_q, block_k, t_k=k.shape[1],
-        segmented=segmented,
+        segmented=segmented, windowed=window is not None,
     )
     if not supported(
         q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype,
@@ -571,12 +681,13 @@ def flash_attention_with_lse(
         return _dense_with_lse(
             q, k, v, causal=causal,
             q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            window=window, q_offset=q_offset,
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash_lse(
-        q, k, v, q_segment_ids, kv_segment_ids, causal, block_q, block_k,
-        interpret,
+        q, k, v, q_segment_ids, kv_segment_ids, causal, window, q_offset,
+        block_q, block_k, interpret,
     )
 
 
@@ -616,7 +727,8 @@ def supported(q_shape, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K,
 
 def pick_blocks(t: int, d: int, dtype, bq: int = DEFAULT_BLOCK_Q,
                 bk: int = DEFAULT_BLOCK_K, t_k: int | None = None,
-                segmented: bool = False) -> tuple[int, int]:
+                segmented: bool = False,
+                windowed: bool = False) -> tuple[int, int]:
     """Largest workable (block_q, block_k) ≤ the requested sizes for a
     [*, t, *, d] attention call (``t_k`` = K/V's own length for
     cross-attention; default self-attention): clamp for wide heads (a 1024²
@@ -627,10 +739,13 @@ def pick_blocks(t: int, d: int, dtype, bq: int = DEFAULT_BLOCK_Q,
     t_k = t if t_k is None else t_k
     if d > 128:
         bq, bk = min(bq, 512), min(bk, 512)
-    if segmented:
-        # The double-buffered segment-id tiles ([bq, LANES] i32 q-ids) push
-        # 1024² configs ~0.8 MB past v5e's 16 MB VMEM stack; 512² fits with
-        # headroom and measured within a few % of 1024² in the block sweep.
+    if segmented or windowed:
+        # Extra in-kernel operands push 1024² past v5e's 16 MB VMEM stack:
+        # the double-buffered segment-id tiles cost ~0.8 MB, and the band
+        # mask's [bq, bk] i32 iotas a few hundred KB (measured 16.30M vs
+        # the 16M limit at seq 32768). 512² fits with headroom, measured
+        # within a few % of 1024² in the block sweep — and for windows a
+        # smaller K block also tightens the block-skip granularity.
         bq, bk = min(bq, 512), min(bk, 512)
     bq, bk = min(bq, t), min(bk, t_k)
     # Degrade no further than 128: below that the kernel's tiny score tiles
@@ -654,6 +769,8 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     q_segment_ids=None,
     kv_segment_ids=None,
+    window: int | None = None,
+    q_offset: int | None = None,
     interpret: bool | None = None,
 ):
     """[B,Tq,H,D] attention via the pallas kernel; dense fallback when the
@@ -665,27 +782,39 @@ def flash_attention(
     (multiple documents per row, none attending across its neighbors), with
     block-level early-out so disjoint tile pairs cost no FLOPs. K/V may
     carry their own length Tk ≠ Tq (cross-attention); with ``causal`` the
-    sequences align at their ENDS (query i sees keys j ≤ i + Tk − Tq)."""
+    sequences align at their ENDS (query i sees keys j ≤ i + Tk − Tq).
+
+    ``window`` (sliding-window attention, Mistral-style: each query sees
+    only its ``window`` most recent keys, itself included — requires
+    ``causal``) masks the band row − col < window AND block-skips tiles
+    entirely outside it, so FLOPs scale with T·window instead of T²/2.
+    ``q_offset`` overrides the q↔k alignment: query row i sits at key
+    position i + q_offset (default Tk − Tq, the end-aligned convention);
+    ring attention uses it to place a remote K/V block's hop distance into
+    the causal/window arithmetic."""
     _check_segment_shapes(q, k, q_segment_ids, kv_segment_ids)
+    check_window(window, causal)
     segmented = q_segment_ids is not None
     block_q, block_k = pick_blocks(
         q.shape[1], q.shape[-1], q.dtype, block_q, block_k, t_k=k.shape[1],
-        segmented=segmented,
+        segmented=segmented, windowed=window is not None,
     )
     if not supported(
         q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype,
         segmented=segmented,
     ):
-        if segmented or k.shape[1] != q.shape[1]:
+        if segmented or k.shape[1] != q.shape[1] or window is not None \
+                or q_offset is not None:
             out, _ = _dense_with_lse(
                 q, k, v, causal=causal,
                 q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+                window=window, q_offset=q_offset,
             )
             return out
         return dense_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(
-        q, k, v, q_segment_ids, kv_segment_ids, causal, block_q, block_k,
-        interpret,
+        q, k, v, q_segment_ids, kv_segment_ids, causal, window, q_offset,
+        block_q, block_k, interpret,
     )

@@ -75,7 +75,8 @@ def compiled_cost_flops(compiled) -> float | None:
 
 def flash_attention_flops(batch: int, seq_q: int, seq_k: int, heads: int,
                           head_dim: int, *, causal: bool = True,
-                          backward: bool = True) -> float:
+                          backward: bool = True,
+                          window: int | None = None) -> float:
     """Matmul FLOPs one flash-attention call actually executes — the part
     XLA's cost model cannot see (a Mosaic custom call is opaque to it;
     BASELINE.md footnote 1).
@@ -85,10 +86,16 @@ def flash_attention_flops(batch: int, seq_q: int, seq_k: int, heads: int,
     backward runs 7 (dq pass: recomputed scores, dP, dQ; dkv pass:
     recomputed scores, dV, dP, dK). Each full-sequence dot is
     ``2·B·H·Tq·Tk·D`` FLOPs; causal block-skipping halves the executed
-    tiles. Training callers add this per flash call (per layer, per step)
-    to the XLA cost-model count."""
+    tiles, and a sliding ``window`` shrinks them to the band area
+    W·T − W(W−1)/2 (self-attention; element-granularity approximation of
+    the tile-granular skip). Training callers add this per flash call (per
+    layer, per step) to the XLA cost-model count."""
     per_dot = 2.0 * batch * heads * seq_q * seq_k * head_dim
     dots = 9 if backward else 2
+    if causal and window is not None:
+        w = min(window, seq_k)
+        frac = (w * seq_q - w * (w - 1) / 2.0) / (seq_q * seq_k)
+        return dots * per_dot * frac
     return dots * per_dot * (0.5 if causal else 1.0)
 
 

@@ -261,3 +261,113 @@ def test_dense_attention_empty_segment_rows_zero():
     empty = np.asarray(q_seg)[0] == 1
     np.testing.assert_array_equal(np.asarray(out)[0, empty], 0.0)
     assert np.isfinite(np.asarray(out)).all()
+
+
+class TestWindowedSequenceParallel:
+    """Sliding-window attention across shard boundaries: the band is over
+    GLOBAL positions, so it must be exact through the ring's hop arithmetic
+    (static q_offset per hop distance) and Ulysses' head swap."""
+
+    @pytest.mark.parametrize("window", [1, 5, 8, 20, T])
+    def test_ring_flash_matches_dense(self, window):
+        q, k, v = _qkv(21)
+        expected = dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, window=window,
+        )
+        got = _sharded(
+            ring_flash_attention, _seq_mesh(), causal=True, window=window
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    @pytest.mark.parametrize("window", [5, 20])
+    def test_ring_dense_matches_dense(self, window):
+        q, k, v = _qkv(22)
+        expected = dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, window=window,
+        )
+        got = _sharded(
+            ring_attention, _seq_mesh(), causal=True, window=window
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_ulysses_matches_dense(self):
+        q, k, v = _qkv(23)
+        expected = dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, window=9,
+        )
+        got = _sharded(
+            ulysses_attention, _seq_mesh(), causal=True, window=9
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_ring_flash_gradients(self):
+        q, k, v = _qkv(24)
+        mesh = _seq_mesh()
+        window = 11
+
+        def loss_ring(q, k, v):
+            out = _sharded(
+                ring_flash_attention, mesh, causal=True, window=window
+            )(q, k, v)
+            return (out ** 2).sum()
+
+        def loss_dense(q, k, v):
+            return (
+                dense_attention(q, k, v, causal=True, window=window) ** 2
+            ).sum()
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(
+            *map(jnp.asarray, (q, k, v))
+        )
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(
+            *map(jnp.asarray, (q, k, v))
+        )
+        for a, b in zip(g_ring, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_ring_flash_segments_and_window(self):
+        """Packed docs riding the windowed ring: intersection semantics,
+        global-position band."""
+        rng = np.random.RandomState(25)
+        q, k, v = _qkv(25)
+        ids = np.sort(rng.randint(0, 3, size=(B, T)), axis=1).astype(np.int32)
+        expected = dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True,
+            window=13, q_segment_ids=jnp.asarray(ids),
+            kv_segment_ids=jnp.asarray(ids),
+        )
+        mesh = _seq_mesh()
+        spec = P(None, "seq", None, None)
+        got = jax.jit(
+            shard_map(
+                lambda q, k, v, ids: ring_flash_attention(
+                    q, k, v, axis_name="seq", causal=True, window=13,
+                    segment_ids=ids,
+                ),
+                mesh=mesh,
+                in_specs=(spec, spec, spec, P(None, "seq")),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )(q, k, v, ids)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_window_requires_causal(self):
+        q, k, v = _qkv(26)
+        with pytest.raises(ValueError, match="causal"):
+            _sharded(
+                ring_flash_attention, _seq_mesh(), causal=False, window=4
+            )(q, k, v)

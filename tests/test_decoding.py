@@ -291,3 +291,67 @@ class TestGQADecode:
         )
         got = generate(sharded, params, prompt, 10)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSlidingWindowDecode:
+    """window models decode through the cache with the same band the
+    training forward used: a cached decode must equal the full recompute
+    (whose attention masks the band in the training path)."""
+
+    def test_cache_decode_equals_full_recompute(self):
+        model = _model(window=6)
+        params = _params(model)
+        prompt = np.array([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+        # 14 new tokens: generation runs well past the window so stale
+        # cache rows MUST be masked away (an unmasked cache would diverge
+        # from the windowed recompute).
+        want = _greedy_no_cache(model, params, prompt, 14)
+        got = generate(model, params, prompt, 14)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_window_changes_output(self):
+        """Sanity: the window actually binds at these lengths (otherwise
+        the parity test above proves nothing)."""
+        full = _model()
+        windowed = _model(window=3)
+        params = _params(full)
+        prompt = np.array([[3, 1, 4, 1, 5]], np.int32)
+        a = np.asarray(generate(full, params, prompt, 14))
+        b = np.asarray(generate(windowed, params, prompt, 14))
+        assert not np.array_equal(a, b)
+
+    def test_prefill_logits_match_training_forward(self):
+        model = _model(window=4)
+        params = _params(model)
+        prompt = jnp.arange(16, dtype=jnp.int32).reshape(2, 8) % VOCAB
+        train_logits = model.apply({"params": params}, prompt)
+        dmodel = model.clone(decode=True, max_decode_len=12)
+        decode_logits, _ = dmodel.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(decode_logits), np.asarray(train_logits),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_chunked_prefill_matches_single_prefill(self):
+        """Chunk extension (T>1 on a warm cache) must mask the band too."""
+        model = _model(window=5, decode=True, max_decode_len=16)
+        params = _params(_model(window=5))
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(0, VOCAB, (2, 12)), jnp.int32
+        )
+        single, vars1 = model.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        chunked, vars2 = model.apply(
+            {"params": params}, prompt[:, :8], mutable=["cache"]
+        )
+        chunk2, _ = model.apply(
+            {"params": params, "cache": vars2["cache"]}, prompt[:, 8:],
+            mutable=["cache"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(single[:, 8:]), np.asarray(chunk2),
+            rtol=2e-5, atol=2e-5,
+        )

@@ -471,3 +471,134 @@ class TestCrossAttention:
             ).sum()
         )(q)
         assert np.isfinite(np.asarray(g)).all()
+
+
+class TestWindow:
+    """Sliding-window (local) attention: the band mask row − col < window
+    plus block-level skip of out-of-band tiles. Reference = dense_attention
+    with the same window."""
+
+    @pytest.mark.parametrize("window", [1, 17, 32, 100, T, 3 * T])
+    def test_matches_dense(self, window):
+        q, k, v = _qkv(11)
+        out = flash_attention(q, k, v, causal=True, window=window, **BLOCKS)
+        expected = dense_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_grads_match_dense(self):
+        q, k, v = _qkv(12)
+        window = 40  # not a block multiple: exercises partial band tiles
+
+        def loss_flash(q, k, v):
+            return (
+                flash_attention(q, k, v, causal=True, window=window, **BLOCKS)
+                ** 2
+            ).sum()
+
+        def loss_dense(q, k, v):
+            return (
+                dense_attention(q, k, v, causal=True, window=window) ** 2
+            ).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_with_lse_and_q_offset(self):
+        """The ring building block: a q block at global offset attends a
+        past K/V block under the window band; (out, lse) must match the
+        dense fallback's same-offset math, gradients included (the offset
+        path is what window-aware ring hops run)."""
+        from horovod_tpu.ops.flash_attention import _dense_with_lse
+
+        rng = np.random.RandomState(13)
+        tq = tk = 64
+        q, k, v = (
+            jnp.asarray(rng.randn(B, t, H, D).astype(np.float32))
+            for t in (tq, tk, tk)
+        )
+        window, offset = 80, 64  # band straddles the block boundary
+        out, lse = flash_attention_with_lse(
+            q, k, v, causal=True, window=window, q_offset=offset, **BLOCKS
+        )
+        ref_out, ref_lse = _dense_with_lse(
+            q, k, v, causal=True, window=window, q_offset=offset
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref_out), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(ref_lse), rtol=1e-5, atol=1e-5
+        )
+
+        def loss_k(fn):
+            def f(q, k, v):
+                o, s = fn(q, k, v)
+                return (o.astype(jnp.float32) ** 2).sum() + (
+                    jnp.where(s > -1e29, s, 0.0) ** 2
+                ).sum()
+
+            return jax.grad(f, argnums=(0, 1, 2))
+
+        g1 = loss_k(
+            lambda q, k, v: flash_attention_with_lse(
+                q, k, v, causal=True, window=window, q_offset=offset, **BLOCKS
+            )
+        )(q, k, v)
+        g2 = loss_k(
+            lambda q, k, v: _dense_with_lse(
+                q, k, v, causal=True, window=window, q_offset=offset
+            )
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_composes_with_segments(self):
+        """Packed documents AND a window: attention restricted to the
+        intersection (same doc, within the band)."""
+        rng = np.random.RandomState(14)
+        q, k, v = _qkv(14)
+        ids = jnp.asarray(
+            np.sort(rng.randint(0, 3, size=(B, T)), axis=1), jnp.int32
+        )
+        out = flash_attention(
+            q, k, v, causal=True, window=24,
+            q_segment_ids=ids, kv_segment_ids=ids, **BLOCKS
+        )
+        expected = dense_attention(
+            q, k, v, causal=True, window=24,
+            q_segment_ids=ids, kv_segment_ids=ids,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_fallback_path_applies_window(self):
+        """Tiling that can't run the kernel must still honor the window in
+        the dense fallback."""
+        rng = np.random.RandomState(15)
+        q = jnp.asarray(rng.randn(1, 100, 2, 16).astype(np.float32))
+        assert not supported(q.shape, 64, 64)
+        out = flash_attention(
+            q, q, q, causal=True, window=30, block_q=64, block_k=64
+        )
+        expected = dense_attention(q, q, q, causal=True, window=30)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+        )
+
+    def test_window_requires_causal(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8)
+        with pytest.raises(ValueError, match="positive"):
+            flash_attention(q, k, v, causal=True, window=0)
+        with pytest.raises(ValueError, match="causal"):
+            dense_attention(q, k, v, causal=False, window=8)
