@@ -275,19 +275,17 @@ def bench_train(which: str) -> dict:
             n_layers = int(os.environ.get("BENCH_NLAYERS", 8))
             if n_docs:
                 # Equal-length packed documents: executed score entries are
-                # the band ∩ same-document area — per doc of length L,
-                # w·L − w(w−1)/2 with w = min(window, L) (w = L is the
-                # plain causal triangle L(L+1)/2), summed over docs. Plain
-                # min() of the two discounts overstates it near window ≈ L
-                # (the band crosses doc boundaries, where the segment
-                # early-out skips tiles).
+                # the band ∩ same-document area — each document is its own
+                # length-L windowed causal attention (w = min(window, L);
+                # no window = the causal triangle), summed over docs. A
+                # plain min() of the two discounts would overstate it near
+                # window ≈ L (the band crosses doc boundaries, where the
+                # segment early-out skips tiles).
                 L = seq_len // n_docs
-                w = min(window or L, L)
-                per_doc = w * L - w * (w - 1) / 2.0
                 fa = trace.flash_attention_flops(
-                    per_chip_batch * n_chips, seq_len, seq_len, heads,
-                    head_dim, causal=False,
-                ) * n_layers * (n_docs * per_doc / float(seq_len) ** 2)
+                    per_chip_batch * n_chips, L, L, heads, head_dim,
+                    window=min(window or L, L),
+                ) * n_layers * n_docs
             else:
                 fa = trace.flash_attention_flops(
                     per_chip_batch * n_chips, seq_len, seq_len, heads,

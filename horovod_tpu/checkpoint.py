@@ -148,11 +148,12 @@ def save_async(path: str, state: PyTree) -> _SaveThread:
     return _SaveThread(lambda: save(path, snapshot))
 
 
-def restore(path: str, template: PyTree) -> PyTree:
+def restore(path: str, template: PyTree, *, reshard: bool = False) -> PyTree:
     """Deserialize into the structure of ``template``. A directory path is a
-    sharded checkpoint and routes to `restore_sharded`."""
+    sharded checkpoint and routes to `restore_sharded` (``reshard`` as
+    there)."""
     if os.path.isdir(path):
-        return restore_sharded(path, template)
+        return restore_sharded(path, template, reshard=reshard)
     with open(path, "rb") as f:
         data = f.read()
     return serialization.from_bytes(jax.device_get(template), data)
@@ -258,14 +259,57 @@ def _sharded_complete(path: str) -> bool:
     )
 
 
-def restore_sharded(path: str, template: PyTree) -> PyTree:
+def _parse_slices(spec: str) -> tuple:
+    """Inverse of `_fmt_index`: ``'0:64,0:128'`` → (slice(0,64), slice(0,128))
+    (the empty string is a scalar's index, ())."""
+    if not spec:
+        return ()
+    return tuple(
+        slice(int(a), int(b))
+        for a, b in (part.split(":") for part in spec.split(","))
+    )
+
+
+def _assemble_global(store: dict, i: int, shape: tuple, dtype) -> np.ndarray:
+    """Reassemble leaf ``i``'s full global array from whatever shard pieces
+    the checkpoint holds (each global piece is stored exactly once —
+    `save_sharded`'s replica_id==0 dedup — so the pieces tile the array)."""
+    prefix = f"{i}|"
+    arr = np.empty(shape, dtype)
+    filled = 0
+    for key, val in store.items():
+        if not key.startswith(prefix) or key == f"{i}|host":
+            continue
+        piece = np.asarray(val)
+        arr[_parse_slices(key[len(prefix):])] = piece
+        filled += piece.size
+    if filled != arr.size:
+        raise ValueError(
+            f"leaf {i}: shard pieces cover {filled} of {arr.size} elements — "
+            "the checkpoint is torn or was saved with a different model size"
+        )
+    return arr
+
+
+def restore_sharded(path: str, template: PyTree, *,
+                    reshard: bool = False) -> PyTree:
     """Rebuild a sharded checkpoint onto the ``template``'s shardings.
 
     EVERY process calls this. Shard files are read lazily, own-process first:
     with an unchanged topology a process touches only its own file plus
     whichever file owns the replicated leaves. Each needed piece is
     device_put to its target device and the global arrays assembled with
-    `jax.make_array_from_single_device_arrays` — no collective traffic."""
+    `jax.make_array_from_single_device_arrays` — no collective traffic.
+
+    ``reshard=True`` lifts the same-topology requirement: a checkpoint saved
+    under ANY process count / mesh / sharding layout restores onto the
+    template's (train on pipe=2, fine-tune on data=4; shrink a pod; move a
+    TP=4 model to TP=2 — the durability side of elasticity). Every process
+    then reads all shard files, reassembles each mismatched leaf's global
+    array on host, and re-slices it for its own devices; exact-layout leaves
+    still take the piece-by-piece fast path. Costs one host-RAM copy of the
+    largest leaf; leave False (the default) to keep topology drift loud on
+    ordinary resumes."""
     with open(os.path.join(path, INDEX_FILE)) as f:
         index = json.load(f)
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
@@ -288,17 +332,20 @@ def restore_sharded(path: str, template: PyTree) -> PyTree:
             f"weights): {', '.join(drift[:5])} — model/optimizer structure "
             "changed"
         )
-    if index["n_processes"] != jax.process_count():
+    if index["n_processes"] != jax.process_count() and not reshard:
         # Every process reads the same index, so all ranks raise together —
         # a partial-restore desync (some ranks proceeding into collectives
         # while others crash on a missing shard file) cannot happen.
         raise ValueError(
             f"checkpoint {path} was saved by {index['n_processes']} "
             f"processes but this run has {jax.process_count()} — sharded "
-            "checkpoints resume only under the same process topology"
+            "checkpoints resume only under the same process topology "
+            "(pass reshard=True to re-slice onto the new one)"
         )
     me = jax.process_index()
-    read_order = [me] + [p for p in range(index["n_processes"]) if p != me]
+    read_order = [p for p in range(index["n_processes"]) if p != me]
+    if me < index["n_processes"]:
+        read_order = [me] + read_order
     store: dict[str, np.ndarray] = {}
 
     def lookup(key):
@@ -320,12 +367,29 @@ def restore_sharded(path: str, template: PyTree) -> PyTree:
             out.append(lookup(f"{i}|host"))
             continue
         target, shape = leaf.sharding, leaf.shape
-        pieces = [
-            jax.device_put(
-                np.asarray(lookup(f"{i}|{_fmt_index(idx, shape)}"), leaf.dtype), d
-            )
-            for d, idx in target.addressable_devices_indices_map(shape).items()
-        ]
+        placement = target.addressable_devices_indices_map(shape).items()
+        try:
+            pieces = [
+                jax.device_put(
+                    np.asarray(
+                        lookup(f"{i}|{_fmt_index(idx, shape)}"), leaf.dtype
+                    ),
+                    d,
+                )
+                for d, idx in placement
+            ]
+        except ValueError:
+            if not reshard:
+                raise
+            # Saved layout ≠ template layout for this leaf: reassemble the
+            # global array from all stored pieces and slice out what each
+            # local device needs. `lookup` has already drained every shard
+            # file into `store` before concluding a key is missing.
+            whole = _assemble_global(store, i, shape, leaf.dtype)
+            pieces = [
+                jax.device_put(np.ascontiguousarray(whole[idx]), d)
+                for d, idx in placement
+            ]
         out.append(
             jax.make_array_from_single_device_arrays(shape, target, pieces)
         )
@@ -447,9 +511,12 @@ def broadcast_parameters(tree: PyTree, root_rank: int = 0, mesh=None) -> PyTree:
     return tree
 
 
-def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None) -> tuple[PyTree, int]:
+def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None,
+                                 *, reshard: bool = False) -> tuple[PyTree, int]:
     """The full resume path (§5.3): the primary loads the newest checkpoint,
     all processes adopt it. Returns (state, epoch) — epoch 0 if none found.
+    ``reshard=True`` additionally accepts sharded checkpoints saved under a
+    different topology/layout (see `restore_sharded`).
 
     Collective-safe under single-writer checkpoints: only the *primary's*
     view of the directory decides (checkpoints may exist on its filesystem
@@ -510,7 +577,7 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None) ->
         spath = os.path.join(
             directory, bytes(name).rstrip(b"\0").decode()
         )
-        return restore_sharded(spath, template), epoch
+        return restore_sharded(spath, template, reshard=reshard), epoch
     state = restore(path, template) if primary else template
     return broadcast_parameters(state, mesh=mesh), epoch
 

@@ -22,6 +22,7 @@ import os
 import time
 
 import jax
+import numpy as np
 
 from horovod_tpu import runtime
 
@@ -93,8 +94,13 @@ def flash_attention_flops(batch: int, seq_q: int, seq_k: int, heads: int,
     per_dot = 2.0 * batch * heads * seq_q * seq_k * head_dim
     dots = 9 if backward else 2
     if causal and window is not None:
+        # Executed score entries: query row i sees min(w, i + Tk − Tq + 1)
+        # keys (end-aligned causal band, clamped at 0 for rows before the
+        # first key when Tk < Tq) — summed over rows, never negative.
         w = min(window, seq_k)
-        frac = (w * seq_q - w * (w - 1) / 2.0) / (seq_q * seq_k)
+        rows = np.arange(seq_q, dtype=np.float64)
+        visible = np.clip(rows + (seq_k - seq_q) + 1, 0.0, float(w))
+        frac = float(visible.sum()) / (seq_q * seq_k)
         return dots * per_dot * frac
     return dots * per_dot * (0.5 if causal else 1.0)
 

@@ -494,3 +494,126 @@ class TestMultiProcessCIJob:
             REPO, "horovod_tpu", "launch", "jobs", "mnist-ci-2proc.yaml"
         )
         assert run_job(spec) == 0
+
+
+class TestReshardAcrossTopologies:
+    """Topology-change resume (`restore_sharded(reshard=True)`): a sharded
+    checkpoint written by a 2-process fsdp=2 fleet restores into THIS
+    single-process suite on a different mesh — the 'pod checkpoint on a
+    workstation' / changed-fleet-size durability case the same-topology
+    guard otherwise refuses."""
+
+    def test_two_process_checkpoint_restores_single_process(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {REPO!r})
+            import os
+            import jax
+            import numpy as np
+            import optax
+            import horovod_tpu as hvt
+            from horovod_tpu import checkpoint
+            from horovod_tpu.data import datasets
+            from horovod_tpu.parallel import mesh as mesh_lib
+            from jax.sharding import PartitionSpec as P
+            from horovod_tpu.models.transformer import (
+                ShardingConfig, TransformerLM, param_specs,
+            )
+
+            hvt.init()
+            r = hvt.process_rank()
+            base = {str(tmp_path)!r}
+            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=1, fsdp=2))
+            model = TransformerLM(
+                vocab_size=16, d_model=16, n_heads=2, n_layers=2, dropout=0.0,
+                sharding=ShardingConfig(mesh=mesh, attn='dense'),
+            )
+            spec = P(('data', 'fsdp'), 'seq')
+            trainer = hvt.Trainer(
+                model, hvt.DistributedOptimizer(optax.adam(1e-3)),
+                mesh=mesh, param_specs=param_specs, batch_specs=(spec, spec),
+            )
+            x, y = datasets.copy_task(8, 8, vocab_size=16)
+            trainer.build(x[:4])
+            trainer.fit(x=x, y=y, batch_size=4, epochs=1, steps_per_epoch=2,
+                        verbose=0)
+            assert checkpoint.is_cross_process_sharded(trainer.state)
+            checkpoint.save_sharded(
+                os.path.join(base, "ckpt.shards"), trainer.state
+            )
+            # Each rank's replica-0 shard sum: the two ranks' files tile the
+            # global state exactly once, so their sum is THE global digest.
+            total = 0.0
+            for l in jax.tree.leaves(trainer.state):
+                if isinstance(l, jax.Array):
+                    for sh in l.addressable_shards:
+                        if sh.replica_id == 0:
+                            total += float(
+                                np.abs(np.asarray(sh.data, np.float64)).sum()
+                            )
+                elif r == 0:
+                    total += float(np.abs(np.float64(l)))
+            with open(os.path.join(base, f"digest-{{r}}"), "w") as f:
+                f.write(repr(total))
+        """))
+        env = _mp_env(tmp_path, devices_per_proc=1)
+        code = launcher.run_local(
+            2, [sys.executable, str(script)], env=env, tag_output=False
+        )
+        assert code == 0
+
+        # Restore HERE: 1 process, different mesh (data=2, model=2), then a
+        # plain single-device template — both via reshard.
+        import jax
+        import numpy as np
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        import horovod_tpu as hvt
+        from horovod_tpu import checkpoint
+        from horovod_tpu.data import datasets
+        from horovod_tpu.models.transformer import (
+            ShardingConfig,
+            TransformerLM,
+            param_specs,
+        )
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, model=2), devices=jax.devices()[:4]
+        )
+        model = TransformerLM(
+            vocab_size=16, d_model=16, n_heads=2, n_layers=2, dropout=0.0,
+            sharding=ShardingConfig(mesh=mesh, attn="dense"),
+        )
+        spec = P(("data", "fsdp"), "seq")
+        trainer = hvt.Trainer(
+            model, hvt.DistributedOptimizer(optax.adam(1e-3)),
+            mesh=mesh, param_specs=param_specs, batch_specs=(spec, spec),
+        )
+        x, _ = datasets.copy_task(8, 8, vocab_size=16)
+        trainer.build(x[:4])
+        path = str(tmp_path / "ckpt.shards")
+        with pytest.raises(ValueError, match="process topology"):
+            checkpoint.restore_sharded(path, trainer.state)
+        restored = checkpoint.restore_sharded(
+            path, trainer.state, reshard=True
+        )
+        total = 0.0
+        for leaf in jax.tree.leaves(restored):
+            if isinstance(leaf, jax.Array):
+                arr = np.asarray(jax.device_get(leaf), np.float64)
+                total += float(np.abs(arr).sum())
+            else:
+                total += float(np.abs(np.float64(leaf)))
+        want = sum(
+            float((tmp_path / f"digest-{r}").read_text()) for r in range(2)
+        )
+        np.testing.assert_allclose(total, want, rtol=1e-9)
+        # And training continues from the resharded state.
+        trainer.state = restored
+        x, y = datasets.copy_task(8, 8, vocab_size=16)
+        hist = trainer.fit(x=x, y=y, batch_size=4, epochs=1,
+                           steps_per_epoch=2, verbose=0)
+        assert np.isfinite(hist[-1]["loss"])
