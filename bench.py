@@ -21,6 +21,8 @@ transfer on top of it).
 Modes (BENCH_MODEL):
   mnist       (default) reference CNN, per-chip batch 128 bf16
   resnet      CIFAR-10 ResNet-20 — heavier gradients (BASELINE.json config 4)
+  vit         CIFAR-10 Vision Transformer (models/vit.py) — the conv-free
+              vision family; images/sec + the MFU the conv shapes can't reach
   transformer decoder LM (d512 x 8L, seq 1024, flash attention) — tokens/sec
   moe         same LM with MoE MLPs every 2nd block (8 experts, top-2) —
               tokens/sec + router drop-rate observability
@@ -65,6 +67,10 @@ def _lm_from_env(*, moe: bool = False):
         # block-skips tiles outside the band, so long-seq steps get
         # proportionally faster (and MFU accounts the executed band only).
         window=int(os.environ.get("BENCH_WINDOW", 0)) or None,
+        # BENCH_SLIDING=1 (decode mode, needs BENCH_WINDOW): ring-buffer KV
+        # cache — O(window) cache reads per generated token instead of
+        # O(prompt+new_tokens), the decode-side win of a window.
+        sliding_cache=runtime.env_flag("BENCH_SLIDING"),
         compute_dtype=jnp.bfloat16,
         dropout=0.0,  # LM-pretraining norm (and threefry dropout costs
         # ~12%/step — HVT_FAST_RNG=1 makes dropout free when wanted)
@@ -131,6 +137,30 @@ def bench_train(which: str) -> dict:
         # Default 128 = the reference's per-worker batch (honest comparison
         # config); BENCH_BATCH=512 is the measured throughput sweet spot
         # (+38%, benchmarks/conv_profile.py sweep — BASELINE.md conv note).
+        per_chip_batch = int(os.environ.get("BENCH_BATCH", BATCH))
+        unit_per_step = per_chip_batch * n_chips
+        lr = optax.adam(hvt.scale_lr(1e-3))
+        loss = "sparse_categorical_crossentropy"
+        unit = "images/sec/chip"
+        default_steps = 256
+    elif which == "vit":
+        # The conv-free vision family (models/vit.py): image classification
+        # as MXU-shaped matmuls — the TPU-first answer to the conv models'
+        # shape-bound MFU ceiling (BASELINE.md conv attribution row).
+        from horovod_tpu.models.vit import ViT
+
+        (x_train, y_train), _ = datasets.cifar10()
+        x = x_train
+        y = y_train.astype(np.int32)
+        module = ViT(
+            patch_size=int(os.environ.get("BENCH_PATCH", 4)),
+            d_model=int(os.environ.get("BENCH_DMODEL", 512)),
+            n_heads=int(os.environ.get("BENCH_HEADS", 8)),
+            n_layers=int(os.environ.get("BENCH_NLAYERS", 8)),
+            dropout=0.0,
+            compute_dtype=jnp.bfloat16,
+        )
+        metric = "cifar10_vit_train_images_per_sec_per_chip"
         per_chip_batch = int(os.environ.get("BENCH_BATCH", BATCH))
         unit_per_step = per_chip_batch * n_chips
         lr = optax.adam(hvt.scale_lr(1e-3))

@@ -29,6 +29,7 @@ from horovod_tpu import metrics
 from horovod_tpu.data import datasets
 from horovod_tpu.data.loader import ArrayDataset
 from horovod_tpu.models.resnet import ResNetCIFAR
+from horovod_tpu.models.vit import ViT
 
 
 def main() -> None:
@@ -58,8 +59,19 @@ def main() -> None:
         .batch(per_process_batch)
     )
 
+    # ARCH=vit swaps the conv model for the conv-free ViT (models/vit.py)
+    # through the identical training path — architecture is a swappable
+    # leaf, and the ViT's matmul shapes reach MFU the CIFAR convs can't
+    # (BASELINE.md vit row).
+    if os.environ.get("ARCH", "resnet") == "vit":
+        module = ViT(
+            patch_size=4, d_model=256, n_heads=8, n_layers=6,
+            compute_dtype=jnp.bfloat16,
+        )
+    else:
+        module = ResNetCIFAR(depth=20, compute_dtype=jnp.bfloat16)
     trainer = hvt.Trainer(
-        ResNetCIFAR(depth=20, compute_dtype=jnp.bfloat16),
+        module,
         hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(0.001))),
         loss="sparse_categorical_crossentropy",
     )

@@ -348,16 +348,23 @@ def restore_sharded(path: str, template: PyTree, *,
         read_order = [me] + read_order
     store: dict[str, np.ndarray] = {}
 
+    class _ShardKeyMissing(ValueError):
+        """Key absent after draining every shard file — the one condition
+        the reshard fallback may treat as a layout mismatch (a corrupt
+        file's own error must propagate, not be misread as 'resharding
+        needed')."""
+
     def lookup(key):
         while key not in store and read_order:
             p = read_order.pop(0)
             with open(os.path.join(path, f"shard-{p}.msgpack"), "rb") as f:
                 store.update(serialization.msgpack_restore(f.read()))
         if key not in store:
-            raise ValueError(
+            raise _ShardKeyMissing(
                 f"shard {key!r} not found in {path}: the checkpoint was "
                 "saved under a different mesh or sharding layout than the "
-                "template's (resume must use the same parallel config)"
+                "template's (resume must use the same parallel config, or "
+                "pass reshard=True)"
             )
         return store[key]
 
@@ -378,7 +385,7 @@ def restore_sharded(path: str, template: PyTree, *,
                 )
                 for d, idx in placement
             ]
-        except ValueError:
+        except _ShardKeyMissing:
             if not reshard:
                 raise
             # Saved layout ≠ template layout for this leaf: reassemble the

@@ -15,6 +15,7 @@ from horovod_tpu.models.speculative import (  # noqa: F401
     make_speculative_fn,
     ngram_draft_fn,
 )
+from horovod_tpu.models.vit import ViT  # noqa: F401
 from horovod_tpu.models.transformer import (  # noqa: F401
     ShardingConfig,
     TransformerLM,

@@ -84,6 +84,10 @@ class PipelinedLM(nn.Module):
     n_heads: int = 8
     n_layers: int = 4
     n_micro: int = 4
+    # Sliding-window (local) attention inside every stage — same band
+    # semantics as TransformerLM.window (global positions; exact through
+    # the stage-internal ring when sp > 1). None = full causal.
+    window: int | None = None
     compute_dtype: jnp.dtype = jnp.float32
     mesh: Mesh | None = None
     # 'gpipe' = AD-derived backward (parallel/pipeline.spmd_pipeline);
@@ -418,12 +422,13 @@ class PipelinedLM(nn.Module):
 
         if sp > 1:
             att = attention_ops.ring_flash_attention(
-                q, k, v, axis_name=SEQ_AXIS, causal=True, segment_ids=seg
+                q, k, v, axis_name=SEQ_AXIS, causal=True, segment_ids=seg,
+                window=self.window,
             )
         else:
             att = flash_attention(
                 q, k, v, causal=True,
-                q_segment_ids=seg, kv_segment_ids=seg,
+                q_segment_ids=seg, kv_segment_ids=seg, window=self.window,
             )  # [mb, T, H/tp, hd]
         out = att.reshape(mb, t, h_local * hd) @ p["attn_out"].astype(cd)
         if tp > 1:

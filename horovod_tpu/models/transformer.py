@@ -138,6 +138,12 @@ class Block(nn.Module):
     # (H_kv == n_kv_heads, == H for MHA).
     decode: bool = False
     max_decode_len: int = 0
+    # Streaming decode (requires ``window``): the cache is a [B, window,
+    # H_kv, D] RING BUFFER (slot = position mod window) instead of the full
+    # [B, max_decode_len, ...] history — O(window) memory and O(window)
+    # cache reads per generated token however long the generation runs.
+    # Exact: a windowed query never needs anything the ring has evicted.
+    sliding_cache: bool = False
 
     @nn.compact
     def __call__(self, x, positions, train: bool = False, segment_ids=None,
@@ -329,26 +335,71 @@ class Block(nn.Module):
             raise ValueError(
                 f"max_decode_len ({self.max_decode_len}) < input length ({t})"
             )
+        if self.sliding_cache and self.window is None:
+            raise ValueError(
+                "sliding_cache is the ring buffer for sliding-window "
+                "attention — set window too"
+            )
         cache_spec = P(BATCH_AXES, None, MODEL_AXIS, None)
         first_call = not self.has_variable("cache", "k")
+        cache_len = (
+            min(self.window, self.max_decode_len)
+            if self.sliding_cache else self.max_decode_len
+        )
         zeros = lambda: jnp.zeros(  # noqa: E731
-            (b, self.max_decode_len, h_kv, d), self.compute_dtype
+            (b, cache_len, h_kv, d), self.compute_dtype
         )
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
         idx = jnp.asarray(decode_index, jnp.int32)
-        ck.value = cfg.constrain(
-            jax.lax.dynamic_update_slice(
-                ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
-            ),
-            cache_spec,
-        )
-        cv.value = cfg.constrain(
-            jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
-            ),
-            cache_spec,
-        )
+        if self.sliding_cache:
+            if t > 1 and not first_call:
+                raise ValueError(
+                    "sliding_cache supports prefill + single-token decode "
+                    "steps; chunk extension (speculative decoding's verify "
+                    "pass) needs the full-history cache — evicted rows "
+                    "could be needed by the chunk's early tokens"
+                )
+            # Per-slot absolute positions ([B, W] so batch-reordering
+            # consumers like beam search gather it like the K/V arrays);
+            # -1 = never written.
+            cpos = self.variable(
+                "cache", "pos",
+                lambda: jnp.full((b, cache_len), -1, jnp.int32),
+            )
+            # Only the last `cache_len` fresh tokens can survive eviction —
+            # writing just those keeps the scatter slots unique.
+            t_eff = min(t, cache_len)
+            new_pos = idx + (t - t_eff) + jnp.arange(t_eff, dtype=jnp.int32)
+            slots = new_pos % cache_len
+            ck.value = cfg.constrain(
+                ck.value.at[:, slots].set(
+                    k[:, t - t_eff:].astype(ck.value.dtype)
+                ),
+                cache_spec,
+            )
+            cv.value = cfg.constrain(
+                cv.value.at[:, slots].set(
+                    v[:, t - t_eff:].astype(cv.value.dtype)
+                ),
+                cache_spec,
+            )
+            cpos.value = cpos.value.at[:, slots].set(
+                jnp.broadcast_to(new_pos, (b, t_eff))
+            )
+        else:
+            ck.value = cfg.constrain(
+                jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
+                ),
+                cache_spec,
+            )
+            cv.value = cfg.constrain(
+                jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
+                ),
+                cache_spec,
+            )
         if t > 1 and first_call:
             # Prefill: the cache was empty below `idx` (generate() starts at
             # 0), so causal attention over the fresh K/V is the full answer —
@@ -385,14 +436,24 @@ class Block(nn.Module):
             "bqhgd,bkhd->bhgqk", q5, ck.value,
             preferred_element_type=jnp.float32,
         ) * scale
-        kpos = jnp.arange(self.max_decode_len, dtype=jnp.int32)
         qpos = idx + jnp.arange(t, dtype=jnp.int32)
-        valid = kpos[None, :] <= qpos[:, None]
-        if self.window is not None:
-            # Sliding window over the cache: a query at qpos sees cache
-            # rows in (qpos − window, qpos] — the same band training used.
-            valid &= kpos[None, :] > qpos[:, None] - self.window
-        valid = valid[None, None, None, :, :]
+        if self.sliding_cache:
+            # Ring slots carry their absolute positions: valid = written,
+            # causal, and inside the band (eviction already guarantees
+            # > qpos − window for fully-warm caches; the explicit check
+            # keeps partially-warm ones exact too).
+            kpos = cpos.value[:, None, :]  # [B, 1, W]
+            qp = qpos[None, :, None]  # [1, t, 1]
+            valid = (kpos >= 0) & (kpos <= qp) & (kpos > qp - self.window)
+            valid = valid[:, None, None, :, :]  # [B, 1, 1, t, W]
+        else:
+            kpos = jnp.arange(self.max_decode_len, dtype=jnp.int32)
+            valid = kpos[None, :] <= qpos[:, None]
+            if self.window is not None:
+                # Sliding window over the cache: a query at qpos sees cache
+                # rows in (qpos − window, qpos] — the band training used.
+                valid &= kpos[None, :] > qpos[:, None] - self.window
+            valid = valid[None, None, None, :, :]
         s = jnp.where(valid, s, attention_ops._BIG_NEG)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum(
@@ -442,6 +503,9 @@ class TransformerLM(nn.Module):
     # T==1 = one decode step.
     decode: bool = False
     max_decode_len: int = 0
+    # Ring-buffer cache for windowed models: O(window) decode memory and
+    # cache traffic regardless of generation length (see Block).
+    sliding_cache: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, segment_ids=None):
@@ -490,6 +554,7 @@ class TransformerLM(nn.Module):
                 moe_aux_coef=self.moe_aux_coef,
                 decode=self.decode,
                 max_decode_len=self.max_decode_len,
+                sliding_cache=self.sliding_cache,
                 # Explicit name = flax's auto-name, so the param tree is
                 # identical with and without remat (the remat wrapper would
                 # otherwise scope as CheckpointBlock_i).

@@ -355,3 +355,98 @@ class TestSlidingWindowDecode:
             np.asarray(single[:, 8:]), np.asarray(chunk2),
             rtol=2e-5, atol=2e-5,
         )
+
+
+class TestSlidingCache:
+    """Ring-buffer KV cache (`sliding_cache=True`): O(window) memory and
+    cache reads per token, bit-identical generations to the full-history
+    cache for windowed models."""
+
+    def _pair(self, **kw):
+        kw = dict(vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2,
+                  dropout=0.0, window=6, **kw)
+        return TransformerLM(**kw), TransformerLM(**kw, sliding_cache=True)
+
+    def test_matches_full_cache_far_past_window(self):
+        full, sliding = self._pair()
+        params = _params(full)
+        prompt = np.array([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+        a = generate(full, params, prompt, 40)
+        b = generate(sliding, params, prompt, 40)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_cache_is_window_sized(self):
+        import jax.numpy as jnp
+
+        _, sliding = self._pair()
+        params = _params(sliding)
+        dm = sliding.clone(decode=True, max_decode_len=64)
+        _, variables = dm.apply(
+            {"params": params}, jnp.zeros((2, 8), jnp.int32),
+            mutable=["cache"],
+        )
+        blk = variables["cache"]["Block_0"]
+        assert blk["k"].shape[1] == 6  # window, not max_decode_len
+        assert blk["pos"].shape == (2, 6)
+
+    def test_gqa_composes(self):
+        full, sliding = self._pair(n_kv_heads=2)
+        params = _params(full)
+        prompt = np.array([[1, 2, 3, 4]], np.int32)
+        a = generate(full, params, prompt, 30)
+        b = generate(sliding, params, prompt, 30)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_long_prompt_prefill_evicts_correctly(self):
+        """Prompt longer than the window: only the last W rows survive the
+        prefill write, and generation still matches the full cache."""
+        full, sliding = self._pair()
+        params = _params(full)
+        prompt = np.asarray(
+            np.random.RandomState(0).randint(0, VOCAB, (2, 17)), np.int32
+        )
+        a = generate(full, params, prompt, 12)
+        b = generate(sliding, params, prompt, 12)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_chunk_extension_rejected(self):
+        import jax.numpy as jnp
+
+        _, sliding = self._pair()
+        params = _params(sliding)
+        dm = sliding.clone(decode=True, max_decode_len=32)
+        _, variables = dm.apply(
+            {"params": params}, jnp.zeros((1, 4), jnp.int32),
+            mutable=["cache"],
+        )
+        with pytest.raises(ValueError, match="sliding_cache supports"):
+            dm.apply(
+                {"params": params, "cache": variables["cache"]},
+                jnp.zeros((1, 3), jnp.int32), mutable=["cache"],
+            )
+
+    def test_requires_window(self):
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=1,
+            dropout=0.0, sliding_cache=True,
+        )
+        params = _params(TransformerLM(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=1, dropout=0.0,
+        ))
+        with pytest.raises(ValueError, match="window"):
+            generate(model, params, np.zeros((1, 4), np.int32), 2)
+
+    def test_beam_search_composes(self):
+        from horovod_tpu.models.beam import make_beam_search_fn
+
+        full, sliding = self._pair()
+        params = _params(full)
+        prompt = np.array([[3, 1, 4, 1, 5]], np.int32)
+        a, sa = make_beam_search_fn(
+            full, max_new_tokens=16, beam_size=3, return_scores=True
+        )(params, prompt)
+        b, sb = make_beam_search_fn(
+            sliding, max_new_tokens=16, beam_size=3, return_scores=True
+        )(params, prompt)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(sa), np.asarray(sb), rtol=1e-6)
