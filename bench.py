@@ -79,6 +79,9 @@ def _lm_from_env(*, moe: bool = False):
         n_experts=int(os.environ.get("BENCH_EXPERTS", 8)),
         moe_k=int(os.environ.get("BENCH_MOE_K", 2)),
         capacity_factor=float(os.environ.get("BENCH_CAPACITY", 1.25)),
+        # BENCH_MOE_ROUTER=expert_choice: drop-free expert-choice routing
+        # (models/moe.py) — observability metric becomes uncovered-rate.
+        moe_router=os.environ.get("BENCH_MOE_ROUTER", "top_k"),
         # Long-context memory knobs (BASELINE.md context-envelope rows):
         remat=runtime.env_flag("BENCH_REMAT"),
         logits_dtype=jnp.bfloat16

@@ -23,6 +23,16 @@ well:
 * **Overflow drops are safe by construction**: the transformer block adds
   the MoE output to the residual stream, so a token past capacity
   contributes zero instead of garbage.
+* **Two routers.** ``router='top_k'`` (default): tokens pick experts —
+  GShard/Switch semantics, capacity overflow possible (observable via
+  ``moe_drop_rate``). ``router='expert_choice'`` (Zhou et al.,
+  arXiv:2202.09368): each expert picks its top-``capacity`` tokens —
+  perfectly load-balanced and drop-free BY CONSTRUCTION (no aux loss
+  needed; the observability metric becomes ``moe_uncovered_rate``, the
+  fraction of tokens no expert chose). Training-only for causal LMs:
+  expert choice ranks tokens across the whole group, so selection of an
+  early token depends on later tokens — the known train/inference
+  asymmetry of EC routing; the decode path refuses it loudly.
 """
 
 from __future__ import annotations
@@ -65,6 +75,9 @@ class MoEMlp(nn.Module):
     k: int = 2
     capacity_factor: float = 1.25
     aux_loss_coef: float = 1e-2
+    # 'top_k' (tokens pick experts, GShard/Switch) or 'expert_choice'
+    # (experts pick tokens — drop-free, aux-free; see module docstring).
+    router: str = "top_k"
     compute_dtype: jnp.dtype = jnp.float32
     sharding: object = None
 
@@ -93,10 +106,20 @@ class MoEMlp(nn.Module):
         capacity = max(1, int(self.k * s / e * self.capacity_factor))
 
         # --- routing (float32) ---------------------------------------------
+        if self.router not in ("top_k", "expert_choice"):
+            raise ValueError(
+                f"router must be 'top_k' or 'expert_choice', got "
+                f"{self.router!r}"
+            )
         router = nn.Dense(
             e, use_bias=False, dtype=jnp.float32, name="router"
         )(tokens.astype(jnp.float32))
         probs = jax.nn.softmax(router, axis=-1)  # [n, S, E]
+
+        if self.router == "expert_choice":
+            return self._expert_choice(
+                x, tokens, probs, capacity, n_groups, s
+            )
 
         top_probs, top_idx = jax.lax.top_k(probs, self.k)  # [n, S, k]
         if self.k > 1:
@@ -152,7 +175,48 @@ class MoEMlp(nn.Module):
             "nsec,nsd->necd", dispatch.astype(cd), tokens.astype(cd)
         )  # [n, E, C, d]
         expert_in = self._constrain(expert_in, P(None, EXPERT_AXIS, None, None))
+        out = self._experts(expert_in, d)
 
+        # --- combine back to token order -----------------------------------
+        mixed = jnp.einsum("nsec,necd->nsd", combine.astype(cd), out)
+        return mixed.reshape(b, t, d).astype(x.dtype)
+
+    def _expert_choice(self, x, tokens, probs, capacity, n_groups, s):
+        """Expert-choice dispatch: each expert takes its top-``capacity``
+        tokens of the group (scores = router softmax over experts, read
+        column-wise). Every expert is exactly full — balanced and drop-free
+        by construction, so there is no load-balancing aux loss; the
+        observability dual of drop-rate is the fraction of tokens NO expert
+        chose (they pass through on the residual stream only)."""
+        b, t, d = x.shape
+        e = self.n_experts
+        cd = self.compute_dtype
+        capacity = min(capacity, s)  # an expert cannot take a token twice
+        # [n, E, S] scores; per-expert top-C over the token axis.
+        g_val, g_idx = jax.lax.top_k(
+            jnp.moveaxis(probs, -1, 1), capacity
+        )  # both [n, E, C]
+        dispatch = jax.nn.one_hot(g_idx, s)  # [n, E, C, S]
+        # Coverage observability (see docstring).
+        chosen = jnp.clip(dispatch.sum((1, 2)), 0.0, 1.0)  # [n, S]
+        self.sow(
+            "metrics", "moe_uncovered_rate",
+            1.0 - jnp.sum(chosen) / float(n_groups * s),
+        )
+        expert_in = jnp.einsum(
+            "necs,nsd->necd", dispatch.astype(cd), tokens.astype(cd)
+        )
+        expert_in = self._constrain(expert_in, P(None, EXPERT_AXIS, None, None))
+        out = self._experts(expert_in, d)
+        combine = dispatch * g_val[..., None]  # [n, E, C, S] gated
+        mixed = jnp.einsum("necs,necd->nsd", combine.astype(cd), out)
+        return mixed.reshape(b, t, d).astype(x.dtype)
+
+    def _experts(self, expert_in, d):
+        """The E parallel FFNs over [n, E, C, d] dispatched activations —
+        shared by both routers (identical params/layout either way)."""
+        cd = self.compute_dtype
+        e = self.n_experts
         hidden = self.mlp_ratio * d
         w_up = self.param(
             "moe_up",
@@ -167,11 +231,7 @@ class MoEMlp(nn.Module):
         h = jnp.einsum("necd,edh->nech", expert_in, w_up.astype(cd))
         h = nn.gelu(h)
         out = jnp.einsum("nech,ehd->necd", h, w_down.astype(cd))
-        out = self._constrain(out, P(None, EXPERT_AXIS, None, None))
-
-        # --- combine back to token order -----------------------------------
-        mixed = jnp.einsum("nsec,necd->nsd", combine.astype(cd), out)
-        return mixed.reshape(b, t, d).astype(x.dtype)
+        return self._constrain(out, P(None, EXPERT_AXIS, None, None))
 
     def _n_groups(self, g: int) -> int:
         return dispatch_group_count(g, self.group_size)

@@ -133,6 +133,9 @@ class Block(nn.Module):
     moe_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
+    # 'top_k' or 'expert_choice' (drop-free, training-only — see
+    # models/moe.py module docstring).
+    moe_router: str = "top_k"
     # Autoregressive inference (models/decoding.py): K/V for past tokens live
     # in a ``cache`` variable collection sized [B, max_decode_len, H_kv, D]
     # (H_kv == n_kv_heads, == H for MHA).
@@ -144,6 +147,18 @@ class Block(nn.Module):
     # cache reads per generated token however long the generation runs.
     # Exact: a windowed query never needs anything the ring has evicted.
     sliding_cache: bool = False
+    # Attention sinks (StreamingLLM, arXiv:2309.17453 / Longformer-style
+    # global+local): the first `attention_sinks` positions stay visible —
+    # and, with sliding_cache, pinned in the cache — in addition to the
+    # window band. A first-class mask, consistent across training, eval,
+    # prefill, chunk extension and decode (sinks+band everywhere), so a
+    # model can be TRAINED global+local and streamed exactly; cloning a
+    # densely-trained model with (window, attention_sinks, sliding_cache)
+    # for generation is the approximate StreamingLLM recipe. The
+    # non-decode forward runs the dense reference path (no flash-kernel
+    # sink support yet — O(T²) scores) and sinks do not compose with
+    # sequence parallelism (ring/Ulysses raise).
+    attention_sinks: int = 0
 
     @nn.compact
     def __call__(self, x, positions, train: bool = False, segment_ids=None,
@@ -206,7 +221,22 @@ class Block(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        if cfg.seq_parallel:
+        if self.attention_sinks and cfg.seq_parallel:
+            raise ValueError(
+                "attention_sinks does not compose with sequence "
+                "parallelism yet — the sink block lives on one shard; "
+                "drop the seq axis or the sinks"
+            )
+        if self.attention_sinks:
+            # Global+local mask: the dense reference path carries the sink
+            # columns (no flash-kernel sink support yet). The SAME mask the
+            # decode cache applies, so train/eval/prefill/decode agree.
+            out = attention_ops.dense_attention(
+                q, k, v, causal=True, window=self.window,
+                sinks=self.attention_sinks,
+                q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+            )
+        elif cfg.seq_parallel:
             impls = {
                 "ring": attention_ops.ring_flash_attention,
                 "ring_dense": attention_ops.ring_attention,
@@ -290,12 +320,21 @@ class Block(nn.Module):
         if self.use_moe:
             from horovod_tpu.models.moe import MoEMlp
 
+            if self.moe_router == "expert_choice" and self.decode:
+                raise ValueError(
+                    "expert_choice routing is training-only: expert "
+                    "selection ranks tokens across the whole group, which "
+                    "a per-token decode step cannot reproduce (the known "
+                    "EC train/inference asymmetry) — decode with "
+                    "moe_router='top_k'"
+                )
             return MoEMlp(
                 self.d_model,
                 n_experts=self.n_experts,
                 k=self.moe_k,
                 capacity_factor=self.capacity_factor,
                 aux_loss_coef=self.moe_aux_coef,
+                router=self.moe_router,
                 compute_dtype=self.compute_dtype,
                 sharding=self.sharding,
                 name="moe",
@@ -340,10 +379,13 @@ class Block(nn.Module):
                 "sliding_cache is the ring buffer for sliding-window "
                 "attention — set window too"
             )
+        if self.attention_sinks < 0:
+            raise ValueError("attention_sinks must be >= 0")
+        sinks = self.attention_sinks
         cache_spec = P(BATCH_AXES, None, MODEL_AXIS, None)
         first_call = not self.has_variable("cache", "k")
         cache_len = (
-            min(self.window, self.max_decode_len)
+            sinks + min(self.window, self.max_decode_len)
             if self.sliding_cache else self.max_decode_len
         )
         zeros = lambda: jnp.zeros(  # noqa: E731
@@ -367,25 +409,34 @@ class Block(nn.Module):
                 "cache", "pos",
                 lambda: jnp.full((b, cache_len), -1, jnp.int32),
             )
-            # Only the last `cache_len` fresh tokens can survive eviction —
-            # writing just those keeps the scatter slots unique.
-            t_eff = min(t, cache_len)
-            new_pos = idx + (t - t_eff) + jnp.arange(t_eff, dtype=jnp.int32)
-            slots = new_pos % cache_len
+            # Slot layout: positions < sinks pin to slots [0, sinks); the
+            # rest ring over [sinks, sinks + window). A fresh token is kept
+            # iff it is a sink or among the last `window` ring-eligible
+            # tokens of this write (earlier ones would be evicted within
+            # the same chunk); dropped tokens scatter to an out-of-bounds
+            # slot under mode='drop'. Kept slots are unique: sink slots by
+            # position, ring slots because the last `window` ring positions
+            # are distinct mod window.
+            win = cache_len - sinks
+            new_pos = idx + jnp.arange(t, dtype=jnp.int32)
+            ring_slot = sinks + (new_pos - sinks) % win
+            slot = jnp.where(new_pos < sinks, new_pos, ring_slot)
+            keep = (new_pos < sinks) | (new_pos >= idx + t - win)
+            slot = jnp.where(keep, slot, cache_len)  # OOB → dropped
             ck.value = cfg.constrain(
-                ck.value.at[:, slots].set(
-                    k[:, t - t_eff:].astype(ck.value.dtype)
+                ck.value.at[:, slot].set(
+                    k.astype(ck.value.dtype), mode="drop"
                 ),
                 cache_spec,
             )
             cv.value = cfg.constrain(
-                cv.value.at[:, slots].set(
-                    v[:, t - t_eff:].astype(cv.value.dtype)
+                cv.value.at[:, slot].set(
+                    v.astype(cv.value.dtype), mode="drop"
                 ),
                 cache_spec,
             )
-            cpos.value = cpos.value.at[:, slots].set(
-                jnp.broadcast_to(new_pos, (b, t_eff))
+            cpos.value = cpos.value.at[:, slot].set(
+                jnp.broadcast_to(new_pos, (b, t)), mode="drop"
             )
         else:
             ck.value = cfg.constrain(
@@ -411,9 +462,18 @@ class Block(nn.Module):
             if rep > 1:  # prefill attends at full H, like training
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            local = functools.partial(
-                flash_attention, causal=True, window=self.window
-            )
+            if sinks:
+                # Same global+local mask as training/decode, computed from
+                # the fresh K/V (the ring cache may already have evicted
+                # mid-prompt keys an early query needs).
+                local = functools.partial(
+                    attention_ops.dense_attention, causal=True,
+                    window=self.window, sinks=sinks,
+                )
+            else:
+                local = functools.partial(
+                    flash_attention, causal=True, window=self.window
+                )
             if cfg.mesh is not None and cfg.mesh.size > 1:
                 spec = P(BATCH_AXES, None, MODEL_AXIS, None)
                 local = jax.shard_map(
@@ -439,20 +499,27 @@ class Block(nn.Module):
         qpos = idx + jnp.arange(t, dtype=jnp.int32)
         if self.sliding_cache:
             # Ring slots carry their absolute positions: valid = written,
-            # causal, and inside the band (eviction already guarantees
-            # > qpos − window for fully-warm caches; the explicit check
-            # keeps partially-warm ones exact too).
+            # causal, and inside the band OR a pinned sink (eviction
+            # already guarantees the band bound for fully-warm caches; the
+            # explicit check keeps partially-warm ones exact too).
             kpos = cpos.value[:, None, :]  # [B, 1, W]
             qp = qpos[None, :, None]  # [1, t, 1]
-            valid = (kpos >= 0) & (kpos <= qp) & (kpos > qp - self.window)
+            band = (kpos > qp - self.window) | (kpos < sinks)
+            valid = (kpos >= 0) & (kpos <= qp) & band
             valid = valid[:, None, None, :, :]  # [B, 1, 1, t, W]
         else:
             kpos = jnp.arange(self.max_decode_len, dtype=jnp.int32)
             valid = kpos[None, :] <= qpos[:, None]
             if self.window is not None:
                 # Sliding window over the cache: a query at qpos sees cache
-                # rows in (qpos − window, qpos] — the band training used.
-                valid &= kpos[None, :] > qpos[:, None] - self.window
+                # rows in (qpos − window, qpos] — plus the first `sinks`
+                # positions when streaming a densely-trained model
+                # (StreamingLLM; the full-history twin of the ring path,
+                # which the ring's exactness tests compare against).
+                keep = kpos[None, :] > qpos[:, None] - self.window
+                if sinks:
+                    keep |= (kpos < sinks)[None, :]
+                valid &= keep
             valid = valid[None, None, None, :, :]
         s = jnp.where(valid, s, attention_ops._BIG_NEG)
         p = jax.nn.softmax(s, axis=-1)
@@ -497,6 +564,7 @@ class TransformerLM(nn.Module):
     moe_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
+    moe_router: str = "top_k"  # or 'expert_choice' (see models/moe.py)
     # Autoregressive inference (models/decoding.py `generate`): per-block K/V
     # caches sized [B, max_decode_len, H_kv, D] in the ``cache`` collection; the
     # top-level ``cache/index`` counts consumed positions. T>1 = prefill,
@@ -506,6 +574,8 @@ class TransformerLM(nn.Module):
     # Ring-buffer cache for windowed models: O(window) decode memory and
     # cache traffic regardless of generation length (see Block).
     sliding_cache: bool = False
+    # StreamingLLM attention sinks (decode-time; see Block.attention_sinks).
+    attention_sinks: int = 0
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, segment_ids=None):
@@ -552,9 +622,11 @@ class TransformerLM(nn.Module):
                 moe_k=self.moe_k,
                 capacity_factor=self.capacity_factor,
                 moe_aux_coef=self.moe_aux_coef,
+                moe_router=self.moe_router,
                 decode=self.decode,
                 max_decode_len=self.max_decode_len,
                 sliding_cache=self.sliding_cache,
+                attention_sinks=self.attention_sinks,
                 # Explicit name = flax's auto-name, so the param tree is
                 # identical with and without remat (the remat wrapper would
                 # otherwise scope as CheckpointBlock_i).

@@ -55,7 +55,8 @@ def _scores(q, k, scale):
 
 
 def dense_attention(q, k, v, *, causal: bool = True, q_segment_ids=None,
-                    kv_segment_ids=None, window: int | None = None):
+                    kv_segment_ids=None, window: int | None = None,
+                    sinks: int = 0):
     """Reference full-materialization attention (numerics ground truth).
 
     float32 softmax regardless of input dtype — bf16 logits lose too much for
@@ -64,8 +65,12 @@ def dense_attention(q, k, v, *, causal: bool = True, q_segment_ids=None,
     to equal-id pairs (packed sequences) — the reference semantics the flash
     kernel's segment masking is tested against. ``window`` (requires
     ``causal``) further restricts each query to its ``window`` most recent
-    keys (the sliding-window band the flash kernel block-skips)."""
+    keys (the sliding-window band the flash kernel block-skips); ``sinks``
+    re-admits the first ``sinks`` key positions beyond the band — the
+    global+local (StreamingLLM / Longformer-style) mask."""
     check_window(window, causal)
+    if sinks < 0:
+        raise ValueError(f"sinks must be >= 0, got {sinks}")
     scale = q.shape[-1] ** -0.5
     s = _scores(q, k, scale)
     keep = None
@@ -75,7 +80,10 @@ def dense_attention(q, k, v, *, causal: bool = True, q_segment_ids=None,
         k_pos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
         keep = (q_pos >= k_pos)[None, None]
         if window is not None:
-            keep &= (k_pos > q_pos - window)[None, None]
+            band = k_pos > q_pos - window
+            if sinks:
+                band |= k_pos < sinks
+            keep &= band[None, None]
     if q_segment_ids is not None:
         seg = q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
         keep = seg if keep is None else keep & seg
