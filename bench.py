@@ -67,6 +67,9 @@ def _lm_from_env(*, moe: bool = False):
         # block-skips tiles outside the band, so long-seq steps get
         # proportionally faster (and MFU accounts the executed band only).
         window=int(os.environ.get("BENCH_WINDOW", 0)) or None,
+        # BENCH_SINKS (with BENCH_WINDOW): global+local attention — the
+        # first S positions ride the kernel's pinned sink tile.
+        attention_sinks=int(os.environ.get("BENCH_SINKS", 0)),
         # BENCH_SLIDING=1 (decode mode, needs BENCH_WINDOW): ring-buffer KV
         # cache — O(window) cache reads per generated token instead of
         # O(prompt+new_tokens), the decode-side win of a window.

@@ -154,10 +154,10 @@ class Block(nn.Module):
     # prefill, chunk extension and decode (sinks+band everywhere), so a
     # model can be TRAINED global+local and streamed exactly; cloning a
     # densely-trained model with (window, attention_sinks, sliding_cache)
-    # for generation is the approximate StreamingLLM recipe. The
-    # non-decode forward runs the dense reference path (no flash-kernel
-    # sink support yet — O(T²) scores) and sinks do not compose with
-    # sequence parallelism (ring/Ulysses raise).
+    # for generation is the approximate StreamingLLM recipe. Sink-masked
+    # forwards run the flash kernel (a pinned sink tile per q block —
+    # O(T·(window+sinks)); dense fallback when the tiling doesn't hold);
+    # sinks do not compose with sequence parallelism (ring/Ulysses raise).
     attention_sinks: int = 0
 
     @nn.compact
@@ -221,22 +221,20 @@ class Block(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        if self.attention_sinks and cfg.seq_parallel:
-            raise ValueError(
-                "attention_sinks does not compose with sequence "
-                "parallelism yet — the sink block lives on one shard; "
-                "drop the seq axis or the sinks"
-            )
         if self.attention_sinks:
-            # Global+local mask: the dense reference path carries the sink
-            # columns (no flash-kernel sink support yet). The SAME mask the
-            # decode cache applies, so train/eval/prefill/decode agree.
-            out = attention_ops.dense_attention(
-                q, k, v, causal=True, window=self.window,
-                sinks=self.attention_sinks,
-                q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
-            )
-        elif cfg.seq_parallel:
+            if self.window is None:
+                raise ValueError(
+                    "attention_sinks is the global+local mask's global "
+                    "part — it needs window set (full causal attention "
+                    "already sees every sink)"
+                )
+            if cfg.seq_parallel:
+                raise ValueError(
+                    "attention_sinks does not compose with sequence "
+                    "parallelism yet — the sink block lives on one shard; "
+                    "drop the seq axis or the sinks"
+                )
+        if cfg.seq_parallel:
             impls = {
                 "ring": attention_ops.ring_flash_attention,
                 "ring_dense": attention_ops.ring_attention,
@@ -275,6 +273,7 @@ class Block(nn.Module):
         elif cfg.attn == "dense":
             out = attention_ops.dense_attention(
                 q, k, v, causal=True, window=self.window,
+                sinks=self.attention_sinks,
                 q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
             )
         else:
@@ -286,9 +285,13 @@ class Block(nn.Module):
             # heads over model — attention mixes neither).
             from horovod_tpu.ops.flash_attention import flash_attention
 
+            # sinks ride the kernel's pinned sink tile (a no-op at 0;
+            # dense fallback automatic) — one code path for plain, windowed
+            # and global+local local attention.
             def local(q, k, v, ids=None):
                 return flash_attention(
                     q, k, v, causal=True, window=self.window,
+                    sinks=self.attention_sinks,
                     q_segment_ids=ids, kv_segment_ids=ids,
                 )
 
@@ -381,6 +384,12 @@ class Block(nn.Module):
             )
         if self.attention_sinks < 0:
             raise ValueError("attention_sinks must be >= 0")
+        if self.attention_sinks and self.window is None:
+            raise ValueError(
+                "attention_sinks is the global+local mask's global part — "
+                "it needs window set (full causal attention already sees "
+                "every sink)"
+            )
         sinks = self.attention_sinks
         cache_spec = P(BATCH_AXES, None, MODEL_AXIS, None)
         first_call = not self.has_variable("cache", "k")
@@ -462,18 +471,14 @@ class Block(nn.Module):
             if rep > 1:  # prefill attends at full H, like training
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            if sinks:
-                # Same global+local mask as training/decode, computed from
-                # the fresh K/V (the ring cache may already have evicted
-                # mid-prompt keys an early query needs).
-                local = functools.partial(
-                    attention_ops.dense_attention, causal=True,
-                    window=self.window, sinks=sinks,
-                )
-            else:
-                local = functools.partial(
-                    flash_attention, causal=True, window=self.window
-                )
+            # Same global+local mask as training/decode, computed from the
+            # fresh K/V (the ring cache may already have evicted mid-prompt
+            # keys an early query needs); sinks ride the kernel's pinned
+            # tile, dense fallback automatic.
+            local = functools.partial(
+                flash_attention, causal=True, window=self.window,
+                sinks=sinks,
+            )
             if cfg.mesh is not None and cfg.mesh.size > 1:
                 spec = P(BATCH_AXES, None, MODEL_AXIS, None)
                 local = jax.shard_map(

@@ -72,24 +72,56 @@ def _causal_mask(iq, ik, bq, bk, offset, window=None):
 
 
 def _tile_mask(iq, ik, causal, segmented, bq, bk, offset, window,
-               qs_ref, ks_ref):
+               qs_ref, ks_ref, sinks=0, sink_sel=None):
     """(needed, mask): the block-skip predicate and the [bq, bk] 0/1 mask
     (None when unmasked). ``needed`` is False when the whole tile is
     provably masked — above the causal diagonal, below the sliding-window
     band, or (segment early-out) the q block's id range cannot intersect
     the k block's (a NECESSARY condition for any equality match, so the
     skip is sound for arbitrary id layouts, and tight for the contiguous
-    runs packing produces)."""
+    runs packing produces).
+
+    ``sinks``/``sink_sel``: global+local attention. A SINK tile (sink_sel
+    True — a traced scalar when one grid handles both kinds, or the
+    literal True for a sink-only kernel) masks to cols < sinks AND below
+    the band — strictly disjoint from band tiles, so a (row, col) pair
+    visible through both the band and the sink region is never counted
+    twice."""
     needed = True
     mask = None
     if causal:
-        needed = ik * bk <= iq * bq + bq - 1 + offset
+        band_needed = ik * bk <= iq * bq + bq - 1 + offset
         if window is not None:
             # The tile's newest key vs the tile's oldest query's horizon:
             # every (row, col) has row − col ≥ (iq*bq + offset) − (ik*bk +
             # bk − 1); when even that gap ≥ window the whole tile is stale.
-            needed &= ik * bk + bk - 1 > iq * bq + offset - window
-        mask = _causal_mask(iq, ik, bq, bk, offset, window)
+            band_needed &= ik * bk + bk - 1 > iq * bq + offset - window
+        if sinks and sink_sel is not None:
+            rows = iq * bq + offset + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
+            )
+            cols = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            band_keep = (rows >= cols) & (cols > rows - window)
+            sink_keep = (
+                (rows >= cols) & (cols < sinks) & (cols <= rows - window)
+            )
+            # A q block whose rows are all inside the window needs no
+            # sink tile — the band tiles already cover block 0.
+            sink_needed = iq * bq + bq - 1 + offset >= window
+            if sink_sel is True:
+                needed = sink_needed
+                mask = sink_keep.astype(jnp.float32)
+            else:
+                needed = (sink_sel & sink_needed) | (~sink_sel & band_needed)
+                # f32 select: Mosaic cannot legalize a vector select on i1.
+                mask = jnp.where(
+                    sink_sel,
+                    sink_keep.astype(jnp.float32),
+                    band_keep.astype(jnp.float32),
+                )
+        else:
+            needed = band_needed
+            mask = _causal_mask(iq, ik, bq, bk, offset, window)
     if segmented:
         qs = qs_ref[0]  # [bq, LANES]
         ks = ks_ref[0, 0:1, :]  # [1, bk]
@@ -115,7 +147,7 @@ def _band_lo_q(ik, bq, bk, offset, window):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
-                bq, bk, offset, window, banded, nk):
+                bq, bk, offset, window, banded, nk, sinks=0):
     if segmented:
         qs_ref, ks_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -128,7 +160,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
     # tiles (and, crucially, O(T·window) K/V DMA: a predicated-off tile in
     # a full grid still streams its block; a tile the grid never names
     # does not). The top-clipped DMA duplicates mask off via `needed`.
-    ik = _band_lo_k(iq, bq, bk, offset, window) + jj if banded else jj
+    # With sinks, tile jj==0 is the pinned SINK tile (k block 0) and the
+    # band walks jj−1.
+    sink_sel = None
+    if banded and sinks:
+        sink_sel = jj == 0
+        ik = jnp.where(
+            sink_sel, 0, _band_lo_k(iq, bq, bk, offset, window) + jj - 1
+        )
+    elif banded:
+        ik = _band_lo_k(iq, bq, bk, offset, window) + jj
+    else:
+        ik = jj
 
     @pl.when(jj == 0)
     def _():
@@ -141,7 +184,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
     # update away (half the FLOPs for causal; one matmul per co-resident
     # segment pair for packed sequences).
     needed, mask = _tile_mask(
-        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref
+        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref,
+        sinks=sinks, sink_sel=sink_sel,
     )
     if banded:
         needed &= ik <= nk - 1  # clipped-DMA duplicates beyond the last block
@@ -188,7 +232,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                    scale, causal, segmented, bq, bk, offset, window, banded,
-                   nk):
+                   nk, sinks=0):
     if segmented:
         qs_ref, ks_ref, dq_ref, acc_ref = rest
     else:
@@ -196,14 +240,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         qs_ref = ks_ref = None
     iq, jj = pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
-    ik = _band_lo_k(iq, bq, bk, offset, window) + jj if banded else jj
+    sink_sel = None
+    if banded and sinks:
+        sink_sel = jj == 0
+        ik = jnp.where(
+            sink_sel, 0, _band_lo_k(iq, bq, bk, offset, window) + jj - 1
+        )
+    elif banded:
+        ik = _band_lo_k(iq, bq, bk, offset, window) + jj
+    else:
+        ik = jj
 
     @pl.when(jj == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     needed, mask = _tile_mask(
-        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref
+        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref,
+        sinks=sinks, sink_sel=sink_sel,
     )
     if banded:
         needed &= ik <= nk - 1
@@ -245,7 +299,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                     scale, causal, segmented, bq, bk, offset, window, banded,
-                    nq):
+                    nq, sinks=0, sink_only=False):
     if segmented:
         qs_ref, ks_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
@@ -261,7 +315,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     needed, mask = _tile_mask(
-        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref
+        iq, ik, causal, segmented, bq, bk, offset, window, qs_ref, ks_ref,
+        sinks=sinks, sink_sel=True if sink_only else None,
     )
     if banded:
         needed &= iq <= nq - 1
@@ -363,19 +418,35 @@ def _seg_operands(q_seg, kv_seg, tq, tk):
     return qs, ks
 
 
+def _band_sweep_k(bq, bk, off, window, sinks, nk):
+    """(swept-axis size, k-block selector) for a banded [+ pinned sink
+    tile] sweep — shared by the forward and backward grids so they cannot
+    disagree on which k block a grid step reads."""
+    nb = min(nk, (bq + window - 2) // bk + 2) + (1 if sinks else 0)
+    lo = lambda i: _band_lo_k(i, bq, bk, off, window)  # noqa: E731
+    if sinks:
+        ksel = lambda i, j: jnp.where(  # noqa: E731
+            j == 0, 0, jnp.clip(lo(i) + j - 1, 0, nk - 1)
+        )
+    else:
+        ksel = _sweep_banded(lo, nk)
+    return nb, ksel
+
+
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11)
 )
-def _flash(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
+def _flash(q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset, bq, bk,
            interpret):
     out, _ = _flash_fwd_impl(
-        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+        q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset, bq, bk,
+        interpret,
     )
     return out
 
 
-def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
-                    interpret):
+def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset,
+                    bq, bk, interpret):
     # Kernel layout is [B, H, T, D] so the (T-block, D) tile occupies the
     # trailing dims; callers pass [B, T, H, D]. K/V carry their own Tk
     # (cross-attention); causality aligns the sequence ENDS via offset.
@@ -391,16 +462,15 @@ def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
         # Sliding window: the swept grid axis walks only the ≤ nb k blocks
         # that can intersect q block i's band (span bq + window − 1 cols,
         # any alignment) — O(T·window) tiles AND K/V DMA instead of O(T²).
-        nb = min(nk, (bq + window - 2) // bk + 2)
-        ksel = _sweep_banded(
-            lambda i: _band_lo_k(i, bq, bk, off, window), nk
-        )
+        # Sinks prepend one pinned tile (k block 0) to every sweep.
+        nb, ksel = _band_sweep_k(bq, bk, off, window, sinks, nk)
     else:
         nb, ksel = nk, _sweep
     grid = (b, h, nq, nb)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, segmented=segmented,
         bq=bq, bk=bk, offset=off, window=window, banded=banded, nk=nk,
+        sinks=sinks,
     )
     in_specs = [
         _block_spec(d, bq, _anchor),
@@ -433,22 +503,23 @@ def _flash_fwd_impl(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
-def _flash_fwd(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
-               interpret):
+def _flash_fwd(q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset, bq,
+               bk, interpret):
     out, lse = _flash_fwd_impl(
-        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+        q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset, bq, bk,
+        interpret,
     )
     return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
-def _flash_bwd(causal, window, q_offset, bq, bk, interpret, res, g):
+def _flash_bwd(causal, window, sinks, q_offset, bq, bk, interpret, res, g):
     return _flash_bwd_core(
-        causal, window, q_offset, bq, bk, interpret, res, g, None
+        causal, window, sinks, q_offset, bq, bk, interpret, res, g, None
     )
 
 
-def _flash_bwd_core(causal, window, q_offset, bq, bk, interpret, res, g,
-                    g_lse):
+def _flash_bwd_core(causal, window, sinks, q_offset, bq, bk, interpret, res,
+                    g, g_lse):
     """Shared backward: the lse cotangent (from `flash_attention_with_lse`
     consumers like the ring merge) folds into the per-row jacobian term —
     with s → p = exp(s−lse), o = p·v:  ds = p ⊙ (dp − (δ − dlse)) where
@@ -466,10 +537,7 @@ def _flash_bwd_core(causal, window, q_offset, bq, bk, interpret, res, g,
     nq, nk = tq // bq, tk // bk
     banded = window is not None
     if banded:
-        nb = min(nk, (bq + window - 2) // bk + 2)
-        ksel = _sweep_banded(
-            lambda i: _band_lo_k(i, bq, bk, off, window), nk
-        )
+        nb, ksel = _band_sweep_k(bq, bk, off, window, sinks, nk)
         nbq = min(nq, (bk + window - 2) // bq + 2)
         qsel = _sweep_banded(
             lambda i: _band_lo_q(i, bq, bk, off, window), nq
@@ -502,6 +570,7 @@ def _flash_bwd_core(causal, window, q_offset, bq, bk, interpret, res, g,
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, segmented=segmented,
             bq=bq, bk=bk, offset=off, window=window, banded=banded, nk=nk,
+            sinks=sinks,
         ),
         grid=(b, h, nq, nb),
         in_specs=dq_in_specs,
@@ -544,6 +613,45 @@ def _flash_bwd_core(causal, window, q_offset, bq, bk, interpret, res, g,
         ],
         interpret=interpret,
     )(qt, kt, vt, gt, lse, delta, *seg_ops)
+    if banded and sinks:
+        # Sink contributions to dK/dV of k block 0: every q block sees the
+        # sink columns, so this pass sweeps ALL nq q blocks for the one
+        # anchored block — a separate call keeps the band pass's swept axis
+        # at nbq instead of forcing the whole rectangle to nq.
+        sink_in_specs = [
+            _block_spec(d, bq, _sweep),
+            _block_spec(d, bk, _anchor),
+            _block_spec(d, bk, _anchor),
+            _block_spec(d, bq, _sweep),
+            _stat_spec(bq, _sweep),
+            _stat_spec(bq, _sweep),
+        ]
+        if segmented:
+            sink_in_specs += [_seg_q_spec(bq, _sweep), _seg_kv_spec(bk, _anchor)]
+        dk0, dv0 = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel, scale=scale, causal=causal,
+                segmented=segmented, bq=bq, bk=bk, offset=off, window=window,
+                banded=False, nq=nq, sinks=sinks, sink_only=True,
+            ),
+            grid=(b, h, 1, nq),
+            in_specs=sink_in_specs,
+            out_specs=[
+                _block_spec(d, bk, _anchor),
+                _block_spec(d, bk, _anchor),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, bk, d), k.dtype),
+                jax.ShapeDtypeStruct((b, h, bk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qt, kt[:, :, :bk], vt[:, :, :bk], gt, lse, delta, *seg_ops)
+        dk = dk.at[:, :, :bk].add(dk0)
+        dv = dv.at[:, :, :bk].add(dv0)
     back = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
     # Integer segment-id operands take no gradient (None cotangent).
     return back(dq), back(dk), back(dv), None, None
@@ -552,22 +660,24 @@ def _flash_bwd_core(causal, window, q_offset, bq, bk, interpret, res, g,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash_lse(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
-               interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_lse(q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset, bq,
+               bk, interpret):
     """Kernel entry that also RETURNS the per-row logsumexp — the statistic
     a cross-chip online-softmax merge needs (ring attention: each hop's
     (out, lse) pair is exactly one step of the recurrence)."""
     out, lse = _flash_fwd_impl(
-        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+        q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset, bq, bk,
+        interpret,
     )
     return out, jnp.transpose(lse[..., 0], (0, 2, 1))  # [B,H,T,1]→[B,T,H]
 
 
-def _flash_lse_fwd(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
-                   interpret):
+def _flash_lse_fwd(q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset,
+                   bq, bk, interpret):
     out, lse = _flash_fwd_impl(
-        q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk, interpret
+        q, k, v, q_seg, kv_seg, causal, window, sinks, q_offset, bq, bk,
+        interpret,
     )
     return (
         (out, jnp.transpose(lse[..., 0], (0, 2, 1))),
@@ -575,11 +685,11 @@ def _flash_lse_fwd(q, k, v, q_seg, kv_seg, causal, window, q_offset, bq, bk,
     )
 
 
-def _flash_lse_bwd(causal, window, q_offset, bq, bk, interpret, res,
+def _flash_lse_bwd(causal, window, sinks, q_offset, bq, bk, interpret, res,
                    cotangents):
     g, g_lse = cotangents
     return _flash_bwd_core(
-        causal, window, q_offset, bq, bk, interpret, res, g, g_lse
+        causal, window, sinks, q_offset, bq, bk, interpret, res, g, g_lse
     )
 
 
@@ -587,7 +697,8 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _dense_with_lse(q, k, v, *, causal: bool, q_segment_ids=None,
-                    kv_segment_ids=None, window=None, q_offset=None):
+                    kv_segment_ids=None, window=None, q_offset=None,
+                    sinks=0):
     """Dense (out, lse) fallback, numerically matching the kernel's
     conventions: f32 statistics, fully-masked rows get lse ≈ _BIG_NEG and
     zero output (so a merge weights them to zero), natively differentiable.
@@ -606,7 +717,10 @@ def _dense_with_lse(q, k, v, *, causal: bool, q_segment_ids=None,
         cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
         keep = rows >= cols  # [Tq, Tk], broadcasts over [B, H]
         if window is not None:
-            keep &= cols > rows - window
+            band = cols > rows - window
+            if sinks:
+                band |= cols < sinks
+            keep &= band
     if q_segment_ids is not None:
         seg = (
             q_segment_ids[:, None, :, None] == kv_segment_ids[:, None, None, :]
@@ -686,7 +800,7 @@ def flash_attention_with_lse(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash_lse(
-        q, k, v, q_segment_ids, kv_segment_ids, causal, window, q_offset,
+        q, k, v, q_segment_ids, kv_segment_ids, causal, window, 0, q_offset,
         block_q, block_k, interpret,
     )
 
@@ -770,6 +884,7 @@ def flash_attention(
     q_segment_ids=None,
     kv_segment_ids=None,
     window: int | None = None,
+    sinks: int = 0,
     q_offset: int | None = None,
     interpret: bool | None = None,
 ):
@@ -791,30 +906,41 @@ def flash_attention(
     ``q_offset`` overrides the q↔k alignment: query row i sits at key
     position i + q_offset (default Tk − Tq, the end-aligned convention);
     ring attention uses it to place a remote K/V block's hop distance into
-    the causal/window arithmetic."""
+    the causal/window arithmetic.
+
+    ``sinks`` (global+local / StreamingLLM mask; requires ``window``)
+    re-admits the first ``sinks`` key positions beyond the band: the grid
+    prepends one pinned tile (k block 0) per q block, masked disjointly
+    from the band, and the backward adds a sink-only dK/dV pass over that
+    block — overall cost stays O(T·(window + sinks))."""
     _check_segment_shapes(q, k, q_segment_ids, kv_segment_ids)
     check_window(window, causal)
+    if sinks < 0:
+        raise ValueError(f"sinks must be >= 0, got {sinks}")
+    if window is None:
+        sinks = 0  # full causal attention already sees every sink
     segmented = q_segment_ids is not None
     block_q, block_k = pick_blocks(
         q.shape[1], q.shape[-1], q.dtype, block_q, block_k, t_k=k.shape[1],
         segmented=segmented, windowed=window is not None,
     )
-    if not supported(
+    kernel_ok = supported(
         q.shape, block_q, block_k, k_shape=k.shape, dtype=q.dtype,
         segmented=segmented,
-    ):
+    ) and (sinks == 0 or (sinks <= block_k and q_offset is None))
+    if not kernel_ok:
         if segmented or k.shape[1] != q.shape[1] or window is not None \
                 or q_offset is not None:
             out, _ = _dense_with_lse(
                 q, k, v, causal=causal,
                 q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-                window=window, q_offset=q_offset,
+                window=window, q_offset=q_offset, sinks=sinks,
             )
             return out
         return dense_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(
-        q, k, v, q_segment_ids, kv_segment_ids, causal, window, q_offset,
-        block_q, block_k, interpret,
+        q, k, v, q_segment_ids, kv_segment_ids, causal, window, sinks,
+        q_offset, block_q, block_k, interpret,
     )

@@ -602,3 +602,82 @@ class TestWindow:
             flash_attention(q, k, v, causal=True, window=0)
         with pytest.raises(ValueError, match="causal"):
             dense_attention(q, k, v, causal=False, window=8)
+
+
+class TestSinks:
+    """Global+local (window + pinned sinks) through the banded grid: one
+    extra sink tile per q block, disjoint masks, sink-only dK/dV pass."""
+
+    @pytest.mark.parametrize("window,sinks", [(32, 8), (24, 24), (100, 17)])
+    def test_matches_dense(self, window, sinks):
+        q, k, v = _qkv(31)
+        out = flash_attention(
+            q, k, v, causal=True, window=window, sinks=sinks, **BLOCKS
+        )
+        expected = dense_attention(
+            q, k, v, causal=True, window=window, sinks=sinks
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_grads_match_dense(self):
+        q, k, v = _qkv(32)
+        window, sinks = 40, 12
+
+        def loss(fn):
+            return jax.grad(
+                lambda q, k, v: (fn(q, k, v) ** 2).sum(), argnums=(0, 1, 2)
+            )
+
+        g1 = loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=window, sinks=sinks, **BLOCKS
+        ))(q, k, v)
+        g2 = loss(lambda q, k, v: dense_attention(
+            q, k, v, causal=True, window=window, sinks=sinks
+        ))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_composes_with_segments(self):
+        rng = np.random.RandomState(33)
+        q, k, v = _qkv(33)
+        ids = jnp.asarray(
+            np.sort(rng.randint(0, 3, size=(B, T)), axis=1), jnp.int32
+        )
+        out = flash_attention(
+            q, k, v, causal=True, window=24, sinks=8,
+            q_segment_ids=ids, kv_segment_ids=ids, **BLOCKS
+        )
+        expected = dense_attention(
+            q, k, v, causal=True, window=24, sinks=8,
+            q_segment_ids=ids, kv_segment_ids=ids,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_sinks_without_window_is_plain_causal(self):
+        q, k, v = _qkv(34)
+        out = flash_attention(q, k, v, causal=True, sinks=16, **BLOCKS)
+        expected = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_oversized_sinks_fall_back_dense(self):
+        """sinks > block_k can't ride the single pinned tile — must still
+        produce the right answer via the dense fallback."""
+        q, k, v = _qkv(35)
+        out = flash_attention(
+            q, k, v, causal=True, window=32, sinks=100,
+            block_q=32, block_k=32,
+        )
+        expected = dense_attention(
+            q, k, v, causal=True, window=32, sinks=100
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
