@@ -156,8 +156,10 @@ class Block(nn.Module):
     # densely-trained model with (window, attention_sinks, sliding_cache)
     # for generation is the approximate StreamingLLM recipe. Sink-masked
     # forwards run the flash kernel (a pinned sink tile per q block —
-    # O(T·(window+sinks)); dense fallback when the tiling doesn't hold);
-    # sinks do not compose with sequence parallelism (ring/Ulysses raise).
+    # O(T·(window+sinks)); dense fallback when the tiling doesn't hold)
+    # and compose with sequence parallelism: the flash ring adds a dense
+    # sink contribution on the hop holding global block 0, Ulysses passes
+    # them to its local kernel (the dense-block ring refuses).
     attention_sinks: int = 0
 
     @nn.compact
@@ -228,11 +230,10 @@ class Block(nn.Module):
                     "part — it needs window set (full causal attention "
                     "already sees every sink)"
                 )
-            if cfg.seq_parallel:
+            if cfg.seq_parallel and cfg.attn == "ring_dense":
                 raise ValueError(
-                    "attention_sinks does not compose with sequence "
-                    "parallelism yet — the sink block lives on one shard; "
-                    "drop the seq axis or the sinks"
+                    "sinks need attn='ring' or 'ulysses' — the dense-block "
+                    "ring is sink-unaware"
                 )
         if cfg.seq_parallel:
             impls = {
@@ -256,10 +257,12 @@ class Block(nn.Module):
             # The segment ids (when packing) shard with the tokens; ring
             # rotates the kv ids, Ulysses all-gathers them (ops/attention).
             spec = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
-            impl = functools.partial(
-                impls[cfg.attn], axis_name=SEQ_AXIS, causal=True,
-                window=self.window,
+            impl_kw = dict(
+                axis_name=SEQ_AXIS, causal=True, window=self.window
             )
+            if self.attention_sinks:
+                impl_kw["sinks"] = self.attention_sinks
+            impl = functools.partial(impls[cfg.attn], **impl_kw)
             if segment_ids is None:
                 fn, args, in_specs = impl, (q, k, v), (spec, spec, spec)
             else:

@@ -173,7 +173,8 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
 
 
 def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
-                         segment_ids=None, window: int | None = None):
+                         segment_ids=None, window: int | None = None,
+                         sinks: int = 0):
     """Ring attention whose per-hop block attention is the pallas flash
     kernel — the within-chip and cross-chip halves of the SAME online
     softmax: each hop computes its block's ``(out, lse)`` in O(T/n) memory
@@ -202,13 +203,32 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
     `lax.switch` over the hop distance), and a partially-covered hop
     block-skips its stale tiles in-kernel. The ring itself still makes all
     n − 1 ppermute hops (a collective must be uniform across the axis), so
-    a window prunes FLOPs, not ICI traffic."""
+    a window prunes FLOPs, not ICI traffic.
+
+    ``sinks`` (global+local; requires ``window``): the first ``sinks``
+    GLOBAL positions stay visible beyond the band. They live in global
+    block 0, which visits every device once per rotation — the hop holding
+    it (`j == 0`, a `lax.cond`) adds a small dense (out, lse) contribution
+    over just the sink columns, masked disjointly from the band, merged by
+    the same logsumexp recurrence as every other hop. Needs
+    ``sinks ≤ T/n`` (the sink region must fit the first shard)."""
     from horovod_tpu.ops.flash_attention import flash_attention_with_lse
 
     check_window(window, causal)
+    if sinks:
+        if sinks < 0:
+            raise ValueError(f"sinks must be >= 0, got {sinks}")
+        if window is None:
+            raise ValueError(
+                "sinks need window set (full causal already sees them)"
+            )
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
+    if sinks > t_local:
+        raise ValueError(
+            f"sinks ({sinks}) must fit one sequence shard (T/n = {t_local})"
+        )
 
     def seg_kw(ks_blk):
         return (
@@ -266,21 +286,70 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
             j == my, diag, lambda x: lax.cond(j < my, full, skip, x), None
         )
 
+    def sink_contrib(k_blk, v_blk, ks_blk):
+        """(out, lse) of my queries against the sink columns of global
+        block 0 (currently held here): cols < sinks AND below the band —
+        disjoint from every band tile, so nothing is counted twice. Dense
+        [T/n, sinks] scores: the sink region is small by design."""
+        kb = k_blk[:, :sinks]
+        vb = v_blk[:, :sinks]
+        scale = d ** -0.5
+        s_ = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32
+        ) * scale
+        rows = (my * t_local + jnp.arange(t_local))[:, None]  # global q pos
+        cols = jnp.arange(sinks)[None, :]
+        keep = cols <= rows - window  # below the band (and causal: col<row)
+        if ks_blk is not None:
+            keep = keep[None] & (
+                segment_ids[:, :, None] == ks_blk[:, None, :sinks]
+            )
+            keep = keep[:, None]  # [B, 1, Tq, S]
+        else:
+            keep = keep[None, None]  # [1, 1, Tq, S]
+        s_ = jnp.where(keep, s_, _BIG_NEG)
+        mx = s_.max(axis=-1, keepdims=True)
+        p = jnp.exp(s_ - mx)
+        p = jnp.where(keep, p, 0.0)
+        lsum = p.sum(axis=-1, keepdims=True)
+        empty = lsum == 0.0
+        l_safe = jnp.where(empty, 1.0, lsum)
+        o_ = jnp.einsum(
+            "bhqk,bkhd->bqhd", (p / l_safe).astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
+        lse_ = jnp.where(empty, _BIG_NEG, mx + jnp.log(l_safe))[..., 0]
+        return o_, jnp.transpose(lse_, (0, 2, 1))  # [B, Tq, H]
+
+    def merge(o, m, l, o_c, lse_c):
+        m_new = jnp.maximum(m, lse_c)
+        alpha = jnp.exp(m - m_new)
+        w = jnp.exp(lse_c - m_new)
+        return (
+            o * alpha[..., None] + o_c.astype(jnp.float32) * w[..., None],
+            m_new,
+            l * alpha + w,
+        )
+
     def step(carry, i):
         o, m, l, k_blk, v_blk, ks_blk = carry
         j = (my - i) % n  # the block born at rank j is here after i hops
         o_j, lse_j = hop_contrib(i, j, k_blk, v_blk, ks_blk)
-        m_new = jnp.maximum(m, lse_j)
-        alpha = jnp.exp(m - m_new)
-        w = jnp.exp(lse_j - m_new)
-        l_new = l * alpha + w
-        o_new = o * alpha[..., None] + o_j.astype(jnp.float32) * w[..., None]
+        o, m, l = merge(o, m, l, o_j, lse_j)
+        if sinks:
+            o_s, lse_s = lax.cond(
+                j == 0,
+                lambda _: sink_contrib(k_blk, v_blk, ks_blk),
+                skip,
+                None,
+            )
+            o, m, l = merge(o, m, l, o_s, lse_s)
         perm = [(r, (r + 1) % n) for r in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         if ks_blk is not None:
             ks_blk = lax.ppermute(ks_blk, axis_name, perm)
-        return (o_new, m_new, l_new, k_blk, v_blk, ks_blk), None
+        return (o, m, l, k_blk, v_blk, ks_blk), None
 
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
     m0 = jnp.full((b, t_local, h), _BIG_NEG, jnp.float32)
@@ -292,7 +361,8 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
-                      segment_ids=None, window: int | None = None):
+                      segment_ids=None, window: int | None = None,
+                      sinks: int = 0):
     """All-to-all sequence parallelism: swap seq-sharding for head-sharding,
     attend over the full sequence locally, swap back.
 
@@ -328,6 +398,6 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
         seg_kw = dict(q_segment_ids=full_ids, kv_segment_ids=full_ids)
     out = flash_attention(
         to_heads(q), to_heads(k), to_heads(v), causal=causal, window=window,
-        **seg_kw
+        sinks=sinks, **seg_kw
     )
     return to_seq(out)

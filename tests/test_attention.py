@@ -371,3 +371,104 @@ class TestWindowedSequenceParallel:
             _sharded(
                 ring_flash_attention, _seq_mesh(), causal=False, window=4
             )(q, k, v)
+
+
+class TestRingSinks:
+    """Global+local through the flash ring: the hop holding global block 0
+    contributes the sink columns (dense, disjoint from the band), merged
+    by the same lse recurrence."""
+
+    @pytest.mark.parametrize("window,sinks", [(5, 2), (9, 7), (16, 8)])
+    def test_matches_dense(self, window, sinks):
+        q, k, v = _qkv(41)
+        expected = dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, window=window, sinks=sinks,
+        )
+        got = _sharded(
+            ring_flash_attention, _seq_mesh(), causal=True, window=window,
+            sinks=sinks,
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_gradients_match_dense(self):
+        q, k, v = _qkv(42)
+        mesh = _seq_mesh()
+        window, sinks = 7, 3
+
+        def loss_ring(q, k, v):
+            out = _sharded(
+                ring_flash_attention, mesh, causal=True, window=window,
+                sinks=sinks,
+            )(q, k, v)
+            return (out ** 2).sum()
+
+        def loss_dense(q, k, v):
+            return (dense_attention(
+                q, k, v, causal=True, window=window, sinks=sinks
+            ) ** 2).sum()
+
+        g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(
+            *map(jnp.asarray, (q, k, v))
+        )
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(
+            *map(jnp.asarray, (q, k, v))
+        )
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_segments_compose(self):
+        rng = np.random.RandomState(43)
+        q, k, v = _qkv(43)
+        ids = np.sort(rng.randint(0, 2, size=(B, T)), axis=1).astype(np.int32)
+        expected = dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True,
+            window=9, sinks=4, q_segment_ids=jnp.asarray(ids),
+            kv_segment_ids=jnp.asarray(ids),
+        )
+        mesh = _seq_mesh()
+        spec = P(None, "seq", None, None)
+        got = jax.jit(
+            shard_map(
+                lambda q, k, v, ids: ring_flash_attention(
+                    q, k, v, axis_name="seq", causal=True, window=9,
+                    sinks=4, segment_ids=ids,
+                ),
+                mesh=mesh,
+                in_specs=(spec, spec, spec, P(None, "seq")),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )(q, k, v, ids)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_ulysses_sinks(self):
+        q, k, v = _qkv(44)
+        expected = dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, window=9, sinks=4,
+        )
+        got = _sharded(
+            ulysses_attention, _seq_mesh(), causal=True, window=9, sinks=4
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_sinks_need_window_and_fit_shard(self):
+        q, k, v = _qkv(45)
+        with pytest.raises(ValueError, match="window"):
+            _sharded(
+                ring_flash_attention, _seq_mesh(), causal=True, sinks=4
+            )(q, k, v)
+        with pytest.raises(ValueError, match="shard"):
+            _sharded(
+                ring_flash_attention, _seq_mesh(), causal=True, window=9,
+                sinks=T,  # > T/n
+            )(q, k, v)
