@@ -293,10 +293,7 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
         [T/n, sinks] scores: the sink region is small by design."""
         kb = k_blk[:, :sinks]
         vb = v_blk[:, :sinks]
-        scale = d ** -0.5
-        s_ = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32
-        ) * scale
+        s_ = _scores(q, kb, d ** -0.5)
         rows = (my * t_local + jnp.arange(t_local))[:, None]  # global q pos
         cols = jnp.arange(sinks)[None, :]
         keep = cols <= rows - window  # below the band (and causal: col<row)

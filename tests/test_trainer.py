@@ -110,3 +110,98 @@ def test_update_scale_controls_effective_lr():
 class _FreezeScale(hvt.callbacks.Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.trainer.update_scale = 0.0
+
+
+class TestShardUpdate:
+    """ZeRO-1 / cross-replica weight-update sharding (arXiv:2004.13336):
+    replicated model, optimizer state sharded over the data axis — same
+    math as pure DP, ~1/dp per-device optimizer memory."""
+
+    def _data(self):
+        from horovod_tpu.data import datasets
+
+        (x, y), _ = datasets.mnist(cache_dir=None)
+        return x[:256, ..., None], y[:256].astype(np.int32)
+
+    def _trainer(self, **kw):
+        from horovod_tpu.models.cnn import MnistCNN
+
+        return hvt.Trainer(
+            MnistCNN(),
+            hvt.DistributedOptimizer(optax.adam(1e-3)),
+            loss="sparse_categorical_crossentropy",
+            **kw,
+        )
+
+    def test_matches_plain_dp_and_stays_sharded(self):
+        import jax
+
+        x, y = self._data()
+        plain = self._trainer()
+        zero1 = self._trainer(shard_update=True)
+        h1 = plain.fit(x=x, y=y, batch_size=8, epochs=2, verbose=0)
+        h2 = zero1.fit(x=x, y=y, batch_size=8, epochs=2, verbose=0)
+        assert abs(h1[-1]["loss"] - h2[-1]["loss"]) < 1e-5
+        for a, b in zip(
+            jax.tree.leaves(plain.state.params),
+            jax.tree.leaves(zero1.state.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
+            )
+        # The sharding survives the donated training steps.
+        specs = {
+            str(l.sharding.spec)
+            for l in jax.tree.leaves(zero1.state.opt_state)
+            if hasattr(l, "sharding") and l.ndim > 0
+        }
+        assert any("data" in s for s in specs), specs
+
+    def test_per_device_optimizer_memory_shrinks(self):
+        import jax
+
+        x, y = self._data()
+        zero1 = self._trainer(shard_update=True)
+        zero1.build(x[:8])
+        dp = zero1.mesh.shape["data"]
+        assert dp == 8
+
+        def fleet_bytes(tree):
+            # ALL shards, replicas included: replicated state costs
+            # dp × global here, sharded state ≈ 1 × global — so the bound
+            # below actually fails if sharding regresses.
+            total = 0
+            for l in jax.tree.leaves(tree):
+                if isinstance(l, jax.Array):
+                    total += sum(
+                        int(np.prod(sh.data.shape)) * l.dtype.itemsize
+                        for sh in l.addressable_shards
+                    )
+            return total
+
+        global_bytes = sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jax.tree.leaves(zero1.state.opt_state)
+            if isinstance(l, jax.Array)
+        )
+        # Sharded leaves cost one global copy across the fleet; a fully-
+        # replicated state would cost dp ×. Slack covers the replicated
+        # scalar/odd-shaped leaves.
+        assert fleet_bytes(zero1.state.opt_state) < 0.35 * dp * global_bytes
+
+    def test_guards(self):
+        from horovod_tpu.models.transformer import param_specs
+
+        with pytest.raises(ValueError, match="fsdp"):
+            self._trainer(shard_update=True, param_specs=param_specs)
+        from horovod_tpu.models.cnn import MnistCNN
+
+        with pytest.raises(ValueError, match="compression"):
+            hvt.Trainer(
+                MnistCNN(),
+                hvt.DistributedOptimizer(
+                    optax.adam(1e-3), compression="bf16"
+                ),
+                loss="sparse_categorical_crossentropy",
+                shard_update=True,
+            )
