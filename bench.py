@@ -26,6 +26,9 @@ Modes (BENCH_MODEL):
   transformer decoder LM (d512 x 8L, seq 1024, flash attention) — tokens/sec
   moe         same LM with MoE MLPs every 2nd block (8 experts, top-2) —
               tokens/sec + router drop-rate observability
+  seq2seq     encoder-decoder (models/seq2seq.py, d512 x 6enc+6dec, seq
+              1024): bidirectional encoder + causal decoder + cross-
+              attention (the flash kernel's Tk≠Tq grids) — tokens/sec
   decode      autoregressive generation (KV-cache prefill + scan decode
               loop, models/decoding.py) — generated tokens/sec
   spec        speculative decoding A/B (models/speculative.py): trains a
@@ -173,6 +176,54 @@ def bench_train(which: str) -> dict:
         loss = "sparse_categorical_crossentropy"
         unit = "images/sec/chip"
         default_steps = 256
+    elif which == "seq2seq":
+        # Encoder-decoder family (models/seq2seq.py) on a translation-shaped
+        # synthetic task (target = copy of the source, teacher-forced). The
+        # harness feeds ONE [B, S+T] int array and a thin adapter splits it
+        # into the model's {'src','tgt'} dict, so the flat-array bench legs
+        # (chunk stacking, device-cached e2e) apply unchanged — the
+        # dict-input feeding path itself is covered by tests/test_seq2seq.py.
+        import flax.linen as nn
+
+        from horovod_tpu.models.seq2seq import Seq2SeqTransformer
+
+        seq_len = int(os.environ.get("BENCH_SEQ_LEN", 1024))
+        per_chip_batch = int(os.environ.get("BENCH_LM_BATCH", 8))
+        d_model = int(os.environ.get("BENCH_DMODEL", 512))
+        enc_l = int(os.environ.get("BENCH_ENC_LAYERS", 6))
+        dec_l = int(os.environ.get("BENCH_DEC_LAYERS", 6))
+        heads = int(os.environ.get("BENCH_HEADS", 8))
+        rng0 = np.random.RandomState(0)
+        src = rng0.randint(3, 8192, size=(4096, seq_len)).astype(np.int32)
+        tgt_in = np.concatenate(
+            [np.ones((4096, 1), np.int32), src[:, :-1]], axis=1
+        )
+        inner = Seq2SeqTransformer(
+            vocab_size=8192, d_model=d_model, n_heads=heads,
+            n_enc_layers=enc_l, n_dec_layers=dec_l, dropout=0.0,
+            compute_dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+        )
+
+        class _SeqPair(nn.Module):
+            inner: Seq2SeqTransformer
+            src_len: int
+
+            @nn.compact
+            def __call__(self, xy, train: bool = False):
+                return self.inner(
+                    {"src": xy[:, : self.src_len], "tgt": xy[:, self.src_len:]},
+                    train=train,
+                )
+
+        module = _SeqPair(inner=inner, src_len=seq_len)
+        x = np.concatenate([src, tgt_in], axis=1)
+        y = src  # labels: reproduce the source token-for-token
+        metric = "seq2seq_train_tokens_per_sec_per_chip"
+        unit_per_step = per_chip_batch * n_chips * seq_len  # trained labels
+        lr = optax.adamw(hvt.scale_lr(3e-4))
+        loss = "sparse_categorical_crossentropy"
+        unit = "tokens/sec/chip"
+        default_steps = 32
     elif which in ("transformer", "moe"):
         seq_len = int(os.environ.get("BENCH_SEQ_LEN", 1024))
         per_chip_batch = int(os.environ.get("BENCH_LM_BATCH", 8))
@@ -328,6 +379,33 @@ def bench_train(which: str) -> dict:
                     head_dim, window=window,
                 ) * n_layers
             flops += fa
+    elif flops and which == "seq2seq":
+        # Three flash calls per step: encoder self (non-causal, segmented),
+        # decoder self (causal), cross (non-causal Tk≠Tq grids, segmented) —
+        # all opaque to XLA's cost model (BASELINE.md footnote 1).
+        from horovod_tpu.ops import flash_attention as fa_kernel
+
+        head_dim = d_model // heads
+        B = per_chip_batch * n_chips
+        q_shape = (B, seq_len, heads, head_dim)
+        fa = 0.0
+        blocks_seg = fa_kernel.pick_blocks(
+            seq_len, head_dim, jnp.bfloat16, segmented=True
+        )
+        if fa_kernel.supported(
+            q_shape, *blocks_seg, dtype=jnp.bfloat16, segmented=True
+        ):
+            full = trace.flash_attention_flops(
+                B, seq_len, seq_len, heads, head_dim, causal=False
+            )
+            fa += full * enc_l  # encoder self-attention
+            fa += full * dec_l  # cross-attention (Tq == Tk here)
+        blocks = fa_kernel.pick_blocks(seq_len, head_dim, jnp.bfloat16)
+        if fa_kernel.supported(q_shape, *blocks, dtype=jnp.bfloat16):
+            fa += trace.flash_attention_flops(
+                B, seq_len, seq_len, heads, head_dim, causal=True
+            ) * dec_l  # decoder self-attention
+        flops += fa
 
     # --- end-to-end: training WITH its input pipeline — the device-resident
     # dataset path (`Trainer.fit(cache='device')`): dataset staged into HBM

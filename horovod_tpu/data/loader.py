@@ -20,14 +20,22 @@ from __future__ import annotations
 import os
 from typing import Iterator, Sequence
 
+import jax.tree_util
 import numpy as np
 
 
 class ArrayDataset:
-    """An in-memory dataset of parallel arrays with chained transforms."""
+    """An in-memory dataset of parallel arrays with chained transforms.
 
-    def __init__(self, arrays: Sequence[np.ndarray]):
-        arrays = tuple(np.asarray(a) for a in arrays)
+    ``arrays`` may be any pytree of same-leading-dim arrays — a plain
+    ``(x, y)`` pair, or nested structures like ``({'src': ..., 'tgt': ...},
+    y)`` for multi-input models (e.g. the seq2seq family): batches are
+    yielded with the SAME structure, transforms operate on the flattened
+    leaves."""
+
+    def __init__(self, arrays):
+        leaves, self._treedef = jax.tree_util.tree_flatten(arrays)
+        arrays = tuple(np.asarray(a) for a in leaves)
         n = arrays[0].shape[0]
         if any(a.shape[0] != n for a in arrays):
             raise ValueError("all arrays must share the leading dimension")
@@ -48,7 +56,14 @@ class ArrayDataset:
 
     @property
     def arrays(self) -> tuple:
+        """The FLAT leaves (what the native batch-assembly engine consumes);
+        pair with `structure` to rebuild full batches."""
         return self._arrays
+
+    @property
+    def structure(self):
+        """The pytree structure batches are yielded with (a jax treedef)."""
+        return self._treedef
 
     def shard(self, index: int, count: int) -> "ArrayDataset":
         """Keep every count-th example starting at index (per-process split)."""
@@ -77,6 +92,7 @@ class ArrayDataset:
 
     def _clone(self) -> "ArrayDataset":
         ds = ArrayDataset(self._arrays)
+        ds._treedef = self._treedef
         ds._repeat = self._repeat
         ds._shuffle_buffer = self._shuffle_buffer
         ds._batch_size = self._batch_size
@@ -117,15 +133,16 @@ class ArrayDataset:
             raise ValueError("call .batch(batch_size) before iterating")
         bs = self._batch_size
         pending: list[int] = []
+        unflatten = jax.tree_util.tree_unflatten
         for idx in self._index_stream():
             pending.append(idx)
             if len(pending) == bs:
                 sel = np.asarray(pending)
                 pending = []
-                yield tuple(a[sel] for a in self._arrays)
+                yield unflatten(self._treedef, [a[sel] for a in self._arrays])
         if pending and not self._drop_remainder:
             sel = np.asarray(pending)
-            yield tuple(a[sel] for a in self._arrays)
+            yield unflatten(self._treedef, [a[sel] for a in self._arrays])
 
     def take(self, n_batches: int):
         it = iter(self)
@@ -137,6 +154,7 @@ def training_pipeline(
     batch_size: int,
     seed: int = 0,
     shuffle_buffer: int | None = None,
+    structure=None,
 ):
     """The training-path input iterator: infinite shuffled batches of the
     given arrays (the reference's ``repeat().shuffle().batch()`` chain,
@@ -153,7 +171,19 @@ def training_pipeline(
     Returns ``(iterator, close)``: call ``close()`` when done so the native
     producer thread and its staging ring are torn down promptly rather than
     at GC time.
+
+    ``arrays`` are FLAT leaves (what the native engine consumes); pass
+    ``structure`` (an `ArrayDataset.structure` treedef) to have batches
+    rebuilt into the original pytree shape — how dict-input (multi-input)
+    models ride both the native and Python assembly paths.
     """
+    def rebuild(it):
+        if structure is None:
+            return it
+        return (
+            jax.tree_util.tree_unflatten(structure, list(b)) for b in it
+        )
+
     n = len(arrays[0])
     full_shuffle = shuffle_buffer is None or shuffle_buffer >= n
     if full_shuffle and not os.environ.get("HVT_NO_NATIVE"):
@@ -163,11 +193,11 @@ def training_pipeline(
             loader = native_loader.NativeBatchLoader(
                 arrays, batch_size, seed=seed, shuffle=True
             )
-            return iter(loader), loader.close
+            return rebuild(iter(loader)), loader.close
     ds = (
         ArrayDataset(arrays)
         .repeat()
         .shuffle(shuffle_buffer or n, seed=seed)
         .batch(batch_size)
     )
-    return iter(ds), lambda: None
+    return rebuild(iter(ds)), lambda: None

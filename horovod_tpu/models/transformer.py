@@ -648,7 +648,7 @@ class TransformerLM(nn.Module):
         return logits.astype(self.logits_dtype)
 
 
-def param_specs(params, mesh: Mesh) -> dict:
+def param_specs(params, mesh: Mesh, extra_tp_dim: dict | None = None) -> dict:
     """PartitionSpec tree for the Megatron TP (+FSDP) layout.
 
     Path-based rules over the plain param pytree:
@@ -663,6 +663,10 @@ def param_specs(params, mesh: Mesh) -> dict:
     With an ``fsdp`` axis > 1, each kernel's first divisible non-model dim is
     additionally sharded over ``fsdp`` (weight-gathered FSDP: XLA inserts the
     gathers where the weights are consumed).
+
+    ``extra_tp_dim`` extends the name→column/row rule table — how sibling
+    model families (e.g. `models/seq2seq.py` with its cross-attention
+    projections) reuse these rules without duplicating them.
     """
     fsdp = mesh.shape.get(FSDP_AXIS, 1) > 1
 
@@ -677,6 +681,8 @@ def param_specs(params, mesh: Mesh) -> dict:
         "mlp_down": 0,   # [4·dm, dm]   — inputs (row-parallel)
         "lm_head": 1,    # [dm, vocab]  — vocab (column-parallel)
     }
+    if extra_tp_dim:
+        tp_dim = {**tp_dim, **extra_tp_dim}
     # Expert weights: experts over the `expert` axis, hidden over `model`
     # (column for up, row for down) — EP × TP composition.
     moe_dims = {

@@ -546,14 +546,18 @@ class Trainer:
         init_rng, dropout_rng, state_rng = jax.random.split(rng, 3)
         # Init batch sized to the data-parallel degree: models that carry
         # internal sharding constraints need the batch dim divisible by it.
-        sample = np.asarray(sample_x)
+        # Leaf-wise so pytree (dict-input) samples build like flat ones.
         n = self.dp_size
-        if len(sample) < n:
-            reps = -(-n // len(sample))
-            sample = np.concatenate([sample] * reps)
+
+        def size_to_dp(a):
+            a = np.asarray(a)
+            if len(a) < n:
+                a = np.concatenate([a] * (-(-n // len(a))))
+            return jnp.asarray(a[:n])
+
         variables = self.module.init(
             {"params": init_rng, "dropout": dropout_rng},
-            jnp.asarray(sample[:n]),
+            jax.tree.map(size_to_dp, sample_x),
             train=False,
         )
         params = variables["params"]
@@ -770,6 +774,11 @@ class Trainer:
         if cache == "device":
             if x is None or y is None:
                 raise ValueError("cache='device' needs x=/y= arrays")
+            if isinstance(x, dict):
+                raise ValueError(
+                    "cache='device' stages a single input array; pytree "
+                    "(dict) inputs use the streamed fit path"
+                )
             if self.batch_specs is not None and mesh_lib.has_live_model_axes(
                 self.mesh
             ):
@@ -805,7 +814,7 @@ class Trainer:
             # pure Python otherwise — same semantics either way.
             dataset, close_input = training_pipeline(
                 ds.arrays, local_batch, seed=self.seed,
-                shuffle_buffer=shuffle_buffer,
+                shuffle_buffer=shuffle_buffer, structure=ds.structure,
             )
         elif steps_per_epoch is None:
             raise ValueError("steps_per_epoch is required with a dataset")
@@ -991,9 +1000,10 @@ class Trainer:
                     if spe == 1:
                         yield batches[0]
                     else:
-                        yield tuple(
-                            np.stack([b[i] for b in batches])
-                            for i in range(len(batches[0]))
+                        # Stack K batches leaf-wise — pytree batches (dict
+                        # inputs, multi-input models) stack like flat ones.
+                        yield jax.tree.map(
+                            lambda *xs: np.stack(xs), *batches
                         )
 
         # Batches are staged onto the devices by a background thread while
@@ -1099,27 +1109,41 @@ class Trainer:
             # same condition as fit(cache='device')'s guard.
             cache = None
         if cache == "device":
+            if isinstance(x, dict):
+                raise ValueError(
+                    "cache='device' stages a single input array; pytree "
+                    "(dict) inputs use the streamed eval path"
+                )
             result = self._evaluate_device_cached(x, y, batch_size)
             if verbose and runtime.is_primary():
                 print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
             return result
         if cache is not None:
             raise ValueError(f"unknown cache mode {cache!r}")
-        n = len(x)
+        # x may be a pytree (dict-input models, e.g. seq2seq) — slice, pad
+        # and shard leaf-wise; y/mask stay flat arrays.
+        n = len(jax.tree_util.tree_leaves(x)[0])
         global_batch = batch_size * self.dp_size
         loss_sum = correct_sum = count = 0.0
         for start in range(0, n, global_batch):
-            xb = np.asarray(x[start : start + global_batch])
-            yb = np.asarray(y[start : start + global_batch])
-            bs = len(xb)
+            sl = lambda a: np.asarray(a[start : start + global_batch])  # noqa: E731
+            xb = jax.tree.map(sl, x)
+            yb = sl(y)
+            bs = len(yb)
             mask = np.ones((global_batch,), np.float32)
             if bs < global_batch:  # pad to the compiled shape, mask it out
                 pad = global_batch - bs
-                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, 0)])
-                yb = np.concatenate([yb, np.repeat(yb[-1:], pad, 0)])
+                grow = lambda a: np.concatenate(  # noqa: E731
+                    [a, np.repeat(a[-1:], pad, 0)]
+                )
+                xb = jax.tree.map(grow, xb)
+                yb = grow(yb)
                 mask[bs:] = 0.0
             batch = tuple(
-                self._local_slice(a, global_batch) for a in (xb, yb, mask)
+                jax.tree.map(
+                    lambda a: self._local_slice(a, global_batch), part
+                )
+                for part in (xb, yb, mask)
             )
             m = jax.device_get(self._eval_step(self.state, self._shard(batch)))
             loss_sum += float(m["loss_sum"])

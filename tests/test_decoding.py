@@ -567,7 +567,10 @@ class TestAttentionSinks:
             rtol=2e-5, atol=2e-5,
         )
 
-    def test_sinks_reject_sequence_parallelism(self):
+    def test_sinks_reject_dense_block_ring(self):
+        """Sinks compose with sequence parallelism through the flash ring
+        and Ulysses (tests/test_attention.py); the ONE remaining refusal is
+        the dense-block ring (attn='ring_dense'), which is sink-unaware."""
         import jax
         import jax.numpy as jnp
 
@@ -580,7 +583,7 @@ class TestAttentionSinks:
         model = TransformerLM(
             vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=1,
             dropout=0.0, window=6, attention_sinks=2,
-            sharding=ShardingConfig(mesh=mesh, attn="ring"),
+            sharding=ShardingConfig(mesh=mesh, attn="ring_dense"),
         )
-        with pytest.raises(ValueError, match="sequence"):
+        with pytest.raises(ValueError, match="sink-unaware"):
             model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32))
