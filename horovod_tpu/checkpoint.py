@@ -37,6 +37,7 @@ format automatically from the state's shardings.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import re
@@ -57,8 +58,17 @@ PyTree = Any
 CHECKPOINT_RE = re.compile(r"checkpoint-(\d+)\.\w+$")
 
 
+_write_seq = itertools.count()
+
+
 def _atomic_write(path: str, data: bytes) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # Unique per WRITE, not just per process: a pid-only suffix collides
+    # when two same-process writers target one path concurrently (e.g. an
+    # async ModelCheckpoint save in flight while PreemptionCheckpoint
+    # sync-saves the same epoch) and their interleaved writes would be
+    # os.replace'd into place as a corrupt checkpoint. With distinct temp
+    # files, each replace installs one complete payload — last wins.
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_write_seq)}"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)  # atomic: no torn checkpoints on crash (§5.2)

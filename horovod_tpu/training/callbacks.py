@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import time
 
 import jax
@@ -169,6 +170,37 @@ class LearningRateScheduleCallback(Callback):
             )
 
 
+def save_state(filepath_template: str, epoch: int, state, *,
+               async_save: bool = False, pending=None):
+    """One TrainState save with the checkpoint ROUTING shared by
+    `ModelCheckpoint` and `PreemptionCheckpointCallback`: single-file
+    (primary-writer-only) for host-syncable state, the sharded directory
+    format when state is cross-process sharded (every process writes its
+    shard). Returns the async handle when ``async_save`` (after joining
+    ``pending``), else None."""
+    from horovod_tpu import checkpoint
+
+    sharded = checkpoint.is_cross_process_sharded(state)
+    if not sharded and not runtime.is_primary():
+        return None
+    path = filepath_template.format(epoch=epoch + 1)
+    if sharded:
+        # Consistent across processes: shardings are SPMD-global state.
+        root, _ = os.path.splitext(path)
+        path = root + checkpoint.SHARDED_SUFFIX
+        do_save = checkpoint.save_sharded
+        do_async = checkpoint.save_sharded_async
+    else:
+        do_save = checkpoint.save
+        do_async = checkpoint.save_async
+    if async_save:
+        if pending is not None:
+            pending.join()
+        return do_async(path, state)
+    do_save(path, state)
+    return None
+
+
 class ModelCheckpoint(Callback):
     """Per-epoch full-state checkpoint, written by the primary process only
     (tensorflow2_keras_mnist.py:86-88; single-writer discipline §5.2).
@@ -196,33 +228,182 @@ class ModelCheckpoint(Callback):
         self._pending = None
 
     def on_epoch_end(self, epoch: int, logs=None):
-        from horovod_tpu import checkpoint
-
-        state = self.trainer.state
-        sharded = checkpoint.is_cross_process_sharded(state)
-        if not sharded and not runtime.is_primary():
-            return
-        path = self.filepath.format(epoch=epoch + 1)
-        if sharded:
-            # Consistent across processes: shardings are SPMD-global state.
-            root, _ = os.path.splitext(path)
-            path = root + checkpoint.SHARDED_SUFFIX
-            do_save = checkpoint.save_sharded
-            do_async = checkpoint.save_sharded_async
-        else:
-            do_save = checkpoint.save
-            do_async = checkpoint.save_async
-        if self.async_save:
-            if self._pending is not None:
-                self._pending.join()
-            self._pending = do_async(path, state)
-        else:
-            do_save(path, state)
+        self._pending = save_state(
+            self.filepath, epoch, self.trainer.state,
+            async_save=self.async_save, pending=self._pending,
+        )
 
     def on_train_end(self, logs=None):
         if self._pending is not None:
             self._pending.join()
             self._pending = None
+
+
+class PreemptionCheckpointCallback(Callback):
+    """Preemption-graceful training — the §5.3 stretch the reference lacks.
+
+    The reference's fault model is pure fail-stop: a reclaimed node kills
+    the MPI job and everything since the last per-epoch checkpoint is lost
+    (SURVEY.md §5.3). Gang-scheduled TPU slices get a *grace window* first
+    (SIGTERM → deadline → SIGKILL); this callback turns that window into a
+    clean save-and-stop:
+
+    * the signal handler only sets a flag — all real work happens at the
+      next epoch boundary, OUTSIDE collectives and XLA dispatch, so the
+      handler is async-signal-safe by construction;
+    * at every epoch end the flag is agreed cross-process
+      (`allgather_object` — ANY process's signal stops the WHOLE fleet at
+      the same epoch, so a signal that reaches processes at different
+      times cannot strand some of them in a collective);
+    * on agreement: one final checkpoint (`save_state` — same single-file
+      /sharded routing as `ModelCheckpoint`), ``trainer.stop_training``,
+      and optionally a distinct exit status.
+
+    Granularity is the epoch: bound epoch wall-clock (steps_per_epoch)
+    below the platform's grace window. Resume is the standard idiom —
+    `checkpoint.restore_latest_and_broadcast` + ``initial_epoch`` (the
+    examples do this automatically), so a preempted job relaunches and
+    continues as if it had completed the epoch normally.
+
+    ``exit_code``: when set (143 = 128+SIGTERM is the convention), a
+    SystemExit with that status is raised from ``on_train_end`` — AFTER
+    earlier callbacks flushed/joined their writers, so place this callback
+    LAST — letting a supervisor distinguish "preemption, state saved" from
+    a crash. Default None: fit() returns normally with
+    ``callback.preempted == True``.
+
+    Handlers install at train begin and restore at train end; Python
+    delivers signals to the main thread, so fit() must run there (it does
+    in every launcher path)."""
+
+    def __init__(self, filepath: str, signals=(signal.SIGTERM,),
+                 exit_code: int | None = None, verbose: int = 1):
+        self.filepath = filepath
+        self.signals = tuple(signals)
+        self.exit_code = exit_code
+        self.verbose = verbose
+        self.preempted = False
+        self._hit = False
+        self._old: dict = {}
+
+    def on_train_begin(self, logs=None):
+        self._hit = False
+        self.preempted = False
+        for s in self.signals:
+            self._old[s] = signal.signal(s, self._handler)
+
+    def _handler(self, signum, frame):
+        self._hit = True
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        hit = self._hit
+        if jax.process_count() > 1:
+            # Collective agreement — entered by every process every epoch,
+            # so the fleet takes the same branch regardless of which
+            # processes the signal has reached so far.
+            hit = any(collectives.allgather_object(hit))
+        if not hit:
+            return
+        save_state(self.filepath, epoch, self.trainer.state)
+        self.trainer.stop_training = True
+        self.preempted = True
+        if self.verbose and runtime.is_primary():
+            print(
+                f"PreemptionCheckpoint: signal received — epoch {epoch + 1} "
+                f"saved, stopping training"
+            )
+
+    def on_train_end(self, logs=None):
+        for s, h in self._old.items():
+            signal.signal(s, h)
+        self._old = {}
+        if self.preempted and self.exit_code is not None:
+            raise SystemExit(self.exit_code)
+
+
+class ExponentialMovingAverage(Callback):
+    """Polyak/EMA weight averaging — evaluate and export with a smoothed
+    copy of the parameters (beyond-parity; the standard large-batch
+    companion to the LR-scaling recipe the reference uses).
+
+    After every train-step execution: ``ema ← decay·ema + (1−decay)·params``
+    as one jitted donated update, so the shadow copy lives on device and
+    costs one fused elementwise pass per execution — no host traffic.
+    Granularity follows the fit path: per step on the streamed path, per
+    `steps_per_execution` chunk, per EPOCH on ``cache='device'`` (where
+    on_batch_end fires once per epoch) — pick ``decay`` for the cadence.
+
+    ``zero_debias=True`` applies the Adam-style correction
+    ``ema / (1 − decay^t)`` when reading (`ema_params`), so early reads are
+    unbiased even though the shadow starts at zero. Default starts the
+    shadow AT the initial params (no bias, no correction needed).
+
+    Read access: ``ema_params`` (debiased), or the ``averaged(trainer)``
+    context manager which swaps the EMA weights into ``trainer.state`` for
+    an eval/export block and restores the live weights after:
+
+        with ema.averaged(trainer):
+            trainer.evaluate(x_test, y_test)
+    """
+
+    def __init__(self, decay: float = 0.999, zero_debias: bool = False):
+        if not 0.0 < decay < 1.0:
+            raise ValueError(f"decay must be in (0, 1), got {decay}")
+        self.decay = decay
+        self.zero_debias = zero_debias
+        self._ema = None
+        self._count = 0
+        self._update = jax.jit(
+            lambda e, p: jax.tree.map(
+                lambda a, b: self.decay * a + (1.0 - self.decay) * b, e, p
+            ),
+            donate_argnums=(0,),
+        )
+
+    def on_train_begin(self, logs=None):
+        params = self.trainer.state.params
+        if self._ema is None:
+            self._ema = (
+                jax.tree.map(jax.numpy.zeros_like, params)
+                if self.zero_debias
+                else jax.tree.map(lambda a: a + 0, params)  # device copy
+            )
+            self._count = 0
+
+    def on_batch_end(self, batch: int, logs=None):
+        self._ema = self._update(self._ema, self.trainer.state.params)
+        self._count += 1
+
+    @property
+    def ema_params(self):
+        if self._ema is None:
+            raise RuntimeError("EMA not initialized — runs at fit()")
+        if self.zero_debias and self._count > 0:
+            corr = 1.0 - self.decay ** self._count
+            return jax.tree.map(lambda a: a / corr, self._ema)
+        # Fresh buffers, never the live shadow: the next update DONATES the
+        # shadow's buffers, so a returned reference would turn into a
+        # deleted jax.Array if training continues (e.g. a second fit() with
+        # this callback, or reading mid-training).
+        return jax.tree.map(lambda a: a + 0, self._ema)
+
+    def averaged(self, trainer=None):
+        """Context manager: trainer.state carries the EMA weights inside
+        the block, the live weights after."""
+        import contextlib
+
+        trainer = trainer or self.trainer
+
+        @contextlib.contextmanager
+        def swap():
+            live = trainer.state.params
+            trainer.state = trainer.state.replace(params=self.ema_params)
+            try:
+                yield
+            finally:
+                trainer.state = trainer.state.replace(params=live)
+
+        return swap()
 
 
 class ScalarLogger(Callback):

@@ -121,6 +121,20 @@ def _param_shaped_matcher(params):
     return param_shaped
 
 
+def _teardown_callbacks(callbacks) -> None:
+    """Best-effort on_train_end while a training error unwinds: teardown
+    hooks (signal-handler restoration, writer flush/close, async-save
+    joins) must still run — a PreemptionCheckpointCallback left installed
+    after a crash would silently swallow the NEXT real SIGTERM — but their
+    own failures (including the preemption callback's SystemExit) must not
+    mask the original error."""
+    for cb in callbacks:
+        try:
+            cb.on_train_end()
+        except BaseException:
+            pass
+
+
 class Trainer:
     """compile+fit+evaluate+predict for a flax module over a device mesh.
 
@@ -771,13 +785,17 @@ class Trainer:
         last step's metrics."""
         if verbose is None:
             verbose = 1 if runtime.is_primary() else 0
+        if isinstance(x, list):
+            # Keras-parity: a plain list of example rows is one array input
+            # (the pre-pytree behavior); dict/tuple inputs stay pytrees.
+            x = np.asarray(x)
         if cache == "device":
             if x is None or y is None:
                 raise ValueError("cache='device' needs x=/y= arrays")
-            if isinstance(x, dict):
+            if len(jax.tree_util.tree_leaves(x)) != 1:
                 raise ValueError(
                     "cache='device' stages a single input array; pytree "
-                    "(dict) inputs use the streamed fit path"
+                    "(dict/tuple) inputs use the streamed fit path"
                 )
             if self.batch_specs is not None and mesh_lib.has_live_model_axes(
                 self.mesh
@@ -846,8 +864,11 @@ class Trainer:
                     steps_per_epoch, callbacks, validation_data, batch_size,
                     verbose,
                 )
-        finally:
+        except BaseException:
             close_input()
+            _teardown_callbacks(callbacks)
+            raise
+        close_input()
         for cb in callbacks:
             cb.on_train_end()
         return self.history
@@ -907,29 +928,34 @@ class Trainer:
             cb.on_train_begin()
         zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
         epoch_key = jax.random.PRNGKey(self.seed + 1)
-        with trace_lib.maybe_trace(trace_lib.profile_dir()):
-            for epoch in range(initial_epoch, epochs):
-                if self.stop_training:
-                    break
-                # Fresh scale each epoch: LR callbacks compose into it in
-                # list order (warmup assigns, schedules multiply).
-                self.update_scale = 1.0
-                for cb in callbacks:
-                    cb.on_epoch_begin(epoch)
-                t0 = time.perf_counter()
-                scale = jnp.asarray(self.update_scale, jnp.float32)
-                self.state, metrics, metric_acc = self._train_epoch(
-                    self.state, data, jax.random.fold_in(epoch_key, epoch),
-                    scale, zero_acc, steps, batch_size,
-                )
-                for cb in callbacks:
-                    cb.on_batch_end(steps - 1, metrics)
-                self._finish_epoch(
-                    epoch, epochs, metric_acc, steps, t0, callbacks,
-                    validation_data, batch_size, verbose,
-                    # Device-cached training implies device-cached validation.
-                    val_cache="device",
-                )
+        try:
+            with trace_lib.maybe_trace(trace_lib.profile_dir()):
+                for epoch in range(initial_epoch, epochs):
+                    if self.stop_training:
+                        break
+                    # Fresh scale each epoch: LR callbacks compose into it
+                    # in list order (warmup assigns, schedules multiply).
+                    self.update_scale = 1.0
+                    for cb in callbacks:
+                        cb.on_epoch_begin(epoch)
+                    t0 = time.perf_counter()
+                    scale = jnp.asarray(self.update_scale, jnp.float32)
+                    self.state, metrics, metric_acc = self._train_epoch(
+                        self.state, data, jax.random.fold_in(epoch_key, epoch),
+                        scale, zero_acc, steps, batch_size,
+                    )
+                    for cb in callbacks:
+                        cb.on_batch_end(steps - 1, metrics)
+                    self._finish_epoch(
+                        epoch, epochs, metric_acc, steps, t0, callbacks,
+                        validation_data, batch_size, verbose,
+                        # Device-cached training implies device-cached
+                        # validation.
+                        val_cache="device",
+                    )
+        except BaseException:
+            _teardown_callbacks(callbacks)
+            raise
         for cb in callbacks:
             cb.on_train_end()
         return self.history
@@ -1108,11 +1134,13 @@ class Trainer:
             # batch-dim-only. With those axes trivial the layouts coincide —
             # same condition as fit(cache='device')'s guard.
             cache = None
+        if isinstance(x, list):
+            x = np.asarray(x)  # list-of-rows = one array input (see fit)
         if cache == "device":
-            if isinstance(x, dict):
+            if len(jax.tree_util.tree_leaves(x)) != 1:
                 raise ValueError(
                     "cache='device' stages a single input array; pytree "
-                    "(dict) inputs use the streamed eval path"
+                    "(dict/tuple) inputs use the streamed eval path"
                 )
             result = self._evaluate_device_cached(x, y, batch_size)
             if verbose and runtime.is_primary():

@@ -169,3 +169,131 @@ def test_full_reference_callback_stack_runs():
     assert len(hist) == 4
     # after warmup the scale must be back to 1.0
     assert trainer.update_scale == 1.0
+
+
+class TestExponentialMovingAverage:
+    def _fit(self, cb_list, steps=4):
+        import flax.linen as nn
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return nn.Dense(3)(x)
+
+        trainer = hvt.Trainer(
+            Tiny(), hvt.DistributedOptimizer(optax.sgd(0.5)),
+            loss="sparse_categorical_crossentropy",
+        )
+        rng = np.random.RandomState(0)
+        x = rng.rand(32 * steps, 5).astype(np.float32)
+        y = rng.randint(0, 3, size=(32 * steps,)).astype(np.int32)
+        trainer.fit(x=x, y=y, epochs=1, batch_size=32, callbacks=cb_list, verbose=0)
+        return trainer
+
+    def test_exact_math(self):
+        """Shadow starts AT the initial params; per-execution recurrence
+        ema_t = d*ema_{t-1} + (1-d)*p_t, verified in numpy leaf-wise."""
+        from horovod_tpu.training.callbacks import (
+            Callback,
+            ExponentialMovingAverage,
+        )
+        import jax
+
+        seen = []
+
+        class Recorder(Callback):
+            def on_train_begin(self, logs=None):
+                seen.append(jax.device_get(self.trainer.state.params))
+
+            def on_batch_end(self, batch, logs=None):
+                seen.append(jax.device_get(self.trainer.state.params))
+
+        d = 0.5
+        ema = ExponentialMovingAverage(decay=d)
+        self._fit([Recorder(), ema], steps=4)
+        expect = seen[0]  # p_init
+        for p in seen[1:]:
+            expect = jax.tree.map(lambda a, b: d * a + (1 - d) * b, expect, p)
+        got = jax.device_get(ema.ema_params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            expect, got,
+        )
+
+    def test_exact_math_zero_debias(self):
+        from horovod_tpu.training.callbacks import (
+            Callback,
+            ExponentialMovingAverage,
+        )
+        import jax
+
+        seen = []
+
+        class Recorder(Callback):
+            def on_batch_end(self, batch, logs=None):
+                seen.append(jax.device_get(self.trainer.state.params))
+
+        d = 0.5
+        ema = ExponentialMovingAverage(decay=d, zero_debias=True)
+        trainer = self._fit([Recorder(), ema], steps=4)
+        # Zero-init shadow has the closed form:
+        # ema_t = (1-d) * sum_i d^(t-i) p_i ; debiased by (1 - d^t).
+        t = len(seen)
+        expect = None
+        for i, p in enumerate(seen, start=1):
+            w = (1 - d) * d ** (t - i)
+            expect = jax.tree.map(
+                lambda a, b=None: w * a if expect is None else None, p
+            ) if expect is None else jax.tree.map(
+                lambda acc, a: acc + w * a, expect, p
+            )
+        corr = 1 - d ** t
+        expect = jax.tree.map(lambda a: a / corr, expect)
+        got = jax.device_get(ema.ema_params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            expect, got,
+        )
+
+    def test_averaged_swaps_and_restores(self):
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+        import jax
+
+        ema = ExponentialMovingAverage(decay=0.9)
+        trainer = self._fit([ema], steps=3)
+        live = jax.device_get(trainer.state.params)
+        avg = jax.device_get(ema.ema_params)
+        with ema.averaged(trainer):
+            inside = jax.device_get(trainer.state.params)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_array_equal(a, b), inside, avg
+            )
+        after = jax.device_get(trainer.state.params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(a, b), after, live
+        )
+
+    def test_decay_validation(self):
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+
+        with pytest.raises(ValueError):
+            ExponentialMovingAverage(decay=1.0)
+        with pytest.raises(ValueError):
+            ExponentialMovingAverage(decay=0.0)
+
+    def test_ema_read_survives_continued_training(self):
+        """ema_params must return FRESH buffers: the next update donates
+        the shadow, so a returned live reference would be deleted."""
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+        import jax
+
+        ema = ExponentialMovingAverage(decay=0.9)
+        trainer = self._fit([ema], steps=2)
+        held = ema.ema_params
+        # Continue training with the same callback: shadow buffers donate.
+        rng = np.random.RandomState(1)
+        x = rng.rand(64, 5).astype(np.float32)
+        y = rng.randint(0, 3, size=(64,)).astype(np.int32)
+        trainer.fit(x=x, y=y, epochs=1, batch_size=32, callbacks=[ema], verbose=0)
+        # The earlier read is still alive and fetchable.
+        jax.tree.map(lambda a: np.asarray(a), held)
