@@ -78,6 +78,22 @@ class PackedLM(nn.Module):
         return self.inner(tokens, train=train, segment_ids=seg)
 
 
+def text_corpus(n_docs: int, seed: int = 0):
+    """Synthetic TEXT documents (motifs of words) for the TEXT=1 path —
+    exercising the full text front-end: ByteBPETokenizer.train → encode →
+    pack. Same learnable repeated-motif structure as the token corpus."""
+    rng = np.random.RandomState(seed)
+    words = [
+        "alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+        "theta", "iota", "kappa", "lambda", "mu",
+    ]
+    docs = []
+    for _ in range(n_docs):
+        motif = " ".join(rng.choice(words, size=rng.randint(3, 7)))
+        docs.append(" ".join([motif] * rng.randint(2, 6)))
+    return docs
+
+
 def main() -> None:
     hvt.init()
     mesh = mesh_lib.build_mesh(
@@ -86,7 +102,29 @@ def main() -> None:
     seq_len = int(os.environ.get("SEQ_LEN", 256))
     vocab = int(os.environ.get("VOCAB", 64))
 
-    docs = synthetic_corpus(int(os.environ.get("DOCS", 2000)), vocab)
+    if os.environ.get("TEXT"):
+        # Full text pipeline: raw strings → trained byte-BPE → token docs.
+        from horovod_tpu.data.tokenizer import ByteBPETokenizer
+
+        texts = text_corpus(int(os.environ.get("DOCS", 2000)))
+        vocab = int(os.environ.get("VOCAB", 384))
+        tokenizer = ByteBPETokenizer.train(texts, vocab_size=vocab)
+        vocab = tokenizer.vocab_size  # training may stop below the budget
+        docs = tokenizer.encode_corpus(texts)
+        if hvt.is_primary():
+            path = os.path.join(
+                os.environ.get("PS_MODEL_PATH", "./models"), "tokenizer.json"
+            )
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tokenizer.save(path)
+            raw = sum(len(t.encode()) for t in texts)
+            enc = sum(len(d) for d in docs)
+            print(
+                f"byte-BPE: vocab {vocab}, {raw} bytes -> {enc} tokens "
+                f"({raw / enc:.2f} bytes/token), saved {path}"
+            )
+    else:
+        docs = synthetic_corpus(int(os.environ.get("DOCS", 2000)), vocab)
     # Pack at seq_len + 1: the shifted next-token pairs then span exactly
     # seq_len positions — divisible by a live `seq` axis for SP meshes.
     toks, seg, _ = pack_documents(docs, seq_len=seq_len + 1)

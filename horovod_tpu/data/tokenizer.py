@@ -1,0 +1,234 @@
+"""Byte-level BPE tokenizer — the text front-end of the LM data pipeline.
+
+The reference has no text path at all (MNIST images only); a framework
+whose flagship families are language models needs corpus → token-id
+plumbing, so this module completes the chain
+``text → ByteBPETokenizer.encode → packing.pack_documents →
+TransformerLM(segment_ids=...)`` with zero external dependencies.
+
+Byte-level BPE (the GPT-2/RoBERTa scheme, Sennrich et al. arXiv:1508.07909
+adapted to bytes): the base alphabet is all 256 bytes — every string is
+encodable with NO unknown-token case, and ``decode(encode(s)) == s``
+exactly for any Unicode input. Training learns ``vocab_size − 256 −
+len(specials)`` merges by iterated most-frequent-pair counting over a
+word-frequency table; encoding applies those merges greedily by learned
+rank (lowest rank first — the standard BPE inference order), with an
+LRU-ish per-word cache since natural corpora repeat words heavily.
+
+Pre-tokenization splits on whitespace with the space attached to the
+FOLLOWING word (GPT-2's convention, so ``" the"`` is one frequent unit
+and merges never cross word boundaries — what keeps BPE training linear
+instead of corpus-quadratic).
+
+Special tokens occupy the id range [256 + n_merges, vocab_size) and are
+matched as whole literals before byte-splitting, so ``<eos>`` in raw text
+becomes one id, never 5 byte tokens.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+import numpy as np
+
+
+def _pretokenize(text: str) -> list[bytes]:
+    """Whitespace-split with the space glued to the next word: the units
+    BPE merges operate within."""
+    words: list[bytes] = []
+    start = 0
+    i = 0
+    n = len(text)
+    while i < n:
+        if text[i].isspace():
+            # Flush the word ending here; the whitespace run prefixes the
+            # next word.
+            if start < i:
+                words.append(text[start:i].encode("utf-8"))
+                start = i
+            i += 1
+            while i < n and text[i].isspace():
+                i += 1
+            # find the end of the following word
+            j = i
+            while j < n and not text[j].isspace():
+                j += 1
+            words.append(text[start:j].encode("utf-8"))
+            start = j
+            i = j
+        else:
+            i += 1
+    if start < n:
+        words.append(text[start:].encode("utf-8"))
+    return words
+
+
+class ByteBPETokenizer:
+    """Trainable byte-level BPE. ``train`` then ``encode``/``decode``;
+    `save`/`load` round-trip the full state as JSON."""
+
+    def __init__(self, merges=None, specials=()):
+        # merges: list of (id_a, id_b) pairs in learned order; pair i forms
+        # token id 256 + i.
+        self.merges: list[tuple[int, int]] = [tuple(m) for m in (merges or [])]
+        self.specials: tuple[str, ...] = tuple(specials)
+        self._ranks = {m: i for i, m in enumerate(self.merges)}
+        self._cache: dict[bytes, list[int]] = {}
+
+    # -- vocabulary layout ---------------------------------------------------
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges) + len(self.specials)
+
+    def special_id(self, token: str) -> int:
+        return 256 + len(self.merges) + self.specials.index(token)
+
+    # -- training ------------------------------------------------------------
+    @classmethod
+    def train(cls, texts, vocab_size: int, specials=()) -> "ByteBPETokenizer":
+        """Learn merges from an iterable of strings until ``vocab_size``.
+
+        Pair counting runs over the word-frequency table (each distinct
+        word counted once, weighted by its frequency) — corpus length only
+        matters through the pre-tokenization pass.
+        """
+        n_merges = vocab_size - 256 - len(specials)
+        if n_merges < 0:
+            raise ValueError(
+                f"vocab_size ({vocab_size}) < base 256 + specials "
+                f"({len(specials)})"
+            )
+        word_freq: collections.Counter = collections.Counter()
+        for t in texts:
+            word_freq.update(_pretokenize(t))
+        # Each distinct word as a mutable symbol list.
+        words = [(list(w), f) for w, f in word_freq.items()]
+        merges: list[tuple[int, int]] = []
+        for _ in range(n_merges):
+            pairs: collections.Counter = collections.Counter()
+            for sym, f in words:
+                for a, b in zip(sym, sym[1:]):
+                    pairs[(a, b)] += f
+            if not pairs:
+                break  # corpus exhausted: every word is one symbol
+            (a, b), count = max(pairs.items(), key=lambda kv: (kv[1], -kv[0][0], -kv[0][1]))
+            if count < 2:
+                break  # nothing repeats — further merges are noise
+            new_id = 256 + len(merges)
+            merges.append((a, b))
+            for sym, _ in words:
+                i = 0
+                while i < len(sym) - 1:
+                    if sym[i] == a and sym[i + 1] == b:
+                        sym[i : i + 2] = [new_id]
+                    else:
+                        i += 1
+        return cls(merges=merges, specials=specials)
+
+    # -- encoding ------------------------------------------------------------
+    def _bpe_word(self, word: bytes) -> list[int]:
+        cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        sym = list(word)
+        while len(sym) > 1:
+            # The lowest-rank (earliest-learned) pair present merges first.
+            best = None
+            best_rank = None
+            for pair in zip(sym, sym[1:]):
+                r = self._ranks.get(pair)
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = pair, r
+            if best is None:
+                break
+            a, b = best
+            new_id = 256 + best_rank
+            i = 0
+            while i < len(sym) - 1:
+                if sym[i] == a and sym[i + 1] == b:
+                    sym[i : i + 2] = [new_id]
+                else:
+                    i += 1
+        if len(self._cache) < 1 << 16:
+            self._cache[word] = sym
+        return sym
+
+    def encode(self, text: str) -> list[int]:
+        if not self.specials:
+            ids: list[int] = []
+            for w in _pretokenize(text):
+                ids.extend(self._bpe_word(w))
+            return ids
+        # Specials are whole-literal matches, longest first, before BPE.
+        ids = []
+        ordered = sorted(self.specials, key=len, reverse=True)
+        rest = text
+        while rest:
+            # Earliest match wins; at equal positions the LONGEST special
+            # wins (ordered is longest-first, so its index breaks the tie).
+            hit = min(
+                (
+                    (rest.find(s), k, s)
+                    for k, s in enumerate(ordered)
+                    if s in rest
+                ),
+                default=None,
+            )
+            if hit is None:
+                for w in _pretokenize(rest):
+                    ids.extend(self._bpe_word(w))
+                break
+            pos, _, s = hit
+            for w in _pretokenize(rest[:pos]):
+                ids.extend(self._bpe_word(w))
+            ids.append(self.special_id(s))
+            rest = rest[pos + len(s):]
+        return ids
+
+    def decode(self, ids) -> str:
+        out = bytearray()
+        n_base = 256 + len(self.merges)
+        # Expand merged ids depth-first back to bytes.
+        stack = list(reversed([int(i) for i in ids]))
+        while stack:
+            i = stack.pop()
+            if i < 256:
+                out.append(i)
+            elif i < n_base:
+                a, b = self.merges[i - 256]
+                stack.extend((b, a))
+            else:
+                out.extend(self.specials[i - n_base].encode("utf-8"))
+        return out.decode("utf-8", errors="replace")
+
+    def encode_corpus(self, texts) -> list[np.ndarray]:
+        """Encode documents for `packing.pack_documents` — the
+        text → packed-pretraining bridge."""
+        return [np.asarray(self.encode(t), np.int32) for t in texts]
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str) -> str:
+        payload = {
+            "format": "hvt-bbpe-v1",
+            "merges": [list(m) for m in self.merges],
+            "specials": list(self.specials),
+        }
+        # One audited atomic-write implementation for the whole package
+        # (unique temp per WRITE — see checkpoint._atomic_write).
+        from horovod_tpu.checkpoint import _atomic_write
+
+        _atomic_write(path, json.dumps(payload).encode())
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ByteBPETokenizer":
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("format") != "hvt-bbpe-v1":
+            raise ValueError(f"not a tokenizer file: {path}")
+        return cls(
+            merges=[tuple(m) for m in payload["merges"]],
+            specials=payload["specials"],
+        )

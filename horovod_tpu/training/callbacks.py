@@ -266,11 +266,12 @@ class PreemptionCheckpointCallback(Callback):
     continues as if it had completed the epoch normally.
 
     ``exit_code``: when set (143 = 128+SIGTERM is the convention), a
-    SystemExit with that status is raised from ``on_train_end`` — AFTER
-    earlier callbacks flushed/joined their writers, so place this callback
-    LAST — letting a supervisor distinguish "preemption, state saved" from
-    a crash. Default None: fit() returns normally with
-    ``callback.preempted == True``.
+    SystemExit with that status is raised from ``on_train_end``, letting a
+    supervisor distinguish "preemption, state saved" from a crash — safe
+    at any list position: the Trainer runs EVERY callback's on_train_end
+    (writer flushes, async-save joins) before propagating the first raise.
+    Default None: fit() returns normally with ``callback.preempted ==
+    True``.
 
     Handlers install at train begin and restore at train end; Python
     delivers signals to the main thread, so fit() must run there (it does

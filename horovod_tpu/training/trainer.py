@@ -121,6 +121,23 @@ def _param_shaped_matcher(params):
     return param_shaped
 
 
+def _run_train_end(callbacks) -> None:
+    """on_train_end for the SUCCESS path: every hook runs even when an
+    earlier one raises (PreemptionCheckpointCallback's SystemExit must not
+    skip a later ModelCheckpoint's async-save join — its daemon thread
+    would be killed at interpreter exit with the write half-done); the
+    first raised exception propagates after all hooks ran."""
+    first: BaseException | None = None
+    for cb in callbacks:
+        try:
+            cb.on_train_end()
+        except BaseException as e:
+            if first is None:
+                first = e
+    if first is not None:
+        raise first
+
+
 def _teardown_callbacks(callbacks) -> None:
     """Best-effort on_train_end while a training error unwinds: teardown
     hooks (signal-handler restoration, writer flush/close, async-save
@@ -843,16 +860,21 @@ class Trainer:
 
         for cb in callbacks:
             cb.set_trainer(self)
-        for cb in callbacks:
-            cb.on_train_begin()
-
-        pending = first
-        # Zero metric accumulator, committed to the mesh's replicated
-        # sharding ONCE: a fresh uncommitted jnp.zeros each epoch would give
-        # the first step of every epoch a different input-sharding signature
-        # than the chained steps, ping-ponging between two executables.
-        zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
         try:
+            # on_train_begin sits INSIDE the teardown scope: an early
+            # installer (e.g. PreemptionCheckpointCallback's signal
+            # handler) must be torn down even when a LATER callback's
+            # begin hook raises.
+            for cb in callbacks:
+                cb.on_train_begin()
+
+            pending = first
+            # Zero metric accumulator, committed to the mesh's replicated
+            # sharding ONCE: a fresh uncommitted jnp.zeros each epoch would
+            # give the first step of every epoch a different input-sharding
+            # signature than the chained steps, ping-ponging between two
+            # executables.
+            zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
             # HVT_PROFILE=<dir> captures a jax.profiler trace of the training
             # loop (XLA op + ICI collective timing) — the Horovod-Timeline
             # env-var contract, primary-process-gated (trace.py).
@@ -869,8 +891,7 @@ class Trainer:
             _teardown_callbacks(callbacks)
             raise
         close_input()
-        for cb in callbacks:
-            cb.on_train_end()
+        _run_train_end(callbacks)
         return self.history
 
     def _stage_sharded(self, arr, per_shard: int):
@@ -924,11 +945,12 @@ class Trainer:
 
         for cb in callbacks:
             cb.set_trainer(self)
-        for cb in callbacks:
-            cb.on_train_begin()
-        zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
-        epoch_key = jax.random.PRNGKey(self.seed + 1)
         try:
+            # Inside the teardown scope — see the streamed fit path's note.
+            for cb in callbacks:
+                cb.on_train_begin()
+            zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
+            epoch_key = jax.random.PRNGKey(self.seed + 1)
             with trace_lib.maybe_trace(trace_lib.profile_dir()):
                 for epoch in range(initial_epoch, epochs):
                     if self.stop_training:
@@ -956,8 +978,7 @@ class Trainer:
         except BaseException:
             _teardown_callbacks(callbacks)
             raise
-        for cb in callbacks:
-            cb.on_train_end()
+        _run_train_end(callbacks)
         return self.history
 
     def _finish_epoch(

@@ -99,6 +99,27 @@ def test_lm_packed_pretraining(tmp_path):
     assert "LEARNING" in res.stdout, res.stdout[-800:]
 
 
+def test_lm_packed_pretraining_text_frontend(tmp_path):
+    """TEXT=1: raw strings -> trained byte-BPE -> packed pretraining.
+    The tokenizer trains, compresses, saves, and the model still learns."""
+    res = _run(
+        "lm_packed_pretraining.py",
+        {
+            "TEXT": "1",
+            "PS_MODEL_PATH": str(tmp_path),
+            "SEQ_LEN": "64",
+            "DOCS": "300",
+            "DRIVE_EPOCHS": "3",
+            "DRIVE_STEPS": "4",
+        },
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "byte-BPE: vocab" in res.stdout
+    assert "bytes/token" in res.stdout
+    assert "LEARNING" in res.stdout, res.stdout[-800:]
+    assert (tmp_path / "tokenizer.json").exists()
+
+
 @pytest.mark.slow
 def test_lm_generate(tmp_path):
     res = _run(

@@ -287,3 +287,45 @@ def test_handlers_restored_when_fit_raises(tmp_path):
         trainer.fit(x=x, y=y, epochs=2, batch_size=32,
                     callbacks=[Boom(), cb], verbose=0)
     assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_handlers_restored_when_train_begin_raises(tmp_path):
+    """A LATER callback's on_train_begin raising must still tear down the
+    already-installed signal handler."""
+
+    class BadBegin(Callback):
+        def on_train_begin(self, logs=None):
+            raise RuntimeError("begin boom")
+
+    before = signal.getsignal(signal.SIGTERM)
+    trainer = _toy_trainer()
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(64,)).astype(np.int32)
+    cb = PreemptionCheckpointCallback(str(tmp_path / "checkpoint-{epoch}.msgpack"))
+    with pytest.raises(RuntimeError, match="begin boom"):
+        trainer.fit(x=x, y=y, epochs=1, batch_size=32,
+                    callbacks=[cb, BadBegin()], verbose=0)
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_exit_code_does_not_skip_later_train_end(tmp_path):
+    """SystemExit from the preemption callback must not skip a LATER
+    callback's on_train_end (async-save joins, writer flushes)."""
+    ran = []
+
+    class After(Callback):
+        def on_train_end(self, logs=None):
+            ran.append(True)
+
+    trainer = _toy_trainer()
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(64,)).astype(np.int32)
+    cb = PreemptionCheckpointCallback(
+        str(tmp_path / "checkpoint-{epoch}.msgpack"), exit_code=143
+    )
+    with pytest.raises(SystemExit):
+        trainer.fit(x=x, y=y, epochs=4, batch_size=32,
+                    callbacks=[_SignalSelfAt(epoch=0), cb, After()], verbose=0)
+    assert ran == [True]
