@@ -19,10 +19,16 @@ al.; T5-style layout with RoPE instead of learned/relative positions):
   No RoPE on cross q/k: source and target positions are different spaces,
   so cross-attention is position-agnostic (the T5 convention).
 
-Parallelism: data/FSDP batch sharding plus Megatron tensor parallelism via
+Parallelism: data/FSDP batch sharding, Megatron tensor parallelism via
 `param_specs` (the same name-keyed column/row rules as the decoder-only
-LM, extended with the cross-attention projections). Sequence parallelism
-is decoder-only-flagship territory and intentionally not wired here.
+LM, extended with the cross-attention projections), AND sequence/context
+parallelism: with a live ``seq`` mesh axis all three attention families
+run as ring collectives — the encoder's bidirectional segmented
+self-attention and the decoder's causal self-attention through
+`ring_flash_attention`, cross-attention through `ring_cross_attention`
+(queries and memory sharded over DIFFERENT logical sequences; the memory
+blocks and their padding ids rotate around the ring). Decode mode is the
+one seq-parallel refusal: a single-token step has no sequence to shard.
 
 Inference (`make_seq2seq_generate_fn`): encode once, then the whole
 autoregressive decode — BOS prefill + `lax.scan` of single-token steps —
@@ -50,18 +56,71 @@ from horovod_tpu.models.transformer import (
     _rope,
 )
 from horovod_tpu.ops import attention as attention_ops
-from horovod_tpu.parallel.mesh import MODEL_AXIS
+from horovod_tpu.parallel.mesh import MODEL_AXIS, SEQ_AXIS
 
 _NEG = -1e30
 
 
-def _local_flash(cfg: ShardingConfig, q, k, v, *, causal: bool,
-                 q_ids=None, kv_ids=None):
-    """Flash-kernel attention on the local (non-sequence-parallel) path,
-    shard_mapped over a live mesh exactly like `transformer.Block` — GSPMD
-    cannot auto-partition a Mosaic custom call, and attention mixes neither
-    batch nor heads, so manual batch/head sharding is free."""
+def _attention(cfg: ShardingConfig, q, k, v, *, causal: bool,
+               q_ids=None, kv_ids=None, cross: bool = False):
+    """One attention dispatch for all three seq2seq call sites.
+
+    Without a live ``seq`` axis: the flash kernel locally, shard_mapped
+    over the mesh exactly like `transformer.Block` (GSPMD cannot
+    auto-partition a Mosaic custom call; attention mixes neither batch nor
+    heads, so manual batch/head sharding is free). With sequence
+    parallelism: the ring collectives — `ring_flash_attention` for the
+    encoder's non-causal segmented self-attention and the decoder's causal
+    self-attention, `ring_cross_attention` for cross-attention (queries
+    and memory sharded over DIFFERENT logical sequences; kv ids rotate
+    with their blocks, q ids stay local)."""
     from horovod_tpu.ops.flash_attention import flash_attention
+
+    if cfg.seq_parallel:
+        if cfg.attn != "ring":
+            raise ValueError(
+                "sequence-parallel Seq2SeqTransformer supports attn='ring' "
+                f"only (got {cfg.attn!r}) — the dense/Ulysses paths are "
+                "decoder-only territory"
+            )
+        qspec = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
+        ids_spec = P(BATCH_AXES, SEQ_AXIS)
+        if cross:
+            fn = lambda q, k, v, qi, ki: attention_ops.ring_cross_attention(  # noqa: E731
+                q, k, v, axis_name=SEQ_AXIS,
+                q_segment_ids=qi, kv_segment_ids=ki,
+            )
+            return jax.shard_map(
+                fn, mesh=cfg.mesh,
+                in_specs=(qspec, qspec, qspec, ids_spec, ids_spec),
+                out_specs=qspec, check_vma=False,
+            )(q, k, v, q_ids, kv_ids)
+        if q_ids is not None:
+            # Encoder self-attention: q and kv ids are the SAME shard —
+            # ring_flash_attention takes one segment_ids for both sides, so
+            # a future asymmetric-mask caller must not silently lose kv_ids
+            # here (every other path honors the two independently).
+            if q_ids is not kv_ids:
+                raise ValueError(
+                    "sequence-parallel self-attention needs q_ids and "
+                    "kv_ids to be the same array (asymmetric masks are "
+                    "cross=True territory)"
+                )
+            fn = lambda q, k, v, ids: attention_ops.ring_flash_attention(  # noqa: E731
+                q, k, v, axis_name=SEQ_AXIS, causal=causal, segment_ids=ids
+            )
+            return jax.shard_map(
+                fn, mesh=cfg.mesh,
+                in_specs=(qspec, qspec, qspec, ids_spec),
+                out_specs=qspec, check_vma=False,
+            )(q, k, v, q_ids)
+        fn = lambda q, k, v: attention_ops.ring_flash_attention(  # noqa: E731
+            q, k, v, axis_name=SEQ_AXIS, causal=causal
+        )
+        return jax.shard_map(
+            fn, mesh=cfg.mesh, in_specs=(qspec, qspec, qspec),
+            out_specs=qspec, check_vma=False,
+        )(q, k, v)
 
     if cfg.attn == "dense":
         return attention_ops.dense_attention(
@@ -113,20 +172,20 @@ class EncoderBlock(nn.Module):
         # based), so pad rows of the memory are garbage — harmless only
         # because the cross-attention mask drops them downstream; any new
         # consumer of the memory (e.g. mean-pooling) must mask too.
-        out = _local_flash(
+        out = _attention(
             cfg, q, k, v, causal=False, q_ids=src_valid, kv_ids=src_valid
         )
         out = dense(features=self.d_model, axis=(-2, -1), name="attn_out")(out)
         out = nn.Dropout(self.dropout, deterministic=not train)(out)
         x = x + out
-        x = cfg.constrain(x, P(BATCH_AXES, None, None))
+        x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
 
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
         h = dense(features=4 * self.d_model, name="mlp_up")(h)
         h = nn.gelu(h)
         h = dense(features=self.d_model, name="mlp_down")(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
-        return cfg.constrain(x + h, P(BATCH_AXES, None, None))
+        return cfg.constrain(x + h, P(BATCH_AXES, SEQ_AXIS, None))
 
 
 class DecoderBlock(nn.Module):
@@ -158,11 +217,11 @@ class DecoderBlock(nn.Module):
         if self.decode:
             out = self._cached_self_attention(q, k, v, decode_index)
         else:
-            out = _local_flash(cfg, q, k, v, causal=True)
+            out = _attention(cfg, q, k, v, causal=True)
         out = dense(features=self.d_model, axis=(-2, -1), name="attn_out")(out)
         out = nn.Dropout(self.dropout, deterministic=not train)(out)
         x = x + out
-        x = cfg.constrain(x, P(BATCH_AXES, None, None))
+        x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
 
         # --- cross-attention into the encoder memory ----------------------
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
@@ -179,13 +238,14 @@ class DecoderBlock(nn.Module):
             # the whole (unpadded) source. Query ids are the constant 1, so
             # the mask reduces to the source-side padding mask.
             q_ids = jnp.ones(q.shape[:2], jnp.int32)
-            out = _local_flash(
-                cfg, q, ck, cv, causal=False, q_ids=q_ids, kv_ids=mem_valid
+            out = _attention(
+                cfg, q, ck, cv, causal=False, q_ids=q_ids, kv_ids=mem_valid,
+                cross=True,
             )
         out = dense(features=self.d_model, axis=(-2, -1), name="cross_out")(out)
         out = nn.Dropout(self.dropout, deterministic=not train)(out)
         x = x + out
-        x = cfg.constrain(x, P(BATCH_AXES, None, None))
+        x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
 
         # --- MLP -----------------------------------------------------------
         h = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
@@ -193,7 +253,7 @@ class DecoderBlock(nn.Module):
         h = nn.gelu(h)
         h = dense(features=self.d_model, name="mlp_down")(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
-        return cfg.constrain(x + h, P(BATCH_AXES, None, None))
+        return cfg.constrain(x + h, P(BATCH_AXES, SEQ_AXIS, None))
 
     def _cached_self_attention(self, q, k, v, decode_index):
         """Growing-cache causal self-attention (the full-history layout of
@@ -227,7 +287,7 @@ class DecoderBlock(nn.Module):
             cache_spec,
         )
         if t > 1 and first_call:
-            return _local_flash(cfg, q, k, v, causal=True)
+            return _attention(cfg, q, k, v, causal=True)
         scale = d ** -0.5
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, ck.value,
@@ -303,7 +363,7 @@ class Encoder(nn.Module):
             self.vocab_size, self.d_model, dtype=self.compute_dtype,
             name="embed",
         )(src)
-        x = cfg.constrain(x, P(BATCH_AXES, None, None))
+        x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
         for i in range(self.n_layers):
             x = EncoderBlock(
                 self.d_model, self.n_heads, self.dropout, self.compute_dtype,
@@ -345,7 +405,7 @@ class Decoder(nn.Module):
             self.vocab_size, self.d_model, dtype=self.compute_dtype,
             name="embed",
         )(tgt)
-        x = cfg.constrain(x, P(BATCH_AXES, None, None))
+        x = cfg.constrain(x, P(BATCH_AXES, SEQ_AXIS, None))
         for i in range(self.n_layers):
             x = DecoderBlock(
                 self.d_model, self.n_heads, self.dropout, self.compute_dtype,
@@ -387,14 +447,14 @@ class Seq2SeqTransformer(nn.Module):
 
     def setup(self):
         cfg = self.sharding
-        if cfg.seq_parallel:
-            # Refuse loudly (the house convention — cf. Block's attn checks):
-            # silently replicating the sequence work across a live `seq`
-            # axis would be numerically right and 1/seq_parallel the speed.
+        if cfg.seq_parallel and self.decode:
+            # Training/eval run sequence-parallel (ring attention across
+            # all three call sites); autoregressive DECODE does not — a
+            # single-token step has no sequence to shard. Refuse loudly
+            # rather than silently replicate (the house convention).
             raise ValueError(
-                "Seq2SeqTransformer does not implement sequence parallelism "
-                "— use a mesh without a live 'seq' axis (the decoder-only "
-                "TransformerLM is the sequence-parallel flagship)"
+                "seq2seq decode mode does not compose with a live 'seq' "
+                "axis — generate on a mesh without sequence parallelism"
             )
         self.encoder = Encoder(
             self.vocab_size, self.d_model, self.n_heads, self.n_enc_layers,

@@ -172,6 +172,82 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
 
 
+def _merge_lse(o, m, l, o_c, lse_c):
+    """Fold one block's (out, lse) contribution into the running
+    (unnormalized out, max, normalizer) accumulator — the logsumexp
+    recurrence every ring variant shares."""
+    m_new = jnp.maximum(m, lse_c)
+    alpha = jnp.exp(m - m_new)
+    w = jnp.exp(lse_c - m_new)
+    return (
+        o * alpha[..., None] + o_c.astype(jnp.float32) * w[..., None],
+        m_new,
+        l * alpha + w,
+    )
+
+
+def ring_cross_attention(q, k, v, *, axis_name: str = "seq",
+                         q_segment_ids=None, kv_segment_ids=None):
+    """Non-causal CROSS-attention over a sequence-sharded ring — the
+    seq2seq decoder's cross-attention under sequence parallelism.
+
+    Inside `shard_map`: ``q`` is this device's ``[B, Tq/n, H, D]`` shard of
+    the decoder tokens, ``k``/``v`` the ``[B, Tk/n, H, D]`` shard of the
+    encoder memory (Tq and Tk are independent). Each of the n hops runs
+    the flash kernel's non-causal Tk≠Tq grids against one memory block and
+    folds the result in by the logsumexp recurrence while the block
+    rotates to the neighbor — identical structure to
+    `ring_flash_attention`, minus the causal machinery (every query sees
+    every key, so every hop is a full block).
+
+    ``q_segment_ids`` stays local with the queries; ``kv_segment_ids``
+    rotates with its K/V block (the source-side padding mask). A query
+    with NO matching key anywhere (an all-pad source row) gets exactly
+    zero output — the kernel's empty-row convention, preserved through
+    the merge by the safe final divide."""
+    from horovod_tpu.ops.flash_attention import flash_attention_with_lse
+
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError(
+            "q_segment_ids and kv_segment_ids come as a pair (the "
+            "source-side padding mask needs both sides labelled)"
+        )
+    n = lax.axis_size(axis_name)
+    b, tq, h, d = q.shape
+
+    def hop(k_blk, v_blk, ks_blk):
+        kw = (
+            dict(q_segment_ids=q_segment_ids, kv_segment_ids=ks_blk)
+            if q_segment_ids is not None
+            else {}
+        )
+        return flash_attention_with_lse(q, k_blk, v_blk, causal=False, **kw)
+
+    def step(carry, _):
+        o, m, l, k_blk, v_blk, ks_blk = carry
+        o_j, lse_j = hop(k_blk, v_blk, ks_blk)
+        o, m, l = _merge_lse(o, m, l, o_j, lse_j)
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        if ks_blk is not None:
+            ks_blk = lax.ppermute(ks_blk, axis_name, perm)
+        return (o, m, l, k_blk, v_blk, ks_blk), None
+
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    m0 = jnp.full((b, tq, h), _BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((b, tq, h), jnp.float32)
+    (o, _, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, kv_segment_ids), jnp.arange(n)
+    )
+    # A query with no visible key anywhere (all-pad source row) ends with
+    # o exactly 0 — each empty hop contributes (o_c=0, lse=-BIG), and while
+    # m stays at -BIG the merge adds w=1 to l per hop, so l ends at n, NOT
+    # 0. The zero output therefore comes from o, and the max() below only
+    # guards the true-zero-l case that the recurrence never produces.
+    return (o / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+
+
 def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
                          segment_ids=None, window: int | None = None,
                          sinks: int = 0):
@@ -318,15 +394,7 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True
         lse_ = jnp.where(empty, _BIG_NEG, mx + jnp.log(l_safe))[..., 0]
         return o_, jnp.transpose(lse_, (0, 2, 1))  # [B, Tq, H]
 
-    def merge(o, m, l, o_c, lse_c):
-        m_new = jnp.maximum(m, lse_c)
-        alpha = jnp.exp(m - m_new)
-        w = jnp.exp(lse_c - m_new)
-        return (
-            o * alpha[..., None] + o_c.astype(jnp.float32) * w[..., None],
-            m_new,
-            l * alpha + w,
-        )
+    merge = _merge_lse
 
     def step(carry, i):
         o, m, l, k_blk, v_blk, ks_blk = carry

@@ -225,11 +225,62 @@ class TestGeneration:
         assert (out >= 0).all() and (out < VOCAB).all()
 
 
-def test_seq_parallel_refused():
-    """A live `seq` mesh axis must refuse loudly, not silently replicate
-    the sequence work (the house loud-refusal convention)."""
-    mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=4))
-    model = _model(mesh=mesh)
-    batch = _batch(np.random.RandomState(8))
-    with pytest.raises(ValueError, match="sequence parallelism"):
-        model.init(jax.random.PRNGKey(0), batch)
+class TestSequenceParallel:
+    """All three attention families over a live `seq` axis: the encoder's
+    segmented bidirectional ring, the decoder's causal ring, and the
+    cross-attention ring (memory blocks + padding ids rotating)."""
+
+    def _sp_pair(self, seed=8, s=16, t=16, pad_tail=4):
+        rng = np.random.RandomState(seed)
+        src = rng.randint(3, VOCAB, size=(2, s)).astype(np.int32)
+        if pad_tail:
+            src[0, -pad_tail:] = PAD
+        tgt = rng.randint(3, VOCAB, size=(2, t)).astype(np.int32)
+        return {"src": jnp.asarray(src), "tgt": jnp.asarray(tgt)}
+
+    def test_matches_unsharded_values_and_grads(self):
+        batch = self._sp_pair()
+        ref_m = _model()
+        params = ref_m.init(jax.random.PRNGKey(0), batch)["params"]
+        ref = ref_m.apply({"params": params}, batch)
+
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=4))
+        sp_m = _model(mesh=mesh)
+        out = jax.jit(lambda p, b: sp_m.apply({"params": p}, b))(params, batch)
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), atol=3e-5
+        )
+
+        def loss(m):
+            return lambda p: (
+                m.apply({"params": p}, batch).astype(jnp.float32) ** 2
+            ).mean()
+
+        g_ref = jax.grad(loss(ref_m))(params)
+        g_sp = jax.jit(jax.grad(loss(sp_m)))(params)
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_sp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+    def test_trains_on_dp_sp_mesh(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=4))
+        model = _model(mesh=mesh)
+        trainer = hvt.Trainer(
+            model, hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy", mesh=mesh,
+        )
+        rng = np.random.RandomState(0)
+        x, y = _copy_task(512, 16, 16, rng)
+        hist = trainer.fit(x=x, y=y, epochs=2, batch_size=8, verbose=0)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_sp_requires_ring(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=4))
+        model = _model(mesh=mesh, attn="dense")
+        with pytest.raises(ValueError, match="attn='ring'"):
+            model.init(jax.random.PRNGKey(0), self._sp_pair())
+
+    def test_decode_refused_on_seq_mesh(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, seq=4))
+        model = _model(mesh=mesh).clone(decode=True, max_decode_len=8)
+        with pytest.raises(ValueError, match="decode mode"):
+            model.init(jax.random.PRNGKey(0), self._sp_pair(t=1))
