@@ -1,0 +1,148 @@
+"""Minimal HTTP model server — the TF-Serving role over this framework's
+serving bundles.
+
+The reference's export tail produces a SavedModel "so that it can be
+served by TF Serving" (mnist_keras.py:126-140); this module is the
+native half of that story: it serves a StableHLO bundle
+(`checkpoint.export_serving`'s default format) over HTTP with the same
+``input → prob`` contract, no TF anywhere.
+
+Endpoints (JSON, shapes follow the exported signature's trailing dims):
+
+* ``GET  /healthz``                → ``{"status": "ok", "bundle": ...}``
+* ``POST /v1/predict``  body ``{"input": [[...], ...]}``
+                                   → ``{"prob": [[...], ...]}``
+
+Batching: the exported program is compiled for ONE batch shape (static
+shapes are the deal with XLA). Requests of any row count are padded up /
+split to the bundle's batch size server-side, so clients never see the
+static-shape constraint. The compiled callable is locked — requests
+serialize through the device; concurrency comes from the accelerator
+being fast, not from re-entrancy.
+
+Run:  ``python -m horovod_tpu.launch.serve <bundle_dir> [--port 8000]``
+(or `serve_forever(bundle_dir, port)` programmatically; tests use
+`make_server` + a background thread).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+class _ModelApp:
+    """The bundle, its static batch size, and the pad/split logic."""
+
+    def __init__(self, bundle_dir: str):
+        from horovod_tpu import checkpoint
+
+        self.bundle_dir = bundle_dir
+        self.fn = checkpoint.load_serving(bundle_dir)
+        with open(f"{bundle_dir}/{checkpoint.SIGNATURE_FILE}") as f:
+            self.signature = json.load(f)["signature"]
+        spec = self.signature["inputs"]["input"]
+        self.batch = int(spec["shape"][0])
+        self.row_shape = tuple(int(d) for d in spec["shape"][1:])
+        self.dtype = np.dtype(spec["dtype"])
+        self._lock = threading.Lock()
+
+    def predict(self, rows: np.ndarray) -> np.ndarray:
+        if rows.ndim != 1 + len(self.row_shape) or (
+            rows.shape[1:] != self.row_shape
+        ):
+            raise ValueError(
+                f"input rows must be shaped {('N',) + self.row_shape}, "
+                f"got {rows.shape}"
+            )
+        rows = rows.astype(self.dtype)
+        out = []
+        with self._lock:
+            for start in range(0, len(rows), self.batch):
+                chunk = rows[start : start + self.batch]
+                n = len(chunk)
+                if n < self.batch:  # pad to the compiled shape
+                    chunk = np.concatenate(
+                        [chunk, np.repeat(chunk[-1:], self.batch - n, 0)]
+                    )
+                out.append(np.asarray(self.fn(chunk))[:n])
+        return np.concatenate(out)
+
+
+def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1"):
+    """Build (but don't start) the HTTP server; ``server.server_address``
+    carries the bound port when ``port=0``."""
+    app = _ModelApp(bundle_dir)
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet: one line per request is noise
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(
+                    200, {"status": "ok", "bundle": app.bundle_dir,
+                          "signature": app.signature}
+                )
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/predict":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length))
+                rows = np.asarray(payload["input"])
+                prob = app.predict(rows)
+                self._send(200, {"prob": prob.tolist()})
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:  # device/runtime failures -> 5xx JSON,
+                # never a dropped socket (the module's errors-are-JSON
+                # contract; XlaRuntimeError does not subclass ValueError).
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.app = app  # tests reach the model through the server handle
+    return server
+
+
+def serve_forever(bundle_dir: str, port: int = 8000, host: str = "0.0.0.0"):
+    server = make_server(bundle_dir, port=port, host=host)
+    print(
+        f"serving {bundle_dir} on http://{host}:{server.server_address[1]} "
+        f"(input {server.app.signature['inputs']['input']['shape']})",
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("bundle_dir", help="a checkpoint.export_serving bundle")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+    serve_forever(args.bundle_dir, port=args.port, host=args.host)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""HTTP model server over a StableHLO serving bundle: the TF-Serving role
+(mnist_keras.py:126-140's 'so it can be served') with the input→prob
+contract over real HTTP — health, predict, server-side batch pad/split,
+and input validation."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint
+from horovod_tpu.launch.serve import make_server
+
+BATCH, DIM, CLASSES = 4, 6, 3
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(CLASSES)(x)
+
+    model = Tiny()
+    x0 = np.zeros((BATCH, DIM), np.float32)
+    params = model.init(jax.random.PRNGKey(0), x0)["params"]
+    d = tmp_path_factory.mktemp("export")
+    out = checkpoint.export_serving(
+        str(d),
+        lambda p, x: model.apply({"params": p}, x),
+        params,
+        input_shape=(BATCH, DIM),
+        timestamp="19700101-000000",
+    )
+    return out, model, params
+
+
+@pytest.fixture(scope="module")
+def server(bundle):
+    out, _, _ = bundle
+    srv = make_server(out, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+def _url(server, path):
+    return f"http://127.0.0.1:{server.server_address[1]}{path}"
+
+
+def _post(server, path, payload):
+    req = urllib.request.Request(
+        _url(server, path), data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz(server):
+    with urllib.request.urlopen(_url(server, "/healthz")) as r:
+        body = json.loads(r.read())
+    assert body["status"] == "ok"
+    assert body["signature"]["inputs"]["input"]["shape"] == [BATCH, DIM]
+
+
+def test_predict_matches_local(server, bundle):
+    _, model, params = bundle
+    rng = np.random.RandomState(0)
+    x = rng.randn(BATCH, DIM).astype(np.float32)
+    status, body = _post(server, "/v1/predict", {"input": x.tolist()})
+    assert status == 200
+    want = jax.nn.softmax(model.apply({"params": params}, x), axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(body["prob"]), np.asarray(want), atol=1e-5
+    )
+
+
+def test_pad_and_split_arbitrary_row_counts(server, bundle):
+    """Clients never see the compiled batch shape: 1 row pads up, 11 rows
+    split into compiled-batch chunks."""
+    _, model, params = bundle
+    rng = np.random.RandomState(1)
+    for n in (1, BATCH - 1, BATCH, 2 * BATCH + 3):
+        x = rng.randn(n, DIM).astype(np.float32)
+        status, body = _post(server, "/v1/predict", {"input": x.tolist()})
+        assert status == 200
+        prob = np.asarray(body["prob"])
+        assert prob.shape == (n, CLASSES)
+        want = jax.nn.softmax(model.apply({"params": params}, x), axis=-1)
+        np.testing.assert_allclose(prob, np.asarray(want), atol=1e-5)
+
+
+def test_bad_input_is_400_not_crash(server):
+    status, body = _post(server, "/v1/predict", {"input": [[1.0, 2.0]]})
+    assert status == 400 and "error" in body
+    status, body = _post(server, "/v1/predict", {"wrong_key": []})
+    assert status == 400
+    status, body = _post(server, "/nope", {"input": []})
+    assert status == 404
+
+
+def test_unknown_get_404(server):
+    try:
+        urllib.request.urlopen(_url(server, "/nope"))
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_runtime_failure_is_500_json(server):
+    """An unexpected error inside the model call must surface as a 5xx
+    JSON body, not a dropped socket."""
+    app = server.app
+    orig = app.fn
+    app.fn = lambda x: (_ for _ in ()).throw(RuntimeError("device fell over"))
+    try:
+        x = np.zeros((BATCH, DIM), np.float32)
+        status, body = _post(server, "/v1/predict", {"input": x.tolist()})
+        assert status == 500
+        assert "device fell over" in body["error"]
+    finally:
+        app.fn = orig
