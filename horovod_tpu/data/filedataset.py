@@ -1,0 +1,162 @@
+"""File-backed sharded datasets — feeding sets bigger than host RAM.
+
+The reference's data story is `mnist.load_data()` into memory
+(tensorflow2_keras_mnist.py:34-41); at framework scale the dataset lives
+on disk in shards and the host touches only the rows of the current
+batch. This module is that path with zero dependencies:
+
+* `write_shards(columns, dir)` — named columns ({'x': ..., 'y': ...}) cut
+  into ``shard_size``-row pieces, one ``.npy`` per column per shard plus
+  an ``index.json`` (atomic). `.npy` (not `.npz`) because numpy can
+  MEMORY-MAP it: readers never load a shard, they map it.
+* `FileDataset(dir)` — lazily mmaps shards on first touch; batch assembly
+  gathers exactly the requested rows through the maps (the OS page cache
+  is the working set, not a Python copy of the dataset).
+* `.batches(...)` — per-epoch global permutation (seeded), optional
+  repeat, and per-process striping (``shard=(index, count)``), mirroring
+  `ArrayDataset.shard`'s every-count-th-row split. `.pairs('x', 'y', ...)`
+  yields the ``(x, y)`` tuples `Trainer.fit(dataset=...)`` consumes.
+
+This is the host-side cold path; the hot path stays the same — batches
+land on device through `sharding.shard_batch` exactly like in-memory
+feeding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+INDEX_FILE = "index.json"
+_FORMAT = "hvt-shards-v1"
+
+
+def write_shards(columns: dict, directory: str, shard_size: int = 8192) -> str:
+    """Cut named columns into on-disk shards. Returns ``directory``."""
+    if not isinstance(columns, dict) or not columns:
+        raise ValueError("columns must be a non-empty dict of name -> array")
+    arrays = {k: np.asarray(v) for k, v in columns.items()}
+    n = len(next(iter(arrays.values())))
+    if any(len(a) != n for a in arrays.values()):
+        raise ValueError("all columns must share the leading dimension")
+    if shard_size < 1:
+        raise ValueError(f"shard_size must be >= 1, got {shard_size}")
+    os.makedirs(directory, exist_ok=True)
+    if os.path.exists(os.path.join(directory, INDEX_FILE)):
+        # Rewriting in place cannot be made crash-atomic (shards would be
+        # overwritten before the new index lands, and a live reader's mmap
+        # can SIGBUS under truncation) — refuse; write a fresh directory.
+        raise ValueError(
+            f"{directory} already holds a dataset (index.json present); "
+            "write_shards only creates fresh directories"
+        )
+    n_shards = -(-n // shard_size)
+    for s in range(n_shards):
+        lo, hi = s * shard_size, min((s + 1) * shard_size, n)
+        for key, arr in arrays.items():
+            np.save(os.path.join(directory, f"shard-{s:05d}.{key}.npy"),
+                    arr[lo:hi])
+    index = {
+        "format": _FORMAT,
+        "n_examples": n,
+        "shard_size": shard_size,
+        "n_shards": n_shards,
+        "columns": {
+            k: {"dtype": a.dtype.name, "shape": list(a.shape[1:])}
+            for k, a in arrays.items()
+        },
+    }
+    # Atomic: a reader never sees a directory with an index but missing
+    # shards (the index is written LAST) or a torn index.
+    from horovod_tpu.checkpoint import _atomic_write
+
+    _atomic_write(
+        os.path.join(directory, INDEX_FILE), json.dumps(index).encode()
+    )
+    return directory
+
+
+class FileDataset:
+    """Reader over a `write_shards` directory; shards memory-map lazily."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        with open(os.path.join(directory, INDEX_FILE)) as f:
+            self.index = json.load(f)
+        if self.index.get("format") != _FORMAT:
+            raise ValueError(f"not a shard directory: {directory}")
+        self.columns = tuple(self.index["columns"])
+        self._maps: dict[tuple[int, str], np.ndarray] = {}
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.index["n_examples"])
+
+    def _map(self, shard: int, key: str) -> np.ndarray:
+        m = self._maps.get((shard, key))
+        if m is None:
+            m = np.load(
+                os.path.join(self.directory, f"shard-{shard:05d}.{key}.npy"),
+                mmap_mode="r",
+            )
+            self._maps[(shard, key)] = m
+        return m
+
+    def gather(self, rows: np.ndarray) -> dict:
+        """Assemble the given global row ids (in order) as one dict batch —
+        reads touch only those rows of the mapped shards."""
+        rows = np.asarray(rows)
+        size = int(self.index["shard_size"])
+        shard_of, offset = rows // size, rows % size
+        out = {
+            k: np.empty(
+                (len(rows),) + tuple(self.index["columns"][k]["shape"]),
+                dtype=self.index["columns"][k]["dtype"],
+            )
+            for k in self.columns
+        }
+        for s in np.unique(shard_of):
+            sel = shard_of == s
+            offs = offset[sel]
+            for k in self.columns:
+                out[k][sel] = self._map(int(s), k)[offs]
+        return out
+
+    def batches(self, batch_size: int, *, seed: int = 0,
+                shuffle: bool = True, repeat: bool = False,
+                shard: tuple[int, int] = (0, 1),
+                drop_remainder: bool = True):
+        """Dict batches over a per-epoch seeded permutation.
+
+        ``shard=(i, n)`` keeps every n-th example starting at i — the
+        per-process split (`ArrayDataset.shard` semantics: disjoint,
+        exhaustive)."""
+        idx, cnt = shard
+        if not (0 <= idx < cnt):
+            raise ValueError(f"shard index {idx} out of range for {cnt}")
+        mine = np.arange(self.num_examples)[idx::cnt]
+        if drop_remainder and len(mine) < batch_size:
+            # Every epoch would yield ZERO batches; with repeat=True the
+            # loop would spin forever producing nothing — refuse loudly.
+            raise ValueError(
+                f"per-process stripe has {len(mine)} examples < batch_size "
+                f"({batch_size}); shrink the batch or set "
+                "drop_remainder=False"
+            )
+        rng = np.random.RandomState(seed)
+        while True:
+            order = rng.permutation(mine) if shuffle else mine
+            for lo in range(0, len(order), batch_size):
+                sel = order[lo : lo + batch_size]
+                if len(sel) < batch_size and drop_remainder:
+                    break
+                yield self.gather(sel)
+            if not repeat:
+                return
+
+    def pairs(self, x_key: str, y_key: str, batch_size: int, **kw):
+        """(x, y) tuple batches for ``Trainer.fit(dataset=...)``."""
+        for b in self.batches(batch_size, **kw):
+            yield b[x_key], b[y_key]

@@ -25,6 +25,7 @@ import signal
 import time
 
 import jax
+import numpy as np
 
 from horovod_tpu import runtime
 from horovod_tpu.parallel import collectives, sharding
@@ -345,15 +346,25 @@ class ExponentialMovingAverage(Callback):
 
         with ema.averaged(trainer):
             trainer.evaluate(x_test, y_test)
+
+    Durability: pass ``checkpoint_dir`` to persist the shadow alongside the
+    model checkpoints (primary-written ``ema.msgpack``, atomic, every
+    epoch) and restore it on the next fit() — without this, a
+    preemption/restart resumes the MODEL from its checkpoint but would
+    silently restart the shadow from the restored weights, quietly
+    discarding the accumulated average.
     """
 
-    def __init__(self, decay: float = 0.999, zero_debias: bool = False):
+    def __init__(self, decay: float = 0.999, zero_debias: bool = False,
+                 checkpoint_dir: str | None = None):
         if not 0.0 < decay < 1.0:
             raise ValueError(f"decay must be in (0, 1), got {decay}")
         self.decay = decay
         self.zero_debias = zero_debias
+        self.checkpoint_dir = checkpoint_dir
         self._ema = None
         self._count = 0
+        self._pending = None
         self._update = jax.jit(
             lambda e, p: jax.tree.map(
                 lambda a, b: self.decay * a + (1.0 - self.decay) * b, e, p
@@ -361,8 +372,57 @@ class ExponentialMovingAverage(Callback):
             donate_argnums=(0,),
         )
 
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.checkpoint_dir, "ema.msgpack")
+
     def on_train_begin(self, logs=None):
         params = self.trainer.state.params
+        if self._ema is None and self.checkpoint_dir is not None:
+            from horovod_tpu import checkpoint
+
+            # The PRIMARY's view of the directory decides (the file is
+            # primary-written; checkpoint_dir may be a host-local path on
+            # a pod), and the restored shadow is broadcast so every
+            # process resumes the SAME running average — mirroring
+            # restore_latest_and_broadcast's discipline.
+            found = (
+                os.path.exists(self._ckpt_path())
+                if runtime.is_primary() else False
+            )
+            if jax.process_count() > 1:
+                found = collectives.broadcast_object(found)
+            if found:
+                count = 0
+                if runtime.is_primary():
+                    payload = checkpoint.restore(
+                        self._ckpt_path(), {"shadow": params, "count": 0}
+                    )
+                    shadow = jax.tree.map(np.asarray, payload["shadow"])
+                    count = int(payload["count"])
+                else:
+                    shadow = jax.tree.map(
+                        lambda l: np.zeros(l.shape, l.dtype), params
+                    )
+                if jax.process_count() > 1:
+                    # ORDER MATTERS: broadcast on the HOST first so every
+                    # process holds identical values, THEN device_put — a
+                    # device_put onto a cross-process sharding is itself a
+                    # collective (it verifies value equality across
+                    # processes), so placing divergent pre-broadcast values
+                    # would fail, and any asymmetry between the primary's
+                    # and the others' paths here deadlocks the fleet.
+                    shadow = collectives.broadcast_pytree(shadow)
+                    count = int(collectives.broadcast_object(count))
+                # The shadow must carry the params' shardings: a bare
+                # device_put would commit it to one device and the next
+                # donated _update would see incompatible placements.
+                self._ema = jax.tree.map(
+                    lambda t, p: jax.device_put(
+                        t, p.sharding if isinstance(p, jax.Array) else None
+                    ),
+                    shadow, params,
+                )
+                self._count = count
         if self._ema is None:
             self._ema = (
                 jax.tree.map(jax.numpy.zeros_like, params)
@@ -374,6 +434,27 @@ class ExponentialMovingAverage(Callback):
     def on_batch_end(self, batch: int, logs=None):
         self._ema = self._update(self._ema, self.trainer.state.params)
         self._count += 1
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        if self.checkpoint_dir is None or not runtime.is_primary():
+            return
+        from horovod_tpu import checkpoint
+
+        # The shadow is replicated state (params stay replicated under the
+        # EMA-supported layouts), so the single-file primary write applies.
+        # Async with at most one write in flight (ModelCheckpoint's
+        # discipline): the fetch + serialization run off-thread instead of
+        # stalling every epoch boundary on a params-sized device_get.
+        if self._pending is not None:
+            self._pending.join()
+        self._pending = checkpoint.save_async(
+            self._ckpt_path(), {"shadow": self._ema, "count": self._count}
+        )
+
+    def on_train_end(self, logs=None):
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
 
     @property
     def ema_params(self):

@@ -297,3 +297,26 @@ class TestExponentialMovingAverage:
         trainer.fit(x=x, y=y, epochs=1, batch_size=32, callbacks=[ema], verbose=0)
         # The earlier read is still alive and fetchable.
         jax.tree.map(lambda a: np.asarray(a), held)
+
+    def test_ema_checkpoint_roundtrip(self, tmp_path):
+        """With checkpoint_dir set, the shadow persists across a restart —
+        a fresh callback (new process, restored model) resumes the SAME
+        running average instead of restarting it from the live weights."""
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+        import jax
+
+        d = str(tmp_path)
+        ema = ExponentialMovingAverage(decay=0.7, checkpoint_dir=d)
+        trainer = self._fit([ema], steps=3)
+        saved = jax.device_get(ema.ema_params)
+        count = ema._count
+        assert (tmp_path / "ema.msgpack").exists()
+
+        ema2 = ExponentialMovingAverage(decay=0.7, checkpoint_dir=d)
+        ema2.set_trainer(trainer)
+        ema2.on_train_begin()
+        assert ema2._count == count
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            jax.device_get(ema2.ema_params), saved,
+        )

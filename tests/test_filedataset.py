@@ -1,0 +1,141 @@
+"""File-backed sharded dataset: write/read round-trip through memory
+maps, per-process striping, epoch permutations, Trainer integration."""
+
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu.data.filedataset import FileDataset, write_shards
+
+
+@pytest.fixture()
+def store(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.rand(100, 5).astype(np.float32)
+    y = np.arange(100).astype(np.int32)
+    d = write_shards({"x": x, "y": y}, str(tmp_path / "ds"), shard_size=16)
+    return d, x, y
+
+
+class TestRoundTrip:
+    def test_content_and_mmap(self, store):
+        d, x, y = store
+        ds = FileDataset(d)
+        assert ds.num_examples == 100
+        got = ds.gather(np.arange(100))
+        np.testing.assert_array_equal(got["x"], x)
+        np.testing.assert_array_equal(got["y"], y)
+        # Shards are MAPPED, not loaded.
+        assert isinstance(ds._map(0, "x"), np.memmap)
+
+    def test_gather_arbitrary_order_crossing_shards(self, store):
+        d, x, y = store
+        ds = FileDataset(d)
+        rows = np.array([99, 0, 17, 16, 15, 63, 2])
+        got = ds.gather(rows)
+        np.testing.assert_array_equal(got["y"], y[rows])
+        np.testing.assert_array_equal(got["x"], x[rows])
+
+    def test_ragged_last_shard(self, tmp_path):
+        d = write_shards(
+            {"a": np.arange(10)}, str(tmp_path / "r"), shard_size=4
+        )
+        ds = FileDataset(d)
+        assert ds.num_examples == 10
+        np.testing.assert_array_equal(
+            ds.gather(np.arange(10))["a"], np.arange(10)
+        )
+
+    def test_bad_dir_rejected(self, tmp_path):
+        p = tmp_path / "not_ds"
+        p.mkdir()
+        (p / "index.json").write_text(json.dumps({"format": "nope"}))
+        with pytest.raises(ValueError, match="not a shard"):
+            FileDataset(str(p))
+
+    def test_writer_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="leading"):
+            write_shards(
+                {"a": np.arange(4), "b": np.arange(5)}, str(tmp_path / "v")
+            )
+        with pytest.raises(ValueError, match="non-empty dict"):
+            write_shards({}, str(tmp_path / "v2"))
+
+
+class TestIteration:
+    def test_epoch_is_a_permutation(self, store):
+        d, _, y = store
+        ds = FileDataset(d)
+        seen = np.concatenate(
+            [b["y"] for b in ds.batches(10, seed=3)]
+        )
+        assert sorted(seen.tolist()) == list(range(100))
+        assert not np.array_equal(seen, np.arange(100))  # actually shuffled
+
+    def test_striped_sharding_disjoint_exhaustive(self, store):
+        d, _, _ = store
+        ds = FileDataset(d)
+        parts = [
+            {int(v) for b in ds.batches(5, shard=(i, 4), shuffle=False)
+             for v in b["y"]}
+            for i in range(4)
+        ]
+        assert set().union(*parts) == set(range(100))
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert not parts[i] & parts[j]
+
+    def test_repeat_crosses_epochs_with_fresh_permutations(self, store):
+        d, _, _ = store
+        ds = FileDataset(d)
+        it = ds.batches(100, repeat=True, seed=1)
+        first, second = next(it)["y"], next(it)["y"]
+        assert sorted(first.tolist()) == sorted(second.tolist())
+        assert not np.array_equal(first, second)
+
+
+class TestTrainerIntegration:
+    def test_fit_from_disk(self, tmp_path):
+        import flax.linen as nn
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(256, 8).astype(np.float32)
+        w = rng.rand(8)
+        y = (x @ w > w.sum() / 2).astype(np.int32)
+        d = write_shards({"x": x, "y": y}, str(tmp_path / "ds"), shard_size=64)
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, a, train: bool = False):
+                return nn.Dense(2)(a)
+
+        trainer = hvt.Trainer(
+            Tiny(), hvt.DistributedOptimizer(optax.adam(1e-2)),
+            loss="sparse_categorical_crossentropy",
+        )
+        ds = FileDataset(d)
+        hist = trainer.fit(
+            dataset=ds.pairs("x", "y", batch_size=32, repeat=True),
+            steps_per_epoch=8, epochs=4, verbose=0,
+        )
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_rewrite_refused(tmp_path, store=None):
+    d = write_shards({"a": np.arange(8)}, str(tmp_path / "once"), shard_size=4)
+    with pytest.raises(ValueError, match="already holds"):
+        write_shards({"a": np.arange(8)}, d, shard_size=4)
+
+
+def test_starved_stripe_refused(tmp_path):
+    d = write_shards({"a": np.arange(10)}, str(tmp_path / "tiny"), shard_size=4)
+    ds = FileDataset(d)
+    with pytest.raises(ValueError, match="stripe"):
+        next(ds.batches(8, shard=(0, 4), repeat=True))
+    # drop_remainder=False yields the short batch instead.
+    b = next(ds.batches(8, shard=(0, 4), drop_remainder=False))
+    assert len(b["a"]) == 3

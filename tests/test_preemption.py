@@ -329,3 +329,72 @@ def test_exit_code_does_not_skip_later_train_end(tmp_path):
         trainer.fit(x=x, y=y, epochs=4, batch_size=32,
                     callbacks=[_SignalSelfAt(epoch=0), cb, After()], verbose=0)
     assert ran == [True]
+
+
+@pytest.mark.slow
+def test_ema_restore_broadcasts_to_fileless_ranks(tmp_path):
+    """Durable-EMA restore on a pod where checkpoint_dir is host-local:
+    only rank 0 has ema.msgpack; rank 1 must adopt rank 0's shadow via the
+    broadcast, not silently fresh-init a divergent one."""
+    # Parent prepares rank 0's file: a recognizable shadow (all 0.5).
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import checkpoint
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(4)(x)
+
+    params = Tiny().init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.float32)
+    )["params"]
+    shadow = jax.tree.map(lambda a: jnp.full_like(a, 0.5), params)
+    d0 = tmp_path / "rank0"
+    d0.mkdir()
+    (tmp_path / "rank1").mkdir()
+    checkpoint.save(str(d0 / "ema.msgpack"), {"shadow": shadow, "count": 42})
+
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import optax
+        import jax
+        import flax.linen as nn
+        import horovod_tpu as hvt
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+
+        hvt.init()
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return nn.Dense(4)(x)
+
+        trainer = hvt.Trainer(
+            Tiny(), hvt.DistributedOptimizer(optax.adam(1e-2)),
+            loss='sparse_categorical_crossentropy',
+        )
+        trainer.build(np.zeros((2, 8), np.float32))
+        d = {str(tmp_path)!r} + '/rank%d' % hvt.process_rank()
+        ema = ExponentialMovingAverage(decay=0.9, checkpoint_dir=d)
+        ema.set_trainer(trainer)
+        ema.on_train_begin()
+        flat = np.concatenate(
+            [np.asarray(l).ravel() for l in jax.tree.leaves(ema.ema_params)]
+        )
+        assert ema._count == 42, ema._count
+        assert np.allclose(flat, 0.5), flat[:4]
+        with open({str(tmp_path)!r} + '/ema-ok-%d' % hvt.process_rank(), 'w') as f:
+            f.write('ok')
+    """))
+    code = launcher.run_local(
+        2, [sys.executable, str(script)],
+        env={"HVT_PLATFORM": "cpu", "HVT_NUM_CPU_DEVICES": "1"},
+        tag_output=False,
+    )
+    assert code == 0
+    assert (tmp_path / "ema-ok-0").exists() and (tmp_path / "ema-ok-1").exists()
