@@ -64,7 +64,10 @@ def write_shards(columns: dict, directory: str, shard_size: int = 8192) -> str:
         "shard_size": shard_size,
         "n_shards": n_shards,
         "columns": {
-            k: {"dtype": a.dtype.name, "shape": list(a.shape[1:])}
+            # dtype.str, not dtype.name: .name does not round-trip for
+            # string/bytes columns ('<U2' -> 'str160', which np.dtype
+            # rejects on read).
+            k: {"dtype": a.dtype.str, "shape": list(a.shape[1:])}
             for k, a in arrays.items()
         },
     }

@@ -393,15 +393,32 @@ class ExponentialMovingAverage(Callback):
                 found = collectives.broadcast_object(found)
             if found:
                 count = 0
+                err = None
                 if runtime.is_primary():
-                    payload = checkpoint.restore(
-                        self._ckpt_path(), {"shadow": params, "count": 0}
-                    )
-                    shadow = jax.tree.map(np.asarray, payload["shadow"])
-                    count = int(payload["count"])
+                    try:
+                        payload = checkpoint.restore(
+                            self._ckpt_path(), {"shadow": params, "count": 0}
+                        )
+                        shadow = jax.tree.map(np.asarray, payload["shadow"])
+                        count = int(payload["count"])
+                    except Exception as e:  # stale/incompatible file
+                        err = f"{type(e).__name__}: {e}"
+                        shadow = None
                 else:
                     shadow = jax.tree.map(
                         lambda l: np.zeros(l.shape, l.dtype), params
+                    )
+                if jax.process_count() > 1:
+                    # The primary's restore outcome travels BEFORE the
+                    # pytree broadcast, so a failed restore raises on EVERY
+                    # rank together instead of stranding the others in the
+                    # collective (restore_latest_and_broadcast's torn-flag
+                    # discipline).
+                    err = collectives.broadcast_object(err)
+                if err is not None:
+                    raise RuntimeError(
+                        f"EMA shadow restore failed ({self._ckpt_path()}): "
+                        f"{err} — delete the file to restart the average"
                     )
                 if jax.process_count() > 1:
                     # ORDER MATTERS: broadcast on the HOST first so every

@@ -16,6 +16,18 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache, shared by every test AND every
+# subprocess test (they inherit the env): the suite is compile-dominated,
+# and a warm cache measured 1.8x on the heaviest file. Keyed by HLO +
+# compile options, so stale-cache wrongness is not a failure mode; safe to
+# delete any time. Override by exporting JAX_COMPILATION_CACHE_DIR ("" to
+# disable).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -320,3 +320,16 @@ class TestExponentialMovingAverage:
             lambda a, b: np.testing.assert_array_equal(a, b),
             jax.device_get(ema2.ema_params), saved,
         )
+
+    def test_ema_restore_incompatible_file_raises(self, tmp_path):
+        """A stale/incompatible ema.msgpack raises a clear error instead of
+        restoring garbage (and on a pod, instead of stranding non-primary
+        ranks in the broadcast)."""
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+
+        (tmp_path / "ema.msgpack").write_bytes(b"not msgpack at all")
+        ema = ExponentialMovingAverage(decay=0.9, checkpoint_dir=str(tmp_path))
+        trainer = self._fit([], steps=1)
+        ema.set_trainer(trainer)
+        with pytest.raises(RuntimeError, match="EMA shadow restore failed"):
+            ema.on_train_begin()

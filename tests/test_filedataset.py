@@ -125,7 +125,7 @@ class TestTrainerIntegration:
         assert hist[-1]["loss"] < hist[0]["loss"]
 
 
-def test_rewrite_refused(tmp_path, store=None):
+def test_rewrite_refused(tmp_path):
     d = write_shards({"a": np.arange(8)}, str(tmp_path / "once"), shard_size=4)
     with pytest.raises(ValueError, match="already holds"):
         write_shards({"a": np.arange(8)}, d, shard_size=4)
@@ -139,3 +139,14 @@ def test_starved_stripe_refused(tmp_path):
     # drop_remainder=False yields the short batch instead.
     b = next(ds.batches(8, shard=(0, 4), drop_remainder=False))
     assert len(b["a"]) == 3
+
+
+def test_string_columns_roundtrip(tmp_path):
+    """dtype round-trip for non-numeric columns (dtype.str, not .name)."""
+    labels = np.array(["cat", "doggo", "x"])
+    d = write_shards(
+        {"label": labels, "v": np.arange(3)}, str(tmp_path / "s"), shard_size=2
+    )
+    ds = FileDataset(d)
+    got = ds.gather(np.array([2, 0, 1]))
+    np.testing.assert_array_equal(got["label"], labels[[2, 0, 1]])
