@@ -20,13 +20,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 # subprocess test (they inherit the env): the suite is compile-dominated,
 # and a warm cache measured 1.8x on the heaviest file. Keyed by HLO +
 # compile options, so stale-cache wrongness is not a failure mode; safe to
-# delete any time. Override by exporting JAX_COMPILATION_CACHE_DIR ("" to
-# disable).
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+# delete any time. Override by exporting JAX_COMPILATION_CACHE_DIR to
+# another path; export it EMPTY to disable entirely (mapped to
+# JAX_ENABLE_COMPILATION_CACHE=0 below — jax itself would treat '' as a
+# cwd-relative cache dir, not as off).
+if os.environ.get("JAX_COMPILATION_CACHE_DIR") == "":
+    del os.environ["JAX_COMPILATION_CACHE_DIR"]
+    os.environ["JAX_ENABLE_COMPILATION_CACHE"] = "0"
+elif os.environ.get("JAX_ENABLE_COMPILATION_CACHE") != "0":
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 import jax  # noqa: E402
 

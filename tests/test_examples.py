@@ -99,6 +99,7 @@ def test_lm_packed_pretraining(tmp_path):
     assert "LEARNING" in res.stdout, res.stdout[-800:]
 
 
+@pytest.mark.slow
 def test_lm_packed_pretraining_text_frontend(tmp_path):
     """TEXT=1: raw strings -> trained byte-BPE -> packed pretraining.
     The tokenizer trains, compresses, saves, and the model still learns."""
@@ -118,6 +119,26 @@ def test_lm_packed_pretraining_text_frontend(tmp_path):
     assert "bytes/token" in res.stdout
     assert "LEARNING" in res.stdout, res.stdout[-800:]
     assert (tmp_path / "tokenizer.json").exists()
+
+
+@pytest.mark.slow
+def test_seq2seq_translation(tmp_path):
+    """Text -> BPE -> encoder-decoder -> generation on a data x model mesh:
+    the reversal must be LEARNED on held-out pairs."""
+    res = _run(
+        "seq2seq_translation.py",
+        {
+            "HVT_MESH": "data=4,model=2",
+            "PS_MODEL_PATH": str(tmp_path),
+            "DOCS": "4096",
+            "DRIVE_EPOCHS": "8",
+            "DMODEL": "96",
+        },
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "byte-BPE vocab" in res.stdout
+    assert "REVERSAL LEARNED" in res.stdout, res.stdout[-800:]
+    assert (tmp_path / "seq2seq-reversal" / "tokenizer.json").exists()
 
 
 @pytest.mark.slow
