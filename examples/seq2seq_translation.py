@@ -96,12 +96,25 @@ def main() -> None:
 
     n_docs = int(os.environ.get("DOCS", 8192))
     pairs = corpus(n_docs)
+    # Merge budget sized so every word collapses to ~1 token (≈6 bytes/word
+    # × 14 words needs ~100 merges); with too few merges 4-5-word sentences
+    # overflow max_len and silent truncation drops the EOS and the source
+    # tail — the words the reversed target must BEGIN with.
     tok = ByteBPETokenizer.train(
-        (s for p in pairs for s in p), vocab_size=256 + len(WORDS) + 8,
+        (s for p in pairs for s in p), vocab_size=256 + 128 + 1,
         specials=("<eos>",),
     )
     tok.save(os.path.join(model_dir, "tokenizer.json"))
     max_len = 16
+    # Refuse (don't truncate) pairs that can't fit: a clipped pair is
+    # unanswerable by construction and silently poisons the accuracy gate.
+    fit_pairs = [
+        p for p in pairs
+        if max(len(tok.encode(p[0])), len(tok.encode(p[1]))) < max_len
+    ]
+    if hvt.is_primary() and len(fit_pairs) < len(pairs):
+        print(f"dropped {len(pairs) - len(fit_pairs)} overlong pairs")
+    pairs = fit_pairs
     src, tgt_in, labels = encode_pairs(tok, pairs, max_len)
     if hvt.is_primary():
         print(
@@ -109,8 +122,13 @@ def main() -> None:
             f"max_len {max_len}"
         )
 
+    # Model vocab padded up to a multiple of 8: the column-parallel lm_head
+    # shards its vocab dim over the `model` axis, so it must divide evenly
+    # (tokenizer vocab sizes are data-dependent and can land odd). Unused
+    # ids never appear in labels and cost nothing.
+    model_vocab = -(-tok.vocab_size // 8) * 8
     model = Seq2SeqTransformer(
-        vocab_size=tok.vocab_size,
+        vocab_size=model_vocab,
         d_model=int(os.environ.get("DMODEL", 96)),
         n_heads=4,
         n_enc_layers=2,
@@ -151,7 +169,10 @@ def main() -> None:
     )
 
     # Held-out generation: greedy decode must produce the reversal.
-    test_pairs = corpus(32, seed=999)
+    test_pairs = [
+        p for p in corpus(48, seed=999)
+        if max(len(tok.encode(p[0])), len(tok.encode(p[1]))) < max_len
+    ][:32]
     tsrc, _, tlabels = encode_pairs(tok, test_pairs, max_len)
     gen = make_seq2seq_generate_fn(
         model.clone(sharding=ShardingConfig()),  # decode: no seq axis

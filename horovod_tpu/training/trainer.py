@@ -717,8 +717,16 @@ class Trainer:
                     x, jax.sharding.NamedSharding(self.mesh, spec)
                 )
 
+            def put_part(part, spec):
+                # One batch part against its spec: a single PartitionSpec
+                # broadcasts over a pytree part (dict-input models), a
+                # matching spec pytree maps pairwise.
+                if isinstance(spec, jax.sharding.PartitionSpec):
+                    return jax.tree.map(lambda a: put(a, spec), part)
+                return jax.tree.map(put, part, spec)
+
             if not isinstance(batch, (tuple, list)):
-                return put(batch, specs[0])  # predict: bare x
+                return put_part(batch, specs[0])  # predict: bare x
             if len(batch) == len(specs) + 1:
                 # evaluate() appends a per-example mask: batch-sharded only.
                 last = tuple(specs[-1])
@@ -726,7 +734,9 @@ class Trainer:
                     jax.sharding.PartitionSpec(*last[:1]) if last
                     else jax.sharding.PartitionSpec(),
                 )
-            return tuple(put(x, spec) for x, spec in zip(batch, specs))
+            return tuple(
+                put_part(x, spec) for x, spec in zip(batch, specs)
+            )
         return sharding_lib.shard_batch(batch, self.mesh)
 
     def _feed_groups(self) -> tuple[int, int]:
@@ -1175,19 +1185,10 @@ class Trainer:
         global_batch = batch_size * self.dp_size
         loss_sum = correct_sum = count = 0.0
         for start in range(0, n, global_batch):
-            sl = lambda a: np.asarray(a[start : start + global_batch])  # noqa: E731
-            xb = jax.tree.map(sl, x)
-            yb = sl(y)
-            bs = len(yb)
+            xb, bs = self._slice_pad(x, start, global_batch)
+            yb, _ = self._slice_pad(y, start, global_batch)
             mask = np.ones((global_batch,), np.float32)
-            if bs < global_batch:  # pad to the compiled shape, mask it out
-                pad = global_batch - bs
-                grow = lambda a: np.concatenate(  # noqa: E731
-                    [a, np.repeat(a[-1:], pad, 0)]
-                )
-                xb = jax.tree.map(grow, xb)
-                yb = grow(yb)
-                mask[bs:] = 0.0
+            mask[bs:] = 0.0
             batch = tuple(
                 jax.tree.map(
                     lambda a: self._local_slice(a, global_batch), part
@@ -1203,20 +1204,40 @@ class Trainer:
             print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
         return result
 
+    def _slice_pad(self, part, start: int, global_batch: int):
+        """(batch slice padded to the compiled shape, true row count) for
+        one batch part — leaf-wise, so pytree (dict-input) parts feed like
+        flat arrays. ONE implementation of the multi-process padding
+        contract, shared by evaluate and predict."""
+        sliced = jax.tree.map(
+            lambda a: np.asarray(a[start : start + global_batch]), part
+        )
+        bs = len(jax.tree_util.tree_leaves(sliced)[0])
+        if bs < global_batch:
+            pad = global_batch - bs
+            sliced = jax.tree.map(
+                lambda a: np.concatenate([a, np.repeat(a[-1:], pad, 0)]),
+                sliced,
+            )
+        return sliced, bs
+
     def predict(self, x, batch_size: int = 128) -> np.ndarray:
         """Class probabilities (softmax applied here, keeping the serving
-        contract input→prob, mnist_keras.py:133-134)."""
+        contract input→prob, mnist_keras.py:133-134). ``x`` may be a pytree
+        (dict-input models) — slice/pad/shard run leaf-wise, like
+        `evaluate`."""
         if self.state is None:
             raise RuntimeError("call fit() or build() first")
+        if isinstance(x, list):
+            x = np.asarray(x)  # list-of-rows = one array input (see fit)
         out = []
         global_batch = batch_size * self.dp_size
-        n = len(x)
+        n = len(jax.tree_util.tree_leaves(x)[0])
         for start in range(0, n, global_batch):
-            xb = np.asarray(x[start : start + global_batch])
-            bs = len(xb)
-            if bs < global_batch:
-                xb = np.concatenate([xb, np.repeat(xb[-1:], global_batch - bs, 0)])
-            xb = self._local_slice(xb, global_batch)
+            xb, bs = self._slice_pad(x, start, global_batch)
+            xb = jax.tree.map(
+                lambda a: self._local_slice(a, global_batch), xb
+            )
             probs = jax.device_get(self._predict_step(self.state, self._shard(xb)))
             out.append(probs[:bs])
         return np.concatenate(out, axis=0)

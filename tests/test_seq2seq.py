@@ -284,3 +284,20 @@ class TestSequenceParallel:
         model = _model(mesh=mesh).clone(decode=True, max_decode_len=8)
         with pytest.raises(ValueError, match="decode mode"):
             model.init(jax.random.PRNGKey(0), self._sp_pair(t=1))
+
+
+def test_predict_with_dict_inputs():
+    """Trainer.predict slices/pads/shards pytree inputs leaf-wise —
+    teacher-forced next-token probabilities for a dict-batch model,
+    including the padded tail batch."""
+    model = _model()
+    trainer = hvt.Trainer(
+        model, hvt.DistributedOptimizer(optax.adam(1e-3)),
+        loss="sparse_categorical_crossentropy",
+    )
+    rng = np.random.RandomState(9)
+    x, y = _copy_task(19, 12, 8, rng)  # 19: forces a ragged final batch
+    trainer.build(jax.tree.map(lambda a: a[:8], x))
+    probs = trainer.predict(x, batch_size=1)
+    assert probs.shape == (19, 8, VOCAB)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
