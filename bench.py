@@ -93,6 +93,10 @@ def _lm_from_env(*, moe: bool = False):
         logits_dtype=jnp.bfloat16
         if os.environ.get("BENCH_LOGITS", "") == "bf16"
         else jnp.float32,
+        # BENCH_FUSED_CE=<n_chunks>: fused chunked linear-CE head
+        # (ops/fused_ce.py) — the [B, T, vocab] logits + cotangent are never
+        # materialized; the train rows switch to Trainer(loss='module').
+        fused_head_chunks=int(os.environ.get("BENCH_FUSED_CE", 0)),
     )
 
 
@@ -248,13 +252,15 @@ def bench_train(which: str) -> dict:
                 docs: int
 
                 @nn.compact
-                def __call__(self, tokens, *, train: bool = False):
+                def __call__(self, tokens, *, train: bool = False, labels=None):
                     b, t = tokens.shape
                     ids = jnp.repeat(
                         jnp.arange(self.docs, dtype=jnp.int32), t // self.docs
                     )
                     ids = jnp.broadcast_to(ids, (b, t))
-                    return self.inner(tokens, train=train, segment_ids=ids)
+                    return self.inner(
+                        tokens, train=train, segment_ids=ids, labels=labels
+                    )
 
             module = _PackedLM(inner=module, docs=n_docs)
             metric += "_packed"
@@ -262,7 +268,13 @@ def bench_train(which: str) -> dict:
         # a trained label.
         unit_per_step = per_chip_batch * n_chips * seq_len
         lr = optax.adamw(hvt.scale_lr(3e-4))
-        loss = "sparse_categorical_crossentropy"
+        # Fused chunked-CE head: the module computes the loss (see
+        # _lm_from_env's fused_head_chunks knob).
+        loss = (
+            "module"
+            if int(os.environ.get("BENCH_FUSED_CE", 0))
+            else "sparse_categorical_crossentropy"
+        )
         unit = "tokens/sec/chip"
         default_steps = 48
     else:
@@ -379,6 +391,14 @@ def bench_train(which: str) -> dict:
                     head_dim, window=window,
                 ) * n_layers
             flops += fa
+        lm = module.inner if n_docs else module
+        if lm.fused_head_chunks > 1:
+            # The fused head's chunk scan is likewise undercounted by the
+            # cost model (body counted once, executed n_chunks times).
+            flops += trace.fused_ce_flops(
+                per_chip_batch * n_chips * seq_len,
+                lm.d_model, lm.vocab_size, lm.fused_head_chunks,
+            )
     elif flops and which == "seq2seq":
         # Three flash calls per step: encoder self (non-causal, segmented),
         # decoder self (causal), cross (non-causal Tk≠Tq grids, segmented) —

@@ -19,8 +19,9 @@ Mesh shape via HVT_MESH, e.g.:
 
 Knobs: DRIVE_STEPS, DRIVE_EPOCHS, SEQ_LEN, VOCAB, DMODEL, NLAYERS, ATTN
 (ring|ulysses), REMAT=1 (block rematerialization), LOGITS=bf16 (16-bit
-logits; the loss upcasts to f32 on the fly), MOE_EVERY (0=dense; k = MoE
-MLP every k-th block), N_EXPERTS. MoE composes with the mesh's ``expert``
+logits; the loss upcasts to f32 on the fly), FUSED_CE=<n_chunks> (fused
+chunked-CE head: full logits never materialized — the stronger long-context
+memory knob), MOE_EVERY (0=dense; k = MoE MLP every k-th block), N_EXPERTS. MoE composes with the mesh's ``expert``
 axis, e.g.:
 
     HVT_MESH="data=2,expert=4" MOE_EVERY=2 python examples/lm_long_context.py
@@ -117,6 +118,10 @@ def main() -> None:
             logits_dtype=jnp.bfloat16
             if os.environ.get("LOGITS", "") == "bf16"
             else jnp.float32,
+            # FUSED_CE=<n_chunks>: the fused chunked-CE head — f32-accurate
+            # loss with the [B, T, vocab] logits never materialized
+            # (ops/fused_ce.py); supersedes LOGITS=bf16 for long context.
+            fused_head_chunks=int(os.environ.get("FUSED_CE", 0)),
         )
         batch_spec = P(
             (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS), mesh_lib.SEQ_AXIS
@@ -124,7 +129,9 @@ def main() -> None:
         trainer = hvt.Trainer(
             model,
             hvt.DistributedOptimizer(optax.adam(3e-3)),
-            loss="sparse_categorical_crossentropy",
+            loss="module"
+            if int(os.environ.get("FUSED_CE", 0))
+            else "sparse_categorical_crossentropy",
             mesh=mesh,
             param_specs=param_specs,
             batch_specs=(batch_spec, batch_spec),

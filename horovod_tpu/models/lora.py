@@ -1,0 +1,190 @@
+"""LoRA — low-rank adapter fine-tuning (Hu et al., arXiv:2106.09685).
+
+Fine-tuning a pretrained model updates weights by a low-rank delta most of
+the time; LoRA makes that structural: every target kernel ``W [m, n]``
+gains adapters ``A [m, r]`` (gaussian) and ``B [r, n]`` (zeros) and the
+model runs with ``W + (alpha/r)·A@B``. Only A/B train — optimizer state
+shrinks from O(params) to O(r·(m+n)) per kernel, and a fine-tune "run"
+is a few-MB adapter file against a frozen base checkpoint.
+
+TPU-native design: no module surgery. `LoRAModel` wraps any flax module;
+its param tree is ``{'base': <inner params>, 'lora': <adapters>}`` and the
+merge ``W + scale·A@B`` happens **inside the jitted step**, where XLA fuses
+it into the consumer matmul's prologue — the base stays untouched in HBM,
+and the backward computes adapter gradients from the same dW the full
+backward already produces (no extra backward matmuls beyond the rank-r
+contractions). Freezing is an optax partition (`freeze_base`): base updates
+are `set_to_zero`, so `DistributedOptimizer`/`Trainer`/checkpointing all
+see one ordinary param tree — every subsystem (broadcast, EMA, sharded
+checkpoints, ZeRO-1) composes untouched.
+
+Capability context: the reference has no fine-tuning story (its scripts
+train from scratch, `/root/reference/tensorflow2_keras_mnist.py:96`); this
+is a beyond-parity capability every framework at this scale is expected to
+ship.
+
+Usage:
+    model = LoRAModel(inner=TransformerLM(...), rank=8, alpha=16.0)
+    trainer = hvt.Trainer(
+        model,
+        hvt.DistributedOptimizer(lora.freeze_base(optax.adamw(1e-4))),
+        loss="sparse_categorical_crossentropy",
+    )
+    state = trainer.build(x)
+    state = state.replace(params={**state.params, "base": pretrained})
+    ... fit ...
+    merged = lora.merge_params(state.params)   # plain inner params:
+    # serve/decode/export with the ORIGINAL module, adapters folded in.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+# Default target selection: 2-D+ kernels named like projection/matmul
+# weights. Embeddings and norms stay frozen-only (the LoRA paper's recipe).
+DEFAULT_TARGETS = (
+    "qkv", "q_proj", "kv_proj", "attn_out", "mlp_up", "mlp_down", "lm_head",
+)
+
+
+def _match_fn(targets) -> Callable[[tuple, Any], bool]:
+    if callable(targets):
+        return targets
+
+    def match(path, leaf) -> bool:
+        names = {p.key for p in path if isinstance(p, jax.tree_util.DictKey)}
+        return leaf.ndim >= 2 and bool(names & set(targets))
+
+    return match
+
+
+def init_adapters(rng, params, rank: int, targets=DEFAULT_TARGETS):
+    """Adapter tree mirroring ``params``: matched kernels ``[m, ..., n]``
+    (flattened to ``[m, prod(rest)]`` for the delta) get
+    ``{'a': [m, r] ~ N(0, 1/r), 'b': [r, prod(rest)] = 0}``; everything
+    else maps to an empty tuple (no adapter, nothing to train)."""
+    match = _match_fn(targets)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    keys = jax.random.split(rng, max(1, len(flat)))
+
+    def one(key, path, leaf):
+        if not match(path, leaf):
+            return ()
+        m, n = leaf.shape[0], math.prod(leaf.shape[1:])
+        a = jax.random.normal(key, (m, rank), jnp.float32) / jnp.sqrt(rank)
+        return {"a": a, "b": jnp.zeros((rank, n), jnp.float32)}
+
+    leaves = [one(k, p, l) for k, (p, l) in zip(keys, flat)]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), leaves
+    )
+
+
+def _is_adapter_node(x) -> bool:
+    """Stops tree traversal at adapter positions: ``()`` (no adapter) or
+    an ``{'a', 'b'}`` pair."""
+    if isinstance(x, tuple) and x == ():
+        return True
+    return isinstance(x, dict) and set(x) == {"a", "b"}
+
+
+def merge_delta(base, adapters, scale: float):
+    """``W + scale · A@B`` per adapted leaf (delta computed in f32, cast to
+    the leaf dtype); non-adapted leaves pass through."""
+
+    def one(ab, w):
+        if not isinstance(ab, dict):
+            return w
+        delta = (ab["a"] @ ab["b"]).reshape(w.shape) * scale
+        return (w.astype(jnp.float32) + delta).astype(w.dtype)
+
+    return jax.tree.map(one, adapters, base, is_leaf=_is_adapter_node)
+
+
+def merge_params(params, *, rank: int | None = None, alpha: float = 16.0,
+                 scale: float | None = None):
+    """Fold a LoRAModel param tree ``{'base', 'lora'}`` into plain inner
+    params (for decode/export/serving with the original module). ``scale``
+    defaults to ``alpha / rank``; rank is read off the adapters when not
+    given."""
+    base, adapters = params["base"], params["lora"]
+    if scale is None:
+        if rank is None:
+            rank = next(
+                ab["a"].shape[1]
+                for ab in jax.tree.leaves(adapters, is_leaf=_is_adapter_node)
+                if isinstance(ab, dict)
+            )
+        scale = alpha / rank
+    return merge_delta(base, adapters, scale)
+
+
+def freeze_base(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """``tx`` on the ``lora`` subtree, ``set_to_zero`` on ``base`` — the
+    optimizer carries state only for the adapters. Wrap the RESULT in
+    `DistributedOptimizer` (gradient averaging is orthogonal)."""
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()},
+        param_labels=lambda params: {
+            k: jax.tree.map(lambda _: "train" if k == "lora" else "freeze", v)
+            for k, v in params.items()
+        },
+    )
+
+
+class LoRAModel(nn.Module):
+    """Any flax module with low-rank adapters on its matmul kernels.
+
+    Param tree: ``{'base': inner params (frozen), 'lora': adapters}``.
+    Forward merges ``W + (alpha/rank)·A@B`` in-step and delegates to the
+    inner module — `train`/`labels`/`segment_ids` kwargs, dropout rngs, and
+    sown 'losses'/'metrics' collections all pass through."""
+
+    inner: nn.Module
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Any = DEFAULT_TARGETS
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        base = self.param(
+            "base",
+            lambda rng: self.inner.init(
+                {"params": rng, "dropout": rng}, *args, **kwargs
+            )["params"],
+        )
+        adapters = self.param(
+            "lora",
+            lambda rng: init_adapters(rng, base, self.rank, self.targets),
+        )
+        merged = merge_delta(base, adapters, self.alpha / self.rank)
+        rngs = {}
+        if self.has_rng("dropout"):
+            rngs["dropout"] = self.make_rng("dropout")
+        out, updated = self.inner.apply(
+            {"params": merged}, *args, **kwargs, rngs=rngs,
+            mutable=["losses", "metrics"],
+        )
+        # Re-sow the inner module's auxiliary channels so the Trainer's
+        # objective/observability contracts survive the wrap. The sow NAME
+        # must be the inner path's final dict key (e.g. 'moe_drop_rate'):
+        # the Trainer's metric aggregator groups on it, and same-named sows
+        # from different layers append — exactly the inner behavior.
+        for col in ("losses", "metrics"):
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                updated.get(col, {})
+            )[0]:
+                names = [
+                    p.key for p in path
+                    if isinstance(p, jax.tree_util.DictKey)
+                ]
+                if names:
+                    self.sow(col, names[-1], leaf)
+        return out

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from horovod_tpu.ops import attention as attention_ops
+from horovod_tpu.ops import attention as attention_ops, fused_ce
 from horovod_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
@@ -538,8 +538,46 @@ class Block(nn.Module):
         return out.reshape(b, t, h, d).astype(q.dtype)
 
 
+class LMHead(nn.Module):
+    """The LM head as an explicit ``[d_model, vocab]`` kernel (param path
+    ``lm_head/kernel``, identical to the former DenseGeneral's) so the fused
+    chunked-CE path (ops/fused_ce.py) can reach the kernel without
+    materializing full logits."""
+
+    d_model: int
+    vocab_size: int
+    compute_dtype: jnp.dtype = jnp.float32
+    logits_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.d_model, self.vocab_size),
+        )
+
+    def __call__(self, x):
+        logits = jnp.dot(
+            x.astype(self.compute_dtype), self.kernel.astype(self.compute_dtype)
+        )
+        return logits.astype(self.logits_dtype)
+
+    def fused_loss(self, x, labels, n_chunks: int):
+        """(per-token loss, per-token correct) without full logits."""
+        return fused_ce.fused_linear_cross_entropy(
+            x.astype(self.compute_dtype), self.kernel, labels,
+            max(1, n_chunks),
+        )
+
+
 class TransformerLM(nn.Module):
-    """Causal LM over integer tokens: ``[B, T] -> [B, T, vocab]`` logits."""
+    """Causal LM over integer tokens: ``[B, T] -> [B, T, vocab]`` logits.
+
+    With ``labels=...`` passed to ``__call__`` the model instead returns
+    ``(per_token_loss, per_token_correct)`` computed by the fused chunked-CE
+    head (``fused_head_chunks`` row-chunks; see ops/fused_ce.py) — the
+    ``Trainer(loss='module')`` contract. Without labels the full-logits path
+    is unchanged (predict/decode/export)."""
 
     vocab_size: int = 256
     d_model: int = 256
@@ -584,9 +622,16 @@ class TransformerLM(nn.Module):
     sliding_cache: bool = False
     # StreamingLLM attention sinks (decode-time; see Block.attention_sinks).
     attention_sinks: int = 0
+    # Row-chunk count for the fused linear-CE head when ``labels`` are fed
+    # through ``__call__`` (loss='module'): peak head memory is
+    # ceil(B·T/chunks)·vocab floats instead of the full [B, T, vocab] logits
+    # + cotangent. 0 → a single chunk (dense-equivalent memory, same math).
+    fused_head_chunks: int = 0
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False, segment_ids=None):
+    def __call__(
+        self, tokens, *, train: bool = False, segment_ids=None, labels=None
+    ):
         cfg = self.sharding
         b, t = tokens.shape
         decode_index = None
@@ -641,11 +686,15 @@ class TransformerLM(nn.Module):
                 name=f"Block_{i}",
             )(x, positions, train, segment_ids, decode_index)
         x = nn.LayerNorm(dtype=self.compute_dtype, use_bias=False)(x)
-        logits = nn.DenseGeneral(
-            features=self.vocab_size, dtype=self.compute_dtype, use_bias=False,
+        head = LMHead(
+            self.d_model, self.vocab_size,
+            compute_dtype=self.compute_dtype,
+            logits_dtype=self.logits_dtype,
             name="lm_head",
-        )(x)
-        return logits.astype(self.logits_dtype)
+        )
+        if labels is not None:
+            return head.fused_loss(x, labels, self.fused_head_chunks)
+        return head(x)
 
 
 def param_specs(params, mesh: Mesh, extra_tp_dim: dict | None = None) -> dict:

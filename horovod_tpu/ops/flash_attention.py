@@ -851,7 +851,16 @@ def pick_blocks(t: int, d: int, dtype, bq: int = DEFAULT_BLOCK_Q,
     instead of regressing to the dense fallback just because
     1536 % 1024 != 0."""
     t_k = t if t_k is None else t_k
-    if d > 128:
+    if d > 128 or max(t, t_k) >= 32768:
+        # Wide heads: a 1024² f32 score tile + wide q/k/v blocks would
+        # crowd VMEM. Very long grids overflow v5e's 16 MB scoped-VMEM
+        # budget *in context*: the bare kernel compiles at 1024² up to
+        # T=32k, but inside a remat'd training step XLA co-schedules
+        # neighboring fusions into the same scoped budget and the
+        # allocation grows slowly with T (measured: 16.26M at T=32k,
+        # 16.76M at T=131k vs the 16.00M limit — both fail, while T=8k
+        # fits). 512² tiles leave ~3/4 of the score-tile footprint as
+        # headroom and measured within a few % of 1024² in the block sweep.
         bq, bk = min(bq, 512), min(bk, 512)
     if segmented or windowed:
         # Extra in-kernel operands push 1024² past v5e's 16 MB VMEM stack:

@@ -105,6 +105,18 @@ def flash_attention_flops(batch: int, seq_q: int, seq_k: int, heads: int,
     return dots * per_dot * (0.5 if causal else 1.0)
 
 
+def fused_ce_flops(n_tokens: int, d_model: int, vocab: int,
+                   n_chunks: int) -> float:
+    """Matmul FLOPs the fused chunked-CE head (ops/fused_ce.py) executes
+    beyond what XLA's cost model counts. The head's forward and backward
+    each run inside a ``lax.scan`` whose body the cost model counts ONCE
+    but which executes ``n_chunks`` times. Executed per step over all
+    N = B·T tokens: forward logits 2·N·D·V, backward recompute 2·N·D·V +
+    dh 2·N·D·V + dW 2·N·D·V = 8·N·D·V total; counted = that / n_chunks —
+    so the uncounted remainder is 8·N·D·V·(1 − 1/n_chunks)."""
+    return 8.0 * n_tokens * d_model * vocab * (1.0 - 1.0 / max(1, n_chunks))
+
+
 def mfu(flops_per_step: float | None, step_time_s: float, n_chips: int = 1,
         device=None) -> float | None:
     """Model FLOPs utilization: achieved FLOP/s ÷ fleet peak FLOP/s."""

@@ -62,6 +62,12 @@ def _resolve_loss(loss) -> Callable:
     (mnist_keras.py:89)."""
     if callable(loss):
         return loss
+    # 'module': the module computes its own loss — apply(x, labels=y)
+    # returns (per_token_loss, per_token_correct). The contract of the fused
+    # chunked-CE head (TransformerLM(fused_head_chunks=...), ops/fused_ce.py),
+    # where materializing logits for a Trainer-side loss would defeat the op.
+    if loss == "module":
+        return None
     # Upcast at the loss boundary: models may emit 16-bit logits to halve
     # long-sequence HBM (TransformerLM logits_dtype) — the f32 cast fuses
     # into the logsumexp chain, so statistics are f32-accurate without a
@@ -182,6 +188,7 @@ class Trainer:
         self.module = module
         self.tx = optimizer
         self.loss_fn = _resolve_loss(loss)
+        self._module_loss = loss == "module"
         self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
         self.seed = seed
         # param_specs: callable (params, mesh) -> PartitionSpec pytree, or a
@@ -254,6 +261,31 @@ class Trainer:
                 "replicated optimizer state) — pick one"
             )
 
+        def forward_loss(variables, x, y, rng):
+            """Shared train-mode forward: (core_loss+aux, acc, updated, sown
+            metrics) under either loss contract — Trainer-side loss_fn on
+            logits, or loss='module' (apply(x, labels=y) → per-token
+            (loss, correct), the fused-CE head's path)."""
+            kwargs = {"labels": y} if self._module_loss else {}
+            out, updated = self.module.apply(
+                variables, x, train=True, **kwargs,
+                rngs={"dropout": rng},
+                mutable=self._mutable + ["losses", "metrics"],
+            )
+            sown = updated.pop("losses", {})
+            sm = _aggregate_sown_metrics(updated.pop("metrics", {}))
+            aux = sum(
+                (jnp.sum(v) for v in jax.tree.leaves(sown)),
+                jnp.zeros((), jnp.float32),
+            )
+            if self._module_loss:
+                loss_vec, correct = out
+                loss, acc = loss_vec.mean() + aux, correct.mean()
+            else:
+                loss = self.loss_fn(out, y).mean() + aux
+                acc = _accuracy(out, y)
+            return loss, acc, (dict(updated) if updated else None), sm
+
         def compressed_grads(state: TrainState, x, y, step_rng):
             """(loss, acc, model_state, grads) with the cross-worker gradient
             reduction made explicit: a psum over the data axes on the 16-bit
@@ -286,21 +318,10 @@ class Trainer:
                 )
 
                 def loss_of(params):
-                    variables = {"params": params, **(ms or {})}
-                    logits, updated = self.module.apply(
-                        variables, x, train=True,
-                        rngs={"dropout": shard_rng},
-                        mutable=self._mutable + ["losses", "metrics"],
+                    loss, acc, upd, sm = forward_loss(
+                        {"params": params, **(ms or {})}, x, y, shard_rng
                     )
-                    sown = updated.pop("losses", {})
-                    sm = _aggregate_sown_metrics(updated.pop("metrics", {}))
-                    aux = sum(
-                        (jnp.sum(v) for v in jax.tree.leaves(sown)),
-                        jnp.zeros((), jnp.float32),
-                    )
-                    new_ms = dict(updated) if updated else ms
-                    loss = self.loss_fn(logits, y).mean() + aux
-                    return loss, (_accuracy(logits, y), new_ms, sm)
+                    return loss, (acc, upd if upd is not None else ms, sm)
 
                 (loss, (acc, new_ms, sm)), grads = jax.value_and_grad(
                     loss_of, has_aux=True
@@ -341,7 +362,6 @@ class Trainer:
             step_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_of(params):
-                variables = {"params": params, **(state.model_state or {})}
                 # 'losses' is the auxiliary-objective channel: any value a
                 # module sows there during training (e.g. MoE load-balance
                 # loss, models/moe.py) is added to the objective. Requested
@@ -353,20 +373,13 @@ class Trainer:
                 # OBSERVABILITY channel: scalar values land in the step
                 # metrics / epoch logs / sinks (e.g. MoE router drop-rate,
                 # models/moe.py) — see _aggregate_sown_metrics.
-                logits, updated = self.module.apply(
-                    variables, x, train=True,
-                    rngs={"dropout": step_rng},
-                    mutable=self._mutable + ["losses", "metrics"],
+                loss, acc, upd, sm = forward_loss(
+                    {"params": params, **(state.model_state or {})},
+                    x, y, step_rng,
                 )
-                sown = updated.pop("losses", {})
-                sm = _aggregate_sown_metrics(updated.pop("metrics", {}))
-                aux = sum(
-                    (jnp.sum(v) for v in jax.tree.leaves(sown)),
-                    jnp.zeros((), jnp.float32),
+                return loss, (
+                    acc, upd if upd is not None else state.model_state, sm
                 )
-                new_ms = dict(updated) if updated else state.model_state
-                loss = self.loss_fn(logits, y).mean() + aux
-                return loss, (_accuracy(logits, y), new_ms, sm)
 
             if self._comm_dtype is not None:
                 loss, acc, model_state, sown_metrics, grads = compressed_grads(
@@ -493,13 +506,20 @@ class Trainer:
             # (sequence models produce per-token losses [G, T]); `count`
             # then counts tokens, keeping the mean per-token.
             x, y, mask = batch
-            logits = self.module.apply(_eval_variables(state), x, train=False)
-            loss_vec = self.loss_fn(logits, y)
+            if self._module_loss:
+                loss_vec, correct = self.module.apply(
+                    _eval_variables(state), x, train=False, labels=y
+                )
+            else:
+                logits = self.module.apply(
+                    _eval_variables(state), x, train=False
+                )
+                loss_vec = self.loss_fn(logits, y)
+                pred = jnp.argmax(logits, axis=-1)
+                labels = jnp.argmax(y, axis=-1) if y.ndim == logits.ndim else y
+                correct = (pred == labels).astype(jnp.float32)
             w = mask.reshape(mask.shape + (1,) * (loss_vec.ndim - 1))
             w = jnp.broadcast_to(w, loss_vec.shape)
-            pred = jnp.argmax(logits, axis=-1)
-            labels = jnp.argmax(y, axis=-1) if y.ndim == logits.ndim else y
-            correct = (pred == labels).astype(jnp.float32)
             return {
                 "loss_sum": (loss_vec * w).sum(),
                 "correct_sum": (correct * w).sum(),
