@@ -145,7 +145,12 @@ class LoRAModel(nn.Module):
     Param tree: ``{'base': inner params (frozen), 'lora': adapters}``.
     Forward merges ``W + (alpha/rank)·A@B`` in-step and delegates to the
     inner module — `train`/`labels`/`segment_ids` kwargs, dropout rngs, and
-    sown 'losses'/'metrics' collections all pass through."""
+    sown 'losses'/'metrics' collections all pass through. Any OTHER mutable
+    inner collection (batch-stats-style state, decode caches) rides as one
+    wrapper variable holding the whole inner collection dict — collection
+    ``inner_state`` — seeded from ``inner.init`` and written back after
+    every apply, so the Trainer's ``model_state`` path works through the
+    wrap unchanged."""
 
     inner: nn.Module
     rank: int = 8
@@ -154,24 +159,58 @@ class LoRAModel(nn.Module):
 
     @nn.compact
     def __call__(self, *args, **kwargs):
-        base = self.param(
-            "base",
-            lambda rng: self.inner.init(
+        init_cache = {}
+
+        def _inner_init(rng):
+            init_cache["vars"] = self.inner.init(
                 {"params": rng, "dropout": rng}, *args, **kwargs
-            )["params"],
-        )
+            )
+            return init_cache["vars"]["params"]
+
+        base = self.param("base", _inner_init)
         adapters = self.param(
             "lora",
             lambda rng: init_adapters(rng, base, self.rank, self.targets),
         )
+        if self.is_initializing():
+            extra = {
+                k: v
+                for k, v in init_cache.get("vars", {}).items()
+                if k not in ("params", "losses", "metrics")
+            }
+            carry = (
+                self.variable("inner_state", "collections", lambda: extra)
+                if extra
+                else None
+            )
+        else:
+            carry = (
+                self.variable("inner_state", "collections", dict)
+                if self.has_variable("inner_state", "collections")
+                else None
+            )
+        seed = dict(carry.value) if carry is not None else {}
         merged = merge_delta(base, adapters, self.alpha / self.rank)
         rngs = {}
         if self.has_rng("dropout"):
             rngs["dropout"] = self.make_rng("dropout")
-        out, updated = self.inner.apply(
-            {"params": merged}, *args, **kwargs, rngs=rngs,
-            mutable=["losses", "metrics"],
+        # Inner state is writable only when the outer apply made
+        # 'inner_state' mutable: a read-only eval must be read-only for the
+        # inner module too (its is_mutable_collection update gates see the
+        # truth), and the outer-init forward must NOT advance the freshly
+        # seeded inner.init state (the carry keeps inner.init's values).
+        state_writable = (
+            not self.is_initializing()
+            and self.is_mutable_collection("inner_state")
         )
+        out, updated = self.inner.apply(
+            {"params": merged, **seed}, *args, **kwargs, rngs=rngs,
+            mutable=(list(seed) if state_writable else [])
+            + ["losses", "metrics"],
+        )
+        new_state = {k: updated[k] for k in updated if k in seed}
+        if carry is not None and new_state and state_writable:
+            carry.value = {**seed, **new_state}
         # Re-sow the inner module's auxiliary channels so the Trainer's
         # objective/observability contracts survive the wrap. The sow NAME
         # must be the inner path's final dict key (e.g. 'moe_drop_rate'):

@@ -744,7 +744,14 @@ def param_specs(params, mesh: Mesh, extra_tp_dim: dict | None = None) -> dict:
             p.key for p in path if isinstance(p, jax.tree_util.DictKey)
         ]
         spec: list = [None] * leaf.ndim
-        moe = next((n for n in names if n in moe_dims), None)
+        # LoRA adapter leaves (…/lora/…/{a,b}) live under the SAME layer
+        # names as the kernels they adapt, but their shapes carry the rank
+        # dimension — TP/EP-sharding them is degenerate for small ranks and
+        # a divisibility (or rank) failure otherwise. Adapters skip both
+        # rule tables; the fsdp rule below still applies, with its own
+        # divisibility check.
+        is_lora = "lora" in names
+        moe = next((n for n in names if n in moe_dims), None) if not is_lora else None
         if moe is not None:
             for dim, axis in moe_dims[moe].items():
                 if leaf.shape[dim] % mesh.shape[axis] != 0:
@@ -758,7 +765,7 @@ def param_specs(params, mesh: Mesh, extra_tp_dim: dict | None = None) -> dict:
                 spec[dim] = axis
         else:
             layer = next((n for n in names if n in tp_dim), None)
-            if layer is not None and leaf.ndim >= 2:
+            if layer is not None and leaf.ndim >= 2 and not is_lora:
                 spec[tp_dim[layer]] = MODEL_AXIS
         if fsdp and leaf.ndim >= 2:
             for dim in range(leaf.ndim):

@@ -588,9 +588,16 @@ class Trainer:
         """A zero accumulator matching the step metrics' structure."""
         return {n: jnp.zeros((), jnp.float32) for n in self.metric_names}
 
-    def build(self, sample_x: np.ndarray) -> TrainState:
+    def build(self, sample_x: np.ndarray, sample_y=None) -> TrainState:
         """Initialize parameters (lazy, from the first batch — like Keras
-        building on first fit)."""
+        building on first fit).
+
+        With ``loss='module'`` the init passes labels so the module traces
+        its fused-loss branch (see below): ``sample_y`` when given, else
+        labels synthesized as ``zeros_like(sample_x)`` — valid for the LM
+        family, where labels share the token batch's shape/dtype. Models
+        whose labels differ from their inputs in dtype/shape/structure must
+        pass ``sample_y`` (``fit`` always does)."""
         if self.state is not None:
             return self.state
         rng = jax.random.PRNGKey(self.seed)
@@ -606,10 +613,26 @@ class Trainer:
                 a = np.concatenate([a] * (-(-n // len(a))))
             return jnp.asarray(a[:n])
 
+        sized_x = jax.tree.map(size_to_dp, sample_x)
+        # loss='module' contract: init with labels so the module traces its
+        # fused-loss branch — otherwise build() materializes the dense
+        # [B, T, vocab] logits that the fused head exists to avoid, making
+        # init the OOM point at long-context scale even though train/eval
+        # steps are fused. Real labels when the caller has them; the
+        # zeros_like fallback matches the LM family's labels-share-the-
+        # token-batch contract (models/transformer.py `__call__`).
+        init_kwargs = {}
+        if self._module_loss:
+            init_kwargs["labels"] = (
+                jax.tree.map(size_to_dp, sample_y)
+                if sample_y is not None
+                else jax.tree.map(jnp.zeros_like, sized_x)
+            )
         variables = self.module.init(
             {"params": init_rng, "dropout": dropout_rng},
-            jax.tree.map(size_to_dp, sample_x),
+            sized_x,
             train=False,
+            **init_kwargs,
         )
         params = variables["params"]
         # Sown per-apply channels never persist in the carried state: values
@@ -886,7 +909,7 @@ class Trainer:
 
         it = iter(dataset)
         first = next(it)
-        self.build(first[0])
+        self.build(first[0], first[1])
 
         for cb in callbacks:
             cb.set_trainer(self)
@@ -971,7 +994,9 @@ class Trainer:
                 f"({batch_size})"
             )
         steps = min(steps_per_epoch or max_steps, max_steps)
-        self.build(np.asarray(x[: self.dp_size]))
+        self.build(
+            np.asarray(x[: self.dp_size]), np.asarray(y[: self.dp_size])
+        )
 
         for cb in callbacks:
             cb.set_trainer(self)

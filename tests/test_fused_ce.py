@@ -3,6 +3,7 @@ contract: math parity with the dense logits path, gradient parity through
 the custom VJP, and the memory claim (no full [B·T, vocab] logits array)
 verified against XLA's own memory analysis."""
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -189,3 +190,63 @@ class TestModuleLossTrainer:
             jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
         )["params"]
         assert params["lm_head"]["kernel"].shape == (32, 64)
+
+
+class TestBuildTracesFusedPath:
+    def test_init_receives_labels_under_module_loss(self):
+        # build() must init with dummy labels so the module traces the
+        # fused-CE branch — the dense [B, T, vocab] branch at init is the
+        # OOM point at long-context scale (ADVICE r3, trainer.py build).
+        seen = []
+
+        class Rec(nn.Module):
+            @nn.compact
+            def __call__(self, tokens, train: bool = False, labels=None):
+                seen.append(labels is not None)
+                emb = self.param(
+                    "emb", nn.initializers.normal(0.02), (64, 8)
+                )
+                h = emb[tokens].mean(axis=1) @ emb.T  # [B, 64]
+                if labels is None:
+                    return h
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    h, labels[:, 0]
+                )
+                correct = (jnp.argmax(h, -1) == labels[:, 0]).astype(
+                    jnp.float32
+                )
+                return loss, correct
+
+        trainer = hvt.Trainer(
+            Rec(), hvt.DistributedOptimizer(optax.adam(1e-2)), loss="module"
+        )
+        x = np.random.RandomState(0).randint(1, 64, size=(8, 4)).astype(
+            np.int32
+        )
+        trainer.build(x)
+        assert seen and all(seen), seen
+
+    def test_build_with_sample_y_for_non_token_labels(self):
+        # labels that differ from x in dtype/shape (float inputs, int class
+        # labels): build must use the provided sample_y, not zeros_like(x).
+        class Clf(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False, labels=None):
+                w = self.param("w", nn.initializers.normal(0.02), (4, 8))
+                h = x @ w  # [B, 8] logits
+                if labels is None:
+                    return h
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    h, labels
+                )
+                correct = (jnp.argmax(h, -1) == labels).astype(jnp.float32)
+                return loss, correct
+
+        trainer = hvt.Trainer(
+            Clf(), hvt.DistributedOptimizer(optax.adam(1e-2)), loss="module"
+        )
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = (np.arange(16) % 8).astype(np.int32)
+        # fit threads the real labels through to init.
+        history = trainer.fit(x=x, y=y, batch_size=2, epochs=1, verbose=0)
+        assert np.isfinite(history[-1]["loss"])

@@ -2,15 +2,18 @@
 base under training, merged-weights equivalence, and composition with the
 Trainer / DistributedOptimizer / fused-CE stack."""
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvt
 from horovod_tpu.models import lora
 from horovod_tpu.models.lora import LoRAModel
-from horovod_tpu.models.transformer import TransformerLM
+from horovod_tpu.models.transformer import TransformerLM, param_specs
+from horovod_tpu.parallel import mesh as mesh_lib
 
 
 def _lm(**kw):
@@ -124,6 +127,43 @@ class TestLoRATraining:
             base0, state.params["base"],
         )
 
+    def test_stateful_inner_state_carries_through_wrapper(self):
+        # Inner mutable collections beyond sows must survive the wrap: the
+        # wrapper carries them as its 'inner_state' variable, so the
+        # Trainer's model_state path threads them step to step.
+        class StatefulNet(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                k = self.param(
+                    "mlp_up", nn.initializers.normal(0.02), (4, 8)
+                )
+                count = self.variable(
+                    "counter", "steps", lambda: jnp.zeros((), jnp.int32)
+                )
+                if train and self.is_mutable_collection("counter"):
+                    count.value = count.value + 1
+                return (x @ k) @ k.T
+
+        model = LoRAModel(inner=StatefulNet(), rank=2)
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(lora.freeze_base(optax.adamw(1e-2))),
+            loss="sparse_categorical_crossentropy",
+        )
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = (np.arange(16) % 4).astype(np.int32)
+        state = trainer.build(x)
+        assert state.model_state and "inner_state" in state.model_state
+        for _ in range(3):
+            state, _, _ = trainer._train_step(
+                state, trainer._shard((x, y)), np.float32(1.0),
+                trainer.zero_metrics(),
+            )
+        steps = jax.device_get(
+            state.model_state["inner_state"]["collections"]["counter"]["steps"]
+        )
+        assert int(steps) == 3
+
     def test_moe_aux_channels_pass_through(self):
         # The wrapper re-sows the inner module's 'losses'/'metrics': the MoE
         # load-balance objective and drop-rate observability must survive.
@@ -142,3 +182,90 @@ class TestLoRATraining:
             trainer.zero_metrics(),
         )
         assert np.isfinite(float(metrics["moe_drop_rate"]))
+
+
+class TestLoRAWithTP:
+    def test_param_specs_replicate_adapters(self):
+        # rank 3 is NOT divisible by the model axis (2): pre-fix the TP rule
+        # matched adapter leaves through their layer names and raised / left
+        # degenerate shardings. Adapters must skip the TP rule entirely.
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        model = LoRAModel(inner=_lm(), rank=3)
+        x, _ = _data()
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        specs = param_specs(params, mesh)
+
+        def axes(spec):
+            out = []
+            for ax in spec:
+                out.extend(ax if isinstance(ax, tuple) else (ax,))
+            return [a for a in out if a is not None]
+
+        flat = jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda s: isinstance(s, P)
+        )[0]
+        lora_specs = [
+            (path, s) for path, s in flat
+            if "lora" in [
+                p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+            ]
+        ]
+        base_specs = [(p, s) for p, s in flat if (p, s) not in lora_specs]
+        assert lora_specs, "adapter leaves missing from the spec tree"
+        for path, s in lora_specs:
+            assert "model" not in axes(s), (path, s)
+        # The base kernels must still carry TP shardings.
+        assert any("model" in axes(s) for _, s in base_specs)
+
+    def test_moe_targets_do_not_hit_expert_rule(self):
+        # Custom targets adapting expert weights: the 2-D [E, r] adapter
+        # must not be pushed through the 3-D moe sharding rule.
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, expert=2, model=2)
+        )
+        model = LoRAModel(
+            inner=_lm(moe_every=2, n_experts=4), rank=2,
+            targets=("moe_up", "moe_down", "qkv"),
+        )
+        x, _ = _data()
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        specs = param_specs(params, mesh)  # must not raise / index OOB
+        assert specs is not None
+
+    def test_init_does_not_advance_unconditional_inner_state(self):
+        # An inner module that advances state on EVERY forward (the decode-
+        # cache pattern): the wrapper's init must seed inner.init's fresh
+        # values, not state contaminated by the init-time forward; and a
+        # read-only eval apply must not advance it either.
+        class Ticker(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                k = self.param("mlp_up", nn.initializers.normal(0.02), (4, 8))
+                idx = self.variable(
+                    "cache", "index", lambda: jnp.zeros((), jnp.int32)
+                )
+                if self.is_mutable_collection("cache"):
+                    idx.value = idx.value + 1
+                return (x @ k) @ k.T
+
+        model = LoRAModel(inner=Ticker(), rank=2)
+        x = np.ones((4, 4), np.float32)
+        # Parity target: whatever plain inner.init leaves in the state
+        # (its init forward ticks once, like the unwrapped module).
+        plain = int(
+            Ticker().init(jax.random.PRNGKey(0), x)["cache"]["index"]
+        )
+        variables = model.init(jax.random.PRNGKey(0), x)
+        carried = variables["inner_state"]["collections"]["cache"]["index"]
+        assert int(carried) == plain, (
+            "wrapper init forward advanced the seeded state past "
+            "inner.init's"
+        )
+        # Read-only apply: no mutable collections -> inner must not tick.
+        out = model.apply(variables, x)
+        assert out.shape == (4, 4)
+        # Mutable apply: ticks exactly once past the seed.
+        _, upd = model.apply(variables, x, mutable=["inner_state"])
+        assert int(
+            upd["inner_state"]["collections"]["cache"]["index"]
+        ) == plain + 1
