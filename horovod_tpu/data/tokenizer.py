@@ -28,6 +28,7 @@ becomes one id, never 5 byte tokens.
 from __future__ import annotations
 
 import collections
+import heapq
 import json
 import os
 
@@ -103,28 +104,64 @@ class ByteBPETokenizer:
         word_freq: collections.Counter = collections.Counter()
         for t in texts:
             word_freq.update(_pretokenize(t))
-        # Each distinct word as a mutable symbol list.
+        # Each distinct word as a mutable symbol list. Training is
+        # incremental (the merge-queue scheme): pair counts and a
+        # pair → containing-words index are built once, each merge touches
+        # only the words that contain the merged pair, and the best pair
+        # comes from a lazy-deletion heap — per-merge cost is O(changed)
+        # instead of a full corpus rescan, which is what makes MB-scale
+        # corpora train in seconds.
         words = [(list(w), f) for w, f in word_freq.items()]
+        pairs: dict[tuple[int, int], int] = {}
+        where: dict[tuple[int, int], set[int]] = {}
+        for wi, (sym, f) in enumerate(words):
+            for p in zip(sym, sym[1:]):
+                pairs[p] = pairs.get(p, 0) + f
+                where.setdefault(p, set()).add(wi)
+        # Heap key (-count, pair) reproduces the selection order of a full
+        # rescan: highest count first, ties to the smallest (a, b) — the
+        # learned merges are bit-identical to the O(merges × corpus)
+        # trainer this replaces.
+        heap = [(-c, p) for p, c in pairs.items()]
+        heapq.heapify(heap)
         merges: list[tuple[int, int]] = []
-        for _ in range(n_merges):
-            pairs: collections.Counter = collections.Counter()
-            for sym, f in words:
-                for a, b in zip(sym, sym[1:]):
-                    pairs[(a, b)] += f
-            if not pairs:
-                break  # corpus exhausted: every word is one symbol
-            (a, b), count = max(pairs.items(), key=lambda kv: (kv[1], -kv[0][0], -kv[0][1]))
+        while len(merges) < n_merges and heap:
+            negc, pair = heapq.heappop(heap)
+            count = pairs.get(pair, 0)
             if count < 2:
-                break  # nothing repeats — further merges are noise
+                continue  # dead or noise-level pair (stale entry or < 2)
+            if -negc != count:
+                # Stale count: re-queue at the true value and keep popping.
+                heapq.heappush(heap, (-count, pair))
+                continue
+            a, b = pair
             new_id = 256 + len(merges)
-            merges.append((a, b))
-            for sym, _ in words:
+            merges.append(pair)
+            changed: set[tuple[int, int]] = set()
+            for wi in where.pop(pair, ()):
+                sym, f = words[wi]
+                for p in zip(sym, sym[1:]):
+                    left = pairs.get(p, 0) - f
+                    if left > 0:
+                        pairs[p] = left
+                    else:
+                        pairs.pop(p, None)
+                    ws = where.get(p)
+                    if ws is not None:
+                        ws.discard(wi)
                 i = 0
                 while i < len(sym) - 1:
                     if sym[i] == a and sym[i + 1] == b:
                         sym[i : i + 2] = [new_id]
                     else:
                         i += 1
+                for p in zip(sym, sym[1:]):
+                    pairs[p] = pairs.get(p, 0) + f
+                    where.setdefault(p, set()).add(wi)
+                    changed.add(p)
+            for p in changed:
+                if p in pairs:
+                    heapq.heappush(heap, (-pairs[p], p))
         return cls(merges=merges, specials=specials)
 
     # -- encoding ------------------------------------------------------------

@@ -1,9 +1,12 @@
 """Byte-level BPE: lossless round-trip, merge learning, specials,
 persistence, and the text → packing → model bridge."""
 
+import time
+
 import numpy as np
 import pytest
 
+from horovod_tpu.data import tokenizer as tokenizer_mod
 from horovod_tpu.data.tokenizer import ByteBPETokenizer, _pretokenize
 
 CORPUS = [
@@ -117,3 +120,76 @@ class TestPackingBridge:
         }
         for d in docs:
             assert tuple(d) in chunks
+
+
+class TestIncrementalTrainer:
+    def test_matches_full_rescan_trainer(self):
+        # The incremental merge-queue trainer must learn EXACTLY the merges
+        # of the O(merges x corpus) full-rescan reference it replaced
+        # (same count ordering, ties to the smallest (a, b) pair).
+        def rescan_train(texts, n_merges):
+            import collections
+
+            word_freq = collections.Counter()
+            for t in texts:
+                word_freq.update(tokenizer_mod._pretokenize(t))
+            words = [(list(w), f) for w, f in word_freq.items()]
+            merges = []
+            for _ in range(n_merges):
+                pairs = collections.Counter()
+                for sym, f in words:
+                    for a, b in zip(sym, sym[1:]):
+                        pairs[(a, b)] += f
+                if not pairs:
+                    break
+                (a, b), count = max(
+                    pairs.items(),
+                    key=lambda kv: (kv[1], -kv[0][0], -kv[0][1]),
+                )
+                if count < 2:
+                    break
+                new_id = 256 + len(merges)
+                merges.append((a, b))
+                for sym, _ in words:
+                    i = 0
+                    while i < len(sym) - 1:
+                        if sym[i] == a and sym[i + 1] == b:
+                            sym[i : i + 2] = [new_id]
+                        else:
+                            i += 1
+            return merges
+
+        corpus = [
+            "the quick brown fox jumps over the lazy dog",
+            "pack my box with five dozen liquor jugs",
+            "the the the quick quick fox fox fox dog",
+            "sphinx of black quartz judge my vow " * 3,
+        ] * 4
+        expected = rescan_train(corpus, 120)
+        got = ByteBPETokenizer.train(corpus, vocab_size=256 + 120).merges
+        assert got == expected
+
+    def test_mb_scale_corpus_trains_fast(self):
+        # ~2 MB synthetic corpus with natural-ish word repetition: the
+        # incremental trainer must finish in seconds (the rescan trainer
+        # took minutes here). Generous bound - the test box is 1 CPU and
+        # may be running a sibling suite.
+        rng = np.random.RandomState(0)
+        lexicon = [
+            "".join(
+                rng.choice(list("abcdefghijklmnopqrstuvwxyz"))
+                for _ in range(int(rng.randint(2, 12)))
+            )
+            for _ in range(2000)
+        ]
+        zipf = rng.zipf(1.3, size=400_000) % len(lexicon)
+        text = " ".join(lexicon[i] for i in zipf)
+        assert len(text) > 2_000_000
+        t0 = time.time()
+        tok = ByteBPETokenizer.train([text], vocab_size=1024)
+        elapsed = time.time() - t0
+        assert len(tok.merges) == 1024 - 256
+        assert elapsed < 90, f"BPE training took {elapsed:.1f}s"
+        # Round-trip still exact on a sample.
+        sample = text[:2000]
+        assert tok.decode(tok.encode(sample)) == sample
