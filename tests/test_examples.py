@@ -21,13 +21,16 @@ ENV = {
 }
 
 
-def _run(script, extra_env):
+def _run(script, extra_env, timeout=420):
+    # Margin note: test_lm_generate has been observed at ~276 s solo but can
+    # exceed 420 s when another workload shares the box; callers that compile
+    # many programs pass a wider timeout explicitly.
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", script)],
         env={**ENV, **extra_env},
         capture_output=True,
         text=True,
-        timeout=420,
+        timeout=int(os.environ.get("HVT_TEST_SUBPROC_TIMEOUT", timeout)),
         cwd=REPO,
     )
 
@@ -154,6 +157,7 @@ def test_lm_generate(tmp_path):
             "NLAYERS": "2",
             "GAMMA": "3",
         },
+        timeout=900,
     )
     assert res.returncode == 0, res.stdout + res.stderr
     assert (tmp_path / "lm-generate" / "checkpoint-final.msgpack").exists()
