@@ -173,10 +173,14 @@ class LoRAModel(nn.Module):
             lambda rng: init_adapters(rng, base, self.rank, self.targets),
         )
         if self.is_initializing():
+            # 'intermediates' (and the other sown per-apply channels) must
+            # not seed the carry: flax gives them append semantics, so a
+            # carried tuple would grow on every mutable apply and change the
+            # model_state pytree structure mid-scan.
             extra = {
                 k: v
                 for k, v in init_cache.get("vars", {}).items()
-                if k not in ("params", "losses", "metrics")
+                if k not in ("params", "losses", "metrics", "intermediates")
             }
             carry = (
                 self.variable("inner_state", "collections", lambda: extra)

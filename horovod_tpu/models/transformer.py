@@ -750,7 +750,13 @@ def param_specs(params, mesh: Mesh, extra_tp_dim: dict | None = None) -> dict:
         # a divisibility (or rank) failure otherwise. Adapters skip both
         # rule tables; the fsdp rule below still applies, with its own
         # divisibility check.
-        is_lora = "lora" in names
+        # Match the LoRAModel layout precisely ({'base', 'lora'} at the top,
+        # adapter leaves named 'a'/'b' — models/lora.py `init_adapters`), so
+        # a user model that merely CONTAINS a submodule named 'lora' still
+        # gets its kernels TP/EP-sharded.
+        is_lora = (
+            len(names) >= 2 and names[0] == "lora" and names[-1] in ("a", "b")
+        )
         moe = next((n for n in names if n in moe_dims), None) if not is_lora else None
         if moe is not None:
             for dim, axis in moe_dims[moe].items():

@@ -622,18 +622,38 @@ class Trainer:
         # zeros_like fallback matches the LM family's labels-share-the-
         # token-batch contract (models/transformer.py `__call__`).
         init_kwargs = {}
+        synthesized_labels = False
         if self._module_loss:
-            init_kwargs["labels"] = (
-                jax.tree.map(size_to_dp, sample_y)
-                if sample_y is not None
-                else jax.tree.map(jnp.zeros_like, sized_x)
+            if sample_y is not None:
+                init_kwargs["labels"] = jax.tree.map(size_to_dp, sample_y)
+            else:
+                init_kwargs["labels"] = jax.tree.map(jnp.zeros_like, sized_x)
+                synthesized_labels = True
+        try:
+            variables = self.module.init(
+                {"params": init_rng, "dropout": dropout_rng},
+                sized_x,
+                train=False,
+                **init_kwargs,
             )
-        variables = self.module.init(
-            {"params": init_rng, "dropout": dropout_rng},
-            sized_x,
-            train=False,
-            **init_kwargs,
-        )
+        except Exception as e:
+            if synthesized_labels:
+                # The zeros_like fallback assumes LM-style labels (same
+                # shape/dtype as the token batch). For any other module the
+                # trace fails opaquely deep inside init — name the fix.
+                # Mutating args (not re-wrapping) keeps the exception type
+                # even for types with non-string constructors.
+                hint = (
+                    "\n\nhorovod_tpu hint: build() was called with "
+                    "loss='module' and no sample_y, so labels were "
+                    "synthesized as zeros_like(sample_x) (the LM-family "
+                    "contract). If this module's labels differ from its "
+                    "inputs in shape/dtype, pass sample_y to build() — "
+                    "fit() does this automatically."
+                )
+                head = str(e.args[0]) if e.args else str(e)
+                e.args = (head + hint,) + tuple(e.args[1:])
+            raise
         params = variables["params"]
         # Sown per-apply channels never persist in the carried state: values
         # are produced fresh each step ('losses' → objective, 'metrics' →

@@ -250,3 +250,27 @@ class TestBuildTracesFusedPath:
         # fit threads the real labels through to init.
         history = trainer.fit(x=x, y=y, batch_size=2, epochs=1, verbose=0)
         assert np.isfinite(history[-1]["loss"])
+
+    def test_build_without_sample_y_raises_with_hint(self):
+        # Same classifier, but build(x) alone: zeros_like(float x) is a
+        # wrong-typed label for the integer-CE branch. The failure must
+        # carry a hint naming sample_y instead of an opaque trace error.
+        class Clf(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False, labels=None):
+                w = self.param("w", nn.initializers.normal(0.02), (4, 8))
+                h = x @ w
+                if labels is None:
+                    return h
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    h, labels
+                )
+                correct = (jnp.argmax(h, -1) == labels).astype(jnp.float32)
+                return loss, correct
+
+        trainer = hvt.Trainer(
+            Clf(), hvt.DistributedOptimizer(optax.adam(1e-2)), loss="module"
+        )
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        with pytest.raises(Exception, match="sample_y"):
+            trainer.build(x)

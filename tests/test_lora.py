@@ -164,6 +164,35 @@ class TestLoRATraining:
         )
         assert int(steps) == 3
 
+    def test_intermediates_not_carried_as_state(self):
+        # Sows into 'intermediates' have append semantics: if the wrapper
+        # seeded them into the inner_state carry, every mutable apply would
+        # append again, growing the tuple and changing the model_state
+        # pytree structure (breaking the jitted step / scan carry).
+        class SowingNet(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                k = self.param(
+                    "mlp_up", nn.initializers.normal(0.02), (4, 8)
+                )
+                h = x @ k
+                self.sow("intermediates", "hidden", h)
+                return h @ k.T
+
+        model = LoRAModel(inner=SowingNet(), rank=2)
+        x = np.ones((4, 4), np.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        carried = variables.get("inner_state", {}).get("collections", {})
+        assert "intermediates" not in carried
+        # Two mutable applies: carry structure must be a fixed point.
+        _, upd1 = model.apply(variables, x, mutable=["inner_state"])
+        _, upd2 = model.apply(
+            {**variables, **upd1}, x, mutable=["inner_state"]
+        )
+        assert jax.tree_util.tree_structure(
+            upd1
+        ) == jax.tree_util.tree_structure(upd2)
+
     def test_moe_aux_channels_pass_through(self):
         # The wrapper re-sows the inner module's 'losses'/'metrics': the MoE
         # load-balance objective and drop-rate observability must survive.
@@ -216,6 +245,30 @@ class TestLoRAWithTP:
             assert "model" not in axes(s), (path, s)
         # The base kernels must still carry TP shardings.
         assert any("model" in axes(s) for _, s in base_specs)
+
+    def test_submodule_named_lora_still_tp_sharded(self):
+        # A user model that merely CONTAINS a submodule named 'lora' is not
+        # the LoRAModel layout — its kernels must still get TP shardings.
+        class Sub(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                k = self.param(
+                    "mlp_up", nn.initializers.normal(0.02), (32, 128)
+                )
+                return x @ k
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return Sub(name="lora")(x)
+
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        params = Net().init(
+            jax.random.PRNGKey(0), np.ones((4, 32), np.float32)
+        )["params"]
+        specs = param_specs(params, mesh)
+        spec = specs["lora"]["mlp_up"]
+        assert "model" in [ax for ax in spec if ax is not None], spec
 
     def test_moe_targets_do_not_hit_expert_rule(self):
         # Custom targets adapting expert weights: the 2-D [E, r] adapter
