@@ -669,7 +669,9 @@ def bench_spec() -> dict:
         "plain_tokens_per_sec": round(tok_plain, 1),
         "speedup": round(tok_spec / tok_plain, 2),
         "gamma": gamma,
-        "accept_per_round": round(accepted / max(rounds, 1), 2),
+        # stats['tokens'] is the batch-wide committed total; per-row mean
+        # acceptance divides by the batch too (speculative.py docstring).
+        "accept_per_round": round(accepted / max(rounds, 1) / batch, 2),
         "rounds": rounds,
         "batch": batch,
         "new_tokens": n_new,

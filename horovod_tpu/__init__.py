@@ -60,6 +60,7 @@ from horovod_tpu.training.optimizer import Compression, DistributedOptimizer
 from horovod_tpu.training import callbacks
 from horovod_tpu.training.trainer import Trainer, TrainState
 from horovod_tpu import checkpoint
+from horovod_tpu import serving
 from horovod_tpu.checkpoint import broadcast_parameters
 
 __version__ = "0.2.0"  # keep in sync with pyproject.toml

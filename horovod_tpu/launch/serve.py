@@ -3,20 +3,29 @@ serving bundles.
 
 The reference's export tail produces a SavedModel "so that it can be
 served by TF Serving" (mnist_keras.py:126-140); this module is the
-native half of that story: it serves a StableHLO bundle
-(`checkpoint.export_serving`'s default format) over HTTP with the same
-``input → prob`` contract, no TF anywhere.
+native half of that story: it serves a StableHLO bundle over HTTP with
+no TF anywhere. Two bundle kinds, auto-detected:
+
+* **predict bundles** (`checkpoint.export_serving`) — the reference's
+  ``input → prob`` classifier contract;
+* **generation bundles** (`serving.export_generate`) — the flagship LM's
+  compiled prefill + decode loop, tokenizer riding along.
 
 Endpoints (JSON, shapes follow the exported signature's trailing dims):
 
 * ``GET  /healthz``                → ``{"status": "ok", "bundle": ...}``
 * ``POST /v1/predict``  body ``{"input": [[...], ...]}``
                                    → ``{"prob": [[...], ...]}``
+* ``POST /v1/generate`` body ``{"prompt": [[ids...], ...]}`` or
+  ``{"text": ["...", ...]}`` (+ optional ``"seed": N``)
+                                   → ``{"tokens": [[ids...], ...]}``
+                                     (+ ``"text": [...]`` with a tokenizer)
 
 Batching: the exported program is compiled for ONE batch shape (static
 shapes are the deal with XLA). Requests of any row count are padded up /
-split to the bundle's batch size server-side, so clients never see the
-static-shape constraint. The compiled callable is locked — requests
+split to the bundle's batch size server-side — and generation prompts of
+any length ≤ the compiled prompt_len ride the ragged-lengths path — so
+clients never see the static-shape constraint. The compiled callable is locked — requests
 serialize through the device; concurrency comes from the accelerator
 being fast, not from re-entrancy.
 
@@ -35,7 +44,9 @@ import numpy as np
 
 
 class _ModelApp:
-    """The bundle, its static batch size, and the pad/split logic."""
+    """A predict bundle, its static batch size, and the pad/split logic."""
+
+    kind = "predict"
 
     def __init__(self, bundle_dir: str):
         from horovod_tpu import checkpoint
@@ -72,10 +83,67 @@ class _ModelApp:
         return np.concatenate(out)
 
 
+class _GenerateApp:
+    """A generation bundle behind the same lock discipline."""
+
+    kind = "generate"
+
+    def __init__(self, bundle_dir: str):
+        from horovod_tpu import serving
+
+        self.bundle_dir = bundle_dir
+        self.bundle = serving.load_generate(bundle_dir)
+        self.signature = {
+            "inputs": {
+                "prompt": {
+                    "shape": [self.bundle.batch_size, self.bundle.prompt_len],
+                    "dtype": "int32",
+                }
+            },
+            "outputs": {"tokens": {}},
+            "meta": self.bundle.meta,
+        }
+        self._lock = threading.Lock()
+
+    def generate(self, payload: dict) -> dict:
+        seed = int(payload.get("seed", 0))
+        if "text" in payload and "prompt" in payload:
+            raise ValueError("pass 'text' OR 'prompt', not both")
+        # Tokenize OUTSIDE the lock — only the compiled call needs
+        # serializing through the device; CPU encode/decode of one request
+        # must not block another's device run.
+        if "text" in payload:
+            texts = payload["text"]
+            if not isinstance(texts, list):
+                raise ValueError("'text' must be a list of strings")
+            if self.bundle.tokenizer is None:
+                raise ValueError(
+                    "this bundle has no tokenizer — POST token ids "
+                    "under 'prompt' instead"
+                )
+            prompts = [self.bundle.tokenizer.encode(t) for t in texts]
+        else:
+            prompts = payload["prompt"]
+        with self._lock:
+            tokens = self.bundle.generate_tokens(prompts, seed=seed)
+        out = {"tokens": tokens}
+        if self.bundle.tokenizer is not None:
+            out["text"] = [self.bundle.tokenizer.decode(g) for g in tokens]
+        return out
+
+
+def _make_app(bundle_dir: str):
+    from horovod_tpu import serving
+
+    if serving.is_generate_bundle(bundle_dir):
+        return _GenerateApp(bundle_dir)
+    return _ModelApp(bundle_dir)
+
+
 def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1"):
     """Build (but don't start) the HTTP server; ``server.server_address``
     carries the bound port when ``port=0``."""
-    app = _ModelApp(bundle_dir)
+    app = _make_app(bundle_dir)
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):
@@ -93,21 +161,31 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1"):
             if self.path == "/healthz":
                 self._send(
                     200, {"status": "ok", "bundle": app.bundle_dir,
-                          "signature": app.signature}
+                          "kind": app.kind, "signature": app.signature}
                 )
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path != "/v1/predict":
-                self._send(404, {"error": f"no route {self.path}"})
+            route = (app.kind, self.path)
+            if route not in (
+                ("predict", "/v1/predict"), ("generate", "/v1/generate")
+            ):
+                hint = (
+                    f"this server holds a {app.kind} bundle; its route is "
+                    f"/v1/{app.kind}"
+                )
+                self._send(404, {"error": f"no route {self.path} — {hint}"})
                 return
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length))
-                rows = np.asarray(payload["input"])
-                prob = app.predict(rows)
-                self._send(200, {"prob": prob.tolist()})
+                if app.kind == "generate":
+                    self._send(200, app.generate(payload))
+                else:
+                    rows = np.asarray(payload["input"])
+                    prob = app.predict(rows)
+                    self._send(200, {"prob": prob.tolist()})
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
             except Exception as e:  # device/runtime failures -> 5xx JSON,
@@ -122,9 +200,11 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1"):
 
 def serve_forever(bundle_dir: str, port: int = 8000, host: str = "0.0.0.0"):
     server = make_server(bundle_dir, port=port, host=host)
+    inputs = server.app.signature["inputs"]
+    shape = next(iter(inputs.values()))["shape"]
     print(
-        f"serving {bundle_dir} on http://{host}:{server.server_address[1]} "
-        f"(input {server.app.signature['inputs']['input']['shape']})",
+        f"serving {bundle_dir} ({server.app.kind}) on "
+        f"http://{host}:{server.server_address[1]} (input {shape})",
         flush=True,
     )
     try:
@@ -137,7 +217,11 @@ def main(argv=None) -> None:
     import argparse
 
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("bundle_dir", help="a checkpoint.export_serving bundle")
+    p.add_argument(
+        "bundle_dir",
+        help="a serving bundle dir: checkpoint.export_serving (predict) "
+        "or serving.export_generate (generation) — kind auto-detected",
+    )
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--host", default="0.0.0.0")
     args = p.parse_args(argv)

@@ -110,11 +110,22 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
     scan body so the per-token weight stream stays int8 in HBM — the
     bandwidth-bound step reads half the bytes (quant.py; approximate:
     outputs can differ from bf16 decoding near ties).
+
+    **Ragged prompts** — ``fn(params, prompt, rng, lengths)`` with
+    ``lengths`` a ``[B]`` int array: each row's true prompt is its first
+    ``lengths[i]`` tokens; the rest of the row is right-padding (any token
+    id). The prefill writes pad K/V into the cache, but each row's first
+    sampled token reads the logits at its own ``lengths[i]-1`` and decode
+    steps write at per-row cache positions — generated K/V overwrite the
+    pad entries before any query can attend to them (causal masking covers
+    the not-yet-overwritten tail), so every row generates exactly as if it
+    were alone in the batch at its own length. This is the serving path:
+    one compiled program, mixed prompt lengths per batch.
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
 
-    def run(params, prompt, rng):
+    def run(params, prompt, rng, lengths=None):
         prompt = prompt.astype(jnp.int32)
         b, t0 = prompt.shape
         from horovod_tpu.models.quant import make_unpack
@@ -130,8 +141,20 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
         # collection is created here ([B, L, H, D] per block + the position
         # index) and threaded through the scan as plain pytree state.
         logits, vars_ = dmodel.apply({"params": params}, prompt, mutable=["cache"])
+        cache0 = vars_["cache"]
+        if lengths is None:
+            last_logits = logits[:, -1]
+        else:
+            # Ragged batch: row i's next-token logits live at its own last
+            # REAL position, and its decode writes start at lengths[i] —
+            # the per-row cache index layout (transformer.Block).
+            lengths = jnp.asarray(lengths, jnp.int32)
+            last_logits = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1
+            )[:, 0]
+            cache0 = {**cache0, "index": lengths}
         rng, sub = jax.random.split(rng)
-        tok = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+        tok = _sample(last_logits, sub, temperature, top_k, top_p)
         done = (
             jnp.zeros((b,), bool) if eos_id is None else tok == eos_id
         )
@@ -153,7 +176,7 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
             return (step_vars["cache"], nxt, rng, new_done), nxt
 
         (_, _, _, _), rest = lax.scan(
-            body, (vars_["cache"], tok, rng, done), None,
+            body, (cache0, tok, rng, done), None,
             length=max_new_tokens - 1,
         )
         gen = jnp.concatenate([tok[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)

@@ -20,9 +20,11 @@ TPU/XLA model:
 * **exactness by construction**: greedy acceptance keeps a drafted token
   only while it equals the target's own argmax, so the output is
   bit-identical to plain greedy decoding whatever the draft quality —
-  drafts change the speed, never the result. (Batch rows accept different
-  prefix lengths; the shared cache index advances by the row-minimum, so
-  extra row matches are simply re-derived next round — still exact.)
+  drafts change the speed, never the result. Batch rows accept different
+  prefix lengths and each advances by its OWN acceptance (per-row cache
+  indices, transformer.Block's vector decode_index layout): a lucky row
+  never waits for an unlucky one, so batched throughput keeps the batch-1
+  acceptance rate instead of degrading toward the row-minimum.
 
 The built-in draft is **prompt-lookup** (n-gram continuation: propose the
 tokens that followed the most recent earlier occurrence of the current
@@ -30,8 +32,9 @@ n-gram suffix — "prompt lookup decoding", a draft-model-free scheme that
 excels on self-repetitive text: code, summarization-with-quotes, copy
 structure). Two generalizations, same exactness guarantee:
 
-* a custom stateless ``draft_fn(buf [B, Tmax], cur_len, n_draft) ->
-  [B, n_draft]``;
+* a custom stateless ``draft_fn(buf [B, Tmax], cur_len [B], n_draft) ->
+  [B, n_draft]`` (``cur_len`` arrives as a per-row vector; a scalar is
+  also accepted for hand-driven use);
 * a **draft model** (``draft_model=`` + ``draft_params=``: a smaller LM,
   the classic two-model scheme) — it keeps its own KV cache inside the
   loop. Static-shape subtlety: how far the draft cache trails the
@@ -50,10 +53,10 @@ committed law is exactly p per position, so sampled speculative output is
 *distributionally* identical to `decoding.generate`'s sampled path
 (bit-identity is impossible: the rng schedules differ). Randomness is
 keyed by ``(absolute position, draft token, batch row)``, never by round:
-a batch row that accepts past the lockstep minimum re-derives the same
-positions next round against possibly *different* draft proposals, and
-per-(position, token) keys keep the reused test independent of the
-discarded one — round-keyed draws would bias exactly that case.
+with per-row advance each position is decided exactly once, and the
+position/token keying additionally guarantees independence if a position
+ever were revisited (the property the old lockstep scheme needed; kept
+because it costs nothing and makes the draws schedule-invariant).
 
 Restrictions: ``eos_id`` unsupported (use `decoding.generate` for
 eos-terminated generation), and dense models only: MoE expert capacity is
@@ -82,20 +85,25 @@ def ngram_draft_fn(*, ngram: int = 3) -> Callable:
     """Prompt-lookup draft: continue the most recent earlier occurrence of
     the current ``ngram``-token suffix.
 
-    Returns ``draft_fn(buf [B, Tmax], cur_len, gamma) -> [B, gamma]``
-    proposals. When no earlier occurrence exists a row falls back to
-    repeating its last token — drafts are free to be wrong; verification
-    discards mismatches.
+    Returns ``draft_fn(buf [B, Tmax], cur_len [B] or scalar, gamma) ->
+    [B, gamma]`` proposals. When no earlier occurrence exists a row falls
+    back to repeating its last token — drafts are free to be wrong;
+    verification discards mismatches.
     """
 
     def draft_fn(buf, cur_len, n_draft: int):
         b, tmax = buf.shape
-        # Suffix = the last `ngram` finalized tokens (dynamic_slice clamps
-        # the start when cur_len < ngram — the garbage suffix just drafts
-        # badly, which verification absorbs).
-        suffix = lax.dynamic_slice(
-            buf, (jnp.int32(0), cur_len - ngram), (b, ngram)
-        )  # [B, ngram]
+        cur_len = jnp.asarray(cur_len, jnp.int32)
+        if cur_len.ndim == 0:
+            cur_len = jnp.broadcast_to(cur_len, (b,))
+        # Suffix = each row's last `ngram` finalized tokens (indices clamp
+        # at 0 when cur_len < ngram — the garbage suffix just drafts badly,
+        # which verification absorbs).
+        suf_idx = jnp.clip(
+            cur_len[:, None] - ngram + jnp.arange(ngram, dtype=jnp.int32),
+            0, tmax - 1,
+        )
+        suffix = jnp.take_along_axis(buf, suf_idx, axis=1)  # [B, ngram]
         n_windows = tmax - ngram
         win_idx = (
             jnp.arange(n_windows, dtype=jnp.int32)[:, None]
@@ -106,7 +114,7 @@ def ngram_draft_fn(*, ngram: int = 3) -> Callable:
         # An *earlier* occurrence: the window must end before the suffix
         # starts (also excludes matching the suffix against itself).
         eq = jnp.all(windows == suffix[:, None, :], axis=-1) & (
-            starts[None, :] < cur_len - ngram
+            starts[None, :] < (cur_len - ngram)[:, None]
         )
         s_star = jnp.max(
             jnp.where(eq, starts[None, :], -1), axis=1
@@ -117,7 +125,7 @@ def ngram_draft_fn(*, ngram: int = 3) -> Callable:
             0, tmax - 1,
         )
         draft = jnp.take_along_axis(buf, follow, axis=1)  # [B, n_draft]
-        last = jnp.take_along_axis(buf, (cur_len - 1)[None, None].repeat(b, 0), 1)
+        last = jnp.take_along_axis(buf, (cur_len - 1)[:, None], 1)
         return jnp.where(has[:, None], draft, last)
 
     return draft_fn
@@ -141,13 +149,16 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
     filtered distribution per position).
 
     ``gamma`` = tokens verified per target pass (1 known-exact token + γ-1
-    drafts): per round the target streams its weights once and commits
-    between 1 and γ tokens. Drafts come from ``draft_fn`` (stateless), or
+    drafts): per round the target streams its weights once and each batch
+    row commits between 1 and γ tokens — **per row**: acceptance is
+    row-independent (per-row cache indices), so a batch keeps the batch-1
+    acceptance rate instead of advancing in lockstep at the row-minimum.
+    Drafts come from ``draft_fn`` (stateless), or
     ``draft_model``/``draft_params`` (a smaller LM with its own in-loop KV
     cache — see module docstring), or the default prompt-lookup n-gram.
-    ``return_stats`` appends a dict with ``rounds`` and ``tokens``
-    (accepted-per-round = tokens/rounds; plain decoding would use
-    ``tokens`` rounds).
+    ``return_stats`` appends a dict with ``rounds`` (loop iterations until
+    the slowest row finished) and ``tokens`` (total committed across rows;
+    mean accepted-per-round = tokens / (rounds · B)).
 
     ``quantized=True``: ``params`` is a `models/quant.quantize_params`
     tree; every target pass dequantizes inside the loop body so the
@@ -245,18 +256,27 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
                 {"params": draft_params}, prompt[:, :-1], mutable=["cache"]
             )
             dcache0 = dict(dvars["cache"])
+            # Per-row index layout from the start (the while_loop carry
+            # must keep one pytree structure; _model_draft overwrites it
+            # with cur_len - 1 anyway).
+            dcache0["index"] = jnp.full((b,), t0 - 1, jnp.int32)
 
         def _model_draft(dcache, buf, cur_len):
             """γ-1 greedy proposals from the draft LM, cache maintained.
 
-            ``buf[cur_len]`` is the committed head (next_tok). The catch-up
-            window [cur_len-1, cur_len] re-feeds whatever the draft cache
-            might be missing — its index is forced to cur_len-1 first, so
-            committed tokens are (re)written at their true positions.
+            ``buf[i, cur_len[i]]`` is row i's committed head (next_tok).
+            The catch-up window [cur_len-1, cur_len] re-feeds whatever the
+            draft cache might be missing — its (per-row) index is forced
+            to cur_len-1 first, so committed tokens are (re)written at
+            their true positions.
             """
             dcache = dict(dcache)
             dcache["index"] = cur_len - 1
-            window = lax.dynamic_slice(buf, (0, cur_len - 1), (b, 2))
+            window = jnp.take_along_axis(
+                buf,
+                (cur_len - 1)[:, None] + jnp.arange(2, dtype=jnp.int32)[None, :],
+                axis=1,
+            )
             dlogits, dvars = ddraft.apply(
                 {"params": draft_params, "cache": dcache}, window,
                 mutable=["cache"],
@@ -285,15 +305,18 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             return proposals, dcache
 
         def cond(carry):
-            return carry[2] < max_new_tokens
+            # Until the SLOWEST row has its max_new_tokens; fast rows
+            # freeze (m_row = 0) once done.
+            return jnp.min(carry[2]) < max_new_tokens
 
         def body(carry):
             buf, cur_len, n_gen, cache, dcache, next_tok, rounds = carry
+            active = n_gen < max_new_tokens  # [B]
             # next_tok is already the target's exact output — commit it,
-            # then draft continuations for verification.
-            buf = lax.dynamic_update_slice(
-                buf, next_tok[:, None], (0, cur_len)
-            )
+            # then draft continuations for verification. (Frozen rows
+            # rewrite their frozen token at their frozen position — a
+            # deterministic no-op outside the output window.)
+            buf = buf.at[rows, cur_len].set(next_tok)
             if ddraft is not None:
                 proposals, dcache = _model_draft(dcache, buf, cur_len)
             else:
@@ -301,6 +324,8 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             chunk = jnp.concatenate([next_tok[:, None], proposals], axis=1)
             # Quantized mode: dequantize per round, inside the loop body —
             # the weight stream of each verify pass stays int8 in HBM.
+            # The cache index is the per-row committed prefix, so each
+            # row's verify forward lands at its own positions.
             logits_c, new_vars = dmodel.apply(
                 {"params": unpack(qparams), "cache": cache}, chunk,
                 mutable=["cache"],
@@ -310,12 +335,15 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
                 probs = jax.nn.softmax(flt, axis=-1)  # [B, γ, V]
                 vocab = flt.shape[-1]
                 d = chunk[:, 1:]  # drafts at positions cur_len+1..+γ-1
-                pos_vec = cur_len + 1 + jnp.arange(gamma - 1, dtype=jnp.int32)
+                pos_mat = (
+                    cur_len[:, None] + 1
+                    + jnp.arange(gamma - 1, dtype=jnp.int32)[None, :]
+                )  # [B, γ-1] absolute positions, per row
                 us = jax.vmap(  # [B, γ-1] position/token/row-keyed uniforms
-                    lambda drow, r: jax.vmap(
+                    lambda drow, r, prow: jax.vmap(
                         lambda p_, t_: jax.random.uniform(_pkey(p_, t_, r))
-                    )(pos_vec, drow)
-                )(d, rows)
+                    )(prow, drow)
+                )(d, rows, pos_mat)
                 # Deterministic-draft rejection: accept d w.p. p(d) under
                 # the target's filtered distribution.
                 p_d = jnp.take_along_axis(probs[:, :-1], d[..., None], -1)
@@ -325,58 +353,75 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
                 # chunk[:, j] (j >= 1) is correct iff it equals the
                 # target's argmax after chunk[:, :j].
                 acc = (chunk[:, 1:] == a[:, :-1]).astype(jnp.int32)
-            m_row = 1 + jnp.sum(jnp.cumprod(acc, axis=1), axis=1)
-            m = jnp.min(m_row)  # shared cache index ⇒ lockstep advance
-            # Commit accepted drafts (positions cur_len+1 .. cur_len+m-1):
-            # write the whole tail, then let positions >= cur_len+m be
-            # overwritten by later rounds — simpler than a dynamic-length
-            # write, and the [cur_len+m, ...) region is dead until then.
-            buf = lax.dynamic_update_slice(
-                buf, chunk[:, 1:], (0, cur_len + 1)
+            m_row = 1 + jnp.sum(jnp.cumprod(acc, axis=1), axis=1)  # [B]
+            # Per-row advance, clamped to the row's remaining budget (so
+            # n_gen lands exactly on max_new_tokens and buf never outgrows
+            # its γ-token headroom); frozen rows advance 0.
+            m_row = jnp.where(
+                active, jnp.minimum(m_row, max_new_tokens - n_gen), 0
             )
-            # The token at position cur_len + m (next round's committed
-            # head). Per row: rows at the lockstep minimum rejected their
-            # draft there (or have none at m == γ) and resample from the
-            # residual (target dist minus the rejected token — exactly p
-            # overall); rows that accepted beyond the minimum keep their
-            # accepted draft, which the next round re-commits.
+            # Commit accepted drafts (row i: positions cur_len[i]+1 ..
+            # cur_len[i]+m_row[i]-1): write the whole tail, then let
+            # positions >= cur_len+m_row be overwritten by later rounds —
+            # simpler than a dynamic-length write, and that region is dead
+            # until then.
+            tail_pos = (
+                cur_len[:, None] + 1
+                + jnp.arange(gamma - 1, dtype=jnp.int32)[None, :]
+            )
+            buf = buf.at[rows[:, None], tail_pos].set(chunk[:, 1:])
+            # The token at each row's position cur_len + m_row (its next
+            # committed head). A row that rejected its draft there (or has
+            # none at m_row == γ) resamples from the residual (target dist
+            # minus the rejected token — exactly p overall); a row whose
+            # clamped m_row kept an accepted draft carries it forward.
             if sampled:
-                flt_m = lax.dynamic_slice_in_dim(flt, m - 1, 1, axis=1)[:, 0]
-                has_draft = m < gamma
-                idx_d = jnp.clip(m, 1, gamma - 1)[None, None].repeat(b, 0)
+                gather_m = jnp.clip(m_row - 1, 0, gamma - 1)[:, None]
+                flt_m = jnp.take_along_axis(
+                    flt, gather_m[..., None], axis=1
+                )[:, 0]  # [B, V]
+                has_draft = m_row < gamma  # [B]
+                idx_d = jnp.clip(m_row, 1, gamma - 1)[:, None]
                 d_m = jnp.take_along_axis(chunk, idx_d, 1)[:, 0]
-                idx_a = jnp.clip(m - 1, 0, gamma - 2)[None, None].repeat(b, 0)
+                idx_a = jnp.clip(m_row - 1, 0, gamma - 2)[:, None]
                 acc_m = jnp.take_along_axis(acc, idx_a, 1)[:, 0].astype(bool)
                 masked = jnp.where(
-                    has_draft & jax.nn.one_hot(d_m, vocab, dtype=bool),
+                    has_draft[:, None] & jax.nn.one_hot(d_m, vocab, dtype=bool),
                     _NEG, flt_m,
                 )
-                pos_m = cur_len + m
+                pos_m = cur_len + m_row  # [B]
 
-                def res_one(f_row, tok, r):
-                    tag = jnp.where(has_draft, vocab + tok, 2 * vocab)
+                def res_one(f_row, tok, r, p_, hd):
+                    tag = jnp.where(hd, vocab + tok, 2 * vocab)
                     return jax.random.categorical(
-                        _pkey(pos_m, tag, r), f_row
+                        _pkey(p_, tag, r), f_row
                     ).astype(jnp.int32)
 
-                resampled = jax.vmap(res_one)(masked, d_m, rows)
-                next_tok = jnp.where(has_draft & acc_m, d_m, resampled)
+                resampled = jax.vmap(res_one)(
+                    masked, d_m, rows, pos_m, has_draft
+                )
+                new_next = jnp.where(has_draft & acc_m, d_m, resampled)
             else:
-                next_tok = jnp.take_along_axis(
-                    a, (m - 1)[None, None].repeat(b, 0), 1
+                new_next = jnp.take_along_axis(
+                    a, jnp.clip(m_row - 1, 0, gamma - 1)[:, None], 1
                 )[:, 0]
-            # Roll the cache back to the committed prefix: stale K/V above
-            # it are masked out by the attention's index test and will be
-            # overwritten by the next chunk write at exactly this index.
+            next_tok = jnp.where(active, new_next, next_tok)
+            # Roll the cache back to each row's committed prefix: stale K/V
+            # above it are masked out by the attention's per-row index test
+            # and overwritten by the next chunk write at exactly this index.
             cache = dict(new_vars["cache"])
-            cache["index"] = cur_len + m
+            cache["index"] = cur_len + m_row
             return (
-                buf, cur_len + m, n_gen + m, cache, dcache, next_tok,
+                buf, cur_len + m_row, n_gen + m_row, cache, dcache, next_tok,
                 rounds + 1,
             )
 
+        cache0 = dict(vars_["cache"])
+        # Per-row cache indices from the start (prefill leaves a scalar).
+        cache0["index"] = jnp.full((b,), t0, jnp.int32)
         carry = (
-            buf, jnp.int32(t0), jnp.int32(0), dict(vars_["cache"]),
+            buf, jnp.full((b,), t0, jnp.int32), jnp.zeros((b,), jnp.int32),
+            cache0,
             dcache0 if dcache0 is not None else jnp.int32(0),
             next_tok, jnp.int32(0),
         )
@@ -388,7 +433,7 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             (b, (t0 if include_prompt else 0) + max_new_tokens),
         )
         if return_stats:
-            return out, {"rounds": rounds, "tokens": n_gen}
+            return out, {"rounds": rounds, "tokens": jnp.sum(n_gen)}
         return out
 
     return jax.jit(run)

@@ -406,6 +406,11 @@ class Block(nn.Module):
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
         idx = jnp.asarray(decode_index, jnp.int32)
+        if idx.ndim == 1 and self.sliding_cache:
+            raise ValueError(
+                "per-row decode indices are not supported with "
+                "sliding_cache — the ring buffer's slot math is lockstep"
+            )
         if self.sliding_cache:
             if t > 1 and not first_call:
                 raise ValueError(
@@ -450,7 +455,7 @@ class Block(nn.Module):
             cpos.value = cpos.value.at[:, slot].set(
                 jnp.broadcast_to(new_pos, (b, t)), mode="drop"
             )
-        else:
+        elif idx.ndim == 0:
             ck.value = cfg.constrain(
                 jax.lax.dynamic_update_slice(
                     ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
@@ -460,6 +465,25 @@ class Block(nn.Module):
             cv.value = cfg.constrain(
                 jax.lax.dynamic_update_slice(
                     cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
+                ),
+                cache_spec,
+            )
+        else:
+            # Per-row indices ([B]): each row writes its fresh K/V at its
+            # own positions — the ragged-prompt / per-row-speculative
+            # layout. mode='drop' guards rows whose positions run past the
+            # cache (they are masked out of the attention below anyway).
+            rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+            pos = idx[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+            ck.value = cfg.constrain(
+                ck.value.at[rows, pos].set(
+                    k.astype(ck.value.dtype), mode="drop"
+                ),
+                cache_spec,
+            )
+            cv.value = cfg.constrain(
+                cv.value.at[rows, pos].set(
+                    v.astype(cv.value.dtype), mode="drop"
                 ),
                 cache_spec,
             )
@@ -504,31 +528,38 @@ class Block(nn.Module):
             "bqhgd,bkhd->bhgqk", q5, ck.value,
             preferred_element_type=jnp.float32,
         ) * scale
-        qpos = idx + jnp.arange(t, dtype=jnp.int32)
         if self.sliding_cache:
             # Ring slots carry their absolute positions: valid = written,
             # causal, and inside the band OR a pinned sink (eviction
             # already guarantees the band bound for fully-warm caches; the
             # explicit check keeps partially-warm ones exact too).
+            # (Scalar idx only — per-row rejects above.)
+            qpos = idx + jnp.arange(t, dtype=jnp.int32)
             kpos = cpos.value[:, None, :]  # [B, 1, W]
             qp = qpos[None, :, None]  # [1, t, 1]
             band = (kpos > qp - self.window) | (kpos < sinks)
             valid = (kpos >= 0) & (kpos <= qp) & band
             valid = valid[:, None, None, :, :]  # [B, 1, 1, t, W]
         else:
+            # qpos is [Bq, t] with Bq ∈ {1, B}: a scalar index broadcasts
+            # one mask over the batch, per-row indices ([B]) carry a mask
+            # per row.
+            qpos = (
+                idx.reshape(1, 1) if idx.ndim == 0 else idx[:, None]
+            ) + jnp.arange(t, dtype=jnp.int32)[None, :]
             kpos = jnp.arange(self.max_decode_len, dtype=jnp.int32)
-            valid = kpos[None, :] <= qpos[:, None]
+            valid = kpos[None, None, :] <= qpos[:, :, None]  # [Bq, t, L]
             if self.window is not None:
                 # Sliding window over the cache: a query at qpos sees cache
                 # rows in (qpos − window, qpos] — plus the first `sinks`
                 # positions when streaming a densely-trained model
                 # (StreamingLLM; the full-history twin of the ring path,
                 # which the ring's exactness tests compare against).
-                keep = kpos[None, :] > qpos[:, None] - self.window
+                keep = kpos[None, None, :] > qpos[:, :, None] - self.window
                 if sinks:
-                    keep |= (kpos < sinks)[None, :]
+                    keep |= (kpos < sinks)[None, None, :]
                 valid &= keep
-            valid = valid[None, None, None, :, :]
+            valid = valid[:, None, None, :, :]  # [Bq, 1, 1, t, L]
         s = jnp.where(valid, s, attention_ops._BIG_NEG)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum(
@@ -644,10 +675,16 @@ class TransformerLM(nn.Module):
             idx_var = self.variable(
                 "cache", "index", lambda: jnp.zeros((), jnp.int32)
             )
+            # The cache index is a scalar (lockstep decode) or a [B] vector
+            # (per-row positions: ragged-prompt generation, per-row
+            # speculative acceptance). Callers switch layouts by writing the
+            # threaded cache['index'] entry between applies.
             decode_index = idx_var.value
-            positions = decode_index + jnp.broadcast_to(
-                jnp.arange(t, dtype=jnp.int32), (b, t)
-            )
+            offs = jnp.arange(t, dtype=jnp.int32)
+            if decode_index.ndim == 0:
+                positions = decode_index + jnp.broadcast_to(offs, (b, t))
+            else:
+                positions = decode_index[:, None] + offs[None, :]
             idx_var.value = decode_index + t
         elif segment_ids is None:
             positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
@@ -750,13 +787,12 @@ def param_specs(params, mesh: Mesh, extra_tp_dim: dict | None = None) -> dict:
         # a divisibility (or rank) failure otherwise. Adapters skip both
         # rule tables; the fsdp rule below still applies, with its own
         # divisibility check.
-        # Match the LoRAModel layout precisely ({'base', 'lora'} at the top,
-        # adapter leaves named 'a'/'b' — models/lora.py `init_adapters`), so
-        # a user model that merely CONTAINS a submodule named 'lora' still
-        # gets its kernels TP/EP-sharded.
-        is_lora = (
-            len(names) >= 2 and names[0] == "lora" and names[-1] in ("a", "b")
-        )
+        # Match the LoRAModel adapter layout precisely (a 'lora' subtree
+        # whose leaves are named 'a'/'b' — models/lora.py `init_adapters`),
+        # so a user model that merely CONTAINS a submodule named 'lora'
+        # still gets its kernels TP/EP-sharded, while a LoRAModel nested
+        # under any wrapper keeps the exemption.
+        is_lora = "lora" in names and names[-1:] in (["a"], ["b"])
         moe = next((n for n in names if n in moe_dims), None) if not is_lora else None
         if moe is not None:
             for dim, axis in moe_dims[moe].items():

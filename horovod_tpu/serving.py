@@ -1,0 +1,276 @@
+"""Generation serving bundles: export the compiled decode loop, reload it
+anywhere, serve it over HTTP.
+
+The reference's serving story is an export tail: rank 0 saves a SavedModel
+with a named predict signature "so that it can be served"
+(/root/reference/mnist_keras.py:116-140). `checkpoint.export_serving`
+covers that contract for classifiers; this module extends the same role to
+the flagship generation stack: the KV-cache prefill + `lax.scan` decode
+loop of `models/decoding.make_generate_fn` — greedy or
+temperature/top-k/top-p sampling, eos early-stop, ragged prompt lengths —
+is serialized **as one StableHLO program** via `jax.export`, with the
+weights in msgpack beside it and the byte-BPE tokenizer JSON riding along,
+so a serving host needs jax + this module, no flax model code and no
+training checkpoint.
+
+Bundle layout (``export_dir/<YYYYmmdd-HHMMSS>/`` — the reference's
+timestamped-directory convention):
+
+* ``generate.stablehlo`` — the exported program
+  ``(params, prompt [B, T0], rng, lengths [B]) -> tokens [B, new]``;
+* ``weights.msgpack``    — the param pytree (msgpack-restorable without a
+  template);
+* ``generate.json``      — shapes, sampling knobs, eos/pad ids, vocab;
+* ``tokenizer.json``     — optional `data.tokenizer.ByteBPETokenizer`.
+
+Ragged prompts are first-class: the program is compiled for one
+``[batch_size, prompt_len]`` shape, and per-request prompts of any length
+≤ ``prompt_len`` are right-padded server-side with per-row true lengths
+passed through — each row generates exactly as if alone at its own length
+(models/decoding.py ragged contract), so clients never see the static
+shape.
+
+Serve with ``python -m horovod_tpu.launch.serve <bundle_dir>`` — the
+server routes ``/v1/generate`` for these bundles (launch/serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+import jax
+import numpy as np
+from flax import serialization
+
+GEN_GRAPH_FILE = "generate.stablehlo"
+GEN_META_FILE = "generate.json"
+GEN_WEIGHTS_FILE = "weights.msgpack"
+TOKENIZER_FILE = "tokenizer.json"
+
+
+def export_generate(
+    export_dir: str,
+    model,
+    params,
+    *,
+    batch_size: int,
+    prompt_len: int,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    tokenizer=None,
+    timestamp: str | None = None,
+) -> str:
+    """Export a generation bundle into ``export_dir/<stamp>/``.
+
+    ``model`` is the *training* `TransformerLM` (or any module
+    `make_generate_fn` accepts); ``params`` its plain param pytree —
+    host-gather sharded params first (`checkpoint.export_serving` shows the
+    workflow). ``tokenizer`` is a `ByteBPETokenizer`, a path to a saved
+    tokenizer JSON, or None (token-id-only serving).
+
+    The exported program takes params as an ARGUMENT (not baked-in
+    constants): the graph stays small, and the weights live once, in
+    msgpack. Sampling knobs are compile-time (they shape the program);
+    the rng seed and prompts are runtime inputs.
+    """
+    from horovod_tpu.models.decoding import make_generate_fn
+
+    if prompt_len < 1 or batch_size < 1:
+        raise ValueError(
+            f"batch_size ({batch_size}) and prompt_len ({prompt_len}) "
+            "must be >= 1"
+        )
+    stamp = timestamp or time.strftime("%Y%m%d-%H%M%S")
+    out_dir = os.path.join(export_dir, stamp)
+    os.makedirs(out_dir, exist_ok=True)
+
+    fn = make_generate_fn(
+        model,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
+        eos_id=eos_id,
+        include_prompt=False,
+    )
+    from jax import export as jax_export
+
+    params = jax.device_get(params)
+    param_specs = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        params,
+    )
+    prompt_spec = jax.ShapeDtypeStruct((batch_size, prompt_len), np.int32)
+    rng_spec = jax.ShapeDtypeStruct(
+        np.shape(jax.random.PRNGKey(0)),
+        np.asarray(jax.random.PRNGKey(0)).dtype,
+    )
+    lengths_spec = jax.ShapeDtypeStruct((batch_size,), np.int32)
+    exported = jax_export.export(fn)(
+        param_specs, prompt_spec, rng_spec, lengths_spec
+    )
+    from horovod_tpu.checkpoint import _atomic_write
+
+    _atomic_write(os.path.join(out_dir, GEN_GRAPH_FILE), exported.serialize())
+    _atomic_write(
+        os.path.join(out_dir, GEN_WEIGHTS_FILE),
+        serialization.to_bytes(params),
+    )
+    meta = {
+        "kind": "generate",
+        "batch_size": batch_size,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "temperature": temperature,
+        "top_k": top_k,
+        "top_p": top_p,
+        "eos_id": eos_id,
+        "pad_id": pad_id,
+        "has_tokenizer": tokenizer is not None,
+        "created": stamp,
+    }
+    # Tokenizer BEFORE the meta that advertises it: a crash between the two
+    # writes leaves a bundle whose meta under-promises, never one that lies.
+    if tokenizer is not None:
+        tok_path = os.path.join(out_dir, TOKENIZER_FILE)
+        if isinstance(tokenizer, str):
+            shutil.copyfile(tokenizer, tok_path)
+        else:
+            tokenizer.save(tok_path)
+    _atomic_write(
+        os.path.join(out_dir, GEN_META_FILE),
+        json.dumps(meta, indent=2).encode(),
+    )
+    return out_dir
+
+
+def is_generate_bundle(bundle_dir: str) -> bool:
+    return os.path.exists(os.path.join(bundle_dir, GEN_META_FILE))
+
+
+class GenerateBundle:
+    """A reloaded generation bundle: tokenize → pad → run → trim → detok.
+
+    ``generate_tokens(prompts, seed)`` takes a list of token-id sequences
+    (each of length 1..prompt_len); requests of any row count are split /
+    padded to the compiled batch internally. ``generate_text(texts, seed)``
+    adds the tokenizer round-trip (requires the bundle to carry one).
+    Generations are trimmed at ``eos_id`` when the bundle was exported
+    with one.
+    """
+
+    def __init__(self, bundle_dir: str):
+        from jax import export as jax_export
+
+        self.bundle_dir = bundle_dir
+        with open(os.path.join(bundle_dir, GEN_META_FILE)) as f:
+            self.meta = json.load(f)
+        if self.meta.get("kind") != "generate":
+            raise ValueError(f"{bundle_dir} is not a generation bundle")
+        with open(os.path.join(bundle_dir, GEN_GRAPH_FILE), "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        with open(os.path.join(bundle_dir, GEN_WEIGHTS_FILE), "rb") as f:
+            self._params = serialization.msgpack_restore(f.read())
+        self.tokenizer = None
+        tok_path = os.path.join(bundle_dir, TOKENIZER_FILE)
+        if os.path.exists(tok_path):
+            from horovod_tpu.data.tokenizer import ByteBPETokenizer
+
+            self.tokenizer = ByteBPETokenizer.load(tok_path)
+        elif self.meta.get("has_tokenizer"):
+            # Fail fast on an inconsistent bundle (tokenizer.json lost in
+            # transfer) instead of silently degrading to token-id-only
+            # serving while /healthz advertises a tokenizer.
+            raise FileNotFoundError(
+                f"{bundle_dir} advertises a tokenizer "
+                f"(generate.json has_tokenizer=true) but {TOKENIZER_FILE} "
+                "is missing — the bundle is incomplete"
+            )
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.meta["batch_size"])
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.meta["prompt_len"])
+
+    def _run(self, padded: np.ndarray, lengths: np.ndarray, seed: int,
+             chunk: int = 0):
+        # Chunk 0 uses PRNGKey(seed) verbatim — the documented parity
+        # contract with a local `fn(params, prompt, PRNGKey(seed), lens)`
+        # call. Later chunks of an over-batch-size request fold the chunk
+        # index in so sampled generations don't repeat across chunks.
+        rng = jax.random.PRNGKey(seed)
+        if chunk:
+            rng = jax.random.fold_in(rng, chunk)
+        return np.asarray(
+            self._exported.call(
+                self._params,
+                padded.astype(np.int32),
+                rng,
+                lengths.astype(np.int32),
+            )
+        )
+
+    def generate_tokens(self, prompts, seed: int = 0) -> list:
+        """``prompts``: list of token-id sequences → list of generated-id
+        lists (prompt not included; trimmed at eos when configured)."""
+        b, t0 = self.batch_size, self.prompt_len
+        prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+        if not prompts:
+            return []
+        for i, p in enumerate(prompts):
+            if not 1 <= len(p) <= t0:
+                raise ValueError(
+                    f"prompt {i} has {len(p)} tokens; this bundle serves "
+                    f"prompts of 1..{t0} tokens"
+                )
+        pad = int(self.meta.get("pad_id") or 0)
+        out: list = []
+        for ci, start in enumerate(range(0, len(prompts), b)):
+            chunk = prompts[start : start + b]
+            n = len(chunk)
+            padded = np.full((b, t0), pad, np.int32)
+            lengths = np.ones((b,), np.int32)
+            for i, p in enumerate(chunk):
+                padded[i, : len(p)] = p
+                lengths[i] = len(p)
+            gen = self._run(padded, lengths, seed, chunk=ci)[:n]
+            out.extend(self._trim(row) for row in gen)
+        return out
+
+    def _trim(self, row: np.ndarray) -> list:
+        eos = self.meta.get("eos_id")
+        row = [int(t) for t in row]
+        if eos is None:
+            return row
+        return row[: row.index(eos)] if eos in row else row
+
+    def generate_text(self, texts, seed: int = 0) -> list:
+        if self.tokenizer is None:
+            raise ValueError(
+                "this bundle has no tokenizer.json — export with "
+                "tokenizer=... or POST token ids to /v1/generate instead"
+            )
+        prompts = [self.tokenizer.encode(t) for t in texts]
+        for i, p in enumerate(prompts):
+            if len(p) > self.prompt_len:
+                raise ValueError(
+                    f"text {i} tokenizes to {len(p)} tokens; this bundle "
+                    f"serves prompts of up to {self.prompt_len} tokens"
+                )
+        gen = self.generate_tokens(prompts, seed=seed)
+        return [self.tokenizer.decode(g) for g in gen]
+
+
+def load_generate(bundle_dir: str) -> GenerateBundle:
+    """Reload an `export_generate` bundle."""
+    return GenerateBundle(bundle_dir)

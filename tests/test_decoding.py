@@ -587,3 +587,102 @@ class TestAttentionSinks:
         )
         with pytest.raises(ValueError, match="sink-unaware"):
             model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32))
+
+
+class TestRaggedPrompts:
+    """fn(params, prompt, rng, lengths): mixed prompt lengths in one batch,
+    each row generating exactly as if alone at its own length."""
+
+    def test_each_row_matches_its_solo_generation(self):
+        model = _model()
+        params = _params(model)
+        rng = np.random.RandomState(0)
+        t0 = 8
+        lens = np.array([3, 8, 5], np.int32)
+        rows = [rng.randint(1, VOCAB, size=(L,)).astype(np.int32) for L in lens]
+        padded = np.zeros((3, t0), np.int32)
+        for i, r in enumerate(rows):
+            padded[i, : lens[i]] = r
+        fn = make_generate_fn(model, max_new_tokens=6, include_prompt=False)
+        key = jax.random.PRNGKey(0)
+        got = np.asarray(fn(params, jnp.asarray(padded), key, jnp.asarray(lens)))
+        for i, r in enumerate(rows):
+            solo = np.asarray(
+                fn(params, jnp.asarray(r[None, :]), key)
+            )
+            np.testing.assert_array_equal(got[i], solo[0], err_msg=f"row {i}")
+
+    def test_full_lengths_match_legacy_path(self):
+        model = _model()
+        params = _params(model)
+        prompt = np.array([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+        fn = make_generate_fn(model, max_new_tokens=7)
+        key = jax.random.PRNGKey(1)
+        legacy = np.asarray(fn(params, jnp.asarray(prompt), key))
+        ragged = np.asarray(
+            fn(params, jnp.asarray(prompt), key,
+               jnp.full((2,), prompt.shape[1], jnp.int32))
+        )
+        np.testing.assert_array_equal(ragged, legacy)
+
+    def test_pad_content_is_irrelevant(self):
+        # Whatever garbage sits in the padding must not leak into any row's
+        # generation — the core correctness claim of the ragged layout.
+        model = _model()
+        params = _params(model)
+        lens = jnp.array([4, 6], jnp.int32)
+        base = np.array(
+            [[5, 3, 7, 2, 0, 0, 0, 0], [1, 9, 8, 4, 2, 6, 0, 0]], np.int32
+        )
+        noisy = base.copy()
+        noisy[0, 4:] = [11, 13, 17, 19]
+        noisy[1, 6:] = [23, 29]
+        fn = make_generate_fn(model, max_new_tokens=5, include_prompt=False)
+        key = jax.random.PRNGKey(2)
+        a = np.asarray(fn(params, jnp.asarray(base), key, lens))
+        b = np.asarray(fn(params, jnp.asarray(noisy), key, lens))
+        np.testing.assert_array_equal(a, b)
+
+    def test_sampled_ragged_stays_in_vocab(self):
+        model = _model()
+        params = _params(model)
+        lens = jnp.array([2, 7], jnp.int32)
+        prompt = np.array(
+            [[5, 3, 0, 0, 0, 0, 0, 0], [1, 9, 8, 4, 2, 6, 3, 0]], np.int32
+        )
+        fn = make_generate_fn(
+            model, max_new_tokens=8, temperature=0.8, top_k=8,
+            include_prompt=False,
+        )
+        out = np.asarray(
+            fn(params, jnp.asarray(prompt), jax.random.PRNGKey(3), lens)
+        )
+        assert out.shape == (2, 8)
+        assert (out >= 0).all() and (out < VOCAB).all()
+
+    def test_gqa_ragged_matches_solo(self):
+        model = _model(n_heads=4, n_kv_heads=2)
+        params = _params(model)
+        lens = np.array([3, 6], np.int32)
+        rng = np.random.RandomState(4)
+        padded = np.zeros((2, 6), np.int32)
+        rows = []
+        for i, L in enumerate(lens):
+            r = rng.randint(1, VOCAB, size=(L,)).astype(np.int32)
+            rows.append(r)
+            padded[i, :L] = r
+        fn = make_generate_fn(model, max_new_tokens=5, include_prompt=False)
+        key = jax.random.PRNGKey(0)
+        got = np.asarray(fn(params, jnp.asarray(padded), key, jnp.asarray(lens)))
+        for i, r in enumerate(rows):
+            solo = np.asarray(fn(params, jnp.asarray(r[None, :]), key))
+            np.testing.assert_array_equal(got[i], solo[0], err_msg=f"row {i}")
+
+    def test_sliding_cache_rejects_ragged(self):
+        model = _model(window=4, sliding_cache=True)
+        params = _params(model)
+        prompt = np.zeros((2, 6), np.int32)
+        fn = make_generate_fn(model, max_new_tokens=4)
+        with pytest.raises(ValueError, match="per-row"):
+            fn(params, jnp.asarray(prompt), jax.random.PRNGKey(0),
+               jnp.array([3, 6], jnp.int32))

@@ -246,6 +246,35 @@ class TestLoRAWithTP:
         # The base kernels must still carry TP shardings.
         assert any("model" in axes(s) for _, s in base_specs)
 
+    def test_nested_lora_model_keeps_adapter_exemption(self):
+        # A LoRAModel nested under a wrapper module: adapter paths start
+        # with the wrapper's name, not 'lora' — the exemption must key on
+        # the 'lora' subtree + 'a'/'b' leaves, not on path position.
+        class Wrap(nn.Module):
+            inner: nn.Module
+
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return self.inner(x, train=train)
+
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        model = Wrap(inner=LoRAModel(inner=_lm(), rank=3, name="peft"))
+        x, _ = _data()
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        specs = param_specs(params, mesh)  # rank 3 % model 2 != 0: must
+        # not raise, and no adapter leaf may carry the model axis.
+        flat = jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda s: isinstance(s, P)
+        )[0]
+        for path, s in flat:
+            names = [
+                p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+            ]
+            if names[-1] in ("a", "b"):
+                assert "model" not in [ax for ax in s if ax is not None], (
+                    names, s
+                )
+
     def test_submodule_named_lora_still_tp_sharded(self):
         # A user model that merely CONTAINS a submodule named 'lora' is not
         # the LoRAModel layout — its kernels must still get TP shardings.

@@ -164,6 +164,55 @@ class TestSpeedup:
         )
 
 
+class TestPerRowAdvance:
+    """Batch rows advance by their OWN acceptance (per-row cache indices):
+    the batch finishes in exactly as many rounds as its slowest row would
+    alone — no lockstep row-minimum degradation."""
+
+    def _solo_rounds(self, fn, params, prompt_row):
+        _, stats = fn(params, prompt_row[None, :])
+        return int(stats["rounds"])
+
+    def test_batched_rounds_equal_slowest_solo_row(self):
+        model = _model()
+        params = _params(model)
+        rng = np.random.RandomState(17)
+        # Rows with very different draftability: self-repetitive (ngram
+        # lookup drafts well) vs random (drafts badly).
+        repetitive = np.tile(np.array([4, 7, 2], np.int32), 4)  # len 12
+        random_row = rng.randint(1, VOCAB, size=(12,)).astype(np.int32)
+        fn = make_speculative_fn(
+            model, max_new_tokens=12, gamma=4, return_stats=True
+        )
+        solo = [
+            self._solo_rounds(fn, params, jnp.asarray(r))
+            for r in (repetitive, random_row)
+        ]
+        batch = jnp.asarray(np.stack([repetitive, random_row]))
+        got, stats = fn(params, batch)
+        # Exactness at batch 2 (each row == its solo generation).
+        want = generate(model, params, batch, 12)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(stats["rounds"]) == max(solo), (
+            f"batched rounds {int(stats['rounds'])} != slowest solo row "
+            f"{max(solo)} — per-row advance regressed toward lockstep"
+        )
+
+    def test_tokens_stat_is_total_committed(self):
+        model = _model()
+        params = _params(model)
+        prompt = jnp.asarray(
+            np.random.RandomState(23).randint(1, VOCAB, size=(3, 8)),
+            jnp.int32,
+        )
+        fn = make_speculative_fn(
+            model, max_new_tokens=10, gamma=3, return_stats=True
+        )
+        _, stats = fn(params, prompt)
+        # Clamped per-row advance commits exactly max_new_tokens per row.
+        assert int(stats["tokens"]) == 3 * 10
+
+
 class TestMoERejected:
     def test_moe_model_rejected(self):
         """MoE capacity binds per call group: a chunked verify forward can
