@@ -36,6 +36,8 @@ Modes (BENCH_MODEL):
               greedy vs speculative (prompt-lookup draft) on copy prompts —
               exact-output speedup + acceptance rate
   input       host input pipeline A/B: native C++ batch assembly vs Python
+  serve       HTTP serving A/B: coalescing queue vs serialized requests —
+              requests/sec through the real server (launch/serve.py)
 
 HVT_PROFILE=<dir> captures a jax.profiler trace of the measured loop.
 """
@@ -680,6 +682,106 @@ def bench_spec() -> dict:
     }
 
 
+def bench_serve() -> dict:
+    """HTTP serving A/B: coalescing queue vs serialized requests.
+
+    Spins up the real server (launch/serve.py) over a small predict
+    bundle, fires BENCH_SERVE_CLIENTS concurrent single-row clients for a
+    fixed request count, and measures requests/sec with the coalescing
+    worker on and off. The device call is the real exported program; the
+    win is shared dispatches (ceil(N/batch) instead of N), which matters
+    exactly when per-call latency (tunnel RTT / dispatch overhead)
+    dominates tiny-model compute.
+    """
+    import tempfile
+    import threading
+    import urllib.request
+
+    import flax.linen as nn
+    import jax
+    import numpy as np
+
+    from horovod_tpu import checkpoint
+    from horovod_tpu.launch.serve import make_server
+
+    batch = int(os.environ.get("BENCH_SERVE_BATCH", 8))
+    dim = int(os.environ.get("BENCH_SERVE_DIM", 64))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 200))
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(10)(nn.relu(nn.Dense(128)(x)))
+
+    model = Tiny()
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((batch, dim), np.float32)
+    )["params"]
+    tmp = tempfile.mkdtemp(prefix="hvt-bench-serve-")
+    bundle = checkpoint.export_serving(
+        tmp,
+        lambda p, x: model.apply({"params": p}, x),
+        params,
+        input_shape=(batch, dim),
+    )
+
+    def measure(coalesce: bool) -> tuple:
+        srv = make_server(bundle, port=0, coalesce=coalesce)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{srv.server_address[1]}/v1/predict"
+        row = json.dumps(
+            {"input": np.random.RandomState(0).randn(1, dim).tolist()}
+        ).encode()
+
+        def one():
+            req = urllib.request.Request(
+                url, data=row, headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req) as r:
+                r.read()
+
+        one()  # warm the compiled call
+        remaining = [n_requests]
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    if remaining[0] <= 0:
+                        return
+                    remaining[0] -= 1
+                one()
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client) for _ in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        calls = srv.app.stats["device_calls"]
+        srv.shutdown()
+        return n_requests / elapsed, calls
+
+    rps_serial, calls_serial = measure(coalesce=False)
+    rps_coalesce, calls_coalesce = measure(coalesce=True)
+    return {
+        "metric": "serve_requests_per_sec",
+        "value": round(rps_coalesce, 1),
+        "unit": "requests/sec",
+        "serialized_requests_per_sec": round(rps_serial, 1),
+        "speedup": round(rps_coalesce / rps_serial, 2),
+        "device_calls_coalesced": calls_coalesce,
+        "device_calls_serialized": calls_serial,
+        "clients": n_clients,
+        "requests": n_requests,
+        "batch": batch,
+    }
+
+
 def bench_input() -> dict:
     """Host input-pipeline A/B: native C++ batch assembly vs pure Python.
 
@@ -753,6 +855,8 @@ def main() -> None:
     which = os.environ.get("BENCH_MODEL", "mnist")
     if which == "input":
         result = bench_input()
+    elif which == "serve":
+        result = bench_serve()
     elif which == "decode":
         result = bench_decode()
     elif which == "spec":

@@ -499,6 +499,63 @@ def _host_syncable(leaf) -> bool:
     )
 
 
+def gather_to_host(tree: PyTree) -> PyTree:
+    """Assemble every leaf's full GLOBAL value as host numpy arrays — the
+    export-from-model-parallel-state bridge.
+
+    Single-process-visible leaves (host arrays, process-local device
+    arrays, fully-replicated global arrays, single-host TP/FSDP layouts)
+    are a plain ``device_get``. Leaves sharded ACROSS processes
+    (multi-host TP/FSDP/pipeline layouts) make this a **collective**:
+    every process must call it. Each contributes the shard pieces it owns
+    (``replica_id == 0`` — `save_sharded`'s dedup) over one fused
+    host-level allgather, and every process reassembles the global arrays
+    with the sharded-checkpoint piece-tiling machinery (`_assemble_global`)
+    — the in-memory twin of a ``save_sharded → restore_sharded
+    (reshard=True)`` roundtrip, no disk involved. Costs one host-RAM copy
+    of the tree per process; a tree too large to assemble on one host
+    cannot be exported as a single-device program — shard-and-serve is the
+    workflow (`save_sharded` + a resharded restore on the serving fleet).
+    """
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [l for _, l in paths_and_leaves]
+    cross = {
+        i for i, l in enumerate(leaves)
+        if isinstance(l, jax.Array) and not _host_syncable(l)
+    }
+    if not cross:
+        return jax.device_get(tree)
+    payload = {}
+    meta = {}
+    for i in cross:
+        leaf = leaves[i]
+        meta[i] = (tuple(leaf.shape), np.dtype(leaf.dtype))
+        for sh in leaf.addressable_shards:
+            if sh.replica_id == 0:
+                payload[f"{i}|{_fmt_index(sh.index, leaf.shape)}"] = (
+                    np.asarray(sh.data)
+                )
+    store: dict = {}
+    for part in collectives.allgather_object(payload):
+        store.update(part)
+    try:
+        out = [
+            _assemble_global(store, i, *meta[i]) if i in cross
+            else jax.device_get(leaf)
+            for i, leaf in enumerate(leaves)
+        ]
+    except MemoryError as e:
+        raise MemoryError(
+            "gather_to_host could not assemble the full model on this "
+            "host — a model that large cannot be exported as a "
+            "single-device serving program. Workflow: save_sharded(dir, "
+            "state) from every training process, then restore_sharded("
+            "dir, template, reshard=True) onto the serving fleet's own "
+            "mesh."
+        ) from e
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def broadcast_parameters(tree: PyTree, root_rank: int = 0, mesh=None) -> PyTree:
     """``hvd.broadcast_global_variables(0)`` equivalent for any pytree:
     every process adopts the root's values; with ``mesh`` given,
@@ -632,9 +689,30 @@ def export_serving(
         dim), loadable by any standard TF Serving stack — byte-for-role
         parity with the reference's SavedModelBuilder export. Requires
         TensorFlow importable.
+
+    **Model-parallel state**: params sharded within one process (TP/FSDP
+    on a single-host mesh) export transparently. Params sharded ACROSS
+    processes (multi-host TP/FSDP, pipeline stages) make this a
+    collective: EVERY process must call export_serving (drop the
+    is_primary gate); the shards are host-gathered (`gather_to_host`),
+    the primary writes the bundle, and non-primaries return None.
     """
     stamp = timestamp or time.strftime("%Y%m%d-%H%M%S")
     out_dir = os.path.join(export_dir, stamp)
+
+    if is_cross_process_sharded(params):
+        params = gather_to_host(params)  # collective — see docstring
+        if not runtime.is_primary():
+            return None
+    else:
+        # Single-process shardings (TP/FSDP on one host) assemble here.
+        params = jax.device_get(params)
+    # Re-materialize as (single-device) jax arrays: apply_fns that index
+    # params directly (e.g. PipelinedLM's embed[tokens]) would otherwise
+    # hit numpy's __getitem__ with a tracer.
+    import jax.numpy as jnp
+
+    params = jax.tree.map(jnp.asarray, params)
 
     def predict(x):
         return jax.nn.softmax(apply_fn(params, x), axis=-1)
@@ -656,7 +734,7 @@ def export_serving(
     with open(os.path.join(out_dir, GRAPH_FILE), "wb") as f:
         f.write(exported.serialize())
     with open(os.path.join(out_dir, WEIGHTS_FILE), "wb") as f:
-        f.write(serialization.to_bytes(jax.device_get(params)))
+        f.write(serialization.to_bytes(params))
     with open(os.path.join(out_dir, SIGNATURE_FILE), "w") as f:
         json.dump(
             {

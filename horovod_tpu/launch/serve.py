@@ -25,9 +25,18 @@ Batching: the exported program is compiled for ONE batch shape (static
 shapes are the deal with XLA). Requests of any row count are padded up /
 split to the bundle's batch size server-side — and generation prompts of
 any length ≤ the compiled prompt_len ride the ragged-lengths path — so
-clients never see the static-shape constraint. The compiled callable is locked — requests
-serialize through the device; concurrency comes from the accelerator
-being fast, not from re-entrancy.
+clients never see the static-shape constraint.
+
+Concurrency: one device worker drains a **coalescing queue** — rows from
+concurrent requests are packed together into the compiled batch shape, so
+N simultaneous single-row clients cost ~ceil(N/batch) device dispatches
+instead of N (measured ~batch× requests/sec at saturation; bench.py's
+serve row). Handler threads only enqueue and wait; the device callable
+never runs re-entrantly. Sampled generation bundles (temperature > 0) are
+the exception: each request owns its rng seed for the whole compiled
+call, so they serialize per-request through the worker instead of mixing
+rows from different seeds (``app.stats['device_calls']`` exposes the
+dispatch count either way).
 
 Run:  ``python -m horovod_tpu.launch.serve <bundle_dir> [--port 8000]``
 (or `serve_forever(bundle_dir, port)` programmatically; tests use
@@ -37,10 +46,80 @@ Run:  ``python -m horovod_tpu.launch.serve <bundle_dir> [--port 8000]``
 from __future__ import annotations
 
 import json
+import queue as queue_lib
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
+
+
+class _Slot:
+    """One request row's rendezvous with the device worker."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+
+    def set(self, value):
+        self.value = value
+        self.event.set()
+
+    def set_err(self, e):
+        self.error = e
+        self.event.set()
+
+    def get(self):
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class _Batcher:
+    """The coalescing device worker.
+
+    Handler threads `submit` lists of row-items and block; the single
+    worker thread drains the queue, packs up to ``batch`` rows — across
+    requests — into one device call, and distributes per-row results.
+    ``run_rows(items) -> results`` is the only code that touches the
+    device, so the compiled callable never runs re-entrantly and the old
+    global lock is gone.
+    """
+
+    def __init__(self, run_rows, batch: int, stats: dict):
+        self.run_rows = run_rows
+        self.batch = batch
+        self.stats = stats
+        self.q: queue_lib.Queue = queue_lib.Queue()
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def submit(self, items: list) -> list:
+        slots = [_Slot() for _ in items]
+        for it, s in zip(items, slots):
+            self.q.put((it, s))
+        return [s.get() for s in slots]
+
+    def _loop(self):
+        while True:
+            group = [self.q.get()]
+            while len(group) < self.batch:
+                try:
+                    group.append(self.q.get_nowait())
+                except queue_lib.Empty:
+                    break
+            self.stats["device_calls"] += 1
+            self.stats["rows"] += len(group)
+            try:
+                results = self.run_rows([it for it, _ in group])
+                for (_, s), r in zip(group, results):
+                    s.set(r)
+            except Exception as e:
+                for _, s in group:
+                    s.set_err(e)
 
 
 class _ModelApp:
@@ -48,7 +127,7 @@ class _ModelApp:
 
     kind = "predict"
 
-    def __init__(self, bundle_dir: str):
+    def __init__(self, bundle_dir: str, coalesce: bool = True):
         from horovod_tpu import checkpoint
 
         self.bundle_dir = bundle_dir
@@ -59,7 +138,23 @@ class _ModelApp:
         self.batch = int(spec["shape"][0])
         self.row_shape = tuple(int(d) for d in spec["shape"][1:])
         self.dtype = np.dtype(spec["dtype"])
-        self._lock = threading.Lock()
+        self.stats = {"device_calls": 0, "rows": 0}
+        # coalesce=False keeps the legacy serialize-whole-requests path —
+        # the bench's before/after baseline (bench.py serve row).
+        self._lock = None if coalesce else threading.Lock()
+        self._batcher = (
+            _Batcher(self._run_rows, self.batch, self.stats)
+            if coalesce else None
+        )
+
+    def _run_rows(self, rows: list) -> list:
+        chunk = np.stack(rows)
+        n = len(chunk)
+        if n < self.batch:  # pad to the compiled shape
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[-1:], self.batch - n, 0)]
+            )
+        return list(np.asarray(self.fn(chunk))[:n])
 
     def predict(self, rows: np.ndarray) -> np.ndarray:
         if rows.ndim != 1 + len(self.row_shape) or (
@@ -70,25 +165,29 @@ class _ModelApp:
                 f"got {rows.shape}"
             )
         rows = rows.astype(self.dtype)
+        if self._batcher is not None:
+            return np.stack(self._batcher.submit(list(rows)))
         out = []
         with self._lock:
             for start in range(0, len(rows), self.batch):
-                chunk = rows[start : start + self.batch]
-                n = len(chunk)
-                if n < self.batch:  # pad to the compiled shape
-                    chunk = np.concatenate(
-                        [chunk, np.repeat(chunk[-1:], self.batch - n, 0)]
-                    )
-                out.append(np.asarray(self.fn(chunk))[:n])
-        return np.concatenate(out)
+                self.stats["device_calls"] += 1
+                self.stats["rows"] += len(rows[start : start + self.batch])
+                out.append(self._run_rows(list(rows[start : start + self.batch])))
+        return np.concatenate([np.stack(o) for o in out])
 
 
 class _GenerateApp:
-    """A generation bundle behind the same lock discipline."""
+    """A generation bundle behind the coalescing worker.
+
+    Greedy bundles (temperature == 0: the rng is dead code in the exported
+    program) coalesce rows across concurrent requests exactly like predict
+    bundles. Sampled bundles serialize whole requests: the rng seed is a
+    per-CALL input, so rows from different seeds cannot share a dispatch.
+    """
 
     kind = "generate"
 
-    def __init__(self, bundle_dir: str):
+    def __init__(self, bundle_dir: str, coalesce: bool = True):
         from horovod_tpu import serving
 
         self.bundle_dir = bundle_dir
@@ -103,7 +202,17 @@ class _GenerateApp:
             "outputs": {"tokens": {}},
             "meta": self.bundle.meta,
         }
+        self.stats = {"device_calls": 0, "rows": 0}
+        greedy = float(self.bundle.meta.get("temperature", 0.0)) == 0.0
         self._lock = threading.Lock()
+        self._batcher = (
+            _Batcher(
+                lambda rows: self.bundle.generate_batch(rows),
+                self.bundle.batch_size,
+                self.stats,
+            )
+            if (coalesce and greedy) else None
+        )
 
     def generate(self, payload: dict) -> dict:
         seed = int(payload.get("seed", 0))
@@ -124,26 +233,38 @@ class _GenerateApp:
             prompts = [self.bundle.tokenizer.encode(t) for t in texts]
         else:
             prompts = payload["prompt"]
-        with self._lock:
-            tokens = self.bundle.generate_tokens(prompts, seed=seed)
+        if self._batcher is not None:
+            # Validate on the handler thread; rows coalesce across
+            # requests (greedy: the seed is dead code in the program).
+            rows = self.bundle.validate_prompts(prompts)
+            tokens = self._batcher.submit(rows) if rows else []
+        else:
+            with self._lock:
+                self.stats["device_calls"] += max(
+                    1, -(-len(prompts) // self.bundle.batch_size)
+                )
+                self.stats["rows"] += len(prompts)
+                tokens = self.bundle.generate_tokens(prompts, seed=seed)
         out = {"tokens": tokens}
         if self.bundle.tokenizer is not None:
             out["text"] = [self.bundle.tokenizer.decode(g) for g in tokens]
         return out
 
 
-def _make_app(bundle_dir: str):
+def _make_app(bundle_dir: str, coalesce: bool = True):
     from horovod_tpu import serving
 
     if serving.is_generate_bundle(bundle_dir):
-        return _GenerateApp(bundle_dir)
-    return _ModelApp(bundle_dir)
+        return _GenerateApp(bundle_dir, coalesce=coalesce)
+    return _ModelApp(bundle_dir, coalesce=coalesce)
 
 
-def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1"):
+def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
+                coalesce: bool = True):
     """Build (but don't start) the HTTP server; ``server.server_address``
-    carries the bound port when ``port=0``."""
-    app = _make_app(bundle_dir)
+    carries the bound port when ``port=0``. ``coalesce=False`` keeps the
+    legacy serialize-whole-requests path (the bench baseline)."""
+    app = _make_app(bundle_dir, coalesce=coalesce)
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):
@@ -161,7 +282,8 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1"):
             if self.path == "/healthz":
                 self._send(
                     200, {"status": "ok", "bundle": app.bundle_dir,
-                          "kind": app.kind, "signature": app.signature}
+                          "kind": app.kind, "signature": app.signature,
+                          "stats": dict(app.stats)}
                 )
             else:
                 self._send(404, {"error": f"no route {self.path}"})

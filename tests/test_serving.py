@@ -271,3 +271,98 @@ class TestBundleIntegrity:
         (broken / "tokenizer.json").unlink()
         with pytest.raises(FileNotFoundError, match="incomplete"):
             serving.load_generate(str(broken))
+
+
+class TestExportFromShardedParams:
+    def test_generate_bundle_from_tp_sharded_params(self, tmp_path):
+        # A TP/FSDP-trained model must export its decode bundle without
+        # manual resharding (single-host layout: device_get assembles).
+        from horovod_tpu.models.transformer import param_specs
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        model = TransformerLM(
+            vocab_size=32, d_model=32, n_heads=4, n_layers=2, dropout=0.0
+        )
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, T0), jnp.int32)
+        )["params"]
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        sharded = jax.device_put(
+            params,
+            jax.tree.map(
+                lambda s: jax.sharding.NamedSharding(mesh, s),
+                param_specs(params, mesh),
+                is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
+            ),
+        )
+        out = serving.export_generate(
+            str(tmp_path), model, sharded,
+            batch_size=2, prompt_len=T0, max_new_tokens=NEW,
+        )
+        b = serving.load_generate(out)
+        prompts = [[3, 1, 4, 1], [9, 2]]
+        got = b.generate_tokens(prompts)
+        fn = make_generate_fn(model, max_new_tokens=NEW, include_prompt=False)
+        padded = np.zeros((2, T0), np.int32)
+        padded[0, :4] = prompts[0]
+        padded[1, :2] = prompts[1]
+        want = np.asarray(
+            fn(params, jnp.asarray(padded), jax.random.PRNGKey(0),
+               jnp.array([4, 2], jnp.int32))
+        )
+        for i in range(2):
+            np.testing.assert_array_equal(got[i], want[i], err_msg=f"row {i}")
+
+
+class TestGenerateCoalescing:
+    def test_concurrent_greedy_requests_coalesce(self, bundle_dir, lm):
+        import threading as th
+        import time
+
+        model, params = lm
+        srv = make_server(bundle_dir, port=0)
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            app = srv.app
+            real = app.bundle._run
+
+            def slow_run(*a, **kw):  # hold the device; queue builds
+                time.sleep(0.15)
+                return real(*a, **kw)
+
+            app.bundle._run = slow_run
+            prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [7, 7], [5], [2, 4], [8]]
+            results = [None] * len(prompts)
+            errors = []
+
+            def client(i):
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{srv.server_address[1]}/v1/generate",
+                        data=json.dumps({"prompt": [prompts[i]]}).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req) as r:
+                        results[i] = json.loads(r.read())["tokens"][0]
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [
+                th.Thread(target=client, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for c in threads:
+                c.start()
+            for c in threads:
+                c.join(timeout=60)
+            assert not errors, errors
+            want = _local_ragged(model, params, prompts)
+            for i in range(len(prompts)):
+                np.testing.assert_array_equal(
+                    results[i], want[i], err_msg=f"row {i}"
+                )
+            assert app.stats["rows"] == len(prompts)
+            assert app.stats["device_calls"] < len(prompts), app.stats
+        finally:
+            srv.shutdown()
