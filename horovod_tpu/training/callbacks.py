@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import time
 
@@ -348,11 +349,17 @@ class ExponentialMovingAverage(Callback):
             trainer.evaluate(x_test, y_test)
 
     Durability: pass ``checkpoint_dir`` to persist the shadow alongside the
-    model checkpoints (primary-written ``ema.msgpack``, atomic, every
-    epoch) and restore it on the next fit() — without this, a
+    model checkpoints and restore it on the next fit() — without this, a
     preemption/restart resumes the MODEL from its checkpoint but would
     silently restart the shadow from the restored weights, quietly
-    discarding the accumulated average.
+    discarding the accumulated average. The format follows the shadow's
+    layout, mirroring ModelCheckpoint's discipline: replicated/single-host
+    shadows are a primary-written atomic ``ema.msgpack``; shadows sharded
+    ACROSS processes (multi-host TP/FSDP/pipe — the shadow always carries
+    the params' shardings) use the sharded directory format
+    (``ema.shards/``, every process writes its shard, restored with
+    ``reshard=True`` so a topology change between runs still resumes the
+    average).
     """
 
     def __init__(self, decay: float = 0.999, zero_debias: bool = False,
@@ -375,23 +382,79 @@ class ExponentialMovingAverage(Callback):
     def _ckpt_path(self) -> str:
         return os.path.join(self.checkpoint_dir, "ema.msgpack")
 
+    def _sharded_path(self, epoch: int) -> str:
+        # Per-epoch directories (ModelCheckpoint's discipline): an
+        # in-place multi-writer update of one directory could mix epochs
+        # across processes after a mid-write crash and still LOOK
+        # complete; per-epoch dirs + newest-complete discovery make torn
+        # writes harmless. Old dirs are pruned as training advances.
+        return os.path.join(self.checkpoint_dir, f"ema-{epoch}.shards")
+
+    _SHARDED_RE = re.compile(r"ema-(\d+)\.shards$")
+
+    def _newest_complete_shards(self) -> str | None:
+        from horovod_tpu import checkpoint
+
+        best = None
+        try:
+            names = os.listdir(self.checkpoint_dir)
+        except OSError:
+            return None
+        for name in names:
+            m = self._SHARDED_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.checkpoint_dir, name)
+            if checkpoint._sharded_complete(path):
+                if best is None or int(m.group(1)) > best[0]:
+                    best = (int(m.group(1)), path)
+        return best[1] if best else None
+
+    def _restore_sharded_shadow(self, path: str, params):
+        """Resume the shadow from the sharded directory format: every
+        process reads (restore_sharded is process-local file reads, no
+        collectives), ``reshard=True`` so a checkpoint saved under a
+        different topology/layout still resumes, and the restored leaves
+        land directly on the params' shardings (the template)."""
+        from horovod_tpu import checkpoint
+
+        try:
+            payload = checkpoint.restore_sharded(
+                path, {"shadow": params, "count": 0}, reshard=True,
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"EMA shadow restore failed ({path}): "
+                f"{type(e).__name__}: {e} — delete the directory to "
+                "restart the average"
+            ) from e
+        self._ema = payload["shadow"]
+        self._count = int(payload["count"])
+
     def on_train_begin(self, logs=None):
         params = self.trainer.state.params
         if self._ema is None and self.checkpoint_dir is not None:
             from horovod_tpu import checkpoint
 
-            # The PRIMARY's view of the directory decides (the file is
-            # primary-written; checkpoint_dir may be a host-local path on
-            # a pod), and the restored shadow is broadcast so every
-            # process resumes the SAME running average — mirroring
-            # restore_latest_and_broadcast's discipline.
-            found = (
-                os.path.exists(self._ckpt_path())
-                if runtime.is_primary() else False
-            )
+            # The PRIMARY's view of the directory decides (checkpoint_dir
+            # may be a host-local path on a pod) and the outcome is
+            # broadcast so every process takes the same branch —
+            # mirroring restore_latest_and_broadcast's discipline. Either
+            # persisted format resumes, whatever today's layout is: the
+            # sharded directory restores with reshard=True, the single
+            # file restores on the primary and broadcasts.
+            found = "none"
+            if runtime.is_primary():
+                shards = self._newest_complete_shards()
+                if shards is not None:
+                    found = shards
+                elif os.path.exists(self._ckpt_path()):
+                    found = "file"
             if jax.process_count() > 1:
                 found = collectives.broadcast_object(found)
-            if found:
+            if found not in ("none", "file"):
+                self._restore_sharded_shadow(found, params)
+            elif found == "file":
                 count = 0
                 err = None
                 if runtime.is_primary():
@@ -453,20 +516,40 @@ class ExponentialMovingAverage(Callback):
         self._count += 1
 
     def on_epoch_end(self, epoch: int, logs=None):
-        if self.checkpoint_dir is None or not runtime.is_primary():
+        if self.checkpoint_dir is None:
             return
         from horovod_tpu import checkpoint
 
-        # The shadow is replicated state (params stay replicated under the
-        # EMA-supported layouts), so the single-file primary write applies.
-        # Async with at most one write in flight (ModelCheckpoint's
-        # discipline): the fetch + serialization run off-thread instead of
-        # stalling every epoch boundary on a params-sized device_get.
-        if self._pending is not None:
-            self._pending.join()
-        self._pending = checkpoint.save_async(
-            self._ckpt_path(), {"shadow": self._ema, "count": self._count}
-        )
+        # Format follows the shadow's layout (ModelCheckpoint's rule):
+        # cross-process sharded shadows (the shadow carries the params'
+        # shardings) write the sharded directory from EVERY process;
+        # otherwise the primary writes the single file. Async with at most
+        # one write in flight either way: the fetch + serialization run
+        # off-thread instead of stalling every epoch boundary.
+        payload = {"shadow": self._ema, "count": self._count}
+        if checkpoint.is_cross_process_sharded(self._ema):
+            if self._pending is not None:
+                self._pending.join()
+            # Prune superseded epoch dirs (primary; lockstep SPMD epochs
+            # bound writer skew to the previous epoch, which the join
+            # above already finished for THIS process).
+            if runtime.is_primary():
+                import shutil
+
+                for name in os.listdir(self.checkpoint_dir):
+                    m = self._SHARDED_RE.match(name)
+                    if m and int(m.group(1)) < epoch - 1:
+                        shutil.rmtree(
+                            os.path.join(self.checkpoint_dir, name),
+                            ignore_errors=True,
+                        )
+            self._pending = checkpoint.save_sharded_async(
+                self._sharded_path(epoch), payload
+            )
+        elif runtime.is_primary():
+            if self._pending is not None:
+                self._pending.join()
+            self._pending = checkpoint.save_async(self._ckpt_path(), payload)
 
     def on_train_end(self, logs=None):
         if self._pending is not None:

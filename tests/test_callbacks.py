@@ -333,3 +333,114 @@ class TestExponentialMovingAverage:
         ema.set_trainer(trainer)
         with pytest.raises(RuntimeError, match="EMA shadow restore failed"):
             ema.on_train_begin()
+
+
+class TestEMAShardedLayouts:
+    """EMA durability under model-parallel layouts (VERDICT Weak #5): the
+    shadow carries the params' shardings, and its persistence follows the
+    layout — single-host TP/FSDP through the single-file path, ZeRO-1
+    (shard_update) likewise; the cross-process sharded-directory format is
+    exercised in tests/test_multiprocess.py."""
+
+    def _lm_trainer(self, mesh, **kw):
+        from horovod_tpu.models.transformer import (
+            TransformerLM, param_specs,
+        )
+        from jax.sharding import PartitionSpec as P
+
+        return hvt.Trainer(
+            TransformerLM(
+                vocab_size=32, d_model=32, n_heads=4, n_layers=2,
+                dropout=0.0,
+            ),
+            hvt.DistributedOptimizer(optax.adam(1e-2)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh,
+            param_specs=param_specs,
+            batch_specs=(P(("data", "fsdp")), P(("data", "fsdp"))),
+            **kw,
+        )
+
+    def _tokens(self, n=32, t=16):
+        rng = np.random.RandomState(0)
+        x = rng.randint(1, 32, size=(n, t)).astype(np.int32)
+        return x, np.roll(x, -1, axis=1).astype(np.int32)
+
+    def test_roundtrip_under_fsdp_tp(self, tmp_path):
+        import jax
+
+        from horovod_tpu.parallel import mesh as mesh_lib
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=2, fsdp=2, model=2)
+        )
+        d = str(tmp_path)
+        ema = ExponentialMovingAverage(decay=0.8, checkpoint_dir=d)
+        trainer = self._lm_trainer(mesh)
+        x, y = self._tokens()
+        trainer.fit(
+            x=x, y=y, epochs=2, batch_size=8, callbacks=[ema], verbose=0
+        )
+        saved = jax.device_get(ema.ema_params)
+        count = ema._count
+        assert count > 0
+        assert (tmp_path / "ema.msgpack").exists()
+
+        ema2 = ExponentialMovingAverage(decay=0.8, checkpoint_dir=d)
+        ema2.set_trainer(trainer)
+        ema2.on_train_begin()
+        assert ema2._count == count
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            jax.device_get(ema2.ema_params), saved,
+        )
+        # The restored shadow carries the params' shardings, so the next
+        # donated update composes (and actually runs).
+        for p, e in zip(
+            jax.tree.leaves(trainer.state.params),
+            jax.tree.leaves(ema2._ema),
+        ):
+            assert p.sharding == e.sharding, (p.sharding, e.sharding)
+        ema2.on_batch_end(0)
+        assert ema2._count == count + 1
+
+    def test_roundtrip_under_shard_update(self, tmp_path):
+        import jax
+
+        from horovod_tpu.training.callbacks import ExponentialMovingAverage
+
+        import flax.linen as nn
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return nn.Dense(8)(nn.relu(nn.Dense(16)(x)))
+
+        d = str(tmp_path)
+        trainer = hvt.Trainer(
+            Tiny(), hvt.DistributedOptimizer(optax.adam(1e-2)),
+            loss="sparse_categorical_crossentropy",
+            shard_update=True,
+        )
+        rng = np.random.RandomState(1)
+        x = rng.rand(64, 12).astype(np.float32)
+        y = rng.randint(0, 8, size=(64,)).astype(np.int32)
+        ema = ExponentialMovingAverage(decay=0.9, checkpoint_dir=d)
+        trainer.fit(
+            x=x, y=y, epochs=2, batch_size=8, callbacks=[ema], verbose=0
+        )
+        saved = jax.device_get(ema.ema_params)
+        count = ema._count
+        assert count > 0
+
+        ema2 = ExponentialMovingAverage(decay=0.9, checkpoint_dir=d)
+        ema2.set_trainer(trainer)
+        ema2.on_train_begin()
+        assert ema2._count == count
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            jax.device_get(ema2.ema_params), saved,
+        )
+        ema2.on_batch_end(0)
+        assert ema2._count == count + 1
