@@ -94,3 +94,25 @@ def test_loader_pytree_shard_keeps_alignment():
     for xb, yb in ds:
         np.testing.assert_array_equal(yb, xb["a"] * 2)
         assert all(v % 4 == 1 for v in xb["a"])
+
+
+def test_genuine_npz_preempts_synthesis(tmp_cache):
+    """A keras-layout npz already at the cache path is LOADED, not
+    regenerated — the real-data hook (SURVEY.md §2.1 data pipeline row;
+    the synthetic path is a fallback, not a fork of the API)."""
+    import os
+
+    rng = np.random.RandomState(3)
+    real = {
+        "x_train": rng.randint(0, 255, size=(64, 28, 28), dtype=np.uint8),
+        "y_train": rng.randint(0, 10, size=(64,)).astype(np.int64),
+        "x_test": rng.randint(0, 255, size=(16, 28, 28), dtype=np.uint8),
+        "y_test": rng.randint(0, 10, size=(16,)).astype(np.int64),
+    }
+    cache = os.environ["HVT_DATA_DIR"]
+    np.savez_compressed(os.path.join(cache, "mnist-7.npz"), **real)
+    (xtr, ytr), (xte, yte) = datasets.mnist(path="mnist-7.npz")
+    np.testing.assert_array_equal(xtr, real["x_train"])
+    np.testing.assert_array_equal(ytr, real["y_train"])
+    np.testing.assert_array_equal(xte, real["x_test"])
+    np.testing.assert_array_equal(yte, real["y_test"])
