@@ -568,6 +568,100 @@ def bench_decode() -> dict:
     }
 
 
+def bench_int8_compute() -> dict:
+    """int8 COMPUTE A/B (models/quant.int8_dot_general): prefill and
+    large-batch decode, bf16 MXU vs int8 MXU (dynamic activation scales,
+    per-channel weight scales, int32 accumulation).
+
+    Prefill is the compute-bound phase (a full causal forward over the
+    prompt); large-batch decode amortizes the weight stream until the
+    matmuls, not the bytes, dominate — exactly where v5e's 2x int8 MXU
+    rate can pay. Reported: prefill ms and decode tokens/sec for both
+    paths at the d1024-class shape (BENCH_DMODEL et al. to vary).
+    """
+    os.environ.setdefault("HVT_FAST_RNG", "1")
+    os.environ.setdefault("BENCH_DMODEL", "1024")
+    os.environ.setdefault("BENCH_NLAYERS", "16")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvt
+    from horovod_tpu.models.decoding import make_generate_fn
+
+    hvt.init()
+    n_chips = jax.device_count()
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", 32))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", 512))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", 128))
+    model = _lm_from_env()
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(
+        rng.randint(0, 8192, size=(batch, prompt_len)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    reps = max(1, int(os.environ.get("BENCH_DECODE_REPS", 4)))
+
+    def measure_prefill(int8: bool) -> float:
+        m = model.clone(int8_compute=int8) if int8 else model
+        fwd = jax.jit(lambda p, x: m.apply({"params": p}, x).sum())
+        float(jax.device_get(fwd(params, prompt)))
+
+        def run_reps():
+            total = jnp.float32(0)
+            for _ in range(reps):
+                total = total + fwd(params, prompt)
+            return total
+
+        return min(_timed(run_reps) for _ in range(3)) / reps
+
+    def measure_decode(int8: bool) -> float:
+        fn = make_generate_fn(
+            model, max_new_tokens=new_tokens, include_prompt=False,
+            int8_compute=int8,
+        )
+        key = jax.random.PRNGKey(7)
+
+        def run():
+            return fn(params, prompt, key).sum()
+
+        float(jax.device_get(run()))
+
+        def run_reps():
+            total = jnp.int32(0)
+            for _ in range(reps):
+                total = total + run()
+            return total
+
+        return min(_timed(run_reps) for _ in range(3)) / reps
+
+    pre_bf16 = measure_prefill(False)
+    pre_int8 = measure_prefill(True)
+    dec_bf16 = measure_decode(False)
+    dec_int8 = measure_decode(True)
+    toks = batch * new_tokens
+    return {
+        "metric": "int8_compute_prefill_speedup",
+        "value": round(pre_bf16 / pre_int8, 2),
+        "unit": "x vs bf16",
+        "prefill_ms_bf16": round(pre_bf16 * 1e3, 2),
+        "prefill_ms_int8": round(pre_int8 * 1e3, 2),
+        "prefill_tokens_per_sec_int8": round(
+            batch * prompt_len / pre_int8 / n_chips, 1
+        ),
+        "decode_tokens_per_sec_bf16": round(toks / dec_bf16 / n_chips, 1),
+        "decode_tokens_per_sec_int8": round(toks / dec_int8 / n_chips, 1),
+        "decode_speedup": round(dec_bf16 / dec_int8, 2),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "d_model": model.d_model,
+        "n_layers": model.n_layers,
+        "n_chips": n_chips,
+    }
+
+
 def bench_spec() -> dict:
     """Speculative-decoding A/B: exact-greedy speedup on a model that has
     actually learned its task.
@@ -857,6 +951,8 @@ def main() -> None:
         result = bench_input()
     elif which == "serve":
         result = bench_serve()
+    elif which == "int8":
+        result = bench_int8_compute()
     elif which == "decode":
         result = bench_decode()
     elif which == "spec":

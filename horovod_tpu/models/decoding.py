@@ -96,7 +96,8 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 0.0,
                      eos_id: int | None = None,
                      include_prompt: bool = True,
-                     quantized: bool = False):
+                     quantized: bool = False,
+                     int8_compute: bool = False):
     """Build the compiled generator: ``(params, prompt, rng) -> tokens``.
 
     ``model`` is the *training* `TransformerLM`; it is cloned into decode
@@ -110,6 +111,12 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
     scan body so the per-token weight stream stays int8 in HBM — the
     bandwidth-bound step reads half the bytes (quant.py; approximate:
     outputs can differ from bf16 decoding near ties).
+
+    ``int8_compute=True``: the PREFILL forward runs its matmuls on the
+    int8 MXU (`quant.int8_dot_general`) — the compute-bound phase where
+    the 2× int8 rate pays (1.2–1.44× measured, BASELINE.md); decode scan
+    steps stay bf16, where per-step dynamic weight requantization was
+    measured slower. Orthogonal to ``quantized`` (storage).
 
     **Ragged prompts** — ``fn(params, prompt, rng, lengths)`` with
     ``lengths`` a ``[B]`` int array: each row's true prompt is its first
@@ -137,10 +144,17 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
             decode=True, max_decode_len=t0 + max_new_tokens, dropout=0.0,
             remat=False,
         )
+        # int8_compute applies to the PREFILL apply only — the measured
+        # split (BASELINE.md int8 row): prefill is compute-bound and gains
+        # 1.2-1.44x from the int8 MXU, while a decode step is bandwidth-
+        # bound and per-step dynamic weight requantization makes it
+        # SLOWER (0.87-1.0x) — so the scan body stays bf16. (For a full
+        # int8 forward, use TransformerLM(int8_compute=True) directly.)
+        pmodel = dmodel.clone(int8_compute=True) if int8_compute else dmodel
         # Prefill: one causal forward over the prompt; the mutable 'cache'
         # collection is created here ([B, L, H, D] per block + the position
         # index) and threaded through the scan as plain pytree state.
-        logits, vars_ = dmodel.apply({"params": params}, prompt, mutable=["cache"])
+        logits, vars_ = pmodel.apply({"params": params}, prompt, mutable=["cache"])
         cache0 = vars_["cache"]
         if lengths is None:
             last_logits = logits[:, -1]
@@ -188,7 +202,7 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
 def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              eos_id: int | None = None, include_prompt: bool = True,
-             quantized: bool = False):
+             quantized: bool = False, int8_compute: bool = False):
     """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, T0] ints).
 
     Convenience wrapper over `make_generate_fn` (which see, for the handle
@@ -199,6 +213,7 @@ def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
         model, max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, top_p=top_p, eos_id=eos_id,
         include_prompt=include_prompt, quantized=quantized,
+        int8_compute=int8_compute,
     )
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -147,6 +147,10 @@ class Block(nn.Module):
     # cache reads per generated token however long the generation runs.
     # Exact: a windowed query never needs anything the ring has evicted.
     sliding_cache: bool = False
+    # int8 MXU compute for Dense matmuls (inference-only; see
+    # models/quant.int8_dot_general — dynamic activation scales,
+    # per-channel weight scales, int32 accumulation).
+    int8_compute: bool = False
     # Attention sinks (StreamingLLM, arXiv:2309.17453 / Longformer-style
     # global+local): the first `attention_sinks` positions stay visible —
     # and, with sliding_cache, pinned in the cache — in addition to the
@@ -167,8 +171,14 @@ class Block(nn.Module):
                  decode_index=None):
         cfg = self.sharding
         head_dim = self.d_model // self.n_heads
+        dense_kw = {}
+        if self.int8_compute:
+            from horovod_tpu.models.quant import int8_dot_general
+
+            dense_kw["dot_general"] = int8_dot_general
         dense = functools.partial(
-            nn.DenseGeneral, dtype=self.compute_dtype, use_bias=False
+            nn.DenseGeneral, dtype=self.compute_dtype, use_bias=False,
+            **dense_kw,
         )
 
         # --- attention -----------------------------------------------------
@@ -579,6 +589,7 @@ class LMHead(nn.Module):
     vocab_size: int
     compute_dtype: jnp.dtype = jnp.float32
     logits_dtype: jnp.dtype = jnp.float32
+    int8_compute: bool = False
 
     def setup(self):
         self.kernel = self.param(
@@ -588,6 +599,16 @@ class LMHead(nn.Module):
         )
 
     def __call__(self, x):
+        if self.int8_compute:
+            from horovod_tpu.models.quant import int8_dot_general
+
+            logits = int8_dot_general(
+                x.astype(self.compute_dtype),
+                self.kernel.astype(self.compute_dtype),
+                (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=self.logits_dtype,
+            )
+            return logits
         logits = jnp.dot(
             x.astype(self.compute_dtype), self.kernel.astype(self.compute_dtype)
         )
@@ -634,6 +655,10 @@ class TransformerLM(nn.Module):
     #   (fused by XLA, never materialized), so logsumexp stays accurate.
     remat: bool = False
     logits_dtype: jnp.dtype = jnp.float32
+    # int8 MXU compute for every Dense matmul + the LM head (inference
+    # only — prefill and large-batch decode are compute-bound, where the
+    # v5e's 2x int8 MXU rate pays; models/quant.int8_dot_general).
+    int8_compute: bool = False
     # moe_every=k replaces every k-th block's MLP with an expert-parallel
     # MoE (0 = dense everywhere, the default).
     moe_every: int = 0
@@ -665,6 +690,20 @@ class TransformerLM(nn.Module):
     ):
         cfg = self.sharding
         b, t = tokens.shape
+        if self.int8_compute and train:
+            raise ValueError(
+                "int8_compute is inference-only: round() kills gradients "
+                "(quantization-aware training would need a straight-"
+                "through estimator) — clone the model with "
+                "int8_compute=False for training"
+            )
+        if self.int8_compute and self.moe_every:
+            raise ValueError(
+                "int8_compute does not cover MoE expert matmuls (the "
+                "routed einsums bypass the Dense dot_general injection) — "
+                "an MoE model would silently keep its dominant FLOPs in "
+                "bf16; use a dense model or int8_compute=False"
+            )
         decode_index = None
         if self.decode:
             if self.remat or train or segment_ids is not None:
@@ -717,6 +756,7 @@ class TransformerLM(nn.Module):
                 max_decode_len=self.max_decode_len,
                 sliding_cache=self.sliding_cache,
                 attention_sinks=self.attention_sinks,
+                int8_compute=self.int8_compute,
                 # Explicit name = flax's auto-name, so the param tree is
                 # identical with and without remat (the remat wrapper would
                 # otherwise scope as CheckpointBlock_i).
@@ -727,6 +767,7 @@ class TransformerLM(nn.Module):
             self.d_model, self.vocab_size,
             compute_dtype=self.compute_dtype,
             logits_dtype=self.logits_dtype,
+            int8_compute=self.int8_compute,
             name="lm_head",
         )
         if labels is not None:

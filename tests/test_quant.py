@@ -126,3 +126,162 @@ class TestQuantizedDecode:
         )
         assert agree >= 0.9, f"top-1 agreement with bf16 only {agree:.2f}"
         assert recall >= 0.85, f"quantized recall dropped to {recall:.2f}"
+
+
+class TestInt8DotGeneral:
+    def test_matches_f32_dot_within_quant_error(self):
+        from horovod_tpu.models.quant import int8_dot_general
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 64).astype(np.float32)
+        w = rng.randn(64, 32).astype(np.float32)
+        dims = (((1,), (0,)), ((), ()))
+        got = np.asarray(
+            int8_dot_general(jnp.asarray(x), jnp.asarray(w), dims,
+                             preferred_element_type=jnp.float32)
+        )
+        want = x @ w
+        # Two symmetric roundings at 127 levels each: relative error on
+        # the order of a few percent of the row/channel magnitudes.
+        denom = np.maximum(np.abs(want), np.abs(want).mean())
+        assert (np.abs(got - want) / denom).max() < 0.08
+
+    def test_exact_on_int8_lattice(self):
+        """Operands already on their int8 lattices quantize losslessly, so
+        the int32 MXU accumulation makes the whole product EXACT."""
+        from horovod_tpu.models.quant import int8_dot_general
+
+        rng = np.random.RandomState(1)
+        xi = rng.randint(-127, 128, size=(8, 32)).astype(np.float32)
+        wi = rng.randint(-127, 128, size=(32, 16)).astype(np.float32)
+        # Pin each row's / channel's amax to exactly 127 so the dynamic
+        # scale is the lattice unit and quantization round-trips.
+        xi[:, 0] = 127.0
+        wi[0, :] = 127.0
+        x = xi * 0.013
+        w = wi * 0.07
+        dims = (((1,), (0,)), ((), ()))
+        got = np.asarray(
+            int8_dot_general(jnp.asarray(x), jnp.asarray(w), dims,
+                             preferred_element_type=jnp.float32)
+        )
+        # Ground truth in exact integer arithmetic (a f32 x @ w reference
+        # would itself carry accumulation error near zero entries).
+        want = (xi.astype(np.int64) @ wi.astype(np.int64)) * (0.013 * 0.07)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+    def test_multi_axis_contraction(self):
+        # DenseGeneral's axis=(-2,-1) pattern (attn_out: [B,T,H,D]x[H,D,dm]).
+        from horovod_tpu.models.quant import int8_dot_general
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 6, 4, 8).astype(np.float32)
+        w = rng.randn(4, 8, 16).astype(np.float32)
+        dims = (((2, 3), (0, 1)), ((), ()))
+        got = np.asarray(
+            int8_dot_general(jnp.asarray(x), jnp.asarray(w), dims,
+                             preferred_element_type=jnp.float32)
+        )
+        want = np.einsum("bthd,hdm->btm", x, w)
+        denom = np.maximum(np.abs(want), np.abs(want).mean())
+        assert (np.abs(got - want) / denom).max() < 0.08
+
+    def test_batch_dims_rejected(self):
+        from horovod_tpu.models.quant import int8_dot_general
+
+        with pytest.raises(NotImplementedError, match="batch"):
+            int8_dot_general(
+                jnp.ones((2, 3, 4)), jnp.ones((2, 4, 5)),
+                (((2,), (1,)), ((0,), (0,))),
+            )
+
+
+class TestInt8Compute:
+    def test_forward_close_to_bf16_and_train_rejected(self):
+        model = _model()
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        x = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        base = np.asarray(model.apply({"params": params}, x), np.float32)
+        q = np.asarray(
+            model.clone(int8_compute=True).apply({"params": params}, x),
+            np.float32,
+        )
+        # Same argmax token at nearly every position on an untrained net.
+        agree = (base.argmax(-1) == q.argmax(-1)).mean()
+        assert agree >= 0.8, agree
+        with pytest.raises(ValueError, match="inference-only"):
+            model.clone(int8_compute=True).apply(
+                {"params": params}, x, train=True,
+                rngs={"dropout": jax.random.PRNGKey(0)},
+            )
+
+    def test_trained_model_quality_preserved(self):
+        """int8 COMPUTE on the trained copy-task model: greedy decode with
+        dynamic activation quant + int8 MXU matmuls still recalls the
+        copy and agrees with bf16 — the existing quality gate applied to
+        the compute path (VERDICT Weak #7)."""
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        model = _model()
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh_lib.build_mesh(
+                mesh_lib.MeshSpec(data=1), devices=jax.devices()[:1]
+            ),
+        )
+        x, y = datasets.copy_task(512, 32, vocab_size=VOCAB, seed=9)
+        trainer.fit(
+            x=x, y=y, batch_size=32, epochs=4, steps_per_epoch=16, verbose=0
+        )
+        params = trainer.state.params
+        xt, _ = datasets.copy_task(4, 32, vocab_size=VOCAB, seed=23)
+        prompt = jnp.asarray(xt[:, :16])
+        n_new = 15
+
+        bf16 = make_generate_fn(
+            model, max_new_tokens=n_new, include_prompt=False
+        )(params, prompt, jax.random.PRNGKey(0))
+        int8c = make_generate_fn(
+            model, max_new_tokens=n_new, include_prompt=False,
+            int8_compute=True,
+        )(params, prompt, jax.random.PRNGKey(0))
+
+        agree = float((np.asarray(bf16) == np.asarray(int8c)).mean())
+        recall = float(
+            (np.asarray(int8c) == np.asarray(xt[:, 16:31])).mean()
+        )
+        assert agree >= 0.9, f"top-1 agreement with bf16 only {agree:.2f}"
+        assert recall >= 0.85, f"int8-compute recall dropped to {recall:.2f}"
+
+    def test_stacks_with_weight_only_storage(self):
+        # quantized=True (int8 HBM stream) + int8_compute=True (int8 MXU):
+        # requantization round-trips the lattice, generation stays valid.
+        model = _model()
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        fn = make_generate_fn(
+            model, max_new_tokens=8, include_prompt=False,
+            quantized=True, int8_compute=True,
+        )
+        out = np.asarray(
+            fn(quantize_params(params),
+               jnp.asarray([[1, 2, 3, 4]], jnp.int32),
+               jax.random.PRNGKey(0))
+        )
+        assert out.shape == (1, 8)
+        assert out.min() >= 0 and out.max() < VOCAB
+
+
+def test_int8_compute_moe_rejected():
+    model = _model(moe_every=2, n_experts=4, int8_compute=True)
+    params_model = _model(moe_every=2, n_experts=4)
+    params = params_model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="MoE"):
+        model.apply({"params": params}, jnp.zeros((2, 8), jnp.int32))
