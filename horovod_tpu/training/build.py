@@ -1,0 +1,206 @@
+"""Trainer.build — parameter/optimizer-state initialization and layout.
+
+Split out of trainer.py (round 5): lazy Keras-style build from the first
+batch, module-loss label synthesis, TP/FSDP param placement from
+param_specs, optimizer-mirror shardings, and the ZeRO-1 (shard_update)
+opt-state layout. One entry point: `build_state(trainer, sample_x,
+sample_y)` — the body of ``Trainer.build``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.parallel import sharding as sharding_lib
+from horovod_tpu.training.train_state import (
+    TrainState,
+    _aggregate_sown_metrics,
+    _param_shaped_matcher,
+)
+
+
+def build_state(trainer, sample_x: np.ndarray, sample_y=None) -> TrainState:
+    """Initialize parameters (lazy, from the first batch — like Keras
+    building on first fit).
+
+    With ``loss='module'`` the init passes labels so the module traces
+    its fused-loss branch (see below): ``sample_y`` when given, else
+    labels synthesized as ``zeros_like(sample_x)`` — valid for the LM
+    family, where labels share the token batch's shape/dtype. Models
+    whose labels differ from their inputs in dtype/shape/structure must
+    pass ``sample_y`` (``fit`` always does)."""
+    if trainer.state is not None:
+        return trainer.state
+    rng = jax.random.PRNGKey(trainer.seed)
+    init_rng, dropout_rng, state_rng = jax.random.split(rng, 3)
+    # Init batch sized to the data-parallel degree: models that carry
+    # internal sharding constraints need the batch dim divisible by it.
+    # Leaf-wise so pytree (dict-input) samples build like flat ones.
+    n = trainer.dp_size
+
+    def size_to_dp(a):
+        a = np.asarray(a)
+        if len(a) < n:
+            a = np.concatenate([a] * (-(-n // len(a))))
+        return jnp.asarray(a[:n])
+
+    sized_x = jax.tree.map(size_to_dp, sample_x)
+    # loss='module' contract: init with labels so the module traces its
+    # fused-loss branch — otherwise build() materializes the dense
+    # [B, T, vocab] logits that the fused head exists to avoid, making
+    # init the OOM point at long-context scale even though train/eval
+    # steps are fused. Real labels when the caller has them; the
+    # zeros_like fallback matches the LM family's labels-share-the-
+    # token-batch contract (models/transformer.py `__call__`).
+    init_kwargs = {}
+    synthesized_labels = False
+    if trainer._module_loss:
+        if sample_y is not None:
+            init_kwargs["labels"] = jax.tree.map(size_to_dp, sample_y)
+        else:
+            init_kwargs["labels"] = jax.tree.map(jnp.zeros_like, sized_x)
+            synthesized_labels = True
+    try:
+        variables = trainer.module.init(
+            {"params": init_rng, "dropout": dropout_rng},
+            sized_x,
+            train=False,
+            **init_kwargs,
+        )
+    except Exception as e:
+        if synthesized_labels:
+            # The zeros_like fallback assumes LM-style labels (same
+            # shape/dtype as the token batch). For any other module the
+            # trace fails opaquely deep inside init — name the fix.
+            # Mutating args (not re-wrapping) keeps the exception type
+            # even for types with non-string constructors.
+            hint = (
+                "\n\nhorovod_tpu hint: build() was called with "
+                "loss='module' and no sample_y, so labels were "
+                "synthesized as zeros_like(sample_x) (the LM-family "
+                "contract). If this module's labels differ from its "
+                "inputs in shape/dtype, pass sample_y to build() — "
+                "fit() does this automatically."
+            )
+            head = str(e.args[0]) if e.args else str(e)
+            e.args = (head + hint,) + tuple(e.args[1:])
+        raise
+    params = variables["params"]
+    # Sown per-apply channels never persist in the carried state: values
+    # are produced fresh each step ('losses' → objective, 'metrics' →
+    # observability). Their presence at init DOES reveal the metric
+    # names, which sizes the epoch accumulator — which is why 'metrics'
+    # sows must be UNCONDITIONAL (not train-gated): a name that appears
+    # only at train time couldn't be discovered here, and the step
+    # checks for that drift loudly (see train_step).
+    trainer._metric_names = tuple(
+        sorted(_aggregate_sown_metrics(variables.get("metrics", {})))
+    )
+    reserved = {"loss", "accuracy"} & set(trainer._metric_names)
+    if reserved:
+        raise ValueError(
+            f"module sows 'metrics' entries named {sorted(reserved)}, "
+            "which would silently overwrite the Trainer's own "
+            "loss/accuracy in every log and sink — rename the sow"
+        )
+    model_state = {
+        k: v
+        for k, v in variables.items()
+        if k not in ("params", "losses", "metrics")
+    }
+    trainer._mutable = sorted(model_state.keys())
+    if trainer.param_specs is not None:
+        specs = (
+            trainer.param_specs(params, trainer.mesh)
+            if callable(trainer.param_specs)
+            else trainer.param_specs
+        )
+        trainer._param_shardings = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(trainer.mesh, s),
+            specs,
+            is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
+        )
+        params = jax.device_put(params, trainer._param_shardings)
+        # Optimizer mirrors (momenta etc.) must carry the param layout.
+        # Sharding propagation can't deliver it — `init` is zeros_like,
+        # which reads only shapes, so XLA sees an input-free computation —
+        # hence explicit out_shardings: any opt-state subtree that is
+        # param-shaped gets the param shardings, the rest replicate.
+        rep = sharding_lib.replicated(trainer.mesh)
+        param_shaped = _param_shaped_matcher(params)
+        opt_shardings = jax.tree.map(
+            lambda sub: trainer._param_shardings if param_shaped(sub) else rep,
+            jax.eval_shape(trainer.tx.init, params),
+            is_leaf=param_shaped,
+        )
+        opt_state = jax.jit(trainer.tx.init, out_shardings=opt_shardings)(params)
+        state = TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            params=params,
+            opt_state=opt_state,
+            rng=jax.device_put(state_rng, rep),
+            model_state=sharding_lib.replicate(model_state, trainer.mesh)
+            if model_state
+            else None,
+        )
+        trainer.state = state
+    elif (
+        trainer.shard_update
+        and trainer.mesh.shape.get(mesh_lib.DATA_AXIS, 1) > 1
+    ):
+        # ZeRO-1 (arXiv:2004.13336): replicated params, optimizer state
+        # sharded dim-0 over the data axis. The jitted step then
+        # compiles the paper's transformation — gradients reduce-scatter
+        # into the update shard each replica owns, and the applied
+        # params all-gather back — purely from these init shardings.
+        dp = trainer.mesh.shape[mesh_lib.DATA_AXIS]
+        rep = sharding_lib.replicated(trainer.mesh)
+        param_shaped = _param_shaped_matcher(params)
+
+        def zero1(shape):
+            # First dp-divisible dim carries the shard (dim 0 for the
+            # matmul kernels that dominate; conv kernels usually shard
+            # their channel dims); nothing divisible → replicate.
+            for i, dim in enumerate(shape):
+                if dim % dp == 0:
+                    spec = [None] * len(shape)
+                    spec[i] = mesh_lib.DATA_AXIS
+                    return jax.sharding.NamedSharding(
+                        trainer.mesh, jax.sharding.PartitionSpec(*spec)
+                    )
+            return rep
+
+        opt_shardings = jax.tree.map(
+            lambda sub: jax.tree.map(lambda l: zero1(l.shape), sub)
+            if param_shaped(sub)
+            else rep,
+            jax.eval_shape(trainer.tx.init, params),
+            is_leaf=param_shaped,
+        )
+        params = jax.device_put(params, rep)
+        opt_state = jax.jit(trainer.tx.init, out_shardings=opt_shardings)(
+            params
+        )
+        state = TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            params=params,
+            opt_state=opt_state,
+            rng=jax.device_put(state_rng, rep),
+            model_state=sharding_lib.replicate(model_state, trainer.mesh)
+            if model_state
+            else None,
+        )
+        trainer.state = state
+    else:
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=trainer.tx.init(params),
+            rng=state_rng,
+            model_state=model_state or None,
+        )
+        trainer.state = sharding_lib.replicate(state, trainer.mesh)
+    return trainer.state

@@ -1,0 +1,286 @@
+"""Exponential moving average of the parameters — the EMA callback.
+
+Split out of callbacks.py (round 5); `ExponentialMovingAverage` is
+re-exported there, so ``hvt.callbacks.ExponentialMovingAverage`` is
+unchanged. See the class docstring for semantics (device-resident shadow,
+zero-debias, layout-following durability through the single-file or
+sharded checkpoint formats).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+
+import jax
+import numpy as np
+
+from horovod_tpu import runtime
+from horovod_tpu.parallel import collectives
+from horovod_tpu.training.callbacks import Callback
+
+
+class ExponentialMovingAverage(Callback):
+    """Polyak/EMA weight averaging — evaluate and export with a smoothed
+    copy of the parameters (beyond-parity; the standard large-batch
+    companion to the LR-scaling recipe the reference uses).
+
+    After every train-step execution: ``ema ← decay·ema + (1−decay)·params``
+    as one jitted donated update, so the shadow copy lives on device and
+    costs one fused elementwise pass per execution — no host traffic.
+    Granularity follows the fit path: per step on the streamed path, per
+    `steps_per_execution` chunk, per EPOCH on ``cache='device'`` (where
+    on_batch_end fires once per epoch) — pick ``decay`` for the cadence.
+
+    ``zero_debias=True`` applies the Adam-style correction
+    ``ema / (1 − decay^t)`` when reading (`ema_params`), so early reads are
+    unbiased even though the shadow starts at zero. Default starts the
+    shadow AT the initial params (no bias, no correction needed).
+
+    Read access: ``ema_params`` (debiased), or the ``averaged(trainer)``
+    context manager which swaps the EMA weights into ``trainer.state`` for
+    an eval/export block and restores the live weights after:
+
+        with ema.averaged(trainer):
+            trainer.evaluate(x_test, y_test)
+
+    Durability: pass ``checkpoint_dir`` to persist the shadow alongside the
+    model checkpoints and restore it on the next fit() — without this, a
+    preemption/restart resumes the MODEL from its checkpoint but would
+    silently restart the shadow from the restored weights, quietly
+    discarding the accumulated average. The format follows the shadow's
+    layout, mirroring ModelCheckpoint's discipline: replicated/single-host
+    shadows are a primary-written atomic ``ema.msgpack``; shadows sharded
+    ACROSS processes (multi-host TP/FSDP/pipe — the shadow always carries
+    the params' shardings) use the sharded directory format
+    (``ema.shards/``, every process writes its shard, restored with
+    ``reshard=True`` so a topology change between runs still resumes the
+    average).
+    """
+
+    def __init__(self, decay: float = 0.999, zero_debias: bool = False,
+                 checkpoint_dir: str | None = None):
+        if not 0.0 < decay < 1.0:
+            raise ValueError(f"decay must be in (0, 1), got {decay}")
+        self.decay = decay
+        self.zero_debias = zero_debias
+        self.checkpoint_dir = checkpoint_dir
+        self._ema = None
+        self._count = 0
+        self._pending = None
+        self._update = jax.jit(
+            lambda e, p: jax.tree.map(
+                lambda a, b: self.decay * a + (1.0 - self.decay) * b, e, p
+            ),
+            donate_argnums=(0,),
+        )
+
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.checkpoint_dir, "ema.msgpack")
+
+    def _sharded_path(self, epoch: int) -> str:
+        # Per-epoch directories (ModelCheckpoint's discipline): an
+        # in-place multi-writer update of one directory could mix epochs
+        # across processes after a mid-write crash and still LOOK
+        # complete; per-epoch dirs + newest-complete discovery make torn
+        # writes harmless. Old dirs are pruned as training advances.
+        return os.path.join(self.checkpoint_dir, f"ema-{epoch}.shards")
+
+    _SHARDED_RE = re.compile(r"ema-(\d+)\.shards$")
+
+    def _newest_complete_shards(self) -> str | None:
+        from horovod_tpu import checkpoint
+
+        best = None
+        try:
+            names = os.listdir(self.checkpoint_dir)
+        except OSError:
+            return None
+        for name in names:
+            m = self._SHARDED_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.checkpoint_dir, name)
+            if checkpoint._sharded_complete(path):
+                if best is None or int(m.group(1)) > best[0]:
+                    best = (int(m.group(1)), path)
+        return best[1] if best else None
+
+    def _restore_sharded_shadow(self, path: str, params):
+        """Resume the shadow from the sharded directory format: every
+        process reads (restore_sharded is process-local file reads, no
+        collectives), ``reshard=True`` so a checkpoint saved under a
+        different topology/layout still resumes, and the restored leaves
+        land directly on the params' shardings (the template)."""
+        from horovod_tpu import checkpoint
+
+        try:
+            payload = checkpoint.restore_sharded(
+                path, {"shadow": params, "count": 0}, reshard=True,
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"EMA shadow restore failed ({path}): "
+                f"{type(e).__name__}: {e} — delete the directory to "
+                "restart the average"
+            ) from e
+        self._ema = payload["shadow"]
+        self._count = int(payload["count"])
+
+    def on_train_begin(self, logs=None):
+        params = self.trainer.state.params
+        if self._ema is None and self.checkpoint_dir is not None:
+            from horovod_tpu import checkpoint
+
+            # The PRIMARY's view of the directory decides (checkpoint_dir
+            # may be a host-local path on a pod) and the outcome is
+            # broadcast so every process takes the same branch —
+            # mirroring restore_latest_and_broadcast's discipline. Either
+            # persisted format resumes, whatever today's layout is: the
+            # sharded directory restores with reshard=True, the single
+            # file restores on the primary and broadcasts.
+            found = "none"
+            if runtime.is_primary():
+                shards = self._newest_complete_shards()
+                if shards is not None:
+                    found = shards
+                elif os.path.exists(self._ckpt_path()):
+                    found = "file"
+            if jax.process_count() > 1:
+                found = collectives.broadcast_object(found)
+            if found not in ("none", "file"):
+                self._restore_sharded_shadow(found, params)
+            elif found == "file":
+                count = 0
+                err = None
+                if runtime.is_primary():
+                    try:
+                        payload = checkpoint.restore(
+                            self._ckpt_path(), {"shadow": params, "count": 0}
+                        )
+                        shadow = jax.tree.map(np.asarray, payload["shadow"])
+                        count = int(payload["count"])
+                    except Exception as e:  # stale/incompatible file
+                        err = f"{type(e).__name__}: {e}"
+                        shadow = None
+                else:
+                    shadow = jax.tree.map(
+                        lambda l: np.zeros(l.shape, l.dtype), params
+                    )
+                if jax.process_count() > 1:
+                    # The primary's restore outcome travels BEFORE the
+                    # pytree broadcast, so a failed restore raises on EVERY
+                    # rank together instead of stranding the others in the
+                    # collective (restore_latest_and_broadcast's torn-flag
+                    # discipline).
+                    err = collectives.broadcast_object(err)
+                if err is not None:
+                    raise RuntimeError(
+                        f"EMA shadow restore failed ({self._ckpt_path()}): "
+                        f"{err} — delete the file to restart the average"
+                    )
+                if jax.process_count() > 1:
+                    # ORDER MATTERS: broadcast on the HOST first so every
+                    # process holds identical values, THEN device_put — a
+                    # device_put onto a cross-process sharding is itself a
+                    # collective (it verifies value equality across
+                    # processes), so placing divergent pre-broadcast values
+                    # would fail, and any asymmetry between the primary's
+                    # and the others' paths here deadlocks the fleet.
+                    shadow = collectives.broadcast_pytree(shadow)
+                    count = int(collectives.broadcast_object(count))
+                # The shadow must carry the params' shardings: a bare
+                # device_put would commit it to one device and the next
+                # donated _update would see incompatible placements.
+                self._ema = jax.tree.map(
+                    lambda t, p: jax.device_put(
+                        t, p.sharding if isinstance(p, jax.Array) else None
+                    ),
+                    shadow, params,
+                )
+                self._count = count
+        if self._ema is None:
+            self._ema = (
+                jax.tree.map(jax.numpy.zeros_like, params)
+                if self.zero_debias
+                else jax.tree.map(lambda a: a + 0, params)  # device copy
+            )
+            self._count = 0
+
+    def on_batch_end(self, batch: int, logs=None):
+        self._ema = self._update(self._ema, self.trainer.state.params)
+        self._count += 1
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        if self.checkpoint_dir is None:
+            return
+        from horovod_tpu import checkpoint
+
+        # Format follows the shadow's layout (ModelCheckpoint's rule):
+        # cross-process sharded shadows (the shadow carries the params'
+        # shardings) write the sharded directory from EVERY process;
+        # otherwise the primary writes the single file. Async with at most
+        # one write in flight either way: the fetch + serialization run
+        # off-thread instead of stalling every epoch boundary.
+        payload = {"shadow": self._ema, "count": self._count}
+        if checkpoint.is_cross_process_sharded(self._ema):
+            if self._pending is not None:
+                self._pending.join()
+            # Prune superseded epoch dirs (primary; lockstep SPMD epochs
+            # bound writer skew to the previous epoch, which the join
+            # above already finished for THIS process).
+            if runtime.is_primary():
+                import shutil
+
+                for name in os.listdir(self.checkpoint_dir):
+                    m = self._SHARDED_RE.match(name)
+                    if m and int(m.group(1)) < epoch - 1:
+                        shutil.rmtree(
+                            os.path.join(self.checkpoint_dir, name),
+                            ignore_errors=True,
+                        )
+            self._pending = checkpoint.save_sharded_async(
+                self._sharded_path(epoch), payload
+            )
+        elif runtime.is_primary():
+            if self._pending is not None:
+                self._pending.join()
+            self._pending = checkpoint.save_async(self._ckpt_path(), payload)
+
+    def on_train_end(self, logs=None):
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+
+    @property
+    def ema_params(self):
+        if self._ema is None:
+            raise RuntimeError("EMA not initialized — runs at fit()")
+        if self.zero_debias and self._count > 0:
+            corr = 1.0 - self.decay ** self._count
+            return jax.tree.map(lambda a: a / corr, self._ema)
+        # Fresh buffers, never the live shadow: the next update DONATES the
+        # shadow's buffers, so a returned reference would turn into a
+        # deleted jax.Array if training continues (e.g. a second fit() with
+        # this callback, or reading mid-training).
+        return jax.tree.map(lambda a: a + 0, self._ema)
+
+    def averaged(self, trainer=None):
+        """Context manager: trainer.state carries the EMA weights inside
+        the block, the live weights after."""
+        import contextlib
+
+        trainer = trainer or self.trainer
+
+        @contextlib.contextmanager
+        def swap():
+            live = trainer.state.params
+            trainer.state = trainer.state.replace(params=self.ema_params)
+            try:
+                yield
+            finally:
+                trainer.state = trainer.state.replace(params=live)
+
+        return swap()
+

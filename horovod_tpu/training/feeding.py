@@ -1,0 +1,557 @@
+"""The Trainer's feeding, evaluation and prediction paths.
+
+Split out of trainer.py (round 5). Everything input-side lives here: batch
+sharding onto the mesh (custom batch_specs included), the multi-process
+feed-group layout, the streamed fit path (prefetched, steps_per_execution
+chunking), the device-cached fit/eval paths (datasets staged into HBM,
+whole epochs as one dispatch), epoch bookkeeping, and the padded/masked
+slice contract shared by evaluate and predict. Functions take the Trainer
+instance; the Trainer's public verbs delegate here.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu import runtime
+from horovod_tpu.data.loader import ArrayDataset, training_pipeline
+from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.parallel import sharding as sharding_lib
+from horovod_tpu.training.train_state import (
+    _run_train_end,
+    _teardown_callbacks,
+)
+
+
+def shard_batch(trainer, batch):
+    if trainer.batch_specs is not None:
+        specs = tuple(trainer.batch_specs)
+
+        def put(x, spec):
+            return sharding_lib.put_global(
+                x, jax.sharding.NamedSharding(trainer.mesh, spec)
+            )
+
+        def put_part(part, spec):
+            # One batch part against its spec: a single PartitionSpec
+            # broadcasts over a pytree part (dict-input models), a
+            # matching spec pytree maps pairwise.
+            if isinstance(spec, jax.sharding.PartitionSpec):
+                return jax.tree.map(lambda a: put(a, spec), part)
+            return jax.tree.map(put, part, spec)
+
+        if not isinstance(batch, (tuple, list)):
+            return put_part(batch, specs[0])  # predict: bare x
+        if len(batch) == len(specs) + 1:
+            # evaluate() appends a per-example mask: batch-sharded only.
+            last = tuple(specs[-1])
+            specs = specs + (
+                jax.sharding.PartitionSpec(*last[:1]) if last
+                else jax.sharding.PartitionSpec(),
+            )
+        return tuple(
+            put_part(x, spec) for x, spec in zip(batch, specs)
+        )
+    return sharding_lib.shard_batch(batch, trainer.mesh)
+
+def feed_groups(trainer) -> tuple[int, int]:
+    """(n_groups, my_group): how processes map onto the data axis.
+
+    Processes feed batches in ``min(world, dp_size)`` distinct groups.
+    With dp >= world (the usual DP deployment) every process is its own
+    group. With dp < world (model-parallel-only meshes spanning
+    processes, e.g. pipe=2 over 2 hosts) several processes share one
+    data shard and MUST feed identical rows — the batch is logically
+    replicated across the non-data axes, and divergent per-process
+    contributions would silently give each device different contents
+    for the same global array."""
+    world = runtime.process_count()
+    dp = trainer.dp_size
+    groups = min(world, dp)
+    if world % groups != 0 or (dp >= world and dp % world != 0):
+        # e.g. 3 processes over dp=2: some rank would straddle two data
+        # shards and the grouping below would slice out-of-range rows —
+        # fail loudly instead of feeding wrong data.
+        raise ValueError(
+            f"process count ({world}) and data-parallel degree ({dp}) "
+            "must divide one another for a coherent feeding layout"
+        )
+    per_group = world // groups
+    return groups, runtime.process_rank() // per_group
+
+def local_slice(trainer, arr, global_batch: int):
+    """This feed-group's share of a globally-indexed batch — what
+    `make_array_from_process_local_data` expects as the local
+    contribution (each example fed exactly once across the data axis;
+    processes sharing a data shard contribute identical rows)."""
+    if runtime.process_count() == 1:
+        return arr
+    groups, group = feed_groups(trainer)
+    local = global_batch // groups
+    return arr[group * local : (group + 1) * local]
+
+def stage_sharded(trainer, arr, per_shard: int):
+    """Stage one host array as [n_shards, per_shard, ...] in HBM,
+    example-sharded over the data axes: shard s takes rows
+    [s*per_shard, (s+1)*per_shard); multi-process, each feed group
+    contributes the rows for its chips (processes sharing a data shard
+    stage identical rows — see _feed_groups)."""
+    groups, group = feed_groups(trainer)
+    local_shards = trainer.dp_size // groups
+    arr = np.asarray(arr)
+    lo = group * local_shards * per_shard
+    hi = (group + 1) * local_shards * per_shard
+    local = arr[lo:hi].reshape((local_shards, per_shard) + arr.shape[1:])
+    spec = jax.sharding.PartitionSpec(
+        (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
+        *([None] * arr.ndim),
+    )
+    return sharding_lib.put_global(
+        local, jax.sharding.NamedSharding(trainer.mesh, spec)
+    )
+
+def stage_device_dataset(trainer, x, y):
+    """Stage (x, y) into HBM as [n_shards, per_shard_n, ...] leaves,
+    example-sharded over the data axes (truncated to divide evenly)."""
+    n_shards = trainer.dp_size
+    n = (len(x) // n_shards) * n_shards
+    if n == 0:
+        raise ValueError(f"need at least {n_shards} examples")
+    per_shard = n // n_shards
+    return (
+        stage_sharded(trainer, np.asarray(x)[:n], per_shard),
+        stage_sharded(trainer, np.asarray(y)[:n], per_shard),
+    ), per_shard
+
+def shard_chunk(trainer, chunk):
+    """Place a [K, batch, ...] stack of K batches (steps_per_execution)
+    onto the mesh — the scan axis stays unsharded."""
+    if trainer.batch_specs is not None:
+        specs = tuple(trainer.batch_specs)
+
+        def put(x, spec):
+            return sharding_lib.put_global(
+                x,
+                jax.sharding.NamedSharding(
+                    trainer.mesh, jax.sharding.PartitionSpec(None, *tuple(spec))
+                ),
+            )
+
+        return tuple(put(x, spec) for x, spec in zip(chunk, specs))
+    return sharding_lib.shard_chunk(chunk, trainer.mesh)
+
+def slice_pad(trainer, part, start: int, global_batch: int):
+    """(batch slice padded to the compiled shape, true row count) for
+    one batch part — leaf-wise, so pytree (dict-input) parts feed like
+    flat arrays. ONE implementation of the multi-process padding
+    contract, shared by evaluate and predict."""
+    sliced = jax.tree.map(
+        lambda a: np.asarray(a[start : start + global_batch]), part
+    )
+    bs = len(jax.tree_util.tree_leaves(sliced)[0])
+    if bs < global_batch:
+        pad = global_batch - bs
+        sliced = jax.tree.map(
+            lambda a: np.concatenate([a, np.repeat(a[-1:], pad, 0)]),
+            sliced,
+        )
+    return sliced, bs
+
+def finish_epoch(trainer, epoch, epochs, metric_acc, steps, t0, callbacks,
+    validation_data, batch_size, verbose, val_cache=None,
+):
+    """Epoch bookkeeping shared by every fit path: ONE host fetch of the
+    in-step metric sums, optional validation, callbacks, history."""
+    sums = jax.device_get(metric_acc)
+    logs = {k: float(v) / steps for k, v in sums.items()}
+    logs["epoch_time_s"] = time.perf_counter() - t0
+    if validation_data is not None:
+        val = run_evaluate(trainer, 
+            validation_data[0], validation_data[1],
+            batch_size=batch_size, verbose=0, cache=val_cache,
+        )
+        logs.update({f"val_{k}": v for k, v in val.items()})
+    for cb in callbacks:
+        cb.on_epoch_end(epoch, logs)
+    trainer.history.append(logs)
+    if verbose:
+        shown = {k: round(v, 4) for k, v in logs.items()}
+        print(f"Epoch {epoch + 1}/{epochs} - {shown}")
+
+def run_fit(trainer,
+    dataset=None,
+    *,
+    x=None,
+    y=None,
+    batch_size: int = 128,
+    epochs: int = 1,
+    initial_epoch: int = 0,
+    steps_per_epoch: int | None = None,
+    callbacks: Sequence = (),
+    validation_data=None,
+    shuffle_buffer: int | None = None,
+    verbose: int | None = None,
+    cache: str | None = None,
+) -> list[dict]:
+    """Train. Either pass a batched ``ArrayDataset``/iterable of
+    ``(x, y)`` numpy batches (the TF2 script's idiom,
+    tensorflow2_keras_mnist.py:96) or raw ``x``/``y`` arrays with a
+    per-worker ``batch_size`` (the TF1 script's idiom,
+    mnist_keras.py:107-112).
+
+    ``initial_epoch`` is the Keras resume idiom: epoch numbering (and
+    LR-warmup position, checkpoint names) continues from a restored run —
+    pair it with `checkpoint.restore_latest_and_broadcast`.
+
+    ``cache='device'`` (with ``x``/``y``) stages the whole dataset into
+    HBM once, sharded over the data axes, and runs shuffling + batching +
+    training fully on-device: ONE dispatch and ONE metrics fetch per
+    epoch, zero per-step host involvement. This is the TPU-native answer
+    to input-bound training (datasets at MNIST/CIFAR scale are trivially
+    HBM-resident); on_batch_end callbacks fire once per epoch with the
+    last step's metrics."""
+    if verbose is None:
+        verbose = 1 if runtime.is_primary() else 0
+    if isinstance(x, list):
+        # Keras-parity: a plain list of example rows is one array input
+        # (the pre-pytree behavior); dict/tuple inputs stay pytrees.
+        x = np.asarray(x)
+    if cache == "device":
+        if x is None or y is None:
+            raise ValueError("cache='device' needs x=/y= arrays")
+        if len(jax.tree_util.tree_leaves(x)) != 1:
+            raise ValueError(
+                "cache='device' stages a single input array; pytree "
+                "(dict/tuple) inputs use the streamed fit path"
+            )
+        if trainer.batch_specs is not None and mesh_lib.has_live_model_axes(
+            trainer.mesh
+        ):
+            # The staged layout shards the batch dim only; custom batch
+            # layouts over live non-data axes (e.g. seq-sharded tokens)
+            # need the streamed path's batch_specs handling.
+            raise ValueError(
+                "cache='device' supports data-sharded batches only; "
+                "use the streamed fit path with batch_specs meshes"
+            )
+        return fit_device_cached(trainer, 
+            x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
+            callbacks, validation_data, verbose,
+        )
+    if cache is not None:
+        raise ValueError(f"unknown cache mode {cache!r}")
+
+    groups, group = feed_groups(trainer)
+    close_input = lambda: None  # noqa: E731
+    if dataset is None:
+        if x is None or y is None:
+            raise ValueError("pass either dataset= or x=/y=")
+        ds = ArrayDataset((x, y)).shard(group, groups)
+        n_local = ds.num_examples
+        # Global batch = per-worker batch × dp_size; each feed group
+        # contributes its share (see _feed_groups for the dp < world
+        # case, where processes sharing a shard feed identical rows).
+        local_batch = batch_size * trainer.dp_size // groups
+        if steps_per_epoch is None:
+            steps_per_epoch = max(1, n_local // local_batch)
+        # Batch assembly runs in the native C++ producer thread when
+        # available (overlapping shuffle/gather with the device step),
+        # pure Python otherwise — same semantics either way.
+        dataset, close_input = training_pipeline(
+            ds.arrays, local_batch, seed=trainer.seed,
+            shuffle_buffer=shuffle_buffer, structure=ds.structure,
+        )
+    elif steps_per_epoch is None:
+        raise ValueError("steps_per_epoch is required with a dataset")
+
+    it = iter(dataset)
+    first = next(it)
+    trainer.build(first[0], first[1])
+
+    for cb in callbacks:
+        cb.set_trainer(trainer)
+    try:
+        # on_train_begin sits INSIDE the teardown scope: an early
+        # installer (e.g. PreemptionCheckpointCallback's signal
+        # handler) must be torn down even when a LATER callback's
+        # begin hook raises.
+        for cb in callbacks:
+            cb.on_train_begin()
+
+        pending = first
+        # Zero metric accumulator, committed to the mesh's replicated
+        # sharding ONCE: a fresh uncommitted jnp.zeros each epoch would
+        # give the first step of every epoch a different input-sharding
+        # signature than the chained steps, ping-ponging between two
+        # executables.
+        zero_acc = sharding_lib.replicate(trainer.zero_metrics(), trainer.mesh)
+        # HVT_PROFILE=<dir> captures a jax.profiler trace of the training
+        # loop (XLA op + ICI collective timing) — the Horovod-Timeline
+        # env-var contract, primary-process-gated (trace.py).
+        from horovod_tpu import trace as trace_lib
+
+        with trace_lib.maybe_trace(trace_lib.profile_dir()):
+            fit_epochs(trainer, 
+                it, pending, zero_acc, epochs, initial_epoch,
+                steps_per_epoch, callbacks, validation_data, batch_size,
+                verbose,
+            )
+    except BaseException:
+        close_input()
+        _teardown_callbacks(callbacks)
+        raise
+    close_input()
+    _run_train_end(callbacks)
+    return trainer.history
+
+def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_epoch,
+    callbacks, validation_data, batch_size, verbose,
+):
+    from horovod_tpu.data.prefetch import DevicePrefetcher
+
+    # Per-epoch execution plan: full steps_per_execution chunks plus one
+    # remainder chunk (a second, smaller executable) when K doesn't
+    # divide the epoch.
+    spe = min(trainer.steps_per_execution, steps_per_epoch)
+    plan = [spe] * (steps_per_epoch // spe)
+    if steps_per_epoch % spe:
+        plan.append(steps_per_epoch % spe)
+    buffered = [pending]
+
+    def host_chunks():
+        # Host-side assembly of the execution units: single batches when
+        # K == 1, [K, ...] stacks otherwise.
+        for _ in range(initial_epoch, epochs):
+            for k in plan:
+                batches = [
+                    buffered.pop() if buffered else next(it)
+                    for _ in range(k)
+                ]
+                if spe == 1:
+                    yield batches[0]
+                else:
+                    # Stack K batches leaf-wise — pytree batches (dict
+                    # inputs, multi-input models) stack like flat ones.
+                    yield jax.tree.map(
+                        lambda *xs: np.stack(xs), *batches
+                    )
+
+    # Batches are staged onto the devices by a background thread while
+    # the current step computes — transfer enqueue never blocks dispatch.
+    run = trainer._train_step if spe == 1 else trainer._train_chunk
+    prefetcher = DevicePrefetcher(
+        host_chunks(), trainer._shard if spe == 1 else trainer._shard_chunk
+    )
+    try:
+        for epoch in range(initial_epoch, epochs):
+            if trainer.stop_training:
+                break
+            # Fresh scale each epoch (see _fit_device_cached note).
+            trainer.update_scale = 1.0
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            t0 = time.perf_counter()
+            scale = jnp.asarray(trainer.update_scale, jnp.float32)
+            metric_acc = zero_acc
+            step = 0
+            for k in plan:
+                chunk = next(prefetcher)
+                trainer.state, metrics, metric_acc = run(
+                    trainer.state, chunk, scale, metric_acc
+                )
+                step += k
+                # Once per execution, with the last step's metrics —
+                # Keras's steps_per_execution callback semantics.
+                for cb in callbacks:
+                    cb.on_batch_end(step - 1, metrics)
+            finish_epoch(trainer, 
+                epoch, epochs, metric_acc, steps_per_epoch, t0, callbacks,
+                validation_data, batch_size, verbose,
+            )
+    finally:
+        prefetcher.close()
+
+def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
+    callbacks, validation_data, verbose,
+):
+    from horovod_tpu import trace as trace_lib
+
+    data, per_shard = stage_device_dataset(trainer, x, y)
+    max_steps = per_shard // batch_size
+    if max_steps == 0:
+        raise ValueError(
+            f"per-shard examples ({per_shard}) < per-chip batch "
+            f"({batch_size})"
+        )
+    steps = min(steps_per_epoch or max_steps, max_steps)
+    trainer.build(
+        np.asarray(x[: trainer.dp_size]), np.asarray(y[: trainer.dp_size])
+    )
+
+    for cb in callbacks:
+        cb.set_trainer(trainer)
+    try:
+        # Inside the teardown scope — see the streamed fit path's note.
+        for cb in callbacks:
+            cb.on_train_begin()
+        zero_acc = sharding_lib.replicate(trainer.zero_metrics(), trainer.mesh)
+        epoch_key = jax.random.PRNGKey(trainer.seed + 1)
+        with trace_lib.maybe_trace(trace_lib.profile_dir()):
+            for epoch in range(initial_epoch, epochs):
+                if trainer.stop_training:
+                    break
+                # Fresh scale each epoch: LR callbacks compose into it
+                # in list order (warmup assigns, schedules multiply).
+                trainer.update_scale = 1.0
+                for cb in callbacks:
+                    cb.on_epoch_begin(epoch)
+                t0 = time.perf_counter()
+                scale = jnp.asarray(trainer.update_scale, jnp.float32)
+                trainer.state, metrics, metric_acc = trainer._train_epoch(
+                    trainer.state, data, jax.random.fold_in(epoch_key, epoch),
+                    scale, zero_acc, steps, batch_size,
+                )
+                for cb in callbacks:
+                    cb.on_batch_end(steps - 1, metrics)
+                finish_epoch(trainer, 
+                    epoch, epochs, metric_acc, steps, t0, callbacks,
+                    validation_data, batch_size, verbose,
+                    # Device-cached training implies device-cached
+                    # validation.
+                    val_cache="device",
+                )
+    except BaseException:
+        _teardown_callbacks(callbacks)
+        raise
+    _run_train_end(callbacks)
+    return trainer.history
+
+def evaluate_device_cached(trainer, x, y, batch_size: int) -> dict:
+    """evaluate() over a device-resident eval set: stage once (padded to
+    full batches, padding masked), then each call is ONE dispatch + one
+    3-scalar fetch. The per-epoch validation pass stops restreaming the
+    test set from the host every epoch.
+
+    Caching is by the host arrays' identity: do not mutate ``x``/``y``
+    in place while cached, or stale staged data is evaluated."""
+    key = (id(x), id(y), batch_size)
+    if key not in trainer._eval_cache:
+        n = len(x)
+        n_shards = trainer.dp_size
+        per = -(-n // (n_shards * batch_size)) * batch_size  # ceil→pad
+        pad_n = per * n_shards
+        mask = np.zeros(pad_n, np.float32)
+        mask[:n] = 1.0
+
+        def padded(a):
+            # Repeat a REAL example into the padded tail (like the
+            # streamed path): all-zero rows could produce non-finite
+            # losses in input-normalizing models, and NaN*0 = NaN would
+            # poison the masked sums.
+            a = np.asarray(a)
+            out = np.concatenate(
+                [a, np.repeat(a[-1:], pad_n - n, axis=0)]
+            )
+            return out
+
+        data = (
+            stage_sharded(trainer, padded(x), per),
+            stage_sharded(trainer, padded(y), per),
+            stage_sharded(trainer, mask, per),
+        )
+        # Keep x/y referenced so their ids stay unique while cached.
+        trainer._eval_cache[key] = (data, per // batch_size, (x, y))
+        if len(trainer._eval_cache) > 4:  # bound device memory
+            trainer._eval_cache.pop(next(iter(trainer._eval_cache)))
+    data, steps, _ = trainer._eval_cache[key]
+    m = jax.device_get(
+        trainer._eval_epoch(trainer.state, data, steps, batch_size)
+    )
+    return {
+        "loss": float(m["loss_sum"]) / float(m["count"]),
+        "accuracy": float(m["correct_sum"]) / float(m["count"]),
+    }
+
+def run_evaluate(trainer, x, y, batch_size: int = 128, verbose: int = 0,
+    cache: str | None = None,
+) -> dict:
+    """Full-dataset eval on the mesh. Unlike the reference (every rank
+    redundantly evaluates the full test set, SURVEY.md §3.2), the eval
+    batch is sharded across chips — same result, 1/size the work.
+    ``cache='device'`` keeps the (padded, masked) eval set in HBM and
+    runs the whole pass as one compiled scan."""
+    if trainer.state is None:
+        raise RuntimeError("call fit() or build() first")
+    if (
+        cache == "device"
+        and trainer.batch_specs is not None
+        and mesh_lib.has_live_model_axes(trainer.mesh)
+    ):
+        # Custom batch layouts over LIVE non-data axes (e.g. seq-sharded
+        # tokens) need _shard's spec handling; the cached path stages
+        # batch-dim-only. With those axes trivial the layouts coincide —
+        # same condition as fit(cache='device')'s guard.
+        cache = None
+    if isinstance(x, list):
+        x = np.asarray(x)  # list-of-rows = one array input (see fit)
+    if cache == "device":
+        if len(jax.tree_util.tree_leaves(x)) != 1:
+            raise ValueError(
+                "cache='device' stages a single input array; pytree "
+                "(dict/tuple) inputs use the streamed eval path"
+            )
+        result = evaluate_device_cached(trainer, x, y, batch_size)
+        if verbose and runtime.is_primary():
+            print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
+        return result
+    if cache is not None:
+        raise ValueError(f"unknown cache mode {cache!r}")
+    # x may be a pytree (dict-input models, e.g. seq2seq) — slice, pad
+    # and shard leaf-wise; y/mask stay flat arrays.
+    n = len(jax.tree_util.tree_leaves(x)[0])
+    global_batch = batch_size * trainer.dp_size
+    loss_sum = correct_sum = count = 0.0
+    for start in range(0, n, global_batch):
+        xb, bs = slice_pad(trainer, x, start, global_batch)
+        yb, _ = slice_pad(trainer, y, start, global_batch)
+        mask = np.ones((global_batch,), np.float32)
+        mask[bs:] = 0.0
+        batch = tuple(
+            jax.tree.map(
+                lambda a: local_slice(trainer, a, global_batch), part
+            )
+            for part in (xb, yb, mask)
+        )
+        m = jax.device_get(trainer._eval_step(trainer.state, shard_batch(trainer, batch)))
+        loss_sum += float(m["loss_sum"])
+        correct_sum += float(m["correct_sum"])
+        count += float(m["count"])
+    result = {"loss": loss_sum / count, "accuracy": correct_sum / count}
+    if verbose and runtime.is_primary():
+        print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
+    return result
+
+def run_predict(trainer, x, batch_size: int = 128) -> np.ndarray:
+    """Class probabilities (softmax applied here, keeping the serving
+    contract input→prob, mnist_keras.py:133-134). ``x`` may be a pytree
+    (dict-input models) — slice/pad/shard run leaf-wise, like
+    `evaluate`."""
+    if trainer.state is None:
+        raise RuntimeError("call fit() or build() first")
+    if isinstance(x, list):
+        x = np.asarray(x)  # list-of-rows = one array input (see fit)
+    out = []
+    global_batch = batch_size * trainer.dp_size
+    n = len(jax.tree_util.tree_leaves(x)[0])
+    for start in range(0, n, global_batch):
+        xb, bs = slice_pad(trainer, x, start, global_batch)
+        xb = jax.tree.map(
+            lambda a: local_slice(trainer, a, global_batch), xb
+        )
+        probs = jax.device_get(trainer._predict_step(trainer.state, shard_batch(trainer, xb)))
+        out.append(probs[:bs])
+    return np.concatenate(out, axis=0)

@@ -1,0 +1,141 @@
+"""TrainState and the Trainer's loss/metric/callback helpers.
+
+Split out of trainer.py (round 5): the state dataclass every subsystem
+broadcasts/checkpoints, the Keras-style loss resolution, sown-metric
+aggregation, and the callback teardown discipline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+PyTree = Any
+
+
+@flax.struct.dataclass
+class TrainState:
+    """The full broadcastable training state.
+
+    Horovod's BroadcastGlobalVariablesCallback covers model *and* optimizer
+    variables (SURVEY.md §7.3); keeping them in one pytree makes
+    broadcast/checkpoint cover both by construction. ``model_state`` holds
+    non-parameter variable collections (e.g. BatchNorm ``batch_stats``);
+    under SPMD jit those statistics are computed over the *global* batch, so
+    cross-replica BN sync — an extra op in GPU data-parallel stacks — is the
+    default semantics here."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    rng: jax.Array
+    model_state: PyTree = None
+
+
+def _resolve_loss(loss) -> Callable:
+    """Map Keras-style loss names to fused-logits implementations.
+
+    Covers both reference losses: SparseCategoricalCrossentropy
+    (tensorflow2_keras_mnist.py:63) and categorical_crossentropy
+    (mnist_keras.py:89)."""
+    if callable(loss):
+        return loss
+    # 'module': the module computes its own loss — apply(x, labels=y)
+    # returns (per_token_loss, per_token_correct). The contract of the fused
+    # chunked-CE head (TransformerLM(fused_head_chunks=...), ops/fused_ce.py),
+    # where materializing logits for a Trainer-side loss would defeat the op.
+    if loss == "module":
+        return None
+    # Upcast at the loss boundary: models may emit 16-bit logits to halve
+    # long-sequence HBM (TransformerLM logits_dtype) — the f32 cast fuses
+    # into the logsumexp chain, so statistics are f32-accurate without a
+    # materialized f32 copy. No-op for f32 logits.
+    if loss in ("sparse_categorical_crossentropy", "sparse_ce"):
+        return lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        )
+    if loss in ("categorical_crossentropy", "ce"):
+        return lambda logits, labels: optax.softmax_cross_entropy(
+            logits.astype(jnp.float32), labels
+        )
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+def _accuracy(logits, labels):
+    pred = jnp.argmax(logits, axis=-1)
+    if labels.ndim == logits.ndim:  # one-hot
+        labels = jnp.argmax(labels, axis=-1)
+    return (pred == labels).astype(jnp.float32).mean()
+
+
+def _aggregate_sown_metrics(sown) -> dict:
+    """Collapse a sown 'metrics' collection to ``{name: scalar}``: leaves
+    sharing their final sow name (e.g. every MoE layer's 'moe_drop_rate')
+    are averaged. This is the module→Trainer observability channel — any
+    scalar a module sows into 'metrics' lands in the step metrics, the
+    epoch logs, and every metrics sink, with no Trainer changes."""
+    out: dict = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(sown)[0]:
+        names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
+        if names:
+            out.setdefault(names[-1], []).append(
+                jnp.asarray(leaf, jnp.float32)
+            )
+    return {k: jnp.mean(jnp.stack(v)) for k, v in out.items()}
+
+
+def _param_shaped_matcher(params):
+    """Predicate: is a subtree exactly param-shaped (same treedef, same leaf
+    shapes)? Used to find the optimizer-state mirrors (momenta etc.) that
+    must carry a parameter-derived sharding."""
+    params_def = jax.tree.structure(params)
+    params_shapes = jax.tree.leaves(jax.tree.map(lambda p: p.shape, params))
+
+    def param_shaped(subtree) -> bool:
+        try:
+            if jax.tree.structure(subtree) != params_def:
+                return False
+            return (
+                jax.tree.leaves(jax.tree.map(lambda l: l.shape, subtree))
+                == params_shapes
+            )
+        except Exception:
+            return False
+
+    return param_shaped
+
+
+def _run_train_end(callbacks) -> None:
+    """on_train_end for the SUCCESS path: every hook runs even when an
+    earlier one raises (PreemptionCheckpointCallback's SystemExit must not
+    skip a later ModelCheckpoint's async-save join — its daemon thread
+    would be killed at interpreter exit with the write half-done); the
+    first raised exception propagates after all hooks ran."""
+    first: BaseException | None = None
+    for cb in callbacks:
+        try:
+            cb.on_train_end()
+        except BaseException as e:
+            if first is None:
+                first = e
+    if first is not None:
+        raise first
+
+
+def _teardown_callbacks(callbacks) -> None:
+    """Best-effort on_train_end while a training error unwinds: teardown
+    hooks (signal-handler restoration, writer flush/close, async-save
+    joins) must still run — a PreemptionCheckpointCallback left installed
+    after a crash would silently swallow the NEXT real SIGTERM — but their
+    own failures (including the preemption callback's SystemExit) must not
+    mask the original error."""
+    for cb in callbacks:
+        try:
+            cb.on_train_end()
+        except BaseException:
+            pass

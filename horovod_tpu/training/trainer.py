@@ -17,7 +17,6 @@ carries over unchanged.
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, Sequence
 
 import flax.struct
@@ -27,136 +26,23 @@ import numpy as np
 import optax
 
 from horovod_tpu import runtime
-from horovod_tpu.data.loader import ArrayDataset, training_pipeline
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.parallel import sharding as sharding_lib
 from horovod_tpu.training.optimizer import compression_dtype
 
 PyTree = Any
 
-
-@flax.struct.dataclass
-class TrainState:
-    """The full broadcastable training state.
-
-    Horovod's BroadcastGlobalVariablesCallback covers model *and* optimizer
-    variables (SURVEY.md §7.3); keeping them in one pytree makes
-    broadcast/checkpoint cover both by construction. ``model_state`` holds
-    non-parameter variable collections (e.g. BatchNorm ``batch_stats``);
-    under SPMD jit those statistics are computed over the *global* batch, so
-    cross-replica BN sync — an extra op in GPU data-parallel stacks — is the
-    default semantics here."""
-
-    step: jax.Array
-    params: PyTree
-    opt_state: PyTree
-    rng: jax.Array
-    model_state: PyTree = None
-
-
-def _resolve_loss(loss) -> Callable:
-    """Map Keras-style loss names to fused-logits implementations.
-
-    Covers both reference losses: SparseCategoricalCrossentropy
-    (tensorflow2_keras_mnist.py:63) and categorical_crossentropy
-    (mnist_keras.py:89)."""
-    if callable(loss):
-        return loss
-    # 'module': the module computes its own loss — apply(x, labels=y)
-    # returns (per_token_loss, per_token_correct). The contract of the fused
-    # chunked-CE head (TransformerLM(fused_head_chunks=...), ops/fused_ce.py),
-    # where materializing logits for a Trainer-side loss would defeat the op.
-    if loss == "module":
-        return None
-    # Upcast at the loss boundary: models may emit 16-bit logits to halve
-    # long-sequence HBM (TransformerLM logits_dtype) — the f32 cast fuses
-    # into the logsumexp chain, so statistics are f32-accurate without a
-    # materialized f32 copy. No-op for f32 logits.
-    if loss in ("sparse_categorical_crossentropy", "sparse_ce"):
-        return lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), labels
-        )
-    if loss in ("categorical_crossentropy", "ce"):
-        return lambda logits, labels: optax.softmax_cross_entropy(
-            logits.astype(jnp.float32), labels
-        )
-    raise ValueError(f"unknown loss {loss!r}")
-
-
-def _accuracy(logits, labels):
-    pred = jnp.argmax(logits, axis=-1)
-    if labels.ndim == logits.ndim:  # one-hot
-        labels = jnp.argmax(labels, axis=-1)
-    return (pred == labels).astype(jnp.float32).mean()
-
-
-def _aggregate_sown_metrics(sown) -> dict:
-    """Collapse a sown 'metrics' collection to ``{name: scalar}``: leaves
-    sharing their final sow name (e.g. every MoE layer's 'moe_drop_rate')
-    are averaged. This is the module→Trainer observability channel — any
-    scalar a module sows into 'metrics' lands in the step metrics, the
-    epoch logs, and every metrics sink, with no Trainer changes."""
-    out: dict = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(sown)[0]:
-        names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
-        if names:
-            out.setdefault(names[-1], []).append(
-                jnp.asarray(leaf, jnp.float32)
-            )
-    return {k: jnp.mean(jnp.stack(v)) for k, v in out.items()}
-
-
-def _param_shaped_matcher(params):
-    """Predicate: is a subtree exactly param-shaped (same treedef, same leaf
-    shapes)? Used to find the optimizer-state mirrors (momenta etc.) that
-    must carry a parameter-derived sharding."""
-    params_def = jax.tree.structure(params)
-    params_shapes = jax.tree.leaves(jax.tree.map(lambda p: p.shape, params))
-
-    def param_shaped(subtree) -> bool:
-        try:
-            if jax.tree.structure(subtree) != params_def:
-                return False
-            return (
-                jax.tree.leaves(jax.tree.map(lambda l: l.shape, subtree))
-                == params_shapes
-            )
-        except Exception:
-            return False
-
-    return param_shaped
-
-
-def _run_train_end(callbacks) -> None:
-    """on_train_end for the SUCCESS path: every hook runs even when an
-    earlier one raises (PreemptionCheckpointCallback's SystemExit must not
-    skip a later ModelCheckpoint's async-save join — its daemon thread
-    would be killed at interpreter exit with the write half-done); the
-    first raised exception propagates after all hooks ran."""
-    first: BaseException | None = None
-    for cb in callbacks:
-        try:
-            cb.on_train_end()
-        except BaseException as e:
-            if first is None:
-                first = e
-    if first is not None:
-        raise first
-
-
-def _teardown_callbacks(callbacks) -> None:
-    """Best-effort on_train_end while a training error unwinds: teardown
-    hooks (signal-handler restoration, writer flush/close, async-save
-    joins) must still run — a PreemptionCheckpointCallback left installed
-    after a crash would silently swallow the NEXT real SIGTERM — but their
-    own failures (including the preemption callback's SystemExit) must not
-    mask the original error."""
-    for cb in callbacks:
-        try:
-            cb.on_train_end()
-        except BaseException:
-            pass
-
+from horovod_tpu.training import build as build_lib
+from horovod_tpu.training import feeding
+from horovod_tpu.training.train_state import (  # noqa: F401 — re-exported:
+    TrainState,          # the public state dataclass
+    _accuracy,
+    _aggregate_sown_metrics,
+    _param_shaped_matcher,
+    _resolve_loss,
+    _run_train_end,
+    _teardown_callbacks,
+)
 
 class Trainer:
     """compile+fit+evaluate+predict for a flax module over a device mesh.
@@ -571,7 +457,6 @@ class Trainer:
         self._predict_step = jax.jit(
             predict_step, out_shardings=sharding_lib.replicated(self.mesh)
         )
-
     # --- state management ---------------------------------------------------
 
     @property
@@ -590,719 +475,38 @@ class Trainer:
 
     def build(self, sample_x: np.ndarray, sample_y=None) -> TrainState:
         """Initialize parameters (lazy, from the first batch — like Keras
-        building on first fit).
+        building on first fit); see `training.build.build_state` for the
+        full contract (module-loss labels, TP/FSDP placement, ZeRO-1)."""
+        return build_lib.build_state(self, sample_x, sample_y)
 
-        With ``loss='module'`` the init passes labels so the module traces
-        its fused-loss branch (see below): ``sample_y`` when given, else
-        labels synthesized as ``zeros_like(sample_x)`` — valid for the LM
-        family, where labels share the token batch's shape/dtype. Models
-        whose labels differ from their inputs in dtype/shape/structure must
-        pass ``sample_y`` (``fit`` always does)."""
-        if self.state is not None:
-            return self.state
-        rng = jax.random.PRNGKey(self.seed)
-        init_rng, dropout_rng, state_rng = jax.random.split(rng, 3)
-        # Init batch sized to the data-parallel degree: models that carry
-        # internal sharding constraints need the batch dim divisible by it.
-        # Leaf-wise so pytree (dict-input) samples build like flat ones.
-        n = self.dp_size
-
-        def size_to_dp(a):
-            a = np.asarray(a)
-            if len(a) < n:
-                a = np.concatenate([a] * (-(-n // len(a))))
-            return jnp.asarray(a[:n])
-
-        sized_x = jax.tree.map(size_to_dp, sample_x)
-        # loss='module' contract: init with labels so the module traces its
-        # fused-loss branch — otherwise build() materializes the dense
-        # [B, T, vocab] logits that the fused head exists to avoid, making
-        # init the OOM point at long-context scale even though train/eval
-        # steps are fused. Real labels when the caller has them; the
-        # zeros_like fallback matches the LM family's labels-share-the-
-        # token-batch contract (models/transformer.py `__call__`).
-        init_kwargs = {}
-        synthesized_labels = False
-        if self._module_loss:
-            if sample_y is not None:
-                init_kwargs["labels"] = jax.tree.map(size_to_dp, sample_y)
-            else:
-                init_kwargs["labels"] = jax.tree.map(jnp.zeros_like, sized_x)
-                synthesized_labels = True
-        try:
-            variables = self.module.init(
-                {"params": init_rng, "dropout": dropout_rng},
-                sized_x,
-                train=False,
-                **init_kwargs,
-            )
-        except Exception as e:
-            if synthesized_labels:
-                # The zeros_like fallback assumes LM-style labels (same
-                # shape/dtype as the token batch). For any other module the
-                # trace fails opaquely deep inside init — name the fix.
-                # Mutating args (not re-wrapping) keeps the exception type
-                # even for types with non-string constructors.
-                hint = (
-                    "\n\nhorovod_tpu hint: build() was called with "
-                    "loss='module' and no sample_y, so labels were "
-                    "synthesized as zeros_like(sample_x) (the LM-family "
-                    "contract). If this module's labels differ from its "
-                    "inputs in shape/dtype, pass sample_y to build() — "
-                    "fit() does this automatically."
-                )
-                head = str(e.args[0]) if e.args else str(e)
-                e.args = (head + hint,) + tuple(e.args[1:])
-            raise
-        params = variables["params"]
-        # Sown per-apply channels never persist in the carried state: values
-        # are produced fresh each step ('losses' → objective, 'metrics' →
-        # observability). Their presence at init DOES reveal the metric
-        # names, which sizes the epoch accumulator — which is why 'metrics'
-        # sows must be UNCONDITIONAL (not train-gated): a name that appears
-        # only at train time couldn't be discovered here, and the step
-        # checks for that drift loudly (see train_step).
-        self._metric_names = tuple(
-            sorted(_aggregate_sown_metrics(variables.get("metrics", {})))
-        )
-        reserved = {"loss", "accuracy"} & set(self._metric_names)
-        if reserved:
-            raise ValueError(
-                f"module sows 'metrics' entries named {sorted(reserved)}, "
-                "which would silently overwrite the Trainer's own "
-                "loss/accuracy in every log and sink — rename the sow"
-            )
-        model_state = {
-            k: v
-            for k, v in variables.items()
-            if k not in ("params", "losses", "metrics")
-        }
-        self._mutable = sorted(model_state.keys())
-        if self.param_specs is not None:
-            specs = (
-                self.param_specs(params, self.mesh)
-                if callable(self.param_specs)
-                else self.param_specs
-            )
-            self._param_shardings = jax.tree.map(
-                lambda s: jax.sharding.NamedSharding(self.mesh, s),
-                specs,
-                is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
-            )
-            params = jax.device_put(params, self._param_shardings)
-            # Optimizer mirrors (momenta etc.) must carry the param layout.
-            # Sharding propagation can't deliver it — `init` is zeros_like,
-            # which reads only shapes, so XLA sees an input-free computation —
-            # hence explicit out_shardings: any opt-state subtree that is
-            # param-shaped gets the param shardings, the rest replicate.
-            rep = sharding_lib.replicated(self.mesh)
-            param_shaped = _param_shaped_matcher(params)
-            opt_shardings = jax.tree.map(
-                lambda sub: self._param_shardings if param_shaped(sub) else rep,
-                jax.eval_shape(self.tx.init, params),
-                is_leaf=param_shaped,
-            )
-            opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
-            state = TrainState(
-                step=jax.device_put(jnp.zeros((), jnp.int32), rep),
-                params=params,
-                opt_state=opt_state,
-                rng=jax.device_put(state_rng, rep),
-                model_state=sharding_lib.replicate(model_state, self.mesh)
-                if model_state
-                else None,
-            )
-            self.state = state
-        elif (
-            self.shard_update
-            and self.mesh.shape.get(mesh_lib.DATA_AXIS, 1) > 1
-        ):
-            # ZeRO-1 (arXiv:2004.13336): replicated params, optimizer state
-            # sharded dim-0 over the data axis. The jitted step then
-            # compiles the paper's transformation — gradients reduce-scatter
-            # into the update shard each replica owns, and the applied
-            # params all-gather back — purely from these init shardings.
-            dp = self.mesh.shape[mesh_lib.DATA_AXIS]
-            rep = sharding_lib.replicated(self.mesh)
-            param_shaped = _param_shaped_matcher(params)
-
-            def zero1(shape):
-                # First dp-divisible dim carries the shard (dim 0 for the
-                # matmul kernels that dominate; conv kernels usually shard
-                # their channel dims); nothing divisible → replicate.
-                for i, dim in enumerate(shape):
-                    if dim % dp == 0:
-                        spec = [None] * len(shape)
-                        spec[i] = mesh_lib.DATA_AXIS
-                        return jax.sharding.NamedSharding(
-                            self.mesh, jax.sharding.PartitionSpec(*spec)
-                        )
-                return rep
-
-            opt_shardings = jax.tree.map(
-                lambda sub: jax.tree.map(lambda l: zero1(l.shape), sub)
-                if param_shaped(sub)
-                else rep,
-                jax.eval_shape(self.tx.init, params),
-                is_leaf=param_shaped,
-            )
-            params = jax.device_put(params, rep)
-            opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(
-                params
-            )
-            state = TrainState(
-                step=jax.device_put(jnp.zeros((), jnp.int32), rep),
-                params=params,
-                opt_state=opt_state,
-                rng=jax.device_put(state_rng, rep),
-                model_state=sharding_lib.replicate(model_state, self.mesh)
-                if model_state
-                else None,
-            )
-            self.state = state
-        else:
-            state = TrainState(
-                step=jnp.zeros((), jnp.int32),
-                params=params,
-                opt_state=self.tx.init(params),
-                rng=state_rng,
-                model_state=model_state or None,
-            )
-            self.state = sharding_lib.replicate(state, self.mesh)
-        return self.state
+    # --- feeding / verbs — bodies live in training/feeding.py --------------
 
     def _shard(self, batch):
-        if self.batch_specs is not None:
-            specs = tuple(self.batch_specs)
-
-            def put(x, spec):
-                return sharding_lib.put_global(
-                    x, jax.sharding.NamedSharding(self.mesh, spec)
-                )
-
-            def put_part(part, spec):
-                # One batch part against its spec: a single PartitionSpec
-                # broadcasts over a pytree part (dict-input models), a
-                # matching spec pytree maps pairwise.
-                if isinstance(spec, jax.sharding.PartitionSpec):
-                    return jax.tree.map(lambda a: put(a, spec), part)
-                return jax.tree.map(put, part, spec)
-
-            if not isinstance(batch, (tuple, list)):
-                return put_part(batch, specs[0])  # predict: bare x
-            if len(batch) == len(specs) + 1:
-                # evaluate() appends a per-example mask: batch-sharded only.
-                last = tuple(specs[-1])
-                specs = specs + (
-                    jax.sharding.PartitionSpec(*last[:1]) if last
-                    else jax.sharding.PartitionSpec(),
-                )
-            return tuple(
-                put_part(x, spec) for x, spec in zip(batch, specs)
-            )
-        return sharding_lib.shard_batch(batch, self.mesh)
-
-    def _feed_groups(self) -> tuple[int, int]:
-        """(n_groups, my_group): how processes map onto the data axis.
-
-        Processes feed batches in ``min(world, dp_size)`` distinct groups.
-        With dp >= world (the usual DP deployment) every process is its own
-        group. With dp < world (model-parallel-only meshes spanning
-        processes, e.g. pipe=2 over 2 hosts) several processes share one
-        data shard and MUST feed identical rows — the batch is logically
-        replicated across the non-data axes, and divergent per-process
-        contributions would silently give each device different contents
-        for the same global array."""
-        world = runtime.process_count()
-        dp = self.dp_size
-        groups = min(world, dp)
-        if world % groups != 0 or (dp >= world and dp % world != 0):
-            # e.g. 3 processes over dp=2: some rank would straddle two data
-            # shards and the grouping below would slice out-of-range rows —
-            # fail loudly instead of feeding wrong data.
-            raise ValueError(
-                f"process count ({world}) and data-parallel degree ({dp}) "
-                "must divide one another for a coherent feeding layout"
-            )
-        per_group = world // groups
-        return groups, runtime.process_rank() // per_group
-
-    def _local_slice(self, arr, global_batch: int):
-        """This feed-group's share of a globally-indexed batch — what
-        `make_array_from_process_local_data` expects as the local
-        contribution (each example fed exactly once across the data axis;
-        processes sharing a data shard contribute identical rows)."""
-        if runtime.process_count() == 1:
-            return arr
-        groups, group = self._feed_groups()
-        local = global_batch // groups
-        return arr[group * local : (group + 1) * local]
-
-    # --- Keras-parity verbs -------------------------------------------------
-
-    def fit(
-        self,
-        dataset=None,
-        *,
-        x=None,
-        y=None,
-        batch_size: int = 128,
-        epochs: int = 1,
-        initial_epoch: int = 0,
-        steps_per_epoch: int | None = None,
-        callbacks: Sequence = (),
-        validation_data=None,
-        shuffle_buffer: int | None = None,
-        verbose: int | None = None,
-        cache: str | None = None,
-    ) -> list[dict]:
-        """Train. Either pass a batched ``ArrayDataset``/iterable of
-        ``(x, y)`` numpy batches (the TF2 script's idiom,
-        tensorflow2_keras_mnist.py:96) or raw ``x``/``y`` arrays with a
-        per-worker ``batch_size`` (the TF1 script's idiom,
-        mnist_keras.py:107-112).
-
-        ``initial_epoch`` is the Keras resume idiom: epoch numbering (and
-        LR-warmup position, checkpoint names) continues from a restored run —
-        pair it with `checkpoint.restore_latest_and_broadcast`.
-
-        ``cache='device'`` (with ``x``/``y``) stages the whole dataset into
-        HBM once, sharded over the data axes, and runs shuffling + batching +
-        training fully on-device: ONE dispatch and ONE metrics fetch per
-        epoch, zero per-step host involvement. This is the TPU-native answer
-        to input-bound training (datasets at MNIST/CIFAR scale are trivially
-        HBM-resident); on_batch_end callbacks fire once per epoch with the
-        last step's metrics."""
-        if verbose is None:
-            verbose = 1 if runtime.is_primary() else 0
-        if isinstance(x, list):
-            # Keras-parity: a plain list of example rows is one array input
-            # (the pre-pytree behavior); dict/tuple inputs stay pytrees.
-            x = np.asarray(x)
-        if cache == "device":
-            if x is None or y is None:
-                raise ValueError("cache='device' needs x=/y= arrays")
-            if len(jax.tree_util.tree_leaves(x)) != 1:
-                raise ValueError(
-                    "cache='device' stages a single input array; pytree "
-                    "(dict/tuple) inputs use the streamed fit path"
-                )
-            if self.batch_specs is not None and mesh_lib.has_live_model_axes(
-                self.mesh
-            ):
-                # The staged layout shards the batch dim only; custom batch
-                # layouts over live non-data axes (e.g. seq-sharded tokens)
-                # need the streamed path's batch_specs handling.
-                raise ValueError(
-                    "cache='device' supports data-sharded batches only; "
-                    "use the streamed fit path with batch_specs meshes"
-                )
-            return self._fit_device_cached(
-                x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
-                callbacks, validation_data, verbose,
-            )
-        if cache is not None:
-            raise ValueError(f"unknown cache mode {cache!r}")
-
-        groups, group = self._feed_groups()
-        close_input = lambda: None  # noqa: E731
-        if dataset is None:
-            if x is None or y is None:
-                raise ValueError("pass either dataset= or x=/y=")
-            ds = ArrayDataset((x, y)).shard(group, groups)
-            n_local = ds.num_examples
-            # Global batch = per-worker batch × dp_size; each feed group
-            # contributes its share (see _feed_groups for the dp < world
-            # case, where processes sharing a shard feed identical rows).
-            local_batch = batch_size * self.dp_size // groups
-            if steps_per_epoch is None:
-                steps_per_epoch = max(1, n_local // local_batch)
-            # Batch assembly runs in the native C++ producer thread when
-            # available (overlapping shuffle/gather with the device step),
-            # pure Python otherwise — same semantics either way.
-            dataset, close_input = training_pipeline(
-                ds.arrays, local_batch, seed=self.seed,
-                shuffle_buffer=shuffle_buffer, structure=ds.structure,
-            )
-        elif steps_per_epoch is None:
-            raise ValueError("steps_per_epoch is required with a dataset")
-
-        it = iter(dataset)
-        first = next(it)
-        self.build(first[0], first[1])
-
-        for cb in callbacks:
-            cb.set_trainer(self)
-        try:
-            # on_train_begin sits INSIDE the teardown scope: an early
-            # installer (e.g. PreemptionCheckpointCallback's signal
-            # handler) must be torn down even when a LATER callback's
-            # begin hook raises.
-            for cb in callbacks:
-                cb.on_train_begin()
-
-            pending = first
-            # Zero metric accumulator, committed to the mesh's replicated
-            # sharding ONCE: a fresh uncommitted jnp.zeros each epoch would
-            # give the first step of every epoch a different input-sharding
-            # signature than the chained steps, ping-ponging between two
-            # executables.
-            zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
-            # HVT_PROFILE=<dir> captures a jax.profiler trace of the training
-            # loop (XLA op + ICI collective timing) — the Horovod-Timeline
-            # env-var contract, primary-process-gated (trace.py).
-            from horovod_tpu import trace as trace_lib
-
-            with trace_lib.maybe_trace(trace_lib.profile_dir()):
-                self._fit_epochs(
-                    it, pending, zero_acc, epochs, initial_epoch,
-                    steps_per_epoch, callbacks, validation_data, batch_size,
-                    verbose,
-                )
-        except BaseException:
-            close_input()
-            _teardown_callbacks(callbacks)
-            raise
-        close_input()
-        _run_train_end(callbacks)
-        return self.history
-
-    def _stage_sharded(self, arr, per_shard: int):
-        """Stage one host array as [n_shards, per_shard, ...] in HBM,
-        example-sharded over the data axes: shard s takes rows
-        [s*per_shard, (s+1)*per_shard); multi-process, each feed group
-        contributes the rows for its chips (processes sharing a data shard
-        stage identical rows — see _feed_groups)."""
-        groups, group = self._feed_groups()
-        local_shards = self.dp_size // groups
-        arr = np.asarray(arr)
-        lo = group * local_shards * per_shard
-        hi = (group + 1) * local_shards * per_shard
-        local = arr[lo:hi].reshape((local_shards, per_shard) + arr.shape[1:])
-        spec = jax.sharding.PartitionSpec(
-            (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
-            *([None] * arr.ndim),
-        )
-        return sharding_lib.put_global(
-            local, jax.sharding.NamedSharding(self.mesh, spec)
-        )
-
-    def _stage_device_dataset(self, x, y):
-        """Stage (x, y) into HBM as [n_shards, per_shard_n, ...] leaves,
-        example-sharded over the data axes (truncated to divide evenly)."""
-        n_shards = self.dp_size
-        n = (len(x) // n_shards) * n_shards
-        if n == 0:
-            raise ValueError(f"need at least {n_shards} examples")
-        per_shard = n // n_shards
-        return (
-            self._stage_sharded(np.asarray(x)[:n], per_shard),
-            self._stage_sharded(np.asarray(y)[:n], per_shard),
-        ), per_shard
-
-    def _fit_device_cached(
-        self, x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
-        callbacks, validation_data, verbose,
-    ):
-        from horovod_tpu import trace as trace_lib
-
-        data, per_shard = self._stage_device_dataset(x, y)
-        max_steps = per_shard // batch_size
-        if max_steps == 0:
-            raise ValueError(
-                f"per-shard examples ({per_shard}) < per-chip batch "
-                f"({batch_size})"
-            )
-        steps = min(steps_per_epoch or max_steps, max_steps)
-        self.build(
-            np.asarray(x[: self.dp_size]), np.asarray(y[: self.dp_size])
-        )
-
-        for cb in callbacks:
-            cb.set_trainer(self)
-        try:
-            # Inside the teardown scope — see the streamed fit path's note.
-            for cb in callbacks:
-                cb.on_train_begin()
-            zero_acc = sharding_lib.replicate(self.zero_metrics(), self.mesh)
-            epoch_key = jax.random.PRNGKey(self.seed + 1)
-            with trace_lib.maybe_trace(trace_lib.profile_dir()):
-                for epoch in range(initial_epoch, epochs):
-                    if self.stop_training:
-                        break
-                    # Fresh scale each epoch: LR callbacks compose into it
-                    # in list order (warmup assigns, schedules multiply).
-                    self.update_scale = 1.0
-                    for cb in callbacks:
-                        cb.on_epoch_begin(epoch)
-                    t0 = time.perf_counter()
-                    scale = jnp.asarray(self.update_scale, jnp.float32)
-                    self.state, metrics, metric_acc = self._train_epoch(
-                        self.state, data, jax.random.fold_in(epoch_key, epoch),
-                        scale, zero_acc, steps, batch_size,
-                    )
-                    for cb in callbacks:
-                        cb.on_batch_end(steps - 1, metrics)
-                    self._finish_epoch(
-                        epoch, epochs, metric_acc, steps, t0, callbacks,
-                        validation_data, batch_size, verbose,
-                        # Device-cached training implies device-cached
-                        # validation.
-                        val_cache="device",
-                    )
-        except BaseException:
-            _teardown_callbacks(callbacks)
-            raise
-        _run_train_end(callbacks)
-        return self.history
-
-    def _finish_epoch(
-        self, epoch, epochs, metric_acc, steps, t0, callbacks,
-        validation_data, batch_size, verbose, val_cache=None,
-    ):
-        """Epoch bookkeeping shared by every fit path: ONE host fetch of the
-        in-step metric sums, optional validation, callbacks, history."""
-        sums = jax.device_get(metric_acc)
-        logs = {k: float(v) / steps for k, v in sums.items()}
-        logs["epoch_time_s"] = time.perf_counter() - t0
-        if validation_data is not None:
-            val = self.evaluate(
-                validation_data[0], validation_data[1],
-                batch_size=batch_size, verbose=0, cache=val_cache,
-            )
-            logs.update({f"val_{k}": v for k, v in val.items()})
-        for cb in callbacks:
-            cb.on_epoch_end(epoch, logs)
-        self.history.append(logs)
-        if verbose:
-            shown = {k: round(v, 4) for k, v in logs.items()}
-            print(f"Epoch {epoch + 1}/{epochs} - {shown}")
+        return feeding.shard_batch(self, batch)
 
     def _shard_chunk(self, chunk):
-        """Place a [K, batch, ...] stack of K batches (steps_per_execution)
-        onto the mesh — the scan axis stays unsharded."""
-        if self.batch_specs is not None:
-            specs = tuple(self.batch_specs)
+        return feeding.shard_chunk(self, chunk)
 
-            def put(x, spec):
-                return sharding_lib.put_global(
-                    x,
-                    jax.sharding.NamedSharding(
-                        self.mesh, jax.sharding.PartitionSpec(None, *tuple(spec))
-                    ),
-                )
+    def _feed_groups(self) -> tuple[int, int]:
+        return feeding.feed_groups(self)
 
-            return tuple(put(x, spec) for x, spec in zip(chunk, specs))
-        return sharding_lib.shard_chunk(chunk, self.mesh)
+    def _local_slice(self, arr, global_batch: int):
+        return feeding.local_slice(self, arr, global_batch)
 
-    def _fit_epochs(
-        self, it, pending, zero_acc, epochs, initial_epoch, steps_per_epoch,
-        callbacks, validation_data, batch_size, verbose,
-    ):
-        from horovod_tpu.data.prefetch import DevicePrefetcher
+    def _stage_device_dataset(self, x, y):
+        return feeding.stage_device_dataset(self, x, y)
 
-        # Per-epoch execution plan: full steps_per_execution chunks plus one
-        # remainder chunk (a second, smaller executable) when K doesn't
-        # divide the epoch.
-        spe = min(self.steps_per_execution, steps_per_epoch)
-        plan = [spe] * (steps_per_epoch // spe)
-        if steps_per_epoch % spe:
-            plan.append(steps_per_epoch % spe)
-        buffered = [pending]
+    def fit(self, dataset=None, **kwargs) -> list[dict]:
+        """Train — the Keras-fit role; full contract in
+        `training.feeding.run_fit` (streamed + device-cached paths)."""
+        return feeding.run_fit(self, dataset, **kwargs)
 
-        def host_chunks():
-            # Host-side assembly of the execution units: single batches when
-            # K == 1, [K, ...] stacks otherwise.
-            for _ in range(initial_epoch, epochs):
-                for k in plan:
-                    batches = [
-                        buffered.pop() if buffered else next(it)
-                        for _ in range(k)
-                    ]
-                    if spe == 1:
-                        yield batches[0]
-                    else:
-                        # Stack K batches leaf-wise — pytree batches (dict
-                        # inputs, multi-input models) stack like flat ones.
-                        yield jax.tree.map(
-                            lambda *xs: np.stack(xs), *batches
-                        )
-
-        # Batches are staged onto the devices by a background thread while
-        # the current step computes — transfer enqueue never blocks dispatch.
-        run = self._train_step if spe == 1 else self._train_chunk
-        prefetcher = DevicePrefetcher(
-            host_chunks(), self._shard if spe == 1 else self._shard_chunk
-        )
-        try:
-            for epoch in range(initial_epoch, epochs):
-                if self.stop_training:
-                    break
-                # Fresh scale each epoch (see _fit_device_cached note).
-                self.update_scale = 1.0
-                for cb in callbacks:
-                    cb.on_epoch_begin(epoch)
-                t0 = time.perf_counter()
-                scale = jnp.asarray(self.update_scale, jnp.float32)
-                metric_acc = zero_acc
-                step = 0
-                for k in plan:
-                    chunk = next(prefetcher)
-                    self.state, metrics, metric_acc = run(
-                        self.state, chunk, scale, metric_acc
-                    )
-                    step += k
-                    # Once per execution, with the last step's metrics —
-                    # Keras's steps_per_execution callback semantics.
-                    for cb in callbacks:
-                        cb.on_batch_end(step - 1, metrics)
-                self._finish_epoch(
-                    epoch, epochs, metric_acc, steps_per_epoch, t0, callbacks,
-                    validation_data, batch_size, verbose,
-                )
-        finally:
-            prefetcher.close()
-
-    def _evaluate_device_cached(self, x, y, batch_size: int) -> dict:
-        """evaluate() over a device-resident eval set: stage once (padded to
-        full batches, padding masked), then each call is ONE dispatch + one
-        3-scalar fetch. The per-epoch validation pass stops restreaming the
-        test set from the host every epoch.
-
-        Caching is by the host arrays' identity: do not mutate ``x``/``y``
-        in place while cached, or stale staged data is evaluated."""
-        key = (id(x), id(y), batch_size)
-        if key not in self._eval_cache:
-            n = len(x)
-            n_shards = self.dp_size
-            per = -(-n // (n_shards * batch_size)) * batch_size  # ceil→pad
-            pad_n = per * n_shards
-            mask = np.zeros(pad_n, np.float32)
-            mask[:n] = 1.0
-
-            def padded(a):
-                # Repeat a REAL example into the padded tail (like the
-                # streamed path): all-zero rows could produce non-finite
-                # losses in input-normalizing models, and NaN*0 = NaN would
-                # poison the masked sums.
-                a = np.asarray(a)
-                out = np.concatenate(
-                    [a, np.repeat(a[-1:], pad_n - n, axis=0)]
-                )
-                return out
-
-            data = (
-                self._stage_sharded(padded(x), per),
-                self._stage_sharded(padded(y), per),
-                self._stage_sharded(mask, per),
-            )
-            # Keep x/y referenced so their ids stay unique while cached.
-            self._eval_cache[key] = (data, per // batch_size, (x, y))
-            if len(self._eval_cache) > 4:  # bound device memory
-                self._eval_cache.pop(next(iter(self._eval_cache)))
-        data, steps, _ = self._eval_cache[key]
-        m = jax.device_get(
-            self._eval_epoch(self.state, data, steps, batch_size)
-        )
-        return {
-            "loss": float(m["loss_sum"]) / float(m["count"]),
-            "accuracy": float(m["correct_sum"]) / float(m["count"]),
-        }
-
-    def evaluate(
-        self, x, y, batch_size: int = 128, verbose: int = 0,
-        cache: str | None = None,
-    ) -> dict:
-        """Full-dataset eval on the mesh. Unlike the reference (every rank
-        redundantly evaluates the full test set, SURVEY.md §3.2), the eval
-        batch is sharded across chips — same result, 1/size the work.
-        ``cache='device'`` keeps the (padded, masked) eval set in HBM and
-        runs the whole pass as one compiled scan."""
-        if self.state is None:
-            raise RuntimeError("call fit() or build() first")
-        if (
-            cache == "device"
-            and self.batch_specs is not None
-            and mesh_lib.has_live_model_axes(self.mesh)
-        ):
-            # Custom batch layouts over LIVE non-data axes (e.g. seq-sharded
-            # tokens) need _shard's spec handling; the cached path stages
-            # batch-dim-only. With those axes trivial the layouts coincide —
-            # same condition as fit(cache='device')'s guard.
-            cache = None
-        if isinstance(x, list):
-            x = np.asarray(x)  # list-of-rows = one array input (see fit)
-        if cache == "device":
-            if len(jax.tree_util.tree_leaves(x)) != 1:
-                raise ValueError(
-                    "cache='device' stages a single input array; pytree "
-                    "(dict/tuple) inputs use the streamed eval path"
-                )
-            result = self._evaluate_device_cached(x, y, batch_size)
-            if verbose and runtime.is_primary():
-                print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
-            return result
-        if cache is not None:
-            raise ValueError(f"unknown cache mode {cache!r}")
-        # x may be a pytree (dict-input models, e.g. seq2seq) — slice, pad
-        # and shard leaf-wise; y/mask stay flat arrays.
-        n = len(jax.tree_util.tree_leaves(x)[0])
-        global_batch = batch_size * self.dp_size
-        loss_sum = correct_sum = count = 0.0
-        for start in range(0, n, global_batch):
-            xb, bs = self._slice_pad(x, start, global_batch)
-            yb, _ = self._slice_pad(y, start, global_batch)
-            mask = np.ones((global_batch,), np.float32)
-            mask[bs:] = 0.0
-            batch = tuple(
-                jax.tree.map(
-                    lambda a: self._local_slice(a, global_batch), part
-                )
-                for part in (xb, yb, mask)
-            )
-            m = jax.device_get(self._eval_step(self.state, self._shard(batch)))
-            loss_sum += float(m["loss_sum"])
-            correct_sum += float(m["correct_sum"])
-            count += float(m["count"])
-        result = {"loss": loss_sum / count, "accuracy": correct_sum / count}
-        if verbose and runtime.is_primary():
-            print(f"eval - {({k: round(v, 4) for k, v in result.items()})}")
-        return result
-
-    def _slice_pad(self, part, start: int, global_batch: int):
-        """(batch slice padded to the compiled shape, true row count) for
-        one batch part — leaf-wise, so pytree (dict-input) parts feed like
-        flat arrays. ONE implementation of the multi-process padding
-        contract, shared by evaluate and predict."""
-        sliced = jax.tree.map(
-            lambda a: np.asarray(a[start : start + global_batch]), part
-        )
-        bs = len(jax.tree_util.tree_leaves(sliced)[0])
-        if bs < global_batch:
-            pad = global_batch - bs
-            sliced = jax.tree.map(
-                lambda a: np.concatenate([a, np.repeat(a[-1:], pad, 0)]),
-                sliced,
-            )
-        return sliced, bs
+    def evaluate(self, x, y, batch_size: int = 128, verbose: int = 0,
+                 cache: str | None = None) -> dict:
+        """Sharded full-dataset eval; see `training.feeding.run_evaluate`."""
+        return feeding.run_evaluate(self, x, y, batch_size, verbose, cache)
 
     def predict(self, x, batch_size: int = 128) -> np.ndarray:
-        """Class probabilities (softmax applied here, keeping the serving
-        contract input→prob, mnist_keras.py:133-134). ``x`` may be a pytree
-        (dict-input models) — slice/pad/shard run leaf-wise, like
-        `evaluate`."""
-        if self.state is None:
-            raise RuntimeError("call fit() or build() first")
-        if isinstance(x, list):
-            x = np.asarray(x)  # list-of-rows = one array input (see fit)
-        out = []
-        global_batch = batch_size * self.dp_size
-        n = len(jax.tree_util.tree_leaves(x)[0])
-        for start in range(0, n, global_batch):
-            xb, bs = self._slice_pad(x, start, global_batch)
-            xb = jax.tree.map(
-                lambda a: self._local_slice(a, global_batch), xb
-            )
-            probs = jax.device_get(self._predict_step(self.state, self._shard(xb)))
-            out.append(probs[:bs])
-        return np.concatenate(out, axis=0)
+        """Class probabilities (input→prob serving contract); see
+        `training.feeding.run_predict`."""
+        return feeding.run_predict(self, x, batch_size)

@@ -335,6 +335,7 @@ class TestExponentialMovingAverage:
             ema.on_train_begin()
 
 
+@pytest.mark.slow
 class TestEMAShardedLayouts:
     """EMA durability under model-parallel layouts (VERDICT Weak #5): the
     shadow carries the params' shardings, and its persistence follows the

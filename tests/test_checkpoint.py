@@ -584,6 +584,7 @@ class TestReshardRestore:
             checkpoint.restore_sharded(path, template, reshard=True)
 
 
+@pytest.mark.slow
 class TestExportFromShardedState:
     """export_serving over model-parallel params (VERDICT Missing #2):
     single-process TP/FSDP shardings must export transparently and the

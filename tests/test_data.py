@@ -1,6 +1,7 @@
 """Data layer: dataset contract parity + the sharding the reference lacks."""
 
 import numpy as np
+import pytest
 
 from horovod_tpu.data import datasets
 from horovod_tpu.data.loader import ArrayDataset
@@ -25,6 +26,7 @@ def test_mnist_per_rank_paths_differ_but_content_consistent(tmp_cache):
     np.testing.assert_array_equal(a[0][0], b[0][0])
 
 
+@pytest.mark.slow
 def test_cifar_contract(tmp_cache):
     (x_train, y_train), (x_test, y_test) = datasets.cifar10()
     assert x_train.shape == (50_000, 32, 32, 3) and x_train.dtype == np.uint8

@@ -39,6 +39,7 @@ def _greedy_no_cache(model, params, prompt, n):
 
 
 class TestGreedyParity:
+    @pytest.mark.slow
     def test_cache_decode_equals_full_recompute(self):
         model = _model()
         params = _params(model)
@@ -61,6 +62,7 @@ class TestGreedyParity:
             rtol=2e-5, atol=2e-5,
         )
 
+    @pytest.mark.slow
     def test_moe_blocks_decode(self):
         # Ample capacity so routing never drops: a binding capacity is
         # enforced per call group, so the per-step decode and the
@@ -293,6 +295,7 @@ class TestGQADecode:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 class TestSlidingWindowDecode:
     """window models decode through the cache with the same band the
     training forward used: a cached decode must equal the full recompute
@@ -589,6 +592,7 @@ class TestAttentionSinks:
             model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32))
 
 
+@pytest.mark.slow
 class TestRaggedPrompts:
     """fn(params, prompt, rng, lengths): mixed prompt lengths in one batch,
     each row generating exactly as if alone at its own length."""

@@ -110,6 +110,7 @@ class TestMnistCNNStillParamsOnly:
         assert state.model_state is None
 
 
+@pytest.mark.slow
 class TestViT:
     """The conv-free vision family: patchify + encoder blocks through the
     same Trainer/optimizer path as the CNNs."""

@@ -145,6 +145,7 @@ class TestRouting:
         assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 class TestAuxLoss:
     def test_sown_during_train_only(self):
         d = 8
@@ -183,6 +184,7 @@ class TestAuxLoss:
         assert run(100.0) > run(0.0) + 1.0
 
 
+@pytest.mark.slow
 class TestExpertParallel:
     def _mesh(self):
         return mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, expert=4))
@@ -283,6 +285,7 @@ class TestExpertParallel:
         )
 
 
+@pytest.mark.slow
 class TestDropRateObservability:
     """Router overflow drops are safe but must be VISIBLE: the layer sows
     'metrics'/'moe_drop_rate' and the Trainer surfaces it in the step
@@ -386,6 +389,7 @@ class TestDropRateObservability:
             tr.build(np.zeros((8, 4), np.float32))
 
 
+@pytest.mark.slow
 class TestMoESeqComposition:
     """dp x sp x ep on one mesh: MoE blocks under GSPMD compose with the
     partially-manual ring-attention seq axis — the routing einsums stay a
@@ -437,6 +441,7 @@ class TestMoESeqComposition:
         assert "moe_drop_rate" in trainer.metric_names
 
 
+@pytest.mark.slow
 class TestExpertChoice:
     """Expert-choice routing (arXiv:2202.09368): experts pick tokens —
     perfectly balanced and drop-free by construction, no aux loss."""

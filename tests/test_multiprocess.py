@@ -496,6 +496,7 @@ class TestMultiProcessCIJob:
         assert run_job(spec) == 0
 
 
+@pytest.mark.slow
 class TestReshardAcrossTopologies:
     """Topology-change resume (`restore_sharded(reshard=True)`): a sharded
     checkpoint written by a 2-process fsdp=2 fleet restores into THIS

@@ -89,6 +89,7 @@ class TestNextTokenPairs:
         np.testing.assert_array_equal(w, [[1, 1, 0, 1, 0]])
 
 
+@pytest.mark.slow
 class TestEndToEnd:
     def test_packed_rows_train_the_segment_model(self):
         """pack_documents output feeds TransformerLM(segment_ids=...) and a

@@ -20,6 +20,9 @@ from horovod_tpu.models.pipelined_lm import PipelinedLM
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.parallel.pipeline import spmd_pipeline, stage_slice_size
 
+# Compile-heavy end-to-end tier (suite diet: default run stays fast).
+pytestmark = pytest.mark.slow
+
 VOCAB = 32
 
 

@@ -56,6 +56,7 @@ class TestQuantize:
         q = quantize_params(p, min_size=1)
         assert quantized_bytes(q) < p["k"].size * 4 / 3.5
 
+    @pytest.mark.slow
     def test_model_params_structure(self):
         model = _model()
         params = model.init(
@@ -70,6 +71,7 @@ class TestQuantize:
         ) == jax.tree_util.tree_structure(params)
 
 
+@pytest.mark.slow
 class TestQuantizedDecode:
     def test_generates_valid_tokens(self):
         model = _model()
@@ -196,6 +198,7 @@ class TestInt8DotGeneral:
             )
 
 
+@pytest.mark.slow
 class TestInt8Compute:
     def test_forward_close_to_bf16_and_train_rejected(self):
         model = _model()

@@ -48,6 +48,7 @@ class TestForward:
         logits = model.apply({"params": params}, batch)
         assert logits.shape == (2, 10, VOCAB)
 
+    @pytest.mark.slow
     def test_flash_matches_dense(self):
         """The flash path (encoder non-causal segments, decoder causal,
         cross-attention Tk≠Tq) agrees with the dense reference — values AND
@@ -145,6 +146,7 @@ def _copy_task(n, s_len, t_len, rng):
     return {"src": src, "tgt": tgt_in}, y
 
 
+@pytest.mark.slow
 class TestTraining:
     def test_learns_copy_through_trainer(self):
         """End-to-end through Trainer on a data×model mesh: the dict batch
@@ -225,6 +227,7 @@ class TestGeneration:
         assert (out >= 0).all() and (out < VOCAB).all()
 
 
+@pytest.mark.slow
 class TestSequenceParallel:
     """All three attention families over a live `seq` axis: the encoder's
     segmented bidirectional ring, the decoder's causal ring, and the
@@ -286,6 +289,7 @@ class TestSequenceParallel:
             model.init(jax.random.PRNGKey(0), self._sp_pair(t=1))
 
 
+@pytest.mark.slow
 def test_predict_with_dict_inputs():
     """Trainer.predict slices/pads/shards pytree inputs leaf-wise —
     teacher-forced next-token probabilities for a dict-batch model,

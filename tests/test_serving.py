@@ -20,6 +20,9 @@ from horovod_tpu.launch.serve import make_server
 from horovod_tpu.models.decoding import make_generate_fn
 from horovod_tpu.models.transformer import TransformerLM
 
+# Compile-heavy end-to-end tier (suite diet: default run stays fast).
+pytestmark = pytest.mark.slow
+
 BATCH, T0, NEW = 2, 8, 6
 CORPUS = [
     "the ring rotates the keys",

@@ -123,6 +123,7 @@ class TestNgramDraft:
         np.testing.assert_array_equal(np.asarray(out), [[5, 5]])
 
 
+@pytest.mark.slow
 class TestSpeedup:
     def test_trained_copy_model_accepts_drafts(self):
         """On a model that has actually learned the copy task, the ngram
@@ -224,6 +225,7 @@ class TestMoERejected:
             make_speculative_fn(model, max_new_tokens=8)
 
 
+@pytest.mark.slow
 class TestModelDraft:
     """Two-model speculative decoding: a smaller LM drafts with its own
     in-loop KV cache (fixed 2-token catch-up window + scan steps). The
@@ -286,6 +288,7 @@ class TestModelDraft:
             fn(tp, jnp.zeros((1, 1), jnp.int32))
 
 
+@pytest.mark.slow
 class TestSampledSpeculative:
     """Sampled (temperature/top-k/top-p) speculative decoding: the
     rejection scheme must commit exactly the target's filtered

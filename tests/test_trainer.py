@@ -133,6 +133,7 @@ class TestShardUpdate:
             **kw,
         )
 
+    @pytest.mark.slow
     def test_matches_plain_dp_and_stays_sharded(self):
         import jax
 

@@ -65,6 +65,7 @@ class TestForward:
         )
 
 
+@pytest.mark.slow
 class TestMeshComposition:
     """dp=2 × seq=2 × model=2 on the 8 virtual devices — every parallelism
     axis live in one training step."""
@@ -162,6 +163,7 @@ class TestMeshComposition:
         )
 
 
+@pytest.mark.slow
 class TestFSDP:
     """fsdp > 1 exercised for real: parameters and optimizer mirrors sharded
     over the fsdp axis, and the training math identical to pure DP — FSDP is
@@ -312,6 +314,7 @@ class TestMemoryKnobs:
         assert hist[-1]["loss"] <= hist[0]["loss"] * 1.5  # sane training
 
 
+@pytest.mark.slow
 class TestLongRangeRecall:
     def test_copy_task_learned_through_ring(self):
         """The functional long-context check: recall-half loss → small, which
@@ -337,6 +340,7 @@ class TestLongRangeRecall:
         assert recall_loss < first_loss * 0.5, (recall_loss, first_loss)
 
 
+@pytest.mark.slow
 class TestPackedSequences:
     """Packing invariance — the semantic contract of segment_ids: a document
     packed next to others must produce EXACTLY the logits it produces alone
@@ -401,6 +405,7 @@ class TestPackedSequences:
         )
 
 
+@pytest.mark.slow
 class TestGQA:
     """Grouped-query attention (n_kv_heads < n_heads): K/V heads shared by
     groups of query heads. The load-bearing equivalence: a GQA model must
@@ -506,6 +511,7 @@ class TestGQA:
         assert hist[-1]["loss"] < hist[0]["loss"]
 
 
+@pytest.mark.slow
 class TestSlidingWindow:
     """TransformerLM(window=W): local attention end-to-end — every
     sequence-parallel impl must agree with the dense-windowed reference,
@@ -567,6 +573,7 @@ class TestSlidingWindow:
         assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 class TestGlobalLocalOnMesh:
     """window + attention_sinks through the ring on a live seq mesh: the
     global+local model must match the dense reference, and train."""
