@@ -517,10 +517,15 @@ def bench_decode() -> dict:
         from horovod_tpu.models.quant import quantize_params
 
         params = quantize_params(params)
+    # BENCH_KV_INT8=1: int8 K/V cache (per-(position, head) scales) — the
+    # cache stream halves; stacks with BENCH_WEIGHTS/BENCH_KV_HEADS.
+    from horovod_tpu import runtime as _rt
+
+    kv_int8 = _rt.env_flag("BENCH_KV_INT8")
     fn = make_generate_fn(
         model, max_new_tokens=new_tokens, include_prompt=False,
         temperature=float(os.environ.get("BENCH_TEMPERATURE", 0.0)),
-        quantized=quantized,
+        quantized=quantized, quantized_cache=kv_int8,
     )
     key = jax.random.PRNGKey(7)
 
@@ -553,6 +558,7 @@ def bench_decode() -> dict:
         "unit": "tokens/sec/chip",
         "batch": batch,
         "weights": "int8" if quantized else "bf16",
+        "kv_cache": "int8" if kv_int8 else "bf16",
         "n_kv_heads": model.n_kv_heads or model.n_heads,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,

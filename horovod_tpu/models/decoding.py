@@ -97,7 +97,8 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
                      eos_id: int | None = None,
                      include_prompt: bool = True,
                      quantized: bool = False,
-                     int8_compute: bool = False):
+                     int8_compute: bool = False,
+                     quantized_cache: bool = False):
     """Build the compiled generator: ``(params, prompt, rng) -> tokens``.
 
     ``model`` is the *training* `TransformerLM`; it is cloned into decode
@@ -117,6 +118,12 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
     the 2× int8 rate pays (1.2–1.44× measured, BASELINE.md); decode scan
     steps stay bf16, where per-step dynamic weight requantization was
     measured slower. Orthogonal to ``quantized`` (storage).
+
+    ``quantized_cache=True``: K/V cache stored int8 with per-(position,
+    head) scales (TransformerLM.quantized_cache) — the cache stream and
+    cache HBM halve; the decode einsums read int8 directly (scales factor
+    out of the head-dim contraction). Stacks with ``quantized`` weights
+    and GQA; approximate, same quality gates.
 
     **Ragged prompts** — ``fn(params, prompt, rng, lengths)`` with
     ``lengths`` a ``[B]`` int array: each row's true prompt is its first
@@ -143,6 +150,7 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
         dmodel = model.clone(
             decode=True, max_decode_len=t0 + max_new_tokens, dropout=0.0,
             remat=False,
+            **({"quantized_cache": True} if quantized_cache else {}),
         )
         # int8_compute applies to the PREFILL apply only — the measured
         # split (BASELINE.md int8 row): prefill is compute-bound and gains
@@ -202,7 +210,8 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
 def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              eos_id: int | None = None, include_prompt: bool = True,
-             quantized: bool = False, int8_compute: bool = False):
+             quantized: bool = False, int8_compute: bool = False,
+             quantized_cache: bool = False):
     """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, T0] ints).
 
     Convenience wrapper over `make_generate_fn` (which see, for the handle
@@ -213,7 +222,7 @@ def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
         model, max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, top_p=top_p, eos_id=eos_id,
         include_prompt=include_prompt, quantized=quantized,
-        int8_compute=int8_compute,
+        int8_compute=int8_compute, quantized_cache=quantized_cache,
     )
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -151,6 +151,15 @@ class Block(nn.Module):
     # models/quant.int8_dot_general — dynamic activation scales,
     # per-channel weight scales, int32 accumulation).
     int8_compute: bool = False
+    # int8 KV cache (decode): K/V stored as int8 with per-(position, head)
+    # f32 scales — the cache stream halves (it was ~a third of decode HBM
+    # traffic at MHA shapes) and so does cache HBM, doubling the context
+    # envelope per chip. Scales factor OUT of the head-dim contraction, so
+    # the decode einsums read int8 directly and apply scales to the
+    # [.., L]-shaped scores/probs — no dequantized [B, L, H, D] copy
+    # exists even transiently. Approximate (two 127-level roundings);
+    # quality-gated like the weight paths (models/quant.py).
+    quantized_cache: bool = False
     # Attention sinks (StreamingLLM, arXiv:2309.17453 / Longformer-style
     # global+local): the first `attention_sinks` positions stay visible —
     # and, with sliding_cache, pinned in the cache — in addition to the
@@ -410,11 +419,36 @@ class Block(nn.Module):
             sinks + min(self.window, self.max_decode_len)
             if self.sliding_cache else self.max_decode_len
         )
+        qc = self.quantized_cache
+        if qc and self.sliding_cache:
+            raise ValueError(
+                "quantized_cache does not compose with sliding_cache "
+                "(the ring path keeps full-width slots) — pick one"
+            )
+        cache_dtype = jnp.int8 if qc else self.compute_dtype
         zeros = lambda: jnp.zeros(  # noqa: E731
-            (b, cache_len, h_kv, d), self.compute_dtype
+            (b, cache_len, h_kv, d), cache_dtype
         )
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
+        if qc:
+            # Per-(position, head) symmetric scales — they factor out of
+            # the head-dim contraction, so reads stay int8 end to end.
+            # The fresh full-precision k/v stay untouched (the prefill
+            # flash attention below uses THEM, so prefill logits are
+            # exact); only the cache writes carry the quantized copies.
+            szeros = lambda: jnp.zeros(  # noqa: E731
+                (b, cache_len, h_kv), jnp.float32
+            )
+            ksc = self.variable("cache", "k_scale", szeros)
+            vsc = self.variable("cache", "v_scale", szeros)
+            from horovod_tpu.models.quant import _quantize_sym
+
+            wk, k_s = _quantize_sym(k, axis=-1)  # int8, [B, T, H_kv, 1]
+            wv, v_s = _quantize_sym(v, axis=-1)
+            k_s, v_s = k_s[..., 0], v_s[..., 0]  # [B, T, H_kv]
+        else:
+            wk, wv = k, v
         idx = jnp.asarray(decode_index, jnp.int32)
         if idx.ndim == 1 and self.sliding_cache:
             raise ValueError(
@@ -468,16 +502,33 @@ class Block(nn.Module):
         elif idx.ndim == 0:
             ck.value = cfg.constrain(
                 jax.lax.dynamic_update_slice(
-                    ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
+                    ck.value, wk.astype(ck.value.dtype), (0, idx, 0, 0)
                 ),
                 cache_spec,
             )
             cv.value = cfg.constrain(
                 jax.lax.dynamic_update_slice(
-                    cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
+                    cv.value, wv.astype(cv.value.dtype), (0, idx, 0, 0)
                 ),
                 cache_spec,
             )
+            if qc:
+                # Same layout pinning as the value writes: heads over
+                # `model`, so the persistent scale state never picks up a
+                # GSPMD-chosen resharding inside the decode scan.
+                scale_spec = P(BATCH_AXES, None, MODEL_AXIS)
+                ksc.value = cfg.constrain(
+                    jax.lax.dynamic_update_slice(
+                        ksc.value, k_s, (0, idx, 0)
+                    ),
+                    scale_spec,
+                )
+                vsc.value = cfg.constrain(
+                    jax.lax.dynamic_update_slice(
+                        vsc.value, v_s, (0, idx, 0)
+                    ),
+                    scale_spec,
+                )
         else:
             # Per-row indices ([B]): each row writes its fresh K/V at its
             # own positions — the ragged-prompt / per-row-speculative
@@ -487,16 +538,26 @@ class Block(nn.Module):
             pos = idx[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
             ck.value = cfg.constrain(
                 ck.value.at[rows, pos].set(
-                    k.astype(ck.value.dtype), mode="drop"
+                    wk.astype(ck.value.dtype), mode="drop"
                 ),
                 cache_spec,
             )
             cv.value = cfg.constrain(
                 cv.value.at[rows, pos].set(
-                    v.astype(cv.value.dtype), mode="drop"
+                    wv.astype(cv.value.dtype), mode="drop"
                 ),
                 cache_spec,
             )
+            if qc:
+                scale_spec = P(BATCH_AXES, None, MODEL_AXIS)
+                ksc.value = cfg.constrain(
+                    ksc.value.at[rows, pos].set(k_s, mode="drop"),
+                    scale_spec,
+                )
+                vsc.value = cfg.constrain(
+                    vsc.value.at[rows, pos].set(v_s, mode="drop"),
+                    scale_spec,
+                )
         if t > 1 and first_call:
             # Prefill: the cache was empty below `idx` (generate() starts at
             # 0), so causal attention over the fresh K/V is the full answer —
@@ -538,6 +599,12 @@ class Block(nn.Module):
             "bqhgd,bkhd->bhgqk", q5, ck.value,
             preferred_element_type=jnp.float32,
         ) * scale
+        if qc:
+            # The per-(position, head) scale factors out of the head-dim
+            # contraction: score = (q · k_int8) · k_scale. The einsum above
+            # read int8 directly (the convert rides the dot); only the
+            # [.., L]-shaped scores pay the scale multiply.
+            s = s * jnp.transpose(ksc.value, (0, 2, 1))[:, :, None, None, :]
         if self.sliding_cache:
             # Ring slots carry their absolute positions: valid = written,
             # causal, and inside the band OR a pinned sink (eviction
@@ -572,10 +639,19 @@ class Block(nn.Module):
             valid = valid[:, None, None, :, :]  # [Bq, 1, 1, t, L]
         s = jnp.where(valid, s, attention_ops._BIG_NEG)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum(
-            "bhgqk,bkhd->bqhgd", p.astype(cv.value.dtype), cv.value,
-            preferred_element_type=jnp.float32,
-        )
+        if qc:
+            # Same factoring on the value side: fold v_scale into the
+            # probabilities (shaped [.., L]) and contract against int8 v.
+            p_eff = p * jnp.transpose(vsc.value, (0, 2, 1))[:, :, None, None, :]
+            out = jnp.einsum(
+                "bhgqk,bkhd->bqhgd", p_eff, cv.value,
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            out = jnp.einsum(
+                "bhgqk,bkhd->bqhgd", p.astype(cv.value.dtype), cv.value,
+                preferred_element_type=jnp.float32,
+            )
         return out.reshape(b, t, h, d).astype(q.dtype)
 
 
@@ -676,6 +752,9 @@ class TransformerLM(nn.Module):
     # Ring-buffer cache for windowed models: O(window) decode memory and
     # cache traffic regardless of generation length (see Block).
     sliding_cache: bool = False
+    # int8 K/V cache with per-(position, head) scales (see Block) — the
+    # decode cache stream and cache HBM halve; approximate, quality-gated.
+    quantized_cache: bool = False
     # StreamingLLM attention sinks (decode-time; see Block.attention_sinks).
     attention_sinks: int = 0
     # Row-chunk count for the fused linear-CE head when ``labels`` are fed
@@ -755,6 +834,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode,
                 max_decode_len=self.max_decode_len,
                 sliding_cache=self.sliding_cache,
+                quantized_cache=self.quantized_cache,
                 attention_sinks=self.attention_sinks,
                 int8_compute=self.int8_compute,
                 # Explicit name = flax's auto-name, so the param tree is

@@ -7,6 +7,7 @@ from horovod_tpu.data import datasets
 from horovod_tpu.data.loader import ArrayDataset
 
 
+@pytest.mark.slow
 def test_mnist_contract(tmp_cache):
     (x_train, y_train), (x_test, y_test) = datasets.mnist(path="mnist-0.npz")
     # Exact keras-layout contract (tensorflow2_keras_mnist.py:34-35)
@@ -19,6 +20,7 @@ def test_mnist_contract(tmp_cache):
     np.testing.assert_array_equal(x_train, x2)
 
 
+@pytest.mark.slow
 def test_mnist_per_rank_paths_differ_but_content_consistent(tmp_cache):
     # per-rank cache filename convention (race avoidance, §5.2)
     a = datasets.mnist(path="mnist-0.npz")

@@ -152,6 +152,7 @@ class TestSharded:
         got = np.asarray(generate(smodel, params, prompt, 10))
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow
     def test_reusable_compiled_fn(self):
         model = _model()
         params = _params(model)
@@ -167,6 +168,7 @@ class TestSharded:
             np.asarray(b), np.asarray(generate(model, params, p2, 6))
         )
 
+    @pytest.mark.slow
     def test_chunked_prefill_matches_single_prefill(self):
         """T>1 on a warm cache extends it (round 3): the chunk attends over
         the cached prefix plus itself causally, so feeding a prompt in two
@@ -258,6 +260,7 @@ class TestTopP:
         assert out.min() >= 0 and out.max() < VOCAB
 
 
+@pytest.mark.slow
 class TestGQADecode:
     """GQA decode: the cache stores n_kv_heads (< n_heads) — the bytes
     streamed per token shrink by the group factor — and the grouped-einsum

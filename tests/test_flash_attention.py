@@ -87,6 +87,7 @@ class TestForward:
         )
 
 
+@pytest.mark.slow
 class TestWithLse:
     """The (out, lse) kernel entry that cross-chip merges build on."""
 
@@ -387,6 +388,7 @@ class TestSegments:
         )
 
 
+@pytest.mark.slow
 class TestCrossAttention:
     """Tk != Tq on the kernel's rectangular grid — round-3 feature."""
 
@@ -473,6 +475,7 @@ class TestCrossAttention:
         assert np.isfinite(np.asarray(g)).all()
 
 
+@pytest.mark.slow
 class TestWindow:
     """Sliding-window (local) attention: the band mask row − col < window
     plus block-level skip of out-of-band tiles. Reference = dense_attention

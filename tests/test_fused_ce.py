@@ -112,6 +112,7 @@ class TestFusedLinearCrossEntropy:
         assert many < one / 4, (one, many)
 
 
+@pytest.mark.slow
 class TestModuleLossTrainer:
     """TransformerLM(fused_head_chunks=...) + Trainer(loss='module')."""
 

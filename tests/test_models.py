@@ -41,6 +41,7 @@ class TestResNetArchitecture:
         assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 class TestResNetTraining:
     """The BASELINE.json config-4 path: ResNet through Trainer +
     DistributedOptimizer on the 8-device mesh."""

@@ -32,6 +32,7 @@ def _init(module, x, train=False):
     return module.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, x, train=train)
 
 
+@pytest.mark.slow
 class TestRouting:
     def test_tokens_reach_their_expert(self):
         """Force the router with a hand-built kernel: token feature i routes

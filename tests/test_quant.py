@@ -288,3 +288,149 @@ def test_int8_compute_moe_rejected():
     )["params"]
     with pytest.raises(ValueError, match="MoE"):
         model.apply({"params": params}, jnp.zeros((2, 8), jnp.int32))
+
+
+class TestQuantizedKVCache:
+    def test_cache_is_int8_with_scales(self):
+        model = _model(quantized_cache=True)
+        dmodel = model.clone(decode=True, max_decode_len=12)
+        prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        params = _model().init(jax.random.PRNGKey(0), prompt)["params"]
+        _, vars_ = dmodel.apply({"params": params}, prompt, mutable=["cache"])
+        blk = vars_["cache"]["Block_0"]
+        assert blk["k"].dtype == jnp.int8 and blk["v"].dtype == jnp.int8
+        assert blk["k_scale"].shape == blk["k"].shape[:3]
+        # bytes: int8 values + f32 per-(pos,head) scales ≈ (1 + 4/D)·B·L·H·D
+        full = blk["k"].size * 4  # f32-equivalent full-width cache
+        stored = blk["k"].size + blk["k_scale"].size * 4
+        assert stored < full / 3, (stored, full)
+
+    def test_first_token_exact_rest_valid(self):
+        # The prefill attention uses the fresh full-precision K/V (only the
+        # cache WRITES are quantized), so the FIRST sampled token is exact
+        # vs the full-width cache; later tokens read the quantized cache
+        # and may legitimately differ near ties on an untrained net.
+        model = _model()
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        prompt = jnp.asarray([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], jnp.int32)
+        full = make_generate_fn(model, max_new_tokens=10, include_prompt=False)(
+            params, prompt, jax.random.PRNGKey(0)
+        )
+        q = make_generate_fn(
+            model, max_new_tokens=10, include_prompt=False,
+            quantized_cache=True,
+        )(params, prompt, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(
+            np.asarray(q[:, 0]), np.asarray(full[:, 0])
+        )
+        assert (np.asarray(q) >= 0).all() and (np.asarray(q) < VOCAB).all()
+
+    @pytest.mark.slow
+    def test_trained_model_quality_preserved(self):
+        """int8 KV cache on the trained copy-task model — the same quality
+        gate as the weight paths: top-1 agreement with the full-width
+        cache and near-perfect task recall."""
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        model = _model(n_kv_heads=2)  # GQA composition too
+        trainer = hvt.Trainer(
+            model,
+            hvt.DistributedOptimizer(optax.adam(3e-3)),
+            loss="sparse_categorical_crossentropy",
+            mesh=mesh_lib.build_mesh(
+                mesh_lib.MeshSpec(data=1), devices=jax.devices()[:1]
+            ),
+        )
+        x, y = datasets.copy_task(512, 32, vocab_size=VOCAB, seed=9)
+        trainer.fit(
+            x=x, y=y, batch_size=32, epochs=4, steps_per_epoch=16, verbose=0
+        )
+        params = trainer.state.params
+        xt, _ = datasets.copy_task(4, 32, vocab_size=VOCAB, seed=27)
+        prompt = jnp.asarray(xt[:, :16])
+        n_new = 15
+        full = make_generate_fn(
+            model, max_new_tokens=n_new, include_prompt=False
+        )(params, prompt, jax.random.PRNGKey(0))
+        q = make_generate_fn(
+            model, max_new_tokens=n_new, include_prompt=False,
+            quantized_cache=True,
+        )(params, prompt, jax.random.PRNGKey(0))
+        agree = float((np.asarray(full) == np.asarray(q)).mean())
+        recall = float((np.asarray(q) == np.asarray(xt[:, 16:31])).mean())
+        assert agree >= 0.9, f"top-1 agreement only {agree:.2f}"
+        assert recall >= 0.85, f"quantized-cache recall {recall:.2f}"
+
+    def test_ragged_composition(self):
+        # Per-row cache indices write int8 values AND scales per row.
+        model = _model(quantized_cache=True)
+        params = _model().init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        lens = jnp.array([3, 6], jnp.int32)
+        prompt = jnp.asarray(
+            [[5, 3, 7, 0, 0, 0], [1, 9, 8, 4, 2, 6]], jnp.int32
+        )
+        fn = make_generate_fn(model, max_new_tokens=5, include_prompt=False)
+        got = np.asarray(fn(params, prompt, jax.random.PRNGKey(0), lens))
+        # Each row equals its solo generation under the SAME quantized
+        # cache (per-position quantization is row-independent).
+        for i, L in enumerate([3, 6]):
+            solo = np.asarray(
+                fn(params, prompt[i : i + 1, :L], jax.random.PRNGKey(0))
+            )
+            np.testing.assert_array_equal(got[i], solo[0], err_msg=f"row {i}")
+
+    def test_sliding_cache_rejected(self):
+        model = _model(window=4, sliding_cache=True, quantized_cache=True)
+        params = _model(window=4).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.int32)
+        )["params"]
+        fn = make_generate_fn(model, max_new_tokens=4)
+        with pytest.raises(ValueError, match="quantized_cache"):
+            fn(params, jnp.zeros((1, 6), jnp.int32), jax.random.PRNGKey(0))
+
+    def test_speculative_exact_vs_plain_quantized_cache(self):
+        # Exactness contract survives: speculative-with-qcache must equal
+        # plain-greedy-with-qcache bit for bit (both consult the same
+        # quantized cache values at every position).
+        from horovod_tpu.models.speculative import make_speculative_fn
+
+        model = _model(quantized_cache=True)
+        params = _model().init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        prompt = jnp.asarray(
+            np.random.RandomState(5).randint(1, VOCAB, size=(2, 10)),
+            jnp.int32,
+        )
+        want = make_generate_fn(model, max_new_tokens=16)(
+            params, prompt, jax.random.PRNGKey(0)
+        )
+        got = make_speculative_fn(model, max_new_tokens=16, gamma=4)(
+            params, prompt
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_tp_mesh_matches_single_device(self):
+        # The scale state carries the same heads-over-model constraint as
+        # the int8 K/V it describes — sharded decode must bit-match.
+        from horovod_tpu.models.transformer import ShardingConfig
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        plain = _model()
+        sharded = _model(sharding=ShardingConfig(mesh=mesh))
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(1, VOCAB, (4, 8)), jnp.int32
+        )
+        params = plain.init(jax.random.PRNGKey(0), prompt)["params"]
+        a = make_generate_fn(plain, max_new_tokens=8, quantized_cache=True)(
+            params, prompt, jax.random.PRNGKey(0)
+        )
+        b = make_generate_fn(sharded, max_new_tokens=8, quantized_cache=True)(
+            params, prompt, jax.random.PRNGKey(0)
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
