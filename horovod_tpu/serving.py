@@ -66,6 +66,8 @@ def export_generate(
     pad_id: int = 0,
     tokenizer=None,
     timestamp: str | None = None,
+    int8_compute: bool = False,
+    quantized_cache: bool = False,
 ) -> str:
     """Export a generation bundle into ``export_dir/<stamp>/``.
 
@@ -101,6 +103,9 @@ def export_generate(
     out_dir = os.path.join(export_dir, stamp)
     os.makedirs(out_dir, exist_ok=True)
 
+    # int8_compute / quantized_cache: the decode-family quantization knobs
+    # (models/quant.py) baked into the exported program — int8-MXU prefill
+    # and/or the int8 K/V cache, the measured serving levers (BASELINE.md).
     fn = make_generate_fn(
         model,
         max_new_tokens=max_new_tokens,
@@ -109,6 +114,8 @@ def export_generate(
         top_p=top_p,
         eos_id=eos_id,
         include_prompt=False,
+        int8_compute=int8_compute,
+        quantized_cache=quantized_cache,
     )
     from jax import export as jax_export
 
@@ -143,6 +150,8 @@ def export_generate(
         "top_p": top_p,
         "eos_id": eos_id,
         "pad_id": pad_id,
+        "int8_compute": int8_compute,
+        "quantized_cache": quantized_cache,
         "has_tokenizer": tokenizer is not None,
         "created": stamp,
     }

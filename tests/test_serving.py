@@ -369,3 +369,33 @@ class TestGenerateCoalescing:
             assert app.stats["device_calls"] < len(prompts), app.stats
         finally:
             srv.shutdown()
+
+
+class TestQuantizedBundle:
+    def test_export_with_int8_knobs_serves(self, tmp_path, lm):
+        # A bundle exported with the int8 serving levers (MXU prefill +
+        # int8 KV cache) generates exactly what the local configured
+        # generator does.
+        model, params = lm
+        out = serving.export_generate(
+            str(tmp_path), model, params,
+            batch_size=2, prompt_len=T0, max_new_tokens=NEW,
+            int8_compute=True, quantized_cache=True,
+        )
+        b = serving.load_generate(out)
+        assert b.meta["quantized_cache"] and b.meta["int8_compute"]
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+        got = b.generate_tokens(prompts, seed=0)
+        fn = make_generate_fn(
+            model, max_new_tokens=NEW, include_prompt=False,
+            int8_compute=True, quantized_cache=True,
+        )
+        padded = np.zeros((2, T0), np.int32)
+        padded[0, :5] = prompts[0]
+        padded[1, :3] = prompts[1]
+        want = np.asarray(
+            fn(params, jnp.asarray(padded), jax.random.PRNGKey(0),
+               jnp.array([5, 3], jnp.int32))
+        )
+        for i in range(2):
+            np.testing.assert_array_equal(got[i], want[i], err_msg=f"row {i}")
