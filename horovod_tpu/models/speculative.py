@@ -160,6 +160,14 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
     the slowest row finished) and ``tokens`` (total committed across rows;
     mean accepted-per-round = tokens / (rounds · B)).
 
+    **Ragged prompts** — ``fn(params, prompt, rng_or_None, lengths)`` with
+    ``lengths`` a ``[B]`` int array: same contract as
+    `decoding.make_generate_fn`'s ragged mode (right-padded prompts, each
+    row exact at its own length), built on the same per-row cache-index
+    layout — so a serving batch mixes prompt lengths AND decodes
+    speculatively. Not supported with ``draft_model`` (its prefill
+    consumes the padded prompt).
+
     ``quantized=True``: ``params`` is a `models/quant.quantize_params`
     tree; every target pass dequantizes inside the loop body so the
     weight stream stays int8 (decoding.make_generate_fn's contract).
@@ -191,7 +199,7 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             )
     draft = draft_fn or (None if draft_model is not None else ngram_draft_fn())
 
-    def run(params, prompt, rng=None):
+    def run(params, prompt, rng=None, lengths=None):
         prompt = prompt.astype(jnp.int32)
         b, t0 = prompt.shape
         tmax = t0 + max_new_tokens + gamma  # chunk-overhang headroom
@@ -199,6 +207,12 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             raise ValueError(
                 "sampled speculative decoding (temperature > 0) needs an "
                 "rng: call fn(params, prompt, rng)"
+            )
+        if lengths is not None and draft_model is not None:
+            raise ValueError(
+                "ragged prompts (lengths=...) are not supported with a "
+                "draft_model — its prefill consumes the padded prompt; "
+                "use the n-gram/custom draft, or decoding.make_generate_fn"
             )
         from horovod_tpu.models.quant import make_unpack
 
@@ -210,6 +224,23 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
         logits, vars_ = dmodel.apply(
             {"params": unpack(qparams)}, prompt, mutable=["cache"]
         )
+        if lengths is not None:
+            # Ragged batch (the serving contract, decoding.py's per-row
+            # layout): row i's prompt is its first lengths[i] tokens; its
+            # first verified token reads the logits at lengths[i]-1, its
+            # committed stream starts at position lengths[i], and every
+            # per-row structure below (cur_len, cache index, buf writes)
+            # starts from the vector. Pad garbage beyond a row's length is
+            # progressively overwritten by committed tokens before any
+            # query can attend to it — same argument as make_generate_fn's
+            # ragged mode; the n-gram draft may read pads and propose
+            # nonsense, which verification absorbs.
+            lengths = jnp.asarray(lengths, jnp.int32)
+            logits = jnp.take_along_axis(
+                logits,
+                jnp.minimum(lengths - 1, t0 - 1)[:, None, None],
+                axis=1,
+            )
 
         def _pkey(pos, tag, row):
             """Draw key for (absolute position, tag, batch row) — round-
@@ -222,16 +253,20 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
 
         rows = jnp.arange(b, dtype=jnp.int32)
 
+        start = (
+            jnp.full((b,), t0, jnp.int32) if lengths is None else lengths
+        )
         if sampled:
             # "No draft at this position" draws (prefill token, bonus) use
             # tag 2*vocab — disjoint from the accept (tok) and resample
-            # (vocab+tok) tag ranges.
+            # (vocab+tok) tag ranges. Position-keyed per row (= t0 for
+            # full prompts, lengths[i] ragged).
             flt0 = filter_logits(logits[:, -1], temperature, top_k, top_p)
             next_tok = jax.vmap(
-                lambda f, r: jax.random.categorical(
-                    _pkey(jnp.int32(t0), 2 * flt0.shape[-1], r), f
+                lambda f, r, p_: jax.random.categorical(
+                    _pkey(p_, 2 * flt0.shape[-1], r), f
                 ).astype(jnp.int32)
-            )(flt0, rows)
+            )(flt0, rows, start)
         else:
             next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         buf = jnp.zeros((b, tmax), jnp.int32)
@@ -417,10 +452,11 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
             )
 
         cache0 = dict(vars_["cache"])
-        # Per-row cache indices from the start (prefill leaves a scalar).
-        cache0["index"] = jnp.full((b,), t0, jnp.int32)
+        # Per-row cache indices from the start (prefill leaves a scalar);
+        # ragged rows start at their own lengths.
+        cache0["index"] = start
         carry = (
-            buf, jnp.full((b,), t0, jnp.int32), jnp.zeros((b,), jnp.int32),
+            buf, start, jnp.zeros((b,), jnp.int32),
             cache0,
             dcache0 if dcache0 is not None else jnp.int32(0),
             next_tok, jnp.int32(0),
@@ -428,10 +464,24 @@ def make_speculative_fn(model, *, max_new_tokens: int, gamma: int = 4,
         buf, cur_len, n_gen, _, _, _, rounds = lax.while_loop(
             cond, body, carry
         )
-        out = lax.dynamic_slice(
-            buf, (0, 0 if include_prompt else t0),
-            (b, (t0 if include_prompt else 0) + max_new_tokens),
-        )
+        if lengths is not None:
+            # Ragged extraction: row i's generated tokens live at
+            # [lengths[i], lengths[i] + max_new_tokens).
+            gen = jnp.take_along_axis(
+                buf,
+                lengths[:, None]
+                + jnp.arange(max_new_tokens, dtype=jnp.int32)[None, :],
+                axis=1,
+            )
+            out = (
+                jnp.concatenate([prompt, gen], axis=1) if include_prompt
+                else gen
+            )
+        else:
+            out = lax.dynamic_slice(
+                buf, (0, 0 if include_prompt else t0),
+                (b, (t0 if include_prompt else 0) + max_new_tokens),
+            )
         if return_stats:
             return out, {"rounds": rounds, "tokens": jnp.sum(n_gen)}
         return out

@@ -399,3 +399,98 @@ class TestQuantizedBundle:
         )
         for i in range(2):
             np.testing.assert_array_equal(got[i], want[i], err_msg=f"row {i}")
+
+
+class TestSpeculativeBundle:
+    def test_export_serve_matches_plain_greedy(self, tmp_path, lm, tok):
+        # The speculative bundle's program IS the speculative decoder;
+        # greedy exactness makes its HTTP generations bit-equal to the
+        # plain greedy bundle's — only the speed differs.
+        model, params = lm
+        out = serving.export_generate(
+            str(tmp_path), model, params,
+            batch_size=2, prompt_len=T0, max_new_tokens=NEW,
+            speculative_gamma=4, tokenizer=tok,
+        )
+        b = serving.load_generate(out)
+        assert b.meta["speculative_gamma"] == 4
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+        got = b.generate_tokens(prompts)
+        want = _local_ragged(model, params, prompts)  # plain greedy
+        for i in range(len(prompts)):
+            np.testing.assert_array_equal(got[i], want[i], err_msg=f"row {i}")
+
+    def test_http_route_serves_speculative_bundle(self, tmp_path, lm, tok):
+        import threading as th
+
+        model, params = lm
+        out = serving.export_generate(
+            str(tmp_path), model, params,
+            batch_size=2, prompt_len=T0, max_new_tokens=NEW,
+            speculative_gamma=3, tokenizer=tok,
+        )
+        srv = make_server(out, port=0)
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            status, body = _post(
+                srv, "/v1/generate", {"text": ["the ring"]}
+            )
+            assert status == 200
+            want = _local_ragged(model, params, [tok.encode("the ring")])
+            np.testing.assert_array_equal(body["tokens"][0], want[0])
+        finally:
+            srv.shutdown()
+
+    def test_sampled_speculative_bundle_rejected(self, tmp_path, lm):
+        model, params = lm
+        with pytest.raises(ValueError, match="greedy-only"):
+            serving.export_generate(
+                str(tmp_path), model, params,
+                batch_size=1, prompt_len=4, max_new_tokens=4,
+                speculative_gamma=4, temperature=0.7,
+            )
+        with pytest.raises(ValueError, match="eos"):
+            serving.export_generate(
+                str(tmp_path), model, params,
+                batch_size=1, prompt_len=4, max_new_tokens=4,
+                speculative_gamma=4, eos_id=3,
+            )
+
+    def test_quantized_cache_speculative_bundle_matches(self, tmp_path, lm):
+        # The stacked config: speculative loop over the int8 KV cache.
+        # Exactness contract: equals the quantized-cache GREEDY generator
+        # (both consult the same quantized values at every position).
+        model, params = lm
+        out = serving.export_generate(
+            str(tmp_path), model, params,
+            batch_size=2, prompt_len=T0, max_new_tokens=NEW,
+            speculative_gamma=4, quantized_cache=True,
+        )
+        b = serving.load_generate(out)
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+        got = b.generate_tokens(prompts)
+        fn = make_generate_fn(
+            model, max_new_tokens=NEW, include_prompt=False,
+            quantized_cache=True,
+        )
+        padded = np.zeros((2, T0), np.int32)
+        padded[0, :5] = prompts[0]
+        padded[1, :3] = prompts[1]
+        want = np.asarray(
+            fn(params, jnp.asarray(padded), jax.random.PRNGKey(0),
+               jnp.array([5, 3], jnp.int32))
+        )
+        for i in range(2):
+            np.testing.assert_array_equal(got[i], want[i], err_msg=f"row {i}")
+
+    def test_rejected_export_leaves_no_empty_dir(self, tmp_path, lm):
+        model, params = lm
+        with pytest.raises(ValueError):
+            serving.export_generate(
+                str(tmp_path), model, params,
+                batch_size=1, prompt_len=4, max_new_tokens=4,
+                speculative_gamma=4, temperature=0.7,
+                timestamp="19990101-000000",
+            )
+        assert not (tmp_path / "19990101-000000").exists()

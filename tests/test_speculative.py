@@ -401,3 +401,61 @@ class TestQuantizedSpeculative:
             model, max_new_tokens=16, gamma=4, quantized=True
         )(qparams, prompt)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestRaggedSpeculative:
+    """Ragged prompts through the speculative loop: per-row start positions
+    on the same per-row cache-index layout — each row bit-equal to plain
+    greedy at its own length (the serving batch contract)."""
+
+    def test_matches_ragged_generate(self):
+        from horovod_tpu.models.decoding import make_generate_fn
+
+        model = _model()
+        params = _params(model)
+        rng = np.random.RandomState(7)
+        t0 = 10
+        lens = np.array([4, 10, 7], np.int32)
+        padded = np.zeros((3, t0), np.int32)
+        for i, L in enumerate(lens):
+            padded[i, :L] = rng.randint(1, VOCAB, size=(L,))
+        want = np.asarray(
+            make_generate_fn(model, max_new_tokens=12, include_prompt=False)(
+                params, jnp.asarray(padded), jax.random.PRNGKey(0),
+                jnp.asarray(lens),
+            )
+        )
+        got = np.asarray(
+            make_speculative_fn(
+                model, max_new_tokens=12, gamma=4, include_prompt=False
+            )(params, jnp.asarray(padded), None, jnp.asarray(lens))
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_pad_content_irrelevant(self):
+        model = _model()
+        params = _params(model)
+        lens = jnp.array([3, 6], jnp.int32)
+        base = np.array(
+            [[5, 3, 7, 0, 0, 0], [1, 9, 8, 4, 2, 6]], np.int32
+        )
+        noisy = base.copy()
+        noisy[0, 3:] = [11, 13, 17]
+        fn = make_speculative_fn(
+            model, max_new_tokens=8, gamma=3, include_prompt=False
+        )
+        a = np.asarray(fn(params, jnp.asarray(base), None, lens))
+        b = np.asarray(fn(params, jnp.asarray(noisy), None, lens))
+        np.testing.assert_array_equal(a, b)
+
+    def test_draft_model_rejected_with_lengths(self):
+        target = _model(n_layers=2)
+        draft = _model(d_model=16, n_heads=2, n_layers=1)
+        toks = jnp.zeros((2, 8), jnp.int32)
+        tp = target.init(jax.random.PRNGKey(0), toks)["params"]
+        dp = draft.init(jax.random.PRNGKey(1), toks)["params"]
+        fn = make_speculative_fn(
+            target, max_new_tokens=8, draft_model=draft, draft_params=dp
+        )
+        with pytest.raises(ValueError, match="ragged"):
+            fn(tp, toks, None, jnp.array([4, 8], jnp.int32))
