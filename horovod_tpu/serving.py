@@ -228,8 +228,19 @@ class GenerateBundle:
             raise ValueError(f"{bundle_dir} is not a generation bundle")
         with open(os.path.join(bundle_dir, GEN_GRAPH_FILE), "rb") as f:
             self._exported = jax_export.deserialize(f.read())
+        # jit the deserialized program ONCE: a bare exported.call re-lowers
+        # on every invocation (measured seconds per request at LM scale);
+        # under jit the compilation caches and repeat calls are a dispatch.
+        self._call = jax.jit(self._exported.call)
         with open(os.path.join(bundle_dir, GEN_WEIGHTS_FILE), "rb") as f:
             self._params = serialization.msgpack_restore(f.read())
+        # Commit the weights to device ONCE: params are an ARGUMENT of the
+        # exported program, and host numpy args would re-transfer the whole
+        # model through the interconnect on every request (measured 3.3 s
+        # vs 0.08 s per request at d512x8L over a tunneled runtime).
+        import jax.numpy as jnp
+
+        self._params = jax.tree.map(jnp.asarray, self._params)
         self.tokenizer = None
         tok_path = os.path.join(bundle_dir, TOKENIZER_FILE)
         if os.path.exists(tok_path):
@@ -260,7 +271,7 @@ class GenerateBundle:
             # Speculative bundles are greedy: no rng input in the program
             # (the seed is ignored — deterministic by construction).
             return np.asarray(
-                self._exported.call(
+                self._call(
                     self._params,
                     padded.astype(np.int32),
                     None,
@@ -275,7 +286,7 @@ class GenerateBundle:
         if chunk:
             rng = jax.random.fold_in(rng, chunk)
         return np.asarray(
-            self._exported.call(
+            self._call(
                 self._params,
                 padded.astype(np.int32),
                 rng,
