@@ -205,22 +205,25 @@ class _GenerateApp:
         self.stats = {"device_calls": 0, "rows": 0}
         greedy = float(self.bundle.meta.get("temperature", 0.0)) == 0.0
         self._lock = threading.Lock()
+        # The batcher's dispatches take the SAME lock the sampled and
+        # streaming paths use, so the compiled programs never run
+        # re-entrantly whatever mix of request kinds is in flight.
         self._batcher = (
             _Batcher(
-                lambda rows: self.bundle.generate_batch(rows),
+                self._locked_generate_batch,
                 self.bundle.batch_size,
                 self.stats,
             )
             if (coalesce and greedy) else None
         )
 
-    def generate(self, payload: dict) -> dict:
-        seed = int(payload.get("seed", 0))
+    def _locked_generate_batch(self, rows: list) -> list:
+        with self._lock:
+            return self.bundle.generate_batch(rows)
+
+    def _payload_prompts(self, payload: dict):
         if "text" in payload and "prompt" in payload:
             raise ValueError("pass 'text' OR 'prompt', not both")
-        # Tokenize OUTSIDE the lock — only the compiled call needs
-        # serializing through the device; CPU encode/decode of one request
-        # must not block another's device run.
         if "text" in payload:
             texts = payload["text"]
             if not isinstance(texts, list):
@@ -230,9 +233,46 @@ class _GenerateApp:
                     "this bundle has no tokenizer — POST token ids "
                     "under 'prompt' instead"
                 )
-            prompts = [self.bundle.tokenizer.encode(t) for t in texts]
-        else:
-            prompts = payload["prompt"]
+            return [self.bundle.tokenizer.encode(t) for t in texts]
+        return payload["prompt"]
+
+    def stream(self, payload: dict):
+        """NDJSON streaming: one ``{"tokens": [[...]]}`` line per chunk,
+        then a final ``{"done": true, ...}`` line (with the detokenized
+        text when the bundle carries a tokenizer). The device lock is
+        taken PER DISPATCH — the carried state is self-contained, so
+        while one stream's client drains a chunk over the network, other
+        requests' device calls interleave instead of queueing behind a
+        slow reader."""
+        seed = int(payload.get("seed", 0))
+        prompts = self._payload_prompts(payload)
+        rows = [[] for _ in prompts]
+        it = self.bundle.stream_chunks(prompts, seed=seed)
+        while True:
+            with self._lock:
+                try:
+                    chunk = next(it)
+                except StopIteration:
+                    break
+                self.stats["device_calls"] += 1
+            for i, part in enumerate(chunk):
+                rows[i].extend(part)
+            yield {"tokens": chunk}
+        self.stats["rows"] += len(prompts)
+        trimmed = [self.bundle._trim(np.asarray(r)) for r in rows]
+        final = {"done": True, "tokens": trimmed}
+        if self.bundle.tokenizer is not None:
+            final["text"] = [
+                self.bundle.tokenizer.decode(g) for g in trimmed
+            ]
+        yield final
+
+    def generate(self, payload: dict) -> dict:
+        seed = int(payload.get("seed", 0))
+        # Tokenize OUTSIDE the lock — only the compiled call needs
+        # serializing through the device; CPU encode/decode of one request
+        # must not block another's device run.
+        prompts = self._payload_prompts(payload)
         if self._batcher is not None:
             # Validate on the handler thread; rows coalesce across
             # requests (greedy: the seed is dead code in the program).
@@ -302,7 +342,37 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length))
-                if app.kind == "generate":
+                if app.kind == "generate" and payload.get("stream"):
+                    # NDJSON streaming: no Content-Length; the body is
+                    # line-delimited JSON chunks, connection-close
+                    # terminated (HTTP/1.0 semantics of this server).
+                    import itertools
+
+                    chunks = app.stream(payload)
+                    first = next(chunks)  # validation runs BEFORE headers
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson"
+                    )
+                    self.end_headers()
+                    try:
+                        for item in itertools.chain((first,), chunks):
+                            self.wfile.write(
+                                json.dumps(item).encode() + b"\n"
+                            )
+                            self.wfile.flush()
+                    except Exception as e:
+                        # Headers are out — a second status line would
+                        # corrupt the body. Keep the errors-are-JSON
+                        # contract with an error NDJSON line; the missing
+                        # 'done' line tells the client the stream died.
+                        self.wfile.write(
+                            json.dumps(
+                                {"error": f"{type(e).__name__}: {e}"}
+                            ).encode() + b"\n"
+                        )
+                        self.wfile.flush()
+                elif app.kind == "generate":
                     self._send(200, app.generate(payload))
                 else:
                     rows = np.asarray(payload["input"])

@@ -207,6 +207,106 @@ def make_generate_fn(model, *, max_new_tokens: int, temperature: float = 0.0,
     return jax.jit(run)
 
 
+def make_chunked_generate_fns(model, *, max_new_tokens: int, chunk: int,
+                              temperature: float = 0.0, top_k: int = 0,
+                              top_p: float = 0.0, eos_id: int | None = None,
+                              quantized_cache: bool = False):
+    """Chunked generation for STREAMING serving: two compiled programs that
+    emit ``chunk`` tokens per dispatch with the KV cache carried between
+    calls as ordinary arrays (device-resident between dispatches).
+
+    Returns ``(start_fn, continue_fn)``:
+
+    * ``start_fn(params, prompt [B, T0], rng, lengths [B]) ->
+      (tokens [B, chunk], state)`` — prefill + the first ``chunk`` tokens
+      (ragged per-row lengths, decoding.make_generate_fn's contract);
+    * ``continue_fn(params, state) -> (tokens [B, chunk], state)`` — the
+      next ``chunk`` tokens against the carried cache.
+
+    ``state`` is a pytree ``(cache, last_tok, rng, done)``; its ``done``
+    leaf ([B] bool) lets a server stop early once every row emitted
+    ``eos_id``. The cache is sized ``prompt_len + max_new_tokens`` at the
+    first call, so at most ``ceil(max_new_tokens / chunk)`` chunks are
+    valid — the caller enforces the budget. Token streams are IDENTICAL
+    to `make_generate_fn`'s for the same knobs (one compiled scan cut at
+    chunk boundaries; greedy/sampling/eos semantics unchanged — parity
+    tested).
+    """
+    if chunk < 1:
+        raise ValueError("chunk must be >= 1")
+    if max_new_tokens % chunk != 0:
+        # The cache is sized t0 + max_new_tokens exactly; a partial final
+        # chunk would scan past it. Divisibility keeps every chunk valid.
+        raise ValueError(
+            f"chunk ({chunk}) must divide max_new_tokens "
+            f"({max_new_tokens})"
+        )
+
+    fill = jnp.int32(0 if eos_id is None else eos_id)
+
+    def make_body(dmodel, params):
+        def body(carry, _):
+            cache, tok, rng, done = carry
+            step_logits, step_vars = dmodel.apply(
+                {"params": params, "cache": cache},
+                tok[:, None], mutable=["cache"],
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(step_logits[:, -1], sub, temperature, top_k, top_p)
+            nxt = jnp.where(done, fill, nxt)
+            new_done = done if eos_id is None else done | (nxt == eos_id)
+            return (step_vars["cache"], nxt, rng, new_done), nxt
+
+        return body
+
+    def dmodel_for(t0):
+        kw = {"quantized_cache": True} if quantized_cache else {}
+        return model.clone(
+            decode=True, max_decode_len=t0 + max_new_tokens, dropout=0.0,
+            remat=False, **kw,
+        )
+
+    def start(params, prompt, rng, lengths):
+        prompt = prompt.astype(jnp.int32)
+        b, t0 = prompt.shape
+        dmodel = dmodel_for(t0)
+        logits, vars_ = dmodel.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        lengths = jnp.asarray(lengths, jnp.int32)
+        last_logits = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]
+        rng, sub = jax.random.split(rng)
+        tok = _sample(last_logits, sub, temperature, top_k, top_p)
+        done = jnp.zeros((b,), bool) if eos_id is None else tok == eos_id
+        cache0 = {**vars_["cache"], "index": lengths}
+        (cache, tok_l, rng, done), rest = lax.scan(
+            make_body(dmodel, params), (cache0, tok, rng, done), None,
+            length=chunk - 1,
+        )
+        tokens = jnp.concatenate(
+            [tok[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+        )
+        return tokens, (cache, tok_l, rng, done)
+
+    def cont(params, state):
+        cache, tok, rng, done = state
+        # The cache length encodes t0 + max_new_tokens; reconstruct the
+        # model at the same static size from the carried cache leaves.
+        any_k = next(
+            v["k"] for v in cache.values() if isinstance(v, dict) and "k" in v
+        )
+        dmodel = dmodel_for(any_k.shape[1] - max_new_tokens)
+        (cache, tok_l, rng, done), toks = lax.scan(
+            make_body(dmodel, params), (cache, tok, rng, done), None,
+            length=chunk,
+        )
+        return jnp.moveaxis(toks, 0, 1), (cache, tok_l, rng, done)
+
+    return jax.jit(start), jax.jit(cont)
+
+
 def generate(model, params, prompt, max_new_tokens: int, *, rng=None,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              eos_id: int | None = None, include_prompt: bool = True,

@@ -34,7 +34,9 @@ Usage:
     state = state.replace(params={**state.params, "base": pretrained})
     ... fit ...
     merged = lora.merge_params(state.params)   # plain inner params:
-    # serve/decode/export with the ORIGINAL module, adapters folded in.
+    # serve/decode/export with the ORIGINAL module, adapters folded in —
+    # e.g. serving.export_generate(dir, inner, merged, ...) ships the
+    # fine-tuned model as an ordinary generation bundle.
 """
 
 from __future__ import annotations

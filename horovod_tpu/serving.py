@@ -46,6 +46,8 @@ import numpy as np
 from flax import serialization
 
 GEN_GRAPH_FILE = "generate.stablehlo"
+GEN_START_FILE = "generate_start.stablehlo"  # streaming bundles
+GEN_CONT_FILE = "generate_cont.stablehlo"
 GEN_META_FILE = "generate.json"
 GEN_WEIGHTS_FILE = "weights.msgpack"
 TOKENIZER_FILE = "tokenizer.json"
@@ -69,6 +71,7 @@ def export_generate(
     int8_compute: bool = False,
     quantized_cache: bool = False,
     speculative_gamma: int = 0,
+    streaming_chunk: int = 0,
 ) -> str:
     """Export a generation bundle into ``export_dir/<stamp>/``.
 
@@ -144,6 +147,30 @@ def export_generate(
             int8_compute=int8_compute,
             quantized_cache=quantized_cache,
         )
+    # streaming_chunk > 0: the bundle carries TWO programs (prefill+first
+    # chunk; continue-against-carried-cache) so a server can stream tokens
+    # chunk by chunk — `make_chunked_generate_fns`, whose token stream is
+    # parity-tested against the one-shot generator. Exclusive with the
+    # speculative program (one program shape per bundle).
+    start_fn = cont_fn = None
+    if streaming_chunk:
+        if speculative_gamma:
+            raise ValueError(
+                "streaming_chunk and speculative_gamma are exclusive — "
+                "one program shape per bundle"
+            )
+        if int8_compute:
+            raise ValueError(
+                "int8_compute is not wired into the chunked generator — "
+                "export with one or the other"
+            )
+        from horovod_tpu.models.decoding import make_chunked_generate_fns
+
+        start_fn, cont_fn = make_chunked_generate_fns(
+            model, max_new_tokens=max_new_tokens, chunk=streaming_chunk,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, quantized_cache=quantized_cache,
+        )
     stamp = timestamp or time.strftime("%Y%m%d-%H%M%S")
     out_dir = os.path.join(export_dir, stamp)
     os.makedirs(out_dir, exist_ok=True)
@@ -162,12 +189,29 @@ def export_generate(
         )
     )
     lengths_spec = jax.ShapeDtypeStruct((batch_size,), np.int32)
-    exported = jax_export.export(fn)(
-        param_specs, prompt_spec, rng_spec, lengths_spec
-    )
     from horovod_tpu.checkpoint import _atomic_write
 
-    _atomic_write(os.path.join(out_dir, GEN_GRAPH_FILE), exported.serialize())
+    if streaming_chunk:
+        exp_start = jax_export.export(start_fn)(
+            param_specs, prompt_spec, rng_spec, lengths_spec
+        )
+        state_spec = jax.eval_shape(
+            start_fn, param_specs, prompt_spec, rng_spec, lengths_spec
+        )[1]
+        exp_cont = jax_export.export(cont_fn)(param_specs, state_spec)
+        _atomic_write(
+            os.path.join(out_dir, GEN_START_FILE), exp_start.serialize()
+        )
+        _atomic_write(
+            os.path.join(out_dir, GEN_CONT_FILE), exp_cont.serialize()
+        )
+    else:
+        exported = jax_export.export(fn)(
+            param_specs, prompt_spec, rng_spec, lengths_spec
+        )
+        _atomic_write(
+            os.path.join(out_dir, GEN_GRAPH_FILE), exported.serialize()
+        )
     _atomic_write(
         os.path.join(out_dir, GEN_WEIGHTS_FILE),
         serialization.to_bytes(params),
@@ -185,6 +229,7 @@ def export_generate(
         "int8_compute": int8_compute,
         "quantized_cache": quantized_cache,
         "speculative_gamma": speculative_gamma,
+        "streaming_chunk": streaming_chunk,
         "has_tokenizer": tokenizer is not None,
         "created": stamp,
     }
@@ -226,12 +271,20 @@ class GenerateBundle:
             self.meta = json.load(f)
         if self.meta.get("kind") != "generate":
             raise ValueError(f"{bundle_dir} is not a generation bundle")
-        with open(os.path.join(bundle_dir, GEN_GRAPH_FILE), "rb") as f:
-            self._exported = jax_export.deserialize(f.read())
-        # jit the deserialized program ONCE: a bare exported.call re-lowers
-        # on every invocation (measured seconds per request at LM scale);
-        # under jit the compilation caches and repeat calls are a dispatch.
-        self._call = jax.jit(self._exported.call)
+        if self.meta.get("streaming_chunk"):
+            with open(os.path.join(bundle_dir, GEN_START_FILE), "rb") as f:
+                self._start = jax.jit(jax_export.deserialize(f.read()).call)
+            with open(os.path.join(bundle_dir, GEN_CONT_FILE), "rb") as f:
+                self._cont = jax.jit(jax_export.deserialize(f.read()).call)
+            self._call = None
+        else:
+            with open(os.path.join(bundle_dir, GEN_GRAPH_FILE), "rb") as f:
+                self._exported = jax_export.deserialize(f.read())
+            # jit the deserialized program ONCE: a bare exported.call
+            # re-lowers on every invocation (measured seconds per request
+            # at LM scale); under jit the compilation caches and repeat
+            # calls are a dispatch.
+            self._call = jax.jit(self._exported.call)
         with open(os.path.join(bundle_dir, GEN_WEIGHTS_FILE), "rb") as f:
             self._params = serialization.msgpack_restore(f.read())
         # Commit the weights to device ONCE: params are an ARGUMENT of the
@@ -265,8 +318,57 @@ class GenerateBundle:
     def prompt_len(self) -> int:
         return int(self.meta["prompt_len"])
 
+    def stream_chunks(self, prompts, seed: int = 0, chunk: int = 0):
+        """STREAMING generation: yields ``[B_req, chunk]``-shaped lists of
+        token ids per dispatch (the cache stays device-resident between
+        chunks). Requires a streaming bundle (``streaming_chunk`` at
+        export) and at most ``batch_size`` validated prompts; stops early
+        once every row has emitted eos (when configured). The
+        concatenation of the yielded chunks equals the one-shot
+        generation for the same knobs (parity-tested)."""
+        k = int(self.meta.get("streaming_chunk") or 0)
+        if not k:
+            raise ValueError(
+                "this bundle was not exported with streaming_chunk — "
+                "re-export to stream"
+            )
+        prompts = self.validate_prompts(prompts)
+        b, t0 = self.batch_size, self.prompt_len
+        if not prompts or len(prompts) > b:
+            raise ValueError(
+                f"streaming takes 1..{b} prompts per request, got "
+                f"{len(prompts)}"
+            )
+        n = len(prompts)
+        pad = int(self.meta.get("pad_id") or 0)
+        padded = np.full((b, t0), pad, np.int32)
+        lengths = np.ones((b,), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+            lengths[i] = len(p)
+        # Same per-group rng discipline as _run: group 0 uses PRNGKey(seed)
+        # verbatim (local-parity contract), later groups of an
+        # over-batch-size request fold the group index in.
+        rng = jax.random.PRNGKey(seed)
+        if chunk:
+            rng = jax.random.fold_in(rng, chunk)
+        tokens, state = self._start(self._params, padded, rng, lengths)
+        yield np.asarray(tokens)[:n].tolist()
+        total = int(self.meta["max_new_tokens"])
+        for _ in range(total // k - 1):
+            if self.meta.get("eos_id") is not None and bool(
+                np.asarray(state[3])[:n].all()
+            ):
+                return  # every live row finished — stop dispatching
+            tokens, state = self._cont(self._params, state)
+            yield np.asarray(tokens)[:n].tolist()
+
     def _run(self, padded: np.ndarray, lengths: np.ndarray, seed: int,
              chunk: int = 0):
+        if self.meta.get("streaming_chunk"):
+            # Streaming bundles dispatch via stream_chunks (the one-shot
+            # API collects in generate_batch's streaming branch).
+            raise RuntimeError("_run is not used for streaming bundles")
         if self.meta.get("speculative_gamma"):
             # Speculative bundles are greedy: no rng input in the program
             # (the seed is ignored — deterministic by construction).
@@ -309,13 +411,26 @@ class GenerateBundle:
     def generate_batch(self, prompts, seed: int = 0, chunk: int = 0) -> list:
         """ONE device call over ≤ batch_size validated prompt rows →
         trimmed generated-id lists. The unit the server's coalescing queue
-        dispatches (launch/serve.py)."""
+        dispatches (launch/serve.py). (Streaming bundles run their chunk
+        loop here — same token stream, more dispatches.)"""
         b, t0 = self.batch_size, self.prompt_len
         if len(prompts) > b:
             raise ValueError(
                 f"{len(prompts)} rows > compiled batch {b}; use "
                 "generate_tokens for auto-splitting"
             )
+        if self.meta.get("streaming_chunk"):
+            # One-shot API over a streaming bundle: collect the chunks
+            # (same token stream — chunking is where dispatches cut, not
+            # what is computed). The batch-group index threads through so
+            # sampled over-batch-size requests don't repeat across groups.
+            rows = [[] for _ in prompts]
+            for chunk_tokens in self.stream_chunks(
+                prompts, seed=seed, chunk=chunk
+            ):
+                for i, part in enumerate(chunk_tokens):
+                    rows[i].extend(part)
+            return [self._trim(np.asarray(r)) for r in rows]
         pad = int(self.meta.get("pad_id") or 0)
         n = len(prompts)
         padded = np.full((b, t0), pad, np.int32)

@@ -693,3 +693,87 @@ class TestRaggedPrompts:
         with pytest.raises(ValueError, match="per-row"):
             fn(params, jnp.asarray(prompt), jax.random.PRNGKey(0),
                jnp.array([3, 6], jnp.int32))
+
+
+class TestChunkedGeneration:
+    """make_chunked_generate_fns: the streaming-serving building block —
+    chunk-by-chunk emission with the cache carried between dispatches must
+    reproduce make_generate_fn's token stream exactly."""
+
+    def _stream(self, model, params, prompt, lens, *, chunk, total, **kw):
+        from horovod_tpu.models.decoding import make_chunked_generate_fns
+
+        start, cont = make_chunked_generate_fns(
+            model, max_new_tokens=total, chunk=chunk, **kw
+        )
+        key = jax.random.PRNGKey(0)
+        toks, state = start(params, jnp.asarray(prompt), key, jnp.asarray(lens))
+        out = [np.asarray(toks)]
+        for _ in range(total // chunk - 1):
+            toks, state = cont(params, state)
+            out.append(np.asarray(toks))
+        return np.concatenate(out, axis=1), state
+
+    def test_greedy_stream_matches_one_shot(self):
+        model = _model()
+        params = _params(model)
+        lens = np.array([3, 8], np.int32)
+        prompt = np.zeros((2, 8), np.int32)
+        prompt[0, :3] = [3, 1, 4]
+        prompt[1] = [9, 2, 6, 5, 3, 7, 1, 8]
+        fn = make_generate_fn(model, max_new_tokens=12, include_prompt=False)
+        want = np.asarray(
+            fn(params, jnp.asarray(prompt), jax.random.PRNGKey(0),
+               jnp.asarray(lens))
+        )
+        got, _ = self._stream(
+            model, params, prompt, lens, chunk=4, total=12
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_sampled_stream_matches_one_shot(self):
+        model = _model()
+        params = _params(model)
+        lens = np.array([5, 5], np.int32)
+        prompt = np.asarray([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+        kw = dict(temperature=0.8, top_k=8)
+        fn = make_generate_fn(
+            model, max_new_tokens=10, include_prompt=False, **kw
+        )
+        want = np.asarray(
+            fn(params, jnp.asarray(prompt), jax.random.PRNGKey(0),
+               jnp.asarray(lens))
+        )
+        got, _ = self._stream(
+            model, params, prompt, lens, chunk=5, total=10, **kw
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_eos_done_flag_and_fill(self):
+        model = _model()
+        params = _params(model)
+        lens = np.array([4], np.int32)
+        prompt = np.asarray([[5, 3, 2, 7]], np.int32)
+        # Find a token the model emits, make it eos.
+        probe = make_generate_fn(model, max_new_tokens=8, include_prompt=False)(
+            params, jnp.asarray(prompt), jax.random.PRNGKey(0),
+            jnp.asarray(lens),
+        )
+        eos = int(np.asarray(probe)[0, 1])
+        got, state = self._stream(
+            model, params, prompt, lens, chunk=4, total=8, eos_id=eos
+        )
+        want = np.asarray(
+            make_generate_fn(
+                model, max_new_tokens=8, include_prompt=False, eos_id=eos
+            )(params, jnp.asarray(prompt), jax.random.PRNGKey(0),
+              jnp.asarray(lens))
+        )
+        np.testing.assert_array_equal(got, want)
+        assert bool(np.asarray(state[3])[0])  # done flag set
+
+    def test_chunk_must_divide(self):
+        from horovod_tpu.models.decoding import make_chunked_generate_fns
+
+        with pytest.raises(ValueError, match="divide"):
+            make_chunked_generate_fns(_model(), max_new_tokens=10, chunk=4)

@@ -494,3 +494,166 @@ class TestSpeculativeBundle:
                 timestamp="19990101-000000",
             )
         assert not (tmp_path / "19990101-000000").exists()
+
+
+class TestStreamingBundle:
+    @pytest.fixture(scope="class")
+    def stream_bundle(self, tmp_path_factory, lm, tok):
+        model, params = lm
+        return serving.export_generate(
+            str(tmp_path_factory.mktemp("streamexport")), model, params,
+            batch_size=2, prompt_len=T0, max_new_tokens=6,
+            streaming_chunk=2, tokenizer=tok,
+        )
+
+    def test_chunks_concatenate_to_one_shot(self, stream_bundle, lm):
+        model, params = lm
+        b = serving.load_generate(stream_bundle)
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+        chunks = list(b.stream_chunks(prompts, seed=0))
+        assert len(chunks) == 3 and all(
+            len(c[0]) == 2 for c in chunks
+        )
+        got = [sum((c[i] for c in chunks), []) for i in range(2)]
+        want = _local_ragged(model, params, prompts)[:, :6]
+        for i in range(2):
+            np.testing.assert_array_equal(got[i], want[i], err_msg=f"row {i}")
+
+    def test_one_shot_api_works_on_streaming_bundle(self, stream_bundle, lm):
+        model, params = lm
+        b = serving.load_generate(stream_bundle)
+        got = b.generate_tokens([[7, 7, 2]], seed=0)
+        want = _local_ragged(model, params, [[7, 7, 2]])[:, :6]
+        np.testing.assert_array_equal(got[0], want[0])
+
+    def test_http_ndjson_stream(self, stream_bundle, lm, tok):
+        import threading as th
+
+        model, params = lm
+        srv = make_server(stream_bundle, port=0)
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.server_address[1]}/v1/generate",
+                data=json.dumps(
+                    {"text": ["the ring"], "stream": True}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"] == "application/x-ndjson"
+                lines = [json.loads(l) for l in r.read().splitlines()]
+            assert lines[-1]["done"] is True
+            streamed = sum((l["tokens"][0] for l in lines[:-1]), [])
+            want = _local_ragged(
+                model, params, [tok.encode("the ring")]
+            )[0, :6]
+            np.testing.assert_array_equal(streamed, want)
+            assert lines[-1]["text"] == [tok.decode(list(map(int, want)))]
+        finally:
+            srv.shutdown()
+
+    def test_stream_on_non_streaming_bundle_is_400(self, server):
+        status, body = _post_raw(
+            server, "/v1/generate", {"prompt": [[1, 2]], "stream": True}
+        )
+        assert status == 400
+        assert "streaming" in body["error"]
+
+    def test_eos_stops_stream_early(self, tmp_path, lm):
+        model, params = lm
+        probe_dir = serving.export_generate(
+            str(tmp_path / "probe"), model, params,
+            batch_size=1, prompt_len=4, max_new_tokens=6,
+        )
+        first = serving.load_generate(probe_dir).generate_tokens([[5, 3, 2]])[0]
+        eos = int(first[1])  # emitted at the second position
+        out = serving.export_generate(
+            str(tmp_path / "eos"), model, params,
+            batch_size=1, prompt_len=4, max_new_tokens=6,
+            streaming_chunk=2, eos_id=eos,
+        )
+        b = serving.load_generate(out)
+        chunks = list(b.stream_chunks([[5, 3, 2]]))
+        # eos lands in chunk 1 -> later chunks are not dispatched.
+        assert len(chunks) < 3, chunks
+
+    def test_mid_stream_error_is_ndjson_line(self, stream_bundle):
+        import threading as th
+
+        srv = make_server(stream_bundle, port=0)
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            calls = {"n": 0}
+            app = srv.app
+            real = app.bundle._cont
+
+            def dying_cont(*a):
+                calls["n"] += 1
+                raise RuntimeError("device fell over mid-stream")
+
+            app.bundle._cont = dying_cont
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.server_address[1]}/v1/generate",
+                data=json.dumps(
+                    {"prompt": [[3, 1, 4]], "stream": True}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 200  # headers were already out
+                lines = [json.loads(l) for l in r.read().splitlines()]
+            app.bundle._cont = real
+            # First chunk streamed, then the error line; no 'done' line.
+            assert "tokens" in lines[0]
+            assert "device fell over" in lines[-1]["error"]
+            assert not any(l.get("done") for l in lines)
+        finally:
+            srv.shutdown()
+
+    def test_concurrent_nonstream_not_blocked_by_slow_stream_reader(
+        self, stream_bundle, lm
+    ):
+        # Per-dispatch locking: while a stream's client drains slowly,
+        # other requests' device calls interleave.
+        import threading as th
+        import time as time_lib
+
+        model, params = lm
+        srv = make_server(stream_bundle, port=0)
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/v1/generate"
+            stream_req = urllib.request.Request(
+                url,
+                data=json.dumps(
+                    {"prompt": [[3, 1, 4]], "stream": True}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = urllib.request.urlopen(stream_req)
+            resp.readline()  # first chunk received; stream now idle-ish
+            # A one-shot request must complete while the stream is open.
+            done = {}
+
+            def oneshot():
+                r = urllib.request.urlopen(
+                    urllib.request.Request(
+                        url,
+                        data=json.dumps({"prompt": [[9, 2]]}).encode(),
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=30,
+                )
+                done["tokens"] = json.loads(r.read())["tokens"]
+
+            c = th.Thread(target=oneshot)
+            c.start()
+            c.join(timeout=30)
+            assert done.get("tokens"), "one-shot starved behind open stream"
+            resp.read()  # drain the stream
+        finally:
+            srv.shutdown()
