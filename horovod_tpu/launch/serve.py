@@ -20,6 +20,10 @@ Endpoints (JSON, shapes follow the exported signature's trailing dims):
   ``{"text": ["...", ...]}`` (+ optional ``"seed": N``)
                                    → ``{"tokens": [[ids...], ...]}``
                                      (+ ``"text": [...]`` with a tokenizer)
+* ``POST /v1/generate`` with ``"stream": true`` (streaming bundles —
+  `serving.export_generate(streaming_chunk=K)`) → ``application/x-ndjson``:
+  one ``{"tokens": [[ids...]]}`` line per generated chunk, then a final
+  ``{"done": true, "tokens": ..., "text": ...}`` line.
 
 Batching: the exported program is compiled for ONE batch shape (static
 shapes are the deal with XLA). Requests of any row count are padded up /
