@@ -1,0 +1,51 @@
+"""jax version-tolerance shims.
+
+The repo targets the current jax spelling of APIs; containers pinned to an
+older jax (< 0.5) lack some of them. Every such difference is absorbed here
+— call sites import from `horovod_tpu.compat` and stay on the modern
+signature.
+
+* ``shard_map``: ``jax.shard_map(..., check_vma=...)`` is the modern form;
+  older releases ship ``jax.experimental.shard_map.shard_map`` whose
+  equivalent knob is spelled ``check_rep``.
+* ``axis_size``: ``jax.lax.axis_size(name)`` is newer; the portable
+  spelling reads the bound axis env directly (a trace-time constant, like
+  the modern call — NOT a ``psum(1)`` collective).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+
+    def axis_size(axis_name) -> int:
+        """Size of a bound mesh axis (tuple = product), trace-time."""
+        if isinstance(axis_name, (tuple, list)):
+            out = 1
+            for n in axis_name:
+                out *= axis_size(n)
+            return out
+        from jax._src import core as _core  # old jax only: no public API
+
+        return _core.get_axis_env().axis_size(axis_name)
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+else:  # jax < 0.5: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

@@ -12,14 +12,44 @@ distributed-keras-sample.yaml:1-11) and gates CI on a metric range
 * `ci_gate` — aggregate a metric stream and assert a target range (the
   Gradient workflow's ``checks`` block).
 * `job` — YAML job specs binding the two together (the `.ps_project` role).
+* `supervisor` — fail-*restart* around either launcher: crash/preemption/
+  hang classification, heartbeat hang detection, progress-aware restart
+  budget, JSONL restart journal (``run``/``pod`` ``--max-restarts``
+  ``--backoff`` ``--heartbeat-timeout``; the job spec's ``restart:`` block).
 
 CLI:  python -m horovod_tpu.launch run --nprocs 4 -- python train.py
+      python -m horovod_tpu.launch run --nprocs 4 --max-restarts 3 \\
+          --heartbeat-timeout 300 -- python train.py
       python -m horovod_tpu.launch pod --hostfile hosts.txt -- python train.py
       python -m horovod_tpu.launch gate --metrics m.jsonl --check loss=0.0..0.3
       python -m horovod_tpu.launch job launch/jobs/mnist-ci.yaml
 """
 
-from horovod_tpu.launch.launcher import run_local, run_hosts
+from horovod_tpu.launch.launcher import (
+    Fleet,
+    run_hosts,
+    run_local,
+    start_hosts,
+    start_local,
+)
 from horovod_tpu.launch.ci_gate import check_metrics, parse_target
+from horovod_tpu.launch.supervisor import (
+    RestartPolicy,
+    supervise,
+    supervise_hosts,
+    supervise_local,
+)
 
-__all__ = ["run_local", "run_hosts", "check_metrics", "parse_target"]
+__all__ = [
+    "Fleet",
+    "run_local",
+    "run_hosts",
+    "start_local",
+    "start_hosts",
+    "check_metrics",
+    "parse_target",
+    "RestartPolicy",
+    "supervise",
+    "supervise_local",
+    "supervise_hosts",
+]

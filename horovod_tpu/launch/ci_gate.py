@@ -40,6 +40,11 @@ def read_metric(path: str, name: str) -> list[float]:
 
 
 def aggregate(values: list[float], how: str = "mean") -> float:
+    if how == "count":
+        # Number of records, not their values — the restart-log check
+        # ("exactly one restart recorded": restarts=1..1, aggregate count;
+        # the supervisor's JSONL records are metric-shaped for this).
+        return float(len(values))
     if not values:
         raise ValueError("no values to aggregate")
     if how == "mean":
@@ -62,8 +67,15 @@ def check_metrics(
     """Return (passed, aggregated value). Missing metric — or a missing
     metrics file entirely — fails the gate rather than crashing it (a run
     that logged nothing must not pass)."""
+    if not os.path.exists(path):
+        # A missing stream file always fails — for every aggregate: a run
+        # that wrote nothing (or a typo'd path) must not pass any check.
+        return False, float("nan")
     values = read_metric(path, name)
-    if not values:
+    if not values and how != "count":
+        # count is the exception *for an existing file*: zero matching
+        # records is a legitimate answer (e.g. asserting a supervised run
+        # needed no restarts — the journal exists, no restart lines).
         return False, float("nan")
     value = aggregate(values, how)
     lo, hi = target

@@ -83,7 +83,43 @@ def run_job(spec_path: str) -> int:
         )
         if code != 0:
             return code
-    if hosts:
+    # `restart:` block — supervised fail-restart launch (supervisor.py):
+    #   restart:
+    #     max_restarts: 3         # consecutive no-progress budget
+    #     backoff: 1.0            # seconds, doubles per no-progress restart
+    #     heartbeat_timeout: 300  # omit to disable hang detection
+    #     log: path/restarts.jsonl  # default $PS_MODEL_PATH/restarts.jsonl
+    if "restart" in job:
+        # Key-present-but-empty (`restart:` with every knob commented out)
+        # means "supervise with defaults" — matching the CLI, where any
+        # supervision flag opts in. Only a mapping (or nothing) is valid;
+        # `restart: true` etc. must fail loudly, not run unsupervised.
+        restart = job["restart"] or {}
+        if not isinstance(restart, dict):
+            print(f"job restart: must be a mapping, got {restart!r}")
+            return 1
+        from horovod_tpu.launch import supervisor
+
+        policy = supervisor.RestartPolicy.from_mapping(
+            {k: v for k, v in restart.items() if k != "log"}
+        )
+        log_path = restart.get("log") or supervisor.default_log_path(env)
+        if log_path and os.path.exists(log_path):
+            # Same hygiene as the metrics stream above: a previous run's
+            # restart journal must not feed this run's log/gate.
+            os.remove(log_path)
+        if hosts:
+            code = supervisor.supervise_hosts(
+                list(hosts), argv, env=env, policy=policy,
+                coordinator_port=int(job.get("coordinator_port", 9981)),
+                workdir=job.get("workdir"), log_path=log_path,
+            )
+        else:
+            code = supervisor.supervise_local(
+                int(job.get("nprocs", 1)), argv, env=env, policy=policy,
+                log_path=log_path,
+            )
+    elif hosts:
         code = launcher.run_hosts(
             list(hosts), argv, env=env,
             coordinator_port=int(job.get("coordinator_port", 9981)),

@@ -30,44 +30,93 @@ def pick_free_port() -> int:
         return s.getsockname()[1]
 
 
+class Fleet:
+    """Handle on a launched set of coordinated processes.
+
+    `start_local`/`start_hosts` return one; `wait()` reproduces the MPI
+    fail-stop contract (any rank failure aborts the job, SURVEY.md §5.3) and
+    the supervisor (`launch/supervisor.py`) additionally drives `wait(abort=
+    ...)` to kill a fleet whose heartbeats went stale (a hung collective is
+    invisible to exit codes — the NCCL/ICI failure mode, arXiv:1810.11112).
+    """
+
+    def __init__(self, procs: list[subprocess.Popen], pumps=()):
+        self.procs = list(procs)
+        self.pumps = list(pumps)
+        # True when wait(abort=...) tore the fleet down itself — the
+        # supervisor's hang marker (exit codes alone can't distinguish
+        # "killed for staleness" from "died of SIGTERM").
+        self.aborted = False
+
+    def running(self) -> list[subprocess.Popen]:
+        return [p for p in self.procs if p.poll() is None]
+
+    def first_failure(self) -> int | None:
+        """First nonzero exit code observed so far, None if none yet."""
+        return next(
+            (p.returncode for p in self.procs
+             if p.returncode not in (None, 0)), None
+        )
+
+    def terminate(self, term_timeout: float = 10.0) -> None:
+        """SIGTERM every survivor, escalate to SIGKILL after the timeout."""
+        running = self.running()
+        for p in running:
+            p.terminate()
+        for p in running:
+            try:
+                p.wait(timeout=term_timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def wait(self, grace_seconds: float = 30.0, abort=None) -> int:
+        """Wait for all processes with fail-stop semantics: when any exits
+        nonzero, surviving peers get ``grace_seconds`` to finish on their own
+        (they may be blocked in a collective waiting for the dead rank — the
+        MPI abort analogue, SURVEY.md §5.3) and are then terminated. Returns
+        the first nonzero exit code, 0 if all succeeded.
+
+        ``abort``: optional zero-arg callable polled while the fleet is
+        healthy; returning True terminates the whole fleet immediately and
+        sets ``self.aborted`` (the supervisor's stale-heartbeat kill)."""
+        import time
+
+        first_failure: int | None = None
+        deadline = None
+        while True:
+            running = self.running()
+            if first_failure is None:
+                failed = self.first_failure()
+                if failed is not None:
+                    first_failure = failed
+                    deadline = time.monotonic() + grace_seconds
+            if not running:
+                break
+            if (
+                first_failure is None
+                and abort is not None
+                and abort()
+            ):
+                self.aborted = True
+                self.terminate()
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                self.terminate()
+                break
+            time.sleep(0.1)
+        for t in self.pumps:
+            t.join(timeout=5)
+        if first_failure is not None:
+            return first_failure
+        return next((p.returncode for p in self.procs if p.returncode != 0), 0)
+
+
 def _wait_fail_stop(
     procs: list[subprocess.Popen], grace_seconds: float = 30.0
 ) -> int:
-    """Wait for all processes with fail-stop semantics: when any exits
-    nonzero, surviving peers get ``grace_seconds`` to finish on their own
-    (they may be blocked in a collective waiting for the dead rank — the MPI
-    abort analogue, SURVEY.md §5.3) and are then terminated. Returns the
-    first nonzero exit code, 0 if all succeeded."""
-    import time
-
-    first_failure: int | None = None
-    deadline = None
-    while True:
-        running = [p for p in procs if p.poll() is None]
-        if first_failure is None:
-            failed = next(
-                (p.returncode for p in procs
-                 if p.returncode not in (None, 0)), None
-            )
-            if failed is not None:
-                first_failure = failed
-                deadline = time.monotonic() + grace_seconds
-        if not running:
-            break
-        if deadline is not None and time.monotonic() > deadline:
-            for p in running:
-                p.terminate()
-            for p in running:
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    p.wait()
-            break
-        time.sleep(0.1)
-    if first_failure is not None:
-        return first_failure
-    return next((p.returncode for p in procs if p.returncode != 0), 0)
+    """Fail-stop wait over bare Popens (see `Fleet.wait` for the contract)."""
+    return Fleet(procs).wait(grace_seconds)
 
 
 def _stream(proc: subprocess.Popen, tag: str) -> threading.Thread:
@@ -83,20 +132,20 @@ def _stream(proc: subprocess.Popen, tag: str) -> threading.Thread:
     return t
 
 
-def run_local(
+def start_local(
     nprocs: int,
     argv: list[str],
     env: dict[str, str] | None = None,
     coordinator_port: int | None = None,
     tag_output: bool = True,
-) -> int:
-    """Run ``argv`` as ``nprocs`` coordinated processes on this host.
+) -> Fleet:
+    """Launch ``argv`` as ``nprocs`` coordinated processes on this host and
+    return the running `Fleet` (callers `wait()` it; the supervisor monitors
+    it).
 
     The reference's single-container multi-slot test mode (README.md:53-58:
     ``mpirun -np N`` inside one Docker image) without MPI: each child gets
-    the coordinator address and its process id via HVT_* env vars. Returns
-    the first nonzero exit code (0 if all succeeded) — fail-stop semantics,
-    like an MPI job aborting on any rank failure (SURVEY.md §5.3)."""
+    the coordinator address and its process id via HVT_* env vars."""
     port = coordinator_port or pick_free_port()
     base_env = dict(os.environ)
     base_env.update(env or {})
@@ -120,21 +169,35 @@ def run_local(
             )
         )
     pumps = [_stream(p, f"rank {i}") for i, p in enumerate(procs) if tag_output]
-    code = _wait_fail_stop(procs)
-    for t in pumps:
-        t.join(timeout=5)
-    return code
+    return Fleet(procs, pumps)
 
 
-def run_hosts(
+def run_local(
+    nprocs: int,
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    coordinator_port: int | None = None,
+    tag_output: bool = True,
+) -> int:
+    """`start_local` + fail-stop `Fleet.wait`: returns the first nonzero
+    exit code (0 if all succeeded) — like an MPI job aborting on any rank
+    failure (SURVEY.md §5.3)."""
+    return start_local(
+        nprocs, argv, env=env, coordinator_port=coordinator_port,
+        tag_output=tag_output,
+    ).wait()
+
+
+def start_hosts(
     hosts: list[str],
     argv: list[str],
     env: dict[str, str] | None = None,
     coordinator_port: int = 9981,
     ssh_args: tuple[str, ...] = ("-o", "StrictHostKeyChecking=no"),
     workdir: str | None = None,
-) -> int:
-    """Run ``argv`` once per host over ssh — one process per TPU host.
+) -> Fleet:
+    """Launch ``argv`` once per host over ssh — one process per TPU host —
+    and return the running `Fleet`.
 
     The multi-host path (distributed-keras-sample.yaml topology): host 0 is
     the coordinator (the 'master' whose address every worker dials, replacing
@@ -165,10 +228,22 @@ def run_hosts(
             )
         )
     pumps = [_stream(p, f"{hosts[i]}") for i, p in enumerate(procs)]
-    code = _wait_fail_stop(procs)
-    for t in pumps:
-        t.join(timeout=5)
-    return code
+    return Fleet(procs, pumps)
+
+
+def run_hosts(
+    hosts: list[str],
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    coordinator_port: int = 9981,
+    ssh_args: tuple[str, ...] = ("-o", "StrictHostKeyChecking=no"),
+    workdir: str | None = None,
+) -> int:
+    """`start_hosts` + fail-stop `Fleet.wait` (the blocking pod launch)."""
+    return start_hosts(
+        hosts, argv, env=env, coordinator_port=coordinator_port,
+        ssh_args=ssh_args, workdir=workdir,
+    ).wait()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -196,12 +271,34 @@ def main(argv: list[str] | None = None) -> int:
     p_pod.add_argument("--workdir")
     p_pod.add_argument("--env", action="append", default=[], metavar="K=V")
 
+    for p in (p_run, p_pod):
+        # Supervision (launch/supervisor.py): any of these flags turns the
+        # fail-stop launch into a supervised fail-restart launch.
+        p.add_argument(
+            "--max-restarts", type=int, default=None, metavar="N",
+            help="restart the fleet on failure, up to N consecutive "
+            "no-progress restarts (progress = a new checkpoint under "
+            "PS_MODEL_PATH)")
+        p.add_argument(
+            "--backoff", type=float, default=None, metavar="SECONDS",
+            help="initial restart backoff (doubles per no-progress restart)")
+        p.add_argument(
+            "--heartbeat-timeout", type=float, default=None, metavar="SECONDS",
+            help="kill+restart the fleet when the newest rank heartbeat is "
+            "older than this (hang detection; sets HVT_HEARTBEAT_DIR for "
+            "the ranks)")
+        p.add_argument(
+            "--restart-log", default=None, metavar="PATH",
+            help="JSONL restart journal (default: "
+            "$PS_MODEL_PATH/restarts.jsonl; gateable — "
+            "`gate --metrics <log> --check restarts=0..N --aggregate count`)")
+
     p_gate = sub.add_parser("gate", help="CI metric range check")
     p_gate.add_argument("--metrics", required=True, help="metrics.jsonl path")
     p_gate.add_argument("--check", action="append", required=True,
                         metavar="NAME=LO..HI")
     p_gate.add_argument("--aggregate", default="mean",
-                        choices=["mean", "last", "min", "max"])
+                        choices=["mean", "last", "min", "max", "count"])
 
     p_job = sub.add_parser("job", help="run a YAML job spec")
     p_job.add_argument("spec")
@@ -211,8 +308,32 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"{args.cmd} needs a command after `--`")
     if args.cmd not in ("run", "pod") and command:
         parser.error(f"{args.cmd} takes no trailing command")
+    def restart_policy(a):
+        """None unless a supervision flag was given — ANY of the four
+        (--backoff or --restart-log alone supervise with default budget)."""
+        if (
+            a.max_restarts is None and a.heartbeat_timeout is None
+            and a.backoff is None and a.restart_log is None
+        ):
+            return None
+        from horovod_tpu.launch import supervisor
+
+        return supervisor.RestartPolicy.from_mapping({
+            "max_restarts": a.max_restarts,
+            "backoff": a.backoff,
+            "heartbeat_timeout": a.heartbeat_timeout,
+        })
+
     if args.cmd == "run":
         env = dict(kv.split("=", 1) for kv in args.env)
+        policy = restart_policy(args)
+        if policy is not None:
+            from horovod_tpu.launch import supervisor
+
+            return supervisor.supervise_local(
+                args.nprocs, command, env=env, policy=policy,
+                log_path=args.restart_log,
+            )
         return run_local(args.nprocs, command, env=env)
     if args.cmd == "pod":
         if args.hostfile:
@@ -226,6 +347,15 @@ def main(argv: list[str] | None = None) -> int:
         else:
             parser.error("pod needs --hostfile or --hosts")
         env = dict(kv.split("=", 1) for kv in args.env)
+        policy = restart_policy(args)
+        if policy is not None:
+            from horovod_tpu.launch import supervisor
+
+            return supervisor.supervise_hosts(
+                hosts, command, env=env, policy=policy,
+                coordinator_port=args.port, workdir=args.workdir,
+                log_path=args.restart_log,
+            )
         return run_hosts(hosts, command, env=env,
                          coordinator_port=args.port, workdir=args.workdir)
     if args.cmd == "gate":

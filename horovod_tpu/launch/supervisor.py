@@ -1,0 +1,491 @@
+"""Restart supervisor — fail-*restart* semantics around the fail-stop
+launchers (SURVEY.md §5.3, the layer the reference leaves to a human).
+
+The reference's fault model ends at fail-stop: any rank failure kills the
+MPI job (`launcher.Fleet.wait`) and recovery is *manual* — an operator
+reruns the command and `restore_latest_and_broadcast` resumes from the
+newest checkpoint (`tests/test_resume_e2e.py` proves that leg). This module
+closes the loop: `supervise()` relaunches the whole fleet automatically,
+with three properties the manual loop lacks:
+
+* **Failure classification.** Exit 143 / SIGTERM is a *preemption* (the
+  gang-scheduler reclaiming the slice — the convention
+  `PreemptionCheckpointCallback(exit_code=143)` emits); anything else
+  nonzero is a *crash*; a fleet the supervisor itself had to kill for
+  stale heartbeats is a *hang*.
+* **Progress-aware restart budget.** The budget decrements only when a
+  launch made *no progress* (the newest checkpoint under ``model_dir``
+  unchanged since the previous launch). A transient fault that keeps
+  losing different epochs restarts indefinitely; a deterministic crash
+  loop — same fault, same epoch, every launch — burns through
+  ``max_restarts`` and exits with the original exit code. Backoff is
+  exponential between no-progress restarts and resets on progress.
+* **Hang detection.** A rank wedged in a collective produces no exit code
+  at all (the classic NCCL/ICI failure mode, arXiv:1810.11112). Each rank
+  touches ``<heartbeat_dir>/rank-<i>`` from a trainer callback
+  (`callbacks.HeartbeatCallback`, auto-installed by ``fit()`` when the
+  supervisor exports ``HVT_HEARTBEAT_DIR``); when the *newest* heartbeat
+  is older than ``heartbeat_timeout`` the supervisor kills the fleet and
+  relaunches it. Size the timeout above worst-case step + compile time —
+  the first beat lands at train begin, before the first step compiles.
+  On multi-host (pod) launches hang detection needs ``heartbeat_dir`` on
+  a filesystem shared with every host, and teardown reaches only the
+  local ssh clients — see `supervise_hosts` for the orphan caveats and
+  the coordinator-port rotation that keeps relaunches viable anyway.
+
+Every restart decision is appended to a JSONL log whose records are
+metric-shaped (``{"name": "restarts", "value": <total so far>, ...}``)
+precisely so the existing CI gate reads it unchanged:
+
+    hvt-launch gate --metrics restarts.jsonl --check restarts=1..1 \
+        --aggregate count
+
+Deterministic chaos for testing lives in `horovod_tpu.testing.faults`
+(``HVT_FAULT=rank:epoch:kind``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import signal
+import tempfile
+import time
+
+from horovod_tpu.launch import launcher
+from horovod_tpu.runtime import ENV_HEARTBEAT_DIR
+
+# Any file named like a checkpoint artifact counts as progress: single-file
+# epochs (checkpoint-3.msgpack), sharded dirs (checkpoint-3.sharded/...),
+# EMA shadows. Matched against the basename, extension-agnostic like
+# checkpoint.latest_checkpoint.
+_CHECKPOINT_RE = re.compile(r"checkpoint-(\d+)")
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Knobs for `supervise` (CLI: --max-restarts/--backoff/
+    --heartbeat-timeout; YAML: the job's ``restart:`` block).
+
+    ``max_restarts`` bounds *consecutive no-progress* restarts, not total
+    restarts — see the module docstring. ``heartbeat_timeout=None``
+    disables hang detection; size it above the longest legitimate
+    beat-free span (worst-case compile + step on the streamed fit path,
+    worst-case EPOCH on the device-cached path where batch callbacks fire
+    once per epoch, plus any post-fit export/eval work).
+    ``startup_timeout`` separately bounds time-to-FIRST-beat, so a fleet
+    that wedges before training (stuck ``jax.distributed.initialize``, an
+    orphan holding the coordinator port) is also caught; default
+    ``None`` = 10 × ``heartbeat_timeout`` (imports + distributed init +
+    build trace all precede the first beat)."""
+
+    max_restarts: int = 3
+    backoff: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max: float = 60.0
+    heartbeat_timeout: float | None = None
+    startup_timeout: float | None = None
+    grace_seconds: float = 30.0
+
+    @classmethod
+    def from_mapping(cls, mapping) -> "RestartPolicy":
+        """Build a policy from a partial dict — the single constructor both
+        front-ends (CLI flags, the YAML ``restart:`` block) funnel through,
+        so a new knob can't land in one and silently no-op in the other.
+        Unknown keys are rejected loudly. ``None`` values mean 'keep the
+        default' (unset CLI flags)."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(mapping) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown restart policy keys {sorted(unknown)}; "
+                f"valid: {sorted(fields)}"
+            )
+        policy = cls()
+        for key, value in mapping.items():
+            if value is None:
+                continue
+            setattr(
+                policy, key,
+                int(value) if key == "max_restarts" else float(value),
+            )
+        return policy
+
+
+def classify(exit_code: int, hang: bool = False) -> str:
+    """Map a fleet outcome to a restart-log kind.
+
+    143 (= 128 + SIGTERM, the `PreemptionCheckpointCallback` convention) and
+    a raw SIGTERM death both read as the scheduler reclaiming the slice."""
+    if hang:
+        return "hang"
+    if exit_code in (143, -signal.SIGTERM):
+        return "preemption"
+    return "crash"
+
+
+def shell_code(exit_code: int) -> int:
+    """Popen returncodes are negative for signal deaths; shells speak
+    128+sig. Positive codes pass through untouched (the acceptance contract:
+    a deterministic ``exit 7`` loop exits the supervisor with 7)."""
+    if exit_code > 0:
+        return exit_code
+    if exit_code < 0:
+        return 128 - exit_code
+    return 0
+
+
+def newest_checkpoint_marker(model_dir: str | None):
+    """Identity of the newest checkpoint-like file under ``model_dir``
+    (recursive — single-file checkpoints and sharded-dir shard files alike),
+    as a comparable ``(path, mtime_ns, size)`` tuple; None when there are
+    none. Two calls comparing unequal == progress was made in between."""
+    if not model_dir or not os.path.isdir(model_dir):
+        return None
+    newest = None
+    for root, _, files in os.walk(model_dir):
+        for name in files:
+            if not _CHECKPOINT_RE.search(name) and not _CHECKPOINT_RE.search(
+                os.path.basename(root)
+            ):
+                continue
+            full = os.path.join(root, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue  # racing a writer's atomic rename
+            key = (st.st_mtime_ns, full)
+            if newest is None or key > newest[0]:
+                newest = (key, (full, st.st_mtime_ns, st.st_size))
+    return newest[1] if newest else None
+
+
+def _reset_heartbeats(heartbeat_dir: str) -> None:
+    """Clear stale beats before a (re)launch — a leftover rank file from the
+    previous attempt would read as instantly-stale and kill the new fleet
+    before it trains a step."""
+    os.makedirs(heartbeat_dir, exist_ok=True)
+    for name in os.listdir(heartbeat_dir):
+        if name.startswith("rank-"):
+            try:
+                os.remove(os.path.join(heartbeat_dir, name))
+            except OSError:
+                pass
+
+
+def newest_beat(heartbeat_dir: str) -> float | None:
+    """Wall-clock mtime of the freshest ``rank-*`` beat, None if none."""
+    try:
+        names = os.listdir(heartbeat_dir)
+    except OSError:
+        return None
+    newest = None
+    for name in names:
+        if not name.startswith("rank-"):
+            continue
+        try:
+            mt = os.stat(os.path.join(heartbeat_dir, name)).st_mtime
+        except OSError:
+            continue
+        newest = mt if newest is None else max(newest, mt)
+    return newest
+
+
+def heartbeats_stale(heartbeat_dir: str, timeout: float,
+                     now=None) -> bool:
+    """True when heartbeats exist but the newest is older than ``timeout``
+    of wall-clock ``now``. Same-clock convenience check (single-host
+    tooling, tests); the supervisor's own abort hook uses skew-immune
+    change-detection instead (`_throttled_staleness_check`). No files yet
+    = not stale here — time-to-FIRST-beat is bounded separately by the
+    abort hook's startup timeout."""
+    newest = newest_beat(heartbeat_dir)
+    if newest is None:
+        return False
+    return (now if now is not None else time.time()) - newest > timeout
+
+
+def _throttled_staleness_check(heartbeat_dir: str, timeout: float,
+                               startup_timeout: float):
+    """An abort hook for `Fleet.wait` that stats the heartbeat dir at a
+    cadence proportional to the timeout (bounded to [0.5s, 5s]) rather than
+    at the fleet's 10 Hz process-poll rate — a question with timeout-scale
+    resolution must not generate constant metadata traffic on the
+    NFS/GCS-fuse mounts multi-host hang detection runs over.
+
+    Two hang shapes are bounded: beats that STOPPED and beats that never
+    STARTED (no rank file within ``startup_timeout`` of the launch — a
+    fleet wedged in distributed init produces no exit code and no beats,
+    and would otherwise be supervised forever).
+
+    Staleness is judged by whether the newest beat's mtime has CHANGED
+    within ``timeout`` of the supervisor's own monotonic clock — never by
+    comparing rank-written mtimes against the supervisor's wall clock.
+    On multi-host (NFS/GCS-fuse) deployments the rank hosts' clocks can
+    skew past the timeout in either direction; wall-clock comparison
+    would then kill healthy fleets (or mask real hangs), while
+    change-detection only requires the mtimes to be *distinct* across
+    beats."""
+    interval = max(0.5, min(5.0, timeout / 10.0))
+    t0 = time.monotonic()
+    state = {"next": 0.0, "stale": False, "beat": None, "changed_at": t0}
+
+    def abort() -> bool:
+        now = time.monotonic()
+        if now >= state["next"]:
+            state["next"] = now + interval
+            beat = newest_beat(heartbeat_dir)
+            if beat is None:
+                state["stale"] = now - t0 > startup_timeout
+            else:
+                if beat != state["beat"]:
+                    state["beat"] = beat
+                    state["changed_at"] = now
+                state["stale"] = now - state["changed_at"] > timeout
+        return state["stale"]
+
+    return abort
+
+
+class RestartLog:
+    """Append-only JSONL restart journal. Records double as CI-gate metrics:
+    each carries ``name``/``value`` (value = total restarts so far), so
+    ``ci_gate.check_metrics(log, 'restarts', (1, 1), how='count')`` asserts
+    restart counts with no new machinery."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+
+    def touch(self) -> None:
+        """Ensure the journal exists even for a zero-restart run: the CI
+        gate fails on a MISSING file for every aggregate, so 'ran
+        supervised, zero restarts' (`restarts=0..0 --aggregate count`)
+        must be distinguishable from 'never ran'."""
+        if not self.path:
+            return
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.path, "a"):
+            pass
+
+    def write(self, name: str, value: float, **fields) -> None:
+        if not self.path:
+            return
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        record = {"name": name, "value": value, "wall_time": time.time(),
+                  **fields}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+
+
+def supervise(
+    start,
+    policy: RestartPolicy | None = None,
+    *,
+    model_dir: str | None = None,
+    heartbeat_dir: str | None = None,
+    log_path: str | None = None,
+    sleep=time.sleep,
+    verbose: bool = True,
+) -> int:
+    """Launch-monitor-relaunch loop. ``start`` is a zero-arg callable
+    returning a running `launcher.Fleet` (close over `start_local` /
+    `start_hosts` with the env already carrying ``HVT_HEARTBEAT_DIR`` —
+    `supervise_local` does this wiring). Returns 0 on fleet success, else
+    the final failure's shell exit code once the no-progress budget is
+    exhausted."""
+    policy = policy or RestartPolicy()
+    log = RestartLog(log_path)
+    log.touch()
+    marker = newest_checkpoint_marker(model_dir)
+    restarts_used = 0   # consecutive no-progress restarts — the budget
+    total_restarts = 0  # lifetime count — what the log/gate report
+    backoff = policy.backoff
+    attempt = 0
+
+    while True:
+        attempt += 1
+        abort = None
+        if heartbeat_dir and policy.heartbeat_timeout is not None:
+            _reset_heartbeats(heartbeat_dir)
+            abort = _throttled_staleness_check(
+                heartbeat_dir, policy.heartbeat_timeout,
+                policy.startup_timeout
+                if policy.startup_timeout is not None
+                else 10.0 * policy.heartbeat_timeout,
+            )
+        fleet = start()
+        code = fleet.wait(policy.grace_seconds, abort=abort)
+        if code == 0 and not fleet.aborted:
+            if verbose and total_restarts:
+                print(f"supervisor: fleet succeeded after "
+                      f"{total_restarts} restart(s)")
+            return 0
+
+        kind = classify(code, hang=fleet.aborted)
+        new_marker = newest_checkpoint_marker(model_dir)
+        progressed = model_dir is not None and new_marker != marker
+        marker = new_marker
+        if progressed:
+            # Fresh checkpoint since launch: the fault is not a
+            # deterministic loop — full budget and backoff again.
+            restarts_used = 0
+            backoff = policy.backoff
+        if restarts_used >= policy.max_restarts:
+            log.write(
+                "supervisor_gave_up", 1.0, attempt=attempt, kind=kind,
+                exit_code=code, restarts=total_restarts,
+            )
+            if verbose:
+                print(
+                    f"supervisor: giving up after {total_restarts} "
+                    f"restart(s) — attempt {attempt} {kind} "
+                    f"(exit {code}), no progress in the last "
+                    f"{restarts_used} restart(s)"
+                )
+            # `or 1`: a hang-killed rank that trapped SIGTERM and exited 0
+            # must still surface as failure.
+            return shell_code(code) or 1
+        restarts_used += 1
+        total_restarts += 1
+        log.write(
+            "restarts", float(total_restarts), attempt=attempt, kind=kind,
+            exit_code=code, progressed=progressed, backoff_s=backoff,
+        )
+        if verbose:
+            print(
+                f"supervisor: attempt {attempt} {kind} (exit {code}, "
+                f"{'progress' if progressed else 'no progress'}) — "
+                f"restart {total_restarts} in {backoff:.1f}s"
+            )
+        sleep(backoff)
+        backoff = min(backoff * policy.backoff_factor, policy.backoff_max)
+
+
+def default_heartbeat_dir(model_dir: str | None) -> str:
+    """``<model_dir>/hb`` when the job has a model dir (shared-filesystem
+    deployments get multi-host hang detection for free), else a tmpdir."""
+    if model_dir:
+        return os.path.join(model_dir, "hb")
+    return tempfile.mkdtemp(prefix="hvt-hb-")
+
+
+def default_model_dir(env) -> str | None:
+    """The progress-detection root: job env's PS_MODEL_PATH, falling back
+    to the launcher's own environment."""
+    return (env or {}).get("PS_MODEL_PATH") or os.environ.get("PS_MODEL_PATH")
+
+
+def default_log_path(env) -> str | None:
+    """Where the restart journal lands by default: beside the checkpoints.
+    The SINGLE resolver — `run_job`'s stale-journal reset and the
+    supervisor's writer must agree on the path or the reset silently
+    guards the wrong file."""
+    model_dir = default_model_dir(env)
+    return os.path.join(model_dir, "restarts.jsonl") if model_dir else None
+
+
+def _resolve_dirs(env, model_dir, heartbeat_dir, log_path, policy):
+    """Shared CLI/YAML wiring: model dir from PS_MODEL_PATH, heartbeat dir
+    exported to children, restart log defaulted beside the checkpoints."""
+    env = dict(env or {})
+    model_dir = model_dir or default_model_dir(env)
+    if policy.heartbeat_timeout is not None:
+        heartbeat_dir = heartbeat_dir or default_heartbeat_dir(model_dir)
+        env[ENV_HEARTBEAT_DIR] = heartbeat_dir
+    else:
+        heartbeat_dir = None
+    if log_path is None:
+        log_path = default_log_path(env)
+    return env, model_dir, heartbeat_dir, log_path
+
+
+def supervise_local(
+    nprocs: int,
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    policy: RestartPolicy | None = None,
+    *,
+    model_dir: str | None = None,
+    heartbeat_dir: str | None = None,
+    log_path: str | None = None,
+    tag_output: bool = True,
+    sleep=time.sleep,
+) -> int:
+    """`launcher.start_local` under supervision (the ``hvt-launch run
+    --max-restarts`` path)."""
+    policy = policy or RestartPolicy()
+    env, model_dir, heartbeat_dir, log_path = _resolve_dirs(
+        env, model_dir, heartbeat_dir, log_path, policy
+    )
+    return supervise(
+        lambda: launcher.start_local(
+            nprocs, argv, env=env, tag_output=tag_output
+        ),
+        policy,
+        model_dir=model_dir,
+        heartbeat_dir=heartbeat_dir,
+        log_path=log_path,
+        sleep=sleep,
+    )
+
+
+def supervise_hosts(
+    hosts: list[str],
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    policy: RestartPolicy | None = None,
+    *,
+    coordinator_port: int = 9981,
+    workdir: str | None = None,
+    model_dir: str | None = None,
+    heartbeat_dir: str | None = None,
+    log_path: str | None = None,
+    sleep=time.sleep,
+) -> int:
+    """`launcher.start_hosts` under supervision (the ``hvt-launch pod
+    --max-restarts`` path).
+
+    Multi-host caveats (all three want a shared filesystem — NFS/GCS-fuse —
+    mounted at the same paths on the launcher and every host):
+
+    * **Hang detection** reads ``heartbeat_dir`` on the LAUNCHER's
+      filesystem; without a shared mount, set ``heartbeat_timeout=None``
+      and supervision still covers crash/preemption restarts.
+    * **Progress detection** likewise walks ``model_dir`` locally; without
+      a shared mount every restart reads as no-progress, so
+      ``max_restarts`` bounds TOTAL restarts, not consecutive stuck ones.
+    * **Hang teardown** terminates the local ssh clients; a wedged remote
+      rank that writes no output may survive as an orphan on its host
+      (ssh without a pty cannot signal it). Each relaunch therefore dials
+      a ROTATED coordinator port (base + attempt) so an orphan holding the
+      old port cannot wedge every subsequent attempt; pair with a host
+      provisioner that sweeps orphans (ROADMAP follow-up: coordinator-side
+      TCP heartbeats + remote kill)."""
+    policy = policy or RestartPolicy()
+    env, model_dir, heartbeat_dir, log_path = _resolve_dirs(
+        env, model_dir, heartbeat_dir, log_path, policy
+    )
+    launches = {"n": 0}
+
+    def start():
+        port = coordinator_port + launches["n"]
+        launches["n"] += 1
+        return launcher.start_hosts(
+            hosts, argv, env=env, coordinator_port=port, workdir=workdir,
+        )
+
+    return supervise(
+        start,
+        policy,
+        model_dir=model_dir,
+        heartbeat_dir=heartbeat_dir,
+        log_path=log_path,
+        sleep=sleep,
+    )

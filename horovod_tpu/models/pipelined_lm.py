@@ -41,6 +41,8 @@ from __future__ import annotations
 
 import flax.linen as nn
 import jax
+
+from horovod_tpu import compat
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -354,7 +356,7 @@ class PipelinedLM(nn.Module):
             if extras is not None:
                 args += (extras,)
                 in_specs += ((extra_spec, extra_spec),)
-            out = jax.shard_map(
+            out = compat.shard_map(
                 run,
                 mesh=self.mesh,
                 in_specs=in_specs,

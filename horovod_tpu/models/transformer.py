@@ -31,6 +31,8 @@ import functools
 
 import flax.linen as nn
 import jax
+
+from horovod_tpu import compat
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -288,7 +290,7 @@ class Block(nn.Module):
                 fn = lambda q, k, v, ids: impl(q, k, v, segment_ids=ids)  # noqa: E731
                 args = (q, k, v, segment_ids)
                 in_specs = (spec, spec, spec, P(BATCH_AXES, SEQ_AXIS))
-            out = jax.shard_map(
+            out = compat.shard_map(
                 fn, mesh=cfg.mesh, in_specs=in_specs, out_specs=spec,
                 check_vma=False,
             )(*args)
@@ -323,7 +325,7 @@ class Block(nn.Module):
                 in_specs = (spec, spec, spec)
                 if segment_ids is not None:
                     in_specs += (P(BATCH_AXES, None),)
-                local = jax.shard_map(
+                local = compat.shard_map(
                     local, mesh=cfg.mesh, in_specs=in_specs, out_specs=spec,
                     check_vma=False,
                 )
@@ -579,7 +581,7 @@ class Block(nn.Module):
             )
             if cfg.mesh is not None and cfg.mesh.size > 1:
                 spec = P(BATCH_AXES, None, MODEL_AXIS, None)
-                local = jax.shard_map(
+                local = compat.shard_map(
                     local, mesh=cfg.mesh, in_specs=(spec, spec, spec),
                     out_specs=spec, check_vma=False,
                 )

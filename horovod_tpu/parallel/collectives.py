@@ -29,6 +29,8 @@ from __future__ import annotations
 from typing import Any, Sequence
 
 import jax
+
+from horovod_tpu import compat
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import multihost_utils
@@ -81,7 +83,7 @@ def broadcast(x, root: int = 0, axis_name=None):
         names = _axis_names(axis_name)
         idx = lax.axis_index(names[0])
         for name in names[1:]:
-            idx = idx * lax.axis_size(name) + lax.axis_index(name)
+            idx = idx * compat.axis_size(name) + lax.axis_index(name)
         mask = (idx == root).astype(x.dtype)
         return lax.psum(x * mask, axis_name)
     if jax.process_count() == 1:

@@ -56,6 +56,12 @@ ENV_LOCAL_RANK = "HVT_LOCAL_RANK"
 # jax.config directly, which must happen before any backend use.
 ENV_PLATFORM = "HVT_PLATFORM"
 ENV_NUM_CPU_DEVICES = "HVT_NUM_CPU_DEVICES"
+# Liveness contract with the restart supervisor (launch/supervisor.py):
+# when set, fit() auto-installs callbacks.HeartbeatCallback, which touches
+# $HVT_HEARTBEAT_DIR/rank-<process rank> through training; the supervisor
+# kills and relaunches a fleet whose newest beat goes stale. Examples need
+# no changes — the supervisor exports the variable, fit() reacts.
+ENV_HEARTBEAT_DIR = "HVT_HEARTBEAT_DIR"
 
 _initialized = False
 
@@ -108,7 +114,29 @@ def init(
     if os.environ.get(ENV_PLATFORM):
         jax.config.update("jax_platforms", os.environ[ENV_PLATFORM])
     if os.environ.get(ENV_NUM_CPU_DEVICES):
-        jax.config.update("jax_num_cpu_devices", int(os.environ[ENV_NUM_CPU_DEVICES]))
+        n_cpu = int(os.environ[ENV_NUM_CPU_DEVICES])
+        try:
+            jax.config.update("jax_num_cpu_devices", n_cpu)
+        except AttributeError:
+            # Older jax: the config option doesn't exist. XLA_FLAGS works as
+            # long as the backend hasn't initialized yet — true here for the
+            # launched-child path (init() runs before any device use).
+            # HVT_NUM_CPU_DEVICES is authoritative (the config-option
+            # semantics), so an inherited device-count flag — e.g. the test
+            # harness's 8-device XLA_FLAGS leaking into launched children —
+            # is REPLACED, not kept: a 2-process fleet accidentally running
+            # 8 virtual devices per process wedges its cross-process
+            # collectives.
+            import re as _re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags
+            )
+            os.environ["XLA_FLAGS"] = (
+                flags.strip()
+                + f" --xla_force_host_platform_device_count={n_cpu}"
+            ).strip()
     if env_flag("HVT_FAST_RNG"):
         # TPU hardware RNG for dropout/init keys: threefry (the reproducible
         # default) costs real step time when dropout is on (~12% on the LM
@@ -123,6 +151,21 @@ def init(
         process_id = int(os.environ[ENV_PROCESS_ID])
 
     if coordinator_address is not None:
+        # Multi-process on the CPU *platform* (the launched test mode,
+        # README.md:53-58): cross-process collectives need the gloo CPU
+        # backend on jax versions where it isn't the default. Must land
+        # before backend init — true here, init() precedes any device use.
+        platform_hint = (
+            os.environ.get(ENV_PLATFORM)
+            or os.environ.get("JAX_PLATFORMS", "")
+        )
+        if "cpu" in platform_hint:
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except (AttributeError, ValueError):
+                pass  # option absent (newer jax handles this itself)
         # Multi-host control plane over DCN: replaces MPI_Init + the Horovod
         # background coordinator thread (SURVEY.md §2.3 row 1) — after this,
         # collective order is compiled statically, no runtime negotiation.

@@ -1,0 +1,2 @@
+"""Deterministic test/chaos utilities (no production code imports these
+by default — `faults` activates only through the HVT_FAULT env contract)."""

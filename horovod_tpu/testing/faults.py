@@ -1,0 +1,141 @@
+"""Deterministic fault injection — the reproducible chaos knob the
+reference stack lacks entirely (SURVEY.md §5.3: "No fault injection
+anywhere").
+
+Contract: ``HVT_FAULT=rank:epoch:kind`` makes exactly one rank misbehave at
+a chosen point in training, via a callback `fit()` auto-installs (so any
+example/entry script is injectable unmodified). Kinds:
+
+* ``kill``  — SIGKILL self: the hard crash / OOM-killer / node-loss shape.
+  Peers block in the next collective; the launcher's fail-stop grace window
+  then reaps them (`launcher.Fleet.wait`).
+* ``exitN`` — ``os._exit(N)`` (e.g. ``exit1``, ``exit143``): a crash with a
+  chosen exit code, bypassing teardown the way a real abort does. ``exit143``
+  exercises the supervisor's preemption classification.
+* ``hang``  — stop making progress while staying alive: the wedged-collective
+  failure mode (arXiv:1810.11112) that produces no exit code and is only
+  detectable via stale heartbeats.
+
+The fault fires at the first ``on_batch_end`` of the target epoch — mid-epoch
+by construction (after the epoch's checkpoint boundary, before the next), so
+kill-and-resume tests lose partial-epoch work exactly like a real fault.
+
+One-shot faults: set ``HVT_FAULT_STAMP=<path>`` and the callback touches the
+stamp file just before firing and never fires while it exists — across
+process *relaunches*, which is what makes "inject once, assert exactly one
+supervised restart" deterministic. Without a stamp the fault fires every
+launch: the deterministic crash loop that must exhaust the supervisor's
+no-progress budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+
+from horovod_tpu import runtime
+from horovod_tpu.training.callbacks import Callback
+
+ENV_FAULT = "HVT_FAULT"
+ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
+
+KINDS = ("kill", "hang")  # plus exitN, validated in parse_plan
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One planned fault: ``rank`` fires ``kind`` mid-epoch ``epoch``."""
+
+    rank: int
+    epoch: int
+    kind: str
+
+    @property
+    def exit_code(self) -> int | None:
+        if self.kind.startswith("exit"):
+            return int(self.kind[4:])
+        return None
+
+
+def parse_plan(spec: str) -> FaultPlan:
+    """Parse ``rank:epoch:kind`` (kind: ``kill`` | ``hang`` | ``exitN``)."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(
+            f"HVT_FAULT must be rank:epoch:kind, got {spec!r}"
+        )
+    rank_s, epoch_s, kind = parts
+    try:
+        rank, epoch = int(rank_s), int(epoch_s)
+    except ValueError:
+        raise ValueError(
+            f"HVT_FAULT rank/epoch must be integers, got {spec!r}"
+        ) from None
+    if kind not in KINDS:
+        if kind.startswith("exit"):
+            try:
+                int(kind[4:])
+            except ValueError:
+                raise ValueError(
+                    f"HVT_FAULT exit kind needs an integer code "
+                    f"(exit1, exit143, ...), got {kind!r}"
+                ) from None
+        else:
+            raise ValueError(
+                f"HVT_FAULT kind must be kill, hang or exitN, got {kind!r}"
+            )
+    return FaultPlan(rank=rank, epoch=epoch, kind=kind)
+
+
+class FaultInjectionCallback(Callback):
+    """Fires the planned fault at the first batch end of the target epoch on
+    the target rank. Installed automatically by ``fit()`` when ``HVT_FAULT``
+    is set (`callbacks.env_callbacks`); constructible directly for in-process
+    tests."""
+
+    def __init__(self, plan: FaultPlan, stamp: str | None = None):
+        self.plan = plan
+        self.stamp = stamp
+        self._epoch: int | None = None
+
+    @classmethod
+    def from_env(cls) -> "FaultInjectionCallback":
+        return cls(
+            parse_plan(os.environ[ENV_FAULT]),
+            stamp=os.environ.get(ENV_FAULT_STAMP) or None,
+        )
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        self._epoch = epoch
+
+    def on_batch_end(self, batch: int, logs=None):
+        if self._epoch != self.plan.epoch:
+            return
+        if runtime.rank() != self.plan.rank:
+            return
+        if self.stamp and os.path.exists(self.stamp):
+            return  # already fired in a previous launch — one-shot spent
+        if self.stamp:
+            d = os.path.dirname(self.stamp)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            open(self.stamp, "w").close()
+        self._fire()
+
+    def _fire(self):  # pragma: no cover — ends or wedges the process
+        print(
+            f"FaultInjection: rank {self.plan.rank} firing "
+            f"{self.plan.kind!r} at epoch {self.plan.epoch}",
+            flush=True,
+        )
+        if self.plan.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif self.plan.kind == "hang":
+            # Stay alive, make no progress, touch no heartbeat — only a
+            # stale-heartbeat supervisor can reap this.
+            while True:
+                time.sleep(3600)
+        else:
+            os._exit(self.plan.exit_code)

@@ -455,6 +455,82 @@ class ScalarLogger(Callback):
             self._tb_writer = None
 
 
+class HeartbeatCallback(Callback):
+    """Touch a per-rank liveness file so the restart supervisor
+    (`launch/supervisor.py`) can tell a *hung* fleet from a slow one — a
+    rank wedged in a collective produces no exit code at all (SURVEY.md
+    §5.3's undetectable failure mode; arXiv:1810.11112).
+
+    The supervisor exports ``HVT_HEARTBEAT_DIR`` to every rank; ``fit()``
+    auto-installs this callback when the variable is set
+    (`env_callbacks`), so entry scripts need no changes. Beats land at
+    train/epoch boundaries unconditionally and at batch ends throttled to
+    ``interval`` seconds (a per-batch utime would be noise; a heartbeat
+    only needs to be fresher than the supervisor's timeout). The file is
+    ``rank-<process rank>`` — per-rank so a shared dir works multi-host
+    and staleness is judged on the NEWEST beat (one live writer proves
+    the host loop is advancing).
+
+    Beating is deliberately synchronous with the training loop — no
+    background timer thread, which would keep a wedged main thread
+    looking alive. Consequence for timeout sizing: the beat-free span is
+    a full EPOCH on the device-cached fit path (its batch callbacks fire
+    once per epoch), and post-fit work (export, final eval) does not
+    beat at all — the supervisor's ``heartbeat_timeout`` must exceed
+    both."""
+
+    def __init__(self, directory: str, interval: float = 1.0):
+        self.directory = directory
+        self.interval = interval
+        self._last = 0.0
+
+    def _beat(self, force: bool = False):
+        now = time.time()
+        if not force and now - self._last < self.interval:
+            return
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            path = os.path.join(self.directory, f"rank-{runtime.rank()}")
+            with open(path, "a"):
+                os.utime(path, None)
+        except OSError:
+            # A torn-down heartbeat dir must never kill training itself.
+            return
+        self._last = now
+
+    def on_train_begin(self, logs=None):
+        self._beat(force=True)
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        self._beat(force=True)
+
+    def on_batch_end(self, batch: int, logs=None):
+        self._beat()
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        self._beat(force=True)
+
+
+def env_callbacks() -> list:
+    """Callbacks the environment asks for — appended by ``fit()`` to the
+    user's list on every path, so launcher-level machinery reaches into
+    training without entry-script changes:
+
+    * ``HVT_HEARTBEAT_DIR`` (set by the supervisor) → `HeartbeatCallback`
+    * ``HVT_FAULT`` (the deterministic chaos knob) →
+      `testing.faults.FaultInjectionCallback`
+    """
+    out: list = []
+    hb_dir = os.environ.get(runtime.ENV_HEARTBEAT_DIR)
+    if hb_dir:
+        out.append(HeartbeatCallback(hb_dir))
+    if os.environ.get("HVT_FAULT"):
+        from horovod_tpu.testing.faults import FaultInjectionCallback
+
+        out.append(FaultInjectionCallback.from_env())
+    return out
+
+
 class MetricsPushCallback(Callback):
     """Push epoch-end logs to the platform metrics sink (§5.5 channel 1).
 

@@ -27,6 +27,16 @@ from horovod_tpu.training.train_state import (
 )
 
 
+def _with_env_callbacks(callbacks):
+    """User callbacks + env-requested ones (heartbeat / fault injection —
+    `callbacks.env_callbacks`). Appended last so liveness/chaos hooks see
+    the epoch state the user's callbacks produced; applied on every fit
+    path so supervised launches need no entry-script changes."""
+    from horovod_tpu.training import callbacks as callbacks_lib
+
+    return list(callbacks) + callbacks_lib.env_callbacks()
+
+
 def shard_batch(trainer, batch):
     if trainer.batch_specs is not None:
         specs = tuple(trainer.batch_specs)
@@ -272,6 +282,7 @@ def run_fit(trainer,
     first = next(it)
     trainer.build(first[0], first[1])
 
+    callbacks = _with_env_callbacks(callbacks)
     for cb in callbacks:
         cb.set_trainer(trainer)
     try:
@@ -392,6 +403,7 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
         np.asarray(x[: trainer.dp_size]), np.asarray(y[: trainer.dp_size])
     )
 
+    callbacks = _with_env_callbacks(callbacks)
     for cb in callbacks:
         cb.set_trainer(trainer)
     try:

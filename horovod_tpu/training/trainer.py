@@ -21,6 +21,8 @@ from typing import Any, Callable, Sequence
 
 import flax.struct
 import jax
+
+from horovod_tpu import compat
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -235,7 +237,7 @@ class Trainer:
                 return loss, acc, new_ms, sm, grads
 
             P = jax.sharding.PartitionSpec
-            return jax.shard_map(
+            return compat.shard_map(
                 local,
                 mesh=self.mesh,
                 in_specs=(P(), P(), P(data_axes), P(data_axes)),

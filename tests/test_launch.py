@@ -5,6 +5,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -172,6 +173,99 @@ class TestJob:
         assert run_job(str(spec)) == 1
         assert (tmp_path / "job.yaml").exists()  # nothing was wiped
 
+    def test_job_restart_block_supervises_and_logs(self, tmp_path):
+        """The YAML `restart:` block routes through the supervisor: a
+        one-shot failure is restarted (journaled), the rerun passes the
+        gate, and a stale restart journal is reset first."""
+        model_dir = tmp_path / "models"
+        model_dir.mkdir()
+        log = model_dir / "restarts.jsonl"
+        log.write_text('{"name": "restarts", "value": 9}\n')  # stale
+        metrics = tmp_path / "metrics.jsonl"
+        stamp = tmp_path / "fired"
+        body = (
+            "import json, os, sys;"
+            f"s = {str(stamp)!r};"
+            "fired = os.path.exists(s);"
+            "open(s, 'w').close();"
+            "(sys.exit(3) if not fired else None);"
+            f"open({str(metrics)!r}, 'w').write("
+            "json.dumps({'name': 'loss', 'value': 0.1}) + '\\n')"
+        )
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: supervised-job
+            job:
+              command: ["{sys.executable}", "-c", {json.dumps(body)}]
+              nprocs: 1
+              restart:
+                max_restarts: 2
+                backoff: 0.0
+              env:
+                PS_MODEL_PATH: {model_dir}
+            metrics: {metrics}
+            checks:
+              loss:
+                target: "0.0..0.3"
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 0
+        records = [json.loads(line)
+                   for line in log.read_text().splitlines()]
+        assert len(records) == 1  # stale journal was reset
+        assert records[0]["name"] == "restarts"
+        assert records[0]["exit_code"] == 3
+
+    def test_job_empty_restart_block_supervises_with_defaults(self, tmp_path):
+        """`restart:` with every knob commented out (YAML None) still opts
+        in — matching the CLI where any supervision flag supervises."""
+        model_dir = tmp_path / "models"
+        model_dir.mkdir()
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: default-supervised
+            job:
+              command: ["{sys.executable}", "-c", "pass"]
+              nprocs: 1
+              restart:
+              env:
+                PS_MODEL_PATH: {model_dir}
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 0
+        # Supervision ran: the journal was touched at the default location.
+        assert (model_dir / "restarts.jsonl").exists()
+
+    def test_job_non_mapping_restart_rejected(self, tmp_path):
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: bad-restart
+            job:
+              command: ["{sys.executable}", "-c", "pass"]
+              nprocs: 1
+              restart: true
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 1
+
+    def test_job_restart_block_exhausts_budget(self, tmp_path):
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: doomed-job
+            job:
+              command: ["{sys.executable}", "-c", "raise SystemExit(9)"]
+              nprocs: 1
+              restart:
+                max_restarts: 1
+                backoff: 0.0
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 9
+
     def test_job_resets_stale_metrics(self, tmp_path):
         """A previous run's appended metrics must not feed this run's gate."""
         metrics = tmp_path / "metrics.jsonl"
@@ -191,6 +285,87 @@ class TestJob:
         from horovod_tpu.launch.job import run_job
 
         assert run_job(str(spec)) == 1
+
+
+class TestWaitFailStop:
+    """Grace-window edge cases of the fail-stop wait (SURVEY.md §5.3):
+    survivors of a rank failure get grace_seconds to finish on their own
+    before termination, and the FIRST failure's code is the job's code."""
+
+    def _proc(self, code, delay=0.0):
+        return subprocess.Popen(
+            [sys.executable, "-c",
+             f"import time, sys; time.sleep({delay}); sys.exit({code})"]
+        )
+
+    def test_survivor_finishing_within_grace_is_untouched(self):
+        failed = self._proc(3)
+        survivor = self._proc(0, delay=0.7)
+        code = launcher._wait_fail_stop([failed, survivor], grace_seconds=30.0)
+        assert code == 3
+        # The survivor completed on its own terms — not terminated.
+        assert survivor.returncode == 0
+
+    def test_survivor_terminated_after_grace(self):
+        failed = self._proc(2)
+        survivor = self._proc(0, delay=60)
+        t0 = time.monotonic()
+        code = launcher._wait_fail_stop([failed, survivor], grace_seconds=0.4)
+        assert code == 2
+        assert time.monotonic() - t0 < 30
+        # Terminated by the launcher, not a clean exit: signal death.
+        assert survivor.returncode is not None and survivor.returncode < 0
+
+    def test_first_failure_code_wins_over_later_ones(self):
+        first = self._proc(5)
+        second = self._proc(9, delay=0.7)
+        code = launcher._wait_fail_stop([first, second], grace_seconds=30.0)
+        assert code == 5  # not 9: the initial fault is the job's verdict
+        assert second.returncode == 9  # it did exit on its own within grace
+
+    def test_all_zero_is_zero(self):
+        code = launcher._wait_fail_stop(
+            [self._proc(0), self._proc(0, delay=0.2)], grace_seconds=5.0)
+        assert code == 0
+
+
+class TestSupervisedCLI:
+    def test_run_with_max_restarts_supervises(self, tmp_path):
+        """`hvt-launch run --max-restarts` routes through the supervisor:
+        a deterministic crash loop exits with the original code after the
+        budget, and the restart journal lands where --restart-log says."""
+        log = tmp_path / "restarts.jsonl"
+        code = launcher.main([
+            "run", "--nprocs", "1", "--max-restarts", "1", "--backoff", "0",
+            "--restart-log", str(log),
+            "--", sys.executable, "-c", "raise SystemExit(5)",
+        ])
+        assert code == 5
+        records = [json.loads(line) for line in log.read_text().splitlines()]
+        assert [r["name"] for r in records] == [
+            "restarts", "supervisor_gave_up"]
+
+    def test_restart_log_alone_enables_supervision(self, tmp_path):
+        """Any supervision flag supervises: --restart-log by itself must
+        journal (a silently-unsupervised run would fail its count gate)."""
+        log = tmp_path / "restarts.jsonl"
+        code = launcher.main([
+            "run", "--nprocs", "1", "--restart-log", str(log),
+            "--", sys.executable, "-c", "pass",
+        ])
+        assert code == 0
+        assert log.exists()  # journal touched even with zero restarts
+
+    def test_gate_count_aggregate_cli(self, tmp_path):
+        """The restart journal is gateable with the count aggregate."""
+        log = tmp_path / "restarts.jsonl"
+        _write_metrics(log, [1.0], name="restarts")
+        assert launcher.main(["gate", "--metrics", str(log),
+                              "--check", "restarts=1..1",
+                              "--aggregate", "count"]) == 0
+        assert launcher.main(["gate", "--metrics", str(log),
+                              "--check", "restarts=0..0",
+                              "--aggregate", "count"]) == 1
 
 
 @pytest.fixture

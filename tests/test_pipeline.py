@@ -7,6 +7,8 @@ stack computes — the schedule is an execution detail, not a model change.
 """
 
 import jax
+
+from horovod_tpu import compat
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -48,7 +50,7 @@ class TestSchedule:
 
             return spmd_pipeline(stage, xm)
 
-        out = jax.shard_map(
+        out = compat.shard_map(
             run,
             mesh=mesh,
             in_specs=(P("pipe", None), P("pipe", None), P(None, None, None)),

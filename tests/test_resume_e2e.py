@@ -27,6 +27,11 @@ def _env(tmp_path):
         "PS_MODEL_PATH": str(tmp_path),
         "DRIVE_STEPS": str(STEPS),
         "DRIVE_EPOCHS": str(EPOCHS),
+        # This test SIGKILLs the child mid-run: it must not share the
+        # suite's persistent XLA cache (a torn write poisons later runs —
+        # see the conftest cache caveat).
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
     }
 
 

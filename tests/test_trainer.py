@@ -206,3 +206,48 @@ class TestShardUpdate:
                 loss="sparse_categorical_crossentropy",
                 shard_update=True,
             )
+
+
+class TestModuleLossBuildHint:
+    """Regression for the ADVICE build() fallback: with loss='module' and no
+    sample_y, labels are synthesized as zeros_like(sample_x) (the LM-family
+    contract); a module whose labels differ in dtype/shape fails deep inside
+    init — the re-raise must name the fix (pass sample_y)."""
+
+    def _module(self):
+        import flax.linen as nn
+        import jax
+
+        class IntLabelLoss(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False, labels=None):
+                logits = nn.Dense(4)(x)
+                ll = jax.nn.log_softmax(logits)
+                # take_along_axis requires integer labels — the zeros_like
+                # float fallback must blow up here.
+                loss = -jnp.take_along_axis(ll, labels[:, None], axis=-1)[:, 0]
+                correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+                return loss, correct
+
+        return IntLabelLoss()
+
+    def test_synthesized_labels_failure_carries_hint(self):
+        trainer = hvt.Trainer(
+            self._module(),
+            hvt.DistributedOptimizer(optax.adam(1e-3)),
+            loss="module",
+        )
+        x = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        with pytest.raises(Exception, match="pass sample_y"):
+            trainer.build(x)
+
+    def test_sample_y_builds_fine(self):
+        trainer = hvt.Trainer(
+            self._module(),
+            hvt.DistributedOptimizer(optax.adam(1e-3)),
+            loss="module",
+        )
+        x = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        y = np.zeros(4, np.int64)
+        state = trainer.build(x, y)
+        assert state is trainer.state

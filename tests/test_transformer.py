@@ -616,3 +616,35 @@ class TestGlobalLocalOnMesh:
             losses.append(float(metrics["loss"]))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+
+class TestLoRASpecExemption:
+    """Regression for the ADVICE is_lora tightening: the TP/EP exemption is
+    for LoRAModel *adapter* leaves (a 'lora' subtree with 'a'/'b' leaves) —
+    a user submodule merely NAMED 'lora' must still get its kernels
+    TP-sharded, or it silently trains unsharded."""
+
+    def test_user_submodule_named_lora_still_tp_sharded(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        params = {
+            # Looks like a user's submodule that happens to be called lora:
+            # ordinary kernels under layer names the rule table knows.
+            "lora": {"mlp_up": {"kernel": np.zeros((8, 32), np.float32)}},
+            # The real LoRAModel layout: adapters keep the exemption.
+            "base": {"mlp_up": {"kernel": np.zeros((8, 32), np.float32)}},
+        }
+        specs = param_specs(params, mesh)
+        assert specs["lora"]["mlp_up"]["kernel"] == P(None, "model")
+        assert specs["base"]["mlp_up"]["kernel"] == P(None, "model")
+
+    def test_adapter_leaves_keep_exemption_under_any_wrapper(self):
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        params = {
+            "wrapper": {"lora": {"mlp_up": {
+                "a": np.zeros((8, 2), np.float32),   # rank dim: unshardable
+                "b": np.zeros((2, 32), np.float32),
+            }}},
+        }
+        specs = param_specs(params, mesh)
+        assert specs["wrapper"]["lora"]["mlp_up"]["a"] == P(None, None)
+        assert specs["wrapper"]["lora"]["mlp_up"]["b"] == P(None, None)
