@@ -1,0 +1,139 @@
+"""Elastic MNIST data-parallel training — the Horovod Elastic capability,
+TPU-native (`horovod_tpu.elastic`).
+
+Same training recipe as `tf2_style_mnist.py`, restructured into the
+elastic idiom: everything world-size-dependent (trainer, optimizer LR
+scale, dataset shard, steps-per-epoch) is built INSIDE the per-generation
+train function, committed state rides an `ElasticState`, and
+`elastic.run` re-invokes the function whenever the fleet rendezvous
+settles a new world. A member that is preempted (SIGTERM) or injected
+with the ``leave`` fault departs cleanly at the next epoch boundary —
+survivors keep training from the last commit without a process restart;
+a replacement joining grows the fleet back.
+
+Launch (the supervisor owns the rendezvous coordinator):
+
+    python -m horovod_tpu.launch run --nprocs 3 --elastic \
+        --min-ranks 2 -- python examples/elastic_mnist.py
+
+or via the job spec `horovod_tpu/launch/jobs/mnist-elastic-2proc.yaml`.
+Unlaunched (no HVT_ELASTIC_COORDINATOR), it degrades to a plain
+single-process run through a local one-member rendezvous.
+
+Smoke-test env knobs: DRIVE_STEPS, DRIVE_EPOCHS.
+"""
+
+import os
+
+try:
+    import horovod_tpu  # noqa: F401 — installed (`pip install -e .`)
+except ModuleNotFoundError:  # bare source checkout: make the repo importable
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import optax
+
+import horovod_tpu as hvt
+from horovod_tpu import checkpoint, elastic, metrics
+from horovod_tpu.data import datasets
+from horovod_tpu.data.loader import ArrayDataset
+from horovod_tpu.models.cnn import MnistCNN
+
+
+def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
+    model_dir = os.path.join(
+        os.environ.get("PS_MODEL_PATH", "./models"), "elastic-mnist"
+    )
+    metrics.init(sync_tensorboard=True)
+    if world.rank == 0:
+        print(
+            f"elastic-mnist: generation {world.generation} — "
+            f"{world.size} rank(s), resuming at epoch {state.epoch}",
+            flush=True,
+        )
+
+    (x_train, y_train), _ = datasets.mnist(path=f"mnist-{world.rank}.npz")
+    x_train = (x_train.astype(np.float32) / 255.0)[..., None]
+    y_train = y_train.astype(np.int64)
+
+    # The data pipeline re-shards per generation: shard(rank, size) of the
+    # FULL dataset, so the new world again partitions every example once
+    # per epoch (ArrayDataset.reshard is the equivalent hook for a kept
+    # pipeline object). Per-worker batch is fixed (Horovod semantics) —
+    # the global batch and the LR scale below both track world.size.
+    world_procs = hvt.process_count()
+    per_process_batch = 128 * hvt.size() // world_procs
+    dataset = (
+        ArrayDataset((x_train, y_train))
+        .shard(world.rank, world_procs)
+        .repeat()
+        .shuffle(10000, seed=world.rank)
+        .batch(per_process_batch)
+    )
+
+    trainer = hvt.Trainer(
+        MnistCNN(),
+        # lr = 0.001 × size: rebuilt each generation, so the effective LR
+        # rescales with the world exactly like Horovod Elastic's
+        # reset-on-rescale optimizer.
+        hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(0.001))),
+        loss="sparse_categorical_crossentropy",
+    )
+    trainer.build(x_train[:1])
+
+    if state.state is not None:
+        # The common rescale path: adopt the committed snapshot (already
+        # synced from the freshest member — no checkpoint round-trip).
+        trainer.install_state(state.state)
+    else:
+        # Fresh process (first generation, or a per-rank restart after a
+        # hard crash): the checkpoint fallback.
+        trainer.state, done = checkpoint.restore_latest_and_broadcast(
+            model_dir, trainer.state, mesh=trainer.mesh
+        )
+        state.epoch = max(state.epoch, done)
+
+    callbacks = [
+        hvt.callbacks.LearningRateWarmupCallback(warmup_epochs=3),
+    ]
+    if world.rank == 0:
+        callbacks.append(hvt.callbacks.ModelCheckpoint(
+            os.path.join(model_dir, "checkpoint-{epoch}.msgpack")
+        ))
+        callbacks.append(hvt.callbacks.ScalarLogger(model_dir))
+    # LAST in the list: commits the epoch AFTER checkpoints/logs saw it,
+    # then runs the membership agreement (and may interrupt the fit).
+    callbacks.append(elastic.ElasticStateCallback(state, state.client))
+
+    steps = int(os.environ.get("DRIVE_STEPS", 0)) or hvt.shard_steps(500)
+    epochs = int(os.environ.get("DRIVE_EPOCHS", 0)) or 24
+
+    trainer.fit(
+        dataset,
+        steps_per_epoch=steps,
+        epochs=epochs,
+        initial_epoch=state.epoch,
+        callbacks=callbacks,
+        verbose=1 if world.rank == 0 else 0,
+    )
+
+
+def main() -> None:
+    if os.environ.get(hvt.runtime.ENV_ELASTIC_COORDINATOR):
+        elastic.run(train)
+    else:
+        # Bare mode: a process-local one-member rendezvous, so the same
+        # script runs unlaunched (the README.md single-instance contract).
+        coord = elastic.Coordinator(min_ranks=1, max_ranks=1).start()
+        try:
+            elastic.run(train, address=coord.address, member_id="solo")
+        finally:
+            coord.stop()
+    if hvt.rank() == 0:
+        print("TRAINING COMPLETE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,3 +49,83 @@ else:  # jax < 0.5: experimental module, check_rep spelling
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_rep=check_vma,
         )
+
+
+# --- elastic rescale shims (horovod_tpu.elastic) ---------------------------
+#
+# Resizing the world in-process needs two operations jax has no stable public
+# API for: fully resetting the distributed runtime's global state (so a
+# second `initialize()` is legal) and dropping the live backends (whose
+# collectives are compiled against the OLD world size). Both touch private
+# modules whose spelling drifts across versions — absorbed here.
+
+
+def reset_distributed_state() -> None:
+    """Null out jax's distributed global state so a subsequent
+    ``jax.distributed.initialize`` succeeds.
+
+    ``jax.distributed.shutdown()`` forgets ``preemption_sync_manager`` on
+    0.4.x ("Preemption sync manager should only be initialized once" on the
+    next init) and leaves ``coordinator_address``/``process_id`` populated;
+    a rescale must clear everything. Attribute-tolerant: fields that a jax
+    version lacks are skipped."""
+    try:
+        from jax._src import distributed
+    except ImportError:  # pragma: no cover — future jax moved the module
+        return
+    state = distributed.global_state
+    for attr in ("client", "service", "preemption_sync_manager",
+                 "coordinator_address"):
+        if hasattr(state, attr):
+            setattr(state, attr, None)
+    # Back to the PRISTINE single-process values, not None: backend
+    # creation reads process_id/num_processes directly (node_id=None
+    # crashes the CPU client constructor).
+    if hasattr(state, "process_id"):
+        state.process_id = 0
+    if hasattr(state, "num_processes"):
+        state.num_processes = 1
+
+
+def distributed_shutdown_barrier() -> None:
+    """The SYNCHRONIZED clean teardown of a live distributed world: every
+    process must call this at the same point (a collective boundary).
+
+    ``client.shutdown()`` is a barrier — it completes only when all tasks
+    reach it, which is exactly what keeps the coordination service from
+    entering its error state (an abrupt disconnect makes it propagate a
+    fatal error to every surviving client — observed as SIGABRT,
+    "Terminating process because the JAX distributed service detected
+    fatal errors"). After the barrier, leftover fields are reset so
+    re-initialization at a new world size is legal."""
+    try:
+        from jax._src import distributed
+    except ImportError:  # pragma: no cover
+        return
+    state = distributed.global_state
+    try:
+        state.shutdown()
+    finally:
+        reset_distributed_state()
+
+
+def clear_backends() -> None:
+    """Drop live XLA backends (and jit caches) so the next device use
+    re-creates them against the CURRENT distributed world.
+
+    Every live ``jax.Array`` is invalidated — callers must hold host
+    (numpy) copies of anything they still need (the ElasticState commit
+    contract). Spelling drift: ``jax.extend.backend.clear_backends`` is the
+    current home; older releases only have the underscored xla_bridge
+    helper."""
+    jax.clear_caches()
+    try:
+        from jax.extend import backend as _backend
+
+        _backend.clear_backends()
+        return
+    except (ImportError, AttributeError):
+        pass
+    from jax._src import xla_bridge  # pragma: no cover — old jax only
+
+    xla_bridge._clear_backends()

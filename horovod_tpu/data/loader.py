@@ -45,6 +45,11 @@ class ArrayDataset:
         self._batch_size = None
         self._drop_remainder = True
         self._seed = 0
+        # Elastic resharding support: `shard()` remembers the UNSHARDED
+        # leaves and this view's (index, count) so `reshard()` can recut
+        # the split at a new world size from the full data.
+        self._unsharded = None
+        self._shard_spec = None
 
     @classmethod
     def from_tensor_slices(cls, arrays) -> "ArrayDataset":
@@ -66,11 +71,45 @@ class ArrayDataset:
         return self._treedef
 
     def shard(self, index: int, count: int) -> "ArrayDataset":
-        """Keep every count-th example starting at index (per-process split)."""
+        """Keep every count-th example starting at index (per-process split).
+
+        The pre-split arrays are retained so `reshard` can recut the same
+        data at a different world size (the elastic rescale hook)."""
         if not (0 <= index < count):
             raise ValueError(f"shard index {index} out of range for count {count}")
         ds = self._clone()
+        ds._unsharded = self._unsharded or self._arrays
         ds._arrays = tuple(a[index::count] for a in self._arrays)
+        ds._shard_spec = (index, count)
+        return ds
+
+    @property
+    def shard_spec(self) -> tuple[int, int] | None:
+        """(index, count) of this view's split; None if unsharded."""
+        return self._shard_spec
+
+    def reshard(self, index: int, count: int) -> "ArrayDataset":
+        """Recut the per-process split at a NEW world size from the
+        ORIGINAL (unsharded) data — what the elastic rescale does to the
+        input pipeline on a generation change (`horovod_tpu.elastic`).
+
+        Unlike chaining ``.shard()`` on an already-sharded view (which
+        splits the SPLIT — shards of shards), this re-derives shard
+        ``index``/``count`` of the full dataset, so across the new world
+        the shards again partition every example exactly once per epoch.
+        Batch geometry (batch size, drop_remainder) carries over
+        unchanged, keeping per-rank batch shapes static across a rescale
+        — the dropped tail is at most ``batch_size - 1`` examples per
+        shard, exactly as on the original sharding."""
+        if not (0 <= index < count):
+            raise ValueError(
+                f"shard index {index} out of range for count {count}"
+            )
+        source = self._unsharded or self._arrays
+        ds = self._clone()
+        ds._unsharded = source
+        ds._arrays = tuple(a[index::count] for a in source)
+        ds._shard_spec = (index, count)
         return ds
 
     def repeat(self) -> "ArrayDataset":
@@ -98,6 +137,8 @@ class ArrayDataset:
         ds._batch_size = self._batch_size
         ds._drop_remainder = self._drop_remainder
         ds._seed = self._seed
+        ds._unsharded = self._unsharded
+        ds._shard_spec = self._shard_spec
         return ds
 
     def _index_stream(self) -> Iterator[int]:

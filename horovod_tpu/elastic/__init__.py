@@ -1,0 +1,58 @@
+"""Elastic data-parallel training — continue-through-failure instead of
+restart-on-failure (Horovod Elastic's role, TPU/JAX-native).
+
+Three pieces, one subsystem:
+
+* `coordinator` — the rendezvous/heartbeat control plane: a TCP server
+  (supervisor-owned) tracking membership, versioning the world by
+  **generation**, assigning ranks, electing the state-broadcast root, and
+  carrying heartbeats so pod-mode hang detection needs no shared
+  filesystem.
+* `state` — the ``commit()/restore()`` state contract plus the trainer
+  callback that commits on cadence and runs the epoch-end membership
+  agreement (the synchronized teardown boundary).
+* `rescale` — `ensure_world` (tear down + re-init the jax runtime at a
+  settled world's size) and `run` (the per-generation driver loop).
+
+Worker-side idiom::
+
+    from horovod_tpu import elastic
+
+    def train(state, world):
+        trainer = make_trainer()           # reacts to the new world size
+        trainer.build(x0, y0)
+        if state.state is not None:        # rescale / rejoin: adopt commit
+            trainer.install_state(state.state)
+        else:                              # fresh process: checkpoint fallback
+            trainer.state, done = checkpoint.restore_latest_and_broadcast(...)
+            state.epoch = max(state.epoch, done)
+        cb = elastic.ElasticStateCallback(state, state.client)
+        trainer.fit(..., initial_epoch=state.epoch, callbacks=[..., cb])
+
+    elastic.run(train)   # reads HVT_ELASTIC_COORDINATOR/_MEMBER
+
+Launcher-side: ``hvt-launch run --elastic --min-ranks 2 -- ...`` (or the
+job-spec ``elastic:`` block) starts the coordinator and supervises
+members individually — a clean leave shrinks the fleet in place, a
+replacement grows it back, and only hard crashes escalate to per-rank
+restarts (README "Elastic training").
+"""
+
+from horovod_tpu.elastic.coordinator import (  # noqa: F401
+    Coordinator,
+    ElasticClient,
+    ElasticError,
+    WorldInfo,
+)
+from horovod_tpu.elastic.rescale import (  # noqa: F401
+    ensure_world,
+    member_id_from_env,
+    run,
+)
+from horovod_tpu.elastic.state import (  # noqa: F401
+    ElasticState,
+    ElasticStateCallback,
+    HostsUpdatedInterrupt,
+    LeaveInterrupt,
+    progress_marker,
+)

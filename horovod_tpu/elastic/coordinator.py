@@ -1,0 +1,552 @@
+"""Rendezvous coordinator — the membership/generation control plane of
+elastic training (SURVEY.md §5.3's "elastic / dynamic world size" gap;
+Horovod Elastic's rendezvous server, TPU-native).
+
+One small TCP server, owned by the supervisor process, speaking
+line-delimited JSON (one request, one response, one connection — a member
+death can never wedge the server). It is the single source of truth for:
+
+* **Membership**: who is in the fleet (``sync`` auto-joins, ``leave``
+  departs, the supervisor marks hard deaths via `Coordinator.mark_dead`).
+* **Generations**: every membership event bumps an integer generation.
+  Workers learn the current generation from beat responses and compare it
+  to the generation they last rendezvoused at — a mismatch means the world
+  changed and they must re-rendezvous at the next commit boundary.
+* **Rank assignment**: a ``sync`` round blocks until every live member has
+  asked, then assigns contiguous ranks 0..n-1 in join order (survivors
+  keep their relative order, so rank 0 — the single writer — stays stable
+  across shrinks that don't kill it), picks the jax.distributed
+  coordinator port for the new world, and elects the **root**: the member
+  with the most committed progress, from whom (re)joiners receive state
+  (`ElasticState.sync`).
+* **Heartbeats**: beats ride the control socket (``beat`` requests), so
+  pod-mode hang detection needs NO shared filesystem — the
+  ``HVT_HEARTBEAT_DIR`` requirement disappears under ``--elastic``.
+  Members blocked in a ``sync`` call are exempt from staleness: a pending
+  rendezvous is itself proof of liveness.
+
+The wire format is deliberately dumb (JSON lines over TCP, new connection
+per call): the control plane moves a few hundred bytes per epoch per
+member; all bulk state movement (params to joiners) happens over the
+data plane (`collectives.broadcast_pytree` on the freshly built world).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+
+
+class ElasticError(RuntimeError):
+    """A coordinator-reported protocol failure (world full, below
+    min_ranks, malformed request)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldInfo:
+    """One settled rendezvous round — everything a worker needs to (re)build
+    its runtime for the new generation."""
+
+    rank: int
+    size: int
+    generation: int
+    jax_coordinator: str | None  # None ⇔ size == 1 (bare local mode)
+    root_rank: int               # who broadcasts committed state
+    max_progress: int            # the root's committed progress marker
+
+    @classmethod
+    def from_wire(cls, msg: dict) -> "WorldInfo":
+        return cls(
+            rank=int(msg["rank"]),
+            size=int(msg["size"]),
+            generation=int(msg["generation"]),
+            jax_coordinator=msg.get("jax_coordinator") or None,
+            root_rank=int(msg.get("root_rank", 0)),
+            max_progress=int(msg.get("max_progress", -1)),
+        )
+
+
+@dataclasses.dataclass
+class Member:
+    """Coordinator-side record of one fleet member."""
+
+    member_id: str
+    host: str
+    join_seq: int
+    status: str = "live"        # live | left | dead
+    reason: str = ""
+    rank: int | None = None
+    progress: int = -1          # last reported committed progress
+    last_beat: float = 0.0      # coordinator-side monotonic clock
+    joined_at: float = 0.0
+
+
+class Coordinator:
+    """The rendezvous/heartbeat server. Thread-safe; the supervisor calls
+    the ``mark_dead``/``stale_members``/``snapshot`` methods in-process
+    while workers speak the TCP protocol."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        min_ranks: int = 1,
+        max_ranks: int | None = None,
+        expected: int | None = None,
+        rendezvous_timeout: float = 60.0,
+        sync_port_base: int | None = None,
+        journal=None,
+    ):
+        """``expected``: how many members the FIRST round should wait for
+        (the supervisor's initial spawn count); later rounds settle on the
+        current live membership. ``sync_port_base``: fixed-base
+        jax.distributed port rotation (``base + generation``) for
+        multi-host fleets where the coordinator cannot probe a free port
+        on rank 0's host; None (single-host) probes a free local port per
+        round. ``journal``: optional ``fn(name, value, **fields)`` — the
+        supervisor's `RestartLog.write` — receiving generation-tagged
+        membership/rescale events."""
+        self._host = host
+        self._requested_port = port
+        self.min_ranks = int(min_ranks)
+        self.max_ranks = int(max_ranks) if max_ranks is not None else None
+        self.expected = int(expected) if expected is not None else None
+        self.rendezvous_timeout = float(rendezvous_timeout)
+        self.sync_port_base = sync_port_base
+        self._journal = journal
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.generation = 0
+        self.members: dict[str, Member] = {}
+        self._join_seq = 0
+        self._settled = 0          # how many rounds have settled
+        self._last_settle: dict | None = None
+        # member_id -> {"progress": int, "since": monotonic, "world": dict|None}
+        self._waiters: dict[str, dict] = {}
+        self._server: socketserver.ThreadingTCPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Coordinator":
+        coord = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    reply = coord._dispatch(json.loads(line))
+                except ElasticError as e:
+                    reply = {"error": str(e)}
+                except Exception as e:  # malformed request — never crash
+                    reply = {"error": f"{type(e).__name__}: {e}"}
+                try:
+                    self.wfile.write(json.dumps(reply).encode() + b"\n")
+                except OSError:
+                    pass  # caller died mid-reply; membership catches it
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((self._host, self._requested_port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        with self._cond:
+            # Unblock any waiter still parked in a sync round.
+            for slot in self._waiters.values():
+                slot.setdefault("error", "coordinator stopped")
+            self._cond.notify_all()
+
+    @property
+    def address(self) -> str:
+        assert self._server is not None, "call start() first"
+        return f"{self._host}:{self._server.server_address[1]}"
+
+    # --- protocol ----------------------------------------------------------
+
+    def _dispatch(self, msg: dict) -> dict:
+        cmd = msg.get("cmd")
+        if cmd == "sync":
+            return self._handle_sync(msg)
+        if cmd == "beat":
+            return self._handle_beat(msg)
+        if cmd == "leave":
+            return self._handle_leave(msg)
+        if cmd == "state":
+            return self.snapshot()
+        raise ElasticError(f"unknown command {cmd!r}")
+
+    def _bump(self, why: str, member_id: str, reason: str = "") -> None:
+        """One membership event: new generation + a journal line. Caller
+        holds the lock."""
+        self.generation += 1
+        self._write_journal(
+            why, 1.0, member=member_id, generation=self.generation,
+            reason=reason,
+        )
+        self._cond.notify_all()
+
+    def _fail_waiter(self, member_id: str, message: str) -> None:
+        """Release a parked sync handler for a member that was removed
+        (died/left mid-rendezvous) — settle only answers LIVE members, so
+        without this the handler thread would spin until its client's
+        socket timeout, leaking a thread per hard death. Caller holds the
+        lock."""
+        slot = self._waiters.get(member_id)
+        if slot is not None and slot.get("world") is None:
+            slot["error"] = message
+            self._cond.notify_all()
+
+    def _write_journal(self, name: str, value: float, **fields) -> None:
+        if self._journal is not None:
+            try:
+                self._journal(name, value, **fields)
+            except Exception:
+                pass  # observability must never take down the control plane
+
+    def _handle_sync(self, msg: dict) -> dict:
+        member_id = str(msg["member"])
+        host = str(msg.get("host") or "127.0.0.1")
+        progress = int(msg.get("progress", -1))
+        deadline = time.monotonic() + self.rendezvous_timeout
+        with self._cond:
+            m = self.members.get(member_id)
+            if m is None or m.status != "live":
+                live = self._live()
+                if self.max_ranks is not None and len(live) >= self.max_ranks:
+                    raise ElasticError(
+                        f"world is full ({len(live)}/{self.max_ranks} ranks)"
+                    )
+                self._join_seq += 1
+                now = time.monotonic()
+                m = Member(
+                    member_id=member_id, host=host, join_seq=self._join_seq,
+                    last_beat=now, joined_at=now,
+                )
+                self.members[member_id] = m
+                self._bump("join", member_id)
+            m.progress = progress
+            m.last_beat = time.monotonic()
+            slot = {"progress": progress, "since": time.monotonic(),
+                    "world": None}
+            self._waiters[member_id] = slot
+            self._cond.notify_all()
+            while slot.get("world") is None and "error" not in slot:
+                self._maybe_settle()
+                if slot.get("world") is not None or "error" in slot:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._expire_laggards()
+                    remaining = 0.05
+                self._cond.wait(timeout=min(remaining, 0.25))
+            self._waiters.pop(member_id, None)
+            if "error" in slot:
+                raise ElasticError(slot["error"])
+            return slot["world"]
+
+    def _handle_beat(self, msg: dict) -> dict:
+        member_id = str(msg["member"])
+        with self._cond:
+            m = self.members.get(member_id)
+            if m is not None:
+                m.last_beat = time.monotonic()
+                if "progress" in msg:
+                    m.progress = int(msg["progress"])
+            return {"generation": self.generation,
+                    "known": m is not None and m.status == "live"}
+
+    def _handle_leave(self, msg: dict) -> dict:
+        member_id = str(msg["member"])
+        reason = str(msg.get("reason", "leave"))
+        with self._cond:
+            m = self.members.get(member_id)
+            if m is not None and m.status == "live":
+                m.status = "left"
+                m.reason = reason
+                self._bump("leave", member_id, reason=reason)
+                self._fail_waiter(member_id, f"member left ({reason})")
+                self._maybe_settle()
+            return {"ok": 1, "generation": self.generation}
+
+    # --- settle ------------------------------------------------------------
+
+    def _live(self) -> list[Member]:
+        return sorted(
+            (m for m in self.members.values() if m.status == "live"),
+            key=lambda m: m.join_seq,
+        )
+
+    def _maybe_settle(self) -> None:
+        """Settle the pending rendezvous round when every live member is
+        waiting (and the first round has gathered its expected quorum).
+        Caller holds the lock."""
+        live = self._live()
+        waiting = [m for m in live if m.member_id in self._waiters
+                   and self._waiters[m.member_id].get("world") is None]
+        if not waiting or len(waiting) < len(live):
+            return
+        if len(live) < self.min_ranks:
+            return
+        if (
+            self._settled == 0
+            and self.expected is not None
+            and len(live) < min(
+                self.expected,
+                self.max_ranks if self.max_ranks is not None else self.expected,
+            )
+            # the expected quorum is waived once the oldest waiter has
+            # out-waited the rendezvous window (a member died pre-join)
+            and not self._quorum_expired()
+        ):
+            return
+        self._settle(live)
+
+    def _quorum_expired(self) -> bool:
+        oldest = min(
+            (w["since"] for w in self._waiters.values()), default=None
+        )
+        return (
+            oldest is not None
+            and time.monotonic() - oldest > self.rendezvous_timeout
+        )
+
+    def _expire_laggards(self) -> None:
+        """A waiter out-waited the rendezvous window: live members that never
+        showed up are presumed dead (crashed without the supervisor noticing
+        yet), dropped, and the round re-evaluated. Caller holds the lock."""
+        live = self._live()
+        laggards = [m for m in live if m.member_id not in self._waiters]
+        if not laggards:
+            if len(live) >= self.min_ranks:
+                # Everyone alive IS waiting — only the first round's
+                # expected quorum held the settle back, and expiry waives
+                # it (_quorum_expired is now true).
+                self._maybe_settle()
+                return
+            # Below min_ranks with nobody left to expire: fail loudly.
+            for slot in self._waiters.values():
+                if slot.get("world") is None:
+                    slot["error"] = (
+                        f"rendezvous timed out below min_ranks "
+                        f"({len(live)} < {self.min_ranks})"
+                    )
+            self._cond.notify_all()
+            return
+        for m in laggards:
+            m.status = "dead"
+            m.reason = "rendezvous-timeout"
+            self._bump("dead", m.member_id, reason="rendezvous-timeout")
+        self._maybe_settle()
+
+    def _pick_sync_port(self) -> int:
+        if self.sync_port_base is not None:
+            # Rotation keeps an orphan holding the old port from wedging
+            # the new world (the supervise_hosts trick, per generation).
+            return int(self.sync_port_base) + self.generation
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    def _settle(self, live: list[Member]) -> None:
+        size = len(live)
+        prev = self._last_settle
+        for rank, m in enumerate(live):
+            m.rank = rank
+        root = max(live, key=lambda m: (m.progress, -m.rank))
+        if size > 1:
+            port = self._pick_sync_port()
+            jax_coordinator = f"{live[0].host}:{port}"
+        else:
+            jax_coordinator = None  # bare local mode — no control plane
+        self._settled += 1
+        kind = (
+            "start" if prev is None
+            else "shrink" if size < prev["size"]
+            else "grow" if size > prev["size"]
+            else "steady"
+        )
+        self._last_settle = {
+            "generation": self.generation, "size": size,
+            "members": [m.member_id for m in live],
+            "jax_coordinator": jax_coordinator,
+            "kind": kind, "wall_time": time.time(),
+        }
+        self._write_journal(
+            kind, float(size), generation=self.generation, size=size,
+            members=",".join(m.member_id for m in live),
+            root=root.member_id,
+        )
+        for m in live:
+            self._waiters[m.member_id]["world"] = {
+                "rank": m.rank, "size": size,
+                "generation": self.generation,
+                "jax_coordinator": jax_coordinator,
+                "root_rank": root.rank, "max_progress": root.progress,
+            }
+        self._cond.notify_all()
+
+    # --- supervisor-side API ------------------------------------------------
+
+    def mark_dead(self, member_id: str, reason: str = "crash") -> bool:
+        """Remove a member the supervisor observed dying (process exit, TCP
+        beat gone stale). Bumps the generation so survivors re-rendezvous."""
+        with self._cond:
+            m = self.members.get(member_id)
+            if m is None or m.status != "live":
+                return False
+            m.status = "dead"
+            m.reason = reason
+            self._bump("dead", member_id, reason=reason)
+            self._fail_waiter(member_id, f"member removed ({reason})")
+            self._maybe_settle()
+            return True
+
+    def stale_members(self, timeout: float, *, now: float | None = None
+                      ) -> list[str]:
+        """Live members whose last TCP beat is older than ``timeout``.
+        Members parked in a sync round are exempt — a pending rendezvous
+        is proof the process is alive and connected."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [
+                m.member_id for m in self.members.values()
+                if m.status == "live"
+                and m.member_id not in self._waiters
+                and now - m.last_beat > timeout
+            ]
+
+    def member_status(self, member_id: str) -> tuple[str, str]:
+        """(status, reason) for a member; ("unknown", "") if never joined."""
+        with self._lock:
+            m = self.members.get(member_id)
+            return (m.status, m.reason) if m is not None else ("unknown", "")
+
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for m in self.members.values() if m.status == "live"
+            )
+
+    def snapshot(self) -> dict:
+        """JSON-safe control-plane state (the ``state`` protocol command and
+        the supervisor's journal/teardown view)."""
+        with self._lock:
+            return {
+                "generation": self.generation,
+                "min_ranks": self.min_ranks,
+                "max_ranks": self.max_ranks,
+                "settled_rounds": self._settled,
+                "last_settle": dict(self._last_settle)
+                if self._last_settle else None,
+                "members": {
+                    m.member_id: {
+                        "host": m.host, "status": m.status,
+                        "reason": m.reason, "rank": m.rank,
+                        "progress": m.progress,
+                    }
+                    for m in self.members.values()
+                },
+            }
+
+
+class ElasticClient:
+    """Worker-side handle on the coordinator. One connection per call —
+    stateless on the wire, so a mid-call death on either side surfaces as
+    a socket error, never a wedged server thread."""
+
+    def __init__(
+        self,
+        address: str | None = None,
+        member_id: str | None = None,
+        *,
+        host: str | None = None,
+        timeout: float = 300.0,
+    ):
+        from horovod_tpu import runtime
+
+        address = address or os.environ.get(runtime.ENV_ELASTIC_COORDINATOR)
+        if not address:
+            raise ValueError(
+                "no coordinator address — pass address= or export "
+                f"{runtime.ENV_ELASTIC_COORDINATOR}"
+            )
+        self.coord_host, port_s = address.rsplit(":", 1)
+        self.coord_port = int(port_s)
+        self.member_id = (
+            member_id
+            or os.environ.get(runtime.ENV_ELASTIC_MEMBER)
+            or f"{socket.gethostname()}-{os.getpid()}"
+        )
+        # The address peers use to dial THIS member's jax coordinator when
+        # it lands rank 0. Single-host fleets loop back; multi-host members
+        # advertise their hostname.
+        self.host = host or (
+            "127.0.0.1" if self.coord_host in ("127.0.0.1", "localhost")
+            else socket.gethostname()
+        )
+        self.timeout = timeout
+        self.synced_generation = -1
+
+    def _call(self, timeout: float | None = None, **msg) -> dict:
+        with socket.create_connection(
+            (self.coord_host, self.coord_port),
+            timeout=timeout or self.timeout,
+        ) as s:
+            s.sendall(json.dumps(msg).encode() + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise ElasticError("coordinator closed the connection")
+                buf += chunk
+        reply = json.loads(buf)
+        if "error" in reply:
+            raise ElasticError(reply["error"])
+        return reply
+
+    def sync(self, progress: int = -1,
+             timeout: float | None = None) -> WorldInfo:
+        """Block until the next rendezvous round settles; returns this
+        member's place in the new world. Auto-joins on first call."""
+        world = WorldInfo.from_wire(self._call(
+            cmd="sync", member=self.member_id, host=self.host,
+            progress=progress, timeout=timeout,
+        ))
+        self.synced_generation = world.generation
+        return world
+
+    def beat(self, progress: int | None = None) -> int:
+        """One TCP heartbeat; returns the coordinator's CURRENT generation
+        (compare with `synced_generation` to detect membership changes)."""
+        msg = {"cmd": "beat", "member": self.member_id}
+        if progress is not None:
+            msg["progress"] = progress
+        return int(self._call(timeout=10.0, **msg)["generation"])
+
+    def leave(self, reason: str = "leave") -> None:
+        """Planned departure — the clean-shrink signal."""
+        self._call(cmd="leave", member=self.member_id, reason=reason,
+                   timeout=10.0)
+
+    def state(self) -> dict:
+        return self._call(cmd="state", timeout=10.0)

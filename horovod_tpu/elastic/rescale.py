@@ -1,0 +1,103 @@
+"""Rescale machinery: rebuild the runtime for a settled world, and the
+worker-side elastic driver loop.
+
+The loop is Horovod Elastic's ``@hvd.elastic.run`` shape, adapted to the
+jitted-SPMD world: since the mesh, every compiled executable, and every
+live array are functions of the world size, a rescale rebuilds ALL of
+them — `ensure_world` tears down jax's distributed runtime and backends
+and re-initializes at the rendezvous size, and the user's ``train_fn``
+reconstructs its Trainer (fresh jit caches compile for the new topology).
+What survives a rescale is exactly the `ElasticState`'s committed host
+snapshot — params, optimizer state, epoch — moved to (re)joiners by
+``state.sync`` over the freshly built world.
+"""
+
+from __future__ import annotations
+
+import os
+
+from horovod_tpu import runtime
+from horovod_tpu.elastic import state as state_lib
+from horovod_tpu.elastic.coordinator import ElasticClient, WorldInfo
+from horovod_tpu.elastic.state import (
+    ElasticState,
+    HostsUpdatedInterrupt,
+    LeaveInterrupt,
+)
+
+
+def ensure_world(world: WorldInfo) -> "runtime.World":
+    """(Re)build the process's runtime for a settled rendezvous world.
+
+    First call in a fresh process: plain `runtime.init`. Later calls (a
+    rescale): the old world was already shut down at the agreement
+    boundary (`ElasticStateCallback` runs the synchronized barrier), so
+    what remains is dropping the stale backends and initializing at the
+    new size. A world of size 1 skips `jax.distributed` entirely — the
+    bare single-process mode, every collective a local op — so a fleet
+    can shrink all the way to one survivor."""
+    if world.size > 1:
+        return runtime.reinit(
+            coordinator_address=world.jax_coordinator,
+            num_processes=world.size,
+            process_id=world.rank,
+        )
+    return runtime.reinit()
+
+
+def run(
+    train_fn,
+    state: ElasticState | None = None,
+    *,
+    client: ElasticClient | None = None,
+    address: str | None = None,
+    member_id: str | None = None,
+    max_generations: int = 1000,
+):
+    """Drive ``train_fn`` through rendezvous generations until it returns.
+
+    ``train_fn(state, world)`` must build its Trainer FOR the given world
+    (meshes, `scale_lr`, steps-per-epoch all react to ``world.size``),
+    adopt ``state`` (``trainer.install_state(state.state)`` when a
+    committed snapshot exists, the checkpoint-restore idiom otherwise),
+    include ``ElasticStateCallback(state, client)`` in its fit callbacks
+    (LAST in the list, so earlier callbacks see each epoch before a
+    rescale can interrupt it), and train from ``state.epoch``.
+
+    Per generation: rendezvous (`client.sync` — blocks until the world
+    settles), rebuild the runtime (`ensure_world`), adopt the freshest
+    committed snapshot (`state.sync` from the coordinator-elected root),
+    then hand over to ``train_fn``. A `HostsUpdatedInterrupt` rolls state
+    back to the last commit and loops; a `LeaveInterrupt` notifies the
+    coordinator (already done at the boundary) and exits with status 143
+    — the preemption convention the supervisor classifies as a planned,
+    clean departure. Normal return reports ``done`` and hands back
+    ``train_fn``'s result."""
+    client = client or ElasticClient(address, member_id)
+    state = state or ElasticState()
+    state.client = client
+    for _ in range(max_generations):
+        world = client.sync(progress=state.progress)
+        ensure_world(world)
+        state.sync(world.root_rank)
+        try:
+            result = train_fn(state, world)
+        except HostsUpdatedInterrupt:
+            state.restore()
+            continue
+        except LeaveInterrupt:
+            raise SystemExit(143)
+        try:
+            client.leave(reason="done")
+        except state_lib.CONTROL_PLANE_ERRORS:
+            pass  # supervisor may already be tearing the fleet down
+        return result
+    raise RuntimeError(
+        f"elastic run exceeded {max_generations} generations — the fleet "
+        "is thrashing (check the supervisor journal for a rescale loop)"
+    )
+
+
+def member_id_from_env() -> str | None:
+    """The supervisor-assigned member identity, if launched elastically."""
+    return os.environ.get(runtime.ENV_ELASTIC_MEMBER)

@@ -1,0 +1,261 @@
+"""Elastic training state — the Horovod ``State.commit()/restore()``
+pattern, JAX-native.
+
+The contract that makes in-process rescaling possible: everything a worker
+needs to continue training after the world changes must exist as a HOST
+(numpy) snapshot, because the rescale drops every live ``jax.Array`` along
+with the old backends (`compat.clear_backends`). `ElasticState.commit`
+takes that snapshot at clean boundaries (epoch ends, or every N steps);
+`restore` rolls the live attributes back to it after a membership-change
+interrupt; `sync` moves the freshest committed snapshot to (re)joining
+members over ONE fused host-level broadcast — no checkpoint round-trip for
+the common case (the checkpoint path stays as the fallback for members
+whose process itself was restarted).
+
+`ElasticStateCallback` is the commit hook wired into the `Trainer` loop:
+it tracks the trainer's state into the `ElasticState`, commits on the
+chosen cadence, carries TCP heartbeats to the coordinator, and runs the
+epoch-end **membership agreement** — the same allgather-agreement shape
+`PreemptionCheckpointCallback` uses for signals — so every rank of a
+generation tears down and re-rendezvouses at the SAME epoch boundary.
+That lockstep is what lets `runtime.shutdown` complete its barrier
+cleanly (a one-sided teardown makes the coordination service kill the
+survivors; see `compat.distributed_shutdown_barrier`).
+"""
+
+from __future__ import annotations
+
+import signal
+
+import jax
+
+from horovod_tpu import runtime
+from horovod_tpu.elastic.coordinator import ElasticError
+from horovod_tpu.parallel import collectives
+from horovod_tpu.training.callbacks import Callback
+
+# What a control-plane call can throw when the coordinator is dying or
+# racing teardown: socket errors, a mid-exchange close / error reply
+# (ElasticError), or a torn JSON line (json.JSONDecodeError ⊂ ValueError).
+CONTROL_PLANE_ERRORS = (OSError, ElasticError, ValueError)
+
+
+class HostsUpdatedInterrupt(BaseException):
+    """The world changed (a member joined/left/died): unwind out of fit(),
+    restore committed state, re-rendezvous. BaseException so user-level
+    ``except Exception`` blocks in training code cannot swallow it."""
+
+
+class LeaveInterrupt(BaseException):
+    """This member is leaving the fleet (planned departure: a scheduler
+    SIGTERM, or the ``leave`` fault kind). `elastic.run` converts it into
+    the 143 exit-status convention the supervisor classifies as clean."""
+
+
+def progress_marker(epoch: int, step: int = 0) -> int:
+    """Total order over committed progress: epochs dominate, steps break
+    ties within an epoch (the every-N-steps commit cadence). Used to elect
+    the rendezvous root — the member whose snapshot everyone adopts."""
+    return int(epoch) * 1_000_000 + int(step)
+
+
+class ElasticState:
+    """Committed training state: named attributes (``state`` — typically a
+    `TrainState` — plus ``epoch``/``step`` bookkeeping and any extra
+    kwargs), snapshotted to host memory on ``commit()``.
+
+    Attributes named at construction are the tracked set; assign to them
+    freely between commits. After ``restore()`` array-valued attributes
+    hold HOST (numpy) pytrees — `Trainer.install_state` puts them back on
+    whatever mesh the new world built."""
+
+    def __init__(self, state=None, epoch: int = 0, step: int = 0, **extra):
+        self._tracked = ("state", "epoch", "step", *extra)
+        self.state = state
+        self.epoch = epoch
+        self.step = step
+        for k, v in extra.items():
+            setattr(self, k, v)
+        self._committed: dict | None = None
+        self.commits = 0
+        # Untracked convenience handle: `elastic.run` parks its client here
+        # so train functions can reach the control plane (e.g. to build the
+        # ElasticStateCallback) without threading it separately.
+        self.client = None
+
+    def commit(self) -> None:
+        """Snapshot every tracked attribute to host memory. Call at clean
+        boundaries only (between steps, outside collectives): at most one
+        commit interval of progress is lost to a membership change."""
+        self._committed = {
+            k: jax.device_get(getattr(self, k)) for k in self._tracked
+        }
+        self.commits += 1
+
+    def restore(self) -> None:
+        """Roll tracked attributes back to the last commit (no-op before
+        the first — a fresh member keeps its initial values and relies on
+        `sync` or the checkpoint fallback)."""
+        if self._committed is None:
+            return
+        for k, v in self._committed.items():
+            setattr(self, k, v)
+
+    @property
+    def progress(self) -> int:
+        """Committed progress marker (-1 = nothing committed) — what the
+        coordinator's root election compares across members."""
+        if self._committed is None:
+            return -1
+        return progress_marker(
+            self._committed.get("epoch", 0), self._committed.get("step", 0)
+        )
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Adopt the root member's committed snapshot, cross-process.
+
+        Two transports, picked by what the members actually hold: when
+        every member has a committed snapshot of identical structure
+        (the shrink case — survivors already carry byte-identical
+        replicated state) the arrays ride `collectives.broadcast_pytree`,
+        one fused host-level broadcast. When structures differ or someone
+        has nothing (the (re)join case) the whole snapshot travels as one
+        `broadcast_object` — structure included, so a fresh process needs
+        no template. Ends with `restore()`, so live attributes reflect
+        the adopted snapshot."""
+        if jax.process_count() == 1:
+            self.restore()
+            return
+        fp = None
+        if self._committed is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(self._committed)
+            fp = (
+                str(treedef),
+                tuple(getattr(l, "shape", ()) for l in leaves),
+                tuple(str(getattr(l, "dtype", type(l).__name__))
+                      for l in leaves),
+            )
+        fps = collectives.allgather_object(fp)
+        if all(f is not None and f == fps[root_rank] for f in fps):
+            self._committed = collectives.broadcast_pytree(
+                self._committed, root=root_rank
+            )
+        else:
+            self._committed = collectives.broadcast_object(
+                self._committed, root=root_rank
+            )
+        if self._committed is not None:
+            self._committed = jax.device_get(self._committed)
+        self.restore()
+
+
+class ElasticStateCallback(Callback):
+    """The trainer-side elastic hook: commit cadence + TCP heartbeats +
+    the epoch-end membership agreement.
+
+    Wire it into ``fit(callbacks=[...])`` from an `elastic.run` train
+    function. Per epoch end it (1) tracks ``trainer.state`` into the
+    `ElasticState`, (2) beats the coordinator, (3) allgathers every
+    rank's view (coordinator generation + leave intent) so the WHOLE
+    generation takes the same branch, and on a membership change
+    (4) commits, runs the synchronized `runtime.shutdown` barrier, and
+    raises `HostsUpdatedInterrupt` (survivors) or `LeaveInterrupt`
+    (planned leavers — scheduler SIGTERM or the ``leave`` fault kind).
+
+    ``commit_every``: commit every N epochs (1 = every epoch). A
+    membership change always commits first regardless of cadence — the
+    boundary is clean, so the just-finished epoch is never thrown away.
+
+    SIGTERM: a handler installed for the duration of fit() records the
+    signal as leave intent, so a scheduler preemption becomes a clean
+    shrink at the next epoch boundary instead of a fleet abort. Don't
+    stack this with `PreemptionCheckpointCallback` — both would claim
+    the same signal."""
+
+    def __init__(self, state: ElasticState, client, *,
+                 commit_every: int = 1, beat_interval: float = 1.0):
+        self.state = state
+        self.client = client
+        self.commit_every = max(1, int(commit_every))
+        self.beat_interval = beat_interval
+        self._last_beat = 0.0
+        self._leave_requested = False
+        self._old_handler = None
+
+    # --- liveness ----------------------------------------------------------
+
+    def _beat(self, force: bool = False) -> int | None:
+        import time
+
+        now = time.time()
+        if not force and now - self._last_beat < self.beat_interval:
+            return None
+        try:
+            gen = self.client.beat(progress=self.state.progress)
+        except CONTROL_PLANE_ERRORS:
+            # A dead coordinator must not kill training mid-epoch; the
+            # next sync/leave will surface the failure loudly.
+            return None
+        self._last_beat = now
+        return gen
+
+    def _handler(self, signum, frame):
+        self._leave_requested = True
+
+    def on_train_begin(self, logs=None):
+        self._old_handler = signal.signal(signal.SIGTERM, self._handler)
+        self._beat(force=True)
+
+    def on_train_end(self, logs=None):
+        if self._old_handler is not None:
+            signal.signal(signal.SIGTERM, self._old_handler)
+            self._old_handler = None
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        self._beat(force=True)
+
+    def on_batch_end(self, batch: int, logs=None):
+        self._beat()
+
+    # --- the commit + agreement boundary -----------------------------------
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        from horovod_tpu.testing import faults
+
+        self.state.state = self.trainer.state
+        self.state.epoch = epoch + 1
+        self.state.step = 0
+        gen = self._beat(force=True)
+        leaving = self._leave_requested or faults.leave_requested()
+        if jax.process_count() > 1:
+            votes = collectives.allgather_object(
+                (gen if gen is not None else -1, bool(leaving))
+            )
+            agreed_gen = max(g for g, _ in votes)
+            any_leaving = any(l for _, l in votes)
+        else:
+            agreed_gen = gen if gen is not None else -1
+            any_leaving = bool(leaving)
+        changed = (
+            any_leaving
+            or (agreed_gen >= 0
+                and agreed_gen != self.client.synced_generation)
+        )
+        if not changed:
+            if (epoch + 1) % self.commit_every == 0:
+                self.state.commit()
+            return
+        # Clean boundary: bank the finished epoch, then tear the old world
+        # down in lockstep (every rank of the generation reaches this
+        # barrier — the votes above guarantee the same branch everywhere).
+        self.state.commit()
+        runtime.shutdown()
+        if leaving:
+            try:
+                self.client.leave(
+                    reason="fault" if faults.leave_requested() else "sigterm"
+                )
+            except CONTROL_PLANE_ERRORS:
+                pass
+            raise LeaveInterrupt()
+        raise HostsUpdatedInterrupt()

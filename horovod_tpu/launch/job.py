@@ -89,7 +89,45 @@ def run_job(spec_path: str) -> int:
     #     backoff: 1.0            # seconds, doubles per no-progress restart
     #     heartbeat_timeout: 300  # omit to disable hang detection
     #     log: path/restarts.jsonl  # default $PS_MODEL_PATH/restarts.jsonl
-    if "restart" in job:
+    # `elastic:` block — elastic rendezvous launch (supervisor.py
+    # supervise_elastic + horovod_tpu.elastic):
+    #   elastic:
+    #     min_ranks: 2            # smallest world to shrink to
+    #     max_ranks: 3            # largest world to grow back to
+    #     rendezvous_timeout: 60  # seconds a round waits for stragglers
+    # Composes with `restart:` for the budget/backoff/heartbeat knobs; the
+    # journal (restart log) carries the generation-tagged shrink/grow
+    # events the gate and /healthz read.
+    if "elastic" in job:
+        elastic_map = job["elastic"] or {}
+        if not isinstance(elastic_map, dict):
+            print(f"job elastic: must be a mapping, got {elastic_map!r}")
+            return 1
+        from horovod_tpu.launch import supervisor
+
+        elastic = supervisor.ElasticPolicy.from_mapping(elastic_map)
+        restart = job.get("restart") or {}
+        if not isinstance(restart, dict):
+            print(f"job restart: must be a mapping, got {restart!r}")
+            return 1
+        policy = supervisor.RestartPolicy.from_mapping(
+            {k: v for k, v in restart.items() if k != "log"}
+        )
+        log_path = restart.get("log") or supervisor.default_log_path(env)
+        if log_path and os.path.exists(log_path):
+            os.remove(log_path)  # stale-journal hygiene, as below
+        if hosts:
+            code = supervisor.supervise_elastic_hosts(
+                list(hosts), argv, env=env, policy=policy, elastic=elastic,
+                sync_port_base=int(job.get("coordinator_port", 9981)),
+                workdir=job.get("workdir"), log_path=log_path,
+            )
+        else:
+            code = supervisor.supervise_elastic(
+                int(job.get("nprocs", 1)), argv, env=env, policy=policy,
+                elastic=elastic, log_path=log_path,
+            )
+    elif "restart" in job:
         # Key-present-but-empty (`restart:` with every knob commented out)
         # means "supervise with defaults" — matching the CLI, where any
         # supervision flag opts in. Only a mapping (or nothing) is valid;

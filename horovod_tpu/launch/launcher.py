@@ -292,6 +292,24 @@ def main(argv: list[str] | None = None) -> int:
             help="JSONL restart journal (default: "
             "$PS_MODEL_PATH/restarts.jsonl; gateable — "
             "`gate --metrics <log> --check restarts=0..N --aggregate count`)")
+        # Elastic mode (launch/supervisor.py supervise_elastic +
+        # horovod_tpu.elastic): members are supervised INDIVIDUALLY — a
+        # clean departure shrinks the fleet in place (survivors keep
+        # training from committed state), a replacement grows it back.
+        p.add_argument(
+            "--elastic", action="store_true",
+            help="elastic launch: rendezvous coordinator + TCP heartbeats "
+            "+ per-rank restart; shrink to survivors instead of "
+            "relaunching the fleet (the command must drive training via "
+            "horovod_tpu.elastic.run)")
+        p.add_argument(
+            "--min-ranks", type=int, default=None, metavar="N",
+            help="smallest world the elastic fleet may shrink to "
+            "(default 1)")
+        p.add_argument(
+            "--max-ranks", type=int, default=None, metavar="N",
+            help="largest world the elastic fleet may grow to "
+            "(default: the launch size)")
 
     p_gate = sub.add_parser("gate", help="CI metric range check")
     p_gate.add_argument("--metrics", required=True, help="metrics.jsonl path")
@@ -324,9 +342,30 @@ def main(argv: list[str] | None = None) -> int:
             "heartbeat_timeout": a.heartbeat_timeout,
         })
 
+    def elastic_policy(a):
+        """None unless an elastic flag was given (--min/--max-ranks alone
+        opt in, like the supervision flags)."""
+        if not (a.elastic or a.min_ranks is not None
+                or a.max_ranks is not None):
+            return None
+        from horovod_tpu.launch import supervisor
+
+        return supervisor.ElasticPolicy.from_mapping({
+            "min_ranks": a.min_ranks,
+            "max_ranks": a.max_ranks,
+        })
+
     if args.cmd == "run":
         env = dict(kv.split("=", 1) for kv in args.env)
         policy = restart_policy(args)
+        elastic = elastic_policy(args)
+        if elastic is not None:
+            from horovod_tpu.launch import supervisor
+
+            return supervisor.supervise_elastic(
+                args.nprocs, command, env=env, policy=policy,
+                elastic=elastic, log_path=args.restart_log,
+            )
         if policy is not None:
             from horovod_tpu.launch import supervisor
 
@@ -348,6 +387,29 @@ def main(argv: list[str] | None = None) -> int:
             parser.error("pod needs --hostfile or --hosts")
         env = dict(kv.split("=", 1) for kv in args.env)
         policy = restart_policy(args)
+        elastic = elastic_policy(args)
+        if elastic is not None:
+            from horovod_tpu.launch import supervisor
+
+            return supervisor.supervise_elastic_hosts(
+                hosts, command, env=env, policy=policy, elastic=elastic,
+                sync_port_base=args.port, workdir=args.workdir,
+                log_path=args.restart_log,
+            )
+        if args.heartbeat_timeout is not None and not (
+            env.get("PS_MODEL_PATH") or os.environ.get("PS_MODEL_PATH")
+        ):
+            # Fail fast at the CLI: a launcher-local tmpdir heartbeat dir
+            # can never observe remote ranks' beats, so pod hang detection
+            # would silently never fire (supervise_hosts raises the same
+            # contract for programmatic callers).
+            parser.error(
+                "pod --heartbeat-timeout needs a shared filesystem for "
+                "heartbeats: set PS_MODEL_PATH to a mount shared with "
+                "every host (NFS/GCS-fuse), or use --elastic — its "
+                "heartbeats ride the rendezvous TCP socket and need no "
+                "shared filesystem"
+            )
         if policy is not None:
             from horovod_tpu.launch import supervisor
 

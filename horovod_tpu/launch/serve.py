@@ -14,6 +14,8 @@ no TF anywhere. Two bundle kinds, auto-detected:
 Endpoints (JSON, shapes follow the exported signature's trailing dims):
 
 * ``GET  /healthz``                → ``{"status": "ok", "bundle": ...}``
+  (+ a ``fleet`` section — generation/size/restart/rescale events from the
+  supervisor journal — when launched with ``--fleet-journal``)
 * ``POST /v1/predict``  body ``{"input": [[...], ...]}``
                                    → ``{"prob": [[...], ...]}``
 * ``POST /v1/generate`` body ``{"prompt": [[ids...], ...]}`` or
@@ -304,10 +306,16 @@ def _make_app(bundle_dir: str, coalesce: bool = True):
 
 
 def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
-                coalesce: bool = True):
+                coalesce: bool = True, fleet_journal: str | None = None):
     """Build (but don't start) the HTTP server; ``server.server_address``
     carries the bound port when ``port=0``. ``coalesce=False`` keeps the
-    legacy serialize-whole-requests path (the bench baseline)."""
+    legacy serialize-whole-requests path (the bench baseline).
+
+    ``fleet_journal``: path to a supervisor restart/rescale journal
+    (``restarts.jsonl``); when given, ``GET /healthz`` grows a ``fleet``
+    section — current generation/size, restart/shrink/grow counts, last
+    events — read fresh per request (`supervisor.fleet_status`), so a
+    health probe sees training-fleet trouble from the serving side."""
     app = _make_app(bundle_dir, coalesce=coalesce)
 
     class Handler(BaseHTTPRequestHandler):
@@ -324,11 +332,14 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(
-                    200, {"status": "ok", "bundle": app.bundle_dir,
-                          "kind": app.kind, "signature": app.signature,
-                          "stats": dict(app.stats)}
-                )
+                payload = {"status": "ok", "bundle": app.bundle_dir,
+                           "kind": app.kind, "signature": app.signature,
+                           "stats": dict(app.stats)}
+                if fleet_journal is not None:
+                    from horovod_tpu.launch.supervisor import fleet_status
+
+                    payload["fleet"] = fleet_status(fleet_journal)
+                self._send(200, payload)
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -394,8 +405,10 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
     return server
 
 
-def serve_forever(bundle_dir: str, port: int = 8000, host: str = "0.0.0.0"):
-    server = make_server(bundle_dir, port=port, host=host)
+def serve_forever(bundle_dir: str, port: int = 8000, host: str = "0.0.0.0",
+                  fleet_journal: str | None = None):
+    server = make_server(bundle_dir, port=port, host=host,
+                         fleet_journal=fleet_journal)
     inputs = server.app.signature["inputs"]
     shape = next(iter(inputs.values()))["shape"]
     print(
@@ -420,8 +433,15 @@ def main(argv=None) -> None:
     )
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--host", default="0.0.0.0")
+    p.add_argument(
+        "--fleet-journal", default=None, metavar="PATH",
+        help="supervisor restart/rescale journal (restarts.jsonl); adds a "
+        "'fleet' section to GET /healthz — generation, size, "
+        "restart/shrink/grow counts, recent events",
+    )
     args = p.parse_args(argv)
-    serve_forever(args.bundle_dir, port=args.port, host=args.host)
+    serve_forever(args.bundle_dir, port=args.port, host=args.host,
+                  fleet_journal=args.fleet_journal)
 
 
 if __name__ == "__main__":

@@ -436,6 +436,395 @@ def supervise_local(
     )
 
 
+@dataclasses.dataclass
+class ElasticPolicy:
+    """Knobs for `supervise_elastic` (CLI: ``--elastic --min-ranks/
+    --max-ranks``; YAML: the job's ``elastic:`` block).
+
+    The fleet shrinks to survivors on a clean departure (down to
+    ``min_ranks``) and grows back as replacements join (up to
+    ``max_ranks``). ``rendezvous_timeout`` bounds how long a rendezvous
+    round waits for a member that will never arrive."""
+
+    min_ranks: int = 1
+    max_ranks: int | None = None
+    rendezvous_timeout: float = 60.0
+
+    @classmethod
+    def from_mapping(cls, mapping) -> "ElasticPolicy":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(mapping) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown elastic policy keys {sorted(unknown)}; "
+                f"valid: {sorted(fields)}"
+            )
+        policy = cls()
+        for key, value in mapping.items():
+            if value is None:
+                continue
+            setattr(
+                policy, key,
+                float(value) if key == "rendezvous_timeout" else int(value),
+            )
+        return policy
+
+
+def _spawn_member_local(argv, env, member_id, slot, tag_output=True):
+    """One elastic member as a local subprocess (the per-rank unit the
+    elastic supervisor restarts — contrast `launcher.start_local`, which
+    only knows whole fleets)."""
+    import subprocess
+
+    from horovod_tpu.runtime import ENV_ELASTIC_MEMBER, ENV_LOCAL_RANK
+
+    child_env = dict(os.environ)
+    child_env.update(env or {})
+    child_env[ENV_ELASTIC_MEMBER] = member_id
+    child_env[ENV_LOCAL_RANK] = str(slot)
+    proc = subprocess.Popen(
+        argv,
+        env=child_env,
+        stdout=subprocess.PIPE if tag_output else None,
+        stderr=subprocess.STDOUT if tag_output else None,
+        text=tag_output,
+    )
+    if tag_output:
+        launcher._stream(proc, member_id)
+    return proc
+
+
+def supervise_elastic(
+    nprocs: int,
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    policy: RestartPolicy | None = None,
+    elastic: ElasticPolicy | None = None,
+    *,
+    model_dir: str | None = None,
+    log_path: str | None = None,
+    coordinator_host: str = "127.0.0.1",
+    sync_port_base: int | None = None,
+    spawn=None,
+    tag_output: bool = True,
+    sleep=time.sleep,
+    verbose: bool = True,
+    poll_interval: float = 0.1,
+) -> int:
+    """Elastic launch-and-supervise loop: continue-through-failure.
+
+    Where `supervise` can only kill-and-relaunch the WHOLE fleet, this
+    mode owns a rendezvous `Coordinator` and supervises members
+    individually:
+
+    * a member that LEAVES cleanly (scheduler SIGTERM honored by the
+      elastic callback, the ``leave`` fault kind, exit 143) shrinks the
+      fleet in place — survivors re-rendezvous at the next commit
+      boundary and keep training from committed state, their processes
+      untouched;
+    * a replacement is spawned (budget and backoff permitting) and the
+      fleet GROWS back when it joins;
+    * a member that dies hard (crash/SIGKILL) is marked dead — the jax
+      coordination service tears the peers of that generation down with
+      it (a collective with a dead rank cannot be aborted), so hard
+      faults escalate to per-rank restarts: every dead member is
+      respawned, rejoins, and restores from the last checkpoint (the
+      `ElasticState` fallback path);
+    * a member whose TCP beats go stale (`Coordinator.stale_members` —
+      no shared filesystem needed, the pod-mode answer) is killed and
+      treated as a hang.
+
+    The restart budget/backoff semantics are `RestartPolicy`'s,
+    progress-aware over ``model_dir``: replacements stop being spawned
+    once the no-progress budget is spent — the fleet then simply stays
+    shrunken while it still clears ``min_ranks``, and only fails once it
+    cannot. Every membership/rescale event lands in the JSONL journal,
+    generation-tagged, CI-gateable (``shrink=1..N --aggregate count``)
+    and servable (`fleet_status`, the /healthz ``fleet`` section)."""
+    from horovod_tpu.elastic.coordinator import Coordinator
+    from horovod_tpu.runtime import ENV_ELASTIC_COORDINATOR
+
+    policy = policy or RestartPolicy()
+    elastic = elastic or ElasticPolicy()
+    max_ranks = elastic.max_ranks or nprocs
+    env, model_dir, _, log_path = _resolve_dirs(
+        dict(env or {}), model_dir, None,
+        log_path, RestartPolicy(heartbeat_timeout=None),
+    )
+    log = RestartLog(log_path)
+    log.touch()
+    coord = Coordinator(
+        host=coordinator_host,
+        min_ranks=elastic.min_ranks,
+        max_ranks=max_ranks,
+        expected=min(nprocs, max_ranks),
+        rendezvous_timeout=elastic.rendezvous_timeout,
+        sync_port_base=sync_port_base,
+        journal=log.write,
+    ).start()
+    env[ENV_ELASTIC_COORDINATOR] = coord.address
+    if spawn is None:
+        spawn = lambda member_id, slot: _spawn_member_local(  # noqa: E731
+            argv, env, member_id, slot, tag_output=tag_output
+        )
+
+    members: dict[str, dict] = {}   # live procs: id -> {proc, slot, spawned}
+    seq = 0
+
+    def launch(slot: int):
+        nonlocal seq
+        member_id = f"m{seq}"
+        seq += 1
+        members[member_id] = {
+            "proc": spawn(member_id, slot), "slot": slot,
+            "spawned": time.monotonic(),
+        }
+        return member_id
+
+    marker = newest_checkpoint_marker(model_dir)
+    restarts_used = 0
+    total_restarts = 0
+    backoff = policy.backoff
+    hang_killed: set[str] = set()
+    respawn_queue: list[tuple[float, int]] = []  # (due, slot)
+    job_done = False
+    done_since: float | None = None
+    last_failure = 1
+    startup_timeout = (
+        policy.startup_timeout
+        if policy.startup_timeout is not None
+        else (10.0 * policy.heartbeat_timeout
+              if policy.heartbeat_timeout is not None else None)
+    )
+
+    def teardown(code: int) -> int:
+        for rec in members.values():
+            if rec["proc"].poll() is None:
+                rec["proc"].terminate()
+        deadline = time.monotonic() + policy.grace_seconds
+        for rec in members.values():
+            p = rec["proc"]
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        coord.stop()
+        return code
+
+    try:
+        for slot in range(min(nprocs, max_ranks)):
+            launch(slot)
+        while True:
+            now = time.monotonic()
+            # --- reap exits -------------------------------------------------
+            for member_id in list(members):
+                rec = members[member_id]
+                code = rec["proc"].poll()
+                if code is None:
+                    continue
+                del members[member_id]
+                status, reason = coord.member_status(member_id)
+                if status == "left" and reason == "done":
+                    job_done = True
+                    continue
+                if code == 0:
+                    # Finished without the leave handshake (a non-elastic
+                    # script, or the coordinator raced teardown): still a
+                    # success signal; unblock any pending rendezvous.
+                    job_done = True
+                    coord.mark_dead(member_id, reason="exit0-no-leave")
+                    continue
+                if status == "left":
+                    # Planned departure (preemption/leave): the coordinator
+                    # already journaled the leave and survivors shrink in
+                    # place. Grow back with a replacement.
+                    kind = "leave"
+                else:
+                    kind = "hang" if member_id in hang_killed else classify(
+                        code
+                    )
+                    coord.mark_dead(member_id, reason=kind)
+                    last_failure = code if code else 1
+                if not job_done:
+                    new_marker = newest_checkpoint_marker(model_dir)
+                    progressed = (
+                        model_dir is not None and new_marker != marker
+                    )
+                    marker = new_marker
+                    if progressed:
+                        restarts_used = 0
+                        backoff = policy.backoff
+                    if restarts_used >= policy.max_restarts:
+                        log.write(
+                            "supervisor_gave_up", 1.0, member=member_id,
+                            kind=kind, exit_code=code,
+                            generation=coord.generation,
+                            restarts=total_restarts,
+                        )
+                        if verbose:
+                            print(
+                                f"supervisor: not replacing {member_id} "
+                                f"({kind}, exit {code}) — no-progress "
+                                f"budget spent after {total_restarts} "
+                                "restart(s)"
+                            )
+                        continue
+                    restarts_used += 1
+                    total_restarts += 1
+                    log.write(
+                        "restarts", float(total_restarts),
+                        member=member_id, kind=kind, exit_code=code,
+                        progressed=progressed, backoff_s=backoff,
+                        generation=coord.generation,
+                    )
+                    if verbose:
+                        print(
+                            f"supervisor: {member_id} {kind} (exit {code}) "
+                            f"— replacement in {backoff:.1f}s "
+                            f"(restart {total_restarts})"
+                        )
+                    respawn_queue.append((now + backoff, rec["slot"]))
+                    backoff = min(
+                        backoff * policy.backoff_factor, policy.backoff_max
+                    )
+            def soft_kill(rec):
+                """First pass SIGTERMs; `terminated_at` arms the escalation
+                below. A wedged member ignores SIGTERM by construction —
+                the elastic callback installs a flag-only handler during
+                fit, and a rank stuck in a native collective or the `hang`
+                fault's sleep never reaches a teardown path — so without
+                the SIGKILL escalation it would never be reaped and the
+                fleet would wait on it forever."""
+                if "terminated_at" not in rec:
+                    rec["terminated_at"] = now
+                    rec["proc"].terminate()
+
+            # --- hang detection over TCP beats ------------------------------
+            if policy.heartbeat_timeout is not None:
+                for member_id in coord.stale_members(
+                    policy.heartbeat_timeout
+                ):
+                    rec = members.get(member_id)
+                    if rec is not None and rec["proc"].poll() is None:
+                        hang_killed.add(member_id)
+                        soft_kill(rec)
+            if startup_timeout is not None:
+                for member_id, rec in members.items():
+                    if (
+                        rec["proc"].poll() is None
+                        and coord.member_status(member_id)[0] == "unknown"
+                        and now - rec["spawned"] > startup_timeout
+                    ):
+                        hang_killed.add(member_id)
+                        soft_kill(rec)
+            for rec in members.values():
+                if (
+                    rec.get("terminated_at") is not None
+                    and rec["proc"].poll() is None
+                    and now - rec["terminated_at"] > policy.grace_seconds
+                ):
+                    rec["proc"].kill()
+            # --- grow back --------------------------------------------------
+            if not job_done:
+                due = [r for r in respawn_queue if r[0] <= now]
+                respawn_queue = [r for r in respawn_queue if r[0] > now]
+                for _, slot in due:
+                    if coord.live_count() + sum(
+                        1 for m in members
+                        if coord.member_status(m)[0] == "unknown"
+                    ) < max_ranks:
+                        launch(slot)
+            # --- end states -------------------------------------------------
+            if job_done and members:
+                # Training is complete; peers get a grace window to finish
+                # their own clean leave, then any straggler (typically a
+                # replacement parked in a rendezvous that can never settle)
+                # is terminated rather than waited out.
+                if done_since is None:
+                    done_since = now
+                elif now - done_since > policy.grace_seconds:
+                    for rec in members.values():
+                        if rec["proc"].poll() is None:
+                            soft_kill(rec)  # escalates to kill() above
+            if job_done and not members:
+                if verbose and total_restarts:
+                    print(
+                        f"supervisor: training complete after "
+                        f"{total_restarts} per-rank restart(s)"
+                    )
+                return teardown(0)
+            if not members and not respawn_queue:
+                if verbose:
+                    print(
+                        f"supervisor: fleet extinct (last failure "
+                        f"{last_failure}) after {total_restarts} restart(s)"
+                    )
+                return teardown(shell_code(last_failure) or 1)
+            if (
+                not job_done
+                and not respawn_queue
+                and coord.live_count() < elastic.min_ranks
+                and all(
+                    coord.member_status(m)[0] != "unknown" for m in members
+                )
+                and restarts_used >= policy.max_restarts
+            ):
+                if verbose:
+                    print(
+                        f"supervisor: live ranks below min_ranks="
+                        f"{elastic.min_ranks} with the restart budget "
+                        "spent — giving up"
+                    )
+                return teardown(shell_code(last_failure) or 1)
+            sleep(poll_interval)
+    except BaseException:
+        teardown(1)
+        raise
+
+
+def fleet_status(journal_path: str | None, events: int = 8) -> dict:
+    """Summarize a supervisor journal for serving/health surfaces: current
+    generation/size (from the last settle record), restart/shrink/grow
+    counts, and the trailing events. Tolerant of torn lines and of a
+    missing file (a fleet that never ran restarts supervised)."""
+    status: dict = {
+        "journal": journal_path, "generation": None, "size": None,
+        "restarts": 0, "shrinks": 0, "grows": 0, "events": [],
+    }
+    if not journal_path or not os.path.exists(journal_path):
+        status["error"] = "journal not found"
+        return status
+    records = []
+    with open(journal_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail mid-append
+    for rec in records:
+        name = rec.get("name")
+        if name in ("start", "shrink", "grow", "steady"):
+            status["generation"] = rec.get("generation")
+            status["size"] = rec.get("size")
+        if name == "restarts":
+            status["restarts"] = int(rec.get("value", 0))
+        elif name == "shrink":
+            status["shrinks"] += 1
+        elif name == "grow":
+            status["grows"] += 1
+    status["events"] = [
+        {k: r.get(k) for k in
+         ("name", "kind", "member", "generation", "size", "wall_time")
+         if k in r}
+        for r in records[-events:]
+    ]
+    return status
+
+
 def supervise_hosts(
     hosts: list[str],
     argv: list[str],
@@ -469,6 +858,23 @@ def supervise_hosts(
       provisioner that sweeps orphans (ROADMAP follow-up: coordinator-side
       TCP heartbeats + remote kill)."""
     policy = policy or RestartPolicy()
+    if (
+        policy.heartbeat_timeout is not None
+        and heartbeat_dir is None
+        and default_model_dir(env) is None
+    ):
+        # Without a model dir (or an explicit heartbeat dir) the heartbeat
+        # dir falls back to a LAUNCHER-LOCAL tmpdir that remote ranks can
+        # never write — hang detection would silently never fire. Fail
+        # fast with the fix (satellite of the elastic ISSUE).
+        raise ValueError(
+            "pod-mode hang detection (--heartbeat-timeout) needs a "
+            "heartbeat dir on a filesystem shared with every host: set "
+            "PS_MODEL_PATH to a shared mount (NFS/GCS-fuse) or pass "
+            "heartbeat_dir= explicitly — or use --elastic, whose "
+            "heartbeats ride the rendezvous TCP socket and need no "
+            "shared filesystem"
+        )
     env, model_dir, heartbeat_dir, log_path = _resolve_dirs(
         env, model_dir, heartbeat_dir, log_path, policy
     )
@@ -488,4 +894,68 @@ def supervise_hosts(
         heartbeat_dir=heartbeat_dir,
         log_path=log_path,
         sleep=sleep,
+    )
+
+
+def supervise_elastic_hosts(
+    hosts: list[str],
+    argv: list[str],
+    env: dict[str, str] | None = None,
+    policy: RestartPolicy | None = None,
+    elastic: ElasticPolicy | None = None,
+    *,
+    sync_port_base: int = 9981,
+    workdir: str | None = None,
+    model_dir: str | None = None,
+    log_path: str | None = None,
+    ssh_args: tuple[str, ...] = ("-o", "StrictHostKeyChecking=no"),
+    sleep=time.sleep,
+    verbose: bool = True,
+) -> int:
+    """`supervise_elastic` over ssh — one member per host, the ``hvt-launch
+    pod --elastic`` path. Each member (and each replacement, respawned onto
+    the SAME host) is one ssh client; heartbeats are TCP beats to the
+    launcher-side coordinator, so no shared filesystem is needed for hang
+    detection (the `supervise_hosts` caveat this mode exists to remove).
+    Progress detection over ``model_dir`` still reads the LAUNCHER's
+    filesystem — without a shared mount the restart budget bounds total
+    restarts, exactly as in `supervise_hosts`. The jax.distributed port
+    rotates with the generation (``sync_port_base + generation``) so an
+    orphan holding an old port cannot wedge the next world."""
+    import shlex as shlex_lib
+    import socket as socket_lib
+    import subprocess
+
+    from horovod_tpu.runtime import ENV_ELASTIC_MEMBER, ENV_LOCAL_RANK
+
+    env = dict(env or {})
+
+    def spawn(member_id: str, slot: int):
+        host = hosts[slot % len(hosts)]
+        remote_env = {
+            ENV_ELASTIC_MEMBER: member_id,
+            ENV_LOCAL_RANK: "0",
+            **env,
+        }
+        exports = " ".join(
+            f"{k}={shlex_lib.quote(v)}" for k, v in remote_env.items()
+        )
+        cd = f"cd {shlex_lib.quote(workdir)} && " if workdir else ""
+        remote_cmd = (
+            f"{cd}{exports} "
+            f"{' '.join(shlex_lib.quote(a) for a in argv)}"
+        )
+        proc = subprocess.Popen(
+            ["ssh", *ssh_args, host, remote_cmd],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        launcher._stream(proc, f"{host}/{member_id}")
+        return proc
+
+    return supervise_elastic(
+        len(hosts), argv, env=env, policy=policy, elastic=elastic,
+        model_dir=model_dir, log_path=log_path,
+        coordinator_host=socket_lib.gethostname(),
+        sync_port_base=sync_port_base, spawn=spawn, sleep=sleep,
+        verbose=verbose,
     )

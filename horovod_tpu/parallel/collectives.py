@@ -118,28 +118,103 @@ def broadcast_pytree(tree: PyTree, root: int = 0, axis_name=None) -> PyTree:
     over an arbitrary pytree (model params AND optimizer state; the reference
     broadcasts both, SURVEY.md §7.3)."""
     if axis_name is None and jax.process_count() > 1:
-        # One fused host-level broadcast for the whole tree.
+        if _kv_client() is not None:
+            # One fused host-level broadcast over the coordination-service
+            # KV store (see _kv_client for why it replaces the psum path).
+            # Only the ROOT's tree travels — non-root copies are replaced
+            # wholesale, so their device→host fetch would be pure waste.
+            return broadcast_object(
+                jax.device_get(tree)
+                if jax.process_index() == root else None,
+                root=root,
+            )
         return multihost_utils.broadcast_one_to_all(
             tree, is_source=jax.process_index() == root
         )
     return jax.tree.map(lambda x: broadcast(x, root=root, axis_name=axis_name), tree)
 
 
+# --- host-level object collectives over the coordination-service KV store --
+#
+# Why not ride broadcast_one_to_all/process_allgather for these? Their
+# device path (zero-stack + psum over a 'processes' axis) is observed to be
+# UNRELIABLE on this repo's compat floor (jax 0.4.x + gloo CPU collectives:
+# nondeterministic all-zero results for host-staged buffers), and object
+# movement is control-plane work anyway. jax's distributed runtime carries a
+# key-value store on the coordination service — the exact channel gloo uses
+# to bootstrap itself — and a blocking KV get is deterministic: set-then-get
+# is the broadcast, set-all-then-get-all is the allgather. Keys are
+# sequenced per client connection, which is correct under the collective
+# calling discipline (every process makes the same sequence of collective
+# calls against a given world — the same contract the array collectives
+# already require); an elastic rescale swaps the client (fresh service,
+# fresh namespace), resetting the sequence on every process together.
+
+_KV_CHUNK = 2 * 1024 * 1024  # stay clear of gRPC's default 4 MB message cap
+_KV_TIMEOUT_MS = 600_000
+_kv_seq = {"client": None, "n": 0}
+
+
+def _kv_client():
+    """The live coordination-service client, or None (no distributed init —
+    single-process, or a backend brought up without jax.distributed)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except ImportError:  # pragma: no cover — future jax moved the module
+        return None
+
+
+def _kv_next(tag: str) -> str:
+    client = _kv_client()
+    if client is not _kv_seq["client"]:
+        _kv_seq["client"] = client
+        _kv_seq["n"] = 0
+    _kv_seq["n"] += 1
+    return f"hvt/{tag}/{_kv_seq['n']}"
+
+
+def _kv_put(client, key: str, payload: bytes) -> None:
+    chunks = [
+        payload[i : i + _KV_CHUNK]
+        for i in range(0, len(payload), _KV_CHUNK)
+    ] or [b""]
+    for i, chunk in enumerate(chunks):
+        client.key_value_set_bytes(f"{key}/c{i}", chunk)
+    # Meta lands LAST: a reader that sees it knows every chunk is in place.
+    client.key_value_set(f"{key}/meta", str(len(chunks)))
+
+
+def _kv_get(client, key: str) -> bytes:
+    n = int(client.blocking_key_value_get(f"{key}/meta", _KV_TIMEOUT_MS))
+    return b"".join(
+        client.blocking_key_value_get_bytes(f"{key}/c{i}", _KV_TIMEOUT_MS)
+        for i in range(n)
+    )
+
+
 def broadcast_object(obj, root: int = 0):
     """``hvd.broadcast_object``: every process adopts the root's arbitrary
-    picklable Python object (config dicts, vocabularies, epoch counters —
-    the host-side metadata Horovod moves alongside tensors). Pickle bytes
-    travel over ONE fused host-level broadcast; ``process_count()==1`` is
-    the identity, like every collective here."""
+    picklable Python object (config dicts, vocabularies, epoch counters,
+    committed elastic state — the host-side metadata Horovod moves
+    alongside tensors). Travels over the coordination-service KV store
+    (see above); ``process_count()==1`` is the identity, like every
+    collective here."""
     import pickle
 
     import numpy as np
 
     if jax.process_count() == 1:
         return obj
+    client = _kv_client()
+    if client is not None:
+        key = _kv_next("bcast")
+        if jax.process_index() == root:
+            _kv_put(client, key, pickle.dumps(obj))
+        return pickle.loads(_kv_get(client, key))
+    # Fallback (no distributed client): the fixed-width array broadcast.
     payload = pickle.dumps(obj) if jax.process_index() == root else b""
-    # Fixed-width header then the padded body: broadcast_one_to_all needs
-    # identical shapes on every process.
     n = int(
         multihost_utils.broadcast_one_to_all(
             np.int64(len(payload)), is_source=jax.process_index() == root
@@ -156,13 +231,22 @@ def broadcast_object(obj, root: int = 0):
 
 def allgather_object(obj) -> list:
     """``hvd.allgather_object``: every process receives the list of all
-    processes' picklable objects, ordered by process index."""
+    processes' picklable objects, ordered by process index. KV-store
+    transport (set mine, read everyone's), like `broadcast_object`."""
     import pickle
 
     import numpy as np
 
     if jax.process_count() == 1:
         return [obj]
+    client = _kv_client()
+    if client is not None:
+        key = _kv_next("gather")
+        _kv_put(client, f"{key}/r{jax.process_index()}", pickle.dumps(obj))
+        return [
+            pickle.loads(_kv_get(client, f"{key}/r{r}"))
+            for r in range(jax.process_count())
+        ]
     payload = np.frombuffer(pickle.dumps(obj), np.uint8)
     sizes = multihost_utils.process_allgather(np.int64(len(payload)))
     width = int(np.max(sizes))

@@ -62,6 +62,13 @@ ENV_NUM_CPU_DEVICES = "HVT_NUM_CPU_DEVICES"
 # kills and relaunches a fleet whose newest beat goes stale. Examples need
 # no changes — the supervisor exports the variable, fit() reacts.
 ENV_HEARTBEAT_DIR = "HVT_HEARTBEAT_DIR"
+# Elastic rendezvous (horovod_tpu.elastic): the supervisor's coordinator
+# address ("host:port") and this process's stable member identity. Set by
+# `hvt-launch run/pod --elastic`; consumed by `elastic.run`, NOT by init()
+# — in elastic mode the world (size/rank/jax coordinator) comes from a
+# rendezvous round, not from static env assignment.
+ENV_ELASTIC_COORDINATOR = "HVT_ELASTIC_COORDINATOR"
+ENV_ELASTIC_MEMBER = "HVT_ELASTIC_MEMBER"
 
 _initialized = False
 
@@ -179,15 +186,48 @@ def init(
 
 
 def shutdown() -> None:
-    """Tear down the distributed runtime (no-op if single-process)."""
+    """Tear down the distributed runtime (no-op if single-process).
+
+    In a multi-process world this is a BARRIER: every process must call it
+    at the same point, or the coordination service flags the stragglers'
+    disconnect as a fatal error and terminates the survivors (see
+    `compat.distributed_shutdown_barrier`). The elastic rescale path calls
+    it from the membership-change agreement, where lockstep is guaranteed."""
     global _initialized
     if not _initialized:
         return
     try:
         if jax.process_count() > 1:
-            jax.distributed.shutdown()
+            from horovod_tpu import compat
+
+            compat.distributed_shutdown_barrier()
     finally:
         _initialized = False
+
+
+def reinit(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> World:
+    """Tear down whatever runtime exists and initialize at a (possibly
+    different) world size — the elastic rescale primitive.
+
+    Sequence: synchronized distributed shutdown (if a world is up — all
+    processes of the OLD world must arrive here together), then backend
+    drop (old executables/arrays were compiled against the old collective
+    world and are invalid — hold host copies, the `ElasticState.commit`
+    contract), then a fresh `init` at the new size. With no coordinator
+    the result is the bare single-process mode — a fleet shrunk to one
+    survivor keeps training with every collective degraded to a local op."""
+    global _initialized
+    from horovod_tpu import compat
+
+    shutdown()
+    compat.reset_distributed_state()  # idempotent; covers a torn shutdown
+    compat.clear_backends()
+    _initialized = False
+    return init(coordinator_address, num_processes, process_id)
 
 
 def is_initialized() -> bool:

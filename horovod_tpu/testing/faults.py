@@ -15,6 +15,16 @@ example/entry script is injectable unmodified). Kinds:
 * ``hang``  — stop making progress while staying alive: the wedged-collective
   failure mode (arXiv:1810.11112) that produces no exit code and is only
   detectable via stale heartbeats.
+* ``leave`` — clean SIGTERM-style self-removal: the planned-departure shape
+  (scheduler preemption honored gracefully, elastic shrink testing). Under
+  an elastic launch (``HVT_ELASTIC_COORDINATOR`` set) it only RECORDS leave
+  intent (`request_leave`); the elastic callback then executes the
+  departure at the epoch boundary — coordinator notified, synchronized
+  teardown, exit 143 — so survivors shrink instead of aborting. Outside
+  elastic mode it degrades to a SIGTERM to self: with
+  `PreemptionCheckpointCallback` installed that is the graceful save-and-
+  stop path, without it the process dies of SIGTERM and the supervisor
+  classifies a preemption.
 
 The fault fires at the first ``on_batch_end`` of the target epoch — mid-epoch
 by construction (after the epoch's checkpoint boundary, before the next), so
@@ -41,7 +51,27 @@ from horovod_tpu.training.callbacks import Callback
 ENV_FAULT = "HVT_FAULT"
 ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
 
-KINDS = ("kill", "hang")  # plus exitN, validated in parse_plan
+KINDS = ("kill", "hang", "leave")  # plus exitN, validated in parse_plan
+
+# Process-wide leave intent (the `leave` fault kind under an elastic
+# launch). The elastic epoch-end agreement consumes it; tests reset it.
+_leave_requested = False
+
+
+def request_leave() -> None:
+    """Record that this process should leave the fleet at the next elastic
+    commit boundary (consumed by `elastic.ElasticStateCallback`)."""
+    global _leave_requested
+    _leave_requested = True
+
+
+def leave_requested() -> bool:
+    return _leave_requested
+
+
+def reset_leave() -> None:
+    global _leave_requested
+    _leave_requested = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,7 +114,8 @@ def parse_plan(spec: str) -> FaultPlan:
                 ) from None
         else:
             raise ValueError(
-                f"HVT_FAULT kind must be kill, hang or exitN, got {kind!r}"
+                f"HVT_FAULT kind must be kill, hang, leave or exitN, "
+                f"got {kind!r}"
             )
     return FaultPlan(rank=rank, epoch=epoch, kind=kind)
 
@@ -137,5 +168,12 @@ class FaultInjectionCallback(Callback):
             # stale-heartbeat supervisor can reap this.
             while True:
                 time.sleep(3600)
+        elif self.plan.kind == "leave":
+            if os.environ.get(runtime.ENV_ELASTIC_COORDINATOR):
+                # Elastic launch: record intent; the elastic callback
+                # executes the clean departure at the epoch boundary.
+                request_leave()
+            else:
+                os.kill(os.getpid(), signal.SIGTERM)
         else:
             os._exit(self.plan.exit_code)

@@ -56,6 +56,18 @@ class Callback:
         pass
 
 
+def agree_any(flag: bool) -> bool:
+    """Cross-process agreement on a local boolean: True on ANY process →
+    True on EVERY process. Entered by every process at the same point (it
+    is a collective), so the whole fleet takes the same branch regardless
+    of which processes observed the local condition — the pattern behind
+    `PreemptionCheckpointCallback`'s signal agreement and the elastic
+    membership agreement (`horovod_tpu.elastic.ElasticStateCallback`)."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    return any(collectives.allgather_object(bool(flag)))
+
+
 class BroadcastGlobalVariablesCallback(Callback):
     """Broadcast the full TrainState (params AND optimizer state — the
     reference's 'global variables' include optimizer slots, SURVEY.md §7.3)
@@ -299,12 +311,10 @@ class PreemptionCheckpointCallback(Callback):
         self._hit = True
 
     def on_epoch_end(self, epoch: int, logs=None):
-        hit = self._hit
-        if jax.process_count() > 1:
-            # Collective agreement — entered by every process every epoch,
-            # so the fleet takes the same branch regardless of which
-            # processes the signal has reached so far.
-            hit = any(collectives.allgather_object(hit))
+        # Collective agreement — entered by every process every epoch,
+        # so the fleet takes the same branch regardless of which
+        # processes the signal has reached so far.
+        hit = agree_any(self._hit)
         if not hit:
             return
         save_state(self.filepath, epoch, self.trainer.state)

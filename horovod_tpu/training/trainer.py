@@ -481,6 +481,36 @@ class Trainer:
         full contract (module-loss labels, TP/FSDP placement, ZeRO-1)."""
         return build_lib.build_state(self, sample_x, sample_y)
 
+    def install_state(self, host_state) -> TrainState:
+        """Adopt a host-side TrainState snapshot onto this trainer's mesh —
+        the elastic restore hook (`horovod_tpu.elastic.ElasticState`).
+
+        ``host_state`` must structurally match the built state (same
+        module/optimizer — the committed snapshot of a prior generation of
+        the SAME job); each array leaf is placed with the freshly built
+        leaf's sharding, so the snapshot follows whatever layout this
+        world's build chose (replicated pure-DP, ZeRO-1 shards, ...).
+        Call after `build()`; returns the installed state."""
+        if self.state is None:
+            raise RuntimeError("call build() before install_state()")
+
+        def place(host_leaf, built_leaf):
+            if isinstance(built_leaf, jax.Array):
+                arr = np.asarray(host_leaf)
+                if arr.shape != built_leaf.shape:
+                    raise ValueError(
+                        f"snapshot leaf shape {arr.shape} != built shape "
+                        f"{built_leaf.shape} — the committed state belongs "
+                        "to a different model configuration"
+                    )
+                return jax.device_put(
+                    arr.astype(built_leaf.dtype), built_leaf.sharding
+                )
+            return host_leaf
+
+        self.state = jax.tree.map(place, host_state, self.state)
+        return self.state
+
     # --- feeding / verbs — bodies live in training/feeding.py --------------
 
     def _shard(self, batch):

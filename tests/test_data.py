@@ -120,3 +120,64 @@ def test_genuine_npz_preempts_synthesis(tmp_cache):
     np.testing.assert_array_equal(ytr, real["y_train"])
     np.testing.assert_array_equal(xte, real["x_test"])
     np.testing.assert_array_equal(yte, real["y_test"])
+
+
+def test_loader_reshard_recuts_from_full_data():
+    """Elastic rescale hook: resharding N→N-1 re-derives the split from the
+    ORIGINAL arrays (not shard-of-shard), so the new world's shards again
+    partition every example — each example is seen at least once per epoch
+    after the shrink."""
+    x = np.arange(60)
+    shards3 = [ArrayDataset((x,)).shard(i, 3) for i in range(3)]
+    # Mid-stream: rank 2 left; ranks 0..1 recut to a 2-way split.
+    shards2 = [shards3[i].reshard(i, 2) for i in range(2)]
+    seen = set()
+    for ds in shards2:
+        seen.update(ds._arrays[0].tolist())
+    assert seen == set(range(60))  # full coverage at the new size
+    # Disjoint partition, not shard-of-shard (which could only ever see
+    # rank i's third of the data).
+    assert not set(shards2[0]._arrays[0]) & set(shards2[1]._arrays[0])
+    assert shards2[0].shard_spec == (0, 2)
+
+
+def test_loader_reshard_keeps_batch_geometry_static():
+    """Per-rank batch shapes stay static across a reshard: batch size and
+    drop_remainder carry over, so every batch is full-shape (the tail that
+    doesn't fill a batch is dropped, exactly as pre-shrink)."""
+    x = np.arange(61)  # deliberately indivisible
+    y = np.arange(61) * 2
+    ds = ArrayDataset((x, y)).shard(0, 3).batch(4)
+    pre = [b[0].shape for b in ds]
+    assert set(pre) == {(4,)}  # drop_remainder: full batches only
+    re = ds.reshard(0, 2)
+    post = list(re)
+    assert {b[0].shape for b in post} == {(4,)}
+    # 31 examples in shard 0-of-2 → 7 full batches, tail of 3 dropped.
+    assert len(post) == 31 // 4
+    for xb, yb in post:
+        np.testing.assert_array_equal(yb, xb * 2)  # rows stay aligned
+
+
+def test_loader_reshard_preserves_chain_config():
+    x = np.arange(40)
+    ds = ArrayDataset((x,)).shard(1, 4).repeat().shuffle(40, seed=5).batch(3)
+    re = ds.reshard(1, 2)
+    assert re._repeat and re._shuffle_buffer == 40
+    assert re._batch_size == 3
+    batches = re.take(8)  # crosses the shard-epoch boundary: repeat works
+    vals = set(np.concatenate([b[0] for b in batches]).tolist())
+    assert vals <= set(range(1, 40, 2))  # shard 1 of 2 — odd indices
+
+
+def test_loader_reshard_unsharded_and_bad_index():
+    import pytest
+
+    x = np.arange(8)
+    ds = ArrayDataset((x,))
+    # reshard on a never-sharded dataset behaves like shard().
+    np.testing.assert_array_equal(
+        ds.reshard(0, 2)._arrays[0], ds.shard(0, 2)._arrays[0]
+    )
+    with pytest.raises(ValueError, match="out of range"):
+        ds.reshard(2, 2)

@@ -1,0 +1,687 @@
+"""Elastic subsystem units (tier-1, no jax worlds): rendezvous coordinator
+protocol (join/sync/beat/leave/generation/settle/timeout), ElasticState
+commit/restore/progress, the ``leave`` fault kind, the supervisor's elastic
+loop driven by jax-free fake workers speaking the real TCP protocol, the
+journal summary behind /healthz, and the CLI/YAML wiring."""
+
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic.coordinator import (
+    Coordinator,
+    ElasticClient,
+    ElasticError,
+    WorldInfo,
+)
+from horovod_tpu.elastic.state import ElasticState, progress_marker
+from horovod_tpu.launch import ci_gate, launcher, supervisor
+from horovod_tpu.launch.supervisor import ElasticPolicy, RestartPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+
+def _journal(log_path):
+    with open(log_path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _sync_all(address, member_ids, progress=None, timeout=20.0):
+    """Drive one rendezvous round from N client threads; returns
+    {member_id: WorldInfo}."""
+    out, errs = {}, {}
+
+    def worker(mid):
+        try:
+            out[mid] = ElasticClient(address, mid).sync(
+                progress=(progress or {}).get(mid, -1)
+            )
+        except Exception as e:  # surfaced by the caller's assert
+            errs[mid] = e
+
+    threads = [
+        threading.Thread(target=worker, args=(m,)) for m in member_ids
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not errs, errs
+    assert len(out) == len(member_ids)
+    return out
+
+
+class TestCoordinator:
+    def test_first_round_settles_expected_members(self):
+        coord = Coordinator(expected=3, rendezvous_timeout=10.0).start()
+        try:
+            worlds = _sync_all(coord.address, ["a", "b", "c"])
+            assert sorted(w.rank for w in worlds.values()) == [0, 1, 2]
+            gens = {w.generation for w in worlds.values()}
+            ports = {w.jax_coordinator for w in worlds.values()}
+            assert len(gens) == 1 and len(ports) == 1
+            assert all(w.size == 3 for w in worlds.values())
+        finally:
+            coord.stop()
+
+    def test_leave_bumps_generation_and_next_round_shrinks(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        coord = Coordinator(
+            expected=2, rendezvous_timeout=10.0, journal=log.write
+        ).start()
+        try:
+            worlds = _sync_all(coord.address, ["a", "b"])
+            gen0 = worlds["a"].generation
+            ElasticClient(coord.address, "b").leave("test")
+            # Beats tell the survivor the world moved on.
+            assert ElasticClient(coord.address, "a").beat() > gen0
+            again = _sync_all(coord.address, ["a"])
+            assert again["a"].size == 1 and again["a"].rank == 0
+            # Size 1 = bare local mode: no jax coordinator to dial.
+            assert again["a"].jax_coordinator is None
+            names = [r["name"] for r in _journal(log.path)]
+            assert "start" in names and "leave" in names
+            assert "shrink" in names  # the settle after the leave
+        finally:
+            coord.stop()
+
+    def test_join_midflight_grows_next_round(self):
+        coord = Coordinator(expected=2, rendezvous_timeout=10.0).start()
+        try:
+            worlds = _sync_all(coord.address, ["a", "b"])
+            gen0 = worlds["a"].generation
+            # A third member starts syncing: blocks (a/b not waiting), but
+            # its JOIN bumps the generation immediately.
+            result = {}
+            t = threading.Thread(
+                target=lambda: result.update(
+                    c=ElasticClient(coord.address, "c").sync()
+                )
+            )
+            t.start()
+            deadline = time.monotonic() + 5
+            while (
+                ElasticClient(coord.address, "a").beat() == gen0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert ElasticClient(coord.address, "a").beat() > gen0
+            worlds2 = _sync_all(coord.address, ["a", "b"])
+            t.join(10)
+            assert result["c"].size == 3
+            assert worlds2["a"].generation == result["c"].generation
+            ranks = sorted(
+                [worlds2["a"].rank, worlds2["b"].rank, result["c"].rank]
+            )
+            assert ranks == [0, 1, 2]
+            # Survivors keep their relative order; the joiner is last.
+            assert result["c"].rank == 2
+        finally:
+            coord.stop()
+
+    def test_root_election_prefers_most_progress(self):
+        coord = Coordinator(expected=2, rendezvous_timeout=10.0).start()
+        try:
+            worlds = _sync_all(
+                coord.address, ["a", "b"],
+                progress={"a": progress_marker(1), "b": progress_marker(5)},
+            )
+            # Root is b (most committed progress), whatever rank it got.
+            assert worlds["a"].root_rank == worlds["b"].rank
+            assert worlds["a"].max_progress == progress_marker(5)
+        finally:
+            coord.stop()
+
+    def test_rendezvous_timeout_drops_laggard(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        coord = Coordinator(
+            expected=2, min_ranks=1, rendezvous_timeout=0.5,
+            journal=log.write,
+        ).start()
+        try:
+            # 'b' joins (known live) but never syncs again after round 1;
+            # 'a' re-rendezvous must not hang forever on it.
+            _sync_all(coord.address, ["a", "b"])
+            ElasticClient(coord.address, "a").beat()
+            world = ElasticClient(coord.address, "a").sync(timeout=30.0)
+            assert world.size == 1
+            dead = [r for r in _journal(log.path) if r["name"] == "dead"]
+            assert dead and dead[0]["member"] == "b"
+            assert dead[0]["reason"] == "rendezvous-timeout"
+        finally:
+            coord.stop()
+
+    def test_below_min_ranks_fails_loudly(self):
+        coord = Coordinator(
+            expected=1, min_ranks=2, rendezvous_timeout=0.4
+        ).start()
+        try:
+            with pytest.raises(ElasticError, match="below min_ranks"):
+                ElasticClient(coord.address, "a").sync(timeout=30.0)
+        finally:
+            coord.stop()
+
+    def test_world_full_rejected(self):
+        coord = Coordinator(
+            expected=1, max_ranks=1, rendezvous_timeout=5.0
+        ).start()
+        try:
+            _sync_all(coord.address, ["a"])
+            with pytest.raises(ElasticError, match="full"):
+                ElasticClient(coord.address, "b").sync(timeout=10.0)
+        finally:
+            coord.stop()
+
+    def test_stale_members_exempts_pending_sync(self):
+        coord = Coordinator(expected=1, rendezvous_timeout=10.0).start()
+        try:
+            _sync_all(coord.address, ["a"])
+            # Beat recorded at sync; ancient clock → stale.
+            assert coord.stale_members(
+                0.0, now=time.monotonic() + 100
+            ) == ["a"]
+            # A member parked in sync is alive by construction.
+            t = threading.Thread(
+                target=lambda: ElasticClient(coord.address, "b").sync()
+            )
+            t.start()
+            deadline = time.monotonic() + 5
+            while (
+                coord.member_status("b")[0] == "unknown"
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert "b" not in coord.stale_members(
+                0.0, now=time.monotonic() + 100
+            )
+            ElasticClient(coord.address, "a").sync()  # settle, release b
+            t.join(10)
+        finally:
+            coord.stop()
+
+    def test_snapshot_state_command(self):
+        coord = Coordinator(expected=1, rendezvous_timeout=5.0).start()
+        try:
+            _sync_all(coord.address, ["a"])
+            snap = ElasticClient(coord.address, "x").state()
+            assert snap["last_settle"]["size"] == 1
+            assert snap["members"]["a"]["status"] == "live"
+        finally:
+            coord.stop()
+
+
+class TestElasticState:
+    def test_commit_restore_roundtrip(self):
+        import numpy as np
+
+        s = ElasticState(state={"w": np.arange(4)}, epoch=0)
+        s.commit()
+        s.state = {"w": np.zeros(4)}
+        s.epoch = 7
+        s.restore()
+        np.testing.assert_array_equal(s.state["w"], np.arange(4))
+        assert s.epoch == 0
+
+    def test_restore_before_commit_keeps_initials(self):
+        s = ElasticState(epoch=3)
+        s.restore()
+        assert s.epoch == 3 and s.state is None
+
+    def test_progress_tracks_committed_not_live(self):
+        s = ElasticState(epoch=0)
+        assert s.progress == -1  # nothing committed yet
+        s.epoch = 4
+        s.commit()
+        s.epoch = 9  # live value moves on; progress stays committed
+        assert s.progress == progress_marker(4)
+
+    def test_extra_attrs_tracked(self):
+        s = ElasticState(epoch=0, lr=0.1)
+        s.commit()
+        s.lr = 99.0
+        s.restore()
+        assert s.lr == 0.1
+
+    def test_sync_single_process_is_restore(self):
+        s = ElasticState(epoch=2)
+        s.commit()
+        s.epoch = 5
+        s.sync(root_rank=0)
+        assert s.epoch == 2
+
+
+class TestLeaveFault:
+    def test_parse_leave(self):
+        from horovod_tpu.testing import faults
+
+        assert faults.parse_plan("2:1:leave").kind == "leave"
+
+    def test_leave_sets_flag_under_elastic_env(self, monkeypatch):
+        from horovod_tpu import runtime
+        from horovod_tpu.testing import faults
+
+        faults.reset_leave()
+        monkeypatch.setenv(runtime.ENV_ELASTIC_COORDINATOR, "127.0.0.1:1")
+        killed = []
+        monkeypatch.setattr(os, "kill", lambda *a: killed.append(a))
+        cb = faults.FaultInjectionCallback(faults.parse_plan("0:0:leave"))
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)
+        assert faults.leave_requested()
+        assert not killed  # elastic mode: intent only, no signal
+        faults.reset_leave()
+
+    def test_leave_degrades_to_sigterm_without_elastic(self, monkeypatch):
+        import signal
+
+        from horovod_tpu import runtime
+        from horovod_tpu.testing import faults
+
+        faults.reset_leave()
+        monkeypatch.delenv(runtime.ENV_ELASTIC_COORDINATOR, raising=False)
+        killed = []
+        monkeypatch.setattr(
+            os, "kill", lambda pid, sig: killed.append((pid, sig))
+        )
+        cb = faults.FaultInjectionCallback(faults.parse_plan("0:0:leave"))
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)
+        assert killed == [(os.getpid(), signal.SIGTERM)]
+        assert not faults.leave_requested()
+
+
+# Jax-free fake worker: speaks the real rendezvous WIRE protocol (sync →
+# paced "epochs" with beats → membership-change re-sync → done-leave), so
+# the supervisor's elastic loop is testable in seconds. The client is
+# inlined (same JSON-lines protocol ElasticClient speaks — which the
+# coordinator tests above drive through the real class) because importing
+# horovod_tpu pulls jax, and ~3s of import per spawned fake would dominate
+# tier-1 time. Behavior knobs via env: FAKE_EPOCHS/FAKE_PACE, FAKE_LEAVER
+# (member id that leaves after one epoch; one-shot via FAKE_STAMP; "ALL"
+# matches every member), FAKE_CRASHER (exits 7 instead), FAKE_WEDGER
+# (joins, then stops beating forever).
+FAKE_WORKER = """
+import json, os, socket, sys, time
+from types import SimpleNamespace
+
+member = os.environ["HVT_ELASTIC_MEMBER"]
+host, port = os.environ["HVT_ELASTIC_COORDINATOR"].rsplit(":", 1)
+
+
+class MiniClient:  # ElasticClient's wire protocol, import-free
+    def _call(self, **msg):
+        with socket.create_connection((host, int(port)), timeout=60) as s:
+            s.sendall(json.dumps(msg).encode() + b"\\n")
+            buf = b""
+            while not buf.endswith(b"\\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise OSError("closed")
+                buf += chunk
+        reply = json.loads(buf)
+        if "error" in reply:
+            raise SystemExit(f"coordinator error: {reply['error']}")
+        return reply
+
+    def sync(self, progress=-1):
+        r = self._call(cmd="sync", member=member, host="127.0.0.1",
+                       progress=progress)
+        return SimpleNamespace(
+            generation=r["generation"],
+            max_progress=r.get("max_progress", -1),
+        )
+
+    def beat(self, progress=None):
+        return self._call(cmd="beat", member=member,
+                          progress=progress)["generation"]
+
+    def leave(self, reason):
+        self._call(cmd="leave", member=member, reason=reason)
+
+
+client = MiniClient()
+epochs = int(os.environ.get("FAKE_EPOCHS", "4"))
+pace = float(os.environ.get("FAKE_PACE", "0.1"))
+stamp = os.environ.get("FAKE_STAMP")
+
+def fire_once(kind_env):
+    target = os.environ.get(kind_env)
+    if target not in (member, "ALL") or (stamp and os.path.exists(stamp)):
+        return False
+    if stamp:
+        open(stamp, "w").close()
+    return True
+
+epoch = 0
+while epoch < epochs:
+    world = client.sync(progress=epoch)
+    epoch = max(epoch, world.max_progress if world.max_progress > 0 else 0)
+    while epoch < epochs:
+        time.sleep(pace)
+        epoch += 1
+        if fire_once("FAKE_LEAVER"):
+            client.leave(reason="fake-leave")
+            sys.exit(143)
+        if fire_once("FAKE_CRASHER"):
+            sys.exit(7)
+        if fire_once("FAKE_WEDGER"):
+            # A real wedged rank traps SIGTERM (the elastic callback's
+            # flag-only handler) and never acts on it — only the
+            # supervisor's SIGKILL escalation can reap it.
+            import signal
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            time.sleep(3600)
+        if client.beat(progress=epoch) != world.generation:
+            break  # membership changed: re-rendezvous
+client.leave(reason="done")
+print(f"FAKE-DONE {member}", flush=True)
+"""
+
+
+def write_fake_worker(tmp_path):
+    path = tmp_path / "fake_worker.py"
+    path.write_text(textwrap.dedent(FAKE_WORKER))
+    return [sys.executable, str(path)]
+
+
+class TestSuperviseElastic:
+    def test_clean_completion_no_restarts(self, tmp_path, capfd):
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise_elastic(
+            2, argv, env={"FAKE_EPOCHS": "2"},
+            policy=RestartPolicy(max_restarts=2, backoff=0.0,
+                                 grace_seconds=5.0),
+            elastic=ElasticPolicy(min_ranks=1, rendezvous_timeout=20.0),
+            log_path=str(log),
+        )
+        assert code == 0
+        names = [r["name"] for r in _journal(log)]
+        assert "start" in names
+        assert "restarts" not in names
+        assert capfd.readouterr().out.count("FAKE-DONE") == 2
+
+    def test_leave_shrinks_then_replacement_grows(self, tmp_path, capfd):
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise_elastic(
+            3, argv,
+            env={
+                "FAKE_EPOCHS": "10", "FAKE_PACE": "0.2",
+                "FAKE_LEAVER": "m1", "FAKE_STAMP": str(tmp_path / "stamp"),
+            },
+            policy=RestartPolicy(max_restarts=3, backoff=0.1,
+                                 grace_seconds=5.0),
+            elastic=ElasticPolicy(min_ranks=2, max_ranks=3,
+                                  rendezvous_timeout=20.0),
+            log_path=str(log),
+        )
+        assert code == 0
+        records = _journal(log)
+        names = [r["name"] for r in records]
+        assert names.count("shrink") >= 1
+        assert names.count("grow") >= 1
+        # Order: start at 3 → shrink to 2 → grow back to 3.
+        sizes = [r["size"] for r in records
+                 if r["name"] in ("start", "shrink", "grow", "steady")]
+        assert sizes[0] == 3
+        assert 2 in sizes and sizes.index(2) < len(sizes) - 1 \
+            and 3 in sizes[sizes.index(2):]
+        # The replacement (m3) was spawned; the survivors were NOT
+        # respawned — exactly one restart journaled, for the leaver.
+        restarts = [r for r in records if r["name"] == "restarts"]
+        assert len(restarts) == 1
+        assert restarts[0]["kind"] == "leave"
+        assert restarts[0]["member"] == "m1"
+
+    def test_crash_respawned_with_budget(self, tmp_path):
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise_elastic(
+            2, argv,
+            env={
+                "FAKE_EPOCHS": "8", "FAKE_PACE": "0.2",
+                "FAKE_CRASHER": "m0", "FAKE_STAMP": str(tmp_path / "stamp"),
+            },
+            policy=RestartPolicy(max_restarts=3, backoff=0.1,
+                                 grace_seconds=5.0),
+            elastic=ElasticPolicy(min_ranks=1, max_ranks=2,
+                                  rendezvous_timeout=20.0),
+            log_path=str(log),
+        )
+        assert code == 0
+        records = _journal(log)
+        restarts = [r for r in records if r["name"] == "restarts"]
+        assert len(restarts) == 1
+        assert restarts[0]["kind"] == "crash"
+        assert restarts[0]["exit_code"] == 7
+        dead = [r for r in records if r["name"] == "dead"]
+        assert any(r["member"] == "m0" for r in dead)
+
+    def test_deterministic_crash_loop_gives_up_below_min(self, tmp_path):
+        """No stamp: every incarnation crashes before joining a settled
+        world twice... the budget spends and the supervisor exits with the
+        fault's code once the fleet cannot reach min_ranks."""
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise_elastic(
+            1, argv,
+            env={"FAKE_EPOCHS": "10", "FAKE_PACE": "0.05",
+                 "FAKE_CRASHER": "ALL"},
+            policy=RestartPolicy(max_restarts=2, backoff=0.0,
+                                 grace_seconds=5.0),
+            elastic=ElasticPolicy(min_ranks=1, rendezvous_timeout=5.0),
+            log_path=str(log),
+        )
+        assert code == 7
+        records = _journal(log)
+        assert any(r["name"] == "supervisor_gave_up" for r in records)
+
+    def test_tcp_beat_hang_detection_kills_and_replaces(self, tmp_path):
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise_elastic(
+            2, argv,
+            env={
+                # Long enough that the healthy member is still training
+                # when the wedge is detected (1.5s), SIGTERM is ignored,
+                # and the SIGKILL escalation (grace 1.0s) reaps it.
+                "FAKE_EPOCHS": "30", "FAKE_PACE": "0.25",
+                "FAKE_WEDGER": "m1", "FAKE_STAMP": str(tmp_path / "stamp"),
+            },
+            policy=RestartPolicy(max_restarts=3, backoff=0.1,
+                                 grace_seconds=1.0, heartbeat_timeout=1.5),
+            elastic=ElasticPolicy(min_ranks=1, max_ranks=2,
+                                  rendezvous_timeout=20.0),
+            log_path=str(log),
+        )
+        assert code == 0
+        restarts = [
+            r for r in _journal(log) if r["name"] == "restarts"
+        ]
+        assert len(restarts) == 1
+        assert restarts[0]["kind"] == "hang"
+        assert restarts[0]["member"] == "m1"
+
+    def test_journal_gateable_with_count(self, tmp_path):
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise_elastic(
+            3, argv,
+            env={
+                "FAKE_EPOCHS": "10", "FAKE_PACE": "0.2",
+                "FAKE_LEAVER": "m2", "FAKE_STAMP": str(tmp_path / "stamp"),
+            },
+            policy=RestartPolicy(max_restarts=3, backoff=0.1,
+                                 grace_seconds=5.0),
+            elastic=ElasticPolicy(min_ranks=2, max_ranks=3,
+                                  rendezvous_timeout=20.0),
+            log_path=str(log),
+        )
+        assert code == 0
+        # The CI-gate contract from the job spec: a shrink occurred.
+        ok, value = ci_gate.check_metrics(
+            str(log), "shrink", (1.0, 9.0), how="count"
+        )
+        assert ok and value >= 1.0
+
+
+class TestFleetStatus:
+    def test_summarizes_journal(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        log.write("start", 3.0, generation=3, size=3)
+        log.write("restarts", 1.0, member="m1", kind="leave", exit_code=143)
+        log.write("shrink", 2.0, generation=4, size=2)
+        log.write("grow", 3.0, generation=5, size=3)
+        status = supervisor.fleet_status(log.path)
+        assert status["generation"] == 5 and status["size"] == 3
+        assert status["restarts"] == 1
+        assert status["shrinks"] == 1 and status["grows"] == 1
+        assert [e["name"] for e in status["events"]] == [
+            "start", "restarts", "shrink", "grow"
+        ]
+
+    def test_missing_journal_is_soft(self, tmp_path):
+        status = supervisor.fleet_status(str(tmp_path / "nope.jsonl"))
+        assert status["error"] == "journal not found"
+        assert status["generation"] is None
+
+    def test_torn_line_tolerated(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        p.write_text(
+            json.dumps({"name": "start", "value": 2.0, "size": 2,
+                        "generation": 1}) + "\n" + '{"name": "sh'
+        )
+        assert supervisor.fleet_status(str(p))["size"] == 2
+
+
+class TestWiring:
+    def test_cli_elastic_flags_route_to_supervise_elastic(self, monkeypatch):
+        calls = {}
+
+        def fake(nprocs, command, env=None, policy=None, elastic=None,
+                 log_path=None):
+            calls.update(nprocs=nprocs, command=command, policy=policy,
+                         elastic=elastic)
+            return 0
+
+        monkeypatch.setattr(supervisor, "supervise_elastic", fake)
+        code = launcher.main([
+            "run", "--nprocs", "3", "--elastic", "--min-ranks", "2",
+            "--max-ranks", "3", "--max-restarts", "5",
+            "--", "python", "train.py",
+        ])
+        assert code == 0
+        assert calls["nprocs"] == 3
+        assert calls["elastic"].min_ranks == 2
+        assert calls["elastic"].max_ranks == 3
+        assert calls["policy"].max_restarts == 5
+
+    def test_cli_min_ranks_alone_opts_in(self, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(
+            supervisor, "supervise_elastic",
+            lambda *a, **k: seen.update(k) or 0,
+        )
+        assert launcher.main(
+            ["run", "--nprocs", "2", "--min-ranks", "1", "--", "x"]
+        ) == 0
+        assert seen["elastic"].min_ranks == 1
+
+    def test_pod_heartbeat_without_shared_fs_fails_fast(self, monkeypatch,
+                                                        capsys):
+        monkeypatch.delenv("PS_MODEL_PATH", raising=False)
+        with pytest.raises(SystemExit) as e:
+            launcher.main([
+                "pod", "--hosts", "h1,h2", "--heartbeat-timeout", "60",
+                "--", "python", "train.py",
+            ])
+        assert e.value.code == 2  # argparse error
+        err = capsys.readouterr().err
+        assert "--elastic" in err and "shared" in err
+
+    def test_pod_heartbeat_with_model_path_accepted(self, monkeypatch):
+        monkeypatch.setenv("PS_MODEL_PATH", "/tmp/shared")
+        seen = {}
+        monkeypatch.setattr(
+            supervisor, "supervise_hosts",
+            lambda *a, **k: seen.update(k) or 0,
+        )
+        assert launcher.main([
+            "pod", "--hosts", "h1,h2", "--heartbeat-timeout", "60",
+            "--", "python", "train.py",
+        ]) == 0
+        assert seen["policy"].heartbeat_timeout == 60.0
+
+    def test_supervise_hosts_raises_same_contract(self, monkeypatch):
+        monkeypatch.delenv("PS_MODEL_PATH", raising=False)
+        with pytest.raises(ValueError, match="--elastic"):
+            supervisor.supervise_hosts(
+                ["h1"], ["x"], env={},
+                policy=RestartPolicy(heartbeat_timeout=30.0),
+            )
+
+    def test_elastic_policy_mapping_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown elastic"):
+            ElasticPolicy.from_mapping({"min_rank": 2})
+        p = ElasticPolicy.from_mapping(
+            {"min_ranks": "2", "rendezvous_timeout": 30}
+        )
+        assert p.min_ranks == 2 and p.rendezvous_timeout == 30.0
+
+    def test_job_spec_elastic_block(self, tmp_path, monkeypatch):
+        from horovod_tpu.launch import job as job_lib
+
+        seen = {}
+        monkeypatch.setattr(
+            supervisor, "supervise_elastic",
+            lambda nprocs, argv, **k: seen.update(nprocs=nprocs, **k) or 0,
+        )
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent("""
+            name: elastic-test
+            job:
+              command: python train.py
+              nprocs: 3
+              elastic:
+                min_ranks: 2
+                max_ranks: 3
+              restart:
+                max_restarts: 4
+        """))
+        assert job_lib.run_job(str(spec)) == 0
+        assert seen["nprocs"] == 3
+        assert seen["elastic"].min_ranks == 2
+        assert seen["policy"].max_restarts == 4
+
+    def test_shipped_elastic_job_spec_parses(self):
+        import yaml
+
+        spec_path = os.path.join(
+            REPO, "horovod_tpu", "launch", "jobs",
+            "mnist-elastic-2proc.yaml",
+        )
+        with open(spec_path) as f:
+            spec = yaml.safe_load(f)
+        ElasticPolicy.from_mapping(spec["job"]["elastic"])
+        RestartPolicy.from_mapping(
+            {k: v for k, v in spec["job"]["restart"].items() if k != "log"}
+        )
+        from horovod_tpu.testing import faults
+
+        plan = faults.parse_plan(spec["job"]["env"]["HVT_FAULT"])
+        assert plan.kind == "leave"
+        assert spec["checks"]["loss"]["target"] == "0.0..0.3"
+
+
+class TestWorldInfo:
+    def test_from_wire_defaults(self):
+        w = WorldInfo.from_wire({"rank": 0, "size": 1, "generation": 2})
+        assert w.jax_coordinator is None
+        assert w.root_rank == 0 and w.max_progress == -1

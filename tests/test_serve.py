@@ -207,3 +207,49 @@ class TestCoalescing:
             assert srv.app.stats["device_calls"] == 1
         finally:
             srv.shutdown()
+
+
+def test_healthz_fleet_section_from_journal(bundle, tmp_path):
+    """`--fleet-journal` surfaces the supervisor's restart/rescale journal
+    in serving health: generation/size from the last settle, counts, and
+    the trailing events (the ROADMAP follow-up the elastic PR closes)."""
+    out, _, _ = bundle
+    journal = tmp_path / "restarts.jsonl"
+    with open(journal, "w") as f:
+        for rec in (
+            {"name": "start", "value": 3.0, "generation": 3, "size": 3},
+            {"name": "leave", "value": 1.0, "member": "m1", "generation": 4},
+            {"name": "shrink", "value": 2.0, "generation": 4, "size": 2},
+            {"name": "restarts", "value": 1.0, "member": "m1",
+             "kind": "leave"},
+            {"name": "grow", "value": 3.0, "generation": 5, "size": 3},
+        ):
+            f.write(json.dumps(rec) + "\n")
+    srv = make_server(out, port=0, fleet_journal=str(journal))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(_url(srv, "/healthz")) as r:
+            body = json.loads(r.read())
+        fleet = body["fleet"]
+        assert fleet["generation"] == 5 and fleet["size"] == 3
+        assert fleet["shrinks"] == 1 and fleet["grows"] == 1
+        assert fleet["restarts"] == 1
+        assert [e["name"] for e in fleet["events"]][-1] == "grow"
+        # Journal is read per request: a new event shows up live.
+        with open(journal, "a") as f:
+            f.write(json.dumps(
+                {"name": "shrink", "value": 2.0, "generation": 6, "size": 2}
+            ) + "\n")
+        with urllib.request.urlopen(_url(srv, "/healthz")) as r:
+            body = json.loads(r.read())
+        assert body["fleet"]["size"] == 2
+        assert body["fleet"]["shrinks"] == 2
+    finally:
+        srv.shutdown()
+
+
+def test_healthz_without_journal_has_no_fleet_section(server):
+    with urllib.request.urlopen(_url(server, "/healthz")) as r:
+        body = json.loads(r.read())
+    assert "fleet" not in body
