@@ -101,16 +101,10 @@ class ArrayDataset:
         unchanged, keeping per-rank batch shapes static across a rescale
         — the dropped tail is at most ``batch_size - 1`` examples per
         shard, exactly as on the original sharding."""
-        if not (0 <= index < count):
-            raise ValueError(
-                f"shard index {index} out of range for count {count}"
-            )
-        source = self._unsharded or self._arrays
         ds = self._clone()
-        ds._unsharded = source
-        ds._arrays = tuple(a[index::count] for a in source)
-        ds._shard_spec = (index, count)
-        return ds
+        ds._arrays = self._unsharded or self._arrays
+        ds._unsharded = None
+        return ds.shard(index, count)
 
     def repeat(self) -> "ArrayDataset":
         ds = self._clone()

@@ -23,7 +23,10 @@ death can never wedge the server). It is the single source of truth for:
   pod-mode hang detection needs NO shared filesystem — the
   ``HVT_HEARTBEAT_DIR`` requirement disappears under ``--elastic``.
   Members blocked in a ``sync`` call are exempt from staleness: a pending
-  rendezvous is itself proof of liveness.
+  rendezvous is itself proof of liveness. Beats cut the other way too:
+  with ``heartbeat_window`` set, a member whose beats are fresh is exempt
+  from rendezvous-timeout expiry — it is mid-epoch and busy, not dead, and
+  a joiner waiting out a long epoch must not get it declared dead.
 
 The wire format is deliberately dumb (JSON lines over TCP, new connection
 per call): the control plane moves a few hundred bytes per epoch per
@@ -45,6 +48,14 @@ import time
 class ElasticError(RuntimeError):
     """A coordinator-reported protocol failure (world full, below
     min_ranks, malformed request)."""
+
+
+# jax.distributed ports rotate within this window (``sync_port_base +
+# generation % SYNC_PORT_WINDOW``): wide enough that an orphan holding a
+# recent generation's port cannot wedge the next world, bounded so a
+# long-lived churning fleet cannot drift the port into other services'
+# ranges (or past 65535).
+SYNC_PORT_WINDOW = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,16 +111,23 @@ class Coordinator:
         max_ranks: int | None = None,
         expected: int | None = None,
         rendezvous_timeout: float = 60.0,
+        heartbeat_window: float | None = None,
         sync_port_base: int | None = None,
         journal=None,
     ):
         """``expected``: how many members the FIRST round should wait for
         (the supervisor's initial spawn count); later rounds settle on the
-        current live membership. ``sync_port_base``: fixed-base
-        jax.distributed port rotation (``base + generation``) for
-        multi-host fleets where the coordinator cannot probe a free port
-        on rank 0's host; None (single-host) probes a free local port per
-        round. ``journal``: optional ``fn(name, value, **fields)`` — the
+        current live membership. ``heartbeat_window``: members whose last
+        TCP beat is fresher than this are exempt from rendezvous-timeout
+        expiry (a fresh beat proves the process alive and busy — typically
+        mid-epoch while a joiner waits for the next commit boundary); with
+        ``None`` every absentee expires, so ``rendezvous_timeout`` must
+        then exceed the worst-case epoch duration. ``sync_port_base``:
+        fixed-base jax.distributed port rotation
+        (``base + generation % SYNC_PORT_WINDOW``) for multi-host fleets
+        where the coordinator cannot probe a free port on rank 0's host;
+        None (single-host) probes a free local port per round.
+        ``journal``: optional ``fn(name, value, **fields)`` — the
         supervisor's `RestartLog.write` — receiving generation-tagged
         membership/rescale events."""
         self._host = host
@@ -118,6 +136,9 @@ class Coordinator:
         self.max_ranks = int(max_ranks) if max_ranks is not None else None
         self.expected = int(expected) if expected is not None else None
         self.rendezvous_timeout = float(rendezvous_timeout)
+        self.heartbeat_window = (
+            float(heartbeat_window) if heartbeat_window is not None else None
+        )
         self.sync_port_base = sync_port_base
         self._journal = journal
 
@@ -130,6 +151,11 @@ class Coordinator:
         self._last_settle: dict | None = None
         # member_id -> {"progress": int, "since": monotonic, "world": dict|None}
         self._waiters: dict[str, dict] = {}
+        # member_id -> its latest settled world, for retry re-delivery: a
+        # round that settles while a member's socket is dead (client-side
+        # sync timeout) must hand the SAME world to its retry, or that
+        # member waits for a round its peers already left.
+        self._answers: dict[str, dict] = {}
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -246,20 +272,44 @@ class Coordinator:
                 self._bump("join", member_id)
             m.progress = progress
             m.last_beat = time.monotonic()
+            if bool(msg.get("retry")):
+                ans = self._answers.get(member_id)
+                if ans is not None and ans["generation"] == self.generation:
+                    # The round settled while this member's socket was
+                    # dead (between its sync timeout and this retry):
+                    # re-deliver the same world instead of parking it for
+                    # a round its peers already left.
+                    return dict(ans)
+            else:
+                # A fresh (non-retry) sync proves the previous answer was
+                # received; drop it so a LATER retry can never be fed a
+                # stale world from a still-current generation.
+                self._answers.pop(member_id, None)
             slot = {"progress": progress, "since": time.monotonic(),
                     "world": None}
             self._waiters[member_id] = slot
             self._cond.notify_all()
             while slot.get("world") is None and "error" not in slot:
+                if self._waiters.get(member_id) is not slot:
+                    # The member reconnected (client-side socket timeout →
+                    # re-sync) and a newer waiter slot took over; settle
+                    # only answers the CURRENT slot, so without this the
+                    # stale handler thread would spin forever.
+                    slot["error"] = "superseded by a newer sync"
+                    break
                 self._maybe_settle()
                 if slot.get("world") is not None or "error" in slot:
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    # Past the deadline the round can stay open for a
+                    # whole epoch (beat-fresh absentees); poll expiry at
+                    # the normal wait cadence, not a tight spin.
                     self._expire_laggards()
-                    remaining = 0.05
+                    remaining = 0.25
                 self._cond.wait(timeout=min(remaining, 0.25))
-            self._waiters.pop(member_id, None)
+            if self._waiters.get(member_id) is slot:
+                self._waiters.pop(member_id)
             if "error" in slot:
                 raise ElasticError(slot["error"])
             return slot["world"]
@@ -332,11 +382,25 @@ class Coordinator:
 
     def _expire_laggards(self) -> None:
         """A waiter out-waited the rendezvous window: live members that never
-        showed up are presumed dead (crashed without the supervisor noticing
-        yet), dropped, and the round re-evaluated. Caller holds the lock."""
+        showed up AND whose beats have gone silent for ``heartbeat_window``
+        are presumed dead (crashed without the supervisor noticing yet),
+        dropped, and the round re-evaluated. A beat-fresh absentee is busy
+        training toward its commit boundary, not dead — the waiters keep
+        waiting for it instead of settling without it. Caller holds the
+        lock."""
+        now = time.monotonic()
         live = self._live()
-        laggards = [m for m in live if m.member_id not in self._waiters]
+        absent = [m for m in live if m.member_id not in self._waiters]
+        laggards = [
+            m for m in absent
+            if self.heartbeat_window is None
+            or now - m.last_beat > self.heartbeat_window
+        ]
         if not laggards:
+            if absent:
+                # Every absentee is provably alive (fresh beats): nothing
+                # to expire, the round simply hasn't gathered yet.
+                return
             if len(live) >= self.min_ranks:
                 # Everyone alive IS waiting — only the first round's
                 # expected quorum held the settle back, and expiry waives
@@ -361,8 +425,10 @@ class Coordinator:
     def _pick_sync_port(self) -> int:
         if self.sync_port_base is not None:
             # Rotation keeps an orphan holding the old port from wedging
-            # the new world (the supervise_hosts trick, per generation).
-            return int(self.sync_port_base) + self.generation
+            # the new world (the supervise_hosts trick, per generation);
+            # the bounded window keeps a churning fleet's port from
+            # drifting upward forever.
+            return int(self.sync_port_base) + self.generation % SYNC_PORT_WINDOW
         with socket.socket() as s:
             s.bind(("", 0))
             return s.getsockname()[1]
@@ -397,12 +463,14 @@ class Coordinator:
             root=root.member_id,
         )
         for m in live:
-            self._waiters[m.member_id]["world"] = {
+            world = {
                 "rank": m.rank, "size": size,
                 "generation": self.generation,
                 "jax_coordinator": jax_coordinator,
                 "root_rank": root.rank, "max_progress": root.progress,
             }
+            self._waiters[m.member_id]["world"] = world
+            self._answers[m.member_id] = world
         self._cond.notify_all()
 
     # --- supervisor-side API ------------------------------------------------
@@ -527,11 +595,28 @@ class ElasticClient:
     def sync(self, progress: int = -1,
              timeout: float | None = None) -> WorldInfo:
         """Block until the next rendezvous round settles; returns this
-        member's place in the new world. Auto-joins on first call."""
-        world = WorldInfo.from_wire(self._call(
-            cmd="sync", member=self.member_id, host=self.host,
-            progress=progress, timeout=timeout,
-        ))
+        member's place in the new world. Auto-joins on first call.
+
+        The wait is UNBOUNDED by design — the server holds the round open
+        as long as absent members are provably alive (fresh beats), which
+        can be a whole epoch. With ``timeout=None`` each attempt waits
+        ``self.timeout`` on the socket and then simply re-enters the
+        rendezvous (the server supersedes the stale waiter slot), so a
+        slow epoch elsewhere cannot crash a joiner while a half-open
+        connection still cannot wedge it. Pass an explicit ``timeout`` to
+        bound the total wait instead."""
+        retry = False
+        while True:
+            try:
+                world = WorldInfo.from_wire(self._call(
+                    cmd="sync", member=self.member_id, host=self.host,
+                    progress=progress, retry=retry, timeout=timeout,
+                ))
+                break
+            except TimeoutError:
+                if timeout is not None:
+                    raise
+                retry = True
         self.synced_generation = world.generation
         return world
 

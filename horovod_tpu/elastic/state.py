@@ -114,19 +114,28 @@ class ElasticState:
     def sync(self, root_rank: int = 0) -> None:
         """Adopt the root member's committed snapshot, cross-process.
 
-        Two transports, picked by what the members actually hold: when
-        every member has a committed snapshot of identical structure
-        (the shrink case — survivors already carry byte-identical
-        replicated state) the arrays ride `collectives.broadcast_pytree`,
-        one fused host-level broadcast. When structures differ or someone
-        has nothing (the (re)join case) the whole snapshot travels as one
-        `broadcast_object` — structure included, so a fresh process needs
-        no template. Ends with `restore()`, so live attributes reflect
-        the adopted snapshot."""
+        The common shrink moves NOTHING: every survivor committed the same
+        boundary of the same SPMD program, so when every member's
+        (structure, progress, content-digest) vote matches the root's,
+        everyone provably holds the root's bytes already and the
+        model-sized transport is skipped (the digest — not just structure
+        — guards against low-bit replica drift or rank-dependent tracked
+        extras: any divergence falls through to the broadcast, exactly the
+        pre-skip behavior). Otherwise, two transports, picked by what the
+        members actually hold: identical structures ride
+        `collectives.broadcast_pytree`, one fused host-level broadcast;
+        differing structures or an empty-handed (re)joiner get the whole
+        snapshot as one `broadcast_object` — structure included, so a
+        fresh process needs no template. Ends with `restore()`, so live
+        attributes reflect the adopted snapshot."""
+        import hashlib
+        import pickle
+
         if jax.process_count() == 1:
             self.restore()
             return
         fp = None
+        digest = None
         if self._committed is not None:
             leaves, treedef = jax.tree_util.tree_flatten(self._committed)
             fp = (
@@ -135,7 +144,14 @@ class ElasticState:
                 tuple(str(getattr(l, "dtype", type(l).__name__))
                       for l in leaves),
             )
-        fps = collectives.allgather_object(fp)
+            digest = hashlib.sha256(
+                pickle.dumps(self._committed)
+            ).hexdigest()
+        votes = collectives.allgather_object((fp, self.progress, digest))
+        if all(v == votes[root_rank] for v in votes):
+            self.restore()
+            return
+        fps = [f for f, _, _ in votes]
         if all(f is not None and f == fps[root_rank] for f in fps):
             self._committed = collectives.broadcast_pytree(
                 self._committed, root=root_rank

@@ -540,7 +540,13 @@ def supervise_elastic(
     shrunken while it still clears ``min_ranks``, and only fails once it
     cannot. Every membership/rescale event lands in the JSONL journal,
     generation-tagged, CI-gateable (``shrink=1..N --aggregate count``)
-    and servable (`fleet_status`, the /healthz ``fleet`` section)."""
+    and servable (`fleet_status`, the /healthz ``fleet`` section).
+
+    ``spawn(member_id, slot, env)``: optional member factory (the ssh
+    path's hook). It receives the RESOLVED env overlay — including
+    ``HVT_ELASTIC_COORDINATOR``, which only exists once the coordinator
+    here has started — and must apply it to the child; a closure over the
+    caller's own env dict would silently miss the coordinator address."""
     from horovod_tpu.elastic.coordinator import Coordinator
     from horovod_tpu.runtime import ENV_ELASTIC_COORDINATOR
 
@@ -559,12 +565,20 @@ def supervise_elastic(
         max_ranks=max_ranks,
         expected=min(nprocs, max_ranks),
         rendezvous_timeout=elastic.rendezvous_timeout,
+        # A member whose beats are fresh is mid-epoch, not dead: exempt it
+        # from rendezvous-timeout expiry so a joiner waiting out a long
+        # epoch cannot get actively-training survivors declared dead.
+        heartbeat_window=(
+            policy.heartbeat_timeout
+            if policy.heartbeat_timeout is not None
+            else elastic.rendezvous_timeout
+        ),
         sync_port_base=sync_port_base,
         journal=log.write,
     ).start()
     env[ENV_ELASTIC_COORDINATOR] = coord.address
     if spawn is None:
-        spawn = lambda member_id, slot: _spawn_member_local(  # noqa: E731
+        spawn = lambda member_id, slot, env: _spawn_member_local(  # noqa: E731
             argv, env, member_id, slot, tag_output=tag_output
         )
 
@@ -576,7 +590,7 @@ def supervise_elastic(
         member_id = f"m{seq}"
         seq += 1
         members[member_id] = {
-            "proc": spawn(member_id, slot), "slot": slot,
+            "proc": spawn(member_id, slot, dict(env)), "slot": slot,
             "spawned": time.monotonic(),
         }
         return member_id
@@ -920,22 +934,26 @@ def supervise_elastic_hosts(
     Progress detection over ``model_dir`` still reads the LAUNCHER's
     filesystem — without a shared mount the restart budget bounds total
     restarts, exactly as in `supervise_hosts`. The jax.distributed port
-    rotates with the generation (``sync_port_base + generation``) so an
-    orphan holding an old port cannot wedge the next world."""
+    rotates with the generation (``sync_port_base +
+    generation % SYNC_PORT_WINDOW``) so an orphan holding a recent port
+    cannot wedge the next world."""
     import shlex as shlex_lib
     import socket as socket_lib
     import subprocess
 
     from horovod_tpu.runtime import ENV_ELASTIC_MEMBER, ENV_LOCAL_RANK
 
-    env = dict(env or {})
-
-    def spawn(member_id: str, slot: int):
+    def spawn(member_id: str, slot: int, env: dict[str, str]):
+        # ``env`` is the overlay supervise_elastic resolved (model dir,
+        # journal path, HVT_ELASTIC_COORDINATOR) — NOT this function's
+        # caller env. Supervisor-owned identity keys are applied last so a
+        # stale HVT_ELASTIC_MEMBER/HVT_LOCAL_RANK leaked into --env can
+        # never override the assigned member id and slot.
         host = hosts[slot % len(hosts)]
         remote_env = {
+            **env,
             ENV_ELASTIC_MEMBER: member_id,
             ENV_LOCAL_RANK: "0",
-            **env,
         }
         exports = " ".join(
             f"{k}={shlex_lib.quote(v)}" for k, v in remote_env.items()

@@ -149,6 +149,9 @@ def broadcast_pytree(tree: PyTree, root: int = 0, axis_name=None) -> PyTree:
 # calls against a given world — the same contract the array collectives
 # already require); an elastic rescale swaps the client (fresh service,
 # fresh namespace), resetting the sequence on every process together.
+# Each round's keys are garbage-collected once every reader has fetched
+# (_kv_cleanup), so a long-lived world does not accumulate per-epoch votes
+# or park model-sized broadcast payloads in the coordination service.
 
 _KV_CHUNK = 2 * 1024 * 1024  # stay clear of gRPC's default 4 MB message cap
 _KV_TIMEOUT_MS = 600_000
@@ -157,13 +160,21 @@ _kv_seq = {"client": None, "n": 0}
 
 def _kv_client():
     """The live coordination-service client, or None (no distributed init —
-    single-process, or a backend brought up without jax.distributed)."""
+    single-process, or a backend brought up without jax.distributed, or a
+    jaxlib without the bytes KV APIs — the multihost_utils array fallback
+    one branch away is then the right path)."""
     try:
         from jax._src import distributed
 
-        return distributed.global_state.client
+        client = distributed.global_state.client
     except ImportError:  # pragma: no cover — future jax moved the module
         return None
+    if client is None or not (
+        hasattr(client, "key_value_set_bytes")
+        and hasattr(client, "blocking_key_value_get_bytes")
+    ):
+        return None
+    return client
 
 
 def _kv_next(tag: str) -> str:
@@ -194,6 +205,23 @@ def _kv_get(client, key: str) -> bytes:
     )
 
 
+def _kv_cleanup(client, key: str, *, root: int = 0) -> None:
+    """Best-effort removal of a finished round's keys. The barrier proves
+    every reader has fetched before the root deletes — without it a root
+    racing ahead could delete chunks a slower peer is still blocked on.
+    Any failure (a jaxlib predating delete/barrier, a peer death failing
+    the barrier) leaves the keys behind, which costs memory in the
+    coordination service but never correctness: keys are never reused
+    (monotonic sequence) and an elastic rescale drops the whole namespace
+    with the old service anyway."""
+    try:
+        client.wait_at_barrier(f"{key}/done", _KV_TIMEOUT_MS)
+        if jax.process_index() == root:
+            client.key_value_delete(f"{key}/")
+    except Exception:
+        pass
+
+
 def broadcast_object(obj, root: int = 0):
     """``hvd.broadcast_object``: every process adopts the root's arbitrary
     picklable Python object (config dicts, vocabularies, epoch counters,
@@ -212,7 +240,9 @@ def broadcast_object(obj, root: int = 0):
         key = _kv_next("bcast")
         if jax.process_index() == root:
             _kv_put(client, key, pickle.dumps(obj))
-        return pickle.loads(_kv_get(client, key))
+        out = pickle.loads(_kv_get(client, key))
+        _kv_cleanup(client, key, root=root)
+        return out
     # Fallback (no distributed client): the fixed-width array broadcast.
     payload = pickle.dumps(obj) if jax.process_index() == root else b""
     n = int(
@@ -243,10 +273,12 @@ def allgather_object(obj) -> list:
     if client is not None:
         key = _kv_next("gather")
         _kv_put(client, f"{key}/r{jax.process_index()}", pickle.dumps(obj))
-        return [
+        out = [
             pickle.loads(_kv_get(client, f"{key}/r{r}"))
             for r in range(jax.process_count())
         ]
+        _kv_cleanup(client, key)
+        return out
     payload = np.frombuffer(pickle.dumps(obj), np.uint8)
     sizes = multihost_utils.process_allgather(np.int64(len(payload)))
     width = int(np.max(sizes))

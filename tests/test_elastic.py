@@ -14,6 +14,7 @@ import time
 import pytest
 
 from horovod_tpu.elastic.coordinator import (
+    SYNC_PORT_WINDOW,
     Coordinator,
     ElasticClient,
     ElasticError,
@@ -157,6 +158,106 @@ class TestCoordinator:
         finally:
             coord.stop()
 
+    def test_fresh_beats_exempt_busy_member_from_expiry(self, tmp_path):
+        """A joiner out-waiting the rendezvous window must NOT get a
+        survivor declared dead while that survivor's TCP beats are fresh:
+        it is mid-epoch (slower than rendezvous_timeout), not crashed. The
+        round settles only once the busy member reaches its boundary —
+        with everyone still in."""
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        coord = Coordinator(
+            expected=1, min_ranks=1, rendezvous_timeout=0.3,
+            heartbeat_window=10.0, journal=log.write,
+        ).start()
+        try:
+            _sync_all(coord.address, ["a"])
+            stop = threading.Event()
+
+            def beat_a():
+                while not stop.is_set():
+                    ElasticClient(coord.address, "a").beat()
+                    time.sleep(0.05)
+
+            beater = threading.Thread(target=beat_a)
+            beater.start()
+            try:
+                # 'b' joins and waits; 'a' is busy training (absent from
+                # the round, beating).
+                worlds = {}
+                joiner = threading.Thread(
+                    target=lambda: worlds.update(
+                        b=ElasticClient(coord.address, "b").sync(timeout=30.0)
+                    )
+                )
+                joiner.start()
+                time.sleep(1.0)  # several rendezvous windows of waiting
+                assert coord.member_status("a")[0] == "live"
+            finally:
+                stop.set()
+                beater.join(5)
+            # 'a' reaches its commit boundary: the round settles at 2.
+            world_a = ElasticClient(coord.address, "a").sync(timeout=30.0)
+            joiner.join(10)
+            assert world_a.size == 2
+            assert worlds["b"].size == 2
+            assert not [
+                r for r in _journal(log.path) if r["name"] == "dead"
+            ]
+        finally:
+            coord.stop()
+
+    def test_sync_outlives_client_socket_timeout(self, tmp_path):
+        """An unbounded sync() must survive epochs longer than the client
+        socket timeout: each expired attempt re-enters the rendezvous (the
+        server superseding the stale waiter slot) until the busy member
+        arrives and the round settles with everyone in."""
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        coord = Coordinator(
+            expected=1, min_ranks=1, rendezvous_timeout=0.2,
+            heartbeat_window=10.0, journal=log.write,
+        ).start()
+        try:
+            _sync_all(coord.address, ["a"])
+            stop = threading.Event()
+
+            def beat_a():
+                while not stop.is_set():
+                    ElasticClient(coord.address, "a").beat()
+                    time.sleep(0.05)
+
+            beater = threading.Thread(target=beat_a)
+            beater.start()
+            try:
+                worlds = {}
+                joiner = threading.Thread(
+                    target=lambda: worlds.update(
+                        # Per-attempt socket timeout far below the wait:
+                        # forces several timeout→re-sync cycles.
+                        b=ElasticClient(coord.address, "b", timeout=0.3)
+                        .sync()
+                    )
+                )
+                joiner.start()
+                time.sleep(1.2)  # ≥3 client attempts while 'a' is busy
+                assert coord.member_status("b")[0] == "live"
+            finally:
+                stop.set()
+                beater.join(5)
+            world_a = ElasticClient(coord.address, "a").sync(timeout=30.0)
+            joiner.join(10)
+            assert world_a.size == 2
+            assert worlds["b"].size == 2
+            assert not [
+                r for r in _journal(log.path) if r["name"] == "dead"
+            ]
+        finally:
+            coord.stop()
+
+    def test_sync_port_rotation_stays_bounded(self):
+        coord = Coordinator(expected=1, sync_port_base=9100)
+        coord.generation = 7 + 5 * SYNC_PORT_WINDOW  # a long-churned fleet
+        assert coord._pick_sync_port() == 9100 + 7
+
     def test_below_min_ranks_fails_loudly(self):
         coord = Coordinator(
             expected=1, min_ranks=2, rendezvous_timeout=0.4
@@ -254,6 +355,89 @@ class TestElasticState:
         s.epoch = 5
         s.sync(root_rank=0)
         assert s.epoch == 2
+
+    def test_sync_skips_transport_when_members_match(self, monkeypatch):
+        """The common shrink: every survivor committed the same boundary
+        of the same SPMD program — matching (structure, progress) votes
+        mean the model-sized broadcast would move nothing, so it must not
+        run at all."""
+        import jax
+        import numpy as np
+
+        from horovod_tpu.elastic import state as state_mod
+
+        s = ElasticState(state={"w": np.arange(4)}, epoch=2)
+        s.commit()
+        s.state = {"w": np.zeros(4)}  # live value drifts past the commit
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object", lambda v: [v, v]
+        )
+
+        def no_transport(*a, **k):
+            raise AssertionError("transport must be skipped on a shrink")
+
+        monkeypatch.setattr(
+            state_mod.collectives, "broadcast_pytree", no_transport
+        )
+        monkeypatch.setattr(
+            state_mod.collectives, "broadcast_object", no_transport
+        )
+        s.sync(root_rank=0)
+        np.testing.assert_array_equal(s.state["w"], np.arange(4))
+        assert s.epoch == 2
+
+    def test_sync_transports_when_content_diverged(self, monkeypatch):
+        """Same structure and progress but divergent bytes (low-bit
+        replica drift, rank-dependent tracked extras): the digest vote
+        differs, so the root's content IS broadcast — the skip never
+        trades correctness for the saved transport."""
+        import jax
+        import numpy as np
+
+        from horovod_tpu.elastic import state as state_mod
+
+        s = ElasticState(state={"w": np.arange(4)}, epoch=2)
+        s.commit()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda v: [v, (v[0], v[1], "diverged-digest")],
+        )
+        sent = []
+        monkeypatch.setattr(
+            state_mod.collectives, "broadcast_pytree",
+            lambda tree, root=0: sent.append(tree) or tree,
+        )
+        s.sync(root_rank=0)
+        assert len(sent) == 1
+
+    def test_sync_transports_to_empty_handed_joiner(self, monkeypatch):
+        """A fresh joiner votes (None, -1): structures differ, so the full
+        snapshot travels as one broadcast_object."""
+        import jax
+        import numpy as np
+
+        from horovod_tpu.elastic import state as state_mod
+
+        s = ElasticState(state={"w": np.arange(4)}, epoch=2)
+        s.commit()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda v: [v, (None, -1, None)],
+        )
+        sent = []
+
+        def fake_broadcast_object(obj, root=0):
+            sent.append(obj)
+            return obj
+
+        monkeypatch.setattr(
+            state_mod.collectives, "broadcast_object", fake_broadcast_object
+        )
+        s.sync(root_rank=0)
+        assert len(sent) == 1 and sent[0]["epoch"] == 2
 
 
 class TestLeaveFault:
@@ -482,6 +666,51 @@ class TestSuperviseElastic:
         assert code == 7
         records = _journal(log)
         assert any(r["name"] == "supervisor_gave_up" for r in records)
+
+    def test_hosts_members_receive_coordinator_env(
+        self, tmp_path, monkeypatch
+    ):
+        """The ssh path end-to-end with a PATH-shimmed ssh that execs
+        locally. The spawn closure only learns HVT_ELASTIC_COORDINATOR via
+        the resolved env supervise_elastic hands it (the address exists
+        only after the coordinator starts), so a clean completion — the
+        fake worker dials that address at startup — proves propagation;
+        the captured remote commands pin it, and pin that the
+        supervisor-assigned member identity beats a stale one leaked into
+        the user env."""
+        bin_dir = tmp_path / "fakebin"
+        bin_dir.mkdir()
+        capture = tmp_path / "ssh-cmds.log"
+        ssh = bin_dir / "ssh"
+        ssh.write_text(
+            "#!/bin/bash\n"
+            'while [[ "$1" == -* ]]; do\n'
+            '  if [[ "$1" == "-o" ]]; then shift 2; else shift; fi\n'
+            "done\n"
+            'host="$1"; shift\n'
+            f'printf \'%s\\n\' "$*" >> {capture}\n'
+            'exec sh -c "$*"\n'
+        )
+        ssh.chmod(0o755)
+        monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise_elastic_hosts(
+            ["hostA", "hostB"], argv,
+            env={"FAKE_EPOCHS": "2", "HVT_ELASTIC_MEMBER": "stale-zombie"},
+            policy=RestartPolicy(max_restarts=1, backoff=0.0,
+                                 grace_seconds=5.0),
+            elastic=ElasticPolicy(min_ranks=1, rendezvous_timeout=20.0),
+            log_path=str(log),
+        )
+        assert code == 0
+        cmds = capture.read_text()
+        assert "HVT_ELASTIC_COORDINATOR=" in cmds
+        assert "HVT_ELASTIC_MEMBER=m0" in cmds
+        assert "HVT_ELASTIC_MEMBER=m1" in cmds
+        assert "stale-zombie" not in cmds
+        names = [r["name"] for r in _journal(log)]
+        assert "start" in names
 
     def test_tcp_beat_hang_detection_kills_and_replaces(self, tmp_path):
         argv = write_fake_worker(tmp_path)

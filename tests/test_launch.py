@@ -524,6 +524,23 @@ class TestDistributedLaunch:
             objs = collectives.allgather_object(
                 'r' * (hvt.process_rank() + 1))
             assert objs == ['r', 'rr'], objs
+            # Every round's KV keys are garbage-collected once all readers
+            # fetched (bounded control-plane footprint for a long-lived
+            # world). Rank 0 deletes right after the round's barrier, so
+            # poll briefly; the sentinel proves dir_get itself works.
+            import time
+            client = collectives._kv_client()
+            assert client is not None
+            if hvt.process_rank() == 0:
+                client.key_value_set('hvt-sentinel/x', '1')
+            client.wait_at_barrier('sentinel-ready', 30000)
+            assert client.key_value_dir_get('hvt-sentinel/')
+            deadline = time.time() + 10
+            leftover = client.key_value_dir_get_bytes('hvt/')
+            while leftover and time.time() < deadline:
+                time.sleep(0.1)
+                leftover = client.key_value_dir_get_bytes('hvt/')
+            assert not leftover, [k for k, _ in leftover]
             open({str(tmp_path)!r} + f'/ok-{{hvt.process_rank()}}', 'w').close()
         """))
         code = launcher.run_local(
