@@ -20,6 +20,18 @@ or via the job spec `horovod_tpu/launch/jobs/mnist-elastic-2proc.yaml`.
 Unlaunched (no HVT_ELASTIC_COORDINATOR), it degrades to a plain
 single-process run through a local one-member rendezvous.
 
+``ELASTIC_ZERO1=1`` turns on ZeRO-1 cross-replica weight-update sharding
+(`Trainer(shard_update=True)`): the optimizer state is then sharded
+ACROSS processes, exercising the per-shard elastic commit path — commits
+snapshot each process's own optimizer shards, the membership boundary
+reassembles them, and checkpoints use the sharded directory format
+(which is why `ModelCheckpoint` below runs on EVERY rank: `save_state`
+self-gates to the primary for single-file checkpoints, but the sharded
+format needs every process's shard file). The checkpoint fallback passes
+``reshard=True`` so a sharded checkpoint saved by a 3-rank generation
+restores onto a 2-rank world. `jobs/mnist-elastic-sharded-2proc.yaml` is
+the CI form.
+
 Smoke-test env knobs: DRIVE_STEPS, DRIVE_EPOCHS.
 """
 
@@ -80,6 +92,10 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
         # reset-on-rescale optimizer.
         hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(0.001))),
         loss="sparse_categorical_crossentropy",
+        # ZeRO-1: optimizer state sharded over the data axis — with one
+        # chip per process this is CROSS-PROCESS sharding, the layout the
+        # per-shard elastic commit exists for.
+        shard_update=hvt.runtime.env_flag("ELASTIC_ZERO1"),
     )
     trainer.build(x_train[:1])
 
@@ -89,19 +105,25 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
         trainer.install_state(state.state)
     else:
         # Fresh process (first generation, or a per-rank restart after a
-        # hard crash): the checkpoint fallback.
+        # hard crash): the checkpoint fallback. reshard=True because a
+        # sharded (ZeRO-1) checkpoint may have been saved by a different
+        # generation's world size.
         trainer.state, done = checkpoint.restore_latest_and_broadcast(
-            model_dir, trainer.state, mesh=trainer.mesh
+            model_dir, trainer.state, mesh=trainer.mesh, reshard=True
         )
         state.epoch = max(state.epoch, done)
 
     callbacks = [
         hvt.callbacks.LearningRateWarmupCallback(warmup_epochs=3),
+        # EVERY rank, not just rank 0: save_state self-gates single-file
+        # saves to the primary, and the sharded (ZeRO-1) format requires
+        # every process to write its own shard file — a rank-0 gate there
+        # would tear every sharded checkpoint.
+        hvt.callbacks.ModelCheckpoint(
+            os.path.join(model_dir, "checkpoint-{epoch}.msgpack")
+        ),
     ]
     if world.rank == 0:
-        callbacks.append(hvt.callbacks.ModelCheckpoint(
-            os.path.join(model_dir, "checkpoint-{epoch}.msgpack")
-        ))
         callbacks.append(hvt.callbacks.ScalarLogger(model_dir))
     # LAST in the list: commits the epoch AFTER checkpoints/logs saw it,
     # then runs the membership agreement (and may interrupt the fit).

@@ -18,6 +18,14 @@ Resume is restore → broadcast: load on the primary, then
 ``broadcast_parameters`` syncs all processes (the reference's implicit resume
 contract, tensorflow2_keras_mnist.py:68-71).
 
+**Integrity**: every checkpoint file (single-file payloads AND per-process
+shard files) gets a ``.sha256`` sidecar written right after its atomic
+rename. Discovery (`latest_checkpoint`/`_sharded_complete`) and restore
+verify it, so a checkpoint corrupted after landing — torn fsync, bit rot,
+a truncated shard — is skipped in favor of the previous complete epoch
+rather than deserialized into garbage. Files without a sidecar (pre-digest
+checkpoints) are accepted unverified.
+
 **Sharded (distributed) checkpoints**: when the state is sharded ACROSS
 processes (pipeline stages, cross-host TP/FSDP), no single process can
 host-gather it, so the single-file format is impossible. The sharded format
@@ -37,6 +45,7 @@ format automatically from the state's shardings.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import os
@@ -54,14 +63,28 @@ from horovod_tpu.parallel import collectives, sharding
 PyTree = Any
 
 # Accept any extension so user-supplied templates ('checkpoint-{epoch}.h5',
-# Keras-style) are still discovered on resume.
+# Keras-style) are still discovered on resume. Deliberately does NOT match
+# digest sidecars (extra '.sha256' after the extension).
 CHECKPOINT_RE = re.compile(r"checkpoint-(\d+)\.\w+$")
+
+# Integrity sidecar: '<file>.sha256' holds the hex digest of '<file>'.
+# Written right after the payload's atomic rename; verified on discovery
+# and restore, so a checkpoint corrupted AFTER its atomic write landed (a
+# writer killed mid-fsync on a lying filesystem, a flipped bit, a truncated
+# shard) is skipped in favor of the previous complete one instead of being
+# deserialized into garbage. Files without a sidecar (pre-digest
+# checkpoints) are accepted unverified for backward compatibility.
+DIGEST_SUFFIX = ".sha256"
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file's bytes do not match its recorded sha256 digest."""
 
 
 _write_seq = itertools.count()
 
 
-def _atomic_write(path: str, data: bytes) -> None:
+def _atomic_write(path: str, data: bytes, digest: bool = False) -> None:
     # Unique per WRITE, not just per process: a pid-only suffix collides
     # when two same-process writers target one path concurrently (e.g. an
     # async ModelCheckpoint save in flight while PreemptionCheckpoint
@@ -72,6 +95,61 @@ def _atomic_write(path: str, data: bytes) -> None:
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)  # atomic: no torn checkpoints on crash (§5.2)
+    if digest:
+        # Sidecar lands after the payload; both writes are atomic. The
+        # crash window between them leaves a payload with a missing/stale
+        # sidecar — safe either way: missing = legacy-accept, stale =
+        # only reachable when two writers raced the SAME path, and those
+        # write identical bytes (same committed state, same epoch), so
+        # the digest still matches.
+        dtmp = f"{path}{DIGEST_SUFFIX}.tmp.{os.getpid()}.{next(_write_seq)}"
+        with open(dtmp, "w") as f:
+            f.write(hashlib.sha256(data).hexdigest() + "\n")
+        os.replace(dtmp, path + DIGEST_SUFFIX)
+
+
+def recorded_digest(path: str) -> str | None:
+    """The sidecar-recorded sha256 hex digest for ``path``, or None when no
+    sidecar exists (a pre-digest checkpoint — accepted unverified)."""
+    try:
+        with open(path + DIGEST_SUFFIX) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def file_intact(path: str) -> bool:
+    """True when ``path``'s bytes match its recorded digest (or no digest
+    was recorded). False on mismatch or an unreadable file."""
+    want = recorded_digest(path)
+    if want is None:
+        return os.path.isfile(path)
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    except OSError:
+        return False
+    return h.hexdigest() == want
+
+
+def _read_verified(path: str) -> bytes:
+    """Read a checkpoint file and verify it against its digest sidecar —
+    the restore-side half of the integrity contract (discovery uses
+    `file_intact`; both must hold so a corrupt file neither loads as
+    garbage nor wins discovery)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    want = recorded_digest(path)
+    if want is not None and hashlib.sha256(data).hexdigest() != want:
+        raise CheckpointCorruptError(
+            f"checkpoint file {path} does not match its recorded sha256 "
+            "digest — the file was corrupted after being written (torn "
+            "write, bit rot, or a concurrent writer). Delete it to fall "
+            "back to the previous complete checkpoint."
+        )
+    return data
 
 
 def save(path: str, state: PyTree) -> str:
@@ -89,13 +167,19 @@ def save(path: str, state: PyTree) -> str:
             "save_checkpoint/ModelCheckpoint select it automatically."
         )
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    _atomic_write(path, serialization.to_bytes(jax.device_get(state)))
+    _atomic_write(
+        path, serialization.to_bytes(jax.device_get(state)), digest=True
+    )
     return path
 
 
 class _SaveThread:
-    """Background save handle whose `join()` re-raises the thread's failure —
-    a checkpoint that silently failed to write must not look successful."""
+    """Background save handle whose `join()` — and `is_alive()`, once the
+    thread has finished — re-raise the thread's failure: a checkpoint that
+    silently failed to write must not look successful. The exception is
+    kept (not consumed), so every later consumption point re-raises too —
+    `ModelCheckpoint` hits it at the next epoch's join and again at train
+    end, whichever the caller reaches first."""
 
     def __init__(self, work):
         import threading
@@ -117,7 +201,12 @@ class _SaveThread:
             raise self.exc
 
     def is_alive(self):
-        return self._t.is_alive()
+        alive = self._t.is_alive()
+        if not alive and self.exc is not None:
+            # A caller polling is_alive() instead of joining must not read
+            # "finished" as "succeeded" — the failure surfaces here too.
+            raise self.exc
+        return alive
 
 
 def save_async(path: str, state: PyTree) -> _SaveThread:
@@ -161,12 +250,13 @@ def save_async(path: str, state: PyTree) -> _SaveThread:
 def restore(path: str, template: PyTree, *, reshard: bool = False) -> PyTree:
     """Deserialize into the structure of ``template``. A directory path is a
     sharded checkpoint and routes to `restore_sharded` (``reshard`` as
-    there)."""
+    there). The file is verified against its digest sidecar when one exists
+    (`CheckpointCorruptError` on mismatch — never deserialize garbage)."""
     if os.path.isdir(path):
         return restore_sharded(path, template, reshard=reshard)
-    with open(path, "rb") as f:
-        data = f.read()
-    return serialization.from_bytes(jax.device_get(template), data)
+    return serialization.from_bytes(
+        jax.device_get(template), _read_verified(path)
+    )
 
 
 # --- Sharded (distributed) checkpoint format -------------------------------
@@ -196,6 +286,20 @@ def _fmt_index(index: tuple, shape: tuple) -> str:
     return ",".join(parts)
 
 
+def leaf_shard_pieces(leaf) -> dict:
+    """This process's OWNED pieces of one array leaf: ``{index_spec:
+    np.ndarray}`` over the addressable shards with ``replica_id == 0`` —
+    the dedup under which every piece of the global array is held by
+    exactly one process fleet-wide. The single extraction shared by
+    `save_sharded`, `gather_to_host`, and the elastic per-shard commit
+    (`horovod_tpu.elastic.ElasticState`)."""
+    return {
+        _fmt_index(sh.index, leaf.shape): np.asarray(sh.data)
+        for sh in leaf.addressable_shards
+        if sh.replica_id == 0
+    }
+
+
 def save_sharded(path: str, state: PyTree) -> str:
     """Distributed checkpoint: EVERY process calls this (unlike `save`).
 
@@ -216,16 +320,14 @@ def save_sharded(path: str, state: PyTree) -> str:
     payload = {}
     for i, leaf in enumerate(leaves):
         if isinstance(leaf, jax.Array):
-            for sh in leaf.addressable_shards:
-                if sh.replica_id == 0:
-                    payload[f"{i}|{_fmt_index(sh.index, leaf.shape)}"] = (
-                        np.asarray(sh.data)
-                    )
+            for spec, piece in leaf_shard_pieces(leaf).items():
+                payload[f"{i}|{spec}"] = piece
         elif runtime.is_primary():
             payload[f"{i}|host"] = np.asarray(leaf)
     _atomic_write(
         os.path.join(path, f"shard-{jax.process_index()}.msgpack"),
         serialization.msgpack_serialize(payload),
+        digest=True,
     )
     if runtime.is_primary():
         index = {
@@ -257,14 +359,17 @@ def save_sharded_async(path: str, state: PyTree) -> _SaveThread:
 
 def _sharded_complete(path: str) -> bool:
     """A sharded checkpoint is usable iff the index and every per-process
-    shard file landed (each lands atomically)."""
+    shard file landed (each lands atomically) AND every shard file still
+    matches its recorded digest, so a shard corrupted after landing loses
+    discovery to the previous complete epoch exactly like a missing
+    one."""
     try:
         with open(os.path.join(path, INDEX_FILE)) as f:
             n = int(json.load(f)["n_processes"])
     except (OSError, ValueError, KeyError):
         return False
     return all(
-        os.path.isfile(os.path.join(path, f"shard-{p}.msgpack"))
+        file_intact(os.path.join(path, f"shard-{p}.msgpack"))
         for p in range(n)
     )
 
@@ -367,8 +472,9 @@ def restore_sharded(path: str, template: PyTree, *,
     def lookup(key):
         while key not in store and read_order:
             p = read_order.pop(0)
-            with open(os.path.join(path, f"shard-{p}.msgpack"), "rb") as f:
-                store.update(serialization.msgpack_restore(f.read()))
+            store.update(serialization.msgpack_restore(
+                _read_verified(os.path.join(path, f"shard-{p}.msgpack"))
+            ))
         if key not in store:
             raise _ShardKeyMissing(
                 f"shard {key!r} not found in {path}: the checkpoint was "
@@ -404,7 +510,13 @@ def restore_sharded(path: str, template: PyTree, *,
             # file into `store` before concluding a key is missing.
             whole = _assemble_global(store, i, shape, leaf.dtype)
             pieces = [
-                jax.device_put(np.ascontiguousarray(whole[idx]), d)
+                # reshape: ascontiguousarray promotes 0-d slices to (1,).
+                jax.device_put(
+                    np.ascontiguousarray(whole[idx]).reshape(
+                        np.shape(whole[idx])
+                    ),
+                    d,
+                )
                 for d, idx in placement
             ]
         out.append(
@@ -426,23 +538,35 @@ def save_checkpoint(directory: str, state: PyTree, epoch: int) -> str:
     return save(os.path.join(directory, f"checkpoint-{epoch}.msgpack"), state)
 
 
+def checkpoint_intact(path: str) -> bool:
+    """Whether a discovered checkpoint artifact is safe to restore: a
+    sharded dir must be complete with every shard matching its digest; a
+    single file must match its digest sidecar (no sidecar = legacy,
+    accepted)."""
+    if os.path.isdir(path):
+        return _sharded_complete(path)
+    return file_intact(path)
+
+
 def latest_checkpoint(directory: str) -> str | None:
-    """Highest-epoch checkpoint path, or None. Sharded checkpoint dirs
-    count only when complete (a crash mid-save leaves a torn dir that must
-    lose to the previous epoch's complete one)."""
+    """Highest-epoch INTACT checkpoint path, or None. Sharded dirs count
+    only when complete, and digest-verified files only when their bytes
+    still match (`checkpoint_intact`) — so a checkpoint torn by a crash
+    mid-save OR corrupted after landing loses to the previous epoch's
+    complete one instead of being restored as garbage. Candidates are
+    checked newest-first and only until one passes, so the common
+    nothing-is-corrupt resume hashes exactly one checkpoint."""
     if not os.path.isdir(directory):
         return None
-    best, best_epoch = None, -1
+    candidates = []
     for name in os.listdir(directory):
         m = CHECKPOINT_RE.search(name)
-        if not m or int(m.group(1)) <= best_epoch:
-            continue
-        full = os.path.join(directory, name)
-        if os.path.isdir(full) and not _sharded_complete(full):
-            continue
-        best_epoch = int(m.group(1))
-        best = full
-    return best
+        if m:
+            candidates.append((int(m.group(1)), os.path.join(directory, name)))
+    for _, full in sorted(candidates, reverse=True):
+        if checkpoint_intact(full):
+            return full
+    return None
 
 
 def _torn_sharded_dirs(directory: str) -> list:
@@ -482,6 +606,10 @@ def _discard_future_checkpoints(directory: str, epoch: int) -> None:
             shutil.rmtree(full, ignore_errors=True)
         else:
             os.remove(full)
+            try:
+                os.remove(full + DIGEST_SUFFIX)
+            except OSError:
+                pass  # no sidecar (legacy file), or already gone
 
 
 def _host_syncable(leaf) -> bool:
@@ -530,11 +658,8 @@ def gather_to_host(tree: PyTree) -> PyTree:
     for i in cross:
         leaf = leaves[i]
         meta[i] = (tuple(leaf.shape), np.dtype(leaf.dtype))
-        for sh in leaf.addressable_shards:
-            if sh.replica_id == 0:
-                payload[f"{i}|{_fmt_index(sh.index, leaf.shape)}"] = (
-                    np.asarray(sh.data)
-                )
+        for spec, piece in leaf_shard_pieces(leaf).items():
+            payload[f"{i}|{spec}"] = piece
     store: dict = {}
     for part in collectives.allgather_object(payload):
         store.update(part)
@@ -638,9 +763,10 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None,
             f"incomplete sharded checkpoint(s) exist{detail}. Causes: "
             "(a) the saver was gated to one rank — for cross-process-"
             "sharded state EVERY process must run ModelCheckpoint/"
-            "save_checkpoint; (b) a crash during the very first save. "
-            "Fix the gating (a) or delete the torn dir(s) to start "
-            "fresh (b)."
+            "save_checkpoint; (b) a crash during the very first save; "
+            "(c) every saved shard failed its sha256 digest check "
+            "(corruption). Fix the gating (a) or delete the torn "
+            "dir(s) to start fresh (b/c)."
         )
     if epoch == 0:
         return template, 0

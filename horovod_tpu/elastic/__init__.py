@@ -54,5 +54,7 @@ from horovod_tpu.elastic.state import (  # noqa: F401
     ElasticStateCallback,
     HostsUpdatedInterrupt,
     LeaveInterrupt,
+    ShardedLeaf,
     progress_marker,
+    validate_committable,
 )

@@ -72,7 +72,16 @@ def run(
     coordinator (already done at the boundary) and exits with status 143
     — the preemption convention the supervisor classifies as a planned,
     clean departure. Normal return reports ``done`` and hands back
-    ``train_fn``'s result."""
+    ``train_fn``'s result.
+
+    Cross-process-sharded tracked state (ZeRO-1/TP/FSDP) is supported
+    end to end: commits snapshot per-process pieces, the membership
+    boundary reassembles them across the departing generation, and
+    `state.sync` hands every survivor the dense snapshot to re-place on
+    the new world's mesh (`Trainer.install_state`). Layouts the
+    per-shard commit cannot reassemble fail fast at entry — the elastic
+    callback validates the tracked state at train begin
+    (`state.validate_committable`) before any step runs."""
     client = client or ElasticClient(address, member_id)
     state = state or ElasticState()
     state.client = client

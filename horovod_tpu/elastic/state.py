@@ -12,6 +12,18 @@ members over ONE fused host-level broadcast — no checkpoint round-trip for
 the common case (the checkpoint path stays as the fallback for members
 whose process itself was restarted).
 
+Cross-process-sharded state (ZeRO-1 optimizer shards, multi-host TP/FSDP)
+cannot be device_get on any single process; `commit` snapshots those
+leaves as this process's OWNED pieces (`ShardedLeaf`, the `save_sharded`
+replica-0 dedup) so the commit stays communication-free, and
+`gather_committed` reassembles them into dense host arrays — verified
+piece-by-piece against the committing process's sha256 — at the
+membership-change boundary, while every member of the departing
+generation is still alive. A 3→2 ZeRO-1 shrink therefore keeps the
+departing member's third of the optimizer state without any survivor
+process restarting; layouts that genuinely cannot round-trip fail fast at
+`elastic.run` entry (`validate_committable`).
+
 `ElasticStateCallback` is the commit hook wired into the `Trainer` loop:
 it tracks the trainer's state into the `ElasticState`, commits on the
 chosen cadence, carries TCP heartbeats to the coordinator, and runs the
@@ -25,9 +37,12 @@ survivors; see `compat.distributed_shutdown_barrier`).
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import signal
 
 import jax
+import numpy as np
 
 from horovod_tpu import runtime
 from horovod_tpu.elastic.coordinator import ElasticError
@@ -59,6 +74,108 @@ def progress_marker(epoch: int, step: int = 0) -> int:
     return int(epoch) * 1_000_000 + int(step)
 
 
+# --- per-shard commit for cross-process-sharded state -----------------------
+#
+# ZeRO-1/TP/FSDP layouts shard state ACROSS processes: no single process can
+# `jax.device_get` those leaves, so the dense host snapshot `commit()` takes
+# for replicated state is impossible. Instead each process snapshots exactly
+# the pieces it OWNS — its addressable `replica_id == 0` shards, the same
+# dedup `checkpoint.save_sharded` uses, so every piece of the global array
+# is committed exactly once fleet-wide — as a `ShardedLeaf` carrying the
+# global shape/dtype, the index specs, and a per-piece sha256. The commit
+# stays communication-free (callable every epoch); the pieces are
+# reassembled into dense host arrays by `ElasticState.gather_committed()`
+# — one host-level object allgather (the KV transport) + the sharded-
+# checkpoint slice-assembly logic (`checkpoint._assemble_global`) — which
+# the elastic callback runs at the membership-change boundary while every
+# member of the old generation, INCLUDING a clean leaver, is still alive.
+# After the gather the snapshot is dense and the existing sync/broadcast
+# machinery moves it like any other.
+
+
+@dataclasses.dataclass
+class ShardedLeaf:
+    """One cross-process-sharded leaf's committed form: this process's
+    owned pieces plus the metadata needed to reassemble the global array
+    (and to prove, via per-piece sha256, that reassembly installed the
+    committing process's exact bytes)."""
+
+    shape: tuple
+    dtype: str
+    pieces: dict            # index spec -> np.ndarray (this process's share)
+    digests: dict           # index spec -> sha256 hex of the piece's bytes
+
+    @classmethod
+    def snap(cls, leaf) -> "ShardedLeaf":
+        from horovod_tpu import checkpoint
+
+        pieces = {
+            spec: np.ascontiguousarray(piece)
+            for spec, piece in checkpoint.leaf_shard_pieces(leaf).items()
+        }
+        return cls(
+            shape=tuple(leaf.shape),
+            dtype=str(np.dtype(leaf.dtype)),
+            pieces=pieces,
+            digests={
+                spec: hashlib.sha256(piece.tobytes()).hexdigest()
+                for spec, piece in pieces.items()
+            },
+        )
+
+
+def _is_cross_process(leaf) -> bool:
+    """Whether a leaf is sharded across processes — the condition under
+    which commit must snapshot pieces instead of a dense host copy.
+    Module-level (not inlined) so single-process tests can patch the
+    classification: real cross-process arrays cannot exist in one
+    process."""
+    from horovod_tpu import checkpoint
+
+    return isinstance(leaf, jax.Array) and not checkpoint._host_syncable(leaf)
+
+
+def _snap_leaf(leaf):
+    """Commit-time snapshot of one leaf: dense host copy when any single
+    process can hold it, `ShardedLeaf` pieces otherwise."""
+    if _is_cross_process(leaf):
+        return ShardedLeaf.snap(leaf)
+    return jax.device_get(leaf)
+
+
+def _has_sharded(tree) -> bool:
+    return any(
+        isinstance(l, ShardedLeaf) for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def validate_committable(tree, where: str = "elastic.run") -> None:
+    """Fail fast — with an actionable error — for layouts the per-shard
+    commit genuinely cannot reassemble (strided shard indices), instead of
+    crashing mid-training at the first commit or, worse, mid-rescale.
+    Called by `ElasticStateCallback.on_train_begin`, i.e. at `elastic.run`
+    entry of every generation, before any training step runs."""
+    from horovod_tpu import checkpoint
+
+    paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in paths_and_leaves:
+        if not _is_cross_process(leaf):
+            continue
+        try:
+            checkpoint.leaf_shard_pieces(leaf)
+        except ValueError as e:
+            raise RuntimeError(
+                f"{where}: tracked state leaf "
+                f"{jax.tree_util.keystr(path)!r} is sharded across "
+                f"processes with a layout the elastic per-shard commit "
+                f"cannot reassemble ({e}). Elastic continue-through-"
+                "failure is unavailable for this layout — run under the "
+                "plain supervised launcher (--max-restarts without "
+                "--elastic) and rely on sharded checkpoints, or change "
+                "the sharding to contiguous per-dimension slices."
+            ) from None
+
+
 class ElasticState:
     """Committed training state: named attributes (``state`` — typically a
     `TrainState` — plus ``epoch``/``step`` bookkeeping and any extra
@@ -86,11 +203,139 @@ class ElasticState:
     def commit(self) -> None:
         """Snapshot every tracked attribute to host memory. Call at clean
         boundaries only (between steps, outside collectives): at most one
-        commit interval of progress is lost to a membership change."""
+        commit interval of progress is lost to a membership change.
+
+        Cross-process-sharded leaves (ZeRO-1 optimizer shards, TP/FSDP
+        weights) are snapshot as THIS process's owned pieces
+        (`ShardedLeaf` — the `save_sharded` replica-0 dedup), keeping the
+        commit communication-free; `gather_committed` reassembles them
+        into dense host arrays at the membership-change boundary."""
         self._committed = {
-            k: jax.device_get(getattr(self, k)) for k in self._tracked
+            k: jax.tree_util.tree_map(_snap_leaf, getattr(self, k))
+            for k in self._tracked
         }
         self.commits += 1
+
+    @property
+    def has_sharded_commit(self) -> bool:
+        """Whether the committed snapshot still holds per-process pieces
+        that must be reassembled (`gather_committed`) before the snapshot
+        can travel or be restored as dense host state."""
+        return self._committed is not None and _has_sharded(self._committed)
+
+    def manifest(self) -> dict | None:
+        """Summary of the committed snapshot — treedef, per-leaf global
+        shapes/dtypes, this process's index specs and per-piece sha256
+        digests, and the committed progress marker. The integrity record
+        the reassembly path verifies against; also the debugging surface
+        for 'what exactly did this member commit'."""
+        if self._committed is None:
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(self._committed)
+        entries = []
+        for leaf in leaves:
+            if isinstance(leaf, ShardedLeaf):
+                entries.append({
+                    "sharded": True, "shape": list(leaf.shape),
+                    "dtype": leaf.dtype,
+                    "pieces": sorted(leaf.pieces),
+                    "sha256": dict(leaf.digests),
+                })
+            else:
+                a = np.asarray(leaf)
+                entries.append({
+                    "sharded": False, "shape": list(a.shape),
+                    "dtype": str(a.dtype),
+                })
+        return {
+            "treedef": str(treedef),
+            "progress": self.progress,
+            "leaves": entries,
+        }
+
+    def gather_committed(self, force: bool = False) -> None:
+        """Reassemble every committed `ShardedLeaf` into its dense global
+        array, across the CURRENT membership — a collective (every process
+        must call it at the same point).
+
+        Each process contributes its owned pieces over one host-level
+        object allgather (the KV transport), verifies every received piece
+        against the committing process's sha256, and tiles the pieces into
+        the global arrays with the sharded-checkpoint assembly logic
+        (`checkpoint._assemble_global`). The elastic callback runs this at
+        the membership-change boundary, while every member of the old
+        generation — including a clean leaver — is still alive, so a
+        3-process ZeRO-1 world shrinking to 2 keeps the departing third of
+        the optimizer state.
+
+        ``force=True`` makes a member with NO sharded commit (an
+        empty-handed joiner, a dense-committed peer) still enter the
+        allgather with an empty contribution — `sync` needs that so the
+        collective stays lockstep when only SOME members' votes say
+        sharded. Without force, no-sharded-commit is a communication-free
+        no-op (the boundary path, where the classification is provably
+        identical on every rank).
+
+        Raises a RuntimeError naming the missing coverage when the pieces
+        no longer tile an array (a member died hard before its pieces
+        could travel): the caller's process then restarts and takes the
+        checkpoint-restore fallback, which is the designed escalation."""
+        from horovod_tpu import checkpoint
+
+        sharded = self.has_sharded_commit
+        if not sharded and not force:
+            return
+        payload: dict = {}
+        digests: dict = {}
+        leaves: list = []
+        treedef = None
+        if sharded:
+            leaves, treedef = jax.tree_util.tree_flatten(self._committed)
+            for i, leaf in enumerate(leaves):
+                if isinstance(leaf, ShardedLeaf):
+                    for spec, piece in leaf.pieces.items():
+                        payload[f"{i}|{spec}"] = piece
+                        digests[f"{i}|{spec}"] = leaf.digests[spec]
+        store: dict = {}
+        want: dict = {}
+        for part_payload, part_digests in collectives.allgather_object(
+            (payload, digests)
+        ):
+            store.update(part_payload)
+            want.update(part_digests)
+        if not sharded:
+            return  # participated for lockstep; nothing to reassemble
+        for key, piece in store.items():
+            got = hashlib.sha256(
+                np.ascontiguousarray(piece).tobytes()
+            ).hexdigest()
+            if got != want.get(key):
+                raise RuntimeError(
+                    f"elastic commit piece {key!r} failed its sha256 "
+                    "check after transport — refusing to install "
+                    "corrupt state; restart and restore from the last "
+                    "checkpoint"
+                )
+        out = []
+        for i, leaf in enumerate(leaves):
+            if not isinstance(leaf, ShardedLeaf):
+                out.append(leaf)
+                continue
+            try:
+                out.append(checkpoint._assemble_global(
+                    {k: v for k, v in store.items()
+                     if k.startswith(f"{i}|")},
+                    i, leaf.shape, np.dtype(leaf.dtype),
+                ))
+            except ValueError as e:
+                raise RuntimeError(
+                    f"cannot reassemble committed sharded state: {e}. "
+                    "Pieces owned by a departed member never reached the "
+                    "survivors (a hard death before the commit boundary); "
+                    "restart and restore from the newest complete "
+                    "checkpoint — the ElasticState fallback path."
+                ) from None
+        self._committed = jax.tree_util.tree_unflatten(treedef, out)
 
     def restore(self) -> None:
         """Roll tracked attributes back to the last commit (no-op before
@@ -111,13 +356,39 @@ class ElasticState:
             self._committed.get("epoch", 0), self._committed.get("step", 0)
         )
 
+    def _vote(self) -> tuple:
+        """(structure fingerprint, progress, content digest, has-sharded)
+        — what each member contributes to the sync agreement."""
+        import pickle
+
+        if self._committed is None:
+            return (None, self.progress, None, False)
+        leaves, treedef = jax.tree_util.tree_flatten(self._committed)
+        fp = (
+            str(treedef),
+            tuple(getattr(l, "shape", ()) for l in leaves),
+            tuple(str(getattr(l, "dtype", type(l).__name__))
+                  for l in leaves),
+        )
+        digest = hashlib.sha256(pickle.dumps(self._committed)).hexdigest()
+        return (fp, self.progress, digest, _has_sharded(self._committed))
+
     def sync(self, root_rank: int = 0) -> None:
         """Adopt the root member's committed snapshot, cross-process.
 
-        The common shrink moves NOTHING: every survivor committed the same
-        boundary of the same SPMD program, so when every member's
-        (structure, progress, content-digest) vote matches the root's,
-        everyone provably holds the root's bytes already and the
+        A snapshot still holding per-process `ShardedLeaf` pieces (a
+        commit that never passed a membership boundary's
+        `gather_committed`) is first reassembled across the surviving
+        membership — every member enters the gather when ANY member's
+        vote says sharded, so the collective stays lockstep; pieces that
+        no longer tile (a hard death took them) raise the actionable
+        reassembly error, whose designed escalation is a per-rank restart
+        into the checkpoint fallback.
+
+        The common shrink then moves NOTHING: every survivor committed
+        the same boundary of the same SPMD program, so when every
+        member's (structure, progress, content-digest) vote matches the
+        root's, everyone provably holds the root's bytes already and the
         model-sized transport is skipped (the digest — not just structure
         — guards against low-bit replica drift or rank-dependent tracked
         extras: any divergence falls through to the broadcast, exactly the
@@ -128,30 +399,22 @@ class ElasticState:
         snapshot as one `broadcast_object` — structure included, so a
         fresh process needs no template. Ends with `restore()`, so live
         attributes reflect the adopted snapshot."""
-        import hashlib
-        import pickle
-
         if jax.process_count() == 1:
+            if self.has_sharded_commit:
+                self.gather_committed()  # local-only; loud if incomplete
             self.restore()
             return
-        fp = None
-        digest = None
-        if self._committed is not None:
-            leaves, treedef = jax.tree_util.tree_flatten(self._committed)
-            fp = (
-                str(treedef),
-                tuple(getattr(l, "shape", ()) for l in leaves),
-                tuple(str(getattr(l, "dtype", type(l).__name__))
-                      for l in leaves),
-            )
-            digest = hashlib.sha256(
-                pickle.dumps(self._committed)
-            ).hexdigest()
-        votes = collectives.allgather_object((fp, self.progress, digest))
+        votes = collectives.allgather_object(self._vote())
+        if any(v[3] for v in votes):
+            # Collective: every member enters, sharded commit or not
+            # (force — a member without sharded pieces contributes an
+            # empty payload rather than skipping the allgather).
+            self.gather_committed(force=True)
+            votes = collectives.allgather_object(self._vote())
         if all(v == votes[root_rank] for v in votes):
             self.restore()
             return
-        fps = [f for f, _, _ in votes]
+        fps = [v[0] for v in votes]
         if all(f is not None and f == fps[root_rank] for f in fps):
             self._committed = collectives.broadcast_pytree(
                 self._committed, root=root_rank
@@ -219,6 +482,15 @@ class ElasticStateCallback(Callback):
         self._leave_requested = True
 
     def on_train_begin(self, logs=None):
+        # Fail fast — at elastic.run entry of every generation, before a
+        # single step trains — for cross-process-sharded layouts the
+        # per-shard commit cannot reassemble (see validate_committable).
+        if self.trainer is not None and getattr(
+            self.trainer, "state", None
+        ) is not None:
+            validate_committable(
+                self.trainer.state, where="elastic.run (tracked state)"
+            )
         self._old_handler = signal.signal(signal.SIGTERM, self._handler)
         self._beat(force=True)
 
@@ -265,6 +537,14 @@ class ElasticStateCallback(Callback):
         # down in lockstep (every rank of the generation reaches this
         # barrier — the votes above guarantee the same branch everywhere).
         self.state.commit()
+        if self.state.has_sharded_commit:
+            # Reassemble per-process pieces (ZeRO-1/TP/FSDP commits) while
+            # every member of the OLD generation — including a clean
+            # leaver — is still here: after the teardown below, a departed
+            # member's share of the state is gone for good. Collective;
+            # the sharded/dense classification is a function of the shared
+            # SPMD state, so every rank takes this branch together.
+            self.state.gather_committed()
         runtime.shutdown()
         if leaving:
             try:

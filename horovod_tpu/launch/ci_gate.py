@@ -19,23 +19,28 @@ def parse_target(target: str) -> tuple[float, float]:
 
 
 def read_metric(path: str, name: str) -> list[float]:
+    """All values of ``name`` in the stream, in write order. Reads a
+    rotated ``.1`` predecessor (the supervisor's `RestartLog` rotation)
+    before the live file, so count/last aggregates see the full window
+    across the rotation boundary."""
     values = []
-    if not os.path.exists(path):
-        return values
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                # A torn final line (writer killed mid-append, or a reader
-                # racing the appender) must not crash the gate — the
-                # fail-on-empty-stream semantics still hold below.
-                continue
-            if rec.get("name") == name:
-                values.append(float(rec["value"]))
+    for part in (path + ".1", path):
+        if not os.path.exists(part):
+            continue
+        with open(part) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # A torn final line (writer killed mid-append, or a
+                    # reader racing the appender) must not crash the gate —
+                    # the fail-on-empty-stream semantics still hold below.
+                    continue
+                if rec.get("name") == name:
+                    values.append(float(rec["value"]))
     return values
 
 
@@ -67,9 +72,11 @@ def check_metrics(
     """Return (passed, aggregated value). Missing metric — or a missing
     metrics file entirely — fails the gate rather than crashing it (a run
     that logged nothing must not pass)."""
-    if not os.path.exists(path):
+    if not os.path.exists(path) and not os.path.exists(path + ".1"):
         # A missing stream file always fails — for every aggregate: a run
         # that wrote nothing (or a typo'd path) must not pass any check.
+        # (A rotated-away live file with a `.1` predecessor still counts
+        # as present: the stream exists, its newest window is just empty.)
         return False, float("nan")
     values = read_metric(path, name)
     if not values and how != "count":

@@ -98,6 +98,7 @@ def run_job(spec_path: str) -> int:
     # Composes with `restart:` for the budget/backoff/heartbeat knobs; the
     # journal (restart log) carries the generation-tagged shrink/grow
     # events the gate and /healthz read.
+    log_path = None  # set by the supervised branches; journal_checks needs it
     if "elastic" in job:
         elastic_map = job["elastic"] or {}
         if not isinstance(elastic_map, dict):
@@ -114,8 +115,7 @@ def run_job(spec_path: str) -> int:
             {k: v for k, v in restart.items() if k != "log"}
         )
         log_path = restart.get("log") or supervisor.default_log_path(env)
-        if log_path and os.path.exists(log_path):
-            os.remove(log_path)  # stale-journal hygiene, as below
+        _reset_journal(log_path)  # stale-journal hygiene, as below
         if hosts:
             code = supervisor.supervise_elastic_hosts(
                 list(hosts), argv, env=env, policy=policy, elastic=elastic,
@@ -142,10 +142,9 @@ def run_job(spec_path: str) -> int:
             {k: v for k, v in restart.items() if k != "log"}
         )
         log_path = restart.get("log") or supervisor.default_log_path(env)
-        if log_path and os.path.exists(log_path):
-            # Same hygiene as the metrics stream above: a previous run's
-            # restart journal must not feed this run's log/gate.
-            os.remove(log_path)
+        # Same hygiene as the metrics stream above: a previous run's
+        # restart journal must not feed this run's log/gate.
+        _reset_journal(log_path)
         if hosts:
             code = supervisor.supervise_hosts(
                 list(hosts), argv, env=env, policy=policy,
@@ -169,6 +168,24 @@ def run_job(spec_path: str) -> int:
         print(f"job failed with exit code {code}")
         return code
 
+    # `journal_checks:` — the same {name: {target, aggregate}} grammar as
+    # `checks:`, evaluated against the supervisor's restart JOURNAL instead
+    # of the metrics stream. This is how an elastic CI job gates its
+    # lifecycle in-spec ("the shrink actually happened, nobody gave up"):
+    #   journal_checks:
+    #     shrink: {target: "1..9", aggregate: count}
+    # Requires a supervised launch (restart:/elastic: block) — without one
+    # there is no journal and the gate fails loudly rather than
+    # vacuously passing.
+    journal_checks = spec.get("journal_checks") or {}
+    if journal_checks:
+        if not log_path:
+            print("journal_checks: needs a restart:/elastic: block "
+                  "(no restart journal was written)")
+            return 1
+        if not ci_gate.run_checks(log_path, journal_checks):
+            return 1
+
     if not checks:
         return 0
     if hosts:
@@ -176,6 +193,17 @@ def run_job(spec_path: str) -> int:
         # without shared storage it must be fetched before gating.
         metrics_path = _fetch_remote_metrics(hosts[0], metrics_path)
     return 0 if ci_gate.run_checks(metrics_path, checks) else 1
+
+
+def _reset_journal(log_path: str | None) -> None:
+    """Remove a previous run's restart journal AND its rotated ``.1``
+    predecessor — the gate reads across the rotation boundary, so a stale
+    predecessor could feed this run's journal checks."""
+    if not log_path:
+        return
+    for p in (log_path, log_path + ".1"):
+        if os.path.exists(p):
+            os.remove(p)
 
 
 def _remote_rm(host: str, path: str, recursive: bool, why: str) -> int:

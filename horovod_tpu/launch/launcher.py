@@ -291,7 +291,12 @@ def main(argv: list[str] | None = None) -> int:
             "--restart-log", default=None, metavar="PATH",
             help="JSONL restart journal (default: "
             "$PS_MODEL_PATH/restarts.jsonl; gateable — "
-            "`gate --metrics <log> --check restarts=0..N --aggregate count`)")
+            "`gate --metrics <log> --check restarts=0..N --aggregate "
+            "count`). Rotates to <PATH>.1 past "
+            "$HVT_RESTART_LOG_MAX_LINES/$HVT_RESTART_LOG_MAX_MB "
+            "(default 100000 lines / 64 MB; 0 disables) so a "
+            "weeks-long elastic fleet's journal stays bounded — the "
+            "gate and /healthz read across the rotation")
         # Elastic mode (launch/supervisor.py supervise_elastic +
         # horovod_tpu.elastic): members are supervised INDIVIDUALLY — a
         # clean departure shrinks the fleet in place (survivors keep

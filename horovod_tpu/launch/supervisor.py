@@ -253,10 +253,32 @@ class RestartLog:
     """Append-only JSONL restart journal. Records double as CI-gate metrics:
     each carries ``name``/``value`` (value = total restarts so far), so
     ``ci_gate.check_metrics(log, 'restarts', (1, 1), how='count')`` asserts
-    restart counts with no new machinery."""
+    restart counts with no new machinery.
 
-    def __init__(self, path: str | None):
+    **Rotation** (long-lived elastic fleets journal every beat-adjacent
+    membership event for weeks): when the file exceeds ``max_lines`` or
+    ``max_bytes`` it is renamed to ``<path>.1`` — replacing the previous
+    predecessor, so at most two windows exist on disk — and appending
+    continues in a fresh file. Readers (`fleet_status`,
+    `ci_gate.read_metric`) read the ``.1`` predecessor first, so counts
+    and settle state survive the rotation boundary. Defaults come from
+    ``HVT_RESTART_LOG_MAX_LINES`` / ``HVT_RESTART_LOG_MAX_MB`` (100000
+    lines / 64 MB; 0 disables that bound)."""
+
+    def __init__(self, path: str | None, max_lines: int | None = None,
+                 max_bytes: int | None = None):
         self.path = path
+        if max_lines is None:
+            max_lines = int(os.environ.get(
+                "HVT_RESTART_LOG_MAX_LINES", "100000"
+            ))
+        if max_bytes is None:
+            max_bytes = int(float(os.environ.get(
+                "HVT_RESTART_LOG_MAX_MB", "64"
+            )) * 1024 * 1024)
+        self.max_lines = max_lines or None
+        self.max_bytes = max_bytes or None
+        self._lines: int | None = None  # counted lazily on first write
 
     def touch(self) -> None:
         """Ensure the journal exists even for a zero-restart run: the CI
@@ -271,17 +293,46 @@ class RestartLog:
         with open(self.path, "a"):
             pass
 
+    def _maybe_rotate(self) -> None:
+        if self.max_lines is None and self.max_bytes is None:
+            return
+        over_lines = (
+            self.max_lines is not None
+            and self._lines is not None
+            and self._lines >= self.max_lines
+        )
+        over_bytes = False
+        if not over_lines and self.max_bytes is not None:
+            try:
+                over_bytes = os.path.getsize(self.path) >= self.max_bytes
+            except OSError:
+                pass
+        if over_lines or over_bytes:
+            try:
+                os.replace(self.path, self.path + ".1")
+            except OSError:
+                return  # rotation is best-effort; keep appending
+            self._lines = 0
+
     def write(self, name: str, value: float, **fields) -> None:
         if not self.path:
             return
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
+        if self._lines is None:
+            try:
+                with open(self.path) as f:
+                    self._lines = sum(1 for _ in f)
+            except OSError:
+                self._lines = 0
         record = {"name": name, "value": value, "wall_time": time.time(),
                   **fields}
         with open(self.path, "a") as f:
             f.write(json.dumps(record) + "\n")
             f.flush()
+        self._lines += 1
+        self._maybe_rotate()
 
 
 def supervise(
@@ -750,6 +801,18 @@ def supervise_elastic(
                     ) < max_ranks:
                         launch(slot)
             # --- end states -------------------------------------------------
+            if not job_done:
+                # A member that reported leave(done) over TCP finished
+                # training even if its process hasn't been reaped yet.
+                # Without this, a done-leave drops live_count below
+                # min_ranks a poll tick before the exit lands, and a fleet
+                # with a spent restart budget would read its own success
+                # as "below min_ranks — giving up" (observed with
+                # max_restarts=0).
+                job_done = any(
+                    m["status"] == "left" and m["reason"] == "done"
+                    for m in coord.snapshot()["members"].values()
+                )
             if job_done and members:
                 # Training is complete; peers get a grace window to finish
                 # their own clean leave, then any straggler (typically a
@@ -801,24 +864,32 @@ def fleet_status(journal_path: str | None, events: int = 8) -> dict:
     """Summarize a supervisor journal for serving/health surfaces: current
     generation/size (from the last settle record), restart/shrink/grow
     counts, and the trailing events. Tolerant of torn lines and of a
-    missing file (a fleet that never ran restarts supervised)."""
+    missing file (a fleet that never ran restarts supervised). Reads the
+    rotated ``.1`` predecessor (if any) before the live file, so counts
+    and settle state are continuous across a `RestartLog` rotation."""
     status: dict = {
         "journal": journal_path, "generation": None, "size": None,
         "restarts": 0, "shrinks": 0, "grows": 0, "events": [],
     }
-    if not journal_path or not os.path.exists(journal_path):
+    if not journal_path or not (
+        os.path.exists(journal_path)
+        or os.path.exists(journal_path + ".1")
+    ):
         status["error"] = "journal not found"
         return status
     records = []
-    with open(journal_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue  # torn tail mid-append
+    for part in (journal_path + ".1", journal_path):
+        if not os.path.exists(part):
+            continue
+        with open(part) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail mid-append
     for rec in records:
         name = rec.get("name")
         if name in ("start", "shrink", "grow", "steady"):

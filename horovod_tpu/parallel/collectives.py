@@ -187,6 +187,8 @@ def _kv_next(tag: str) -> str:
 
 
 def _kv_put(client, key: str, payload: bytes) -> None:
+    import hashlib
+
     chunks = [
         payload[i : i + _KV_CHUNK]
         for i in range(0, len(payload), _KV_CHUNK)
@@ -194,15 +196,30 @@ def _kv_put(client, key: str, payload: bytes) -> None:
     for i, chunk in enumerate(chunks):
         client.key_value_set_bytes(f"{key}/c{i}", chunk)
     # Meta lands LAST: a reader that sees it knows every chunk is in place.
-    client.key_value_set(f"{key}/meta", str(len(chunks)))
+    # It carries the payload's sha256 so the reader can prove it reassembled
+    # the writer's exact bytes — the elastic commit/sync path moves model
+    # state over this channel, and a silently-corrupt transport would
+    # otherwise install garbage weights fleet-wide.
+    digest = hashlib.sha256(payload).hexdigest()
+    client.key_value_set(f"{key}/meta", f"{len(chunks)}:{digest}")
 
 
 def _kv_get(client, key: str) -> bytes:
-    n = int(client.blocking_key_value_get(f"{key}/meta", _KV_TIMEOUT_MS))
-    return b"".join(
+    import hashlib
+
+    meta = str(client.blocking_key_value_get(f"{key}/meta", _KV_TIMEOUT_MS))
+    n_s, _, digest = meta.partition(":")
+    payload = b"".join(
         client.blocking_key_value_get_bytes(f"{key}/c{i}", _KV_TIMEOUT_MS)
-        for i in range(n)
+        for i in range(int(n_s))
     )
+    if digest and hashlib.sha256(payload).hexdigest() != digest:
+        raise ValueError(
+            f"KV object-collective payload {key!r} failed its sha256 check "
+            f"({len(payload)} bytes reassembled) — coordination-service "
+            "transport corruption"
+        )
+    return payload
 
 
 def _kv_cleanup(client, key: str, *, root: int = 0) -> None:

@@ -25,6 +25,13 @@ example/entry script is injectable unmodified). Kinds:
   `PreemptionCheckpointCallback` installed that is the graceful save-and-
   stop path, without it the process dies of SIGTERM and the supervisor
   classifies a preemption.
+* ``corrupt`` — damage the newest checkpoint file/shard under
+  ``PS_MODEL_PATH`` (truncate to half, bit-flip the first surviving byte
+  — both without touching its ``.sha256`` sidecar), then SIGKILL self: the
+  writer-killed-mid-fsync / bit-rot shape. Drives the corruption-recovery
+  path deterministically: the relaunched run must detect the digest
+  mismatch and resume from the previous complete checkpoint instead of
+  crashing on (or silently loading) garbage.
 
 The fault fires at the first ``on_batch_end`` of the target epoch — mid-epoch
 by construction (after the epoch's checkpoint boundary, before the next), so
@@ -51,7 +58,7 @@ from horovod_tpu.training.callbacks import Callback
 ENV_FAULT = "HVT_FAULT"
 ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
 
-KINDS = ("kill", "hang", "leave")  # plus exitN, validated in parse_plan
+KINDS = ("kill", "hang", "leave", "corrupt")  # plus exitN (parse_plan)
 
 # Process-wide leave intent (the `leave` fault kind under an elastic
 # launch). The elastic epoch-end agreement consumes it; tests reset it.
@@ -114,10 +121,57 @@ def parse_plan(spec: str) -> FaultPlan:
                 ) from None
         else:
             raise ValueError(
-                f"HVT_FAULT kind must be kill, hang, leave or exitN, "
-                f"got {kind!r}"
+                f"HVT_FAULT kind must be kill, hang, leave, corrupt or "
+                f"exitN, got {kind!r}"
             )
     return FaultPlan(rank=rank, epoch=epoch, kind=kind)
+
+
+def newest_checkpoint_file(model_dir: str) -> str | None:
+    """Newest checkpoint payload file under ``model_dir`` (recursive, so
+    shard files inside ``*.shards/`` dirs count), by mtime. Digest
+    sidecars are excluded — the ``corrupt`` fault damages payloads, not
+    the record of what they should have been (corrupting the record would
+    also trigger recovery, but proves less)."""
+    from horovod_tpu import checkpoint
+
+    newest = None
+    for root, _, files in os.walk(model_dir):
+        for name in files:
+            # Skip digest sidecars AND atomic-write temp files: corrupting
+            # an in-flight '...tmp.<pid>.<seq>' would be overwritten by
+            # its own os.replace (silent no-op for the fault).
+            if name.endswith(checkpoint.DIGEST_SUFFIX) or ".tmp." in name:
+                continue
+            in_shards_dir = os.path.basename(root).endswith(
+                checkpoint.SHARDED_SUFFIX
+            )
+            if not checkpoint.CHECKPOINT_RE.search(name) and not (
+                in_shards_dir and name.startswith("shard-")
+            ):
+                continue
+            full = os.path.join(root, name)
+            try:
+                key = (os.stat(full).st_mtime_ns, full)
+            except OSError:
+                continue
+            if newest is None or key > newest[0]:
+                newest = (key, full)
+    return newest[1] if newest else None
+
+
+def corrupt_file(path: str) -> None:
+    """Deterministically damage a file in place: truncate to half its
+    size, then flip every bit of the first remaining byte. The ``.sha256``
+    sidecar (if any) is left untouched, so integrity verification MUST now
+    fail for the file."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+        f.seek(0)
+        first = f.read(1) or b"\0"
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]))
 
 
 class FaultInjectionCallback(Callback):
@@ -175,5 +229,13 @@ class FaultInjectionCallback(Callback):
                 request_leave()
             else:
                 os.kill(os.getpid(), signal.SIGTERM)
+        elif self.plan.kind == "corrupt":
+            target = newest_checkpoint_file(
+                os.environ.get("PS_MODEL_PATH", "./models")
+            )
+            if target is not None:
+                print(f"FaultInjection: corrupting {target}", flush=True)
+                corrupt_file(target)
+            os.kill(os.getpid(), signal.SIGKILL)
         else:
             os._exit(self.plan.exit_code)

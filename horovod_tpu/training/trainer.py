@@ -503,9 +503,22 @@ class Trainer:
                         f"{built_leaf.shape} — the committed state belongs "
                         "to a different model configuration"
                     )
-                return jax.device_put(
-                    arr.astype(built_leaf.dtype), built_leaf.sharding
-                )
+                arr = arr.astype(built_leaf.dtype)
+                if not built_leaf.sharding.is_fully_addressable:
+                    # Cross-process target layout (ZeRO-1 opt shards after
+                    # a rescale, multi-host TP/FSDP): place only the
+                    # shards THIS process owns, slicing them out of the
+                    # dense snapshot — device_put of a host array onto a
+                    # non-addressable sharding is not portable across the
+                    # supported jax range. The trailing reshape undoes
+                    # ascontiguousarray's 0-d → (1,) promotion.
+                    return jax.make_array_from_callback(
+                        arr.shape, built_leaf.sharding,
+                        lambda idx, a=arr: np.ascontiguousarray(
+                            a[idx]
+                        ).reshape(np.shape(a[idx])),
+                    )
+                return jax.device_put(arr, built_leaf.sharding)
             return host_leaf
 
         self.state = jax.tree.map(place, host_state, self.state)

@@ -390,6 +390,200 @@ class TestShardedCheckpoint:
         assert a == b
 
 
+class TestCheckpointIntegrity:
+    """End-to-end checkpoint integrity (the robustness-PR tentpole's third
+    leg): every save records a sha256; discovery and restore verify it; a
+    corrupted newest checkpoint loses to the previous complete one instead
+    of crashing the resume or loading garbage."""
+
+    def _save(self, tmp_path, epoch, value=1.0):
+        state = {"w": np.full(8, value, np.float32), "step": np.int64(epoch)}
+        return checkpoint.save_checkpoint(str(tmp_path), state, epoch)
+
+    def test_save_writes_digest_sidecar(self, tmp_path):
+        path = self._save(tmp_path, 1)
+        sidecar = path + checkpoint.DIGEST_SUFFIX
+        assert os.path.exists(sidecar)
+        assert checkpoint.file_intact(path)
+        import hashlib
+
+        with open(path, "rb") as f:
+            assert open(sidecar).read().strip() == hashlib.sha256(
+                f.read()
+            ).hexdigest()
+
+    def test_corrupt_file_detected_and_restore_refuses(self, tmp_path):
+        from horovod_tpu.testing import faults
+
+        path = self._save(tmp_path, 1)
+        template = {"w": np.zeros(8, np.float32), "step": np.int64(0)}
+        faults.corrupt_file(path)
+        assert not checkpoint.file_intact(path)
+        with pytest.raises(checkpoint.CheckpointCorruptError, match="sha256"):
+            checkpoint.restore(path, template)
+
+    def test_legacy_file_without_sidecar_accepted(self, tmp_path):
+        path = self._save(tmp_path, 1)
+        os.remove(path + checkpoint.DIGEST_SUFFIX)
+        assert checkpoint.file_intact(path)
+        restored = checkpoint.restore(
+            path, {"w": np.zeros(8, np.float32), "step": np.int64(0)}
+        )
+        np.testing.assert_array_equal(restored["w"], np.full(8, 1.0))
+
+    def test_latest_checkpoint_fallback_ordering(self, tmp_path):
+        """Table-driven: for each way the NEWEST checkpoint can be bad —
+        torn sharded dir, digest-mismatched sharded shard, corrupted
+        single file — discovery falls back to the previous complete epoch,
+        and resume discards the bad artifact
+        (`_discard_future_checkpoints`)."""
+        from horovod_tpu.parallel import mesh as mesh_lib
+        from horovod_tpu.testing import faults
+
+        hvt.init()
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+
+        def sharded_state(fill):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            val = np.full((8, 8), fill, np.float32)
+            return {
+                "w": jax.device_put(val, NamedSharding(mesh, P("data", None)))
+            }
+
+        def tear(path):
+            os.remove(os.path.join(path, "shard-0.msgpack"))
+
+        def corrupt_shard(path):
+            faults.corrupt_file(os.path.join(path, "shard-0.msgpack"))
+
+        cases = [
+            ("torn-sharded", True, tear),
+            ("digest-mismatched-shard", True, corrupt_shard),
+            ("corrupted-file", False, faults.corrupt_file),
+        ]
+        for name, sharded, damage in cases:
+            d = tmp_path / name
+            d.mkdir()
+            # Epoch 1: always a good single-file checkpoint.
+            checkpoint.save(
+                str(d / "checkpoint-1.msgpack"), {"w": np.ones((8, 8))}
+            )
+            # Epoch 2: the newest, damaged per the case.
+            if sharded:
+                newest = checkpoint.save_sharded(
+                    str(d / ("checkpoint-2" + checkpoint.SHARDED_SUFFIX)),
+                    sharded_state(2.0),
+                )
+            else:
+                newest = checkpoint.save(
+                    str(d / "checkpoint-2.msgpack"), {"w": np.ones((8, 8))}
+                )
+            assert checkpoint.latest_checkpoint(str(d)).endswith(
+                os.path.basename(newest)
+            ), name
+            damage(newest)
+            got = checkpoint.latest_checkpoint(str(d))
+            assert got and got.endswith("checkpoint-1.msgpack"), name
+            # The full resume path agrees AND removes the bad artifact so
+            # the retrained epoch can never mix generations with it.
+            restored, epoch = checkpoint.restore_latest_and_broadcast(
+                str(d), {"w": np.zeros((8, 8), np.float32)}
+            )
+            assert epoch == 1, name
+            np.testing.assert_array_equal(restored["w"], np.ones((8, 8)))
+            assert not os.path.exists(newest), name
+            if not sharded:
+                assert not os.path.exists(
+                    newest + checkpoint.DIGEST_SUFFIX
+                ), name
+
+    def test_corrupt_fault_targets_newest_payload(self, tmp_path, monkeypatch):
+        """`HVT_FAULT=...:corrupt` unit: the fault finds the newest payload
+        (never a .sha256 sidecar), damages it so integrity fails, and
+        SIGKILLs itself."""
+        import signal as signal_mod
+
+        from horovod_tpu.testing import faults
+
+        p1 = self._save(tmp_path, 1)
+        import time as time_mod
+
+        os.utime(p1 + checkpoint.DIGEST_SUFFIX, None)  # sidecar newest
+        time_mod.sleep(0.01)
+        p2 = self._save(tmp_path, 2)
+        os.utime(p2 + checkpoint.DIGEST_SUFFIX, None)
+        target = faults.newest_checkpoint_file(str(tmp_path))
+        assert target == p2  # payload, not its newer sidecar
+        monkeypatch.setenv("PS_MODEL_PATH", str(tmp_path))
+        killed = []
+        monkeypatch.setattr(
+            os, "kill", lambda pid, sig: killed.append((pid, sig))
+        )
+        cb = faults.FaultInjectionCallback(faults.parse_plan("0:0:corrupt"))
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)
+        assert killed == [(os.getpid(), signal_mod.SIGKILL)]
+        assert not checkpoint.file_intact(p2)
+        assert checkpoint.file_intact(p1)
+        assert checkpoint.latest_checkpoint(str(tmp_path)).endswith(
+            "checkpoint-1.msgpack"
+        )
+
+
+class TestAsyncSaveErrorSurfacing:
+    """A save thread that raised must surface at every consumption point —
+    join(), is_alive(), and the next ModelCheckpoint epoch — never vanish
+    (a checkpoint that silently failed to write looks successful)."""
+
+    def _failing_async_save(self, tmp_path):
+        # The payload path IS a directory: the atomic os.replace inside
+        # save() fails on the worker thread, after the snapshot succeeded
+        # on the caller thread.
+        target = tmp_path / "checkpoint-1.msgpack"
+        target.mkdir()
+        return checkpoint.save_async(
+            str(target), {"w": np.ones(4, np.float32)}
+        )
+
+    def _wait_done(self, t):
+        t._t.join(timeout=30)
+        assert not t._t.is_alive()
+
+    def test_join_reraises(self, tmp_path):
+        t = self._failing_async_save(tmp_path)
+        self._wait_done(t)
+        with pytest.raises(OSError):
+            t.join()
+
+    def test_is_alive_reraises_after_death(self, tmp_path):
+        t = self._failing_async_save(tmp_path)
+        self._wait_done(t)
+        with pytest.raises(OSError):
+            t.is_alive()
+        # The failure is kept, not consumed: a later join raises again.
+        with pytest.raises(OSError):
+            t.join()
+
+    def test_model_checkpoint_next_epoch_reraises(self, tmp_path):
+        """async_save=True: epoch N's failed write surfaces at epoch N+1's
+        on_epoch_end (which joins the pending write before starting the
+        next), and again at train end."""
+        from types import SimpleNamespace
+
+        (tmp_path / "checkpoint-1.msgpack").mkdir()  # epoch-1 write fails
+        cb = hvt.callbacks.ModelCheckpoint(
+            str(tmp_path / "checkpoint-{epoch}.msgpack"), async_save=True
+        )
+        cb.set_trainer(SimpleNamespace(state={"w": np.ones(4, np.float32)}))
+        cb.on_epoch_end(0)  # starts the doomed async write
+        self._wait_done(cb._pending)
+        with pytest.raises(OSError):
+            cb.on_epoch_end(1)
+        with pytest.raises(OSError):
+            cb.on_train_end()
+
+
 def test_backward_passes_per_step_accumulates():
     """Horovod's gradient-accumulation argument: N passes of batch B must
     equal 1 pass of batch N*B (mean semantics) for a linear model + SGD."""

@@ -402,7 +402,7 @@ class TestElasticState:
         monkeypatch.setattr(jax, "process_count", lambda: 2)
         monkeypatch.setattr(
             state_mod.collectives, "allgather_object",
-            lambda v: [v, (v[0], v[1], "diverged-digest")],
+            lambda v: [v, (v[0], v[1], "diverged-digest", False)],
         )
         sent = []
         monkeypatch.setattr(
@@ -425,7 +425,7 @@ class TestElasticState:
         monkeypatch.setattr(jax, "process_count", lambda: 2)
         monkeypatch.setattr(
             state_mod.collectives, "allgather_object",
-            lambda v: [v, (None, -1, None)],
+            lambda v: [v, (None, -1, None, False)],
         )
         sent = []
 
@@ -438,6 +438,239 @@ class TestElasticState:
         )
         s.sync(root_rank=0)
         assert len(sent) == 1 and sent[0]["epoch"] == 2
+
+
+class TestShardedCommit:
+    """Per-shard elastic commit for cross-process-sharded (ZeRO-1/TP/FSDP)
+    state. Real cross-process arrays cannot exist in a single test process,
+    so these units drive the classification through a patched
+    `_is_cross_process` with duck-typed fake arrays; the real 3-proc
+    ZeRO-1 shrink is proven end-to-end in test_elastic_sharded_e2e.py."""
+
+    @staticmethod
+    def _fake_sharded(full: "np.ndarray", lo: int, hi: int):
+        """A fake jax.Array holding rows [lo:hi) of ``full`` as its only
+        owned (replica-0) shard."""
+        from types import SimpleNamespace
+
+        import numpy as np
+
+        return SimpleNamespace(
+            shape=full.shape,
+            dtype=full.dtype,
+            addressable_shards=[SimpleNamespace(
+                index=(slice(lo, hi),) + tuple(
+                    slice(0, d) for d in full.shape[1:]
+                ),
+                replica_id=0,
+                data=np.ascontiguousarray(full[lo:hi]),
+            )],
+        )
+
+    def _patch(self, monkeypatch):
+        from types import SimpleNamespace
+
+        from horovod_tpu.elastic import state as state_mod
+
+        monkeypatch.setattr(
+            state_mod, "_is_cross_process",
+            lambda l: isinstance(l, SimpleNamespace),
+        )
+        return state_mod
+
+    def test_commit_snapshots_owned_pieces_with_digests(self, monkeypatch):
+        import hashlib
+
+        import numpy as np
+
+        from horovod_tpu.elastic.state import ShardedLeaf
+
+        self._patch(monkeypatch)
+        full = np.arange(24, dtype=np.float32).reshape(6, 4)
+        s = ElasticState(
+            state={"w": self._fake_sharded(full, 0, 2), "b": np.ones(3)},
+            epoch=1,
+        )
+        s.commit()
+        leaf = s._committed["state"]["w"]
+        assert isinstance(leaf, ShardedLeaf)
+        assert leaf.shape == (6, 4) and leaf.dtype == "float32"
+        np.testing.assert_array_equal(leaf.pieces["0:2,0:4"], full[0:2])
+        assert leaf.digests["0:2,0:4"] == hashlib.sha256(
+            full[0:2].tobytes()
+        ).hexdigest()
+        # Dense leaves commit dense, untouched by the sharded path.
+        np.testing.assert_array_equal(s._committed["state"]["b"], np.ones(3))
+        assert s.has_sharded_commit
+        man = s.manifest()
+        sharded = [e for e in man["leaves"] if e["sharded"]]
+        assert len(sharded) == 1
+        assert sharded[0]["shape"] == [6, 4]
+        assert sharded[0]["pieces"] == ["0:2,0:4"]
+        assert man["progress"] == s.progress
+
+    @staticmethod
+    def _contribution(m):
+        """What one member sends into the gather — the wire contract:
+        ``{leaf_index|index_spec: piece}`` plus the matching digests."""
+        import jax
+
+        from horovod_tpu.elastic.state import ShardedLeaf
+
+        leaves, _ = jax.tree_util.tree_flatten(m._committed)
+        payload, digests = {}, {}
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, ShardedLeaf):
+                for spec, piece in leaf.pieces.items():
+                    payload[f"{i}|{spec}"] = piece
+                    digests[f"{i}|{spec}"] = leaf.digests[spec]
+        return payload, digests
+
+    def test_gather_reassembles_across_members(self, monkeypatch):
+        """Three members each commit one third; after the gather every
+        member holds the dense global array — the 3→2 shrink keeps the
+        leaver's third."""
+        import numpy as np
+
+        state_mod = self._patch(monkeypatch)
+        full = np.arange(24, dtype=np.float32).reshape(6, 4)
+        members = [
+            ElasticState(state={"w": self._fake_sharded(full, lo, hi)},
+                         epoch=2)
+            for lo, hi in ((0, 2), (2, 4), (4, 6))
+        ]
+        for m in members:
+            m.commit()
+        everyone = [self._contribution(m) for m in members]
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda obj: list(everyone),
+        )
+        for m in members:
+            m.gather_committed()
+            np.testing.assert_array_equal(m._committed["state"]["w"], full)
+            assert not m.has_sharded_commit
+            assert m.progress == progress_marker(2)  # progress untouched
+
+    def test_gather_missing_coverage_is_loud(self, monkeypatch):
+        """Pieces that no longer tile the array (a hard death took them)
+        must raise the actionable fallback error, not return garbage."""
+        import numpy as np
+
+        state_mod = self._patch(monkeypatch)
+        full = np.arange(12, dtype=np.float32).reshape(6, 2)
+        m = ElasticState(state={"w": self._fake_sharded(full, 0, 2)})
+        m.commit()
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object", lambda obj: [obj]
+        )
+        with pytest.raises(RuntimeError, match="checkpoint"):
+            m.gather_committed()
+
+    def test_gather_detects_corrupt_piece(self, monkeypatch):
+        import numpy as np
+
+        state_mod = self._patch(monkeypatch)
+        full = np.arange(8, dtype=np.float32).reshape(2, 4)
+        m = ElasticState(state={"w": self._fake_sharded(full, 0, 2)})
+        m.commit()
+
+        def corrupting_allgather(obj):
+            payload, digests = obj
+            bad = {k: v.copy() for k, v in payload.items()}
+            next(iter(bad.values()))[0] += 1.0  # transport flipped a value
+            return [(bad, digests)]
+
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object", corrupting_allgather
+        )
+        with pytest.raises(RuntimeError, match="sha256"):
+            m.gather_committed()
+
+    def test_sync_gathers_sharded_votes_then_skips(self, monkeypatch):
+        """A residual sharded commit at sync time is reassembled across
+        the current membership first; with every member then holding the
+        same dense bytes, the model-sized transport is still skipped."""
+        import jax
+        import numpy as np
+
+        state_mod = self._patch(monkeypatch)
+        full = np.arange(12, dtype=np.float32).reshape(6, 2)
+        m = ElasticState(state={"w": self._fake_sharded(full, 0, 6)},
+                         epoch=3)
+        m.commit()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object", lambda obj: [obj, obj]
+        )
+
+        def no_transport(*a, **k):
+            raise AssertionError("transport must be skipped")
+
+        monkeypatch.setattr(
+            state_mod.collectives, "broadcast_pytree", no_transport
+        )
+        monkeypatch.setattr(
+            state_mod.collectives, "broadcast_object", no_transport
+        )
+        m.sync(root_rank=0)
+        np.testing.assert_array_equal(m.state["w"], full)
+        assert m.epoch == 3
+
+    def test_gather_force_participates_without_sharded_commit(
+        self, monkeypatch
+    ):
+        """Lockstep discipline: when sync sees ANY sharded vote, every
+        member — including one with no sharded commit, or no commit at
+        all — must enter the gather's allgather (with an empty
+        contribution), or the collective wedges."""
+        import numpy as np
+
+        from horovod_tpu.elastic import state as state_mod
+
+        calls = []
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda obj: calls.append(obj) or [obj],
+        )
+        empty = ElasticState()
+        empty.gather_committed(force=True)
+        dense = ElasticState(state={"w": np.ones(3)})
+        dense.commit()
+        dense.gather_committed(force=True)
+        assert calls == [({}, {})] * 2   # both participated, empty-handed
+        np.testing.assert_array_equal(
+            dense._committed["state"]["w"], np.ones(3)
+        )
+        # Without force, no sharded commit = communication-free no-op.
+        calls.clear()
+        dense.gather_committed()
+        empty.gather_committed()
+        assert calls == []
+
+    def test_validate_committable_strided_is_loud(self, monkeypatch):
+        from types import SimpleNamespace
+
+        import numpy as np
+
+        state_mod = self._patch(monkeypatch)
+        bad = SimpleNamespace(
+            shape=(8,),
+            dtype=np.float32,
+            addressable_shards=[SimpleNamespace(
+                index=(slice(0, 8, 2),), replica_id=0,
+                data=np.zeros(4, np.float32),
+            )],
+        )
+        with pytest.raises(RuntimeError, match="--max-restarts"):
+            state_mod.validate_committable({"w": bad}, where="elastic.run")
+
+    def test_validate_committable_accepts_dense(self):
+        import numpy as np
+
+        from horovod_tpu.elastic.state import validate_committable
+
+        validate_committable({"w": np.zeros(4)})  # no raise
 
 
 class TestLeaveFault:
@@ -907,6 +1140,95 @@ class TestWiring:
         plan = faults.parse_plan(spec["job"]["env"]["HVT_FAULT"])
         assert plan.kind == "leave"
         assert spec["checks"]["loss"]["target"] == "0.0..0.3"
+        assert spec["journal_checks"]["shrink"]["aggregate"] == "count"
+
+    def test_shipped_sharded_elastic_job_spec_parses(self):
+        """The ZeRO-1 sibling job: same elastic/restart grammar, the
+        ELASTIC_ZERO1 knob on, the same unchanged loss gate, plus the
+        in-spec journal gates."""
+        import yaml
+
+        spec_path = os.path.join(
+            REPO, "horovod_tpu", "launch", "jobs",
+            "mnist-elastic-sharded-2proc.yaml",
+        )
+        with open(spec_path) as f:
+            spec = yaml.safe_load(f)
+        elastic = ElasticPolicy.from_mapping(spec["job"]["elastic"])
+        assert elastic.min_ranks == 2 and elastic.max_ranks == 3
+        RestartPolicy.from_mapping(
+            {k: v for k, v in spec["job"]["restart"].items() if k != "log"}
+        )
+        from horovod_tpu.testing import faults
+
+        assert spec["job"]["env"]["ELASTIC_ZERO1"] == "1"
+        plan = faults.parse_plan(spec["job"]["env"]["HVT_FAULT"])
+        assert plan.kind == "leave" and plan.rank == 2
+        # Elasticity + sharding must not move the convergence bar.
+        assert spec["checks"]["loss"]["target"] == "0.0..0.3"
+        assert spec["journal_checks"]["shrink"]["aggregate"] == "count"
+        assert (
+            spec["journal_checks"]["supervisor_gave_up"]["target"] == "0..0"
+        )
+
+    def test_job_journal_checks_gate(self, tmp_path, monkeypatch):
+        """journal_checks: evaluated against the restart journal — passes
+        when the journaled lifecycle matches, fails the job when it
+        doesn't, and fails loudly without a supervised launch."""
+        import textwrap as tw
+
+        from horovod_tpu.launch import job as job_lib
+
+        def fake_supervise(nprocs, argv, env=None, policy=None,
+                           elastic=None, log_path=None):
+            log = supervisor.RestartLog(log_path)
+            log.touch()
+            if env.get("DO_SHRINK") == "1":
+                log.write("shrink", 2.0, generation=2, size=2)
+            return 0
+
+        monkeypatch.setattr(supervisor, "supervise_elastic", fake_supervise)
+
+        def write_spec(name, do_shrink):
+            spec = tmp_path / name
+            spec.write_text(tw.dedent(f"""
+                name: jc-test
+                job:
+                  command: python train.py
+                  nprocs: 2
+                  elastic:
+                    min_ranks: 1
+                  env:
+                    PS_MODEL_PATH: {tmp_path / name}.models
+                    DO_SHRINK: "{do_shrink}"
+                journal_checks:
+                  shrink:
+                    target: "1..9"
+                    aggregate: count
+            """))
+            return str(spec)
+
+        assert job_lib.run_job(write_spec("pass.yaml", 1)) == 0
+        assert job_lib.run_job(write_spec("fail.yaml", 0)) == 1
+
+    def test_job_journal_checks_require_supervised_launch(
+        self, tmp_path, monkeypatch
+    ):
+        import textwrap as tw
+
+        from horovod_tpu.launch import job as job_lib
+
+        monkeypatch.setattr(launcher, "run_local", lambda *a, **k: 0)
+        spec = tmp_path / "job.yaml"
+        spec.write_text(tw.dedent("""
+            name: jc-unsupervised
+            job:
+              command: python train.py
+              nprocs: 1
+            journal_checks:
+              shrink: {target: "1..9", aggregate: count}
+        """))
+        assert job_lib.run_job(str(spec)) == 1
 
 
 class TestWorldInfo:

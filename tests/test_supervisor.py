@@ -325,6 +325,77 @@ class TestGateOnJournal:
         assert ok and value == 0.0
 
 
+class TestRestartLogRotation:
+    """Journal rotation for long-lived fleets: past the size/line bound the
+    live file rotates to ``<path>.1`` (one predecessor kept), and every
+    reader — fleet_status, the CI gate's count aggregate — reads across
+    the rotation boundary."""
+
+    def test_rotates_at_max_lines_keeping_one_predecessor(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"), max_lines=3)
+        for i in range(8):
+            log.write("restarts", float(i + 1), attempt=i + 1)
+        live = _records(log.path)
+        prev = _records(log.path + ".1")
+        # Exactly two windows on disk, nothing lost in the newest two.
+        assert len(prev) == 3
+        assert [r["value"] for r in prev] + [r["value"] for r in live] == [
+            4.0, 5.0, 6.0, 7.0, 8.0
+        ]
+
+    def test_rotates_at_max_bytes(self, tmp_path):
+        log = supervisor.RestartLog(
+            str(tmp_path / "j.jsonl"), max_lines=0, max_bytes=1
+        )
+        log.write("restarts", 1.0)
+        log.write("restarts", 2.0)
+        assert os.path.exists(log.path + ".1")
+
+    def test_env_zero_disables_rotation(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HVT_RESTART_LOG_MAX_LINES", "0")
+        monkeypatch.setenv("HVT_RESTART_LOG_MAX_MB", "0")
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        for i in range(50):
+            log.write("restarts", float(i))
+        assert not os.path.exists(log.path + ".1")
+        assert len(_records(log.path)) == 50
+
+    def test_ci_gate_counts_across_rotation(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"), max_lines=2)
+        for i in range(5):
+            log.write("shrink", float(i + 1), generation=i)
+        # Two windows survive: the .1 predecessor (writes 3-4) + the live
+        # file (write 5); the oldest window (writes 1-2) rotated away.
+        ok, value = ci_gate.check_metrics(
+            str(log.path), "shrink", (3.0, 3.0), how="count")
+        assert ok and value == 3.0
+
+    def test_ci_gate_accepts_rotated_away_live_file(self, tmp_path):
+        """Right after a rotation the live file may not exist yet; the
+        stream still counts as present via its .1 predecessor."""
+        p = tmp_path / "j.jsonl"
+        (tmp_path / "j.jsonl.1").write_text(
+            json.dumps({"name": "restarts", "value": 1.0}) + "\n"
+        )
+        ok, value = ci_gate.check_metrics(
+            str(p), "restarts", (1.0, 1.0), how="count")
+        assert ok and value == 1.0
+
+    def test_fleet_status_reads_across_rotation(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"), max_lines=2)
+        log.write("start", 3.0, generation=1, size=3)
+        log.write("restarts", 1.0, member="m1", kind="leave")
+        # rotation happens here (2 lines reached)
+        log.write("shrink", 2.0, generation=2, size=2)
+        assert os.path.exists(log.path + ".1")
+        status = supervisor.fleet_status(log.path)
+        assert status["generation"] == 2 and status["size"] == 2
+        assert status["restarts"] == 1 and status["shrinks"] == 1
+        assert [e["name"] for e in status["events"]] == [
+            "start", "restarts", "shrink"
+        ]
+
+
 class TestFleet:
     def test_abort_terminates_and_marks(self):
         proc = subprocess.Popen(
