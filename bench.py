@@ -29,6 +29,11 @@ Modes (BENCH_MODEL):
   seq2seq     encoder-decoder (models/seq2seq.py, d512 x 6enc+6dec, seq
               1024): bidirectional encoder + causal decoder + cross-
               attention (the flash kernel's Tk≠Tq grids) — tokens/sec
+  accum       gradient-accumulation A/B on the LM config: K=1 vs
+              K=BENCH_ACCUM_K (default 4) backward_passes_per_step —
+              tokens/sec plus cross-worker reduction calls per OPTIMIZER
+              step counted in the compiled step (the accumulating step
+              must show exactly one bucketed boundary reduction)
   decode      autoregressive generation (KV-cache prefill + scan decode
               loop, models/decoding.py) — generated tokens/sec
   spec        speculative decoding A/B (models/speculative.py): trains a
@@ -479,6 +484,139 @@ def bench_train(which: str) -> dict:
         },
         "n_chips": n_chips,
         **extra_metrics,
+    }
+
+
+def _reduction_calls(hlo: str) -> int:
+    """Cross-worker GRADIENT reduction ops in a compiled step's HLO text:
+    all-reduce (sync or -start; -done is the same op's completion) with a
+    non-scalar operand — scalar all-reduces are the loss/accuracy metric
+    means, which exist on every path and aren't gradient traffic."""
+    import re
+
+    count = 0
+    for line in hlo.splitlines():
+        if "all-reduce-done" in line:
+            continue
+        m = re.search(r"\ball-reduce(?:-start)?\(", line)
+        if not m:
+            continue
+        # The result type precedes the op name: non-scalar iff any shaped
+        # dimension appears in it (f32[262144]{0} yes, f32[] no; tuple
+        # types count once — one launched collective).
+        if re.search(r"\[\d", line[: m.start()]):
+            count += 1
+    return count
+
+
+def bench_accum() -> dict:
+    """Gradient-accumulation A/B (Horovod's ``backward_passes_per_step``):
+    K=1 vs K=BENCH_ACCUM_K (default 4) on the LM training config.
+
+    Reports tokens/sec/chip for both runs and, the load-bearing number,
+    cross-worker reduction calls per OPTIMIZER step from the compiled
+    step's HLO: the K=1 step carries XLA's per-step gradient reduction,
+    the accumulating step must show exactly the bucket count (one large
+    fused reduction at default bucket bytes) regardless of K — gradient
+    communication per sample divided by K. Same honesty rules as the
+    training benches: one fused scan per timed fetch (_timed)."""
+    os.environ.setdefault("HVT_FAST_RNG", "1")
+
+    import jax
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvt
+    from horovod_tpu.data import datasets
+
+    hvt.init()
+    n_chips = jax.device_count()
+    K = max(2, int(os.environ.get("BENCH_ACCUM_K", 4)))
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", 1024))
+    per_chip_batch = int(os.environ.get("BENCH_LM_BATCH", 8))
+    x, y = datasets.copy_task(4096, seq_len, vocab_size=8192)
+    n_steps = int(os.environ.get("BENCH_STEPS", 16))  # optimizer steps
+    global_batch = per_chip_batch * n_chips
+
+    def measure(k: int) -> tuple:
+        trainer = hvt.Trainer(
+            _lm_from_env(),
+            hvt.DistributedOptimizer(
+                optax.adamw(hvt.scale_lr(3e-4)),
+                backward_passes_per_step=k,
+                # Mean over the K passes: the effective LR then matches
+                # the K=1 leg, so the A/B compares communication, not
+                # optimization trajectories.
+                average_aggregated_gradients=True,
+            ),
+            loss="sparse_categorical_crossentropy",
+        )
+        rng = np.random.RandomState(0)
+
+        def draw():
+            idx = rng.randint(0, len(x), size=global_batch)
+            return x[idx], y[idx]
+
+        def step_batch():
+            # One optimizer step's feed: [G, T] for k=1, a [k, G, T]
+            # microbatch stack for the accumulating step.
+            if k == 1:
+                return draw()
+            micro = [draw() for _ in range(k)]
+            return tuple(np.stack([m[i] for m in micro]) for i in range(2))
+
+        sample = draw()
+        state = trainer.build(sample[0])
+        state = hvt.broadcast_parameters(state, mesh=trainer.mesh)
+        scale = np.float32(1.0)
+        zero_acc = {m: np.float32(0) for m in trainer.metric_names}
+        # Reduction count from the compiled SINGLE step (before the mega
+        # run donates the state's buffers).
+        one = step_batch()
+        dev_one = (
+            trainer._shard(one) if k == 1 else trainer._shard_chunk(one, 1)
+        )
+        hlo = trainer._train_step.lower(
+            state, dev_one, scale, zero_acc
+        ).compile().as_text()
+        reductions = _reduction_calls(hlo)
+        # Timed leg: ONE fused scan over n_steps optimizer steps.
+        steps = [step_batch() for _ in range(n_steps)]
+        mega = tuple(np.stack([s[i] for s in steps]) for i in range(2))
+        dev_mega = trainer._shard_chunk(mega, 2 if k > 1 else 1)
+        compiled = trainer._train_chunk.lower(
+            state, dev_mega, scale, zero_acc
+        ).compile()
+        w_state, _, w_acc = compiled(state, dev_mega, scale, zero_acc)
+        float(jax.device_get(w_acc["loss"]))
+        holder = {"state": w_state}
+
+        def run():
+            holder["state"], _, acc = compiled(
+                holder["state"], dev_mega, scale, zero_acc
+            )
+            return acc["loss"]
+
+        sec_per_opt_step = _timed(run) / n_steps
+        tokens_per_opt_step = k * global_batch * seq_len
+        return tokens_per_opt_step / sec_per_opt_step / n_chips, reductions
+
+    tok_k1, red_k1 = measure(1)
+    tok_kn, red_kn = measure(K)
+    return {
+        "metric": "accum_train_tokens_per_sec_per_chip",
+        "value": round(tok_kn, 1),
+        "unit": "tokens/sec/chip",
+        "k": K,
+        "k1_tokens_per_sec_per_chip": round(tok_k1, 1),
+        "speedup": round(tok_kn / tok_k1, 2),
+        # K=1: XLA's implicit reduction, per microbatch == per step.
+        # K=N: the single bucketed boundary reduction — per-sample
+        # gradient communication divided by N.
+        "reduction_calls_per_opt_step": {"k1": red_k1, f"k{K}": red_kn},
+        "per_chip_batch": per_chip_batch,
+        "seq_len": seq_len,
+        "n_chips": n_chips,
     }
 
 
@@ -959,6 +1097,8 @@ def main() -> None:
         result = bench_serve()
     elif which == "int8":
         result = bench_int8_compute()
+    elif which == "accum":
+        result = bench_accum()
     elif which == "decode":
         result = bench_decode()
     elif which == "spec":

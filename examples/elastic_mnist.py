@@ -85,12 +85,23 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
         .batch(per_process_batch)
     )
 
+    # HVT_BACKWARD_PASSES=K: gradient accumulation (K microbatch passes per
+    # optimizer step, one boundary reduction). Elastic commits stay aligned
+    # by construction — the K-microbatch scan runs inside the compiled
+    # step, so `commit_every_steps` commits (ElasticStateCallback below,
+    # cadence via the job spec's elastic: block) can never land
+    # mid-accumulation. Not composed with ELASTIC_ZERO1 (shard_update and
+    # accumulation are mutually exclusive — Trainer fails fast).
+    backward_passes = int(os.environ.get("HVT_BACKWARD_PASSES", 1) or 1)
     trainer = hvt.Trainer(
         MnistCNN(),
         # lr = 0.001 × size: rebuilt each generation, so the effective LR
         # rescales with the world exactly like Horovod Elastic's
         # reset-on-rescale optimizer.
-        hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(0.001))),
+        hvt.DistributedOptimizer(
+            optax.adam(hvt.scale_lr(0.001)),
+            backward_passes_per_step=backward_passes,
+        ),
         loss="sparse_categorical_crossentropy",
         # ZeRO-1: optimizer state sharded over the data axis — with one
         # chip per process this is CROSS-PROCESS sharding, the layout the

@@ -73,10 +73,17 @@ def main() -> None:
         .batch(per_process_batch)
     )
 
+    # HVT_BACKWARD_PASSES=K (job-spec env surface): Horovod's gradient
+    # accumulation — K microbatch passes per optimizer update, one
+    # cross-worker reduction per K passes (effective batch K×128/worker).
+    backward_passes = int(os.environ.get("HVT_BACKWARD_PASSES", 1) or 1)
     trainer = hvt.Trainer(
         MnistCNN(compute_dtype=jnp.bfloat16),
         # Adam(0.001 × size) (:55) wrapped for gradient averaging (:58).
-        hvt.DistributedOptimizer(optax.adam(hvt.scale_lr(0.001))),
+        hvt.DistributedOptimizer(
+            optax.adam(hvt.scale_lr(0.001)),
+            backward_passes_per_step=backward_passes,
+        ),
         loss="sparse_categorical_crossentropy",  # :63
     )
 

@@ -338,8 +338,14 @@ def save_sharded(path: str, state: PyTree) -> str:
                 jax.tree_util.keystr(p) for p, _ in paths_and_leaves
             ],
         }
+        # digest=True: the index gets its own .sha256 sidecar like every
+        # payload file — a bit-rotted index would otherwise misdirect the
+        # whole restore (wrong n_processes tears discovery; corrupted
+        # leaf_names could mis-verify structure) while every shard file
+        # still verified clean.
         _atomic_write(
-            os.path.join(path, INDEX_FILE), json.dumps(index).encode()
+            os.path.join(path, INDEX_FILE), json.dumps(index).encode(),
+            digest=True,
         )
     return path
 
@@ -359,12 +365,16 @@ def save_sharded_async(path: str, state: PyTree) -> _SaveThread:
 
 def _sharded_complete(path: str) -> bool:
     """A sharded checkpoint is usable iff the index and every per-process
-    shard file landed (each lands atomically) AND every shard file still
-    matches its recorded digest, so a shard corrupted after landing loses
-    discovery to the previous complete epoch exactly like a missing
-    one."""
+    shard file landed (each lands atomically) AND every file — the index
+    included — still matches its recorded digest, so anything corrupted
+    after landing loses discovery to the previous complete epoch exactly
+    like a missing file (indexes without a sidecar are legacy-accepted,
+    same as payloads)."""
+    ipath = os.path.join(path, INDEX_FILE)
+    if not file_intact(ipath):
+        return False
     try:
-        with open(os.path.join(path, INDEX_FILE)) as f:
+        with open(ipath) as f:
             n = int(json.load(f)["n_processes"])
     except (OSError, ValueError, KeyError):
         return False
@@ -425,8 +435,9 @@ def restore_sharded(path: str, template: PyTree, *,
     still take the piece-by-piece fast path. Costs one host-RAM copy of the
     largest leaf; leave False (the default) to keep topology drift loud on
     ordinary resumes."""
-    with open(os.path.join(path, INDEX_FILE)) as f:
-        index = json.load(f)
+    # Digest-verified like every shard read below: a corrupt index must
+    # raise CheckpointCorruptError, not steer the restore with garbage.
+    index = json.loads(_read_verified(os.path.join(path, INDEX_FILE)))
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = [l for _, l in paths_and_leaves]
     if len(leaves) != index["leaf_count"]:

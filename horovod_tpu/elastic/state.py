@@ -445,6 +445,29 @@ class ElasticStateCallback(Callback):
     membership change always commits first regardless of cadence — the
     boundary is clean, so the just-finished epoch is never thrown away.
 
+    ``commit_every_steps``: ADDITIONALLY commit every N optimizer steps
+    within an epoch (0 = epoch-cadence only) — the sub-epoch cadence for
+    long epochs. Commits land at ``on_batch_end``, which the STREAMED fit
+    path fires once per optimizer step (per chunk with
+    ``steps_per_execution>1`` — the cadence then commits at the next
+    chunk boundary past N), so a hard crash there restores at most
+    N (+chunk) steps behind instead of a whole epoch. With gradient
+    accumulation (``backward_passes_per_step=K``) the K-microbatch scan
+    lives inside the compiled step, so a commit can never land
+    mid-accumulation with unreduced local grads: the alignment is
+    structural, not scheduled. Limitation: ``fit(cache='device')`` runs
+    the WHOLE epoch as one compiled scan and fires ``on_batch_end`` once
+    per epoch — commits there stay epoch-granular regardless of this
+    knob (sub-epoch cadence would require splitting the epoch program).
+    Mid-epoch commits record ``(epoch, step)`` progress
+    (`progress_marker` orders them under the epoch-end commit), which
+    drives root election after a crash; the training loop itself still
+    resumes at epoch granularity (``initial_epoch``), with the
+    freshest mid-epoch WEIGHTS. Defaults read the job-spec surface:
+    ``HVT_COMMIT_EVERY`` / ``HVT_COMMIT_EVERY_STEPS`` (set by the
+    supervisor from the ``elastic:`` block's ``commit_every`` /
+    ``commit_every_steps`` keys).
+
     SIGTERM: a handler installed for the duration of fit() records the
     signal as leave intent, so a scheduler preemption becomes a clean
     shrink at the next epoch boundary instead of a fleet abort. Don't
@@ -452,14 +475,27 @@ class ElasticStateCallback(Callback):
     the same signal."""
 
     def __init__(self, state: ElasticState, client, *,
-                 commit_every: int = 1, beat_interval: float = 1.0):
+                 commit_every: int | None = None,
+                 commit_every_steps: int | None = None,
+                 beat_interval: float = 1.0):
+        import os
+
         self.state = state
         self.client = client
+        if commit_every is None:
+            commit_every = int(os.environ.get("HVT_COMMIT_EVERY", 1) or 1)
         self.commit_every = max(1, int(commit_every))
+        if commit_every_steps is None:
+            commit_every_steps = int(
+                os.environ.get("HVT_COMMIT_EVERY_STEPS", 0) or 0
+            )
+        self.commit_every_steps = max(0, int(commit_every_steps))
         self.beat_interval = beat_interval
         self._last_beat = 0.0
         self._leave_requested = False
         self._old_handler = None
+        self._epoch = 0
+        self._last_commit_step = 0
 
     # --- liveness ----------------------------------------------------------
 
@@ -500,10 +536,28 @@ class ElasticStateCallback(Callback):
             self._old_handler = None
 
     def on_epoch_begin(self, epoch: int, logs=None):
+        self._epoch = epoch
+        self._last_commit_step = 0
         self._beat(force=True)
 
     def on_batch_end(self, batch: int, logs=None):
         self._beat()
+        if not self.commit_every_steps:
+            return
+        # ``batch`` indexes OPTIMIZER steps (the Trainer fires this hook
+        # once per compiled execution — per optimizer step at
+        # steps_per_execution=1, per chunk otherwise), so a commit here is
+        # always at an accumulation boundary: K-microbatch accumulation
+        # runs INSIDE the step and never leaves unreduced local grads
+        # across the hook. >= (not ==) so steps_per_execution chunks that
+        # stride past the cadence still commit at the next boundary.
+        done = batch + 1
+        if done - self._last_commit_step >= self.commit_every_steps:
+            self._last_commit_step = done
+            self.state.state = self.trainer.state
+            self.state.epoch = self._epoch
+            self.state.step = done
+            self.state.commit()
 
     # --- the commit + agreement boundary -----------------------------------
 
@@ -537,13 +591,31 @@ class ElasticStateCallback(Callback):
         # down in lockstep (every rank of the generation reaches this
         # barrier — the votes above guarantee the same branch everywhere).
         self.state.commit()
-        if self.state.has_sharded_commit:
+        if self.state.has_sharded_commit and any_leaving:
             # Reassemble per-process pieces (ZeRO-1/TP/FSDP commits) while
             # every member of the OLD generation — including a clean
             # leaver — is still here: after the teardown below, a departed
             # member's share of the state is gone for good. Collective;
             # the sharded/dense classification is a function of the shared
-            # SPMD state, so every rank takes this branch together.
+            # SPMD state, so every rank takes this branch together, and
+            # any_leaving comes from the same allgather'd votes.
+            #
+            # Grow-only fast path: when NO member is departing (the
+            # generation bump is a joiner waiting in rendezvous — a hard
+            # death never reaches this agreement, it kills the collective
+            # above first), every piece's owner survives into the next
+            # generation, so the model-sized piece-allgather is deferred:
+            # survivors keep their compact sharded commits through the
+            # teardown, and `sync` on the new world sees the sharded
+            # votes and runs the lockstep reassembly there, which also
+            # covers the empty-handed joiners. Trade-off, accepted
+            # deliberately (ROADMAP PR 3 follow-up): a survivor dying
+            # HARD inside the teardown→sync window now takes its pieces
+            # with it — sync's reassembly then raises the actionable
+            # coverage error and the fleet falls back to the newest
+            # checkpoint, exactly the designed hard-death escalation
+            # (the same death DURING the old boundary gather lost the
+            # same progress; only the window is slightly wider).
             self.state.gather_committed()
         runtime.shutdown()
         if leaving:

@@ -95,6 +95,10 @@ def run_job(spec_path: str) -> int:
     #     min_ranks: 2            # smallest world to shrink to
     #     max_ranks: 3            # largest world to grow back to
     #     rendezvous_timeout: 60  # seconds a round waits for stragglers
+    #     commit_every: 1         # elastic commit cadence, epochs
+    #     commit_every_steps: 0   # sub-epoch cadence, optimizer steps
+    #                             # (0 = epoch cadence only; commits are
+    #                             # accumulation-boundary-aligned)
     # Composes with `restart:` for the budget/backoff/heartbeat knobs; the
     # journal (restart log) carries the generation-tagged shrink/grow
     # events the gate and /healthz read.

@@ -495,11 +495,22 @@ class ElasticPolicy:
     The fleet shrinks to survivors on a clean departure (down to
     ``min_ranks``) and grows back as replacements join (up to
     ``max_ranks``). ``rendezvous_timeout`` bounds how long a rendezvous
-    round waits for a member that will never arrive."""
+    round waits for a member that will never arrive.
+
+    ``commit_every`` (epochs) and ``commit_every_steps`` (optimizer steps
+    within an epoch; 0 = epoch cadence only) set the members' elastic
+    commit cadence: they travel to every member as ``HVT_COMMIT_EVERY`` /
+    ``HVT_COMMIT_EVERY_STEPS``, which `ElasticStateCallback` reads as its
+    defaults — so a job spec tunes the cadence without entry-script
+    changes. Sub-epoch commits are always aligned to gradient-accumulation
+    boundaries (the callback commits per optimizer step; see
+    `ElasticStateCallback.commit_every_steps`)."""
 
     min_ranks: int = 1
     max_ranks: int | None = None
     rendezvous_timeout: float = 60.0
+    commit_every: int = 1
+    commit_every_steps: int = 0
 
     @classmethod
     def from_mapping(cls, mapping) -> "ElasticPolicy":
@@ -519,6 +530,17 @@ class ElasticPolicy:
                 float(value) if key == "rendezvous_timeout" else int(value),
             )
         return policy
+
+    def commit_env(self) -> dict:
+        """The member-env overlay carrying the commit cadence (only the
+        non-default knobs, so an explicit ElasticStateCallback argument in
+        user code still wins when the spec says nothing)."""
+        env = {}
+        if self.commit_every != 1:
+            env["HVT_COMMIT_EVERY"] = str(self.commit_every)
+        if self.commit_every_steps:
+            env["HVT_COMMIT_EVERY_STEPS"] = str(self.commit_every_steps)
+        return env
 
 
 def _spawn_member_local(argv, env, member_id, slot, tag_output=True):
@@ -628,6 +650,7 @@ def supervise_elastic(
         journal=log.write,
     ).start()
     env[ENV_ELASTIC_COORDINATOR] = coord.address
+    env.update(elastic.commit_env())
     if spawn is None:
         spawn = lambda member_id, slot, env: _spawn_member_local(  # noqa: E731
             argv, env, member_id, slot, tag_output=tag_output

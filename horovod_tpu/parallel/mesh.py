@@ -204,6 +204,58 @@ def data_parallel_mesh(devices=None) -> Mesh:
     return build_mesh(MeshSpec(), devices)
 
 
+def dcn_factor(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """How many DCN-connected slice groups the named mesh axis spans.
+
+    1 on a single-slice (or CPU/virtual) mesh — the flat psum is then the
+    right gradient reduction. >1 means the axis was factored across slices
+    by `_device_array`'s hybrid layout (slice factor outermost, matching
+    `create_hybrid_device_mesh`), and the hierarchical two-hop reduction
+    (`collectives.hierarchical_psum`) can keep full-precision traffic on
+    ICI and pay the compression dtype only across DCN.
+
+    The factor is derived from the devices' actual ``slice_index`` layout
+    and only trusted when it matches the hybrid contract — the slice id
+    constant within each slab of the axis, changing in equal-length
+    contiguous outer blocks. Any other arrangement returns 1 (flat
+    reduction stays correct; hierarchy would be wrong, not just slow).
+
+    ``HVT_DCN_FACTOR=<n>`` overrides the derivation — the fake-topology
+    knob for benchmarking the two-hop path on single-slice hardware (and
+    for tests, where CPU devices carry no slice_index)."""
+    size = mesh.shape[axis]
+    env = os.environ.get("HVT_DCN_FACTOR")
+    if env:
+        dcn = int(env)
+        if dcn < 1 or size % dcn != 0:
+            raise ValueError(
+                f"HVT_DCN_FACTOR={dcn} must divide the {axis!r} axis size "
+                f"({size})"
+            )
+        return dcn
+    if size <= 1:
+        return 1
+    ax_pos = list(mesh.axis_names).index(axis)
+    devs = np.moveaxis(mesh.devices, ax_pos, 0).reshape(size, -1)
+    slabs = []
+    for i in range(size):
+        ids = {int(getattr(d, "slice_index", 0) or 0) for d in devs[i]}
+        if len(ids) != 1:
+            return 1  # slices cross OTHER axes too — no clean factoring
+        slabs.append(next(iter(ids)))
+    # Contiguous equal-length outer blocks of distinct slice ids?
+    boundaries = [i for i in range(1, size) if slabs[i] != slabs[i - 1]]
+    dcn = len(boundaries) + 1
+    if dcn == 1:
+        return 1
+    ici = size // dcn
+    if size % dcn != 0 or boundaries != [ici * k for k in range(1, dcn)]:
+        return 1
+    if len(set(slabs[::ici])) != dcn:
+        return 1  # a slice id repeats across blocks — not hybrid-ordered
+    return dcn
+
+
 def dp_size(mesh: Mesh) -> int:
     """Number of data-parallel workers (batch shards) in a mesh."""
     return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]

@@ -64,19 +64,23 @@ def shard_batch(batch: PyTree, mesh: Mesh) -> PyTree:
     )
 
 
-def chunk_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
-    """Sharding for a [K, batch, ...] stack of K batches (steps_per_execution):
-    the scan axis K is unsharded, the batch axis splits over data."""
+def chunk_sharding(mesh: Mesh, ndim: int, lead: int = 1) -> NamedSharding:
+    """Sharding for a stack of batches with ``lead`` unsharded leading axes
+    — [K, batch, ...] for steps_per_execution scans (lead=1), [C, K, batch,
+    ...] for chunked microbatch accumulation (lead=2). The batch axis after
+    the leading stack axes splits over data."""
     return NamedSharding(
-        mesh, P(None, (DATA_AXIS, FSDP_AXIS), *([None] * max(0, ndim - 2)))
+        mesh,
+        P(*([None] * lead), (DATA_AXIS, FSDP_AXIS),
+          *([None] * max(0, ndim - lead - 1))),
     )
 
 
-def shard_chunk(chunk: PyTree, mesh: Mesh) -> PyTree:
-    """Place a [K, batch, ...] host stack onto the mesh (see chunk_sharding);
+def shard_chunk(chunk: PyTree, mesh: Mesh, lead: int = 1) -> PyTree:
+    """Place a stacked host batch onto the mesh (see chunk_sharding);
     multi-process, each process contributes its local slice of every batch."""
     return jax.tree.map(
-        lambda x: put_global(x, chunk_sharding(mesh, np.asarray(x).ndim)),
+        lambda x: put_global(x, chunk_sharding(mesh, np.asarray(x).ndim, lead)),
         chunk,
     )
 

@@ -31,7 +31,12 @@ example/entry script is injectable unmodified). Kinds:
   writer-killed-mid-fsync / bit-rot shape. Drives the corruption-recovery
   path deterministically: the relaunched run must detect the digest
   mismatch and resume from the previous complete checkpoint instead of
-  crashing on (or silently loading) garbage.
+  crashing on (or silently loading) garbage. An optional target picks the
+  victim instead of the newest file: ``corrupt@epoch3`` hits epoch 3's
+  checkpoint artifact (testing fallback across a HISTORY of checkpoints,
+  not just the head), ``corrupt@shard1`` hits shard file 1 of the newest
+  sharded checkpoint (one process's shard rots, the others stay clean),
+  and ``corrupt@epoch3/shard1`` combines both.
 
 The fault fires at the first ``on_batch_end`` of the target epoch — mid-epoch
 by construction (after the epoch's checkpoint boundary, before the next), so
@@ -58,7 +63,8 @@ from horovod_tpu.training.callbacks import Callback
 ENV_FAULT = "HVT_FAULT"
 ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
 
-KINDS = ("kill", "hang", "leave", "corrupt")  # plus exitN (parse_plan)
+KINDS = ("kill", "hang", "leave", "corrupt")  # plus exitN and
+# corrupt@<target> (parse_plan / corrupt_target)
 
 # Process-wide leave intent (the `leave` fault kind under an elastic
 # launch). The elastic epoch-end agreement consumes it; tests reset it.
@@ -119,35 +125,76 @@ def parse_plan(spec: str) -> FaultPlan:
                     f"HVT_FAULT exit kind needs an integer code "
                     f"(exit1, exit143, ...), got {kind!r}"
                 ) from None
+        elif kind.startswith("corrupt@"):
+            corrupt_target(kind)  # validates; raises on a bad target
         else:
             raise ValueError(
-                f"HVT_FAULT kind must be kill, hang, leave, corrupt or "
-                f"exitN, got {kind!r}"
+                f"HVT_FAULT kind must be kill, hang, leave, corrupt[@"
+                f"epochN][/shardM] or exitN, got {kind!r}"
             )
     return FaultPlan(rank=rank, epoch=epoch, kind=kind)
 
 
-def newest_checkpoint_file(model_dir: str) -> str | None:
+def corrupt_target(kind: str) -> tuple:
+    """Parse a ``corrupt`` kind's optional target: ``corrupt`` →
+    ``(None, None)`` (the newest payload), ``corrupt@epoch3`` → ``(3,
+    None)``, ``corrupt@shard1`` → ``(None, 1)``, ``corrupt@epoch3/shard1``
+    → ``(3, 1)``."""
+    if kind == "corrupt":
+        return None, None
+    target = kind[len("corrupt@"):]
+    epoch = shard = None
+    for part in target.split("/"):
+        if part.startswith("epoch") and part[5:].isdigit():
+            epoch = int(part[5:])
+        elif part.startswith("shard") and part[5:].isdigit():
+            shard = int(part[5:])
+        else:
+            raise ValueError(
+                f"HVT_FAULT corrupt target must be epochN, shardM or "
+                f"epochN/shardM, got {target!r}"
+            )
+    return epoch, shard
+
+
+def newest_checkpoint_file(
+    model_dir: str, epoch: int | None = None, shard: int | None = None
+) -> str | None:
     """Newest checkpoint payload file under ``model_dir`` (recursive, so
     shard files inside ``*.shards/`` dirs count), by mtime. Digest
     sidecars are excluded — the ``corrupt`` fault damages payloads, not
     the record of what they should have been (corrupting the record would
-    also trigger recovery, but proves less)."""
+    also trigger recovery, but proves less).
+
+    ``epoch`` restricts candidates to that epoch's checkpoint artifact
+    (single file or shards dir); ``shard`` restricts to ``shard-{shard}``
+    files of sharded checkpoints (single-file checkpoints then never
+    match). Both None = the newest payload anywhere, the classic fault."""
     from horovod_tpu import checkpoint
 
     newest = None
     for root, _, files in os.walk(model_dir):
+        base = os.path.basename(root)
+        in_shards_dir = base.endswith(checkpoint.SHARDED_SUFFIX)
+        dir_m = checkpoint.CHECKPOINT_RE.search(base) if in_shards_dir else None
         for name in files:
             # Skip digest sidecars AND atomic-write temp files: corrupting
             # an in-flight '...tmp.<pid>.<seq>' would be overwritten by
             # its own os.replace (silent no-op for the fault).
             if name.endswith(checkpoint.DIGEST_SUFFIX) or ".tmp." in name:
                 continue
-            in_shards_dir = os.path.basename(root).endswith(
-                checkpoint.SHARDED_SUFFIX
+            is_shard_file = in_shards_dir and name.startswith("shard-")
+            m = checkpoint.CHECKPOINT_RE.search(name)
+            if not m and not is_shard_file:
+                continue
+            file_epoch = (
+                int(dir_m.group(1)) if is_shard_file and dir_m
+                else (int(m.group(1)) if m else None)
             )
-            if not checkpoint.CHECKPOINT_RE.search(name) and not (
-                in_shards_dir and name.startswith("shard-")
+            if epoch is not None and file_epoch != epoch:
+                continue
+            if shard is not None and not (
+                is_shard_file and name.startswith(f"shard-{shard}.")
             ):
                 continue
             full = os.path.join(root, name)
@@ -229,9 +276,11 @@ class FaultInjectionCallback(Callback):
                 request_leave()
             else:
                 os.kill(os.getpid(), signal.SIGTERM)
-        elif self.plan.kind == "corrupt":
+        elif self.plan.kind.startswith("corrupt"):
+            epoch, shard = corrupt_target(self.plan.kind)
             target = newest_checkpoint_file(
-                os.environ.get("PS_MODEL_PATH", "./models")
+                os.environ.get("PS_MODEL_PATH", "./models"),
+                epoch=epoch, shard=shard,
             )
             if target is not None:
                 print(f"FaultInjection: corrupting {target}", flush=True)

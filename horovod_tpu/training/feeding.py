@@ -137,9 +137,11 @@ def stage_device_dataset(trainer, x, y):
         stage_sharded(trainer, np.asarray(y)[:n], per_shard),
     ), per_shard
 
-def shard_chunk(trainer, chunk):
-    """Place a [K, batch, ...] stack of K batches (steps_per_execution)
-    onto the mesh — the scan axis stays unsharded."""
+def shard_chunk(trainer, chunk, lead: int = 1):
+    """Place a stacked host batch onto the mesh — ``lead`` unsharded
+    leading axes ([K, batch, ...] for steps_per_execution scans, lead=1;
+    [C, K, batch, ...] for chunked microbatch-accumulation feeds, lead=2);
+    the scan/microbatch axes stay unsharded."""
     if trainer.batch_specs is not None:
         specs = tuple(trainer.batch_specs)
 
@@ -147,12 +149,15 @@ def shard_chunk(trainer, chunk):
             return sharding_lib.put_global(
                 x,
                 jax.sharding.NamedSharding(
-                    trainer.mesh, jax.sharding.PartitionSpec(None, *tuple(spec))
+                    trainer.mesh,
+                    jax.sharding.PartitionSpec(
+                        *([None] * lead), *tuple(spec)
+                    ),
                 ),
             )
 
         return tuple(put(x, spec) for x, spec in zip(chunk, specs))
-    return sharding_lib.shard_chunk(chunk, trainer.mesh)
+    return sharding_lib.shard_chunk(chunk, trainer.mesh, lead)
 
 def slice_pad(trainer, part, start: int, global_batch: int):
     """(batch slice padded to the compiled shape, true row count) for
@@ -267,7 +272,11 @@ def run_fit(trainer,
         # case, where processes sharing a shard feed identical rows).
         local_batch = batch_size * trainer.dp_size // groups
         if steps_per_epoch is None:
-            steps_per_epoch = max(1, n_local // local_batch)
+            # steps_per_epoch counts OPTIMIZER steps; with gradient
+            # accumulation each one consumes K microbatches.
+            steps_per_epoch = max(
+                1, n_local // (local_batch * trainer._accum_steps)
+            )
         # Batch assembly runs in the native C++ producer thread when
         # available (overlapping shuffle/gather with the device step),
         # pure Python otherwise — same semantics either way.
@@ -332,31 +341,49 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
     if steps_per_epoch % spe:
         plan.append(steps_per_epoch % spe)
     buffered = [pending]
+    # Microbatches per optimizer step (backward_passes_per_step): each
+    # execution unit carries accum microbatches per step, stacked on a
+    # leading axis the accumulating train step scans over.
+    accum = trainer._accum_steps
 
     def host_chunks():
         # Host-side assembly of the execution units: single batches when
-        # K == 1, [K, ...] stacks otherwise.
+        # spe*accum == 1, [accum, ...] microbatch stacks per step, and
+        # [spe(, accum), ...] stacks of steps.
         for _ in range(initial_epoch, epochs):
             for k in plan:
                 batches = [
                     buffered.pop() if buffered else next(it)
-                    for _ in range(k)
+                    for _ in range(k * accum)
                 ]
-                if spe == 1:
-                    yield batches[0]
+                # Stack leaf-wise — pytree batches (dict inputs,
+                # multi-input models) stack like flat ones.
+                if accum > 1:
+                    steps = [
+                        jax.tree.map(
+                            lambda *xs: np.stack(xs),
+                            *batches[i * accum : (i + 1) * accum],
+                        )
+                        for i in range(k)
+                    ]
                 else:
-                    # Stack K batches leaf-wise — pytree batches (dict
-                    # inputs, multi-input models) stack like flat ones.
-                    yield jax.tree.map(
-                        lambda *xs: np.stack(xs), *batches
-                    )
+                    steps = batches
+                if spe == 1:
+                    yield steps[0]
+                else:
+                    yield jax.tree.map(lambda *xs: np.stack(xs), *steps)
 
     # Batches are staged onto the devices by a background thread while
     # the current step computes — transfer enqueue never blocks dispatch.
     run = trainer._train_step if spe == 1 else trainer._train_chunk
-    prefetcher = DevicePrefetcher(
-        host_chunks(), trainer._shard if spe == 1 else trainer._shard_chunk
-    )
+    if spe == 1:
+        place = (
+            trainer._shard if accum == 1
+            else lambda b: trainer._shard_chunk(b, 1)
+        )
+    else:
+        place = lambda b: trainer._shard_chunk(b, 2 if accum > 1 else 1)  # noqa: E731
+    prefetcher = DevicePrefetcher(host_chunks(), place)
     try:
         for epoch in range(initial_epoch, epochs):
             if trainer.stop_training:
@@ -392,11 +419,13 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
     from horovod_tpu import trace as trace_lib
 
     data, per_shard = stage_device_dataset(trainer, x, y)
-    max_steps = per_shard // batch_size
+    # One optimizer step consumes accum_steps microbatches of batch_size.
+    max_steps = per_shard // (batch_size * trainer._accum_steps)
     if max_steps == 0:
         raise ValueError(
             f"per-shard examples ({per_shard}) < per-chip batch "
-            f"({batch_size})"
+            f"({batch_size}) x backward_passes_per_step "
+            f"({trainer._accum_steps})"
         )
     steps = min(steps_per_epoch or max_steps, max_steps)
     trainer.build(

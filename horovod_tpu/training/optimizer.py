@@ -18,6 +18,8 @@ must be requested by name.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -37,6 +39,25 @@ _COMPRESSION_DTYPES = {
     "bfloat16": jnp.bfloat16,
     "float16": jnp.float16,
 }
+
+
+@dataclasses.dataclass(frozen=True)
+class AccumulationSpec:
+    """What a ``backward_passes_per_step > 1`` request means for the
+    compiled SPMD path — the `Trainer`-side contract (see
+    `accumulation_spec`).
+
+    ``k``: microbatch passes per optimizer step. ``average``: Horovod's
+    ``average_aggregated_gradients`` (False = the K grads are SUMMED, the
+    Horovod default). ``inner``: the transformation *before* the
+    `optax.MultiSteps` wrap — the Trainer applies it once per K microbatch
+    passes with the already-accumulated gradient, so the MultiSteps state
+    (a params-sized accumulator persisted in opt_state plus the zero-update
+    machinery) never exists on that path."""
+
+    k: int
+    average: bool
+    inner: optax.GradientTransformation
 
 
 class Compression:
@@ -69,9 +90,16 @@ def DistributedOptimizer(
       average: Horovod-parity default True (mean). False gives sum.
       backward_passes_per_step: Horovod's gradient-accumulation argument —
         N backward passes are aggregated before one optimizer update (the
-        effective batch is N× larger). Built on `optax.MultiSteps`, so the
-        result stays a plain GradientTransformation
-        (checkpoint/broadcast-friendly).
+        effective batch is N× larger). Two execution forms, one contract:
+        used standalone (or with an explicit ``axis_name``) the result is
+        an `optax.MultiSteps` wrap — a plain GradientTransformation
+        (checkpoint/broadcast-friendly) that zero-updates N-1 of N calls.
+        Handed to `Trainer` in the default SPMD mode, the wrap is bypassed
+        (see `accumulation_spec`): the Trainer runs the N microbatch
+        passes inside ONE compiled step, accumulating local grads in f32,
+        with exactly one cross-worker reduction (bucket-fused,
+        hierarchical on multi-slice meshes) and one optimizer apply at the
+        boundary — gradient communication per sample drops N×.
       average_aggregated_gradients: Horovod-parity default False — the N
         accumulated gradients are SUMMED (Horovod's
         ``average_aggregated_gradients`` default); True averages them.
@@ -110,11 +138,12 @@ def DistributedOptimizer(
 
     tx = optax.GradientTransformation(init_fn, update_fn)
     if backward_passes_per_step > 1:
-        # MultiSteps accumulates the MEAN of the N microbatch gradients and
-        # emits zero updates on the first N-1 passes. Horovod's default is
-        # the SUM of the N passes (average_aggregated_gradients=False), so
-        # the sum contract pre-scales the mean by N before the wrapped
-        # optimizer sees it.
+        # Standalone (no Trainer) contract: `optax.MultiSteps` accumulates
+        # the MEAN of the N microbatch gradients and emits zero updates on
+        # the first N-1 passes. Horovod's default is the SUM of the N
+        # passes (average_aggregated_gradients=False), so the sum contract
+        # pre-scales the mean by N before the wrapped optimizer sees it.
+        inner = tx
         if not average_aggregated_gradients:
             tx = optax.chain(optax.scale(float(backward_passes_per_step)), tx)
         ms = optax.MultiSteps(
@@ -125,6 +154,21 @@ def DistributedOptimizer(
             return ms.update(updates, state, params, **extra)
 
         tx = optax.GradientTransformation(ms.init, ms_update)
+        if axis_name is None:
+            # SPMD-jit mode: Trainer runs TRUE accumulation — K microbatch
+            # forward/backward passes inside ONE compiled step, local f32
+            # grad accumulation, exactly one cross-worker reduction and one
+            # optimizer apply at the boundary (communication per sample
+            # drops K×; effective batch K·B in the same device memory).
+            # The tag hands Trainer the knob AND the unwrapped inner
+            # transformation (see AccumulationSpec); standalone users of
+            # this GradientTransformation keep the MultiSteps semantics
+            # above unchanged.
+            tx.update._hvt_accum = AccumulationSpec(
+                k=backward_passes_per_step,
+                average=average_aggregated_gradients,
+                inner=inner,
+            )
     if comm_dtype is not None and axis_name is None:
         # SPMD-jit mode: the reduction this dtype applies to lives inside the
         # compiled step, not here. Tag the transformation so Trainer selects
@@ -140,3 +184,13 @@ def compression_dtype(tx: optax.GradientTransformation):
     compiled SPMD path, or None. Trainer uses this to switch its train step
     to the explicit-collective gradient reduction."""
     return getattr(tx.update, "_hvt_compression", None)
+
+
+def accumulation_spec(tx: optax.GradientTransformation):
+    """The `AccumulationSpec` a ``backward_passes_per_step > 1``
+    `DistributedOptimizer` tagged for the compiled SPMD path, or None.
+    Trainer uses this to (a) switch its train step to the K-microbatch
+    accumulating explicit-collective form and (b) swap the MultiSteps wrap
+    for the unwrapped inner transformation — the accumulation then lives
+    in the step's scan, not in a params-sized opt_state buffer."""
+    return getattr(tx.update, "_hvt_accum", None)

@@ -17,6 +17,7 @@ carries over unchanged.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Sequence
 
 import flax.struct
@@ -28,9 +29,10 @@ import numpy as np
 import optax
 
 from horovod_tpu import runtime
+from horovod_tpu.parallel import collectives
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.parallel import sharding as sharding_lib
-from horovod_tpu.training.optimizer import compression_dtype
+from horovod_tpu.training.optimizer import accumulation_spec, compression_dtype
 
 PyTree = Any
 
@@ -72,6 +74,7 @@ class Trainer:
         batch_specs=None,
         steps_per_execution: int = 1,
         shard_update: bool = False,
+        bucket_bytes: int | None = None,
     ):
         self.module = module
         self.tx = optimizer
@@ -125,6 +128,51 @@ class Trainer:
                 "parameters (param_specs=None); sharded-parameter layouts "
                 "keep XLA's implicit f32 gradient reduction"
             )
+        # Gradient accumulation (DistributedOptimizer(backward_passes_per_
+        # step=K)): the Trainer runs the K microbatch passes INSIDE one
+        # compiled step — local f32 grad accumulation, exactly one
+        # cross-worker reduction and one optimizer apply per K passes — so
+        # the MultiSteps wrap (zero updates + a params-sized accumulator in
+        # opt_state) is swapped for the unwrapped inner transformation (see
+        # optimizer.accumulation_spec). Each train step then consumes a
+        # [K, batch, ...] microbatch stack.
+        self._accum = accumulation_spec(optimizer)
+        self._accum_steps = self._accum.k if self._accum is not None else 1
+        if self._accum is not None:
+            if param_specs is not None:
+                raise ValueError(
+                    "DistributedOptimizer(backward_passes_per_step=K) "
+                    "requires replicated parameters (param_specs=None): "
+                    "the accumulating step's explicit boundary reduction "
+                    "assumes the pure-DP gradient layout"
+                )
+            if batch_specs is not None:
+                raise ValueError(
+                    "backward_passes_per_step does not compose with custom "
+                    "batch_specs — the microbatch stack is sharded along "
+                    "the data axes only"
+                )
+            self.tx = self._accum.inner
+        # Boundary-reduction fusion buckets (Horovod's tensor-fusion
+        # threshold): the explicit-collective step reduces gradients as a
+        # few contiguous dtype-homogeneous buckets of at most this many
+        # bytes, instead of one collective per leaf.
+        self._bucket_bytes = int(
+            bucket_bytes
+            or os.environ.get("HVT_BUCKET_BYTES")
+            or collectives.DEFAULT_BUCKET_BYTES
+        )
+        # Multi-slice factor of the data axis (1 on single-slice meshes):
+        # when > 1, the boundary reduction runs two-hop — ICI sub-axis in
+        # full precision, DCN sub-axis in the compression dtype
+        # (EQuARX-style DCN-only quantization). Only consulted by the
+        # explicit-collective step; the default SPMD path leaves reduction
+        # placement to XLA.
+        self._dcn = (
+            mesh_lib.dcn_factor(self.mesh)
+            if (self._comm_dtype is not None or self._accum_steps > 1)
+            else 1
+        )
         # ZeRO-1 / cross-replica weight-update sharding (Xu et al.,
         # arXiv:2004.13336 — PAPERS.md): keep the MODEL replicated (pure-DP
         # forward/backward, the reference's layout) but shard the optimizer
@@ -147,6 +195,16 @@ class Trainer:
                 "shard_update does not compose with wire compression's "
                 "explicit-collective step (whose hand-rolled psum assumes "
                 "replicated optimizer state) — pick one"
+            )
+        if shard_update and self._accum_steps > 1:
+            raise ValueError(
+                "shard_update (ZeRO-1) does not compose with "
+                "backward_passes_per_step > 1: ZeRO-1 relies on XLA "
+                "turning the implicit gradient reduction into a "
+                "reduce-scatter, and the accumulating step replaces that "
+                "reduction with an explicit boundary psum over replicated "
+                "gradients — pick one (accumulation already delivers the "
+                "communication saving ZeRO-1's reduce-scatter amortizes)"
             )
 
         def forward_loss(variables, x, y, rng):
@@ -174,13 +232,28 @@ class Trainer:
                 acc = _accuracy(out, y)
             return loss, acc, (dict(updated) if updated else None), sm
 
-        def compressed_grads(state: TrainState, x, y, step_rng):
-            """(loss, acc, model_state, grads) with the cross-worker gradient
-            reduction made explicit: a psum over the data axes on the 16-bit
-            wire dtype (Horovod Compression.fp16 semantics — compress, ring
-            allreduce-SUM on the wire, decompress, then average). Everything
-            else matches the SPMD loss_of path: per-shard loss means combine
-            to the global-batch mean because shards are equal-sized.
+        def explicit_grads(state: TrainState, xs, ys, step_rng):
+            """(loss, acc, model_state, sown_metrics, grads) with the
+            cross-worker gradient reduction made explicit — the
+            K-microbatch accumulating, bucket-fused, wire-compressed step.
+
+            ``xs``/``ys`` leaves are [K, G, ...] microbatch stacks (K =
+            backward_passes_per_step; the plain-compression K == 1 case is
+            stacked to [1, G, ...] by train_step). Each microbatch runs
+            forward/backward per shard producing LOCAL gradients — no
+            reduction — accumulated in f32 on device; then exactly ONE
+            boundary reduction per optimizer step: the gradient pytree is
+            packed into a handful of contiguous dtype-homogeneous buckets
+            (Horovod tensor-fusion semantics, `collectives.
+            reduce_gradients`), each bucket psum'd in the 16-bit wire
+            dtype when compression is on (compress, ring allreduce-SUM on
+            the wire, decompress, then average), and two-hop on a
+            multi-slice mesh — the ICI sub-axis in full precision, only
+            the DCN sub-axis in the compression dtype (EQuARX-style).
+            Horovod's accumulation contract holds: the K grads are SUMMED
+            (``average_aggregated_gradients=False``, the default) or
+            averaged; reported loss/accuracy are the mean over the K
+            microbatches (what one K·B-batch step would report).
 
             Contract deltas vs the SPMD path (both only observable with
             non-iid extras, never with the plain CE objective):
@@ -194,31 +267,85 @@ class Trainer:
               total variance) vs the SPMD path's exact global-batch
               variance. Identical for iid shards (the sharded loader's
               case); an underestimate only for systematically skewed
-              shards."""
+              shards. With K > 1 the running stats additionally step once
+              per MICROBATCH (momentum applied K times per optimizer
+              step), the standard accumulation behavior."""
             comm = self._comm_dtype
+            K = self._accum_steps
+            avg_k = self._accum.average if self._accum is not None else False
             data_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
 
-            def local(params, ms, x, y):
+            def local(params, ms, xs, ys):
                 # Distinct dropout per shard (the SPMD path's global mask is
-                # partitioned; here each shard must draw its own).
+                # partitioned; here each shard must draw its own), and per
+                # microbatch when accumulating.
                 shard_rng = jax.random.fold_in(
                     step_rng, jax.lax.axis_index(data_axes)
                 )
 
-                def loss_of(params):
+                def loss_of(params, xb, yb, ms, rng):
                     loss, acc, upd, sm = forward_loss(
-                        {"params": params, **(ms or {})}, x, y, shard_rng
+                        {"params": params, **(ms or {})}, xb, yb, rng
                     )
                     return loss, (acc, upd if upd is not None else ms, sm)
 
-                (loss, (acc, new_ms, sm)), grads = jax.value_and_grad(
-                    loss_of, has_aux=True
-                )(params)
-                inv_n = 1.0 / jax.lax.psum(1, data_axes)
+                grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+                x0 = jax.tree.map(lambda a: a[0], xs)
+                y0 = jax.tree.map(lambda a: a[0], ys)
+                # K == 1 keeps the pre-accumulation rng stream bit-exact.
+                rng0 = (
+                    shard_rng if K == 1
+                    else jax.random.fold_in(shard_rng, 0)
+                )
+                (loss, (acc, new_ms, sm)), grads = grad_fn(
+                    params, x0, y0, ms, rng0
+                )
+                # Local accumulation in f32: microbatch grads sum without
+                # precision loss even for bf16-param models.
                 grads = jax.tree.map(
-                    lambda g: jax.lax.psum(g.astype(comm), data_axes)
-                    .astype(g.dtype) * inv_n,
+                    lambda g: g.astype(jnp.float32), grads
+                )
+                if K > 1:
+                    def micro(carry, inp):
+                        g_acc, ms_c, loss_s, acc_s, sm_s = carry
+                        k, xb, yb = inp
+                        (l, (a, ms_c, smk)), g = grad_fn(
+                            params, xb, yb, ms_c,
+                            jax.random.fold_in(shard_rng, k),
+                        )
+                        g_acc = jax.tree.map(
+                            lambda A, G: A + G.astype(jnp.float32), g_acc, g
+                        )
+                        return (
+                            g_acc, ms_c, loss_s + l, acc_s + a,
+                            jax.tree.map(jnp.add, sm_s, smk),
+                        ), None
+
+                    (grads, new_ms, loss, acc, sm), _ = jax.lax.scan(
+                        micro, (grads, new_ms, loss, acc, sm),
+                        (
+                            jnp.arange(1, K),
+                            jax.tree.map(lambda a: a[1:], xs),
+                            jax.tree.map(lambda a: a[1:], ys),
+                        ),
+                    )
+                    loss, acc = loss / K, acc / K
+                    sm = jax.tree.map(lambda v: v / K, sm)
+                # THE one cross-worker reduction of the optimizer step.
+                grads = collectives.reduce_gradients(
                     grads,
+                    data_axis=mesh_lib.DATA_AXIS,
+                    extra_axes=(mesh_lib.FSDP_AXIS,),
+                    dcn=self._dcn,
+                    wire_dtype=comm,
+                    bucket_bytes=self._bucket_bytes,
+                )
+                # Sum → Horovod semantics: divide by world size (mean over
+                # workers) and, only with average_aggregated_gradients, by
+                # K (mean over passes; the default keeps the K-pass SUM).
+                denom = jax.lax.psum(1, data_axes) * (K if avg_k else 1)
+                grads = jax.tree.map(
+                    lambda g, p: (g / denom).astype(p.dtype), grads, params
                 )
                 loss = jax.lax.pmean(loss, data_axes)
                 acc = jax.lax.pmean(acc, data_axes)
@@ -237,13 +364,14 @@ class Trainer:
                 return loss, acc, new_ms, sm, grads
 
             P = jax.sharding.PartitionSpec
+            stacked = P(None, data_axes)
             return compat.shard_map(
                 local,
                 mesh=self.mesh,
-                in_specs=(P(), P(), P(data_axes), P(data_axes)),
+                in_specs=(P(), P(), stacked, stacked),
                 out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False,
-            )(state.params, state.model_state, x, y)
+            )(state.params, state.model_state, xs, ys)
 
         def train_step(state: TrainState, batch, update_scale, metric_acc):
             x, y = batch
@@ -269,9 +397,15 @@ class Trainer:
                     acc, upd if upd is not None else state.model_state, sm
                 )
 
-            if self._comm_dtype is not None:
-                loss, acc, model_state, sown_metrics, grads = compressed_grads(
-                    state, x, y, step_rng
+            if self._comm_dtype is not None or self._accum_steps > 1:
+                if self._accum_steps > 1:
+                    sx, sy = x, y  # already [K, G, ...] microbatch stacks
+                else:
+                    # Plain compression: one microbatch, stacked to [1, G].
+                    sx = jax.tree.map(lambda a: a[None], x)
+                    sy = jax.tree.map(lambda a: a[None], y)
+                loss, acc, model_state, sown_metrics, grads = explicit_grads(
+                    state, sx, sy, step_rng
                 )
             else:
                 (loss, (acc, model_state, sown_metrics)), grads = (
@@ -326,6 +460,7 @@ class Trainer:
             shards partition the data so an epoch sees each example once."""
             first = jax.tree.leaves(data)[0]
             n_shards, per_n = first.shape[0], first.shape[1]
+            K = self._accum_steps  # microbatches consumed per optimizer step
             u = jax.random.uniform(epoch_seed, (n_shards, per_n))
             order = jnp.argsort(u, axis=1)  # row-wise → shard-local
 
@@ -342,7 +477,7 @@ class Trainer:
             # live alongside `data` for the epoch — the device-cached path
             # trades HBM for zero per-step host/latency cost by design; use
             # the streamed fit path when the dataset crowds HBM.
-            need = steps * per_chip_batch
+            need = steps * per_chip_batch * K
             shuffled = jax.tree.map(
                 lambda a: jax.vmap(
                     lambda rows, ii: jnp.take(rows, ii, axis=0)
@@ -354,12 +489,29 @@ class Trainer:
 
             def body(carry, t):
                 state, acc = carry
-                batch = jax.tree.map(
-                    lambda a: jax.lax.dynamic_slice_in_dim(
-                        a, t * per_chip_batch, per_chip_batch, axis=1
-                    ).reshape((n_shards * per_chip_batch,) + a.shape[2:]),
-                    shuffled,
-                )
+                if K == 1:
+                    batch = jax.tree.map(
+                        lambda a: jax.lax.dynamic_slice_in_dim(
+                            a, t * per_chip_batch, per_chip_batch, axis=1
+                        ).reshape((n_shards * per_chip_batch,) + a.shape[2:]),
+                        shuffled,
+                    )
+                else:
+                    # One optimizer step consumes K contiguous microbatches
+                    # per shard, restacked to the [K, global_batch, ...]
+                    # layout the accumulating step expects.
+                    def take(a):
+                        sl = jax.lax.dynamic_slice_in_dim(
+                            a, t * K * per_chip_batch, K * per_chip_batch,
+                            axis=1,
+                        ).reshape(
+                            (n_shards, K, per_chip_batch) + a.shape[2:]
+                        )
+                        return jnp.moveaxis(sl, 1, 0).reshape(
+                            (K, n_shards * per_chip_batch) + a.shape[2:]
+                        )
+
+                    batch = jax.tree.map(take, shuffled)
                 state, metrics, acc = train_step(state, batch, update_scale, acc)
                 return (state, acc), metrics
 
@@ -529,8 +681,8 @@ class Trainer:
     def _shard(self, batch):
         return feeding.shard_batch(self, batch)
 
-    def _shard_chunk(self, chunk):
-        return feeding.shard_chunk(self, chunk)
+    def _shard_chunk(self, chunk, lead: int = 1):
+        return feeding.shard_chunk(self, chunk, lead)
 
     def _feed_groups(self) -> tuple[int, int]:
         return feeding.feed_groups(self)

@@ -12,6 +12,23 @@ from horovod_tpu import checkpoint
 from horovod_tpu.models import MnistCNN
 
 
+def _rewrite_index(idx_path, mutate):
+    """Hand-edit a sharded checkpoint's index.json (topology-faking tests)
+    AND refresh its digest sidecar — the index is integrity-verified like
+    every payload file, so a bare rewrite would read as corruption."""
+    import hashlib
+    import json
+
+    with open(idx_path) as f:
+        idx = json.load(f)
+    mutate(idx)
+    data = json.dumps(idx).encode()
+    with open(idx_path, "wb") as f:
+        f.write(data)
+    with open(idx_path + checkpoint.DIGEST_SUFFIX, "w") as f:
+        f.write(hashlib.sha256(data).hexdigest() + "\n")
+
+
 @pytest.fixture()
 def trainer_and_data():
     hvt.init()
@@ -356,17 +373,12 @@ class TestShardedCheckpoint:
         """Resuming a sharded checkpoint under a different process topology
         must raise the designed ValueError on every rank — not leak a
         FileNotFoundError from a missing shard file on some ranks only."""
-        import json as json_lib
-
         mesh = self._mesh()
         state = self._state(mesh, fill=True)
         path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
         idx_path = os.path.join(path, checkpoint.INDEX_FILE)
-        with open(idx_path) as f:
-            idx = json_lib.load(f)
-        idx["n_processes"] = 2  # pretend it was saved by a 2-process run
-        with open(idx_path, "w") as f:
-            json_lib.dump(idx, f)
+        # pretend it was saved by a 2-process run
+        _rewrite_index(idx_path, lambda idx: idx.update(n_processes=2))
         # _sharded_complete now wants shard-1 too; satisfy it so the check
         # under test (restore_sharded's topology guard) is what fires.
         import shutil
@@ -530,6 +542,162 @@ class TestCheckpointIntegrity:
             "checkpoint-1.msgpack"
         )
 
+    # --- index.json integrity sidecar (ROADMAP follow-up) -------------------
+
+    def _sharded(self, d, epoch, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        state = {
+            "w": jax.device_put(
+                np.full((8, 8), float(epoch), np.float32),
+                NamedSharding(mesh, P("data", None)),
+            )
+        }
+        return checkpoint.save_sharded(
+            str(d / f"checkpoint-{epoch}{checkpoint.SHARDED_SUFFIX}"), state
+        )
+
+    def test_index_gets_digest_sidecar(self, tmp_path):
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        hvt.init()
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        path = self._sharded(tmp_path, 1, mesh)
+        ipath = os.path.join(path, checkpoint.INDEX_FILE)
+        assert os.path.exists(ipath + checkpoint.DIGEST_SUFFIX)
+        assert checkpoint.file_intact(ipath)
+        assert checkpoint._sharded_complete(path)
+
+    def test_corrupt_index_loses_discovery_and_restore_refuses(
+        self, tmp_path
+    ):
+        """A bit-rotted index.json (payloads all clean) must lose discovery
+        to the previous complete epoch, and a direct restore must raise
+        CheckpointCorruptError — never steer the restore with garbage."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from horovod_tpu.parallel import mesh as mesh_lib
+        from horovod_tpu.testing import faults
+
+        hvt.init()
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        self._sharded(tmp_path, 1, mesh)
+        newest = self._sharded(tmp_path, 2, mesh)
+        ipath = os.path.join(newest, checkpoint.INDEX_FILE)
+        faults.corrupt_file(ipath)
+        assert not checkpoint._sharded_complete(newest)
+        got = checkpoint.latest_checkpoint(str(tmp_path))
+        assert got and got.endswith(
+            f"checkpoint-1{checkpoint.SHARDED_SUFFIX}"
+        )
+        template = {
+            "w": jax.device_put(
+                np.zeros((8, 8), np.float32),
+                NamedSharding(mesh, P("data", None)),
+            )
+        }
+        with pytest.raises(checkpoint.CheckpointCorruptError, match="sha256"):
+            checkpoint.restore_sharded(newest, template)
+
+    def test_legacy_index_without_sidecar_accepted(self, tmp_path):
+        from horovod_tpu.parallel import mesh as mesh_lib
+
+        hvt.init()
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        path = self._sharded(tmp_path, 1, mesh)
+        os.remove(
+            os.path.join(path, checkpoint.INDEX_FILE)
+            + checkpoint.DIGEST_SUFFIX
+        )
+        assert checkpoint._sharded_complete(path)
+
+    # --- corrupt@target (ROADMAP follow-up) ---------------------------------
+
+    def test_corrupt_target_parsing(self):
+        from horovod_tpu.testing import faults
+
+        assert faults.corrupt_target("corrupt") == (None, None)
+        assert faults.corrupt_target("corrupt@epoch3") == (3, None)
+        assert faults.corrupt_target("corrupt@shard1") == (None, 1)
+        assert faults.corrupt_target("corrupt@epoch3/shard1") == (3, 1)
+        assert faults.parse_plan("0:1:corrupt@epoch3").kind == "corrupt@epoch3"
+        with pytest.raises(ValueError, match="corrupt target"):
+            faults.parse_plan("0:1:corrupt@newest")
+
+    def test_corrupt_fault_hits_targeted_epoch(self, tmp_path, monkeypatch):
+        """corrupt@epoch1 must damage epoch 1's payload even when epoch 2
+        is newer — the fallback-across-history scenario (newest stays
+        intact, so discovery keeps epoch 2 and the PREVIOUS epoch is the
+        corrupted one)."""
+        import time as time_mod
+
+        from horovod_tpu.testing import faults
+
+        p1 = self._save(tmp_path, 1)
+        time_mod.sleep(0.01)
+        p2 = self._save(tmp_path, 2)
+        assert faults.newest_checkpoint_file(str(tmp_path), epoch=1) == p1
+        monkeypatch.setenv("PS_MODEL_PATH", str(tmp_path))
+        killed = []
+        monkeypatch.setattr(
+            os, "kill", lambda pid, sig: killed.append(sig)
+        )
+        cb = faults.FaultInjectionCallback(
+            faults.parse_plan("0:0:corrupt@epoch1")
+        )
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)
+        assert killed  # still SIGKILLs after corrupting
+        assert not checkpoint.file_intact(p1)
+        assert checkpoint.file_intact(p2)
+        assert checkpoint.latest_checkpoint(str(tmp_path)).endswith(
+            "checkpoint-2.msgpack"
+        )
+
+    def test_corrupt_fault_hits_targeted_shard(self, tmp_path):
+        """corrupt@shard1 damages exactly shard file 1 of the newest
+        sharded checkpoint; single-file checkpoints never match a shard
+        target."""
+        import time as time_mod
+
+        from horovod_tpu.parallel import mesh as mesh_lib
+        from horovod_tpu.testing import faults
+
+        hvt.init()
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=4, model=2))
+        self._save(tmp_path, 1)
+        time_mod.sleep(0.01)
+        shards = self._sharded(tmp_path, 2, mesh)
+        # single-process save writes shard-0 only; fake a shard-1
+        import shutil as shutil_mod
+
+        shutil_mod.copy(
+            os.path.join(shards, "shard-0.msgpack"),
+            os.path.join(shards, "shard-1.msgpack"),
+        )
+        shutil_mod.copy(
+            os.path.join(
+                shards, "shard-0.msgpack" + checkpoint.DIGEST_SUFFIX
+            ),
+            os.path.join(
+                shards, "shard-1.msgpack" + checkpoint.DIGEST_SUFFIX
+            ),
+        )
+        target = faults.newest_checkpoint_file(str(tmp_path), shard=1)
+        assert target == os.path.join(shards, "shard-1.msgpack")
+        faults.corrupt_file(target)
+        assert not checkpoint.file_intact(target)
+        assert checkpoint.file_intact(
+            os.path.join(shards, "shard-0.msgpack")
+        )
+        # combined epoch+shard addressing
+        assert faults.newest_checkpoint_file(
+            str(tmp_path), epoch=2, shard=0
+        ) == os.path.join(shards, "shard-0.msgpack")
+        assert faults.newest_checkpoint_file(
+            str(tmp_path), epoch=1, shard=0
+        ) is None
+
 
 class TestAsyncSaveErrorSurfacing:
     """A save thread that raised must surface at every consumption point —
@@ -586,7 +754,9 @@ class TestAsyncSaveErrorSurfacing:
 
 def test_backward_passes_per_step_accumulates():
     """Horovod's gradient-accumulation argument: N passes of batch B must
-    equal 1 pass of batch N*B (mean semantics) for a linear model + SGD."""
+    equal 1 pass of batch N*B (mean semantics) for a linear model + SGD.
+    steps_per_epoch counts OPTIMIZER steps — each consumes N microbatches
+    inside one compiled step (trainer-native accumulation)."""
     import flax.linen as nn
     import jax.numpy as jnp
 
@@ -615,7 +785,7 @@ def test_backward_passes_per_step_accumulates():
         ),
         seed=3,
     )
-    acc.fit(x=x, y=y, batch_size=1, epochs=1, steps_per_epoch=8,
+    acc.fit(x=x, y=y, batch_size=1, epochs=1, steps_per_epoch=2,
             shuffle_buffer=1, verbose=0)
     # ...equal 2 plain steps of per-chip batch 4 (global 32) over the same
     # 64 examples in the same order.
@@ -637,7 +807,7 @@ def test_backward_passes_per_step_accumulates():
             ),
             seed=3,
         )
-        t.fit(x=x, y=y, batch_size=1, epochs=1, steps_per_epoch=4,
+        t.fit(x=x, y=y, batch_size=1, epochs=1, steps_per_epoch=1,
               shuffle_buffer=1, verbose=0)
         return jax.device_get(jax.tree.leaves(t.state.params)[0])
 
@@ -728,8 +898,6 @@ class TestReshardRestore:
             )
 
     def test_reshard_accepts_process_count_mismatch(self, tmp_path):
-        import json as json_lib
-
         from flax import serialization as ser
         from jax.sharding import PartitionSpec as P
 
@@ -737,11 +905,8 @@ class TestReshardRestore:
         state = self._state(mesh, [P("data", None), P(None, "model")])
         path = checkpoint.save_sharded(str(tmp_path / "c.shards"), state)
         idx_path = os.path.join(path, checkpoint.INDEX_FILE)
-        with open(idx_path) as f:
-            idx = json_lib.load(f)
-        idx["n_processes"] = 2  # as if saved by a 2-process fleet
-        with open(idx_path, "w") as f:
-            json_lib.dump(idx, f)
+        # as if saved by a 2-process fleet
+        _rewrite_index(idx_path, lambda idx: idx.update(n_processes=2))
         with open(os.path.join(path, "shard-1.msgpack"), "wb") as f:
             f.write(ser.msgpack_serialize({}))  # rank 1 owned nothing
         template = self._state(mesh, [P("data", None), P(None, "model")],

@@ -171,15 +171,20 @@ class TestComposition:
             Trainer(_MLP(), tx, param_specs={})
 
     def test_tag_survives_multisteps(self):
-        """backward_passes_per_step wraps in MultiSteps; the compression tag
-        (and the compressed step) must survive the wrap."""
+        """backward_passes_per_step composes with compression: the tag
+        survives, the Trainer runs the K-microbatch accumulating step, and
+        only the boundary reduction is compressed (one reduction per
+        optimizer step, fed a [K, G, ...] microbatch stack)."""
         tx = hvt.DistributedOptimizer(
             optax.adam(1e-2), compression="bf16", backward_passes_per_step=2
         )
         assert compression_dtype(tx) == jnp.bfloat16
-        x, y = _data()
+        x, y = _data(n=128)
         tr = Trainer(_MLP(), tx)
-        loss = _run_steps(tr, x, y, n=4)
+        loss = tr.fit(
+            x=x, y=y, batch_size=4, epochs=1, steps_per_epoch=2,
+            shuffle_buffer=1, verbose=0,
+        )[-1]["loss"]
         assert np.isfinite(loss)
 
     def test_axis_name_mode_not_tagged(self):

@@ -1236,3 +1236,187 @@ class TestWorldInfo:
         w = WorldInfo.from_wire({"rank": 0, "size": 1, "generation": 2})
         assert w.jax_coordinator is None
         assert w.root_rank == 0 and w.max_progress == -1
+
+
+class TestCommitCadence:
+    """Sub-epoch commit cadence (commit_every_steps) + the job-spec env
+    surface. on_batch_end fires once per OPTIMIZER step, so step commits
+    are accumulation-boundary-aligned by construction."""
+
+    class _Client:
+        synced_generation = 3
+
+        def beat(self, progress=None):
+            return 3
+
+    class _Trainer:
+        state = {"w": 1}
+
+    def _callback(self, **kw):
+        from horovod_tpu.elastic.state import ElasticStateCallback
+
+        cb = ElasticStateCallback(ElasticState(), self._Client(), **kw)
+        cb.trainer = self._Trainer()
+        return cb
+
+    def test_commits_every_n_steps(self):
+        cb = self._callback(commit_every_steps=2)
+        cb.on_epoch_begin(4)
+        cb.on_batch_end(0)
+        assert cb.state.commits == 0
+        cb.on_batch_end(1)
+        assert cb.state.commits == 1
+        assert cb.state.progress == progress_marker(4, 2)
+        cb.on_batch_end(2)
+        assert cb.state.commits == 1
+        cb.on_batch_end(3)
+        assert cb.state.commits == 2
+        assert cb.state.progress == progress_marker(4, 4)
+        # committed snapshot carries the trainer's live state
+        assert cb.state._committed["state"] == {"w": 1}
+
+    def test_step_commit_orders_under_epoch_commit(self):
+        """progress_marker total order: a mid-epoch commit of epoch E must
+        rank above E's start and below the epoch-end commit (E+1, 0)."""
+        assert (
+            progress_marker(4, 0)
+            < progress_marker(4, 7)
+            < progress_marker(5, 0)
+        )
+
+    def test_chunked_executions_commit_at_next_boundary(self):
+        """steps_per_execution strides: batch indices jump by the chunk
+        size; cadence uses >= since-last-commit, so a chunk striding past
+        the target still commits at its end."""
+        cb = self._callback(commit_every_steps=3)
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(1)   # 2 steps done — below cadence
+        assert cb.state.commits == 0
+        cb.on_batch_end(3)   # 4 steps done — past cadence: commit
+        assert cb.state.commits == 1
+        assert cb.state.progress == progress_marker(0, 4)
+
+    def test_epoch_begin_resets_cadence(self):
+        cb = self._callback(commit_every_steps=2)
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(1)
+        assert cb.state.commits == 1
+        cb.on_epoch_begin(1)
+        cb.on_batch_end(0)  # 1 step into the new epoch — no commit yet
+        assert cb.state.commits == 1
+
+    def test_zero_means_epoch_cadence_only(self):
+        cb = self._callback()
+        cb.on_epoch_begin(0)
+        for b in range(10):
+            cb.on_batch_end(b)
+        assert cb.state.commits == 0
+
+    def test_env_defaults_from_job_spec_surface(self, monkeypatch):
+        monkeypatch.setenv("HVT_COMMIT_EVERY", "2")
+        monkeypatch.setenv("HVT_COMMIT_EVERY_STEPS", "50")
+        cb = self._callback()
+        assert cb.commit_every == 2
+        assert cb.commit_every_steps == 50
+        # explicit args beat the env
+        cb2 = self._callback(commit_every=1, commit_every_steps=0)
+        assert cb2.commit_every == 1 and cb2.commit_every_steps == 0
+
+    def test_policy_parses_and_exports_commit_env(self):
+        p = ElasticPolicy.from_mapping(
+            {"min_ranks": 2, "commit_every": 3, "commit_every_steps": 25}
+        )
+        assert p.commit_every == 3 and p.commit_every_steps == 25
+        assert p.commit_env() == {
+            "HVT_COMMIT_EVERY": "3", "HVT_COMMIT_EVERY_STEPS": "25"
+        }
+        # defaults export NOTHING — user-code callback args must win
+        assert ElasticPolicy().commit_env() == {}
+
+    def test_policy_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown elastic policy"):
+            ElasticPolicy.from_mapping({"commit_cadence": 1})
+
+
+class TestGrowOnlyFastPath:
+    """A membership change that only ADDS ranks must skip the boundary
+    piece-allgather: no piece's owner is departing, so survivors keep
+    their compact sharded commits and sync's reassembly on the new world
+    covers the joiners (ROADMAP follow-up from PR 3)."""
+
+    class _Client:
+        def __init__(self):
+            self.synced_generation = 3
+            self.left = []
+
+        def beat(self, progress=None):
+            return 4  # a NEW generation: membership changed
+
+        def leave(self, reason=""):
+            self.left.append(reason)
+
+    class _Trainer:
+        state = {"w": 1}
+
+    def _boundary(self, monkeypatch, leaving_votes):
+        """Run one epoch-end agreement with fake votes; returns
+        (callback, gather_calls, interrupt type raised)."""
+        import jax
+
+        from horovod_tpu import runtime
+        from horovod_tpu.elastic import state as state_mod
+        from horovod_tpu.elastic.state import (
+            ElasticStateCallback,
+            HostsUpdatedInterrupt,
+            LeaveInterrupt,
+        )
+
+        state = ElasticState()
+        cb = ElasticStateCallback(state, self._Client())
+        cb.trainer = self._Trainer()
+        monkeypatch.setattr(jax, "process_count", lambda: len(leaving_votes))
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda v: [(4, l) for l in leaving_votes],
+        )
+        monkeypatch.setattr(runtime, "shutdown", lambda: None)
+        # A sharded commit: state.commit() is patched to mark one
+        # (real cross-process arrays cannot exist in one test process).
+        from horovod_tpu.elastic.state import ShardedLeaf
+
+        def fake_commit():
+            state._committed = {
+                "state": ShardedLeaf(
+                    shape=(2,), dtype="float32", pieces={}, digests={}
+                ),
+                "epoch": state.epoch, "step": state.step,
+            }
+            state.commits += 1
+
+        monkeypatch.setattr(state, "commit", fake_commit)
+        gathered = []
+        monkeypatch.setattr(
+            state, "gather_committed",
+            lambda force=False: gathered.append(force),
+        )
+        raised = None
+        try:
+            cb.on_epoch_end(5)
+        except (HostsUpdatedInterrupt, LeaveInterrupt) as e:
+            raised = type(e).__name__
+        return cb, gathered, raised
+
+    def test_grow_only_skips_piece_allgather(self, monkeypatch):
+        cb, gathered, raised = self._boundary(
+            monkeypatch, leaving_votes=[False, False]
+        )
+        assert raised == "HostsUpdatedInterrupt"
+        assert cb.state.commits == 1      # the boundary still commits
+        assert gathered == []             # ...but nothing is reassembled
+
+    def test_departure_still_gathers(self, monkeypatch):
+        cb, gathered, raised = self._boundary(
+            monkeypatch, leaving_votes=[False, True]
+        )
+        assert raised == "HostsUpdatedInterrupt"
+        assert gathered == [False]        # boundary reassembly ran
