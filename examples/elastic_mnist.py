@@ -62,7 +62,8 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
     if world.rank == 0:
         print(
             f"elastic-mnist: generation {world.generation} — "
-            f"{world.size} rank(s), resuming at epoch {state.epoch}",
+            f"{world.size} rank(s), resuming at epoch {state.epoch} "
+            f"step {state.step}",
             flush=True,
         )
 
@@ -118,11 +119,18 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
         # Fresh process (first generation, or a per-rank restart after a
         # hard crash): the checkpoint fallback. reshard=True because a
         # sharded (ZeRO-1) checkpoint may have been saved by a different
-        # generation's world size.
-        trainer.state, done = checkpoint.restore_latest_and_broadcast(
-            model_dir, trainer.state, mesh=trainer.mesh, reshard=True
+        # generation's world size. with_step=True: a mid-epoch manifest
+        # resumes at the committed optimizer step, not the epoch start.
+        trainer.state, done, done_step = (
+            checkpoint.restore_latest_and_broadcast(
+                model_dir, trainer.state, mesh=trainer.mesh, reshard=True,
+                with_step=True,
+            )
         )
-        state.epoch = max(state.epoch, done)
+        if elastic.progress_marker(done, done_step) > elastic.progress_marker(
+            state.epoch, state.step
+        ):
+            state.epoch, state.step = done, done_step
 
     callbacks = [
         hvt.callbacks.LearningRateWarmupCallback(warmup_epochs=3),
@@ -148,6 +156,11 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
         steps_per_epoch=steps,
         epochs=epochs,
         initial_epoch=state.epoch,
+        # Mid-epoch commits/rescales (commit_every_steps /
+        # rescale_every_steps) resume at the committed OPTIMIZER step:
+        # the feeding path fast-forwards the resharded dataset
+        # deterministically, so survivors replay zero steps.
+        initial_step=state.step,
         callbacks=callbacks,
         verbose=1 if world.rank == 0 else 0,
     )

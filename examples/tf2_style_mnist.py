@@ -101,6 +101,10 @@ def main() -> None:
     # Rank-0-only artifacts (:85-92); other workers would corrupt them.
     if hvt.rank() == 0:
         callbacks.append(
+            # HVT_SAVE_EVERY_STEPS (env default) additionally checkpoints
+            # every N optimizer steps with an (epoch, step) manifest —
+            # the resume below then restarts mid-epoch, not at the
+            # epoch boundary.
             hvt.callbacks.ModelCheckpoint(os.path.join(model_dir, "checkpoint-{epoch}.msgpack"))
         )
         callbacks.append(hvt.callbacks.ScalarLogger(model_dir, update_freq="batch"))
@@ -111,19 +115,27 @@ def main() -> None:
     # Resume: restore the newest checkpoint (primary loads, every process
     # adopts via broadcast) and continue the epoch numbering — the
     # reference's restore contract (tensorflow2_keras_mnist.py:68-71) made
-    # explicit. A fresh model_dir starts from epoch 0.
+    # explicit, at STEP granularity: a mid-epoch checkpoint's manifest
+    # hands back (epoch, step) and fit fast-forwards the data to exactly
+    # there. A fresh model_dir starts from epoch 0.
     trainer.build(x_train[:1])
-    trainer.state, done_epochs = checkpoint.restore_latest_and_broadcast(
-        model_dir, trainer.state, mesh=trainer.mesh
+    trainer.state, done_epochs, done_steps = (
+        checkpoint.restore_latest_and_broadcast(
+            model_dir, trainer.state, mesh=trainer.mesh, with_step=True
+        )
     )
-    if done_epochs and hvt.rank() == 0:
-        print(f"Resuming from checkpoint epoch {done_epochs}")
+    if (done_epochs or done_steps) and hvt.rank() == 0:
+        print(
+            f"Resuming from checkpoint epoch {done_epochs}"
+            + (f" step {done_steps}" if done_steps else "")
+        )
 
     trainer.fit(
         dataset,
         steps_per_epoch=steps_per_epoch,
         epochs=epochs,
         initial_epoch=done_epochs,
+        initial_step=done_steps,
         callbacks=callbacks,
         verbose=1 if hvt.rank() == 0 else 0,  # :92
     )

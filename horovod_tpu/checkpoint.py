@@ -67,6 +67,17 @@ PyTree = Any
 # digest sidecars (extra '.sha256' after the extension).
 CHECKPOINT_RE = re.compile(r"checkpoint-(\d+)\.\w+$")
 
+# Progress manifest: '<file>.meta.json' records the (epoch, step) a
+# checkpoint resumes at — STEP-granular, so a mid-epoch save (ModelCheckpoint
+# save_every_steps) relaunches with fit(initial_epoch=epoch, initial_step=
+# step) instead of replaying the whole epoch. The meta also records the
+# payload's sha256: a crash between the payload's atomic replace and the
+# meta's leaves a stale meta whose digest no longer matches, and
+# `checkpoint_progress` then falls back to (filename epoch, step 0) — a
+# full-epoch replay, never a wrong weights/step pairing. Sharded
+# checkpoints carry the same record in index.json ("progress").
+META_SUFFIX = ".meta.json"
+
 # Integrity sidecar: '<file>.sha256' holds the hex digest of '<file>'.
 # Written right after the payload's atomic rename; verified on discovery
 # and restore, so a checkpoint corrupted AFTER its atomic write landed (a
@@ -152,9 +163,15 @@ def _read_verified(path: str) -> bytes:
     return data
 
 
-def save(path: str, state: PyTree) -> str:
+def save(path: str, state: PyTree, progress: tuple | None = None) -> str:
     """Serialize a state pytree to one file, atomically. Caller gates rank
     (callbacks do; direct users should check ``runtime.is_primary()``).
+
+    ``progress=(epoch, step)`` additionally writes the ``.meta.json``
+    progress manifest: the resume point this checkpoint represents, at
+    OPTIMIZER-step granularity (step 0 = an epoch boundary). The manifest
+    records the payload's sha256 so a torn save can never pair fresh
+    weights with a stale step (see `checkpoint_progress`).
 
     Refuses cross-process-sharded state loudly: no single process holds it,
     so a one-file checkpoint is impossible — use `save_sharded` (the
@@ -167,9 +184,14 @@ def save(path: str, state: PyTree) -> str:
             "save_checkpoint/ModelCheckpoint select it automatically."
         )
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    _atomic_write(
-        path, serialization.to_bytes(jax.device_get(state)), digest=True
-    )
+    data = serialization.to_bytes(jax.device_get(state))
+    _atomic_write(path, data, digest=True)
+    if progress is not None:
+        epoch, step = progress
+        _atomic_write(path + META_SUFFIX, json.dumps({
+            "epoch": int(epoch), "step": int(step),
+            "payload_sha256": hashlib.sha256(data).hexdigest(),
+        }).encode())
     return path
 
 
@@ -209,7 +231,8 @@ class _SaveThread:
         return alive
 
 
-def save_async(path: str, state: PyTree) -> _SaveThread:
+def save_async(path: str, state: PyTree,
+               progress: tuple | None = None) -> _SaveThread:
     """`save` without blocking the training loop.
 
     The state is first copied ON DEVICE (cheap, and immune to the training
@@ -244,7 +267,7 @@ def save_async(path: str, state: PyTree) -> _SaveThread:
         return jnp.copy(a)
 
     snapshot = jax.tree.map(snap, state)
-    return _SaveThread(lambda: save(path, snapshot))
+    return _SaveThread(lambda: save(path, snapshot, progress=progress))
 
 
 def restore(path: str, template: PyTree, *, reshard: bool = False) -> PyTree:
@@ -300,7 +323,8 @@ def leaf_shard_pieces(leaf) -> dict:
     }
 
 
-def save_sharded(path: str, state: PyTree) -> str:
+def save_sharded(path: str, state: PyTree,
+                 progress: tuple | None = None) -> str:
     """Distributed checkpoint: EVERY process calls this (unlike `save`).
 
     Each process writes one ``shard-{p}.msgpack`` holding exactly the shard
@@ -338,6 +362,12 @@ def save_sharded(path: str, state: PyTree) -> str:
                 jax.tree_util.keystr(p) for p, _ in paths_and_leaves
             ],
         }
+        if progress is not None:
+            # The (epoch, step) resume point this checkpoint represents —
+            # the sharded twin of the single-file .meta.json manifest.
+            index["progress"] = {
+                "epoch": int(progress[0]), "step": int(progress[1]),
+            }
         # digest=True: the index gets its own .sha256 sidecar like every
         # payload file — a bit-rotted index would otherwise misdirect the
         # whole restore (wrong n_processes tears discovery; corrupted
@@ -350,7 +380,8 @@ def save_sharded(path: str, state: PyTree) -> str:
     return path
 
 
-def save_sharded_async(path: str, state: PyTree) -> _SaveThread:
+def save_sharded_async(path: str, state: PyTree,
+                       progress: tuple | None = None) -> _SaveThread:
     """`save_sharded` off the training loop: snapshot every array leaf on
     device (buffer-donation immunity, same rationale as `save_async` — the
     copy is a communication-free SPMD identity every process enters), then
@@ -360,7 +391,7 @@ def save_sharded_async(path: str, state: PyTree) -> _SaveThread:
     snapshot = jax.tree.map(
         lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, state
     )
-    return _SaveThread(lambda: save_sharded(path, snapshot))
+    return _SaveThread(lambda: save_sharded(path, snapshot, progress=progress))
 
 
 def _sharded_complete(path: str) -> bool:
@@ -536,17 +567,26 @@ def restore_sharded(path: str, template: PyTree, *,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def save_checkpoint(directory: str, state: PyTree, epoch: int) -> str:
+def save_checkpoint(directory: str, state: PyTree, epoch: int,
+                    step: int = 0) -> str:
     """Epoch-numbered checkpoint (``checkpoint-{epoch}.msgpack``), parity
     with the reference's per-epoch template (tensorflow2_keras_mnist.py:87).
     Epochs are 1-based (epoch 0 means "no checkpoint" on resume).
+    ``step`` > 0 marks a MID-epoch save: ``epoch`` is then the number of
+    COMPLETED epochs and the manifest records the ``(epoch, step)`` resume
+    point — `restore_latest_and_broadcast(with_step=True)` hands it back
+    for ``fit(initial_epoch=, initial_step=)``.
     Cross-process-sharded state routes to the sharded directory format
     (``checkpoint-{epoch}.shards/``) — then ALL processes must call this."""
     if is_cross_process_sharded(state):
         return save_sharded(
-            os.path.join(directory, f"checkpoint-{epoch}{SHARDED_SUFFIX}"), state
+            os.path.join(directory, f"checkpoint-{epoch}{SHARDED_SUFFIX}"),
+            state, progress=(epoch, step),
         )
-    return save(os.path.join(directory, f"checkpoint-{epoch}.msgpack"), state)
+    return save(
+        os.path.join(directory, f"checkpoint-{epoch}.msgpack"), state,
+        progress=(epoch, step),
+    )
 
 
 def checkpoint_intact(path: str) -> bool:
@@ -559,14 +599,60 @@ def checkpoint_intact(path: str) -> bool:
     return file_intact(path)
 
 
-def latest_checkpoint(directory: str) -> str | None:
+def checkpoint_progress(path: str) -> tuple[int, int]:
+    """The ``(epoch, step)`` resume point a checkpoint artifact records —
+    step-granular when a progress manifest exists, ``(filename epoch, 0)``
+    otherwise (pre-manifest checkpoints, or a manifest whose recorded
+    payload sha256 no longer matches the payload: a crash landed the
+    payload but not its manifest, and trusting the stale step would pair
+    fresh weights with an old data position — a full-epoch replay from
+    the filename epoch is the safe degradation)."""
+    m = CHECKPOINT_RE.search(os.path.basename(path))
+    fallback = (int(m.group(1)) if m else 0, 0)
+    try:
+        if os.path.isdir(path):
+            with open(os.path.join(path, INDEX_FILE)) as f:
+                rec = json.load(f).get("progress")
+            if not rec:
+                return fallback
+            return int(rec["epoch"]), int(rec["step"])
+        with open(path + META_SUFFIX) as f:
+            rec = json.load(f)
+        want = rec.get("payload_sha256")
+        if want is not None:
+            actual = recorded_digest(path)
+            if actual is None:
+                h = hashlib.sha256()
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                actual = h.hexdigest()
+            if actual != want:
+                return fallback
+        return int(rec["epoch"]), int(rec["step"])
+    except (OSError, ValueError, KeyError):
+        return fallback
+
+
+def latest_checkpoint(directory: str, *,
+                      complete_only: bool = False) -> str | None:
     """Highest-epoch INTACT checkpoint path, or None. Sharded dirs count
     only when complete, and digest-verified files only when their bytes
     still match (`checkpoint_intact`) — so a checkpoint torn by a crash
     mid-save OR corrupted after landing loses to the previous epoch's
     complete one instead of being restored as garbage. Candidates are
     checked newest-first and only until one passes, so the common
-    nothing-is-corrupt resume hashes exactly one checkpoint."""
+    nothing-is-corrupt resume hashes exactly one checkpoint.
+
+    ``complete_only=True`` additionally skips artifacts whose progress
+    manifest records a MID-epoch step (`checkpoint_progress` step > 0) —
+    the resolution step-UNaware resume takes
+    (`restore_latest_and_broadcast` without ``with_step``): a mid-epoch
+    save (``HVT_SAVE_EVERY_STEPS``) holds weights that already trained an
+    epoch prefix, so a caller that resumes with ``fit(initial_epoch=)``
+    alone must fall back to the newest COMPLETE-epoch checkpoint rather
+    than silently re-apply that prefix's data to weights that consumed
+    it."""
     if not os.path.isdir(directory):
         return None
     candidates = []
@@ -576,6 +662,8 @@ def latest_checkpoint(directory: str) -> str | None:
             candidates.append((int(m.group(1)), os.path.join(directory, name)))
     for _, full in sorted(candidates, reverse=True):
         if checkpoint_intact(full):
+            if complete_only and checkpoint_progress(full)[1] > 0:
+                continue
             return full
     return None
 
@@ -617,10 +705,11 @@ def _discard_future_checkpoints(directory: str, epoch: int) -> None:
             shutil.rmtree(full, ignore_errors=True)
         else:
             os.remove(full)
-            try:
-                os.remove(full + DIGEST_SUFFIX)
-            except OSError:
-                pass  # no sidecar (legacy file), or already gone
+            for suffix in (DIGEST_SUFFIX, META_SUFFIX):
+                try:
+                    os.remove(full + suffix)
+                except OSError:
+                    pass  # no sidecar (legacy file), or already gone
 
 
 def _host_syncable(leaf) -> bool:
@@ -722,19 +811,36 @@ def broadcast_parameters(tree: PyTree, root_rank: int = 0, mesh=None) -> PyTree:
 
 
 def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None,
-                                 *, reshard: bool = False) -> tuple[PyTree, int]:
+                                 *, reshard: bool = False,
+                                 with_step: bool = False):
     """The full resume path (§5.3): the primary loads the newest checkpoint,
     all processes adopt it. Returns (state, epoch) — epoch 0 if none found.
-    ``reshard=True`` additionally accepts sharded checkpoints saved under a
-    different topology/layout (see `restore_sharded`).
+    ``with_step=True`` returns (state, epoch, step) instead: the
+    STEP-granular resume point from the checkpoint's progress manifest
+    (`checkpoint_progress`), broadcast alongside the epoch so every rank
+    resumes ``fit(initial_epoch=epoch, initial_step=step)`` identically —
+    manifest-less checkpoints read as step 0, so callers need no legacy
+    branch. Without ``with_step``, mid-epoch artifacts
+    (``HVT_SAVE_EVERY_STEPS``) are SKIPPED in favor of the newest
+    complete-epoch checkpoint (`latest_checkpoint(complete_only=True)`):
+    a step-unaware caller resuming ``fit(initial_epoch=)`` from mid-epoch
+    weights would re-apply the epoch prefix's data to weights that
+    already trained it — mid-epoch checkpoints are consumable only by
+    step-aware resume. ``reshard=True`` additionally accepts sharded
+    checkpoints saved under a different topology/layout (see
+    `restore_sharded`).
 
     Collective-safe under single-writer checkpoints: only the *primary's*
     view of the directory decides (checkpoints may exist on its filesystem
     only), and that decision is broadcast first so every process takes the
     same branch — no process can skip a collective the others entered."""
     primary = runtime.is_primary()
-    path = latest_checkpoint(directory) if primary else None
+    path = (
+        latest_checkpoint(directory, complete_only=not with_step)
+        if primary else None
+    )
     epoch = int(CHECKPOINT_RE.search(path).group(1)) if path else 0
+    step = checkpoint_progress(path)[1] if path else 0
     # A directory holding ONLY torn sharded dirs (the signature of a
     # rank-gated ModelCheckpoint on a model-parallel run — rank 0 wrote its
     # shard every epoch, the other ranks never did) must NOT silently resume
@@ -751,18 +857,18 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None,
         _discard_future_checkpoints(directory, epoch)
     # Sharded = directory (isdir on the primary's actual pick — names are
     # user-controlled); non-primaries need the real NAME, not a guess, so
-    # broadcast it as fixed-width bytes alongside the epoch.
+    # it travels alongside the epoch. One KV-store object broadcast, NOT
+    # the fixed-width-array device path: host-staged buffers through
+    # broadcast_one_to_all are unreliable on the compat floor (see
+    # collectives._kv_client — gloo returns nondeterministic garbage for
+    # them, which here decoded into a corrupt checkpoint name on
+    # model-parallel meshes).
     sharded = bool(path) and os.path.isdir(path)
-    name = np.zeros(256, np.uint8)
-    if path:
-        raw = os.path.basename(path).encode()
-        name[: len(raw)] = np.frombuffer(raw, np.uint8)
+    name = os.path.basename(path) if path else ""
     if jax.process_count() > 1:
-        hdr = collectives.broadcast(
-            np.array([epoch, int(sharded), len(torn)], np.int64), root=0
+        epoch, sharded, n_torn, step, name = collectives.broadcast_object(
+            (epoch, sharded, len(torn), step, name), root=0
         )
-        name = collectives.broadcast(name, root=0)
-        epoch, sharded, n_torn = int(hdr[0]), bool(hdr[1]), int(hdr[2])
     else:
         n_torn = len(torn)
     if n_torn:
@@ -779,18 +885,23 @@ def restore_latest_and_broadcast(directory: str, template: PyTree, mesh=None,
             "(corruption). Fix the gating (a) or delete the torn "
             "dir(s) to start fresh (b/c)."
         )
-    if epoch == 0:
-        return template, 0
+    def ret(state, epoch, step):
+        return (state, epoch, step) if with_step else (state, epoch)
+
+    if epoch == 0 and step == 0:
+        # Nothing to resume. (A mid-epoch save DURING epoch 0 is
+        # checkpoint-0 with step > 0 — real progress, restored below.)
+        return ret(template, 0, 0)
     if sharded:
         # Collective restore: every process reads the shard bytes its own
         # template shardings need — identical bytes for replicated leaves,
         # so the post-restore broadcast is unnecessary by construction.
-        spath = os.path.join(
-            directory, bytes(name).rstrip(b"\0").decode()
+        spath = os.path.join(directory, name)
+        return ret(
+            restore_sharded(spath, template, reshard=reshard), epoch, step
         )
-        return restore_sharded(spath, template, reshard=reshard), epoch
     state = restore(path, template) if primary else template
-    return broadcast_parameters(state, mesh=mesh), epoch
+    return ret(broadcast_parameters(state, mesh=mesh), epoch, step)
 
 
 # --- Serving export (TF-free SavedModel role) ------------------------------

@@ -164,18 +164,39 @@ class ArrayDataset:
                 return
 
     def __iter__(self):
+        return self.batches()
+
+    def batches(self, skip: int = 0):
+        """Iterate batches, optionally fast-forwarded past the first
+        ``skip`` batches WITHOUT materializing them: the skipped stretch
+        only consumes integers from the shuffle's index stream (no row
+        gathers, no batch assembly), so resuming a run at optimizer step S
+        costs O(S·batch) index draws, not O(S·batch·row_bytes) of copied
+        data. The stream is a pure function of (seed, shard geometry), so
+        ``ds.batches(skip=n)`` yields byte-identically what the (n+1)-th
+        ``iter(ds)`` batch onward would — the deterministic-resume
+        contract `Trainer.fit(initial_step=)` builds on; `reshard` at the
+        same world size preserves it (identical arrays → identical
+        stream)."""
         if self._batch_size is None:
             raise ValueError("call .batch(batch_size) before iterating")
         bs = self._batch_size
+        skipped = 0
         pending: list[int] = []
         unflatten = jax.tree_util.tree_unflatten
         for idx in self._index_stream():
             pending.append(idx)
             if len(pending) == bs:
+                if skipped < skip:
+                    skipped += 1
+                    pending = []
+                    continue
                 sel = np.asarray(pending)
                 pending = []
                 yield unflatten(self._treedef, [a[sel] for a in self._arrays])
         if pending and not self._drop_remainder:
+            if skipped < skip:
+                return
             sel = np.asarray(pending)
             yield unflatten(self._treedef, [a[sel] for a in self._arrays])
 
@@ -190,6 +211,7 @@ def training_pipeline(
     seed: int = 0,
     shuffle_buffer: int | None = None,
     structure=None,
+    skip_batches: int = 0,
 ):
     """The training-path input iterator: infinite shuffled batches of the
     given arrays (the reference's ``repeat().shuffle().batch()`` chain,
@@ -211,7 +233,17 @@ def training_pipeline(
     ``structure`` (an `ArrayDataset.structure` treedef) to have batches
     rebuilt into the original pytree shape — how dict-input (multi-input)
     models ride both the native and Python assembly paths.
+
+    ``skip_batches`` fast-forwards the stream past its first N batches —
+    the step-granular resume hook (`Trainer.fit(initial_step=)`). Each
+    engine skips within ITS OWN deterministic stream (python: index draws
+    only, nothing materialized; native: slots advanced and released
+    without a host copy), so a resumed run sees byte-identically the
+    batches an uninterrupted run of the same engine would have seen from
+    that position.
     """
+    skip_batches = int(skip_batches)
+
     def rebuild(it):
         if structure is None:
             return it
@@ -228,6 +260,8 @@ def training_pipeline(
             loader = native_loader.NativeBatchLoader(
                 arrays, batch_size, seed=seed, shuffle=True
             )
+            if skip_batches:
+                loader.skip(skip_batches)
             return rebuild(iter(loader)), loader.close
     ds = (
         ArrayDataset(arrays)
@@ -235,4 +269,4 @@ def training_pipeline(
         .shuffle(shuffle_buffer or n, seed=seed)
         .batch(batch_size)
     )
-    return rebuild(iter(ds)), lambda: None
+    return rebuild(ds.batches(skip=skip_batches)), lambda: None

@@ -163,6 +163,23 @@ class NativeBatchLoader:
             out.append(arr.copy() if self.copy else arr)
         return tuple(out)
 
+    def skip(self, n_batches: int) -> None:
+        """Fast-forward the stream past ``n_batches`` batches without a
+        host copy: each skipped slot is advanced and released unread (the
+        C++ producer's ring recycles it), so the loader's permutation
+        stream lands exactly where an uninterrupted consumer would be —
+        the step-granular resume hook (`Trainer.fit(initial_step=)`)."""
+        if self._handle is None:
+            raise RuntimeError("loader is closed")
+        if self._held_slot >= 0:
+            self._lib.hvt_loader_release(self._handle, self._held_slot)
+            self._held_slot = -1
+        for _ in range(int(n_batches)):
+            slot = self._lib.hvt_loader_next(self._handle)
+            if slot < 0:
+                raise RuntimeError("native loader stream ended during skip")
+            self._lib.hvt_loader_release(self._handle, slot)
+
     def close(self):
         if self._handle is not None:
             self._lib.hvt_loader_destroy(self._handle)

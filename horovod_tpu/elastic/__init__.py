@@ -27,7 +27,9 @@ Worker-side idiom::
             trainer.state, done = checkpoint.restore_latest_and_broadcast(...)
             state.epoch = max(state.epoch, done)
         cb = elastic.ElasticStateCallback(state, state.client)
-        trainer.fit(..., initial_epoch=state.epoch, callbacks=[..., cb])
+        trainer.fit(..., initial_epoch=state.epoch,
+                    initial_step=state.step,   # mid-epoch commits resume
+                    callbacks=[..., cb])       # at the committed step
 
     elastic.run(train)   # reads HVT_ELASTIC_COORDINATOR/_MEMBER
 

@@ -57,6 +57,16 @@ class ElasticError(RuntimeError):
 # ranges (or past 65535).
 SYNC_PORT_WINDOW = 64
 
+# Radix of the committed-progress ordering key (`state.progress_marker`:
+# epoch·RADIX + min(step, RADIX-1)): epochs dominate, steps break ties.
+# Wide enough that no practical epoch length overflows it (1e9 optimizer
+# steps ≈ years of training); `progress_marker` clamps anyway, so even a
+# beyond-radix epoch degrades to an in-epoch tie rather than letting a
+# mid-epoch commit outrank the next epoch's start. Lives here (not
+# state.py) because state imports coordinator, and the journal's
+# epoch/step decompose below must use the same radix.
+PROGRESS_STEP_RADIX = 1_000_000_000
+
 
 @dataclasses.dataclass(frozen=True)
 class WorldInfo:
@@ -322,7 +332,18 @@ class Coordinator:
                 m.last_beat = time.monotonic()
                 if "progress" in msg:
                     m.progress = int(msg["progress"])
+            # ``pending``: the membership changed since the world this
+            # member last received (its `_answers` entry) — piggybacked
+            # on the heartbeat so workers' STEADY-STATE sub-epoch rescale
+            # rounds stay one cheap boolean agreement instead of a full
+            # vote (`ElasticStateCallback.rescale_every_steps`).
+            ans = self._answers.get(member_id)
+            pending = bool(
+                m is not None and m.status == "live"
+                and (ans is None or ans.get("generation") != self.generation)
+            )
             return {"generation": self.generation,
+                    "pending": pending,
                     "known": m is not None and m.status == "live"}
 
     def _handle_leave(self, msg: dict) -> dict:
@@ -457,11 +478,25 @@ class Coordinator:
             "jax_coordinator": jax_coordinator,
             "kind": kind, "wall_time": time.time(),
         }
+        # Progress decomposed from the root's committed marker
+        # (state.progress_marker: epoch·PROGRESS_STEP_RADIX + step):
+        # settle records say WHERE in training the membership change
+        # landed, and shrink/grow get a dedicated step-valued record so
+        # job specs can gate "the rescale really happened MID-epoch"
+        # (`shrink_step=1..N`).
+        step = max(0, root.progress) % PROGRESS_STEP_RADIX
+        epoch = max(0, root.progress) // PROGRESS_STEP_RADIX
         self._write_journal(
             kind, float(size), generation=self.generation, size=size,
             members=",".join(m.member_id for m in live),
-            root=root.member_id,
+            root=root.member_id, progress=root.progress,
+            epoch=epoch, step=step,
         )
+        if kind in ("shrink", "grow"):
+            self._write_journal(
+                f"{kind}_step", float(step), generation=self.generation,
+                epoch=epoch,
+            )
         for m in live:
             world = {
                 "rank": m.rank, "size": size,
@@ -574,6 +609,10 @@ class ElasticClient:
         )
         self.timeout = timeout
         self.synced_generation = -1
+        # Set from each beat reply: the coordinator observed a membership
+        # change this member has not rendezvoused over yet (the cheap
+        # steady-state signal for sub-epoch rescale rounds).
+        self.last_beat_pending = False
 
     def _call(self, timeout: float | None = None, **msg) -> dict:
         with socket.create_connection(
@@ -622,11 +661,16 @@ class ElasticClient:
 
     def beat(self, progress: int | None = None) -> int:
         """One TCP heartbeat; returns the coordinator's CURRENT generation
-        (compare with `synced_generation` to detect membership changes)."""
+        (compare with `synced_generation` to detect membership changes).
+        Also records the reply's ``pending`` membership flag on
+        ``self.last_beat_pending`` — the piggybacked signal sub-epoch
+        rescale rounds consult before escalating to a full vote."""
         msg = {"cmd": "beat", "member": self.member_id}
         if progress is not None:
             msg["progress"] = progress
-        return int(self._call(timeout=10.0, **msg)["generation"])
+        reply = self._call(timeout=10.0, **msg)
+        self.last_beat_pending = bool(reply.get("pending", False))
+        return int(reply["generation"])
 
     def leave(self, reason: str = "leave") -> None:
         """Planned departure — the clean-shrink signal."""

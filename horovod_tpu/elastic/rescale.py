@@ -62,13 +62,21 @@ def run(
     committed snapshot exists, the checkpoint-restore idiom otherwise),
     include ``ElasticStateCallback(state, client)`` in its fit callbacks
     (LAST in the list, so earlier callbacks see each epoch before a
-    rescale can interrupt it), and train from ``state.epoch``.
+    rescale can interrupt it), and train from ``state.epoch`` AND
+    ``state.step`` — pass both to ``fit(initial_epoch=state.epoch,
+    initial_step=state.step)`` so a generation that rescaled mid-epoch
+    resumes at the committed OPTIMIZER step with the data iterator
+    deterministically fast-forwarded (zero replayed steps), not at the
+    epoch boundary.
 
     Per generation: rendezvous (`client.sync` — blocks until the world
     settles), rebuild the runtime (`ensure_world`), adopt the freshest
-    committed snapshot (`state.sync` from the coordinator-elected root),
-    then hand over to ``train_fn``. A `HostsUpdatedInterrupt` rolls state
-    back to the last commit and loops; a `LeaveInterrupt` notifies the
+    committed snapshot (`state.sync` from the coordinator-elected root —
+    ordered by `progress_marker(epoch, step)`, so a mid-epoch commit
+    outranks the same epoch's start), then hand over to ``train_fn``. A
+    `HostsUpdatedInterrupt` rolls state back to the last commit —
+    `state.restore()` hands back the ``(epoch, step)`` resume point —
+    and loops; a `LeaveInterrupt` notifies the
     coordinator (already done at the boundary) and exits with status 143
     — the preemption convention the supervisor classifies as a planned,
     clean departure. Normal return reports ``done`` and hands back

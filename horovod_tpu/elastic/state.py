@@ -47,7 +47,7 @@ import numpy as np
 from horovod_tpu import runtime
 from horovod_tpu.elastic.coordinator import ElasticError
 from horovod_tpu.parallel import collectives
-from horovod_tpu.training.callbacks import Callback
+from horovod_tpu.training.callbacks import Callback, agree_any
 
 # What a control-plane call can throw when the coordinator is dying or
 # racing teardown: socket errors, a mid-exchange close / error reply
@@ -70,8 +70,18 @@ class LeaveInterrupt(BaseException):
 def progress_marker(epoch: int, step: int = 0) -> int:
     """Total order over committed progress: epochs dominate, steps break
     ties within an epoch (the every-N-steps commit cadence). Used to elect
-    the rendezvous root — the member whose snapshot everyone adopts."""
-    return int(epoch) * 1_000_000 + int(step)
+    the rendezvous root — the member whose snapshot everyone adopts.
+    Steps are clamped into the radix (`coordinator.PROGRESS_STEP_RADIX`),
+    so a pathological beyond-radix epoch degrades to a tie within that
+    epoch — it can never make a mid-epoch commit outrank the next epoch's
+    start. The resume point itself travels as full-fidelity (epoch, step)
+    ints; only this ORDERING key (and the journal's decompose of it) is
+    radix-bounded."""
+    from horovod_tpu.elastic.coordinator import PROGRESS_STEP_RADIX
+
+    return int(epoch) * PROGRESS_STEP_RADIX + min(
+        int(step), PROGRESS_STEP_RADIX - 1
+    )
 
 
 # --- per-shard commit for cross-process-sharded state -----------------------
@@ -337,14 +347,18 @@ class ElasticState:
                 ) from None
         self._committed = jax.tree_util.tree_unflatten(treedef, out)
 
-    def restore(self) -> None:
+    def restore(self) -> tuple:
         """Roll tracked attributes back to the last commit (no-op before
         the first — a fresh member keeps its initial values and relies on
-        `sync` or the checkpoint fallback)."""
-        if self._committed is None:
-            return
-        for k, v in self._committed.items():
-            setattr(self, k, v)
+        `sync` or the checkpoint fallback). Returns the restored resume
+        point ``(epoch, step)`` — what the next generation's train
+        function hands to ``fit(initial_epoch=, initial_step=)`` so the
+        run continues at the committed OPTIMIZER step, not the epoch
+        boundary."""
+        if self._committed is not None:
+            for k, v in self._committed.items():
+                setattr(self, k, v)
+        return int(self.epoch), int(self.step)
 
     @property
     def progress(self) -> int:
@@ -461,12 +475,31 @@ class ElasticStateCallback(Callback):
     knob (sub-epoch cadence would require splitting the epoch program).
     Mid-epoch commits record ``(epoch, step)`` progress
     (`progress_marker` orders them under the epoch-end commit), which
-    drives root election after a crash; the training loop itself still
-    resumes at epoch granularity (``initial_epoch``), with the
-    freshest mid-epoch WEIGHTS. Defaults read the job-spec surface:
-    ``HVT_COMMIT_EVERY`` / ``HVT_COMMIT_EVERY_STEPS`` (set by the
+    drives root election after a crash — and the training loop resumes
+    AT that step: `ElasticState.restore` hands back ``(epoch, step)``
+    and the train function passes both to ``fit(initial_epoch=,
+    initial_step=)``, whose feeding paths deterministically fast-forward
+    the data to the committed optimizer step (zero replayed steps).
+
+    ``rescale_every_steps``: ADDITIONALLY run the membership agreement
+    every N optimizer steps within an epoch (0 = epoch boundaries only)
+    — the sub-epoch rescale cadence for long epochs. Steady-state rounds
+    cost one cheap boolean agreement (`agree_any`): the coordinator
+    piggybacks a ``pending`` membership flag on heartbeat replies, so a
+    rank only escalates to the full vote when some rank saw a pending
+    generation bump or leave intent. On agreement the boundary runs
+    exactly like the epoch-end one — commit at the CURRENT ``(epoch,
+    step)``, sharded reassembly if anyone is departing, lockstep
+    `runtime.shutdown` at the step boundary, interrupt — so a joiner is
+    admitted (and a clean leaver released) within N optimizer steps
+    instead of waiting out the epoch. Like ``commit_every_steps``, the
+    cadence is accumulation-aligned by construction and epoch-granular
+    on ``fit(cache='device')``.
+
+    Defaults read the job-spec surface: ``HVT_COMMIT_EVERY`` /
+    ``HVT_COMMIT_EVERY_STEPS`` / ``HVT_RESCALE_EVERY_STEPS`` (set by the
     supervisor from the ``elastic:`` block's ``commit_every`` /
-    ``commit_every_steps`` keys).
+    ``commit_every_steps`` / ``rescale_every_steps`` keys).
 
     SIGTERM: a handler installed for the duration of fit() records the
     signal as leave intent, so a scheduler preemption becomes a clean
@@ -477,6 +510,7 @@ class ElasticStateCallback(Callback):
     def __init__(self, state: ElasticState, client, *,
                  commit_every: int | None = None,
                  commit_every_steps: int | None = None,
+                 rescale_every_steps: int | None = None,
                  beat_interval: float = 1.0):
         import os
 
@@ -490,12 +524,18 @@ class ElasticStateCallback(Callback):
                 os.environ.get("HVT_COMMIT_EVERY_STEPS", 0) or 0
             )
         self.commit_every_steps = max(0, int(commit_every_steps))
+        if rescale_every_steps is None:
+            rescale_every_steps = int(
+                os.environ.get("HVT_RESCALE_EVERY_STEPS", 0) or 0
+            )
+        self.rescale_every_steps = max(0, int(rescale_every_steps))
         self.beat_interval = beat_interval
         self._last_beat = 0.0
         self._leave_requested = False
         self._old_handler = None
         self._epoch = 0
         self._last_commit_step = 0
+        self._last_rescale_step = 0
 
     # --- liveness ----------------------------------------------------------
 
@@ -537,13 +577,21 @@ class ElasticStateCallback(Callback):
 
     def on_epoch_begin(self, epoch: int, logs=None):
         self._epoch = epoch
-        self._last_commit_step = 0
+        # Step cadences measure from the TRUE resume point: a fit resumed
+        # mid-epoch (initial_step=S) fires its first on_batch_end at step
+        # S+1, and a zero baseline would make every cadence fire
+        # immediately on resume.
+        base = 0
+        if self.trainer is not None and epoch == getattr(
+            self.trainer, "_resume_epoch", 0
+        ):
+            base = int(getattr(self.trainer, "_resume_step", 0))
+        self._last_commit_step = base
+        self._last_rescale_step = base
         self._beat(force=True)
 
     def on_batch_end(self, batch: int, logs=None):
         self._beat()
-        if not self.commit_every_steps:
-            return
         # ``batch`` indexes OPTIMIZER steps (the Trainer fires this hook
         # once per compiled execution — per optimizer step at
         # steps_per_execution=1, per chunk otherwise), so a commit here is
@@ -552,12 +600,91 @@ class ElasticStateCallback(Callback):
         # across the hook. >= (not ==) so steps_per_execution chunks that
         # stride past the cadence still commit at the next boundary.
         done = batch + 1
-        if done - self._last_commit_step >= self.commit_every_steps:
+        if (
+            self.commit_every_steps
+            and done - self._last_commit_step >= self.commit_every_steps
+        ):
             self._last_commit_step = done
             self.state.state = self.trainer.state
             self.state.epoch = self._epoch
             self.state.step = done
             self.state.commit()
+        self._maybe_step_rescale(done)
+
+    def _maybe_step_rescale(self, done: int) -> None:
+        """The SUB-EPOCH membership agreement (``rescale_every_steps``):
+        at the cadence's step boundaries, agree fleet-wide whether the
+        membership changed and, if so, run the same commit → (sharded
+        reassembly) → lockstep-teardown boundary the epoch end runs —
+        at the CURRENT optimizer step, so survivors resume with
+        ``initial_step`` and zero replayed steps, and joiners/leavers
+        wait at most N steps instead of an epoch."""
+        from horovod_tpu.testing import faults
+
+        if not self.rescale_every_steps:
+            return
+        if done - self._last_rescale_step < self.rescale_every_steps:
+            return
+        self._last_rescale_step = done
+        gen = self._beat(force=True)
+        leaving = self._leave_requested or faults.leave_requested()
+        pending = bool(
+            leaving
+            or getattr(self.client, "last_beat_pending", False)
+            or (gen is not None and gen != self.client.synced_generation)
+        )
+        # Steady state costs ONE boolean agreement: the coordinator
+        # piggybacks the pending-membership flag on the heartbeat reply,
+        # so unless some rank saw a generation drift or leave intent the
+        # round ends here.
+        if not agree_any(pending):
+            return
+        if jax.process_count() > 1:
+            votes = collectives.allgather_object(
+                (gen if gen is not None else -1, bool(leaving))
+            )
+            agreed_gen = max(g for g, _ in votes)
+            any_leaving = any(l for _, l in votes)
+        else:
+            agreed_gen = gen if gen is not None else -1
+            any_leaving = bool(leaving)
+        changed = (
+            any_leaving
+            or (agreed_gen >= 0
+                and agreed_gen != self.client.synced_generation)
+        )
+        if not changed:
+            return  # the pending flag raced a settle; next cadence re-checks
+        # Clean STEP boundary: bank progress at (epoch, done) — the
+        # resumed generation fast-forwards its data to exactly here —
+        # then tear down in lockstep (the votes above guarantee every
+        # rank of the generation reaches this barrier at the same step).
+        self.state.state = self.trainer.state
+        self.state.epoch = self._epoch
+        self.state.step = done
+        self.state.commit()
+        if self.state.has_sharded_commit and any_leaving:
+            # Same departure-only reassembly rule as the epoch boundary
+            # (grow-only changes defer to sync's reassembly on the new
+            # world) — see on_epoch_end for the full rationale.
+            self.state.gather_committed()
+        self._teardown_and_interrupt(leaving)
+
+    def _teardown_and_interrupt(self, leaving: bool):
+        """The shared tail of both membership boundaries: synchronized
+        runtime teardown, then the interrupt that unwinds fit()."""
+        from horovod_tpu.testing import faults
+
+        runtime.shutdown()
+        if leaving:
+            try:
+                self.client.leave(
+                    reason="fault" if faults.leave_requested() else "sigterm"
+                )
+            except CONTROL_PLANE_ERRORS:
+                pass
+            raise LeaveInterrupt()
+        raise HostsUpdatedInterrupt()
 
     # --- the commit + agreement boundary -----------------------------------
 
@@ -617,13 +744,4 @@ class ElasticStateCallback(Callback):
             # (the same death DURING the old boundary gather lost the
             # same progress; only the window is slightly wider).
             self.state.gather_committed()
-        runtime.shutdown()
-        if leaving:
-            try:
-                self.client.leave(
-                    reason="fault" if faults.leave_requested() else "sigterm"
-                )
-            except CONTROL_PLANE_ERRORS:
-                pass
-            raise LeaveInterrupt()
-        raise HostsUpdatedInterrupt()
+        self._teardown_and_interrupt(leaving)

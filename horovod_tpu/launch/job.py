@@ -99,10 +99,27 @@ def run_job(spec_path: str) -> int:
     #     commit_every_steps: 0   # sub-epoch cadence, optimizer steps
     #                             # (0 = epoch cadence only; commits are
     #                             # accumulation-boundary-aligned)
+    #     rescale_every_steps: 0  # sub-epoch MEMBERSHIP agreement cadence,
+    #                             # optimizer steps (0 = epoch boundaries
+    #                             # only): joins/leaves execute mid-epoch
+    #                             # and survivors resume at the committed
+    #                             # step (fit initial_step)
     # Composes with `restart:` for the budget/backoff/heartbeat knobs; the
     # journal (restart log) carries the generation-tagged shrink/grow
-    # events the gate and /healthz read.
+    # events the gate and /healthz read. A top-level `status_port: N` under
+    # job: serves the supervisor's own HTTP status (GET /status, /journal,
+    # /healthz — supervisor.start_status_server) for the run's duration.
     log_path = None  # set by the supervised branches; journal_checks needs it
+    status_port = int(job["status_port"]) if job.get("status_port") else None
+    if status_port is not None and not ("elastic" in job or "restart" in job):
+        # Match the CLI, where --status-port without supervision flags
+        # errors: the status server is the SUPERVISOR's — an unsupervised
+        # launch has nothing to serve, and silently ignoring the key
+        # would leave the operator's /healthz probes failing against a
+        # job that looks correctly configured.
+        print("job status_port: needs a supervised launch — add a "
+              "restart: or elastic: block")
+        return 1
     if "elastic" in job:
         elastic_map = job["elastic"] or {}
         if not isinstance(elastic_map, dict):
@@ -125,11 +142,13 @@ def run_job(spec_path: str) -> int:
                 list(hosts), argv, env=env, policy=policy, elastic=elastic,
                 sync_port_base=int(job.get("coordinator_port", 9981)),
                 workdir=job.get("workdir"), log_path=log_path,
+                status_port=status_port,
             )
         else:
             code = supervisor.supervise_elastic(
                 int(job.get("nprocs", 1)), argv, env=env, policy=policy,
                 elastic=elastic, log_path=log_path,
+                status_port=status_port,
             )
     elif "restart" in job:
         # Key-present-but-empty (`restart:` with every knob commented out)
@@ -154,11 +173,12 @@ def run_job(spec_path: str) -> int:
                 list(hosts), argv, env=env, policy=policy,
                 coordinator_port=int(job.get("coordinator_port", 9981)),
                 workdir=job.get("workdir"), log_path=log_path,
+                status_port=status_port,
             )
         else:
             code = supervisor.supervise_local(
                 int(job.get("nprocs", 1)), argv, env=env, policy=policy,
-                log_path=log_path,
+                log_path=log_path, status_port=status_port,
             )
     elif hosts:
         code = launcher.run_hosts(

@@ -288,6 +288,15 @@ def main(argv: list[str] | None = None) -> int:
             "older than this (hang detection; sets HVT_HEARTBEAT_DIR for "
             "the ranks)")
         p.add_argument(
+            "--status-port", type=int, default=None, metavar="N",
+            help="serve the supervisor's own status over HTTP on this "
+            "port: GET /status (fleet_status + the elastic rendezvous "
+            "snapshot), GET /journal (the restart/elastic journal as "
+            "JSON), GET /healthz — no serving bundle required (the "
+            "`serve --fleet-journal` surface, from the supervisor "
+            "itself). Needs a supervised launch (any restart/elastic "
+            "flag)")
+        p.add_argument(
             "--restart-log", default=None, metavar="PATH",
             help="JSONL restart journal (default: "
             "$PS_MODEL_PATH/restarts.jsonl; gateable — "
@@ -370,13 +379,20 @@ def main(argv: list[str] | None = None) -> int:
             return supervisor.supervise_elastic(
                 args.nprocs, command, env=env, policy=policy,
                 elastic=elastic, log_path=args.restart_log,
+                status_port=args.status_port,
             )
         if policy is not None:
             from horovod_tpu.launch import supervisor
 
             return supervisor.supervise_local(
                 args.nprocs, command, env=env, policy=policy,
-                log_path=args.restart_log,
+                log_path=args.restart_log, status_port=args.status_port,
+            )
+        if args.status_port is not None:
+            parser.error(
+                "--status-port needs a supervised launch: add a "
+                "restart flag (--max-restarts/--backoff/"
+                "--heartbeat-timeout/--restart-log) or --elastic"
             )
         return run_local(args.nprocs, command, env=env)
     if args.cmd == "pod":
@@ -399,7 +415,7 @@ def main(argv: list[str] | None = None) -> int:
             return supervisor.supervise_elastic_hosts(
                 hosts, command, env=env, policy=policy, elastic=elastic,
                 sync_port_base=args.port, workdir=args.workdir,
-                log_path=args.restart_log,
+                log_path=args.restart_log, status_port=args.status_port,
             )
         if args.heartbeat_timeout is not None and not (
             env.get("PS_MODEL_PATH") or os.environ.get("PS_MODEL_PATH")
@@ -421,7 +437,13 @@ def main(argv: list[str] | None = None) -> int:
             return supervisor.supervise_hosts(
                 hosts, command, env=env, policy=policy,
                 coordinator_port=args.port, workdir=args.workdir,
-                log_path=args.restart_log,
+                log_path=args.restart_log, status_port=args.status_port,
+            )
+        if args.status_port is not None:
+            parser.error(
+                "--status-port needs a supervised launch: add a "
+                "restart flag (--max-restarts/--backoff/"
+                "--heartbeat-timeout/--restart-log) or --elastic"
             )
         return run_hosts(hosts, command, env=env,
                          coordinator_port=args.port, workdir=args.workdir)

@@ -342,6 +342,7 @@ def supervise(
     model_dir: str | None = None,
     heartbeat_dir: str | None = None,
     log_path: str | None = None,
+    status_port: int | None = None,
     sleep=time.sleep,
     verbose: bool = True,
 ) -> int:
@@ -350,16 +351,35 @@ def supervise(
     `start_hosts` with the env already carrying ``HVT_HEARTBEAT_DIR`` —
     `supervise_local` does this wiring). Returns 0 on fleet success, else
     the final failure's shell exit code once the no-progress budget is
-    exhausted."""
+    exhausted. ``status_port`` serves `start_status_server` from this
+    supervisor for the run's duration (fleet status + journal over HTTP,
+    no serving bundle required)."""
     policy = policy or RestartPolicy()
     log = RestartLog(log_path)
     log.touch()
+    status_server = (
+        start_status_server(status_port, log_path)
+        if status_port is not None else None
+    )
     marker = newest_checkpoint_marker(model_dir)
     restarts_used = 0   # consecutive no-progress restarts — the budget
     total_restarts = 0  # lifetime count — what the log/gate report
     backoff = policy.backoff
     attempt = 0
 
+    try:
+        return _supervise_loop(
+            start, policy, log, model_dir, heartbeat_dir, sleep, verbose,
+            marker, restarts_used, total_restarts, backoff, attempt,
+        )
+    finally:
+        if status_server is not None:
+            status_server.shutdown()
+
+
+def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
+                    verbose, marker, restarts_used, total_restarts, backoff,
+                    attempt) -> int:
     while True:
         attempt += 1
         abort = None
@@ -466,6 +486,7 @@ def supervise_local(
     model_dir: str | None = None,
     heartbeat_dir: str | None = None,
     log_path: str | None = None,
+    status_port: int | None = None,
     tag_output: bool = True,
     sleep=time.sleep,
 ) -> int:
@@ -483,6 +504,7 @@ def supervise_local(
         model_dir=model_dir,
         heartbeat_dir=heartbeat_dir,
         log_path=log_path,
+        status_port=status_port,
         sleep=sleep,
     )
 
@@ -504,13 +526,23 @@ class ElasticPolicy:
     defaults — so a job spec tunes the cadence without entry-script
     changes. Sub-epoch commits are always aligned to gradient-accumulation
     boundaries (the callback commits per optimizer step; see
-    `ElasticStateCallback.commit_every_steps`)."""
+    `ElasticStateCallback.commit_every_steps`).
+
+    ``rescale_every_steps`` (optimizer steps; 0 = epoch boundaries only)
+    sets the members' SUB-EPOCH membership-agreement cadence
+    (``HVT_RESCALE_EVERY_STEPS`` → `ElasticStateCallback.
+    rescale_every_steps`): joiners are admitted and clean leavers
+    released within N optimizer steps instead of an epoch, with
+    survivors resuming at the committed step (``initial_step``). Pair
+    with ``commit_every_steps`` so the boundary always has a fresh
+    sub-epoch commit to resume from."""
 
     min_ranks: int = 1
     max_ranks: int | None = None
     rendezvous_timeout: float = 60.0
     commit_every: int = 1
     commit_every_steps: int = 0
+    rescale_every_steps: int = 0
 
     @classmethod
     def from_mapping(cls, mapping) -> "ElasticPolicy":
@@ -532,14 +564,16 @@ class ElasticPolicy:
         return policy
 
     def commit_env(self) -> dict:
-        """The member-env overlay carrying the commit cadence (only the
-        non-default knobs, so an explicit ElasticStateCallback argument in
-        user code still wins when the spec says nothing)."""
+        """The member-env overlay carrying the commit/rescale cadences
+        (only the non-default knobs, so an explicit ElasticStateCallback
+        argument in user code still wins when the spec says nothing)."""
         env = {}
         if self.commit_every != 1:
             env["HVT_COMMIT_EVERY"] = str(self.commit_every)
         if self.commit_every_steps:
             env["HVT_COMMIT_EVERY_STEPS"] = str(self.commit_every_steps)
+        if self.rescale_every_steps:
+            env["HVT_RESCALE_EVERY_STEPS"] = str(self.rescale_every_steps)
         return env
 
 
@@ -576,6 +610,7 @@ def supervise_elastic(
     *,
     model_dir: str | None = None,
     log_path: str | None = None,
+    status_port: int | None = None,
     coordinator_host: str = "127.0.0.1",
     sync_port_base: int | None = None,
     spawn=None,
@@ -651,6 +686,10 @@ def supervise_elastic(
     ).start()
     env[ENV_ELASTIC_COORDINATOR] = coord.address
     env.update(elastic.commit_env())
+    status_server = (
+        start_status_server(status_port, log_path, coord=coord)
+        if status_port is not None else None
+    )
     if spawn is None:
         spawn = lambda member_id, slot, env: _spawn_member_local(  # noqa: E731
             argv, env, member_id, slot, tag_output=tag_output
@@ -670,6 +709,24 @@ def supervise_elastic(
         return member_id
 
     marker = newest_checkpoint_marker(model_dir)
+    # STEP-granular progress: members report their committed
+    # progress_marker(epoch, step) over beats/syncs, so an elastic fleet
+    # advancing optimizer steps between failures counts as progressing
+    # even when no new checkpoint FILE landed (sub-epoch commits live on
+    # the coordinator, not on disk). The budget then only burns on truly
+    # stuck loops — same fault, same committed step, every time.
+    # -1 is the exact "nothing committed" baseline: members report -1
+    # until their first commit, and every commit path records >= 1 step
+    # or epoch of real training, so the -1 -> first-marker transition is
+    # genuine progress, never a free budget reset.
+    best_progress = -1
+
+    def committed_progress() -> int:
+        return max(
+            (m["progress"] for m in coord.snapshot()["members"].values()),
+            default=-1,
+        )
+
     restarts_used = 0
     total_restarts = 0
     backoff = policy.backoff
@@ -698,6 +755,8 @@ def supervise_elastic(
                 p.kill()
                 p.wait()
         coord.stop()
+        if status_server is not None:
+            status_server.shutdown()
         return code
 
     try:
@@ -736,10 +795,16 @@ def supervise_elastic(
                     last_failure = code if code else 1
                 if not job_done:
                     new_marker = newest_checkpoint_marker(model_dir)
+                    cur_progress = committed_progress()
                     progressed = (
-                        model_dir is not None and new_marker != marker
+                        (model_dir is not None and new_marker != marker)
+                        # Step advance IS progress: a fresher committed
+                        # (epoch, step) marker on the coordinator since
+                        # the last failure, checkpoint file or not.
+                        or cur_progress > best_progress
                     )
                     marker = new_marker
+                    best_progress = max(best_progress, cur_progress)
                     if progressed:
                         restarts_used = 0
                         backoff = policy.backoff
@@ -765,6 +830,7 @@ def supervise_elastic(
                         member=member_id, kind=kind, exit_code=code,
                         progressed=progressed, backoff_s=backoff,
                         generation=coord.generation,
+                        progress_marker=cur_progress,
                     )
                     if verbose:
                         print(
@@ -883,6 +949,95 @@ def supervise_elastic(
         raise
 
 
+def journal_records(journal_path: str | None) -> list:
+    """Every parseable record of a supervisor journal, rotated ``.1``
+    predecessor first so counts survive a `RestartLog` rotation — the
+    shared reader behind `fleet_status` and the status endpoint's
+    ``/journal`` route. Torn tail lines are skipped; missing files read
+    as an empty journal."""
+    records: list = []
+    if not journal_path:
+        return records
+    for part in (journal_path + ".1", journal_path):
+        if not os.path.exists(part):
+            continue
+        with open(part) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail mid-append
+    return records
+
+
+def start_status_server(port: int, log_path: str | None, coord=None,
+                        host: str | None = None):
+    """Serve the supervisor's own status over HTTP (the ``--status-port``
+    surface): fleet state WITHOUT a serving bundle — previously the
+    journal was only visible through ``serve --fleet-journal``'s
+    ``/healthz``, i.e. only once a model server was up.
+
+    Binds loopback by default: the routes are unauthenticated and expose
+    member ids/hosts/progress and the full journal, so reaching them from
+    off-host (a fleet dashboard, a kubelet probing the pod IP) is an
+    explicit opt-in — pass ``host=`` or set ``HVT_STATUS_HOST=0.0.0.0``.
+
+    Routes (all JSON):
+
+    * ``GET /status``  → ``{"fleet": fleet_status(...), "coordinator":
+      <rendezvous snapshot or null>}`` — generation/size/restart/rescale
+      counts plus, on elastic launches, the live membership table.
+    * ``GET /journal`` → ``{"records": [...]}`` — the full restart/elastic
+      journal (rotation-spanning), each line as a JSON object.
+    * ``GET /healthz`` → ``{"status": "ok", "fleet": ...}`` — probe form.
+
+    Returns the started server (a daemon thread runs it); callers own
+    ``shutdown()``. Port 0 binds an ephemeral port —
+    ``server.server_address[1]`` carries the real one."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if host is None:
+        host = os.environ.get("HVT_STATUS_HOST") or "127.0.0.1"
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # health probes are noise
+            pass
+
+        def do_GET(self):
+            try:
+                if self.path == "/status":
+                    self._send(200, {
+                        "fleet": fleet_status(log_path),
+                        "coordinator": coord.snapshot()
+                        if coord is not None else None,
+                    })
+                elif self.path == "/journal":
+                    self._send(200, {"records": journal_records(log_path)})
+                elif self.path == "/healthz":
+                    self._send(200, {"status": "ok",
+                                     "fleet": fleet_status(log_path)})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+            except Exception as e:  # observability must never crash
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
 def fleet_status(journal_path: str | None, events: int = 8) -> dict:
     """Summarize a supervisor journal for serving/health surfaces: current
     generation/size (from the last settle record), restart/shrink/grow
@@ -900,19 +1055,7 @@ def fleet_status(journal_path: str | None, events: int = 8) -> dict:
     ):
         status["error"] = "journal not found"
         return status
-    records = []
-    for part in (journal_path + ".1", journal_path):
-        if not os.path.exists(part):
-            continue
-        with open(part) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    records.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue  # torn tail mid-append
+    records = journal_records(journal_path)
     for rec in records:
         name = rec.get("name")
         if name in ("start", "shrink", "grow", "steady"):
@@ -944,6 +1087,7 @@ def supervise_hosts(
     model_dir: str | None = None,
     heartbeat_dir: str | None = None,
     log_path: str | None = None,
+    status_port: int | None = None,
     sleep=time.sleep,
 ) -> int:
     """`launcher.start_hosts` under supervision (the ``hvt-launch pod
@@ -1001,6 +1145,7 @@ def supervise_hosts(
         model_dir=model_dir,
         heartbeat_dir=heartbeat_dir,
         log_path=log_path,
+        status_port=status_port,
         sleep=sleep,
     )
 
@@ -1016,6 +1161,7 @@ def supervise_elastic_hosts(
     workdir: str | None = None,
     model_dir: str | None = None,
     log_path: str | None = None,
+    status_port: int | None = None,
     ssh_args: tuple[str, ...] = ("-o", "StrictHostKeyChecking=no"),
     sleep=time.sleep,
     verbose: bool = True,
@@ -1066,7 +1212,7 @@ def supervise_elastic_hosts(
 
     return supervise_elastic(
         len(hosts), argv, env=env, policy=policy, elastic=elastic,
-        model_dir=model_dir, log_path=log_path,
+        model_dir=model_dir, log_path=log_path, status_port=status_port,
         coordinator_host=socket_lib.gethostname(),
         sync_port_base=sync_port_base, spawn=spawn, sleep=sleep,
         verbose=verbose,

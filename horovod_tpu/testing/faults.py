@@ -2,9 +2,10 @@
 reference stack lacks entirely (SURVEY.md §5.3: "No fault injection
 anywhere").
 
-Contract: ``HVT_FAULT=rank:epoch:kind`` makes exactly one rank misbehave at
-a chosen point in training, via a callback `fit()` auto-installs (so any
-example/entry script is injectable unmodified). Kinds:
+Contract: ``HVT_FAULT=rank:epoch[.step]:kind`` makes exactly one rank
+misbehave at a chosen point in training, via a callback `fit()`
+auto-installs (so any example/entry script is injectable unmodified).
+Kinds:
 
 * ``kill``  — SIGKILL self: the hard crash / OOM-killer / node-loss shape.
   Peers block in the next collective; the launcher's fail-stop grace window
@@ -41,6 +42,19 @@ example/entry script is injectable unmodified). Kinds:
 The fault fires at the first ``on_batch_end`` of the target epoch — mid-epoch
 by construction (after the epoch's checkpoint boundary, before the next), so
 kill-and-resume tests lose partial-epoch work exactly like a real fault.
+
+**Step filter**: ``rank:epoch.step:kind`` (e.g. ``2:1.3:leave``) defers the
+fault to the chosen OPTIMIZER step's ``on_batch_end`` instead of the
+epoch's first batch — chaos tests can then target a precise mid-epoch
+point (the step-granular recovery paths: sub-epoch commits, mid-epoch
+rescale, ``initial_step`` resume). The trigger is "``step`` steps done or
+more" (``>=``), so ``steps_per_execution`` chunks that stride past the
+target still fire at the next boundary — but a run RESUMED at or past the
+target step (``fit(initial_step=)`` from the trainer's recorded resume
+point) does not re-fire: the fault already fired in the run being
+resumed. Without ``.step`` the behavior is unchanged: first batch end of
+the epoch (epoch-filtered faults still need ``HVT_FAULT_STAMP`` to stay
+one-shot across relaunches that resume INTO the target epoch).
 
 One-shot faults: set ``HVT_FAULT_STAMP=<path>`` and the callback touches the
 stamp file just before firing and never fires while it exists — across
@@ -89,11 +103,14 @@ def reset_leave() -> None:
 
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
-    """One planned fault: ``rank`` fires ``kind`` mid-epoch ``epoch``."""
+    """One planned fault: ``rank`` fires ``kind`` mid-epoch ``epoch`` —
+    at its first batch end, or at optimizer step ``step`` (1-based count
+    of completed steps) when the ``epoch.step`` form was used."""
 
     rank: int
     epoch: int
     kind: str
+    step: int | None = None
 
     @property
     def exit_code(self) -> int | None:
@@ -103,13 +120,28 @@ class FaultPlan:
 
 
 def parse_plan(spec: str) -> FaultPlan:
-    """Parse ``rank:epoch:kind`` (kind: ``kill`` | ``hang`` | ``exitN``)."""
+    """Parse ``rank:epoch[.step]:kind`` (kind: ``kill`` | ``hang`` |
+    ``exitN`` | ``leave`` | ``corrupt[@target]``)."""
     parts = spec.split(":")
     if len(parts) != 3:
         raise ValueError(
-            f"HVT_FAULT must be rank:epoch:kind, got {spec!r}"
+            f"HVT_FAULT must be rank:epoch[.step]:kind, got {spec!r}"
         )
     rank_s, epoch_s, kind = parts
+    step = None
+    if "." in epoch_s:
+        epoch_s, step_s = epoch_s.split(".", 1)
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"HVT_FAULT step must be an integer, got {spec!r}"
+            ) from None
+        if step < 1:
+            raise ValueError(
+                f"HVT_FAULT step is a 1-based completed-step count, "
+                f"got {spec!r}"
+            )
     try:
         rank, epoch = int(rank_s), int(epoch_s)
     except ValueError:
@@ -132,7 +164,7 @@ def parse_plan(spec: str) -> FaultPlan:
                 f"HVT_FAULT kind must be kill, hang, leave, corrupt[@"
                 f"epochN][/shardM] or exitN, got {kind!r}"
             )
-    return FaultPlan(rank=rank, epoch=epoch, kind=kind)
+    return FaultPlan(rank=rank, epoch=epoch, kind=kind, step=step)
 
 
 def corrupt_target(kind: str) -> tuple:
@@ -247,6 +279,25 @@ class FaultInjectionCallback(Callback):
             return
         if runtime.rank() != self.plan.rank:
             return
+        if self.plan.step is not None:
+            if batch + 1 < self.plan.step:
+                # Step-filtered plan: hold fire until the chosen optimizer
+                # step completes (>= so steps_per_execution strides that
+                # jump past the target still fire at the next boundary).
+                return
+            if (
+                self.trainer is not None
+                and getattr(self.trainer, "_resume_epoch", 0)
+                == self.plan.epoch
+                and getattr(self.trainer, "_resume_step", 0)
+                >= self.plan.step
+            ):
+                # The fit RESUMED at or past the target step: the fault
+                # already fired in the run being resumed (that is why a
+                # resume point past it exists), so do not re-fire — the
+                # stamp-free form of the one-shot contract for resumed
+                # step-granular runs.
+                return
         if self.stamp and os.path.exists(self.stamp):
             return  # already fired in a previous launch — one-shot spent
         if self.stamp:
@@ -257,9 +308,12 @@ class FaultInjectionCallback(Callback):
         self._fire()
 
     def _fire(self):  # pragma: no cover — ends or wedges the process
+        at = f"epoch {self.plan.epoch}" + (
+            f" step {self.plan.step}" if self.plan.step is not None else ""
+        )
         print(
             f"FaultInjection: rank {self.plan.rank} firing "
-            f"{self.plan.kind!r} at epoch {self.plan.epoch}",
+            f"{self.plan.kind!r} at {at}",
             flush=True,
         )
         if self.plan.kind == "kill":

@@ -185,19 +185,41 @@ class LearningRateScheduleCallback(Callback):
 
 
 def save_state(filepath_template: str, epoch: int, state, *,
-               async_save: bool = False, pending=None):
+               async_save: bool = False, pending=None, step: int = 0):
     """One TrainState save with the checkpoint ROUTING shared by
     `ModelCheckpoint` and `PreemptionCheckpointCallback`: single-file
     (primary-writer-only) for host-syncable state, the sharded directory
     format when state is cross-process sharded (every process writes its
     shard). Returns the async handle when ``async_save`` (after joining
-    ``pending``), else None."""
+    ``pending``), else None.
+
+    ``step`` selects the boundary the save represents. ``step == 0``
+    (default): the END of 0-based epoch ``epoch`` — file
+    ``checkpoint-{epoch+1}``, manifest ``(epoch+1, 0)``, the historical
+    behavior. ``step > 0``: a MID-epoch save DURING epoch ``epoch`` after
+    ``step`` optimizer steps — the file is ``checkpoint-{epoch}`` (it
+    monotonically advances the previous boundary's artifact; atomic
+    replace, strictly newer progress) and the manifest records
+    ``(epoch, step)``, so a relaunch resumes at the committed step
+    instead of replaying the epoch. Mid-epoch saves require host-syncable
+    (single-file) state: the sharded directory format cannot overwrite
+    in place without a torn-mix window across processes."""
     from horovod_tpu import checkpoint
 
     sharded = checkpoint.is_cross_process_sharded(state)
+    if sharded and step:
+        raise ValueError(
+            "mid-epoch checkpoints (save_every_steps) support single-file "
+            "(host-syncable) state only: overwriting a sharded checkpoint "
+            "dir in place could mix shard files from two saves. Use the "
+            "elastic commit cadence (commit_every_steps) for step-granular "
+            "recovery of cross-process-sharded state."
+        )
     if not sharded and not runtime.is_primary():
         return None
-    path = filepath_template.format(epoch=epoch + 1)
+    completed = epoch + 1 if step == 0 else epoch
+    path = filepath_template.format(epoch=completed)
+    progress = (completed, step)
     if sharded:
         # Consistent across processes: shardings are SPMD-global state.
         root, _ = os.path.splitext(path)
@@ -210,8 +232,8 @@ def save_state(filepath_template: str, epoch: int, state, *,
     if async_save:
         if pending is not None:
             pending.join()
-        return do_async(path, state)
-    do_save(path, state)
+        return do_async(path, state, progress=progress)
+    do_save(path, state, progress=progress)
     return None
 
 
@@ -234,12 +256,60 @@ class ModelCheckpoint(Callback):
     the sharded directory format: EVERY process writes its own shard file
     (`checkpoint.save_sharded`), so the primary-only gate applies only to
     single-file checkpoints — the single-writer discipline then holds
-    per-file (each process owns exactly one path, §5.2)."""
+    per-file (each process owns exactly one path, §5.2).
 
-    def __init__(self, filepath: str, async_save: bool = False):
+    ``save_every_steps=N`` ADDITIONALLY saves every N optimizer steps
+    within an epoch (0 = epoch cadence only, the default; env default
+    ``HVT_SAVE_EVERY_STEPS`` — the job-spec surface). A mid-epoch save
+    advances the CURRENT epoch's artifact in place (atomic replace) with
+    an ``(epoch, step)`` progress manifest, so a supervised restart
+    resumes at the committed optimizer step
+    (`checkpoint.restore_latest_and_broadcast(with_step=True)` →
+    ``fit(initial_epoch=, initial_step=)``) instead of replaying the
+    epoch — the checkpoint-file twin of the elastic
+    ``commit_every_steps`` cadence, and accumulation-aligned for the
+    same reason (``on_batch_end`` fires once per optimizer step).
+    Single-file (host-syncable) state only — `save_state` refuses the
+    sharded format mid-epoch. Cadence counts from the fit's resume step,
+    so a resumed epoch doesn't instantly re-save."""
+
+    def __init__(self, filepath: str, async_save: bool = False,
+                 save_every_steps: int | None = None):
         self.filepath = filepath
         self.async_save = async_save
+        if save_every_steps is None:
+            save_every_steps = int(
+                os.environ.get("HVT_SAVE_EVERY_STEPS", 0) or 0
+            )
+        self.save_every_steps = max(0, int(save_every_steps))
         self._pending = None
+        self._epoch = 0
+        self._last_save_step = 0
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        self._epoch = epoch
+        self._last_save_step = 0
+        if self.trainer is not None and epoch == getattr(
+            self.trainer, "_resume_epoch", 0
+        ):
+            self._last_save_step = int(
+                getattr(self.trainer, "_resume_step", 0)
+            )
+
+    def on_batch_end(self, batch: int, logs=None):
+        if not self.save_every_steps:
+            return
+        done = batch + 1
+        # >= (not ==): steps_per_execution chunks stride the index, so a
+        # chunk passing the cadence saves at its end — same contract as
+        # the elastic commit cadence.
+        if done - self._last_save_step < self.save_every_steps:
+            return
+        self._last_save_step = done
+        self._pending = save_state(
+            self.filepath, self._epoch, self.trainer.state,
+            async_save=self.async_save, pending=self._pending, step=done,
+        )
 
     def on_epoch_end(self, epoch: int, logs=None):
         self._pending = save_state(

@@ -197,6 +197,23 @@ def finish_epoch(trainer, epoch, epochs, metric_acc, steps, t0, callbacks,
         shown = {k: round(v, 4) for k, v in logs.items()}
         print(f"Epoch {epoch + 1}/{epochs} - {shown}")
 
+def _normalize_resume(initial_epoch: int, initial_step: int,
+                      steps_per_epoch: int) -> tuple[int, int]:
+    """Canonicalize a resume point against this run's epoch geometry: a
+    step at or past the epoch's end rolls into the next epoch (a commit
+    taken at the last step boundary of an epoch IS the next epoch's
+    start), so callers may hand back exactly what the elastic commit or
+    checkpoint manifest recorded without special-casing the boundary."""
+    initial_epoch = int(initial_epoch)
+    initial_step = int(initial_step)
+    if initial_step < 0:
+        raise ValueError(f"initial_step must be >= 0, got {initial_step}")
+    if initial_step and steps_per_epoch:
+        initial_epoch += initial_step // steps_per_epoch
+        initial_step %= steps_per_epoch
+    return initial_epoch, initial_step
+
+
 def run_fit(trainer,
     dataset=None,
     *,
@@ -205,6 +222,7 @@ def run_fit(trainer,
     batch_size: int = 128,
     epochs: int = 1,
     initial_epoch: int = 0,
+    initial_step: int = 0,
     steps_per_epoch: int | None = None,
     callbacks: Sequence = (),
     validation_data=None,
@@ -221,6 +239,34 @@ def run_fit(trainer,
     ``initial_epoch`` is the Keras resume idiom: epoch numbering (and
     LR-warmup position, checkpoint names) continues from a restored run —
     pair it with `checkpoint.restore_latest_and_broadcast`.
+
+    ``initial_step`` resumes MID-epoch, at optimizer step S of
+    ``initial_epoch`` — the step-granular recovery contract
+    (`horovod_tpu.elastic`, step-carrying checkpoint manifests). The data
+    iterator is deterministically fast-forwarded by exactly ``S × K``
+    microbatches (K = ``backward_passes_per_step``) without materializing
+    the skipped batches, so the resumed run consumes byte-identically the
+    batches an uninterrupted run of the same fit call would have consumed
+    from step S on — on every feeding path (streamed, device-cached,
+    ``steps_per_execution`` chunks), and stably across an
+    `ArrayDataset.reshard` (the cut is defined in optimizer steps, not
+    bytes). A step at or past ``steps_per_epoch`` rolls into the next
+    epoch. User-supplied ``dataset=`` iterables without an
+    `ArrayDataset.batches`-style skip hook are fast-forwarded by drawing
+    and discarding (correct, but materializes the skipped batches).
+
+    Anchoring, precisely: byte-identity is against an uninterrupted run
+    of the SAME call shape. The streamed ``x=``/``y=`` path builds a
+    fresh shuffle stream each fit (every elastic generation rebuilds its
+    pipeline), so epochs that PREDATE the resume call's ``initial_epoch``
+    are not replayed position-exact — within the resume epoch the skip is
+    exact, across older epochs the stream re-anchors (a valid full
+    shuffle pass either way; the recorded ROADMAP follow-up).
+    ``cache='device'`` is epoch-exact unconditionally (the permutation is
+    a pure function of (seed, epoch)); ``dataset=`` streams own their
+    epoch anchoring — hand the stream positioned at the resume epoch's
+    first batch and fit skips the ``S × K`` within it (the
+    `examples/elastic_mnist.py` / midstep-e2e idiom).
 
     ``cache='device'`` (with ``x``/``y``) stages the whole dataset into
     HBM once, sharded over the data axes, and runs shuffling + batching +
@@ -253,9 +299,9 @@ def run_fit(trainer,
                 "cache='device' supports data-sharded batches only; "
                 "use the streamed fit path with batch_specs meshes"
             )
-        return fit_device_cached(trainer, 
+        return fit_device_cached(trainer,
             x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
-            callbacks, validation_data, verbose,
+            callbacks, validation_data, verbose, initial_step,
         )
     if cache is not None:
         raise ValueError(f"unknown cache mode {cache!r}")
@@ -277,17 +323,43 @@ def run_fit(trainer,
             steps_per_epoch = max(
                 1, n_local // (local_batch * trainer._accum_steps)
             )
+        initial_epoch, initial_step = _normalize_resume(
+            initial_epoch, initial_step, steps_per_epoch
+        )
         # Batch assembly runs in the native C++ producer thread when
         # available (overlapping shuffle/gather with the device step),
-        # pure Python otherwise — same semantics either way.
+        # pure Python otherwise — same semantics either way. A mid-epoch
+        # resume fast-forwards the engine's OWN stream by K·S microbatches
+        # (accumulation-aligned), so the resumed sequence is byte-identical
+        # to the uninterrupted one whichever engine is active.
         dataset, close_input = training_pipeline(
             ds.arrays, local_batch, seed=trainer.seed,
             shuffle_buffer=shuffle_buffer, structure=ds.structure,
+            skip_batches=initial_step * trainer._accum_steps,
         )
+        it = iter(dataset)
     elif steps_per_epoch is None:
         raise ValueError("steps_per_epoch is required with a dataset")
+    else:
+        initial_epoch, initial_step = _normalize_resume(
+            initial_epoch, initial_step, steps_per_epoch
+        )
+        skip = initial_step * trainer._accum_steps
+        if skip and hasattr(dataset, "batches"):
+            # ArrayDataset-style source: index-level skip, nothing
+            # materialized (and reshard-stable — the stream is a pure
+            # function of seed + shard geometry).
+            it = dataset.batches(skip=skip)
+        else:
+            it = iter(dataset)
+            # Generic iterables expose no skip hook: draw and discard
+            # (documented materializing fallback — still deterministic).
+            for _ in range(skip):
+                next(it)
 
-    it = iter(dataset)
+    # Where this fit resumes, for resume-aware callbacks (the elastic
+    # callback aligns its commit/rescale cadences to the resume step).
+    trainer._resume_epoch, trainer._resume_step = initial_epoch, initial_step
     first = next(it)
     trainer.build(first[0], first[1])
 
@@ -315,10 +387,10 @@ def run_fit(trainer,
         from horovod_tpu import trace as trace_lib
 
         with trace_lib.maybe_trace(trace_lib.profile_dir()):
-            fit_epochs(trainer, 
+            fit_epochs(trainer,
                 it, pending, zero_acc, epochs, initial_epoch,
                 steps_per_epoch, callbacks, validation_data, batch_size,
-                verbose,
+                verbose, initial_step,
             )
     except BaseException:
         close_input()
@@ -329,17 +401,28 @@ def run_fit(trainer,
     return trainer.history
 
 def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_epoch,
-    callbacks, validation_data, batch_size, verbose,
+    callbacks, validation_data, batch_size, verbose, initial_step=0,
 ):
     from horovod_tpu.data.prefetch import DevicePrefetcher
 
     # Per-epoch execution plan: full steps_per_execution chunks plus one
     # remainder chunk (a second, smaller executable) when K doesn't
-    # divide the epoch.
+    # divide the epoch. The RESUME epoch (initial_step > 0) covers only
+    # its remaining steps — the iterator was already fast-forwarded past
+    # the first initial_step·accum microbatches — so its plan (and hence
+    # the host-chunk assembly below) is shorter than the steady-state
+    # epochs'.
     spe = min(trainer.steps_per_execution, steps_per_epoch)
-    plan = [spe] * (steps_per_epoch // spe)
-    if steps_per_epoch % spe:
-        plan.append(steps_per_epoch % spe)
+
+    def plan_for(epoch):
+        steps = steps_per_epoch - (
+            initial_step if epoch == initial_epoch else 0
+        )
+        plan = [spe] * (steps // spe)
+        if steps % spe:
+            plan.append(steps % spe)
+        return plan
+
     buffered = [pending]
     # Microbatches per optimizer step (backward_passes_per_step): each
     # execution unit carries accum microbatches per step, stacked on a
@@ -350,8 +433,8 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
         # Host-side assembly of the execution units: single batches when
         # spe*accum == 1, [accum, ...] microbatch stacks per step, and
         # [spe(, accum), ...] stacks of steps.
-        for _ in range(initial_epoch, epochs):
-            for k in plan:
+        for epoch in range(initial_epoch, epochs):
+            for k in plan_for(epoch):
                 batches = [
                     buffered.pop() if buffered else next(it)
                     for _ in range(k * accum)
@@ -395,8 +478,13 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
             t0 = time.perf_counter()
             scale = jnp.asarray(trainer.update_scale, jnp.float32)
             metric_acc = zero_acc
-            step = 0
-            for k in plan:
+            # Batch indices are TRUE within-epoch optimizer steps: a
+            # resumed epoch's first on_batch_end fires with the step it
+            # actually trained, so step-keyed cadences (elastic commits,
+            # step-targeted faults) stay aligned across a resume.
+            start = initial_step if epoch == initial_epoch else 0
+            step = start
+            for k in plan_for(epoch):
                 chunk = next(prefetcher)
                 trainer.state, metrics, metric_acc = run(
                     trainer.state, chunk, scale, metric_acc
@@ -406,15 +494,15 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
                 # Keras's steps_per_execution callback semantics.
                 for cb in callbacks:
                     cb.on_batch_end(step - 1, metrics)
-            finish_epoch(trainer, 
-                epoch, epochs, metric_acc, steps_per_epoch, t0, callbacks,
-                validation_data, batch_size, verbose,
+            finish_epoch(trainer,
+                epoch, epochs, metric_acc, steps_per_epoch - start, t0,
+                callbacks, validation_data, batch_size, verbose,
             )
     finally:
         prefetcher.close()
 
 def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_per_epoch,
-    callbacks, validation_data, verbose,
+    callbacks, validation_data, verbose, initial_step=0,
 ):
     from horovod_tpu import trace as trace_lib
 
@@ -428,6 +516,15 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
             f"({trainer._accum_steps})"
         )
     steps = min(steps_per_epoch or max_steps, max_steps)
+    # Mid-epoch resume: the epoch's shuffle is a pure function of
+    # (seed, epoch) — fold_in below — so the resume epoch regenerates the
+    # SAME permutation and the compiled epoch program simply starts its
+    # gather/scan at step `initial_step`: batches byte-identical to the
+    # uninterrupted epoch's steps S.., no skipped batch ever gathered.
+    initial_epoch, initial_step = _normalize_resume(
+        initial_epoch, initial_step, steps
+    )
+    trainer._resume_epoch, trainer._resume_step = initial_epoch, initial_step
     trainer.build(
         np.asarray(x[: trainer.dp_size]), np.asarray(y[: trainer.dp_size])
     )
@@ -452,14 +549,15 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
                     cb.on_epoch_begin(epoch)
                 t0 = time.perf_counter()
                 scale = jnp.asarray(trainer.update_scale, jnp.float32)
+                start = initial_step if epoch == initial_epoch else 0
                 trainer.state, metrics, metric_acc = trainer._train_epoch(
                     trainer.state, data, jax.random.fold_in(epoch_key, epoch),
-                    scale, zero_acc, steps, batch_size,
+                    scale, zero_acc, steps, batch_size, start,
                 )
                 for cb in callbacks:
                     cb.on_batch_end(steps - 1, metrics)
-                finish_epoch(trainer, 
-                    epoch, epochs, metric_acc, steps, t0, callbacks,
+                finish_epoch(trainer,
+                    epoch, epochs, metric_acc, steps - start, t0, callbacks,
                     validation_data, batch_size, verbose,
                     # Device-cached training implies device-cached
                     # validation.

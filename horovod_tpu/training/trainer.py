@@ -106,6 +106,12 @@ class Trainer:
         self.update_scale: float = 1.0
         self.stop_training = False
         self.history: list[dict] = []
+        # Where the CURRENT fit resumed — (initial_epoch, initial_step)
+        # after normalization (feeding._normalize_resume). Resume-aware
+        # callbacks (the elastic commit/rescale cadences) read these to
+        # measure step cadences from the true resume point.
+        self._resume_epoch = 0
+        self._resume_step = 0
         # Keras's steps_per_execution: K > 1 compiles a lax.scan over K train
         # steps into ONE executable, so dispatch + input-transfer overhead is
         # paid once per K steps instead of per step. Semantics trade-off
@@ -444,7 +450,7 @@ class Trainer:
 
         def train_epoch(
             state: TrainState, data, epoch_seed, update_scale, metric_acc,
-            steps: int, per_chip_batch: int,
+            steps: int, per_chip_batch: int, start: int = 0,
         ):
             """One epoch over a DEVICE-RESIDENT dataset, fully on-device.
 
@@ -457,7 +463,14 @@ class Trainer:
             Per-shard independent shuffles are the reference's own sampling
             semantics (every rank shuffles independently,
             tensorflow2_keras_mnist.py:37-41), with the improvement that
-            shards partition the data so an epoch sees each example once."""
+            shards partition the data so an epoch sees each example once.
+
+            ``start`` resumes MID-epoch at optimizer step ``start`` (the
+            `fit(initial_step=)` contract): the permutation is a pure
+            function of ``epoch_seed``, so the resume epoch regenerates
+            the uninterrupted epoch's exact order and the gather/scan
+            below simply begin at step ``start`` — the skipped steps'
+            rows are never gathered."""
             first = jax.tree.leaves(data)[0]
             n_shards, per_n = first.shape[0], first.shape[1]
             K = self._accum_steps  # microbatches consumed per optimizer step
@@ -477,13 +490,15 @@ class Trainer:
             # live alongside `data` for the epoch — the device-cached path
             # trades HBM for zero per-step host/latency cost by design; use
             # the streamed fit path when the dataset crowds HBM.
+            lo = start * per_chip_batch * K
             need = steps * per_chip_batch * K
+            width = need - lo
             shuffled = jax.tree.map(
                 lambda a: jax.vmap(
                     lambda rows, ii: jnp.take(rows, ii, axis=0)
                 )(
-                    a.reshape(a.shape[0], a.shape[1], -1), order[:, :need]
-                ).reshape((a.shape[0], need) + a.shape[2:]),
+                    a.reshape(a.shape[0], a.shape[1], -1), order[:, lo:need]
+                ).reshape((a.shape[0], width) + a.shape[2:]),
                 data,
             )
 
@@ -516,7 +531,7 @@ class Trainer:
                 return (state, acc), metrics
 
             (state, metric_acc), metrics = jax.lax.scan(
-                body, (state, metric_acc), jnp.arange(steps)
+                body, (state, metric_acc), jnp.arange(steps - start)
             )
             last = jax.tree.map(lambda m: m[-1], metrics)
             return state, last, metric_acc
@@ -597,7 +612,7 @@ class Trainer:
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
         self._train_chunk = jax.jit(train_chunk, donate_argnums=(0,))
         self._train_epoch = jax.jit(
-            train_epoch, static_argnums=(5, 6), donate_argnums=(0,)
+            train_epoch, static_argnums=(5, 6, 7), donate_argnums=(0,)
         )
         self._eval_step = jax.jit(eval_step)
         self._eval_epoch = jax.jit(eval_epoch, static_argnums=(2, 3))

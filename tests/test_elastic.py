@@ -1028,9 +1028,9 @@ class TestWiring:
         calls = {}
 
         def fake(nprocs, command, env=None, policy=None, elastic=None,
-                 log_path=None):
+                 log_path=None, status_port=None):
             calls.update(nprocs=nprocs, command=command, policy=policy,
-                         elastic=elastic)
+                         elastic=elastic, status_port=status_port)
             return 0
 
         monkeypatch.setattr(supervisor, "supervise_elastic", fake)
@@ -1180,7 +1180,7 @@ class TestWiring:
         from horovod_tpu.launch import job as job_lib
 
         def fake_supervise(nprocs, argv, env=None, policy=None,
-                           elastic=None, log_path=None):
+                           elastic=None, log_path=None, status_port=None):
             log = supervisor.RestartLog(log_path)
             log.touch()
             if env.get("DO_SHRINK") == "1":
@@ -1282,6 +1282,18 @@ class TestCommitCadence:
             progress_marker(4, 0)
             < progress_marker(4, 7)
             < progress_marker(5, 0)
+        )
+
+    def test_marker_step_clamped_into_radix(self):
+        """A beyond-radix step count degrades to an in-epoch tie — it can
+        never make a mid-epoch commit outrank the NEXT epoch's start
+        (which represents strictly more training)."""
+        from horovod_tpu.elastic.coordinator import PROGRESS_STEP_RADIX
+
+        huge = PROGRESS_STEP_RADIX + 12345
+        assert progress_marker(0, huge) < progress_marker(1, 0)
+        assert progress_marker(0, huge) == progress_marker(
+            0, PROGRESS_STEP_RADIX - 1
         )
 
     def test_chunked_executions_commit_at_next_boundary(self):
@@ -1420,3 +1432,267 @@ class TestGrowOnlyFastPath:
         )
         assert raised == "HostsUpdatedInterrupt"
         assert gathered == [False]        # boundary reassembly ran
+
+
+class TestStepGranularElastic:
+    """The sub-epoch rescale cadence (`rescale_every_steps`) + the
+    (epoch, step) resume contract: steady-state rounds are one cheap
+    boolean agreement; a pending membership change or leave intent
+    executes the full boundary — commit at the CURRENT optimizer step,
+    lockstep teardown, interrupt — and restore() hands the step back."""
+
+    class _Client:
+        def __init__(self, gen=3, pending=False):
+            self.synced_generation = 3
+            self._gen = gen
+            self.last_beat_pending = pending
+            self.left = []
+
+        def beat(self, progress=None):
+            return self._gen
+
+        def leave(self, reason=""):
+            self.left.append(reason)
+
+    class _Trainer:
+        state = {"w": 1}
+        _resume_epoch = 0
+        _resume_step = 0
+
+    def _callback(self, client=None, **kw):
+        from horovod_tpu.elastic.state import ElasticStateCallback
+
+        cb = ElasticStateCallback(
+            ElasticState(), client or self._Client(), **kw
+        )
+        cb.trainer = self._Trainer()
+        return cb
+
+    def test_restore_hands_back_epoch_and_step(self):
+        s = ElasticState(state={"w": 2}, epoch=0, step=0)
+        s.epoch, s.step = 4, 7
+        s.commit()
+        s.epoch, s.step = 5, 0  # live values drift past the commit
+        assert s.restore() == (4, 7)
+        assert (s.epoch, s.step) == (4, 7)
+
+    def test_restore_before_commit_returns_current(self):
+        s = ElasticState(epoch=2, step=5)
+        assert s.restore() == (2, 5)
+
+    def test_steady_state_no_interrupt_single_cheap_round(self, monkeypatch):
+        """Same generation, no pending flag, nobody leaving: the cadence
+        round must end at the boolean agreement — no votes, no commit,
+        no interrupt."""
+        import jax
+
+        from horovod_tpu.elastic import state as state_mod
+
+        cb = self._callback(rescale_every_steps=2)
+        calls = []
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda v: calls.append(v) or [v, v],
+        )
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)  # below cadence: nothing at all
+        assert calls == []
+        cb.on_batch_end(1)  # cadence boundary: ONE boolean agreement
+        assert calls == [False]
+        assert cb.state.commits == 0
+
+    def test_pending_generation_executes_step_boundary(self, monkeypatch):
+        """A generation drift (joiner waiting) rescales at the STEP
+        boundary: commit at (epoch, done), teardown, interrupt — and the
+        committed snapshot resumes at that exact step."""
+        import jax
+
+        from horovod_tpu import runtime
+        from horovod_tpu.elastic import state as state_mod
+        from horovod_tpu.elastic.state import HostsUpdatedInterrupt
+
+        cb = self._callback(client=self._Client(gen=4),
+                            rescale_every_steps=2)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+        def fake_allgather(v):
+            if isinstance(v, bool):
+                return [v, v]          # the cheap agreement
+            return [v, v]              # the (gen, leaving) votes
+
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object", fake_allgather
+        )
+        shutdowns = []
+        monkeypatch.setattr(runtime, "shutdown",
+                            lambda: shutdowns.append(1))
+        cb.on_epoch_begin(5)
+        cb.on_batch_end(0)
+        with pytest.raises(HostsUpdatedInterrupt):
+            cb.on_batch_end(1)
+        assert shutdowns == [1]
+        assert cb.state.commits == 1
+        assert cb.state.progress == progress_marker(5, 2)
+        assert cb.state.restore() == (5, 2)
+
+    def test_leave_intent_executes_step_boundary(self, monkeypatch):
+        import jax
+
+        from horovod_tpu import runtime
+        from horovod_tpu.elastic import state as state_mod
+        from horovod_tpu.elastic.state import LeaveInterrupt
+
+        client = self._Client(gen=3)
+        cb = self._callback(client=client, rescale_every_steps=1)
+        cb._leave_requested = True
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object", lambda v: [v, v]
+        )
+        monkeypatch.setattr(runtime, "shutdown", lambda: None)
+        cb.on_epoch_begin(2)
+        with pytest.raises(LeaveInterrupt):
+            cb.on_batch_end(2)
+        assert client.left == ["sigterm"]
+        assert cb.state.progress == progress_marker(2, 3)
+
+    def test_beat_pending_flag_triggers_vote(self, monkeypatch):
+        """The coordinator's piggybacked pending flag alone (same
+        generation number visible to THIS member) escalates to the vote
+        — and a vote revealing a real drift interrupts."""
+        import jax
+
+        from horovod_tpu import runtime
+        from horovod_tpu.elastic import state as state_mod
+        from horovod_tpu.elastic.state import HostsUpdatedInterrupt
+
+        cb = self._callback(client=self._Client(gen=3, pending=True),
+                            rescale_every_steps=1)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda v: [v, v] if isinstance(v, bool) else [(3, False),
+                                                          (4, False)],
+        )
+        monkeypatch.setattr(runtime, "shutdown", lambda: None)
+        cb.on_epoch_begin(0)
+        with pytest.raises(HostsUpdatedInterrupt):
+            cb.on_batch_end(0)
+
+    def test_pending_race_with_settle_is_soft(self, monkeypatch):
+        """agree_any fires but the votes reveal no actual change (the
+        pending flag raced a settle this member already adopted): keep
+        training — the next cadence re-checks."""
+        import jax
+
+        from horovod_tpu.elastic import state as state_mod
+
+        cb = self._callback(client=self._Client(gen=3, pending=True),
+                            rescale_every_steps=1)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            state_mod.collectives, "allgather_object",
+            lambda v: [v, v] if isinstance(v, bool) else [(3, False),
+                                                          (3, False)],
+        )
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)  # no raise
+        assert cb.state.commits == 0
+
+    def test_cadence_measures_from_resume_step(self):
+        """A fit resumed at (epoch, S) must not insta-fire its cadences:
+        baselines start at S for the resume epoch, 0 afterwards."""
+        class _T:
+            state = {"w": 1}
+            _resume_epoch = 3
+            _resume_step = 5
+
+        cb = self._callback(commit_every_steps=4)
+        cb.trainer = _T()
+        cb.on_epoch_begin(3)
+        assert cb._last_commit_step == 5
+        cb.on_batch_end(6)  # 7 steps done, 2 since resume: below cadence
+        assert cb.state.commits == 0
+        cb.on_batch_end(8)  # 9 done, 4 since resume: commit
+        assert cb.state.commits == 1
+        assert cb.state.progress == progress_marker(3, 9)
+        cb.on_epoch_begin(4)  # past the resume epoch: baseline back to 0
+        assert cb._last_commit_step == 0
+
+    def test_env_default_and_policy_export(self, monkeypatch):
+        monkeypatch.setenv("HVT_RESCALE_EVERY_STEPS", "25")
+        cb = self._callback()
+        assert cb.rescale_every_steps == 25
+        cb2 = self._callback(rescale_every_steps=0)
+        assert cb2.rescale_every_steps == 0
+        p = ElasticPolicy.from_mapping(
+            {"rescale_every_steps": 7, "commit_every_steps": 3}
+        )
+        assert p.commit_env() == {
+            "HVT_COMMIT_EVERY_STEPS": "3",
+            "HVT_RESCALE_EVERY_STEPS": "7",
+        }
+        assert ElasticPolicy().commit_env() == {}
+
+
+class TestCoordinatorStepProgress:
+    """Beat replies piggyback the pending-membership flag, and settle
+    journal records carry the root's (epoch, step) — shrink/grow
+    additionally journal a step-valued record job specs can gate
+    (`shrink_step: 1..N` = the shrink happened MID-epoch)."""
+
+    def test_beat_pending_flag(self):
+        coord = Coordinator(min_ranks=1, expected=1,
+                            rendezvous_timeout=10.0).start()
+        try:
+            c = ElasticClient(coord.address, "m0")
+            c.sync()
+            c.beat()
+            assert c.last_beat_pending is False
+            # A join bumps the generation: m0's next beat says pending.
+            threading.Thread(
+                target=lambda: ElasticClient(coord.address, "m1").sync(),
+                daemon=True,
+            ).start()
+            deadline = time.monotonic() + 5.0
+            while not c.last_beat_pending:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+                c.beat()
+            # m0 re-rendezvouses; the settled world clears the flag.
+            c.sync()
+            c.beat()
+            assert c.last_beat_pending is False
+        finally:
+            coord.stop()
+
+    def test_shrink_journal_carries_step(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        coord = Coordinator(min_ranks=1, expected=3,
+                            rendezvous_timeout=10.0,
+                            journal=log.write).start()
+        try:
+            _sync_all(coord.address, ["m0", "m1", "m2"])
+            # m2 leaves with the fleet's freshest committed progress at
+            # (epoch 1, step 3) — a MID-epoch boundary.
+            ElasticClient(coord.address, "m2").leave()
+            _sync_all(
+                coord.address, ["m0", "m1"],
+                progress={"m0": progress_marker(1, 3),
+                          "m1": progress_marker(1, 3)},
+            )
+        finally:
+            coord.stop()
+        records = _journal(str(tmp_path / "j.jsonl"))
+        shrink = next(r for r in records if r["name"] == "shrink")
+        assert shrink["epoch"] == 1 and shrink["step"] == 3
+        assert shrink["progress"] == progress_marker(1, 3)
+        steps = [r for r in records if r["name"] == "shrink_step"]
+        assert steps and steps[-1]["value"] == 3.0
+        # the CI-gate contract of mnist-elastic-midstep-2proc.yaml
+        ok, value = ci_gate.check_metrics(
+            str(tmp_path / "j.jsonl"), "shrink_step", (1.0, 999999.0),
+            how="max",
+        )
+        assert ok and value == 3.0

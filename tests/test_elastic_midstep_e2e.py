@@ -1,0 +1,274 @@
+"""Step-granular elastic recovery end-to-end (the ISSUE 5 acceptance run):
+
+a 3-process elastic fleet gets a STEP-filtered ``leave`` fault
+(``HVT_FAULT=2:1.5:leave``) — rank 2 records leave intent at optimizer
+step 5 OF epoch 1, mid-epoch. With ``rescale_every_steps`` the membership
+agreement runs at step boundaries, so the departure executes within steps
+(not at the epoch end): survivors commit at the current ``(epoch, step)``,
+tear down in lockstep, re-rendezvous at size 2, and resume with
+``fit(initial_epoch=, initial_step=)`` — the data iterator fast-forwarded
+to the committed optimizer step. The supervisor spawns a replacement; its
+join is likewise admitted at a step boundary, mid-epoch.
+
+The assertions are the acceptance criteria verbatim:
+
+* **step counter exact, zero replayed optimizer steps** — the rank-0
+  per-step trace covers every global optimizer step exactly once, and the
+  optimizer's own step counter equals the global step at every point;
+* **loss trajectory equal (rel 1e-4) to an uninterrupted control** — the
+  feed is a pure function of the global batch index and identical on
+  every rank (so the gradient is world-size-invariant), and a 1-process
+  uninterrupted control run must produce the same per-step losses;
+* **the joiner is admitted mid-epoch** — the coordinator's ``grow_step``
+  journal record carries step > 0 (and ``shrink_step`` > 0 proves the
+  shrink was mid-epoch), the gate contract of
+  `launch/jobs/mnist-elastic-midstep-2proc.yaml`.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.launch import ci_gate, supervisor
+from horovod_tpu.launch.supervisor import ElasticPolicy, RestartPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = 4
+STEPS = 40  # optimizer steps per epoch
+
+# One script, two modes. Elastic mode is the plain `elastic.run` idiom
+# with the step-granular resume contract (initial_epoch AND initial_step);
+# CONTROL=1 runs the identical fit uninterrupted in one process. The feed
+# is deterministic AND world-size-invariant: batch i is a pure function of
+# the global batch index, and every rank feeds the SAME batch, so the
+# allreduced gradient — hence the whole trajectory — does not depend on
+# the world size, and the two runs are comparable per step at rel 1e-4.
+TRAIN_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, __REPO__)
+import numpy as np
+import optax
+import flax.linen as nn
+import horovod_tpu as hvt
+from horovod_tpu import elastic
+
+STEPS = __STEPS__
+EPOCHS = __EPOCHS__
+
+print(f"BOOT member={os.environ.get('HVT_ELASTIC_MEMBER', 'control')}",
+      flush=True)
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(4)(x)
+
+
+def make_batch(i):
+    # Pure function of the GLOBAL batch index — the determinism anchor.
+    rng = np.random.RandomState(1000 + i)
+    x = rng.rand(8, 8).astype("float32")
+    y = rng.randint(0, 4, size=(8,)).astype("int64")
+    return x, y
+
+
+class Stream:
+    \"""`ArrayDataset.batches`-protocol feed over the global index space.
+    ``start`` anchors position 0 at the resume epoch's first batch, so a
+    resumed fit(initial_epoch=E, initial_step=S) — which skips S batches —
+    lands at global batch E*STEPS+S, exactly where the uninterrupted
+    control is at that optimizer step.\"""
+
+    def __init__(self, start=0):
+        self.start = start
+
+    def batches(self, skip=0):
+        i = self.start + skip
+        while True:
+            yield make_batch(i)
+            i += 1
+
+    def __iter__(self):
+        return self.batches()
+
+
+class Trace(hvt.callbacks.Callback):
+    \"""Per-step proof line from rank 0: global step, the optimizer's own
+    step counter, and the step's loss.\"""
+
+    def __init__(self, rank, size):
+        self.rank, self.size = rank, size
+        self._epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_batch_end(self, batch, logs=None):
+        import jax
+        g = self._epoch * STEPS + batch + 1
+        if self.rank == 0:
+            opt = int(jax.device_get(self.trainer.state.step))
+            print(f"TRACE g={g} opt={opt} loss={float(logs['loss']):.8f}",
+                  flush=True)
+        if self.size < 3 and os.environ.get("CONTROL") != "1":
+            # Pace the shrunken generation so the replacement's join
+            # (spawn + jax import away) lands MID-epoch deterministically.
+            time.sleep(0.25)
+
+
+def make_trainer():
+    trainer = hvt.Trainer(Tiny(), hvt.DistributedOptimizer(optax.adam(1e-2)))
+    x0, y0 = make_batch(0)
+    trainer.build(x0, y0)
+    return trainer
+
+
+def train(state, world):
+    print(f"GEN member={os.environ['HVT_ELASTIC_MEMBER']} rank={world.rank} "
+          f"size={world.size} gen={world.generation} epoch={state.epoch} "
+          f"step={state.step}", flush=True)
+    trainer = make_trainer()
+    if state.state is not None:
+        trainer.install_state(state.state)
+    cbs = [Trace(world.rank, world.size),
+           elastic.ElasticStateCallback(state, state.client)]
+    trainer.fit(
+        dataset=Stream(start=state.epoch * STEPS),
+        steps_per_epoch=STEPS, epochs=EPOCHS,
+        initial_epoch=state.epoch, initial_step=state.step,
+        callbacks=cbs, verbose=0,
+    )
+
+
+if os.environ.get("CONTROL") == "1":
+    hvt.init()
+    trainer = make_trainer()
+    trainer.fit(
+        dataset=Stream(0), steps_per_epoch=STEPS, epochs=EPOCHS,
+        callbacks=[Trace(0, 3)], verbose=0,
+    )
+else:
+    elastic.run(train)
+print("TRAINING COMPLETE", flush=True)
+"""
+
+TRACE_RE = re.compile(r"TRACE g=(\d+) opt=(\d+) loss=([0-9.eE+-]+)")
+
+
+def _write_script(tmp_path):
+    path = tmp_path / "midstep_train.py"
+    path.write_text(
+        textwrap.dedent(TRAIN_SCRIPT)
+        .replace("__REPO__", repr(REPO))
+        .replace("__STEPS__", str(STEPS))
+        .replace("__EPOCHS__", str(EPOCHS))
+    )
+    return [sys.executable, str(path)]
+
+
+def _traces(out):
+    return {
+        int(m.group(1)): (int(m.group(2)), float(m.group(3)))
+        for m in TRACE_RE.finditer(out)
+    }
+
+
+def _journal(log):
+    with open(log) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.mark.slow
+def test_midepoch_leave_resumes_at_step_and_matches_control(tmp_path, capfd):
+    argv = _write_script(tmp_path)
+    base_env = {
+        "HVT_PLATFORM": "cpu",
+        "HVT_NUM_CPU_DEVICES": "1",
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
+    }
+
+    # The uninterrupted control: same fit, one process, no chaos.
+    control = subprocess.run(
+        argv, capture_output=True, text=True, timeout=300,
+        env={**os.environ, **base_env, "CONTROL": "1"},
+    )
+    assert control.returncode == 0, control.stdout[-3000:] + control.stderr[-3000:]
+    control_traces = _traces(control.stdout)
+    total = EPOCHS * STEPS
+    assert sorted(control_traces) == list(range(1, total + 1))
+
+    # The chaos run: rank 2 leaves at epoch 1 STEP 5 (mid-epoch), the
+    # agreement cadence is 2 optimizer steps, and every step is committed
+    # so the boundary always resumes fresh (zero replayed steps).
+    log = tmp_path / "restarts.jsonl"
+    env = {
+        **base_env,
+        "HVT_FAULT": "2:1.5:leave",
+        "HVT_FAULT_STAMP": str(tmp_path / "leave-stamp"),
+    }
+    code = supervisor.supervise_elastic(
+        3, argv, env=env,
+        policy=RestartPolicy(max_restarts=4, backoff=0.5, grace_seconds=10.0),
+        elastic=ElasticPolicy(min_ranks=2, max_ranks=3,
+                              rendezvous_timeout=180.0,
+                              commit_every_steps=1, rescale_every_steps=2),
+        log_path=str(log),
+    )
+    out = capfd.readouterr().out
+    assert code == 0, out[-4000:]
+    assert "TRAINING COMPLETE" in out
+
+    # Survivors were NOT restarted: 3 initial members + 1 replacement.
+    boots = re.findall(r"BOOT member=(\S+)", out)
+    assert len(boots) == 4 and len(set(boots)) == 4, boots
+
+    # --- step counter exact, zero replayed optimizer steps -----------------
+    # Each generation's rank 0 traces the steps it trained; across the whole
+    # run every global optimizer step appears EXACTLY once (a replayed step
+    # would duplicate a g=, a skipped one would leave a hole), and the
+    # optimizer's own step counter agrees with the global step everywhere —
+    # the committed (epoch, step) resume is exact.
+    lines = re.findall(r"TRACE g=(\d+)", out)
+    assert sorted(int(g) for g in lines) == list(range(1, total + 1)), (
+        "replayed or skipped optimizer steps",
+        sorted(int(g) for g in lines)[:10],
+    )
+    chaos_traces = _traces(out)
+    for g, (opt, _) in sorted(chaos_traces.items()):
+        assert opt == g, (g, opt)
+
+    # --- the rescales happened MID-epoch, at step boundaries ---------------
+    records = _journal(log)
+    shrink = next(r for r in records if r["name"] == "shrink")
+    assert shrink["size"] == 2
+    assert shrink["epoch"] == 1 and shrink["step"] > 0, shrink
+    grow = next(r for r in records if r["name"] == "grow")
+    assert grow["size"] == 3 and grow["step"] > 0, grow
+    # The departure was the CLEAN path; nobody exhausted the budget.
+    names = [r["name"] for r in records]
+    assert "leave" in names
+    assert "supervisor_gave_up" not in names
+    # The CI-gate contract of mnist-elastic-midstep-2proc.yaml, verbatim.
+    ok, value = ci_gate.check_metrics(
+        str(log), "shrink_step", (1.0, 999999.0), how="max")
+    assert ok and value >= 1.0
+    ok, _ = ci_gate.check_metrics(str(log), "shrink", (1.0, 9.0), how="count")
+    assert ok
+
+    # A resumed generation really did start mid-epoch (initial_step > 0).
+    gens = re.findall(r"GEN member=\S+ rank=\d+ size=\d+ gen=\d+ "
+                      r"epoch=(\d+) step=(\d+)", out)
+    assert any(int(s) > 0 for _, s in gens), gens
+
+    # --- loss trajectory equal (rel 1e-4) to the uninterrupted control -----
+    for g in range(1, total + 1):
+        c, x = control_traces[g][1], chaos_traces[g][1]
+        assert x == pytest.approx(c, rel=1e-4, abs=1e-6), (g, c, x)

@@ -1,0 +1,258 @@
+"""Step-granular resume: `fit(initial_epoch=, initial_step=)` must
+deterministically fast-forward every feeding path to optimizer step S —
+the data a resumed run consumes is BYTE-IDENTICAL to what the
+uninterrupted run consumed from step S on, accumulation-aligned (exactly
+K·S microbatches skipped), without materializing the skipped batches, and
+stable across an `ArrayDataset.reshard` at resume.
+
+Two layers of proof:
+
+* `TestLoaderFastForward` — the data layer: `ArrayDataset.batches(skip)`
+  and `training_pipeline(skip_batches=)` yield the uninterrupted stream's
+  tail, byte for byte, python and native engines alike.
+* `TestResumeBitwise` — the trainer: for {streamed, steps_per_execution,
+  device-cached} × K ∈ {1, 4} (× reshard at resume), training epoch E in
+  two fits — steps [0, S) then a resumed fit(initial_step=S) — ends with
+  params AND optimizer state bitwise equal to the uninterrupted single
+  fit. Bitwise state equality is strictly stronger than batch equality:
+  any skew in the fast-forward (off-by-one batch, wrong microbatch
+  alignment, a differently-seeded shuffle) changes some gradient and
+  breaks it.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import flax.linen as nn  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvt  # noqa: E402
+from horovod_tpu.data.loader import ArrayDataset, training_pipeline  # noqa: E402
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(4)(x)
+
+
+def _batches_equal(a, b):
+    for xa, xb in zip(a, b):
+        la, lb = jax.tree.leaves(xa), jax.tree.leaves(xb)
+        assert len(la) == len(lb)
+        for ua, ub in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(ua), np.asarray(ub))
+
+
+class TestLoaderFastForward:
+    def _ds(self):
+        x = np.arange(80, dtype=np.float32).reshape(40, 2)
+        y = np.arange(40)
+        return (
+            ArrayDataset((x, y)).repeat().shuffle(40, seed=3).batch(4)
+        )
+
+    def test_skip_yields_uninterrupted_tail(self):
+        ds = self._ds()
+        full = [b for _, b in zip(range(10), iter(ds))]
+        tail = [b for _, b in zip(range(7), ds.batches(skip=3))]
+        _batches_equal(full[3:], tail)
+
+    def test_skip_materializes_nothing(self, monkeypatch):
+        """The skipped stretch must never gather rows: poison __getitem__
+        on the arrays and unpoison only after the skip is spent."""
+        ds = self._ds()
+        it = ds.batches(skip=5)
+        reads = {"n": 0}
+
+        class Poison:
+            def __init__(self, arr):
+                self.arr = arr
+                self.shape = arr.shape
+
+            def __getitem__(self, sel):
+                reads["n"] += 1
+                return self.arr[sel]
+
+        ds._arrays = tuple(Poison(a) for a in ds._arrays)
+        first = next(it)
+        # Exactly ONE gather per array part — for the first YIELDED batch.
+        assert reads["n"] == len(ds._arrays)
+        assert jax.tree.leaves(first)[0].shape[0] == 4
+
+    def test_reshard_at_resume_same_cut(self):
+        """reshard() at the same world size reproduces the identical
+        stream, so a resumed generation's skip lands on the same cut."""
+        ds = self._ds().shard(0, 1).batch(4)
+        full = [b for _, b in zip(range(8), iter(ds))]
+        resharded = ds.reshard(0, 1).batch(4)
+        tail = [b for _, b in zip(range(4), resharded.batches(skip=4))]
+        _batches_equal(full[4:], tail)
+
+    def test_skip_count_is_world_size_independent(self):
+        """The fast-forward cut is defined in BATCHES (optimizer steps ×
+        K), not bytes: at a different world size each process skips the
+        same batch count of its own resharded stream."""
+        ds = self._ds().shard(0, 2).batch(4)
+        full = [b for _, b in zip(range(4), iter(ds))]
+        tail = [b for _, b in zip(range(2), ds.batches(skip=2))]
+        _batches_equal(full[2:], tail)
+
+    @pytest.mark.parametrize("native", [False, True])
+    def test_training_pipeline_skip(self, native, monkeypatch):
+        if native:
+            from horovod_tpu.data import native_loader
+
+            if not native_loader.available():
+                pytest.skip("native loader unavailable")
+        else:
+            monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x = np.arange(60, dtype=np.float32).reshape(30, 2)
+        y = np.arange(30, dtype=np.int64)
+        it_a, close_a = training_pipeline((x, y), 5, seed=11)
+        full = [b for _, b in zip(range(9), it_a)]
+        close_a()
+        it_b, close_b = training_pipeline((x, y), 5, seed=11, skip_batches=4)
+        tail = [b for _, b in zip(range(5), it_b)]
+        close_b()
+        _batches_equal(full[4:], tail)
+
+
+def _params_bytes(trainer):
+    state = jax.device_get(trainer.state)
+    return [
+        np.asarray(l).tobytes()
+        for l in jax.tree.leaves((state.params, state.opt_state))
+    ]
+
+
+T, S = 4, 2  # steps per epoch, resume step
+EPOCHS = 3   # train epochs [1, 3)
+
+
+def _data(n=256):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 8).astype(np.float32)
+    y = (np.arange(n) % 4).astype(np.int64)
+    return x, y
+
+
+def _trainer(K=1, spe=1):
+    return hvt.Trainer(
+        Tiny(),
+        hvt.DistributedOptimizer(
+            optax.adam(1e-2), backward_passes_per_step=K
+        ),
+        seed=7,
+        steps_per_execution=spe,
+    )
+
+
+class TestResumeBitwise:
+    """Uninterrupted control vs [partial epoch + fit(initial_step=S)]:
+    final params + optimizer state must be BITWISE equal (CPU determinism
+    — any fast-forward skew breaks it). The control starts the same fit
+    call shape (fresh stream at initial_epoch), matching the elastic
+    contract where every generation rebuilds its input pipeline."""
+
+    @pytest.mark.parametrize("K", [1, 4])
+    @pytest.mark.parametrize("spe", [1, 3])
+    def test_streamed(self, K, spe, monkeypatch):
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x, y = _data()
+        tA = _trainer(K, spe)
+        tA.fit(x=x, y=y, batch_size=4, epochs=EPOCHS, initial_epoch=1,
+               steps_per_epoch=T, verbose=0)
+        tB = _trainer(K, spe)
+        # The interruption: epoch 1 trained only S steps (the stream,
+        # fresh per fit, consumed exactly the control's first S·K
+        # microbatches — steps_per_epoch only caps consumption).
+        tB.fit(x=x, y=y, batch_size=4, epochs=2, initial_epoch=1,
+               steps_per_epoch=S, verbose=0)
+        # The resume: fast-forward S·K microbatches, continue to the end.
+        tB.fit(x=x, y=y, batch_size=4, epochs=EPOCHS, initial_epoch=1,
+               initial_step=S, steps_per_epoch=T, verbose=0)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+    @pytest.mark.parametrize("K", [1, 4])
+    def test_device_cached(self, K):
+        # 256 rows over the suite's 8-device mesh: per-shard 32 examples
+        # = T·K·batch at K=4 — the epoch exactly covers the shard.
+        x, y = _data(256)
+        tA = _trainer(K)
+        tA.fit(x=x, y=y, batch_size=2, cache="device", epochs=EPOCHS,
+               initial_epoch=1, steps_per_epoch=T, verbose=0)
+        tB = _trainer(K)
+        # The epoch permutation is a pure function of (seed, epoch), so
+        # a partial epoch consumes the uninterrupted epoch's prefix...
+        tB.fit(x=x, y=y, batch_size=2, cache="device", epochs=2,
+               initial_epoch=1, steps_per_epoch=S, verbose=0)
+        # ...and the resume gathers/scans from step S of the SAME order.
+        tB.fit(x=x, y=y, batch_size=2, cache="device", epochs=EPOCHS,
+               initial_epoch=1, initial_step=S, steps_per_epoch=T,
+               verbose=0)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+    @pytest.mark.parametrize("K", [1, 4])
+    def test_streamed_reshard_at_resume(self, K, monkeypatch):
+        """The dataset= path across a reshard: the resumed fit feeds a
+        RESHARDED (same-size) recut of the dataset — the elastic
+        generation-change shape — and still lands bitwise."""
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x, y = _data()
+
+        def chain(ds):
+            # Batch divisible by the suite's 8-device data axis.
+            return ds.repeat().shuffle(len(x), seed=5).batch(8 * K)
+
+        tA = _trainer(K)
+        tA.fit(chain(ArrayDataset((x, y)).shard(0, 1)), epochs=EPOCHS,
+               initial_epoch=1, steps_per_epoch=T, verbose=0)
+        tB = _trainer(K)
+        base = ArrayDataset((x, y)).shard(0, 1)
+        tB.fit(chain(base), epochs=2, initial_epoch=1, steps_per_epoch=S,
+               verbose=0)
+        tB.fit(chain(base.reshard(0, 1)), epochs=EPOCHS, initial_epoch=1,
+               initial_step=S, steps_per_epoch=T, verbose=0)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+    def test_batch_indices_resume_at_step(self, monkeypatch):
+        """on_batch_end fires with TRUE within-epoch step indices after a
+        resume — step-keyed cadences (elastic commits, step faults) stay
+        aligned — and the epoch's logged mean covers only the steps the
+        resumed fit actually ran."""
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x, y = _data()
+        seen = []
+
+        class Spy(hvt.callbacks.Callback):
+            def on_batch_end(self, batch, logs=None):
+                seen.append(batch)
+
+        t = _trainer()
+        t.fit(x=x, y=y, batch_size=4, epochs=2, initial_epoch=1,
+              initial_step=S, steps_per_epoch=T, callbacks=[Spy()],
+              verbose=0)
+        assert seen == list(range(S, T))
+        assert t._resume_epoch == 1 and t._resume_step == S
+
+    def test_step_rolls_into_next_epoch(self, monkeypatch):
+        """A resume point at the epoch's end (a commit taken at the last
+        step boundary) normalizes to the NEXT epoch's start."""
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x, y = _data()
+        t = _trainer()
+        hist = t.fit(x=x, y=y, batch_size=4, epochs=3, initial_epoch=1,
+                     initial_step=T, steps_per_epoch=T, verbose=0)
+        # (1, T) ≡ (2, 0): exactly one epoch (epoch 2) runs.
+        assert len(hist) == 1
+        assert t._resume_epoch == 2 and t._resume_step == 0
+
+    def test_negative_step_rejected(self):
+        x, y = _data()
+        t = _trainer()
+        with pytest.raises(ValueError, match="initial_step"):
+            t.fit(x=x, y=y, batch_size=4, epochs=2, initial_step=-1,
+                  steps_per_epoch=T, verbose=0)

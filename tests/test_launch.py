@@ -126,6 +126,24 @@ class TestJob:
         spec2.write_text(spec.read_text().replace("0.0..0.3", "0.0..0.05"))
         assert run_job(str(spec2)) == 1
 
+    def test_job_status_port_requires_supervision(self, tmp_path, capsys):
+        """status_port on an UNsupervised spec fails loudly (the status
+        server is the supervisor's), matching the CLI's --status-port
+        error — silently ignoring it would leave the operator's health
+        probes failing against a job that looks correctly configured."""
+        spec = tmp_path / "job.yaml"
+        spec.write_text(textwrap.dedent(f"""
+            name: test-job
+            job:
+              command: ["{sys.executable}", "-c", "pass"]
+              nprocs: 1
+              status_port: 9967
+        """))
+        from horovod_tpu.launch.job import run_job
+
+        assert run_job(str(spec)) == 1
+        assert "status_port" in capsys.readouterr().out
+
     def test_job_fresh_wipes_model_dir(self, tmp_path):
         """fresh: true — a gated run must train from scratch: a stale
         checkpoint in the job-owned PS_MODEL_PATH would make the entry

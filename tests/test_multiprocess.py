@@ -433,7 +433,13 @@ class TestModelParallelCheckpointResume:
                 with open(os.path.join(base, f"resumed-{{r}}"), "w") as f:
                     f.write(repr(hist[-1]["loss"]))
         """))
-        env = _mp_env(tmp_path, devices_per_proc=1)
+        # SIGKILLed children must stay out of the suite's shared persistent
+        # XLA cache: a kill racing a cache write poisons the entry, and on
+        # this jax a poisoned entry later deserializes into a silently
+        # WRONG executable (observed here as NaN shard digests on the
+        # resume leg) — the conftest caveat, applied.
+        env = _mp_env(tmp_path, devices_per_proc=1,
+                      JAX_ENABLE_COMPILATION_CACHE=0)
         code = launcher.run_local(
             2, [sys.executable, str(script)], env=env, tag_output=False
         )

@@ -395,6 +395,36 @@ class TestRestartLogRotation:
             "start", "restarts", "shrink"
         ]
 
+    def test_status_server_routes_and_loopback_default(self, tmp_path):
+        """--status-port serves /status /journal /healthz from the
+        supervisor itself — and binds LOOPBACK by default (the routes are
+        unauthenticated; off-host exposure is the HVT_STATUS_HOST /
+        host= opt-in)."""
+        import urllib.request
+
+        log = supervisor.RestartLog(str(tmp_path / "j.jsonl"))
+        log.write("start", 2.0, generation=1, size=2)
+        log.write("shrink", 1.0, generation=2, size=1)
+        server = supervisor.start_status_server(0, log.path)
+        try:
+            bound_host, port = server.server_address[:2]
+            assert bound_host == "127.0.0.1"
+
+            def get(route):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{route}", timeout=5
+                ) as r:
+                    return json.loads(r.read())
+
+            status = get("/status")
+            assert status["fleet"]["shrinks"] == 1
+            assert status["coordinator"] is None  # no elastic coord here
+            records = get("/journal")["records"]
+            assert [r["name"] for r in records] == ["start", "shrink"]
+            assert get("/healthz")["status"] == "ok"
+        finally:
+            server.shutdown()
+
 
 class TestFleet:
     def test_abort_terminates_and_marks(self):
@@ -443,14 +473,59 @@ class TestFaultPlan:
         assert exit_plan.kind == "exit143"
         assert exit_plan.exit_code == 143
 
+    def test_parse_step_filter(self):
+        from horovod_tpu.testing import faults
+
+        plan = faults.parse_plan("2:1.5:leave")
+        assert (plan.rank, plan.epoch, plan.step, plan.kind) == (
+            2, 1, 5, "leave")
+        assert faults.parse_plan("0:3:kill").step is None
+
     @pytest.mark.parametrize("bad", [
-        "0:1", "a:1:kill", "0:b:kill", "0:1:explode", "0:1:exitX", ""
+        "0:1", "a:1:kill", "0:b:kill", "0:1:explode", "0:1:exitX", "",
+        "0:1.x:kill", "0:1.0:kill", "0:1.-2:kill",
     ])
     def test_parse_rejects(self, bad):
         from horovod_tpu.testing import faults
 
         with pytest.raises(ValueError):
             faults.parse_plan(bad)
+
+    def test_step_filter_fires_at_or_past_target(self, monkeypatch):
+        from horovod_tpu.testing import faults
+
+        fired = []
+        cb = faults.FaultInjectionCallback(faults.parse_plan("0:1.3:kill"))
+        monkeypatch.setattr(cb, "_fire", lambda: fired.append(1))
+        cb.on_epoch_begin(1)
+        cb.on_batch_end(0)
+        cb.on_batch_end(1)
+        assert not fired  # steps 1, 2 done — before the target
+        # A steps_per_execution chunk striding past step 3 (>= semantics).
+        cb.on_batch_end(4)
+        assert len(fired) == 1
+
+    def test_step_filter_does_not_refire_on_resumed_run(self, monkeypatch):
+        from types import SimpleNamespace
+
+        from horovod_tpu.testing import faults
+
+        fired = []
+        cb = faults.FaultInjectionCallback(faults.parse_plan("0:1.3:kill"))
+        monkeypatch.setattr(cb, "_fire", lambda: fired.append(1))
+        # The relaunch resumed fit(initial_epoch=1, initial_step=3): the
+        # fault fired in the run being resumed, so it must stay quiet —
+        # no stamp file needed for step-filtered plans.
+        cb.set_trainer(SimpleNamespace(_resume_epoch=1, _resume_step=3))
+        cb.on_epoch_begin(1)
+        cb.on_batch_end(3)  # first batch end after the resume point
+        cb.on_batch_end(4)
+        assert not fired
+        # A resume BEFORE the target (crash from another cause) still
+        # fires once the target step completes.
+        cb.set_trainer(SimpleNamespace(_resume_epoch=1, _resume_step=1))
+        cb.on_batch_end(2)
+        assert len(fired) == 1
 
     def test_callback_gates_on_rank_epoch_and_stamp(self, tmp_path,
                                                     monkeypatch):
