@@ -1,0 +1,33 @@
+"""Static analysis (`hvt-lint`) + the central env-knob registry.
+
+The reliability spine's correctness invariants (collective symmetry,
+lockstep teardown, trace purity, knob discipline, atomic artifact writes)
+previously lived only in prose — this subsystem enforces them at lint
+time. See `core` (framework), `rules` (HVT001-HVT005), `registry` (the
+``HVT_*`` knob table ``docs/ENVVARS.md`` is generated from) and `cli`
+(the ``hvt-lint`` entry point).
+
+Import discipline: `registry` is stdlib-only and importable from the
+earliest bootstrap (`runtime.init` reads knobs through it); nothing here
+imports jax.
+"""
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.analysis.core import (
+    Finding,
+    LintResult,
+    Rule,
+    iter_rules,
+    lint_paths,
+    register_rule,
+)
+
+__all__ = [
+    "registry",
+    "Finding",
+    "LintResult",
+    "Rule",
+    "iter_rules",
+    "lint_paths",
+    "register_rule",
+]

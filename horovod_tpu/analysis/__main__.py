@@ -1,0 +1,3 @@
+from horovod_tpu.analysis.cli import main
+
+raise SystemExit(main())

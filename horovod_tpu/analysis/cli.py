@@ -1,0 +1,140 @@
+"""`hvt-lint` — the distributed-correctness static analyzer CLI.
+
+Usage::
+
+    hvt-lint horovod_tpu/                 # human output, committed baseline
+    hvt-lint --format json horovod_tpu/   # machine output (CI annotations)
+    hvt-lint --select HVT001,HVT003 ...   # subset of rules
+    hvt-lint --write-baseline ...         # grandfather current findings
+    hvt-lint --list-rules
+
+Exit codes (pre-commit-hook friendly):
+
+* ``0`` — clean: zero findings, or every finding matches the committed
+  baseline;
+* ``1`` — at least one non-baselined finding (printed);
+* ``2`` — usage error / unreadable input.
+
+Also reachable as ``python -m horovod_tpu.launch lint ...`` (the
+launcher's tooling surface) and ``python -m horovod_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from horovod_tpu.analysis import core
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvt-lint",
+        description="AST-based distributed-correctness checks "
+        "(collective symmetry, teardown discipline, tracing hazards, "
+        "env-knob registry, checkpoint-write atomicity)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["horovod_tpu"],
+        help="files or directories to lint (default: horovod_tpu)")
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human")
+    parser.add_argument(
+        "--select", default=None, metavar="HVT001,HVT002,...",
+        help="run only these rules")
+    parser.add_argument(
+        "--baseline", default=core.DEFAULT_BASELINE, metavar="PATH",
+        help="baseline file of grandfathered findings "
+        "(default: the committed horovod_tpu/analysis/baseline.json)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current findings to --baseline (justifications "
+        "left as TODO for hand-editing) and exit 0")
+    parser.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="directory findings/baseline paths are relative to "
+        "(default: cwd)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in core.iter_rules():
+            print(f"{cls.rule_id}  {cls.title}")
+        return 0
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"hvt-lint: no such file or directory: {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    select = args.select.split(",") if args.select else None
+    baseline_path = None if args.no_baseline else args.baseline
+    try:
+        result = core.lint_paths(
+            args.paths, root=args.root, select=select,
+            baseline_path=None if args.write_baseline else baseline_path,
+        )
+    except (OSError, ValueError) as e:
+        print(f"hvt-lint: {e}", file=sys.stderr)
+        return 2
+    if result.files == 0:
+        # A gate that lints nothing must not report "clean" — a typo'd
+        # path or a CI step run from the wrong directory stays loud.
+        print(
+            "hvt-lint: no python files under "
+            f"{', '.join(args.paths)} — nothing was linted",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.write_baseline:
+        try:
+            existing = core.load_baseline(args.baseline)
+        except ValueError as e:
+            print(f"hvt-lint: {e}", file=sys.stderr)
+            return 2
+        core.write_baseline(
+            args.baseline, result.findings,
+            existing=existing, selected=select,
+        )
+        print(
+            f"hvt-lint: wrote {len(result.findings)} finding(s) to "
+            f"{args.baseline} — edit the TODO justifications before "
+            "committing"
+        )
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": result.files,
+            "findings": [f.to_json() for f in result.findings],
+            "baselined": [f.to_json() for f in result.baselined],
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.format())
+        summary = (
+            f"hvt-lint: {len(result.findings)} finding(s) in "
+            f"{result.files} file(s)"
+        )
+        if result.baselined:
+            summary += f" ({len(result.baselined)} baselined)"
+        print(summary)
+    return 0 if result.clean else 1
+
+
+def cli() -> None:
+    """Console entry point (`hvt-lint`, pyproject.toml)."""
+    raise SystemExit(main())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,370 @@
+"""`hvt-lint` checker framework: the pieces every rule shares.
+
+The analyzer is a plain AST pass — no imports of the analyzed code, so it
+runs in milliseconds over the whole package and can't be wedged by
+import-time side effects (the same reason it can lint a file whose
+dependencies aren't installed). Structure:
+
+* `ModuleSource` — one parsed file: source text, AST, per-line ``noqa``
+  suppressions, and an import-alias map (so rules can resolve
+  ``from jax import random`` vs stdlib ``random``).
+* `Rule` + `register_rule` — the visitor registry. A rule yields
+  `Finding`s from `check(module)`.
+* Baseline — a committed JSON file of grandfathered findings, each with a
+  one-line justification. Matching is by (rule, path, source-line
+  snippet), NOT line number, so unrelated edits above a baselined site
+  don't invalidate it — while any edit to the flagged line itself does.
+* `lint_paths` — the runner: walk files, parse, run rules, partition
+  into fresh findings vs baselined.
+
+Suppressions, narrowest first:
+
+1. ``# hvt: noqa[HVT001]`` (or a comma list) on the flagged line —
+   site-local, visible in review;
+2. a baseline entry with a justification — for grandfathered findings;
+3. nothing rule-wide: a rule that needs blanket exceptions should encode
+   them (see HVT002's sanctioned-module set).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Iterator
+
+# Parse failures surface as findings under this pseudo-rule (a file the
+# analyzer cannot read is a lint failure, not a silent skip).
+PARSE_ERROR_RULE = "HVT000"
+
+_NOQA_RE = re.compile(
+    r"#\s*hvt:\s*noqa(?:\[(?P<rules>[A-Z0-9,\s]+)\])?", re.IGNORECASE
+)
+
+_ALL = "ALL"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str      # forward-slash path relative to the lint root
+    line: int      # 1-based
+    col: int       # 0-based
+    message: str
+    snippet: str   # the stripped source line (the baseline match key)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _parse_noqa(text: str) -> dict[int, set[str] | str]:
+    """Per-line suppressions: line number -> set of rule ids, or _ALL."""
+    out: dict[int, set[str] | str] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        if "noqa" not in line:
+            continue
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is None:
+            out[i] = _ALL
+        else:
+            out[i] = {r.strip().upper() for r in rules.split(",") if r.strip()}
+    return out
+
+
+class ModuleSource:
+    """One file under analysis."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)  # may raise SyntaxError
+        self.noqa = _parse_noqa(text)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        entry = self.noqa.get(lineno)
+        if entry is None:
+            return False
+        return entry == _ALL or rule in entry
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=node.lineno,
+            col=node.col_offset,
+            message=message,
+            snippet=self.line_at(node.lineno),
+        )
+
+    # --- shared AST helpers used by several rules ---------------------------
+
+    def import_map(self) -> dict[str, str]:
+        """Local name -> dotted origin for module-level imports, e.g.
+        ``{'np': 'numpy', 'random': 'jax.random'}`` after
+        ``import numpy as np; from jax import random``. Cached."""
+        cached = getattr(self, "_import_map", None)
+        if cached is not None:
+            return cached
+        mapping: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mapping[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    mapping[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+        self._import_map = mapping
+        return mapping
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.lax.psum`` for the matching Attribute/Name chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> str | None:
+    """The rightmost identifier of a call target: ``psum`` for both
+    ``psum(...)`` and ``jax.lax.psum(...)``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def resolved_dotted(module: ModuleSource, node: ast.AST) -> str | None:
+    """`dotted_name` with the leading segment resolved through the module's
+    imports: ``np.random.rand`` -> ``numpy.random.rand``."""
+    dotted = dotted_name(node)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    origin = module.import_map().get(head, head)
+    return f"{origin}.{rest}" if rest else origin
+
+
+# --- rule registry ----------------------------------------------------------
+
+
+class Rule:
+    """Base class: subclass, set `rule_id`/`title`, implement `check`."""
+
+    rule_id: str = "HVT000"
+    title: str = ""
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_RULES: dict[str, type[Rule]] = {}
+
+
+def register_rule(cls: type[Rule]) -> type[Rule]:
+    if cls.rule_id in _RULES:
+        raise ValueError(f"duplicate rule id {cls.rule_id}")
+    _RULES[cls.rule_id] = cls
+    return cls
+
+
+def iter_rules() -> list[type[Rule]]:
+    """Registered rules, id-sorted. Importing `rules` populates the
+    registry; done lazily here so `core` stays import-cycle-free."""
+    from horovod_tpu.analysis import rules as _rules  # noqa: F401
+
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+# --- baseline ---------------------------------------------------------------
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None) -> list[dict]:
+    """Baseline entries: ``{rule, path, snippet, justification}``. A
+    missing file is an empty baseline."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data["findings"] if isinstance(data, dict) else data
+    for e in entries:
+        for key in ("rule", "path", "snippet", "justification"):
+            if key not in e:
+                raise ValueError(
+                    f"baseline entry {e!r} is missing {key!r} — every "
+                    "grandfathered finding needs a one-line justification"
+                )
+    return entries
+
+
+def write_baseline(
+    path: str,
+    findings: Iterable[Finding],
+    *,
+    existing: Iterable[dict] = (),
+    selected: Iterable[str] | None = None,
+) -> None:
+    """Emit a baseline covering `findings`. A rewrite must not destroy
+    hand-written grandfather clauses: entries in `existing` that still
+    match a finding keep their justification (TODO only for NEW
+    findings), and when `selected` restricts the run to a rule subset,
+    existing entries for the other rules are carried over untouched —
+    otherwise ``--select HVT001 --write-baseline`` would silently drop
+    every other rule's entries from the committed file."""
+    by_key: dict[tuple, dict] = {
+        (e["rule"], e["path"], e["snippet"]): e for e in existing
+    }
+    entries = []
+    seen: set[tuple] = set()
+    for f in findings:
+        key = _baseline_key(f.rule, f.path, f.snippet)
+        if key in seen:
+            continue
+        seen.add(key)
+        prev = by_key.get(key)
+        entries.append({
+            "rule": f.rule, "path": f.path, "snippet": f.snippet,
+            "justification": (
+                prev["justification"] if prev else "TODO: justify or fix"
+            ),
+        })
+    if selected is not None:
+        wanted = {s.upper() for s in selected}
+        entries.extend(
+            e for k, e in by_key.items()
+            if e["rule"] not in wanted and k not in seen
+        )
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["snippet"]))
+    # Dev-tool output, hand-edited before commit — not a crash-consistency
+    # artifact (no reader verifies it mid-write).
+    with open(path, "w") as f:  # hvt: noqa[HVT005]
+        json.dump({"findings": entries}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _baseline_key(rule: str, path: str, snippet: str) -> tuple:
+    return (rule, path, snippet)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]       # fresh — these fail the lint
+    baselined: list[Finding]      # matched a committed baseline entry
+    files: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".hypothesis")
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def lint_paths(
+    paths: Iterable[str],
+    *,
+    root: str | None = None,
+    select: Iterable[str] | None = None,
+    baseline_path: str | None = DEFAULT_BASELINE,
+) -> LintResult:
+    """Run every (selected) rule over every ``.py`` under `paths`.
+
+    `root` anchors the relative paths findings/baselines are keyed by
+    (default: the current directory) — run from the repo root, or pass
+    the repo root, for baseline paths like ``horovod_tpu/tbevents.py``
+    to match."""
+    root = os.path.abspath(root or os.getcwd())
+
+    def anchor_relpath(abspath: str) -> str:
+        if abspath.startswith(root + os.sep):
+            return os.path.relpath(abspath, root)
+        # Input outside `root` (an absolute path from another cwd, an
+        # editor integration): anchor at the LAST `horovod_tpu` path
+        # segment — the package directory — so the paths that key the
+        # HVT002 sanctioned-module set and the committed baseline are
+        # invocation-directory-independent.
+        parts = abspath.split(os.sep)
+        if "horovod_tpu" in parts:
+            i = len(parts) - 1 - parts[::-1].index("horovod_tpu")
+            return os.sep.join(parts[i:])
+        return abspath
+
+    wanted = {s.upper() for s in select} if select else None
+    rules = [
+        cls() for cls in iter_rules()
+        if wanted is None or cls.rule_id in wanted
+    ]
+    baseline = {
+        _baseline_key(e["rule"], e["path"], e["snippet"])
+        for e in load_baseline(baseline_path)
+    }
+    result = LintResult(findings=[], baselined=[])
+    for filepath in iter_python_files(paths):
+        result.files += 1
+        abspath = os.path.abspath(filepath)
+        relpath = anchor_relpath(abspath)
+        with open(filepath, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            module = ModuleSource(abspath, relpath, text)
+        except SyntaxError as e:
+            result.findings.append(Finding(
+                rule=PARSE_ERROR_RULE, path=relpath.replace(os.sep, "/"),
+                line=e.lineno or 1, col=(e.offset or 1) - 1,
+                message=f"file does not parse: {e.msg}", snippet="",
+            ))
+            continue
+        for rule in rules:
+            for finding in rule.check(module):
+                if module.suppressed(finding.rule, finding.line):
+                    continue
+                key = _baseline_key(
+                    finding.rule, finding.path, finding.snippet
+                )
+                if key in baseline:
+                    result.baselined.append(finding)
+                else:
+                    result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

@@ -1,0 +1,288 @@
+"""Central registry of every ``HVT_*`` environment knob.
+
+The reliability spine (PRs 1-5) grew ~30 env knobs whose names, types and
+defaults lived only at their scattered read sites — drift in BOTH
+directions (a knob read but documented nowhere; a knob documented but no
+longer read) was unobservable. This module is the single source of truth:
+
+* every knob is declared here with type, default, owning subsystem and a
+  one-line description;
+* code reads knobs through the typed accessors (`get_raw`/`get_str`/
+  `get_int`/`get_float`/`get_flag`), which refuse undeclared names — so a
+  new knob cannot ship without a registry row;
+* the `hvt-lint` rule HVT004 (`analysis/rules.py`) statically rejects any
+  ``HVT_*`` string literal in the package that is not declared here, and
+  any inline ``os.environ`` read that bypasses the accessors;
+* ``docs/ENVVARS.md`` is GENERATED from this table (`generate_doc`;
+  ``python -m horovod_tpu.analysis.registry`` prints it) and a tier-1
+  test asserts regeneration produces no diff.
+
+Value contract, uniform across every accessor: an UNSET variable and a
+variable set to the EMPTY STRING are both "unset" (the registered default
+applies). Boolean knobs follow `runtime.env_flag`'s spelling contract:
+unset/''/'0'/'false'/'no' (case-insensitive) are off, anything else is on
+— that contract is implemented here (`flag_like`) and `runtime.env_flag`
+delegates to it, so the accepted spellings cannot drift.
+
+Deliberately dependency-free (stdlib only): the ``hvt-lint`` CLI and the
+earliest bootstrap code (`runtime.init`, before any backend exists) both
+import this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = [
+    "Knob", "KNOBS", "UnknownKnobError", "knob", "is_registered",
+    "get_raw", "get_str", "get_int", "get_float", "get_flag",
+    "flag_like", "generate_doc",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str
+    type: str          # "str" | "int" | "float" | "flag" | "path" | "spec"
+    default: object    # the value accessors return when unset ('' == unset)
+    subsystem: str     # owning layer (the ENVVARS.md grouping)
+    description: str
+
+
+_SUBSYSTEM_ORDER = (
+    "runtime", "parallel", "training", "checkpoint", "elastic",
+    "launch", "data", "observability", "testing", "examples",
+)
+
+
+def _decl(knobs: list[Knob]) -> dict[str, Knob]:
+    table: dict[str, Knob] = {}
+    for k in knobs:
+        if k.name in table:
+            raise ValueError(f"duplicate knob declaration {k.name}")
+        if k.subsystem not in _SUBSYSTEM_ORDER:
+            raise ValueError(
+                f"{k.name}: unknown subsystem {k.subsystem!r} — add it to "
+                "_SUBSYSTEM_ORDER so ENVVARS.md ordering stays deterministic"
+            )
+        table[k.name] = k
+    return table
+
+
+KNOBS: dict[str, Knob] = _decl([
+    # --- runtime bootstrap (runtime.init) ----------------------------------
+    Knob("HVT_COORDINATOR_ADDRESS", "str", None, "runtime",
+         "jax.distributed coordinator `host:port`; unset = single-process "
+         "(every collective degrades to a local op)."),
+    Knob("HVT_NUM_PROCESSES", "int", None, "runtime",
+         "Process count of the static (non-elastic) world."),
+    Knob("HVT_PROCESS_ID", "int", None, "runtime",
+         "This process's rank in the static world."),
+    Knob("HVT_LOCAL_RANK", "int", 0, "runtime",
+         "Ordinal among co-located processes on one host (launcher-set)."),
+    Knob("HVT_PLATFORM", "str", None, "runtime",
+         "Force the jax platform (e.g. `cpu`) before backend init — "
+         "overrides a site hook's forced accelerator registration."),
+    Knob("HVT_NUM_CPU_DEVICES", "int", None, "runtime",
+         "Virtual CPU device count for launched children (authoritative: "
+         "replaces an inherited XLA_FLAGS device count)."),
+    Knob("HVT_FAST_RNG", "flag", False, "runtime",
+         "Use the TPU hardware RNG (`rbg`) instead of threefry: faster "
+         "dropout, not bit-reproducible across topologies."),
+    # --- parallel / mesh ---------------------------------------------------
+    Knob("HVT_MESH", "spec", None, "parallel",
+         "Mesh axis sizes, `axis=size` pairs (`data=2,seq=4`); "
+         "unset/empty = pure data parallelism (`MeshSpec.from_string`)."),
+    Knob("HVT_MESH_ORDER", "str", "auto", "parallel",
+         "Physical device layout: `auto` (ICI-torus-aware mesh_utils) or "
+         "`flat` (enumeration-order reshape)."),
+    Knob("HVT_DCN_FACTOR", "int", None, "parallel",
+         "Override the derived multi-slice factor of the data axis — the "
+         "fake-topology knob for the ICI/DCN two-hop reduction; must "
+         "divide the axis size."),
+    Knob("HVT_BUCKET_BYTES", "int", None, "parallel",
+         "Gradient-fusion bucket cap in bytes for the explicit-collective "
+         "boundary reduction (default: collectives.DEFAULT_BUCKET_BYTES, "
+         "64 MB — Horovod's fusion threshold)."),
+    # --- training ----------------------------------------------------------
+    Knob("HVT_SAVE_EVERY_STEPS", "int", 0, "training",
+         "ModelCheckpoint mid-epoch save cadence in optimizer steps "
+         "(0 = epoch cadence only). Single-file checkpoints only."),
+    # --- elastic -----------------------------------------------------------
+    Knob("HVT_ELASTIC_COORDINATOR", "str", None, "elastic",
+         "Rendezvous coordinator `host:port` (supervisor-set); presence "
+         "switches faults and entry scripts into elastic mode."),
+    Knob("HVT_ELASTIC_MEMBER", "str", None, "elastic",
+         "This process's stable elastic member identity (supervisor-set)."),
+    Knob("HVT_COMMIT_EVERY", "int", 1, "elastic",
+         "Elastic commit cadence in epochs (ElasticStateCallback default; "
+         "job-spec `elastic: {commit_every}` travels as this)."),
+    Knob("HVT_COMMIT_EVERY_STEPS", "int", 0, "elastic",
+         "Additional sub-epoch commit cadence in optimizer steps "
+         "(0 = epoch cadence only)."),
+    Knob("HVT_RESCALE_EVERY_STEPS", "int", 0, "elastic",
+         "Sub-epoch membership-agreement cadence in optimizer steps "
+         "(0 = epoch boundaries only)."),
+    # --- launch / supervision ----------------------------------------------
+    Knob("HVT_HEARTBEAT_DIR", "path", None, "launch",
+         "Per-rank liveness dir (supervisor-set); fit() auto-installs "
+         "HeartbeatCallback when present."),
+    Knob("HVT_RESTART_LOG_MAX_LINES", "int", 100000, "launch",
+         "Restart-journal rotation bound in lines (0 disables)."),
+    Knob("HVT_RESTART_LOG_MAX_MB", "float", 64.0, "launch",
+         "Restart-journal rotation bound in MB (0 disables)."),
+    Knob("HVT_STATUS_HOST", "str", "127.0.0.1", "launch",
+         "Bind host for the supervisor status endpoint (`--status-port`); "
+         "loopback by default — set 0.0.0.0 to expose off-host."),
+    # --- data --------------------------------------------------------------
+    Knob("HVT_NO_NATIVE", "flag", False, "data",
+         "Disable the native C++ loader; fall back to the pure-python "
+         "feeding path."),
+    Knob("HVT_DATA_DIR", "path", "~/.cache/horovod_tpu", "data",
+         "Dataset cache directory (the keras-layout npz archives)."),
+    # --- observability ------------------------------------------------------
+    Knob("HVT_PROFILE", "path", None, "observability",
+         "Capture a jax.profiler trace of fit()/bench into this dir — the "
+         "HOROVOD_TIMELINE contract, primary-process-gated."),
+    Knob("HVT_METRICS_DIR", "path", None, "observability",
+         "Metrics-stream directory (default: $PS_MODEL_PATH, else "
+         "./models)."),
+    # --- testing / chaos ----------------------------------------------------
+    Knob("HVT_FAULT", "spec", None, "testing",
+         "Deterministic fault injection, `rank:epoch[.step]:kind` (kinds "
+         "kill/exitN/hang/leave/corrupt[@target])."),
+    Knob("HVT_FAULT_STAMP", "path", None, "testing",
+         "One-shot stamp file: the fault fires once, never while the "
+         "stamp exists — across relaunches."),
+    # --- examples / bench (read by entry scripts, not the package) ----------
+    Knob("HVT_BACKWARD_PASSES", "int", 1, "examples",
+         "Gradient-accumulation factor K for the example entry scripts "
+         "(DistributedOptimizer backward_passes_per_step)."),
+    Knob("HVT_DEVICE_CACHE", "flag", False, "examples",
+         "Examples: stage the dataset into HBM once (`cache='device'`)."),
+    Knob("HVT_EXPORT_FORMAT", "str", "stablehlo", "examples",
+         "Examples: serving-bundle export format (stablehlo/savedmodel)."),
+])
+
+
+class UnknownKnobError(KeyError):
+    """An env knob was read that is not declared in this registry."""
+
+    def __init__(self, name: str):
+        super().__init__(
+            f"{name} is not a declared HVT_* knob — add a Knob row to "
+            "horovod_tpu/analysis/registry.py (type, default, subsystem, "
+            "description) and regenerate docs/ENVVARS.md"
+        )
+
+
+def knob(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise UnknownKnobError(name) from None
+
+
+def is_registered(name: str) -> bool:
+    return name in KNOBS
+
+
+def flag_like(value: str | None) -> bool:
+    """The shared boolean env contract (see module docstring)."""
+    return (value or "").lower() not in ("", "0", "false", "no")
+
+
+def get_raw(name: str, *, environ=None) -> str | None:
+    """The raw string value, or None when unset/empty. The name must be
+    registered — this is the choke point HVT004 pushes every read through."""
+    k = knob(name)
+    env = os.environ if environ is None else environ
+    raw = env.get(k.name, "")
+    return raw if raw != "" else None
+
+
+def get_str(name: str, *, environ=None) -> str | None:
+    raw = get_raw(name, environ=environ)
+    return raw if raw is not None else knob(name).default
+
+
+def get_int(name: str, *, environ=None) -> int | None:
+    raw = get_raw(name, environ=environ)
+    if raw is None:
+        d = knob(name).default
+        return None if d is None else int(d)
+    return int(raw)
+
+
+def get_float(name: str, *, environ=None) -> float | None:
+    raw = get_raw(name, environ=environ)
+    if raw is None:
+        d = knob(name).default
+        return None if d is None else float(d)
+    return float(raw)
+
+
+def get_flag(name: str, *, environ=None) -> bool:
+    k = knob(name)
+    raw = get_raw(name, environ=environ)
+    return bool(k.default) if raw is None else flag_like(raw)
+
+
+# --- generated reference doc (docs/ENVVARS.md) ------------------------------
+
+_DOC_HEADER = """\
+# `HVT_*` environment variables
+
+<!-- GENERATED FILE — do not edit by hand.
+     Source of truth: horovod_tpu/analysis/registry.py.
+     Regenerate: python -m horovod_tpu.analysis.registry > docs/ENVVARS.md
+     (tests/test_lint_clean.py fails when this file drifts). -->
+
+Every knob the framework reads, from the central registry
+(`horovod_tpu/analysis/registry.py`). Contract, uniform across all knobs:
+**unset and empty-string are equivalent** (the default applies); `flag`
+knobs treat `''`/`0`/`false`/`no` (case-insensitive) as off and anything
+else as on. The static analyzer (`hvt-lint`, rule HVT004) rejects any
+`HVT_*` read in the package that is not declared in the registry.
+
+`PS_MODEL_PATH` (not `HVT_`-prefixed — inherited from the reference
+stack) is the checkpoint/metrics root many defaults hang off; it is
+documented where used rather than registered here.
+"""
+
+
+def _fmt_default(k: Knob) -> str:
+    if k.default is None:
+        return "—"
+    if k.type == "flag":
+        return "on" if k.default else "off"
+    return f"`{k.default}`"
+
+
+def generate_doc() -> str:
+    """Render the ENVVARS.md content. Deterministic: grouped by subsystem
+    in `_SUBSYSTEM_ORDER`, name-sorted within a group."""
+    parts = [_DOC_HEADER]
+    for sub in _SUBSYSTEM_ORDER:
+        group = sorted(
+            (k for k in KNOBS.values() if k.subsystem == sub),
+            key=lambda k: k.name,
+        )
+        if not group:
+            continue
+        parts.append(f"\n## {sub}\n")
+        parts.append("| name | type | default | description |")
+        parts.append("|---|---|---|---|")
+        for k in group:
+            parts.append(
+                f"| `{k.name}` | {k.type} | {_fmt_default(k)} "
+                f"| {k.description} |"
+            )
+    return "\n".join(parts) + "\n"
+
+
+if __name__ == "__main__":
+    print(generate_doc(), end="")

@@ -1,0 +1,470 @@
+"""The distributed-correctness rules `hvt-lint` ships.
+
+Each rule encodes an invariant this repo has actually been bitten by (or
+designed around, loudly, in CHANGES.md/docstrings) — not generic style:
+
+* HVT001 — collective symmetry: a collective/barrier reached only under
+  rank-conditional control flow is the classic Horovod hang class
+  (arXiv:1802.05799): the gated ranks never enter, the rest block
+  forever (or the coordination service SIGABRTs them).
+* HVT002 — teardown discipline: `jax.distributed.shutdown` is a BARRIER
+  on this stack; one-sided teardown kills survivors (PR 2). Only the
+  sanctioned runtime/elastic boundary modules may touch it directly.
+* HVT003 — tracing hazards: host side effects inside jit/scan/shard_map
+  functions execute once at trace time (or diverge per-rank) — the
+  silent-divergence class.
+* HVT004 — env-knob registry: every ``HVT_*`` knob must be declared in
+  `analysis/registry.py`, and inline ``os.environ`` reads must go
+  through the typed accessors.
+* HVT005 — checkpoint-write atomicity: artifact writes go through
+  `checkpoint._atomic_write` (atomic rename + ``.sha256`` sidecar); a
+  bare truncating ``open`` can tear under crash/preemption (PR 3).
+
+Heuristics are lexical by design (no dataflow): a collective gated by an
+early ``return`` under a rank check, or a rank value laundered through a
+local variable, is NOT caught. The rules catch the shapes that actually
+appear; the suppressions (``# hvt: noqa[RULE]``, baseline) keep the
+false-positive cost at zero.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.analysis.core import (
+    Finding,
+    ModuleSource,
+    Rule,
+    dotted_name,
+    register_rule,
+    resolved_dotted,
+    terminal_name,
+)
+
+# --- shared: rank-condition detection ---------------------------------------
+
+# Topology queries whose result gates single-writer code paths. Both the
+# call forms (`runtime.rank()`, `jax.process_index()`, `hvt.is_primary()`)
+# and the attribute forms (`world.process_rank`) count.
+_RANK_CALLS = {"rank", "process_rank", "process_index", "local_rank",
+               "is_primary"}
+_RANK_ATTRS = {"process_rank", "process_index", "local_rank", "is_primary"}
+
+
+def _is_rank_gated(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = terminal_name(node.func)
+            if name in _RANK_CALLS:
+                return True
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            if node.attr in _RANK_ATTRS:
+                return True
+    return False
+
+
+# --- HVT001 -----------------------------------------------------------------
+
+# Collective/barrier operations that every rank of the world must issue
+# together, matched by terminal callee name regardless of qualification.
+_COLLECTIVES_ANY = {
+    "psum", "psum_scatter", "pmean", "hierarchical_psum",
+    "allreduce", "allgather", "all_gather", "broadcast",
+    "broadcast_object", "allgather_object", "broadcast_pytree",
+    "pmean_pytree", "reduce_gradients", "barrier", "wait_at_barrier",
+    "sync_global_devices",
+}
+# Operations matched only when qualified, to dodge same-name methods on
+# unrelated objects (`httpd.shutdown()`, `os.sync()`):
+#   runtime.shutdown / runtime.reinit (also bare, via the import map) are
+#   world-teardown barriers; `<...>.state.sync` / `ElasticState.sync` is
+#   the elastic state collective.
+_QUALIFIED = {
+    "shutdown": {"runtime", "hvt", "horovod_tpu"},
+    "reinit": {"runtime", "hvt", "horovod_tpu"},
+    "sync": {"state", "elastic_state", "ElasticState"},
+}
+
+
+def _collective_name(module: ModuleSource, call: ast.Call) -> str | None:
+    name = terminal_name(call.func)
+    if name is None:
+        return None
+    if name in _COLLECTIVES_ANY:
+        return dotted_name(call.func) or name
+    if name in _QUALIFIED:
+        resolved = resolved_dotted(module, call.func) or name
+        segments = resolved.split(".")
+        if len(segments) == 1 or segments[-2] in _QUALIFIED[name]:
+            return dotted_name(call.func) or name
+    return None
+
+
+@register_rule
+class CollectiveSymmetry(Rule):
+    rule_id = "HVT001"
+    title = "collective reachable only under rank-conditional control flow"
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        findings: list[Finding] = []
+
+        def visit(node: ast.AST, gate: tuple[int, str] | None):
+            if isinstance(node, ast.Call):
+                name = _collective_name(module, node)
+                if name is not None and gate is not None:
+                    line, cond = gate
+                    findings.append(module.finding(
+                        self.rule_id, node,
+                        f"collective/barrier `{name}` is reached only "
+                        f"under rank-conditional control flow (gated at "
+                        f"line {line}: `{cond}`) — ranks outside the "
+                        "branch never issue it, and the others hang in "
+                        "it (the Horovod one-sided-collective class); "
+                        "hoist the collective out of the rank gate",
+                    ))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, gate)
+                return
+            if isinstance(node, (ast.If, ast.While)):
+                branch_gate = gate
+                if _is_rank_gated(node.test):
+                    branch_gate = (node.lineno, module.line_at(node.lineno))
+                visit(node.test, gate)
+                for child in node.body:
+                    visit(child, branch_gate)
+                for child in node.orelse:
+                    visit(child, branch_gate)
+                return
+            if isinstance(node, ast.IfExp):
+                branch_gate = gate
+                if _is_rank_gated(node.test):
+                    branch_gate = (node.lineno, module.line_at(node.lineno))
+                visit(node.test, gate)
+                visit(node.body, branch_gate)
+                visit(node.orelse, branch_gate)
+                return
+            if isinstance(node, ast.BoolOp):
+                # `rank() == 0 and collective()`: operands after a
+                # rank-gated one are short-circuit-conditional on it.
+                seen_gate = gate
+                for value in node.values:
+                    visit(value, seen_gate)
+                    if seen_gate is None and _is_rank_gated(value):
+                        seen_gate = (
+                            node.lineno, module.line_at(node.lineno)
+                        )
+                return
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                # New execution scope: a def/lambda under a rank gate is
+                # conditionally DEFINED, not conditionally executed —
+                # tracking call sites needs dataflow this linter
+                # deliberately doesn't do.
+                for child in ast.iter_child_nodes(node):
+                    visit(child, None)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, gate)
+
+        visit(module.tree, None)
+        return iter(findings)
+
+
+# --- HVT002 -----------------------------------------------------------------
+
+# The only modules allowed to touch the raw teardown primitives: the
+# runtime owns the shutdown barrier, compat implements it, and the two
+# elastic modules run the sanctioned `_teardown_and_interrupt` /
+# `ensure_world` boundaries where lockstep is guaranteed by the
+# membership agreement.
+_SANCTIONED_TEARDOWN_MODULES = (
+    "horovod_tpu/runtime.py",
+    "horovod_tpu/compat.py",
+    "horovod_tpu/elastic/rescale.py",
+    "horovod_tpu/elastic/state.py",
+)
+
+
+@register_rule
+class TeardownDiscipline(Rule):
+    rule_id = "HVT002"
+    title = "raw distributed teardown outside the sanctioned boundary"
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        if module.relpath in _SANCTIONED_TEARDOWN_MODULES:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolved_dotted(module, node.func)
+            if resolved is None:
+                continue
+            if resolved.endswith("jax.distributed.shutdown"):
+                target = "jax.distributed.shutdown"
+            elif resolved.split(".")[-1] == "clear_backends":
+                target = resolved
+            else:
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                f"direct `{target}` call — the distributed teardown is a "
+                "BARRIER (one-sided teardown SIGABRTs the survivors); "
+                "call `runtime.shutdown()`/`runtime.reinit()` or go "
+                "through the elastic membership boundary "
+                "(`_teardown_and_interrupt`), which guarantee lockstep",
+            )
+
+
+# --- HVT003 -----------------------------------------------------------------
+
+_TRACE_WRAPPERS = {"jit", "pjit", "shard_map"}
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    for node in ast.walk(dec):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if terminal_name(node) in _TRACE_WRAPPERS:
+                return True
+    return False
+
+
+def _collect_traced_roots(module: ModuleSource) -> list[ast.AST]:
+    """Function bodies that run under a jax trace: defs decorated with
+    jit/pjit/shard_map (incl. through `partial`), and functions/lambdas
+    handed to `jax.jit(f)` / `shard_map(f, ...)` / `lax.scan(f, ...)`."""
+    defs_by_name: dict[str, ast.AST] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name[node.name] = node
+
+    roots: list[ast.AST] = []
+    seen: set[int] = set()
+
+    def add(node: ast.AST):
+        if id(node) not in seen:
+            seen.add(id(node))
+            roots.append(node)
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_traces(d) for d in node.decorator_list):
+                add(node)
+        elif isinstance(node, ast.Call):
+            name = terminal_name(node.func)
+            is_wrapper = name in _TRACE_WRAPPERS
+            if not is_wrapper and name == "scan":
+                resolved = resolved_dotted(module, node.func) or ""
+                is_wrapper = resolved.endswith("lax.scan")
+            if not is_wrapper or not node.args:
+                continue
+            fn = node.args[0]
+            if isinstance(fn, ast.Lambda):
+                add(fn)
+            elif isinstance(fn, ast.Name) and fn.id in defs_by_name:
+                add(defs_by_name[fn.id])
+    return roots
+
+
+@register_rule
+class TracingHazards(Rule):
+    rule_id = "HVT003"
+    title = "host side effect inside a traced (jit/scan/shard_map) function"
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        reported: set[tuple[int, int]] = set()
+        for root in _collect_traced_roots(module):
+            body = root.body if isinstance(root.body, list) else [root.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    finding = self._hazard(module, node)
+                    if finding and (finding.line, finding.col) not in reported:
+                        reported.add((finding.line, finding.col))
+                        yield finding
+
+    def _hazard(self, module: ModuleSource, node: ast.AST) -> Finding | None:
+        if isinstance(node, ast.Call):
+            resolved = resolved_dotted(module, node.func)
+            if resolved is not None:
+                if resolved.startswith("time."):
+                    return module.finding(
+                        self.rule_id, node,
+                        f"`{resolved}` inside a traced function reads the "
+                        "host clock ONCE at trace time (a constant "
+                        "thereafter) — and any rank-varying value "
+                        "silently diverges the compiled program; compute "
+                        "timestamps outside the traced region",
+                    )
+                if resolved.startswith(("random.", "numpy.random.")):
+                    return module.finding(
+                        self.rule_id, node,
+                        f"seed-free `{resolved}` inside a traced function "
+                        "draws per-rank host randomness at trace time — "
+                        "the silent-divergence class; thread a "
+                        "`jax.random` key through the function instead",
+                    )
+                if resolved == "os.getenv":
+                    return module.finding(
+                        self.rule_id, node,
+                        "`os.getenv` inside a traced function is read "
+                        "once at trace time and may differ across ranks; "
+                        "resolve knobs outside the traced region",
+                    )
+            if isinstance(node.func, ast.Name) and node.func.id in (
+                "print", "open", "input"
+            ):
+                return module.finding(
+                    self.rule_id, node,
+                    f"host side effect `{node.func.id}(...)` inside a "
+                    "traced function runs at TRACE time, not per step — "
+                    "use `jax.debug.print`/`io_callback`, or hoist it "
+                    "out of the traced region",
+                )
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            if (
+                node.attr == "environ"
+                and resolved_dotted(module, node) == "os.environ"
+            ):
+                return module.finding(
+                    self.rule_id, node,
+                    "`os.environ` read inside a traced function is "
+                    "evaluated once at trace time and may differ across "
+                    "ranks; resolve knobs outside the traced region",
+                )
+        return None
+
+
+# --- HVT004 -----------------------------------------------------------------
+
+_KNOB_RE = re.compile(r"^HVT_[A-Z0-9_]+$")
+
+
+@register_rule
+class EnvKnobRegistry(Rule):
+    rule_id = "HVT004"
+    title = "HVT_* env knob not declared in analysis/registry.py"
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if _KNOB_RE.match(node.value) and not registry.is_registered(
+                    node.value
+                ):
+                    yield module.finding(
+                        self.rule_id, node,
+                        f"`{node.value}` is not declared in "
+                        "horovod_tpu/analysis/registry.py — add a Knob "
+                        "row (type, default, subsystem, description) and "
+                        "regenerate docs/ENVVARS.md, so the knob surface "
+                        "can't drift",
+                    )
+            elif isinstance(node, ast.Call):
+                key = self._env_read_key(module, node)
+                if key is not None:
+                    yield module.finding(
+                        self.rule_id, node,
+                        f"inline `os.environ` read of `{key}` — go "
+                        "through the typed registry accessors "
+                        "(`horovod_tpu.analysis.registry.get_*`), which "
+                        "carry the declared default and the "
+                        "empty-string-is-unset contract",
+                    )
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if (
+                    resolved_dotted(module, node.value) == "os.environ"
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and _KNOB_RE.match(node.slice.value)
+                ):
+                    yield module.finding(
+                        self.rule_id, node,
+                        f"inline `os.environ[{node.slice.value!r}]` read "
+                        "— go through the typed registry accessors "
+                        "(`horovod_tpu.analysis.registry.get_*`)",
+                    )
+
+    @staticmethod
+    def _env_read_key(module: ModuleSource, call: ast.Call) -> str | None:
+        resolved = resolved_dotted(module, call.func)
+        if resolved not in ("os.environ.get", "os.getenv"):
+            return None
+        if not call.args:
+            return None
+        key = call.args[0]
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            if _KNOB_RE.match(key.value):
+                return key.value
+        return None
+
+
+# --- HVT005 -----------------------------------------------------------------
+
+# The one function allowed to open artifact files for writing: it owns the
+# tmp-name + os.replace + .sha256-sidecar discipline every checkpoint
+# consumer (discovery, restore, elastic reassembly) verifies against.
+_SANCTIONED_WRITERS = {"_atomic_write"}
+
+_WRITE_MODES = ("w", "x", "+")
+
+
+@register_rule
+class CheckpointWriteAtomicity(Rule):
+    rule_id = "HVT005"
+    title = "truncating file write outside the atomic-write helper"
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        for writer, node in self._truncating_opens(module.tree):
+            if writer in _SANCTIONED_WRITERS:
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                "truncating `open(..., 'w')` outside "
+                "`checkpoint._atomic_write` — a crash/preemption "
+                "mid-write tears the file, and checkpoint artifacts "
+                "additionally need the `.sha256` sidecar that discovery "
+                "and restore verify; route artifact writes through "
+                "`checkpoint._atomic_write`/`save*` (non-artifact "
+                "writes: suppress with `# hvt: noqa[HVT005]` and say "
+                "why)",
+            )
+
+    @staticmethod
+    def _truncating_opens(tree: ast.AST):
+        """(enclosing function name, call node) for each truncating open."""
+
+        def walk(node: ast.AST, fn_name: str | None):
+            for child in ast.iter_child_nodes(node):
+                child_fn = fn_name
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    child_fn = child.name
+                if isinstance(child, ast.Call) and isinstance(
+                    child.func, ast.Name
+                ) and child.func.id == "open":
+                    mode = None
+                    if len(child.args) >= 2:
+                        mode = child.args[1]
+                    for kw in child.keywords:
+                        if kw.arg == "mode":
+                            mode = kw.value
+                    if (
+                        isinstance(mode, ast.Constant)
+                        and isinstance(mode.value, str)
+                        and any(c in mode.value for c in _WRITE_MODES)
+                    ):
+                        yield (fn_name, child)
+                yield from walk(child, child_fn)
+
+        yield from walk(tree, None)

@@ -979,12 +979,18 @@ def export_serving(
     os.makedirs(out_dir, exist_ok=True)
     spec = jax.ShapeDtypeStruct(input_shape, input_dtype)
     exported = jax_export.export(jax.jit(predict))(spec)
-    with open(os.path.join(out_dir, GRAPH_FILE), "wb") as f:
-        f.write(exported.serialize())
-    with open(os.path.join(out_dir, WEIGHTS_FILE), "wb") as f:
-        f.write(serialization.to_bytes(params))
-    with open(os.path.join(out_dir, SIGNATURE_FILE), "w") as f:
-        json.dump(
+    # Atomic + digested like every other artifact: a preemption mid-export
+    # must not leave a torn bundle that serve_forever then loads.
+    _atomic_write(
+        os.path.join(out_dir, GRAPH_FILE), exported.serialize(), digest=True
+    )
+    _atomic_write(
+        os.path.join(out_dir, WEIGHTS_FILE), serialization.to_bytes(params),
+        digest=True,
+    )
+    _atomic_write(
+        os.path.join(out_dir, SIGNATURE_FILE),
+        json.dumps(
             {
                 "signature": {"inputs": {"input": {"shape": list(input_shape),
                                                    "dtype": np.dtype(input_dtype).name}},
@@ -992,9 +998,10 @@ def export_serving(
                 "format": "stablehlo+msgpack",
                 "created": stamp,
             },
-            f,
             indent=2,
-        )
+        ).encode(),
+        digest=True,
+    )
     return out_dir
 
 

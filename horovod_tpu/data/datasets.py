@@ -30,6 +30,8 @@ import os
 
 import numpy as np
 
+from horovod_tpu.analysis import registry
+
 # 5x7 bitmap font for digits 0-9 (rows top→bottom, 5 bits per row).
 _DIGIT_FONT = {
     0: ["01110", "10001", "10011", "10101", "11001", "10001", "01110"],
@@ -78,8 +80,8 @@ def _load_or_create(path: str, cache_dir: str | None, synthesize):
     """Shared cache contract: read the keras-layout npz if present, else
     materialize via ``synthesize() -> ((xtr, ytr), (xte, yte))`` with an
     atomic rename (no torn files under concurrent writers)."""
-    cache_dir = cache_dir or os.environ.get(
-        "HVT_DATA_DIR", os.path.expanduser("~/.cache/horovod_tpu")
+    cache_dir = cache_dir or os.path.expanduser(
+        registry.get_str("HVT_DATA_DIR")
     )
     full = path if os.path.isabs(path) else os.path.join(cache_dir, path)
     if os.path.exists(full):

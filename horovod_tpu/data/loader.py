@@ -23,6 +23,8 @@ from typing import Iterator, Sequence
 import jax.tree_util
 import numpy as np
 
+from horovod_tpu.analysis import registry
+
 
 class ArrayDataset:
     """An in-memory dataset of parallel arrays with chained transforms.
@@ -253,7 +255,7 @@ def training_pipeline(
 
     n = len(arrays[0])
     full_shuffle = shuffle_buffer is None or shuffle_buffer >= n
-    if full_shuffle and not os.environ.get("HVT_NO_NATIVE"):
+    if full_shuffle and not registry.get_flag("HVT_NO_NATIVE"):
         from horovod_tpu.data import native_loader
 
         if native_loader.available() and batch_size <= n:

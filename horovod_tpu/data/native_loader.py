@@ -30,6 +30,8 @@ from typing import Sequence
 
 import numpy as np
 
+from horovod_tpu.analysis import registry
+
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
@@ -49,7 +51,7 @@ def _load():
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if os.environ.get("HVT_NO_NATIVE"):
+        if registry.get_flag("HVT_NO_NATIVE"):
             _load_failed = True
             return None
         # Always run make (a no-op when up to date) so the Makefile's source

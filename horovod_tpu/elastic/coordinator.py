@@ -586,8 +586,9 @@ class ElasticClient:
         timeout: float = 300.0,
     ):
         from horovod_tpu import runtime
+        from horovod_tpu.analysis import registry
 
-        address = address or os.environ.get(runtime.ENV_ELASTIC_COORDINATOR)
+        address = address or registry.get_str(runtime.ENV_ELASTIC_COORDINATOR)
         if not address:
             raise ValueError(
                 "no coordinator address — pass address= or export "
@@ -597,7 +598,7 @@ class ElasticClient:
         self.coord_port = int(port_s)
         self.member_id = (
             member_id
-            or os.environ.get(runtime.ENV_ELASTIC_MEMBER)
+            or registry.get_str(runtime.ENV_ELASTIC_MEMBER)
             or f"{socket.gethostname()}-{os.getpid()}"
         )
         # The address peers use to dial THIS member's jax coordinator when

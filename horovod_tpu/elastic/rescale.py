@@ -117,4 +117,6 @@ def run(
 
 def member_id_from_env() -> str | None:
     """The supervisor-assigned member identity, if launched elastically."""
-    return os.environ.get(runtime.ENV_ELASTIC_MEMBER)
+    from horovod_tpu.analysis import registry
+
+    return registry.get_str(runtime.ENV_ELASTIC_MEMBER)

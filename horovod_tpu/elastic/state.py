@@ -512,22 +512,18 @@ class ElasticStateCallback(Callback):
                  commit_every_steps: int | None = None,
                  rescale_every_steps: int | None = None,
                  beat_interval: float = 1.0):
-        import os
+        from horovod_tpu.analysis import registry
 
         self.state = state
         self.client = client
         if commit_every is None:
-            commit_every = int(os.environ.get("HVT_COMMIT_EVERY", 1) or 1)
+            commit_every = registry.get_int("HVT_COMMIT_EVERY")
         self.commit_every = max(1, int(commit_every))
         if commit_every_steps is None:
-            commit_every_steps = int(
-                os.environ.get("HVT_COMMIT_EVERY_STEPS", 0) or 0
-            )
+            commit_every_steps = registry.get_int("HVT_COMMIT_EVERY_STEPS")
         self.commit_every_steps = max(0, int(commit_every_steps))
         if rescale_every_steps is None:
-            rescale_every_steps = int(
-                os.environ.get("HVT_RESCALE_EVERY_STEPS", 0) or 0
-            )
+            rescale_every_steps = registry.get_int("HVT_RESCALE_EVERY_STEPS")
         self.rescale_every_steps = max(0, int(rescale_every_steps))
         self.beat_interval = beat_interval
         self._last_beat = 0.0

@@ -257,6 +257,15 @@ def main(argv: list[str] | None = None) -> int:
         split = argv.index("--")
         argv, command = argv[:split], argv[split + 1 :]
 
+    if argv and argv[0] == "lint":
+        # Delegate the whole tail to the analyzer CLI before argparse sees
+        # it (its flags are not ours; exit codes 0/1/2 are the pre-commit
+        # contract). Restore a `--`-split tail — hvt-lint has no trailing
+        # command but argparse treats `--` as an inert separator.
+        from horovod_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:] + (["--"] + command if command else []))
+
     parser = argparse.ArgumentParser(prog="python -m horovod_tpu.launch")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -334,6 +343,12 @@ def main(argv: list[str] | None = None) -> int:
 
     p_job = sub.add_parser("job", help="run a YAML job spec")
     p_job.add_argument("spec")
+
+    # Handled above, declared here so `--help` lists it.
+    sub.add_parser(
+        "lint",
+        help="hvt-lint: distributed-correctness static analysis "
+        "(see `hvt-lint --help`)")
 
     args = parser.parse_args(argv)
     if args.cmd in ("run", "pod") and not command:

@@ -54,6 +54,7 @@ import signal
 import tempfile
 import time
 
+from horovod_tpu.analysis import registry
 from horovod_tpu.launch import launcher
 from horovod_tpu.runtime import ENV_HEARTBEAT_DIR
 
@@ -269,13 +270,11 @@ class RestartLog:
                  max_bytes: int | None = None):
         self.path = path
         if max_lines is None:
-            max_lines = int(os.environ.get(
-                "HVT_RESTART_LOG_MAX_LINES", "100000"
-            ))
+            max_lines = registry.get_int("HVT_RESTART_LOG_MAX_LINES")
         if max_bytes is None:
-            max_bytes = int(float(os.environ.get(
-                "HVT_RESTART_LOG_MAX_MB", "64"
-            )) * 1024 * 1024)
+            max_bytes = int(
+                registry.get_float("HVT_RESTART_LOG_MAX_MB") * 1024 * 1024
+            )
         self.max_lines = max_lines or None
         self.max_bytes = max_bytes or None
         self._lines: int | None = None  # counted lazily on first write
@@ -1001,7 +1000,7 @@ def start_status_server(port: int, log_path: str | None, coord=None,
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     if host is None:
-        host = os.environ.get("HVT_STATUS_HOST") or "127.0.0.1"
+        host = registry.get_str("HVT_STATUS_HOST")
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):

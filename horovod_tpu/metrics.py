@@ -16,6 +16,7 @@ import time
 from typing import Protocol
 
 from horovod_tpu import runtime
+from horovod_tpu.analysis import registry
 
 
 class MetricsSink(Protocol):
@@ -76,7 +77,8 @@ def init(sync_tensorboard: bool = False, path: str | None = None) -> None:
     _sink = None
     _sync_tensorboard = bool(sync_tensorboard)
     _configured_path = path or os.path.join(
-        os.environ.get("HVT_METRICS_DIR", os.environ.get("PS_MODEL_PATH", "./models")),
+        registry.get_str("HVT_METRICS_DIR")
+        or os.environ.get("PS_MODEL_PATH", "./models"),
         "metrics.jsonl",
     )
 

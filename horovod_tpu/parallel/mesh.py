@@ -26,6 +26,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from horovod_tpu.analysis import registry
+
 # Canonical axis order, outermost (slowest, DCN-adjacent) first. Data/fsdp
 # outermost so cross-host traffic is the infrequent gradient reduction;
 # pipe next (stage handoffs are point-to-point, once per microbatch tick,
@@ -137,7 +139,7 @@ def _device_array(devices: np.ndarray, shape: tuple, order: str | None = None):
     meaningless and tests rely on enumeration order), or with
     ``order='flat'``.
     """
-    order = order or os.environ.get("HVT_MESH_ORDER", "auto")
+    order = order or registry.get_str("HVT_MESH_ORDER")
     if order not in ("auto", "flat"):
         raise ValueError(
             f"HVT_MESH_ORDER must be 'auto' or 'flat', got {order!r}"
@@ -224,9 +226,8 @@ def dcn_factor(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     knob for benchmarking the two-hop path on single-slice hardware (and
     for tests, where CPU devices carry no slice_index)."""
     size = mesh.shape[axis]
-    env = os.environ.get("HVT_DCN_FACTOR")
-    if env:
-        dcn = int(env)
+    dcn = registry.get_int("HVT_DCN_FACTOR")
+    if dcn is not None:
         if dcn < 1 or size % dcn != 0:
             raise ValueError(
                 f"HVT_DCN_FACTOR={dcn} must divide the {axis!r} axis size "

@@ -44,6 +44,8 @@ import socket
 
 import jax
 
+from horovod_tpu.analysis import registry
+
 # Environment variables understood by init(), mirroring the role of
 # mpirun's `-x` env propagation + /generated/hostfile (README.md:57).
 ENV_COORDINATOR = "HVT_COORDINATOR_ADDRESS"
@@ -76,8 +78,9 @@ _initialized = False
 def env_flag(name: str) -> bool:
     """Shared boolean env-var contract: unset/''/'0'/'false'/'no' are off
     (case-insensitive), anything else is on. Used for every HVT_* switch so
-    the accepted spellings can't drift between call sites."""
-    return os.environ.get(name, "").lower() not in ("", "0", "false", "no")
+    the accepted spellings can't drift between call sites — the contract
+    itself lives in `analysis.registry.flag_like` (the knob registry)."""
+    return registry.flag_like(os.environ.get(name))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,10 +121,10 @@ def init(
     if _initialized:
         return world()
 
-    if os.environ.get(ENV_PLATFORM):
-        jax.config.update("jax_platforms", os.environ[ENV_PLATFORM])
-    if os.environ.get(ENV_NUM_CPU_DEVICES):
-        n_cpu = int(os.environ[ENV_NUM_CPU_DEVICES])
+    if registry.get_str(ENV_PLATFORM):
+        jax.config.update("jax_platforms", registry.get_str(ENV_PLATFORM))
+    n_cpu = registry.get_int(ENV_NUM_CPU_DEVICES)
+    if n_cpu is not None:
         try:
             jax.config.update("jax_num_cpu_devices", n_cpu)
         except AttributeError:
@@ -151,11 +154,11 @@ def init(
         # bit-reproducible across topologies the way threefry is.
         jax.config.update("jax_default_prng_impl", "rbg")
 
-    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
-    if num_processes is None and os.environ.get(ENV_NUM_PROCESSES):
-        num_processes = int(os.environ[ENV_NUM_PROCESSES])
-    if process_id is None and os.environ.get(ENV_PROCESS_ID):
-        process_id = int(os.environ[ENV_PROCESS_ID])
+    coordinator_address = coordinator_address or registry.get_str(ENV_COORDINATOR)
+    if num_processes is None:
+        num_processes = registry.get_int(ENV_NUM_PROCESSES)
+    if process_id is None:
+        process_id = registry.get_int(ENV_PROCESS_ID)
 
     if coordinator_address is not None:
         # Multi-process on the CPU *platform* (the launched test mode,
@@ -163,7 +166,7 @@ def init(
         # backend on jax versions where it isn't the default. Must land
         # before backend init — true here, init() precedes any device use.
         platform_hint = (
-            os.environ.get(ENV_PLATFORM)
+            registry.get_str(ENV_PLATFORM)
             or os.environ.get("JAX_PLATFORMS", "")
         )
         if "cpu" in platform_hint:
@@ -273,7 +276,7 @@ def local_rank() -> int:
     0 in the standard one-process-per-host deployment; launchers that place
     several processes on one host set HVT_LOCAL_RANK. Note the reference uses
     this only for GPU pinning (mnist_keras.py:35), which has no TPU analogue."""
-    return int(os.environ.get(ENV_LOCAL_RANK, "0"))
+    return registry.get_int(ENV_LOCAL_RANK)
 
 
 def local_size() -> int:

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from horovod_tpu import runtime
+from horovod_tpu.analysis import registry
 
 # Peak dense-matmul throughput per chip, FLOP/s. bf16 peaks from the public
 # TPU spec sheets; fp32 on TPU runs through the same MXU passes (bf16x3) so
@@ -128,7 +129,7 @@ def mfu(flops_per_step: float | None, step_time_s: float, n_chips: int = 1,
 
 def profile_dir() -> str | None:
     """The `HVT_PROFILE` target directory, or None when profiling is off."""
-    return os.environ.get("HVT_PROFILE") or None
+    return registry.get_str("HVT_PROFILE")
 
 
 @contextlib.contextmanager

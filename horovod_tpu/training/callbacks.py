@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from horovod_tpu import runtime
+from horovod_tpu.analysis import registry
 from horovod_tpu.parallel import collectives, sharding
 
 
@@ -278,9 +279,7 @@ class ModelCheckpoint(Callback):
         self.filepath = filepath
         self.async_save = async_save
         if save_every_steps is None:
-            save_every_steps = int(
-                os.environ.get("HVT_SAVE_EVERY_STEPS", 0) or 0
-            )
+            save_every_steps = registry.get_int("HVT_SAVE_EVERY_STEPS")
         self.save_every_steps = max(0, int(save_every_steps))
         self._pending = None
         self._epoch = 0
@@ -601,10 +600,10 @@ def env_callbacks() -> list:
       `testing.faults.FaultInjectionCallback`
     """
     out: list = []
-    hb_dir = os.environ.get(runtime.ENV_HEARTBEAT_DIR)
+    hb_dir = registry.get_str(runtime.ENV_HEARTBEAT_DIR)
     if hb_dir:
         out.append(HeartbeatCallback(hb_dir))
-    if os.environ.get("HVT_FAULT"):
+    if registry.get_str("HVT_FAULT"):
         from horovod_tpu.testing.faults import FaultInjectionCallback
 
         out.append(FaultInjectionCallback.from_env())

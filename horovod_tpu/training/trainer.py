@@ -24,6 +24,7 @@ import flax.struct
 import jax
 
 from horovod_tpu import compat
+from horovod_tpu.analysis import registry
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -165,7 +166,7 @@ class Trainer:
         # bytes, instead of one collective per leaf.
         self._bucket_bytes = int(
             bucket_bytes
-            or os.environ.get("HVT_BUCKET_BYTES")
+            or registry.get_int("HVT_BUCKET_BYTES")
             or collectives.DEFAULT_BUCKET_BYTES
         )
         # Multi-slice factor of the data axis (1 on single-slice meshes):

@@ -569,3 +569,86 @@ class TestDistributedLaunch:
         )
         assert code == 0
         assert (tmp_path / "ok-0").exists() and (tmp_path / "ok-1").exists()
+
+
+@pytest.mark.slow
+class TestJobStatusPortE2E:
+    def test_mnist_ci_2proc_serves_status_and_journal(self, tmp_path):
+        """The real mnist-ci-2proc.yaml spec with `status_port:` set (the
+        ROADMAP item PR 5 left open): while the supervised 2-proc run is
+        live, the supervisor's own HTTP endpoint answers GET /status with
+        the fleet summary and GET /journal with the restart journal —
+        operator probes need no serving bundle. Budget shrunk to CPU-test
+        size; the convergence gate is mnist-ci-2proc's own job, not this
+        test's."""
+        import socket
+        import threading
+        import urllib.request
+
+        import yaml
+
+        with open(os.path.join(
+            REPO, "horovod_tpu", "launch", "jobs", "mnist-ci-2proc.yaml"
+        )) as f:
+            spec = yaml.safe_load(f)
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        model_dir = str(tmp_path / "models")
+        spec["job"]["status_port"] = port
+        # Absolute entry-script path: run_job launches from the test's cwd.
+        spec["job"]["command"] = (
+            f"{sys.executable} {os.path.join(REPO, 'examples', 'tf2_style_mnist.py')}"
+        )
+        env = spec["job"]["env"]
+        env["PS_MODEL_PATH"] = model_dir
+        env["DRIVE_STEPS"] = "8"
+        env["DRIVE_EPOCHS"] = "2"
+        spec["metrics"] = os.path.join(model_dir, "metrics.jsonl")
+        # 8 steps x 2 epochs is far below the convergence budget — keep the
+        # gate structurally exercised but trivially satisfiable.
+        spec["checks"]["loss"]["target"] = "0.0..100.0"
+        mod = tmp_path / "job.yaml"
+        mod.write_text(yaml.safe_dump(spec))
+
+        from horovod_tpu.launch.job import run_job
+
+        result: dict = {}
+        t = threading.Thread(
+            target=lambda: result.setdefault("code", run_job(str(mod)))
+        )
+        t.start()
+
+        def get(route):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{route}", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        # The server starts with the supervisor, before the ranks finish
+        # compiling — poll until it answers, then hold the assertions
+        # while the run is still live.
+        status = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline and t.is_alive():
+            try:
+                status = get("/status")
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert status is not None, "status endpoint never came up"
+        assert "fleet" in status and "coordinator" in status
+        assert status["coordinator"] is None  # restart-supervised, not elastic
+        fleet = status["fleet"]
+        assert fleet["restarts"] == 0 and fleet["shrinks"] == 0
+        assert fleet["journal"].startswith(model_dir)
+        journal = get("/journal")
+        assert journal["records"] == []  # clean run: journal touched, empty
+        assert get("/healthz")["status"] == "ok"
+
+        t.join(timeout=600)
+        assert not t.is_alive(), "job did not finish"
+        assert result["code"] == 0

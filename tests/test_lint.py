@@ -1,0 +1,548 @@
+"""Per-rule `hvt-lint` units over fixture snippets (ISSUE 6 satellite).
+
+Each rule gets positive fixtures seeded with the bug shape it encodes —
+including the PR 2 one-sided-shutdown reproduction for HVT001 — plus
+negatives for the shapes it must NOT flag, and the suppression paths
+(``# hvt: noqa[RULE]``, committed baseline) end to end through
+`lint_paths` and the CLI.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import cli, core, registry
+from horovod_tpu.analysis.rules import (
+    CheckpointWriteAtomicity,
+    CollectiveSymmetry,
+    EnvKnobRegistry,
+    TeardownDiscipline,
+    TracingHazards,
+)
+
+
+def findings_of(rule_cls, src, relpath="horovod_tpu/fake.py"):
+    """Run ONE rule over a source snippet (no noqa/baseline filtering —
+    that layer is covered through `lint_paths` below)."""
+    module = core.ModuleSource(
+        "/fake/" + relpath, relpath, textwrap.dedent(src)
+    )
+    return list(rule_cls().check(module))
+
+
+def lint_tree(tmp_path, files, **kwargs):
+    """Write `files` ({relpath: source}) under tmp_path and lint the tree
+    with the full pipeline (noqa + baseline)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    kwargs.setdefault("baseline_path", None)
+    return core.lint_paths([str(tmp_path)], root=str(tmp_path), **kwargs)
+
+
+class TestHVT001CollectiveSymmetry:
+    def test_rank_gated_psum_flagged(self):
+        found = findings_of(CollectiveSymmetry, """
+            from horovod_tpu.parallel.collectives import psum
+            def step(x):
+                if rank() == 0:
+                    return psum(x)
+                return x
+        """)
+        assert len(found) == 1
+        assert found[0].rule == "HVT001" and "psum" in found[0].message
+
+    def test_pr2_one_sided_shutdown_shape(self):
+        """The seeded PR 2 fixture: `runtime.shutdown` is a BARRIER; a
+        rank-gated call tears down one side and SIGABRTs the survivors
+        (CHANGES.md PR 2) — exactly the shape HVT001 exists for."""
+        found = findings_of(CollectiveSymmetry, """
+            from horovod_tpu import runtime
+
+            def leave_early(world):
+                if runtime.process_rank() != 0:
+                    runtime.shutdown()
+        """)
+        assert [f.rule for f in found] == ["HVT001"]
+        assert "runtime.shutdown" in found[0].message
+
+    def test_attribute_rank_gate_and_while(self):
+        found = findings_of(CollectiveSymmetry, """
+            def f(world, x):
+                while world.process_index == 0:
+                    barrier()
+        """)
+        assert len(found) == 1
+
+    def test_boolop_short_circuit_gate(self):
+        flagged = findings_of(CollectiveSymmetry, """
+            def f(x):
+                ok = rank() == 0 and broadcast_object(x)
+        """)
+        assert len(flagged) == 1
+        # Operand BEFORE the rank test is unconditionally evaluated.
+        clean = findings_of(CollectiveSymmetry, """
+            def f(x):
+                ok = broadcast_object(x) and rank() == 0
+        """)
+        assert clean == []
+
+    def test_else_branch_of_rank_gate_flagged(self):
+        # Either arm of a rank-conditional is rank-asymmetric.
+        found = findings_of(CollectiveSymmetry, """
+            def f(x):
+                if is_primary():
+                    pass
+                else:
+                    allgather_object(x)
+        """)
+        assert len(found) == 1
+
+    def test_ungated_collective_clean(self):
+        assert findings_of(CollectiveSymmetry, """
+            def step(x):
+                y = psum(x)
+                if rank() == 0:
+                    print(y)
+                return y
+        """) == []
+
+    def test_def_under_gate_is_not_execution(self):
+        # A function DEFINED under a rank gate is not thereby CALLED
+        # under it (tracking call sites needs dataflow; documented limit).
+        assert findings_of(CollectiveSymmetry, """
+            def f(x):
+                if rank() == 0:
+                    def helper(y):
+                        return psum(y)
+                return x
+        """) == []
+
+    def test_qualified_shutdown_needs_runtime_like_owner(self):
+        # `httpd.shutdown()` under a rank gate is a same-name method on an
+        # unrelated object — must not be flagged.
+        assert findings_of(CollectiveSymmetry, """
+            def stop(httpd):
+                if rank() == 0:
+                    httpd.shutdown()
+        """) == []
+
+    def test_elastic_state_sync_qualified_forms(self):
+        found = findings_of(CollectiveSymmetry, """
+            def agree(self, x):
+                if process_index() == 0:
+                    self.state.sync(x)
+        """)
+        assert len(found) == 1
+        assert findings_of(CollectiveSymmetry, """
+            def f(conn):
+                if rank() == 0:
+                    conn.sync()
+        """) == []
+
+
+class TestHVT002TeardownDiscipline:
+    def test_direct_jax_distributed_shutdown_flagged(self):
+        found = findings_of(TeardownDiscipline, """
+            import jax
+            def cleanup():
+                jax.distributed.shutdown()
+        """)
+        assert [f.rule for f in found] == ["HVT002"]
+
+    def test_import_alias_resolved(self):
+        found = findings_of(TeardownDiscipline, """
+            from jax import distributed
+            def cleanup():
+                distributed.shutdown()
+        """)
+        assert len(found) == 1
+
+    def test_clear_backends_flagged(self):
+        found = findings_of(TeardownDiscipline, """
+            from horovod_tpu import compat
+            def reset():
+                compat.clear_backends()
+        """)
+        assert len(found) == 1 and "clear_backends" in found[0].message
+
+    def test_sanctioned_modules_exempt(self):
+        src = """
+            import jax
+            def _teardown_and_interrupt():
+                jax.distributed.shutdown()
+        """
+        for rel in ("horovod_tpu/elastic/rescale.py",
+                    "horovod_tpu/elastic/state.py",
+                    "horovod_tpu/runtime.py",
+                    "horovod_tpu/compat.py"):
+            assert findings_of(TeardownDiscipline, src, relpath=rel) == []
+        assert len(findings_of(
+            TeardownDiscipline, src, relpath="horovod_tpu/training/x.py"
+        )) == 1
+
+    def test_runtime_shutdown_wrapper_clean(self):
+        # The sanctioned wrapper is the REPLACEMENT, not a violation.
+        assert findings_of(TeardownDiscipline, """
+            from horovod_tpu import runtime
+            def cleanup():
+                runtime.shutdown()
+        """) == []
+
+
+class TestHVT003TracingHazards:
+    def test_time_in_jitted_function(self):
+        found = findings_of(TracingHazards, """
+            import time
+            import jax
+            @jax.jit
+            def step(x):
+                t = time.time()
+                return x + t
+        """)
+        assert [f.rule for f in found] == ["HVT003"]
+        assert "trace time" in found[0].message
+
+    def test_seed_free_numpy_random(self):
+        found = findings_of(TracingHazards, """
+            import numpy as np
+            from jax import jit
+            @jit
+            def noise(x):
+                return x + np.random.rand()
+        """)
+        assert len(found) == 1 and "numpy.random.rand" in found[0].message
+
+    def test_jax_random_with_key_clean(self):
+        assert findings_of(TracingHazards, """
+            from jax import jit, random
+            @jit
+            def noise(x, key):
+                return x + random.normal(key, x.shape)
+        """) == []
+
+    def test_environ_read_inside_shard_map(self):
+        found = findings_of(TracingHazards, """
+            import os
+            from jax.experimental.shard_map import shard_map
+            @shard_map
+            def step(x):
+                if os.environ.get("HVT_FAULT"):
+                    return x
+                return x * 2
+        """)
+        assert len(found) == 1 and "os.environ" in found[0].message
+
+    def test_scan_body_lambda_and_named(self):
+        found = findings_of(TracingHazards, """
+            import time
+            from jax import lax
+            def body(c, x):
+                return c, x * time.perf_counter()
+            def run(xs):
+                lax.scan(body, 0.0, xs)
+                lax.scan(lambda c, x: (c, print(x)), 0.0, xs)
+        """)
+        assert len(found) == 2
+
+    def test_host_effects_outside_trace_clean(self):
+        assert findings_of(TracingHazards, """
+            import time
+            def host_loop(step_fn, xs):
+                t0 = time.time()
+                for x in xs:
+                    step_fn(x)
+                print(time.time() - t0)
+        """) == []
+
+
+class TestHVT004EnvKnobRegistry:
+    def test_undeclared_literal_flagged(self):
+        found = findings_of(EnvKnobRegistry, """
+            KNOB = "HVT_DEFINITELY_NOT_DECLARED"
+        """)
+        assert [f.rule for f in found] == ["HVT004"]
+
+    def test_inline_reads_flagged_even_for_declared_knobs(self):
+        found = findings_of(EnvKnobRegistry, """
+            import os
+            a = os.environ.get("HVT_FAULT")
+            b = os.getenv("HVT_FAULT")
+            c = os.environ["HVT_FAULT"]
+        """)
+        assert len(found) == 3
+        assert all("registry" in f.message for f in found)
+
+    def test_registry_accessor_and_plain_literal_clean(self):
+        assert findings_of(EnvKnobRegistry, """
+            from horovod_tpu.analysis import registry
+            a = registry.get_str("HVT_FAULT")
+            DOC = "set HVT_FAULT to inject faults"  # not a bare knob literal
+        """) == []
+
+    def test_non_hvt_env_reads_out_of_scope(self):
+        assert findings_of(EnvKnobRegistry, """
+            import os
+            p = os.environ.get("PS_MODEL_PATH", "./models")
+        """) == []
+
+    def test_every_declared_knob_passes(self):
+        src = "NAMES = [" + ",".join(
+            repr(name) for name in registry.KNOBS
+        ) + "]"
+        assert findings_of(EnvKnobRegistry, src) == []
+
+
+class TestHVT005CheckpointWriteAtomicity:
+    def test_truncating_open_flagged(self):
+        found = findings_of(CheckpointWriteAtomicity, """
+            def save(path, data):
+                with open(path, "w") as f:
+                    f.write(data)
+        """)
+        assert [f.rule for f in found] == ["HVT005"]
+
+    def test_mode_kwarg_and_update_modes(self):
+        found = findings_of(CheckpointWriteAtomicity, """
+            def f(path):
+                a = open(path, mode="wb")
+                b = open(path, "r+b")
+        """)
+        assert len(found) == 2
+
+    def test_reads_and_appends_clean(self):
+        assert findings_of(CheckpointWriteAtomicity, """
+            def f(path):
+                a = open(path)
+                b = open(path, "rb")
+                c = open(path, "a")  # append streams cannot tear history
+        """) == []
+
+    def test_atomic_write_helper_sanctioned(self):
+        assert findings_of(CheckpointWriteAtomicity, """
+            import os
+            def _atomic_write(path, data):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        """) == []
+
+
+class TestSuppressionsAndBaseline:
+    SRC = """
+        import os
+        a = os.environ.get("HVT_FAULT")
+    """
+
+    def test_noqa_rule_scoped(self, tmp_path):
+        res = lint_tree(tmp_path, {"m.py": """
+            import os
+            a = os.environ.get("HVT_FAULT")  # hvt: noqa[HVT004]
+            b = os.environ.get("HVT_FAULT")  # hvt: noqa[HVT001]
+            c = os.environ.get("HVT_FAULT")  # hvt: noqa
+        """})
+        # a suppressed (right rule), b NOT (wrong rule), c suppressed (all).
+        assert [f.line for f in res.findings] == [4]
+
+    def test_baseline_matches_by_snippet_not_line(self, tmp_path):
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"findings": [{
+            "rule": "HVT004", "path": "m.py",
+            "snippet": 'a = os.environ.get("HVT_FAULT")',
+            "justification": "grandfathered for the test",
+        }]}))
+        # Extra lines ABOVE the finding: line number moved, snippet same.
+        res = lint_tree(tmp_path, {"m.py": """
+            import os
+
+            # comment pushing the read down some lines
+            a = os.environ.get("HVT_FAULT")
+        """}, baseline_path=str(baseline))
+        assert res.findings == [] and len(res.baselined) == 1
+
+        # Editing the flagged LINE invalidates the baseline entry.
+        res2 = lint_tree(tmp_path, {"m.py": """
+            import os
+            a = os.environ.get("HVT_FAULT") or "edited"
+        """}, baseline_path=str(baseline))
+        assert len(res2.findings) == 1 and res2.baselined == []
+
+    def test_baseline_requires_justification(self, tmp_path):
+        bad = tmp_path / "baseline.json"
+        bad.write_text(json.dumps({"findings": [{
+            "rule": "HVT004", "path": "m.py", "snippet": "x",
+        }]}))
+        with pytest.raises(ValueError, match="justification"):
+            core.load_baseline(str(bad))
+
+    def test_syntax_error_is_a_finding(self, tmp_path):
+        res = lint_tree(tmp_path, {"broken.py": "def f(:\n"})
+        assert [f.rule for f in res.findings] == [core.PARSE_ERROR_RULE]
+
+    def test_out_of_root_paths_anchor_at_package_dir(self, tmp_path):
+        """Absolute inputs from another cwd (editor/CI integrations) must
+        key the HVT002 sanctioned set and the baseline by the SAME
+        package-relative paths as a repo-root run — not by raw absolute
+        paths that match nothing."""
+        pkg = tmp_path / "checkout" / "horovod_tpu"
+        (pkg / "elastic").mkdir(parents=True)
+        (pkg / "elastic" / "rescale.py").write_text(textwrap.dedent("""
+            import jax
+            def _teardown_and_interrupt():
+                jax.distributed.shutdown()
+        """))
+        elsewhere = tmp_path / "elsewhere"
+        elsewhere.mkdir()
+        res = core.lint_paths(
+            [str(pkg)], root=str(elsewhere), baseline_path=None
+        )
+        assert res.findings == []  # sanctioned module still recognized
+
+    def test_select_subset(self, tmp_path):
+        res = lint_tree(tmp_path, {"m.py": self.SRC}, select=["HVT001"])
+        assert res.findings == []
+        res = lint_tree(tmp_path, {"m.py": self.SRC}, select=["HVT004"])
+        assert len(res.findings) == 1
+
+
+class TestCLI:
+    def test_exit_codes_and_write_baseline(self, tmp_path, capsys):
+        (tmp_path / "m.py").write_text(
+            'import os\na = os.environ.get("HVT_FAULT")\n'
+        )
+        baseline = tmp_path / "baseline.json"
+        argv = [str(tmp_path), "--root", str(tmp_path),
+                "--baseline", str(baseline)]
+        assert cli.main(argv) == 1  # finding, no baseline yet
+        assert "HVT004" in capsys.readouterr().out
+
+        assert cli.main(argv + ["--write-baseline"]) == 0
+        capsys.readouterr()
+        assert baseline.exists()
+        assert cli.main(argv) == 0  # grandfathered now
+        assert "1 baselined" in capsys.readouterr().out
+        assert cli.main(argv + ["--no-baseline"]) == 1
+        capsys.readouterr()
+
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        assert cli.main([str(tmp_path / "clean.py")]) == 0
+
+    def test_missing_or_empty_paths_are_usage_errors(self, tmp_path,
+                                                     capsys):
+        """A gate that lints NOTHING must not report clean: a typo'd
+        path and a .py-free directory both exit 2, not 0."""
+        assert cli.main([str(tmp_path / "no_such_dir")]) == 2
+        assert "no such file" in capsys.readouterr().err
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert cli.main([str(empty)]) == 2
+        assert "nothing was linted" in capsys.readouterr().err
+
+    def test_write_baseline_preserves_justifications(self, tmp_path,
+                                                     capsys):
+        """Re-running --write-baseline must keep hand-written
+        justifications for findings that still fire, and a --select run
+        must carry other rules' entries over instead of dropping them."""
+        (tmp_path / "m.py").write_text(
+            'import os\n'
+            'a = os.environ.get("HVT_FAULT")\n'
+            'def f(p):\n'
+            '    return open(p, "w")\n'
+        )
+        baseline = tmp_path / "baseline.json"
+        argv = [str(tmp_path), "--root", str(tmp_path),
+                "--baseline", str(baseline)]
+        assert cli.main(argv + ["--write-baseline"]) == 0
+        entries = json.loads(baseline.read_text())["findings"]
+        assert {e["rule"] for e in entries} == {"HVT004", "HVT005"}
+        for e in entries:
+            if e["rule"] == "HVT004":
+                e["justification"] = "hand-written reason"
+        baseline.write_text(json.dumps({"findings": entries}))
+
+        # Full rewrite keeps the hand-written justification.
+        assert cli.main(argv + ["--write-baseline"]) == 0
+        entries = json.loads(baseline.read_text())["findings"]
+        just = {e["rule"]: e["justification"] for e in entries}
+        assert just["HVT004"] == "hand-written reason"
+
+        # A rule-subset rewrite must not drop the other rules' entries.
+        assert cli.main(
+            argv + ["--select", "HVT004", "--write-baseline"]
+        ) == 0
+        entries = json.loads(baseline.read_text())["findings"]
+        assert {e["rule"] for e in entries} == {"HVT004", "HVT005"}
+        assert cli.main(argv) == 0  # everything still grandfathered
+        capsys.readouterr()
+
+    def test_json_format(self, tmp_path, capsys):
+        (tmp_path / "m.py").write_text(
+            'import os\na = os.environ.get("HVT_FAULT")\n'
+        )
+        code = cli.main([str(tmp_path), "--root", str(tmp_path),
+                         "--format", "json", "--no-baseline"])
+        assert code == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"][0]["rule"] == "HVT004"
+        assert payload["findings"][0]["path"] == "m.py"
+
+    def test_list_rules(self, capsys):
+        assert cli.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("HVT001", "HVT002", "HVT003", "HVT004", "HVT005"):
+            assert rid in out
+
+
+class TestRegistryAccessors:
+    def test_unknown_knob_refused(self):
+        with pytest.raises(registry.UnknownKnobError):
+            registry.get_str("HVT_NOT_A_KNOB")
+
+    def test_empty_string_is_unset(self):
+        env = {"HVT_COMMIT_EVERY": ""}
+        assert registry.get_int("HVT_COMMIT_EVERY", environ=env) == 1
+        env = {"HVT_COMMIT_EVERY": "5"}
+        assert registry.get_int("HVT_COMMIT_EVERY", environ=env) == 5
+        assert registry.get_int("HVT_DCN_FACTOR", environ={}) is None
+
+    def test_flag_spellings(self):
+        for off in ("", "0", "false", "FALSE", "no", "No"):
+            assert not registry.get_flag(
+                "HVT_NO_NATIVE", environ={"HVT_NO_NATIVE": off}
+            )
+        for on in ("1", "true", "yes", "anything"):
+            assert registry.get_flag(
+                "HVT_NO_NATIVE", environ={"HVT_NO_NATIVE": on}
+            )
+
+    def test_float_and_default_types(self):
+        assert registry.get_float(
+            "HVT_RESTART_LOG_MAX_MB", environ={}
+        ) == 64.0
+        assert registry.get_float(
+            "HVT_RESTART_LOG_MAX_MB",
+            environ={"HVT_RESTART_LOG_MAX_MB": "0.5"},
+        ) == 0.5
+
+    def test_runtime_env_flag_delegates(self):
+        # runtime.env_flag and registry.flag_like are the SAME contract
+        # by construction (delegation, not duplication).
+        from horovod_tpu import runtime
+
+        assert runtime.env_flag.__doc__  # still documented
+        os.environ["HVT_FAST_RNG"] = "no"
+        try:
+            assert not runtime.env_flag("HVT_FAST_RNG")
+            os.environ["HVT_FAST_RNG"] = "on"
+            assert runtime.env_flag("HVT_FAST_RNG")
+        finally:
+            del os.environ["HVT_FAST_RNG"]
+
+    def test_generate_doc_covers_every_knob(self):
+        doc = registry.generate_doc()
+        for name in registry.KNOBS:
+            assert f"`{name}`" in doc

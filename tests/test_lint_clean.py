@@ -1,0 +1,102 @@
+"""Tier-1 gate: the shipped tree is `hvt-lint`-clean (ISSUE 6).
+
+Three drift directions are closed here:
+
+* code drift — any non-baselined finding in ``horovod_tpu/`` fails CI
+  (the prose invariants of PRs 1-5 are now machine-checked);
+* baseline drift — a baseline entry whose flagged line was since fixed or
+  edited no longer matches anything and must be deleted;
+* doc drift — ``docs/ENVVARS.md`` must be byte-identical to what
+  `registry.generate_doc()` renders, and every registered knob must still
+  be referenced somewhere in the tree (a knob documented but no longer
+  read is drift too, just in the other direction).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+from horovod_tpu.analysis import core, registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "horovod_tpu")
+
+
+def _lint_package():
+    return core.lint_paths([PACKAGE], root=REPO)
+
+
+class TestLintClean:
+    def test_package_is_lint_clean(self):
+        result = _lint_package()
+        assert result.files > 50  # the walk actually covered the package
+        assert not result.findings, (
+            "hvt-lint found non-baselined issues — fix them, or baseline "
+            "with a one-line justification "
+            "(horovod_tpu/analysis/baseline.json):\n"
+            + "\n".join(f.format() for f in result.findings)
+        )
+
+    def test_no_stale_baseline_entries(self):
+        """Every committed baseline entry still matches a live finding —
+        a fixed site must take its grandfather clause with it."""
+        entries = core.load_baseline(core.DEFAULT_BASELINE)
+        result = _lint_package()
+        matched = {(f.rule, f.path, f.snippet) for f in result.baselined}
+        stale = [
+            e for e in entries
+            if (e["rule"], e["path"], e["snippet"]) not in matched
+        ]
+        assert not stale, (
+            "baseline entries no longer match any finding — delete them:\n"
+            + "\n".join(f"{e['rule']} {e['path']}: {e['snippet']}"
+                        for e in stale)
+        )
+
+    def test_cli_exit_code_contract(self):
+        """`hvt-lint horovod_tpu/` exits 0 on the shipped tree — the
+        pre-commit-hook surface, end to end through the real CLI."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis", "horovod_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 finding(s)" in proc.stdout
+
+
+class TestEnvvarsDoc:
+    DOC = os.path.join(REPO, "docs", "ENVVARS.md")
+
+    def test_regeneration_produces_no_diff(self):
+        with open(self.DOC) as f:
+            on_disk = f.read()
+        assert on_disk == registry.generate_doc(), (
+            "docs/ENVVARS.md is stale — regenerate: "
+            "python -m horovod_tpu.analysis.registry > docs/ENVVARS.md"
+        )
+
+    def test_every_registered_knob_is_read_somewhere(self):
+        """Reverse drift: a registered knob nothing references anymore
+        should be deleted from the registry (and thus from the doc)."""
+        referenced = set()
+        roots = [PACKAGE, os.path.join(REPO, "examples"),
+                 os.path.join(REPO, "benchmarks"),
+                 os.path.join(REPO, "bench.py")]
+        for path in core.iter_python_files(p for p in roots
+                                           if os.path.exists(p)):
+            if os.path.abspath(path).startswith(
+                os.path.join(PACKAGE, "analysis") + os.sep
+            ):
+                continue  # the registry declaring a name is not a use
+            with open(path, encoding="utf-8") as f:
+                referenced.update(re.findall(r"HVT_[A-Z0-9_]+", f.read()))
+        unused = sorted(set(registry.KNOBS) - referenced)
+        assert not unused, (
+            f"registered knobs referenced nowhere: {unused} — remove the "
+            "Knob rows and regenerate docs/ENVVARS.md"
+        )
+
+    def test_readme_links_envvars_doc(self):
+        with open(os.path.join(REPO, "README.md")) as f:
+            assert "docs/ENVVARS.md" in f.read()
