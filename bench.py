@@ -57,6 +57,28 @@ BATCH = 128
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+def _fused_ce_chunks() -> int:
+    """BENCH_FUSED_CE chunk count. Default ON (8 chunks): the fused
+    chunked linear-CE head (ops/fused_ce.py) is the bench LM's default
+    config — the [B, T, vocab] logits tensor never materializes. Export
+    BENCH_FUSED_CE=0 to bench the dense head."""
+    return int(os.environ.get("BENCH_FUSED_CE", 8))
+
+
+def _lm_loss() -> str:
+    """Trainer loss matching the fused-CE default: the module computes the
+    loss when the fused head is on."""
+    return "module" if _fused_ce_chunks() else "sparse_categorical_crossentropy"
+
+
+def _wire_compression() -> str:
+    """HVT_COMPRESSION for the train benches (none/bf16/fp16/int8/fp8 →
+    DistributedOptimizer(compression=...))."""
+    from horovod_tpu.analysis import registry
+
+    return registry.get_str("HVT_COMPRESSION") or "none"
+
+
 def _lm_from_env(*, moe: bool = False):
     """The bench transformer, one source of truth for its env knobs — the
     decode rows must measure the same model the training rows do."""
@@ -103,7 +125,8 @@ def _lm_from_env(*, moe: bool = False):
         # BENCH_FUSED_CE=<n_chunks>: fused chunked linear-CE head
         # (ops/fused_ce.py) — the [B, T, vocab] logits + cotangent are never
         # materialized; the train rows switch to Trainer(loss='module').
-        fused_head_chunks=int(os.environ.get("BENCH_FUSED_CE", 0)),
+        # DEFAULT ON (8 chunks) — export BENCH_FUSED_CE=0 for the dense head.
+        fused_head_chunks=_fused_ce_chunks(),
     )
 
 
@@ -275,13 +298,9 @@ def bench_train(which: str) -> dict:
         # a trained label.
         unit_per_step = per_chip_batch * n_chips * seq_len
         lr = optax.adamw(hvt.scale_lr(3e-4))
-        # Fused chunked-CE head: the module computes the loss (see
-        # _lm_from_env's fused_head_chunks knob).
-        loss = (
-            "module"
-            if int(os.environ.get("BENCH_FUSED_CE", 0))
-            else "sparse_categorical_crossentropy"
-        )
+        # Fused chunked-CE head (default on): the module computes the loss
+        # (see _lm_from_env's fused_head_chunks knob).
+        loss = _lm_loss()
         unit = "tokens/sec/chip"
         default_steps = 48
     else:
@@ -299,7 +318,12 @@ def bench_train(which: str) -> dict:
         unit = "images/sec/chip"
         default_steps = 1024
 
-    trainer = hvt.Trainer(module, hvt.DistributedOptimizer(lr), loss=loss)
+    compression = _wire_compression()
+    trainer = hvt.Trainer(
+        module,
+        hvt.DistributedOptimizer(lr, compression=compression),
+        loss=loss,
+    )
 
     n_steps = int(os.environ.get("BENCH_STEPS", default_steps))
     global_batch = per_chip_batch * n_chips
@@ -318,7 +342,12 @@ def bench_train(which: str) -> dict:
     zero_acc = {k: np.float32(0) for k in trainer.metric_names}
 
     # --- compute time: ONE fused scan over n_steps (see _timed's note on why
-    # a Python loop of dispatches cannot be trusted on tunneled runtimes) ---
+    # a Python loop of dispatches cannot be trusted on tunneled runtimes).
+    # Chained BENCH_E2E_REPS times per fetch, exactly like the e2e leg
+    # below: the two legs must amortize the tunnel's per-fetch RTT
+    # identically, or the RTT difference masquerades as phase time (the
+    # r04 `compute > total` accounting bug). ------------------------------
+    reps = max(1, int(os.environ.get("BENCH_E2E_REPS", 4)))
     steps = [draw() for _ in range(n_steps)]
     mega = tuple(np.stack([s[i] for s in steps]) for i in range(2))
     dev_mega = trainer._shard_chunk(mega)
@@ -334,14 +363,23 @@ def bench_train(which: str) -> dict:
     holder = {"state": w_state}
 
     def run_mega():
-        holder["state"], m, acc = compiled_mega(
-            holder["state"], dev_mega, scale, zero_acc
-        )
-        holder["acc"] = acc  # last measured pass — extra metrics read it
+        for _ in range(reps):
+            holder["state"], m, acc = compiled_mega(
+                holder["state"], dev_mega, scale, zero_acc
+            )
+            holder["acc"] = acc  # last measured pass — extras read it
         return acc["loss"]
 
     with trace.maybe_trace(trace.profile_dir()):
-        compute_s = _timed(run_mega) / n_steps
+        compute_s = _timed(run_mega) / (n_steps * reps)
+
+    # --- comm time: the boundary reduction in isolation — the same
+    # bucketed/hierarchical/compressed program the step runs (or, on the
+    # implicit-SPMD path, its explicit equivalent over the same gradient
+    # shapes), chained per fetch like the legs above. On one chip this
+    # measures dispatch-amortized psum overhead (≈0); on a real mesh it is
+    # the exposed wire time a perfectly-overlapped step would hide. -------
+    comm_s = _timed_reduction(trainer, holder["state"].params, reps)
 
     # Module-sown metrics (e.g. moe_drop_rate), averaged over the MEASURED
     # pass — the steady state the throughput number describes, not warm-up.
@@ -469,42 +507,119 @@ def bench_train(which: str) -> dict:
     e2e_s = _timed(run_e2e) / (epoch_steps * e2e_reps)
 
     per_sec_per_chip = unit_per_step / e2e_s / n_chips
+    # Per-phase breakdown, one consistent accounting: `total` is the
+    # end-to-end step (training + on-device input pipeline, the number the
+    # throughput headline divides by); `comm` is the isolated boundary
+    # reduction; `compute` is the compute leg minus its comm share;
+    # `input` is the remainder. Phases are clamped into [0, total] so they
+    # sum to exactly `total` — and main() exits non-zero if any reported
+    # phase still exceeds it (the r04 regression guard).
+    total_s = e2e_s
+    comm_clamped = min(comm_s, total_s)
+    compute_clamped = min(
+        max(compute_s - comm_s, 0.0), total_s - comm_clamped
+    )
+    input_s = max(0.0, total_s - comm_clamped - compute_clamped)
+    # MFU is the HEADLINE: achieved FLOP/s through the full end-to-end
+    # step against fleet peak — the "how idle are the chips" number the
+    # throughput value can't show. mfu_compute excludes input time (the
+    # old headline's denominator, kept for trend comparison).
+    mfu_e2e = trace.mfu(flops, total_s, n_chips)
+    mfu_compute = trace.mfu(flops, compute_s, n_chips)
     return {
+        "mfu": round(mfu_e2e, 4) if mfu_e2e is not None else None,
         "metric": metric,
         "value": round(per_sec_per_chip, 1),
         "unit": unit,
         "flops_per_step": flops,
-        "mfu": round(m, 4) if (m := trace.mfu(flops, compute_s, n_chips)) is not None else None,
+        "mfu_compute": (
+            round(mfu_compute, 4) if mfu_compute is not None else None
+        ),
         "step_ms": {
-            "total": round(e2e_s * 1e3, 3),
-            "compute": round(compute_s * 1e3, 3),
-            # clamp: the two legs are separate timed runs, so on a
-            # compute-bound model their difference can be timing noise
-            "input": round(max(0.0, e2e_s - compute_s) * 1e3, 3),
+            "total": round(total_s * 1e3, 3),
+            "compute": round(compute_clamped * 1e3, 3),
+            "comm": round(comm_clamped * 1e3, 3),
+            "input": round(input_s * 1e3, 3),
         },
+        "overlap_reduction": trainer._overlap,
+        "compression": compression,
         "n_chips": n_chips,
         **extra_metrics,
     }
+
+
+def _timed_reduction(trainer, params, reps: int) -> float:
+    """Per-step wall time of the boundary gradient reduction in isolation:
+    the same `collectives.reduce_gradients` program the explicit step
+    embeds (bucketing, order, dcn two-hop, wire dtype all from the
+    trainer), compiled standalone over gradient-shaped zeros and chained
+    ``reps`` times per honest fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import compat
+    from horovod_tpu.parallel import collectives
+    from horovod_tpu.parallel import mesh as mesh_lib
+
+    P = jax.sharding.PartitionSpec
+    grads = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+
+    def red(g):
+        out = collectives.reduce_gradients(
+            g,
+            data_axis=mesh_lib.DATA_AXIS,
+            extra_axes=(mesh_lib.FSDP_AXIS,),
+            dcn=trainer._dcn,
+            wire_dtype=trainer._comm_dtype,
+            bucket_bytes=trainer._bucket_bytes,
+            reverse=trainer._bucket_reverse,
+        )
+        # Scalar data-dependency on every reduced bucket (honest fetch).
+        return sum(jnp.sum(l) for l in jax.tree.leaves(out))
+
+    f = jax.jit(compat.shard_map(
+        red, mesh=trainer.mesh, in_specs=(P(),), out_specs=P(),
+        check_vma=False,
+    ))
+    float(jax.device_get(f(grads)))  # compile + settle
+
+    def chain():
+        t = jnp.float32(0)
+        for _ in range(reps):
+            t = t + f(grads)
+        return t
+
+    return _timed(chain) / reps
 
 
 def _reduction_calls(hlo: str) -> int:
     """Cross-worker GRADIENT reduction ops in a compiled step's HLO text:
     all-reduce (sync or -start; -done is the same op's completion) with a
     non-scalar operand — scalar all-reduces are the loss/accuracy metric
-    means, which exist on every path and aren't gradient traffic."""
+    means, which exist on every path and aren't gradient traffic. Counts
+    all-gather too: the quantized (int8/fp8) wire reduces as a
+    gather-sum, one PAYLOAD gather per bucket — the per-bucket f32 scale
+    rides a separate rank-1 gather (one scalar per shard, noise bytes)
+    that must not inflate the count, so gathers only count at rank >= 2
+    (a 1-D bucket gathered over shards; the scale's [n_shards] result
+    stays out)."""
     import re
 
     count = 0
     for line in hlo.splitlines():
-        if "all-reduce-done" in line:
+        if "all-reduce-done" in line or "all-gather-done" in line:
             continue
-        m = re.search(r"\ball-reduce(?:-start)?\(", line)
+        m = re.search(r"\ball-(gather|reduce)(?:-start)?\(", line)
         if not m:
             continue
         # The result type precedes the op name: non-scalar iff any shaped
         # dimension appears in it (f32[262144]{0} yes, f32[] no; tuple
-        # types count once — one launched collective).
-        if re.search(r"\[\d", line[: m.start()]):
+        # types count once — one launched collective). Gathers need a
+        # second dimension (payload buckets, not gathered scalar scales).
+        shaped = r"\[\d+,\d" if m.group(1) == "gather" else r"\[\d"
+        if re.search(shaped, line[: m.start()]):
             count += 1
     return count
 
@@ -527,6 +642,7 @@ def bench_accum() -> dict:
     import optax
 
     import horovod_tpu as hvt
+    from horovod_tpu import trace
     from horovod_tpu.data import datasets
 
     hvt.init()
@@ -538,6 +654,8 @@ def bench_accum() -> dict:
     n_steps = int(os.environ.get("BENCH_STEPS", 16))  # optimizer steps
     global_batch = per_chip_batch * n_chips
 
+    compression = _wire_compression()
+
     def measure(k: int) -> tuple:
         trainer = hvt.Trainer(
             _lm_from_env(),
@@ -548,8 +666,9 @@ def bench_accum() -> dict:
                 # the K=1 leg, so the A/B compares communication, not
                 # optimization trajectories.
                 average_aggregated_gradients=True,
+                compression=compression,
             ),
-            loss="sparse_categorical_crossentropy",
+            loss=_lm_loss(),
         )
         rng = np.random.RandomState(0)
 
@@ -576,10 +695,17 @@ def bench_accum() -> dict:
         dev_one = (
             trainer._shard(one) if k == 1 else trainer._shard_chunk(one, 1)
         )
-        hlo = trainer._train_step.lower(
+        compiled_one = trainer._train_step.lower(
             state, dev_one, scale, zero_acc
-        ).compile().as_text()
-        reductions = _reduction_calls(hlo)
+        ).compile()
+        reductions = _reduction_calls(compiled_one.as_text())
+        # Per-MICROBATCH flops from the single step's cost model (the scan
+        # body is counted once, so the k=1 compile is the honest
+        # per-microbatch count; the K leg's per-optimizer-step flops are
+        # K x this, compute dominating the shared reduction/update tail).
+        flops_micro = (
+            trace.compiled_cost_flops(compiled_one) if k == 1 else None
+        )
         # Timed leg: ONE fused scan over n_steps optimizer steps.
         steps = [step_batch() for _ in range(n_steps)]
         mega = tuple(np.stack([s[i] for s in steps]) for i in range(2))
@@ -599,21 +725,34 @@ def bench_accum() -> dict:
 
         sec_per_opt_step = _timed(run) / n_steps
         tokens_per_opt_step = k * global_batch * seq_len
-        return tokens_per_opt_step / sec_per_opt_step / n_chips, reductions
+        return (
+            tokens_per_opt_step / sec_per_opt_step / n_chips,
+            reductions, sec_per_opt_step, flops_micro, trainer,
+        )
 
-    tok_k1, red_k1 = measure(1)
-    tok_kn, red_kn = measure(K)
+    tok_k1, red_k1, sec_k1, flops_micro, _ = measure(1)
+    tok_kn, red_kn, sec_kn, _, trainer_k = measure(K)
+    # Per-optimizer-step flops of the K leg ~= K x the per-microbatch
+    # count (see measure); MFU headline-first like the train benches.
+    flops_k = flops_micro * K if flops_micro else None
+    mfu_k = trace.mfu(flops_k, sec_kn, n_chips) if flops_k else None
+    mfu_k1 = trace.mfu(flops_micro, sec_k1, n_chips) if flops_micro else None
     return {
+        "mfu": round(mfu_k, 4) if mfu_k is not None else None,
         "metric": "accum_train_tokens_per_sec_per_chip",
         "value": round(tok_kn, 1),
         "unit": "tokens/sec/chip",
         "k": K,
         "k1_tokens_per_sec_per_chip": round(tok_k1, 1),
         "speedup": round(tok_kn / tok_k1, 2),
+        "mfu_k1": round(mfu_k1, 4) if mfu_k1 is not None else None,
+        "flops_per_opt_step": flops_k,
         # K=1: XLA's implicit reduction, per microbatch == per step.
         # K=N: the single bucketed boundary reduction — per-sample
         # gradient communication divided by N.
         "reduction_calls_per_opt_step": {"k1": red_k1, f"k{K}": red_kn},
+        "overlap_reduction": trainer_k._overlap,
+        "compression": compression,
         "per_chip_batch": per_chip_batch,
         "seq_len": seq_len,
         "n_chips": n_chips,
@@ -1089,6 +1228,26 @@ def bench_input() -> dict:
     }
 
 
+def _phase_overruns(step_ms: dict) -> list:
+    """Phases reported larger than `total` (impossible under the one
+    consistent accounting bench_train uses — any hit means the measurement
+    or clamping regressed, the r04 `compute: 0.281 > total: 0.256` bug).
+    Also flags the phases summing past total. Small float-printing slack
+    only (phases are rounded to µs independently of total)."""
+    total = step_ms.get("total")
+    if total is None:
+        return []
+    slack = 2e-3  # rounded-to-3-decimals ms values
+    phases = {
+        k: v for k, v in step_ms.items()
+        if k != "total" and isinstance(v, (int, float))
+    }
+    bad = [k for k, v in phases.items() if v > total + slack]
+    if sum(phases.values()) > total + slack * max(1, len(phases)):
+        bad.append("sum(phases)")
+    return bad
+
+
 def main() -> None:
     which = os.environ.get("BENCH_MODEL", "mnist")
     if which == "input":
@@ -1115,6 +1274,16 @@ def main() -> None:
                     vs = round(result["value"] / json.load(f)["images_per_sec"], 2)
         result["vs_baseline"] = vs
     print(json.dumps(result))
+    overruns = _phase_overruns(result.get("step_ms", {}))
+    if overruns:
+        import sys
+
+        print(
+            f"bench: phase(s) {overruns} exceed step_ms.total — "
+            "inconsistent phase accounting",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -93,7 +93,13 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
     # cadence via the job spec's elastic: block) can never land
     # mid-accumulation. Not composed with ELASTIC_ZERO1 (shard_update and
     # accumulation are mutually exclusive — Trainer fails fast).
-    backward_passes = int(os.environ.get("HVT_BACKWARD_PASSES", 1) or 1)
+    from horovod_tpu.analysis import registry
+
+    backward_passes = registry.get_int("HVT_BACKWARD_PASSES") or 1
+    # HVT_COMPRESSION=bf16/fp16/int8/fp8: wire compression on the boundary
+    # reduction; int8/fp8 error-feedback residuals live in opt_state, so
+    # elastic commit/sync and the reshard re-cut carry them unchanged.
+    compression = registry.get_str("HVT_COMPRESSION") or "none"
     trainer = hvt.Trainer(
         MnistCNN(),
         # lr = 0.001 × size: rebuilt each generation, so the effective LR
@@ -102,6 +108,7 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
         hvt.DistributedOptimizer(
             optax.adam(hvt.scale_lr(0.001)),
             backward_passes_per_step=backward_passes,
+            compression=compression,
         ),
         loss="sparse_categorical_crossentropy",
         # ZeRO-1: optimizer state sharded over the data axis — with one

@@ -76,13 +76,20 @@ def main() -> None:
     # HVT_BACKWARD_PASSES=K (job-spec env surface): Horovod's gradient
     # accumulation — K microbatch passes per optimizer update, one
     # cross-worker reduction per K passes (effective batch K×128/worker).
-    backward_passes = int(os.environ.get("HVT_BACKWARD_PASSES", 1) or 1)
+    from horovod_tpu.analysis import registry
+
+    backward_passes = registry.get_int("HVT_BACKWARD_PASSES") or 1
+    # HVT_COMPRESSION=bf16/fp16/int8/fp8: gradient wire compression on the
+    # boundary reduction (int8/fp8 carry error-feedback residuals in the
+    # optimizer state — they ride the checkpoints below for free).
+    compression = registry.get_str("HVT_COMPRESSION") or "none"
     trainer = hvt.Trainer(
         MnistCNN(compute_dtype=jnp.bfloat16),
         # Adam(0.001 × size) (:55) wrapped for gradient averaging (:58).
         hvt.DistributedOptimizer(
             optax.adam(hvt.scale_lr(0.001)),
             backward_passes_per_step=backward_passes,
+            compression=compression,
         ),
         loss="sparse_categorical_crossentropy",  # :63
     )

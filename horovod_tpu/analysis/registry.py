@@ -107,6 +107,16 @@ KNOBS: dict[str, Knob] = _decl([
          "Gradient-fusion bucket cap in bytes for the explicit-collective "
          "boundary reduction (default: collectives.DEFAULT_BUCKET_BYTES, "
          "64 MB — Horovod's fusion threshold)."),
+    Knob("HVT_OVERLAP_REDUCTION", "flag", True, "parallel",
+         "Overlap the boundary reduction with the backward: peel the last "
+         "microbatch out of the accumulation scan so bucket-wise "
+         "reductions issue inside the same schedulable region as its "
+         "backward (async start/done overlap on TPU). Off = serialize "
+         "the reduction after the scan (identical arithmetic)."),
+    Knob("HVT_BUCKET_ORDER", "str", "reverse", "parallel",
+         "Boundary-reduction bucket issue order: `reverse` (last-produced "
+         "gradients reduce first — Horovod's fusion order, overlappable "
+         "with the backward) or `forward` (pytree order)."),
     # --- training ----------------------------------------------------------
     Knob("HVT_SAVE_EVERY_STEPS", "int", 0, "training",
          "ModelCheckpoint mid-epoch save cadence in optimizer steps "
@@ -141,6 +151,10 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_NO_NATIVE", "flag", False, "data",
          "Disable the native C++ loader; fall back to the pure-python "
          "feeding path."),
+    Knob("HVT_PREFETCH_DEPTH", "int", 2, "data",
+         "Device-prefetch queue depth for the streamed fit path (staged "
+         "batches ahead of the consuming step; 2 = classic double "
+         "buffering — the step donates each consumed batch's buffer)."),
     Knob("HVT_DATA_DIR", "path", "~/.cache/horovod_tpu", "data",
          "Dataset cache directory (the keras-layout npz archives)."),
     # --- observability ------------------------------------------------------
@@ -161,6 +175,10 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_BACKWARD_PASSES", "int", 1, "examples",
          "Gradient-accumulation factor K for the example entry scripts "
          "(DistributedOptimizer backward_passes_per_step)."),
+    Knob("HVT_COMPRESSION", "str", "none", "examples",
+         "Gradient wire compression for the example/bench entry scripts "
+         "(none/bf16/fp16/int8/fp8 — DistributedOptimizer(compression=); "
+         "int8/fp8 carry error-feedback residuals by default)."),
     Knob("HVT_DEVICE_CACHE", "flag", False, "examples",
          "Examples: stage the dataset into HBM once (`cache='device'`)."),
     Knob("HVT_EXPORT_FORMAT", "str", "stablehlo", "examples",

@@ -328,22 +328,50 @@ def allgather_object(obj) -> list:
 # psum (sum is associative; the cast boundary is the only numerics delta),
 # 16-bit bytes only where bandwidth is scarce. `reduce_gradients` composes
 # the two: bucket, reduce each bucket (two-hop when dcn > 1), unflatten.
+#
+# Quantized wires (int8 / fp8, the EQuARX-aggressive tier): a sub-16-bit
+# reduction cannot ride a plain all-reduce — int8 partial sums overflow and
+# fp8 ones drown in rounding — so each quantized hop is a gather-sum: the
+# bucket is scaled by ONE per-bucket scalar (amax/qmax), cast to the wire
+# dtype, all-gathered across the hop's groups (the only payload bytes on
+# the wire: 1 B/element plus one f32 scale per bucket per shard), then
+# dequantized and summed in f32 by every receiver. Error feedback (EQuARX
+# residuals): the caller carries a per-shard residual of what quantization
+# failed to transmit and adds it back before the next step's quantization —
+# the errors telescope, so quantization bias does NOT compound across
+# steps. `reduce_gradients(..., residual=...)` threads it per bucket and
+# returns the updated residual tree.
+#
+# Overlap (Horovod's tensor-fusion ORDER trick, arXiv:1802.05799): the
+# backward pass produces the LAST layers' gradients first, so issuing the
+# bucket reductions in reverse pytree order (``reverse=True``) lets XLA's
+# latency-hiding scheduler start a bucket's collective (all-reduce-start /
+# all-gather-start on TPU) as soon as its leaves are final, while the
+# remaining backward compute is still running — provided the caller keeps
+# that backward in the same straight-line computation (see
+# trainer.explicit_grads, which peels the last microbatch out of its
+# accumulation scan exactly for this).
 
 #: Default fusion-bucket size: Horovod's fusion threshold default (64 MB).
 DEFAULT_BUCKET_BYTES = 64 * 1024 * 1024
 
 
-def flatten_buckets(tree: PyTree, bucket_bytes: int | None = None):
+def flatten_buckets(tree: PyTree, bucket_bytes: int | None = None,
+                    *, reverse: bool = False):
     """Pack a pytree into contiguous dtype-homogeneous 1-D buckets.
 
     Leaves are grouped by dtype (first-appearance order), raveled,
     concatenated, and split into chunks of at most ``bucket_bytes`` — so a
     dtype's leaves cost ``ceil(dtype_bytes / bucket_bytes)`` buckets and the
     whole tree at most ``ceil(total_bytes / bucket_bytes) + n_dtypes - 1``.
-    Returns ``(buckets, spec)``; ``unflatten_buckets(buckets, spec)`` is the
-    exact inverse (shapes, dtypes, 0-d leaves, pytree structure all
-    restored). Pure structure — no communication; callers reduce the
-    buckets however they like."""
+    ``reverse=True`` walks the leaves LAST-first (Horovod's fusion order:
+    the backward pass finalizes the last layers' gradients first, so the
+    first buckets become reducible while earlier layers are still
+    computing). Returns ``(buckets, spec)``;
+    ``unflatten_buckets(buckets, spec)`` is the exact inverse (shapes,
+    dtypes, 0-d leaves, pytree structure all restored) for either order.
+    Pure structure — no communication; callers reduce the buckets however
+    they like."""
     if bucket_bytes is None:
         bucket_bytes = DEFAULT_BUCKET_BYTES
     bucket_bytes = int(bucket_bytes)
@@ -353,8 +381,9 @@ def flatten_buckets(tree: PyTree, bucket_bytes: int | None = None):
     shapes = [jnp.shape(l) for l in leaves]
     dtypes = [jnp.result_type(l) for l in leaves]
     by_dtype: dict = {}  # dtype -> list of leaf indices (order-preserving)
-    for i, dt in enumerate(dtypes):
-        by_dtype.setdefault(jnp.dtype(dt), []).append(i)
+    order = range(len(dtypes) - 1, -1, -1) if reverse else range(len(dtypes))
+    for i in order:
+        by_dtype.setdefault(jnp.dtype(dtypes[i]), []).append(i)
     buckets = []
     groups = []  # (leaf_indices, n_chunks) per dtype, bucket order
     for dt, idxs in by_dtype.items():
@@ -405,6 +434,68 @@ def _hier_groups(n: int, dcn: int) -> tuple[list, list]:
     return ici_groups, dcn_groups
 
 
+#: Quantized wire formats: dtype -> the format's largest representable
+#: magnitude (the per-bucket scale denominator). int8 keeps the symmetric
+#: [-127, 127] grid; fp8 is e4m3 (max finite 448 — the gradient-friendly
+#: variant; e5m2's extra exponent bits buy nothing once a per-bucket scale
+#: normalizes the range).
+_QUANTIZED_QMAX = {
+    jnp.dtype(jnp.int8): 127.0,
+    jnp.dtype(jnp.float8_e4m3fn): 448.0,
+}
+
+
+def is_quantized_wire(wire_dtype) -> bool:
+    """True when ``wire_dtype`` needs the gather-sum quantized reduction
+    (int8/fp8) rather than a plain cast-then-psum (bf16/fp16)."""
+    return (
+        wire_dtype is not None and jnp.dtype(wire_dtype) in _QUANTIZED_QMAX
+    )
+
+
+def _quantize(v, wire_dtype):
+    """(payload, scale): ``v`` scaled by one per-bucket scalar onto the wire
+    grid. ``scale`` is f32; an all-zero bucket quantizes to zeros with
+    scale 0 (the dequantized sum is then exactly zero, no 0/0)."""
+    qmax = _QUANTIZED_QMAX[jnp.dtype(wire_dtype)]
+    amax = jnp.max(jnp.abs(v)).astype(jnp.float32)
+    scale = amax / qmax
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    scaled = jnp.clip(v.astype(jnp.float32) * inv, -qmax, qmax)
+    if jnp.dtype(wire_dtype) == jnp.dtype(jnp.int8):
+        payload = jnp.round(scaled).astype(jnp.int8)
+    else:
+        payload = scaled.astype(wire_dtype)
+    return payload, scale
+
+
+def _dequantize(payload, scale):
+    return payload.astype(jnp.float32) * scale
+
+
+def quantized_group_sum(v, axis_name, wire_dtype, *, axis_index_groups=None):
+    """Sum ``v`` across ``axis_name`` (optionally in ``axis_index_groups``)
+    with only wire-dtype bytes crossing the interconnect.
+
+    Each shard quantizes with its own per-bucket scale, all-gathers the
+    (payload, scale) pair across the group, and every receiver dequantizes
+    and sums in f32 — sub-16-bit partial sums never happen, so int8 cannot
+    overflow mid-reduction. Returns ``(sum_f32, own_error)`` where
+    ``own_error = v - dequantize(own payload)`` is THIS shard's
+    untransmitted remainder — the error-feedback residual contribution."""
+    payload, scale = _quantize(v, wire_dtype)
+    own = _dequantize(payload, scale)
+    gathered = lax.all_gather(
+        payload, axis_name, axis_index_groups=axis_index_groups
+    )
+    scales = lax.all_gather(
+        scale, axis_name, axis_index_groups=axis_index_groups
+    )
+    scales = scales.reshape((-1,) + (1,) * (gathered.ndim - 1))
+    total = jnp.sum(gathered.astype(jnp.float32) * scales, axis=0)
+    return total, v.astype(jnp.float32) - own
+
+
 def hierarchical_psum(x, axis_name, dcn: int, *, extra_axes=(),
                       wire_dtype=None):
     """Two-hop psum over ``axis_name`` factored as (dcn outer, ici inner),
@@ -418,29 +509,55 @@ def hierarchical_psum(x, axis_name, dcn: int, *, extra_axes=(),
     exactly when ``wire_dtype`` is None (sum is associative); with a 16-bit
     wire dtype the delta is the cast on the already-ICI-reduced partials
     (strictly less rounding than casting per-shard values, the flat
-    compressed path's behavior)."""
+    compressed path's behavior). A QUANTIZED wire dtype (int8/fp8) runs
+    the DCN hop as `quantized_group_sum` — per-bucket-scaled wire bytes,
+    f32 receiver-side accumulation; pass ``residual=`` via
+    `reduce_gradients` to carry the error feedback."""
+    out, _ = _hierarchical_psum_err(
+        x, axis_name, dcn, extra_axes=extra_axes, wire_dtype=wire_dtype
+    )
+    return out
+
+
+def _hierarchical_psum_err(x, axis_name, dcn: int, *, extra_axes=(),
+                           wire_dtype=None, residual=None):
+    """`hierarchical_psum` body, also returning this shard's quantization
+    error (zeros-shaped None for non-quantized wires). ``residual`` (error
+    feedback) is added to the DCN hop's input before quantization."""
     n = compat.axis_size(axis_name)
     if n % dcn != 0:
         raise ValueError(
             f"dcn factor {dcn} does not divide axis {axis_name!r} size {n}"
         )
     orig = x.dtype
+    quantize = is_quantized_wire(wire_dtype) and jnp.issubdtype(
+        orig, jnp.floating
+    )
     ici_groups, dcn_groups = _hier_groups(n, dcn)
     if extra_axes:
         x = lax.psum(x, tuple(extra_axes))
     if n > dcn:  # ici sub-axis is non-trivial
         x = lax.psum(x, axis_name, axis_index_groups=ici_groups)
+    if quantize:
+        v = x.astype(jnp.float32)
+        if residual is not None:
+            v = v + residual
+        total, err = quantized_group_sum(
+            v, axis_name, wire_dtype, axis_index_groups=dcn_groups
+        )
+        return total.astype(orig), err
     if wire_dtype is not None and jnp.issubdtype(orig, jnp.floating) and (
         jnp.dtype(wire_dtype).itemsize < jnp.dtype(orig).itemsize
     ):
         x = x.astype(wire_dtype)
     x = lax.psum(x, axis_name, axis_index_groups=dcn_groups)
-    return x.astype(orig)
+    return x.astype(orig), None
 
 
 def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
                      dcn: int = 1, wire_dtype=None,
-                     bucket_bytes: int | None = None) -> PyTree:
+                     bucket_bytes: int | None = None,
+                     reverse: bool = False, residual: PyTree | None = None):
     """The boundary gradient reduction: bucket-fused, hierarchical when the
     mesh is multi-slice, wire-compressed. SUM semantics — callers divide by
     world size (and the accumulation factor) themselves.
@@ -450,29 +567,88 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
     ``hierarchical_psum`` over (``data_axis`` factored by ``dcn``) +
     ``extra_axes`` when ``dcn > 1``; a flat psum over all axes, cast to
     ``wire_dtype`` first (compress-then-reduce, Horovod Compression.fp16
-    semantics), when ``dcn == 1`` — and the tree restored. The collective
-    count is therefore the bucket count: at most
+    semantics) — or a `quantized_group_sum` for int8/fp8 wires — when
+    ``dcn == 1``; and the tree restored. The collective count is therefore
+    the bucket count: at most
     ``ceil(total_bytes / bucket_bytes) + n_dtypes - 1`` reductions per call
-    regardless of how many leaves the model has."""
+    regardless of how many leaves the model has.
+
+    ``reverse=True`` buckets AND issues the reductions last-leaf-first
+    (Horovod's fusion order — overlappable with the producing backward;
+    elementwise-identical results for non-quantized wires, since bucket
+    boundaries never mix values).
+
+    ``residual``: error-feedback state for quantized wires — a pytree
+    matching ``tree`` (f32 leaves). It is added to each bucket's
+    pre-quantization value and the call returns ``(reduced_tree,
+    new_residual_tree)`` where the new residual is this shard's
+    untransmitted quantization remainder; without it the return is just
+    the reduced tree (and quantization bias goes uncorrected)."""
     from horovod_tpu.parallel import mesh as mesh_lib
 
     data_axis = data_axis or mesh_lib.DATA_AXIS
-    buckets, spec = flatten_buckets(tree, bucket_bytes)
-
-    def reduce_one(b):
-        if dcn > 1:
-            return hierarchical_psum(
-                b, data_axis, dcn, extra_axes=extra_axes,
-                wire_dtype=wire_dtype,
+    buckets, spec = flatten_buckets(tree, bucket_bytes, reverse=reverse)
+    res_buckets = [None] * len(buckets)
+    if residual is not None:
+        res_buckets, _ = flatten_buckets(
+            residual, bucket_bytes, reverse=reverse
+        )
+        # The residual is bucketed by ITS leaves' dtype grouping (all
+        # f32); a mixed-dtype gradient tree would group differently and
+        # the two bucket lists would silently misalign — require
+        # identical boundaries (the trainer casts grads to f32 before
+        # reducing, so its buckets always align).
+        if [jnp.shape(b) for b in res_buckets] != [
+            jnp.shape(b) for b in buckets
+        ]:
+            raise ValueError(
+                "error-feedback residual buckets do not align with the "
+                "gradient buckets — the residual (f32 leaves) must "
+                "bucket identically to the gradient tree; cast the "
+                "gradients to float32 before reduce_gradients"
             )
+
+    def reduce_one(b, r):
         orig = b.dtype
+        if dcn > 1:
+            return _hierarchical_psum_err(
+                b, data_axis, dcn, extra_axes=extra_axes,
+                wire_dtype=wire_dtype, residual=r,
+            )
+        if is_quantized_wire(wire_dtype) and jnp.issubdtype(
+            orig, jnp.floating
+        ):
+            v = b.astype(jnp.float32)
+            if r is not None:
+                v = v + r
+            total, err = quantized_group_sum(
+                v, (data_axis, *extra_axes), wire_dtype
+            )
+            return total.astype(orig), err
         if wire_dtype is not None and jnp.issubdtype(orig, jnp.floating) and (
             jnp.dtype(wire_dtype).itemsize < jnp.dtype(orig).itemsize
         ):
             b = b.astype(wire_dtype)
-        return lax.psum(b, (data_axis, *extra_axes)).astype(orig)
+        return lax.psum(b, (data_axis, *extra_axes)).astype(orig), None
 
-    return unflatten_buckets([reduce_one(b) for b in buckets], spec)
+    reduced, errors = zip(*[
+        reduce_one(b, r) for b, r in zip(buckets, res_buckets)
+    ]) if buckets else ((), ())
+    out = unflatten_buckets(list(reduced), spec)
+    if residual is None:
+        return out
+    new_res = unflatten_buckets(
+        [
+            e if e is not None else jnp.zeros_like(r)
+            for e, r in zip(errors, res_buckets)
+        ],
+        spec,
+    )
+    # The residual tree mirrors the GRADIENT tree's dtypes through the
+    # spec; force f32 leaves (error mass must not round through a 16-bit
+    # parameter dtype between steps).
+    new_res = jax.tree.map(lambda e: e.astype(jnp.float32), new_res)
+    return out, new_res
 
 
 def metric_mean(metrics: dict, axis_name=None) -> dict:

@@ -195,12 +195,47 @@ def build_state(trainer, sample_x: np.ndarray, sample_y=None) -> TrainState:
         )
         trainer.state = state
     else:
+        if getattr(trainer, "_ef", False):
+            # The error-feedback residual is PER-SHARD state, not a
+            # replica: its leading axis is the shard axis, placed over the
+            # data axes so each shard owns exactly its own remainder row.
+            # It is also the one n_shards-x-model-sized leaf in the state,
+            # so it must NEVER materialize dense: init the opt state under
+            # jit with the residual's out_sharding set — XLA writes each
+            # device's rows only — and keep it out of replicate() below
+            # (which would stage full copies on every device).
+            rep = sharding_lib.replicated(trainer.mesh)
+            shard0 = jax.sharding.NamedSharding(
+                trainer.mesh,
+                jax.sharding.PartitionSpec(
+                    (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+                ),
+            )
+            shapes = jax.eval_shape(trainer.tx.init, params)
+            out_sh = jax.tree.map(lambda _: rep, shapes)
+            out_sh = out_sh.replace(
+                ef_residual=jax.tree.map(
+                    lambda _: shard0, shapes.ef_residual
+                )
+            )
+            opt_state = jax.jit(trainer.tx.init, out_shardings=out_sh)(
+                params
+            )
+            res = opt_state.ef_residual
+            opt_state = opt_state.replace(ef_residual=None)
+        else:
+            opt_state, res = trainer.tx.init(params), None
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
-            opt_state=trainer.tx.init(params),
+            opt_state=opt_state,
             rng=state_rng,
             model_state=model_state or None,
         )
-        trainer.state = sharding_lib.replicate(state, trainer.mesh)
+        state = sharding_lib.replicate(state, trainer.mesh)
+        if res is not None:
+            state = state.replace(
+                opt_state=state.opt_state.replace(ef_residual=res)
+            )
+        trainer.state = state
     return trainer.state

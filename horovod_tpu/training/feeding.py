@@ -458,7 +458,20 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
 
     # Batches are staged onto the devices by a background thread while
     # the current step computes — transfer enqueue never blocks dispatch.
-    run = trainer._train_step if spe == 1 else trainer._train_chunk
+    # The step DONATES each batch (every prefetched chunk is consumed
+    # exactly once), so with the default depth of 2 the path is true
+    # double buffering: two batch-sized device buffers alternate between
+    # "being transferred" and "being consumed", and the consumed one's
+    # memory returns to the allocator at dispatch instead of piling up
+    # behind the queue. HVT_PREFETCH_DEPTH deepens the queue for bursty
+    # producers.
+    from horovod_tpu.analysis import registry
+
+    depth = registry.get_int("HVT_PREFETCH_DEPTH") or 2
+    run = (
+        trainer._train_step_donated if spe == 1
+        else trainer._train_chunk_donated
+    )
     if spe == 1:
         place = (
             trainer._shard if accum == 1
@@ -466,7 +479,7 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
         )
     else:
         place = lambda b: trainer._shard_chunk(b, 2 if accum > 1 else 1)  # noqa: E731
-    prefetcher = DevicePrefetcher(host_chunks(), place)
+    prefetcher = DevicePrefetcher(host_chunks(), place, depth=depth)
     try:
         for epoch in range(initial_epoch, epochs):
             if trainer.stop_training:

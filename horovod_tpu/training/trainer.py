@@ -33,7 +33,12 @@ from horovod_tpu import runtime
 from horovod_tpu.parallel import collectives
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.parallel import sharding as sharding_lib
-from horovod_tpu.training.optimizer import accumulation_spec, compression_dtype
+from horovod_tpu.training.optimizer import (
+    accumulation_spec,
+    compression_dtype,
+    compression_error_feedback,
+    error_feedback_wrap,
+)
 
 PyTree = Any
 
@@ -48,6 +53,42 @@ from horovod_tpu.training.train_state import (  # noqa: F401 — re-exported:
     _run_train_end,
     _teardown_callbacks,
 )
+
+def _adapt_ef_residual(host_state, built_state):
+    """Re-cut an error-feedback residual snapshot onto a new world size.
+
+    The residual's leading axis is the old world's shard count; after an
+    elastic reshard the new world's differs, and unlike every other state
+    leaf there is no "correct" per-shard value to re-slice — the residual
+    is untransmitted gradient MASS, and error-feedback correctness only
+    needs the TOTAL eventually added back. Conserve it: sum the old
+    shards' remainders and spread the total evenly over the new shard
+    axis. Same-shape snapshots (plain restarts) pass through untouched."""
+    try:
+        host_res = host_state.opt_state.ef_residual
+        built_res = built_state.opt_state.ef_residual
+    except AttributeError:
+        # Snapshot predates EF (or carries a bare inner state): leave it
+        # to install_state's structural check to report.
+        return host_state
+
+    def recut(h, b):
+        h = np.asarray(h)
+        shape = jnp.shape(b)
+        if h.shape == tuple(shape):
+            return h
+        if h.ndim == len(shape) and h.shape[1:] == tuple(shape)[1:]:
+            total = h.sum(axis=0)
+            return np.broadcast_to(
+                total / shape[0], tuple(shape)
+            ).astype(h.dtype).copy()
+        return h  # unrelated mismatch — let install_state raise
+
+    adapted = jax.tree.map(recut, host_res, built_res)
+    return host_state.replace(
+        opt_state=host_state.opt_state.replace(ef_residual=adapted)
+    )
+
 
 class Trainer:
     """compile+fit+evaluate+predict for a flax module over a device mesh.
@@ -76,6 +117,8 @@ class Trainer:
         steps_per_execution: int = 1,
         shard_update: bool = False,
         bucket_bytes: int | None = None,
+        overlap_reduction: bool | None = None,
+        bucket_order: str | None = None,
     ):
         self.module = module
         self.tx = optimizer
@@ -169,6 +212,30 @@ class Trainer:
             or registry.get_int("HVT_BUCKET_BYTES")
             or collectives.DEFAULT_BUCKET_BYTES
         )
+        # Overlap the boundary reduction with the tail of the backward
+        # (Horovod's tensor-fusion + overlap design, arXiv:1802.05799):
+        # the LAST microbatch of the accumulation scan is peeled into the
+        # step's straight-line computation, so its backward and the
+        # bucket-wise reduction sit in ONE schedulable region — XLA's
+        # latency-hiding scheduler can then start a bucket's collective
+        # (async all-reduce/all-gather start/done pairs on TPU) as soon as
+        # that bucket's gradients are final, while earlier layers'
+        # backward still computes. Identical arithmetic to the serialized
+        # form (same addition order, same bucket values) — structure only.
+        self._overlap = (
+            bool(overlap_reduction)
+            if overlap_reduction is not None
+            else registry.get_flag("HVT_OVERLAP_REDUCTION")
+        )
+        # Bucket issue order: 'reverse' (default) walks the gradient leaves
+        # last-first, so the first-issued buckets are the ones the backward
+        # produces first — the order that makes the overlap above real.
+        order = bucket_order or registry.get_str("HVT_BUCKET_ORDER")
+        if order not in ("reverse", "forward"):
+            raise ValueError(
+                f"bucket_order must be 'reverse' or 'forward', got {order!r}"
+            )
+        self._bucket_reverse = order == "reverse"
         # Multi-slice factor of the data axis (1 on single-slice meshes):
         # when > 1, the boundary reduction runs two-hop — ICI sub-axis in
         # full precision, DCN sub-axis in the compression dtype
@@ -213,6 +280,20 @@ class Trainer:
                 "gradients — pick one (accumulation already delivers the "
                 "communication saving ZeRO-1's reduce-scatter amortizes)"
             )
+        # Quantized-wire error feedback (compression='int8'/'fp8' with
+        # error_feedback=True): the per-shard untransmitted quantization
+        # remainder lives in opt_state (`ErrorFeedbackState`, one
+        # [n_shards, *param] f32 leaf per parameter, leading axis sharded
+        # over the data axes) so checkpoints, broadcasts and elastic
+        # commits carry it with no extra plumbing. The step reads it into
+        # the boundary reduction and writes the new remainder back.
+        self._ef = collectives.is_quantized_wire(
+            self._comm_dtype
+        ) and compression_error_feedback(optimizer)
+        if self._ef:
+            self.tx = error_feedback_wrap(
+                self.tx, mesh_lib.dp_size(self.mesh)
+            )
 
         def forward_loss(variables, x, y, rng):
             """Shared train-mode forward: (core_loss+aux, acc, updated, sown
@@ -239,10 +320,11 @@ class Trainer:
                 acc = _accuracy(out, y)
             return loss, acc, (dict(updated) if updated else None), sm
 
-        def explicit_grads(state: TrainState, xs, ys, step_rng):
-            """(loss, acc, model_state, sown_metrics, grads) with the
-            cross-worker gradient reduction made explicit — the
-            K-microbatch accumulating, bucket-fused, wire-compressed step.
+        def explicit_grads(state: TrainState, xs, ys, step_rng, residual):
+            """(loss, acc, model_state, sown_metrics, grads, new_residual)
+            with the cross-worker gradient reduction made explicit — the
+            K-microbatch accumulating, bucket-fused, wire-compressed,
+            backward-overlapped step.
 
             ``xs``/``ys`` leaves are [K, G, ...] microbatch stacks (K =
             backward_passes_per_step; the plain-compression K == 1 case is
@@ -254,13 +336,36 @@ class Trainer:
             (Horovod tensor-fusion semantics, `collectives.
             reduce_gradients`), each bucket psum'd in the 16-bit wire
             dtype when compression is on (compress, ring allreduce-SUM on
-            the wire, decompress, then average), and two-hop on a
+            the wire, decompress, then average) — or gather-summed with a
+            per-bucket scale for int8/fp8 wires — and two-hop on a
             multi-slice mesh — the ICI sub-axis in full precision, only
             the DCN sub-axis in the compression dtype (EQuARX-style).
             Horovod's accumulation contract holds: the K grads are SUMMED
             (``average_aggregated_gradients=False``, the default) or
             averaged; reported loss/accuracy are the mean over the K
             microbatches (what one K·B-batch step would report).
+
+            Overlap (HVT_OVERLAP_REDUCTION, default on): microbatches
+            0..K-2 accumulate inside a `lax.scan`, but the LAST
+            microbatch's forward/backward is peeled into the step's
+            straight-line region, immediately followed by the bucket-wise
+            boundary reduction issued in reverse bucket order
+            (last-produced gradients first, HVT_BUCKET_ORDER). A
+            collective after a scan can never start before the scan
+            returns; with the peel, each bucket's reduction depends only
+            on that bucket's leaves, so XLA's latency-hiding scheduler is
+            free to overlap bucket i's ICI/DCN transfer with the
+            still-running backward of earlier layers — Horovod's
+            tensor-fusion + overlap design (arXiv:1802.05799) as compiled
+            structure. Arithmetic is IDENTICAL to the serialized form
+            (same addition order, same bucket contents): the knob changes
+            schedulability, not semantics.
+
+            ``residual``/``new_residual``: the quantized-wire
+            error-feedback state (None unless compression='int8'/'fp8'
+            with error_feedback) — [n_shards, *param] f32 leaves, this
+            shard's slice added to the pre-quantization bucket values and
+            replaced by the new untransmitted remainder.
 
             Contract deltas vs the SPMD path (both only observable with
             non-iid extras, never with the plain CE objective):
@@ -282,7 +387,7 @@ class Trainer:
             avg_k = self._accum.average if self._accum is not None else False
             data_axes = (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
 
-            def local(params, ms, xs, ys):
+            def local(params, ms, xs, ys, res):
                 # Distinct dropout per shard (the SPMD path's global mask is
                 # partitioned; here each shard must draw its own), and per
                 # microbatch when accumulating.
@@ -312,7 +417,14 @@ class Trainer:
                 grads = jax.tree.map(
                     lambda g: g.astype(jnp.float32), grads
                 )
-                if K > 1:
+                # Overlap structure: peel the LAST microbatch out of the
+                # scan so its backward and the bucket reductions share one
+                # straight-line region (see the docstring); the scan then
+                # covers microbatches 1..K-2 only. Serialized form (knob
+                # off) scans 1..K-1 — same additions, same results.
+                peel = self._overlap and K > 1
+                n_scan = K - 1 - (1 if peel else 0)
+                if n_scan > 0:
                     def micro(carry, inp):
                         g_acc, ms_c, loss_s, acc_s, sm_s = carry
                         k, xb, yb = inp
@@ -331,22 +443,51 @@ class Trainer:
                     (grads, new_ms, loss, acc, sm), _ = jax.lax.scan(
                         micro, (grads, new_ms, loss, acc, sm),
                         (
-                            jnp.arange(1, K),
-                            jax.tree.map(lambda a: a[1:], xs),
-                            jax.tree.map(lambda a: a[1:], ys),
+                            jnp.arange(1, 1 + n_scan),
+                            jax.tree.map(
+                                lambda a: a[1 : 1 + n_scan], xs
+                            ),
+                            jax.tree.map(
+                                lambda a: a[1 : 1 + n_scan], ys
+                            ),
                         ),
                     )
+                if peel:
+                    xl = jax.tree.map(lambda a: a[K - 1], xs)
+                    yl = jax.tree.map(lambda a: a[K - 1], ys)
+                    (l, (a, new_ms, smk)), g = grad_fn(
+                        params, xl, yl, new_ms,
+                        jax.random.fold_in(shard_rng, K - 1),
+                    )
+                    grads = jax.tree.map(
+                        lambda A, G: A + G.astype(jnp.float32), grads, g
+                    )
+                    loss, acc = loss + l, acc + a
+                    sm = jax.tree.map(jnp.add, sm, smk)
+                if K > 1:
                     loss, acc = loss / K, acc / K
                     sm = jax.tree.map(lambda v: v / K, sm)
-                # THE one cross-worker reduction of the optimizer step.
-                grads = collectives.reduce_gradients(
+                # THE one cross-worker reduction of the optimizer step —
+                # bucket-wise, reverse-ordered, error-feedback-corrected.
+                res_in = (
+                    None if res is None
+                    else jax.tree.map(lambda r: r[0], res)
+                )
+                reduced = collectives.reduce_gradients(
                     grads,
                     data_axis=mesh_lib.DATA_AXIS,
                     extra_axes=(mesh_lib.FSDP_AXIS,),
                     dcn=self._dcn,
                     wire_dtype=comm,
                     bucket_bytes=self._bucket_bytes,
+                    reverse=self._bucket_reverse,
+                    residual=res_in,
                 )
+                if res is None:
+                    grads, new_res = reduced, None
+                else:
+                    grads, err = reduced
+                    new_res = jax.tree.map(lambda r: r[None], err)
                 # Sum → Horovod semantics: divide by world size (mean over
                 # workers) and, only with average_aggregated_gradients, by
                 # K (mean over passes; the default keeps the K-pass SUM).
@@ -368,17 +509,18 @@ class Trainer:
                         else v,
                         new_ms,
                     )
-                return loss, acc, new_ms, sm, grads
+                return loss, acc, new_ms, sm, grads, new_res
 
             P = jax.sharding.PartitionSpec
             stacked = P(None, data_axes)
+            sharded0 = P(data_axes)  # residual: leading shard axis
             return compat.shard_map(
                 local,
                 mesh=self.mesh,
-                in_specs=(P(), P(), stacked, stacked),
-                out_specs=(P(), P(), P(), P(), P()),
+                in_specs=(P(), P(), stacked, stacked, sharded0),
+                out_specs=(P(), P(), P(), P(), P(), sharded0),
                 check_vma=False,
-            )(state.params, state.model_state, xs, ys)
+            )(state.params, state.model_state, xs, ys, residual)
 
         def train_step(state: TrainState, batch, update_scale, metric_acc):
             x, y = batch
@@ -411,14 +553,24 @@ class Trainer:
                     # Plain compression: one microbatch, stacked to [1, G].
                     sx = jax.tree.map(lambda a: a[None], x)
                     sy = jax.tree.map(lambda a: a[None], y)
-                loss, acc, model_state, sown_metrics, grads = explicit_grads(
-                    state, sx, sy, step_rng
+                residual = (
+                    state.opt_state.ef_residual if self._ef else None
+                )
+                (loss, acc, model_state, sown_metrics, grads,
+                 new_residual) = explicit_grads(
+                    state, sx, sy, step_rng, residual
                 )
             else:
+                new_residual = None
                 (loss, (acc, model_state, sown_metrics)), grads = (
                     jax.value_and_grad(loss_of, has_aux=True)(state.params)
                 )
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+            if self._ef:
+                # Install the boundary reduction's new untransmitted
+                # remainder (the EF wrapper's update passed the old one
+                # through untouched).
+                opt_state = opt_state.replace(ef_residual=new_residual)
             updates = jax.tree.map(lambda u: u * update_scale, updates)
             params = optax.apply_updates(state.params, updates)
             if self._param_shardings is not None:
@@ -610,10 +762,33 @@ class Trainer:
             logits = self.module.apply(_eval_variables(state), x, train=False)
             return jax.nn.softmax(logits, axis=-1)
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
-        self._train_chunk = jax.jit(train_chunk, donate_argnums=(0,))
+        # Error-feedback states must NOT donate the TrainState: the
+        # [n_shards, ...] dim-0-sharded residual gets input→output
+        # donation-aliased, and on this jax floor (0.4.37 CPU) an
+        # executable carrying that aliasing SEGFAULTS when reloaded from
+        # the persistent compilation cache (reproduced: second
+        # same-process int8+EF fit dies inside the deserialized step;
+        # clean with donation off or error_feedback=False). EF already
+        # pays a params-sized residual; the lost donation costs one more
+        # transient state copy.
+        state_donate = () if self._ef else (0,)
+        self._train_step = jax.jit(train_step, donate_argnums=state_donate)
+        self._train_chunk = jax.jit(train_chunk, donate_argnums=state_donate)
+        # Streamed-fit variants that ALSO donate the batch: each prefetched
+        # chunk is consumed exactly once, so its transfer buffer returns to
+        # the allocator at dispatch — with the double-buffered prefetcher
+        # (data/prefetch.py) two batch-sized buffers alternate instead of
+        # accumulating. Bench/tests reuse batches across calls and must
+        # keep the non-donating forms above.
+        self._train_step_donated = jax.jit(
+            train_step, donate_argnums=state_donate + (1,)
+        )
+        self._train_chunk_donated = jax.jit(
+            train_chunk, donate_argnums=state_donate + (1,)
+        )
         self._train_epoch = jax.jit(
-            train_epoch, static_argnums=(5, 6, 7), donate_argnums=(0,)
+            train_epoch, static_argnums=(5, 6, 7),
+            donate_argnums=state_donate,
         )
         self._eval_step = jax.jit(eval_step)
         self._eval_epoch = jax.jit(eval_epoch, static_argnums=(2, 3))
@@ -661,6 +836,8 @@ class Trainer:
         Call after `build()`; returns the installed state."""
         if self.state is None:
             raise RuntimeError("call build() before install_state()")
+        if self._ef:
+            host_state = _adapt_ef_residual(host_state, self.state)
 
         def place(host_leaf, built_leaf):
             if isinstance(built_leaf, jax.Array):
