@@ -1,0 +1,270 @@
+"""File-backed packed-LM pretraining under the elastic supervisor — the
+long-horizon soak for durable stream cursors (ROADMAP item 4).
+
+The workload the reliability spine was built for, end to end:
+
+1. a deterministic document corpus is packed once
+   (`packing.pack_documents` → `next_token_pairs`) and written as an
+   on-disk shard directory (`filedataset.write_shards`) — the dataset
+   lives on disk, the hosts only mmap the rows of the current batch;
+2. each elastic generation cuts its per-process stripe with
+   `FileDataset.reshard(rank, size)` and feeds
+   `FileDataset.pairs_stream(...)` — the resumable view whose
+   ``batches(skip=, start_epoch=, batches_per_epoch=)`` hook
+   `Trainer.fit` drives, so EVERY recovery path (supervised restart,
+   elastic shrink/grow, mid-epoch rescale) resumes the byte stream at
+   the exact committed position — including epochs that predate the
+   resume call (the anchored-stream contract, `data/stream.py`);
+3. faults ride `HVT_FAULT` (kill / leave / corrupt) and the transient-
+   read chaos knob `HVT_DATA_FAULT_READS` exercises the bounded
+   retry-with-backoff (`HVT_DATA_RETRIES`/`HVT_DATA_BACKOFF_S`).
+
+``DIGEST_LOG=<path>`` appends one JSONL record per CONSUMED batch —
+``{"epoch", "step", "rank", "world", "sha256"}`` — the per-batch
+byte-identity proof the soak e2e (tests/test_stream_resume_e2e.py)
+checks against an uninterrupted control: any replayed, skipped or
+re-anchored batch shows up as a digest mismatch.
+
+Launch (CI form: `launch/jobs/packed-lm-soak-2proc.yaml`):
+
+    python -m horovod_tpu.launch run --nprocs 3 --elastic \
+        --min-ranks 2 -- python examples/packed_lm_pretrain.py
+
+Unlaunched it degrades to a plain single-process run (local one-member
+rendezvous), which is also the kill/relaunch e2e's shape.
+
+Smoke knobs: SEQ_LEN, DOCS, VOCAB, DMODEL, NLAYERS, BATCH, DRIVE_STEPS,
+DRIVE_EPOCHS, DIGEST_LOG.
+"""
+
+import hashlib
+import json
+import os
+import time
+
+try:
+    import horovod_tpu  # noqa: F401 — installed (`pip install -e .`)
+except ModuleNotFoundError:  # bare source checkout
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvt
+from horovod_tpu import checkpoint, elastic, metrics
+from horovod_tpu.data.filedataset import FileDataset, write_shards
+from horovod_tpu.data.packing import next_token_pairs, pack_documents
+from horovod_tpu.models.transformer import TransformerLM
+
+import flax.linen as nn
+
+SEED = 17  # the data-stream seed every generation anchors to
+
+
+def synthetic_corpus(n_docs: int, vocab: int, seed: int = 0):
+    """Documents of motif repeats: learnable within-document structure."""
+    rng = np.random.RandomState(seed)
+    docs = []
+    for _ in range(n_docs):
+        motif = rng.randint(1, vocab, size=rng.randint(4, 12))
+        docs.append(np.tile(motif, rng.randint(2, 8)).astype(np.int32))
+    return docs
+
+
+def ensure_corpus_dir(root: str, seq_len: int, vocab: int,
+                      n_docs: int, rank: int) -> str:
+    """Pack the corpus to disk shards exactly once, atomically: the
+    writer builds into a temp dir and renames it into place (the index
+    file inside was itself written last, atomically), losers/waiters
+    poll for the index. Re-entrant across restarts — a relaunched
+    process finds the directory and skips straight to mapping it."""
+    path = os.path.join(root, "packed-corpus")
+    index = os.path.join(path, "index.json")
+    if not os.path.exists(index) and rank == 0:
+        docs = synthetic_corpus(n_docs, vocab, seed=0)
+        toks, seg, _ = pack_documents(docs, seq_len=seq_len + 1)
+        x, y, w = next_token_pairs(toks, seg)
+        xs = np.stack([x, seg[:, :-1]], axis=-1)          # [B, T, 2] int32
+        ys = np.stack([y, w.astype(np.int32)], axis=-1)   # targets ⊕ weights
+        tmp = f"{path}.tmp.{os.getpid()}"
+        write_shards({"x": xs, "y": ys}, tmp, shard_size=64)
+        try:
+            os.rename(tmp, path)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # lost the race
+    deadline = time.time() + 120
+    while not os.path.exists(index):
+        if time.time() > deadline:
+            raise RuntimeError(f"corpus never appeared at {path}")
+        time.sleep(0.1)
+    return path
+
+
+class PackedLM(nn.Module):
+    """TransformerLM with the per-row segment ids carried IN the input
+    ([B, T, 2] = tokens ⊕ ids) — the lm_packed_pretraining.py feed."""
+
+    inner: TransformerLM
+
+    @nn.compact
+    def __call__(self, xs, *, train: bool = False):
+        return self.inner(xs[..., 0], train=train, segment_ids=xs[..., 1])
+
+
+def masked_ce(logits, y2):
+    """Per-row mean CE over real next-token targets (weights channel)."""
+    targets = y2[..., 0]
+    weights = y2[..., 1].astype(jnp.float32)
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    return (per * weights).sum(-1) / jnp.maximum(weights.sum(-1), 1.0)
+
+
+class DigestTee:
+    """Wrap a resumable (x, y) stream, appending a sha256 per CONSUMED
+    batch to a JSONL — the byte-identity audit trail the soak compares
+    across faulted and control runs. Exposes the same ``batches(skip=,
+    start_epoch=, batches_per_epoch=)`` hook, so fit's deterministic
+    fast-forward passes straight through."""
+
+    def __init__(self, inner, path: str, rank: int, world: int):
+        self.inner = inner
+        self.path = path
+        self.rank, self.world = rank, world
+
+    def batches(self, skip: int = 0, *, start_epoch: int = 0,
+                batches_per_epoch: int | None = None):
+        epoch, step = int(start_epoch), int(skip)
+        for x, y in self.inner.batches(
+            skip=skip, start_epoch=start_epoch,
+            batches_per_epoch=batches_per_epoch,
+        ):
+            sha = hashlib.sha256()
+            sha.update(np.ascontiguousarray(x).tobytes())
+            sha.update(np.ascontiguousarray(y).tobytes())
+            with open(self.path, "a") as f:  # append-only audit stream
+                f.write(json.dumps({
+                    "epoch": epoch, "step": step, "rank": self.rank,
+                    "world": self.world, "sha256": sha.hexdigest(),
+                }) + "\n")
+            step += 1
+            if batches_per_epoch and step >= batches_per_epoch:
+                epoch, step = epoch + 1, 0
+            yield x, y
+
+    def __iter__(self):
+        return self.batches()
+
+
+def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
+    root = os.environ.get("PS_MODEL_PATH", "./models")
+    model_dir = os.path.join(root, "packed-lm")
+    metrics.init(sync_tensorboard=True)
+
+    seq_len = int(os.environ.get("SEQ_LEN", 32))
+    vocab = int(os.environ.get("VOCAB", 64))
+    corpus = ensure_corpus_dir(
+        root, seq_len, vocab, int(os.environ.get("DOCS", 400)), world.rank
+    )
+    if world.rank == 0:
+        print(
+            f"packed-lm: generation {world.generation} — {world.size} "
+            f"rank(s), resuming at epoch {state.epoch} step {state.step}",
+            flush=True,
+        )
+
+    ds = FileDataset(corpus)
+    batch = int(os.environ.get("BATCH", 8))
+    # Per-generation recut of the per-process stripe from the FULL
+    # on-disk row space — the elastic rescale hook on the file path.
+    stream = ds.reshard(world.rank, world.size).pairs_stream(
+        "x", "y", batch, seed=SEED
+    )
+    digest_log = os.environ.get("DIGEST_LOG")
+    if digest_log:
+        stream = DigestTee(
+            stream, f"{digest_log}.rank{world.rank}",
+            world.rank, world.size,
+        )
+
+    trainer = hvt.Trainer(
+        PackedLM(inner=TransformerLM(
+            vocab_size=vocab,
+            d_model=int(os.environ.get("DMODEL", 32)),
+            n_heads=2,
+            n_layers=int(os.environ.get("NLAYERS", 1)),
+            dropout=0.0,
+        )),
+        hvt.DistributedOptimizer(optax.adamw(hvt.scale_lr(3e-3))),
+        loss=masked_ce,
+        seed=SEED,
+    )
+    sample = ds.gather(np.arange(1))
+    trainer.build(sample["x"], sample["y"])
+
+    if state.state is not None:
+        trainer.install_state(state.state)
+    else:
+        # Fresh process (first generation or a hard-crash relaunch): the
+        # checkpoint fallback, STEP-granular — the progress manifest (and
+        # its embedded stream cursor) land the resume mid-epoch.
+        trainer.state, done, done_step = (
+            checkpoint.restore_latest_and_broadcast(
+                model_dir, trainer.state, mesh=trainer.mesh,
+                reshard=True, with_step=True,
+            )
+        )
+        if elastic.progress_marker(done, done_step) > elastic.progress_marker(
+            state.epoch, state.step
+        ):
+            state.epoch, state.step = done, done_step
+
+    callbacks = [
+        hvt.callbacks.ModelCheckpoint(
+            os.path.join(model_dir, "checkpoint-{epoch}.msgpack")
+        ),
+    ]
+    if world.rank == 0:
+        # Epoch scalars → the platform metrics sink (the CI gate's feed).
+        callbacks.append(hvt.callbacks.ScalarLogger(model_dir))
+    # LAST: commits after checkpoints saw the epoch, then may interrupt.
+    callbacks.append(elastic.ElasticStateCallback(state, state.client))
+
+    n_rows = ds.num_examples // world.size
+    steps = int(os.environ.get("DRIVE_STEPS", 0)) or max(
+        1, n_rows // batch
+    )
+    epochs = int(os.environ.get("DRIVE_EPOCHS", 0)) or 6
+
+    trainer.fit(
+        stream,
+        steps_per_epoch=steps,
+        epochs=epochs,
+        initial_epoch=state.epoch,
+        initial_step=state.step,
+        callbacks=callbacks,
+        verbose=1 if world.rank == 0 else 0,
+    )
+
+
+def main() -> None:
+    if os.environ.get(hvt.runtime.ENV_ELASTIC_COORDINATOR):
+        elastic.run(train)
+    else:
+        coord = elastic.Coordinator(min_ranks=1, max_ranks=1).start()
+        try:
+            elastic.run(train, address=coord.address, member_id="solo")
+        finally:
+            coord.stop()
+    if hvt.rank() == 0:
+        print("TRAINING COMPLETE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

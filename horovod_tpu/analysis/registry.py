@@ -121,6 +121,11 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_SAVE_EVERY_STEPS", "int", 0, "training",
          "ModelCheckpoint mid-epoch save cadence in optimizer steps "
          "(0 = epoch cadence only). Single-file checkpoints only."),
+    Knob("HVT_EPOCH_CHUNK_STEPS", "int", 0, "training",
+         "fit(cache='device'): split each on-device epoch into compiled "
+         "chunks of this many optimizer steps (0 = whole-epoch program), "
+         "so on_batch_end fires per chunk and sub-epoch commit/rescale "
+         "cadences work on the device-cached path too."),
     # --- elastic -----------------------------------------------------------
     Knob("HVT_ELASTIC_COORDINATOR", "str", None, "elastic",
          "Rendezvous coordinator `host:port` (supervisor-set); presence "
@@ -157,6 +162,14 @@ KNOBS: dict[str, Knob] = _decl([
          "buffering — the step donates each consumed batch's buffer)."),
     Knob("HVT_DATA_DIR", "path", "~/.cache/horovod_tpu", "data",
          "Dataset cache directory (the keras-layout npz archives)."),
+    Knob("HVT_DATA_RETRIES", "int", 3, "data",
+         "Bounded retries for TRANSIENT dataset I/O failures (shard mmap "
+         "opens, index reads — the flaky-NFS OSError class) before "
+         "failing fast with the checkpoint-fallback escalation "
+         "(0 = no retry)."),
+    Knob("HVT_DATA_BACKOFF_S", "float", 0.05, "data",
+         "Base backoff in seconds between dataset-read retries; doubles "
+         "per attempt (exponential)."),
     # --- observability ------------------------------------------------------
     Knob("HVT_PROFILE", "path", None, "observability",
          "Capture a jax.profiler trace of fit()/bench into this dir — the "
@@ -171,6 +184,10 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_FAULT_STAMP", "path", None, "testing",
          "One-shot stamp file: the fault fires once, never while the "
          "stamp exists — across relaunches."),
+    Knob("HVT_DATA_FAULT_READS", "int", 0, "testing",
+         "Inject N deterministic TRANSIENT read faults (OSError) into "
+         "the dataset-read retry path (data.stream.read_with_retries) — "
+         "the chaos hook for exercising HVT_DATA_RETRIES."),
     # --- examples / bench (read by entry scripts, not the package) ----------
     Knob("HVT_BACKWARD_PASSES", "int", 1, "examples",
          "Gradient-accumulation factor K for the example entry scripts "

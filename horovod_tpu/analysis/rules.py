@@ -19,6 +19,11 @@ designed around, loudly, in CHANGES.md/docstrings) — not generic style:
 * HVT005 — checkpoint-write atomicity: artifact writes go through
   `checkpoint._atomic_write` (atomic rename + ``.sha256`` sidecar); a
   bare truncating ``open`` can tear under crash/preemption (PR 3).
+* HVT006 — data-layer determinism: unseeded host RNG inside
+  ``horovod_tpu/data/`` breaks the durable-stream-cursor contract
+  (every feeding path's order must be a pure function of
+  ``(seed, epoch, pass)`` — `data.stream`); a global-RNG draw or a
+  seedless generator makes resumed byte streams irreproducible.
 
 Heuristics are lexical by design (no dataflow): a collective gated by an
 early ``return`` under a rank check, or a rank value laundered through a
@@ -468,3 +473,73 @@ class CheckpointWriteAtomicity(Rule):
                 yield from walk(child, child_fn)
 
         yield from walk(tree, None)
+
+
+# --- HVT006 -----------------------------------------------------------------
+
+# The data layer the durable-stream-cursor contract covers: every feeding
+# path here must derive its order purely from (seed, epoch, pass).
+_DATA_LAYER_PREFIX = "horovod_tpu/data/"
+
+# Draw/mutate functions on the GLOBAL numpy/stdlib RNGs — process-state-
+# dependent, hence irreproducible across a resume.
+_GLOBAL_RNG_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "sample", "choice", "shuffle", "permutation", "uniform", "normal",
+    "standard_normal", "randrange", "getrandbits", "bytes", "seed",
+}
+
+# Generator constructors that MUST carry an explicit seed argument.
+_SEEDED_CTORS = {"RandomState", "default_rng", "Generator", "Random",
+                 "SeedSequence", "PCG64", "Philox"}
+
+
+@register_rule
+class DataLayerSeededRng(Rule):
+    rule_id = "HVT006"
+    title = "unseeded RNG in the data layer (durable-cursor determinism)"
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        if not module.relpath.startswith(_DATA_LAYER_PREFIX):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolved_dotted(module, node.func)
+            if resolved is None:
+                continue
+            tail = resolved.split(".")[-1]
+            on_np_random = resolved.startswith(
+                ("numpy.random.", "np.random.")
+            )
+            on_stdlib_random = (
+                resolved.startswith("random.")
+                and resolved.count(".") == 1
+            )
+            if tail in _GLOBAL_RNG_FNS and (
+                on_np_random or on_stdlib_random
+            ):
+                yield module.finding(
+                    self.rule_id, node,
+                    f"`{resolved}` draws from the GLOBAL RNG: the order "
+                    "it produces depends on process history, so a "
+                    "resumed stream cannot reproduce it — the durable-"
+                    "cursor byte-identity contract (data/stream.py) "
+                    "requires every data-layer draw to come from a "
+                    "generator seeded purely by (seed, epoch, pass); "
+                    "use np.random.RandomState(stream.epoch_seed(...))",
+                )
+            elif tail in _SEEDED_CTORS and (
+                on_np_random or resolved == "random.Random"
+            ):
+                has_seed = bool(node.args) or any(
+                    kw.arg in ("seed", "entropy") for kw in node.keywords
+                )
+                if not has_seed:
+                    yield module.finding(
+                        self.rule_id, node,
+                        f"`{resolved}()` without an explicit seed draws "
+                        "OS entropy — the stream it feeds is "
+                        "irreproducible on resume; pass a seed derived "
+                        "from (seed, epoch, pass) (`stream.epoch_seed`)",
+                    )

@@ -163,7 +163,8 @@ def _read_verified(path: str) -> bytes:
     return data
 
 
-def save(path: str, state: PyTree, progress: tuple | None = None) -> str:
+def save(path: str, state: PyTree, progress: tuple | None = None,
+         cursor: dict | None = None) -> str:
     """Serialize a state pytree to one file, atomically. Caller gates rank
     (callbacks do; direct users should check ``runtime.is_primary()``).
 
@@ -172,6 +173,13 @@ def save(path: str, state: PyTree, progress: tuple | None = None) -> str:
     OPTIMIZER-step granularity (step 0 = an epoch boundary). The manifest
     records the payload's sha256 so a torn save can never pair fresh
     weights with a stale step (see `checkpoint_progress`).
+
+    ``cursor`` (a `data.stream.StreamCursor` dict — the
+    `Trainer.stream_cursor` record) rides inside the manifest: the
+    DURABLE data-stream position this checkpoint resumes at, including
+    the stream-format version, so `checkpoint_cursor` can refuse a
+    cursor from an incompatible stream derivation loudly instead of
+    silently re-anchoring the byte stream.
 
     Refuses cross-process-sharded state loudly: no single process holds it,
     so a one-file checkpoint is impossible — use `save_sharded` (the
@@ -188,10 +196,13 @@ def save(path: str, state: PyTree, progress: tuple | None = None) -> str:
     _atomic_write(path, data, digest=True)
     if progress is not None:
         epoch, step = progress
-        _atomic_write(path + META_SUFFIX, json.dumps({
+        meta = {
             "epoch": int(epoch), "step": int(step),
             "payload_sha256": hashlib.sha256(data).hexdigest(),
-        }).encode())
+        }
+        if cursor is not None:
+            meta["cursor"] = dict(cursor)
+        _atomic_write(path + META_SUFFIX, json.dumps(meta).encode())
     return path
 
 
@@ -232,7 +243,8 @@ class _SaveThread:
 
 
 def save_async(path: str, state: PyTree,
-               progress: tuple | None = None) -> _SaveThread:
+               progress: tuple | None = None,
+               cursor: dict | None = None) -> _SaveThread:
     """`save` without blocking the training loop.
 
     The state is first copied ON DEVICE (cheap, and immune to the training
@@ -267,7 +279,9 @@ def save_async(path: str, state: PyTree,
         return jnp.copy(a)
 
     snapshot = jax.tree.map(snap, state)
-    return _SaveThread(lambda: save(path, snapshot, progress=progress))
+    return _SaveThread(
+        lambda: save(path, snapshot, progress=progress, cursor=cursor)
+    )
 
 
 def restore(path: str, template: PyTree, *, reshard: bool = False) -> PyTree:
@@ -324,7 +338,8 @@ def leaf_shard_pieces(leaf) -> dict:
 
 
 def save_sharded(path: str, state: PyTree,
-                 progress: tuple | None = None) -> str:
+                 progress: tuple | None = None,
+                 cursor: dict | None = None) -> str:
     """Distributed checkpoint: EVERY process calls this (unlike `save`).
 
     Each process writes one ``shard-{p}.msgpack`` holding exactly the shard
@@ -368,6 +383,10 @@ def save_sharded(path: str, state: PyTree,
             index["progress"] = {
                 "epoch": int(progress[0]), "step": int(progress[1]),
             }
+        if cursor is not None:
+            # The durable data-stream cursor (sharded twin of the
+            # .meta.json "cursor" record — see `save`).
+            index["cursor"] = dict(cursor)
         # digest=True: the index gets its own .sha256 sidecar like every
         # payload file — a bit-rotted index would otherwise misdirect the
         # whole restore (wrong n_processes tears discovery; corrupted
@@ -381,7 +400,8 @@ def save_sharded(path: str, state: PyTree,
 
 
 def save_sharded_async(path: str, state: PyTree,
-                       progress: tuple | None = None) -> _SaveThread:
+                       progress: tuple | None = None,
+                       cursor: dict | None = None) -> _SaveThread:
     """`save_sharded` off the training loop: snapshot every array leaf on
     device (buffer-donation immunity, same rationale as `save_async` — the
     copy is a communication-free SPMD identity every process enters), then
@@ -391,7 +411,9 @@ def save_sharded_async(path: str, state: PyTree,
     snapshot = jax.tree.map(
         lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, state
     )
-    return _SaveThread(lambda: save_sharded(path, snapshot, progress=progress))
+    return _SaveThread(
+        lambda: save_sharded(path, snapshot, progress=progress, cursor=cursor)
+    )
 
 
 def _sharded_complete(path: str) -> bool:
@@ -568,7 +590,7 @@ def restore_sharded(path: str, template: PyTree, *,
 
 
 def save_checkpoint(directory: str, state: PyTree, epoch: int,
-                    step: int = 0) -> str:
+                    step: int = 0, cursor: dict | None = None) -> str:
     """Epoch-numbered checkpoint (``checkpoint-{epoch}.msgpack``), parity
     with the reference's per-epoch template (tensorflow2_keras_mnist.py:87).
     Epochs are 1-based (epoch 0 means "no checkpoint" on resume).
@@ -581,11 +603,11 @@ def save_checkpoint(directory: str, state: PyTree, epoch: int,
     if is_cross_process_sharded(state):
         return save_sharded(
             os.path.join(directory, f"checkpoint-{epoch}{SHARDED_SUFFIX}"),
-            state, progress=(epoch, step),
+            state, progress=(epoch, step), cursor=cursor,
         )
     return save(
         os.path.join(directory, f"checkpoint-{epoch}.msgpack"), state,
-        progress=(epoch, step),
+        progress=(epoch, step), cursor=cursor,
     )
 
 
@@ -632,6 +654,31 @@ def checkpoint_progress(path: str) -> tuple[int, int]:
         return int(rec["epoch"]), int(rec["step"])
     except (OSError, ValueError, KeyError):
         return fallback
+
+
+def checkpoint_cursor(path: str):
+    """The durable data-stream cursor a checkpoint artifact records
+    (`data.stream.StreamCursor`), or None when the artifact predates
+    cursors / recorded none. A PRESENT cursor with an incompatible
+    format version raises `stream.StreamCursorError` LOUDLY — the
+    anchored-stream derivation changed, so honouring the recorded
+    position would silently resume a different byte stream; the caller
+    must degrade to epoch-granular resume explicitly (the progress
+    manifest stays readable via `checkpoint_progress`), never guess."""
+    from horovod_tpu.data import stream as stream_lib
+
+    try:
+        if os.path.isdir(path):
+            with open(os.path.join(path, INDEX_FILE)) as f:
+                rec = json.load(f).get("cursor")
+        else:
+            with open(path + META_SUFFIX) as f:
+                rec = json.load(f).get("cursor")
+    except (OSError, ValueError):
+        return None
+    if rec is None:
+        return None
+    return stream_lib.StreamCursor.from_dict(rec)
 
 
 def latest_checkpoint(directory: str, *,

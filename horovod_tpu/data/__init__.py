@@ -5,7 +5,13 @@ from horovod_tpu.data.loader import ArrayDataset, training_pipeline  # noqa: F40
 from horovod_tpu.data.native_loader import NativeBatchLoader  # noqa: F401
 from horovod_tpu.data.native_loader import available as native_available  # noqa: F401
 from horovod_tpu.data.packing import (  # noqa: F401
+    PackedLMStream,
     next_token_pairs,
     pack_documents,
     packing_efficiency,
+)
+from horovod_tpu.data.stream import (  # noqa: F401
+    StreamCursor,
+    StreamCursorError,
+    epoch_seed,
 )

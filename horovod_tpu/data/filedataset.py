@@ -13,9 +13,24 @@ batch. This module is that path with zero dependencies:
   gathers exactly the requested rows through the maps (the OS page cache
   is the working set, not a Python copy of the dataset).
 * `.batches(...)` — per-epoch global permutation (seeded), optional
-  repeat, and per-process striping (``shard=(index, count)``), mirroring
-  `ArrayDataset.shard`'s every-count-th-row split. `.pairs('x', 'y', ...)`
-  yields the ``(x, y)`` tuples `Trainer.fit(dataset=...)`` consumes.
+  repeat, and per-process striping (``shard=(index, count)`` or the
+  `.shard(i, n)`/`.reshard(i, n)` view chain, mirroring
+  `ArrayDataset.shard`'s every-count-th-row split). `.pairs('x', 'y',
+  ...)` yields the ``(x, y)`` tuples `Trainer.fit(dataset=...)` consumes;
+  `.pairs_stream(...)` wraps them in a resumable view with the
+  `batches(skip=, start_epoch=, batches_per_epoch=)` hook fit's
+  fast-forward drives.
+
+Durable stream cursors (`data.stream`): every epoch's permutation is a
+PURE function of ``(seed, epoch, pass)`` (`stream.epoch_seed`), so any
+position of the infinite stream — including epochs consumed by a process
+that no longer exists — is reconstructible from a serializable
+`StreamCursor` (`stream_cursor`/`batches_from`), byte-exactly.
+
+Transient-I/O hardening: shard mmap opens go through
+`stream.read_with_retries` — bounded retry-with-backoff
+(``HVT_DATA_RETRIES`` × ``HVT_DATA_BACKOFF_S``) for the flaky-NFS class,
+then a fast, actionable failure pointing at the checkpoint-restart path.
 
 This is the host-side cold path; the hot path stays the same — batches
 land on device through `sharding.shard_batch` exactly like in-memory
@@ -28,6 +43,8 @@ import json
 import os
 
 import numpy as np
+
+from horovod_tpu.data import stream as stream_lib
 
 INDEX_FILE = "index.json"
 _FORMAT = "hvt-shards-v1"
@@ -86,23 +103,75 @@ class FileDataset:
 
     def __init__(self, directory: str):
         self.directory = directory
-        with open(os.path.join(directory, INDEX_FILE)) as f:
-            self.index = json.load(f)
+
+        def read_index():
+            with open(os.path.join(directory, INDEX_FILE)) as f:
+                return json.load(f)
+
+        self.index = stream_lib.read_with_retries(
+            read_index, f"{directory}/{INDEX_FILE}"
+        )
         if self.index.get("format") != _FORMAT:
             raise ValueError(f"not a shard directory: {directory}")
         self.columns = tuple(self.index["columns"])
         self._maps: dict[tuple[int, str], np.ndarray] = {}
+        # Per-process striping view state (ArrayDataset.shard parity):
+        # the full row space is always on disk, so the view is just the
+        # remembered (index, count) — `reshard` recuts from the full set.
+        self._shard_spec: tuple[int, int] | None = None
 
     @property
     def num_examples(self) -> int:
         return int(self.index["n_examples"])
 
+    # --- per-process striping views (ArrayDataset.shard parity) -------------
+
+    def _view(self, spec: tuple[int, int] | None) -> "FileDataset":
+        ds = object.__new__(FileDataset)
+        ds.directory = self.directory
+        ds.index = self.index
+        ds.columns = self.columns
+        ds._maps = self._maps  # shared: same files, same page cache
+        ds._shard_spec = spec
+        return ds
+
+    def shard(self, index: int, count: int) -> "FileDataset":
+        """A view keeping every count-th example starting at ``index`` —
+        the per-process split (`ArrayDataset.shard` semantics: disjoint,
+        exhaustive). The underlying directory always holds the FULL row
+        space, so the view is cheap and `reshard` can recut it."""
+        if not (0 <= index < count):
+            raise ValueError(f"shard index {index} out of range for {count}")
+        return self._view((int(index), int(count)))
+
+    @property
+    def shard_spec(self) -> tuple[int, int] | None:
+        """(index, count) of this view's split; None if unsharded."""
+        return self._shard_spec
+
+    def reshard(self, index: int, count: int) -> "FileDataset":
+        """Recut the per-process split at a NEW world size from the FULL
+        row space — the elastic rescale hook, `ArrayDataset.reshard`
+        parity for the file-backed path. Unlike chaining ``.shard()`` on
+        an already-sharded ArrayDataset view (shards of shards), a
+        FileDataset view always derives from the full on-disk set, so
+        resharding is simply a fresh cut: across the new world the
+        stripes again partition every example exactly once per epoch."""
+        return self._view(None).shard(index, count)
+
+    # --- row access ---------------------------------------------------------
+
     def _map(self, shard: int, key: str) -> np.ndarray:
         m = self._maps.get((shard, key))
         if m is None:
-            m = np.load(
-                os.path.join(self.directory, f"shard-{shard:05d}.{key}.npy"),
-                mmap_mode="r",
+            path = os.path.join(
+                self.directory, f"shard-{shard:05d}.{key}.npy"
+            )
+            # Bounded retry on the transient-I/O class (NFS blips, a
+            # remounting FUSE volume); exhausted budget fails fast with
+            # the checkpoint-fallback escalation (stream.read_with_retries).
+            m = stream_lib.read_with_retries(
+                lambda: np.load(path, mmap_mode="r"), path
             )
             self._maps[(shard, key)] = m
         return m
@@ -127,19 +196,40 @@ class FileDataset:
                 out[k][sel] = self._map(int(s), k)[offs]
         return out
 
-    def batches(self, batch_size: int, *, seed: int = 0,
-                shuffle: bool = True, repeat: bool = False,
-                shard: tuple[int, int] = (0, 1),
-                drop_remainder: bool = True):
-        """Dict batches over a per-epoch seeded permutation.
+    # --- iteration ----------------------------------------------------------
 
-        ``shard=(i, n)`` keeps every n-th example starting at i — the
-        per-process split (`ArrayDataset.shard` semantics: disjoint,
-        exhaustive)."""
-        idx, cnt = shard
+    def _stripe(self, shard: tuple[int, int] | None) -> np.ndarray:
+        idx, cnt = shard if shard is not None else (
+            self._shard_spec or (0, 1)
+        )
         if not (0 <= idx < cnt):
             raise ValueError(f"shard index {idx} out of range for {cnt}")
-        mine = np.arange(self.num_examples)[idx::cnt]
+        return np.arange(self.num_examples)[idx::cnt]
+
+    def batches(self, batch_size: int, *, seed: int = 0,
+                shuffle: bool = True, repeat: bool = False,
+                shard: tuple[int, int] | None = None,
+                drop_remainder: bool = True,
+                skip: int = 0, start_epoch: int = 0,
+                batches_per_epoch: int | None = None):
+        """Dict batches over per-epoch seeded permutations.
+
+        ``shard=(i, n)`` keeps every n-th example starting at i (defaults
+        to this view's `.shard()` spec). Every epoch's permutation is a
+        pure function of ``(seed, epoch, pass)``, so positions are
+        addressable: ``batches(start_epoch=E, skip=S)`` continues the
+        stream byte-exactly from S batches into epoch E — the durable
+        cursor contract — and the skipped stretch gathers NOTHING (index
+        arithmetic only).
+
+        ``batches_per_epoch=None``: one permutation pass per epoch
+        (``n_stripe // batch_size`` batches with ``drop_remainder``, the
+        historical contract; ``repeat`` chains epochs).
+        ``batches_per_epoch=B``: trainer-anchored epochs of exactly B
+        batches (passes roll within the epoch when B exceeds one pass;
+        partial batches never straddle passes — per-pass drop-remainder —
+        and the stream is infinite regardless of ``repeat``)."""
+        mine = self._stripe(shard)
         if drop_remainder and len(mine) < batch_size:
             # Every epoch would yield ZERO batches; with repeat=True the
             # loop would spin forever producing nothing — refuse loudly.
@@ -148,18 +238,189 @@ class FileDataset:
                 f"({batch_size}); shrink the batch or set "
                 "drop_remainder=False"
             )
-        rng = np.random.RandomState(seed)
+
+        def pass_order(epoch: int, pass_: int) -> np.ndarray:
+            if not shuffle:
+                return mine
+            rng = np.random.RandomState(
+                stream_lib.epoch_seed(seed, epoch, pass_)
+            )
+            return rng.permutation(mine)
+
+        skip = int(skip)
+        skipped = 0
+        epoch = int(start_epoch)
+        if batches_per_epoch is None:
+            while True:
+                order = pass_order(epoch, 0)
+                for lo in range(0, len(order), batch_size):
+                    sel = order[lo: lo + batch_size]
+                    if len(sel) < batch_size and drop_remainder:
+                        break
+                    if skipped < skip:
+                        skipped += 1
+                        continue
+                    yield self.gather(sel)
+                epoch += 1
+                if not repeat:
+                    return
+        B = int(batches_per_epoch)
+        if B < 1:
+            raise ValueError(f"batches_per_epoch must be >= 1, got {B}")
+        per_pass = len(mine) // batch_size
+        if per_pass < 1:
+            raise ValueError(
+                "batches_per_epoch requires at least one full batch per "
+                "pass (drop-remainder anchoring)"
+            )
         while True:
-            order = rng.permutation(mine) if shuffle else mine
-            for lo in range(0, len(order), batch_size):
-                sel = order[lo : lo + batch_size]
-                if len(sel) < batch_size and drop_remainder:
-                    break
-                yield self.gather(sel)
-            if not repeat:
-                return
+            emitted = 0
+            pass_ = 0
+            while emitted < B:
+                order = pass_order(epoch, pass_)
+                take = min(B - emitted, per_pass)
+                for b in range(take):
+                    if skipped < skip:
+                        skipped += 1
+                    else:
+                        yield self.gather(
+                            order[b * batch_size: (b + 1) * batch_size]
+                        )
+                emitted += take
+                pass_ += 1
+            epoch += 1
 
     def pairs(self, x_key: str, y_key: str, batch_size: int, **kw):
         """(x, y) tuple batches for ``Trainer.fit(dataset=...)``."""
         for b in self.batches(batch_size, **kw):
             yield b[x_key], b[y_key]
+
+    def pairs_stream(self, x_key: str, y_key: str, batch_size: int, *,
+                     seed: int = 0, shuffle: bool = True,
+                     shard: tuple[int, int] | None = None
+                     ) -> "FilePairs":
+        """A resumable ``(x, y)`` view exposing the `batches(skip=,
+        start_epoch=, batches_per_epoch=)` hook `Trainer.fit`'s
+        deterministic fast-forward drives — hand THIS (not a bare
+        `pairs()` generator) to ``fit(dataset=...)`` so resumes are
+        byte-exact and nothing skipped is ever gathered."""
+        return FilePairs(self, x_key, y_key, batch_size,
+                         seed=seed, shuffle=shuffle, shard=shard)
+
+    # --- durable stream cursors (data.stream) -------------------------------
+
+    def stream_cursor(self, epoch: int, step: int, *, batch_size: int,
+                      seed: int = 0, shuffle: bool = True,
+                      repeat: bool = True,
+                      shard: tuple[int, int] | None = None,
+                      batches_per_epoch: int | None = None
+                      ) -> "stream_lib.StreamCursor":
+        """Export "``step`` batches into epoch ``epoch``" of this view's
+        stream as a serializable `StreamCursor`. ``shuffle`` and
+        ``repeat`` are part of the stream geometry (a shuffle=False
+        stream is DIFFERENT bytes; a repeat stream is INFINITE) and are
+        recorded + honoured on reconstruction — a cursor cut from a
+        repeating stream reconstructs as one, never silently truncated
+        at the resume epoch's boundary."""
+        spec = shard if shard is not None else self._shard_spec
+        return stream_lib.StreamCursor(
+            kind="file", seed=int(seed), epoch=int(epoch), step=int(step),
+            position={
+                "n_examples": self.num_examples,
+                "batch_size": int(batch_size),
+                "shuffle": bool(shuffle),
+                "repeat": bool(repeat),
+                "shard": list(spec) if spec else None,
+                "batches_per_epoch": batches_per_epoch,
+            },
+        )
+
+    def batches_from(self, cursor, **kw):
+        """Reconstruct the batch stream from a `StreamCursor` (or dict):
+        format/kind/geometry validated loudly, then byte-exact
+        continuation (`batches(skip=cursor.step, start_epoch=
+        cursor.epoch, ...)`) with the CURSOR's recorded shuffle mode."""
+        if not isinstance(cursor, stream_lib.StreamCursor):
+            cursor = stream_lib.StreamCursor.from_dict(cursor)
+        spec = kw.pop("shard", None)
+        if spec is None:
+            spec = self._shard_spec
+        cursor.require(
+            "file",
+            n_examples=self.num_examples,
+            shard=list(spec) if spec else None,
+        )
+        try:
+            batch_size = int(cursor.position["batch_size"])
+            if batch_size < 1:
+                raise ValueError(batch_size)
+        except (KeyError, TypeError, ValueError):
+            raise stream_lib.StreamCursorError(
+                "file cursor carries no usable batch_size — refusing to "
+                "guess the stream geometry"
+            ) from None
+        kw.setdefault("repeat", bool(cursor.position.get("repeat", True)))
+        return self.batches(
+            batch_size,
+            seed=cursor.seed,
+            shuffle=bool(cursor.position.get("shuffle", True)),
+            shard=spec,
+            skip=cursor.step, start_epoch=cursor.epoch,
+            batches_per_epoch=cursor.position.get("batches_per_epoch"),
+            **kw,
+        )
+
+
+class FilePairs:
+    """Resumable ``(x, y)`` stream over a `FileDataset` — the adapter
+    `Trainer.fit(dataset=...)` fast-forwards through its `batches(skip=,
+    start_epoch=, batches_per_epoch=)` hook (byte-exact, nothing skipped
+    is gathered). Also exports/honours `StreamCursor`s."""
+
+    def __init__(self, ds: FileDataset, x_key: str, y_key: str,
+                 batch_size: int, *, seed: int = 0, shuffle: bool = True,
+                 shard: tuple[int, int] | None = None):
+        self.ds = ds
+        self.x_key, self.y_key = x_key, y_key
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.shard = shard if shard is not None else ds.shard_spec
+
+    def batches(self, skip: int = 0, *, start_epoch: int = 0,
+                batches_per_epoch: int | None = None):
+        for b in self.ds.batches(
+            self.batch_size, seed=self.seed, shuffle=self.shuffle,
+            shard=self.shard, skip=skip, start_epoch=start_epoch,
+            batches_per_epoch=batches_per_epoch, repeat=True,
+        ):
+            yield b[self.x_key], b[self.y_key]
+
+    def __iter__(self):
+        return self.batches()
+
+    def stream_cursor(self, epoch: int, step: int,
+                      batches_per_epoch: int | None = None):
+        return self.ds.stream_cursor(
+            epoch, step, batch_size=self.batch_size, seed=self.seed,
+            shuffle=self.shuffle, shard=self.shard,
+            batches_per_epoch=batches_per_epoch,
+        )
+
+    def batches_from(self, cursor):
+        if not isinstance(cursor, stream_lib.StreamCursor):
+            cursor = stream_lib.StreamCursor.from_dict(cursor)
+        # FULL geometry validation, same strictness as
+        # FileDataset.batches_from: a cursor cut on a different stripe,
+        # row count or shuffle mode addresses a different byte stream.
+        cursor.require(
+            "file", seed=self.seed,
+            n_examples=self.ds.num_examples,
+            batch_size=self.batch_size,
+            shuffle=self.shuffle,
+            shard=list(self.shard) if self.shard else None,
+        )
+        return self.batches(
+            skip=cursor.step, start_epoch=cursor.epoch,
+            batches_per_epoch=cursor.position.get("batches_per_epoch"),
+        )

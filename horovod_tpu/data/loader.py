@@ -24,6 +24,7 @@ import jax.tree_util
 import numpy as np
 
 from horovod_tpu.analysis import registry
+from horovod_tpu.data import stream as stream_lib
 
 
 class ArrayDataset:
@@ -137,70 +138,175 @@ class ArrayDataset:
         ds._shard_spec = self._shard_spec
         return ds
 
-    def _index_stream(self) -> Iterator[int]:
+    def _pass_indices(self, epoch: int, pass_: int = 0) -> Iterator[int]:
+        """One shuffle pass over the examples, as a PURE function of
+        ``(seed, epoch, pass_)`` (`stream.epoch_seed`): any epoch's order
+        is regenerable without replaying the epochs before it — the
+        positional-addressability invariant the durable stream cursors
+        (`data.stream.StreamCursor`) are built on."""
         n = self.num_examples
-        rng = np.random.RandomState(self._seed)
-        epoch = 0
-        while True:
-            order = np.arange(n)
-            if self._shuffle_buffer >= n:
-                # Buffer covers the dataset → full permutation (matches
-                # tf.data when buffer_size >= dataset size).
-                rng.shuffle(order)
-                yield from order
-            elif self._shuffle_buffer > 1:
-                # Reservoir shuffle: identical semantics to tf.data's
-                # bounded-buffer shuffle.
-                buf = list(order[: self._shuffle_buffer])
-                for idx in order[self._shuffle_buffer:]:
-                    j = rng.randint(0, len(buf))
-                    yield buf[j]
-                    buf[j] = idx
-                while buf:
-                    j = rng.randint(0, len(buf))
-                    yield buf.pop(j)
-            else:
-                yield from order
-            epoch += 1
-            if not self._repeat:
-                return
+        rng = np.random.RandomState(
+            stream_lib.epoch_seed(self._seed, epoch, pass_)
+        )
+        order = np.arange(n)
+        if self._shuffle_buffer >= n:
+            # Buffer covers the dataset → full permutation (matches
+            # tf.data when buffer_size >= dataset size).
+            rng.shuffle(order)
+            yield from order
+        elif self._shuffle_buffer > 1:
+            # Reservoir shuffle: identical semantics to tf.data's
+            # bounded-buffer shuffle (restarted per pass, so each pass is
+            # anchored — the reservoir never straddles epochs).
+            buf = list(order[: self._shuffle_buffer])
+            for idx in order[self._shuffle_buffer:]:
+                j = rng.randint(0, len(buf))
+                yield buf[j]
+                buf[j] = idx
+            while buf:
+                j = rng.randint(0, len(buf))
+                yield buf.pop(j)
+        else:
+            yield from order
 
     def __iter__(self):
         return self.batches()
 
-    def batches(self, skip: int = 0):
+    def _assemble(self, pending: list):
+        sel = np.asarray(pending)
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [a[sel] for a in self._arrays]
+        )
+
+    def batches(self, skip: int = 0, *, start_epoch: int = 0,
+                batches_per_epoch: int | None = None):
         """Iterate batches, optionally fast-forwarded past the first
         ``skip`` batches WITHOUT materializing them: the skipped stretch
         only consumes integers from the shuffle's index stream (no row
         gathers, no batch assembly), so resuming a run at optimizer step S
         costs O(S·batch) index draws, not O(S·batch·row_bytes) of copied
-        data. The stream is a pure function of (seed, shard geometry), so
-        ``ds.batches(skip=n)`` yields byte-identically what the (n+1)-th
-        ``iter(ds)`` batch onward would — the deterministic-resume
-        contract `Trainer.fit(initial_step=)` builds on; `reshard` at the
-        same world size preserves it (identical arrays → identical
-        stream)."""
+        data.
+
+        Every pass's order is a pure function of ``(seed, epoch, pass)``
+        (`_pass_indices`), so positions are ADDRESSABLE: ``batches(
+        start_epoch=E, skip=S)`` yields byte-identically what an
+        uninterrupted stream would have yielded from that position —
+        including when epochs [0, E) were consumed by an earlier process
+        that no longer exists (the cross-epoch durable-cursor contract;
+        `reshard` at the same world size preserves it — identical arrays
+        → identical stream).
+
+        ``batches_per_epoch=None`` (default): one shuffle pass IS an
+        epoch; the batch remainder of a pass straddles into the next in
+        repeat mode (the historical tf.data-chain contract), so
+        cross-epoch positions are exact when ``batch_size`` divides the
+        example count.
+
+        ``batches_per_epoch=B``: trainer-anchored epochs — epoch ``e``
+        yields EXACTLY ``B`` batches drawn from passes ``(e, 0), (e, 1),
+        ...`` (a new pass starts within the epoch when one is exhausted;
+        partial batches carry across passes but are DISCARDED at the
+        epoch boundary), then the stream advances to epoch ``e+1``
+        regardless of ``repeat()``. This is the mode `Trainer.fit`'s
+        streamed path drives: epoch boundaries are clean cuts, so a
+        cursor ``(epoch, step)`` is exact for ANY batch size."""
         if self._batch_size is None:
             raise ValueError("call .batch(batch_size) before iterating")
         bs = self._batch_size
+        skip = int(skip)
         skipped = 0
-        pending: list[int] = []
-        unflatten = jax.tree_util.tree_unflatten
-        for idx in self._index_stream():
-            pending.append(idx)
-            if len(pending) == bs:
+        if batches_per_epoch is None:
+            pending: list[int] = []
+            epoch = int(start_epoch)
+            while True:
+                for idx in self._pass_indices(epoch):
+                    pending.append(idx)
+                    if len(pending) == bs:
+                        if skipped < skip:
+                            skipped += 1
+                            pending = []
+                            continue
+                        out = self._assemble(pending)
+                        pending = []
+                        yield out
+                epoch += 1
+                if not self._repeat:
+                    break
+            if pending and not self._drop_remainder:
                 if skipped < skip:
-                    skipped += 1
-                    pending = []
+                    return
+                yield self._assemble(pending)
+            return
+        B = int(batches_per_epoch)
+        if B < 1:
+            raise ValueError(f"batches_per_epoch must be >= 1, got {B}")
+        epoch = int(start_epoch)
+        while True:
+            emitted = 0
+            pass_ = 0
+            pending = []
+            while emitted < B:
+                for idx in self._pass_indices(epoch, pass_):
+                    pending.append(idx)
+                    if len(pending) == bs:
+                        emitted += 1
+                        if skipped < skip:
+                            skipped += 1
+                            pending = []
+                        else:
+                            out = self._assemble(pending)
+                            pending = []
+                            yield out
+                        if emitted >= B:
+                            break
+                else:
+                    # Pass exhausted mid-epoch: continue with the next
+                    # anchored pass of the SAME epoch (pending carries).
+                    pass_ += 1
                     continue
-                sel = np.asarray(pending)
-                pending = []
-                yield unflatten(self._treedef, [a[sel] for a in self._arrays])
-        if pending and not self._drop_remainder:
-            if skipped < skip:
-                return
-            sel = np.asarray(pending)
-            yield unflatten(self._treedef, [a[sel] for a in self._arrays])
+                break
+            epoch += 1
+
+    # --- durable stream cursors (data.stream) -------------------------------
+
+    def stream_cursor(self, epoch: int, step: int,
+                      batches_per_epoch: int | None = None
+                      ) -> "stream_lib.StreamCursor":
+        """Export the position "``step`` batches into epoch ``epoch``" as
+        a serializable `StreamCursor` — `batches_from` reconstructs the
+        stream from it byte-exactly (same geometry required)."""
+        if self._batch_size is None:
+            raise ValueError("call .batch(batch_size) before cursor export")
+        return stream_lib.StreamCursor(
+            kind="array", seed=int(self._seed), epoch=int(epoch),
+            step=int(step),
+            position={
+                "n_examples": self.num_examples,
+                "batch_size": self._batch_size,
+                "shard": list(self._shard_spec) if self._shard_spec else None,
+                "shuffle_buffer": self._shuffle_buffer,
+                "batches_per_epoch": batches_per_epoch,
+            },
+        )
+
+    def batches_from(self, cursor):
+        """Reconstruct the batch stream from a `StreamCursor` (or its
+        dict form): validates format/kind/seed/geometry loudly
+        (`stream.StreamCursorError`), then yields byte-identically what
+        the exporting stream would have yielded from that position on."""
+        if not isinstance(cursor, stream_lib.StreamCursor):
+            cursor = stream_lib.StreamCursor.from_dict(cursor)
+        cursor.require(
+            "array", seed=self._seed,
+            n_examples=self.num_examples,
+            batch_size=self._batch_size,
+            shard=list(self._shard_spec) if self._shard_spec else None,
+            shuffle_buffer=self._shuffle_buffer,
+        )
+        return self.batches(
+            skip=cursor.step, start_epoch=cursor.epoch,
+            batches_per_epoch=cursor.position.get("batches_per_epoch"),
+        )
 
     def take(self, n_batches: int):
         it = iter(self)
@@ -214,6 +320,9 @@ def training_pipeline(
     shuffle_buffer: int | None = None,
     structure=None,
     skip_batches: int = 0,
+    start_epoch: int = 0,
+    batches_per_epoch: int | None = None,
+    engine_out: dict | None = None,
 ):
     """The training-path input iterator: infinite shuffled batches of the
     given arrays (the reference's ``repeat().shuffle().batch()`` chain,
@@ -243,6 +352,23 @@ def training_pipeline(
     without a host copy), so a resumed run sees byte-identically the
     batches an uninterrupted run of the same engine would have seen from
     that position.
+
+    ``start_epoch``/``batches_per_epoch`` anchor the stream's epochs to
+    ABSOLUTE epoch numbers (per-engine pure ``(seed, epoch, pass)``
+    derivations): the stream starts at epoch ``start_epoch`` — including
+    when epochs before it were consumed by a process that no longer
+    exists — and, with ``batches_per_epoch=B``, each epoch is exactly B
+    batches (the `Trainer.fit` streamed contract; see
+    `ArrayDataset.batches`). Together with ``skip_batches`` this is the
+    durable-cursor reconstruction hook: ``(start_epoch=E, skip=S)`` is
+    cursor position ``(E, S)``.
+
+    ``engine_out`` (a dict, filled in place) reports which engine was
+    selected (``{'engine': 'native' | 'python'}``): the two engines'
+    anchored streams are DIFFERENT byte streams, so durable cursors must
+    record which one produced them — a resume that lands on the other
+    engine (toolchain missing, ``HVT_NO_NATIVE`` flipped) is then
+    detectable instead of silently re-anchored.
     """
     skip_batches = int(skip_batches)
 
@@ -260,15 +386,26 @@ def training_pipeline(
 
         if native_loader.available() and batch_size <= n:
             loader = native_loader.NativeBatchLoader(
-                arrays, batch_size, seed=seed, shuffle=True
+                arrays, batch_size, seed=seed, shuffle=True,
+                start_epoch=start_epoch,
+                batches_per_epoch=batches_per_epoch or 0,
             )
             if skip_batches:
                 loader.skip(skip_batches)
+            if engine_out is not None:
+                engine_out["engine"] = "native"
             return rebuild(iter(loader)), loader.close
+    if engine_out is not None:
+        engine_out["engine"] = "python"
     ds = (
         ArrayDataset(arrays)
         .repeat()
         .shuffle(shuffle_buffer or n, seed=seed)
         .batch(batch_size)
     )
-    return rebuild(ds.batches(skip=skip_batches)), lambda: None
+    return rebuild(
+        ds.batches(
+            skip=skip_batches, start_epoch=start_epoch,
+            batches_per_epoch=batches_per_epoch,
+        )
+    ), lambda: None

@@ -74,12 +74,27 @@ def _load():
         except OSError:
             _load_failed = True
             return None
+        # ABI handshake: a stale prebuilt .so (no compiler to rebuild,
+        # make failed above) predating the epoch-anchored stream would
+        # silently IGNORE the extra create arguments — the cursors would
+        # then describe a stream nobody produces. Missing symbol or
+        # version mismatch → treat the native engine as unavailable and
+        # fall back to the python pipeline (fail-safe, never
+        # fail-different-bytes).
+        try:
+            if lib.hvt_loader_abi_version() != 2:
+                _load_failed = True
+                return None
+        except AttributeError:
+            _load_failed = True
+            return None
         lib.hvt_loader_create.restype = ctypes.c_void_p
         lib.hvt_loader_create.argtypes = [
             ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64,
         ]
         lib.hvt_loader_next.restype = ctypes.c_int
         lib.hvt_loader_next.argtypes = [ctypes.c_void_p]
@@ -108,7 +123,16 @@ class NativeBatchLoader:
         shuffle: bool = True,
         n_slots: int = 4,
         copy: bool = True,
+        start_epoch: int = 0,
+        batches_per_epoch: int = 0,
     ):
+        """``start_epoch``/``batches_per_epoch`` anchor the stream's
+        epochs (the durable-cursor contract — see `data.stream` and the
+        hvt_data.cc header): every pass's permutation is a pure function
+        of ``(seed, epoch, pass)``, so the stream can start at ANY
+        absolute epoch without replaying the ones before it.
+        ``batches_per_epoch=0`` keeps one-permutation-pass-per-epoch
+        semantics; > 0 cuts epochs at exactly that many batches."""
         self.copy = copy
         lib = _load()
         if lib is None:
@@ -137,10 +161,28 @@ class NativeBatchLoader:
         self._handle = lib.hvt_loader_create(
             ptrs, row_bytes, len(self._arrays), n, self.batch_size,
             n_slots, seed, 1 if shuffle else 0,
+            int(start_epoch), int(batches_per_epoch),
         )
         if not self._handle:
             raise RuntimeError("hvt_loader_create failed")
         self._held_slot = -1
+        # Cursor bookkeeping (mirrors the producer's position exactly:
+        # both sides count consumed batches of the same deterministic
+        # stream). Epoch length in batches: the explicit cut when given,
+        # else the pass length (drop-remainder permutation batches).
+        self._seed = int(seed)
+        self._shuffle = bool(shuffle)
+        self._batches_per_epoch = (
+            int(batches_per_epoch) or n // self.batch_size
+        )
+        self._epoch = int(start_epoch)
+        self._batch_in_epoch = 0
+
+    def _advance(self, n_batches: int = 1) -> None:
+        self._batch_in_epoch += n_batches
+        while self._batch_in_epoch >= self._batches_per_epoch:
+            self._batch_in_epoch -= self._batches_per_epoch
+            self._epoch += 1
 
     def __iter__(self):
         return self
@@ -156,6 +198,7 @@ class NativeBatchLoader:
         if slot < 0:
             raise StopIteration
         self._held_slot = slot
+        self._advance()
         out = []
         for idx, (shape, dtype) in enumerate(zip(self._shapes, self._dtypes)):
             ptr = self._lib.hvt_loader_slot_ptr(self._handle, slot, idx)
@@ -181,6 +224,60 @@ class NativeBatchLoader:
             if slot < 0:
                 raise RuntimeError("native loader stream ended during skip")
             self._lib.hvt_loader_release(self._handle, slot)
+            self._advance()
+
+    def cursor(self):
+        """The position of the NEXT batch this loader will yield, as a
+        serializable `data.stream.StreamCursor`. Reconstruct with
+        `NativeBatchLoader.from_cursor(arrays, cursor)` — byte-identical
+        continuation of the same (seed, epoch, pass)-anchored stream."""
+        from horovod_tpu.data import stream as stream_lib
+
+        return stream_lib.StreamCursor(
+            kind="native", seed=self._seed, epoch=self._epoch,
+            step=self._batch_in_epoch,
+            position={
+                "n_examples": self._arrays[0].shape[0],
+                "batch_size": self.batch_size,
+                "shuffle": self._shuffle,
+                "batches_per_epoch": self._batches_per_epoch,
+            },
+        )
+
+    @classmethod
+    def from_cursor(cls, arrays: Sequence[np.ndarray], cursor, **kw):
+        """Rebuild a loader positioned exactly at ``cursor`` (validated
+        loudly — format, kind, seed, geometry; `stream.StreamCursorError`
+        on any mismatch). The within-epoch offset is skipped natively
+        (slots advanced and released, no host copy)."""
+        from horovod_tpu.data import stream as stream_lib
+
+        if not isinstance(cursor, stream_lib.StreamCursor):
+            cursor = stream_lib.StreamCursor.from_dict(cursor)
+        n = int(np.asarray(arrays[0]).shape[0])
+        cursor.require("native", n_examples=n)
+        try:
+            batch_size = int(cursor.position["batch_size"])
+            if batch_size < 1:
+                raise ValueError(batch_size)
+        except (KeyError, TypeError, ValueError):
+            raise stream_lib.StreamCursorError(
+                "native cursor carries no usable batch_size — refusing "
+                "to guess the stream geometry"
+            ) from None
+        bpe = int(cursor.position.get("batches_per_epoch") or 0)
+        loader = cls(
+            arrays, batch_size, seed=cursor.seed,
+            shuffle=bool(cursor.position.get("shuffle", True)),
+            start_epoch=cursor.epoch,
+            batches_per_epoch=(
+                0 if bpe == n // batch_size else bpe
+            ),
+            **kw,
+        )
+        if cursor.step:
+            loader.skip(cursor.step)
+        return loader
 
     def close(self):
         if self._handle is not None:

@@ -115,6 +115,109 @@ def packing_efficiency(segment_ids) -> float:
     return float((seg != 0).mean()) if seg.size else 0.0
 
 
+class PackedLMStream:
+    """Resumable packed-LM batch stream — the corpus → packed-row feeding
+    path (`tokenizer.py` → `pack_documents` → `next_token_pairs`) as a
+    DURABLE stream with an exportable `data.stream.StreamCursor`.
+
+    Packing is deterministic (best-fit-decreasing over a fixed corpus
+    order), so the packed row set is a pure function of the inputs; the
+    per-epoch row order is a pure function of ``(seed, epoch)`` (the
+    anchored `ArrayDataset` engine underneath). Together any stream
+    position — including epochs consumed by a dead process — is
+    reconstructible byte-exactly from ``(seed, epoch, step)`` plus the
+    geometry fingerprint the cursor carries (row count, seq_len, batch
+    size, shard spec, and the tokenizer's merge-table sha256 when the
+    corpus came in as raw text).
+
+    Batches are ``(x, y)`` with ``x = tokens ⊕ segment_ids`` ([B, T, 2]
+    int32) and ``y = targets ⊕ loss-weights`` ([B, T, 2] int32) — the
+    `examples/lm_packed_pretraining.py` stacked-channel feed, so the
+    stream drops straight into ``Trainer.fit(dataset=...)`` with the
+    masked-CE loss."""
+
+    def __init__(self, docs, seq_len: int, batch_size: int, *,
+                 seed: int = 0, tokenizer=None, shard=(0, 1),
+                 pad_id: int = 0):
+        self._tok_digest = None
+        if tokenizer is not None:
+            import hashlib
+            import json as _json
+
+            self._tok_digest = hashlib.sha256(
+                _json.dumps(
+                    [list(m) for m in tokenizer.merges]
+                ).encode()
+            ).hexdigest()[:16]
+            docs = tokenizer.encode_corpus(docs)
+        toks, seg, _ = pack_documents(docs, seq_len + 1, pad_id=pad_id)
+        x, y, w = next_token_pairs(toks, seg)
+        xs = np.stack([x, seg[:, :-1]], axis=-1)
+        ys = np.stack([y, w.astype(np.int32)], axis=-1)
+        self.seq_len = int(seq_len)
+        self.n_rows = int(len(xs))
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        from horovod_tpu.data.loader import ArrayDataset
+
+        ds = ArrayDataset((xs, ys))
+        if tuple(shard) != (0, 1):
+            ds = ds.shard(*shard)
+        self.shard = tuple(shard)
+        self._ds = (
+            ds.repeat()
+            .shuffle(ds.num_examples, seed=seed)
+            .batch(batch_size)
+        )
+
+    def batches(self, skip: int = 0, *, start_epoch: int = 0,
+                batches_per_epoch: int | None = None):
+        """Anchored ``(x, y)`` batches — the `Trainer.fit(dataset=...)`
+        fast-forward hook (see `ArrayDataset.batches`)."""
+        return self._ds.batches(
+            skip=skip, start_epoch=start_epoch,
+            batches_per_epoch=batches_per_epoch,
+        )
+
+    def __iter__(self):
+        return self.batches()
+
+    def stream_cursor(self, epoch: int, step: int,
+                      batches_per_epoch: int | None = None):
+        from horovod_tpu.data import stream as stream_lib
+
+        return stream_lib.StreamCursor(
+            kind="packed-lm", seed=self.seed, epoch=int(epoch),
+            step=int(step),
+            position={
+                "n_rows": self.n_rows,
+                "seq_len": self.seq_len,
+                "batch_size": self.batch_size,
+                "shard": list(self.shard),
+                "tokenizer_sha256": self._tok_digest,
+                "batches_per_epoch": batches_per_epoch,
+            },
+        )
+
+    def batches_from(self, cursor):
+        """Byte-exact continuation from a `StreamCursor` (or its dict
+        form); format/kind/geometry mismatches are refused loudly."""
+        from horovod_tpu.data import stream as stream_lib
+
+        if not isinstance(cursor, stream_lib.StreamCursor):
+            cursor = stream_lib.StreamCursor.from_dict(cursor)
+        cursor.require(
+            "packed-lm", seed=self.seed,
+            n_rows=self.n_rows, seq_len=self.seq_len,
+            batch_size=self.batch_size, shard=list(self.shard),
+            tokenizer_sha256=self._tok_digest,
+        )
+        return self.batches(
+            skip=cursor.step, start_epoch=cursor.epoch,
+            batches_per_epoch=cursor.position.get("batches_per_epoch"),
+        )
+
+
 def next_token_pairs(tokens, segment_ids):
     """(x, y, weights) next-token training triplets for packed rows.
 

@@ -1,0 +1,227 @@
+"""Durable stream cursors — byte-exact cross-epoch resume for the feeders.
+
+PR 5 made `fit(initial_step=)` step-exact WITHIN the resume epoch, but the
+streamed feeding paths re-anchored epochs that predate the resume call:
+each fit built a fresh shuffle stream whose RNG state evolved ACROSS
+epochs, so "epoch 40 of a resumed run" and "epoch 40 of the uninterrupted
+run" drew different permutations. The fix implemented across the data
+layer is positional addressability: every feeding engine derives the
+order of epoch ``e`` (and pass ``p`` within it) as a PURE FUNCTION of
+``(seed, e, p)`` — `epoch_seed` here, `mix_seed` in the native engine —
+so any position in the infinite stream is reconstructible without
+replaying the stream that led to it.
+
+With that invariant, a stream position is fully described by a small
+serializable record, the `StreamCursor`:
+
+* ``kind`` — which engine produced it (``array``/``file``/``native``/
+  ``packed-lm``/``fit``); a cursor never resumes a different engine.
+* ``seed``/``epoch``/``step`` — the anchored position: ``step`` counts
+  BATCHES consumed within ``epoch``.
+* ``position`` — per-source geometry (example count, batch size, shard
+  spec, batches-per-epoch, ...): the stream is only byte-identical when
+  the geometry matches, so reconstruction validates it loudly.
+* ``format`` — the cursor format version. A cursor from a DIFFERENT
+  format version is REFUSED loudly (`StreamCursorError`), never silently
+  re-anchored: a silently re-anchored resume is exactly the corruption
+  this subsystem exists to prevent.
+
+The cursor rides the existing durability surfaces: checkpoint progress
+manifests (``.meta.json`` / sharded ``index.json`` — `checkpoint.save*`
+``cursor=``), `ElasticState` commits (tracked ``cursor`` attribute), and
+`Trainer.fit(initial_epoch=, initial_step=)` threading.
+
+This module also owns the transient-I/O hardening for the file-backed
+feeders: `read_with_retries` wraps mmap/index reads in a bounded
+retry-with-backoff (`HVT_DATA_RETRIES` × `HVT_DATA_BACKOFF_S`,
+exponential), failing fast with the actionable checkpoint-fallback
+message once the budget is spent. `HVT_DATA_FAULT_READS` injects
+deterministic transient faults for the chaos tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from horovod_tpu.analysis import registry
+
+# Bump when the anchored-stream derivation changes incompatibly (a new
+# epoch_seed scheme, a different pass-rollover rule): a cursor written by
+# an older format addresses positions in a DIFFERENT byte stream, so
+# resuming from it must fail loudly, never silently re-anchor.
+CURSOR_FORMAT = 1
+
+
+class StreamCursorError(ValueError):
+    """A stream cursor cannot be honoured byte-exactly (wrong format
+    version, wrong engine kind, or mismatched stream geometry)."""
+
+
+def epoch_seed(seed: int, epoch: int, pass_: int = 0) -> int:
+    """The RNG seed for pass ``pass_`` of epoch ``epoch`` of a stream
+    seeded ``seed`` — the pure derivation that makes stream positions
+    addressable. `numpy.random.SeedSequence` is documented stable across
+    numpy versions, so the derived streams are reproducible artifacts.
+    (The native engine uses its own splitmix64 derivation with the same
+    (seed, epoch, pass) purity — byte-identity is per-engine.)"""
+    return int(
+        np.random.SeedSequence(
+            [int(seed) & 0xFFFFFFFF, int(epoch), int(pass_)]
+        ).generate_state(1)[0]
+    )
+
+
+@dataclasses.dataclass
+class StreamCursor:
+    """One serializable stream position. See the module docstring."""
+
+    kind: str
+    seed: int
+    epoch: int
+    step: int               # batches consumed within `epoch`
+    position: dict          # per-source geometry the stream depends on
+    format: int = CURSOR_FORMAT
+
+    def to_dict(self) -> dict:
+        """JSON-ready form (what checkpoint manifests store)."""
+        return {
+            "format": int(self.format),
+            "kind": self.kind,
+            "seed": int(self.seed),
+            "epoch": int(self.epoch),
+            "step": int(self.step),
+            "position": dict(self.position),
+        }
+
+    @classmethod
+    def from_dict(cls, rec: dict) -> "StreamCursor":
+        """Parse a stored cursor. REFUSES unknown/older format versions
+        loudly — resuming a v(N) stream from a v(M) cursor would silently
+        re-anchor the byte stream, the exact corruption cursors exist to
+        prevent. Recover by resuming epoch-granular (``initial_epoch``
+        from the progress manifest, ``initial_step=0``) instead."""
+        if not isinstance(rec, dict) or "format" not in rec:
+            raise StreamCursorError(
+                "not a stream cursor record (missing 'format'); refusing "
+                "to guess a stream position"
+            )
+        fmt = int(rec["format"])
+        if fmt != CURSOR_FORMAT:
+            raise StreamCursorError(
+                f"stream cursor format {fmt} != this build's "
+                f"{CURSOR_FORMAT}: the anchored-stream derivation changed "
+                "and this cursor addresses a DIFFERENT byte stream. "
+                "Refusing to silently re-anchor — resume epoch-granular "
+                "(initial_epoch from the progress manifest, "
+                "initial_step=0) or re-train from the last checkpoint "
+                "written by this build."
+            )
+        return cls(
+            kind=str(rec["kind"]),
+            seed=int(rec["seed"]),
+            epoch=int(rec["epoch"]),
+            step=int(rec["step"]),
+            position=dict(rec.get("position", {})),
+            format=fmt,
+        )
+
+    def require(self, kind: str, **geometry) -> None:
+        """Validate this cursor against the reconstructing stream: same
+        engine kind, same seed, same geometry — byte-identity holds only
+        then. Raises `StreamCursorError` naming the first mismatch."""
+        if self.kind != kind:
+            raise StreamCursorError(
+                f"cursor was exported by a {self.kind!r} stream, cannot "
+                f"resume a {kind!r} stream byte-exactly"
+            )
+        want_seed = geometry.pop("seed", None)
+        if want_seed is not None and int(want_seed) != self.seed:
+            raise StreamCursorError(
+                f"cursor seed {self.seed} != stream seed {int(want_seed)} "
+                "— different shuffle streams"
+            )
+        for key, want in geometry.items():
+            got = self.position.get(key)
+            # JSON round-trips tuples as lists; compare canonicalized.
+            def canon(v):
+                return list(v) if isinstance(v, (tuple, list)) else v
+            if canon(got) != canon(want):
+                raise StreamCursorError(
+                    f"cursor geometry mismatch at {key!r}: cursor has "
+                    f"{got!r}, the stream has {want!r} — the data or its "
+                    "sharding changed since the cursor was written"
+                )
+
+
+# --- transient-I/O hardening -------------------------------------------------
+
+# Observable retry telemetry (tests assert it; ops can log it): total
+# transient read failures retried since import.
+RETRY_STATS = {"retried": 0}
+
+# Deterministic fault injection for the chaos tests: the first
+# HVT_DATA_FAULT_READS guarded reads raise a (retriable) OSError. Lazily
+# armed from the knob so a test's monkeypatched env is honoured.
+_fault_budget: int | None = None
+
+
+def _take_injected_fault() -> bool:
+    global _fault_budget
+    if _fault_budget is None:
+        _fault_budget = registry.get_int("HVT_DATA_FAULT_READS") or 0
+    if _fault_budget > 0:
+        _fault_budget -= 1
+        return True
+    return False
+
+
+def reset_fault_injection() -> None:
+    """Re-arm `HVT_DATA_FAULT_READS` from the environment (test hook)."""
+    global _fault_budget
+    _fault_budget = None
+
+
+def read_with_retries(fn, what: str):
+    """Run ``fn()`` (a dataset read: an mmap open, an index load) with
+    bounded retry-with-backoff on TRANSIENT failures.
+
+    Retriable: `OSError` (the NFS/FUSE/flaky-disk class — EIO, ESTALE,
+    EAGAIN, a vanished-then-replaced file). Up to ``HVT_DATA_RETRIES``
+    retries, sleeping ``HVT_DATA_BACKOFF_S × 2**attempt`` between
+    attempts. Anything else (a ValueError from a genuinely corrupt index,
+    a KeyboardInterrupt) propagates immediately — retrying non-transient
+    errors only delays the real diagnosis.
+
+    Exhausted budget fails FAST with the actionable escalation: the run
+    should fall back to its newest checkpoint (restart under the
+    supervisor), not spin on a dead filesystem."""
+    retries = registry.get_int("HVT_DATA_RETRIES")
+    retries = 3 if retries is None else max(0, int(retries))
+    backoff = registry.get_float("HVT_DATA_BACKOFF_S")
+    backoff = 0.05 if backoff is None else max(0.0, float(backoff))
+    last: OSError | None = None
+    for attempt in range(retries + 1):
+        try:
+            if _take_injected_fault():
+                raise OSError(
+                    f"injected transient read fault (HVT_DATA_FAULT_READS) "
+                    f"reading {what}"
+                )
+            return fn()
+        except OSError as e:
+            last = e
+            if attempt < retries:
+                RETRY_STATS["retried"] += 1
+                time.sleep(backoff * (2 ** attempt))
+    raise RuntimeError(
+        f"transient I/O failure reading {what} persisted through "
+        f"{retries} retr{'y' if retries == 1 else 'ies'} "
+        f"(HVT_DATA_RETRIES; last error: {last}). The data source is "
+        "unavailable — fail fast and restart this run from its newest "
+        "checkpoint (the supervisor relaunch path); raise "
+        "HVT_DATA_RETRIES/HVT_DATA_BACKOFF_S if the filesystem is known "
+        "to blip longer than the current budget."
+    ) from last

@@ -196,11 +196,17 @@ class ElasticState:
     hold HOST (numpy) pytrees — `Trainer.install_state` puts them back on
     whatever mesh the new world built."""
 
-    def __init__(self, state=None, epoch: int = 0, step: int = 0, **extra):
-        self._tracked = ("state", "epoch", "step", *extra)
+    def __init__(self, state=None, epoch: int = 0, step: int = 0,
+                 cursor: dict | None = None, **extra):
+        # `cursor` is the durable data-stream cursor
+        # (`Trainer.stream_cursor` — data/stream.py): committed and
+        # synced like every tracked attribute, so a shrink/grow carries
+        # the exact stream position to the next generation for free.
+        self._tracked = ("state", "epoch", "step", "cursor", *extra)
         self.state = state
         self.epoch = epoch
         self.step = step
+        self.cursor = cursor
         for k, v in extra.items():
             setattr(self, k, v)
         self._committed: dict | None = None
@@ -469,10 +475,12 @@ class ElasticStateCallback(Callback):
     accumulation (``backward_passes_per_step=K``) the K-microbatch scan
     lives inside the compiled step, so a commit can never land
     mid-accumulation with unreduced local grads: the alignment is
-    structural, not scheduled. Limitation: ``fit(cache='device')`` runs
-    the WHOLE epoch as one compiled scan and fires ``on_batch_end`` once
-    per epoch — commits there stay epoch-granular regardless of this
-    knob (sub-epoch cadence would require splitting the epoch program).
+    structural, not scheduled. ``fit(cache='device')`` runs the epoch as
+    one compiled scan by default (``on_batch_end`` once per epoch, so
+    commits stay epoch-granular there) — set ``HVT_EPOCH_CHUNK_STEPS=C``
+    to split the epoch into compiled C-step chunks, which fires
+    ``on_batch_end`` per chunk and makes this cadence (and
+    ``rescale_every_steps``) work on the device-cached path too.
     Mid-epoch commits record ``(epoch, step)`` progress
     (`progress_marker` orders them under the epoch-end commit), which
     drives root election after a crash — and the training loop resumes
@@ -493,8 +501,9 @@ class ElasticStateCallback(Callback):
     `runtime.shutdown` at the step boundary, interrupt — so a joiner is
     admitted (and a clean leaver released) within N optimizer steps
     instead of waiting out the epoch. Like ``commit_every_steps``, the
-    cadence is accumulation-aligned by construction and epoch-granular
-    on ``fit(cache='device')``.
+    cadence is accumulation-aligned by construction, and on
+    ``fit(cache='device')`` it is epoch-granular unless the epoch is
+    step-chunked (``HVT_EPOCH_CHUNK_STEPS``).
 
     Defaults read the job-spec surface: ``HVT_COMMIT_EVERY`` /
     ``HVT_COMMIT_EVERY_STEPS`` / ``HVT_RESCALE_EVERY_STEPS`` (set by the
@@ -604,8 +613,17 @@ class ElasticStateCallback(Callback):
             self.state.state = self.trainer.state
             self.state.epoch = self._epoch
             self.state.step = done
+            self.state.cursor = self._stream_cursor(self._epoch, done)
             self.state.commit()
         self._maybe_step_rescale(done)
+
+    def _stream_cursor(self, epoch: int, step: int):
+        """The trainer's durable data-stream cursor for the committed
+        position (None for trainers/fakes without one) — committed and
+        synced with the snapshot, so the next generation resumes the
+        SAME anchored byte stream (`data.stream`)."""
+        fn = getattr(self.trainer, "stream_cursor", None)
+        return fn(epoch, step) if callable(fn) else None
 
     def _maybe_step_rescale(self, done: int) -> None:
         """The SUB-EPOCH membership agreement (``rescale_every_steps``):
@@ -658,6 +676,7 @@ class ElasticStateCallback(Callback):
         self.state.state = self.trainer.state
         self.state.epoch = self._epoch
         self.state.step = done
+        self.state.cursor = self._stream_cursor(self._epoch, done)
         self.state.commit()
         if self.state.has_sharded_commit and any_leaving:
             # Same departure-only reassembly rule as the epoch boundary
@@ -690,6 +709,7 @@ class ElasticStateCallback(Callback):
         self.state.state = self.trainer.state
         self.state.epoch = epoch + 1
         self.state.step = 0
+        self.state.cursor = self._stream_cursor(epoch + 1, 0)
         gen = self._beat(force=True)
         leaving = self._leave_requested or faults.leave_requested()
         if jax.process_count() > 1:

@@ -186,7 +186,8 @@ class LearningRateScheduleCallback(Callback):
 
 
 def save_state(filepath_template: str, epoch: int, state, *,
-               async_save: bool = False, pending=None, step: int = 0):
+               async_save: bool = False, pending=None, step: int = 0,
+               cursor: dict | None = None):
     """One TrainState save with the checkpoint ROUTING shared by
     `ModelCheckpoint` and `PreemptionCheckpointCallback`: single-file
     (primary-writer-only) for host-syncable state, the sharded directory
@@ -233,8 +234,8 @@ def save_state(filepath_template: str, epoch: int, state, *,
     if async_save:
         if pending is not None:
             pending.join()
-        return do_async(path, state, progress=progress)
-    do_save(path, state, progress=progress)
+        return do_async(path, state, progress=progress, cursor=cursor)
+    do_save(path, state, progress=progress, cursor=cursor)
     return None
 
 
@@ -308,13 +309,21 @@ class ModelCheckpoint(Callback):
         self._pending = save_state(
             self.filepath, self._epoch, self.trainer.state,
             async_save=self.async_save, pending=self._pending, step=done,
+            # The durable data-stream cursor rides the progress manifest
+            # (stream-format-versioned — see Trainer.stream_cursor).
+            cursor=self._cursor(self._epoch, done),
         )
 
     def on_epoch_end(self, epoch: int, logs=None):
         self._pending = save_state(
             self.filepath, epoch, self.trainer.state,
             async_save=self.async_save, pending=self._pending,
+            cursor=self._cursor(epoch + 1, 0),
         )
+
+    def _cursor(self, epoch: int, step: int):
+        fn = getattr(self.trainer, "stream_cursor", None)
+        return fn(epoch, step) if callable(fn) else None
 
     def on_train_end(self, logs=None):
         if self._pending is not None:
@@ -386,7 +395,15 @@ class PreemptionCheckpointCallback(Callback):
         hit = agree_any(self._hit)
         if not hit:
             return
-        save_state(self.filepath, epoch, self.trainer.state)
+        # Stamp the durable stream cursor like every other checkpoint
+        # writer: the preemption restart is exactly the path that needs
+        # the engine/geometry/format record to refuse a re-anchored
+        # resume loudly (data/stream.py).
+        fn = getattr(self.trainer, "stream_cursor", None)
+        save_state(
+            self.filepath, epoch, self.trainer.state,
+            cursor=fn(epoch + 1, 0) if callable(fn) else None,
+        )
         self.trainer.stop_training = True
         self.preempted = True
         if self.verbose and runtime.is_primary():

@@ -197,6 +197,24 @@ def finish_epoch(trainer, epoch, epochs, metric_acc, steps, t0, callbacks,
         shown = {k: round(v, 4) for k, v in logs.items()}
         print(f"Epoch {epoch + 1}/{epochs} - {shown}")
 
+def _accepts_anchoring(batches_fn) -> bool:
+    """Whether a duck-typed ``batches`` hook takes the anchored
+    ``start_epoch``/``batches_per_epoch`` keywords (explicitly or via
+    ``**kwargs``) — decided from the signature so a TypeError raised
+    INSIDE the source is never mistaken for 'not anchored'."""
+    import inspect
+
+    try:
+        params = inspect.signature(batches_fn).parameters
+    except (TypeError, ValueError):
+        return False
+    if any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    ):
+        return True
+    return {"start_epoch", "batches_per_epoch"} <= set(params)
+
+
 def _normalize_resume(initial_epoch: int, initial_step: int,
                       steps_per_epoch: int) -> tuple[int, int]:
     """Canonicalize a resume point against this run's epoch geometry: a
@@ -255,18 +273,20 @@ def run_fit(trainer,
     `ArrayDataset.batches`-style skip hook are fast-forwarded by drawing
     and discarding (correct, but materializes the skipped batches).
 
-    Anchoring, precisely: byte-identity is against an uninterrupted run
-    of the SAME call shape. The streamed ``x=``/``y=`` path builds a
-    fresh shuffle stream each fit (every elastic generation rebuilds its
-    pipeline), so epochs that PREDATE the resume call's ``initial_epoch``
-    are not replayed position-exact — within the resume epoch the skip is
-    exact, across older epochs the stream re-anchors (a valid full
-    shuffle pass either way; the recorded ROADMAP follow-up).
-    ``cache='device'`` is epoch-exact unconditionally (the permutation is
-    a pure function of (seed, epoch)); ``dataset=`` streams own their
-    epoch anchoring — hand the stream positioned at the resume epoch's
-    first batch and fit skips the ``S × K`` within it (the
-    `examples/elastic_mnist.py` / midstep-e2e idiom).
+    Anchoring: every feeding path is EPOCH-ANCHORED (durable stream
+    cursors, `data/stream.py`) — each epoch's order is a pure function
+    of ``(trainer.seed, epoch)``, so a resumed fit regenerates exactly
+    the stream an uninterrupted run would have consumed from
+    ``(initial_epoch, initial_step)`` on, INCLUDING when the epochs
+    before it were consumed by a process that no longer exists (the
+    formerly re-anchoring case, closed by ISSUE 8). This holds for the
+    streamed ``x=``/``y=`` path (python and native engines alike),
+    ``cache='device'`` (pure (seed, epoch) permutation, as before), and
+    ``dataset=`` sources exposing the anchored ``batches(skip=,
+    start_epoch=, batches_per_epoch=)`` hook (`ArrayDataset`,
+    `FileDataset.pairs_stream`, `PackedLMStream`); bare ``batches(
+    skip=)`` sources keep the PR 5 contract (exact within the resume
+    epoch, the source owns its own cross-epoch anchoring).
 
     ``cache='device'`` (with ``x``/``y``) stages the whole dataset into
     HBM once, sharded over the data axes, and runs shuffling + batching +
@@ -328,15 +348,35 @@ def run_fit(trainer,
         )
         # Batch assembly runs in the native C++ producer thread when
         # available (overlapping shuffle/gather with the device step),
-        # pure Python otherwise — same semantics either way. A mid-epoch
-        # resume fast-forwards the engine's OWN stream by K·S microbatches
-        # (accumulation-aligned), so the resumed sequence is byte-identical
-        # to the uninterrupted one whichever engine is active.
+        # pure Python otherwise — same semantics either way. The stream
+        # is EPOCH-ANCHORED (start_epoch/batches_per_epoch): every
+        # epoch's order is a pure function of (seed, epoch), so a resume
+        # at (initial_epoch, initial_step) regenerates byte-identically
+        # what the uninterrupted run consumed from that position on —
+        # including when the epochs before it were consumed by a process
+        # that no longer exists (the durable-cursor contract,
+        # data/stream.py) — whichever engine is active.
+        engine: dict = {}
         dataset, close_input = training_pipeline(
             ds.arrays, local_batch, seed=trainer.seed,
             shuffle_buffer=shuffle_buffer, structure=ds.structure,
             skip_batches=initial_step * trainer._accum_steps,
+            start_epoch=initial_epoch,
+            batches_per_epoch=steps_per_epoch * trainer._accum_steps,
+            engine_out=engine,
         )
+        # Full stream geometry for the durable cursor: the ENGINE is
+        # part of it (python and native anchored streams are different
+        # byte streams), as are the batch/row counts.
+        trainer._stream_geometry = {
+            "path": "streamed",
+            "engine": engine.get("engine"),
+            "accum": trainer._accum_steps,
+            "steps_per_epoch": steps_per_epoch,
+            "batch_size": local_batch,
+            "n_examples": n_local,
+            "shuffle_buffer": shuffle_buffer,
+        }
         it = iter(dataset)
     elif steps_per_epoch is None:
         raise ValueError("steps_per_epoch is required with a dataset")
@@ -345,11 +385,38 @@ def run_fit(trainer,
             initial_epoch, initial_step, steps_per_epoch
         )
         skip = initial_step * trainer._accum_steps
-        if skip and hasattr(dataset, "batches"):
-            # ArrayDataset-style source: index-level skip, nothing
-            # materialized (and reshard-stable — the stream is a pure
-            # function of seed + shard geometry).
-            it = dataset.batches(skip=skip)
+        # dataset= sources: the geometry the trainer can see (the
+        # source's own cursor surface carries the rest — seed, shard
+        # spec, row counts).
+        trainer._stream_geometry = {
+            "path": "streamed",
+            "engine": "dataset",
+            "accum": trainer._accum_steps,
+            "steps_per_epoch": steps_per_epoch,
+        }
+        if hasattr(dataset, "batches"):
+            # ArrayDataset-style source (ArrayDataset, FilePairs,
+            # PackedLMStream, any duck-typed `batches(skip=, start_epoch=,
+            # batches_per_epoch=)`): index-level fast-forward, nothing
+            # materialized, and EPOCH-ANCHORED — the stream starts at the
+            # resume epoch's exact position (reshard-stable: the stream
+            # is a pure function of seed + shard geometry + epoch).
+            # Capability is probed from the SIGNATURE, not by catching
+            # TypeError around the call — a TypeError raised inside a
+            # broken anchored source must surface, not silently degrade
+            # the resume to an unanchored stream.
+            if _accepts_anchoring(dataset.batches):
+                it = dataset.batches(
+                    skip=skip, start_epoch=initial_epoch,
+                    batches_per_epoch=(
+                        steps_per_epoch * trainer._accum_steps
+                    ),
+                )
+            else:
+                # Pre-anchoring source with a bare `batches(skip=)` hook:
+                # exact within the resume epoch (the PR 5 contract);
+                # cross-epoch anchoring is the source's own business.
+                it = dataset.batches(skip=skip) if skip else iter(dataset)
         else:
             it = iter(dataset)
             # Generic iterables expose no skip hook: draw and discard
@@ -538,6 +605,12 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
         initial_epoch, initial_step, steps
     )
     trainer._resume_epoch, trainer._resume_step = initial_epoch, initial_step
+    trainer._stream_geometry = {
+        "path": "device",
+        "accum": trainer._accum_steps,
+        "steps_per_epoch": steps,
+        "batch_size": batch_size,
+    }
     trainer.build(
         np.asarray(x[: trainer.dp_size]), np.asarray(y[: trainer.dp_size])
     )
@@ -545,6 +618,17 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
     callbacks = _with_env_callbacks(callbacks)
     for cb in callbacks:
         cb.set_trainer(trainer)
+    # Step-chunked epoch executables (HVT_EPOCH_CHUNK_STEPS): split each
+    # on-device epoch into compiled chunks of C optimizer steps so
+    # on_batch_end fires per chunk — sub-epoch commit/rescale/save
+    # cadences (elastic commit_every_steps, HVT_SAVE_EVERY_STEPS) work on
+    # the device-cached path too. `start` is a dynamic jit argument, so
+    # the whole epoch costs at most two executables (full chunk +
+    # remainder), independent of the chunk count. 0 = whole-epoch program
+    # (the historical single-dispatch behavior).
+    from horovod_tpu.analysis import registry
+
+    chunk = registry.get_int("HVT_EPOCH_CHUNK_STEPS") or 0
     try:
         # Inside the teardown scope — see the streamed fit path's note.
         for cb in callbacks:
@@ -563,12 +647,24 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
                 t0 = time.perf_counter()
                 scale = jnp.asarray(trainer.update_scale, jnp.float32)
                 start = initial_step if epoch == initial_epoch else 0
-                trainer.state, metrics, metric_acc = trainer._train_epoch(
-                    trainer.state, data, jax.random.fold_in(epoch_key, epoch),
-                    scale, zero_acc, steps, batch_size, start,
-                )
-                for cb in callbacks:
-                    cb.on_batch_end(steps - 1, metrics)
+                c = chunk if chunk > 0 else steps - start
+                metric_acc = zero_acc
+                at = start
+                while at < steps:
+                    n = min(c, steps - at)
+                    trainer.state, metrics, metric_acc = (
+                        trainer._train_epoch(
+                            trainer.state, data,
+                            jax.random.fold_in(epoch_key, epoch),
+                            scale, metric_acc, n, batch_size, at,
+                        )
+                    )
+                    at += n
+                    # Once per chunk, with the chunk's last step metrics
+                    # and the TRUE within-epoch step index — the
+                    # steps_per_execution callback contract.
+                    for cb in callbacks:
+                        cb.on_batch_end(at - 1, metrics)
                 finish_epoch(trainer,
                     epoch, epochs, metric_acc, steps - start, t0, callbacks,
                     validation_data, batch_size, verbose,

@@ -156,6 +156,10 @@ class Trainer:
         # measure step cadences from the true resume point.
         self._resume_epoch = 0
         self._resume_step = 0
+        # Geometry of the CURRENT fit's data stream (set by the feeding
+        # paths) — what `stream_cursor` stamps into the durable cursors
+        # that ride checkpoint manifests and elastic commits.
+        self._stream_geometry: dict | None = None
         # Keras's steps_per_execution: K > 1 compiles a lax.scan over K train
         # steps into ONE executable, so dispatch + input-transfer overhead is
         # paid once per K steps instead of per step. Semantics trade-off
@@ -603,9 +607,9 @@ class Trainer:
 
         def train_epoch(
             state: TrainState, data, epoch_seed, update_scale, metric_acc,
-            steps: int, per_chip_batch: int, start: int = 0,
+            steps: int, per_chip_batch: int, start=0,
         ):
-            """One epoch over a DEVICE-RESIDENT dataset, fully on-device.
+            """One epoch CHUNK over a DEVICE-RESIDENT dataset, on-device.
 
             ``data`` leaves are [n_shards, per_shard_n, ...], example axis
             sharded over the data axes — the dataset lives in HBM. Each epoch
@@ -618,12 +622,17 @@ class Trainer:
             tensorflow2_keras_mnist.py:37-41), with the improvement that
             shards partition the data so an epoch sees each example once.
 
-            ``start`` resumes MID-epoch at optimizer step ``start`` (the
-            `fit(initial_step=)` contract): the permutation is a pure
-            function of ``epoch_seed``, so the resume epoch regenerates
-            the uninterrupted epoch's exact order and the gather/scan
-            below simply begin at step ``start`` — the skipped steps'
-            rows are never gathered."""
+            ``start`` begins the chunk MID-epoch at optimizer step
+            ``start`` (the `fit(initial_step=)` resume contract AND the
+            step-chunked epoch cadence, ``HVT_EPOCH_CHUNK_STEPS``): the
+            permutation is a pure function of ``epoch_seed``, so any
+            chunk regenerates the uninterrupted epoch's exact order and
+            the gather/scan below simply cover steps [start, start +
+            steps) — rows outside the window are never gathered.
+            ``start`` is a DYNAMIC argument (``steps`` is the static
+            chunk length), so every same-length chunk of an epoch shares
+            ONE compiled executable — an epoch split into C chunks costs
+            at most two programs (full + remainder), not C."""
             first = jax.tree.leaves(data)[0]
             n_shards, per_n = first.shape[0], first.shape[1]
             K = self._accum_steps  # microbatches consumed per optimizer step
@@ -643,14 +652,14 @@ class Trainer:
             # live alongside `data` for the epoch — the device-cached path
             # trades HBM for zero per-step host/latency cost by design; use
             # the streamed fit path when the dataset crowds HBM.
-            lo = start * per_chip_batch * K
-            need = steps * per_chip_batch * K
-            width = need - lo
+            lo = jnp.asarray(start, jnp.int32) * (per_chip_batch * K)
+            width = steps * per_chip_batch * K  # static: chunk row count
+            window = jax.lax.dynamic_slice_in_dim(order, lo, width, axis=1)
             shuffled = jax.tree.map(
                 lambda a: jax.vmap(
                     lambda rows, ii: jnp.take(rows, ii, axis=0)
                 )(
-                    a.reshape(a.shape[0], a.shape[1], -1), order[:, lo:need]
+                    a.reshape(a.shape[0], a.shape[1], -1), window
                 ).reshape((a.shape[0], width) + a.shape[2:]),
                 data,
             )
@@ -684,7 +693,7 @@ class Trainer:
                 return (state, acc), metrics
 
             (state, metric_acc), metrics = jax.lax.scan(
-                body, (state, metric_acc), jnp.arange(steps - start)
+                body, (state, metric_acc), jnp.arange(steps)
             )
             last = jax.tree.map(lambda m: m[-1], metrics)
             return state, last, metric_acc
@@ -786,8 +795,11 @@ class Trainer:
         self._train_chunk_donated = jax.jit(
             train_chunk, donate_argnums=state_donate + (1,)
         )
+        # `start` (argnum 7) is DYNAMIC: every same-length chunk of a
+        # step-chunked epoch (HVT_EPOCH_CHUNK_STEPS) and every resume
+        # offset reuses one executable per chunk length.
         self._train_epoch = jax.jit(
-            train_epoch, static_argnums=(5, 6, 7),
+            train_epoch, static_argnums=(5, 6),
             donate_argnums=state_donate,
         )
         self._eval_step = jax.jit(eval_step)
@@ -868,6 +880,32 @@ class Trainer:
 
         self.state = jax.tree.map(place, host_state, self.state)
         return self.state
+
+    def stream_cursor(self, epoch: int, step: int) -> dict | None:
+        """The durable stream cursor for training position "``step``
+        optimizer steps into epoch ``epoch``" of the CURRENT fit, as a
+        serializable dict (`data.stream.StreamCursor`) — None before any
+        fit established a stream geometry.
+
+        Because every feeding path anchors its per-epoch order to a pure
+        function of ``(trainer.seed, epoch)``, this cursor plus the same
+        fit-call shape fully reconstructs the data position:
+        ``fit(initial_epoch=cursor['epoch'], initial_step=
+        cursor['step'])`` resumes byte-exactly. The cursor rides the
+        checkpoint progress manifests (`checkpoint.save(cursor=)` — the
+        `ModelCheckpoint` path stamps it automatically) and elastic
+        commits (`ElasticState.cursor`), recording the stream-format
+        version so a resume against an INCOMPATIBLE derivation is
+        refused loudly (`stream.StreamCursorError`), never silently
+        re-anchored."""
+        if self._stream_geometry is None:
+            return None
+        from horovod_tpu.data import stream as stream_lib
+
+        return stream_lib.StreamCursor(
+            kind="fit", seed=int(self.seed), epoch=int(epoch),
+            step=int(step), position=dict(self._stream_geometry),
+        ).to_dict()
 
     # --- feeding / verbs — bodies live in training/feeding.py --------------
 

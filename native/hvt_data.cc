@@ -10,7 +10,8 @@
 //
 // Exposed as a tiny C ABI consumed via ctypes (no pybind11 in this image):
 //   hvt_loader_create(arr_ptrs, row_bytes, n_arrays, n_examples,
-//                     batch, n_slots, seed, shuffle)  -> handle
+//                     batch, n_slots, seed, shuffle,
+//                     start_epoch, batches_per_epoch)  -> handle
 //   hvt_loader_next(handle)             -> slot id (blocks until filled)
 //   hvt_loader_slot_ptr(handle, slot, array_idx) -> buffer pointer
 //   hvt_loader_release(handle, slot)    -> recycle a consumed slot
@@ -20,6 +21,17 @@
 // permutation per epoch (the reference's shuffle(10000)-over-60k behaves
 // as one, tensorflow2_keras_mnist.py:40), repeating forever; batches never
 // straddle an epoch boundary remainder (drop_remainder=True).
+//
+// Epoch anchoring (the durable-stream-cursor contract, data/stream.py):
+// each pass's permutation is a PURE function of (seed, epoch, pass) — the
+// RNG is reseeded via splitmix64 mixing and the permutation reset to
+// identity at every pass start — so any position in the infinite stream
+// is reconstructible without replaying the stream before it:
+//   * start_epoch anchors the stream's first epoch to an absolute number;
+//   * batches_per_epoch > 0 cuts epochs at exactly that many batches
+//     (passes roll within an epoch when it is longer than one permutation;
+//     the unconsumed tail of a pass is discarded at the epoch boundary);
+//     0 keeps the historical pass-per-epoch semantics, now anchored.
 
 #include <atomic>
 #include <condition_variable>
@@ -30,6 +42,24 @@
 #include <vector>
 
 namespace {
+
+// splitmix64 — the seed-mixing primitive (also used inside XorShift128Plus
+// seeding); chains (seed, epoch, pass) into one well-distributed word so
+// every pass draws an independent, ADDRESSABLE permutation.
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+inline uint64_t mix_seed(uint64_t seed, int64_t epoch, int64_t pass) {
+  uint64_t s = splitmix64(seed);
+  s = splitmix64(s ^ (static_cast<uint64_t>(epoch) + 0xA5A5A5A5A5A5A5A5ULL));
+  s = splitmix64(s ^ (static_cast<uint64_t>(pass) + 0x5A5A5A5A5A5A5A5AULL));
+  return s;
+}
 
 // xorshift128+ — deterministic, seedable, fast; quality is ample for
 // shuffling (this is not a cryptographic context).
@@ -74,6 +104,8 @@ struct Loader {
   int64_t batch = 0;
   int n_slots = 0;
   bool shuffle = true;
+  int64_t start_epoch = 0;        // absolute epoch the stream starts at
+  int64_t batches_per_epoch = 0;  // 0 = one permutation pass per epoch
 
   // slot_buffers[slot][array] — owned staging buffers.
   std::vector<std::vector<std::vector<uint8_t>>> slots;
@@ -84,9 +116,9 @@ struct Loader {
   std::atomic<bool> stop{false};
   int consumers_in_next = 0;  // guarded by mu; destroy waits for 0
   std::thread producer;
-  XorShift128Plus rng;
+  uint64_t seed;
 
-  Loader(uint64_t seed) : rng(seed) {}
+  explicit Loader(uint64_t seed_) : seed(seed_) {}
 
   void fill(int slot, const std::vector<int64_t>& perm, int64_t offset) {
     for (size_t a = 0; a < arrays.size(); ++a) {
@@ -99,19 +131,47 @@ struct Loader {
     }
   }
 
+  // Reset the permutation to identity and Fisher-Yates it with the rng
+  // derived purely from (seed, epoch, pass): the anchoring invariant.
+  void reshuffle(std::vector<int64_t>* perm, int64_t epoch, int64_t pass) {
+    for (int64_t i = 0; i < n_examples; ++i) (*perm)[i] = i;
+    if (!shuffle) return;
+    XorShift128Plus rng(mix_seed(seed, epoch, pass));
+    for (int64_t i = n_examples - 1; i > 0; --i) {
+      const int64_t j = static_cast<int64_t>(rng.bounded(i + 1));
+      std::swap((*perm)[i], (*perm)[j]);
+    }
+  }
+
   void run() {
     std::vector<int64_t> perm(n_examples);
-    for (int64_t i = 0; i < n_examples; ++i) perm[i] = i;
+    int64_t epoch = start_epoch;
+    int64_t pass = 0;
+    int64_t emitted = 0;          // batches emitted within the epoch
     int64_t cursor = n_examples;  // force a reshuffle on first use
     const int64_t usable = n_examples - n_examples % batch;
     while (!stop.load(std::memory_order_relaxed)) {
+      if (batches_per_epoch > 0 && emitted >= batches_per_epoch) {
+        // Epoch boundary by batch count: discard the pass tail, advance.
+        ++epoch;
+        pass = 0;
+        emitted = 0;
+        cursor = n_examples;  // force the new epoch's first shuffle
+      }
       if (cursor >= usable) {
-        if (shuffle) {
-          for (int64_t i = n_examples - 1; i > 0; --i) {
-            const int64_t j = static_cast<int64_t>(rng.bounded(i + 1));
-            std::swap(perm[i], perm[j]);
+        if (cursor != static_cast<int64_t>(n_examples) ||
+            emitted > 0 || pass > 0) {
+          // A pass genuinely ran dry (not the initial sentinel): with
+          // batch-cut epochs the next pass stays inside this epoch;
+          // with pass-per-epoch semantics the pass boundary IS the
+          // epoch boundary.
+          if (batches_per_epoch > 0) {
+            ++pass;
+          } else {
+            ++epoch;
           }
         }
+        reshuffle(&perm, epoch, pass);
         cursor = 0;
       }
       int slot = -1;
@@ -130,6 +190,7 @@ struct Loader {
       }
       fill(slot, perm, cursor);
       cursor += batch;
+      ++emitted;
       {
         std::lock_guard<std::mutex> lk(mu);
         ready.push_back(slot);
@@ -143,10 +204,22 @@ struct Loader {
 
 extern "C" {
 
+// ABI handshake: bumped whenever hvt_loader_create's signature or the
+// stream semantics change. The Python binding refuses to use a library
+// reporting a different version (or lacking the symbol — a pre-handshake
+// build): calling a stale 8-arg library with 10 args would silently
+// ignore the anchoring arguments and produce a DIFFERENT byte stream
+// than the cursors describe.
+//   v2: (seed, epoch, pass)-anchored permutations; start_epoch /
+//       batches_per_epoch create arguments.
+int hvt_loader_abi_version() { return 2; }
+
 void* hvt_loader_create(const uint8_t** arr_ptrs, const int64_t* row_bytes,
                         int n_arrays, int64_t n_examples, int64_t batch,
-                        int n_slots, uint64_t seed, int shuffle) {
-  if (n_arrays <= 0 || n_examples < batch || batch <= 0 || n_slots < 2)
+                        int n_slots, uint64_t seed, int shuffle,
+                        int64_t start_epoch, int64_t batches_per_epoch) {
+  if (n_arrays <= 0 || n_examples < batch || batch <= 0 || n_slots < 2 ||
+      start_epoch < 0 || batches_per_epoch < 0)
     return nullptr;
   auto* L = new Loader(seed);
   L->arrays.assign(arr_ptrs, arr_ptrs + n_arrays);
@@ -155,6 +228,8 @@ void* hvt_loader_create(const uint8_t** arr_ptrs, const int64_t* row_bytes,
   L->batch = batch;
   L->n_slots = n_slots;
   L->shuffle = shuffle != 0;
+  L->start_epoch = start_epoch;
+  L->batches_per_epoch = batches_per_epoch;
   L->slots.resize(n_slots);
   for (int s = 0; s < n_slots; ++s) {
     L->slots[s].resize(n_arrays);

@@ -256,3 +256,474 @@ class TestResumeBitwise:
         with pytest.raises(ValueError, match="initial_step"):
             t.fit(x=x, y=y, batch_size=4, epochs=2, initial_step=-1,
                   steps_per_epoch=T, verbose=0)
+
+
+# =========================================================================
+# ISSUE 8 — durable stream cursors: byte-exact CROSS-EPOCH resume.
+#
+# The PR 5 gap: the streamed paths re-anchored epochs that PREDATE the
+# resume call (a resumed fit's fresh stream called its first pass "the
+# resume epoch", while the uninterrupted run's resume epoch was a later
+# pass of an evolving RNG). With every engine's per-epoch order now a
+# pure function of (seed, epoch, pass), a run interrupted in epoch N ≥ 2
+# and resumed at (N, S) must land BITWISE equal to the uninterrupted
+# control — the previously-impossible case.
+# =========================================================================
+
+
+class TestCrossEpochStreamAnchoring:
+    """Data layer: the stream from (start_epoch=E, skip=S) equals the
+    uninterrupted stream's tail — python and native engines."""
+
+    @pytest.mark.parametrize("native", [False, True])
+    def test_pipeline_cross_epoch_tail(self, native, monkeypatch):
+        if native:
+            from horovod_tpu.data import native_loader
+
+            if not native_loader.available():
+                pytest.skip("native loader unavailable")
+        else:
+            monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        from horovod_tpu.data.loader import training_pipeline
+
+        x = np.arange(120, dtype=np.float32).reshape(60, 2)
+        y = np.arange(60, dtype=np.int64)
+        B = 7  # batches per (trainer) epoch; pass = 12 batches
+        it_a, close_a = training_pipeline(
+            (x, y), 5, seed=11, batches_per_epoch=B
+        )
+        full = [b for _, b in zip(range(5 * B), it_a)]
+        close_a()
+        # Resume at (epoch 3, step 2): epochs 0-2 were consumed by a
+        # process that no longer exists — the re-anchoring case.
+        it_b, close_b = training_pipeline(
+            (x, y), 5, seed=11, start_epoch=3, skip_batches=2,
+            batches_per_epoch=B,
+        )
+        tail = [b for _, b in zip(range(2 * B - 2), it_b)]
+        close_b()
+        _batches_equal(full[3 * B + 2:], tail)
+
+    def test_epoch_longer_than_one_pass_rolls_anchored(self, monkeypatch):
+        """batches_per_epoch > one permutation pass: intra-epoch passes
+        are themselves anchored ((seed, epoch, pass)), so the resume
+        still lands byte-exactly mid-rollover."""
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        from horovod_tpu.data.loader import training_pipeline
+
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        y = np.arange(20, dtype=np.int64)
+        B = 7  # pass = 4 batches -> ~2 rollovers per epoch
+        it_a, close_a = training_pipeline(
+            (x, y), 5, seed=3, batches_per_epoch=B
+        )
+        full = [b for _, b in zip(range(3 * B), it_a)]
+        close_a()
+        it_b, close_b = training_pipeline(
+            (x, y), 5, seed=3, start_epoch=1, skip_batches=5,
+            batches_per_epoch=B,
+        )
+        tail = [b for _, b in zip(range(2 * B - 5), it_b)]
+        close_b()
+        _batches_equal(full[B + 5:], tail)
+
+
+class TestStreamCursorContract:
+    """The serializable cursor surface: round trips, loud refusals."""
+
+    def _ds(self):
+        x = np.arange(80, dtype=np.float32).reshape(40, 2)
+        return ArrayDataset((x, np.arange(40))).repeat().shuffle(
+            40, seed=3
+        ).batch(4)
+
+    def test_cursor_round_trip_byte_exact(self):
+        import json
+
+        ds = self._ds()
+        full = [b for _, b in zip(range(21),
+                                  ds.batches(batches_per_epoch=7))]
+        cur = json.loads(json.dumps(
+            ds.stream_cursor(2, 3, batches_per_epoch=7).to_dict()
+        ))
+        tail = [b for _, b in zip(range(4), ds.batches_from(cur))]
+        _batches_equal(full[17:], tail)
+
+    def test_older_format_refused_loudly(self):
+        from horovod_tpu.data import stream as stream_lib
+
+        ds = self._ds()
+        cur = ds.stream_cursor(1, 0).to_dict()
+        cur["format"] = 0
+        with pytest.raises(stream_lib.StreamCursorError,
+                           match="format 0"):
+            ds.batches_from(cur)
+        with pytest.raises(stream_lib.StreamCursorError,
+                           match="missing 'format'"):
+            ds.batches_from({"kind": "array", "epoch": 1})
+
+    def test_wrong_kind_and_geometry_refused(self):
+        from horovod_tpu.data import stream as stream_lib
+
+        ds = self._ds()
+        cur = ds.stream_cursor(1, 0)
+        cur.kind = "file"
+        with pytest.raises(stream_lib.StreamCursorError,
+                           match="cannot resume"):
+            ds.batches_from(cur)
+        cur2 = ds.stream_cursor(1, 0)
+        cur2.position["n_examples"] = 39
+        with pytest.raises(stream_lib.StreamCursorError,
+                           match="n_examples"):
+            ds.batches_from(cur2)
+
+    def test_file_cursor_preserves_shuffle_mode(self, tmp_path):
+        """shuffle=False is stream GEOMETRY: the cursor records it and
+        reconstruction honours it (a shuffled reconstruction of an
+        ordered stream is silently different bytes — the review-found
+        bug class)."""
+        from horovod_tpu.data.filedataset import FileDataset, write_shards
+
+        d = write_shards({"a": np.arange(40)}, str(tmp_path / "ds"),
+                         shard_size=16)
+        ds = FileDataset(d)
+        full = [b["a"] for _, b in zip(range(10), ds.batches(
+            4, shuffle=False, batches_per_epoch=5))]
+        cur = ds.stream_cursor(
+            0, 2, batch_size=4, shuffle=False, batches_per_epoch=5
+        ).to_dict()
+        got = [b["a"] for _, b in zip(range(8), ds.batches_from(cur))]
+        for p, q in zip(full[2:], got):
+            np.testing.assert_array_equal(p, q)
+
+    def test_file_cursor_from_repeat_stream_stays_infinite(self, tmp_path):
+        """A cursor cut from a repeating stream reconstructs as a
+        REPEATING stream — never silently truncated at the resume
+        epoch's boundary (review-found trap)."""
+        from horovod_tpu.data.filedataset import FileDataset, write_shards
+
+        d = write_shards({"a": np.arange(40)}, str(tmp_path / "ds"),
+                         shard_size=16)
+        ds = FileDataset(d)
+        full = [b["a"] for _, b in zip(
+            range(30), ds.batches(4, seed=2, repeat=True))]
+        cur = ds.stream_cursor(1, 2, batch_size=4, seed=2).to_dict()
+        # 18 batches spans well past the resume epoch's remainder (8).
+        got = [b["a"] for _, b in zip(range(18), ds.batches_from(cur))]
+        assert len(got) == 18
+        for p, q in zip(full[12:], got):
+            np.testing.assert_array_equal(p, q)
+
+    def test_preemption_checkpoint_carries_cursor(self, tmp_path,
+                                                  monkeypatch):
+        """The preemption grace-window save stamps the cursor like every
+        other checkpoint writer — the restart path is exactly where the
+        format/geometry refusal matters."""
+        import signal as _signal
+
+        from horovod_tpu import checkpoint
+
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x, y = _data()
+        t = _trainer()
+        cb = hvt.callbacks.PreemptionCheckpointCallback(
+            str(tmp_path / "checkpoint-{epoch}.msgpack")
+        )
+
+        class Fire(hvt.callbacks.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+        import os
+
+        t.fit(x=x, y=y, batch_size=4, epochs=2, steps_per_epoch=T,
+              callbacks=[cb, Fire()], verbose=0)
+        path = checkpoint.latest_checkpoint(str(tmp_path))
+        assert path is not None
+        cur = checkpoint.checkpoint_cursor(path)
+        assert cur is not None and cur.kind == "fit"
+
+    def test_file_pairs_refuses_mismatched_stripe(self, tmp_path):
+        """FilePairs validates the FULL geometry: a cursor cut on a
+        different per-process stripe is refused, not silently resumed
+        on the new stripe's permutations."""
+        from horovod_tpu.data import stream as stream_lib
+        from horovod_tpu.data.filedataset import FileDataset, write_shards
+
+        d = write_shards({"x": np.arange(40), "y": np.arange(40)},
+                         str(tmp_path / "ds"), shard_size=16)
+        ds = FileDataset(d)
+        cur = ds.shard(0, 2).pairs_stream("x", "y", 4).stream_cursor(1, 1)
+        with pytest.raises(stream_lib.StreamCursorError, match="shard"):
+            ds.shard(0, 4).pairs_stream("x", "y", 4).batches_from(cur)
+
+    def test_native_cursor_missing_batch_size_refused(self):
+        from horovod_tpu.data import native_loader, stream as stream_lib
+
+        if not native_loader.available():
+            pytest.skip("native loader unavailable")
+        cur = stream_lib.StreamCursor(
+            kind="native", seed=1, epoch=0, step=0,
+            position={"n_examples": 16},
+        ).to_dict()
+        with pytest.raises(stream_lib.StreamCursorError,
+                           match="batch_size"):
+            native_loader.NativeBatchLoader.from_cursor(
+                [np.arange(16)], cur
+            )
+
+    def test_packed_lm_stream_cursor(self):
+        from horovod_tpu.data.packing import PackedLMStream
+
+        rng = np.random.RandomState(0)
+        docs = [rng.randint(1, 30, size=rng.randint(4, 10))
+                for _ in range(60)]
+        s = PackedLMStream(docs, seq_len=16, batch_size=4, seed=5)
+        full = [b for _, b in zip(range(12),
+                                  s.batches(batches_per_epoch=4))]
+        cur = s.stream_cursor(1, 2, batches_per_epoch=4).to_dict()
+        tail = [b for _, b in zip(range(6), s.batches_from(cur))]
+        _batches_equal(full[6:], tail)
+
+    def test_native_cursor_reconstruction(self):
+        from horovod_tpu.data import native_loader
+
+        if not native_loader.available():
+            pytest.skip("native loader unavailable")
+        x = np.arange(48, dtype=np.int64)
+        a = native_loader.NativeBatchLoader(
+            [x], 6, seed=4, batches_per_epoch=5
+        )
+        consumed = [next(a)[0] for _ in range(8)]
+        cur = a.cursor().to_dict()
+        rest = [next(a)[0] for _ in range(7)]
+        a.close()
+        b = native_loader.NativeBatchLoader.from_cursor([x], cur)
+        got = [next(b)[0] for _ in range(7)]
+        b.close()
+        for p, q in zip(rest, got):
+            np.testing.assert_array_equal(p, q)
+
+    def test_checkpoint_manifest_carries_cursor(self, tmp_path):
+        """The cursor rides .meta.json; an incompatible format version is
+        refused loudly at read time, never silently re-anchored."""
+        from horovod_tpu import checkpoint
+        from horovod_tpu.data import stream as stream_lib
+
+        path = str(tmp_path / "checkpoint-3.msgpack")
+        cur = stream_lib.StreamCursor(
+            kind="fit", seed=7, epoch=3, step=2,
+            position={"steps_per_epoch": T, "accum": 1},
+        ).to_dict()
+        checkpoint.save(path, {"w": np.zeros(2)}, progress=(3, 2),
+                        cursor=cur)
+        got = checkpoint.checkpoint_cursor(path)
+        assert (got.epoch, got.step, got.kind) == (3, 2, "fit")
+        assert checkpoint.checkpoint_progress(path) == (3, 2)
+        # Corrupt the recorded format version in place.
+        import json
+
+        meta = json.loads(
+            open(path + checkpoint.META_SUFFIX).read()
+        )
+        meta["cursor"]["format"] = 99
+        with open(path + checkpoint.META_SUFFIX, "w") as f:
+            f.write(json.dumps(meta))
+        with pytest.raises(stream_lib.StreamCursorError, match="99"):
+            checkpoint.checkpoint_cursor(path)
+
+
+def _interrupt_and_resume(make_trainer, fit, S_kill):
+    """The matrix cell driver: control = one uninterrupted fit over
+    EPOCHS epochs; interrupted = epochs [0, 2) in one fit, a partial
+    epoch 2 of S_kill steps (mid-epoch kill; skipped when S_kill == 0 —
+    the epoch-boundary kill), then a resumed fit from (2, S_kill).
+    Returns (control trainer, resumed trainer) for bitwise comparison."""
+    tA = make_trainer()
+    fit(tA, initial_epoch=0, initial_step=0, epochs=EPOCHS + 1)
+    tB = make_trainer()
+    fit(tB, initial_epoch=0, initial_step=0, epochs=2)
+    if S_kill:
+        fit(tB, initial_epoch=2, initial_step=0, epochs=3,
+            steps_override=S_kill)
+    fit(tB, initial_epoch=2, initial_step=S_kill, epochs=EPOCHS + 1)
+    return tA, tB
+
+
+class TestCrossEpochResumeMatrix:
+    """Trainer level: {streamed, file-backed, packed-LM, native,
+    device-cached} × kill-point {mid-epoch, epoch boundary} × {same
+    world, resharded} — interrupted in epoch 2 (consumed epochs 0-1
+    PREDATE the resume call), final params + opt state bitwise equal to
+    the uninterrupted control."""
+
+    @pytest.mark.parametrize("S_kill", [S, 0],
+                             ids=["mid-epoch", "boundary"])
+    @pytest.mark.parametrize("K", [1, 4])
+    def test_streamed_python(self, K, S_kill, monkeypatch):
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x, y = _data()
+
+        def fit(t, *, initial_epoch, initial_step, epochs,
+                steps_override=None):
+            t.fit(x=x, y=y, batch_size=4, epochs=epochs,
+                  initial_epoch=initial_epoch, initial_step=initial_step,
+                  steps_per_epoch=steps_override or T, verbose=0)
+
+        tA, tB = _interrupt_and_resume(lambda: _trainer(K), fit, S_kill)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+    @pytest.mark.parametrize("S_kill", [S, 0],
+                             ids=["mid-epoch", "boundary"])
+    def test_streamed_native(self, S_kill, monkeypatch):
+        from horovod_tpu.data import native_loader
+
+        if not native_loader.available():
+            pytest.skip("native loader unavailable")
+        monkeypatch.delenv("HVT_NO_NATIVE", raising=False)
+        x, y = _data()
+
+        def fit(t, *, initial_epoch, initial_step, epochs,
+                steps_override=None):
+            t.fit(x=x, y=y, batch_size=4, epochs=epochs,
+                  initial_epoch=initial_epoch, initial_step=initial_step,
+                  steps_per_epoch=steps_override or T, verbose=0)
+
+        tA, tB = _interrupt_and_resume(_trainer, fit, S_kill)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+    @pytest.mark.parametrize("reshard", [False, True])
+    @pytest.mark.parametrize("S_kill", [S, 0],
+                             ids=["mid-epoch", "boundary"])
+    def test_file_backed(self, S_kill, reshard, tmp_path, monkeypatch):
+        from horovod_tpu.data.filedataset import FileDataset, write_shards
+
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+        x, y = _data()
+        d = write_shards({"x": x, "y": y}, str(tmp_path / "ds"),
+                         shard_size=32)
+        base = FileDataset(d).shard(0, 2)
+
+        def fit(t, *, initial_epoch, initial_step, epochs,
+                steps_override=None, view=base):
+            t.fit(view.pairs_stream("x", "y", 8, seed=13),
+                  epochs=epochs, initial_epoch=initial_epoch,
+                  initial_step=initial_step,
+                  steps_per_epoch=steps_override or T, verbose=0)
+
+        tA = _trainer()
+        fit(tA, initial_epoch=0, initial_step=0, epochs=EPOCHS + 1)
+        tB = _trainer()
+        fit(tB, initial_epoch=0, initial_step=0, epochs=2)
+        if S_kill:
+            fit(tB, initial_epoch=2, initial_step=0, epochs=3,
+                steps_override=S_kill)
+        # The resumed generation recuts its stripe from the full row
+        # space (the elastic rescale hook) when `reshard` — same-size
+        # recut must reproduce the identical stream.
+        resumed_view = base.reshard(0, 2) if reshard else base
+        fit(tB, initial_epoch=2, initial_step=S_kill,
+            epochs=EPOCHS + 1, view=resumed_view)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+    @pytest.mark.parametrize("S_kill", [S, 0],
+                             ids=["mid-epoch", "boundary"])
+    def test_packed_lm(self, S_kill, monkeypatch):
+        import flax.linen as nn2
+        import optax as optax2
+
+        from horovod_tpu.data.packing import PackedLMStream
+
+        monkeypatch.setenv("HVT_NO_NATIVE", "1")
+
+        class TinyLM(nn2.Module):
+            @nn2.compact
+            def __call__(self, x, train=False):
+                emb = nn2.Embed(32, 8)(x[..., 0])
+                return nn2.Dense(32)(emb)
+
+        def masked_ce(logits, y2):
+            import jax.numpy as jnp
+            import optax as _o
+
+            per = _o.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y2[..., 0]
+            )
+            w = y2[..., 1].astype(jnp.float32)
+            return (per * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
+
+        rng = np.random.RandomState(1)
+        docs = [rng.randint(1, 30, size=rng.randint(4, 10))
+                for _ in range(160)]
+        stream = PackedLMStream(docs, seq_len=12, batch_size=8, seed=21)
+
+        def make():
+            return hvt.Trainer(
+                TinyLM(),
+                hvt.DistributedOptimizer(optax2.adam(1e-2)),
+                loss=masked_ce, seed=3,
+            )
+
+        def fit(t, *, initial_epoch, initial_step, epochs,
+                steps_override=None):
+            t.fit(stream, epochs=epochs, initial_epoch=initial_epoch,
+                  initial_step=initial_step,
+                  steps_per_epoch=steps_override or T, verbose=0)
+
+        tA, tB = _interrupt_and_resume(make, fit, S_kill)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+    @pytest.mark.parametrize("S_kill", [S, 0],
+                             ids=["mid-epoch", "boundary"])
+    def test_device_cached(self, S_kill):
+        x, y = _data(256)
+
+        def fit(t, *, initial_epoch, initial_step, epochs,
+                steps_override=None):
+            t.fit(x=x, y=y, batch_size=2, cache="device", epochs=epochs,
+                  initial_epoch=initial_epoch, initial_step=initial_step,
+                  steps_per_epoch=steps_override or T, verbose=0)
+
+        tA, tB = _interrupt_and_resume(_trainer, fit, S_kill)
+        assert _params_bytes(tA) == _params_bytes(tB)
+
+
+class TestDeviceCachedChunking:
+    """HVT_EPOCH_CHUNK_STEPS: step-chunked epoch executables on the
+    device-cached path — identical arithmetic, per-chunk on_batch_end
+    (so sub-epoch commit/rescale/save cadences work there too)."""
+
+    def test_chunked_bitwise_equal_and_callbacks_fire(self, monkeypatch):
+        x, y = _data(256)
+        tA = _trainer()
+        tA.fit(x=x, y=y, batch_size=2, cache="device", epochs=2,
+               steps_per_epoch=T, verbose=0)
+        seen = []
+
+        class Spy(hvt.callbacks.Callback):
+            def on_batch_end(self, batch, logs=None):
+                seen.append(batch)
+
+        monkeypatch.setenv("HVT_EPOCH_CHUNK_STEPS", "2")
+        tB = _trainer()
+        tB.fit(x=x, y=y, batch_size=2, cache="device", epochs=2,
+               steps_per_epoch=T, callbacks=[Spy()], verbose=0)
+        assert _params_bytes(tA) == _params_bytes(tB)
+        # T=4 steps, chunk=2 -> on_batch_end at steps 2 and 4 (1-based
+        # minus one), twice (2 epochs).
+        assert seen == [1, 3, 1, 3]
+
+    def test_chunked_mid_epoch_resume(self, monkeypatch):
+        """Chunking composes with the resume contract: a chunked fit
+        resumed at (epoch, S) still lands bitwise."""
+        monkeypatch.setenv("HVT_EPOCH_CHUNK_STEPS", "2")
+        x, y = _data(256)
+
+        def fit(t, *, initial_epoch, initial_step, epochs,
+                steps_override=None):
+            t.fit(x=x, y=y, batch_size=2, cache="device", epochs=epochs,
+                  initial_epoch=initial_epoch, initial_step=initial_step,
+                  steps_per_epoch=steps_override or T, verbose=0)
+
+        tA, tB = _interrupt_and_resume(_trainer, fit, S)
+        assert _params_bytes(tA) == _params_bytes(tB)

@@ -98,6 +98,52 @@ class TestIteration:
         assert not np.array_equal(first, second)
 
 
+class TestShardViews:
+    """`.shard(i, n)`/`.reshard(i, n)` — the ArrayDataset parity views
+    the elastic N→M rescale recuts on the file-backed path (ISSUE 8
+    satellite)."""
+
+    def test_shard_view_defaults_batches(self, store):
+        d, _, _ = store
+        ds = FileDataset(d)
+        view = ds.shard(1, 4)
+        assert view.shard_spec == (1, 4)
+        seen = {int(v) for b in view.batches(5, shuffle=False)
+                for v in b["y"]}
+        assert seen == set(range(1, 100, 4))
+
+    def test_reshard_recuts_from_full(self, store):
+        d, _, _ = store
+        ds = FileDataset(d)
+        # Unlike shard-of-shard, reshard derives from the FULL row space:
+        # a 2-way view resharded 4-way still partitions all 100 rows.
+        views = [ds.shard(0, 2).reshard(i, 4) for i in range(4)]
+        parts = [
+            {int(v) for b in v.batches(5, shuffle=False) for v in b["y"]}
+            for v in views
+        ]
+        assert set().union(*parts) == set(range(100))
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert not parts[i] & parts[j]
+
+    def test_reshard_same_size_identical_stream(self, store):
+        d, _, _ = store
+        ds = FileDataset(d).shard(0, 2)
+        a = [b["y"] for _, b in zip(
+            range(8), ds.batches(10, seed=4, repeat=True))]
+        r = ds.reshard(0, 2)
+        b = [bb["y"] for _, bb in zip(
+            range(4), r.batches(10, seed=4, repeat=True, skip=4))]
+        for p, q in zip(a[4:], b):
+            np.testing.assert_array_equal(p, q)
+
+    def test_out_of_range_rejected(self, store):
+        d, _, _ = store
+        with pytest.raises(ValueError, match="out of range"):
+            FileDataset(d).shard(3, 2)
+
+
 class TestTrainerIntegration:
     def test_fit_from_disk(self, tmp_path):
         import flax.linen as nn

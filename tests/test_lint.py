@@ -17,6 +17,7 @@ from horovod_tpu.analysis import cli, core, registry
 from horovod_tpu.analysis.rules import (
     CheckpointWriteAtomicity,
     CollectiveSymmetry,
+    DataLayerSeededRng,
     EnvKnobRegistry,
     TeardownDiscipline,
     TracingHazards,
@@ -330,6 +331,66 @@ class TestHVT005CheckpointWriteAtomicity:
                     f.write(data)
                 os.replace(tmp, path)
         """) == []
+
+
+class TestHVT006DataLayerSeededRng:
+    """HVT006: unseeded RNG inside horovod_tpu/data/ — the determinism
+    invariant the durable stream cursors depend on (ISSUE 8 satellite)."""
+
+    DATA = "horovod_tpu/data/fake.py"
+
+    def test_global_numpy_rng_flagged(self):
+        found = findings_of(DataLayerSeededRng, """
+            import numpy as np
+            def order(n):
+                return np.random.permutation(n)
+        """, relpath=self.DATA)
+        assert [f.rule for f in found] == ["HVT006"]
+        assert "numpy.random.permutation" in found[0].message
+
+    def test_stdlib_global_rng_flagged(self):
+        found = findings_of(DataLayerSeededRng, """
+            import random
+            def pick(xs):
+                random.shuffle(xs)
+                return random.randint(0, 9)
+        """, relpath=self.DATA)
+        assert len(found) == 2
+
+    def test_seedless_generator_ctors_flagged(self):
+        found = findings_of(DataLayerSeededRng, """
+            import numpy as np
+            rng1 = np.random.RandomState()
+            rng2 = np.random.default_rng()
+        """, relpath=self.DATA)
+        assert len(found) == 2
+
+    def test_seeded_generators_clean(self):
+        assert findings_of(DataLayerSeededRng, """
+            import numpy as np
+            def order(seed, epoch, n):
+                rng = np.random.RandomState(seed)
+                g = np.random.default_rng(seed=epoch)
+                s = np.random.SeedSequence([seed, epoch])
+                return rng.permutation(n), g, s
+        """, relpath=self.DATA) == []
+
+    def test_method_calls_on_local_generators_clean(self):
+        # rng.shuffle/rng.randint resolve through the LOCAL name, not
+        # the numpy.random global module — never flagged.
+        assert findings_of(DataLayerSeededRng, """
+            import numpy as np
+            def draw(seed):
+                rng = np.random.RandomState(seed)
+                rng.shuffle([1, 2])
+                return rng.randint(3)
+        """, relpath=self.DATA) == []
+
+    def test_outside_data_layer_not_scoped(self):
+        assert findings_of(DataLayerSeededRng, """
+            import numpy as np
+            x = np.random.permutation(8)
+        """, relpath="horovod_tpu/training/fake.py") == []
 
 
 class TestSuppressionsAndBaseline:
