@@ -595,33 +595,16 @@ def _timed_reduction(trainer, params, reps: int) -> float:
 
 
 def _reduction_calls(hlo: str) -> int:
-    """Cross-worker GRADIENT reduction ops in a compiled step's HLO text:
-    all-reduce (sync or -start; -done is the same op's completion) with a
-    non-scalar operand — scalar all-reduces are the loss/accuracy metric
-    means, which exist on every path and aren't gradient traffic. Counts
-    all-gather too: the quantized (int8/fp8) wire reduces as a
-    gather-sum, one PAYLOAD gather per bucket — the per-bucket f32 scale
-    rides a separate rank-1 gather (one scalar per shard, noise bytes)
-    that must not inflate the count, so gathers only count at rank >= 2
-    (a 1-D bucket gathered over shards; the scale's [n_shards] result
-    stays out)."""
-    import re
+    """Cross-worker GRADIENT reduction ops in a compiled step's HLO text.
 
-    count = 0
-    for line in hlo.splitlines():
-        if "all-reduce-done" in line or "all-gather-done" in line:
-            continue
-        m = re.search(r"\ball-(gather|reduce)(?:-start)?\(", line)
-        if not m:
-            continue
-        # The result type precedes the op name: non-scalar iff any shaped
-        # dimension appears in it (f32[262144]{0} yes, f32[] no; tuple
-        # types count once — one launched collective). Gathers need a
-        # second dimension (payload buckets, not gathered scalar scales).
-        shaped = r"\[\d+,\d" if m.group(1) == "gather" else r"\[\d"
-        if re.search(shaped, line[: m.start()]):
-            count += 1
-    return count
+    Since PR 9 this is `analysis.hlo_audit.gradient_reductions` — the
+    ONE implementation of the payload-vs-scale-gather discrimination
+    (non-scalar all-reduces plus rank >= 2 payload gathers; the
+    quantized wire's rank-1 per-bucket scale gathers stay out), shared
+    with the perf-path tests and the `hvt-audit` CLI."""
+    from horovod_tpu.analysis import hlo_audit
+
+    return len(hlo_audit.gradient_reductions(hlo))
 
 
 def bench_accum() -> dict:

@@ -1,22 +1,33 @@
-"""Static analysis (`hvt-lint`) + the central env-knob registry.
+"""Static analysis (`hvt-lint`/`hvt-audit`) + the central knob registry.
 
 The reliability spine's correctness invariants (collective symmetry,
 lockstep teardown, trace purity, knob discipline, atomic artifact writes)
 previously lived only in prose — this subsystem enforces them at lint
-time. See `core` (framework), `rules` (HVT001-HVT005), `registry` (the
-``HVT_*`` knob table ``docs/ENVVARS.md`` is generated from) and `cli`
-(the ``hvt-lint`` entry point).
+time, and since PR 9 at COMPILE time too. Two layers:
 
-Import discipline: `registry` is stdlib-only and importable from the
-earliest bootstrap (`runtime.init` reads knobs through it); nothing here
-imports jax.
+* Source analysis — `core` (framework: per-module + project-wide rules),
+  `callgraph` (module-set call graph, collectives-effect summaries,
+  rank-taint propagation), `rules` (HVT001-HVT008; ``docs/LINT_RULES.md``
+  is generated from their metadata), `registry` (the ``HVT_*`` knob
+  table ``docs/ENVVARS.md`` is generated from), `cli` (``hvt-lint``).
+* Compiled-program audit — `hlo_audit` (structured StableHLO/HLO
+  inspector: `collective_ops`, `gradient_reductions`, `donated_args`,
+  `assert_program`), `step_probe` (the canonical lowered trainer step),
+  `audit_cli` (``hvt-audit step/file``).
+
+Import discipline: `registry`, `core`, `callgraph`, `rules` and
+`hlo_audit` are stdlib-only and importable from the earliest bootstrap
+(`runtime.init` reads knobs through the registry); only `step_probe`
+(and `hvt-audit step`) imports jax, lazily.
 """
 
 from horovod_tpu.analysis import registry
 from horovod_tpu.analysis.core import (
     Finding,
     LintResult,
+    Project,
     Rule,
+    generate_rules_doc,
     iter_rules,
     lint_paths,
     register_rule,
@@ -26,7 +37,9 @@ __all__ = [
     "registry",
     "Finding",
     "LintResult",
+    "Project",
     "Rule",
+    "generate_rules_doc",
     "iter_rules",
     "lint_paths",
     "register_rule",

@@ -1,0 +1,233 @@
+"""`hvt-audit` — the compiled-program auditor CLI (hvt-lint v2 layer 2).
+
+Usage::
+
+    # Audit a freshly compiled canonical trainer step (the CI gate):
+    hvt-audit step --k 4 --compression int8 \\
+        --expect one-reduction,wire=int8,overlap
+
+    # Audit a saved program text (lowered StableHLO or compiled HLO):
+    hvt-audit file step.hlo --expect reductions=3,wire=bf16
+
+``step`` builds the canonical probe trainer (`analysis.step_probe`) at
+the requested accumulation factor / wire compression, lowers ONE
+optimizer step and checks it against the expectations — so the
+one-reduction-per-step, wire-dtype and overlap invariants can gate CI
+against the real compiled program, not a prose promise. ``--expect``
+defaults to what the requested config promises (K>1 or any compression
+=> exactly one bucketed boundary reduction; a quantized/16-bit wire =>
+every gradient payload in that dtype; plain K=1 => no explicit
+collective at all).
+
+The ``overlap`` expectation needs two programs: the K=2 peel probe is
+compiled with the overlap knob forced on and off and must show strictly
+fewer loop ops when on (the PR 7 structural witness) — AND the audited
+configuration itself must have overlap enabled, so a fleet running with
+``HVT_OVERLAP_REDUCTION=0`` fails the gate loudly.
+
+Exit codes (the `hvt-lint` contract): 0 clean, 1 violations (printed),
+2 usage/build error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from horovod_tpu.analysis import hlo_audit
+
+
+def _default_expect(k: int, compression: str, bucket_bytes) -> str:
+    tokens = []
+    if compression.lower() not in ("", "none"):
+        if bucket_bytes is None:
+            tokens.append("one-reduction")
+        tokens.append(f"wire={compression}")
+    elif k > 1:
+        if bucket_bytes is None:
+            tokens.append("one-reduction")
+    else:
+        tokens.append("no-collectives")
+    return ",".join(tokens)
+
+
+def _run_step(args) -> int:
+    overlap = {"auto": None, "on": True, "off": False}[args.overlap]
+    expect_spec = args.expect
+    if expect_spec is None:
+        expect_spec = _default_expect(
+            args.k, args.compression, args.bucket_bytes
+        )
+        print(f"hvt-audit: derived --expect {expect_spec}")
+    want_overlap = False
+    tokens = []
+    for token in expect_spec.split(","):
+        if token.strip().lower() == "overlap":
+            want_overlap = True
+        elif token.strip():
+            tokens.append(token)
+    # Usage errors surface before the (expensive) backend init.
+    expects = hlo_audit.ProgramExpectation.parse(",".join(tokens))
+
+    # Environment shaping must precede the first jax import.
+    if args.platform:
+        os.environ["HVT_PLATFORM"] = args.platform
+        if args.platform == "cpu" and args.devices:
+            os.environ["HVT_NUM_CPU_DEVICES"] = str(args.devices)
+
+    import horovod_tpu as hvt
+    from horovod_tpu.analysis import step_probe
+
+    hvt.init()
+
+    x, y = step_probe.probe_data()
+    trainer = step_probe.build_trainer(
+        args.k, args.compression, overlap=overlap,
+        bucket_bytes=args.bucket_bytes,
+    )
+    text = step_probe.lowered_step_text(trainer, x, y, args.k)
+    if args.dump:
+        with open(args.dump, "w") as f:  # hvt: noqa[HVT005] debug dump
+            f.write(text)
+        print(f"hvt-audit: wrote lowered step to {args.dump}")
+
+    violations = hlo_audit.audit(text, expects)
+
+    if want_overlap:
+        if not trainer._overlap:
+            violations.append(
+                "overlap expected but the audited configuration resolves "
+                "overlap_reduction=OFF (HVT_OVERLAP_REDUCTION/--overlap) "
+                "— the boundary reduction serializes after the "
+                "accumulation scan"
+            )
+        else:
+            # The K=2 structural witness: peel empties the scan.
+            on = hlo_audit.while_count(step_probe.lowered_step_text(
+                step_probe.build_trainer(
+                    2, args.compression, overlap=True,
+                    bucket_bytes=args.bucket_bytes,
+                ), x, y, 2,
+            ))
+            off = hlo_audit.while_count(step_probe.lowered_step_text(
+                step_probe.build_trainer(
+                    2, args.compression, overlap=False,
+                    bucket_bytes=args.bucket_bytes,
+                ), x, y, 2,
+            ))
+            if not on < off:
+                violations.append(
+                    "overlap peel is structurally ABSENT: the K=2 "
+                    f"overlapped step carries {on} loop op(s) vs "
+                    f"{off} serialized — the last microbatch is not "
+                    "peeled out of the accumulation scan, so bucket "
+                    "reductions cannot overlap its backward"
+                )
+
+    grads = hlo_audit.gradient_reductions(text)
+    config = (
+        f"k={args.k} compression={args.compression} "
+        f"overlap={'on' if trainer._overlap else 'off'}"
+    )
+    if violations:
+        print(f"hvt-audit: step ({config}) FAILED:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print(
+        f"hvt-audit: step ({config}) ok — "
+        f"{len(grads)} gradient reduction(s)"
+        + (f" [{', '.join(op.dtype for op in grads)}]" if grads else "")
+        + (", overlap peel verified" if want_overlap else "")
+    )
+    return 0
+
+
+def _run_file(args) -> int:
+    try:
+        with open(args.path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"hvt-audit: {e}", file=sys.stderr)
+        return 2
+    expects = hlo_audit.ProgramExpectation.parse(args.expect)
+    violations = hlo_audit.audit(text, expects)
+    ops = hlo_audit.collective_ops(text)
+    if violations:
+        print(f"hvt-audit: {args.path} FAILED:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print(
+        f"hvt-audit: {args.path} ok — {len(ops)} collective(s), "
+        f"{len(hlo_audit.gradient_reductions(ops))} gradient reduction(s)"
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvt-audit",
+        description="Structured compiled-program audits: gradient-"
+        "reduction count, wire dtype, donation aliasing, overlap "
+        "structure — against a live trainer step or a saved program "
+        "text.",
+    )
+    sub = parser.add_subparsers(dest="cmd")
+
+    step = sub.add_parser(
+        "step", help="compile the canonical trainer step and audit it")
+    step.add_argument("--k", type=int, default=4,
+                      help="backward_passes_per_step (default 4)")
+    step.add_argument("--compression", default=None,
+                      help="gradient wire: none/bf16/fp16/int8/fp8 "
+                      "(default: HVT_COMPRESSION, else none)")
+    step.add_argument("--bucket-bytes", type=int, default=None)
+    step.add_argument("--overlap", choices=("auto", "on", "off"),
+                      default="auto",
+                      help="force the overlap knob (auto = env default)")
+    step.add_argument("--expect", default=None,
+                      metavar="one-reduction,wire=int8,overlap,...",
+                      help="expectation list (default: derived from the "
+                      "requested config)")
+    step.add_argument("--platform", default=None,
+                      help="force the jax platform before init (sets "
+                      "HVT_PLATFORM; e.g. cpu)")
+    step.add_argument("--devices", type=int, default=8,
+                      help="virtual device count with --platform cpu "
+                      "(sets HVT_NUM_CPU_DEVICES; default 8)")
+    step.add_argument("--dump", default=None, metavar="PATH",
+                      help="also write the lowered step text to PATH")
+
+    filecmd = sub.add_parser(
+        "file", help="audit a saved StableHLO/HLO program text")
+    filecmd.add_argument("path")
+    filecmd.add_argument("--expect", required=True,
+                         metavar="reductions=N,wire=bf16,...")
+
+    args = parser.parse_args(argv)
+    if args.cmd is None:
+        parser.print_help()
+        return 2
+    try:
+        if args.cmd == "step":
+            # Registry-declared default for the wire.
+            if args.compression is None:
+                from horovod_tpu.analysis import registry
+
+                args.compression = registry.get_str("HVT_COMPRESSION")
+            return _run_step(args)
+        return _run_file(args)
+    except ValueError as e:
+        print(f"hvt-audit: {e}", file=sys.stderr)
+        return 2
+
+
+def cli() -> None:
+    """Console entry point (`hvt-audit`, pyproject.toml)."""
+    raise SystemExit(main())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,459 @@
+"""Module-set call graph + collectives-effect summaries (hvt-lint v2).
+
+Layer 1 of the interprocedural analyzer. The lexical rules (PR 6) see
+one function at a time, so a collective hidden behind one helper hop
+under a rank gate — exactly the PR 2 one-sided-teardown shape — sailed
+through. This module gives the rules whole-program context:
+
+* `CallGraph` — every function/method of the analyzed module set, keyed
+  ``module.dotted:Class.method``, with call edges resolved through each
+  module's import-alias map (``from .state import sync``,
+  ``collectives.reduce_gradients``, ``self.helper`` within a class).
+* Effect summaries — each unit is classified `ISSUES` (reaches a
+  collective on an un-rank-gated path, directly or transitively),
+  `RANK_GATED` (touches collectives only under rank gates — those sites
+  are HVT001 findings in their own right), or `CLEAN`. Computed as a
+  fixed point over the call edges, so taint propagates any number of
+  hops; `witness(key)` returns one concrete chain to a collective for
+  the finding message.
+* Collective sequences — the ordered collective names a unit issues
+  (callees inlined, cycle-guarded, capped), the input to HVT007's
+  sibling-branch order-divergence check: two branches that issue
+  collectives in different orders deadlock the fleet when the branch
+  condition varies by rank (Horovod's mismatched-submission-order
+  class, arXiv:1802.05799).
+
+Resolution is deliberately conservative: a call that cannot be resolved
+inside the analyzed module set (stdlib, jax, dynamic dispatch) simply
+contributes no edge — taint never propagates through guesses, so the
+interprocedural layer adds no false-positive surface beyond the lexical
+rules'. Nested ``def``s are separate scopes (a def under a rank gate is
+conditionally DEFINED, not executed) and are not call-graph-addressable;
+lambda bodies, by contrast, are folded into their enclosing unit's
+EFFECTS (the codebase uses lambdas as immediately-consumed callbacks —
+``tree.map(lambda g: psum(g), ...)`` really issues the psum) while
+staying a fresh scope for gate tracking, matching the lexical rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from horovod_tpu.analysis.core import (
+    ModuleSource,
+    dotted_name,
+    resolved_dotted,
+    terminal_name,
+)
+
+# --- classifications --------------------------------------------------------
+
+CLEAN = "clean"
+RANK_GATED = "rank-gated"
+ISSUES = "issues-collective"
+
+# --- shared collective / rank-gate vocabulary (HVT001 and the graph) --------
+
+# Topology queries whose result gates single-writer code paths. Both the
+# call forms (`runtime.rank()`, `jax.process_index()`, `hvt.is_primary()`)
+# and the attribute forms (`world.process_rank`) count.
+RANK_CALLS = {"rank", "process_rank", "process_index", "local_rank",
+              "is_primary"}
+RANK_ATTRS = {"process_rank", "process_index", "local_rank", "is_primary"}
+
+# Collective/barrier operations that every rank of the world must issue
+# together, matched by terminal callee name regardless of qualification.
+COLLECTIVES_ANY = {
+    "psum", "psum_scatter", "pmean", "hierarchical_psum",
+    "allreduce", "allgather", "all_gather", "broadcast",
+    "broadcast_object", "allgather_object", "broadcast_pytree",
+    "pmean_pytree", "reduce_gradients", "barrier", "wait_at_barrier",
+    "sync_global_devices", "quantized_group_sum",
+}
+# Operations matched only when qualified, to dodge same-name methods on
+# unrelated objects (`httpd.shutdown()`, `os.sync()`):
+#   runtime.shutdown / runtime.reinit (also bare, via the import map) are
+#   world-teardown barriers; `<...>.state.sync` / `ElasticState.sync` is
+#   the elastic state collective.
+QUALIFIED_COLLECTIVES = {
+    "shutdown": {"runtime", "hvt", "horovod_tpu"},
+    "reinit": {"runtime", "hvt", "horovod_tpu"},
+    "sync": {"state", "elastic_state", "ElasticState"},
+}
+
+
+def is_rank_gated(test: ast.AST) -> bool:
+    """True when a branch condition reads the process's rank/primacy."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            if terminal_name(node.func) in RANK_CALLS:
+                return True
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            if node.attr in RANK_ATTRS:
+                return True
+    return False
+
+
+def collective_name(module: ModuleSource, call: ast.Call) -> str | None:
+    """The display name of the collective `call` issues, or None."""
+    name = terminal_name(call.func)
+    if name is None:
+        return None
+    if name in COLLECTIVES_ANY:
+        return dotted_name(call.func) or name
+    if name in QUALIFIED_COLLECTIVES:
+        resolved = resolved_dotted(module, call.func) or name
+        segments = resolved.split(".")
+        if len(segments) == 1 or segments[-2] in QUALIFIED_COLLECTIVES[name]:
+            return dotted_name(call.func) or name
+    return None
+
+
+# --- scan results -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CollectiveSite:
+    """One collective issued inside a unit."""
+
+    name: str            # display name (dotted where written so)
+    node: ast.Call
+    gate: tuple | None   # rank gate in force at the site, if any
+
+
+@dataclasses.dataclass
+class CallEdge:
+    """One resolved call from a unit to another unit in the module set."""
+
+    callee: str          # target unit key
+    display: str         # the call as written (`helper`, `mod.helper`)
+    node: ast.Call
+    gate: tuple | None
+
+
+@dataclasses.dataclass
+class Unit:
+    """One execution scope: a function/method, or a module's top level."""
+
+    key: str                     # "pkg.mod:Class.fn" / "pkg.mod:<module>"
+    name: str                    # bare display name
+    module: ModuleSource
+    node: ast.AST                # FunctionDef or Module
+    body: list                   # the statements this unit executes
+    enclosing_class: str | None  # dotted class path for self./cls. calls
+    collectives: list = dataclasses.field(default_factory=list)
+    calls: list = dataclasses.field(default_factory=list)
+
+
+MODULE_UNIT = "<module>"
+_SEQUENCE_CAP = 32
+
+
+class CallGraph:
+    """The module set's units, call edges, effects and sequences."""
+
+    def __init__(self, modules: list[ModuleSource]):
+        self.modules = list(modules)
+        self.units: dict[str, Unit] = {}
+        # modname -> set of local unit paths ("fn", "Class.fn") — the
+        # dotted-name resolution table.
+        self._locals: dict[str, set[str]] = {}
+        for module in self.modules:
+            self._collect_units(module)
+        for unit in self.units.values():
+            self._scan_unit(unit)
+        self._effects: dict[str, str] | None = None
+        self._witness: dict[str, list] = {}
+
+    # --- unit collection ----------------------------------------------------
+
+    def _collect_units(self, module: ModuleSource) -> None:
+        modname = module.modname
+        local = self._locals.setdefault(modname, set())
+
+        def visit(node: ast.AST, class_path: tuple, addressable: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    path = ".".join(class_path + (child.name,))
+                    key = f"{modname}:{path}"
+                    if key in self.units:
+                        # Redefinition (fallback def after a try-import,
+                        # same-name overload): the FIRST def keeps the
+                        # addressable key — call edges resolve to it —
+                        # but the clash must still be SCANNED, like a
+                        # nested def, or its collectives go dark.
+                        n = 2
+                        while f"{key}#{n}" in self.units:
+                            n += 1
+                        key = f"{key}#{n}"
+                    else:
+                        if addressable:
+                            local.add(path)
+                    self.units[key] = Unit(
+                        key=key, name=child.name, module=module,
+                        node=child, body=child.body,
+                        enclosing_class=(
+                            ".".join(class_path) if class_path else None
+                        ),
+                    )
+                    # Nested defs are separate scopes and must still be
+                    # SCANNED (a rank-gated collective inside one is a
+                    # finding) but are not addressable by callers.
+                    visit(child, class_path + (child.name,), False)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, class_path + (child.name,), addressable)
+
+        visit(module.tree, (), True)
+        mkey = f"{modname}:{MODULE_UNIT}"
+        self.units[mkey] = Unit(
+            key=mkey, name=MODULE_UNIT, module=module, node=module.tree,
+            body=list(module.tree.body), enclosing_class=None,
+        )
+
+    # --- call resolution ----------------------------------------------------
+
+    def _lookup_dotted(self, dotted: str) -> str | None:
+        """``a.b.c.fn`` / ``a.b.C.m`` -> unit key, longest module prefix
+        first."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:i])
+            local = self._locals.get(modname)
+            if local is None:
+                continue
+            path = ".".join(parts[i:])
+            if path in local:
+                return f"{modname}:{path}"
+            return None  # module known, symbol not a def we saw
+        return None
+
+    def resolve_call(self, module: ModuleSource, call: ast.Call,
+                     enclosing_class: str | None) -> str | None:
+        """The unit key `call` dispatches to, or None when the target is
+        outside the analyzed module set (no edge — taint never guesses)."""
+        f = call.func
+        modname = module.modname
+        if isinstance(f, ast.Name):
+            if f.id in self._locals.get(modname, ()):
+                return f"{modname}:{f.id}"
+            origin = module.import_map().get(f.id)
+            if origin and "." in origin:
+                return self._lookup_dotted(origin)
+            return None
+        if isinstance(f, ast.Attribute):
+            dotted = dotted_name(f)
+            if dotted is None:
+                return None
+            head, _, rest = dotted.partition(".")
+            if head in ("self", "cls") and enclosing_class and rest:
+                path = f"{enclosing_class}.{rest}"
+                if path in self._locals.get(modname, ()):
+                    return f"{modname}:{path}"
+                return None
+            resolved = resolved_dotted(module, f)
+            if resolved:
+                return self._lookup_dotted(resolved)
+        return None
+
+    # --- per-unit scan (gate-tracked, lexically faithful to HVT001) ---------
+
+    def _scan_unit(self, unit: Unit) -> None:
+        module = unit.module
+
+        def record_call(node: ast.Call, gate):
+            name = collective_name(module, node)
+            if name is not None:
+                unit.collectives.append(CollectiveSite(name, node, gate))
+                return
+            callee = self.resolve_call(module, node, unit.enclosing_class)
+            if callee is not None and callee != unit.key:
+                display = dotted_name(node.func) or terminal_name(
+                    node.func
+                ) or "?"
+                unit.calls.append(CallEdge(callee, display, node, gate))
+
+        def visit(node: ast.AST, gate):
+            if isinstance(node, ast.Call):
+                record_call(node, gate)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, gate)
+                return
+            if isinstance(node, (ast.If, ast.While)):
+                branch_gate = gate
+                if is_rank_gated(node.test):
+                    branch_gate = (node.lineno, module.line_at(node.lineno))
+                visit(node.test, gate)
+                for child in node.body:
+                    visit(child, branch_gate)
+                for child in node.orelse:
+                    visit(child, branch_gate)
+                return
+            if isinstance(node, ast.IfExp):
+                branch_gate = gate
+                if is_rank_gated(node.test):
+                    branch_gate = (node.lineno, module.line_at(node.lineno))
+                visit(node.test, gate)
+                visit(node.body, branch_gate)
+                visit(node.orelse, branch_gate)
+                return
+            if isinstance(node, ast.BoolOp):
+                # `rank() == 0 and collective()`: operands after a
+                # rank-gated one are short-circuit-conditional on it.
+                seen_gate = gate
+                for value in node.values:
+                    visit(value, seen_gate)
+                    if seen_gate is None and is_rank_gated(value):
+                        seen_gate = (
+                            node.lineno, module.line_at(node.lineno)
+                        )
+                return
+            if isinstance(node, ast.Lambda):
+                # Fresh gate scope (a lambda under a gate is defined, not
+                # executed there) but SAME unit: its collectives count
+                # toward this unit's effects — lambdas here are
+                # immediately-consumed callbacks (tree.map, scan).
+                visit(node.body, None)
+                return
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                return  # separate unit (or unaddressable nested scope)
+            if isinstance(node, ast.ClassDef):
+                # Methods are separate units; class-level statements run
+                # at import in a fresh gate scope (lexical-rule parity).
+                for child in node.body:
+                    if not isinstance(
+                        child,
+                        (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef),
+                    ):
+                        visit(child, None)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, gate)
+
+        for stmt in unit.body:
+            visit(stmt, None)
+
+    # --- effect summaries (fixed point over call edges) ---------------------
+
+    def effects(self) -> dict[str, str]:
+        """key -> CLEAN | RANK_GATED | ISSUES. ISSUES means an un-gated
+        path through the unit reaches a collective (possibly via callees);
+        RANK_GATED means collectives are reachable only under rank gates
+        (each such site is an HVT001 finding at its own location)."""
+        if self._effects is not None:
+            return self._effects
+        effects: dict[str, str] = {}
+        for key, unit in self.units.items():
+            direct = [s for s in unit.collectives if s.gate is None]
+            if direct:
+                effects[key] = ISSUES
+                self._witness[key] = [direct[0].name]
+            elif unit.collectives:
+                effects[key] = RANK_GATED
+            else:
+                effects[key] = CLEAN
+        changed = True
+        while changed:
+            changed = False
+            for key, unit in self.units.items():
+                if effects[key] == ISSUES:
+                    continue
+                for edge in unit.calls:
+                    if edge.gate is None and effects.get(
+                        edge.callee
+                    ) == ISSUES:
+                        effects[key] = ISSUES
+                        self._witness[key] = [edge.display] + self._witness[
+                            edge.callee
+                        ]
+                        changed = True
+                        break
+                else:
+                    if effects[key] == CLEAN and any(
+                        effects.get(e.callee) == ISSUES for e in unit.calls
+                    ):
+                        effects[key] = RANK_GATED
+        self._effects = effects
+        return effects
+
+    def effect(self, key: str) -> str:
+        return self.effects().get(key, CLEAN)
+
+    def witness(self, key: str) -> list:
+        """One concrete chain of names from `key` to a collective —
+        ``['helper_b', 'psum']`` — for finding messages. Empty unless
+        the unit's effect is ISSUES."""
+        self.effects()
+        return list(self._witness.get(key, ()))
+
+    # --- collective sequences (HVT007's input) ------------------------------
+
+    def sequence_of(self, module: ModuleSource, nodes,
+                    enclosing_class: str | None, _stack=None) -> tuple:
+        """Ordered collective names issued by `nodes` (statement list or
+        single AST node), with resolved callees' sequences inlined
+        (recursion cycle-guarded, capped at _SEQUENCE_CAP). Both arms of
+        internal branches contribute in source order — a deliberate
+        flattening: the sequence is an order WITNESS, not an exact
+        trace."""
+        stack = _stack or set()
+        out: list = []
+
+        def visit(node: ast.AST):
+            if len(out) >= _SEQUENCE_CAP:
+                return
+            if isinstance(node, ast.Call):
+                name = collective_name(module, node)
+                if name is not None:
+                    # Key sequences on the terminal op name: `lax.psum`
+                    # and `psum` are the same wire operation.
+                    out.append(terminal_name(node.func) or name)
+                else:
+                    callee = self.resolve_call(module, node,
+                                               enclosing_class)
+                    if callee is not None and callee not in stack:
+                        unit = self.units.get(callee)
+                        if unit is not None:
+                            # Guard RECURSION only: pop after inlining,
+                            # so a helper called twice as siblings
+                            # contributes its sequence twice (the whole
+                            # point of an order witness).
+                            stack.add(callee)
+                            for stmt in unit.body:
+                                sub = self.sequence_of(
+                                    unit.module, stmt,
+                                    unit.enclosing_class, _stack=stack,
+                                )
+                                out.extend(sub)
+                                if len(out) >= _SEQUENCE_CAP:
+                                    break
+                            stack.discard(callee)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                return
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        if isinstance(nodes, (list, tuple)):
+            for n in nodes:
+                visit(n)
+        else:
+            visit(nodes)
+        return tuple(out[:_SEQUENCE_CAP])
+
+    # --- classification export ---------------------------------------------
+
+    def summary(self) -> dict:
+        """key -> classification, for tooling/tests."""
+        return dict(self.effects())

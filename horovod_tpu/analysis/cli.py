@@ -7,6 +7,7 @@ Usage::
     hvt-lint --select HVT001,HVT003 ...   # subset of rules
     hvt-lint --write-baseline ...         # grandfather current findings
     hvt-lint --list-rules
+    hvt-lint --explain HVT007             # rationale/provenance/example
 
 Exit codes (pre-commit-hook friendly):
 
@@ -60,12 +61,35 @@ def main(argv: list[str] | None = None) -> int:
         help="directory findings/baseline paths are relative to "
         "(default: cwd)")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "--explain", default=None, metavar="RULE",
+        help="print one rule's rationale/provenance/example and exit "
+        "(the docs/LINT_RULES.md entry, at the terminal)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for cls in core.iter_rules():
             print(f"{cls.rule_id}  {cls.title}")
         return 0
+
+    if args.explain:
+        wanted = args.explain.strip().upper()
+        for cls in core.iter_rules():
+            if cls.rule_id == wanted:
+                print(f"{cls.rule_id} — {cls.title}")
+                if cls.rationale:
+                    print(f"\nWhy: {cls.rationale}")
+                if cls.provenance:
+                    print(f"\nProvenance: {cls.provenance}")
+                if cls.example:
+                    print("\nFlags:\n" + "\n".join(
+                        "    " + ln
+                        for ln in cls.example.strip("\n").splitlines()
+                    ))
+                return 0
+        print(f"hvt-lint: unknown rule {args.explain!r} — see "
+              "--list-rules", file=sys.stderr)
+        return 2
 
     missing = [p for p in args.paths if not os.path.exists(p)]
     if missing:

@@ -8,8 +8,14 @@ dependencies aren't installed). Structure:
 * `ModuleSource` — one parsed file: source text, AST, per-line ``noqa``
   suppressions, and an import-alias map (so rules can resolve
   ``from jax import random`` vs stdlib ``random``).
-* `Rule` + `register_rule` — the visitor registry. A rule yields
-  `Finding`s from `check(module)`.
+* `Project` — the whole module set of one lint run, plus the lazily
+  built interprocedural call graph (`analysis.callgraph`) the
+  project-wide rules share.
+* `Rule` + `register_rule` — the visitor registry. A per-module rule
+  yields `Finding`s from `check(module)`; a rule with
+  ``project_wide = True`` instead implements `check_project(project)`
+  and sees every module at once (HVT001's rank-taint propagation,
+  HVT007's transitive collective sequences need the call graph).
 * Baseline — a committed JSON file of grandfathered findings, each with a
   one-line justification. Matching is by (rule, path, source-line
   snippet), NOT line number, so unrelated edits above a baselined site
@@ -92,6 +98,24 @@ class ModuleSource:
         self.tree = ast.parse(text, filename=path)  # may raise SyntaxError
         self.noqa = _parse_noqa(text)
 
+    @property
+    def modname(self) -> str:
+        """Dotted module name derived from the relative path —
+        ``horovod_tpu/parallel/collectives.py`` ->
+        ``horovod_tpu.parallel.collectives`` (``__init__.py`` names the
+        package itself). The call graph keys cross-module resolution on
+        this."""
+        parts = self.relpath.split("/")
+        if parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][:-3]
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(p for p in parts if p)
+
+    @property
+    def is_package(self) -> bool:
+        return self.relpath.endswith("__init__.py")
+
     def line_at(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1].strip()
@@ -118,7 +142,9 @@ class ModuleSource:
     def import_map(self) -> dict[str, str]:
         """Local name -> dotted origin for module-level imports, e.g.
         ``{'np': 'numpy', 'random': 'jax.random'}`` after
-        ``import numpy as np; from jax import random``. Cached."""
+        ``import numpy as np; from jax import random``. Relative imports
+        (``from .state import x``) resolve against this module's package
+        so cross-module call-graph edges work inside the package. Cached."""
         cached = getattr(self, "_import_map", None)
         if cached is not None:
             return cached
@@ -129,10 +155,19 @@ class ModuleSource:
                     mapping[alias.asname or alias.name.split(".")[0]] = (
                         alias.name if alias.asname else alias.name.split(".")[0]
                     )
-            elif isinstance(node, ast.ImportFrom) and node.module:
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    parts = self.modname.split(".")
+                    drop = node.level - (1 if self.is_package else 0)
+                    anchor = parts[: max(0, len(parts) - drop)]
+                    base = ".".join(anchor + ([node.module] if node.module
+                                              else []))
+                if not base:
+                    continue
                 for alias in node.names:
                     mapping[alias.asname or alias.name] = (
-                        f"{node.module}.{alias.name}"
+                        f"{base}.{alias.name}"
                     )
         self._import_map = mapping
         return mapping
@@ -175,13 +210,49 @@ def resolved_dotted(module: ModuleSource, node: ast.AST) -> str | None:
 
 
 class Rule:
-    """Base class: subclass, set `rule_id`/`title`, implement `check`."""
+    """Base class: subclass, set `rule_id`/`title`, implement `check` —
+    or set ``project_wide = True`` and implement `check_project`, which
+    sees the whole module set (and its shared call graph) at once.
+
+    `rationale`/`provenance`/`example` feed the generated
+    ``docs/LINT_RULES.md`` (`generate_rules_doc`): the one-paragraph
+    reason the rule exists, the PR/bug it is grounded in, and a minimal
+    flagged snippet."""
 
     rule_id: str = "HVT000"
     title: str = ""
+    project_wide: bool = False
+    rationale: str = ""
+    provenance: str = ""
+    example: str = ""
 
     def check(self, module: ModuleSource) -> Iterator[Finding]:
         raise NotImplementedError
+
+    def check_project(self, project: "Project") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class Project:
+    """Every module of one lint run plus the shared call graph."""
+
+    def __init__(self, modules: list[ModuleSource]):
+        self.modules = modules
+        self._by_path = {m.relpath: m for m in modules}
+        self._graph = None
+
+    def module(self, relpath: str) -> ModuleSource | None:
+        return self._by_path.get(relpath)
+
+    def callgraph(self):
+        """The interprocedural `analysis.callgraph.CallGraph`, built once
+        and shared by every project-wide rule (lazy import keeps `core`
+        cycle-free)."""
+        if self._graph is None:
+            from horovod_tpu.analysis import callgraph as _callgraph
+
+            self._graph = _callgraph.CallGraph(self.modules)
+        return self._graph
 
 
 _RULES: dict[str, type[Rule]] = {}
@@ -200,6 +271,47 @@ def iter_rules() -> list[type[Rule]]:
     from horovod_tpu.analysis import rules as _rules  # noqa: F401
 
     return [_RULES[k] for k in sorted(_RULES)]
+
+
+_RULES_DOC_HEADER = """\
+# `hvt-lint` rules
+
+<!-- GENERATED FILE — do not edit by hand.
+     Source of truth: the Rule classes in horovod_tpu/analysis/rules.py
+     (rationale/provenance/example metadata).
+     Regenerate: python -m horovod_tpu.analysis.rules > docs/LINT_RULES.md
+     (tests/test_lint_clean.py fails when this file drifts). -->
+
+Every rule the distributed-correctness analyzer ships, generated from the
+rule registry the same way `docs/ENVVARS.md` is generated from the knob
+registry. Each rule encodes an invariant this repo was actually bitten
+by — the provenance row names the PR that fixed (or designed around) the
+bug class. Suppress a deliberate site with ``# hvt: noqa[RULE]`` plus a
+reason, or grandfather it in ``horovod_tpu/analysis/baseline.json`` with
+a one-line justification; `hvt-lint --explain RULE` prints a rule's
+entry at the terminal.
+
+`HVT000` (not listed below) is the parse-failure pseudo-rule: a file the
+analyzer cannot read is a lint failure, not a silent skip.
+"""
+
+
+def generate_rules_doc() -> str:
+    """Render docs/LINT_RULES.md from the registry. Deterministic:
+    id-sorted, one section per rule."""
+    parts = [_RULES_DOC_HEADER]
+    for cls in iter_rules():
+        parts.append(f"\n## {cls.rule_id} — {cls.title}\n")
+        if cls.rationale:
+            parts.append(f"**Why:** {cls.rationale}\n")
+        if cls.provenance:
+            parts.append(f"**Provenance:** {cls.provenance}\n")
+        if cls.example:
+            parts.append("**Flags:**\n")
+            parts.append("```python")
+            parts.append(cls.example.strip("\n"))
+            parts.append("```")
+    return "\n".join(parts) + "\n"
 
 
 # --- baseline ---------------------------------------------------------------
@@ -339,6 +451,11 @@ def lint_paths(
         for e in load_baseline(baseline_path)
     }
     result = LintResult(findings=[], baselined=[])
+
+    # Phase 1: parse everything. Project-wide rules (rank-taint through
+    # helpers, collective-order sequences) need the full module set
+    # before any rule can run.
+    modules: list[ModuleSource] = []
     for filepath in iter_python_files(paths):
         result.files += 1
         abspath = os.path.abspath(filepath)
@@ -346,25 +463,38 @@ def lint_paths(
         with open(filepath, encoding="utf-8") as f:
             text = f.read()
         try:
-            module = ModuleSource(abspath, relpath, text)
+            modules.append(ModuleSource(abspath, relpath, text))
         except SyntaxError as e:
             result.findings.append(Finding(
                 rule=PARSE_ERROR_RULE, path=relpath.replace(os.sep, "/"),
                 line=e.lineno or 1, col=(e.offset or 1) - 1,
                 message=f"file does not parse: {e.msg}", snippet="",
             ))
-            continue
+    project = Project(modules)
+
+    def deliver(finding: Finding, module: ModuleSource | None):
+        if module is not None and module.suppressed(
+            finding.rule, finding.line
+        ):
+            return
+        key = _baseline_key(finding.rule, finding.path, finding.snippet)
+        if key in baseline:
+            result.baselined.append(finding)
+        else:
+            result.findings.append(finding)
+
+    # Phase 2: per-module rules, then project-wide rules.
+    for module in modules:
         for rule in rules:
+            if rule.project_wide:
+                continue
             for finding in rule.check(module):
-                if module.suppressed(finding.rule, finding.line):
-                    continue
-                key = _baseline_key(
-                    finding.rule, finding.path, finding.snippet
-                )
-                if key in baseline:
-                    result.baselined.append(finding)
-                else:
-                    result.findings.append(finding)
+                deliver(finding, module)
+    for rule in rules:
+        if not rule.project_wide:
+            continue
+        for finding in rule.check_project(project):
+            deliver(finding, project.module(finding.path))
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     result.baselined.sort(key=lambda f: (f.path, f.line, f.rule))
     return result

@@ -1,0 +1,395 @@
+"""Structured auditor for compiled/lowered XLA programs (hvt-lint v2,
+layer 2).
+
+Every compiled-program invariant the framework actually relies on —
+exactly one gradient reduction per optimizer step (PR 4), wire dtype on
+the DCN hop (PR 7), donation aliasing, the overlap peel — used to live
+as copy-pasted HLO-text greps in three test files and ``bench.py``. This
+module is the single implementation: a small parser over the two text
+dialects jax emits (lowered StableHLO from ``.lower().as_text()``,
+post-optimization HLO from ``.compile().as_text()``) exposing the ops as
+data, plus an `assert_program` API whose failures print a structured
+diff instead of a regex mismatch.
+
+The load-bearing discrimination, shared verbatim with the bench
+(previously private as ``bench._reduction_calls``): cross-worker
+GRADIENT traffic is
+
+* any non-scalar all-reduce — scalar all-reduces are the loss/accuracy
+  metric means, which exist on every path; and
+* any rank >= 2 all-gather — the quantized (int8/fp8) wire reduces as a
+  gather-sum, one PAYLOAD gather per bucket (a 1-D bucket stacked over
+  shards), while the per-bucket f32 scale rides a separate rank-1
+  gather (one scalar per shard, noise bytes) that must not inflate the
+  count.
+
+Deliberately stdlib-only (`re`/`dataclasses`): the lint/audit CLIs and
+the earliest CI hooks import this without jax. Only `step_probe` (which
+produces the text) touches jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+__all__ = [
+    "CollectiveOp",
+    "ProgramAuditError",
+    "ProgramExpectation",
+    "assert_program",
+    "audit",
+    "collective_ops",
+    "donated_args",
+    "gradient_reductions",
+    "while_count",
+    "wire_dtype",
+]
+
+
+# --- the parsed op ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One cross-device collective in a program's text.
+
+    ``index`` is the op's position among the program's collectives in
+    TEXT order — the submission (channel) order every rank must agree
+    on; ``dtype`` is the canonical element type of the result payload
+    (``i8``, ``f8e4m3``, ``bf16``, ``f32``, ...), identical for both
+    dialects (HLO spells int8 ``s8``, StableHLO ``i8``)."""
+
+    kind: str             # "all-reduce" | "all-gather" | "reduce-scatter" | ...
+    dtype: str
+    shape: tuple
+    line: int             # 1-based line in the source text
+    index: int
+
+    @property
+    def rank(self) -> int:
+        return len(self.shape)
+
+    @property
+    def scalar(self) -> bool:
+        return not self.shape
+
+    def describe(self) -> str:
+        dims = "x".join(str(d) for d in self.shape)
+        return (
+            f"[{self.index}] {self.kind} {self.dtype}"
+            f"[{dims}] (line {self.line})"
+        )
+
+
+# --- dtype canonicalization -------------------------------------------------
+
+_DTYPE_CANON = {
+    "s8": "i8", "u8": "u8", "si8": "i8",
+    "f8e4m3fn": "f8e4m3", "f8e4m3": "f8e4m3",
+    "f8e5m2": "f8e5m2", "f8e5m2fn": "f8e5m2",
+}
+
+# What a wire/compression NAME (DistributedOptimizer(compression=...),
+# HVT_COMPRESSION) means as a payload element type.
+WIRE_DTYPES = {
+    "int8": "i8", "i8": "i8",
+    "fp8": "f8e4m3", "f8": "f8e4m3", "f8e4m3": "f8e4m3",
+    "bf16": "bf16",
+    "fp16": "f16", "f16": "f16",
+    "none": "f32", "f32": "f32", "float32": "f32",
+}
+
+
+def _canon_dtype(raw: str) -> str:
+    return _DTYPE_CANON.get(raw.lower(), raw.lower())
+
+
+def wire_dtype(name: str) -> str:
+    """Canonical payload element type for a compression/wire name."""
+    try:
+        return WIRE_DTYPES[name.strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown wire {name!r} — one of {sorted(WIRE_DTYPES)}"
+        ) from None
+
+
+# --- parsers ----------------------------------------------------------------
+
+_KINDS = "all_reduce|all_gather|reduce_scatter|all_to_all|collective_permute"
+
+# StableHLO prints the op's attrs (and a reduction region) first and the
+# type signature LAST, possibly many lines later:
+#   %177 = "stablehlo.all_reduce"(%112) <{...}> ({ region }) :
+#       (tensor<2410xf32>) -> tensor<2410xf32>
+# so the result type is the first `-> tensor<...>` after the op token
+# (tuple results open with `-> (tensor<...>`).
+_STABLEHLO_RE = re.compile(
+    rf"stablehlo\.({_KINDS})\b.*?->\s*\(?\s*tensor<([^>]*)>", re.S
+)
+
+# Post-optimization HLO puts the result type BEFORE the op name on the
+# defining line:
+#   %all-reduce.6 = f32[2410]{0} all-reduce(f32[2410]{0} %x), channel_id=1
+#   %ag = (s8[...], s8[...]) all-gather-start(...)
+# `-done` is the same op's completion and must not double-count.
+_HLO_RE = re.compile(
+    r"=\s*(\([^=]*?\)|\S+)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(-start)?\("
+)
+_HLO_TYPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _parse_tensor_spec(spec: str) -> tuple[str, tuple]:
+    """``'8x301xi8'`` -> ('i8', (8, 301)); ``'f32'`` -> ('f32', ())."""
+    parts = spec.strip().split("x")
+    dims = []
+    for p in parts:
+        if p.isdigit():
+            dims.append(int(p))
+        else:
+            return _canon_dtype(p), tuple(dims)
+    return _canon_dtype(parts[-1]), tuple(dims[:-1])
+
+
+def _parse_stablehlo(text: str) -> list[CollectiveOp]:
+    ops = []
+    for m in _STABLEHLO_RE.finditer(text):
+        dtype, shape = _parse_tensor_spec(m.group(2))
+        ops.append(CollectiveOp(
+            kind=m.group(1).replace("_", "-"), dtype=dtype, shape=shape,
+            line=text.count("\n", 0, m.start()) + 1, index=len(ops),
+        ))
+    return ops
+
+
+def _parse_hlo(text: str) -> list[CollectiveOp]:
+    ops = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        if "-done" in line:
+            continue
+        m = _HLO_RE.search(line)
+        if not m:
+            continue
+        tm = _HLO_TYPE_RE.search(m.group(1))
+        if not tm:
+            continue
+        dims = tuple(
+            int(d) for d in tm.group(2).split(",") if d.strip().isdigit()
+        )
+        ops.append(CollectiveOp(
+            kind=m.group(2), dtype=_canon_dtype(tm.group(1)), shape=dims,
+            line=i, index=len(ops),
+        ))
+    return ops
+
+
+def collective_ops(text: str) -> list[CollectiveOp]:
+    """Every cross-device collective in the program text, in submission
+    (channel) order. Dialect auto-detected."""
+    if "stablehlo." in text:
+        return _parse_stablehlo(text)
+    return _parse_hlo(text)
+
+
+def gradient_reductions(text) -> list[CollectiveOp]:
+    """The GRADIENT-traffic collectives (see module docstring): non-
+    scalar all-reduces plus rank >= 2 all-gathers (quantized-wire payload
+    gathers; rank-1 scale gathers excluded). Accepts program text or a
+    pre-parsed op list."""
+    ops = collective_ops(text) if isinstance(text, str) else text
+    out = []
+    for op in ops:
+        if op.kind == "all-reduce" and not op.scalar:
+            out.append(op)
+        elif op.kind == "all-gather" and op.rank >= 2:
+            out.append(op)
+        elif op.kind == "reduce-scatter" and not op.scalar:
+            out.append(op)
+    return out
+
+
+def while_count(text: str) -> int:
+    """Loop (scan) ops in the program — the overlap peel's structural
+    witness (PR 7: the peeled K=2 step has strictly fewer)."""
+    if "stablehlo." in text:
+        return text.count("stablehlo.while")
+    return sum(
+        1 for line in text.splitlines()
+        if re.search(r"=\s*[^=]*\bwhile\(", line)
+    )
+
+
+# Donation: lowered StableHLO marks donated args with `tf.aliasing_output`
+# / `jax.buffer_donor` arg attributes; compiled HLO records the aliasing
+# map in the module header.
+_STABLEHLO_DONOR_RE = re.compile(
+    r"tf\.aliasing_output\s*=\s*(\d+)|jax\.buffer_donor\s*=\s*true"
+)
+_HLO_ALIAS_RE = re.compile(r"\{[0-9, ]*\}:\s*\((\d+)\s*,")
+
+
+def donated_args(text: str) -> list[int]:
+    """Argument numbers the program donates (aliases to outputs).
+
+    From compiled HLO the numbers are the header's ``input_output_alias``
+    parameter indices; from lowered StableHLO, the positions of
+    arg-attribute donation markers in declaration order (an approximation
+    — compile for the exact map)."""
+    if "input_output_alias=" in text:
+        header = text.split("input_output_alias={", 1)[1]
+        # the alias map is brace-balanced; entries look like
+        # `{0}: (0, {}, may-alias)` — harvest the arg numbers.
+        depth, end = 1, 0
+        for i, ch in enumerate(header):
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        return sorted({
+            int(g) for g in _HLO_ALIAS_RE.findall(header[:end])
+        })
+    hits = []
+    for i, m in enumerate(_STABLEHLO_DONOR_RE.finditer(text)):
+        hits.append(int(m.group(1)) if m.group(1) is not None else i)
+    return sorted(set(hits))
+
+
+# --- expectations -----------------------------------------------------------
+
+
+class ProgramAuditError(AssertionError):
+    """A compiled program violated its expectations (structured diff in
+    the message)."""
+
+
+@dataclasses.dataclass
+class ProgramExpectation:
+    """What a compiled step must look like. Unset fields are unchecked.
+
+    ``wire`` implies at least one gradient reduction exists (an empty
+    program trivially satisfying 'every reduction is int8' is itself a
+    violation — the invariant is about traffic that must be present)."""
+
+    gradient_reductions: int | None = None   # exact count
+    max_gradient_reductions: int | None = None
+    # Compression name or dtype. Check the LOWERED StableHLO: post-
+    # optimization HLO may legalize wire dtypes per backend (CPU upcasts
+    # the bf16 all-reduce to f32) — counts survive optimization, element
+    # types do not.
+    wire: str | None = None
+    no_explicit_collectives: bool = False
+    min_donated: int | None = None
+
+    @classmethod
+    def parse(cls, spec: str) -> "ProgramExpectation":
+        """CLI grammar: comma-separated tokens —
+        ``one-reduction`` | ``reductions=N`` | ``max-reductions=N`` |
+        ``wire=int8`` | ``no-collectives`` | ``donates=N``.
+        (``overlap`` is a CLI-level expectation: it needs two compiles.)
+        """
+        exp = cls()
+        for token in spec.split(","):
+            token = token.strip().lower()
+            if not token:
+                continue
+            key, _, value = token.partition("=")
+            if token == "one-reduction":
+                exp.gradient_reductions = 1
+            elif key == "reductions" and value:
+                exp.gradient_reductions = int(value)
+            elif key == "max-reductions" and value:
+                exp.max_gradient_reductions = int(value)
+            elif key == "wire" and value:
+                wire_dtype(value)  # validate now -> usage error, not audit
+                exp.wire = value
+            elif token == "no-collectives":
+                exp.no_explicit_collectives = True
+            elif key == "donates" and value:
+                exp.min_donated = int(value)
+            else:
+                raise ValueError(
+                    f"unknown expectation {token!r} — grammar: "
+                    "one-reduction | reductions=N | max-reductions=N | "
+                    "wire=<int8|fp8|bf16|fp16|f32> | no-collectives | "
+                    "donates=N | overlap"
+                )
+        return exp
+
+
+def audit(text: str, expects: ProgramExpectation) -> list[str]:
+    """Check `text` against `expects`; returns human-readable violation
+    lines (empty = clean)."""
+    ops = collective_ops(text)
+    grads = gradient_reductions(ops)
+    violations = []
+    if expects.no_explicit_collectives and ops:
+        violations.append(
+            f"expected NO explicit collectives, found {len(ops)}:\n"
+            + _op_table(ops)
+        )
+    if expects.gradient_reductions is not None and len(grads) != (
+        expects.gradient_reductions
+    ):
+        violations.append(
+            f"expected exactly {expects.gradient_reductions} gradient "
+            f"reduction(s) per step, found {len(grads)}:\n"
+            + _op_table(grads)
+        )
+    if expects.max_gradient_reductions is not None and len(grads) > (
+        expects.max_gradient_reductions
+    ):
+        violations.append(
+            f"expected at most {expects.max_gradient_reductions} gradient "
+            f"reduction(s), found {len(grads)}:\n" + _op_table(grads)
+        )
+    if expects.wire is not None:
+        want = wire_dtype(expects.wire)
+        if not grads:
+            violations.append(
+                f"expected {expects.wire} ({want}) gradient traffic, "
+                "found NO gradient reductions at all"
+            )
+        off_wire = [op for op in grads if op.dtype != want]
+        if off_wire:
+            violations.append(
+                f"expected every gradient reduction's payload in "
+                f"{expects.wire} ({want}), found off-wire traffic:\n"
+                + _op_table(off_wire)
+            )
+    if expects.min_donated is not None:
+        donated = donated_args(text)
+        if len(donated) < expects.min_donated:
+            violations.append(
+                f"expected >= {expects.min_donated} donated (aliased) "
+                f"inputs, found {len(donated)}: {donated}"
+            )
+    return violations
+
+
+def _op_table(ops) -> str:
+    if not ops:
+        return "      (none)"
+    return "\n".join("      " + op.describe() for op in ops)
+
+
+def assert_program(text: str, expects: ProgramExpectation | str) -> None:
+    """Raise `ProgramAuditError` (an AssertionError) with a structured
+    diff when `text` violates `expects` (a `ProgramExpectation` or the
+    CLI expectation string)."""
+    if isinstance(expects, str):
+        expects = ProgramExpectation.parse(expects)
+    violations = audit(text, expects)
+    if violations:
+        grads = gradient_reductions(text)
+        raise ProgramAuditError(
+            "compiled program violates expectations:\n"
+            + "\n".join(f"  - {v}" for v in violations)
+            + f"\n  gradient reductions observed: {len(grads)}"
+            + (("\n" + _op_table(grads)) if grads else "")
+        )

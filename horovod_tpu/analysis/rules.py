@@ -24,12 +24,24 @@ designed around, loudly, in CHANGES.md/docstrings) — not generic style:
   (every feeding path's order must be a pure function of
   ``(seed, epoch, pass)`` — `data.stream`); a global-RNG draw or a
   seedless generator makes resumed byte streams irreproducible.
+* HVT007 — collective-order symmetry: sibling branches that issue
+  DIFFERENT collective sequences deadlock the fleet when the branch
+  condition varies by rank (mismatched submission order — the class
+  Horovod's coordinator exists to prevent).
+* HVT008 — reduction-composition discipline: gradient reductions in the
+  accumulation/ZeRO surface must route through the bucketed boundary
+  entry point (`collectives.reduce_gradients`), never a raw per-leaf
+  psum — the guardrail ROADMAP item 3's reduce-scatter refactor builds
+  on.
 
-Heuristics are lexical by design (no dataflow): a collective gated by an
-early ``return`` under a rank check, or a rank value laundered through a
-local variable, is NOT caught. The rules catch the shapes that actually
-appear; the suppressions (``# hvt: noqa[RULE]``, baseline) keep the
-false-positive cost at zero.
+Rules are interprocedural where the bug class demands it (HVT001 taints
+rank-gated CALLS whose callee transitively issues a collective; HVT007
+inlines callee sequences — both via `analysis.callgraph`), lexical
+everywhere else: a collective gated by an early ``return`` under a rank
+check, or a rank value laundered through a local variable, is NOT
+caught. The rules catch the shapes that actually appear; the
+suppressions (``# hvt: noqa[RULE]``, baseline) keep the false-positive
+cost at zero.
 """
 
 from __future__ import annotations
@@ -38,10 +50,11 @@ import ast
 import re
 from typing import Iterator
 
-from horovod_tpu.analysis import registry
+from horovod_tpu.analysis import callgraph, registry
 from horovod_tpu.analysis.core import (
     Finding,
     ModuleSource,
+    Project,
     Rule,
     dotted_name,
     register_rule,
@@ -49,138 +62,79 @@ from horovod_tpu.analysis.core import (
     terminal_name,
 )
 
-# --- shared: rank-condition detection ---------------------------------------
-
-# Topology queries whose result gates single-writer code paths. Both the
-# call forms (`runtime.rank()`, `jax.process_index()`, `hvt.is_primary()`)
-# and the attribute forms (`world.process_rank`) count.
-_RANK_CALLS = {"rank", "process_rank", "process_index", "local_rank",
-               "is_primary"}
-_RANK_ATTRS = {"process_rank", "process_index", "local_rank", "is_primary"}
-
-
-def _is_rank_gated(test: ast.AST) -> bool:
-    for node in ast.walk(test):
-        if isinstance(node, ast.Call):
-            name = terminal_name(node.func)
-            if name in _RANK_CALLS:
-                return True
-        elif isinstance(node, ast.Attribute) and isinstance(
-            node.ctx, ast.Load
-        ):
-            if node.attr in _RANK_ATTRS:
-                return True
-    return False
-
+# The shared vocabulary (rank gates, collective tables) lives in
+# `callgraph` so the graph and the rules cannot drift.
 
 # --- HVT001 -----------------------------------------------------------------
-
-# Collective/barrier operations that every rank of the world must issue
-# together, matched by terminal callee name regardless of qualification.
-_COLLECTIVES_ANY = {
-    "psum", "psum_scatter", "pmean", "hierarchical_psum",
-    "allreduce", "allgather", "all_gather", "broadcast",
-    "broadcast_object", "allgather_object", "broadcast_pytree",
-    "pmean_pytree", "reduce_gradients", "barrier", "wait_at_barrier",
-    "sync_global_devices",
-}
-# Operations matched only when qualified, to dodge same-name methods on
-# unrelated objects (`httpd.shutdown()`, `os.sync()`):
-#   runtime.shutdown / runtime.reinit (also bare, via the import map) are
-#   world-teardown barriers; `<...>.state.sync` / `ElasticState.sync` is
-#   the elastic state collective.
-_QUALIFIED = {
-    "shutdown": {"runtime", "hvt", "horovod_tpu"},
-    "reinit": {"runtime", "hvt", "horovod_tpu"},
-    "sync": {"state", "elastic_state", "ElasticState"},
-}
-
-
-def _collective_name(module: ModuleSource, call: ast.Call) -> str | None:
-    name = terminal_name(call.func)
-    if name is None:
-        return None
-    if name in _COLLECTIVES_ANY:
-        return dotted_name(call.func) or name
-    if name in _QUALIFIED:
-        resolved = resolved_dotted(module, call.func) or name
-        segments = resolved.split(".")
-        if len(segments) == 1 or segments[-2] in _QUALIFIED[name]:
-            return dotted_name(call.func) or name
-    return None
 
 
 @register_rule
 class CollectiveSymmetry(Rule):
     rule_id = "HVT001"
     title = "collective reachable only under rank-conditional control flow"
+    project_wide = True
+    rationale = (
+        "A collective/barrier that only some ranks issue is the classic "
+        "Horovod hang class (arXiv:1802.05799): the gated ranks never "
+        "enter, the rest block forever — or the coordination service "
+        "SIGABRTs them. Since PR 9 the check is INTERPROCEDURAL: a call "
+        "under a rank gate is tainted when its callee transitively "
+        "issues a collective, any number of helper hops deep, resolved "
+        "through the module-set call graph."
+    )
+    provenance = (
+        "PR 2's one-sided `runtime.shutdown` SIGABRT and PR 3's "
+        "rank-gated-checkpoint tear; the helper-hop upgrade is PR 9."
+    )
+    example = (
+        "if runtime.process_rank() == 0:\n"
+        "    helper(x)        # helper() -> inner() -> psum(...)\n"
+    )
 
     def check(self, module: ModuleSource) -> Iterator[Finding]:
-        findings: list[Finding] = []
+        # Single-module convenience (fixtures, editor integrations):
+        # the same analysis over a one-module project — helper hops
+        # within the module still resolve.
+        return self.check_project(Project([module]))
 
-        def visit(node: ast.AST, gate: tuple[int, str] | None):
-            if isinstance(node, ast.Call):
-                name = _collective_name(module, node)
-                if name is not None and gate is not None:
-                    line, cond = gate
-                    findings.append(module.finding(
-                        self.rule_id, node,
-                        f"collective/barrier `{name}` is reached only "
-                        f"under rank-conditional control flow (gated at "
-                        f"line {line}: `{cond}`) — ranks outside the "
-                        "branch never issue it, and the others hang in "
-                        "it (the Horovod one-sided-collective class); "
-                        "hoist the collective out of the rank gate",
-                    ))
-                for child in ast.iter_child_nodes(node):
-                    visit(child, gate)
-                return
-            if isinstance(node, (ast.If, ast.While)):
-                branch_gate = gate
-                if _is_rank_gated(node.test):
-                    branch_gate = (node.lineno, module.line_at(node.lineno))
-                visit(node.test, gate)
-                for child in node.body:
-                    visit(child, branch_gate)
-                for child in node.orelse:
-                    visit(child, branch_gate)
-                return
-            if isinstance(node, ast.IfExp):
-                branch_gate = gate
-                if _is_rank_gated(node.test):
-                    branch_gate = (node.lineno, module.line_at(node.lineno))
-                visit(node.test, gate)
-                visit(node.body, branch_gate)
-                visit(node.orelse, branch_gate)
-                return
-            if isinstance(node, ast.BoolOp):
-                # `rank() == 0 and collective()`: operands after a
-                # rank-gated one are short-circuit-conditional on it.
-                seen_gate = gate
-                for value in node.values:
-                    visit(value, seen_gate)
-                    if seen_gate is None and _is_rank_gated(value):
-                        seen_gate = (
-                            node.lineno, module.line_at(node.lineno)
-                        )
-                return
-            if isinstance(
-                node,
-                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
-                 ast.ClassDef),
-            ):
-                # New execution scope: a def/lambda under a rank gate is
-                # conditionally DEFINED, not conditionally executed —
-                # tracking call sites needs dataflow this linter
-                # deliberately doesn't do.
-                for child in ast.iter_child_nodes(node):
-                    visit(child, None)
-                return
-            for child in ast.iter_child_nodes(node):
-                visit(child, gate)
-
-        visit(module.tree, None)
-        return iter(findings)
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        graph = project.callgraph()
+        effects = graph.effects()
+        for unit in graph.units.values():
+            for site in unit.collectives:
+                if site.gate is None:
+                    continue
+                line, cond = site.gate
+                yield unit.module.finding(
+                    self.rule_id, site.node,
+                    f"collective/barrier `{site.name}` is reached only "
+                    f"under rank-conditional control flow (gated at "
+                    f"line {line}: `{cond}`) — ranks outside the "
+                    "branch never issue it, and the others hang in "
+                    "it (the Horovod one-sided-collective class); "
+                    "hoist the collective out of the rank gate",
+                )
+            for edge in unit.calls:
+                if edge.gate is None:
+                    continue
+                if effects.get(edge.callee) != callgraph.ISSUES:
+                    continue
+                line, cond = edge.gate
+                chain = " -> ".join(
+                    [edge.display] + graph.witness(edge.callee)
+                )
+                yield unit.module.finding(
+                    self.rule_id, edge.node,
+                    f"`{edge.display}(...)` transitively issues a "
+                    f"collective ({chain}) and is reached only under "
+                    f"rank-conditional control flow (gated at line "
+                    f"{line}: `{cond}`) — ranks outside the branch "
+                    "never issue it, and the others hang in it (the "
+                    "Horovod one-sided-collective class, through one "
+                    "or more helper hops); hoist the call out of the "
+                    "rank gate or make the callee's collective "
+                    "unconditional",
+                )
 
 
 # --- HVT002 -----------------------------------------------------------------
@@ -202,6 +156,18 @@ _SANCTIONED_TEARDOWN_MODULES = (
 class TeardownDiscipline(Rule):
     rule_id = "HVT002"
     title = "raw distributed teardown outside the sanctioned boundary"
+    rationale = (
+        "`jax.distributed.shutdown` is a BARRIER on this stack: one-"
+        "sided teardown propagates a coordination-service error that "
+        "kills the surviving ranks with SIGABRT. Only the runtime/"
+        "compat/elastic boundary modules — where lockstep is guaranteed "
+        "by the membership agreement — may touch the raw primitives."
+    )
+    provenance = "PR 2 (elastic teardown discipline; the SIGABRT class)."
+    example = (
+        "def cleanup():\n"
+        "    jax.distributed.shutdown()   # outside runtime/elastic\n"
+    )
 
     def check(self, module: ModuleSource) -> Iterator[Finding]:
         if module.relpath in _SANCTIONED_TEARDOWN_MODULES:
@@ -282,6 +248,18 @@ def _collect_traced_roots(module: ModuleSource) -> list[ast.AST]:
 class TracingHazards(Rule):
     rule_id = "HVT003"
     title = "host side effect inside a traced (jit/scan/shard_map) function"
+    rationale = (
+        "Host side effects inside jit/pjit/shard_map/scan bodies execute "
+        "ONCE at trace time (clocks/env become burned-in constants) — and "
+        "any rank-varying value silently diverges the compiled program "
+        "across the fleet, the silent-divergence class."
+    )
+    provenance = "PR 6 (designed-around invariant; trainer.py discipline)."
+    example = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x + time.time()   # traced once, constant forever\n"
+    )
 
     def check(self, module: ModuleSource) -> Iterator[Finding]:
         reported: set[tuple[int, int]] = set()
@@ -357,6 +335,17 @@ _KNOB_RE = re.compile(r"^HVT_[A-Z0-9_]+$")
 class EnvKnobRegistry(Rule):
     rule_id = "HVT004"
     title = "HVT_* env knob not declared in analysis/registry.py"
+    rationale = (
+        "Every `HVT_*` knob must carry a registry row (type, default, "
+        "subsystem, description) and be read through the typed accessors "
+        "— the single source of truth `docs/ENVVARS.md` is generated "
+        "from; undeclared literals and inline `os.environ` reads are how "
+        "the knob surface drifted before PR 6."
+    )
+    provenance = "PR 6 (central knob registry; 19 inline reads migrated)."
+    example = (
+        "flag = os.environ.get(\"HVT_NEW_KNOB\")   # undeclared, inline\n"
+    )
 
     def check(self, module: ModuleSource) -> Iterator[Finding]:
         for node in ast.walk(module.tree):
@@ -427,6 +416,19 @@ _WRITE_MODES = ("w", "x", "+")
 class CheckpointWriteAtomicity(Rule):
     rule_id = "HVT005"
     title = "truncating file write outside the atomic-write helper"
+    rationale = (
+        "A crash/preemption mid-write tears a truncating `open(..., "
+        "'w')`; checkpoint artifacts additionally need the `.sha256` "
+        "sidecar that discovery and restore verify. Artifact writes "
+        "route through `checkpoint._atomic_write` (tmp name + "
+        "os.replace + sidecar); deliberate non-artifact writers carry a "
+        "noqa with the reason."
+    )
+    provenance = "PR 3 (checkpoint integrity; torn-bundle export fix PR 6)."
+    example = (
+        "with open(manifest_path, \"w\") as f:   # tears under SIGKILL\n"
+        "    json.dump(manifest, f)\n"
+    )
 
     def check(self, module: ModuleSource) -> Iterator[Finding]:
         for writer, node in self._truncating_opens(module.tree):
@@ -498,6 +500,17 @@ _SEEDED_CTORS = {"RandomState", "default_rng", "Generator", "Random",
 class DataLayerSeededRng(Rule):
     rule_id = "HVT006"
     title = "unseeded RNG in the data layer (durable-cursor determinism)"
+    rationale = (
+        "The durable-stream-cursor contract (data/stream.py) requires "
+        "every feeding path's order to be a PURE function of (seed, "
+        "epoch, pass); a global-RNG draw or a seedless generator inside "
+        "`horovod_tpu/data/` makes a resumed byte stream irreproducible."
+    )
+    provenance = "PR 8 (byte-exact cross-epoch resume; StreamCursor)."
+    example = (
+        "def order(n):\n"
+        "    return np.random.permutation(n)   # process-history RNG\n"
+    )
 
     def check(self, module: ModuleSource) -> Iterator[Finding]:
         if not module.relpath.startswith(_DATA_LAYER_PREFIX):
@@ -543,3 +556,196 @@ class DataLayerSeededRng(Rule):
                         "irreproducible on resume; pass a seed derived "
                         "from (seed, epoch, pass) (`stream.epoch_seed`)",
                     )
+
+
+# --- HVT007 -----------------------------------------------------------------
+
+
+@register_rule
+class CollectiveOrderDivergence(Rule):
+    rule_id = "HVT007"
+    title = "sibling branches issue different collective sequences"
+    project_wide = True
+    rationale = (
+        "Collectives match up across ranks by SUBMISSION ORDER: when an "
+        "`if`/`else` pair issues different collective sequences "
+        "(directly or through helpers — callee sequences are inlined "
+        "via the call graph) and the condition varies by rank, rank A's "
+        "first collective pairs with rank B's different one — wrong "
+        "results at best, a fleet-wide deadlock at worst (the "
+        "mismatched-order class Horovod's coordinator exists to "
+        "prevent). A branch whose condition is provably uniform across "
+        "ranks (a config knob) is safe — suppress with a noqa stating "
+        "the uniformity argument."
+    )
+    provenance = (
+        "PR 9, pinning the Horovod timeline/stall-check class "
+        "(arXiv:1802.05799 §4) before the ZeRO-1 composition refactor."
+    )
+    example = (
+        "if phase == 0:           # rank-varying in practice\n"
+        "    psum(x); allgather(y)\n"
+        "else:\n"
+        "    allgather(y); psum(x)   # same ops, different order\n"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        return self.check_project(Project([module]))
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        graph = project.callgraph()
+        for module in project.modules:
+            yield from self._check_module(module, graph)
+
+    def _check_module(self, module, graph) -> Iterator[Finding]:
+        def visit(node: ast.AST, class_path: tuple):
+            for child in ast.iter_child_nodes(node):
+                child_path = class_path
+                if isinstance(child, ast.ClassDef):
+                    child_path = class_path + (child.name,)
+                if isinstance(child, ast.If) and child.orelse:
+                    enclosing = ".".join(class_path) or None
+                    seq_body = graph.sequence_of(
+                        module, child.body, enclosing
+                    )
+                    seq_else = graph.sequence_of(
+                        module, child.orelse, enclosing
+                    )
+                    if seq_body and seq_else and seq_body != seq_else:
+                        yield module.finding(
+                            self.rule_id, child,
+                            "sibling branches issue different collective "
+                            f"sequences — if: {list(seq_body)}, else: "
+                            f"{list(seq_else)} (helper calls inlined) — "
+                            "a rank-varying condition here submits "
+                            "collectives in different orders across the "
+                            "fleet and deadlocks it; issue the same "
+                            "collectives in the same order on both "
+                            "paths, or suppress with a noqa stating why "
+                            "the condition is uniform across ranks",
+                        )
+                yield from visit(child, child_path)
+
+        yield from visit(module.tree, ())
+
+
+# --- HVT008 -----------------------------------------------------------------
+
+# The accumulation/ZeRO composition surface: modules touching these names
+# participate in the gradient-reduction contract ROADMAP item 3 composes
+# (backward_passes_per_step x shard_update x hierarchy x elastic).
+_COMPOSITION_SURFACE = re.compile(
+    r"backward_passes_per_step|shard_update|accumulation_spec"
+)
+# The raw per-leaf wire operations a composition-surface module must not
+# issue directly — `collectives.reduce_gradients` owns bucketing, the
+# ICI/DCN two-hop, wire compression and (future) reduce-scatter layout.
+_PER_LEAF_REDUCTIONS = {
+    "psum", "psum_scatter", "hierarchical_psum", "quantized_group_sum",
+}
+# The one module allowed to spell the raw operations: the entry point.
+_REDUCTION_ENTRY_MODULE = "horovod_tpu/parallel/collectives.py"
+
+_TREE_MAP_TAILS = (".tree.map", ".tree_map", ".tree_multimap")
+
+
+def _is_tree_map(module: ModuleSource, call: ast.Call) -> bool:
+    resolved = resolved_dotted(module, call.func)
+    if resolved is None:
+        return False
+    return resolved.endswith(_TREE_MAP_TAILS)
+
+
+@register_rule
+class ReductionComposition(Rule):
+    rule_id = "HVT008"
+    title = "per-leaf gradient reduction outside the bucketed entry point"
+    rationale = (
+        "In the accumulation/ZeRO surface (anything touching "
+        "`backward_passes_per_step`, `shard_update` or "
+        "`accumulation_spec`), gradient reductions must route through "
+        "`collectives.reduce_gradients`: a raw per-leaf psum "
+        "(`tree.map(lambda g: psum(g), grads)`) forfeits the "
+        "dtype-homogeneous bucket fusion (÷K communication), skips the "
+        "ICI/DCN two-hop and wire compression, and cannot become the "
+        "ZeRO-1 reduce-scatter the composition refactor (ROADMAP item "
+        "3, arXiv:2004.13336) lowers the boundary into. `psum_scatter` "
+        "likewise belongs inside the entry point, where the sharded "
+        "update layout is derived from the bucket spec."
+    )
+    provenance = (
+        "PR 9, pinning PR 4's one-bucketed-reduction-per-step invariant "
+        "as the guardrail for the ZeRO x accumulation composition."
+    )
+    example = (
+        "grads = jax.tree.map(lambda g: lax.psum(g, 'data'), grads)\n"
+        "# in a module that also wires backward_passes_per_step\n"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        if module.relpath == _REDUCTION_ENTRY_MODULE:
+            return
+        if not _COMPOSITION_SURFACE.search(module.text):
+            return
+        defs_by_name = {
+            n.name: n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name == "psum_scatter":
+                yield module.finding(
+                    self.rule_id, node,
+                    "raw `psum_scatter` in an accumulation/ZeRO-surface "
+                    "module — the sharded-update reduction must go "
+                    "through `collectives.reduce_gradients`, which owns "
+                    "the bucket spec the reduce-scatter layout is "
+                    "derived from (ROADMAP item 3)",
+                )
+                continue
+            if not _is_tree_map(module, node) or not node.args:
+                continue
+            fn = node.args[0]
+            body = None
+            if isinstance(fn, ast.Lambda):
+                body = fn
+            elif isinstance(fn, ast.Name) and fn.id in defs_by_name:
+                body = defs_by_name[fn.id]
+            if body is None:
+                continue
+            for inner in ast.walk(body):
+                if isinstance(inner, ast.Call) and terminal_name(
+                    inner.func
+                ) in _PER_LEAF_REDUCTIONS:
+                    yield module.finding(
+                        self.rule_id, node,
+                        f"per-leaf `{terminal_name(inner.func)}` inside "
+                        "`tree.map` in an accumulation/ZeRO-surface "
+                        "module — route the gradient tree through "
+                        "`collectives.reduce_gradients` (dtype-"
+                        "homogeneous buckets, ICI/DCN two-hop, wire "
+                        "compression); per-leaf collectives forfeit the "
+                        "÷K bucket fusion and break the ZeRO-1 "
+                        "reduce-scatter composition (ROADMAP item 3)",
+                    )
+                    break
+
+
+if __name__ == "__main__":
+    # Regenerate docs/LINT_RULES.md (the ENVVARS.md pattern):
+    #   python -m horovod_tpu.analysis.rules > docs/LINT_RULES.md
+    import sys
+
+    # Under `-m` this file IS `__main__`; alias it so iter_rules'
+    # `import horovod_tpu.analysis.rules` finds the already-registered
+    # rule set instead of executing the module a second time (which
+    # would trip the duplicate-rule-id guard).
+    sys.modules.setdefault(
+        "horovod_tpu.analysis.rules", sys.modules[__name__]
+    )
+    from horovod_tpu.analysis.core import generate_rules_doc
+
+    print(generate_rules_doc(), end="")

@@ -435,7 +435,9 @@ class ElasticState:
             self.restore()
             return
         fps = [v[0] for v in votes]
-        if all(f is not None and f == fps[root_rank] for f in fps):
+        # Uniform branch: fps comes from the allgather above, so every
+        # rank evaluates the SAME condition and takes the SAME transport.
+        if all(f is not None and f == fps[root_rank] for f in fps):  # hvt: noqa[HVT007]
             self._committed = collectives.broadcast_pytree(
                 self._committed, root=root_rank
             )

@@ -1,0 +1,111 @@
+"""Poisoned-persistent-XLA-cache detection for the test harness.
+
+The suite shares one persistent XLA compilation cache (tests/.jax_cache,
+conftest.py) because it is compile-dominated — but a subprocess test
+that SIGKILLs/os._exit()s a training child can tear a cache write, and
+on this jax floor a torn entry later either fails DESERIALIZATION
+loudly, or — far worse — deserializes into a silently WRONG executable
+(observed twice: an EMA shadow off by exactly the decay factor, PR 5 and
+PR 8). The wrongness mode looks like a phantom numeric mismatch and has
+cost two sessions real time; the fix is always the same:
+``rm -rf tests/.jax_cache`` and re-run.
+
+Two guards, both wired into conftest:
+
+* `scan_cache_dir` at session start — a zero-byte or stale ``.tmp``
+  entry is definitionally torn (the atomic-rename never completed);
+  conftest deletes them and says so, before they can poison a test.
+* `poisoned_cache_advice` at failure time — when a test fails with a
+  deserialization-shaped error (`DESERIALIZATION_SIGNATURES`), the
+  report grows an actionable section naming the cache dir and the
+  ``rm -rf`` command instead of leaving the operator to chase phantoms.
+
+Numeric wrongness without a deserialization error cannot be detected
+here (the executable runs; it is just wrong) — that is why the advice
+also triggers on the *assertion shapes* the poisoned cache historically
+produced only when the persistent cache is actually enabled, and why it
+is phrased as a first-thing-to-try hint, not a diagnosis.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# Error text that indicates a torn cache entry failed to deserialize —
+# the LOUD poisoning mode. Matched case-insensitively against the
+# failure repr.
+DESERIALIZATION_SIGNATURES = (
+    r"failed to deserialize",
+    r"deserializ\w+ (?:error|failure|failed)",
+    r"error loading program from (?:the )?compilation cache",
+    r"compilation cache (?:entry|read|load)\w* (?:is )?(?:corrupt|invalid|failed)",
+    r"xla runtime error.*deserial",
+    r"invalid (?:serialized|flatbuffer)",
+    r"zlib\.error",
+    r"data loss:",
+)
+
+_SIGNATURE_RE = re.compile(
+    "|".join(f"(?:{s})" for s in DESERIALIZATION_SIGNATURES),
+    re.IGNORECASE,
+)
+
+
+def cache_dir_from_env(environ=None) -> str | None:
+    """The persistent cache directory in effect, or None when disabled
+    (the conftest contract: JAX_ENABLE_COMPILATION_CACHE=0 wins)."""
+    env = os.environ if environ is None else environ
+    if env.get("JAX_ENABLE_COMPILATION_CACHE") == "0":
+        return None
+    return env.get("JAX_COMPILATION_CACHE_DIR") or None
+
+
+def poisoned_cache_advice(failure_text: str,
+                          cache_dir: str | None) -> str | None:
+    """An actionable hint when `failure_text` looks like the documented
+    poisoned-cache failure mode and a persistent cache is in play."""
+    if not cache_dir:
+        return None
+    if not _SIGNATURE_RE.search(failure_text):
+        return None
+    return (
+        "This failure matches the torn persistent-XLA-cache signature "
+        "(a SIGKILLed child can tear a cache write; the entry later "
+        "fails to deserialize — or worse, deserializes into a silently "
+        "wrong executable that shows up as a phantom numeric mismatch; "
+        "see tests/conftest.py and CHANGES.md PR 5/PR 8 notes).\n"
+        f"First thing to try:  rm -rf {cache_dir}  and re-run.\n"
+        "If it persists with a cold cache, it is a real failure."
+    )
+
+
+def scan_cache_dir(cache_dir: str | None) -> list[str]:
+    """Paths of definitionally-torn entries in the persistent cache:
+    zero-byte files and orphaned temp files from interrupted writes.
+    Safe to delete (the cache is keyed content; jax recompiles)."""
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return []
+    torn = []
+    for dirpath, _, filenames in os.walk(cache_dir):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size == 0 or ".tmp" in name:
+                torn.append(path)
+    return sorted(torn)
+
+
+def remove_torn_entries(cache_dir: str | None) -> list[str]:
+    """Delete what `scan_cache_dir` found; returns the removed paths."""
+    removed = []
+    for path in scan_cache_dir(cache_dir):
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed

@@ -59,12 +59,46 @@ if REPO_ROOT not in sys.path:
 
 import pytest  # noqa: E402
 
+from horovod_tpu.testing import cachecheck  # noqa: E402
+
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end tests")
     config.addinivalue_line(
         "markers", "ci_job: full CI-gated convergence runs (several minutes)"
     )
+    # Guard 1 for the twice-documented poisoned-cache failure mode (the
+    # CAVEAT above): a zero-byte or orphaned-.tmp cache entry is
+    # definitionally torn (its atomic rename never completed) — delete it
+    # before it can deserialize into a SEGFAULT or a silently wrong
+    # executable mid-suite.
+    removed = cachecheck.remove_torn_entries(
+        cachecheck.cache_dir_from_env()
+    )
+    if removed:
+        print(
+            f"\n[conftest] removed {len(removed)} torn persistent-XLA-"
+            f"cache entr{'y' if len(removed) == 1 else 'ies'} "
+            "(zero-byte/.tmp — a killed child interrupted the write):\n"
+            + "\n".join(f"  {p}" for p in removed)
+        )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Guard 2: when a test fails with the torn-cache deserialization
+    signature, attach the actionable `rm -rf tests/.jax_cache` hint to
+    the report instead of leaving the operator to chase phantom numeric
+    mismatches (the documented PR 5/PR 8 time sink)."""
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call" or not report.failed:
+        return
+    advice = cachecheck.poisoned_cache_advice(
+        str(report.longrepr), cachecheck.cache_dir_from_env()
+    )
+    if advice:
+        report.sections.append(("poisoned XLA cache?", advice))
 
 
 @pytest.fixture(scope="session")

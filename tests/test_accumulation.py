@@ -19,8 +19,6 @@ Proof obligations (the PR's acceptance criteria):
 * Hierarchical == flat psum on a fake 2-slice topology.
 """
 
-import re
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -29,8 +27,9 @@ import optax
 import pytest
 
 import horovod_tpu as hvt
+from horovod_tpu.analysis import hlo_audit
+from horovod_tpu.analysis.step_probe import lowered_step_text
 from horovod_tpu.parallel import collectives, mesh as mesh_lib
-from horovod_tpu.parallel import sharding as sharding_lib
 from horovod_tpu.training.optimizer import accumulation_spec
 
 
@@ -84,35 +83,9 @@ def _trainer(module, k=1, compression="none", bucket_bytes=None, seed=3,
     return hvt.Trainer(module, tx, seed=seed, bucket_bytes=bucket_bytes)
 
 
-def _lowered_step_text(tr, x, y, k):
-    """The lowered (stablehlo) text of one compiled optimizer step, fed a
-    [K, G, ...] microbatch stack when k > 1."""
-    state = tr.build(x[: tr.dp_size])
-    if k == 1:
-        batch = tr._shard((x[:32], y[:32]))
-    else:
-        g = 8
-        batch = tr._shard_chunk(
-            (
-                np.stack([x[i * g : (i + 1) * g] for i in range(k)]),
-                np.stack([y[i * g : (i + 1) * g] for i in range(k)]),
-            ),
-            1,
-        )
-    acc = sharding_lib.replicate(tr.zero_metrics(), tr.mesh)
-    return tr._train_step.lower(
-        state, batch, jnp.asarray(1.0, jnp.float32), acc
-    ).as_text()
-
-
-def _grad_reductions(text):
-    """Non-scalar all_reduce ops in lowered stablehlo — gradient traffic.
-    Scalar all_reduces (loss/accuracy means, world-size psums) are metric
-    bookkeeping that exists on every path."""
-    chunks = re.findall(
-        r"stablehlo\.all_reduce.*?->\s*tensor<[^>]*>", text, flags=re.S
-    )
-    return [c for c in chunks if re.search(r"tensor<\d", c.split("->")[-1])]
+# The lowered-step plumbing and the gradient-traffic discrimination are
+# `analysis.step_probe.lowered_step_text` + `analysis.hlo_audit` since
+# PR 9 — one implementation, shared with bench.py and `hvt-audit`.
 
 
 class TestTrajectoryEquivalence:
@@ -216,13 +189,11 @@ class TestOneReductionPerStep:
         reduction — no matter how many microbatch passes scan inside it
         (default bucket bytes hold the whole Probe gradient)."""
         x, y = _probe_data()
-        counts = {}
         for k in (2, 4):
             tr = _trainer(Probe(), k=k)
-            counts[k] = len(
-                _grad_reductions(_lowered_step_text(tr, x, y, k))
+            hlo_audit.assert_program(
+                lowered_step_text(tr, x, y, k), "one-reduction"
             )
-        assert counts == {2: 1, 4: 1}
 
     def test_implicit_spmd_path_untouched(self):
         """Control: the default K=1, no-compression step still has NO
@@ -230,8 +201,9 @@ class TestOneReductionPerStep:
         accumulation machinery must not leak into the default path."""
         x, y = _probe_data()
         tr = _trainer(Probe(), k=1)
-        text = _lowered_step_text(tr, x, y, 1)
-        assert "stablehlo.all_reduce" not in text
+        hlo_audit.assert_program(
+            lowered_step_text(tr, x, y, 1), "no-collectives"
+        )
 
     def test_compression_composes_on_boundary_only(self):
         """compression='bf16' + K=4: every gradient-shaped reduction is
@@ -239,9 +211,9 @@ class TestOneReductionPerStep:
         16-bit cost is paid once per K passes, not per microbatch."""
         x, y = _probe_data()
         tr = _trainer(Probe(), k=4, compression="bf16")
-        grads = _grad_reductions(_lowered_step_text(tr, x, y, 4))
-        assert len(grads) == 1
-        assert all("bf16" in c for c in grads)
+        hlo_audit.assert_program(
+            lowered_step_text(tr, x, y, 4), "one-reduction,wire=bf16"
+        )
 
     def test_bucket_count_tracks_bucket_bytes(self):
         """With bucket_bytes forcing multiple buckets, the reduction count
@@ -252,10 +224,11 @@ class TestOneReductionPerStep:
         total = (64 * 32 + 32 + 32 * 10 + 10) * 4
         bucket_bytes = 4096
         tr = _trainer(Probe(), k=2, bucket_bytes=bucket_bytes)
-        n = len(_grad_reductions(_lowered_step_text(tr, x, y, 2)))
         expected = -(-total // bucket_bytes)  # ceil; one dtype → 3
-        assert n == expected == 3
-        assert n <= -(-total // bucket_bytes) + 1  # + n_dtypes
+        assert expected == 3
+        hlo_audit.assert_program(
+            lowered_step_text(tr, x, y, 2), f"reductions={expected}"
+        )
 
 
 class TestBucketRoundTrip:
@@ -402,13 +375,13 @@ class TestHierarchicalReduction:
             np.asarray(x).sum(0, keepdims=True), x.shape
         )
         np.testing.assert_allclose(got, want, rtol=2e-2)
-        text = f.lower(x).as_text()
-        chunks = re.findall(
-            r"stablehlo\.all_reduce.*?->\s*tensor<[^>]*>", text, flags=re.S
-        )
-        bf16 = [c for c in chunks if "bf16" in c]
-        assert len(bf16) == 1, chunks
-        assert len(chunks) >= 2  # the full-precision ICI hop is separate
+        reduces = [
+            op for op in hlo_audit.collective_ops(f.lower(x).as_text())
+            if op.kind == "all-reduce"
+        ]
+        bf16 = [op for op in reduces if op.dtype == "bf16"]
+        assert len(bf16) == 1, [op.describe() for op in reduces]
+        assert len(reduces) >= 2  # the full-precision ICI hop is separate
 
     def test_bad_dcn_factor_is_loud(self):
         hvt.init()

@@ -1,0 +1,90 @@
+"""`testing.cachecheck` — the poisoned-persistent-XLA-cache guard
+(ISSUE 9 satellite; the twice-documented PR 5/PR 8 failure mode).
+
+The guard has two halves, both wired into tests/conftest.py: a
+session-start sweep that deletes definitionally-torn cache entries
+(zero-byte / orphaned .tmp), and a failure-time matcher that appends the
+actionable ``rm -rf tests/.jax_cache`` hint to any failure whose text
+looks like a torn-entry deserialization — instead of letting the
+operator chase a phantom numeric mismatch.
+"""
+
+import pytest
+
+from horovod_tpu.testing import cachecheck
+
+
+class TestSignatureMatching:
+    CACHE = "/repo/tests/.jax_cache"
+
+    @pytest.mark.parametrize("text", [
+        "jaxlib.xla_extension.XlaRuntimeError: INTERNAL: Failed to "
+        "deserialize the executable",
+        "RuntimeError: error loading program from compilation cache",
+        "Deserialization failed: invalid flatbuffer",
+        "zlib.error: Error -3 while decompressing data",
+        "DATA LOSS: truncated entry",
+    ])
+    def test_deserialization_shapes_match(self, text):
+        advice = cachecheck.poisoned_cache_advice(text, self.CACHE)
+        assert advice is not None
+        assert f"rm -rf {self.CACHE}" in advice
+
+    @pytest.mark.parametrize("text", [
+        "AssertionError: arrays are not almost equal",
+        "ValueError: shapes (3,) and (4,) not aligned",
+        "TimeoutError: supervisor gave up",
+    ])
+    def test_ordinary_failures_do_not_match(self, text):
+        assert cachecheck.poisoned_cache_advice(text, self.CACHE) is None
+
+    def test_no_cache_dir_no_advice(self):
+        assert cachecheck.poisoned_cache_advice(
+            "Failed to deserialize the executable", None
+        ) is None
+
+
+class TestCacheDirFromEnv:
+    def test_reads_dir(self):
+        env = {"JAX_COMPILATION_CACHE_DIR": "/x/cache"}
+        assert cachecheck.cache_dir_from_env(env) == "/x/cache"
+
+    def test_disable_flag_wins(self):
+        env = {
+            "JAX_COMPILATION_CACHE_DIR": "/x/cache",
+            "JAX_ENABLE_COMPILATION_CACHE": "0",
+        }
+        assert cachecheck.cache_dir_from_env(env) is None
+
+    def test_unset_is_none(self):
+        assert cachecheck.cache_dir_from_env({}) is None
+
+
+class TestTornEntrySweep:
+    def _populate(self, d):
+        (d / "sub").mkdir()
+        good = d / "sub" / "entry_ok"
+        good.write_bytes(b"x" * 64)
+        torn = d / "sub" / "entry_torn"
+        torn.write_bytes(b"")
+        tmp = d / "entry.tmp.1234"
+        tmp.write_bytes(b"partial")
+        return good, torn, tmp
+
+    def test_scan_finds_only_torn(self, tmp_path):
+        good, torn, tmp = self._populate(tmp_path)
+        found = cachecheck.scan_cache_dir(str(tmp_path))
+        assert str(torn) in found and str(tmp) in found
+        assert str(good) not in found
+
+    def test_remove_deletes_and_reports(self, tmp_path):
+        good, torn, tmp = self._populate(tmp_path)
+        removed = cachecheck.remove_torn_entries(str(tmp_path))
+        assert sorted(removed) == sorted([str(torn), str(tmp)])
+        assert good.exists() and not torn.exists() and not tmp.exists()
+        # Second sweep is a no-op.
+        assert cachecheck.remove_torn_entries(str(tmp_path)) == []
+
+    def test_missing_dir_is_quiet(self, tmp_path):
+        assert cachecheck.scan_cache_dir(str(tmp_path / "nope")) == []
+        assert cachecheck.remove_torn_entries(None) == []

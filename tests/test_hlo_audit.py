@@ -1,0 +1,294 @@
+"""`analysis.hlo_audit` + `hvt-audit` — the compiled-program auditor
+(ISSUE 9 layer 2).
+
+Parser units run over handcrafted fixtures of BOTH text dialects jax
+emits (lowered StableHLO, post-optimization HLO), then the integration
+tests audit real lowered trainer steps through `analysis.step_probe` —
+the same plumbing bench.py and the migrated perf-path tests ride. The
+CLI subprocess tests pin the exit-code contract (0 clean / 1 violation
+/ 2 usage) and are the tier-1 gate for the canonical K=4 + int8 step:
+`hvt-audit step` must fail loudly when the HVT_OVERLAP_REDUCTION or
+compression invariants are off.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import hlo_audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# --- fixture programs -------------------------------------------------------
+
+STABLEHLO_SAMPLE = textwrap.dedent("""\
+    module @jit_train_step {
+      func.func public @main(%arg0: tensor<2410xf32>) -> tensor<2410xf32> {
+        %0 = stablehlo.while ... {
+          %w = stablehlo.add %arg0, %arg0 : tensor<2410xf32>
+        }
+        %144 = "stablehlo.all_gather"(%143) <{all_gather_dim = 0 : i64,
+            channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>
+        }> : (tensor<301xi8>) -> tensor<8x301xi8>
+        %146 = "stablehlo.all_gather"(%145) <{all_gather_dim = 0 : i64
+        }> : (tensor<f32>) -> tensor<8xf32>
+        %177 = "stablehlo.all_reduce"(%112) <{channel_handle =
+            #stablehlo.channel_handle<handle = 3, type = 1>}> ({
+        ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+          %s = stablehlo.add %a, %b : tensor<f32>
+          stablehlo.return %s : tensor<f32>
+        }) : (tensor<f32>) -> tensor<f32>
+        %180 = "stablehlo.all_reduce"(%113) <{channel_handle =
+            #stablehlo.channel_handle<handle = 4, type = 1>}> ({
+        ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+          %s = stablehlo.add %a, %b : tensor<f32>
+          stablehlo.return %s : tensor<f32>
+        }) : (tensor<2410xbf16>) -> tensor<2410xbf16>
+      }
+    }
+""")
+
+HLO_SAMPLE = textwrap.dedent("""\
+    HloModule jit_train_step, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, may-alias) }, entry_computation_layout={...}
+
+    %region_17.445 (x: f32[], y: f32[]) -> f32[] {
+      ROOT %add = f32[] add(f32[] %x, f32[] %y)
+    }
+
+    ENTRY %main {
+      %while.19 = (s32[], f32[2410]{0}) while((s32[], f32[2410]{0}) %tuple.5), condition=%cond, body=%body
+      %all-reduce.6 = f32[2410]{0} all-reduce(f32[2410]{0} %convert_fusion), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%region_17.445
+      %all-reduce.3 = f32[] all-reduce(f32[] %add_fusion), channel_id=2, to_apply=%region_17.445
+      %ag = (s8[8,2410]{1,0}, s8[8,2410]{1,0}) all-gather-start(s8[2410]{0} %q), channel_id=3, dimensions={0}
+      %ag-d = s8[8,2410]{1,0} all-gather-done((s8[8,2410]{1,0}, s8[8,2410]{1,0}) %ag)
+      %scales = f32[8]{0} all-gather(f32[] %scale), channel_id=4, dimensions={0}
+      %use = f32[2410]{0} fusion(f32[2410]{0} %all-reduce.6), kind=kLoop
+    }
+""")
+
+
+class TestParsers:
+    def test_stablehlo_ops_and_order(self):
+        ops = hlo_audit.collective_ops(STABLEHLO_SAMPLE)
+        assert [(o.kind, o.dtype, o.shape) for o in ops] == [
+            ("all-gather", "i8", (8, 301)),
+            ("all-gather", "f32", (8,)),
+            ("all-reduce", "f32", ()),
+            ("all-reduce", "bf16", (2410,)),
+        ]
+        assert [o.index for o in ops] == [0, 1, 2, 3]
+
+    def test_hlo_ops_skip_done_and_uses(self):
+        """The -done completion and operand USES of a collective's value
+        must not double-count; -start counts once; s8 canonicalizes to
+        i8; tuple result types count the op once."""
+        ops = hlo_audit.collective_ops(HLO_SAMPLE)
+        assert [(o.kind, o.dtype, o.shape) for o in ops] == [
+            ("all-reduce", "f32", (2410,)),
+            ("all-reduce", "f32", ()),
+            ("all-gather", "i8", (8, 2410)),
+            ("all-gather", "f32", (8,)),
+        ]
+
+    def test_gradient_discrimination(self):
+        """The shared bench discrimination: scalar all-reduces (metric
+        means) and rank-1 gathers (quantized-wire per-bucket scales) are
+        NOT gradient traffic; non-scalar all-reduces and rank>=2 payload
+        gathers are."""
+        for sample in (STABLEHLO_SAMPLE, HLO_SAMPLE):
+            grads = hlo_audit.gradient_reductions(sample)
+            assert len(grads) == 2
+            kinds = {(o.kind, o.rank) for o in grads}
+            assert ("all-gather", 2) in kinds
+            assert all(
+                not (o.kind == "all-gather" and o.rank < 2) for o in grads
+            )
+            assert all(not o.scalar for o in grads)
+
+    def test_while_count_both_dialects(self):
+        assert hlo_audit.while_count(STABLEHLO_SAMPLE) == 1
+        assert hlo_audit.while_count(HLO_SAMPLE) == 1
+
+    def test_donated_args_hlo_header(self):
+        assert hlo_audit.donated_args(HLO_SAMPLE) == [0, 2]
+
+    def test_donated_args_stablehlo_markers(self):
+        text = (
+            'func.func public @main(%arg0: tensor<4xf32> '
+            '{tf.aliasing_output = 0 : i32}, %arg1: tensor<4xf32>, '
+            '%arg2: tensor<4xf32> {jax.buffer_donor = true}) '
+            "stablehlo.add"
+        )
+        assert len(hlo_audit.donated_args(text)) == 2
+
+    def test_wire_dtype_aliases(self):
+        assert hlo_audit.wire_dtype("int8") == "i8"
+        assert hlo_audit.wire_dtype("fp8") == "f8e4m3"
+        assert hlo_audit.wire_dtype("BF16") == "bf16"
+        assert hlo_audit.wire_dtype("none") == "f32"
+        with pytest.raises(ValueError, match="unknown wire"):
+            hlo_audit.wire_dtype("int4")
+
+
+class TestExpectations:
+    def test_parse_grammar(self):
+        e = hlo_audit.ProgramExpectation.parse(
+            "one-reduction,wire=int8,donates=2"
+        )
+        assert e.gradient_reductions == 1
+        assert e.wire == "int8"
+        assert e.min_donated == 2
+        e2 = hlo_audit.ProgramExpectation.parse(
+            "reductions=3,max-reductions=4,no-collectives"
+        )
+        assert e2.gradient_reductions == 3
+        assert e2.max_gradient_reductions == 4
+        assert e2.no_explicit_collectives
+
+    def test_parse_rejects_unknown_token(self):
+        with pytest.raises(ValueError, match="unknown expectation"):
+            hlo_audit.ProgramExpectation.parse("one-reduction,bogus=1")
+        with pytest.raises(ValueError, match="unknown wire"):
+            hlo_audit.ProgramExpectation.parse("wire=int4")
+
+    def test_assert_program_structured_diff(self):
+        """The failure message is a structured diff — expected counts,
+        every observed op with dtype/shape/line — not a regex mismatch."""
+        with pytest.raises(hlo_audit.ProgramAuditError) as e:
+            hlo_audit.assert_program(
+                HLO_SAMPLE, "one-reduction,wire=int8"
+            )
+        msg = str(e.value)
+        assert "expected exactly 1 gradient reduction(s)" in msg
+        assert "found 2" in msg
+        assert "all-reduce f32[2410]" in msg
+        assert "off-wire traffic" in msg
+
+    def test_wire_on_empty_program_is_a_violation(self):
+        with pytest.raises(hlo_audit.ProgramAuditError,
+                           match="NO gradient reductions"):
+            hlo_audit.assert_program("HloModule empty", "wire=bf16")
+
+    def test_clean_expectations_pass(self):
+        hlo_audit.assert_program(HLO_SAMPLE, "reductions=2,donates=2")
+        assert hlo_audit.audit(
+            "HloModule empty", hlo_audit.ProgramExpectation.parse(
+                "no-collectives"
+            )
+        ) == []
+
+
+class TestRealPrograms:
+    """Integration over real lowered steps via the shared probe."""
+
+    def test_int8_step_audits_one_i8_payload_gather(self):
+        import horovod_tpu as hvt
+        from horovod_tpu.analysis import step_probe
+
+        hvt.init()
+        x, y = step_probe.probe_data()
+        text = step_probe.lowered_step_text(
+            step_probe.build_trainer(2, "int8"), x, y, 2
+        )
+        hlo_audit.assert_program(text, "one-reduction,wire=int8")
+        grads = hlo_audit.gradient_reductions(text)
+        assert [(o.kind, o.dtype) for o in grads] == [("all-gather", "i8")]
+        # The scale gather exists in the program but not in the count.
+        gathers = [
+            o for o in hlo_audit.collective_ops(text)
+            if o.kind == "all-gather"
+        ]
+        assert len(gathers) == 2
+
+    def test_compiled_step_donation_extracted(self):
+        """The donated TrainState surfaces as input_output_alias entries
+        in the compiled HLO — `donates=1` is auditable."""
+        import horovod_tpu as hvt
+        from horovod_tpu.analysis import step_probe
+
+        hvt.init()
+        x, y = step_probe.probe_data()
+        tr = step_probe.build_trainer(1, "none", error_feedback=False)
+        # Reuse the probe plumbing up to lowering, then compile.
+        import jax.numpy as jnp
+
+        from horovod_tpu.parallel import sharding as sharding_lib
+
+        state = tr.build(x[: tr.dp_size])
+        batch = tr._shard((x[:32], y[:32]))
+        acc = sharding_lib.replicate(tr.zero_metrics(), tr.mesh)
+        ctext = tr._train_step.lower(
+            state, batch, jnp.asarray(1.0, jnp.float32), acc
+        ).compile().as_text()
+        assert len(hlo_audit.donated_args(ctext)) >= 1
+        hlo_audit.assert_program(ctext, "donates=1")
+
+
+def _run_audit(args, env=None):
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.audit_cli"] + args,
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env=full_env,
+    )
+
+
+class TestAuditCLI:
+    """Exit-code contract + the canonical K=4 + int8 tier-1 gate."""
+
+    def test_canonical_k4_int8_step_gate(self):
+        """THE CI gate (ISSUE 9): the canonical accumulating int8 step
+        carries exactly one i8 payload reduction AND the overlap peel —
+        asserted end to end through the real CLI against a freshly
+        lowered program."""
+        proc = _run_audit([
+            "step", "--platform", "cpu", "--k", "4",
+            "--compression", "int8",
+            "--expect", "one-reduction,wire=int8,overlap",
+        ])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ok" in proc.stdout and "overlap peel verified" in proc.stdout
+
+    def test_overlap_knob_off_fails_gate(self):
+        """HVT_OVERLAP_REDUCTION=0 must fail the overlap expectation —
+        the structural gate catches a fleet de-overlapped by env."""
+        proc = _run_audit([
+            "step", "--platform", "cpu", "--k", "4",
+            "--compression", "int8",
+            "--expect", "one-reduction,wire=int8,overlap",
+        ], env={"HVT_OVERLAP_REDUCTION": "0"})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "overlap" in proc.stdout
+
+    def test_wire_violation_fails(self):
+        """An uncompressed step audited against wire=int8 exits 1 with
+        the off-wire op in the diff (the compression invariant)."""
+        proc = _run_audit([
+            "step", "--platform", "cpu", "--k", "2",
+            "--compression", "none", "--expect", "wire=int8",
+        ])
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "off-wire" in proc.stdout
+
+    def test_usage_error_exits_2(self):
+        proc = _run_audit(["step", "--expect", "bogus=1"])
+        assert proc.returncode == 2
+        assert "unknown expectation" in proc.stderr
+
+    def test_file_subcommand(self, tmp_path):
+        p = tmp_path / "step.hlo"
+        p.write_text(HLO_SAMPLE)
+        ok = _run_audit(["file", str(p), "--expect", "reductions=2"])
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        bad = _run_audit(["file", str(p), "--expect", "one-reduction"])
+        assert bad.returncode == 1
+        assert "found 2" in bad.stdout
+        missing = _run_audit(
+            ["file", str(tmp_path / "nope.hlo"), "--expect", "reductions=1"]
+        )
+        assert missing.returncode == 2

@@ -13,12 +13,14 @@ import textwrap
 
 import pytest
 
-from horovod_tpu.analysis import cli, core, registry
+from horovod_tpu.analysis import callgraph, cli, core, registry
 from horovod_tpu.analysis.rules import (
     CheckpointWriteAtomicity,
+    CollectiveOrderDivergence,
     CollectiveSymmetry,
     DataLayerSeededRng,
     EnvKnobRegistry,
+    ReductionComposition,
     TeardownDiscipline,
     TracingHazards,
 )
@@ -143,6 +145,156 @@ class TestHVT001CollectiveSymmetry:
                 if rank() == 0:
                     conn.sync()
         """) == []
+
+
+class TestHVT001Interprocedural:
+    """The PR 9 tentpole: rank-taint propagation through the call graph.
+    A collective reached only through a rank-gated HELPER — one or more
+    hops deep, across modules — is the seeded PR 2 shape the lexical
+    rule deliberately missed."""
+
+    def test_two_hops_in_one_module(self):
+        """The acceptance fixture: gate -> helper -> inner -> psum, two
+        call hops between the gate and the collective."""
+        found = findings_of(CollectiveSymmetry, """
+            from horovod_tpu.parallel.collectives import psum
+
+            def inner(x):
+                return psum(x)
+
+            def helper(x):
+                return inner(x)
+
+            def step(x):
+                if rank() == 0:
+                    helper(x)
+        """)
+        assert len(found) == 1
+        assert "helper -> inner -> psum" in found[0].message
+        assert "rank-conditional" in found[0].message
+
+    def test_cross_module_helper(self, tmp_path):
+        """The same shape split across files: resolution rides the
+        import-alias map and the module-set call graph."""
+        res = lint_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/helpers.py": """
+                from pkg.deep import inner
+                def helper(x):
+                    return inner(x)
+            """,
+            "pkg/deep.py": """
+                def inner(x):
+                    return psum(x)
+            """,
+            "pkg/main.py": """
+                from pkg import helpers
+                def step(x):
+                    if rank() == 0:
+                        helpers.helper(x)
+            """,
+        }, select=["HVT001"])
+        assert [f.path for f in res.findings] == ["pkg/main.py"]
+        assert "helpers.helper -> inner -> psum" in res.findings[0].message
+
+    def test_self_method_resolution(self):
+        found = findings_of(CollectiveSymmetry, """
+            class Agreement:
+                def _announce(self, x):
+                    return broadcast_object(x)
+
+                def maybe(self, x):
+                    if self.is_primary:
+                        self._announce(x)
+        """)
+        assert len(found) == 1
+        assert "self._announce" in found[0].message
+
+    def test_ungated_transitive_call_clean(self):
+        assert findings_of(CollectiveSymmetry, """
+            def helper(x):
+                return psum(x)
+
+            def step(x):
+                helper(x)
+                if rank() == 0:
+                    print(x)
+        """) == []
+
+    def test_gated_inside_callee_does_not_taint_call_site(self):
+        """A helper that gates its own collective is flagged AT the
+        internal site (that finding stands on its own); calling such a
+        helper under a gate adds no second finding — its effect summary
+        is rank-gated, not issues-collective."""
+        found = findings_of(CollectiveSymmetry, """
+            def helper(x):
+                if rank() == 0:
+                    psum(x)
+
+            def step(x):
+                if is_primary():
+                    helper(x)
+        """)
+        assert len(found) == 1
+        assert found[0].line == 4  # the psum inside helper, not the call
+
+    def test_unresolvable_call_never_taints(self):
+        # A call the module set cannot resolve (stdlib, dynamic) must
+        # not propagate taint — no guessing.
+        assert findings_of(CollectiveSymmetry, """
+            import os
+            def step(x):
+                if rank() == 0:
+                    os.listdir(".")
+        """) == []
+
+    def test_redefined_function_body_still_scanned(self):
+        """A fallback redefinition (the try-import shape) must not put
+        the second def's body in the dark: the clash gets a synthetic
+        non-addressable unit and its gated collective is still a
+        finding — lexical-rule parity."""
+        found = findings_of(CollectiveSymmetry, """
+            def save(x):
+                return x
+
+            def save(x):
+                if rank() == 0:
+                    barrier()
+        """)
+        assert len(found) == 1
+        assert "barrier" in found[0].message
+
+    def test_noqa_suppresses_call_site(self, tmp_path):
+        res = lint_tree(tmp_path, {"m.py": """
+            def helper(x):
+                return psum(x)
+
+            def step(x):
+                if rank() == 0:
+                    helper(x)  # hvt: noqa[HVT001]
+        """}, select=["HVT001"])
+        assert res.findings == []
+
+    def test_effect_classification_summary(self):
+        """The callgraph's three-way classification is observable."""
+        m = core.ModuleSource("/fake/m.py", "m.py", textwrap.dedent("""
+            def issues(x):
+                return psum(x)
+            def gated(x):
+                if rank() == 0:
+                    barrier()
+            def clean(x):
+                return x + 1
+            def transitive(x):
+                return issues(x)
+        """))
+        g = callgraph.CallGraph([m])
+        s = g.summary()
+        assert s["m:issues"] == callgraph.ISSUES
+        assert s["m:gated"] == callgraph.RANK_GATED
+        assert s["m:clean"] == callgraph.CLEAN
+        assert s["m:transitive"] == callgraph.ISSUES
+        assert g.witness("m:transitive") == ["issues", "psum"]
 
 
 class TestHVT002TeardownDiscipline:
@@ -391,6 +543,201 @@ class TestHVT006DataLayerSeededRng:
             import numpy as np
             x = np.random.permutation(8)
         """, relpath="horovod_tpu/training/fake.py") == []
+
+
+class TestHVT007CollectiveOrderDivergence:
+    """Sibling branches issuing different collective sequences — the
+    cross-rank mismatched-submission-order deadlock class."""
+
+    def test_direct_order_divergence_flagged(self):
+        found = findings_of(CollectiveOrderDivergence, """
+            def step(x, phase):
+                if phase:
+                    psum(x)
+                    allgather(x)
+                else:
+                    allgather(x)
+                    psum(x)
+        """)
+        assert [f.rule for f in found] == ["HVT007"]
+        assert "['psum', 'allgather']" in found[0].message
+        assert "['allgather', 'psum']" in found[0].message
+
+    def test_divergence_through_helpers_flagged(self):
+        """Callee sequences are inlined: the branches LOOK symmetric
+        (one call each) but the helpers issue different collectives."""
+        found = findings_of(CollectiveOrderDivergence, """
+            def path_a(x):
+                psum(x)
+
+            def path_b(x):
+                broadcast(x)
+
+            def step(x, phase):
+                if phase:
+                    path_a(x)
+                else:
+                    path_b(x)
+        """)
+        assert len(found) == 1
+        assert "['psum']" in found[0].message
+        assert "['broadcast']" in found[0].message
+
+    def test_same_sequence_both_arms_clean(self):
+        assert findings_of(CollectiveOrderDivergence, """
+            def step(x, phase):
+                if phase:
+                    y = psum(x)
+                else:
+                    y = psum(x * 2)
+        """) == []
+
+    def test_collective_free_branch_is_hvt001_territory(self):
+        # One silent arm is only a bug under a rank-varying condition —
+        # exactly what HVT001's gate detection covers; HVT007 stays out.
+        assert findings_of(CollectiveOrderDivergence, """
+            def step(x, phase):
+                if phase:
+                    psum(x)
+                else:
+                    log(x)
+        """) == []
+
+    def test_repeat_count_divergence_flagged(self):
+        """A helper called TWICE in one arm vs once in the other submits
+        a different number of collectives — the cycle guard must pop
+        after inlining (recursion-only), not swallow sibling repeats."""
+        found = findings_of(CollectiveOrderDivergence, """
+            def helper(x):
+                psum(x)
+
+            def step(x, phase):
+                if phase:
+                    helper(x)
+                    helper(x)
+                else:
+                    helper(x)
+        """)
+        assert len(found) == 1
+        assert "['psum', 'psum']" in found[0].message
+
+    def test_recursive_helper_terminates(self):
+        found = findings_of(CollectiveOrderDivergence, """
+            def loop(x, n):
+                psum(x)
+                return loop(x, n - 1)
+
+            def step(x, phase):
+                if phase:
+                    loop(x, 3)
+                else:
+                    broadcast(x)
+        """)
+        assert len(found) == 1  # and no RecursionError
+
+    def test_uniform_config_branch_noqa(self, tmp_path):
+        res = lint_tree(tmp_path, {"m.py": """
+            def reduce(x, quantized):
+                if quantized:  # hvt: noqa[HVT007] config-uniform branch
+                    allgather(x)
+                else:
+                    psum(x)
+        """}, select=["HVT007"])
+        assert res.findings == []
+
+
+class TestHVT008ReductionComposition:
+    """Per-leaf gradient reductions in the accumulation/ZeRO surface
+    must route through `collectives.reduce_gradients` (ROADMAP item 3's
+    pinned guardrail)."""
+
+    def test_tree_mapped_psum_lambda_flagged(self):
+        found = findings_of(ReductionComposition, """
+            # wires backward_passes_per_step into the step
+            import jax
+            def reduce(grads):
+                return jax.tree.map(lambda g: psum(g, 'data'), grads)
+        """)
+        assert [f.rule for f in found] == ["HVT008"]
+        assert "reduce_gradients" in found[0].message
+
+    def test_tree_mapped_named_local_fn_flagged(self):
+        found = findings_of(ReductionComposition, """
+            # wires backward_passes_per_step into the step
+            import jax
+            def _one(g):
+                return hierarchical_psum(g, 'data', 2)
+            def reduce(grads):
+                return jax.tree.map(_one, grads)
+        """)
+        assert len(found) == 1
+
+    def test_raw_psum_scatter_flagged(self):
+        found = findings_of(ReductionComposition, """
+            from jax import lax
+            def shard_update_reduce(grads, spec):
+                return lax.psum_scatter(grads, 'data')
+        """)
+        assert len(found) == 1
+        assert "psum_scatter" in found[0].message
+
+    def test_outside_surface_module_not_scoped(self):
+        assert findings_of(ReductionComposition, """
+            import jax
+            def reduce(grads):
+                return jax.tree.map(lambda g: psum(g, 'data'), grads)
+        """) == []
+
+    def test_metric_pmean_tree_map_clean(self):
+        # Scalar-metric bookkeeping (trainer.py's sown-metrics pmean) is
+        # not gradient reduction — pmean per leaf stays legal.
+        assert findings_of(ReductionComposition, """
+            # wires backward_passes_per_step into the step
+            import jax
+            def metrics(sm):
+                return jax.tree.map(lambda v: jax.lax.pmean(v, 'data'), sm)
+        """) == []
+
+    def test_entry_point_module_exempt(self):
+        src = """
+            # wires backward_passes_per_step into the step
+            import jax
+            def reduce_gradients(grads):
+                return jax.tree.map(lambda g: psum(g, 'data'), grads)
+        """
+        assert findings_of(
+            ReductionComposition, src,
+            relpath="horovod_tpu/parallel/collectives.py",
+        ) == []
+        assert len(findings_of(
+            ReductionComposition, src,
+            relpath="horovod_tpu/training/zero1.py",
+        )) == 1
+
+    def test_routed_through_entry_point_clean(self):
+        assert findings_of(ReductionComposition, """
+            # wires backward_passes_per_step into the step
+            from horovod_tpu.parallel import collectives
+            def boundary(grads, k):
+                return collectives.reduce_gradients(grads, reverse=True)
+        """) == []
+
+
+class TestRulesDocAndExplain:
+    def test_generated_doc_covers_every_rule(self):
+        doc = core.generate_rules_doc()
+        for cls in core.iter_rules():
+            assert f"## {cls.rule_id}" in doc
+            assert cls.title in doc
+
+    def test_explain_prints_rationale(self, capsys):
+        assert cli.main(["--explain", "HVT007"]) == 0
+        out = capsys.readouterr().out
+        assert "HVT007" in out and "Why:" in out and "Provenance:" in out
+
+    def test_explain_unknown_rule_is_usage_error(self, capsys):
+        assert cli.main(["--explain", "HVT999"]) == 2
+        assert "unknown rule" in capsys.readouterr().err
 
 
 class TestSuppressionsAndBaseline:

@@ -100,3 +100,31 @@ class TestEnvvarsDoc:
     def test_readme_links_envvars_doc(self):
         with open(os.path.join(REPO, "README.md")) as f:
             assert "docs/ENVVARS.md" in f.read()
+
+
+class TestLintRulesDoc:
+    """The ENVVARS.md contract, applied to the rule registry: the
+    committed docs/LINT_RULES.md must be byte-identical to what the rule
+    metadata renders (ISSUE 9 satellite)."""
+
+    DOC = os.path.join(REPO, "docs", "LINT_RULES.md")
+
+    def test_regeneration_produces_no_diff(self):
+        with open(self.DOC) as f:
+            on_disk = f.read()
+        assert on_disk == core.generate_rules_doc(), (
+            "docs/LINT_RULES.md is stale — regenerate: "
+            "python -m horovod_tpu.analysis.rules > docs/LINT_RULES.md"
+        )
+
+    def test_every_rule_carries_metadata(self):
+        """A rule without rationale/provenance renders an empty doc
+        section — refuse at the gate, not in review."""
+        for cls in core.iter_rules():
+            assert cls.rationale, f"{cls.rule_id} has no rationale"
+            assert cls.provenance, f"{cls.rule_id} has no provenance"
+            assert cls.example, f"{cls.rule_id} has no example"
+
+    def test_readme_links_rules_doc(self):
+        with open(os.path.join(REPO, "README.md")) as f:
+            assert "docs/LINT_RULES.md" in f.read()

@@ -19,8 +19,6 @@ PR 7's proof obligations:
 * bench.py's phase guard rejects any phase exceeding step_ms.total.
 """
 
-import re
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -30,9 +28,9 @@ import pytest
 
 import horovod_tpu as hvt
 from horovod_tpu import checkpoint, compat
-from horovod_tpu.analysis import registry
+from horovod_tpu.analysis import hlo_audit, registry
+from horovod_tpu.analysis.step_probe import lowered_step_text
 from horovod_tpu.parallel import collectives, mesh as mesh_lib
-from horovod_tpu.parallel import sharding as sharding_lib
 from horovod_tpu.training.optimizer import (
     ErrorFeedbackState,
     compression_error_feedback,
@@ -72,30 +70,9 @@ def _fit_params(tr, x, y, k, steps=4):
     return jax.tree.leaves(jax.device_get(tr.state.params))
 
 
-def _lowered_step_text(tr, x, y, k):
-    state = tr.build(x[: tr.dp_size])
-    if k == 1:
-        batch = tr._shard((x[:32], y[:32]))
-    else:
-        g = 8
-        batch = tr._shard_chunk(
-            (
-                np.stack([x[i * g : (i + 1) * g] for i in range(k)]),
-                np.stack([y[i * g : (i + 1) * g] for i in range(k)]),
-            ),
-            1,
-        )
-    acc = sharding_lib.replicate(tr.zero_metrics(), tr.mesh)
-    return tr._train_step.lower(
-        state, batch, jnp.asarray(1.0, jnp.float32), acc
-    ).as_text()
-
-
-def _grad_allreduces(text):
-    chunks = re.findall(
-        r"stablehlo\.all_reduce.*?->\s*tensor<[^>]*>", text, flags=re.S
-    )
-    return [c for c in chunks if re.search(r"tensor<\d", c.split("->")[-1])]
+# Lowered-step plumbing + the gradient-traffic discrimination live in
+# `analysis.step_probe` / `analysis.hlo_audit` since PR 9 (one
+# implementation, shared with bench.py and `hvt-audit`).
 
 
 class TestOverlapEquivalence:
@@ -147,12 +124,12 @@ class TestOverlapEquivalence:
         serialized step scans — visible as strictly fewer while ops in
         the lowered text."""
         x, y = _data()
-        whiles_on = _lowered_step_text(
+        whiles_on = hlo_audit.while_count(lowered_step_text(
             _trainer(2, "bf16", overlap=True), x, y, 2
-        ).count("stablehlo.while")
-        whiles_off = _lowered_step_text(
+        ))
+        whiles_off = hlo_audit.while_count(lowered_step_text(
             _trainer(2, "bf16", overlap=False), x, y, 2
-        ).count("stablehlo.while")
+        ))
         assert whiles_on < whiles_off
 
     def test_one_reduction_per_step_still_holds(self):
@@ -160,8 +137,10 @@ class TestOverlapEquivalence:
         K=4 overlapped step still carries exactly the bucket count of
         gradient-shaped collectives (one here — default bucket bytes)."""
         x, y = _data()
-        text = _lowered_step_text(_trainer(4, "bf16", overlap=True), x, y, 4)
-        assert len(_grad_allreduces(text)) == 1
+        hlo_audit.assert_program(
+            lowered_step_text(_trainer(4, "bf16", overlap=True), x, y, 4),
+            "one-reduction,wire=bf16",
+        )
 
     def test_knob_defaults(self, monkeypatch):
         assert _trainer()._overlap is True  # HVT_OVERLAP_REDUCTION default
@@ -178,25 +157,21 @@ class TestOverlapEquivalence:
 
 class TestQuantizedWire:
     def test_int8_wire_is_int8_on_the_wire(self):
-        """The lowered int8 step's gradient traffic is all_gather ops with
-        i8 payloads (plus the scalar f32 scales); no gradient-shaped f32
-        all_reduce remains."""
+        """The lowered int8 step's gradient traffic is the per-bucket
+        payload gather in i8 (the rank-1 f32 scale gather stays out of
+        the count); no gradient-shaped f32 all_reduce remains."""
         x, y = _data()
-        text = _lowered_step_text(_trainer(2, "int8"), x, y, 2)
-        gathers = re.findall(
-            r"stablehlo\.all_gather.*?->\s*tensor<[^>]*>", text, flags=re.S
+        hlo_audit.assert_program(
+            lowered_step_text(_trainer(2, "int8"), x, y, 2),
+            "one-reduction,wire=int8",
         )
-        assert any("i8" in g for g in gathers), gathers[:2]
-        assert not _grad_allreduces(text)
 
     def test_fp8_wire_is_f8_on_the_wire(self):
         x, y = _data()
-        text = _lowered_step_text(_trainer(2, "fp8"), x, y, 2)
-        gathers = re.findall(
-            r"stablehlo\.all_gather.*?->\s*tensor<[^>]*>", text, flags=re.S
+        hlo_audit.assert_program(
+            lowered_step_text(_trainer(2, "fp8"), x, y, 2),
+            "one-reduction,wire=fp8",
         )
-        assert any("f8E4M3" in g for g in gathers), gathers[:2]
-        assert not _grad_allreduces(text)
 
     def test_quantized_with_axis_name_rejected(self):
         with pytest.raises(ValueError, match="overflow"):
