@@ -548,12 +548,12 @@ def bench_train(which: str) -> dict:
     }
 
 
-def _timed_reduction(trainer, params, reps: int) -> float:
-    """Per-step wall time of the boundary gradient reduction in isolation:
-    the same `collectives.reduce_gradients` program the explicit step
-    embeds (bucketing, order, dcn two-hop, wire dtype all from the
-    trainer), compiled standalone over gradient-shaped zeros and chained
-    ``reps`` times per honest fetch."""
+def _reduction_program(trainer, params):
+    """(jitted fn, gradient-shaped zeros, lowered text) of the boundary
+    gradient reduction in isolation: the same
+    `collectives.reduce_gradients` program the explicit step embeds
+    (bucketing, order, dcn two-hop, wire dtype, ZeRO-1 scatter — all
+    from the trainer)."""
     import jax
     import jax.numpy as jnp
 
@@ -565,6 +565,7 @@ def _timed_reduction(trainer, params, reps: int) -> float:
     grads = jax.tree.map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params
     )
+    scatter = getattr(trainer, "_scatter", 1)
 
     def red(g):
         out = collectives.reduce_gradients(
@@ -575,14 +576,35 @@ def _timed_reduction(trainer, params, reps: int) -> float:
             wire_dtype=trainer._comm_dtype,
             bucket_bytes=trainer._bucket_bytes,
             reverse=trainer._bucket_reverse,
+            scatter=scatter if scatter > 1 else None,
         )
         # Scalar data-dependency on every reduced bucket (honest fetch).
-        return sum(jnp.sum(l) for l in jax.tree.leaves(out))
+        t = sum(
+            jnp.sum(l.astype(jnp.float32)) for l in jax.tree.leaves(out)
+        )
+        if scatter > 1:
+            # Scattered outputs differ per shard; one scalar psum makes
+            # the fetch replicated (excluded from the byte accounting —
+            # scalar ops never count as payload).
+            t = jax.lax.psum(
+                t, (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+            )
+        return t
 
     f = jax.jit(compat.shard_map(
         red, mesh=trainer.mesh, in_specs=(P(),), out_specs=P(),
         check_vma=False,
     ))
+    return f, grads, f.lower(grads).as_text()
+
+
+def _timed_reduction(trainer, params, reps: int) -> float:
+    """Per-step wall time of the isolated boundary reduction
+    (`_reduction_program`), chained ``reps`` times per honest fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    f, grads, _ = _reduction_program(trainer, params)
     float(jax.device_get(f(grads)))  # compile + settle
 
     def chain():
@@ -592,6 +614,32 @@ def _timed_reduction(trainer, params, reps: int) -> float:
         return t
 
     return _timed(chain) / reps
+
+
+def _wire_bytes_per_step(text: str, world: int) -> float:
+    """Structural per-device bytes-on-wire of one boundary reduction,
+    from its LOWERED program text: every non-scalar collective's payload
+    (`hlo_audit.op_bytes`) weighted by its ring transfer factor — an
+    all-reduce moves ~2x its payload per device, reduce-scatter ~1x its
+    (full, pre-scatter) input, all-gather/all-to-all ~1x the result —
+    each x (world-1)/world. Scale gathers and the honest-fetch scalar
+    psum are scalar/rank-1-of-world and cost their true (tiny) bytes."""
+    from horovod_tpu.analysis import hlo_audit
+
+    ring = (world - 1) / world if world > 1 else 0.0
+    total = 0.0
+    for op in hlo_audit.collective_ops(text):
+        if op.scalar:
+            continue
+        payload = hlo_audit.op_bytes(op)
+        if op.kind == "all-reduce":
+            total += 2 * payload * ring
+        elif op.kind == "reduce-scatter":
+            # op payload is the RESULT (1/world of the input bucket).
+            total += payload * world * ring
+        else:  # all-gather / all-to-all / collective-permute
+            total += payload * ring
+    return total
 
 
 def _reduction_calls(hlo: str) -> int:
@@ -738,6 +786,229 @@ def bench_accum() -> dict:
         "compression": compression,
         "per_chip_batch": per_chip_batch,
         "seq_len": seq_len,
+        "n_chips": n_chips,
+    }
+
+
+def bench_zero1() -> dict:
+    """ZeRO-1 composition A/B (``shard_update`` on/off x K): the sharded
+    weight update composed with accumulation (and, via HVT_COMPRESSION,
+    the quantized wire) against the replicated update at the same K.
+
+    Reports MFU and throughput for the composed leg, the per-phase
+    step_ms breakdown (same accounting rules as the train benches), and
+    the load-bearing number: structural bytes-on-wire per optimizer step
+    of the ISOLATED boundary reduction (`_reduction_program` lowered,
+    ring-factored — `_wire_bytes_per_step`), replicated vs scattered.
+    The scattered reduction must move STRICTLY fewer bytes than the
+    replicated one at the same K (a reduce-scatter is half an
+    all-reduce); main() exits non-zero on a miss. Exception: quantized
+    wires (HVT_COMPRESSION=int8/fp8) keep the dense bucket layout by
+    design — bitwise the replicated reduction — so their gate is
+    byte-equality, never MORE. The ZeRO-1 parameter
+    all-gather is deliberately outside this number — it belongs to the
+    update (and exists on the implicit path too); what the scatter mode
+    changes is the reduction. Fleet-wide optimizer-state bytes are
+    reported alongside (the ZeRO-1 memory win)."""
+    os.environ.setdefault("HVT_FAST_RNG", "1")
+    # A meaningful data-parallel degree on CPU drivers (inert on real
+    # accelerators, where the platform is not cpu).
+    os.environ.setdefault("HVT_NUM_CPU_DEVICES", "8")
+
+    import flax.linen as nn
+    import jax
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvt
+    from horovod_tpu import trace
+
+    hvt.init()
+    n_chips = jax.device_count()
+    K = max(2, int(os.environ.get("BENCH_ACCUM_K", 4)))
+    per_chip_batch = int(os.environ.get("BENCH_ZERO1_BATCH", 32))
+    hidden = int(os.environ.get("BENCH_ZERO1_HIDDEN", 1024))
+    n_steps = int(os.environ.get("BENCH_STEPS", 8))
+    global_batch = per_chip_batch * n_chips
+    compression = _wire_compression()
+
+    class Mlp(nn.Module):
+        # Dims divisible by any plausible chip count, so every kernel
+        # (and its Adam mirrors) shards under the zero1 rule.
+        @nn.compact
+        def __call__(self, x, *, train: bool = False):
+            import jax.numpy as jnp
+
+            x = x.astype(jnp.float32)
+            x = nn.relu(nn.Dense(hidden)(x))
+            x = nn.relu(nn.Dense(hidden)(x))
+            return nn.Dense(16)(x)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(4096, 512).astype(np.float32)
+    y = rng.randint(0, 16, 4096).astype(np.int32)
+
+    def fleet_state_bytes(tree):
+        total = 0
+        for l in jax.tree.leaves(tree):
+            if isinstance(l, jax.Array):
+                total += sum(
+                    int(np.prod(s.data.shape)) * l.dtype.itemsize
+                    for s in l.addressable_shards
+                )
+        return total
+
+    def measure(k: int, zero1: bool) -> dict:
+        trainer = hvt.Trainer(
+            Mlp(),
+            hvt.DistributedOptimizer(
+                optax.adam(hvt.scale_lr(1e-3)),
+                backward_passes_per_step=k,
+                average_aggregated_gradients=True,
+                compression=compression,
+            ),
+            loss="sparse_categorical_crossentropy",
+            shard_update=zero1,
+        )
+
+        def draw():
+            idx = rng.randint(0, len(x), size=global_batch)
+            return x[idx], y[idx]
+
+        def step_batch():
+            if k == 1:
+                return draw()
+            micro = [draw() for _ in range(k)]
+            return tuple(
+                np.stack([m[i] for m in micro]) for i in range(2)
+            )
+
+        state = trainer.build(x[: trainer.dp_size])
+        scale = np.float32(1.0)
+        zero_acc = {m: np.float32(0) for m in trainer.metric_names}
+        one = step_batch()
+        dev_one = (
+            trainer._shard(one) if k == 1 else trainer._shard_chunk(one, 1)
+        )
+        compiled_one = trainer._train_step.lower(
+            state, dev_one, scale, zero_acc
+        ).compile()
+        # Per-microbatch flops from the k=1 compile ONLY (bench_accum's
+        # rule): the K-leg's program holds the accumulation scan (cost
+        # model counts the body once) PLUS the overlap-peeled last
+        # microbatch — taking its count x K would double-report.
+        flops_micro = (
+            trace.compiled_cost_flops(compiled_one) if k == 1 else None
+        )
+        # Structural wire bytes of the isolated boundary reduction (the
+        # explicit path exists whenever k > 1 or a wire is set; the k=1
+        # uncompressed control reduces implicitly — same program shape
+        # as the explicit flat psum, counted identically).
+        _, _, red_text = _reduction_program(trainer, state.params)
+        wire = _wire_bytes_per_step(red_text, trainer.dp_size)
+        # Timed leg: one fused scan over n_steps optimizer steps.
+        steps = [step_batch() for _ in range(n_steps)]
+        mega = tuple(np.stack([s[i] for s in steps]) for i in range(2))
+        dev_mega = trainer._shard_chunk(mega, 2 if k > 1 else 1)
+        compiled = trainer._train_chunk.lower(
+            state, dev_mega, scale, zero_acc
+        ).compile()
+        w_state, _, w_acc = compiled(state, dev_mega, scale, zero_acc)
+        float(jax.device_get(w_acc["loss"]))
+        holder = {"state": w_state}
+
+        def run():
+            holder["state"], _, acc = compiled(
+                holder["state"], dev_mega, scale, zero_acc
+            )
+            return acc["loss"]
+
+        sec_per_opt_step = _timed(run) / n_steps
+        comm_s = _timed_reduction(
+            trainer, state.params, max(4, n_steps)
+        )
+        comm_s = min(comm_s, sec_per_opt_step)
+        return {
+            "examples_per_sec_per_chip": (
+                k * global_batch / sec_per_opt_step / n_chips
+            ),
+            "sec_per_opt_step": sec_per_opt_step,
+            "comm_s": comm_s,
+            "flops_micro": flops_micro,
+            "wire_bytes_per_opt_step": wire,
+            "opt_state_fleet_bytes": fleet_state_bytes(
+                holder["state"].opt_state
+            ),
+        }
+
+    legs = {
+        (k, zero1): measure(k, zero1)
+        for k in (1, K)
+        for zero1 in (False, True)
+    }
+    lead = legs[(K, True)]
+    # Per-optimizer-step flops of the K leg = K x the k=1 zero1 compile's
+    # per-microbatch count (the scan/peel-free program).
+    flops_micro = legs[(1, True)]["flops_micro"]
+    flops_per_opt_step = flops_micro * K if flops_micro else None
+    mfu = (
+        trace.mfu(flops_per_opt_step, lead["sec_per_opt_step"], n_chips)
+        if flops_per_opt_step else None
+    )
+    total_ms = lead["sec_per_opt_step"] * 1e3
+    comm_ms = lead["comm_s"] * 1e3
+    step_ms = {
+        "total": round(total_ms, 3),
+        "compute": round(max(0.0, total_ms - comm_ms), 3),
+        "comm": round(comm_ms, 3),
+        "input": 0.0,
+    }
+    wire = {
+        "replicated": {
+            "k1": round(legs[(1, False)]["wire_bytes_per_opt_step"]),
+            f"k{K}": round(legs[(K, False)]["wire_bytes_per_opt_step"]),
+        },
+        "zero1": {
+            "k1": round(legs[(1, True)]["wire_bytes_per_opt_step"]),
+            f"k{K}": round(legs[(K, True)]["wire_bytes_per_opt_step"]),
+        },
+    }
+    # THE acceptance property: at the same K, the scattered reduction
+    # moves strictly fewer bytes than the replicated one. QUANTIZED
+    # wires are the deliberate exception — they keep the dense bucket
+    # layout (bitwise-identical numerics to the replicated reduction,
+    # see collectives._reduce_gradients_scatter) so the two programs are
+    # byte-identical; the gate there is equality, never MORE.
+    quantized = compression.lower() in ("int8", "fp8")
+    strictly_fewer = (
+        wire["zero1"][f"k{K}"] < wire["replicated"][f"k{K}"]
+        and wire["zero1"]["k1"] < wire["replicated"]["k1"]
+    )
+    not_more = (
+        wire["zero1"][f"k{K}"] <= wire["replicated"][f"k{K}"]
+        and wire["zero1"]["k1"] <= wire["replicated"]["k1"]
+    )
+    wire_ok = not_more if quantized else strictly_fewer
+    return {
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "metric": "zero1_train_examples_per_sec_per_chip",
+        "value": round(lead["examples_per_sec_per_chip"], 1),
+        "unit": "examples/sec/chip",
+        "k": K,
+        "step_ms": step_ms,
+        "wire_bytes_per_opt_step": wire,
+        "wire_strictly_fewer": strictly_fewer,
+        "wire_gate_ok": wire_ok,
+        "replicated_examples_per_sec_per_chip": round(
+            legs[(K, False)]["examples_per_sec_per_chip"], 1
+        ),
+        "opt_state_fleet_bytes": {
+            "replicated": legs[(K, False)]["opt_state_fleet_bytes"],
+            "zero1": legs[(K, True)]["opt_state_fleet_bytes"],
+        },
+        "flops_per_opt_step": flops_per_opt_step,
+        "compression": compression,
+        "per_chip_batch": per_chip_batch,
         "n_chips": n_chips,
     }
 
@@ -1241,6 +1512,8 @@ def main() -> None:
         result = bench_int8_compute()
     elif which == "accum":
         result = bench_accum()
+    elif which == "zero1":
+        result = bench_zero1()
     elif which == "decode":
         result = bench_decode()
     elif which == "spec":
@@ -1264,6 +1537,18 @@ def main() -> None:
         print(
             f"bench: phase(s) {overruns} exceed step_ms.total — "
             "inconsistent phase accounting",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    if result.get("wire_gate_ok") is False:
+        import sys
+
+        print(
+            "bench: the ZeRO-1 scattered boundary reduction regressed — "
+            "it must move strictly fewer bytes than the replicated one "
+            "at the same K (byte-EQUAL for quantized wires, whose dense "
+            "layout is deliberate) "
+            f"({result.get('wire_bytes_per_opt_step')})",
             file=sys.stderr,
         )
         sys.exit(1)

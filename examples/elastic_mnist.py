@@ -91,8 +91,11 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
     # by construction — the K-microbatch scan runs inside the compiled
     # step, so `commit_every_steps` commits (ElasticStateCallback below,
     # cadence via the job spec's elastic: block) can never land
-    # mid-accumulation. Not composed with ELASTIC_ZERO1 (shard_update and
-    # accumulation are mutually exclusive — Trainer fails fast).
+    # mid-accumulation. COMPOSES with ELASTIC_ZERO1 since ISSUE 10: the
+    # boundary reduction then reduce-scatters into the sharded update
+    # layout (collectives.reduce_gradients(scatter=dp)), so the sharded
+    # commit path runs under accumulation too —
+    # jobs/mnist-elastic-sharded-2proc.yaml exercises exactly that.
     from horovod_tpu.analysis import registry
 
     backward_passes = registry.get_int("HVT_BACKWARD_PASSES") or 1

@@ -6,6 +6,12 @@ Usage::
     hvt-audit step --k 4 --compression int8 \\
         --expect one-reduction,wire=int8,overlap
 
+    # The composed ZeRO-1 gate: accumulation x sharded update x
+    # quantized wire — exactly one bucketed scatter-form reduction per
+    # optimizer step, no full-payload all-reduce, wire dtype checked:
+    hvt-audit step --k 4 --zero1 --compression int8 \\
+        --expect scatters=1,wire=int8,overlap
+
     # Audit a saved program text (lowered StableHLO or compiled HLO):
     hvt-audit file step.hlo --expect reductions=3,wire=bf16
 
@@ -38,9 +44,28 @@ import sys
 from horovod_tpu.analysis import hlo_audit
 
 
-def _default_expect(k: int, compression: str, bucket_bytes) -> str:
+def _default_expect(k: int, compression: str, bucket_bytes,
+                    zero1: bool = False) -> str:
+    compressed = compression.lower() not in ("", "none")
+    if zero1 and (k > 1 or compressed):
+        # The composed ZeRO-1 step: scatter-form reductions only, no
+        # full-payload all-reduce. Quantized wires keep the dense bucket
+        # layout (one bucket at the default fusion threshold -> exactly
+        # one scatter group op); the non-quantized scatter layout's
+        # bucket count depends on the device count (which leaves divide),
+        # so only the shape is pinned by default. String-compared, not
+        # imported: this runs before the jax env shaping.
+        tokens = []
+        quantized = compression.lower() in ("int8", "fp8")
+        if quantized and bucket_bytes is None:
+            tokens.append("scatters=1")
+        else:
+            tokens.append("scatter-reduction")
+        if compressed:
+            tokens.append(f"wire={compression}")
+        return ",".join(tokens)
     tokens = []
-    if compression.lower() not in ("", "none"):
+    if compressed:
         if bucket_bytes is None:
             tokens.append("one-reduction")
         tokens.append(f"wire={compression}")
@@ -57,7 +82,7 @@ def _run_step(args) -> int:
     expect_spec = args.expect
     if expect_spec is None:
         expect_spec = _default_expect(
-            args.k, args.compression, args.bucket_bytes
+            args.k, args.compression, args.bucket_bytes, args.zero1
         )
         print(f"hvt-audit: derived --expect {expect_spec}")
     want_overlap = False
@@ -84,7 +109,7 @@ def _run_step(args) -> int:
     x, y = step_probe.probe_data()
     trainer = step_probe.build_trainer(
         args.k, args.compression, overlap=overlap,
-        bucket_bytes=args.bucket_bytes,
+        bucket_bytes=args.bucket_bytes, zero1=args.zero1,
     )
     text = step_probe.lowered_step_text(trainer, x, y, args.k)
     if args.dump:
@@ -107,13 +132,13 @@ def _run_step(args) -> int:
             on = hlo_audit.while_count(step_probe.lowered_step_text(
                 step_probe.build_trainer(
                     2, args.compression, overlap=True,
-                    bucket_bytes=args.bucket_bytes,
+                    bucket_bytes=args.bucket_bytes, zero1=args.zero1,
                 ), x, y, 2,
             ))
             off = hlo_audit.while_count(step_probe.lowered_step_text(
                 step_probe.build_trainer(
                     2, args.compression, overlap=False,
-                    bucket_bytes=args.bucket_bytes,
+                    bucket_bytes=args.bucket_bytes, zero1=args.zero1,
                 ), x, y, 2,
             ))
             if not on < off:
@@ -129,6 +154,7 @@ def _run_step(args) -> int:
     config = (
         f"k={args.k} compression={args.compression} "
         f"overlap={'on' if trainer._overlap else 'off'}"
+        + (" zero1" if args.zero1 else "")
     )
     if violations:
         print(f"hvt-audit: step ({config}) FAILED:")
@@ -184,6 +210,12 @@ def main(argv: list[str] | None = None) -> int:
                       help="gradient wire: none/bf16/fp16/int8/fp8 "
                       "(default: HVT_COMPRESSION, else none)")
     step.add_argument("--bucket-bytes", type=int, default=None)
+    step.add_argument("--zero1", action="store_true",
+                      help="audit the composed ZeRO-1 step "
+                      "(Trainer(shard_update=True)): the boundary "
+                      "reduction must lower into the sharded update's "
+                      "layout — scatter-form reductions only, no "
+                      "full-payload all-reduce")
     step.add_argument("--overlap", choices=("auto", "on", "off"),
                       default="auto",
                       help="force the overlap knob (auto = env default)")

@@ -23,6 +23,13 @@ GRADIENT traffic is
   gather (one scalar per shard, noise bytes) that must not inflate the
   count.
 
+The ZeRO-1 composed step (PR 10) adds the SCATTER-form discrimination
+(`scatter_reductions`): non-scalar reduce-scatters plus rank >= 2
+all-to-alls — the quantized wire's reduce-scatter hop is an all-to-all
+with receiver-side f32 summation — with the `scatter-reduction` /
+`scatters=N` expectation asserting no full-payload all-reduce survives
+anywhere in the program.
+
 Deliberately stdlib-only (`re`/`dataclasses`): the lint/audit CLIs and
 the earliest CI hooks import this without jax. Only `step_probe` (which
 produces the text) touches jax.
@@ -42,6 +49,8 @@ __all__ = [
     "collective_ops",
     "donated_args",
     "gradient_reductions",
+    "op_bytes",
+    "scatter_reductions",
     "while_count",
     "wire_dtype",
 ]
@@ -211,6 +220,60 @@ def gradient_reductions(text) -> list[CollectiveOp]:
     return out
 
 
+def scatter_reductions(text) -> list[CollectiveOp]:
+    """The SCATTER-form gradient reductions: non-scalar reduce-scatters
+    plus rank >= 2 all-to-alls (the quantized wire expresses its
+    reduce-scatter hop as an all-to-all with receiver-side f32
+    summation — sub-16-bit partial sums must never exist on the wire).
+    The ZeRO-1 composed step (``Trainer(shard_update=True)`` with
+    accumulation/compression) must reduce THIS way: one bucketed group
+    of these per optimizer step, and no full-payload all-reduce
+    anywhere. Accepts program text or a pre-parsed op list.
+
+    NOTE: check the LOWERED StableHLO — it carries only the explicit
+    (shard_map-placed) collectives, so the sharded update's implicit
+    parameter all-gather (a GSPMD artifact of the compiled program)
+    cannot pollute the count."""
+    ops = collective_ops(text) if isinstance(text, str) else text
+    return [
+        op for op in ops
+        if (op.kind == "reduce-scatter" and not op.scalar)
+        or (op.kind == "all-to-all" and op.rank >= 2)
+    ]
+
+
+def _wire_payload_ops(ops) -> list[CollectiveOp]:
+    """Every op whose payload must carry the wire dtype: the gradient
+    reductions plus the quantized wire's rank >= 2 all-to-alls (rank-1
+    scale gathers stay excluded, as everywhere)."""
+    grads = gradient_reductions(ops)
+    a2a = [
+        op for op in ops
+        if op.kind == "all-to-all" and op.rank >= 2 and op not in grads
+    ]
+    return sorted(grads + a2a, key=lambda op: op.index)
+
+
+#: Payload element sizes for `op_bytes` (canonical dtype -> bytes).
+_DTYPE_BYTES = {
+    "f64": 8, "s64": 8, "u64": 8,
+    "f32": 4, "s32": 4, "u32": 4, "i32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "i8": 1, "u8": 1, "s8": 1, "f8e4m3": 1, "f8e5m2": 1,
+    "pred": 1, "i1": 1,
+}
+
+
+def op_bytes(op: CollectiveOp) -> int:
+    """Payload bytes of one collective's RESULT (elements x element
+    size) — the structural bytes-on-wire accounting the bench reports.
+    Unknown element types count 4 bytes (the f32 default)."""
+    n = 1
+    for d in op.shape:
+        n *= d
+    return n * _DTYPE_BYTES.get(op.dtype, 4)
+
+
 def while_count(text: str) -> int:
     """Loop (scan) ops in the program — the overlap peel's structural
     witness (PR 7: the peeled K=2 step has strictly fewer)."""
@@ -285,12 +348,23 @@ class ProgramExpectation:
     wire: str | None = None
     no_explicit_collectives: bool = False
     min_donated: int | None = None
+    # Scatter mode (the ZeRO-1 composed step): the gradient traffic must
+    # be ONE bucketed reduce-scatter group — only scatter-form reductions
+    # (`scatter_reductions`), with NO full-payload (non-scalar)
+    # all-reduce anywhere in the program. ``scatter_reductions`` pins the
+    # exact op count (== the bucket count); the bare flag only asserts
+    # the shape. Like ``wire``, check the LOWERED StableHLO — it carries
+    # the explicit collectives only, so the sharded update's implicit
+    # parameter all-gather cannot leak into the counts.
+    scatter_mode: bool = False
+    scatter_reductions: int | None = None
 
     @classmethod
     def parse(cls, spec: str) -> "ProgramExpectation":
         """CLI grammar: comma-separated tokens —
         ``one-reduction`` | ``reductions=N`` | ``max-reductions=N`` |
-        ``wire=int8`` | ``no-collectives`` | ``donates=N``.
+        ``wire=int8`` | ``no-collectives`` | ``donates=N`` |
+        ``scatter-reduction`` | ``scatters=N``.
         (``overlap`` is a CLI-level expectation: it needs two compiles.)
         """
         exp = cls()
@@ -312,12 +386,17 @@ class ProgramExpectation:
                 exp.no_explicit_collectives = True
             elif key == "donates" and value:
                 exp.min_donated = int(value)
+            elif token == "scatter-reduction":
+                exp.scatter_mode = True
+            elif key == "scatters" and value:
+                exp.scatter_mode = True
+                exp.scatter_reductions = int(value)
             else:
                 raise ValueError(
                     f"unknown expectation {token!r} — grammar: "
                     "one-reduction | reductions=N | max-reductions=N | "
                     "wire=<int8|fp8|bf16|fp16|f32> | no-collectives | "
-                    "donates=N | overlap"
+                    "donates=N | scatter-reduction | scatters=N | overlap"
                 )
         return exp
 
@@ -348,19 +427,44 @@ def audit(text: str, expects: ProgramExpectation) -> list[str]:
             f"expected at most {expects.max_gradient_reductions} gradient "
             f"reduction(s), found {len(grads)}:\n" + _op_table(grads)
         )
+    if expects.scatter_mode:
+        scatters = scatter_reductions(ops)
+        full_ar = [
+            op for op in ops if op.kind == "all-reduce" and not op.scalar
+        ]
+        if full_ar:
+            violations.append(
+                "scatter mode forbids full-payload all-reduces (the "
+                "reduction must lower into the sharded update's layout), "
+                f"found {len(full_ar)}:\n" + _op_table(full_ar)
+            )
+        if not scatters:
+            violations.append(
+                "expected scatter-form gradient reductions (reduce-"
+                "scatter / payload all-to-all), found none"
+            )
+        if expects.scatter_reductions is not None and len(scatters) != (
+            expects.scatter_reductions
+        ):
+            violations.append(
+                f"expected exactly {expects.scatter_reductions} scatter-"
+                f"form reduction(s) — one bucketed group — found "
+                f"{len(scatters)}:\n" + _op_table(scatters)
+            )
     if expects.wire is not None:
         want = wire_dtype(expects.wire)
-        if not grads:
+        payload = _wire_payload_ops(ops)
+        if not payload:
             violations.append(
                 f"expected {expects.wire} ({want}) gradient traffic, "
                 "found NO gradient reductions at all"
             )
-        off_wire = [op for op in grads if op.dtype != want]
+        off_wire = [op for op in payload if op.dtype != want]
         if off_wire:
             violations.append(
-                f"expected every gradient reduction's payload in "
-                f"{expects.wire} ({want}), found off-wire traffic:\n"
-                + _op_table(off_wire)
+                f"expected every gradient payload (reductions and "
+                f"scatter all-to-alls) in {expects.wire} ({want}), found "
+                "off-wire traffic:\n" + _op_table(off_wire)
             )
     if expects.min_donated is not None:
         donated = donated_args(text)

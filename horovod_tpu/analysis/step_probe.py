@@ -52,10 +52,14 @@ def probe_data(n: int = 64, seed: int = 0):
 
 def build_trainer(k: int = 1, compression: str = "none", *,
                   overlap=None, bucket_bytes=None, bucket_order=None,
-                  error_feedback: bool = True, model=None, seed: int = 3):
+                  error_feedback: bool = True, model=None, seed: int = 3,
+                  zero1: bool = False):
     """A `Trainer` wired exactly like the perf-path tests wire theirs:
     accumulation factor ``k``, wire ``compression``, optional
-    overlap/bucket knob overrides (None = the env-driven defaults)."""
+    overlap/bucket knob overrides (None = the env-driven defaults).
+    ``zero1`` turns on the sharded weight update
+    (``Trainer(shard_update=True)``) — the composed ZeRO-1 x
+    accumulation x compression step `hvt-audit step --zero1` gates."""
     import optax
 
     import horovod_tpu as hvt
@@ -68,7 +72,7 @@ def build_trainer(k: int = 1, compression: str = "none", *,
     return hvt.Trainer(
         model if model is not None else probe_model(), tx, seed=seed,
         bucket_bytes=bucket_bytes, overlap_reduction=overlap,
-        bucket_order=bucket_order,
+        bucket_order=bucket_order, shard_update=zero1,
     )
 
 

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from horovod_tpu.parallel import collectives
 from horovod_tpu.parallel import mesh as mesh_lib
 from horovod_tpu.parallel import sharding as sharding_lib
 from horovod_tpu.training.train_state import (
@@ -152,34 +153,53 @@ def build_state(trainer, sample_x: np.ndarray, sample_y=None) -> TrainState:
         and trainer.mesh.shape.get(mesh_lib.DATA_AXIS, 1) > 1
     ):
         # ZeRO-1 (arXiv:2004.13336): replicated params, optimizer state
-        # sharded dim-0 over the data axis. The jitted step then
-        # compiles the paper's transformation — gradients reduce-scatter
-        # into the update shard each replica owns, and the applied
-        # params all-gather back — purely from these init shardings.
+        # sharded over the data axis at each leaf's first dp-divisible
+        # dim — `collectives.zero1_shard_dim`, the SAME rule the
+        # scatter-mode boundary reduction derives its bucket layout from
+        # (reduce_gradients(scatter=dp)), so the reduced gradient slices
+        # land exactly on these mirrors. On the implicit (K=1,
+        # uncompressed) path the jitted step still compiles the paper's
+        # transformation purely from these init shardings.
         dp = trainer.mesh.shape[mesh_lib.DATA_AXIS]
         rep = sharding_lib.replicated(trainer.mesh)
         param_shaped = _param_shaped_matcher(params)
 
         def zero1(shape):
-            # First dp-divisible dim carries the shard (dim 0 for the
-            # matmul kernels that dominate; conv kernels usually shard
-            # their channel dims); nothing divisible → replicate.
-            for i, dim in enumerate(shape):
-                if dim % dp == 0:
-                    spec = [None] * len(shape)
-                    spec[i] = mesh_lib.DATA_AXIS
-                    return jax.sharding.NamedSharding(
-                        trainer.mesh, jax.sharding.PartitionSpec(*spec)
-                    )
-            return rep
+            return jax.sharding.NamedSharding(
+                trainer.mesh, collectives.zero1_partition_spec(shape, dp)
+            )
 
-        opt_shardings = jax.tree.map(
-            lambda sub: jax.tree.map(lambda l: zero1(l.shape), sub)
-            if param_shaped(sub)
-            else rep,
-            jax.eval_shape(trainer.tx.init, params),
-            is_leaf=param_shaped,
-        )
+        def mirror_shardings(shapes):
+            return jax.tree.map(
+                lambda sub: jax.tree.map(lambda l: zero1(l.shape), sub)
+                if param_shaped(sub)
+                else rep,
+                shapes,
+                is_leaf=param_shaped,
+            )
+
+        shapes = jax.eval_shape(trainer.tx.init, params)
+        if getattr(trainer, "_ef", False):
+            # Quantized-wire error feedback composed with ZeRO-1: the
+            # residual is PER-SHARD state ([n_shards, *param], dim-0 over
+            # the data axes — the same placement as the replicated-layout
+            # EF branch below, and the one n_shards-x-model-sized leaf
+            # that must never materialize dense); the wrapped inner
+            # state takes the zero1 mirrors.
+            shard0 = jax.sharding.NamedSharding(
+                trainer.mesh,
+                jax.sharding.PartitionSpec(
+                    (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+                ),
+            )
+            opt_shardings = shapes.replace(
+                ef_residual=jax.tree.map(
+                    lambda _: shard0, shapes.ef_residual
+                ),
+                inner=mirror_shardings(shapes.inner),
+            )
+        else:
+            opt_shardings = mirror_shardings(shapes)
         params = jax.device_put(params, rep)
         opt_state = jax.jit(trainer.tx.init, out_shardings=opt_shardings)(
             params

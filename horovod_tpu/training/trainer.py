@@ -268,22 +268,22 @@ class Trainer:
                 "follow the fsdp/tp sharding — compose via the fsdp axis "
                 "instead"
             )
-        if shard_update and self._comm_dtype is not None:
-            raise ValueError(
-                "shard_update does not compose with wire compression's "
-                "explicit-collective step (whose hand-rolled psum assumes "
-                "replicated optimizer state) — pick one"
-            )
-        if shard_update and self._accum_steps > 1:
-            raise ValueError(
-                "shard_update (ZeRO-1) does not compose with "
-                "backward_passes_per_step > 1: ZeRO-1 relies on XLA "
-                "turning the implicit gradient reduction into a "
-                "reduce-scatter, and the accumulating step replaces that "
-                "reduction with an explicit boundary psum over replicated "
-                "gradients — pick one (accumulation already delivers the "
-                "communication saving ZeRO-1's reduce-scatter amortizes)"
-            )
+        # shard_update COMPOSES with backward_passes_per_step, wire
+        # compression and the overlap peel (the former three fail-fasts):
+        # the explicit-collective step's boundary reduction lowers into
+        # the sharded weight-update layout via
+        # `collectives.reduce_gradients(scatter=dp)` — dtype-homogeneous
+        # buckets arranged so one psum_scatter per bucket hands every
+        # shard exactly the gradient slice its zero1 optimizer mirror
+        # consumes (quantized wires keep the dense bucket layout —
+        # bitwise-identical to the replicated reduction — and slice
+        # locally; see the collectives docstring). The K-microbatch scan,
+        # reverse bucket order and the overlap peel are untouched: the
+        # scatter happens at the same single call site.
+        self._scatter = (
+            self.mesh.shape.get(mesh_lib.DATA_AXIS, 1) if shard_update
+            else 1
+        )
         # Quantized-wire error feedback (compression='int8'/'fp8' with
         # error_feedback=True): the per-shard untransmitted quantization
         # remainder lives in opt_state (`ErrorFeedbackState`, one
@@ -486,6 +486,11 @@ class Trainer:
                     bucket_bytes=self._bucket_bytes,
                     reverse=self._bucket_reverse,
                     residual=res_in,
+                    # ZeRO-1 composition: scatter the reduction into the
+                    # sharded weight-update layout — each shard receives
+                    # only ITS zero1 slice of the divisible leaves (the
+                    # rest replicated), matching build's opt mirrors.
+                    scatter=self._scatter if self._scatter > 1 else None,
                 )
                 if res is None:
                     grads, new_res = reduced, None
@@ -518,11 +523,24 @@ class Trainer:
             P = jax.sharding.PartitionSpec
             stacked = P(None, data_axes)
             sharded0 = P(data_axes)  # residual: leading shard axis
+            if self._scatter > 1:
+                # ZeRO-1: the boundary reduction hands each shard its
+                # zero1 slice, so the grads leave the shard_map SHARDED
+                # over the data axis at each leaf's zero1 dim — exactly
+                # the layout the opt-state mirrors carry.
+                grads_spec = jax.tree.map(
+                    lambda p: collectives.zero1_partition_spec(
+                        jnp.shape(p), self._scatter
+                    ),
+                    state.params,
+                )
+            else:
+                grads_spec = P()
             return compat.shard_map(
                 local,
                 mesh=self.mesh,
                 in_specs=(P(), P(), stacked, stacked, sharded0),
-                out_specs=(P(), P(), P(), P(), P(), sharded0),
+                out_specs=(P(), P(), P(), P(), grads_spec, sharded0),
                 check_vma=False,
             )(state.params, state.model_state, xs, ys, residual)
 
@@ -576,7 +594,41 @@ class Trainer:
                 # through untouched).
                 opt_state = opt_state.replace(ef_residual=new_residual)
             updates = jax.tree.map(lambda u: u * update_scale, updates)
+            if self._scatter > 1 and (
+                self._comm_dtype is not None or self._accum_steps > 1
+            ):
+                # Composed ZeRO-1 path: pin the zero1 layout on the
+                # updates so the replication boundary is the param
+                # all-gather AFTER the sharded optimizer math —
+                # propagation must not re-replicate the scattered
+                # gradients and optimizer mirrors instead.
+                updates = jax.lax.with_sharding_constraint(
+                    updates,
+                    jax.tree.map(
+                        lambda p: jax.sharding.NamedSharding(
+                            self.mesh,
+                            collectives.zero1_partition_spec(
+                                jnp.shape(p), self._scatter
+                            ),
+                        ),
+                        state.params,
+                    ),
+                )
             params = optax.apply_updates(state.params, updates)
+            if self._scatter > 1:
+                # ZeRO-1 (implicit or composed): the updated params must
+                # come back REPLICATED. Left to propagation, XLA keeps
+                # them data-sharded — deferring the all-gather into the
+                # NEXT step — which breaks the step's own closure
+                # contract (params re-enter replicated: a silent second
+                # executable per fit, AOT reuse errors) and every state
+                # surface that assumes the built layout (checkpoint
+                # broadcast, elastic commit's sharded-leaf detection).
+                # The constraint places the update all-gather inside the
+                # step, where ZeRO-1 pays it by design.
+                params = jax.lax.with_sharding_constraint(
+                    params, sharding_lib.replicated(self.mesh)
+                )
             if self._param_shardings is not None:
                 # Pin the TP/FSDP layout so XLA's propagation can't drift the
                 # updated params away from their declared placement.

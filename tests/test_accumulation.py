@@ -459,15 +459,20 @@ class TestDcnFactor:
 
 
 class TestCompositionGuards:
-    def test_shard_update_rejected(self):
-        with pytest.raises(ValueError, match="reduce-scatter"):
-            hvt.Trainer(
-                Probe(),
-                hvt.DistributedOptimizer(
-                    optax.adam(1e-3), backward_passes_per_step=2
-                ),
-                shard_update=True,
-            )
+    def test_shard_update_composes(self):
+        """The PR 4 fail-fast is LIFTED: shard_update (ZeRO-1) now
+        composes with backward_passes_per_step — the boundary reduction
+        lowers into the sharded update's layout
+        (reduce_gradients(scatter=dp); full matrix in
+        tests/test_zero1_compose.py)."""
+        tr = hvt.Trainer(
+            Probe(),
+            hvt.DistributedOptimizer(
+                optax.adam(1e-3), backward_passes_per_step=2
+            ),
+            shard_update=True,
+        )
+        assert tr._scatter == tr.mesh.shape["data"]
 
     def test_param_specs_rejected(self):
         with pytest.raises(ValueError, match="replicated"):

@@ -63,8 +63,16 @@ def train(state, world):
     rng = np.random.RandomState(0)
     x = rng.rand(96, 12).astype("float32")
     y = (np.arange(96) % 4).astype("int64")
+    # HVT_BACKWARD_PASSES=K: the composed ZeRO-1 x accumulation path —
+    # K microbatches per optimizer step with the boundary reduction
+    # scattered into the sharded update layout (ISSUE 10).
+    from horovod_tpu.analysis import registry
+    backward_passes = registry.get_int("HVT_BACKWARD_PASSES") or 1
     trainer = hvt.Trainer(
-        Tiny(), hvt.DistributedOptimizer(optax.adam(1e-2)),
+        Tiny(), hvt.DistributedOptimizer(
+            optax.adam(1e-2), backward_passes_per_step=backward_passes,
+            average_aggregated_gradients=True,
+        ),
         shard_update=hvt.runtime.env_flag("ELASTIC_ZERO1"),
     )
     trainer.build(x[:1], y[:1])
@@ -123,7 +131,7 @@ def _journal(log):
         return [json.loads(line) for line in f if line.strip()]
 
 
-def _run_elastic(tmp_path, capfd, tag, zero1):
+def _run_elastic(tmp_path, capfd, tag, zero1, extra_env=None):
     argv = _write_script(tmp_path)
     model_dir = tmp_path / f"models-{tag}"
     log = tmp_path / f"restarts-{tag}.jsonl"
@@ -139,6 +147,7 @@ def _run_elastic(tmp_path, capfd, tag, zero1):
         "JAX_ENABLE_COMPILATION_CACHE": "0",
         "JAX_COMPILATION_CACHE_DIR": "",
     }
+    env.update(extra_env or {})
     code = supervisor.supervise_elastic(
         3, argv, env=env,
         # max_restarts=0: the leaver is NOT replaced, so both runs see the
@@ -220,6 +229,62 @@ def test_zero1_shrink_continues_and_matches_dense(tmp_path, capfd):
     out_dense, _, _ = _run_elastic(tmp_path, capfd, "dense", zero1=False)
     assert ("3", "False") in re.findall(
         r"GEN rank=0 size=(\d) gen=\d+ SHARDED=(\w+)", out_dense
+    )
+    dense = {
+        int(m.group(1)): float(m.group(2))
+        for m in re.finditer(
+            r"STATUS epoch=(\d+) step=\d+ rank=0 size=\d+ loss=([0-9.]+)",
+            out_dense)
+    }
+    sharded_losses = {e: l for e, _, l in statuses}
+    assert set(dense) == set(sharded_losses)
+    for epoch in sorted(dense):
+        assert dense[epoch] == pytest.approx(
+            sharded_losses[epoch], rel=1e-4, abs=1e-6
+        ), (epoch, dense[epoch], sharded_losses[epoch])
+
+
+@pytest.mark.slow
+def test_zero1_k4_composed_shrink_matches_dense(tmp_path, capfd):
+    """ISSUE 10 acceptance leg: the COMPOSED path — ZeRO-1 sharded
+    commits x backward_passes_per_step=4 (the scattered boundary
+    reduction) — through the same 3→2 clean-leave shrink: sharded at
+    both sizes, zero survivor reboots, training completes, and the loss
+    trajectory equals the dense (replicated-update) K=4 control epoch
+    for epoch at rel 1e-4 — elasticity and the scatter lowering change
+    the layout, never the math."""
+    k4 = {"HVT_BACKWARD_PASSES": "4"}
+    out_sharded, log, _ = _run_elastic(
+        tmp_path, capfd, "zero1-k4", zero1=True, extra_env=k4
+    )
+
+    gens = re.findall(r"GEN rank=0 size=(\d) gen=\d+ SHARDED=(\w+)",
+                      out_sharded)
+    assert ("3", "True") in gens and ("2", "True") in gens, gens
+    records = _journal(log)
+    names = [r["name"] for r in records]
+    assert "leave" in names and "shrink" in names
+    boots = re.findall(r"BOOT member=(\S+)", out_sharded)
+    assert len(boots) == 3 and len(set(boots)) == 3, boots
+    statuses = [
+        (int(m.group(1)), int(m.group(2)), float(m.group(3)))
+        for m in re.finditer(
+            r"STATUS epoch=(\d+) step=(\d+) rank=0 size=\d+ "
+            r"loss=([0-9.]+)", out_sharded)
+    ]
+    assert statuses, out_sharded[-2000:]
+    # steps_per_epoch=2 OPTIMIZER steps regardless of K — the counter
+    # stays exact through the composed shrink.
+    assert all(step == 2 * epoch for epoch, step, _ in statuses), statuses
+    assert max(e for e, _, _ in statuses) == EPOCHS
+    assert "TRAINING COMPLETE" in out_sharded
+    sizes = re.findall(
+        r"STATUS epoch=\d+ step=\d+ rank=0 size=(\d+)", out_sharded
+    )
+    assert "3" in sizes and "2" in sizes, sizes
+
+    out_dense, _, _ = _run_elastic(
+        tmp_path, capfd, "dense-k4", zero1=False, extra_env=k4
     )
     dense = {
         int(m.group(1)): float(m.group(2))

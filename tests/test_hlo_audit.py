@@ -154,6 +154,62 @@ class TestExpectations:
         with pytest.raises(ValueError, match="unknown wire"):
             hlo_audit.ProgramExpectation.parse("wire=int4")
 
+    def test_parse_scatter_tokens(self):
+        e = hlo_audit.ProgramExpectation.parse("scatter-reduction")
+        assert e.scatter_mode and e.scatter_reductions is None
+        e2 = hlo_audit.ProgramExpectation.parse("scatters=2,wire=bf16")
+        assert e2.scatter_mode and e2.scatter_reductions == 2
+        assert e2.wire == "bf16"
+
+    def test_scatter_mode_forbids_full_payload_all_reduce(self):
+        """HLO_SAMPLE carries a gradient-shaped f32 all-reduce — in
+        scatter mode that is THE violation (the reduction must lower
+        into the sharded update's layout), reported alongside the
+        missing scatter ops."""
+        with pytest.raises(hlo_audit.ProgramAuditError) as e:
+            hlo_audit.assert_program(HLO_SAMPLE, "scatter-reduction")
+        msg = str(e.value)
+        assert "forbids full-payload all-reduce" in msg
+        assert "expected scatter-form" in msg
+
+    def test_scatter_reductions_discrimination(self):
+        """reduce-scatters and rank >= 2 all-to-alls count; all-gathers
+        (param reassembly) and scalar ops never do — both dialects."""
+        stablehlo = (
+            '%0 = "stablehlo.reduce_scatter"(%a) <{scatter_dimension = 0'
+            ' : i64}> : (tensor<2400xf32>) -> tensor<300xf32>\n'
+            '%1 = "stablehlo.all_to_all"(%b) <{split_count = 8 : i64}> :'
+            " (tensor<8x301xi8>) -> tensor<8x301xi8>\n"
+            '%2 = "stablehlo.all_gather"(%c) <{all_gather_dim = 0 : i64'
+            "}> : (tensor<301xi8>) -> tensor<8x301xi8>\n"
+        )
+        ops = hlo_audit.scatter_reductions(stablehlo)
+        assert [(o.kind, o.dtype) for o in ops] == [
+            ("reduce-scatter", "f32"), ("all-to-all", "i8"),
+        ]
+        hlo = (
+            "ENTRY %main {\n"
+            "  %rs = f32[300]{0} reduce-scatter(f32[2400]{0} %g), "
+            "channel_id=1, dimensions={0}\n"
+            "  %aa = s8[8,301]{1,0} all-to-all(s8[8,301]{1,0} %q), "
+            "channel_id=2\n"
+            "}\n"
+        )
+        ops2 = hlo_audit.scatter_reductions(hlo)
+        assert [(o.kind, o.dtype) for o in ops2] == [
+            ("reduce-scatter", "f32"), ("all-to-all", "i8"),
+        ]
+
+    def test_op_bytes(self):
+        op = hlo_audit.CollectiveOp(
+            kind="all-to-all", dtype="i8", shape=(8, 301), line=1, index=0
+        )
+        assert hlo_audit.op_bytes(op) == 8 * 301
+        op32 = hlo_audit.CollectiveOp(
+            kind="all-reduce", dtype="f32", shape=(2410,), line=1, index=0
+        )
+        assert hlo_audit.op_bytes(op32) == 2410 * 4
+
     def test_assert_program_structured_diff(self):
         """The failure message is a structured diff — expected counts,
         every observed op with dtype/shape/line — not a regex mismatch."""
@@ -196,12 +252,19 @@ class TestRealPrograms:
         hlo_audit.assert_program(text, "one-reduction,wire=int8")
         grads = hlo_audit.gradient_reductions(text)
         assert [(o.kind, o.dtype) for o in grads] == [("all-gather", "i8")]
-        # The scale gather exists in the program but not in the count.
-        gathers = [
-            o for o in hlo_audit.collective_ops(text)
-            if o.kind == "all-gather"
+        # The two-shot wire (PR 10): one i8 all-to-all (the reduce-
+        # scatter shot) + the counted i8 chunk gather, with TWO rank-1
+        # f32 scale gathers (one per shot) in the program but not in
+        # the count.
+        ops = hlo_audit.collective_ops(text)
+        assert [
+            (o.kind, o.dtype) for o in ops if o.kind == "all-to-all"
+        ] == [("all-to-all", "i8")]
+        scale_gathers = [
+            o for o in ops if o.kind == "all-gather" and o.rank == 1
         ]
-        assert len(gathers) == 2
+        assert len(scale_gathers) == 2
+        assert all(o.dtype == "f32" for o in scale_gathers)
 
     def test_compiled_step_donation_extracted(self):
         """The donated TrainState surfaces as input_output_alias entries
@@ -253,6 +316,30 @@ class TestAuditCLI:
         ])
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "ok" in proc.stdout and "overlap peel verified" in proc.stdout
+
+    def test_canonical_k4_zero1_int8_step_gate(self):
+        """THE composed-path CI gate (ISSUE 10): K=4 + shard_update +
+        int8 compiles to exactly ONE bucketed scatter-form reduction per
+        optimizer step (no full-payload all-reduce), wire dtype i8 on
+        the lowered StableHLO, and the overlap peel still holds —
+        end to end through the real CLI."""
+        proc = _run_audit([
+            "step", "--platform", "cpu", "--k", "4", "--zero1",
+            "--compression", "int8",
+            "--expect", "scatters=1,wire=int8,overlap",
+        ])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ok" in proc.stdout and "overlap peel verified" in proc.stdout
+
+    def test_zero1_gate_derives_scatter_expectation(self):
+        """`--zero1` without --expect derives the scatter-form
+        expectation (scatters=1 for the quantized dense layout)."""
+        proc = _run_audit([
+            "step", "--platform", "cpu", "--k", "4", "--zero1",
+            "--compression", "int8",
+        ])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "derived --expect scatters=1,wire=int8" in proc.stdout
 
     def test_overlap_knob_off_fails_gate(self):
         """HVT_OVERLAP_REDUCTION=0 must fail the overlap expectation —

@@ -1,0 +1,501 @@
+"""ZeRO-1 x accumulation x compression x overlap — the composed path
+(ISSUE 10 acceptance):
+
+* The trajectory-equivalence MATRIX: ``shard_update=True`` x K in {1, 4}
+  x compression in {none, int8} x overlap on/off must equal the dense
+  (replicated-update) control at rel 1e-4 on params AND optimizer state.
+  The bar is reachable because the composition is arithmetic-preserving
+  by construction: non-quantized wires reduce-scatter the very sums the
+  control psums (reassociation only), and quantized wires keep the DENSE
+  bucket layout through the two-shot wire — bitwise the control's
+  reduction — and slice locally (re-cutting buckets to the zero1 layout
+  would change the per-bucket scales, i.e. the numerics).
+* The compiled structure: the composed step's gradient traffic is
+  scatter-form ONLY — reduce-scatters (plus the quantized wire's
+  payload all-to-all), never a full-payload all-reduce — and the
+  overlap peel still empties the accumulation scan.
+* `collectives.flatten_scatter_buckets` really inverts into the
+  per-shard zero1 leaf slices `training/build.py` defines.
+* `collectives.quantized_group_sum` is now the two-shot reduce-scatter +
+  all-gather: equivalent to the PR 7 one-shot gather-sum within one
+  re-quantization quantum, at ~2x payload receive bytes instead of
+  group_size x.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu import checkpoint, compat
+from horovod_tpu.analysis import hlo_audit
+from horovod_tpu.analysis.step_probe import lowered_step_text
+from horovod_tpu.parallel import collectives, mesh as mesh_lib
+from horovod_tpu.training.optimizer import ErrorFeedbackState
+
+
+class Probe(nn.Module):
+    # Dense(32) shards at dp=8 (64, 32 both divide); the Dense(10) bias
+    # does NOT divide — deliberately, so the tail-bucket path (pad +
+    # reduce-scatter + all-gather, replicated mirror) is always exercised.
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        return nn.Dense(10)(nn.relu(nn.Dense(32)(x)))
+
+
+def _data(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 8, 8, 1).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.int32)
+    return x, y
+
+
+def _trainer(k=1, compression="none", zero1=False, overlap=None,
+             bucket_bytes=None, seed=3):
+    tx = hvt.DistributedOptimizer(
+        optax.adam(1e-3), backward_passes_per_step=k,
+        average_aggregated_gradients=True, compression=compression,
+    )
+    return hvt.Trainer(
+        Probe(), tx, seed=seed, shard_update=zero1,
+        overlap_reduction=overlap, bucket_bytes=bucket_bytes,
+    )
+
+
+def _fit(tr, k, steps=3):
+    x, y = _data()
+    tr.fit(x=x, y=y, batch_size=max(1, 8 // k), epochs=1,
+           steps_per_epoch=steps, shuffle_buffer=1, verbose=0)
+    return tr
+
+
+def _assert_state_close(a, b, rtol=1e-4, atol=1e-6):
+    for pa, pb in zip(
+        jax.tree.leaves(jax.device_get(a.state.params)),
+        jax.tree.leaves(jax.device_get(b.state.params)),
+    ):
+        np.testing.assert_allclose(pa, pb, rtol=rtol, atol=atol)
+    for oa, ob in zip(
+        jax.tree.leaves(jax.device_get(a.state.opt_state)),
+        jax.tree.leaves(jax.device_get(b.state.opt_state)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(oa), np.asarray(ob), rtol=rtol, atol=atol
+        )
+
+
+class TestComposedTrajectoryMatrix:
+    """THE acceptance matrix: every composed configuration equals its
+    dense control at rel 1e-4 on params and optimizer state."""
+
+    @pytest.mark.parametrize("k", [1, 4])
+    @pytest.mark.parametrize("compression", ["none", "int8"])
+    def test_composed_equals_dense_control(self, k, compression):
+        dense = _fit(_trainer(k, compression), k)
+        for overlap in (True, False):
+            z = _fit(_trainer(k, compression, zero1=True,
+                              overlap=overlap), k)
+            _assert_state_close(z, dense)
+            # And it really trained sharded: some opt-state mirror
+            # carries the data axis (dp=8 divides every Probe leaf's
+            # dim 0 except the Dense(10) bias).
+            specs = {
+                str(l.sharding.spec)
+                for l in jax.tree.leaves(z.state.opt_state)
+                if hasattr(l, "sharding") and getattr(l, "ndim", 0) > 0
+            }
+            assert any("data" in s for s in specs), specs
+
+    def test_fail_fasts_are_lifted(self):
+        """The three former composition fail-fasts construct and build:
+        shard_update with accumulation, with wire compression, and with
+        the overlap peel (which needs the other two)."""
+        x, _ = _data(16)
+        for tr in (
+            _trainer(4, zero1=True),
+            _trainer(1, "bf16", zero1=True),
+            _trainer(2, "int8", zero1=True, overlap=True),
+        ):
+            tr.build(x[:8])
+
+    def test_param_specs_still_rejected(self):
+        """The TP/FSDP layout family stays out of scope: shard_update
+        composes with accumulation/compression/overlap, not with
+        param_specs (the documented fsdp-axis route)."""
+        from horovod_tpu.models.transformer import param_specs
+
+        with pytest.raises(ValueError, match="fsdp"):
+            hvt.Trainer(
+                Probe(),
+                hvt.DistributedOptimizer(optax.adam(1e-3)),
+                shard_update=True, param_specs=param_specs,
+            )
+
+
+class TestComposedCompiledStructure:
+    """Scatter-form gradient traffic only — the `hvt-audit` invariants,
+    asserted against the real lowered step."""
+
+    def test_k4_step_is_scatter_only(self):
+        x, y = _data()
+        tr = _trainer(4, zero1=True)
+        # dp=8: {k1, b1, k2} scatter-bucket + {b2} tail-bucket -> exactly
+        # two reduce-scatters, zero full-payload all-reduces.
+        hlo_audit.assert_program(
+            lowered_step_text(tr, x, y, 4), "scatters=2"
+        )
+
+    def test_int8_step_is_one_bucketed_scatter_group(self):
+        """The canonical acceptance audit: K=4 + shard_update + int8
+        compiles to exactly ONE bucketed scatter-form reduction per
+        optimizer step (the dense-layout payload all-to-all), wire dtype
+        i8, no full-payload all-reduce."""
+        x, y = _data()
+        tr = _trainer(4, "int8", zero1=True)
+        hlo_audit.assert_program(
+            lowered_step_text(tr, x, y, 4), "scatters=1,wire=int8"
+        )
+
+    def test_bf16_wire_rides_the_reduce_scatter(self):
+        x, y = _data()
+        tr = _trainer(4, "bf16", zero1=True)
+        text = lowered_step_text(tr, x, y, 4)
+        hlo_audit.assert_program(text, "scatter-reduction,wire=bf16")
+        rs = [
+            op for op in hlo_audit.collective_ops(text)
+            if op.kind == "reduce-scatter"
+        ]
+        assert rs and all(op.dtype == "bf16" for op in rs), rs
+
+    def test_overlap_peel_survives_composition(self):
+        """Strictly fewer loop ops with the peel on — the PR 7 witness,
+        now on the ZeRO-1 composed step."""
+        x, y = _data()
+        whiles_on = hlo_audit.while_count(lowered_step_text(
+            _trainer(2, zero1=True, overlap=True), x, y, 2
+        ))
+        whiles_off = hlo_audit.while_count(lowered_step_text(
+            _trainer(2, zero1=True, overlap=False), x, y, 2
+        ))
+        assert whiles_on < whiles_off
+
+    def test_implicit_zero1_path_untouched(self):
+        """K=1 + no compression + shard_update keeps the implicit SPMD
+        step: no explicit collective in the lowered text (XLA places the
+        reduce-scatter at partitioning time, as before this PR)."""
+        x, y = _data()
+        tr = _trainer(1, zero1=True)
+        hlo_audit.assert_program(
+            lowered_step_text(tr, x, y, 1), "no-collectives"
+        )
+
+
+class TestScatterBuckets:
+    """`flatten_scatter_buckets` really is the zero1 layout, bucketed."""
+
+    def _tree(self):
+        rng = np.random.RandomState(0)
+        return {
+            "k1": rng.randn(64, 32).astype(np.float32),
+            "b1": rng.randn(32).astype(np.float32),
+            "k2": rng.randn(32, 10).astype(np.float32),
+            "b2": rng.randn(10).astype(np.float32),
+        }
+
+    @pytest.mark.parametrize("reverse", [False, True])
+    @pytest.mark.parametrize("bucket_bytes", [1 << 20, 512])
+    def test_round_trips_into_per_shard_zero1_slices(
+        self, reverse, bucket_bytes
+    ):
+        dp = 8
+        tree = self._tree()
+        buckets, spec = collectives.flatten_scatter_buckets(
+            tree, dp, bucket_bytes, reverse=reverse
+        )
+        fams = collectives.bucket_families(spec)
+        assert len(fams) == len(buckets)
+        for s in range(dp):
+            local = [
+                b.reshape(dp, -1)[s] if f == "scatter" else b
+                for b, f in zip(buckets, fams)
+            ]
+            got = collectives.unflatten_scatter_buckets(local, spec)
+            for name, leaf in tree.items():
+                sd = collectives.zero1_shard_dim(leaf.shape, dp)
+                if sd is None:
+                    np.testing.assert_array_equal(
+                        np.asarray(got[name]), leaf
+                    )
+                else:
+                    blk = leaf.shape[sd] // dp
+                    want = np.take(
+                        leaf, range(s * blk, (s + 1) * blk), axis=sd
+                    )
+                    np.testing.assert_array_equal(
+                        np.asarray(got[name]), want
+                    )
+
+    def test_every_bucket_is_a_world_multiple(self):
+        buckets, _ = collectives.flatten_scatter_buckets(
+            self._tree(), 8, 512
+        )
+        assert all(b.size % 8 == 0 for b in buckets)
+
+    def test_families_split_by_divisibility(self):
+        _, spec = collectives.flatten_scatter_buckets(self._tree(), 8)
+        fams = {fam for fam, _, _ in spec[5]}
+        assert fams == {"scatter", "tail"}  # b2 (10,) cannot shard at 8
+        # ...but at dp=2 every leaf divides: no tail family at all.
+        _, spec2 = collectives.flatten_scatter_buckets(self._tree(), 2)
+        assert {fam for fam, _, _ in spec2[5]} == {"scatter"}
+
+    def test_shared_rule_with_build(self):
+        """zero1_partition_spec is the layout build_state installs —
+        assert against a really-built trainer."""
+        x, _ = _data(16)
+        tr = _trainer(4, zero1=True)
+        tr.build(x[:8])
+        dp = tr.mesh.shape[mesh_lib.DATA_AXIS]
+        mu = tr.state.opt_state[0].mu  # Adam's param-shaped mirror
+        for leaf, p in zip(
+            jax.tree.leaves(mu), jax.tree.leaves(tr.state.params)
+        ):
+            want = collectives.zero1_partition_spec(p.shape, dp)
+            assert leaf.sharding.spec == want, (p.shape, leaf.sharding)
+
+    @pytest.mark.parametrize("dcn", [2, 4, 8])
+    def test_hierarchical_scatter_matches_flat(self, dcn):
+        """The two-hop scatter (ICI psum_scatter full precision, DCN
+        psum_scatter on the wire) equals the flat scatter for every
+        dcn factoring of the 8-way axis — the target-inner-major
+        arrangement really lands each shard its own zero1 row."""
+        hvt.init()
+        mesh = mesh_lib.data_parallel_mesh()
+        dp = mesh.shape["data"]
+        P = jax.sharding.PartitionSpec
+        tree = self._tree()
+        outspec = {
+            k: (P() if collectives.zero1_shard_dim(v.shape, dp) is None
+                else collectives.zero1_partition_spec(v.shape, dp))
+            for k, v in tree.items()
+        }
+
+        def mk(d, wire=None):
+            def red(g):
+                return collectives.reduce_gradients(
+                    g, data_axis="data", extra_axes=("fsdp",), dcn=d,
+                    wire_dtype=wire, bucket_bytes=1 << 20, scatter=dp,
+                )
+
+            return jax.jit(compat.shard_map(
+                red, mesh=mesh, in_specs=(P(),), out_specs=outspec,
+                check_vma=False,
+            ))
+
+        flat = jax.device_get(mk(1)(tree))
+        hier = jax.device_get(mk(dcn)(tree))
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(hier[k]), np.asarray(flat[k]), rtol=1e-6
+            )
+        # A 16-bit wire rides the DCN hop only: per bucket, one f32
+        # (ICI) and one bf16 (DCN) reduce-scatter.
+        text = mk(dcn, jnp.bfloat16).lower(tree).as_text()
+        rs = [
+            op.dtype for op in hlo_audit.collective_ops(text)
+            if op.kind == "reduce-scatter"
+        ]
+        if dcn < dp:  # dcn == dp has no non-trivial ICI hop
+            assert sorted(set(rs)) == ["bf16", "f32"], rs
+        else:
+            assert set(rs) == {"bf16"}, rs
+
+    def test_mismatched_bucket_list_is_loud(self):
+        buckets, spec = collectives.flatten_scatter_buckets(
+            self._tree(), 8
+        )
+        with pytest.raises(ValueError, match="do not match"):
+            collectives.unflatten_scatter_buckets(buckets[:-1], spec)
+
+
+class TestQuantizedTwoShot:
+    """The replicated quantized wire is now a two-shot reduce-scatter +
+    all-gather (ROADMAP item-2 seam)."""
+
+    def _run(self, fn, v, *extra):
+        hvt.init()
+        mesh = mesh_lib.data_parallel_mesh()
+        P = jax.sharding.PartitionSpec
+        sharded = P(("data", "fsdp"))
+        f = jax.jit(compat.shard_map(
+            fn, mesh=mesh,
+            in_specs=(sharded,) * (1 + len(extra)),
+            out_specs=(sharded, sharded),
+            check_vma=False,
+        ))
+        return jax.device_get(f(v, *extra))
+
+    def test_equivalent_to_gather_sum_within_one_quantum(self):
+        """Shot 2 re-quantizes the REDUCED chunk, so the two-shot total
+        may differ from the one-shot gather-sum by that single
+        re-quantization — bounded by one quantum of the reduced value's
+        scale, never compounding (error feedback charges it to the
+        chunk's owner)."""
+        rng = np.random.RandomState(1)
+        v = jnp.asarray(rng.randn(8, 256).astype(np.float32))
+
+        def two(x):
+            return collectives.quantized_group_sum(
+                x, ("data", "fsdp"), jnp.int8
+            )
+
+        def one(x):
+            return collectives._quantized_gather_sum(
+                x, ("data", "fsdp"), jnp.int8
+            )
+
+        t2, e2 = self._run(two, v)
+        t1, e1 = self._run(one, v)
+        true = np.asarray(v).sum(axis=0)
+        quantum = float(np.abs(true).max()) / 127.0
+        np.testing.assert_array_less(np.abs(t2 - t1), quantum + 1e-5)
+        # Both are honest reductions of the same sum.
+        np.testing.assert_allclose(t2[0], true, atol=8 * quantum)
+
+    def test_error_mass_identity_holds(self):
+        """Summed over shards, the returned errors equal exactly
+        (true sum - delivered sum) — the telescoping precondition, now
+        including the shot-2 error charged to each chunk's owner."""
+        rng = np.random.RandomState(2)
+        v = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+
+        def two(x):
+            return collectives.quantized_group_sum(
+                x, ("data", "fsdp"), jnp.int8
+            )
+
+        total, err = self._run(two, v)
+        true = np.asarray(v).sum(axis=0)
+        np.testing.assert_allclose(
+            err.sum(axis=0), true - total[0], rtol=1e-4, atol=1e-5
+        )
+
+    def test_receive_bytes_drop_from_world_to_two(self):
+        """Structural: the two-shot wire's per-device payload receive
+        bytes are ~2x the bucket (one all-to-all + one all-gather of
+        1/world chunks), vs the one-shot's world x (a full [world, n]
+        payload gather). Counted from the lowered programs."""
+        hvt.init()
+        mesh = mesh_lib.data_parallel_mesh()
+        world = mesh.shape["data"]
+        P = jax.sharding.PartitionSpec
+        v = jnp.ones((world, 1024), jnp.float32)
+
+        def lower(fn):
+            f = jax.jit(compat.shard_map(
+                lambda x: fn(x)[0], mesh=mesh,
+                in_specs=(P(("data", "fsdp")),),
+                out_specs=P(("data", "fsdp")), check_vma=False,
+            ))
+            return f.lower(v).as_text()
+
+        def payload_bytes(text):
+            return sum(
+                hlo_audit.op_bytes(op)
+                for op in hlo_audit.collective_ops(text)
+                if op.dtype == "i8"
+            )
+
+        two = payload_bytes(lower(
+            lambda x: collectives.quantized_group_sum(
+                x, ("data", "fsdp"), jnp.int8
+            )
+        ))
+        one = payload_bytes(lower(
+            lambda x: collectives._quantized_gather_sum(
+                x, ("data", "fsdp"), jnp.int8
+            )
+        ))
+        n = 1024  # per-shard bucket bytes (i8)
+        assert one >= world * n  # the gather-sum's full payload gather
+        assert two <= 3 * n      # all-to-all (n) + chunk gather (n)
+        assert two < one / 2
+
+    def test_groups_need_explicit_position(self):
+        with pytest.raises(ValueError, match="group_position"):
+            collectives.quantized_group_sum(
+                jnp.ones(8), "data", jnp.int8,
+                axis_index_groups=[[0, 1], [2, 3]],
+            )
+
+
+class TestComposedStateSurfaces:
+    """EF residuals and checkpoints ride the scattered layout."""
+
+    def _trained(self):
+        tr = _trainer(2, "int8", zero1=True)
+        return _fit(tr, 2, steps=2)
+
+    def test_residual_lives_sharded_in_zero1_opt_state(self):
+        tr = self._trained()
+        assert isinstance(tr.state.opt_state, ErrorFeedbackState)
+        dp = tr.dp_size
+        for leaf, p in zip(
+            jax.tree.leaves(tr.state.opt_state.ef_residual),
+            jax.tree.leaves(tr.state.params),
+        ):
+            assert leaf.shape == (dp,) + p.shape
+            # dim-0 sharded over the data axes, never dense-replicated.
+            assert "data" in str(leaf.sharding.spec)
+        # The inner (Adam) mirrors carry the zero1 layout.
+        mu = tr.state.opt_state.inner[0].mu
+        assert any(
+            "data" in str(l.sharding.spec) for l in jax.tree.leaves(mu)
+        )
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        tr = self._trained()
+        path = str(tmp_path / "state.msgpack")
+        checkpoint.save(path, tr.state)
+        tr2 = _trainer(2, "int8", zero1=True)
+        x, y = _data(16)
+        tr2.build(x[:8], y[:8])
+        restored = checkpoint.restore(path, tr2.state)
+        for a, b in zip(
+            jax.tree.leaves(jax.device_get(tr.state.opt_state)),
+            jax.tree.leaves(jax.device_get(restored.opt_state)),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_install_state_reshard_recuts_residual(self):
+        """A committed snapshot from a 2-shard world installs onto the
+        8-shard composed trainer: the EF residual re-cuts
+        mass-conserving, the zero1 mirrors re-slice."""
+        tr = self._trained()
+        snap = jax.device_get(tr.state)
+        old = jax.tree.map(
+            lambda p: np.stack([
+                np.full(p.shape, 1.0, np.float32),
+                np.full(p.shape, 3.0, np.float32),
+            ]),
+            jax.device_get(tr.state.params),
+        )
+        snap = snap.replace(
+            opt_state=snap.opt_state.replace(ef_residual=old)
+        )
+        installed = tr.install_state(snap)
+        for leaf in jax.tree.leaves(
+            jax.device_get(installed.opt_state.ef_residual)
+        ):
+            np.testing.assert_allclose(leaf.sum(axis=0), 4.0, rtol=1e-6)
+
+    def test_device_cached_path_composes(self):
+        x, y = _data(512)
+        tr = _trainer(2, "int8", zero1=True)
+        hist = tr.fit(x=x, y=y, batch_size=2, epochs=3, cache="device",
+                      verbose=0)
+        assert hist[-1]["loss"] < hist[0]["loss"]
