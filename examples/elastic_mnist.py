@@ -103,6 +103,10 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
     # reduction; int8/fp8 error-feedback residuals live in opt_state, so
     # elastic commit/sync and the reshard re-cut carry them unchanged.
     compression = registry.get_str("HVT_COMPRESSION") or "none"
+    # HVT_COMPRESSION_ICI: the two-hop reduction's ICI-hop wire (inert
+    # on single-slice meshes); its error feedback rides opt_state like
+    # HVT_COMPRESSION's.
+    compression_ici = registry.get_str("HVT_COMPRESSION_ICI") or "none"
     trainer = hvt.Trainer(
         MnistCNN(),
         # lr = 0.001 × size: rebuilt each generation, so the effective LR
@@ -112,6 +116,7 @@ def train(state: "elastic.ElasticState", world: "elastic.WorldInfo") -> None:
             optax.adam(hvt.scale_lr(0.001)),
             backward_passes_per_step=backward_passes,
             compression=compression,
+            compression_ici=compression_ici,
         ),
         loss="sparse_categorical_crossentropy",
         # ZeRO-1: optimizer state sharded over the data axis — with one

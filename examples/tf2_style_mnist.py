@@ -83,6 +83,9 @@ def main() -> None:
     # boundary reduction (int8/fp8 carry error-feedback residuals in the
     # optimizer state — they ride the checkpoints below for free).
     compression = registry.get_str("HVT_COMPRESSION") or "none"
+    # HVT_COMPRESSION_ICI: wire for the two-hop reduction's ICI hop
+    # (inert on single-slice meshes, where dcn == 1).
+    compression_ici = registry.get_str("HVT_COMPRESSION_ICI") or "none"
     trainer = hvt.Trainer(
         MnistCNN(compute_dtype=jnp.bfloat16),
         # Adam(0.001 × size) (:55) wrapped for gradient averaging (:58).
@@ -90,6 +93,7 @@ def main() -> None:
             optax.adam(hvt.scale_lr(0.001)),
             backward_passes_per_step=backward_passes,
             compression=compression,
+            compression_ici=compression_ici,
         ),
         loss="sparse_categorical_crossentropy",  # :63
     )

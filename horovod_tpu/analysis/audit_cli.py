@@ -45,32 +45,58 @@ from horovod_tpu.analysis import hlo_audit
 
 
 def _default_expect(k: int, compression: str, bucket_bytes,
-                    zero1: bool = False) -> str:
+                    zero1: bool = False, compression_ici: str = "none",
+                    dcn=None) -> str:
     compressed = compression.lower() not in ("", "none")
-    if zero1 and (k > 1 or compressed):
+    quantized = compression.lower() in ("int8", "fp8")
+    ici_set = (compression_ici or "none").lower() not in ("", "none")
+    # Under a real dcn factoring (--dcn > 1) exact counts and wire
+    # dtypes are not derivable: the hierarchical reduction legitimately
+    # adds per-hop ops — the dense layout's ICI hop is a FULL-PRECISION
+    # all-reduce (off-wire by design, and full-payload, which the
+    # scatter-mode shape forbids), a quantized ICI hop adds a payload
+    # all-to-all per bucket — so the derivation degrades: shape-only
+    # for the scatter layout, nothing for the rest (pass --expect).
+    two_hop = dcn is not None and dcn > 1
+    if zero1 and (k > 1 or compressed or ici_set):
+        if quantized and two_hop:
+            # Dense (quantized) layout over the factoring: the ICI hop's
+            # full-precision all-reduce makes every scatter-mode token
+            # unsatisfiable by design.
+            return ""
         # The composed ZeRO-1 step: scatter-form reductions only, no
-        # full-payload all-reduce. Quantized wires keep the dense bucket
-        # layout (one bucket at the default fusion threshold -> exactly
-        # one scatter group op); the non-quantized scatter layout's
-        # bucket count depends on the device count (which leaves divide),
-        # so only the shape is pinned by default. String-compared, not
-        # imported: this runs before the jax env shaping.
+        # full-payload all-reduce. At the default fusion threshold the
+        # probe's single-dtype gradient tree packs into exactly ONE
+        # bucket on both layouts — the scatter layout merges the
+        # tail-family (non-divisible) leaves onto the same bucket and
+        # all-gathers just their columns back, and the quantized dense
+        # layout runs one two-shot group — so the derived count is
+        # scatters=1; a custom bucket_bytes (or a quantized ICI hop)
+        # changes the count, so only the shape is pinned then.
+        # String-compared, not imported: this runs before the jax env
+        # shaping.
         tokens = []
-        quantized = compression.lower() in ("int8", "fp8")
-        if quantized and bucket_bytes is None:
+        if bucket_bytes is None and not two_hop:
+            # Any two-hop factoring changes the per-bucket op count
+            # (ICI-hop reduce-scatter or payload all-to-all next to the
+            # DCN hop) — shape-only there.
             tokens.append("scatters=1")
         else:
             tokens.append("scatter-reduction")
-        if compressed:
+        if compressed and not two_hop:
             tokens.append(f"wire={compression}")
         return ",".join(tokens)
     tokens = []
     if compressed:
+        if two_hop:
+            return ""  # per-hop ops; the ICI hop is off-wire by design
         if bucket_bytes is None:
             tokens.append("one-reduction")
         tokens.append(f"wire={compression}")
-    elif k > 1:
-        if bucket_bytes is None:
+    elif k > 1 or ici_set:
+        # An ICI wire alone forces the explicit-collective step too
+        # (Trainer._explicit_step), so no-collectives would be wrong.
+        if bucket_bytes is None and not two_hop:
             tokens.append("one-reduction")
     else:
         tokens.append("no-collectives")
@@ -82,9 +108,17 @@ def _run_step(args) -> int:
     expect_spec = args.expect
     if expect_spec is None:
         expect_spec = _default_expect(
-            args.k, args.compression, args.bucket_bytes, args.zero1
+            args.k, args.compression, args.bucket_bytes, args.zero1,
+            args.compression_ici, args.dcn,
         )
-        print(f"hvt-audit: derived --expect {expect_spec}")
+        if expect_spec:
+            print(f"hvt-audit: derived --expect {expect_spec}")
+        else:
+            print(
+                "hvt-audit: no expectation derivable for this config "
+                "(hierarchical per-hop ops are factoring-dependent) — "
+                "pass --expect to pin invariants"
+            )
     want_overlap = False
     tokens = []
     for token in expect_spec.split(","):
@@ -100,6 +134,11 @@ def _run_step(args) -> int:
         os.environ["HVT_PLATFORM"] = args.platform
         if args.platform == "cpu" and args.devices:
             os.environ["HVT_NUM_CPU_DEVICES"] = str(args.devices)
+    if args.dcn:
+        # Fake the multi-slice factoring so the two-hop reduction (and
+        # the --compression-ici wire that rides its ICI hop) is what
+        # lowers — the HVT_DCN_FACTOR contract.
+        os.environ["HVT_DCN_FACTOR"] = str(args.dcn)
 
     import horovod_tpu as hvt
     from horovod_tpu.analysis import step_probe
@@ -108,8 +147,8 @@ def _run_step(args) -> int:
 
     x, y = step_probe.probe_data()
     trainer = step_probe.build_trainer(
-        args.k, args.compression, overlap=overlap,
-        bucket_bytes=args.bucket_bytes, zero1=args.zero1,
+        args.k, args.compression, compression_ici=args.compression_ici,
+        overlap=overlap, bucket_bytes=args.bucket_bytes, zero1=args.zero1,
     )
     text = step_probe.lowered_step_text(trainer, x, y, args.k)
     if args.dump:
@@ -128,19 +167,29 @@ def _run_step(args) -> int:
                 "accumulation scan"
             )
         else:
-            # The K=2 structural witness: peel empties the scan.
-            on = hlo_audit.while_count(step_probe.lowered_step_text(
+            # The K=2 structural witness: peel empties the scan. With
+            # --zero1 the SAME two programs must also carry an unchanged
+            # scatter-form reduction count — the peel moves the
+            # scatter-family buckets INTO the schedulable region, it
+            # must not change how many there are (a drifted count would
+            # mean the peel re-bucketed the reduction rather than
+            # re-scheduling it).
+            on_text = step_probe.lowered_step_text(
                 step_probe.build_trainer(
-                    2, args.compression, overlap=True,
+                    2, args.compression,
+                    compression_ici=args.compression_ici, overlap=True,
                     bucket_bytes=args.bucket_bytes, zero1=args.zero1,
                 ), x, y, 2,
-            ))
-            off = hlo_audit.while_count(step_probe.lowered_step_text(
+            )
+            off_text = step_probe.lowered_step_text(
                 step_probe.build_trainer(
-                    2, args.compression, overlap=False,
+                    2, args.compression,
+                    compression_ici=args.compression_ici, overlap=False,
                     bucket_bytes=args.bucket_bytes, zero1=args.zero1,
                 ), x, y, 2,
-            ))
+            )
+            on = hlo_audit.while_count(on_text)
+            off = hlo_audit.while_count(off_text)
             if not on < off:
                 violations.append(
                     "overlap peel is structurally ABSENT: the K=2 "
@@ -149,11 +198,26 @@ def _run_step(args) -> int:
                     "peeled out of the accumulation scan, so bucket "
                     "reductions cannot overlap its backward"
                 )
+            if args.zero1:
+                s_on = len(hlo_audit.scatter_reductions(on_text))
+                s_off = len(hlo_audit.scatter_reductions(off_text))
+                if s_on != s_off:
+                    violations.append(
+                        "overlap peel changed the scatter-form reduction "
+                        f"count ({s_on} overlapped vs {s_off} serialized) "
+                        "— the peel must move the buckets into the "
+                        "schedulable region, not re-bucket the reduction"
+                    )
 
     grads = hlo_audit.gradient_reductions(text)
     config = (
         f"k={args.k} compression={args.compression} "
         f"overlap={'on' if trainer._overlap else 'off'}"
+        + (
+            f" ici={args.compression_ici}"
+            if args.compression_ici.lower() not in ("", "none") else ""
+        )
+        + (f" dcn={args.dcn}" if args.dcn else "")
         + (" zero1" if args.zero1 else "")
     )
     if violations:
@@ -209,6 +273,14 @@ def main(argv: list[str] | None = None) -> int:
     step.add_argument("--compression", default=None,
                       help="gradient wire: none/bf16/fp16/int8/fp8 "
                       "(default: HVT_COMPRESSION, else none)")
+    step.add_argument("--compression-ici", default=None,
+                      help="ICI-hop wire for the two-hop reduction "
+                      "(default: HVT_COMPRESSION_ICI, else none); "
+                      "audit-visible only with --dcn > 1")
+    step.add_argument("--dcn", type=int, default=None,
+                      help="fake multi-slice factor (sets HVT_DCN_FACTOR "
+                      "before init) so the hierarchical two-hop reduction "
+                      "is what lowers")
     step.add_argument("--bucket-bytes", type=int, default=None)
     step.add_argument("--zero1", action="store_true",
                       help="audit the composed ZeRO-1 step "
@@ -244,11 +316,16 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     try:
         if args.cmd == "step":
-            # Registry-declared default for the wire.
-            if args.compression is None:
+            # Registry-declared defaults for the wires.
+            if args.compression is None or args.compression_ici is None:
                 from horovod_tpu.analysis import registry
 
-                args.compression = registry.get_str("HVT_COMPRESSION")
+                if args.compression is None:
+                    args.compression = registry.get_str("HVT_COMPRESSION")
+                if args.compression_ici is None:
+                    args.compression_ici = registry.get_str(
+                        "HVT_COMPRESSION_ICI"
+                    )
             return _run_step(args)
         return _run_file(args)
     except ValueError as e:

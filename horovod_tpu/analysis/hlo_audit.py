@@ -28,7 +28,14 @@ The ZeRO-1 composed step (PR 10) adds the SCATTER-form discrimination
 all-to-alls — the quantized wire's reduce-scatter hop is an all-to-all
 with receiver-side f32 summation — with the `scatter-reduction` /
 `scatters=N` expectation asserting no full-payload all-reduce survives
-anywhere in the program.
+anywhere in the program. Since the per-bucket overlapped schedule
+(PR 12) the scatter buckets are leaf-aligned and issue bucket-by-bucket
+inside the peeled backward, with the tail-family (non-divisible) leaves
+merged onto the same buckets — `scatters=N` therefore counts exactly
+the bucket count (N == 1 for the canonical probe at the default fusion
+threshold), and the small rank-1 all-gather returning the tail columns
+is deliberately outside every count (it is the second shot of the
+tail's two-shot all-reduce, not a reduction).
 
 Deliberately stdlib-only (`re`/`dataclasses`): the lint/audit CLIs and
 the earliest CI hooks import this without jax. Only `step_probe` (which

@@ -174,6 +174,13 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_PROFILE", "path", None, "observability",
          "Capture a jax.profiler trace of fit()/bench into this dir — the "
          "HOROVOD_TIMELINE contract, primary-process-gated."),
+    Knob("HVT_PEAK_FLOPS", "float", None, "observability",
+         "Per-chip peak FLOP/s override for the MFU denominator — set it "
+         "when the device kind is missing from the built-in peak table "
+         "(CPU CI topologies, new TPU generations) so every BENCH_* row "
+         "carries a real MFU trend number instead of null; bench.py "
+         "calibrates a matmul-peak fallback when unset on an unknown "
+         "device, and exits 2 on an unparseable override."),
     Knob("HVT_METRICS_DIR", "path", None, "observability",
          "Metrics-stream directory (default: $PS_MODEL_PATH, else "
          "./models)."),
@@ -196,6 +203,12 @@ KNOBS: dict[str, Knob] = _decl([
          "Gradient wire compression for the example/bench entry scripts "
          "(none/bf16/fp16/int8/fp8 — DistributedOptimizer(compression=); "
          "int8/fp8 carry error-feedback residuals by default)."),
+    Knob("HVT_COMPRESSION_ICI", "str", "none", "examples",
+         "ICI-hop gradient wire for the example/bench entry scripts "
+         "(none/bf16/fp16/int8/fp8 — DistributedOptimizer("
+         "compression_ici=): the hierarchical two-hop reduction's "
+         "intra-slice hop, error-feedback-charged per hop for int8/fp8; "
+         "inert on single-slice meshes where dcn == 1)."),
     Knob("HVT_DEVICE_CACHE", "flag", False, "examples",
          "Examples: stage the dataset into HBM once (`cache='device'`)."),
     Knob("HVT_EXPORT_FORMAT", "str", "stablehlo", "examples",

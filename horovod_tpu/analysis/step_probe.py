@@ -51,15 +51,18 @@ def probe_data(n: int = 64, seed: int = 0):
 
 
 def build_trainer(k: int = 1, compression: str = "none", *,
+                  compression_ici: str = "none",
                   overlap=None, bucket_bytes=None, bucket_order=None,
                   error_feedback: bool = True, model=None, seed: int = 3,
                   zero1: bool = False):
     """A `Trainer` wired exactly like the perf-path tests wire theirs:
-    accumulation factor ``k``, wire ``compression``, optional
-    overlap/bucket knob overrides (None = the env-driven defaults).
-    ``zero1`` turns on the sharded weight update
-    (``Trainer(shard_update=True)``) — the composed ZeRO-1 x
-    accumulation x compression step `hvt-audit step --zero1` gates."""
+    accumulation factor ``k``, wire ``compression`` (plus the ICI-hop
+    ``compression_ici``, audit-relevant only under a dcn > 1 factoring
+    — set HVT_DCN_FACTOR to fake one), optional overlap/bucket knob
+    overrides (None = the env-driven defaults). ``zero1`` turns on the
+    sharded weight update (``Trainer(shard_update=True)``) — the
+    composed ZeRO-1 x accumulation x compression step
+    `hvt-audit step --zero1` gates."""
     import optax
 
     import horovod_tpu as hvt
@@ -67,7 +70,7 @@ def build_trainer(k: int = 1, compression: str = "none", *,
     tx = hvt.DistributedOptimizer(
         optax.adam(1e-3), backward_passes_per_step=k,
         average_aggregated_gradients=True, compression=compression,
-        error_feedback=error_feedback,
+        compression_ici=compression_ici, error_feedback=error_feedback,
     )
     return hvt.Trainer(
         model if model is not None else probe_model(), tx, seed=seed,

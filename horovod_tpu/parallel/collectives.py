@@ -436,9 +436,24 @@ def unflatten_buckets(buckets, spec) -> PyTree:
 # (`flatten_scatter_buckets`), so one `lax.psum_scatter` hands every shard
 # precisely the gradient slice its optimizer shard consumes — ~half the
 # wire bytes of reduce-then-slice. Leaves with NO dp-divisible dimension
-# (odd biases, scalars) ride separate "tail" buckets, reduced as a
-# two-shot reduce-scatter + all-gather (never a full-payload all-reduce)
-# and returned replicated, matching their replicated opt-state mirrors.
+# (odd biases, scalars) are padded to a dp multiple and ride the SAME
+# buckets as everyone else ("tail" pieces): the one reduce-scatter covers
+# them too, and their full (replicated-mirror) values come back through a
+# small all-gather of just their columns — a two-shot all-reduce, never a
+# full-payload all-reduce op.
+#
+# Per-bucket schedulability (ISSUE 12 — the overlap-cash-in): each bucket
+# is assembled ONLY from the leaf pieces it carries (leaf-aligned
+# concatenation, never a slice of a whole-tree concat) and each leaf is
+# reassembled ONLY from the buckets that carry it. The dataflow therefore
+# has no cross-bucket dependency in either direction: inside the peeled
+# backward's straight-line region, bucket i's `psum_scatter` can issue as
+# soon as its leaves' gradients are final (reverse bucket order =
+# last-produced-grads-first), and shard s's optimizer apply for bucket
+# i's leaves can start as soon as bucket i lands — while bucket j's
+# transfer is still in flight. The cut points are IDENTICAL to a
+# concat-then-split at `bucket_bytes` (same bucket count, same values,
+# bitwise), so the restructure changes schedulability, not arithmetic.
 
 
 def zero1_shard_dim(shape, dp: int):
@@ -476,14 +491,20 @@ def flatten_scatter_buckets(tree: PyTree, dp: int,
     """Pack a pytree into scatter-ready dtype-homogeneous 1-D buckets.
 
     Leaves with a dp-divisible dim ("scatter" family) contribute their
-    `zero1_shard_dim`-major [dp, size/dp] block matrix; leaves without one
-    ("tail" family) are raveled, zero-padded to a dp multiple and reshaped
-    likewise. Per (family, dtype) group the blocks concatenate into a
-    [dp, B] matrix, cut along columns into chunks of at most
-    ``bucket_bytes``, each raveled row-major — so a tiled
-    ``psum_scatter`` over the data axis hands shard s row s: its exact
-    zero1 slice of every scatter-family leaf. Returns ``(buckets, spec)``
-    for `unflatten_scatter_buckets` / `bucket_families`."""
+    `zero1_shard_dim`-major [dp, size/dp] block matrix; leaves without
+    one ("tail" family) are raveled, zero-padded to a dp multiple and
+    reshaped likewise — both families share the SAME buckets, so ONE
+    tiled ``psum_scatter`` per bucket covers every leaf (tail leaves'
+    full values come back through a small all-gather of their columns
+    only; see `bucket_tail_spans`). Per dtype the [dp, cols] leaf
+    matrices pack greedily into buckets of at most ``bucket_bytes``
+    (cut points at exact ``bucket_bytes`` column multiples — identical
+    to a concat-then-split), but each bucket is ASSEMBLED only from the
+    leaf pieces it carries: the dataflow carries no cross-bucket
+    dependency, so bucket i's collective can issue the moment its
+    leaves' gradients are final while earlier leaves are still in the
+    backward. Returns ``(buckets, spec)``; the spec records, per
+    bucket, the ordered ``(leaf_index, column_width)`` pieces."""
     if bucket_bytes is None:
         bucket_bytes = DEFAULT_BUCKET_BYTES
     bucket_bytes = int(bucket_bytes)
@@ -496,90 +517,213 @@ def flatten_scatter_buckets(tree: PyTree, dp: int,
     shapes = [jnp.shape(l) for l in leaves]
     dtypes = [jnp.result_type(l) for l in leaves]
     sdims = [zero1_shard_dim(s, dp) for s in shapes]
-    by_key: dict = {}  # (family, dtype) -> leaf indices, order-preserving
+    by_dtype: dict = {}  # dtype -> leaf indices, order-preserving
     order = range(len(dtypes) - 1, -1, -1) if reverse else range(len(dtypes))
     for i in order:
-        fam = "scatter" if sdims[i] is not None else "tail"
-        by_key.setdefault((fam, jnp.dtype(dtypes[i])), []).append(i)
-    buckets, groups = [], []
-    for (fam, dt), idxs in by_key.items():
-        mats = []
+        by_dtype.setdefault(jnp.dtype(dtypes[i]), []).append(i)
+    buckets: list = []
+    descs: list = []  # per bucket: tuple of (leaf_index, column_width)
+    for dt, idxs in by_dtype.items():
+        per = max(1, bucket_bytes // (dp * dt.itemsize))  # columns/bucket
+        pieces: list = []
+        pdesc: list = []
+        cols = 0
+
+        def close(dt=dt):
+            nonlocal pieces, pdesc, cols
+            if pieces:
+                mat = (
+                    pieces[0] if len(pieces) == 1
+                    else jnp.concatenate(pieces, axis=1)
+                )
+            else:  # zero-width leaves only
+                mat = jnp.zeros((dp, 0), dt)
+            buckets.append(jnp.ravel(mat))
+            descs.append(tuple(pdesc))
+            pieces, pdesc, cols = [], [], 0
+
         for i in idxs:
             a = jnp.asarray(leaves[i], dtype=dt)
-            if fam == "scatter":
-                a = jnp.moveaxis(a, sdims[i], 0)
-                mats.append(a.reshape(dp, -1))
+            if sdims[i] is not None:
+                m = jnp.moveaxis(a, sdims[i], 0).reshape(dp, -1)
             else:
                 v = jnp.ravel(a)
                 pad = (-v.size) % dp
                 if pad:
                     v = jnp.concatenate([v, jnp.zeros((pad,), dt)])
-                mats.append(v.reshape(dp, -1))
-        mat = mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=1)
-        per = max(1, bucket_bytes // (dp * dt.itemsize))  # columns/bucket
-        cuts = list(range(per, mat.shape[1], per))
-        chunks = jnp.split(mat, cuts, axis=1) if cuts else [mat]
-        buckets.extend(jnp.ravel(c) for c in chunks)
-        groups.append((fam, tuple(idxs), tuple(c.shape[1] for c in chunks)))
+                m = v.reshape(dp, -1)
+            w = m.shape[1]
+            if w == 0:
+                pdesc.append((i, 0))
+                continue
+            off = 0
+            while off < w:
+                take = min(per - cols, w - off)
+                pieces.append(
+                    m if (off == 0 and take == w) else m[:, off: off + take]
+                )
+                pdesc.append((i, take))
+                cols += take
+                off += take
+                if cols == per:
+                    close()
+        if pieces or pdesc:
+            close()
     spec = (
         treedef, tuple(shapes), tuple(dtypes), tuple(sdims), dp,
-        tuple(groups),
+        tuple(descs),
     )
     return buckets, spec
 
 
 def bucket_families(spec) -> list:
-    """Per-bucket family tags ('scatter' | 'tail') for a
-    `flatten_scatter_buckets` spec, in bucket order."""
+    """Per-bucket family tags for a `flatten_scatter_buckets` spec, in
+    bucket order: 'scatter' (every piece has a dp-divisible dim), 'tail'
+    (none does), or 'mixed' (both ride the bucket)."""
+    sdims = spec[3]
     fams = []
-    for fam, _idxs, widths in spec[5]:
-        fams.extend([fam] * len(widths))
+    for pieces in spec[5]:
+        kinds = {
+            "scatter" if sdims[i] is not None else "tail"
+            for i, _w in pieces
+        }
+        fams.append(kinds.pop() if len(kinds) == 1 else
+                    ("mixed" if kinds or len(pieces) else "scatter"))
     return fams
 
 
-def unflatten_scatter_buckets(buckets, spec) -> PyTree:
-    """Inverse of `flatten_scatter_buckets` AFTER a scatter reduction:
-    scatter-family bucket entries are this shard's LOCAL row ([cols]),
-    tail-family entries the FULL reassembled bucket ([dp*cols]). Scatter
-    leaves come back as the local zero1 block (shard dim divided by dp);
-    tail leaves come back whole. Dtypes are restored per leaf."""
+def bucket_tail_spans(spec) -> list:
+    """Per bucket, the ordered ``(column_start, width)`` spans holding
+    tail-family pieces (leaves with no dp-divisible dim) — the columns
+    whose reduced rows must be all-gathered back to full values for the
+    replicated optimizer mirrors. Empty tuple = pure-scatter bucket."""
+    sdims = spec[3]
+    out = []
+    for pieces in spec[5]:
+        col, spans = 0, []
+        for i, w in pieces:
+            if sdims[i] is None and w:
+                spans.append((col, w))
+            col += w
+        out.append(tuple(spans))
+    return out
+
+
+def unflatten_scatter_buckets(entries, spec) -> PyTree:
+    """Inverse of `flatten_scatter_buckets` AFTER a scatter reduction.
+
+    Per bucket the entry is this shard's LOCAL reduced row (``[cols]``);
+    a bucket carrying tail-family pieces takes a ``(local_row,
+    gathered)`` pair, where ``gathered`` is the row-major ravel of the
+    bucket's tail columns all-gathered back to ``[dp, tail_cols]``
+    (`bucket_tail_spans` gives the spans, in the same order). Scatter
+    leaves come back as the local zero1 block (shard dim divided by
+    dp); tail leaves come back whole (padding stripped). Dtypes are
+    restored per leaf. Each leaf is assembled ONLY from the buckets
+    that carry it — the per-bucket schedulability contract's consumer
+    side."""
     import math as _math
 
-    treedef, shapes, dtypes, sdims, dp, groups = spec
-    expected = sum(len(widths) for _, _, widths in groups)
-    if expected != len(buckets):
+    treedef, shapes, dtypes, sdims, dp, descs = spec
+    if len(entries) != len(descs):
         raise ValueError(
-            f"unflatten_scatter_buckets got {len(buckets)} buckets for a "
-            f"spec describing {expected} — bucket list and spec do not "
+            f"unflatten_scatter_buckets got {len(entries)} buckets for a "
+            f"spec describing {len(descs)} — bucket list and spec do not "
             "match"
         )
-    leaves: list = [None] * len(shapes)
-    pos = 0
-    for fam, idxs, widths in groups:
-        chunks = buckets[pos: pos + len(widths)]
-        pos += len(widths)
-        if fam == "scatter":
-            vec = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
-            off = 0
-            for i in idxs:
-                sd = sdims[i]
-                rest = tuple(shapes[i][:sd]) + tuple(shapes[i][sd + 1:])
-                blk = shapes[i][sd] // dp
-                n = blk * int(_math.prod(rest))
-                moved = vec[off: off + n].reshape((blk,) + rest)
-                leaves[i] = jnp.moveaxis(moved, 0, sd).astype(dtypes[i])
-                off += n
+    parts: list[list] = [[] for _ in shapes]
+    for entry, pieces in zip(entries, descs):
+        if isinstance(entry, (tuple, list)):
+            row, gathered = entry
         else:
-            mat = jnp.concatenate(
-                [c.reshape(dp, -1) for c in chunks], axis=1
-            )
-            off = 0
-            for i in idxs:
-                n = int(_math.prod(shapes[i]))
-                per = -(-n // dp)
-                flat = jnp.ravel(mat[:, off: off + per])[:n]
-                leaves[i] = flat.reshape(shapes[i]).astype(dtypes[i])
-                off += per
+            row, gathered = entry, None
+        tail_cols = sum(w for i, w in pieces if sdims[i] is None)
+        gm = None
+        if tail_cols:
+            if gathered is None:
+                raise ValueError(
+                    "bucket carries tail-family pieces but its entry is a "
+                    "bare local row — pass (local_row, gathered_tails); "
+                    "see bucket_tail_spans"
+                )
+            gm = jnp.reshape(gathered, (dp, tail_cols))
+        col = tcol = 0
+        for i, w in pieces:
+            if w == 0:
+                continue
+            if sdims[i] is None:
+                parts[i].append(gm[:, tcol: tcol + w])
+                tcol += w
+            else:
+                parts[i].append(row[col: col + w])
+            col += w
+    leaves: list = [None] * len(shapes)
+    for i, segs in enumerate(parts):
+        if sdims[i] is not None:
+            sd = sdims[i]
+            rest = tuple(shapes[i][:sd]) + tuple(shapes[i][sd + 1:])
+            blk = shapes[i][sd] // dp
+            if not segs:
+                vec = jnp.zeros((0,), dtypes[i])
+            else:
+                vec = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+            moved = vec.reshape((blk,) + rest)
+            leaves[i] = jnp.moveaxis(moved, 0, sd).astype(dtypes[i])
+        else:
+            n = int(_math.prod(shapes[i]))
+            if not segs:
+                flat = jnp.zeros((n,), dtypes[i])
+            else:
+                mat = (
+                    segs[0] if len(segs) == 1
+                    else jnp.concatenate(segs, axis=1)
+                )
+                flat = jnp.ravel(mat)[:n]
+            leaves[i] = flat.reshape(shapes[i]).astype(dtypes[i])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def unflatten_scatter_full(buckets, spec) -> PyTree:
+    """Inverse of `flatten_scatter_buckets` from FULL (un-scattered)
+    ``[dp * cols]`` buckets — the error-feedback residual path, where
+    each shard keeps its own full-bucket quantization remainder. Scatter
+    leaves un-moveaxis back to their original shape; tail leaves strip
+    their padding."""
+    import math as _math
+
+    treedef, shapes, dtypes, sdims, dp, descs = spec
+    if len(buckets) != len(descs):
+        raise ValueError(
+            f"unflatten_scatter_full got {len(buckets)} buckets for a "
+            f"spec describing {len(descs)} — bucket list and spec do not "
+            "match"
+        )
+    parts: list[list] = [[] for _ in shapes]
+    for b, pieces in zip(buckets, descs):
+        cols = sum(w for _i, w in pieces)
+        m = jnp.reshape(b, (dp, cols))
+        col = 0
+        for i, w in pieces:
+            if w == 0:
+                continue
+            parts[i].append(m[:, col: col + w])
+            col += w
+    leaves: list = [None] * len(shapes)
+    for i, segs in enumerate(parts):
+        if not segs:
+            leaves[i] = jnp.zeros(shapes[i], dtypes[i])
+            continue
+        mat = segs[0] if len(segs) == 1 else jnp.concatenate(segs, axis=1)
+        if sdims[i] is not None:
+            sd = sdims[i]
+            rest = tuple(shapes[i][:sd]) + tuple(shapes[i][sd + 1:])
+            moved = mat.reshape((shapes[i][sd],) + rest)
+            leaves[i] = jnp.moveaxis(moved, 0, sd).astype(dtypes[i])
+        else:
+            n = int(_math.prod(shapes[i]))
+            leaves[i] = jnp.ravel(mat)[:n].reshape(
+                shapes[i]
+            ).astype(dtypes[i])
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -602,13 +746,39 @@ def _slice_zero1_local(tree: PyTree, dp: int, axis_name) -> PyTree:
     return jax.tree.map(cut, tree)
 
 
-def _scatter_reduce_bucket(b, axis_name, dcn: int, wire_dtype, extra_axes):
+def _compress16(orig_dtype, wire_dtype) -> bool:
+    """True when ``wire_dtype`` is a plain cast wire (16-bit) narrower
+    than the value's dtype — the compress-then-reduce hop form."""
+    return (
+        wire_dtype is not None
+        and not is_quantized_wire(wire_dtype)
+        and jnp.issubdtype(orig_dtype, jnp.floating)
+        and jnp.dtype(wire_dtype).itemsize < jnp.dtype(orig_dtype).itemsize
+    )
+
+
+def _scatter_reduce_bucket(b, axis_name, dcn: int, wire_dtype, extra_axes,
+                           *, ici_wire_dtype=None, residual=None):
     """Reduce-scatter ONE flat [dp*cols] scatter-arranged bucket over
     ``axis_name`` (two-hop over the dcn/ici factoring when ``dcn > 1``;
     the 16-bit wire dtype rides the DCN hop — or the single hop when flat
-    — exactly like the replicated reduction). Returns this shard's
-    fully-reduced [cols] row in the bucket's dtype. Quantized wires never
-    reach here (they keep the dense-layout two-shot; see
+    — exactly like the replicated reduction). ``ici_wire_dtype``
+    (`compression_ici`) rides the two-hop's ICI hop: a 16-bit dtype
+    casts hop 1, a quantized (int8/fp8) dtype runs hop 1 as a
+    per-bucket-scaled quantized reduce-scatter
+    (`_quantized_matrix_reduce_scatter`) with the untransmitted
+    remainder charged to this shard — single-hop (``dcn <= 1``)
+    reductions have no ICI sub-hop, so the knob is inert there.
+
+    ``residual`` (error feedback, full-bucket f32) is added to the
+    bucket before any wire; when no quantized hop actually runs the
+    residual is transmitted in full and the returned error is zero
+    (flush semantics — mass is conserved either way).
+
+    Returns ``(local_row, error)``: this shard's fully-reduced [cols]
+    row in the bucket's dtype, and the full-bucket f32 untransmitted
+    remainder (None when ``residual`` is None). A quantized DCN wire
+    never reaches here (it keeps the dense-layout two-shot; see
     `reduce_gradients`)."""
     orig = b.dtype
     # Trivial (size-1) extra axes are elided STATICALLY: the lowered text
@@ -618,15 +788,20 @@ def _scatter_reduce_bucket(b, axis_name, dcn: int, wire_dtype, extra_axes):
     extra = tuple(a for a in extra_axes if compat.axis_size(a) > 1)
     if extra:
         b = lax.psum(b, extra)
-    compress = (
-        wire_dtype is not None
-        and not is_quantized_wire(wire_dtype)
-        and jnp.issubdtype(orig, jnp.floating)
-        and jnp.dtype(wire_dtype).itemsize < jnp.dtype(orig).itemsize
-    )
+    if residual is not None:
+        # Stay in f32 from here on: casting the residual-carrying value
+        # back to a narrower bucket dtype would silently drop residual
+        # mass the returned error never charges (the wire predicates
+        # below key off ``orig``, the pre-residual dtype, and the final
+        # result is cast back to it).
+        b = b.astype(jnp.float32) + residual
+    err = None
     if dcn <= 1:
-        x = b.astype(wire_dtype) if compress else b
-        return lax.psum_scatter(x, axis_name, tiled=True).astype(orig)
+        x = b.astype(wire_dtype) if _compress16(orig, wire_dtype) else b
+        out = lax.psum_scatter(x, axis_name, tiled=True).astype(orig)
+        if residual is not None:
+            err = jnp.zeros(b.shape, jnp.float32)
+        return out, err
     n = compat.axis_size(axis_name)
     ici = n // dcn
     ici_groups, dcn_groups = _hier_groups(n, dcn)
@@ -635,16 +810,40 @@ def _scatter_reduce_bucket(b, axis_name, dcn: int, wire_dtype, extra_axes):
     # ici index, so arrange target-inner-major first.
     t = b.reshape(dcn, ici, cols).transpose(1, 0, 2).reshape(-1)
     if ici > 1:
-        part = lax.psum_scatter(
-            t, axis_name, axis_index_groups=ici_groups, tiled=True
-        )  # [dcn*cols]: partials for targets (·, own ici index)
+        # Branch condition is trace-time config (wire dtype + value
+        # dtype), identical on every rank: the whole fleet takes the
+        # same arm and submits the same collective order.
+        if is_quantized_wire(ici_wire_dtype) and jnp.issubdtype(  # hvt: noqa[HVT007] config-uniform
+            orig, jnp.floating
+        ):
+            mat = t.astype(jnp.float32).reshape(ici, dcn * cols)
+            part, e1 = _quantized_matrix_reduce_scatter(
+                mat, axis_name, ici_wire_dtype,
+                axis_index_groups=ici_groups,
+            )  # part: [dcn*cols] f32; e1: [ici, dcn*cols] this shard's
+            if residual is not None:
+                # Back from target-inner-major to bucket order.
+                err = e1.reshape(ici, dcn, cols).transpose(
+                    1, 0, 2
+                ).reshape(-1)
+        elif _compress16(orig, ici_wire_dtype):
+            part = lax.psum_scatter(
+                t.astype(ici_wire_dtype), axis_name,
+                axis_index_groups=ici_groups, tiled=True,
+            ).astype(orig)
+        else:
+            part = lax.psum_scatter(
+                t, axis_name, axis_index_groups=ici_groups, tiled=True
+            )  # [dcn*cols]: partials for targets (·, own ici index)
     else:
         part = t
-    y = part.astype(wire_dtype) if compress else part
+    y = part.astype(wire_dtype) if _compress16(orig, wire_dtype) else part
     out = lax.psum_scatter(
         y, axis_name, axis_index_groups=dcn_groups, tiled=True
     )
-    return out.astype(orig)
+    if residual is not None and err is None:
+        err = jnp.zeros(b.shape, jnp.float32)
+    return out.astype(orig), err
 
 
 def _hier_groups(n: int, dcn: int) -> tuple[list, list]:
@@ -778,19 +977,10 @@ def quantized_group_sum(v, axis_name, wire_dtype, *, axis_index_groups=None,
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
     mat = flat.reshape(g, -1)  # row j = the chunk group-member j owns
-    payload, scale = _quantize(mat, wire_dtype)
-    own = _dequantize(payload, scale)
-    # Shot 1: all-to-all delivers row j of every member's payload to
-    # member j (group order); each member sums ITS chunk in f32.
-    recv = lax.all_to_all(
-        payload, axis_name, split_axis=0, concat_axis=0,
-        axis_index_groups=axis_index_groups, tiled=True,
-    )
-    scales = lax.all_gather(
-        scale, axis_name, axis_index_groups=axis_index_groups
-    )
-    chunk = jnp.sum(
-        recv.astype(jnp.float32) * scales.reshape((-1, 1)), axis=0
+    # Shot 1: the quantized reduce-scatter (shared with the scatter
+    # path's ICI hop, `_quantized_matrix_reduce_scatter`).
+    chunk, err1 = _quantized_matrix_reduce_scatter(
+        mat, axis_name, wire_dtype, axis_index_groups=axis_index_groups
     )
     # Shot 2: re-quantize the reduced chunk and gather the group's chunks.
     p2, s2 = _quantize(chunk, wire_dtype)
@@ -805,76 +995,151 @@ def quantized_group_sum(v, axis_name, wire_dtype, *, axis_index_groups=None,
     # Untransmitted remainder: shot-1 error on every element this shard
     # fed in, plus the shot-2 error of the chunk it owns (padding
     # contributes exactly zero to both).
-    err = (mat - own).at[group_position].add(chunk - dq2)
+    err = err1.at[group_position].add(chunk - dq2)
     total = total[:n].reshape(shape)
     err = err.reshape(-1)[:n].reshape(shape)
     return total, err
 
 
+def _quantized_matrix_reduce_scatter(mat, axis_name, wire_dtype, *,
+                                     axis_index_groups=None):
+    """The quantized reduce-scatter shot shared by `quantized_group_sum`
+    (shot 1 of the two-shot replicated wire) and the scatter path's
+    quantized ICI hop (`_scatter_reduce_bucket` with a quantized
+    ``compression_ici``).
+
+    ``mat`` is this member's f32 ``[g, chunk]`` contribution matrix —
+    row j is the slice group-member j owns. The whole matrix is
+    quantized with ONE per-bucket scale, moved by `lax.all_to_all`
+    (every member receives each peer's quantized contribution to ITS
+    chunk only — the only payload bytes on the wire) and
+    dequantize-summed in f32, so sub-16-bit partial sums never exist.
+    Returns ``(chunk_sum_f32, error)``: this member's reduced ``[chunk]``
+    row and its full ``[g, chunk]`` untransmitted remainder (what error
+    feedback must carry)."""
+    payload, scale = _quantize(mat, wire_dtype)
+    own = _dequantize(payload, scale)
+    recv = lax.all_to_all(
+        payload, axis_name, split_axis=0, concat_axis=0,
+        axis_index_groups=axis_index_groups, tiled=True,
+    )
+    scales = lax.all_gather(
+        scale, axis_name, axis_index_groups=axis_index_groups
+    )
+    chunk = jnp.sum(
+        recv.astype(jnp.float32) * scales.reshape((-1, 1)), axis=0
+    )
+    return chunk, mat.astype(jnp.float32) - own
+
+
 def hierarchical_psum(x, axis_name, dcn: int, *, extra_axes=(),
-                      wire_dtype=None):
+                      wire_dtype=None, ici_wire_dtype=None):
     """Two-hop psum over ``axis_name`` factored as (dcn outer, ici inner),
     traced context only (inside shard_map/pmap).
 
-    Hop 1 (ICI, full precision): sum over ``extra_axes`` and the ici
-    subgroups of ``axis_name`` — intra-slice traffic where bandwidth is
-    plentiful. Hop 2 (DCN): cast to ``wire_dtype`` (when given), sum across
-    the dcn subgroups — the only bytes that cross the slow interconnect —
-    and cast back. Equals the flat ``psum(x, (axis_name, *extra_axes))``
-    exactly when ``wire_dtype`` is None (sum is associative); with a 16-bit
-    wire dtype the delta is the cast on the already-ICI-reduced partials
-    (strictly less rounding than casting per-shard values, the flat
-    compressed path's behavior). A QUANTIZED wire dtype (int8/fp8) runs
-    the DCN hop as `quantized_group_sum` — per-bucket-scaled wire bytes,
-    f32 receiver-side accumulation; pass ``residual=`` via
-    `reduce_gradients` to carry the error feedback."""
+    Hop 1 (ICI): sum over ``extra_axes`` and the ici subgroups of
+    ``axis_name`` — intra-slice traffic. Full precision by default;
+    ``ici_wire_dtype`` (`compression_ici`) puts a wire on this hop too —
+    a 16-bit dtype casts it, a quantized (int8/fp8) dtype runs it as
+    `quantized_group_sum` over the ici subgroups (EQuARX's aggressive
+    tier applied intra-slice, for the topologies where even ICI is the
+    bottleneck). Hop 2 (DCN): cast to ``wire_dtype`` (when given), sum
+    across the dcn subgroups — the only bytes that cross the slow
+    interconnect — and cast back. Equals the flat
+    ``psum(x, (axis_name, *extra_axes))`` exactly when both wires are
+    None (sum is associative); with a 16-bit wire dtype the delta is the
+    cast on the already-reduced partials. A QUANTIZED wire dtype runs
+    its hop as `quantized_group_sum` — per-bucket-scaled wire bytes, f32
+    receiver-side accumulation; pass ``residual=`` via `reduce_gradients`
+    to carry the error feedback, charged PER HOP (each quantized hop
+    contributes its own untransmitted remainder, so the telescoping mass
+    identity stays exact across the two-level factoring)."""
     out, _ = _hierarchical_psum_err(
-        x, axis_name, dcn, extra_axes=extra_axes, wire_dtype=wire_dtype
+        x, axis_name, dcn, extra_axes=extra_axes, wire_dtype=wire_dtype,
+        ici_wire_dtype=ici_wire_dtype,
     )
     return out
 
 
 def _hierarchical_psum_err(x, axis_name, dcn: int, *, extra_axes=(),
-                           wire_dtype=None, residual=None):
+                           wire_dtype=None, ici_wire_dtype=None,
+                           residual=None):
     """`hierarchical_psum` body, also returning this shard's quantization
-    error (zeros-shaped None for non-quantized wires). ``residual`` (error
-    feedback) is added to the DCN hop's input before quantization."""
+    error (None for residual-free calls). ``residual`` (error feedback)
+    is added to the FIRST quantized hop's input before quantization —
+    hop 1 when the ICI wire is quantized, hop 2 otherwise — and each
+    quantized hop charges its own error, summed into the returned
+    remainder (the per-hop telescoping contract). A residual with no
+    quantized hop anywhere is flushed: transmitted in full, zero error
+    back."""
     n = compat.axis_size(axis_name)
     if n % dcn != 0:
         raise ValueError(
             f"dcn factor {dcn} does not divide axis {axis_name!r} size {n}"
         )
     orig = x.dtype
-    quantize = is_quantized_wire(wire_dtype) and jnp.issubdtype(
-        orig, jnp.floating
+    floating = jnp.issubdtype(orig, jnp.floating)
+    quantize_dcn = is_quantized_wire(wire_dtype) and floating
+    quantize_ici = (
+        is_quantized_wire(ici_wire_dtype) and floating and n > dcn
     )
     ici_groups, dcn_groups = _hier_groups(n, dcn)
+    ici = n // dcn
     if extra_axes:
         x = lax.psum(x, tuple(extra_axes))
-    if n > dcn:  # ici sub-axis is non-trivial
-        x = lax.psum(x, axis_name, axis_index_groups=ici_groups)
-    if quantize:
+    if residual is not None and not (quantize_dcn or quantize_ici):
+        # Flush: an exact wire transmits the whole remainder (kept in
+        # f32 so no residual mass rounds away uncharged; the result is
+        # cast back to ``orig`` at return).
+        x = x.astype(jnp.float32) + residual
+        residual = None
+        err = jnp.zeros(jnp.shape(x), jnp.float32)
+    else:
+        err = None
+    # quantize_ici/quantize_dcn are trace-time config (wire dtypes +
+    # value dtype), identical on every rank: the fleet takes the same
+    # arm and submits the same collective order.
+    if quantize_ici:  # hvt: noqa[HVT007] config-uniform branch
+        v = x.astype(jnp.float32)
+        if residual is not None:
+            v = v + residual
+            residual = None  # consumed at the first quantized hop
+        # Position within the ici group: groups hold a fixed outer
+        # (slice) index d with the inner index i varying — i = global
+        # mod ici.
+        x, e1 = quantized_group_sum(
+            v, axis_name, ici_wire_dtype, axis_index_groups=ici_groups,
+            group_position=lax.axis_index(axis_name) % ici,
+        )
+        err = e1 if err is None else err + e1
+    elif n > dcn:  # ici sub-axis is non-trivial
+        if _compress16(orig, ici_wire_dtype):
+            x = lax.psum(
+                x.astype(ici_wire_dtype), axis_name,
+                axis_index_groups=ici_groups,
+            ).astype(orig)
+        else:
+            x = lax.psum(x, axis_name, axis_index_groups=ici_groups)
+    if quantize_dcn:
         v = x.astype(jnp.float32)
         if residual is not None:
             v = v + residual
         # Position within the dcn group: groups hold a fixed ici index i
         # with the outer (slice) index d varying — d = global // ici.
-        ici = n // dcn
-        total, err = quantized_group_sum(
+        total, e2 = quantized_group_sum(
             v, axis_name, wire_dtype, axis_index_groups=dcn_groups,
             group_position=lax.axis_index(axis_name) // ici,
         )
+        err = e2 if err is None else err + e2
         return total.astype(orig), err
-    if wire_dtype is not None and jnp.issubdtype(orig, jnp.floating) and (
-        jnp.dtype(wire_dtype).itemsize < jnp.dtype(orig).itemsize
-    ):
+    if _compress16(orig, wire_dtype):
         x = x.astype(wire_dtype)
     x = lax.psum(x, axis_name, axis_index_groups=dcn_groups)
-    return x.astype(orig), None
+    return x.astype(orig), err
 
 
 def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
-                     dcn: int = 1, wire_dtype=None,
+                     dcn: int = 1, wire_dtype=None, ici_wire_dtype=None,
                      bucket_bytes: int | None = None,
                      reverse: bool = False, residual: PyTree | None = None,
                      scatter: int | None = None):
@@ -898,22 +1163,36 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
     elementwise-identical results for non-quantized wires, since bucket
     boundaries never mix values).
 
+    ``ici_wire_dtype`` (`compression_ici`): a wire for the two-hop
+    factoring's ICI hop only (inert when ``dcn <= 1`` or the ici
+    sub-axis is trivial) — 16-bit dtypes cast it, int8/fp8 run it
+    quantized with the error charged per hop. See `hierarchical_psum`.
+
     ``residual``: error-feedback state for quantized wires — a pytree
     matching ``tree`` (f32 leaves). It is added to each bucket's
     pre-quantization value and the call returns ``(reduced_tree,
     new_residual_tree)`` where the new residual is this shard's
-    untransmitted quantization remainder; without it the return is just
-    the reduced tree (and quantization bias goes uncorrected).
+    untransmitted quantization remainder, summed over the quantized
+    hops (per-hop charging keeps the telescoping mass identity exact);
+    without it the return is just the reduced tree (and quantization
+    bias goes uncorrected). A residual with no quantized hop anywhere
+    is flushed (transmitted in full, zero remainder back).
 
     ``scatter``: the ZeRO-1 (shard_update) shard count — lower the
     reduction INTO the sharded weight-update layout: leaves with a
     dp-divisible dim come back as this shard's LOCAL zero1 block (the
     slice `training/build.py`'s opt-state layout consumes), the rest
-    replicated. Non-quantized wires run each scatter-family bucket as a
-    `psum_scatter` (two-hop over dcn, wire dtype on the DCN hop) —
-    ~half the bytes of reduce-then-slice — and tail-family buckets as
-    reduce-scatter + all-gather (no full-payload all-reduce anywhere).
-    Quantized wires keep the dense bucket layout through the two-shot
+    replicated. Non-quantized wires run every bucket as ONE
+    `psum_scatter` (two-hop over dcn, wire dtype on the DCN hop, the
+    ICI-hop wire when given) — ~half the bytes of reduce-then-slice —
+    with tail-family leaves riding the same buckets and their full
+    values all-gathered back from just their columns (no full-payload
+    all-reduce anywhere). Buckets are leaf-aligned in BOTH directions
+    (see `flatten_scatter_buckets`): inside the overlap peel's
+    straight-line region each bucket's scatter issues as soon as its
+    gradients are final, and each shard's optimizer apply for that
+    bucket's leaves can start as soon as it lands. Quantized DCN wires
+    keep the dense bucket layout through the two-shot
     `quantized_group_sum` — BITWISE identical to the replicated
     reduction, so the composed trajectory equals the dense control —
     and slice locally (the wire is already ~2x payload; re-cutting
@@ -925,8 +1204,8 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
     if scatter is not None and int(scatter) > 1:
         return _reduce_gradients_scatter(
             tree, int(scatter), data_axis=data_axis, extra_axes=extra_axes,
-            dcn=dcn, wire_dtype=wire_dtype, bucket_bytes=bucket_bytes,
-            reverse=reverse, residual=residual,
+            dcn=dcn, wire_dtype=wire_dtype, ici_wire_dtype=ici_wire_dtype,
+            bucket_bytes=bucket_bytes, reverse=reverse, residual=residual,
         )
     buckets, spec = flatten_buckets(tree, bucket_bytes, reverse=reverse)
     res_buckets = [None] * len(buckets)
@@ -954,7 +1233,8 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
         if dcn > 1:
             return _hierarchical_psum_err(
                 b, data_axis, dcn, extra_axes=extra_axes,
-                wire_dtype=wire_dtype, residual=r,
+                wire_dtype=wire_dtype, ici_wire_dtype=ici_wire_dtype,
+                residual=r,
             )
         if is_quantized_wire(wire_dtype) and jnp.issubdtype(
             orig, jnp.floating
@@ -966,11 +1246,16 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
                 v, (data_axis, *extra_axes), wire_dtype
             )
             return total.astype(orig), err
-        if wire_dtype is not None and jnp.issubdtype(orig, jnp.floating) and (
-            jnp.dtype(wire_dtype).itemsize < jnp.dtype(orig).itemsize
-        ):
+        if r is not None:
+            # Residual with an exact single-hop wire (an ICI-quantized
+            # config on a single-slice mesh): flush — transmitted in
+            # full (f32 carries the whole remainder), zero back.
+            b = b.astype(jnp.float32) + r
+        if _compress16(orig, wire_dtype):
             b = b.astype(wire_dtype)
-        return lax.psum(b, (data_axis, *extra_axes)).astype(orig), None
+        out = lax.psum(b, (data_axis, *extra_axes)).astype(orig)
+        return out, (None if r is None else jnp.zeros(jnp.shape(r),
+                                                      jnp.float32))
 
     reduced, errors = zip(*[
         reduce_one(b, r) for b, r in zip(buckets, res_buckets)
@@ -993,48 +1278,94 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
 
 
 def _reduce_gradients_scatter(tree: PyTree, dp: int, *, data_axis,
-                              extra_axes, dcn, wire_dtype, bucket_bytes,
-                              reverse, residual):
+                              extra_axes, dcn, wire_dtype, ici_wire_dtype,
+                              bucket_bytes, reverse, residual):
     """`reduce_gradients(scatter=dp)` body — see its docstring. Returns
     the zero1-local tree (scatter leaves as local blocks, tail leaves
-    replicated), with the new residual tree appended for quantized wires
-    carrying error feedback."""
+    replicated), with the new residual tree appended for error-feedback
+    callers."""
     leaves = jax.tree_util.tree_leaves(tree)
-    quantized = is_quantized_wire(wire_dtype) and all(
+    floating = all(
         jnp.issubdtype(jnp.result_type(l), jnp.floating) for l in leaves
     )
-    if quantized:
-        # Dense-layout quantized wire (bitwise-identical arithmetic to
-        # the replicated path, residual and all), then the free local cut.
+    if is_quantized_wire(wire_dtype) and floating:
+        # Dense-layout quantized DCN wire (bitwise-identical arithmetic
+        # to the replicated path, residual and all), then the free local
+        # cut.
         reduced = reduce_gradients(
             tree, data_axis=data_axis, extra_axes=extra_axes, dcn=dcn,
-            wire_dtype=wire_dtype, bucket_bytes=bucket_bytes,
-            reverse=reverse, residual=residual,
+            wire_dtype=wire_dtype, ici_wire_dtype=ici_wire_dtype,
+            bucket_bytes=bucket_bytes, reverse=reverse, residual=residual,
         )
         if residual is None:
             return _slice_zero1_local(reduced, dp, data_axis)
         out, new_res = reduced
         return _slice_zero1_local(out, dp, data_axis), new_res
-    if residual is not None:
+    if residual is not None and not is_quantized_wire(ici_wire_dtype):
         raise ValueError(
             "error-feedback residuals require a quantized wire dtype "
-            "(int8/fp8); non-quantized scatter reductions are lossless "
-            "and carry no residual"
+            "(int8/fp8) on one of the hops; non-quantized scatter "
+            "reductions are lossless and carry no residual"
         )
     buckets, spec = flatten_scatter_buckets(
         tree, dp, bucket_bytes, reverse=reverse
     )
-    out_buckets = []
-    for b, fam in zip(buckets, bucket_families(spec)):
-        loc = _scatter_reduce_bucket(b, data_axis, dcn, wire_dtype,
-                                     extra_axes)
-        if fam == "tail":
-            # Replicated-mirror leaves need the whole bucket back:
-            # reduce-scatter + all-gather — a two-shot all-reduce that
-            # never puts a full payload through one collective.
-            loc = lax.all_gather(loc, data_axis, tiled=True)
-        out_buckets.append(loc)
-    return unflatten_scatter_buckets(out_buckets, spec)
+    res_buckets: list = [None] * len(buckets)
+    if residual is not None:
+        res_buckets, _ = flatten_scatter_buckets(
+            residual, dp, bucket_bytes, reverse=reverse
+        )
+        if [jnp.shape(b) for b in res_buckets] != [
+            jnp.shape(b) for b in buckets
+        ]:
+            raise ValueError(
+                "error-feedback residual buckets do not align with the "
+                "gradient buckets — the residual (f32 leaves) must "
+                "bucket identically to the gradient tree; cast the "
+                "gradients to float32 before reduce_gradients"
+            )
+    spans = bucket_tail_spans(spec)
+    entries: list = []
+    errors: list = []
+    # Bucket-by-bucket, reverse order already baked into the spec: each
+    # loop iteration's collective depends ONLY on its own leaves (leaf-
+    # aligned assembly), so inside the overlap peel's straight-line
+    # region XLA's latency-hiding scheduler can issue bucket i's
+    # psum_scatter while earlier leaves' backward still computes, and
+    # start bucket i's shard-local optimizer math as soon as it lands.
+    for b, r, sp in zip(buckets, res_buckets, spans):
+        loc, err = _scatter_reduce_bucket(
+            b, data_axis, dcn, wire_dtype, extra_axes,
+            ici_wire_dtype=ici_wire_dtype, residual=r,
+        )
+        if sp:
+            # Tail-family pieces (replicated mirrors) need full values
+            # back: all-gather JUST their columns — with the scatter
+            # above, a two-shot all-reduce that never puts a full
+            # payload through one collective.
+            tail_local = (
+                loc[sp[0][0]: sp[0][0] + sp[0][1]] if len(sp) == 1
+                else jnp.concatenate(
+                    [loc[c: c + w] for c, w in sp]
+                )
+            )
+            gathered = lax.all_gather(tail_local, data_axis, tiled=True)
+            entries.append((loc, gathered))
+        else:
+            entries.append(loc)
+        errors.append(err)
+    out = unflatten_scatter_buckets(entries, spec)
+    if residual is None:
+        return out
+    new_res = unflatten_scatter_full(
+        [
+            e if e is not None else jnp.zeros(jnp.shape(b), jnp.float32)
+            for e, b in zip(errors, buckets)
+        ],
+        spec,
+    )
+    new_res = jax.tree.map(lambda e: e.astype(jnp.float32), new_res)
+    return out, new_res
 
 
 def metric_mean(metrics: dict, axis_name=None) -> dict:

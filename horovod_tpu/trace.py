@@ -45,7 +45,16 @@ _PEAK_FLOPS = {
 
 
 def device_peak_flops(device=None) -> float | None:
-    """Peak FLOP/s of one chip, or None when unknown (e.g. CPU)."""
+    """Peak FLOP/s of one chip, or None when unknown (e.g. CPU).
+
+    ``HVT_PEAK_FLOPS`` overrides the table — the explicit per-chip peak
+    for device kinds the table doesn't know (CPU CI topologies, new TPU
+    generations), so MFU can be a real trend number everywhere. An
+    unparseable override raises ``ValueError`` (bench.py exits 2 on
+    it)."""
+    override = registry.get_float("HVT_PEAK_FLOPS")
+    if override:
+        return float(override)
     device = device or jax.devices()[0]
     kind = device.device_kind.lower()
     for key, peak in sorted(_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):

@@ -157,9 +157,15 @@ def build_state(trainer, sample_x: np.ndarray, sample_y=None) -> TrainState:
         # dim — `collectives.zero1_shard_dim`, the SAME rule the
         # scatter-mode boundary reduction derives its bucket layout from
         # (reduce_gradients(scatter=dp)), so the reduced gradient slices
-        # land exactly on these mirrors. On the implicit (K=1,
-        # uncompressed) path the jitted step still compiles the paper's
-        # transformation purely from these init shardings.
+        # land exactly on these mirrors — and, with the leaf-aligned
+        # buckets, land bucket-by-bucket: each mirror's update is
+        # schedulable as soon as the bucket carrying its leaf arrives,
+        # the fused per-shard apply the trainer's zero1 pin compiles.
+        # Leaves with NO dp-divisible dim keep replicated mirrors; the
+        # scatter path pads them onto the same buckets and all-gathers
+        # just their columns back. On the implicit (K=1, uncompressed)
+        # path the jitted step still compiles the paper's transformation
+        # purely from these init shardings.
         dp = trainer.mesh.shape[mesh_lib.DATA_AXIS]
         rep = sharding_lib.replicated(trainer.mesh)
         param_shaped = _param_shaped_matcher(params)

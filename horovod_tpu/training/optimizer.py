@@ -107,6 +107,7 @@ def DistributedOptimizer(
     backward_passes_per_step: int = 1,
     average_aggregated_gradients: bool = False,
     compression: str = "none",
+    compression_ici: str = "none",
     error_feedback: bool = True,
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates consume cross-worker-averaged gradients.
@@ -151,18 +152,35 @@ def DistributedOptimizer(
         default SPMD-jit mode): a plain ``axis_name`` all-reduce cannot
         sum int8 partials without overflow, so that combination is
         rejected loudly.
-      error_feedback: int8/fp8 only — carry each shard's untransmitted
-        quantization remainder and add it back before the next step's
-        quantization (errors telescope; the wire bias does not compound
-        across steps). Default True; False is the ablation knob the
-        compression A/B measures. Ignored for non-quantized wires.
+      compression_ici: like ``compression``, but for the ICI hop of the
+        hierarchical two-hop reduction only (EQuARX's aggressive tier
+        applied intra-slice — for topologies where even ICI bandwidth is
+        the bottleneck). Inert on single-slice meshes (``dcn == 1``:
+        there is no two-hop factoring to put it on). int8/fp8 run the
+        ICI hop as the per-bucket-scaled quantized reduce-scatter, with
+        the untransmitted remainder charged PER HOP into the same
+        error-feedback residual as ``compression`` (the telescoping mass
+        identity stays exact across the factoring); bf16/fp16 cast the
+        hop. Trainer-only for the quantized tier, like ``compression``.
+      error_feedback: int8/fp8 only (either hop) — carry each shard's
+        untransmitted quantization remainder and add it back before the
+        next step's quantization (errors telescope; the wire bias does
+        not compound across steps). Default True; False is the ablation
+        knob the compression A/B measures. Ignored for non-quantized
+        wires.
     """
     if compression not in _COMPRESSION_DTYPES:
         raise ValueError(
             f"unknown compression {compression!r}; "
             f"expected one of {sorted(_COMPRESSION_DTYPES)}"
         )
+    if compression_ici not in _COMPRESSION_DTYPES:
+        raise ValueError(
+            f"unknown compression_ici {compression_ici!r}; "
+            f"expected one of {sorted(_COMPRESSION_DTYPES)}"
+        )
     comm_dtype = _COMPRESSION_DTYPES[compression]
+    ici_dtype = _COMPRESSION_DTYPES[compression_ici]
     if is_quantized_wire(comm_dtype) and axis_name is not None:
         raise ValueError(
             f"compression={compression!r} needs the Trainer's "
@@ -170,6 +188,13 @@ def DistributedOptimizer(
             "per-bucket scales); with an explicit axis_name the update-side "
             "all-reduce would sum raw int8/fp8 partials — overflow. Use "
             "bf16/fp16 here, or drop axis_name and run under Trainer"
+        )
+    if ici_dtype is not None and axis_name is not None:
+        raise ValueError(
+            f"compression_ici={compression_ici!r} targets the Trainer's "
+            "explicit-collective two-hop reduction (the ICI sub-hop); an "
+            "update-side axis_name all-reduce has no hop to put it on — "
+            "drop axis_name and run under Trainer"
         )
 
     def init_fn(params):
@@ -220,15 +245,23 @@ def DistributedOptimizer(
                 average=average_aggregated_gradients,
                 inner=inner,
             )
-    if comm_dtype is not None and axis_name is None:
-        # SPMD-jit mode: the reduction this dtype applies to lives inside the
-        # compiled step, not here. Tag the transformation so Trainer selects
-        # its explicit-collective (shard_map) gradient path, where the psum
-        # really runs on 16-bit wire traffic. Tagging the plain update
-        # function keeps the result an ordinary GradientTransformation.
-        tx.update._hvt_compression = comm_dtype
+    if (comm_dtype is not None or ici_dtype is not None) and (
+        axis_name is None
+    ):
+        # SPMD-jit mode: the reduction these dtypes apply to lives inside
+        # the compiled step, not here. Tag the transformation so Trainer
+        # selects its explicit-collective (shard_map) gradient path, where
+        # the psum really runs on the wire traffic. Tagging the plain
+        # update function keeps the result an ordinary
+        # GradientTransformation.
+        if comm_dtype is not None:
+            tx.update._hvt_compression = comm_dtype
+        if ici_dtype is not None:
+            tx.update._hvt_compression_ici = ici_dtype
         tx.update._hvt_error_feedback = bool(
-            error_feedback and is_quantized_wire(comm_dtype)
+            error_feedback and (
+                is_quantized_wire(comm_dtype) or is_quantized_wire(ici_dtype)
+            )
         )
     return tx
 
@@ -239,6 +272,14 @@ def compression_dtype(tx: optax.GradientTransformation):
     None. Trainer uses this to switch its train step to the
     explicit-collective gradient reduction."""
     return getattr(tx.update, "_hvt_compression", None)
+
+
+def compression_ici_dtype(tx: optax.GradientTransformation):
+    """The ICI-hop wire dtype a `DistributedOptimizer(compression_ici=)`
+    requested for the hierarchical two-hop reduction, or None. Inert on
+    single-slice meshes (no two-hop factoring); Trainer threads it into
+    `collectives.reduce_gradients(ici_wire_dtype=)`."""
+    return getattr(tx.update, "_hvt_compression_ici", None)
 
 
 def compression_error_feedback(tx: optax.GradientTransformation) -> bool:

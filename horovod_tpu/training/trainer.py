@@ -37,6 +37,7 @@ from horovod_tpu.training.optimizer import (
     accumulation_spec,
     compression_dtype,
     compression_error_feedback,
+    compression_ici_dtype,
     error_feedback_wrap,
 )
 
@@ -176,11 +177,19 @@ class Trainer:
         # sharded params the gradient traffic is layout-dependent and the
         # implicit SPMD reduction must stay in charge.
         self._comm_dtype = compression_dtype(optimizer)
-        if self._comm_dtype is not None and param_specs is not None:
+        # ICI-hop wire (DistributedOptimizer(compression_ici=...)): rides
+        # the hierarchical two-hop reduction's intra-slice hop only —
+        # inert on single-slice meshes (dcn == 1), where there is no
+        # factoring to put it on.
+        self._ici_dtype = compression_ici_dtype(optimizer)
+        if (
+            self._comm_dtype is not None or self._ici_dtype is not None
+        ) and param_specs is not None:
             raise ValueError(
-                "DistributedOptimizer(compression=...) requires replicated "
-                "parameters (param_specs=None); sharded-parameter layouts "
-                "keep XLA's implicit f32 gradient reduction"
+                "DistributedOptimizer(compression=/compression_ici=...) "
+                "requires replicated parameters (param_specs=None); "
+                "sharded-parameter layouts keep XLA's implicit f32 "
+                "gradient reduction"
             )
         # Gradient accumulation (DistributedOptimizer(backward_passes_per_
         # step=K)): the Trainer runs the K microbatch passes INSIDE one
@@ -240,16 +249,22 @@ class Trainer:
                 f"bucket_order must be 'reverse' or 'forward', got {order!r}"
             )
         self._bucket_reverse = order == "reverse"
+        # The explicit-collective step runs whenever any of its features
+        # is requested: a wire dtype (either hop), accumulation (K > 1).
+        # Everything else keeps the implicit SPMD reduction.
+        self._explicit_step = (
+            self._comm_dtype is not None
+            or self._ici_dtype is not None
+            or self._accum_steps > 1
+        )
         # Multi-slice factor of the data axis (1 on single-slice meshes):
         # when > 1, the boundary reduction runs two-hop — ICI sub-axis in
-        # full precision, DCN sub-axis in the compression dtype
-        # (EQuARX-style DCN-only quantization). Only consulted by the
-        # explicit-collective step; the default SPMD path leaves reduction
-        # placement to XLA.
+        # full precision (or the compression_ici wire), DCN sub-axis in
+        # the compression dtype (EQuARX-style DCN-side quantization).
+        # Only consulted by the explicit-collective step; the default
+        # SPMD path leaves reduction placement to XLA.
         self._dcn = (
-            mesh_lib.dcn_factor(self.mesh)
-            if (self._comm_dtype is not None or self._accum_steps > 1)
-            else 1
+            mesh_lib.dcn_factor(self.mesh) if self._explicit_step else 1
         )
         # ZeRO-1 / cross-replica weight-update sharding (Xu et al.,
         # arXiv:2004.13336 — PAPERS.md): keep the MODEL replicated (pure-DP
@@ -284,15 +299,25 @@ class Trainer:
             self.mesh.shape.get(mesh_lib.DATA_AXIS, 1) if shard_update
             else 1
         )
-        # Quantized-wire error feedback (compression='int8'/'fp8' with
-        # error_feedback=True): the per-shard untransmitted quantization
-        # remainder lives in opt_state (`ErrorFeedbackState`, one
-        # [n_shards, *param] f32 leaf per parameter, leading axis sharded
-        # over the data axes) so checkpoints, broadcasts and elastic
-        # commits carry it with no extra plumbing. The step reads it into
-        # the boundary reduction and writes the new remainder back.
-        self._ef = collectives.is_quantized_wire(
-            self._comm_dtype
+        # Quantized-wire error feedback (compression='int8'/'fp8' on
+        # EITHER hop, with error_feedback=True): the per-shard
+        # untransmitted quantization remainder lives in opt_state
+        # (`ErrorFeedbackState`, one [n_shards, *param] f32 leaf per
+        # parameter, leading axis sharded over the data axes) so
+        # checkpoints, broadcasts and elastic commits carry it with no
+        # extra plumbing. The step reads it into the boundary reduction
+        # and writes the new remainder back — charged per hop when both
+        # hops quantize. Deliberately NOT gated on self._dcn: a
+        # quantized ICI wire on a single-slice mesh carries a residual
+        # that provably flushes to zeros each step (pure overhead), but
+        # making the opt-state STRUCTURE depend on the topology would
+        # break every cross-topology state surface (an elastic rescale
+        # across a slice boundary, a checkpoint restored on a different
+        # slice count) — don't set compression_ici on single-slice
+        # fleets instead.
+        self._ef = (
+            collectives.is_quantized_wire(self._comm_dtype)
+            or collectives.is_quantized_wire(self._ici_dtype)
         ) and compression_error_feedback(optimizer)
         if self._ef:
             self.tx = error_feedback_wrap(
@@ -361,7 +386,14 @@ class Trainer:
             free to overlap bucket i's ICI/DCN transfer with the
             still-running backward of earlier layers — Horovod's
             tensor-fusion + overlap design (arXiv:1802.05799) as compiled
-            structure. Arithmetic is IDENTICAL to the serialized form
+            structure. On the ZeRO-1 composed path the same holds for
+            the scatter-form reduction: buckets are leaf-aligned in both
+            directions (`collectives.flatten_scatter_buckets`), so each
+            bucket's `psum_scatter` issues inside this peeled region as
+            its gradients finalize AND the per-shard optimizer apply for
+            its leaves (train_step's zero1-pinned update) is schedulable
+            as soon as it lands — no full-tree barrier between scatter
+            and update. Arithmetic is IDENTICAL to the serialized form
             (same addition order, same bucket contents): the knob changes
             schedulability, not semantics.
 
@@ -483,6 +515,7 @@ class Trainer:
                     extra_axes=(mesh_lib.FSDP_AXIS,),
                     dcn=self._dcn,
                     wire_dtype=comm,
+                    ici_wire_dtype=self._ici_dtype,
                     bucket_bytes=self._bucket_bytes,
                     reverse=self._bucket_reverse,
                     residual=res_in,
@@ -490,6 +523,13 @@ class Trainer:
                     # sharded weight-update layout — each shard receives
                     # only ITS zero1 slice of the divisible leaves (the
                     # rest replicated), matching build's opt mirrors.
+                    # Buckets are leaf-aligned in both directions
+                    # (flatten_scatter_buckets), so inside this peeled
+                    # straight-line region bucket i's psum_scatter can
+                    # issue as soon as its leaves' gradients are final
+                    # and the downstream per-shard optimizer math for
+                    # bucket i's leaves can start as soon as it lands —
+                    # the per-bucket backward-overlapped schedule.
                     scatter=self._scatter if self._scatter > 1 else None,
                 )
                 if res is None:
@@ -568,7 +608,7 @@ class Trainer:
                     acc, upd if upd is not None else state.model_state, sm
                 )
 
-            if self._comm_dtype is not None or self._accum_steps > 1:
+            if self._explicit_step:
                 if self._accum_steps > 1:
                     sx, sy = x, y  # already [K, G, ...] microbatch stacks
                 else:
@@ -594,14 +634,19 @@ class Trainer:
                 # through untouched).
                 opt_state = opt_state.replace(ef_residual=new_residual)
             updates = jax.tree.map(lambda u: u * update_scale, updates)
-            if self._scatter > 1 and (
-                self._comm_dtype is not None or self._accum_steps > 1
-            ):
+            if self._scatter > 1 and self._explicit_step:
                 # Composed ZeRO-1 path: pin the zero1 layout on the
                 # updates so the replication boundary is the param
                 # all-gather AFTER the sharded optimizer math —
                 # propagation must not re-replicate the scattered
-                # gradients and optimizer mirrors instead.
+                # gradients and optimizer mirrors instead. The optimizer
+                # math itself is per-leaf elementwise dataflow over the
+                # scattered gradients, so with leaf-aligned buckets each
+                # bucket's shard-local apply (and its param all-gather
+                # below) is schedulable the moment THAT bucket's scatter
+                # lands — the fused per-shard apply of the weight-update
+                # -sharding end state (arXiv:2004.13336), as compiled
+                # structure.
                 updates = jax.lax.with_sharding_constraint(
                     updates,
                     jax.tree.map(

@@ -331,6 +331,33 @@ class TestAuditCLI:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "ok" in proc.stdout and "overlap peel verified" in proc.stdout
 
+    def test_canonical_k4_zero1_overlap_gate(self):
+        """THE ISSUE 12 acceptance gate: the UNCOMPRESSED composed step
+        (K=4 + shard_update) packs every leaf — tail family included —
+        into ONE leaf-aligned scatter bucket, and the overlap peel
+        holds with the scatter count UNCHANGED between the peeled and
+        serialized programs (the peel re-schedules the buckets, it must
+        not re-bucket the reduction)."""
+        proc = _run_audit([
+            "step", "--platform", "cpu", "--k", "4", "--zero1",
+            "--expect", "scatters=1,overlap",
+        ])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ok" in proc.stdout and "overlap peel verified" in proc.stdout
+
+    def test_quantized_ici_two_hop_audits_shape(self):
+        """--dcn fakes the two-hop factoring and --compression-ici int8
+        puts the quantized wire on its ICI hop: the derived expectation
+        degrades to the shape-only scatter-reduction (the hop-1 payload
+        all-to-all rides next to the hop-2 reduce-scatter, so exact
+        counts depend on the factoring) and the program passes it."""
+        proc = _run_audit([
+            "step", "--platform", "cpu", "--k", "4", "--zero1",
+            "--dcn", "2", "--compression-ici", "int8",
+        ])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "derived --expect scatter-reduction" in proc.stdout
+
     def test_zero1_gate_derives_scatter_expectation(self):
         """`--zero1` without --expect derives the scatter-form
         expectation (scatters=1 for the quantized dense layout)."""

@@ -370,7 +370,7 @@ class TestBenchPhaseGuard:
 class TestKnobRegistry:
     @pytest.mark.parametrize("name", [
         "HVT_OVERLAP_REDUCTION", "HVT_BUCKET_ORDER", "HVT_PREFETCH_DEPTH",
-        "HVT_COMPRESSION",
+        "HVT_COMPRESSION", "HVT_COMPRESSION_ICI", "HVT_PEAK_FLOPS",
     ])
     def test_new_knobs_declared(self, name):
         assert registry.is_registered(name)

@@ -34,7 +34,10 @@ from horovod_tpu import checkpoint, compat
 from horovod_tpu.analysis import hlo_audit
 from horovod_tpu.analysis.step_probe import lowered_step_text
 from horovod_tpu.parallel import collectives, mesh as mesh_lib
-from horovod_tpu.training.optimizer import ErrorFeedbackState
+from horovod_tpu.training.optimizer import (
+    ErrorFeedbackState,
+    compression_error_feedback,
+)
 
 
 class Probe(nn.Module):
@@ -55,10 +58,11 @@ def _data(n=128, seed=0):
 
 
 def _trainer(k=1, compression="none", zero1=False, overlap=None,
-             bucket_bytes=None, seed=3):
+             bucket_bytes=None, seed=3, compression_ici="none"):
     tx = hvt.DistributedOptimizer(
         optax.adam(1e-3), backward_passes_per_step=k,
         average_aggregated_gradients=True, compression=compression,
+        compression_ici=compression_ici,
     )
     return hvt.Trainer(
         Probe(), tx, seed=seed, shard_update=zero1,
@@ -93,12 +97,24 @@ class TestComposedTrajectoryMatrix:
     dense control at rel 1e-4 on params and optimizer state."""
 
     @pytest.mark.parametrize("k", [1, 4])
-    @pytest.mark.parametrize("compression", ["none", "int8"])
-    def test_composed_equals_dense_control(self, k, compression):
-        dense = _fit(_trainer(k, compression), k)
+    @pytest.mark.parametrize(
+        "compression,ici",
+        [("none", "none"), ("int8", "none"), ("int8", "int8")],
+    )
+    def test_composed_equals_dense_control(self, k, compression, ici,
+                                           monkeypatch):
+        """The PR 10 matrix extended with the ICI-hop wire: int8+ici
+        runs BOTH hops quantized under a faked 2-slice factoring
+        (HVT_DCN_FACTOR=2) and must still equal the dense control at the
+        same config — the scatter path keeps the dense bucket layout
+        for quantized DCN wires (bitwise the replicated reduction) and
+        slices locally."""
+        if ici != "none":
+            monkeypatch.setenv("HVT_DCN_FACTOR", "2")
+        dense = _fit(_trainer(k, compression, compression_ici=ici), k)
         for overlap in (True, False):
             z = _fit(_trainer(k, compression, zero1=True,
-                              overlap=overlap), k)
+                              overlap=overlap, compression_ici=ici), k)
             _assert_state_close(z, dense)
             # And it really trained sharded: some opt-state mirror
             # carries the data axis (dp=8 divides every Probe leaf's
@@ -109,6 +125,24 @@ class TestComposedTrajectoryMatrix:
                 if hasattr(l, "sharding") and getattr(l, "ndim", 0) > 0
             }
             assert any("data" in s for s in specs), specs
+
+    def test_quantized_ici_on_scatter_layout_tracks_exact(self,
+                                                          monkeypatch):
+        """compression_ici alone (no DCN wire) keeps the SCATTER layout
+        — the quantized wire rides `_scatter_reduce_bucket`'s ICI hop
+        with error feedback — and the trained params track the exact
+        (uncompressed) zero1 run closely (EF telescopes the per-hop
+        quantization error)."""
+        monkeypatch.setenv("HVT_DCN_FACTOR", "2")
+        exact = _fit(_trainer(4, zero1=True), 4)
+        q = _fit(_trainer(4, zero1=True, compression_ici="int8"), 4)
+        for a, b in zip(
+            jax.tree.leaves(jax.device_get(exact.state.params)),
+            jax.tree.leaves(jax.device_get(q.state.params)),
+        ):
+            np.testing.assert_allclose(a, b, rtol=0.05, atol=5e-3)
+        # The EF residual exists and lives in opt_state.
+        assert isinstance(q.state.opt_state, ErrorFeedbackState)
 
     def test_fail_fasts_are_lifted(self):
         """The three former composition fail-fasts construct and build:
@@ -143,11 +177,20 @@ class TestComposedCompiledStructure:
     def test_k4_step_is_scatter_only(self):
         x, y = _data()
         tr = _trainer(4, zero1=True)
-        # dp=8: {k1, b1, k2} scatter-bucket + {b2} tail-bucket -> exactly
-        # two reduce-scatters, zero full-payload all-reduces.
-        hlo_audit.assert_program(
-            lowered_step_text(tr, x, y, 4), "scatters=2"
-        )
+        # dp=8: {k1, b1, k2} scatter pieces AND the padded b2 tail piece
+        # share ONE bucket at the default fusion threshold -> exactly one
+        # reduce-scatter, zero full-payload all-reduces; the tail's full
+        # value comes back through a small rank-1 all-gather of just its
+        # columns (outside every reduction count by design).
+        text = lowered_step_text(tr, x, y, 4)
+        hlo_audit.assert_program(text, "scatters=1")
+        tail_gathers = [
+            op for op in hlo_audit.collective_ops(text)
+            if op.kind == "all-gather" and op.rank == 1
+        ]
+        assert len(tail_gathers) == 1, tail_gathers
+        # b2 is (10,), padded to 2 columns x 8 shards = 16 elements.
+        assert tail_gathers[0].shape == (16,), tail_gathers
 
     def test_int8_step_is_one_bucketed_scatter_group(self):
         """The canonical acceptance audit: K=4 + shard_update + int8
@@ -217,13 +260,20 @@ class TestScatterBuckets:
             tree, dp, bucket_bytes, reverse=reverse
         )
         fams = collectives.bucket_families(spec)
-        assert len(fams) == len(buckets)
+        spans = collectives.bucket_tail_spans(spec)
+        assert len(fams) == len(buckets) == len(spans)
         for s in range(dp):
-            local = [
-                b.reshape(dp, -1)[s] if f == "scatter" else b
-                for b, f in zip(buckets, fams)
-            ]
-            got = collectives.unflatten_scatter_buckets(local, spec)
+            entries = []
+            for b, sp in zip(buckets, spans):
+                m = np.asarray(b).reshape(dp, -1)
+                if sp:
+                    tails = np.concatenate(
+                        [m[:, c: c + w] for c, w in sp], axis=1
+                    )
+                    entries.append((m[s], tails.ravel()))
+                else:
+                    entries.append(m[s])
+            got = collectives.unflatten_scatter_buckets(entries, spec)
             for name, leaf in tree.items():
                 sd = collectives.zero1_shard_dim(leaf.shape, dp)
                 if sd is None:
@@ -239,19 +289,59 @@ class TestScatterBuckets:
                         np.asarray(got[name]), want
                     )
 
+    @pytest.mark.parametrize("bucket_bytes", [1 << 20, 512])
+    def test_full_buckets_round_trip(self, bucket_bytes):
+        """`unflatten_scatter_full` (the error-feedback residual path)
+        is the exact inverse from un-scattered buckets."""
+        tree = self._tree()
+        buckets, spec = collectives.flatten_scatter_buckets(
+            tree, 8, bucket_bytes
+        )
+        got = collectives.unflatten_scatter_full(buckets, spec)
+        for name, leaf in tree.items():
+            np.testing.assert_array_equal(np.asarray(got[name]), leaf)
+
     def test_every_bucket_is_a_world_multiple(self):
         buckets, _ = collectives.flatten_scatter_buckets(
             self._tree(), 8, 512
         )
         assert all(b.size % 8 == 0 for b in buckets)
 
+    def test_buckets_are_leaf_aligned(self):
+        """The per-bucket schedulability contract: every bucket's spec
+        names exactly the leaf pieces it was assembled from (no bucket
+        references the whole-tree concat), cut points at exact
+        bucket_bytes column multiples."""
+        dp = 8
+        buckets, spec = collectives.flatten_scatter_buckets(
+            self._tree(), dp, 512
+        )
+        per = 512 // (dp * 4)  # columns per bucket (f32)
+        descs = spec[5]
+        assert len(descs) == len(buckets)
+        for b, pieces in zip(buckets, descs):
+            assert sum(w for _i, w in pieces) == b.size // dp
+            assert b.size // dp <= per
+        # Every leaf's pieces, concatenated across buckets, cover it once.
+        shapes = spec[1]
+        covered = {i: 0 for i in range(len(shapes))}
+        for pieces in descs:
+            for i, w in pieces:
+                covered[i] += w
+        for i, shape in enumerate(shapes):
+            n = int(np.prod(shape))
+            assert covered[i] == -(-n // dp), (i, shape, covered[i])
+
     def test_families_split_by_divisibility(self):
+        # At the default threshold everything packs into ONE bucket:
+        # b2 (10,) cannot shard at dp=8, so the bucket is mixed.
         _, spec = collectives.flatten_scatter_buckets(self._tree(), 8)
-        fams = {fam for fam, _, _ in spec[5]}
-        assert fams == {"scatter", "tail"}  # b2 (10,) cannot shard at 8
-        # ...but at dp=2 every leaf divides: no tail family at all.
+        assert collectives.bucket_families(spec) == ["mixed"]
+        assert collectives.bucket_tail_spans(spec)[0]  # b2's columns
+        # ...but at dp=2 every leaf divides: pure scatter, no tail spans.
         _, spec2 = collectives.flatten_scatter_buckets(self._tree(), 2)
-        assert {fam for fam, _, _ in spec2[5]} == {"scatter"}
+        assert collectives.bucket_families(spec2) == ["scatter"]
+        assert collectives.bucket_tail_spans(spec2) == [()]
 
     def test_shared_rule_with_build(self):
         """zero1_partition_spec is the layout build_state installs —
@@ -320,6 +410,226 @@ class TestScatterBuckets:
         )
         with pytest.raises(ValueError, match="do not match"):
             collectives.unflatten_scatter_buckets(buckets[:-1], spec)
+
+
+class TestIciWire:
+    """compression_ici — the ICI-hop wire of the two-hop factoring
+    (ISSUE 12): quantized reduce-scatter on hop 1 of the scatter path,
+    per-hop error-feedback charging, structural dtype witnesses."""
+
+    def _tree(self):
+        rng = np.random.RandomState(0)
+        return {
+            "k1": rng.randn(64, 32).astype(np.float32),
+            "b2": rng.randn(10).astype(np.float32),
+        }
+
+    def _shard_map(self, fn, in_specs, out_specs):
+        hvt.init()
+        mesh = mesh_lib.data_parallel_mesh()
+        return mesh, jax.jit(compat.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        ))
+
+    def test_quantized_ici_scatter_matches_flat_within_quantum(self):
+        hvt.init()
+        mesh = mesh_lib.data_parallel_mesh()
+        dp = mesh.shape["data"]
+        P = jax.sharding.PartitionSpec
+        tree = self._tree()
+        outspec = {
+            k: (P() if collectives.zero1_shard_dim(v.shape, dp) is None
+                else collectives.zero1_partition_spec(v.shape, dp))
+            for k, v in tree.items()
+        }
+
+        def mk(d, ici=None):
+            def red(g):
+                return collectives.reduce_gradients(
+                    g, data_axis="data", extra_axes=("fsdp",), dcn=d,
+                    ici_wire_dtype=ici, scatter=dp,
+                )
+
+            return jax.jit(compat.shard_map(
+                red, mesh=mesh, in_specs=(P(),), out_specs=outspec,
+                check_vma=False,
+            ))
+
+        flat = jax.device_get(mk(1)(tree))
+        quant = jax.device_get(mk(2, jnp.int8)(tree))
+        for k in tree:
+            a, b = np.asarray(quant[k]), np.asarray(flat[k])
+            denom = np.abs(b).max() + 1e-6
+            assert np.abs(a - b).max() / denom < 0.02, k
+        # Structural: hop 1 is the quantized reduce-scatter (an i8
+        # all-to-all + scale gather), hop 2 a plain f32 psum_scatter —
+        # and NO full-payload all-reduce anywhere.
+        text = mk(2, jnp.int8).lower(tree).as_text()
+        ops = hlo_audit.collective_ops(text)
+        kinds = [(o.kind, o.dtype) for o in ops if not o.scalar]
+        assert ("all-to-all", "i8") in kinds, kinds
+        assert any(
+            k == "reduce-scatter" and d == "f32" for k, d in kinds
+        ), kinds
+        assert not any(
+            o.kind == "all-reduce" and not o.scalar for o in ops
+        ), kinds
+
+    def test_bf16_ici_wire_casts_hop_one(self):
+        hvt.init()
+        mesh = mesh_lib.data_parallel_mesh()
+        dp = mesh.shape["data"]
+        P = jax.sharding.PartitionSpec
+        tree = {"k1": np.ones((64, 32), np.float32)}
+
+        def red(g):
+            return collectives.reduce_gradients(
+                g, data_axis="data", extra_axes=("fsdp",), dcn=2,
+                ici_wire_dtype=jnp.bfloat16, scatter=dp,
+            )
+
+        f = jax.jit(compat.shard_map(
+            red, mesh=mesh, in_specs=(P(),),
+            out_specs={"k1": collectives.zero1_partition_spec(
+                (64, 32), dp
+            )},
+            check_vma=False,
+        ))
+        rs = [
+            op.dtype for op in hlo_audit.collective_ops(
+                f.lower(tree).as_text()
+            ) if op.kind == "reduce-scatter"
+        ]
+        # hop 1 bf16 (ICI wire), hop 2 f32 (no DCN wire).
+        assert sorted(set(rs)) == ["bf16", "f32"], rs
+
+    def test_ici_only_error_mass_identity(self):
+        """With ONLY the ICI hop quantized (residual consumed at the
+        first quantized hop, hop 2 an exact psum), the global identity
+        holds exactly: summed over shards, the returned errors equal
+        (true sum + residual mass − delivered sum)."""
+        rng = np.random.RandomState(3)
+        v = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+        r = jnp.asarray(rng.randn(8, 64).astype(np.float32) * 0.1)
+        P = jax.sharding.PartitionSpec
+        sharded = P(("data", "fsdp"))
+
+        def red(x, res):
+            return collectives._hierarchical_psum_err(
+                x, "data", 2, extra_axes=("fsdp",),
+                ici_wire_dtype=jnp.int8, residual=res,
+            )
+
+        _, f = self._shard_map(
+            red, (sharded, sharded), (sharded, sharded)
+        )
+        total, err = jax.device_get(f(v, r))
+        true = np.asarray(v).sum(axis=0) + np.asarray(r).sum(axis=0)
+        np.testing.assert_allclose(
+            err.sum(axis=0), true - total[0], rtol=1e-4, atol=1e-4
+        )
+
+    def test_per_hop_error_mass_identity_both_hops(self):
+        """Per-HOP charging with BOTH hops quantized. The DCN hop runs
+        redundantly in each of the ``ici`` dcn-groups (every group sees
+        the same hop-1 outputs once the residual is consumed at hop 1,
+        so every shard agrees on the delivered gradient), and each group
+        charges its own copy of the hop-2 error — so the exact global
+        identity is
+
+            Σ_s err_s = (true + residual − h) + ici · (h − delivered)
+
+        where ``h`` is the hop-1 (ICI-quantized) partial total,
+        measured by running the SAME reduction with the DCN hop exact
+        (deterministic quantization → identical hop-1 outputs)."""
+        rng = np.random.RandomState(3)
+        v = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+        r = jnp.asarray(rng.randn(8, 64).astype(np.float32) * 0.1)
+        P = jax.sharding.PartitionSpec
+        sharded = P(("data", "fsdp"))
+
+        def red(wire):
+            def f(x, res):
+                return collectives._hierarchical_psum_err(
+                    x, "data", 2, extra_axes=("fsdp",),
+                    wire_dtype=wire, ici_wire_dtype=jnp.int8,
+                    residual=res,
+                )
+
+            return f
+
+        _, both = self._shard_map(
+            red(jnp.int8), (sharded, sharded), (sharded, sharded)
+        )
+        _, ici_only = self._shard_map(
+            red(None), (sharded, sharded), (sharded, sharded)
+        )
+        total, err = jax.device_get(both(v, r))
+        h = jax.device_get(ici_only(v, r))[0][0]  # exact hop-2 of hop-1
+        ici = 8 // 2
+        true = np.asarray(v).sum(axis=0) + np.asarray(r).sum(axis=0)
+        want = (true - h) + ici * (h - total[0])
+        np.testing.assert_allclose(
+            err.sum(axis=0), want, rtol=1e-4, atol=1e-4
+        )
+        # Residual consumed at hop 1 => every shard agrees on the
+        # delivered gradient (no per-dcn-group divergence).
+        np.testing.assert_array_equal(total, np.broadcast_to(
+            total[0], total.shape
+        ))
+
+    def test_residual_flushes_on_exact_wire(self):
+        """A residual with no quantized hop anywhere is transmitted in
+        full and comes back zero — mass conserved, never dropped."""
+        rng = np.random.RandomState(4)
+        v = jnp.asarray(rng.randn(8, 32).astype(np.float32))
+        r = jnp.asarray(rng.randn(8, 32).astype(np.float32) * 0.1)
+        P = jax.sharding.PartitionSpec
+        sharded = P(("data", "fsdp"))
+
+        def red(x, res):
+            out, err = collectives.reduce_gradients(
+                {"v": x}, data_axis="data", extra_axes=("fsdp",),
+                residual={"v": res},
+            )
+            return out["v"], err["v"]
+
+        _, f = self._shard_map(
+            red, (sharded, sharded), (sharded, sharded)
+        )
+        total, err = jax.device_get(f(v, r))
+        true = np.asarray(v).sum(axis=0) + np.asarray(r).sum(axis=0)
+        np.testing.assert_allclose(total[0], true, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(err, 0.0, atol=0.0)
+
+    def test_scatter_residual_requires_a_quantized_hop(self):
+        with pytest.raises(ValueError, match="quantized wire"):
+            collectives._reduce_gradients_scatter(
+                {"k1": jnp.ones((64, 32))}, 8, data_axis="data",
+                extra_axes=(), dcn=1, wire_dtype=None,
+                ici_wire_dtype=jnp.bfloat16, bucket_bytes=None,
+                reverse=False, residual={"k1": jnp.ones((64, 32))},
+            )
+
+    def test_optimizer_tags_and_rejections(self):
+        tx = hvt.DistributedOptimizer(
+            optax.adam(1e-3), compression_ici="int8"
+        )
+        from horovod_tpu.training.optimizer import compression_ici_dtype
+
+        assert compression_ici_dtype(tx) == jnp.int8
+        # A quantized ICI hop alone turns error feedback on.
+        assert compression_error_feedback(tx)
+        with pytest.raises(ValueError, match="compression_ici"):
+            hvt.DistributedOptimizer(
+                optax.adam(1e-3), compression_ici="int4"
+            )
+        with pytest.raises(ValueError, match="axis_name"):
+            hvt.DistributedOptimizer(
+                optax.adam(1e-3), compression_ici="int8",
+                axis_name="data",
+            )
 
 
 class TestQuantizedTwoShot:
@@ -431,6 +741,74 @@ class TestQuantizedTwoShot:
                 jnp.ones(8), "data", jnp.int8,
                 axis_index_groups=[[0, 1], [2, 3]],
             )
+
+
+class TestBenchZero1Gates:
+    """Pure-function units for the new bench gates (the wall-clock
+    overlap gate and MFU-denominator guard run in bench.py's main;
+    their decision logic is unit-tested here)."""
+
+    def _bench(self):
+        import importlib.util
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py",
+        )
+        spec = importlib.util.spec_from_file_location("_bench_mod", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_flops_guard_accepts_peel_structure(self):
+        bench = self._bench()
+        micro = 1e9
+        # K=4 overlap on: first microbatch + peeled last + scan body =
+        # 3 statically counted microbatches; the compiled count sits in
+        # [2.5, 3.5] x micro.
+        g = bench._flops_guard(4, True, micro, 2.9e9)
+        assert g["ok"] and g["counted_microbatches"] == 3
+        # K=4 overlap off: first + scan body = 2.
+        g2 = bench._flops_guard(4, False, micro, 2.1e9)
+        assert g2["ok"] and g2["counted_microbatches"] == 2
+
+    def test_flops_guard_catches_structure_drift(self):
+        bench = self._bench()
+        micro = 1e9
+        # Peel silently gone: the program statically counts one less
+        # microbatch than the overlap-on structure implies.
+        assert not bench._flops_guard(4, True, micro, 1.9e9)["ok"]
+        # Scan silently unrolled: every microbatch counted.
+        assert not bench._flops_guard(4, True, micro, 4.2e9)["ok"]
+
+    def test_flops_guard_skips_without_cost_model(self):
+        bench = self._bench()
+        g = bench._flops_guard(4, True, None, None)
+        assert g["ok"] and g["skipped"]
+        assert bench._flops_guard(1, True, 1e9, 1e9)["skipped"]
+
+    def test_peak_flops_override_resolves_without_calibration(self,
+                                                              monkeypatch):
+        bench = self._bench()
+        monkeypatch.setenv("HVT_PEAK_FLOPS", "1.5e12")
+        peak, src = bench._resolve_peak_flops()
+        assert peak == 1.5e12 and src == "override"
+
+    def test_unparseable_peak_override_is_loud(self, monkeypatch):
+        from horovod_tpu.analysis import registry
+
+        monkeypatch.setenv("HVT_PEAK_FLOPS", "fast")
+        with pytest.raises(ValueError):
+            registry.get_float("HVT_PEAK_FLOPS")
+
+    def test_peak_table_override_reaches_trace_mfu(self, monkeypatch):
+        from horovod_tpu import trace
+
+        monkeypatch.setenv("HVT_PEAK_FLOPS", "2e12")
+        assert trace.device_peak_flops() == 2e12
+        # mfu divides by the override: 1e12 FLOP in 1 s on 1 chip.
+        assert trace.mfu(1e12, 1.0, 1) == pytest.approx(0.5)
 
 
 class TestComposedStateSurfaces:
