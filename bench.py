@@ -92,42 +92,18 @@ def _resolve_peak_flops() -> tuple:
     """(per-chip peak FLOP/s, source) for the MFU denominator — every
     BENCH_* row must carry a non-null MFU trend number.
 
-    Resolution order: the explicit ``HVT_PEAK_FLOPS`` override (the
-    registry knob; an unparseable value exits 2 in main()), the built-in
-    TPU peak table (`trace.device_peak_flops`), and finally a measured
-    matmul calibration on THIS host (best-of-3 chained f32 matmuls) —
-    the honest trend denominator for device kinds with no published
-    peak, e.g. the CPU CI topology. The calibrated value is exported
-    back into ``HVT_PEAK_FLOPS`` so every leg of the run divides by the
-    same number."""
-    import jax
-    import jax.numpy as jnp
-
+    Resolution order (implemented in `trace.resolve_peak_flops`, which
+    the live trainer MFU gauge shares so both surfaces divide by the
+    same number): the explicit ``HVT_PEAK_FLOPS`` override (an
+    unparseable value exits 2 in main()), the built-in TPU peak table,
+    and finally a measured matmul calibration on THIS host (best-of-3
+    chained f32 matmuls) — the honest trend denominator for device kinds
+    with no published peak, e.g. the CPU CI topology. The calibrated
+    value is exported back into ``HVT_PEAK_FLOPS`` so every leg of the
+    run divides by the same number."""
     from horovod_tpu import trace
-    from horovod_tpu.analysis import registry
 
-    if registry.get_raw("HVT_PEAK_FLOPS") is not None:
-        return float(registry.get_float("HVT_PEAK_FLOPS")), "override"
-    peak = trace.device_peak_flops()
-    if peak:
-        return peak, "table"
-    n = int(os.environ.get("BENCH_PEAK_CALIB_N", 1024))
-    a = jnp.ones((n, n), jnp.float32)
-    b = jnp.ones((n, n), jnp.float32)
-    f = jax.jit(lambda a, b: (a @ b).sum())
-    float(jax.device_get(f(a, b)))  # compile + settle
-    reps = 8
-
-    def chain():
-        t = jnp.float32(0)
-        for _ in range(reps):
-            t = t + f(a, b)
-        return t
-
-    best = min(_timed(chain) for _ in range(3)) / reps
-    peak = 2.0 * n ** 3 / best
-    os.environ["HVT_PEAK_FLOPS"] = f"{peak:.6g}"
-    return peak, "calibrated"
+    return trace.resolve_peak_flops(calibrate=True)
 
 
 def _lm_from_env(*, moe: bool = False):
@@ -937,6 +913,134 @@ def bench_accum() -> dict:
     }
 
 
+def _sampler_overhead(hvt, module, x, y, K, compression, compression_ici,
+                      bucket_bytes, global_batch):
+    """A/B the live `StepPhaseSampler` (ISSUE 13): its steady-state cost
+    must be <= BENCH_SAMPLER_MAX_OVERHEAD_PCT (default 2%) of
+    ``step_ms.total`` on the composed zero1 step, at the sampler's real
+    cadence (``HVT_METRICS_EVERY``). Two measured components:
+
+    * the per-window drain/publish cost, measured as a wall-clock A/B:
+      both legs run the SAME python per-step dispatch loop (one
+      sampling window each, so paired legs are temporally adjacent),
+      alternating which leg goes first, gated on the MEDIAN of
+      per-pair relative differences — differencing two multi-second
+      wall-clock quantities to sub-percent precision is drift-limited
+      on a shared CPU host, and the median of adjacent-pair ratios is
+      the estimator that survives it (min-of-legs compares bests from
+      minutes apart and measured the drift, not the sampler);
+    * the periodic isolated-reduction re-time (every ``comm_refresh``
+      samples — short legs rarely land on a refresh, and min-of-pairs
+      would systematically select a refresh-free leg), added
+      ANALYTICALLY from the sampler's own measured ``_comm_s`` amortized
+      over its true cadence: ``comm_s / (comm_refresh x every)`` per
+      step. The sum bounds the steady-state per-step overhead.
+
+    Returns (every, overhead_pct, gate_ok). The sampler's one-time
+    warmups (reduction-program compile, step cost analysis, peak
+    calibration) run before any timed leg — setup cost, not per-step
+    overhead."""
+    import jax
+    import numpy as np
+    import optax
+
+    from horovod_tpu.analysis import registry
+    from horovod_tpu.training.trainer import StepPhaseSampler
+
+    every = registry.get_int("HVT_METRICS_EVERY") or 32
+    max_pct = float(os.environ.get("BENCH_SAMPLER_MAX_OVERHEAD_PCT", 2.0))
+    trainer = hvt.Trainer(
+        module,
+        hvt.DistributedOptimizer(
+            optax.adam(hvt.scale_lr(1e-3)),
+            backward_passes_per_step=K,
+            average_aggregated_gradients=True,
+            compression=compression,
+            compression_ici=compression_ici,
+        ),
+        loss="sparse_categorical_crossentropy",
+        shard_update=True,
+        bucket_bytes=bucket_bytes,
+    )
+    rng = np.random.RandomState(7)
+
+    def step_batch():
+        micro = [
+            (lambda idx: (x[idx], y[idx]))(
+                rng.randint(0, len(x), size=global_batch)
+            )
+            for _ in range(K)
+        ]
+        return tuple(np.stack([m[i] for m in micro]) for i in range(2))
+
+    state = trainer.build(x[: trainer.dp_size])
+    scale = np.float32(1.0)
+    zero_acc = {m: np.float32(0) for m in trainer.metric_names}
+    dev = trainer._shard_chunk(step_batch(), 1)
+    step = trainer._train_step  # non-donating: dev is reused across steps
+    state, _, _ = step(state, dev, scale, zero_acc)
+    jax.block_until_ready(state)
+    sampler = StepPhaseSampler(trainer, global_batch * K, every=every)
+    sampler.capture_step_args(step, (state, dev, scale, zero_acc), 1)
+    # Two forced samples: the first opens the window and pays every
+    # one-time warmup, the second exercises the full sample path once.
+    sampler.maybe_sample(state, every)
+    sampler.maybe_sample(state, every)
+    def leg(with_sampler: bool, n: int) -> float:
+        nonlocal state
+        jax.block_until_ready(state)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, _, _ = step(state, dev, scale, zero_acc)
+            if with_sampler:
+                sampler.maybe_sample(state, 1)
+        jax.block_until_ready(state)
+        return time.perf_counter() - t0
+
+    # Legs are WHOLE sampling windows (each ON window carries exactly
+    # one drain/publish edge), sized to >= ~4 s of wall clock: the
+    # ON/OFF ratio is window-count invariant, and relative timing noise
+    # on a shared CPU host only comes down with leg length.
+    window_s = leg(False, every)  # settle + window-duration probe
+    m = max(1, int(4.0 / max(window_s, 1e-9)) if window_s < 4.0 else 1)
+    n = m * every
+    leg(True, n)  # settle the sampler path at the final leg length
+    pairs_min = max(3, int(os.environ.get("BENCH_SAMPLER_PAIRS", 5)))
+    pairs_cap = max(pairs_min, int(os.environ.get(
+        "BENCH_SAMPLER_MAX_PAIRS", 9
+    )))
+    diffs, t_offs = [], []
+    while True:
+        # Alternate which leg goes first: monotone machine drift
+        # (thermal, cache warming) otherwise systematically favors
+        # whichever leg always runs second.
+        p = len(diffs)
+        order = (False, True) if p % 2 == 0 else (True, False)
+        t = {}
+        for with_sampler in order:
+            t[with_sampler] = leg(with_sampler, n)
+        diffs.append((t[True] - t[False]) / t[False] * 100.0)
+        t_offs.append(t[False])
+        if len(diffs) >= pairs_min:
+            med = sorted(diffs)[len(diffs) // 2]
+            spread = sorted(abs(d - med) for d in diffs)[len(diffs) // 2]
+            # Adaptive stop: keep adding pairs until the median is
+            # stable (median absolute deviation <= 0.75%) or the cap is
+            # hit — a 2% gate needs sub-percent resolution.
+            if spread <= 0.75 or len(diffs) >= pairs_cap:
+                break
+    drain_pct = sorted(diffs)[len(diffs) // 2]
+    # Amortized comm re-time (see docstring): one isolated reduction
+    # every comm_refresh x every steps, against the OFF leg's step time.
+    sec_per_step = min(t_offs) / n
+    comm_pct = (
+        sampler._comm_s / (sampler.comm_refresh * every * sec_per_step)
+        * 100.0
+    )
+    overhead_pct = drain_pct + comm_pct
+    return every, round(overhead_pct, 3), overhead_pct <= max_pct
+
+
 def bench_zero1() -> dict:
     """ZeRO-1 composition A/B (``shard_update`` on/off x K x overlap):
     the sharded weight update composed with accumulation (and, via
@@ -1225,6 +1329,12 @@ def bench_zero1() -> dict:
         and wire["zero1"]["k1"] <= wire["replicated"]["k1"]
     )
     wire_ok = not_more if quantized else strictly_fewer
+    sampler_every, sampler_overhead_pct, sampler_gate_ok = (
+        _sampler_overhead(
+            hvt, Mlp(), x, y, K, compression, compression_ici,
+            bucket_bytes, global_batch,
+        )
+    )
     return {
         "mfu": round(mfu, 4) if mfu is not None else None,
         "metric": "zero1_train_examples_per_sec_per_chip",
@@ -1250,6 +1360,9 @@ def bench_zero1() -> dict:
         },
         "flops_per_opt_step": flops_per_opt_step,
         "flops_guard": flops_guard,
+        "sampler_every": sampler_every,
+        "sampler_overhead_pct": sampler_overhead_pct,
+        "sampler_gate_ok": sampler_gate_ok,
         "compression": compression,
         "compression_ici": compression_ici,
         "peak_flops_per_chip": peak_flops,
@@ -1833,6 +1946,19 @@ def main() -> None:
             f"structure ({result.get('flops_guard')}); the MFU "
             "denominator (K x the K=1 compile) no longer matches the "
             "compiled step",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    if result.get("sampler_gate_ok") is False:
+        import sys
+
+        print(
+            "bench: live StepPhaseSampler overhead "
+            f"{result.get('sampler_overhead_pct')}% exceeds the "
+            f"{os.environ.get('BENCH_SAMPLER_MAX_OVERHEAD_PCT', 2.0)}% "
+            "budget on step_ms.total at "
+            f"every={result.get('sampler_every')} — the trainer-side "
+            "metrics exporter is too expensive to leave on",
             file=sys.stderr,
         )
         sys.exit(1)

@@ -184,6 +184,22 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_METRICS_DIR", "path", None, "observability",
          "Metrics-stream directory (default: $PS_MODEL_PATH, else "
          "./models)."),
+    Knob("HVT_METRICS_PORT", "int", None, "observability",
+         "Opt-in trainer-side Prometheus exporter: every training "
+         "process serves GET /metrics (live step-phase/MFU gauges) and "
+         "POST /profile?seconds=N (on-demand jax.profiler capture) on "
+         "port N + local_rank; 0 binds an ephemeral port; unset = off."),
+    Knob("HVT_METRICS_EVERY", "int", 32, "observability",
+         "Step-phase sampling cadence in optimizer steps for the "
+         "trainer exporter: every N steps the fit loop drains the "
+         "pipeline once and refreshes the step_ms{total,compute,comm,"
+         "input} / examples-per-sec / MFU gauges (bench A/B-gates the "
+         "overhead at <= 2% of step time)."),
+    Knob("HVT_TRACE_DIR", "path", None, "observability",
+         "Structured trace-span directory: nestable JSONL span records "
+         "(step, reduction, commit, rescale, checkpoint-save), one "
+         "rank-tagged file per process (trace.span); also the landing "
+         "dir for POST /profile captures. Unset = spans off."),
     # --- testing / chaos ----------------------------------------------------
     Knob("HVT_FAULT", "spec", None, "testing",
          "Deterministic fault injection, `rank:epoch[.step]:kind` (kinds "

@@ -33,6 +33,11 @@ designed around, loudly, in CHANGES.md/docstrings) — not generic style:
   entry point (`collectives.reduce_gradients`), never a raw per-leaf
   psum — the guardrail ROADMAP item 3's reduce-scatter refactor builds
   on.
+* HVT009 — metric-registry discipline: every ``obs.counter/gauge/
+  histogram`` emission site must name a series declared in
+  `obs/core.py` (the HVT004 pattern for the /metrics surface), and no
+  ``obs.*`` call may sit inside a jit/shard_map-traced body (a host
+  effect — the HVT003 class).
 
 Rules are interprocedural where the bug class demands it (HVT001 taints
 rank-gated CALLS whose callee transitively issues a collective; HVT007
@@ -732,6 +737,120 @@ class ReductionComposition(Rule):
                         "reduce-scatter composition (ROADMAP item 3)",
                     )
                     break
+
+
+# --- HVT009 -----------------------------------------------------------------
+
+# The obs emission verbs (module-level functions AND Registry methods).
+_OBS_EMITTERS = {"counter", "counter_set", "gauge", "histogram"}
+# A call resolving into the obs package's emission surface:
+# `obs.counter(...)`, `horovod_tpu.obs.gauge(...)`, `obs.core.histogram`.
+_OBS_CALL_RE = re.compile(
+    r"(^|\.)obs(\.[a-z_]+)*\.(counter|counter_set|gauge|histogram)$"
+)
+# Any call into the obs package at all (the traced-body check casts the
+# wider net: render/collect/server calls are host effects too).
+_OBS_ANY_RE = re.compile(r"(^|\.)obs(\.[a-z_]+)*\.[a-z_]+$")
+
+
+def _obs_metric_literal(module: ModuleSource, call: ast.Call):
+    """The metric-name string literal of an obs emission call, or None
+    when this call is not an emission site / the name is dynamic.
+
+    Two shapes count as emission sites: calls resolving into the obs
+    package's module-level verbs (import-alias-resolved), and
+    ``<anything>.counter/gauge/...("hvt_*", ...)`` method calls — a
+    `Registry` instance can't be typed statically, so the ``hvt_``
+    naming convention is the discriminator (every declared metric
+    carries it; no other API in this repo spells that shape)."""
+    resolved = resolved_dotted(module, call.func)
+    is_obs = resolved is not None and _OBS_CALL_RE.search(resolved)
+    lit = None
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        lit = call.args[0].value
+    if not is_obs:
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _OBS_EMITTERS
+            and lit is not None
+            and lit.startswith("hvt_")
+        ):
+            is_obs = True
+    return lit if is_obs else None
+
+
+@register_rule
+class MetricRegistryDiscipline(Rule):
+    rule_id = "HVT009"
+    title = "undeclared metric name, or obs emission inside a traced body"
+    rationale = (
+        "`horovod_tpu/obs/core.py` is the single declaration point for "
+        "every exported metric series (the HVT004 pattern for the "
+        "/metrics surface): an emission site naming an undeclared "
+        "series either typos an existing one (a gauge that silently "
+        "never lands where the dashboard looks) or ships a series "
+        "missing from the catalog/HELP text — the instruments refuse it "
+        "at runtime, this rule refuses it at lint time. And any "
+        "`obs.*` call inside a jit/pjit/shard_map/scan body is a host "
+        "effect executed ONCE at trace time (the HVT003 class): the "
+        "gauge would freeze at its trace-time value while looking live."
+    )
+    provenance = (
+        "ISSUE 13 (one-pane-of-glass telemetry registry), extending the "
+        "PR 6 registry discipline to the metric export surface."
+    )
+    example = (
+        "obs.gauge(\"hvt_stpe_ms\", v)   # typo'd, undeclared\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    obs.counter(\"hvt_optimizer_steps_total\")  # traced host "
+        "effect\n"
+        "    return x\n"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        from horovod_tpu.obs import core as obs_core
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            metric = _obs_metric_literal(module, node)
+            if metric is not None and not obs_core.is_declared(metric):
+                yield module.finding(
+                    self.rule_id, node,
+                    f"metric `{metric}` is not declared in "
+                    "horovod_tpu/obs/core.py — add a MetricSpec row "
+                    "(kind, help, subsystem, labels, buckets) so the "
+                    "/metrics catalog stays the single source of truth "
+                    "(the instruments refuse undeclared names at "
+                    "runtime too)",
+                )
+        reported: set[tuple[int, int]] = set()
+        for root in _collect_traced_roots(module):
+            body = root.body if isinstance(root.body, list) else [root.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    resolved = resolved_dotted(module, node.func)
+                    if resolved is None or not _OBS_ANY_RE.search(resolved):
+                        continue
+                    key = (node.lineno, node.col_offset)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield module.finding(
+                        self.rule_id, node,
+                        f"`{resolved}(...)` inside a traced "
+                        "(jit/scan/shard_map) function — metric "
+                        "emission is a host effect that runs ONCE at "
+                        "trace time (the HVT003 class), so the series "
+                        "would freeze at its trace-time value while "
+                        "looking live; emit from the host-side loop "
+                        "around the step instead",
+                    )
 
 
 if __name__ == "__main__":

@@ -191,18 +191,21 @@ def save(path: str, state: PyTree, progress: tuple | None = None,
             "checkpoint.save_sharded(path, state) from every process — "
             "save_checkpoint/ModelCheckpoint select it automatically."
         )
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    data = serialization.to_bytes(jax.device_get(state))
-    _atomic_write(path, data, digest=True)
-    if progress is not None:
-        epoch, step = progress
-        meta = {
-            "epoch": int(epoch), "step": int(step),
-            "payload_sha256": hashlib.sha256(data).hexdigest(),
-        }
-        if cursor is not None:
-            meta["cursor"] = dict(cursor)
-        _atomic_write(path + META_SUFFIX, json.dumps(meta).encode())
+    from horovod_tpu import trace
+
+    with trace.span("checkpoint_save", path=os.path.basename(path)):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        data = serialization.to_bytes(jax.device_get(state))
+        _atomic_write(path, data, digest=True)
+        if progress is not None:
+            epoch, step = progress
+            meta = {
+                "epoch": int(epoch), "step": int(step),
+                "payload_sha256": hashlib.sha256(data).hexdigest(),
+            }
+            if cursor is not None:
+                meta["cursor"] = dict(cursor)
+            _atomic_write(path + META_SUFFIX, json.dumps(meta).encode())
     return path
 
 
@@ -353,21 +356,25 @@ def save_sharded(path: str, state: PyTree,
     without names a same-shape rename/reorder would restore silently
     swapped); host-side (non-array) leaves go in the primary's shard
     file."""
+    from horovod_tpu import trace
+
     paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(state)
     leaves = [l for _, l in paths_and_leaves]
     os.makedirs(path, exist_ok=True)
     payload = {}
-    for i, leaf in enumerate(leaves):
-        if isinstance(leaf, jax.Array):
-            for spec, piece in leaf_shard_pieces(leaf).items():
-                payload[f"{i}|{spec}"] = piece
-        elif runtime.is_primary():
-            payload[f"{i}|host"] = np.asarray(leaf)
-    _atomic_write(
-        os.path.join(path, f"shard-{jax.process_index()}.msgpack"),
-        serialization.msgpack_serialize(payload),
-        digest=True,
-    )
+    with trace.span("checkpoint_save", path=os.path.basename(path),
+                    sharded=True):
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, jax.Array):
+                for spec, piece in leaf_shard_pieces(leaf).items():
+                    payload[f"{i}|{spec}"] = piece
+            elif runtime.is_primary():
+                payload[f"{i}|host"] = np.asarray(leaf)
+        _atomic_write(
+            os.path.join(path, f"shard-{jax.process_index()}.msgpack"),
+            serialization.msgpack_serialize(payload),
+            digest=True,
+        )
     if runtime.is_primary():
         index = {
             "format": 1,

@@ -553,6 +553,7 @@ class Coordinator:
     def snapshot(self) -> dict:
         """JSON-safe control-plane state (the ``state`` protocol command and
         the supervisor's journal/teardown view)."""
+        now = time.monotonic()
         with self._lock:
             return {
                 "generation": self.generation,
@@ -566,6 +567,12 @@ class Coordinator:
                         "host": m.host, "status": m.status,
                         "reason": m.reason, "rank": m.rank,
                         "progress": m.progress,
+                        # Seconds since the last TCP beat (coordinator
+                        # clock) — the /metrics heartbeat-age gauge; live
+                        # members only (a left/dead member's age is
+                        # meaningless and would only grow forever).
+                        "beat_age_s": round(now - m.last_beat, 3)
+                        if m.status == "live" else None,
                     }
                     for m in self.members.values()
                 },

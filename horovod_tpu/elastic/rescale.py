@@ -93,10 +93,16 @@ def run(
     client = client or ElasticClient(address, member_id)
     state = state or ElasticState()
     state.client = client
+    from horovod_tpu import trace
+
     for _ in range(max_generations):
-        world = client.sync(progress=state.progress)
-        ensure_world(world)
-        state.sync(world.root_rank)
+        # One span per rescale boundary: rendezvous wait + runtime
+        # rebuild + state sync — the wall-clock a membership change
+        # costs this worker before training resumes.
+        with trace.span("rescale"):
+            world = client.sync(progress=state.progress)
+            ensure_world(world)
+            state.sync(world.root_rank)
         try:
             result = train_fn(state, world)
         except HostsUpdatedInterrupt:

@@ -226,11 +226,15 @@ class ElasticState:
         (`ShardedLeaf` — the `save_sharded` replica-0 dedup), keeping the
         commit communication-free; `gather_committed` reassembles them
         into dense host arrays at the membership-change boundary."""
-        self._committed = {
-            k: jax.tree_util.tree_map(_snap_leaf, getattr(self, k))
-            for k in self._tracked
-        }
-        self.commits += 1
+        from horovod_tpu import trace
+
+        with trace.span("commit", epoch=int(self.epoch),
+                        step=int(self.step)):
+            self._committed = {
+                k: jax.tree_util.tree_map(_snap_leaf, getattr(self, k))
+                for k in self._tracked
+            }
+            self.commits += 1
 
     @property
     def has_sharded_commit(self) -> bool:

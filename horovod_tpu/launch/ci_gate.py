@@ -89,6 +89,38 @@ def check_metrics(
     return lo <= value <= hi, value
 
 
+def run_prom_checks(prom_path: str, checks: dict) -> bool:
+    """Evaluate a ``{series: {target}}`` block against a Prometheus text
+    exposition dump (the supervisor's final scrape,
+    `supervisor.dump_metrics`) — the job-spec ``metrics_checks:`` gate,
+    same ``lo..hi`` grammar as ``checks:``. A missing dump, an unparseable
+    dump, or an ABSENT series all fail loudly: a run whose metrics never
+    landed must not pass a metrics gate."""
+    from horovod_tpu.obs import prom
+
+    if not prom_path or not os.path.exists(prom_path):
+        print(f"metrics check: exposition dump {prom_path} not found FAIL")
+        return False
+    try:
+        with open(prom_path) as f:
+            values = prom.parse_text(f.read())
+    except ValueError as e:
+        print(f"metrics check: unparseable exposition dump ({e}) FAIL")
+        return False
+    ok = True
+    for name, rule in checks.items():
+        lo, hi = parse_target(str(rule["target"]))
+        value = values.get(name)
+        passed = value is not None and lo <= value <= hi
+        shown = "absent" if value is None else f"{value:.6g}"
+        print(
+            f"metrics check {name}: value={shown} target={rule['target']} "
+            f"{'PASS' if passed else 'FAIL'}"
+        )
+        ok = ok and passed
+    return ok
+
+
 def run_checks(metrics_path: str, checks: dict) -> bool:
     """Evaluate a ``{name: {target, aggregate}}`` block (the config.yaml:8-11
     shape), printing one verdict line per check. Shared by the CLI and the

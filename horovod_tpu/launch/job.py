@@ -136,7 +136,7 @@ def run_job(spec_path: str) -> int:
             {k: v for k, v in restart.items() if k != "log"}
         )
         log_path = restart.get("log") or supervisor.default_log_path(env)
-        _reset_journal(log_path)  # stale-journal hygiene, as below
+        _reset_journal(log_path, supervisor.default_model_dir(env))
         if hosts:
             code = supervisor.supervise_elastic_hosts(
                 list(hosts), argv, env=env, policy=policy, elastic=elastic,
@@ -167,7 +167,7 @@ def run_job(spec_path: str) -> int:
         log_path = restart.get("log") or supervisor.default_log_path(env)
         # Same hygiene as the metrics stream above: a previous run's
         # restart journal must not feed this run's log/gate.
-        _reset_journal(log_path)
+        _reset_journal(log_path, supervisor.default_model_dir(env))
         if hosts:
             code = supervisor.supervise_hosts(
                 list(hosts), argv, env=env, policy=policy,
@@ -210,6 +210,29 @@ def run_job(spec_path: str) -> int:
         if not ci_gate.run_checks(log_path, journal_checks):
             return 1
 
+    # `metrics_checks:` — gate the supervisor's FINAL Prometheus scrape
+    # (dumped to <PS_MODEL_PATH>/metrics.prom at teardown — the same
+    # series GET /metrics serves live), so the one-pane-of-glass metrics
+    # join the journal as gateable job outputs:
+    #   metrics_checks:
+    #     hvt_committed_step: {target: "1..1000000"}
+    #     hvt_restarts_total: {target: "0..0"}
+    # Requires a supervised launch (restart:/elastic: block) — the dump
+    # is the supervisor's; without one the gate fails loudly.
+    metrics_checks = spec.get("metrics_checks") or {}
+    if metrics_checks:
+        if not log_path:
+            print("metrics_checks: needs a restart:/elastic: block "
+                  "(no supervisor metrics dump was written)")
+            return 1
+        from horovod_tpu.launch import supervisor
+
+        prom_path = supervisor.default_metrics_dump_path(
+            supervisor.default_model_dir(env), log_path
+        )
+        if not ci_gate.run_prom_checks(prom_path, metrics_checks):
+            return 1
+
     if not checks:
         return 0
     if hosts:
@@ -219,13 +242,21 @@ def run_job(spec_path: str) -> int:
     return 0 if ci_gate.run_checks(metrics_path, checks) else 1
 
 
-def _reset_journal(log_path: str | None) -> None:
+def _reset_journal(log_path: str | None, model_dir: str | None = None) -> None:
     """Remove a previous run's restart journal AND its rotated ``.1``
     predecessor — the gate reads across the rotation boundary, so a stale
-    predecessor could feed this run's journal checks."""
+    predecessor could feed this run's journal checks. The supervisor's
+    final metrics dump (``metrics.prom``) gets the same hygiene: a stale
+    dump must not feed this run's ``metrics_checks:``."""
     if not log_path:
         return
-    for p in (log_path, log_path + ".1"):
+    from horovod_tpu.launch import supervisor
+
+    paths = [log_path, log_path + ".1"]
+    prom = supervisor.default_metrics_dump_path(model_dir, log_path)
+    if prom:
+        paths.append(prom)
+    for p in paths:
         if os.path.exists(p):
             os.remove(p)
 

@@ -306,6 +306,13 @@ def main(argv: list[str] | None = None) -> int:
             "itself). Needs a supervised launch (any restart/elastic "
             "flag)")
         p.add_argument(
+            "--metrics-port", type=int, default=None, metavar="N",
+            help="opt-in trainer-side Prometheus exporters: export "
+            "HVT_METRICS_PORT=N to the ranks, so every training process "
+            "serves GET /metrics (live step-phase/MFU gauges) and "
+            "POST /profile?seconds=S on port N + local_rank. The "
+            "supervisor's own aggregate /metrics rides --status-port")
+        p.add_argument(
             "--restart-log", default=None, metavar="PATH",
             help="JSONL restart journal (default: "
             "$PS_MODEL_PATH/restarts.jsonl; gateable — "
@@ -386,6 +393,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "run":
         env = dict(kv.split("=", 1) for kv in args.env)
+        if args.metrics_port is not None:
+            env["HVT_METRICS_PORT"] = str(args.metrics_port)
         policy = restart_policy(args)
         elastic = elastic_policy(args)
         if elastic is not None:
@@ -422,6 +431,8 @@ def main(argv: list[str] | None = None) -> int:
         else:
             parser.error("pod needs --hostfile or --hosts")
         env = dict(kv.split("=", 1) for kv in args.env)
+        if args.metrics_port is not None:
+            env["HVT_METRICS_PORT"] = str(args.metrics_port)
         policy = restart_policy(args)
         elastic = elastic_policy(args)
         if elastic is not None:

@@ -54,9 +54,13 @@ from __future__ import annotations
 import json
 import queue as queue_lib
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
+
+from horovod_tpu.obs import core as obs_core
+from horovod_tpu.obs import prom as obs_prom
 
 
 class _Slot:
@@ -315,8 +319,39 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
     (``restarts.jsonl``); when given, ``GET /healthz`` grows a ``fleet``
     section — current generation/size, restart/shrink/grow counts, last
     events — read fresh per request (`supervisor.fleet_status`), so a
-    health probe sees training-fleet trouble from the serving side."""
+    health probe sees training-fleet trouble from the serving side.
+
+    ``GET /metrics`` serves the Prometheus text exposition of this
+    server's OWN registry (one private `obs.Registry` per server, so
+    several servers in one process never share instruments): request
+    counts by route/code, queue depth (sampled at scrape), device-call /
+    row totals, request-latency and TTFT/TPOT histograms."""
     app = _make_app(bundle_dir, coalesce=coalesce)
+    reg = obs_core.Registry()
+
+    def _collect(r):
+        # stats/queue are owned by the app; the scrape mirrors them.
+        r.counter_set(
+            "hvt_serve_device_calls_total", app.stats["device_calls"]
+        )
+        r.counter_set("hvt_serve_rows_total", app.stats["rows"])
+        batcher = getattr(app, "_batcher", None)
+        r.gauge(
+            "hvt_serve_queue_depth",
+            batcher.q.qsize() if batcher is not None else 0,
+        )
+
+    reg.register_collector(_collect)
+
+    # The `route` label must come from a CLOSED set: serve_forever binds
+    # 0.0.0.0 by default, and labeling by the raw client-supplied path
+    # would let any scanner mint unbounded (route, code) series — a
+    # memory leak and scrape-payload blowup driven by untrusted input.
+    _KNOWN_ROUTES = ("/healthz", "/metrics", "/v1/predict", "/v1/generate")
+
+    def _route(path: str) -> str:
+        path = path.split("?", 1)[0]
+        return path if path in _KNOWN_ROUTES else "other"
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):
@@ -326,12 +361,18 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            reg.counter(
+                "hvt_serve_requests_total", route=_route(self.path),
+                code=str(code),
+            )
 
         def log_message(self, *args):  # quiet: one line per request is noise
             pass
 
         def do_GET(self):
-            if self.path == "/healthz":
+            if self.path == "/metrics":
+                obs_prom.write_http(self, reg)
+            elif self.path == "/healthz":
                 payload = {"status": "ok", "bundle": app.bundle_dir,
                            "kind": app.kind, "signature": app.signature,
                            "stats": dict(app.stats)}
@@ -354,6 +395,7 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
                 )
                 self._send(404, {"error": f"no route {self.path} — {hint}"})
                 return
+            t0 = time.perf_counter()
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length))
@@ -365,17 +407,42 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
 
                     chunks = app.stream(payload)
                     first = next(chunks)  # validation runs BEFORE headers
+                    # TTFT: first chunk computed and about to flush —
+                    # the streaming definition (prefill + first decode
+                    # chunk); later chunks feed the TPOT tail below.
+                    ttft = time.perf_counter() - t0
+                    reg.histogram("hvt_serve_ttft_seconds", ttft)
+                    n_tokens = 0
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "application/x-ndjson"
                     )
                     self.end_headers()
+                    reg.counter(
+                        "hvt_serve_requests_total", route=_route(self.path),
+                        code="200",
+                    )
                     try:
                         for item in itertools.chain((first,), chunks):
+                            if "tokens" in item and not item.get("done"):
+                                n_tokens += sum(
+                                    len(r) for r in item["tokens"]
+                                )
                             self.wfile.write(
                                 json.dumps(item).encode() + b"\n"
                             )
                             self.wfile.flush()
+                        total = time.perf_counter() - t0
+                        reg.histogram(
+                            "hvt_serve_request_seconds", total,
+                            route=_route(self.path),
+                        )
+                        if n_tokens > 1:
+                            # Decode tail per token, past the first chunk.
+                            reg.histogram(
+                                "hvt_serve_tpot_seconds",
+                                (total - ttft) / max(1, n_tokens - 1),
+                            )
                     except Exception as e:
                         # Headers are out — a second status line would
                         # corrupt the body. Keep the errors-are-JSON
@@ -388,10 +455,30 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
                         )
                         self.wfile.flush()
                 elif app.kind == "generate":
-                    self._send(200, app.generate(payload))
+                    out = app.generate(payload)
+                    dt = time.perf_counter() - t0
+                    reg.histogram(
+                        "hvt_serve_request_seconds", dt, route=_route(self.path)
+                    )
+                    # One-shot generation is a single dispatch: prefill
+                    # and every decode step land together, so TTFT is
+                    # the whole call and TPOT its per-token amortization
+                    # (documented approximation; streaming requests
+                    # carry the real split).
+                    n_tokens = sum(len(r) for r in out.get("tokens", []))
+                    reg.histogram("hvt_serve_ttft_seconds", dt)
+                    if n_tokens:
+                        reg.histogram(
+                            "hvt_serve_tpot_seconds", dt / n_tokens
+                        )
+                    self._send(200, out)
                 else:
                     rows = np.asarray(payload["input"])
                     prob = app.predict(rows)
+                    reg.histogram(
+                        "hvt_serve_request_seconds",
+                        time.perf_counter() - t0, route=_route(self.path),
+                    )
                     self._send(200, {"prob": prob.tolist()})
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
@@ -402,13 +489,25 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
 
     server = ThreadingHTTPServer((host, port), Handler)
     server.app = app  # tests reach the model through the server handle
+    server.metrics_registry = reg  # tests + the --metrics-port exporter
     return server
 
 
 def serve_forever(bundle_dir: str, port: int = 8000, host: str = "0.0.0.0",
-                  fleet_journal: str | None = None):
+                  fleet_journal: str | None = None,
+                  metrics_port: int | None = None):
     server = make_server(bundle_dir, port=port, host=host,
                          fleet_journal=fleet_journal)
+    if metrics_port is not None:
+        # The same per-server registry on a dedicated scrape port, for
+        # deployments that keep the serving port client-facing and the
+        # metrics port on the ops network (`/metrics` stays mounted on
+        # the main port either way).
+        from horovod_tpu.obs import server as obs_server
+
+        obs_server.start_metrics_server(
+            metrics_port, registry=server.metrics_registry
+        )
     inputs = server.app.signature["inputs"]
     shape = next(iter(inputs.values()))["shape"]
     print(
@@ -439,9 +538,16 @@ def main(argv=None) -> None:
         "'fleet' section to GET /healthz — generation, size, "
         "restart/shrink/grow counts, recent events",
     )
+    p.add_argument(
+        "--metrics-port", type=int, default=None, metavar="N",
+        help="ALSO serve this server's Prometheus /metrics on a "
+        "dedicated port (loopback by default, HVT_STATUS_HOST to "
+        "expose); GET /metrics on the main port works regardless",
+    )
     args = p.parse_args(argv)
     serve_forever(args.bundle_dir, port=args.port, host=args.host,
-                  fleet_journal=args.fleet_journal)
+                  fleet_journal=args.fleet_journal,
+                  metrics_port=args.metrics_port)
 
 
 if __name__ == "__main__":

@@ -56,6 +56,8 @@ import time
 
 from horovod_tpu.analysis import registry
 from horovod_tpu.launch import launcher
+from horovod_tpu.obs import core as obs_core
+from horovod_tpu.obs import prom as obs_prom
 from horovod_tpu.runtime import ENV_HEARTBEAT_DIR
 
 # Any file named like a checkpoint artifact counts as progress: single-file
@@ -356,12 +358,16 @@ def supervise(
     policy = policy or RestartPolicy()
     log = RestartLog(log_path)
     log.touch()
+    # Shared with the status server's /metrics scrape (and the final
+    # dump): the loop keeps "used" current so
+    # hvt_restart_budget_remaining is live, not post-hoc.
+    budget = {"max": policy.max_restarts, "used": 0}
     status_server = (
-        start_status_server(status_port, log_path)
+        start_status_server(status_port, log_path, budget=budget,
+                            model_dir=model_dir)
         if status_port is not None else None
     )
     marker = newest_checkpoint_marker(model_dir)
-    restarts_used = 0   # consecutive no-progress restarts — the budget
     total_restarts = 0  # lifetime count — what the log/gate report
     backoff = policy.backoff
     attempt = 0
@@ -369,16 +375,18 @@ def supervise(
     try:
         return _supervise_loop(
             start, policy, log, model_dir, heartbeat_dir, sleep, verbose,
-            marker, restarts_used, total_restarts, backoff, attempt,
+            marker, budget, total_restarts, backoff, attempt,
         )
     finally:
+        dump_metrics(log_path, None, budget, model_dir)
         if status_server is not None:
             status_server.shutdown()
 
 
 def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
-                    verbose, marker, restarts_used, total_restarts, backoff,
+                    verbose, marker, budget, total_restarts, backoff,
                     attempt) -> int:
+    restarts_used = budget["used"]  # consecutive no-progress restarts
     while True:
         attempt += 1
         abort = None
@@ -407,6 +415,7 @@ def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
             # deterministic loop — full budget and backoff again.
             restarts_used = 0
             backoff = policy.backoff
+        budget["used"] = restarts_used
         if restarts_used >= policy.max_restarts:
             log.write(
                 "supervisor_gave_up", 1.0, attempt=attempt, kind=kind,
@@ -423,6 +432,7 @@ def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
             # must still surface as failure.
             return shell_code(code) or 1
         restarts_used += 1
+        budget["used"] = restarts_used
         total_restarts += 1
         log.write(
             "restarts", float(total_restarts), attempt=attempt, kind=kind,
@@ -685,8 +695,10 @@ def supervise_elastic(
     ).start()
     env[ENV_ELASTIC_COORDINATOR] = coord.address
     env.update(elastic.commit_env())
+    budget = {"max": policy.max_restarts, "used": 0}
     status_server = (
-        start_status_server(status_port, log_path, coord=coord)
+        start_status_server(status_port, log_path, coord=coord,
+                            budget=budget, model_dir=model_dir)
         if status_port is not None else None
     )
     if spawn is None:
@@ -753,6 +765,9 @@ def supervise_elastic(
             if p.poll() is None:
                 p.kill()
                 p.wait()
+        # The final gateable scrape, while the coordinator still answers
+        # (launch/job.py `metrics_checks:` reads this post-run).
+        dump_metrics(log_path, coord, budget, model_dir)
         coord.stop()
         if status_server is not None:
             status_server.shutdown()
@@ -807,6 +822,7 @@ def supervise_elastic(
                     if progressed:
                         restarts_used = 0
                         backoff = policy.backoff
+                    budget["used"] = restarts_used
                     if restarts_used >= policy.max_restarts:
                         log.write(
                             "supervisor_gave_up", 1.0, member=member_id,
@@ -823,6 +839,7 @@ def supervise_elastic(
                             )
                         continue
                     restarts_used += 1
+                    budget["used"] = restarts_used
                     total_restarts += 1
                     log.write(
                         "restarts", float(total_restarts),
@@ -972,8 +989,224 @@ def journal_records(journal_path: str | None) -> list:
     return records
 
 
+def manifest_progress(model_dir: str | None) -> tuple:
+    """Best committed ``(epoch, step, cumulative_step, steps_per_epoch)``
+    readable from the checkpoint progress manifests under ``model_dir``
+    — stdlib-only (the supervisor never imports jax): single-file
+    ``.meta.json`` manifests and sharded ``index.json`` "progress"
+    records alike.
+
+    ``cumulative_step`` is ``epoch x steps_per_epoch + step`` when the
+    manifest's durable stream cursor carries the epoch geometry
+    (`Trainer.stream_cursor` does; ``steps_per_epoch`` is then returned
+    too so fresher NON-manifest progress — the elastic commit marker —
+    can be put on the same cumulative scale), the raw within-epoch
+    ``step`` otherwise. This is the honest "how many optimizer steps has
+    this job durably committed" figure the ``hvt_committed_step`` gauge
+    exports. ``(-1, -1, -1, None)`` when nothing is readable.
+
+    Called on every scrape: per-file parses are memoized by stat
+    signature (manifests are write-once via atomic rename), so a
+    steady-state scrape costs one stat-walk — the JSON parsing only
+    re-runs for manifests that actually changed."""
+    best = (-1, -1, -1, None)
+    if not model_dir or not os.path.isdir(model_dir):
+        return best
+    seen = set()
+    for root, _, files in os.walk(model_dir):
+        for name in files:
+            if not (name.endswith(".meta.json") or name == "index.json"):
+                continue
+            full = os.path.join(root, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            sig = (st.st_mtime_ns, st.st_size)
+            seen.add(full)
+            cached = _manifest_cache.get(full)
+            if cached is not None and cached[0] == sig:
+                parsed = cached[1]
+            else:
+                parsed = _parse_manifest(full, name)
+                _manifest_cache[full] = (sig, parsed)
+            if parsed is not None and parsed[:2] > best[:2]:
+                best = parsed
+    # Drop cache entries for deleted checkpoints (bounded memory over
+    # retention-pruned long runs).
+    for stale in set(_manifest_cache) - seen:
+        del _manifest_cache[stale]
+    return best
+
+
+# path -> ((mtime_ns, size), parsed tuple | None) — see manifest_progress.
+_manifest_cache: dict = {}
+
+
+def _parse_manifest(full: str, name: str):
+    """(epoch, step, cumulative, steps_per_epoch) of one progress
+    manifest, or None when unreadable/progress-free."""
+    try:
+        with open(full) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None  # torn manifest mid-write — skip, never crash
+    prog = rec.get("progress")
+    if isinstance(prog, dict):   # sharded index.json shape
+        epoch, step = prog.get("epoch"), prog.get("step")
+    elif name.endswith(".meta.json"):
+        epoch, step = rec.get("epoch"), rec.get("step")
+    else:
+        return None
+    if epoch is None or step is None:
+        return None
+    epoch, step = int(epoch), int(step)
+    spe = ((rec.get("cursor") or {}).get("position") or {}).get(
+        "steps_per_epoch"
+    )
+    total = epoch * int(spe) + step if spe else step
+    return (epoch, step, total, int(spe) if spe else None)
+
+
+def supervisor_metrics(log_path: str | None, coord=None, budget=None,
+                       model_dir: str | None = None) -> obs_core.Registry:
+    """One scrape of the supervisor's pane of glass, as a FRESH obs
+    registry (private per scrape — concurrent scrapes and multiple
+    supervisors in one test process never share instruments; the
+    declarations stay global, so undeclared names are still refused).
+
+    Aggregates every slice of truth the supervisor can reach:
+
+    * the restart journal → ``hvt_restarts_total`` /
+      ``hvt_fleet_shrinks_total`` / ``hvt_fleet_grows_total`` /
+      ``hvt_supervisor_gave_up_total`` and the last settled
+      generation/size;
+    * the live rendezvous coordinator (elastic mode) →
+      ``hvt_fleet_live_members``, per-member
+      ``hvt_member_heartbeat_age_seconds``, and the committed progress
+      markers;
+    * the checkpoint manifests under ``model_dir`` → committed
+      ``(epoch, step)`` for non-elastic fleets (and the cumulative-step
+      upgrade when the manifest carries the stream geometry);
+    * ``budget`` (the supervise loops' shared dict) →
+      ``hvt_restart_budget_remaining``."""
+    reg = obs_core.Registry()
+    records = journal_records(log_path)
+    restarts = gave_up = shrinks = grows = 0
+    generation = size = None
+    for rec in records:
+        name = rec.get("name")
+        if name == "restarts":
+            restarts = int(rec.get("value", 0))
+        elif name == "supervisor_gave_up":
+            gave_up += 1
+        elif name == "shrink":
+            shrinks += 1
+        elif name == "grow":
+            grows += 1
+        if name in ("start", "shrink", "grow", "steady"):
+            generation = rec.get("generation")
+            size = rec.get("size")
+    reg.counter_set("hvt_restarts_total", restarts)
+    reg.counter_set("hvt_fleet_shrinks_total", shrinks)
+    reg.counter_set("hvt_fleet_grows_total", grows)
+    reg.counter_set("hvt_supervisor_gave_up_total", gave_up)
+    epoch, step, total, spe = manifest_progress(model_dir)
+    if coord is not None:
+        snap = coord.snapshot()
+        generation = snap.get("generation", generation)
+        settle = snap.get("last_settle") or {}
+        size = settle.get("size", size)
+        members = snap.get("members", {})
+        reg.gauge(
+            "hvt_fleet_live_members",
+            sum(1 for m in members.values() if m.get("status") == "live"),
+        )
+        for member_id, m in sorted(members.items()):
+            if m.get("beat_age_s") is not None:
+                reg.gauge(
+                    "hvt_member_heartbeat_age_seconds",
+                    m["beat_age_s"], member=member_id,
+                )
+        # The elastic commit markers live on the coordinator
+        # (epoch·RADIX + step) — fresher than any checkpoint file for
+        # sub-epoch commit cadences.
+        from horovod_tpu.elastic.coordinator import PROGRESS_STEP_RADIX
+
+        marker = max(
+            (m.get("progress", -1) for m in members.values()), default=-1
+        )
+        if marker >= 0:
+            m_epoch = marker // PROGRESS_STEP_RADIX
+            m_step = marker % PROGRESS_STEP_RADIX
+            if (m_epoch, m_step) >= (epoch, step):
+                epoch, step = m_epoch, m_step
+                # Put the fresher marker on the SAME cumulative scale as
+                # the manifest total (the hvt_committed_step contract):
+                # the manifest's stream cursor carries steps_per_epoch,
+                # so a sub-epoch commit marker converts exactly; without
+                # a geometry the gauge degrades to the within-epoch step
+                # monotonically (never below the manifest total).
+                m_total = (
+                    m_epoch * spe + m_step if spe else m_step
+                )
+                total = max(total, m_total)
+    if generation is not None:
+        reg.gauge("hvt_elastic_generation", generation)
+    if size is not None:
+        reg.gauge("hvt_fleet_size", size)
+    if epoch >= 0:
+        reg.gauge("hvt_committed_epoch", epoch)
+        reg.gauge("hvt_committed_step", max(total, step))
+    if budget:
+        reg.gauge(
+            "hvt_restart_budget_remaining",
+            max(0, budget.get("max", 0) - budget.get("used", 0)),
+        )
+    return reg
+
+
+def default_metrics_dump_path(model_dir: str | None,
+                              log_path: str | None) -> str | None:
+    """Where the final supervisor scrape lands: beside the checkpoints
+    (``<model_dir>/metrics.prom``), else beside the journal. The SINGLE
+    resolver — the dump writer and `launch.job`'s ``metrics_checks:``
+    reader must agree on the path or the gate reads a stale file."""
+    root = model_dir or (os.path.dirname(log_path) if log_path else None)
+    return os.path.join(root, "metrics.prom") if root else None
+
+
+def dump_metrics(log_path: str | None, coord=None, budget=None,
+                 model_dir: str | None = None,
+                 path: str | None = None) -> str | None:
+    """Write one final text-exposition scrape beside the journal
+    (`default_metrics_dump_path`) so metrics survive the supervisor —
+    the gateable job output `launch.job`'s ``metrics_checks:`` block
+    reads post-run. Best-effort: a failed dump must never change the
+    job's exit code."""
+    if path is None:
+        path = default_metrics_dump_path(model_dir, log_path)
+        if path is None:
+            return None
+    try:
+        text = obs_prom.render(
+            supervisor_metrics(log_path, coord, budget, model_dir)
+        )
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:  # hvt: noqa[HVT005] — a scrape dump is
+            # derived/regenerable observability output, not a checkpoint
+            # artifact; a torn dump fails the gate loudly (parse error).
+            f.write(text)
+        return path
+    except OSError:
+        return None
+
+
 def start_status_server(port: int, log_path: str | None, coord=None,
-                        host: str | None = None):
+                        host: str | None = None, budget=None,
+                        model_dir: str | None = None):
     """Serve the supervisor's own status over HTTP (the ``--status-port``
     surface): fleet state WITHOUT a serving bundle — previously the
     journal was only visible through ``serve --fleet-journal``'s
@@ -992,6 +1225,10 @@ def start_status_server(port: int, log_path: str | None, coord=None,
     * ``GET /journal`` → ``{"records": [...]}`` — the full restart/elastic
       journal (rotation-spanning), each line as a JSON object.
     * ``GET /healthz`` → ``{"status": "ok", "fleet": ...}`` — probe form.
+    * ``GET /metrics`` → Prometheus text exposition (`supervisor_metrics`
+      — restart-journal counts, elastic generation, committed
+      (epoch, step), per-member heartbeat ages, restart budget
+      remaining), built fresh per scrape.
 
     Returns the started server (a daemon thread runs it); callers own
     ``shutdown()``. Port 0 binds an ephemeral port —
@@ -1016,7 +1253,11 @@ def start_status_server(port: int, log_path: str | None, coord=None,
 
         def do_GET(self):
             try:
-                if self.path == "/status":
+                if self.path == "/metrics":
+                    obs_prom.write_http(self, supervisor_metrics(
+                        log_path, coord, budget, model_dir
+                    ))
+                elif self.path == "/status":
                     self._send(200, {
                         "fleet": fleet_status(log_path),
                         "coordinator": coord.snapshot()

@@ -1,0 +1,30 @@
+"""One pane of glass: typed metric registry + Prometheus exposition.
+
+* `obs.core` — the declared metric catalog (`METRICS`) and the
+  thread-safe instruments; undeclared names are refused (HVT009 checks
+  call sites statically).
+* `obs.prom` — text-format exposition (`render`) and its inverse
+  (`parse_text`, the CI gate's reader).
+* `obs.server` — the ``GET /metrics`` HTTP server and the opt-in
+  trainer-side exporter (``HVT_METRICS_PORT``, ``POST /profile``).
+
+Emission sites import the package and call ``obs.counter`` /
+``obs.gauge`` / ``obs.histogram`` — never inside a jit/shard_map-traced
+body (host effect; HVT009, same class as HVT003).
+"""
+
+from horovod_tpu.obs.core import (  # noqa: F401 — the public surface
+    METRICS,
+    MetricSpec,
+    Registry,
+    UnknownMetricError,
+    counter,
+    counter_set,
+    default_registry,
+    gauge,
+    histogram,
+    is_declared,
+    register_collector,
+    reset,
+    spec,
+)

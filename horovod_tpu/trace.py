@@ -18,7 +18,9 @@ count against the chip's peak; "match or beat" needs this denominator
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import threading
 import time
 
 import jax
@@ -127,6 +129,53 @@ def fused_ce_flops(n_tokens: int, d_model: int, vocab: int,
     return 8.0 * n_tokens * d_model * vocab * (1.0 - 1.0 / max(1, n_chunks))
 
 
+def resolve_peak_flops(calibrate: bool = True) -> tuple:
+    """(per-chip peak FLOP/s, source) for any MFU denominator — shared by
+    bench.py (`_resolve_peak_flops` delegates here) and the live trainer
+    MFU gauge, so no surface reports ``mfu: null``.
+
+    Resolution order: the explicit ``HVT_PEAK_FLOPS`` override, the
+    built-in TPU peak table (`device_peak_flops`), and — with
+    ``calibrate=True`` — a measured matmul calibration on THIS host
+    (best-of-3 chained f32 matmuls), the honest trend denominator for
+    device kinds with no published peak (the CPU CI topology). The
+    calibrated value is exported back into ``HVT_PEAK_FLOPS`` so every
+    later resolution in the process divides by the same number.
+    ``calibrate=False`` returns ``(None, "unknown")`` instead of paying
+    the ~second of matmuls."""
+    import jax.numpy as jnp
+
+    if registry.get_raw("HVT_PEAK_FLOPS") is not None:
+        return float(registry.get_float("HVT_PEAK_FLOPS")), "override"
+    peak = device_peak_flops()
+    if peak:
+        return peak, "table"
+    if not calibrate:
+        return None, "unknown"
+    n = int(os.environ.get("BENCH_PEAK_CALIB_N", 1024))
+    a = jnp.ones((n, n), jnp.float32)
+    b = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda a, b: (a @ b).sum())
+    float(jax.device_get(f(a, b)))  # compile + settle
+    reps = 8
+
+    def chain():
+        t = jnp.float32(0)
+        for _ in range(reps):
+            t = t + f(a, b)
+        return float(jax.device_get(t))
+
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        chain()
+        dt = (time.perf_counter() - t0) / reps
+        best = dt if best is None else min(best, dt)
+    peak = 2.0 * n ** 3 / best
+    os.environ["HVT_PEAK_FLOPS"] = f"{peak:.6g}"
+    return peak, "calibrated"
+
+
 def mfu(flops_per_step: float | None, step_time_s: float, n_chips: int = 1,
         device=None) -> float | None:
     """Model FLOPs utilization: achieved FLOP/s ÷ fleet peak FLOP/s."""
@@ -163,6 +212,104 @@ def trace(log_dir: str, primary_only: bool = True):
     finally:
         if active:
             jax.profiler.stop_trace()
+
+
+# --- structured trace spans (HVT_TRACE_DIR) ---------------------------------
+#
+# Nestable JSONL span records around the framework's operational
+# boundaries — step, reduction, commit, rescale, checkpoint-save — one
+# rank-tagged file per process, so a fleet's spans can be merged by
+# (rank, ts) into a timeline without a collector. Each record:
+#
+#   {"name", "ts" (epoch seconds, span START), "dur_s", "rank", "pid",
+#    "id", "parent" (enclosing span id or null), "depth", ...attrs}
+#
+# Off (zero overhead beyond one registry read) unless HVT_TRACE_DIR is
+# set. Writes are per-record appends with a flush — span cadence is the
+# optimizer step at its finest, never per-microbatch. Span emission must
+# never take training down: write failures are swallowed after the
+# first (the writer disables itself).
+
+
+def span_dir() -> str | None:
+    """The ``HVT_TRACE_DIR`` target, or None when spans are off."""
+    return registry.get_str("HVT_TRACE_DIR")
+
+
+class _SpanWriter:
+    """This process's span file (lazy; thread-safe; fail-once-silent)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = None
+        self._dead = False
+        self._seq = 0
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    def write(self, record: dict) -> None:
+        if self._dead:
+            return
+        try:
+            with self._lock:
+                if self._fh is None:
+                    d = span_dir()
+                    os.makedirs(d, exist_ok=True)
+                    rank = runtime.process_rank()
+                    self._fh = open(
+                        os.path.join(
+                            d, f"spans-rank{rank}-pid{os.getpid()}.jsonl"
+                        ),
+                        "a",
+                    )
+                self._fh.write(json.dumps(record) + "\n")
+                self._fh.flush()
+        except OSError:
+            self._dead = True  # observability must never kill training
+
+    def next_id(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+
+_span_writer = _SpanWriter()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """``with trace.span('commit', epoch=3): ...`` — one JSONL span
+    record on exit, nesting tracked per thread. No-op (and attr kwargs
+    unevaluated only if the caller guards — they're cheap scalars at
+    every call site) when ``HVT_TRACE_DIR`` is unset."""
+    if not span_dir():
+        yield
+        return
+    stack = _span_writer._stack()
+    sid = _span_writer.next_id()
+    parent = stack[-1] if stack else None
+    stack.append(sid)
+    t0 = time.time()
+    p0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        stack.pop()
+        _span_writer.write({
+            "name": name,
+            "ts": t0,
+            "dur_s": time.perf_counter() - p0,
+            "rank": runtime.process_rank(),
+            "pid": os.getpid(),
+            "id": sid,
+            "parent": parent,
+            "depth": len(stack),
+            **attrs,
+        })
 
 
 class StepTimer:

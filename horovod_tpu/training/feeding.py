@@ -467,9 +467,25 @@ def run_fit(trainer,
     _run_train_end(callbacks)
     return trainer.history
 
+def _maybe_step_sampler(trainer):
+    """The live step-phase sampler, when the trainer-side metrics
+    exporter is on (`HVT_METRICS_PORT` — obs/server.py): None otherwise,
+    so the default fit path carries ZERO instrumentation cost. The
+    examples-per-step figure is inferred from the first chunk's shapes
+    (`capture_step_args` time)."""
+    from horovod_tpu.obs import server as obs_server
+
+    if obs_server.ensure_trainer_exporter() is None:
+        return None
+    from horovod_tpu.training.trainer import StepPhaseSampler
+
+    return StepPhaseSampler(trainer, 0)
+
+
 def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_epoch,
     callbacks, validation_data, batch_size, verbose, initial_step=0,
 ):
+    from horovod_tpu import trace as trace_lib
     from horovod_tpu.data.prefetch import DevicePrefetcher
 
     # Per-epoch execution plan: full steps_per_execution chunks plus one
@@ -547,6 +563,7 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
     else:
         place = lambda b: trainer._shard_chunk(b, 2 if accum > 1 else 1)  # noqa: E731
     prefetcher = DevicePrefetcher(host_chunks(), place, depth=depth)
+    sampler = _maybe_step_sampler(trainer)
     try:
         for epoch in range(initial_epoch, epochs):
             if trainer.stop_training:
@@ -565,10 +582,40 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
             start = initial_step if epoch == initial_epoch else 0
             step = start
             for k in plan_for(epoch):
-                chunk = next(prefetcher)
-                trainer.state, metrics, metric_acc = run(
-                    trainer.state, chunk, scale, metric_acc
-                )
+                if sampler is not None:
+                    t_in = time.perf_counter()
+                    chunk = next(prefetcher)
+                    sampler.add_input_wait(time.perf_counter() - t_in)
+                    if sampler._step_shapes is None:
+                        # First chunk: derive examples per OPTIMIZER step
+                        # from the placed shapes ([spe?, K?, G, ...]) and
+                        # snapshot the step args for the cost-model MFU.
+                        leaf = jax.tree_util.tree_leaves(chunk[0])[0]
+                        lead = 1 + (1 if spe > 1 else 0) + (
+                            1 if accum > 1 else 0
+                        )
+                        rows = int(np.prod(leaf.shape[:lead]))
+                        sampler.examples_per_step = rows // (
+                            leaf.shape[0] if spe > 1 else 1
+                        )
+                        # k, not spe: the FIRST chunk of a resumed epoch
+                        # can be a remainder chunk with fewer steps, and
+                        # the captured executable's FLOPs must divide by
+                        # the step count of the program actually
+                        # captured or hvt_mfu mis-scales for the run.
+                        sampler.capture_step_args(
+                            run, (trainer.state, chunk, scale, metric_acc),
+                            k,
+                        )
+                else:
+                    chunk = next(prefetcher)
+                with trace_lib.span("step", epoch=epoch, step=step,
+                                    steps=k):
+                    trainer.state, metrics, metric_acc = run(
+                        trainer.state, chunk, scale, metric_acc
+                    )
+                if sampler is not None:
+                    sampler.maybe_sample(trainer.state, k)
                 step += k
                 # Once per execution, with the last step's metrics —
                 # Keras's steps_per_execution callback semantics.
@@ -629,6 +676,13 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
     from horovod_tpu.analysis import registry
 
     chunk = registry.get_int("HVT_EPOCH_CHUNK_STEPS") or 0
+    sampler = _maybe_step_sampler(trainer)
+    if sampler is not None:
+        # Device-cached feeding has no host input leg by construction;
+        # examples/step is the staged geometry's.
+        sampler.examples_per_step = (
+            trainer.dp_size * batch_size * trainer._accum_steps
+        )
     try:
         # Inside the teardown scope — see the streamed fit path's note.
         for cb in callbacks:
@@ -652,13 +706,17 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
                 at = start
                 while at < steps:
                     n = min(c, steps - at)
-                    trainer.state, metrics, metric_acc = (
-                        trainer._train_epoch(
-                            trainer.state, data,
-                            jax.random.fold_in(epoch_key, epoch),
-                            scale, metric_acc, n, batch_size, at,
+                    with trace_lib.span("step", epoch=epoch, step=at,
+                                        steps=n):
+                        trainer.state, metrics, metric_acc = (
+                            trainer._train_epoch(
+                                trainer.state, data,
+                                jax.random.fold_in(epoch_key, epoch),
+                                scale, metric_acc, n, batch_size, at,
+                            )
                         )
-                    )
+                    if sampler is not None:
+                        sampler.maybe_sample(trainer.state, n)
                     at += n
                     # Once per chunk, with the chunk's last step metrics
                     # and the TRUE within-epoch step index — the

@@ -18,6 +18,7 @@ carries over unchanged.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Sequence
 
 import flax.struct
@@ -978,6 +979,56 @@ class Trainer:
         self.state = jax.tree.map(place, host_state, self.state)
         return self.state
 
+    def reduction_program(self, params):
+        """(jitted fn, gradient-shaped zeros, lowered text) of THIS
+        trainer's boundary gradient reduction in isolation — the same
+        `collectives.reduce_gradients` program the explicit step embeds
+        (bucketing, order, dcn two-hop, wire dtypes, ZeRO-1 scatter, all
+        from the trainer's config). The single attribution source for
+        "how much of a step is comm": bench.py's step_ms.comm legs and
+        the live `StepPhaseSampler` both time exactly this program, so
+        offline BENCH_* rows and the live ``hvt_step_phase_ms{comm}``
+        gauge are the same measurement at different cadences."""
+        import jax.numpy as jnp
+
+        P = jax.sharding.PartitionSpec
+        grads = jax.tree.map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+        )
+        scatter = self._scatter
+
+        def red(g):
+            out = collectives.reduce_gradients(
+                g,
+                data_axis=mesh_lib.DATA_AXIS,
+                extra_axes=(mesh_lib.FSDP_AXIS,),
+                dcn=self._dcn,
+                wire_dtype=self._comm_dtype,
+                ici_wire_dtype=self._ici_dtype,
+                bucket_bytes=self._bucket_bytes,
+                reverse=self._bucket_reverse,
+                scatter=scatter if scatter > 1 else None,
+            )
+            # Scalar data-dependency on every reduced bucket (honest
+            # fetch; see bench._timed).
+            t = sum(
+                jnp.sum(l.astype(jnp.float32)) for l in jax.tree.leaves(out)
+            )
+            if scatter > 1:
+                # Scattered outputs differ per shard; one scalar psum
+                # makes the fetch replicated (scalar ops never count as
+                # payload in the byte accounting).
+                t = jax.lax.psum(
+                    t, (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+                )
+            return t
+
+        f = jax.jit(compat.shard_map(
+            red, mesh=self.mesh, in_specs=(jax.sharding.PartitionSpec(),),
+            out_specs=P(), check_vma=False,
+        ))
+        return f, grads, f.lower(grads).as_text()
+
     def stream_cursor(self, epoch: int, step: int) -> dict | None:
         """The durable stream cursor for training position "``step``
         optimizer steps into epoch ``epoch``" of the CURRENT fit, as a
@@ -1035,3 +1086,186 @@ class Trainer:
         """Class probabilities (input→prob serving contract); see
         `training.feeding.run_predict`."""
         return feeding.run_predict(self, x, batch_size)
+
+
+class StepPhaseSampler:
+    """Live per-step phase timing for the trainer-side metrics exporter
+    (``HVT_METRICS_PORT``): every ``HVT_METRICS_EVERY`` optimizer steps,
+    refresh the ``hvt_step_phase_ms{total,compute,comm,input}``,
+    ``hvt_examples_per_sec``, ``hvt_mfu`` and ``hvt_step_seconds``
+    series from a drained measurement window — the bench-time
+    ``step_ms`` accounting (PR 7/12), live.
+
+    Measurement contract, matching bench.py's discipline exactly:
+
+    * **total** — wall-clock across the window, blocked at BOTH edges
+      (`jax.block_until_ready` on the newest state): with async dispatch
+      the python loop runs ahead of the device, so only a drained window
+      is an honest mean step time. The drain is the sampler's only
+      recurring pipeline cost — one bubble per window, which the bench
+      overhead A/B gates at <= 2% of ``step_ms.total``
+      (``BENCH_MODEL=zero1``).
+    * **comm** — the isolated boundary-reduction program
+      (`Trainer.reduction_program` — the SAME attribution bench trusts),
+      compiled once at the first sample, then re-timed every
+      ``comm_refresh`` samples (default 8) and CACHED in between: the
+      comm split is structural (buckets, wires, topology) and drifts at
+      network-degradation timescales, while re-timing it every window
+      was the dominant recurring sampler cost (a full isolated
+      reduction per window blew the 2% overhead budget on comm-heavy
+      steps). The published comm gauge therefore refreshes every
+      ``comm_refresh x every`` optimizer steps.
+    * **input** — host time the fit loop spent blocked on the prefetcher
+      (`add_input_wait`), amortized per step.
+    * **compute** — the remainder, clamped >= 0; phases are clamped to
+      sum to total (the PR 7 coherence rule — bench exits non-zero on
+      phase > total, the live gauges clamp instead: an observability
+      surface must not kill training over a scheduling blip).
+    * **mfu** — XLA cost-model FLOPs of the compiled step executable
+      (per optimizer step) against `trace.resolve_peak_flops` x chips.
+      Custom-call kernels (flash attention, fused CE) are opaque to the
+      cost model, so this gauge UNDER-counts for those models — a live
+      trend signal; the calibrated BENCH_* rows stay the MFU headline.
+
+    The first ``maybe_sample`` call only opens the window (and pays the
+    one-time warmups: reduction-program compile, step-flops cost
+    analysis) — gauges appear from the second sample point on. All
+    emission goes through `horovod_tpu.obs`; nothing here runs inside a
+    traced body (HVT009)."""
+
+    def __init__(self, trainer: "Trainer", examples_per_step: int,
+                 every: int | None = None, comm_refresh: int = 8):
+        self.trainer = trainer
+        self.examples_per_step = int(examples_per_step)
+        if every is None:
+            every = registry.get_int("HVT_METRICS_EVERY") or 32
+        self.every = max(1, int(every))
+        self.comm_refresh = max(1, int(comm_refresh))
+        self._steps = 0            # optimizer steps since the window edge
+        self._input_s = 0.0        # host input-wait inside the window
+        self._window_t0 = None     # None until the first drained edge
+        self._step_shapes = None   # ShapeDtypeStructs of the step args
+        self._steps_per_exec = 1
+        self._comm = None          # (jitted fn, zero grads) once warmed
+        self._comm_s = 0.0         # cached isolated-comm seconds
+        self._flops = None         # FLOPs per optimizer step (cost model)
+        self._peak = None          # (per-chip peak, source)
+        self.samples = 0
+
+    # -- hooks the feeding loops call ---------------------------------------
+
+    def capture_step_args(self, run, args, steps_per_exec: int) -> None:
+        """Record the jitted step callable + its arg SHAPES (taken before
+        the batch is donated) so the first sample can cost-analyze the
+        executable. Cheap (one tree.map); called once per fit."""
+        if self._step_shapes is not None:
+            return
+        mesh_devices = set(self.trainer.mesh.devices.flat)
+
+        def struct(a):
+            if isinstance(a, jax.Array):
+                sh = a.sharding
+                if set(sh.device_set) != mesh_devices:
+                    # Uncommitted scalars (the update-scale arg) sit on
+                    # one device until jit broadcasts them; lowering
+                    # needs the POST-commit placement — replicated over
+                    # the step's mesh — or the shapes are incompatible.
+                    sh = jax.sharding.NamedSharding(
+                        self.trainer.mesh, jax.sharding.PartitionSpec()
+                    )
+                return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+            return a
+
+        self._run = run
+        self._step_shapes = jax.tree.map(struct, args)
+        self._steps_per_exec = max(1, int(steps_per_exec))
+
+    def add_input_wait(self, seconds: float) -> None:
+        self._input_s += seconds
+
+    def maybe_sample(self, state, steps: int) -> None:
+        """After each execution's dispatch: account ``steps`` optimizer
+        steps; at the cadence boundary, drain and publish."""
+        from horovod_tpu import obs
+
+        obs.counter("hvt_optimizer_steps_total", steps)
+        self._steps += steps
+        if self._window_t0 is not None and self._steps < self.every:
+            return
+        jax.block_until_ready(state)
+        now = time.perf_counter()
+        if self._window_t0 is None:
+            # First edge: one-time warmups OUTSIDE any window, so their
+            # cost never pollutes a published step time.
+            self._warmup(state)
+            self._window_t0 = time.perf_counter()
+            self._steps = 0
+            self._input_s = 0.0
+            return
+        total_s = (now - self._window_t0) / self._steps
+        input_s = min(self._input_s / self._steps, total_s)
+        comm_s = min(self._timed_comm(), total_s - input_s)
+        compute_s = max(0.0, total_s - comm_s - input_s)
+        obs.gauge("hvt_step_phase_ms", total_s * 1e3, phase="total")
+        obs.gauge("hvt_step_phase_ms", compute_s * 1e3, phase="compute")
+        obs.gauge("hvt_step_phase_ms", comm_s * 1e3, phase="comm")
+        obs.gauge("hvt_step_phase_ms", input_s * 1e3, phase="input")
+        obs.histogram("hvt_step_seconds", total_s)
+        obs.gauge(
+            "hvt_examples_per_sec", self.examples_per_step / total_s
+        )
+        obs.gauge("hvt_accum_k", self.trainer._accum_steps)
+        peak, _src = self._peak
+        if peak and self._flops:
+            n_chips = int(self.trainer.mesh.devices.size)
+            obs.gauge("hvt_peak_flops_per_chip", peak)
+            obs.gauge(
+                "hvt_mfu", self._flops / total_s / (peak * n_chips)
+            )
+        obs.counter("hvt_step_samples_total")
+        self.samples += 1
+        # Re-edge AFTER the sampling work: the published step time
+        # measures training, not the sampler; the sampler's own cost is
+        # what the bench overhead A/B measures.
+        self._window_t0 = time.perf_counter()
+        self._steps = 0
+        self._input_s = 0.0
+
+    # -- internals ----------------------------------------------------------
+
+    def _warmup(self, state) -> None:
+        from horovod_tpu import trace as trace_lib
+
+        self._peak = trace_lib.resolve_peak_flops(calibrate=True)
+        try:
+            f, grads, _text = self.trainer.reduction_program(state.params)
+            jax.block_until_ready(f(grads))  # compile + settle
+            self._comm = (f, grads)
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(grads))
+            self._comm_s = time.perf_counter() - t0  # warm cache
+        except Exception:
+            self._comm = None  # attribution degrades to comm=0, loudly
+            # visible as compute==total; never kills training.
+        if self._step_shapes is not None:
+            try:
+                compiled = self._run.lower(*self._step_shapes).compile()
+                flops = trace_lib.compiled_cost_flops(compiled)
+                if flops:
+                    self._flops = flops / self._steps_per_exec
+            except Exception:
+                self._flops = None
+
+    def _timed_comm(self) -> float:
+        if self._comm is None:
+            return 0.0
+        if self.samples % self.comm_refresh:
+            return self._comm_s  # cached between refreshes (docstring)
+        from horovod_tpu import trace as trace_lib
+
+        f, grads = self._comm
+        with trace_lib.span("reduction"):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(grads))
+            self._comm_s = time.perf_counter() - t0
+        return self._comm_s

@@ -807,6 +807,35 @@ def write_fake_worker(tmp_path):
     return [sys.executable, str(path)]
 
 
+def _shrink_gated_spawn(argv, log, nprocs, timeout=60.0):
+    """Deterministic shrink-then-grow for the leave tests.
+
+    The supervisor races the survivors' re-rendezvous (which journals the
+    ``shrink`` settle) against the replacement's join: with a short
+    backoff the replacement can join the round FIRST, the world settles
+    back at full size, and no shrink record ever lands — the historic
+    flake in ``test_leave_shrinks_then_replacement_grows`` /
+    ``test_journal_gateable_with_count``. Instead of tuning sleeps,
+    condition-poll coordinator state: hold every REPLACEMENT spawn
+    (member seq >= the launch size) until the journal carries the
+    settled shrink, bounded generously — on timeout the member spawns
+    anyway and the assertions explain. Survivor/initial spawns pass
+    through untouched. (Blocking inside ``spawn`` is safe: the
+    rendezvous settles on the coordinator's own threads.)"""
+    def spawn(member_id, slot, env):
+        if int(member_id[1:]) >= nprocs:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if os.path.exists(log) and any(
+                    r.get("name") == "shrink" for r in _journal(log)
+                ):
+                    break
+                time.sleep(0.05)
+        return supervisor._spawn_member_local(argv, env, member_id, slot)
+
+    return spawn
+
+
 class TestSuperviseElastic:
     def test_clean_completion_no_restarts(self, tmp_path, capfd):
         argv = write_fake_worker(tmp_path)
@@ -838,6 +867,7 @@ class TestSuperviseElastic:
             elastic=ElasticPolicy(min_ranks=2, max_ranks=3,
                                   rendezvous_timeout=20.0),
             log_path=str(log),
+            spawn=_shrink_gated_spawn(argv, str(log), 3),
         )
         assert code == 0
         records = _journal(log)
@@ -985,6 +1015,7 @@ class TestSuperviseElastic:
             elastic=ElasticPolicy(min_ranks=2, max_ranks=3,
                                   rendezvous_timeout=20.0),
             log_path=str(log),
+            spawn=_shrink_gated_spawn(argv, str(log), 3),
         )
         assert code == 0
         # The CI-gate contract from the job spec: a shrink occurred.

@@ -16,6 +16,7 @@ import pytest
 from horovod_tpu.analysis import callgraph, cli, core, registry
 from horovod_tpu.analysis.rules import (
     CheckpointWriteAtomicity,
+    MetricRegistryDiscipline,
     CollectiveOrderDivergence,
     CollectiveSymmetry,
     DataLayerSeededRng,
@@ -954,3 +955,98 @@ class TestRegistryAccessors:
         doc = registry.generate_doc()
         for name in registry.KNOBS:
             assert f"`{name}`" in doc
+
+
+class TestHVT009MetricRegistryDiscipline:
+    def test_undeclared_metric_name_flagged(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            from horovod_tpu import obs
+            def publish(v):
+                obs.gauge("hvt_stpe_ms", v)
+        """)
+        assert len(found) == 1
+        assert found[0].rule == "HVT009"
+        assert "hvt_stpe_ms" in found[0].message
+        assert "MetricSpec" in found[0].message
+
+    def test_declared_names_clean_across_aliases(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            from horovod_tpu import obs
+            from horovod_tpu.obs import core as obs_core
+            def publish(reg, v):
+                obs.gauge("hvt_mfu", v)
+                obs_core.counter("hvt_scrapes_total")
+                obs.histogram("hvt_step_seconds", v)
+        """)
+        assert found == []
+
+    def test_registry_method_sites_checked_by_convention(self):
+        # A Registry instance can't be typed statically; the hvt_ naming
+        # convention discriminates emission sites (obs/core naming rule).
+        found = findings_of(MetricRegistryDiscipline, """
+            def collect(reg):
+                reg.counter_set("hvt_not_declared_total", 3)
+                reg.gauge("hvt_fleet_size", 2)       # declared — clean
+                other.counter("unrelated_api", 1)    # not hvt_ — skipped
+        """)
+        assert len(found) == 1
+        assert "hvt_not_declared_total" in found[0].message
+
+    def test_dynamic_names_skipped(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            from horovod_tpu import obs
+            def publish(name, v):
+                obs.gauge(name, v)
+        """)
+        assert found == []
+
+    def test_obs_call_inside_jit_flagged(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            import jax
+            from horovod_tpu import obs
+            @jax.jit
+            def step(x):
+                obs.counter("hvt_optimizer_steps_total")
+                return x
+        """)
+        assert len(found) == 1
+        assert "trace time" in found[0].message
+
+    def test_obs_call_inside_shard_map_and_scan_flagged(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            from horovod_tpu import compat, obs
+            from jax import lax
+            def local(x):
+                obs.gauge("hvt_mfu", 0.5)
+                return x
+            f = compat.shard_map(local, mesh=None, in_specs=(), out_specs=())
+            def body(c, t):
+                obs.gauge("hvt_mfu", 0.5)
+                return c, t
+            lax.scan(body, 0, None)
+        """)
+        assert len(found) == 2
+
+    def test_host_side_emission_clean(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            import jax
+            from horovod_tpu import obs
+            @jax.jit
+            def step(x):
+                return x + 1
+            def loop(x):
+                x = step(x)
+                obs.counter("hvt_optimizer_steps_total")
+                return x
+        """)
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        res = lint_tree(tmp_path, {
+            "pkg/mod.py": """
+                from horovod_tpu import obs
+                def publish(v):
+                    obs.gauge("hvt_bespoke", v)  # hvt: noqa[HVT009] why
+            """,
+        })
+        assert [f for f in res.findings if f.rule == "HVT009"] == []

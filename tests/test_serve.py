@@ -253,3 +253,67 @@ def test_healthz_without_journal_has_no_fleet_section(server):
     with urllib.request.urlopen(_url(server, "/healthz")) as r:
         body = json.loads(r.read())
     assert "fleet" not in body
+
+
+class TestServeMetrics:
+    """GET /metrics on the serving server (ISSUE 13): request counters by
+    route/code, device-call/row totals mirrored from app.stats, queue
+    depth, and the latency/TTFT histograms — valid text exposition."""
+
+    def test_metrics_route_serves_valid_exposition(self, server, bundle):
+        from horovod_tpu.obs import prom
+
+        rows = np.random.rand(3, DIM).astype(np.float32)
+        status, _ = _post(server, "/v1/predict", {"input": rows.tolist()})
+        assert status == 200
+        with urllib.request.urlopen(_url(server, "/metrics")) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        values = prom.parse_text(text)
+        assert values["hvt_serve_rows_total"] >= 3
+        assert values["hvt_serve_device_calls_total"] >= 1
+        assert values["hvt_serve_queue_depth"] == 0
+        assert (
+            values['hvt_serve_requests_total{route="/v1/predict",code="200"}']
+            >= 1
+        )
+        # Histogram invariants on the request-latency family.
+        route = 'route="/v1/predict"'
+        count = values[f"hvt_serve_request_seconds_count{{{route}}}"]
+        inf = values[f'hvt_serve_request_seconds_bucket{{{route},le="+Inf"}}']
+        assert count >= 1 and inf == count
+        assert f"hvt_serve_request_seconds_sum{{{route}}}" in values
+        # HELP/TYPE present for every exposed family.
+        families = {
+            line.split()[2] for line in text.splitlines()
+            if line.startswith("# TYPE")
+        }
+        helps = {
+            line.split()[2] for line in text.splitlines()
+            if line.startswith("# HELP")
+        }
+        assert families and families == helps
+
+    def test_error_requests_counted_by_code(self, server):
+        status, _ = _post(server, "/v1/predict", {"wrong": 1})
+        assert status == 400
+        with urllib.request.urlopen(_url(server, "/metrics")) as r:
+            text = r.read().decode()
+        from horovod_tpu.obs import prom
+
+        values = prom.parse_text(text)
+        assert (
+            values['hvt_serve_requests_total{route="/v1/predict",code="400"}']
+            >= 1
+        )
+
+    def test_per_server_registries_are_private(self, bundle):
+        # Two servers over the same bundle: each carries its own
+        # instrument store (no cross-talk between fleets in one process).
+        out, _, _ = bundle
+        a = make_server(out, port=0)
+        b = make_server(out, port=0)
+        assert a.metrics_registry is not b.metrics_registry
+        a.server_close()
+        b.server_close()
