@@ -1,24 +1,31 @@
-"""Static analysis (`hvt-lint`/`hvt-audit`) + the central knob registry.
+"""Static analysis (`hvt-lint`/`hvt-audit`/`hvt-sched`) + the central
+knob registry.
 
 The reliability spine's correctness invariants (collective symmetry,
 lockstep teardown, trace purity, knob discipline, atomic artifact writes)
 previously lived only in prose — this subsystem enforces them at lint
-time, and since PR 9 at COMPILE time too. Two layers:
+time, since PR 9 at COMPILE time, and since ISSUE 14 across the WHOLE
+PROGRAM. Three layers:
 
 * Source analysis — `core` (framework: per-module + project-wide rules),
   `callgraph` (module-set call graph, collectives-effect summaries,
-  rank-taint propagation), `rules` (HVT001-HVT008; ``docs/LINT_RULES.md``
+  rank-taint propagation), `rules` (HVT001-HVT011; ``docs/LINT_RULES.md``
   is generated from their metadata), `registry` (the ``HVT_*`` knob
   table ``docs/ENVVARS.md`` is generated from), `cli` (``hvt-lint``).
 * Compiled-program audit — `hlo_audit` (structured StableHLO/HLO
-  inspector: `collective_ops`, `gradient_reductions`, `donated_args`,
-  `assert_program`), `step_probe` (the canonical lowered trainer step),
-  `audit_cli` (``hvt-audit step/file``).
+  inspector: `collective_ops`, `gradient_reductions`,
+  `payload_alltoalls`, `donated_args`, `assert_program`), `step_probe`
+  (the canonical lowered trainer step + the EP dispatch/combine probe),
+  `audit_cli` (``hvt-audit step/moe/file``).
+* Schedule verification — `schedule` (rank-feasible path model checking
+  over the call graph: rule HVT010, the entry-path automata report),
+  `sched_cli` (``hvt-sched check/replay`` — the replay side cross-checks
+  the per-rank flight records `horovod_tpu.flight` captures at runtime).
 
-Import discipline: `registry`, `core`, `callgraph`, `rules` and
-`hlo_audit` are stdlib-only and importable from the earliest bootstrap
-(`runtime.init` reads knobs through the registry); only `step_probe`
-(and `hvt-audit step`) imports jax, lazily.
+Import discipline: `registry`, `core`, `callgraph`, `rules`, `schedule`
+and `hlo_audit` are stdlib-only and importable from the earliest
+bootstrap (`runtime.init` reads knobs through the registry); only
+`step_probe` (and `hvt-audit step/moe`) imports jax, lazily.
 """
 
 from horovod_tpu.analysis import registry

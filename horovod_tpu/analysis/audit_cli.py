@@ -234,6 +234,47 @@ def _run_step(args) -> int:
     return 0
 
 
+def _run_moe(args) -> int:
+    """Audit the EP dispatch/combine probe (`step_probe.
+    lowered_moe_dispatch_text`): the MoE wire must be exactly two
+    payload all-to-alls through `collectives.all_to_all` — the
+    `alltoalls=N` grammar's canonical gate (ROADMAP item 4)."""
+    expect_spec = args.expect
+    if expect_spec is None:
+        expect_spec = "alltoalls=2"
+        print(f"hvt-audit: derived --expect {expect_spec}")
+    expects = hlo_audit.ProgramExpectation.parse(expect_spec)
+
+    if args.platform:
+        os.environ["HVT_PLATFORM"] = args.platform
+        if args.platform == "cpu" and args.devices:
+            os.environ["HVT_NUM_CPU_DEVICES"] = str(args.devices)
+
+    import horovod_tpu as hvt
+    from horovod_tpu.analysis import step_probe
+
+    hvt.init()
+    text = step_probe.lowered_moe_dispatch_text()
+    if args.dump:
+        with open(args.dump, "w") as f:  # hvt: noqa[HVT005] debug dump
+            f.write(text)
+        print(f"hvt-audit: wrote lowered MoE dispatch to {args.dump}")
+    ops = hlo_audit.collective_ops(text)
+    violations = hlo_audit.audit(text, expects, ops=ops)
+    a2a = hlo_audit.payload_alltoalls(ops)
+    if violations:
+        print("hvt-audit: moe dispatch/combine FAILED:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print(
+        f"hvt-audit: moe dispatch/combine ok — {len(a2a)} payload "
+        f"all-to-all(s)"
+        + (f" [{', '.join(op.dtype for op in a2a)}]" if a2a else "")
+    )
+    return 0
+
+
 def _run_file(args) -> int:
     try:
         with open(args.path, encoding="utf-8") as f:
@@ -304,6 +345,22 @@ def main(argv: list[str] | None = None) -> int:
     step.add_argument("--dump", default=None, metavar="PATH",
                       help="also write the lowered step text to PATH")
 
+    moe = sub.add_parser(
+        "moe", help="audit the EP dispatch/combine probe (the MoE "
+        "all-to-all wire shape)")
+    moe.add_argument("--expect", default=None,
+                     metavar="alltoalls=N,...",
+                     help="expectation list (default: alltoalls=2 — "
+                     "one dispatch + one combine)")
+    moe.add_argument("--platform", default=None,
+                     help="force the jax platform before init (sets "
+                     "HVT_PLATFORM; e.g. cpu)")
+    moe.add_argument("--devices", type=int, default=8,
+                     help="virtual device count with --platform cpu "
+                     "(the expert axis spans them; default 8)")
+    moe.add_argument("--dump", default=None, metavar="PATH",
+                     help="also write the lowered probe text to PATH")
+
     filecmd = sub.add_parser(
         "file", help="audit a saved StableHLO/HLO program text")
     filecmd.add_argument("path")
@@ -327,6 +384,8 @@ def main(argv: list[str] | None = None) -> int:
                         "HVT_COMPRESSION_ICI"
                     )
             return _run_step(args)
+        if args.cmd == "moe":
+            return _run_moe(args)
         return _run_file(args)
     except ValueError as e:
         print(f"hvt-audit: {e}", file=sys.stderr)

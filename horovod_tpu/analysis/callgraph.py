@@ -69,7 +69,7 @@ COLLECTIVES_ANY = {
     "allreduce", "allgather", "all_gather", "broadcast",
     "broadcast_object", "allgather_object", "broadcast_pytree",
     "pmean_pytree", "reduce_gradients", "barrier", "wait_at_barrier",
-    "sync_global_devices", "quantized_group_sum",
+    "sync_global_devices", "quantized_group_sum", "all_to_all",
 }
 # Operations matched only when qualified, to dodge same-name methods on
 # unrelated objects (`httpd.shutdown()`, `os.sync()`):

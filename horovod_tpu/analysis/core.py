@@ -391,6 +391,10 @@ class LintResult:
     findings: list[Finding]       # fresh — these fail the lint
     baselined: list[Finding]      # matched a committed baseline entry
     files: int = 0
+    # The parsed module set + shared call graph of this run, so callers
+    # needing more than findings (hvt-sched's entry-path report) reuse
+    # the parse instead of re-reading the tree.
+    project: "Project | None" = None
 
     @property
     def clean(self) -> bool:
@@ -497,4 +501,5 @@ def lint_paths(
             deliver(finding, project.module(finding.path))
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     result.baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.project = project
     return result

@@ -57,6 +57,8 @@ __all__ = [
     "donated_args",
     "gradient_reductions",
     "op_bytes",
+    "op_bytes_by_kind",
+    "payload_alltoalls",
     "scatter_reductions",
     "while_count",
     "wire_dtype",
@@ -249,6 +251,18 @@ def scatter_reductions(text) -> list[CollectiveOp]:
     ]
 
 
+def payload_alltoalls(text) -> list[CollectiveOp]:
+    """The PAYLOAD all-to-alls: rank >= 2 — the EP dispatch/combine wire
+    (`collectives.all_to_all`) and the quantized wire's reduce-scatter
+    shot alike. Rank-1 all-to-alls are scale/column movement (the
+    quantized wire's per-bucket f32 scales, a tail-span column shuffle)
+    and are excluded, the same discrimination every other count here
+    applies to all-gathers. Both dialects. Accepts program text or a
+    pre-parsed op list."""
+    ops = collective_ops(text) if isinstance(text, str) else text
+    return [op for op in ops if op.kind == "all-to-all" and op.rank >= 2]
+
+
 def _wire_payload_ops(ops) -> list[CollectiveOp]:
     """Every op whose payload must carry the wire dtype: the gradient
     reductions plus the quantized wire's rank >= 2 all-to-alls (rank-1
@@ -279,6 +293,25 @@ def op_bytes(op: CollectiveOp) -> int:
     for d in op.shape:
         n *= d
     return n * _DTYPE_BYTES.get(op.dtype, 4)
+
+
+def op_bytes_by_kind(ops) -> dict:
+    """Per-kind payload-byte totals over the program's PAYLOAD
+    collectives (non-scalar reductions, rank >= 2 gathers/all-to-alls —
+    the same discrimination as the counts; scale noise excluded). The
+    expectation-diff context: when a count expectation fails, WHERE the
+    wire bytes actually went is the first question."""
+    if isinstance(ops, str):
+        ops = collective_ops(ops)
+    out: dict = {}
+    for op in ops:
+        payload = (
+            (op.kind in ("all-reduce", "reduce-scatter") and not op.scalar)
+            or (op.kind in ("all-gather", "all-to-all") and op.rank >= 2)
+        )
+        if payload:
+            out[op.kind] = out.get(op.kind, 0) + op_bytes(op)
+    return out
 
 
 def while_count(text: str) -> int:
@@ -365,13 +398,17 @@ class ProgramExpectation:
     # parameter all-gather cannot leak into the counts.
     scatter_mode: bool = False
     scatter_reductions: int | None = None
+    # The EP dispatch/combine shape: exactly N PAYLOAD (rank >= 2)
+    # all-to-alls — `collectives.all_to_all` submissions; rank-1
+    # scale/column all-to-alls never count (`payload_alltoalls`).
+    alltoalls: int | None = None
 
     @classmethod
     def parse(cls, spec: str) -> "ProgramExpectation":
         """CLI grammar: comma-separated tokens —
         ``one-reduction`` | ``reductions=N`` | ``max-reductions=N`` |
         ``wire=int8`` | ``no-collectives`` | ``donates=N`` |
-        ``scatter-reduction`` | ``scatters=N``.
+        ``scatter-reduction`` | ``scatters=N`` | ``alltoalls=N``.
         (``overlap`` is a CLI-level expectation: it needs two compiles.)
         """
         exp = cls()
@@ -398,20 +435,27 @@ class ProgramExpectation:
             elif key == "scatters" and value:
                 exp.scatter_mode = True
                 exp.scatter_reductions = int(value)
+            elif key == "alltoalls" and value:
+                exp.alltoalls = int(value)
             else:
                 raise ValueError(
                     f"unknown expectation {token!r} — grammar: "
                     "one-reduction | reductions=N | max-reductions=N | "
                     "wire=<int8|fp8|bf16|fp16|f32> | no-collectives | "
-                    "donates=N | scatter-reduction | scatters=N | overlap"
+                    "donates=N | scatter-reduction | scatters=N | "
+                    "alltoalls=N | overlap"
                 )
         return exp
 
 
-def audit(text: str, expects: ProgramExpectation) -> list[str]:
+def audit(text: str, expects: ProgramExpectation, *,
+          ops: list | None = None) -> list[str]:
     """Check `text` against `expects`; returns human-readable violation
-    lines (empty = clean)."""
-    ops = collective_ops(text)
+    lines (empty = clean). ``ops`` lets a caller that already parsed
+    the program (`collective_ops`) skip the re-parse; the text is still
+    needed for the donation-alias header."""
+    if ops is None:
+        ops = collective_ops(text)
     grads = gradient_reductions(ops)
     violations = []
     if expects.no_explicit_collectives and ops:
@@ -473,12 +517,39 @@ def audit(text: str, expects: ProgramExpectation) -> list[str]:
                 f"scatter all-to-alls) in {expects.wire} ({want}), found "
                 "off-wire traffic:\n" + _op_table(off_wire)
             )
+    if expects.alltoalls is not None:
+        a2a = payload_alltoalls(ops)
+        if len(a2a) != expects.alltoalls:
+            excluded = [
+                op for op in ops
+                if op.kind == "all-to-all" and op.rank < 2
+            ]
+            violations.append(
+                f"expected exactly {expects.alltoalls} payload "
+                f"all-to-all(s) (the dispatch/combine shape), found "
+                f"{len(a2a)}:\n" + _op_table(a2a)
+                + (
+                    f"\n      ({len(excluded)} rank-1 scale/column "
+                    "all-to-all(s) excluded from the count)"
+                    if excluded else ""
+                )
+            )
     if expects.min_donated is not None:
         donated = donated_args(text)
         if len(donated) < expects.min_donated:
             violations.append(
                 f"expected >= {expects.min_donated} donated (aliased) "
                 f"inputs, found {len(donated)}: {donated}"
+            )
+    if violations:
+        totals = op_bytes_by_kind(ops)
+        if totals:
+            # Expectation-diff context: where the wire bytes actually
+            # went, per kind — the first question a failed count raises.
+            violations.append(
+                "payload op_bytes by kind: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(totals.items())
+                )
             )
     return violations
 

@@ -195,6 +195,18 @@ KNOBS: dict[str, Knob] = _decl([
          "pipeline once and refreshes the step_ms{total,compute,comm,"
          "input} / examples-per-sec / MFU gauges (bench A/B-gates the "
          "overhead at <= 2% of step time)."),
+    Knob("HVT_FLIGHT_RECORD", "path", None, "observability",
+         "Collective flight recorder: set to a DIRECTORY and every "
+         "collectives.py submission site appends a bounded per-process "
+         "JSONL record (seq, kind, dtype, shape, bytes, bucket id, "
+         "caller tag) to <dir>/flight-<member>.jsonl — write-through "
+         "before the collective blocks, dumped on SIGTERM and "
+         "POST /flightrecord, auto-collected by the supervisor's hang "
+         "path, cross-checked by `hvt-sched replay`. Unset = recorder "
+         "off (zero instrumentation cost)."),
+    Knob("HVT_FLIGHT_RECORD_SIZE", "int", 512, "observability",
+         "Flight-recorder ring bound in records per process (explicit "
+         "dumps rewrite the file to at most this many)."),
     Knob("HVT_TRACE_DIR", "path", None, "observability",
          "Structured trace-span directory: nestable JSONL span records "
          "(step, reduction, commit, rescale, checkpoint-save), one "
@@ -203,7 +215,9 @@ KNOBS: dict[str, Knob] = _decl([
     # --- testing / chaos ----------------------------------------------------
     Knob("HVT_FAULT", "spec", None, "testing",
          "Deterministic fault injection, `rank:epoch[.step]:kind` (kinds "
-         "kill/exitN/hang/leave/corrupt[@target])."),
+         "kill/exitN/hang/leave/reorder/corrupt[@target]; `reorder` "
+         "swaps the rank's last two flight-recorded submissions, then "
+         "wedges like `hang` — the hvt-sched replay acceptance fault)."),
     Knob("HVT_FAULT_STAMP", "path", None, "testing",
          "One-shot stamp file: the fault fires once, never while the "
          "stamp exists — across relaunches."),

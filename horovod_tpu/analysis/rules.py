@@ -38,6 +38,17 @@ designed around, loudly, in CHANGES.md/docstrings) — not generic style:
   `obs/core.py` (the HVT004 pattern for the /metrics surface), and no
   ``obs.*`` call may sit inside a jit/shard_map-traced body (a host
   effect — the HVT003 class).
+* HVT010 — whole-program schedule agreement (`analysis/schedule.py`,
+  the `hvt-sched check` rule): every rank-feasible path through a unit
+  must submit the SAME collective sequence — the cross-function,
+  cross-module generalization of HVT007 (rank-gated early returns that
+  skip later collectives, rank-varying loop trip counts, gates passed
+  into helpers as arguments).
+* HVT011 — expert-parallel all-to-all discipline: payload all-to-alls
+  in EP-surface modules must route through `collectives.all_to_all`
+  (flight-recorded, `hvt-audit alltoalls=N`-auditable), never raw
+  ``lax.all_to_all`` at the model layer — the HVT008 pattern for the
+  MoE dispatch/combine wire (ROADMAP item 4).
 
 Rules are interprocedural where the bug class demands it (HVT001 taints
 rank-gated CALLS whose callee transitively issues a collective; HVT007
@@ -851,6 +862,159 @@ class MetricRegistryDiscipline(Rule):
                         "looking live; emit from the host-side loop "
                         "around the step instead",
                     )
+
+
+# --- HVT010 -----------------------------------------------------------------
+
+
+@register_rule
+class ScheduleDivergence(Rule):
+    rule_id = "HVT010"
+    title = "rank-feasible paths submit divergent collective schedules"
+    project_wide = True
+    rationale = (
+        "Collectives pair up across ranks by SUBMISSION ORDER, and this "
+        "framework deliberately dropped Horovod's runtime coordinator — "
+        "so schedule agreement must hold STATICALLY along every path a "
+        "rank can take. HVT001 sees a collective under a gate and HVT007 "
+        "sees one if/else pair; neither sees the composed shapes: a "
+        "rank-gated early RETURN that skips every later collective, a "
+        "loop whose trip count reads the rank, or a gate passed into a "
+        "helper as an argument (the cross-function case). "
+        "`analysis/schedule.py` lifts the call graph's sequences and "
+        "rank-taint facts into a schedule automaton per unit and "
+        "enumerates the rank-feasible paths (rank-predicate-aware, "
+        "loop/cycle-bounded, callee sequences inlined); any two paths of "
+        "the same uniform configuration with different sequences "
+        "deadlock a fleet whose ranks take different arms. Branches on "
+        "provably-uniform values (an allgathered vote, a config knob) "
+        "group paths into separate configurations and are never "
+        "compared across — suppress genuinely uniform rank-syntax "
+        "branches with a noqa stating the uniformity argument."
+    )
+    provenance = (
+        "ISSUE 14 (hvt-sched), closing the verification gap between "
+        "HVT007's sibling branches (PR 9) and `hvt-audit`'s single "
+        "compiled program before the pipeline/MPMD and MoE all-to-all "
+        "schedules land (ROADMAP items 2 and 4)."
+    )
+    example = (
+        "def step(x):\n"
+        "    if rank() == 0:\n"
+        "        return x          # rank 0 skips the psum below\n"
+        "    return psum(x)        # everyone else blocks in it forever\n"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        return self.check_project(Project([module]))
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        from horovod_tpu.analysis import schedule as schedule_mod
+
+        graph = project.callgraph()
+        checker = schedule_mod.checker_for(graph)
+        for key, div in checker.check_all():
+            unit = graph.units[key]
+            a, b = div.path_a, div.path_b
+            op_a, op_b = div.mismatch_ops()
+            chain_a = "; ".join(d.describe() for d in a.rank_dec) or (
+                "(no rank fork taken)"
+            )
+            chain_b = "; ".join(d.describe() for d in b.rank_dec) or (
+                "(no rank fork taken)"
+            )
+            anchor = _line_anchor(unit, div.anchor_line)
+            yield unit.module.finding(
+                self.rule_id, anchor,
+                f"rank-feasible paths through `{unit.name}` submit "
+                f"DIVERGENT collective schedules — path A "
+                f"[{chain_a}]: {list(a.seq)}; path B [{chain_b}]: "
+                f"{list(b.seq)}; first mismatched submission at op "
+                f"{div.mismatch_index}: `{op_a}` vs `{op_b}`. Ranks "
+                "taking different arms submit mismatched collective "
+                "orders and deadlock the fleet (the class Horovod's "
+                "coordinator exists to prevent, arXiv:1802.05799); make "
+                "every rank-feasible path submit the identical "
+                "sequence, or suppress with a noqa stating why the "
+                "condition is uniform across ranks",
+            )
+
+
+def _line_anchor(unit, line: int | None):
+    """An AST-node-shaped anchor for a finding: the distinguishing fork's
+    line when it lives in the unit's own module, else the unit's
+    definition line (cross-module forks — the noqa then goes on the
+    def)."""
+    import types
+
+    if line is not None:
+        return types.SimpleNamespace(lineno=line, col_offset=0)
+    node = unit.node
+    return types.SimpleNamespace(
+        lineno=getattr(node, "lineno", 1),
+        col_offset=getattr(node, "col_offset", 0),
+    )
+
+
+# --- HVT011 -----------------------------------------------------------------
+
+# The expert-parallel surface: modules touching the expert mesh axis /
+# MoE routing vocabulary participate in the EP dispatch/combine contract
+# (ROADMAP item 4) — their payload all-to-alls must route through the
+# entry point where flight recording and the `alltoalls=N` audit grammar
+# live.
+_EP_SURFACE = re.compile(
+    r"EXPERT_AXIS|n_experts|expert_choice|moe_|'expert'|\"expert\""
+)
+
+
+@register_rule
+class ExpertAllToAllDiscipline(Rule):
+    rule_id = "HVT011"
+    title = "raw all-to-all outside the collectives entry point (EP surface)"
+    rationale = (
+        "MoE dispatch/combine all-to-alls are the EP axis's payload "
+        "wire, and they must carry the same discipline as the gradient "
+        "wire: routed through `collectives.all_to_all`, every submission "
+        "is flight-recorded (the hvt-sched evidence trail) and the "
+        "compiled program's payload all-to-all count is auditable "
+        "(`hvt-audit --expect alltoalls=N`). A raw `lax.all_to_all` at "
+        "the model layer is invisible to both — the HVT008 "
+        "entry-point pattern applied to the expert-parallel surface."
+    )
+    provenance = (
+        "ISSUE 14 satellite of ROADMAP item 4 (EP as a first-class "
+        "axis), pinning the entry point before the MoE trainer path "
+        "composes it."
+    )
+    example = (
+        "dispatched = lax.all_to_all(x, 'expert', 0, 0)\n"
+        "# in a module that also wires n_experts / EXPERT_AXIS\n"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        if module.relpath == _REDUCTION_ENTRY_MODULE:
+            return  # the entry point spells the raw op by definition
+        if not _EP_SURFACE.search(module.text):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) != "all_to_all":
+                continue
+            resolved = resolved_dotted(module, node.func) or ""
+            if resolved.startswith("horovod_tpu.parallel.collectives."):
+                continue  # the sanctioned entry point itself
+            yield module.finding(
+                self.rule_id, node,
+                "raw `all_to_all` in an expert-parallel-surface module — "
+                "route the dispatch/combine payload through "
+                "`collectives.all_to_all`, the EP entry point that "
+                "flight-records every submission and keeps the compiled "
+                "program auditable (`hvt-audit --expect alltoalls=N`); a "
+                "model-layer `lax.all_to_all` is invisible to both "
+                "(ROADMAP item 4's wire discipline)",
+            )
 
 
 if __name__ == "__main__":

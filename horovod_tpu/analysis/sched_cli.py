@@ -1,0 +1,200 @@
+"""`hvt-sched` — whole-program collective-schedule verification CLI
+(analysis layer 3: the static path model checker + the flight-record
+replayer).
+
+Usage::
+
+    # Static side: verify every unit's schedule automaton (rule HVT010)
+    # and print the entry-path report (Trainer loops, elastic
+    # commit/sync, rescale boundary, checkpoint save/broadcast):
+    hvt-sched check horovod_tpu/
+    hvt-sched check --format json horovod_tpu/
+
+    # Runtime side: cross-check N ranks' flight records (the JSONL the
+    # supervisor auto-collects on a hang classification) and name the
+    # first divergent submission:
+    hvt-sched replay /path/to/flight-dir
+    hvt-sched replay --window 5 models/flight/hang-2
+
+Exit codes (the `hvt-lint`/`hvt-audit` contract):
+
+* ``0`` — schedules agree (check: zero non-baselined HVT010 findings;
+  replay: every member's record matches op-for-op);
+* ``1`` — divergence (printed: witness chains + first mismatched op for
+  check; member/seq/op + per-rank context windows for replay);
+* ``2`` — usage error / nothing to analyze.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from horovod_tpu.analysis import core
+
+
+def _run_check(args) -> int:
+    baseline_path = None if args.no_baseline else args.baseline
+    try:
+        result = core.lint_paths(
+            args.paths, root=args.root, select=["HVT010"],
+            baseline_path=baseline_path,
+        )
+    except (OSError, ValueError) as e:
+        print(f"hvt-sched: {e}", file=sys.stderr)
+        return 2
+    if result.files == 0:
+        print(
+            f"hvt-sched: no python files under {', '.join(args.paths)} — "
+            "nothing was verified",
+            file=sys.stderr,
+        )
+        return 2
+
+    entries = _entry_rows(result)
+    if args.format == "json":
+        print(json.dumps({
+            "files": result.files,
+            "entries": entries,
+            "findings": [f.to_json() for f in result.findings],
+            "baselined": [f.to_json() for f in result.baselined],
+        }, indent=2))
+        return 0 if result.clean else 1
+
+    for row in entries:
+        seq = ", ".join(row["sequence"]) or "(no collectives)"
+        status = "agree" if row["agree"] else "DIVERGE"
+        print(
+            f"entry {row['unit']}: {row['paths']} path(s) / "
+            f"{row['configurations']} configuration(s) — {status} "
+            f"[{seq}]"
+        )
+    for f in result.findings:
+        print(f.format())
+    summary = (
+        f"hvt-sched: {len(result.findings)} schedule finding(s) in "
+        f"{result.files} file(s)"
+    )
+    if result.baselined:
+        summary += f" ({len(result.baselined)} baselined)"
+    print(summary)
+    return 0 if result.clean else 1
+
+
+def _entry_rows(result: core.LintResult) -> list:
+    """The entry-path automaton report — the banner that makes 'the
+    real entry paths verify' a printed fact, not a prose claim. Reuses
+    the lint pass's parsed module set AND its memoized schedule checker
+    (`schedule.checker_for`): the whole check parses and enumerates
+    each unit exactly once."""
+    from horovod_tpu.analysis import schedule as schedule_mod
+
+    if result.project is None:
+        return []
+    return schedule_mod.entry_report(result.project.callgraph())
+
+
+def _run_replay(args) -> int:
+    from horovod_tpu import flight
+
+    files = flight.record_files(args.dir)
+    if not files:
+        print(
+            f"hvt-sched: no flight-*.jsonl records under {args.dir} — "
+            "was HVT_FLIGHT_RECORD set on the run, and did the "
+            "supervisor's hang path collect?",
+            file=sys.stderr,
+        )
+        return 2
+    by_member = {}
+    for path in files:
+        label = os.path.basename(path)[len("flight-"):-len(".jsonl")]
+        by_member[label] = flight.read_records(path)
+    counts = ", ".join(
+        f"{lb}={len(rs)}" for lb, rs in sorted(by_member.items())
+    )
+    if len(by_member) < 2:
+        print(
+            f"hvt-sched: only one member's record under {args.dir} "
+            f"({counts}) — replay needs at least two ranks to "
+            "cross-check",
+            file=sys.stderr,
+        )
+        return 2
+    div = flight.first_divergence(by_member)
+    if div is None:
+        print(
+            f"hvt-sched: replay ok — {len(by_member)} member(s) agree "
+            f"op-for-op ({counts})"
+        )
+        return 0
+    a, b = div["member_a"], div["member_b"]
+    print(
+        f"hvt-sched: replay FAILED — first divergent submission at "
+        f"seq {div['seq']} ({div['kind']}):"
+    )
+    print(f"  member {a}: {flight.format_op(div['op_a'])}")
+    print(f"  member {b}: {flight.format_op(div['op_b'])}")
+    for label in (a, b):
+        print(f"  --- {label} context (seq ±{args.window}) ---")
+        for rec in flight.context_window(
+            by_member[label], div["seq"], args.window
+        ):
+            marker = ">>" if rec["seq"] == div["seq"] else "  "
+            print(f"  {marker} [{rec['seq']}] {flight.format_op(rec)}")
+    return 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvt-sched",
+        description="Whole-program collective-schedule verification: "
+        "static rank-feasible path model checking (HVT010) and "
+        "flight-record replay cross-checking.",
+    )
+    sub = parser.add_subparsers(dest="cmd")
+
+    check = sub.add_parser(
+        "check", help="verify schedule automata over a source tree")
+    check.add_argument(
+        "paths", nargs="*", default=["horovod_tpu"],
+        help="files or directories to verify (default: horovod_tpu)")
+    check.add_argument(
+        "--format", choices=("human", "json"), default="human")
+    check.add_argument(
+        "--baseline", default=core.DEFAULT_BASELINE, metavar="PATH",
+        help="baseline file of grandfathered findings (shared with "
+        "hvt-lint)")
+    check.add_argument("--no-baseline", action="store_true")
+    check.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="directory findings/baseline paths are relative to")
+
+    replay = sub.add_parser(
+        "replay", help="cross-check N ranks' flight records")
+    replay.add_argument(
+        "dir", help="directory of flight-<member>.jsonl records (the "
+        "HVT_FLIGHT_RECORD dir, or a supervisor hang-collection dir)")
+    replay.add_argument(
+        "--window", type=int, default=3,
+        help="context records to print around the divergence "
+        "(default 3)")
+
+    args = parser.parse_args(argv)
+    if args.cmd is None:
+        parser.print_help()
+        return 2
+    if args.cmd == "check":
+        return _run_check(args)
+    return _run_replay(args)
+
+
+def cli() -> None:
+    """Console entry point (`hvt-sched`, pyproject.toml)."""
+    raise SystemExit(main())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,624 @@
+"""Whole-program collective-schedule verification (hvt-sched, analysis
+layer 3 — rule HVT010).
+
+Horovod's coordinator forces every rank to submit collectives in an
+agreed order because one disagreement deadlocks the fleet
+(arXiv:1802.05799 §4). This framework dropped the coordinator: schedule
+agreement is a STATIC property of the SPMD program — which the first two
+analysis layers only check locally. HVT001 flags a collective *under* a
+rank gate; HVT007 compares the two arms of *one* ``if``; ``hvt-audit``
+checks *one compiled program* is well-formed. None of them can see the
+composed, cross-function failure shapes:
+
+* a rank-gated **early return** that skips every LATER collective
+  (``if rank() == 0: return`` ... ``psum(x)``) — no collective under the
+  gate, no sibling arm, one compiled program per rank that is locally
+  fine;
+* **loop-count divergence** — a loop whose trip count reads the rank
+  (``for _ in range(rank()): psum(x)``) submits a different NUMBER of
+  collectives per rank;
+* the **cross-function gate**: ``step`` passes ``rank() == 0`` into a
+  helper whose branch on that parameter issues different sequences —
+  the gate and the divergence live in different functions (or modules),
+  invisible to both the lexical gate detector and HVT007's
+  sibling-branch comparison.
+
+This module lifts the call graph's per-unit collective sequences and
+rank-taint facts into a *schedule automaton* per unit: every statement
+list is enumerated into the set of **rank-feasible paths** — at each
+branch whose condition is rank-varying (a syntactic rank read, a local
+tainted by one, a parameter bound to a rank-varying argument at an
+inlined call site, or a call to a helper that *returns* a rank-varying
+value), the enumeration forks, because two ranks of one fleet can take
+different arms. Branches on anything else are UNIFORM — every rank
+agrees on the arm — so they key a *configuration*, not a fork: paths are
+grouped by their uniform-decision assignment and only same-configuration
+path pairs are compared (this is what keeps `elastic/state.py`'s
+uniform transport pick — both ranks provably branch on the same
+allgathered votes — out of the findings). Any same-configuration pair
+whose collective sequences differ is an HVT010 finding carrying both
+witness chains and the first mismatched op.
+
+Callee sequences are inlined through the module-set call graph
+(cycle-guarded, depth- and path-capped); loops are bounded to the
+{0 iterations, 1 iteration} pair when rank-varying — the smallest
+witness of a count divergence — and one pass otherwise. The analysis is
+deliberately lexical about rank-ness, like every rule here: a
+rank-varying value laundered through a container or attribute is not
+tracked, and `IfExp`/`BoolOp` collectives are flattened (their gated
+forms are HVT001's, not this rule's). Soundness direction: uniform
+misclassification can only SUPPRESS findings, never invent them.
+
+The real entry paths the ISSUE names — the `Trainer` step/fit loops,
+`ElasticState.commit/sync`, the `elastic.run` rescale boundary, and
+checkpoint save/broadcast — are declared in `ENTRY_PATHS` and
+summarized by `entry_report` (the ``hvt-sched check`` banner); the rule
+itself verifies EVERY unit, entries included, so a divergence is
+reported at the unit that owns the rank fork.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from horovod_tpu.analysis.callgraph import (
+    MODULE_UNIT,
+    RANK_ATTRS,
+    RANK_CALLS,
+    CallGraph,
+    collective_name,
+)
+from horovod_tpu.analysis.core import terminal_name
+
+#: Bounds. Exceeding a cap truncates deterministically (first paths kept,
+#: sequences clipped): completeness degrades, false positives do not.
+PATH_CAP = 64
+SEQ_CAP = 32
+DEPTH_CAP = 8
+
+#: The real whole-program entry paths (module dotted name, unit path) —
+#: where a schedule disagreement actually deadlocks a fleet: the trainer
+#: loops, the elastic commit/sync boundary, the rescale driver, and the
+#: checkpoint save/broadcast surface. `entry_report` summarizes their
+#: automata; the project-wide rule checks every unit regardless.
+ENTRY_PATHS = (
+    ("horovod_tpu.training.trainer", "Trainer.fit"),
+    ("horovod_tpu.training.trainer", "Trainer.evaluate"),
+    ("horovod_tpu.elastic.state", "ElasticState.commit"),
+    ("horovod_tpu.elastic.state", "ElasticState.sync"),
+    ("horovod_tpu.elastic.state", "ElasticState.gather_committed"),
+    ("horovod_tpu.elastic.rescale", "run"),
+    ("horovod_tpu.checkpoint", "save_checkpoint"),
+    ("horovod_tpu.checkpoint", "restore_latest_and_broadcast"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One branch choice along a path."""
+
+    relpath: str   # module of the branch
+    line: int
+    cond: str      # the branch condition's source line, stripped
+    arm: str       # "if-arm" | "else-arm" | "0-iterations" | ...
+    rank: bool     # rank-feasible fork (True) vs uniform configuration
+
+    def describe(self) -> str:
+        return f"{self.relpath}:{self.line} `{self.cond}` -> {self.arm}"
+
+
+@dataclasses.dataclass
+class Path:
+    """One rank-feasible path through a unit's schedule automaton."""
+
+    seq: tuple = ()        # collective names, submission order
+    rank_dec: tuple = ()   # Decision(rank=True) choices along the way
+    uni_key: tuple = ()    # hashable uniform-configuration assignment
+    returned: bool = False
+
+    def child(self, **kw) -> "Path":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass
+class Divergence:
+    """Two same-configuration paths with different collective sequences."""
+
+    unit_key: str
+    path_a: Path
+    path_b: Path
+    anchor_line: int | None  # line in the unit's module (None = def line)
+
+    @property
+    def mismatch_index(self) -> int:
+        a, b = self.path_a.seq, self.path_b.seq
+        for i in range(max(len(a), len(b))):
+            if i >= len(a) or i >= len(b) or a[i] != b[i]:
+                return i
+        return 0
+
+    def mismatch_ops(self) -> tuple:
+        i = self.mismatch_index
+        a = self.path_a.seq[i] if i < len(self.path_a.seq) else "(nothing)"
+        b = self.path_b.seq[i] if i < len(self.path_b.seq) else "(nothing)"
+        return a, b
+
+
+def _first_differing_rank_decision(a: Path, b: Path):
+    """The fork where the two witness paths part ways — the natural
+    anchor (and noqa site) for the finding."""
+    for da, db in zip(a.rank_dec, b.rank_dec):
+        if da != db:
+            return da
+    short = min(len(a.rank_dec), len(b.rank_dec))
+    longer = a.rank_dec if len(a.rank_dec) > len(b.rank_dec) else b.rank_dec
+    return longer[short] if len(longer) > short else None
+
+
+def checker_for(graph: CallGraph) -> "ScheduleChecker":
+    """The graph's memoized `ScheduleChecker` — the HVT010 rule and the
+    entry-path report share one instance per call graph, so `hvt-sched
+    check` enumerates each unit's paths exactly once."""
+    checker = getattr(graph, "_schedule_checker", None)
+    if checker is None:
+        checker = ScheduleChecker(graph)
+        graph._schedule_checker = checker
+    return checker
+
+
+class ScheduleChecker:
+    """Path model checking over one `CallGraph`'s units."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self._paths: dict = {}       # (key, tainted) -> list[Path]
+        self._verdict: dict = {}     # key -> Divergence | None (taint-free)
+        self._rank_returners: set | None = None
+
+    # --- rank-taint of return values (the cross-function gate's fuel) ----
+
+    def _returns_rank(self, key: str) -> bool:
+        """Whether the unit returns a rank-varying value (``return
+        rank() == 0`` — directly, or through a callee that does).
+        Fixed point over the call graph, lexical about rank reads."""
+        if self._rank_returners is None:
+            members: set = set()
+
+            def direct(unit) -> bool:
+                for node in ast.walk(unit.node):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        if self._expr_reads_rank(node.value):
+                            return True
+                return False
+
+            for k, unit in self.graph.units.items():
+                if unit.name != MODULE_UNIT and direct(unit):
+                    members.add(k)
+            changed = True
+            while changed:
+                changed = False
+                for k, unit in self.graph.units.items():
+                    if k in members or unit.name == MODULE_UNIT:
+                        continue
+                    for node in ast.walk(unit.node):
+                        if not (
+                            isinstance(node, ast.Return)
+                            and node.value is not None
+                        ):
+                            continue
+                        for call in ast.walk(node.value):
+                            if not isinstance(call, ast.Call):
+                                continue
+                            callee = self.graph.resolve_call(
+                                unit.module, call, unit.enclosing_class
+                            )
+                            if callee in members:
+                                members.add(k)
+                                changed = True
+                                break
+                        if k in members:
+                            break
+            self._rank_returners = members
+        return key in self._rank_returners
+
+    @staticmethod
+    def _expr_reads_rank(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                if terminal_name(node.func) in RANK_CALLS:
+                    return True
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.attr in RANK_ATTRS:
+                    return True
+        return False
+
+    def _rank_varying(self, unit, expr: ast.AST, tainted: set) -> bool:
+        """Whether ``expr``'s value can differ across ranks: a syntactic
+        rank read, a tainted local/parameter, or a call into a unit that
+        returns a rank-varying value."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                if terminal_name(node.func) in RANK_CALLS:
+                    return True
+                callee = self.graph.resolve_call(
+                    unit.module, node, unit.enclosing_class
+                )
+                if callee is not None and self._returns_rank(callee):
+                    return True
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.attr in RANK_ATTRS:
+                    return True
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.id in tainted:
+                    return True
+        return False
+
+    # --- path enumeration -------------------------------------------------
+
+    def unit_paths(self, key: str, tainted: frozenset = frozenset(),
+                   _depth: int = 0, _stack=None) -> list:
+        """The unit's rank-feasible paths (capped, cached). ``tainted``
+        names parameters bound to rank-varying arguments at the inlining
+        call site."""
+        stack = _stack if _stack is not None else set()
+        cache_key = (key, tainted)
+        cached = self._paths.get(cache_key)
+        if cached is not None:
+            return cached
+        unit = self.graph.units.get(key)
+        if unit is None or _depth > DEPTH_CAP or key in stack:
+            return [Path()]
+        stack.add(key)
+        env = set(tainted)
+        paths = self._eval_block(
+            unit, unit.body, env, [Path()], _depth, stack
+        )
+        stack.discard(key)
+        self._paths[cache_key] = paths
+        return paths
+
+    def _cap(self, paths: list) -> list:
+        return paths[:PATH_CAP]
+
+    def _eval_block(self, unit, stmts, env, paths, depth, stack) -> list:
+        for stmt in stmts:
+            done = [p for p in paths if p.returned]
+            alive = [p for p in paths if not p.returned]
+            if not alive:
+                return self._cap(done)
+            alive = self._eval_stmt(unit, stmt, env, alive, depth, stack)
+            paths = self._cap(done + alive)
+        return paths
+
+    def _decision(self, unit, node, arm: str, rank: bool) -> Decision:
+        return Decision(
+            relpath=unit.module.relpath, line=node.lineno,
+            cond=unit.module.line_at(node.lineno), arm=arm, rank=rank,
+        )
+
+    def _fork(self, unit, node, env, paths, depth, stack, arms) -> list:
+        """Fork ``paths`` over ``arms`` = [(arm_name, stmt_list), ...].
+        ``rank=True`` forks append to rank_dec; uniform forks key the
+        configuration (uni_key)."""
+        rank = arms[0][2]
+        out = []
+        for arm_name, body, _rank in arms:
+            dec = self._decision(unit, node, arm_name, rank)
+            branch = [
+                p.child(
+                    rank_dec=p.rank_dec + (dec,) if rank else p.rank_dec,
+                    uni_key=p.uni_key if rank else p.uni_key + (
+                        (dec.relpath, dec.line, arm_name),
+                    ),
+                )
+                for p in paths
+            ]
+            out.extend(
+                self._eval_block(unit, body, env, branch, depth, stack)
+            )
+        return self._cap(out)
+
+    def _contains_fork_material(self, unit, nodes, env) -> bool:
+        """Whether a statement list can change path STRUCTURE: returns,
+        raises, or (possibly nested) rank-varying branch points. Uniform
+        branches free of these are flattened instead of forked — the
+        HVT007 order-witness treatment — which keeps path counts small
+        in branch-heavy real code."""
+        for root in nodes:
+            for node in ast.walk(root):
+                if isinstance(node, (ast.Return, ast.Raise)):
+                    return True
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(node, (ast.If, ast.While)):
+                    if self._rank_varying(unit, node.test, env):
+                        return True
+                if isinstance(node, ast.For):
+                    if self._rank_varying(unit, node.iter, env):
+                        return True
+        return False
+
+    def _eval_stmt(self, unit, stmt, env, paths, depth, stack) -> list:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return paths  # separate units / import-time class bodies
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            if getattr(stmt, "value", None) is not None:
+                paths = self._eval_expr(
+                    unit, stmt.value, env, paths, depth, stack
+                )
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                paths = self._eval_expr(
+                    unit, stmt.exc, env, paths, depth, stack
+                )
+            return [p.child(returned=True) for p in paths]
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                paths = self._eval_expr(unit, value, env, paths, depth,
+                                        stack)
+                tainted_value = self._rank_varying(unit, value, env)
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if tainted_value:
+                        env.add(t.id)
+                    elif not isinstance(stmt, ast.AugAssign):
+                        # A plain rebind to a uniform value CLEARS the
+                        # taint (soundness direction: stale taint would
+                        # INVENT divergences on provably-uniform
+                        # branches); += keeps it — the old rank-varying
+                        # value still feeds the result.
+                        env.discard(t.id)
+            return paths
+        if isinstance(stmt, ast.If):
+            if self._rank_varying(unit, stmt.test, env):
+                paths = self._eval_expr(unit, stmt.test, env, paths,
+                                        depth, stack)
+                return self._fork(unit, stmt, env, paths, depth, stack, [
+                    ("if-arm", stmt.body, True),
+                    ("else-arm", stmt.orelse, True),
+                ])
+            paths = self._eval_expr(unit, stmt.test, env, paths, depth,
+                                    stack)
+            if self._contains_fork_material(
+                unit, stmt.body, env
+            ) or self._contains_fork_material(unit, stmt.orelse, env):
+                return self._fork(unit, stmt, env, paths, depth, stack, [
+                    ("if-arm", stmt.body, False),
+                    ("else-arm", stmt.orelse, False),
+                ])
+            # Straight-line arms: flatten in source order (HVT007's
+            # order-witness treatment) — identical on every path, so
+            # uniform content can never read as divergence.
+            paths = self._eval_block(unit, stmt.body, env, paths, depth,
+                                     stack)
+            return self._eval_block(unit, stmt.orelse, env, paths, depth,
+                                    stack)
+        if isinstance(stmt, ast.While):
+            paths = self._eval_expr(unit, stmt.test, env, paths, depth,
+                                    stack)
+            if self._rank_varying(unit, stmt.test, env):
+                # Loop/cycle bound: {0, 1} iterations is the smallest
+                # witness of a rank-varying trip count.
+                return self._fork(unit, stmt, env, paths, depth, stack, [
+                    ("0-iterations", [], True),
+                    (">=1-iteration", stmt.body, True),
+                ])
+            return self._eval_block(
+                unit, stmt.body + stmt.orelse, env, paths, depth, stack
+            )
+        if isinstance(stmt, ast.For):
+            paths = self._eval_expr(unit, stmt.iter, env, paths, depth,
+                                    stack)
+            if self._rank_varying(unit, stmt.iter, env):
+                return self._fork(unit, stmt, env, paths, depth, stack, [
+                    ("0-iterations", [], True),
+                    (">=1-iteration", stmt.body, True),
+                ])
+            return self._eval_block(
+                unit, stmt.body + stmt.orelse, env, paths, depth, stack
+            )
+        if isinstance(stmt, ast.Try):
+            # The no-exception path is the schedule under verification;
+            # handlers fork a uniform "configuration" each (an exception
+            # either hits every rank of an SPMD step or is a crash, not
+            # a schedule question — and `except: return` must not kill
+            # the straight-line path).
+            arms = [("no-exception", stmt.body + stmt.orelse, False)]
+            for i, handler in enumerate(stmt.handlers):
+                arms.append((f"handler-{i}", list(handler.body), False))
+            paths = self._fork(unit, stmt, env, paths, depth, stack, arms)
+            return self._eval_block(unit, stmt.finalbody, env, paths,
+                                    depth, stack)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                paths = self._eval_expr(unit, item.context_expr, env,
+                                        paths, depth, stack)
+            return self._eval_block(unit, stmt.body, env, paths, depth,
+                                    stack)
+        # Everything else: evaluate contained expressions generically.
+        return self._eval_expr(unit, stmt, env, paths, depth, stack)
+
+    def _eval_expr(self, unit, node, env, paths, depth, stack) -> list:
+        """Collect collective submissions (and inline resolved callees)
+        from an expression tree, in the callgraph scanner's order."""
+        if node is None:
+            return paths
+        if isinstance(node, ast.Call):
+            name = collective_name(unit.module, node)
+            # Arguments evaluate before the call.
+            for child in ast.iter_child_nodes(node):
+                paths = self._eval_expr(unit, child, env, paths, depth,
+                                        stack)
+            if name is not None:
+                op = terminal_name(node.func) or name
+                return [
+                    p if len(p.seq) >= SEQ_CAP
+                    else p.child(seq=p.seq + (op,))
+                    for p in paths
+                ]
+            callee = self.graph.resolve_call(
+                unit.module, node, unit.enclosing_class
+            )
+            if callee is not None:
+                return self._inline_call(unit, node, callee, env, paths,
+                                         depth, stack)
+            return paths
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return paths
+        if isinstance(node, ast.Lambda):
+            # Callgraph parity: lambdas are immediately-consumed
+            # callbacks; their collectives count for this unit.
+            return self._eval_expr(unit, node.body, env, paths, depth,
+                                   stack)
+        for child in ast.iter_child_nodes(node):
+            paths = self._eval_expr(unit, child, env, paths, depth, stack)
+        return paths
+
+    def _inline_call(self, unit, call, callee_key, env, paths, depth,
+                     stack) -> list:
+        """Cartesian-extend ``paths`` with the callee's path set,
+        propagating rank taint into parameters bound to rank-varying
+        arguments. A taint-free callee that is DIVERGENT on its own
+        contributes one representative path — its divergence is its own
+        finding, not every caller's."""
+        callee = self.graph.units.get(callee_key)
+        if callee is None:
+            return paths
+        tainted = self._tainted_params(unit, call, callee, env)
+        sub = self.unit_paths(callee_key, tainted, depth + 1, stack)
+        if not tainted and len(sub) > 1 and callee_key not in stack:
+            if self._divergence_of(callee_key, _stack=stack) is not None:
+                sub = sub[:1]
+        out = []
+        for p in paths:
+            for s in sub:
+                seq = (p.seq + s.seq)[:SEQ_CAP]
+                out.append(p.child(
+                    seq=seq,
+                    rank_dec=p.rank_dec + s.rank_dec,
+                    uni_key=p.uni_key + s.uni_key,
+                ))
+        return self._cap(out)
+
+    def _tainted_params(self, unit, call, callee, env) -> frozenset:
+        """Parameter names of ``callee`` bound to rank-varying argument
+        expressions at this call site."""
+        fn = callee.node
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return frozenset()
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        if params and params[0] in ("self", "cls") and callee.enclosing_class:
+            params = params[1:]
+        tainted = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(params) and self._rank_varying(unit, arg, env):
+                tainted.add(params[i])
+        all_params = set(params) | {
+            a.arg for a in fn.args.kwonlyargs
+        }
+        for kw in call.keywords:
+            if kw.arg and kw.arg in all_params and self._rank_varying(
+                unit, kw.value, env
+            ):
+                tainted.add(kw.arg)
+        return frozenset(tainted)
+
+    # --- verdicts ---------------------------------------------------------
+
+    def _divergence_of(self, key: str, _stack=None) -> Divergence | None:
+        if key in self._verdict:
+            return self._verdict[key]
+        paths = self.unit_paths(key, frozenset(),
+                                _stack=_stack if _stack is not None
+                                else set())
+        div = self._compare(key, paths)
+        self._verdict[key] = div
+        return div
+
+    def _compare(self, key: str, paths: list) -> Divergence | None:
+        groups: dict = {}
+        for p in paths:
+            groups.setdefault(p.uni_key, {}).setdefault(p.seq, p)
+        unit = self.graph.units[key]
+        for by_seq in groups.values():
+            if len(by_seq) < 2:
+                continue
+            reps = list(by_seq.values())[:2]
+            a, b = reps[0], reps[1]
+            dec = _first_differing_rank_decision(a, b)
+            anchor = (
+                dec.line
+                if dec is not None and dec.relpath == unit.module.relpath
+                else None
+            )
+            return Divergence(
+                unit_key=key, path_a=a, path_b=b, anchor_line=anchor
+            )
+        return None
+
+    def check_unit(self, key: str) -> Divergence | None:
+        """The unit's verdict: None (all rank-feasible paths of every
+        uniform configuration submit the same collective sequence) or
+        the first Divergence."""
+        return self._divergence_of(key)
+
+    def check_all(self):
+        """(key, Divergence) for every divergent unit, key-sorted."""
+        for key in sorted(self.graph.units):
+            div = self.check_unit(key)
+            if div is not None:
+                yield key, div
+
+
+# --- entry-path report (the hvt-sched check banner) -------------------------
+
+
+def entry_units(graph: CallGraph) -> list:
+    """Unit keys matching `ENTRY_PATHS` that exist in this module set."""
+    out = []
+    for modname, path in ENTRY_PATHS:
+        key = f"{modname}:{path}"
+        if key in graph.units:
+            out.append(key)
+    return out
+
+
+def entry_report(graph: CallGraph,
+                 checker: ScheduleChecker | None = None) -> list:
+    """Per-entry automaton summary: rank-feasible path count, distinct
+    sequence count per uniform configuration (1 everywhere = the entry
+    verifies), and a representative sequence."""
+    checker = checker or checker_for(graph)
+    rows = []
+    for key in entry_units(graph):
+        paths = checker.unit_paths(key)
+        groups: dict = {}
+        for p in paths:
+            groups.setdefault(p.uni_key, set()).add(p.seq)
+        agree = all(len(seqs) <= 1 for seqs in groups.values())
+        rep = max((p.seq for p in paths), key=len, default=())
+        rows.append({
+            "unit": key,
+            "paths": len(paths),
+            "configurations": len(groups),
+            "agree": agree,
+            "sequence": list(rep),
+        })
+    return rows

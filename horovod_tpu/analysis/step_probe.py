@@ -19,6 +19,7 @@ from __future__ import annotations
 __all__ = [
     "build_trainer",
     "canonical_step_text",
+    "lowered_moe_dispatch_text",
     "lowered_step_text",
     "probe_data",
     "probe_model",
@@ -104,6 +105,48 @@ def lowered_step_text(tr, x, y, k: int, *, micro: int = 8,
     return tr._train_step.lower(
         state, batch, jnp.asarray(1.0, jnp.float32), acc
     ).as_text()
+
+
+def lowered_moe_dispatch_text(d_model: int = 8, capacity: int = 4) -> str:
+    """Lowered StableHLO of the canonical EP dispatch/combine probe —
+    the MoE wire shape `hvt-audit moe --expect alltoalls=2` gates.
+
+    A shard_map over an ``expert`` axis spanning every local device
+    moves each group's routed activations to the expert shards that own
+    them (`collectives.all_to_all`, the HVT011 entry point), runs the
+    expert FFN stand-in, and combines them back with the mirror
+    all-to-all — exactly TWO payload (rank >= 2) all-to-alls, no
+    full-payload all-reduce anywhere. The probe is structural like
+    `probe_model`: what's audited is the wire shape, not the routing
+    math (`models/moe.py` owns that). Requires `horovod_tpu.init()`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import compat
+    from horovod_tpu.parallel import collectives
+
+    devices = jax.devices()
+    e = len(devices)
+    mesh = jax.sharding.Mesh(np.asarray(devices), ("expert",))
+
+    def stage(x):
+        # x: this shard's [E, C, D] dispatch block — row i holds the
+        # tokens this shard routed to expert i.
+        dispatched = collectives.all_to_all(
+            x, "expert", split_axis=0, concat_axis=0, tiled=True
+        )
+        h = jnp.tanh(dispatched)  # the expert FFN stand-in
+        return collectives.all_to_all(
+            h, "expert", split_axis=0, concat_axis=0, tiled=True
+        )
+
+    fn = compat.shard_map(
+        stage, mesh=mesh, in_specs=(P("expert"),), out_specs=P("expert")
+    )
+    x = jnp.zeros((e * e, capacity, d_model), jnp.float32)
+    return jax.jit(fn).lower(x).as_text()
 
 
 def canonical_step_text(k: int = 4, compression: str = "none", *,

@@ -1,0 +1,411 @@
+"""Per-process collective flight recorder — the runtime evidence trail
+behind ``hvt-sched replay`` (hvt-sched, the verification layer's runtime
+side).
+
+Horovod's coordinator (arXiv:1802.05799) exists because a single rank
+submitting its collectives in a different order deadlocks the fleet —
+and this framework deliberately dropped the coordinator, trusting the
+SPMD program + the static analyzers to keep submission order agreed.
+When that trust is misplaced the observable symptom is a HANG: no exit
+code, stale heartbeats, and (until now) no record of WHAT each rank was
+doing when it wedged. This module is the black box: with
+``HVT_FLIGHT_RECORD=<dir>`` set, every submission site in
+`parallel.collectives` appends one bounded record — seq, kind, dtype,
+shape, payload bytes, fusion-bucket id, caller tag — to this process's
+``<dir>/flight-<member>.jsonl``, and ``hvt-sched replay <dir>``
+cross-checks N ranks' records to name the first divergent submission.
+
+Contracts:
+
+* **Zero cost off.** Unset ``HVT_FLIGHT_RECORD`` leaves the module-level
+  ``RECORDER`` at ``None``; every submission site in collectives.py
+  routes through ONE gate (``collectives._maybe_record``) whose off-path
+  is a single ``is None`` check — no string formatting, no frame walks,
+  no I/O. Asserted structurally by the tier-1 tests.
+* **Write-through.** Each record is appended (and flushed) to the JSONL
+  file BEFORE the collective blocks, so a rank wedged inside a native
+  collective — the one failure mode that can never run a dump handler —
+  still leaves its final submission on disk. The in-memory ring (bounded
+  by ``HVT_FLIGHT_RECORD_SIZE``) is what explicit dumps rewrite.
+* **Dump triggers.** SIGTERM (handler chained in front of whatever was
+  installed — the supervisor's hang teardown SIGTERMs the fleet first),
+  ``POST /flightrecord`` on the trainer metrics exporter (obs/server),
+  and the supervisor's hang classification, which copies every member's
+  file into a per-attempt quarantine dir before the relaunch truncates
+  them (`collect`).
+* **Submission time vs trace time.** Eager host-level collectives
+  (broadcast_object, the elastic sync/gather transport) record at CALL
+  time — per submission, the runtime evidence. Collectives inside a
+  traced step (reduce_gradients' buckets) record at TRACE time — once
+  per compile, a program-order witness, tagged by the same caller-tag
+  mechanism.
+
+Deliberately stdlib-only: the supervisor (which never imports jax) and
+the ``hvt-sched replay`` CLI both import this module.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+
+from horovod_tpu.analysis import registry
+
+ENV_RECORD = "HVT_FLIGHT_RECORD"
+ENV_SIZE = "HVT_FLIGHT_RECORD_SIZE"
+
+#: The live recorder, or None when recording is off. Submission sites
+#: check this ONE name — the whole off-path instrumentation cost.
+RECORDER = None
+
+
+def member_label() -> str:
+    """Stable per-process identity for the record filename: the elastic
+    member id when launched elastically, else the launcher-assigned rank,
+    else the pid (standalone runs)."""
+    member = registry.get_str("HVT_ELASTIC_MEMBER")
+    if member:
+        return member
+    for knob in ("HVT_PROCESS_ID", "HVT_LOCAL_RANK"):
+        raw = registry.get_raw(knob)
+        if raw is not None:
+            return f"rank{int(raw)}"
+    return f"pid{os.getpid()}"
+
+
+class FlightRecorder:
+    """Bounded per-process submission recorder (see module docstring).
+
+    ``records`` is a ring of at most ``size`` dicts; the JSONL file is
+    append-on-record (write-through) and rewritten from the ring by
+    `dump`/`swap_last_two` — so the file always carries at least the
+    ring, and the tail is on disk even when the process dies without a
+    handler running."""
+
+    def __init__(self, path: str, size: int = 512):
+        self.path = path
+        self.size = max(2, int(size))
+        self.seq = 0
+        self.records: collections.deque = collections.deque(maxlen=self.size)
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # Truncate on open: a fresh process starts a fresh record. This is
+        # a diagnostic stream, not a checkpoint artifact — a torn write
+        # costs one record of evidence, never correctness.
+        self._fh = open(path, "w")  # hvt: noqa[HVT005] diagnostic stream
+
+    @property
+    def count(self) -> int:
+        return len(self.records)
+
+    def record(self, kind: str, *, dtype=None, shape=None, nbytes=None,
+               bucket=None, tag=None) -> None:
+        rec = {"kind": str(kind)}
+        if dtype is not None:
+            rec["dtype"] = str(dtype)
+        if shape is not None:
+            rec["shape"] = list(shape)
+        if nbytes is not None:
+            rec["bytes"] = int(nbytes)
+        if bucket is not None:
+            rec["bucket"] = int(bucket)
+        if tag is not None:
+            rec["tag"] = str(tag)
+        rec["t"] = time.time()
+        with self._lock:
+            # seq is assigned UNDER the lock: replay keys records by it,
+            # so two threads racing a read-then-increment would collapse
+            # into one seq and fake a 'missing' divergence at the gap.
+            rec["seq"] = self.seq
+            self.seq += 1
+            self.records.append(rec)
+            try:
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
+            except (OSError, ValueError):
+                pass  # evidence is best-effort; never take down training
+
+    def swap_last_two(self) -> bool:
+        """Swap the op payloads (everything but seq/t) of the last two
+        recorded submissions — the `reorder` fault kind's seeded
+        divergence: this rank's record now claims it submitted the ops
+        in the opposite order, which is exactly what a real mismatched
+        submission looks like to `hvt-sched replay`."""
+        with self._lock:
+            if len(self.records) < 2:
+                return False
+            a, b = self.records[-2], self.records[-1]
+            keep = ("seq", "t")
+            pa = {k: v for k, v in a.items() if k not in keep}
+            pb = {k: v for k, v in b.items() if k not in keep}
+            for k in pa:
+                a.pop(k, None)
+            for k in pb:
+                b.pop(k, None)
+            a.update(pb)
+            b.update(pa)
+            self._rewrite_locked()
+        return True
+
+    def _rewrite_locked(self) -> None:
+        try:
+            self._fh.seek(0)
+            self._fh.truncate()
+            for rec in self.records:
+                self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        except (OSError, ValueError):
+            pass
+
+    def dump(self) -> str:
+        """Rewrite the file from the ring (idempotent) and return its
+        path — the SIGTERM / POST /flightrecord trigger."""
+        with self._lock:
+            self._rewrite_locked()
+        return self.path
+
+    def close(self) -> None:
+        with self._lock:
+            self._rewrite_locked()
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
+
+_prev_sigterm = None
+_handler_installed = False
+
+
+def _sigterm_dump(signum, frame):  # pragma: no cover — signal path
+    rec = RECORDER
+    if rec is not None:
+        try:
+            rec.dump()
+        except Exception:
+            pass
+    prev = _prev_sigterm
+    if callable(prev):
+        prev(signum, frame)
+    elif prev != signal.SIG_IGN:
+        # SIG_DFL — or None, getsignal's answer when the prior handler
+        # was installed from C (absl/XLA runtimes): restore the default
+        # and re-deliver so termination semantics (and the 143 exit-code
+        # convention) are preserved; a process that dumped its ring must
+        # still DIE on SIGTERM. Only an explicit SIG_IGN keeps ignoring.
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _install_sigterm_dump() -> None:
+    global _prev_sigterm, _handler_installed
+    if _handler_installed:
+        return
+    try:
+        _prev_sigterm = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM, _sigterm_dump)
+        _handler_installed = True
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+
+
+def enable(directory: str | None = None, size: int | None = None):
+    """Start this process's recorder (idempotent). ``directory`` defaults
+    to ``HVT_FLIGHT_RECORD``; returns the recorder, or None when the knob
+    is unset (recording stays off — the zero-cost default)."""
+    global RECORDER
+    if RECORDER is not None:
+        return RECORDER
+    directory = directory or registry.get_str(ENV_RECORD)
+    if not directory:
+        return None
+    if size is None:
+        size = registry.get_int(ENV_SIZE) or 512
+    path = os.path.join(directory, f"flight-{member_label()}.jsonl")
+    RECORDER = FlightRecorder(path, size)
+    _install_sigterm_dump()
+    return RECORDER
+
+
+def disable() -> None:
+    """Stop and drop the recorder (tests)."""
+    global RECORDER
+    if RECORDER is not None:
+        RECORDER.close()
+        RECORDER = None
+
+
+# --- collection (the supervisor's hang path) --------------------------------
+
+
+def record_files(directory: str) -> list:
+    """The per-member record files under ``directory``, name-sorted."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return [
+        os.path.join(directory, n) for n in sorted(names)
+        if n.startswith("flight-") and n.endswith(".jsonl")
+    ]
+
+
+def collect(directory: str, dest: str) -> list:
+    """Quarantine-copy every member's record file into ``dest`` — the
+    supervisor's hang-classification hook. Copies (never moves): the
+    relaunch truncates the live files on its own, and the copies are
+    what ``hvt-sched replay`` examines post-mortem. Returns the copied
+    paths (empty when there was nothing to collect)."""
+    files = record_files(directory)
+    if not files:
+        return []
+    os.makedirs(dest, exist_ok=True)
+    out = []
+    for src in files:
+        target = os.path.join(dest, os.path.basename(src))
+        try:
+            shutil.copyfile(src, target)
+        except OSError:
+            continue
+        out.append(target)
+    return out
+
+
+# --- replay cross-check (hvt-sched replay) ----------------------------------
+
+
+def read_records(path: str) -> list:
+    """Parse one record file; torn tail lines are skipped (a SIGKILL can
+    land mid-append — the preceding records are the evidence)."""
+    out = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "seq" in rec and "kind" in rec:
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def op_key(rec: dict) -> tuple:
+    """What must MATCH across ranks for a submission to agree: the op's
+    identity (kind/dtype/shape/bucket/caller tag). Payload BYTES are
+    deliberately excluded — object collectives legitimately move
+    different byte counts per rank (allgather_object contributions)."""
+    shape = rec.get("shape")
+    return (
+        rec.get("kind"),
+        rec.get("dtype"),
+        tuple(shape) if shape is not None else None,
+        rec.get("bucket"),
+        rec.get("tag"),
+    )
+
+
+def format_op(rec: dict | None) -> str:
+    if rec is None:
+        return "(no submission)"
+    parts = [str(rec.get("kind"))]
+    if rec.get("dtype") is not None or rec.get("shape") is not None:
+        dims = "x".join(str(d) for d in (rec.get("shape") or ()))
+        parts.append(f"{rec.get('dtype') or '?'}[{dims}]")
+    if rec.get("bucket") is not None:
+        parts.append(f"bucket={rec['bucket']}")
+    if rec.get("tag"):
+        parts.append(f"@{rec['tag']}")
+    return " ".join(parts)
+
+
+def first_divergence(by_member: dict) -> dict | None:
+    """Cross-check N members' record lists (``{label: [records]}``) and
+    return the first divergent submission, or None when every member
+    agrees.
+
+    Alignment is by the records' own ``seq`` (ring truncation keeps seq
+    monotonic), starting at the latest FIRST seq any non-empty member
+    still holds: one member's ring may have dropped early history while
+    a natively-wedged peer's write-through file kept it all — coverage
+    asymmetry is not divergence, so only the commonly-covered window is
+    compared. A member with NO records at all still diverges at its
+    peers' first submission (a rank that never submitted is the
+    verdict, not a window artifact). The lexicographically-first member
+    is the reference; the first in-window seq where any member's op
+    identity differs — or where exactly one side has a submission at
+    all (missing/extra) — is the verdict: ``{seq, kind:
+    mismatch|missing|extra, member_a, member_b, op_a, op_b}``."""
+    labels = sorted(by_member)
+    if len(labels) < 2:
+        return None
+    maps = {lb: {r["seq"]: r for r in by_member[lb]} for lb in labels}
+    # The window is computed over NON-empty members only: one member's
+    # empty record must not re-expose another's ring-truncated head as
+    # a false 'missing' — the empty member itself still diverges at the
+    # window's first seq (its silence IS the verdict).
+    starts = [min(m) for m in maps.values() if m]
+    start = max(starts) if starts else 0
+    all_seqs = sorted(
+        {s for m in maps.values() for s in m if s >= start}
+    )
+    ref = labels[0]
+    for s in all_seqs:
+        a = maps[ref].get(s)
+        for lb in labels[1:]:
+            b = maps[lb].get(s)
+            if a is None and b is None:
+                continue
+            if a is None or b is None:
+                return {
+                    "seq": s,
+                    "kind": "missing" if b is None else "extra",
+                    "member_a": ref, "member_b": lb,
+                    "op_a": a, "op_b": b,
+                }
+            if op_key(a) != op_key(b):
+                return {
+                    "seq": s, "kind": "mismatch",
+                    "member_a": ref, "member_b": lb,
+                    "op_a": a, "op_b": b,
+                }
+    return None
+
+
+def context_window(records: list, seq: int, window: int = 3) -> list:
+    """The records within ``window`` submissions of ``seq`` — the
+    per-rank context `hvt-sched replay` prints around the divergence."""
+    return [r for r in records if abs(r["seq"] - seq) <= window]
+
+
+def _has_rank_identity() -> bool:
+    """Whether this process is a launched RANK (the launcher/supervisor
+    assigns one of these) rather than the supervisor/launcher itself —
+    which imports this package too, inherits ``HVT_FLIGHT_RECORD`` from
+    the job shell, and must NOT leave an empty pid-named record that
+    pollutes the hang collection."""
+    return any(
+        registry.get_raw(k) is not None
+        for k in ("HVT_ELASTIC_MEMBER", "HVT_PROCESS_ID", "HVT_LOCAL_RANK")
+    )
+
+
+# Recording starts at import when the knob is set AND this process is a
+# launched rank: the launcher's children inherit HVT_FLIGHT_RECORD and
+# begin recording before the first collective, with no entry-script
+# changes. Standalone (no-launcher) processes enable at `runtime.init`
+# instead — the supervisor never calls either.
+if _has_rank_identity():
+    enable()

@@ -336,6 +336,42 @@ class RestartLog:
         self._maybe_rotate()
 
 
+def resolve_flight_dir(env) -> str | None:
+    """Where the fleet's flight records land, if recording is on: the
+    job env's ``HVT_FLIGHT_RECORD`` overlay, falling back to the
+    launcher's own environment (the registry accessor). None = recorder
+    off — the hang path then collects nothing."""
+    return (env or {}).get("HVT_FLIGHT_RECORD") or registry.get_str(
+        "HVT_FLIGHT_RECORD"
+    )
+
+
+def collect_flight_records(flight_dir: str | None, log: "RestartLog",
+                           attempt: int, **fields) -> list:
+    """The hang-classification hook: quarantine-copy every member's
+    flight record (`flight.collect` — the relaunch truncates the live
+    files, so the copies are the post-mortem evidence `hvt-sched replay
+    <dest>` examines) and journal ONE ``flight_dump`` event carrying the
+    destination — the record `supervisor_metrics` counts into
+    ``hvt_flight_dumps_total``. Best-effort: evidence collection must
+    never change a restart decision."""
+    if not flight_dir:
+        return []
+    from horovod_tpu import flight as flight_lib
+
+    dest = os.path.join(flight_dir, f"hang-{attempt}")
+    try:
+        files = flight_lib.collect(flight_dir, dest)
+    except OSError:
+        return []
+    if files:
+        log.write(
+            "flight_dump", float(len(files)), attempt=attempt, dir=dest,
+            files=[os.path.basename(f) for f in files], **fields,
+        )
+    return files
+
+
 def supervise(
     start,
     policy: RestartPolicy | None = None,
@@ -344,6 +380,7 @@ def supervise(
     heartbeat_dir: str | None = None,
     log_path: str | None = None,
     status_port: int | None = None,
+    flight_dir: str | None = None,
     sleep=time.sleep,
     verbose: bool = True,
 ) -> int:
@@ -375,7 +412,7 @@ def supervise(
     try:
         return _supervise_loop(
             start, policy, log, model_dir, heartbeat_dir, sleep, verbose,
-            marker, budget, total_restarts, backoff, attempt,
+            marker, budget, total_restarts, backoff, attempt, flight_dir,
         )
     finally:
         dump_metrics(log_path, None, budget, model_dir)
@@ -385,7 +422,7 @@ def supervise(
 
 def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
                     verbose, marker, budget, total_restarts, backoff,
-                    attempt) -> int:
+                    attempt, flight_dir=None) -> int:
     restarts_used = budget["used"]  # consecutive no-progress restarts
     while True:
         attempt += 1
@@ -407,6 +444,12 @@ def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
             return 0
 
         kind = classify(code, hang=fleet.aborted)
+        if kind == "hang":
+            # The fleet's SIGTERM teardown already ran each member's
+            # flight-dump handler (and write-through covers ranks
+            # wedged in native collectives): quarantine the evidence
+            # before the relaunch truncates the live files.
+            collect_flight_records(flight_dir, log, attempt, kind=kind)
         new_marker = newest_checkpoint_marker(model_dir)
         progressed = model_dir is not None and new_marker != marker
         marker = new_marker
@@ -514,6 +557,7 @@ def supervise_local(
         heartbeat_dir=heartbeat_dir,
         log_path=log_path,
         status_port=status_port,
+        flight_dir=resolve_flight_dir(env),
         sleep=sleep,
     )
 
@@ -674,6 +718,7 @@ def supervise_elastic(
         dict(env or {}), model_dir, None,
         log_path, RestartPolicy(heartbeat_timeout=None),
     )
+    flight_dir = resolve_flight_dir(env)
     log = RestartLog(log_path)
     log.touch()
     coord = Coordinator(
@@ -742,6 +787,7 @@ def supervise_elastic(
     total_restarts = 0
     backoff = policy.backoff
     hang_killed: set[str] = set()
+    flight_collected: set[int] = set()  # spawn-seq marks, one per hang
     respawn_queue: list[tuple[float, int]] = []  # (due, slot)
     job_done = False
     done_since: float | None = None
@@ -805,6 +851,19 @@ def supervise_elastic(
                     kind = "hang" if member_id in hang_killed else classify(
                         code
                     )
+                    if kind == "hang" and seq not in flight_collected:
+                        # ONE collection per hang episode: a fleet-wide
+                        # wedge reaps every member as `hang` in one
+                        # pass of this loop, and the spawn counter only
+                        # advances on the respawns that follow — so
+                        # marking the current `seq` dedupes the
+                        # episode's members while a LATER hang (after
+                        # respawns) still collects fresh evidence.
+                        flight_collected.add(seq)
+                        collect_flight_records(
+                            flight_dir, log, seq, kind=kind,
+                            member=member_id,
+                        )
                     coord.mark_dead(member_id, reason=kind)
                     last_failure = code if code else 1
                 if not job_done:
@@ -1092,7 +1151,7 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
       ``hvt_restart_budget_remaining``."""
     reg = obs_core.Registry()
     records = journal_records(log_path)
-    restarts = gave_up = shrinks = grows = 0
+    restarts = gave_up = shrinks = grows = flight_dumps = 0
     generation = size = None
     for rec in records:
         name = rec.get("name")
@@ -1104,6 +1163,8 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
             shrinks += 1
         elif name == "grow":
             grows += 1
+        elif name == "flight_dump":
+            flight_dumps += 1
         if name in ("start", "shrink", "grow", "steady"):
             generation = rec.get("generation")
             size = rec.get("size")
@@ -1111,6 +1172,7 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
     reg.counter_set("hvt_fleet_shrinks_total", shrinks)
     reg.counter_set("hvt_fleet_grows_total", grows)
     reg.counter_set("hvt_supervisor_gave_up_total", gave_up)
+    reg.counter_set("hvt_flight_dumps_total", flight_dumps)
     epoch, step, total, spe = manifest_progress(model_dir)
     if coord is not None:
         snap = coord.snapshot()
@@ -1386,6 +1448,7 @@ def supervise_hosts(
         heartbeat_dir=heartbeat_dir,
         log_path=log_path,
         status_port=status_port,
+        flight_dir=resolve_flight_dir(env),
         sleep=sleep,
     )
 

@@ -155,6 +155,11 @@ METRICS: dict[str, MetricSpec] = _decl([
     MetricSpec("hvt_member_heartbeat_age_seconds", "gauge",
                "Seconds since each live member's last TCP beat "
                "(coordinator clock).", "supervisor", labels=("member",)),
+    MetricSpec("hvt_flight_dumps_total", "counter",
+               "Flight-record collections the supervisor journaled on "
+               "hang classifications (each = one hang whose per-rank "
+               "collective submission records were quarantined for "
+               "`hvt-sched replay`).", "supervisor"),
     MetricSpec("hvt_restart_budget_remaining", "gauge",
                "Consecutive no-progress restarts left before the "
                "supervisor gives up (resets to max_restarts on progress).",

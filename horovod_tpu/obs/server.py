@@ -8,7 +8,10 @@ Two consumers:
   so co-located processes don't collide). It additionally mounts
   ``POST /profile?seconds=N``: an on-demand `jax.profiler` capture of the
   next N seconds into ``HVT_TRACE_DIR`` (or ``HVT_PROFILE``), so a slow
-  step can be drilled into without relaunching with profiling on.
+  step can be drilled into without relaunching with profiling on — and
+  ``POST /flightrecord``: an on-demand dump of this process's collective
+  flight record (`horovod_tpu.flight`), the live-fleet entry into
+  ``hvt-sched replay``.
 * **any other long-lived process** wanting a standalone scrape port
   (`start_metrics_server` with an explicit registry). The supervisor and
   the serving server instead mount ``/metrics`` on their existing HTTP
@@ -127,6 +130,28 @@ def start_metrics_server(port: int, host: str | None = None,
         def do_POST(self):
             try:
                 url = urlparse(self.path)
+                if url.path == "/flightrecord":
+                    # On-demand dump of this process's collective flight
+                    # record (horovod_tpu.flight) — the live-fleet
+                    # counterpart of the supervisor's hang collection:
+                    # grab every rank's /flightrecord, then
+                    # `hvt-sched replay` the directory.
+                    from horovod_tpu import flight
+
+                    rec = flight.RECORDER
+                    if rec is None:
+                        self._send_json(409, {
+                            "error": "flight recorder is off — set "
+                            "HVT_FLIGHT_RECORD to a directory and "
+                            "relaunch",
+                        })
+                        return
+                    self._send_json(200, {
+                        "path": rec.dump(),
+                        "records": rec.count,
+                        "seq": rec.seq,
+                    })
+                    return
                 if url.path != "/profile" or trigger is None:
                     self._send_json(404, {"error": f"no route {url.path}"})
                     return

@@ -30,12 +30,52 @@ from typing import Any, Sequence
 
 import jax
 
-from horovod_tpu import compat
+from horovod_tpu import compat, flight
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import multihost_utils
 
 PyTree = Any
+
+
+def _maybe_record(kind, value=None, *, tree=None, bucket=None):
+    """Feed the flight recorder (flight.py) from a submission site.
+
+    THE one gate every site routes through: when ``HVT_FLIGHT_RECORD``
+    is unset, ``flight.RECORDER`` is None and this is a single attribute
+    load + None check — the zero-instrumentation-cost contract the tier-1
+    tests assert structurally. When recording, the record (kind, dtype,
+    shape, payload bytes, bucket id, caller tag) is APPENDED AND FLUSHED
+    before the collective blocks, so a wedged rank's final submission is
+    already on disk when the supervisor collects the evidence."""
+    rec = flight.RECORDER
+    if rec is None:
+        return
+    import math
+    import sys
+
+    dtype = shape = nbytes = None
+    try:
+        if value is not None:
+            shape = tuple(jnp.shape(value))
+            dt = jnp.result_type(value)
+            dtype = str(dt)
+            nbytes = int(jnp.dtype(dt).itemsize * math.prod(shape))
+        elif tree is not None:
+            leaves = jax.tree_util.tree_leaves(tree)
+            nbytes = int(sum(
+                jnp.dtype(jnp.result_type(l)).itemsize
+                * math.prod(jnp.shape(l))
+                for l in leaves
+            ))
+            shape = (len(leaves),)
+    except (TypeError, ValueError):
+        pass  # unhashable/abstract values: record the kind alone
+    code = sys._getframe(2).f_code
+    rec.record(
+        kind, dtype=dtype, shape=shape, nbytes=nbytes, bucket=bucket,
+        tag=getattr(code, "co_qualname", None) or code.co_name,
+    )
 
 
 def _axis_names(axis_name) -> Sequence:
@@ -50,6 +90,7 @@ def allreduce(x, average: bool = True, axis_name=None):
     Traced context: reduction over the named mesh axis/axes.
     Eager context: reduction across host processes (no-op single-process).
     """
+    _maybe_record("allreduce", value=x)
     if axis_name is not None:
         return lax.pmean(x, axis_name) if average else lax.psum(x, axis_name)
     if jax.process_count() == 1:
@@ -62,6 +103,7 @@ def allgather(x, axis_name=None, tiled: bool = True):
     """Concatenate per-worker shards along the leading axis
     (≈ ``hvd.allgather``, the third op in Horovod's kernel set,
     SURVEY.md §2.3 TF-custom-ops row)."""
+    _maybe_record("allgather", value=x)
     if axis_name is not None:
         return lax.all_gather(x, axis_name, axis=0, tiled=tiled)
     if jax.process_count() == 1:
@@ -78,6 +120,7 @@ def broadcast(x, root: int = 0, axis_name=None):
     Eager context: `multihost_utils.broadcast_one_to_all` with the root
     process as source (the reference only ever uses root=0,
     tensorflow2_keras_mnist.py:71, but the API honors any root)."""
+    _maybe_record("broadcast", value=x)
     if axis_name is not None:
         x = jnp.asarray(x)
         names = _axis_names(axis_name)
@@ -105,6 +148,7 @@ def pmean_pytree(tree: PyTree, axis_name=None) -> PyTree:
     host-level mode the whole tree goes through ONE fused collective (the
     moral equivalent of Horovod's tensor-fusion buffer) rather than one
     round-trip per leaf."""
+    _maybe_record("pmean_pytree", tree=tree)
     if axis_name is None:
         if jax.process_count() == 1:
             return tree
@@ -117,6 +161,7 @@ def broadcast_pytree(tree: PyTree, root: int = 0, axis_name=None) -> PyTree:
     """Broadcast every leaf from root — ``hvd.broadcast_global_variables(0)``
     over an arbitrary pytree (model params AND optimizer state; the reference
     broadcasts both, SURVEY.md §7.3)."""
+    _maybe_record("broadcast_pytree", tree=tree)
     if axis_name is None and jax.process_count() > 1:
         if _kv_client() is not None:
             # One fused host-level broadcast over the coordination-service
@@ -246,6 +291,7 @@ def broadcast_object(obj, root: int = 0):
     alongside tensors). Travels over the coordination-service KV store
     (see above); ``process_count()==1`` is the identity, like every
     collective here."""
+    _maybe_record("broadcast_object")
     import pickle
 
     import numpy as np
@@ -280,6 +326,7 @@ def allgather_object(obj) -> list:
     """``hvd.allgather_object``: every process receives the list of all
     processes' picklable objects, ordered by process index. KV-store
     transport (set mine, read everyone's), like `broadcast_object`."""
+    _maybe_record("allgather_object")
     import pickle
 
     import numpy as np
@@ -306,6 +353,30 @@ def allgather_object(obj) -> list:
         pickle.loads(gathered[i, : int(sizes[i])].tobytes())
         for i in range(jax.process_count())
     ]
+
+
+def all_to_all(x, axis_name, *, split_axis: int = 0, concat_axis: int = 0,
+               tiled: bool = True, axis_index_groups=None):
+    """The payload all-to-all entry point — the EP (expert-parallel)
+    dispatch/combine wire (ROADMAP item 4).
+
+    MoE dispatch moves each group's routed activations to the expert
+    shards that own them and combine moves them back: one all-to-all
+    each way, the only collectives whose PAYLOAD is activations rather
+    than gradients. Routing them through this entry point (instead of a
+    raw ``lax.all_to_all`` at the model layer — `hvt-lint` rule HVT011)
+    keeps the EP wire under the same discipline as the gradient wire:
+    every submission is flight-recorded (`horovod_tpu.flight`), and the
+    compiled program's payload all-to-alls are auditable as a count
+    (`hvt-audit --expect alltoalls=N` — rank >= 2 payloads; the rank-1
+    scale/column gathers of the quantized wire stay excluded).
+
+    Traced context only (inside shard_map/pmap over ``axis_name``)."""
+    _maybe_record("all_to_all", value=x)
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=tiled, axis_index_groups=axis_index_groups,
+    )
 
 
 # --- Bucketed fusion + hierarchical (ICI/DCN two-hop) gradient reduction ---
@@ -961,6 +1032,7 @@ def quantized_group_sum(v, axis_name, wire_dtype, *, axis_index_groups=None,
     plus the shot-2 re-quantization error of the chunk it owns — so the
     error-feedback telescoping identity is unchanged: summed over the
     group, the errors equal (true sum − delivered sum) exactly."""
+    _maybe_record("quantized_group_sum", value=v)
     if group_position is None:
         if axis_index_groups is not None:
             raise ValueError(
@@ -1054,6 +1126,7 @@ def hierarchical_psum(x, axis_name, dcn: int, *, extra_axes=(),
     to carry the error feedback, charged PER HOP (each quantized hop
     contributes its own untransmitted remainder, so the telescoping mass
     identity stays exact across the two-level factoring)."""
+    _maybe_record("hierarchical_psum", value=x)
     out, _ = _hierarchical_psum_err(
         x, axis_name, dcn, extra_axes=extra_axes, wire_dtype=wire_dtype,
         ici_wire_dtype=ici_wire_dtype,
@@ -1228,7 +1301,8 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
                 "gradients to float32 before reduce_gradients"
             )
 
-    def reduce_one(b, r):
+    def reduce_one(b, r, bucket_id):
+        _maybe_record("reduce_gradients", value=b, bucket=bucket_id)
         orig = b.dtype
         if dcn > 1:
             return _hierarchical_psum_err(
@@ -1257,9 +1331,15 @@ def reduce_gradients(tree: PyTree, *, data_axis=None, extra_axes=(),
         return out, (None if r is None else jnp.zeros(jnp.shape(r),
                                                       jnp.float32))
 
-    reduced, errors = zip(*[
-        reduce_one(b, r) for b, r in zip(buckets, res_buckets)
-    ]) if buckets else ((), ())
+    # Explicit loop, not a comprehension: reduce_one's flight record
+    # derives its caller tag from the frame two levels up, and a
+    # comprehension frame would tag the evidence '<listcomp>' (and
+    # differently across interpreter versions — PEP 709 inlines it).
+    reduced, errors = [], []
+    for i, (b, r) in enumerate(zip(buckets, res_buckets)):
+        out_b, err_b = reduce_one(b, r, i)
+        reduced.append(out_b)
+        errors.append(err_b)
     out = unflatten_buckets(list(reduced), spec)
     if residual is None:
         return out
@@ -1333,7 +1413,8 @@ def _reduce_gradients_scatter(tree: PyTree, dp: int, *, data_axis,
     # region XLA's latency-hiding scheduler can issue bucket i's
     # psum_scatter while earlier leaves' backward still computes, and
     # start bucket i's shard-local optimizer math as soon as it lands.
-    for b, r, sp in zip(buckets, res_buckets, spans):
+    for i, (b, r, sp) in enumerate(zip(buckets, res_buckets, spans)):
+        _maybe_record("reduce_gradients_scatter", value=b, bucket=i)
         loc, err = _scatter_reduce_bucket(
             b, data_axis, dcn, wire_dtype, extra_axes,
             ici_wire_dtype=ici_wire_dtype, residual=r,

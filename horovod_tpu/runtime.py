@@ -121,6 +121,16 @@ def init(
     if _initialized:
         return world()
 
+    # A process calling init() is a WORKER: start its collective flight
+    # recorder if HVT_FLIGHT_RECORD asks for one (idempotent; no-op
+    # unset). Launched ranks already enabled at import via their
+    # launcher-assigned identity — this covers the standalone
+    # no-launcher mode, and keeps the supervisor (which never inits a
+    # runtime) from recording.
+    from horovod_tpu import flight
+
+    flight.enable()
+
     if registry.get_str(ENV_PLATFORM):
         jax.config.update("jax_platforms", registry.get_str(ENV_PLATFORM))
     n_cpu = registry.get_int(ENV_NUM_CPU_DEVICES)

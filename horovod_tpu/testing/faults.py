@@ -16,6 +16,15 @@ Kinds:
 * ``hang``  — stop making progress while staying alive: the wedged-collective
   failure mode (arXiv:1810.11112) that produces no exit code and is only
   detectable via stale heartbeats.
+* ``reorder`` — swap the last two flight-recorded collective submissions'
+  payloads in THIS rank's record (`flight.FlightRecorder.swap_last_two`),
+  then wedge exactly like ``hang``: the deterministic reproduction of the
+  mismatched-submission-order deadlock class (arXiv:1802.05799 — the bug
+  Horovod's coordinator exists to prevent). The supervisor classifies the
+  hang and auto-collects every member's flight record; ``hvt-sched
+  replay`` must then name this rank, the swapped seq, and the op — the
+  acceptance run for the recorder. Requires ``HVT_FLIGHT_RECORD`` (the
+  swap is a no-op with the recorder off; the wedge still fires).
 * ``leave`` — clean SIGTERM-style self-removal: the planned-departure shape
   (scheduler preemption honored gracefully, elastic shrink testing). Under
   an elastic launch (``HVT_ELASTIC_COORDINATOR`` set) it only RECORDS leave
@@ -78,8 +87,8 @@ from horovod_tpu.training.callbacks import Callback
 ENV_FAULT = "HVT_FAULT"
 ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
 
-KINDS = ("kill", "hang", "leave", "corrupt")  # plus exitN and
-# corrupt@<target> (parse_plan / corrupt_target)
+KINDS = ("kill", "hang", "leave", "corrupt", "reorder")  # plus exitN
+# and corrupt@<target> (parse_plan / corrupt_target)
 
 # Process-wide leave intent (the `leave` fault kind under an elastic
 # launch). The elastic epoch-end agreement consumes it; tests reset it.
@@ -162,8 +171,8 @@ def parse_plan(spec: str) -> FaultPlan:
             corrupt_target(kind)  # validates; raises on a bad target
         else:
             raise ValueError(
-                f"HVT_FAULT kind must be kill, hang, leave, corrupt[@"
-                f"epochN][/shardM] or exitN, got {kind!r}"
+                f"HVT_FAULT kind must be kill, hang, leave, reorder, "
+                f"corrupt[@epochN][/shardM] or exitN, got {kind!r}"
             )
     return FaultPlan(rank=rank, epoch=epoch, kind=kind, step=step)
 
@@ -328,10 +337,17 @@ class FaultInjectionCallback(Callback):
         if self.plan.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
         elif self.plan.kind == "hang":
-            # Stay alive, make no progress, touch no heartbeat — only a
-            # stale-heartbeat supervisor can reap this.
-            while True:
-                time.sleep(3600)
+            self._wedge()
+        elif self.plan.kind == "reorder":
+            # Seed a real submission-order divergence in THIS rank's
+            # flight record, then wedge: the supervisor's hang path
+            # collects every member's record and `hvt-sched replay`
+            # names this rank/seq/op (the recorder acceptance fault).
+            from horovod_tpu import flight
+
+            if flight.RECORDER is not None:
+                flight.RECORDER.swap_last_two()
+            self._wedge()
         elif self.plan.kind == "leave":
             if registry.get_str(runtime.ENV_ELASTIC_COORDINATOR):
                 # Elastic launch: record intent; the elastic callback
@@ -351,3 +367,12 @@ class FaultInjectionCallback(Callback):
             os.kill(os.getpid(), signal.SIGKILL)
         else:
             os._exit(self.plan.exit_code)
+
+    @staticmethod
+    def _wedge():  # pragma: no cover — never returns
+        """Stay alive, make no progress, touch no heartbeat — only a
+        stale-heartbeat supervisor can reap this. A Python-level sleep,
+        so the SIGTERM flight-dump handler still runs when the
+        supervisor's hang teardown arrives."""
+        while True:
+            time.sleep(3600)

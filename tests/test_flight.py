@@ -1,0 +1,387 @@
+"""Flight recorder + `hvt-sched replay` (ISSUE 14 runtime side).
+
+Units pin the recorder contracts (bounded ring, write-through JSONL,
+the off-by-default zero-cost gate — asserted structurally against
+collectives.py's AST), the replay cross-check (mismatch / missing /
+extra, context windows, the 0/1/2 exit contract), the `reorder` fault's
+seeded divergence, the POST /flightrecord surface, and the supervisor's
+hang-path collection + `hvt_flight_dumps_total`. The slow e2e is the
+ISSUE acceptance run: a 2-proc supervised fleet with
+``HVT_FAULT=0:1:reorder`` hangs, the supervisor auto-collects every
+member's record, and `hvt-sched replay` exits nonzero naming the exact
+rank/seq/op.
+"""
+
+import ast
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from horovod_tpu import flight
+from horovod_tpu.analysis import sched_cli
+from horovod_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    flight.disable()
+    rec = flight.enable(str(tmp_path / "flight"), size=8)
+    yield rec
+    flight.disable()
+
+
+class TestRecorder:
+    def test_write_through_and_fields(self, recorder):
+        recorder.record("broadcast_object", tag="sync")
+        recorder.record("reduce_gradients", dtype="float32", shape=(64,),
+                        nbytes=256, bucket=0, tag="step")
+        lines = [json.loads(l) for l in open(recorder.path)]
+        assert [r["seq"] for r in lines] == [0, 1]
+        assert lines[1] == {
+            "seq": 1, "kind": "reduce_gradients", "dtype": "float32",
+            "shape": [64], "bytes": 256, "bucket": 0, "tag": "step",
+            "t": lines[1]["t"],
+        }
+
+    def test_ring_bound_and_dump_rewrite(self, recorder):
+        for i in range(20):
+            recorder.record("allreduce", bucket=i)
+        assert recorder.count == 8  # the HVT_FLIGHT_RECORD_SIZE bound
+        recorder.dump()
+        lines = [json.loads(l) for l in open(recorder.path)]
+        assert len(lines) == 8
+        assert [r["seq"] for r in lines] == list(range(12, 20))
+
+    def test_swap_last_two_seeds_divergence(self, recorder):
+        recorder.record("broadcast_pytree", tag="a")
+        recorder.record("broadcast_object", tag="b")
+        assert recorder.swap_last_two()
+        lines = [json.loads(l) for l in open(recorder.path)]
+        # seqs keep their order; the op payloads traded places.
+        assert [r["seq"] for r in lines] == [0, 1]
+        assert [r["kind"] for r in lines] == [
+            "broadcast_object", "broadcast_pytree",
+        ]
+        assert [r["tag"] for r in lines] == ["b", "a"]
+
+    def test_swap_needs_two_records(self, recorder):
+        recorder.record("allreduce")
+        assert not recorder.swap_last_two()
+
+    def test_collect_quarantines_copies(self, recorder, tmp_path):
+        recorder.record("allreduce")
+        recorder.dump()
+        src_dir = os.path.dirname(recorder.path)
+        dest = str(tmp_path / "hang-1")
+        copied = flight.collect(src_dir, dest)
+        assert len(copied) == 1
+        assert flight.read_records(copied[0])[0]["kind"] == "allreduce"
+
+
+class TestZeroCostOff:
+    def test_recorder_off_by_default(self, monkeypatch):
+        flight.disable()
+        monkeypatch.delenv("HVT_FLIGHT_RECORD", raising=False)
+        assert flight.enable() is None
+        assert flight.RECORDER is None
+
+    def test_collectives_gate_is_structural(self):
+        """The zero-cost contract, asserted against the AST: every
+        submission site in collectives.py routes through the ONE
+        `_maybe_record` gate, whose off-path is exactly a
+        ``flight.RECORDER`` load + ``is None`` return — no other code
+        in the module touches the flight module."""
+        path = os.path.join(
+            REPO, "horovod_tpu", "parallel", "collectives.py"
+        )
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        gate = next(
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef) and n.name == "_maybe_record"
+        )
+        body = [s for s in gate.body
+                if not isinstance(s, ast.Expr)
+                or not isinstance(s.value, ast.Constant)]  # skip docstring
+        first, second = body[0], body[1]
+        assert isinstance(first, ast.Assign)
+        assert ast.unparse(first.value) == "flight.RECORDER"
+        assert isinstance(second, ast.If)
+        assert ast.unparse(second.test).endswith("is None")
+        assert isinstance(second.body[0], ast.Return)
+        # Every flight-module touch outside the gate is the import.
+        sites = 0
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ) and node.func.id == "_maybe_record":
+                sites += 1
+        assert sites >= 10  # every submission site feeds the recorder
+        touches = [
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name) and n.value.id == "flight"
+        ]
+        assert all(
+            gate.lineno <= t.lineno <= gate.end_lineno for t in touches
+        )
+
+
+def _write_records(directory, label, ops):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"flight-{label}.jsonl")
+    # Test fixture, not a crash-consistency artifact.
+    with open(path, "w") as f:  # hvt: noqa[HVT005]
+        for i, op in enumerate(ops):
+            rec = {"seq": i, "t": float(i)}
+            rec.update(op)
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+class TestReplayCrossCheck:
+    OPS = [
+        {"kind": "broadcast_pytree", "tag": "train_begin"},
+        {"kind": "broadcast_object", "tag": "train_begin"},
+        {"kind": "allgather_object", "tag": "epoch_end"},
+    ]
+
+    def test_agreement(self, tmp_path):
+        d = str(tmp_path)
+        _write_records(d, "rank0", self.OPS)
+        _write_records(d, "rank1", self.OPS)
+        by = {lb: flight.read_records(p) for lb, p in [
+            ("rank0", os.path.join(d, "flight-rank0.jsonl")),
+            ("rank1", os.path.join(d, "flight-rank1.jsonl")),
+        ]}
+        assert flight.first_divergence(by) is None
+        assert sched_cli.main(["replay", d]) == 0
+
+    def test_mismatch_names_rank_seq_op(self, tmp_path, capsys):
+        d = str(tmp_path)
+        swapped = [self.OPS[1], self.OPS[0], self.OPS[2]]
+        _write_records(d, "rank0", swapped)
+        _write_records(d, "rank1", self.OPS)
+        assert sched_cli.main(["replay", d]) == 1
+        out = capsys.readouterr().out
+        assert "first divergent submission at seq 0 (mismatch)" in out
+        assert "member rank0: broadcast_object" in out
+        assert "member rank1: broadcast_pytree" in out
+        assert ">> [0]" in out  # the context-window marker
+
+    def test_missing_submission(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _write_records(d, "rank0", self.OPS)
+        _write_records(d, "rank1", self.OPS[:2])  # wedged before op 2
+        assert sched_cli.main(["replay", d]) == 1
+        out = capsys.readouterr().out
+        assert "seq 2 (missing)" in out
+        assert "(no submission)" in out
+
+    def test_ring_truncation_is_not_divergence(self, tmp_path):
+        """Coverage asymmetry — one member's ring dropped early history
+        while a natively-wedged peer's write-through file kept it all —
+        must NOT read as divergence: only the commonly-covered seq
+        window is compared."""
+        ops = [{"kind": k} for k in
+               ("broadcast_pytree", "allreduce", "allgather_object",
+                "broadcast_object")]
+        full = [dict(seq=i, t=float(i), **op) for i, op in enumerate(ops)]
+        truncated = full[2:]  # the ring kept only seqs 2..3
+        assert flight.first_divergence(
+            {"rank0": full, "rank1": truncated}
+        ) is None
+        # A genuinely silent member is still the verdict, not a window
+        # artifact.
+        div = flight.first_divergence({"rank0": full, "rank1": []})
+        assert div is not None and div["seq"] == 0
+        # And one empty member must not re-expose ANOTHER member's
+        # ring-truncated head as a false missing: the window still
+        # clips, and the empty member is the named divergence.
+        div3 = flight.first_divergence(
+            {"rank0": full, "rank1": truncated, "rank2": []}
+        )
+        assert div3 is not None
+        assert div3["seq"] == 2  # the window start, not seq 0
+        assert div3["member_b"] == "rank2"
+
+    def test_usage_errors_exit_2(self, tmp_path, capsys):
+        assert sched_cli.main(
+            ["replay", str(tmp_path / "nope")]
+        ) == 2
+        assert "no flight-" in capsys.readouterr().err
+        d = str(tmp_path)
+        _write_records(d, "rank0", self.OPS)
+        assert sched_cli.main(["replay", d]) == 2  # one rank can't cross-check
+        assert "at least two ranks" in capsys.readouterr().err
+
+
+class TestReorderFault:
+    def test_parse_plan_accepts_reorder(self):
+        plan = faults.parse_plan("0:1:reorder")
+        assert plan.kind == "reorder" and plan.rank == 0 and plan.epoch == 1
+
+    def test_fire_swaps_then_wedges(self, recorder, monkeypatch):
+        recorder.record("broadcast_pytree", tag="a")
+        recorder.record("broadcast_object", tag="b")
+        wedged = []
+        monkeypatch.setattr(
+            faults.FaultInjectionCallback, "_wedge",
+            staticmethod(lambda: wedged.append(True)),
+        )
+        cb = faults.FaultInjectionCallback(faults.parse_plan("0:0:reorder"))
+        cb._fire()
+        assert wedged == [True]
+        assert [r["kind"] for r in recorder.records] == [
+            "broadcast_object", "broadcast_pytree",
+        ]
+
+    def test_recorded_submission_sites_feed_recorder(self, recorder):
+        """The collectives gate actually reaches the recorder: a
+        host-level object collective in a single-process world records
+        its submission (kind + caller tag) before degrading to the
+        identity."""
+        from horovod_tpu.parallel import collectives
+
+        def my_caller():
+            return collectives.broadcast_object({"cfg": 1})
+
+        my_caller()
+        assert recorder.count == 1
+        rec = recorder.records[-1]
+        assert rec["kind"] == "broadcast_object"
+        assert "my_caller" in rec["tag"]
+
+
+class TestPostFlightrecord:
+    def test_post_dumps_and_reports(self, recorder):
+        from horovod_tpu.obs import server as obs_server
+
+        recorder.record("allreduce")
+        srv = obs_server.start_metrics_server(0)
+        try:
+            port = srv.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/flightrecord", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["records"] == 1
+            assert payload["path"] == recorder.path
+            assert os.path.exists(payload["path"])
+        finally:
+            srv.shutdown()
+
+    def test_post_without_recorder_is_409(self):
+        from horovod_tpu.obs import server as obs_server
+
+        flight.disable()
+        srv = obs_server.start_metrics_server(0)
+        try:
+            port = srv.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/flightrecord", method="POST"
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 409
+        finally:
+            srv.shutdown()
+
+
+class TestSupervisorCollection:
+    def test_hang_collection_journals_and_counts(self, tmp_path):
+        from horovod_tpu.launch import supervisor
+
+        flight_dir = str(tmp_path / "flight")
+        _write_records(flight_dir, "rank0", TestReplayCrossCheck.OPS)
+        _write_records(flight_dir, "rank1", TestReplayCrossCheck.OPS)
+        log_path = str(tmp_path / "restarts.jsonl")
+        log = supervisor.RestartLog(log_path)
+        files = supervisor.collect_flight_records(
+            flight_dir, log, attempt=2, kind="hang"
+        )
+        assert len(files) == 2
+        assert all(os.path.dirname(f).endswith("hang-2") for f in files)
+        records = supervisor.journal_records(log_path)
+        dump = next(r for r in records if r["name"] == "flight_dump")
+        assert dump["files"] == [
+            "flight-rank0.jsonl", "flight-rank1.jsonl",
+        ]
+        # The journal record is what the /metrics scrape counts.
+        reg = supervisor.supervisor_metrics(log_path)
+        series = {
+            spec.name: values for spec, values in reg.collect()
+        }
+        assert series["hvt_flight_dumps_total"] == [((), 1.0)]
+
+    def test_no_flight_dir_is_a_noop(self, tmp_path):
+        from horovod_tpu.launch import supervisor
+
+        log = supervisor.RestartLog(str(tmp_path / "restarts.jsonl"))
+        assert supervisor.collect_flight_records(None, log, 1) == []
+        assert supervisor.journal_records(log.path) == []
+
+
+@pytest.mark.slow
+def test_reorder_hang_collect_replay_e2e(tmp_path, capfd):
+    """THE ISSUE 14 acceptance run: a 2-proc supervised fleet with
+    ``HVT_FAULT=0:1:reorder`` — rank 0 swaps its last two recorded
+    submissions and wedges, its peer blocks in the next step's
+    collective, the supervisor classifies the hang, auto-collects every
+    member's flight record into a quarantine dir (journaling
+    ``flight_dump``), relaunches (stamp: the fault is spent), and the
+    rerun completes. `hvt-sched replay` over the collected dir then
+    exits nonzero naming rank 0, the swapped seq, and the ops."""
+    from horovod_tpu.launch import supervisor
+    from horovod_tpu.launch.supervisor import RestartPolicy
+    from tests.test_supervisor import write_train_script
+
+    argv = write_train_script(tmp_path)
+    model_dir = tmp_path / "models"
+    flight_dir = tmp_path / "flight"
+    log = tmp_path / "restarts.jsonl"
+    env = {
+        "HVT_PLATFORM": "cpu",
+        "HVT_NUM_CPU_DEVICES": "2",
+        "PS_MODEL_PATH": str(model_dir),
+        "DRIVE_EPOCHS": "2",
+        "HVT_FAULT": "0:1:reorder",
+        "HVT_FAULT_STAMP": str(tmp_path / "fault-stamp"),
+        "HVT_FLIGHT_RECORD": str(flight_dir),
+        # Chaos children stay out of the shared XLA cache (see
+        # test_supervisor_e2e._env).
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
+    }
+    code = supervisor.supervise_local(
+        2, argv, env=env,
+        policy=RestartPolicy(
+            max_restarts=4, backoff=0.0, grace_seconds=5.0,
+            heartbeat_timeout=20.0,
+        ),
+        model_dir=str(model_dir), log_path=str(log),
+        sleep=lambda s: None,
+    )
+    assert code == 0
+    records = [json.loads(l) for l in open(log) if l.strip()]
+    assert any(
+        r["name"] == "restarts" and r["kind"] == "hang" for r in records
+    )
+    dumps = [r for r in records if r["name"] == "flight_dump"]
+    assert dumps, "the hang classification must collect flight records"
+    collected = dumps[0]["dir"]
+    assert len(flight.record_files(collected)) == 2
+    # The replay names the seeded divergence: rank 0, the swapped seq,
+    # and the mismatched ops.
+    rc = sched_cli.main(["replay", collected])
+    out = capfd.readouterr().out
+    assert rc == 1, out
+    assert "replay FAILED" in out
+    assert "mismatch" in out
+    assert "rank0" in out and "rank1" in out

@@ -200,6 +200,64 @@ class TestExpectations:
             ("reduce-scatter", "f32"), ("all-to-all", "i8"),
         ]
 
+    def test_parse_alltoalls_token(self):
+        e = hlo_audit.ProgramExpectation.parse("alltoalls=2,wire=f32")
+        assert e.alltoalls == 2 and e.wire == "f32"
+
+    def test_payload_alltoalls_discrimination_both_dialects(self):
+        """Rank >= 2 all-to-alls count (dispatch/combine payloads, the
+        quantized wire's reduce-scatter shot); rank-1 all-to-alls are
+        scale/column movement and never do — both dialects."""
+        stablehlo = (
+            '%0 = "stablehlo.all_to_all"(%a) <{split_count = 8 : i64}> :'
+            " (tensor<8x301xi8>) -> tensor<8x301xi8>\n"
+            '%1 = "stablehlo.all_to_all"(%s) <{split_count = 8 : i64}> :'
+            " (tensor<8xf32>) -> tensor<8xf32>\n"
+        )
+        ops = hlo_audit.payload_alltoalls(stablehlo)
+        assert [(o.kind, o.dtype, o.rank) for o in ops] == [
+            ("all-to-all", "i8", 2),
+        ]
+        hlo = (
+            "ENTRY %main {\n"
+            "  %aa = f32[8,16]{1,0} all-to-all(f32[8,16]{1,0} %x), "
+            "channel_id=1\n"
+            "  %sc = f32[8]{0} all-to-all(f32[8]{0} %s), channel_id=2\n"
+            "}\n"
+        )
+        ops2 = hlo_audit.payload_alltoalls(hlo)
+        assert [(o.kind, o.rank) for o in ops2] == [("all-to-all", 2)]
+
+    def test_alltoalls_count_violation_names_exclusions(self):
+        text = (
+            '%0 = "stablehlo.all_to_all"(%a) <{split_count = 8 : i64}> :'
+            " (tensor<8x301xi8>) -> tensor<8x301xi8>\n"
+            '%1 = "stablehlo.all_to_all"(%s) <{split_count = 8 : i64}> :'
+            " (tensor<8xf32>) -> tensor<8xf32>\n"
+        )
+        violations = hlo_audit.audit(
+            text, hlo_audit.ProgramExpectation.parse("alltoalls=2")
+        )
+        assert violations
+        assert "found 1" in violations[0]
+        assert "rank-1 scale/column" in violations[0]
+        hlo_audit.assert_program(text, "alltoalls=1")  # the true count
+
+    def test_op_bytes_by_kind_in_expectation_diffs(self):
+        """A failed count carries the per-kind payload-byte totals —
+        where the wire bytes actually went is the first question."""
+        with pytest.raises(hlo_audit.ProgramAuditError) as e:
+            hlo_audit.assert_program(HLO_SAMPLE, "one-reduction")
+        msg = str(e.value)
+        assert "payload op_bytes by kind:" in msg
+        assert f"all-reduce={2410 * 4}" in msg
+        assert f"all-gather={8 * 2410}" in msg
+        totals = hlo_audit.op_bytes_by_kind(HLO_SAMPLE)
+        # The scalar all-reduce and the rank-1 scale gather contribute 0.
+        assert totals == {
+            "all-reduce": 2410 * 4, "all-gather": 8 * 2410,
+        }
+
     def test_op_bytes(self):
         op = hlo_audit.CollectiveOp(
             kind="all-to-all", dtype="i8", shape=(8, 301), line=1, index=0
@@ -367,6 +425,23 @@ class TestAuditCLI:
         ])
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "derived --expect scatters=1,wire=int8" in proc.stdout
+
+    def test_moe_dispatch_combine_gate(self):
+        """THE EP wire gate (ISSUE 14 satellite of ROADMAP item 4): the
+        MoE probe's dispatch/combine lowers to exactly TWO payload
+        all-to-alls through `collectives.all_to_all` — asserted end to
+        end through the real CLI against a freshly lowered program."""
+        proc = _run_audit(["moe", "--platform", "cpu"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "derived --expect alltoalls=2" in proc.stdout
+        assert "2 payload all-to-all(s)" in proc.stdout
+
+    def test_moe_gate_wrong_count_fails(self):
+        proc = _run_audit([
+            "moe", "--platform", "cpu", "--expect", "alltoalls=3",
+        ])
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "payload all-to-all" in proc.stdout
 
     def test_overlap_knob_off_fails_gate(self):
         """HVT_OVERLAP_REDUCTION=0 must fail the overlap expectation —

@@ -21,7 +21,9 @@ from horovod_tpu.analysis.rules import (
     CollectiveSymmetry,
     DataLayerSeededRng,
     EnvKnobRegistry,
+    ExpertAllToAllDiscipline,
     ReductionComposition,
+    ScheduleDivergence,
     TeardownDiscipline,
     TracingHazards,
 )
@@ -722,6 +724,255 @@ class TestHVT008ReductionComposition:
             def boundary(grads, k):
                 return collectives.reduce_gradients(grads, reverse=True)
         """) == []
+
+
+class TestHVT010ScheduleDivergence:
+    """Whole-program schedule verification (ISSUE 14 tentpole): every
+    rank-feasible path through a unit must submit the same collective
+    sequence. The matrix seeds the shapes the first two layers cannot
+    see — and the rank-gated-but-agreeing shapes that must NOT fire."""
+
+    def test_rank_gated_early_return_flagged(self):
+        """The canonical HVT001/HVT007-invisible deadlock: no collective
+        under the gate, no sibling arm — rank 0 just skips the psum
+        every other rank blocks in."""
+        found = findings_of(ScheduleDivergence, """
+            def step(x):
+                if rank() == 0:
+                    return x
+                return psum(x)
+        """)
+        assert [f.rule for f in found] == ["HVT010"]
+        assert "DIVERGENT" in found[0].message
+        assert "`psum`" in found[0].message
+        assert "first mismatched submission at op 0" in found[0].message
+        # Anchored at the rank fork, where the noqa belongs.
+        assert found[0].line == 3
+
+    def test_two_hop_cross_module_divergent_schedule(self, tmp_path):
+        """The 2-hop cross-module case: the gate lives in the entry
+        module, the collective two call hops away in another — the
+        witness chain still names the fork and the mismatched op."""
+        res = lint_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/helpers.py": """
+                from pkg.deep import inner
+                def finish(x):
+                    return inner(x)
+            """,
+            "pkg/deep.py": """
+                def inner(x):
+                    return psum(x)
+            """,
+            "pkg/main.py": """
+                from pkg import helpers
+                def step(x):
+                    if rank() == 0:
+                        return x
+                    return helpers.finish(x)
+            """,
+        }, select=["HVT010"])
+        assert [f.path for f in res.findings] == ["pkg/main.py"]
+        msg = res.findings[0].message
+        assert "['psum']" in msg and "[]" in msg
+
+    def test_loop_count_divergence_flagged(self):
+        """A loop whose trip count reads the rank submits a different
+        NUMBER of collectives per rank — no gate for HVT001, no sibling
+        arm for HVT007; the {0, 1}-iteration bound witnesses it."""
+        found = findings_of(ScheduleDivergence, """
+            from horovod_tpu import runtime
+            def drain(x):
+                for _ in range(runtime.rank()):
+                    psum(x)
+                return x
+        """)
+        assert [f.rule for f in found] == ["HVT010"]
+        assert "0-iterations" in found[0].message
+
+    def test_rank_gated_but_agreeing_arms_clean(self):
+        """Both arms submit the SAME sequence (the root/non-root
+        broadcast idiom): rank-feasible paths agree — no finding."""
+        assert findings_of(ScheduleDivergence, """
+            def pick(x):
+                if rank() == 0:
+                    cfg = broadcast_object(x)
+                else:
+                    cfg = broadcast_object(None)
+                return cfg
+        """) == []
+
+    def test_uniform_config_pick_clean(self):
+        """elastic/state.py's transport pick, in miniature: the branch
+        reads an ALLGATHERED vote — uniform across ranks — so the two
+        transports are separate configurations, never compared (the
+        false positive the rank-predicate awareness exists to avoid)."""
+        assert findings_of(ScheduleDivergence, """
+            def sync(self, root):
+                votes = allgather_object(self._vote())
+                if all(v == votes[root] for v in votes):
+                    return
+                if votes[root][0] is not None:
+                    self._c = broadcast_pytree(self._c, root=root)
+                else:
+                    self._c = broadcast_object(self._c, root=root)
+        """) == []
+
+    def test_hvt007_invisible_cross_function_case(self):
+        """The gate travels as an ARGUMENT: `step` passes `rank() == 0`
+        into a helper whose one-armed branch on that parameter issues an
+        extra collective. HVT007 needs both arms of one `if` to carry
+        collectives; HVT001 needs a syntactic rank read at the gate —
+        both stay silent, the path pair diverges."""
+        src = """
+            def phase(x, flag):
+                if flag:
+                    psum(x)
+                allgather(x)
+
+            def step(x):
+                phase(x, rank() == 0)
+        """
+        assert findings_of(CollectiveOrderDivergence, src) == []
+        assert findings_of(CollectiveSymmetry, src) == []
+        found = findings_of(ScheduleDivergence, src)
+        assert len(found) == 1
+        msg = found[0].message
+        assert "['psum', 'allgather']" in msg
+        assert "['allgather']" in msg
+        assert "`psum` vs `allgather`" in msg
+
+    def test_rank_returning_helper_gates_the_branch(self):
+        """Rank taint through RETURN VALUES: branching on a helper that
+        returns `rank() == 0` is a rank fork, however many modules away
+        the rank read lives."""
+        found = findings_of(ScheduleDivergence, """
+            def is_root():
+                return rank() == 0
+
+            def step(x):
+                if is_root():
+                    return x
+                return broadcast_object(x)
+        """)
+        assert len(found) == 1
+
+    def test_rebound_uniform_local_clears_taint(self):
+        """Taint soundness direction: a local once bound to a rank read
+        but REBOUND to a uniform value must not keep gating — stale
+        taint would invent divergences on provably-uniform branches."""
+        assert findings_of(ScheduleDivergence, """
+            def step(x):
+                flag = rank() == 0
+                flag = False
+                if flag:
+                    return x
+                return psum(x)
+        """) == []
+        # AugAssign keeps the taint (the old rank value still feeds it).
+        found = findings_of(ScheduleDivergence, """
+            def step(x):
+                n = rank()
+                n += 1
+                if n:
+                    return x
+                return psum(x)
+        """)
+        assert len(found) == 1
+
+    def test_divergent_helper_reported_once(self):
+        """A divergent helper is ITS finding; callers inline one
+        representative path and do not re-report it."""
+        found = findings_of(ScheduleDivergence, """
+            def helper(x):
+                if rank() == 0:
+                    return x
+                return psum(x)
+
+            def caller_a(x):
+                return helper(x)
+
+            def caller_b(x):
+                return helper(x)
+        """)
+        assert len(found) == 1
+
+    def test_noqa_suppresses_at_fork_line(self, tmp_path):
+        res = lint_tree(tmp_path, {"m.py": """
+            def step(x):
+                if rank() == 0:  # hvt: noqa[HVT010] single-proc test path
+                    return x
+                return psum(x)
+        """}, select=["HVT010"])
+        assert res.findings == []
+
+    def test_entry_report_on_fixture_project(self):
+        """`schedule.entry_report` summarizes the real entry automata
+        (the hvt-sched check banner): path/configuration counts and the
+        agree verdict are observable per entry."""
+        import textwrap
+
+        from horovod_tpu.analysis import callgraph, schedule
+
+        m = core.ModuleSource(
+            "/fake/horovod_tpu/elastic/state.py",
+            "horovod_tpu/elastic/state.py",
+            textwrap.dedent("""
+                class ElasticState:
+                    def sync(self, root):
+                        votes = allgather_object(self._vote())
+                        if votes:
+                            self._c = broadcast_object(self._c, root=root)
+                        else:
+                            self._c = broadcast_object(None, root=root)
+            """),
+        )
+        graph = callgraph.CallGraph([m])
+        rows = schedule.entry_report(graph)
+        assert [r["unit"] for r in rows] == [
+            "horovod_tpu.elastic.state:ElasticState.sync"
+        ]
+        assert rows[0]["agree"]
+        assert rows[0]["sequence"][0] == "allgather_object"
+
+
+class TestHVT011ExpertAllToAllDiscipline:
+    """EP dispatch/combine all-to-alls route through the collectives
+    entry point (ROADMAP item 4's wire discipline)."""
+
+    EP_SRC = """
+        from jax import lax
+        from horovod_tpu.parallel.mesh import EXPERT_AXIS
+        def dispatch(x):
+            return lax.all_to_all(x, EXPERT_AXIS, 0, 0, tiled=True)
+    """
+
+    def test_raw_lax_all_to_all_flagged(self):
+        found = findings_of(ExpertAllToAllDiscipline, self.EP_SRC)
+        assert [f.rule for f in found] == ["HVT011"]
+        assert "collectives.all_to_all" in found[0].message
+
+    def test_routed_through_entry_point_clean(self):
+        assert findings_of(ExpertAllToAllDiscipline, """
+            from horovod_tpu.parallel import collectives
+            def dispatch(x, n_experts):
+                return collectives.all_to_all(x, 'expert')
+        """) == []
+
+    def test_outside_ep_surface_not_scoped(self):
+        # A quantized-wire all-to-all in a module with no EP vocabulary
+        # is HVT008/entry-point territory, not this rule's.
+        assert findings_of(ExpertAllToAllDiscipline, """
+            from jax import lax
+            def shuffle(x):
+                return lax.all_to_all(x, 'data', 0, 0)
+        """) == []
+
+    def test_entry_module_exempt(self):
+        assert findings_of(
+            ExpertAllToAllDiscipline, self.EP_SRC,
+            relpath="horovod_tpu/parallel/collectives.py",
+        ) == []
 
 
 class TestRulesDocAndExplain:

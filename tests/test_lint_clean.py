@@ -65,6 +65,66 @@ class TestLintClean:
         assert "0 finding(s)" in proc.stdout
 
 
+class TestSchedCheckClean:
+    """Tier-1 gate (ISSUE 14): the shipped tree passes whole-program
+    schedule verification — every unit's rank-feasible paths submit one
+    collective sequence per uniform configuration, and the real entry
+    paths (Trainer loops, elastic commit/sync, rescale boundary,
+    checkpoint save/broadcast) each verify."""
+
+    def test_package_schedule_verifies(self):
+        result = core.lint_paths(
+            [PACKAGE], root=REPO, select=["HVT010"]
+        )
+        assert result.files > 50
+        assert not result.findings, (
+            "hvt-sched found schedule divergences — fix them, or "
+            "baseline with a one-line justification:\n"
+            + "\n".join(f.format() for f in result.findings)
+        )
+
+    def test_entry_paths_all_agree(self):
+        """Every declared entry automaton verifies AND exists — a
+        renamed entry unit must update schedule.ENTRY_PATHS, not
+        silently drop out of the report."""
+        from horovod_tpu.analysis import schedule
+
+        modules = []
+        for path in core.iter_python_files([PACKAGE]):
+            with open(path, encoding="utf-8") as f:
+                modules.append(core.ModuleSource(
+                    path, os.path.relpath(path, REPO), f.read()
+                ))
+        graph = core.Project(modules).callgraph()
+        rows = schedule.entry_report(graph)
+        assert len(rows) == len(schedule.ENTRY_PATHS), (
+            "entry units missing from the module set — update "
+            "schedule.ENTRY_PATHS for the rename: "
+            f"{[r['unit'] for r in rows]}"
+        )
+        diverging = [r["unit"] for r in rows if not r["agree"]]
+        assert not diverging, f"entry automata diverge: {diverging}"
+        # The elastic sync boundary is the load-bearing one: its
+        # automaton must actually carry the snapshot transport.
+        sync = next(r for r in rows if r["unit"].endswith("ElasticState.sync"))
+        assert "allgather_object" in sync["sequence"]
+
+    def test_sched_cli_exit_code_contract(self):
+        """`hvt-sched check horovod_tpu/` exits 0 on the shipped tree —
+        the pre-commit surface, end to end through the real CLI."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis.sched_cli",
+             "check", "horovod_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 schedule finding(s)" in proc.stdout
+        assert "entry horovod_tpu.elastic.state:ElasticState.sync" in (
+            proc.stdout
+        )
+        assert "DIVERGE" not in proc.stdout
+
+
 class TestEnvvarsDoc:
     DOC = os.path.join(REPO, "docs", "ENVVARS.md")
 
