@@ -209,15 +209,34 @@ KNOBS: dict[str, Knob] = _decl([
          "dumps rewrite the file to at most this many)."),
     Knob("HVT_TRACE_DIR", "path", None, "observability",
          "Structured trace-span directory: nestable JSONL span records "
-         "(step, reduction, commit, rescale, checkpoint-save), one "
-         "rank-tagged file per process (trace.span); also the landing "
-         "dir for POST /profile captures. Unset = spans off."),
+         "(step, reduction, commit, rescale, checkpoint-save, serving "
+         "request/queue-wait/decode), one rank-tagged file per process "
+         "(trace.span); also the landing dir for POST /profile "
+         "captures, and the input of `hvt-trace timeline/report/skew` "
+         "(cross-rank merge, obs/timeline.py). Unset = spans off."),
+    Knob("HVT_SKEW_PROBE", "flag", True, "observability",
+         "Live cross-rank straggler detection (trainer.SkewProbe): at "
+         "each step-phase sample window a tiny host allgather of drain "
+         "waits publishes hvt_step_skew_ms / hvt_straggler_rank / "
+         "hvt_barrier_wait_ms. Only active when the trainer exporter "
+         "(HVT_METRICS_PORT) is on and the run is multi-process; set 0 "
+         "to kill the probe while keeping the exporter."),
+    Knob("HVT_FLEET_POLL_S", "float", 10.0, "observability",
+         "Supervisor fleet-rollup poll cadence in seconds: how often "
+         "the status server re-scrapes each member's trainer exporter "
+         "into the GET /fleet cache (also what the final metrics.prom "
+         "dump merges, so per-rank series survive the fleet). 0 "
+         "disables background polling — /fleet then scrapes only on "
+         "request."),
     # --- testing / chaos ----------------------------------------------------
     Knob("HVT_FAULT", "spec", None, "testing",
          "Deterministic fault injection, `rank:epoch[.step]:kind` (kinds "
-         "kill/exitN/hang/leave/reorder/corrupt[@target]; `reorder` "
-         "swaps the rank's last two flight-recorded submissions, then "
-         "wedges like `hang` — the hvt-sched replay acceptance fault)."),
+         "kill/exitN/hang/leave/reorder/corrupt[@target]/slow:MS; "
+         "`reorder` swaps the rank's last two flight-recorded "
+         "submissions, then wedges like `hang` — the hvt-sched replay "
+         "acceptance fault; `slow:MS` makes the rank sleep MS ms per "
+         "step from the target epoch on, recurring — the hvt-trace "
+         "straggler-detection ground truth)."),
     Knob("HVT_FAULT_STAMP", "path", None, "testing",
          "One-shot stamp file: the fault fires once, never while the "
          "stamp exists — across relaunches."),

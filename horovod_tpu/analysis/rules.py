@@ -762,6 +762,10 @@ _OBS_CALL_RE = re.compile(
 # Any call into the obs package at all (the traced-body check casts the
 # wider net: render/collect/server calls are host effects too).
 _OBS_ANY_RE = re.compile(r"(^|\.)obs(\.[a-z_]+)*\.[a-z_]+$")
+# The span surface (horovod_tpu.trace): entering a span inside a traced
+# body records the TRACE's wall time once, then replays as a constant —
+# a timeline that looks live and is frozen (same HVT003 class).
+_TRACE_SPAN_RE = re.compile(r"(^|\.)trace\.(span|emit_span|maybe_trace)$")
 
 
 def _obs_metric_literal(module: ModuleSource, call: ast.Call):
@@ -795,7 +799,10 @@ def _obs_metric_literal(module: ModuleSource, call: ast.Call):
 @register_rule
 class MetricRegistryDiscipline(Rule):
     rule_id = "HVT009"
-    title = "undeclared metric name, or obs emission inside a traced body"
+    title = (
+        "undeclared metric name, or obs/trace emission inside a traced "
+        "body"
+    )
     rationale = (
         "`horovod_tpu/obs/core.py` is the single declaration point for "
         "every exported metric series (the HVT004 pattern for the "
@@ -806,11 +813,19 @@ class MetricRegistryDiscipline(Rule):
         "at runtime, this rule refuses it at lint time. And any "
         "`obs.*` call inside a jit/pjit/shard_map/scan body is a host "
         "effect executed ONCE at trace time (the HVT003 class): the "
-        "gauge would freeze at its trace-time value while looking live."
+        "gauge would freeze at its trace-time value while looking live. "
+        "`trace.span`/`trace.emit_span` (the HVT_TRACE_DIR span stream "
+        "hvt-trace merges into the fleet timeline) are the same hazard "
+        "in span form: entered inside a traced body they clock the "
+        "TRACE, write one record at compile time, and never fire again "
+        "— a frozen span that poisons the merged timeline's clock "
+        "anchors. Spans wrap the host-side call of the compiled step, "
+        "never code inside it."
     )
     provenance = (
         "ISSUE 13 (one-pane-of-glass telemetry registry), extending the "
-        "PR 6 registry discipline to the metric export surface."
+        "PR 6 registry discipline to the metric export surface; ISSUE "
+        "15 (hvt-trace) added the traced-span check."
     )
     example = (
         "obs.gauge(\"hvt_stpe_ms\", v)   # typo'd, undeclared\n"
@@ -818,6 +833,8 @@ class MetricRegistryDiscipline(Rule):
         "def step(x):\n"
         "    obs.counter(\"hvt_optimizer_steps_total\")  # traced host "
         "effect\n"
+        "    with trace.span(\"step\"):  # clocks the TRACE, fires once\n"
+        "        x = x + 1\n"
         "    return x\n"
     )
 
@@ -846,12 +863,28 @@ class MetricRegistryDiscipline(Rule):
                     if not isinstance(node, ast.Call):
                         continue
                     resolved = resolved_dotted(module, node.func)
-                    if resolved is None or not _OBS_ANY_RE.search(resolved):
+                    if resolved is None:
+                        continue
+                    is_obs = bool(_OBS_ANY_RE.search(resolved))
+                    is_span = bool(_TRACE_SPAN_RE.search(resolved))
+                    if not is_obs and not is_span:
                         continue
                     key = (node.lineno, node.col_offset)
                     if key in reported:
                         continue
                     reported.add(key)
+                    if is_span:
+                        yield module.finding(
+                            self.rule_id, node,
+                            f"`{resolved}(...)` entered inside a traced "
+                            "(jit/scan/shard_map) function — the span "
+                            "clocks the TRACE and writes exactly one "
+                            "record at compile time (the HVT003 class), "
+                            "poisoning the merged timeline's clock "
+                            "anchors; wrap the host-side call of the "
+                            "compiled step instead",
+                        )
+                        continue
                     yield module.finding(
                         self.rule_id, node,
                         f"`{resolved}(...)` inside a traced "

@@ -1,0 +1,171 @@
+"""`hvt-trace` — the fleet timeline CLI (``HOROVOD_TIMELINE`` parity,
+arXiv:1802.05799): merge every rank's ``HVT_TRACE_DIR`` span stream onto
+one aligned clock and either export it for Perfetto/``chrome://tracing``
+or interrogate it for stragglers.
+
+Usage::
+
+    # One Chrome trace-event JSON for the whole fleet (pid = rank,
+    # tid = span depth; flight-recorded collective submissions as
+    # instant events when flight-*.jsonl files sit in the same dir):
+    hvt-trace timeline /path/to/trace-dir -o trace.json
+
+    # Per-phase per-rank duration tables at the terminal:
+    hvt-trace report /path/to/trace-dir
+
+    # Cross-rank skew: straggler score, barrier-wait attribution, and a
+    # named straggler with evidence. --expect-straggler N gates CI runs
+    # with an injected `slow:MS` fault (testing/faults.py):
+    hvt-trace skew /path/to/trace-dir
+    hvt-trace skew /path/to/trace-dir --threshold-pct 5 \\
+        --expect-straggler 1
+
+Exit codes (the `hvt-lint`/`hvt-audit`/`hvt-sched` contract):
+
+* ``0`` — merged/reported (skew: and any ``--expect-straggler`` gate
+  passed);
+* ``1`` — the ``--expect-straggler`` gate missed (no straggler named,
+  or a different rank);
+* ``2`` — usage error / refusal: no span files, or a host whose clock
+  shares no step anchors with the reference (`timeline.TimelineError`
+  — an unalignable dir must not silently export a fabricated order).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from horovod_tpu.obs import timeline
+
+
+def _load(trace_dir: str):
+    by_rank = timeline.load_spans(trace_dir)
+    alignment = timeline.align(by_rank)
+    return by_rank, alignment
+
+
+def _run_timeline(args) -> int:
+    by_rank, alignment = _load(args.dir)
+    flight = timeline.load_flight(args.dir)
+    doc = timeline.chrome_trace(by_rank, alignment, flight)
+    with open(args.output, "w") as f:  # hvt: noqa[HVT005] — derived,
+        # regenerable analysis output, not a durability artifact
+        json.dump(doc, f)
+    n_flight = sum(len(v) for v in flight.values())
+    print(
+        f"hvt-trace: merged {len(by_rank)} rank(s), "
+        f"{sum(len(v) for v in by_rank.values())} span(s)"
+        + (f", {n_flight} collective submission(s)" if n_flight else "")
+        + f" -> {args.output}"
+    )
+    for host in sorted(alignment.residual_ms):
+        print(
+            f"  clock {host!r}: offset applied, residual "
+            f"{alignment.residual_ms[host]:.3f} ms over "
+            f"{alignment.anchor_counts.get(host, 0)} anchor(s)"
+        )
+    print("  load in https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+def _run_report(args) -> int:
+    # Per-rank duration aggregates need no merged ordering — no align()
+    # here, so a dir whose hosts share no anchors (refused by
+    # timeline/skew) still gets its tables.
+    by_rank = timeline.load_spans(args.dir)
+    print(render_banner(by_rank))
+    print(timeline.render_report(by_rank))
+    return 0
+
+
+def _run_skew(args) -> int:
+    by_rank, alignment = _load(args.dir)
+    report = timeline.skew(
+        by_rank, alignment, threshold_pct=args.threshold_pct
+    )
+    print(render_banner(by_rank))
+    print(timeline.render_skew(report))
+    if args.expect_straggler is not None:
+        if report["straggler"] != args.expect_straggler:
+            print(
+                f"hvt-trace: FAIL — expected straggler rank "
+                f"{args.expect_straggler}, detected "
+                f"{report['straggler']}",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"hvt-trace: straggler gate passed (rank "
+            f"{args.expect_straggler})"
+        )
+    return 0
+
+
+def render_banner(by_rank: dict) -> str:
+    return (
+        f"trace: {len(by_rank)} rank(s) "
+        f"({', '.join(f'rank{r}' for r in sorted(by_rank))})"
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvt-trace",
+        description=(
+            "Cross-rank span timeline: merge HVT_TRACE_DIR span files "
+            "onto one aligned clock; export Chrome trace JSON, print "
+            "per-phase tables, or detect stragglers."
+        ),
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    t = sub.add_parser(
+        "timeline", help="export a merged Chrome trace-event JSON"
+    )
+    t.add_argument("dir", help="the HVT_TRACE_DIR of the run")
+    t.add_argument(
+        "-o", "--output", default="trace.json",
+        help="output path (default: trace.json)",
+    )
+    t.set_defaults(fn=_run_timeline)
+    r = sub.add_parser(
+        "report", help="per-phase per-rank duration tables"
+    )
+    r.add_argument("dir", help="the HVT_TRACE_DIR of the run")
+    r.set_defaults(fn=_run_report)
+    s = sub.add_parser(
+        "skew", help="cross-rank skew + straggler attribution"
+    )
+    s.add_argument("dir", help="the HVT_TRACE_DIR of the run")
+    s.add_argument(
+        "--threshold-pct", type=float, default=5.0,
+        help="straggler margin as %% of the fleet step period (default 5)",
+    )
+    s.add_argument(
+        "--expect-straggler", type=int, default=None, metavar="RANK",
+        help="exit 1 unless exactly this rank is named the straggler",
+    )
+    s.set_defaults(fn=_run_skew)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except timeline.TimelineError as e:
+        print(f"hvt-trace: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"hvt-trace: {e}", file=sys.stderr)
+        return 2
+
+
+def cli() -> None:
+    """Console entry point (`hvt-trace`, pyproject.toml)."""
+    raise SystemExit(main())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -51,6 +51,7 @@ Run:  ``python -m horovod_tpu.launch.serve <bundle_dir> [--port 8000]``
 
 from __future__ import annotations
 
+import itertools
 import json
 import queue as queue_lib
 import threading
@@ -63,15 +64,28 @@ from horovod_tpu.obs import core as obs_core
 from horovod_tpu.obs import prom as obs_prom
 
 
-class _Slot:
-    """One request row's rendezvous with the device worker."""
+# Monotone per-process request ids for the serving `request` spans —
+# enough to correlate a request's children in a merged timeline.
+_request_ids = itertools.count(1)
 
-    __slots__ = ("event", "value", "error")
+
+class _Slot:
+    """One request row's rendezvous with the device worker.
+
+    ``started``/``finished`` carry the worker's clocks around the device
+    call that served this row — (wall, perf) at dispatch and perf at
+    completion — so the submitting handler thread can emit queue-wait /
+    decode trace spans for its request (only stamped, and only read,
+    when spans are on)."""
+
+    __slots__ = ("event", "value", "error", "started", "finished")
 
     def __init__(self):
         self.event = threading.Event()
         self.value = None
         self.error = None
+        self.started = None
+        self.finished = None
 
     def set(self, value):
         self.value = value
@@ -97,6 +111,12 @@ class _Batcher:
     ``run_rows(items) -> results`` is the only code that touches the
     device, so the compiled callable never runs re-entrantly and the old
     global lock is gone.
+
+    When ``HVT_TRACE_DIR`` is set, the worker stamps each slot with the
+    wall/perf clocks around its device call so `submit` can emit
+    ``queue_wait`` / ``decode`` child spans for ITS request — the spans
+    belong to the handler thread's open ``request`` span, but the
+    interval they measure happened on the worker (`trace.emit_span`).
     """
 
     def __init__(self, run_rows, batch: int, stats: dict):
@@ -108,10 +128,25 @@ class _Batcher:
         self._worker.start()
 
     def submit(self, items: list) -> list:
+        from horovod_tpu import trace as trace_lib
+
         slots = [_Slot() for _ in items]
+        t_sub, p_sub = time.time(), time.perf_counter()
         for it, s in zip(items, slots):
             self.q.put((it, s))
-        return [s.get() for s in slots]
+        out = [s.get() for s in slots]
+        if trace_lib.span_dir() and slots and slots[0].started is not None:
+            started_wall, started_perf = slots[0].started
+            done_perf = slots[-1].finished
+            trace_lib.emit_span(
+                "queue_wait", t_sub, max(0.0, started_perf - p_sub)
+            )
+            if done_perf is not None:
+                trace_lib.emit_span(
+                    "decode", started_wall, done_perf - started_perf,
+                    rows=len(items),
+                )
+        return out
 
     def _loop(self):
         while True:
@@ -123,9 +158,14 @@ class _Batcher:
                     break
             self.stats["device_calls"] += 1
             self.stats["rows"] += len(group)
+            started = (time.time(), time.perf_counter())
+            for _, s in group:
+                s.started = started
             try:
                 results = self.run_rows([it for it, _ in group])
+                done = time.perf_counter()
                 for (_, s), r in zip(group, results):
+                    s.finished = done
                     s.set(r)
             except Exception as e:
                 for _, s in group:
@@ -254,14 +294,24 @@ class _GenerateApp:
         while one stream's client drains a chunk over the network, other
         requests' device calls interleave instead of queueing behind a
         slow reader."""
+        from horovod_tpu import trace as trace_lib
+
         seed = int(payload.get("seed", 0))
         prompts = self._payload_prompts(payload)
         rows = [[] for _ in prompts]
         it = self.bundle.stream_chunks(prompts, seed=seed)
         while True:
+            t_q, p_q = time.time(), time.perf_counter()
             with self._lock:
+                # Per-dispatch queue-wait/decode child spans: the
+                # request span around the whole stream plus the FIRST
+                # decode child's end is TTFT as span structure.
+                trace_lib.emit_span(
+                    "queue_wait", t_q, time.perf_counter() - p_q
+                )
                 try:
-                    chunk = next(it)
+                    with trace_lib.span("decode", rows=len(prompts)):
+                        chunk = next(it)
                 except StopIteration:
                     break
                 self.stats["device_calls"] += 1
@@ -278,6 +328,8 @@ class _GenerateApp:
         yield final
 
     def generate(self, payload: dict) -> dict:
+        from horovod_tpu import trace as trace_lib
+
         seed = int(payload.get("seed", 0))
         # Tokenize OUTSIDE the lock — only the compiled call needs
         # serializing through the device; CPU encode/decode of one request
@@ -286,15 +338,25 @@ class _GenerateApp:
         if self._batcher is not None:
             # Validate on the handler thread; rows coalesce across
             # requests (greedy: the seed is dead code in the program).
+            # The batcher emits this request's queue_wait/decode spans.
             rows = self.bundle.validate_prompts(prompts)
             tokens = self._batcher.submit(rows) if rows else []
         else:
+            t_q, p_q = time.time(), time.perf_counter()
             with self._lock:
+                # Lock wait IS the sampled path's queue: requests
+                # serialize whole through the device here.
+                trace_lib.emit_span(
+                    "queue_wait", t_q, time.perf_counter() - p_q
+                )
                 self.stats["device_calls"] += max(
                     1, -(-len(prompts) // self.bundle.batch_size)
                 )
                 self.stats["rows"] += len(prompts)
-                tokens = self.bundle.generate_tokens(prompts, seed=seed)
+                with trace_lib.span("decode", rows=len(prompts)):
+                    tokens = self.bundle.generate_tokens(
+                        prompts, seed=seed
+                    )
         out = {"tokens": tokens}
         if self.bundle.tokenizer is not None:
             out["text"] = [self.bundle.tokenizer.decode(g) for g in tokens]
@@ -395,6 +457,19 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
                 )
                 self._send(404, {"error": f"no route {self.path} — {hint}"})
                 return
+            # One `request` span per POST (HVT_TRACE_DIR runs): the app
+            # layer nests queue_wait + decode children under it, so
+            # `hvt-trace timeline` shows the serving tier's TTFT as span
+            # structure (request start -> first decode child end), not
+            # just histograms.
+            from horovod_tpu import trace as trace_lib
+
+            with trace_lib.span(
+                "request", req=next(_request_ids), route=_route(self.path)
+            ):
+                self._handle_post()
+
+        def _handle_post(self):
             t0 = time.perf_counter()
             try:
                 length = int(self.headers.get("Content-Length", 0))
@@ -403,8 +478,6 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
                     # NDJSON streaming: no Content-Length; the body is
                     # line-delimited JSON chunks, connection-close
                     # terminated (HTTP/1.0 semantics of this server).
-                    import itertools
-
                     chunks = app.stream(payload)
                     first = next(chunks)  # validation runs BEFORE headers
                     # TTFT: first chunk computed and about to flush —

@@ -57,6 +57,7 @@ import time
 from horovod_tpu.analysis import registry
 from horovod_tpu.launch import launcher
 from horovod_tpu.obs import core as obs_core
+from horovod_tpu.obs import fleet as obs_fleet
 from horovod_tpu.obs import prom as obs_prom
 from horovod_tpu.runtime import ENV_HEARTBEAT_DIR
 
@@ -381,6 +382,7 @@ def supervise(
     log_path: str | None = None,
     status_port: int | None = None,
     flight_dir: str | None = None,
+    fleet_ports=None,
     sleep=time.sleep,
     verbose: bool = True,
 ) -> int:
@@ -391,7 +393,8 @@ def supervise(
     the final failure's shell exit code once the no-progress budget is
     exhausted. ``status_port`` serves `start_status_server` from this
     supervisor for the run's duration (fleet status + journal over HTTP,
-    no serving bundle required)."""
+    no serving bundle required); ``fleet_ports`` additionally lights up
+    its ``GET /fleet`` rollup (`member_metrics_ports`)."""
     policy = policy or RestartPolicy()
     log = RestartLog(log_path)
     log.touch()
@@ -401,7 +404,7 @@ def supervise(
     budget = {"max": policy.max_restarts, "used": 0}
     status_server = (
         start_status_server(status_port, log_path, budget=budget,
-                            model_dir=model_dir)
+                            model_dir=model_dir, fleet_ports=fleet_ports)
         if status_port is not None else None
     )
     marker = newest_checkpoint_marker(model_dir)
@@ -415,7 +418,13 @@ def supervise(
             marker, budget, total_restarts, backoff, attempt, flight_dir,
         )
     finally:
-        dump_metrics(log_path, None, budget, model_dir)
+        dump_metrics(
+            log_path, None, budget, model_dir,
+            members=(
+                status_server.fleet_cache["members"]
+                if status_server is not None else None
+            ),
+        )
         if status_server is not None:
             status_server.shutdown()
 
@@ -558,6 +567,7 @@ def supervise_local(
         log_path=log_path,
         status_port=status_port,
         flight_dir=resolve_flight_dir(env),
+        fleet_ports=member_metrics_ports(env, nprocs),
         sleep=sleep,
     )
 
@@ -743,7 +753,10 @@ def supervise_elastic(
     budget = {"max": policy.max_restarts, "used": 0}
     status_server = (
         start_status_server(status_port, log_path, coord=coord,
-                            budget=budget, model_dir=model_dir)
+                            budget=budget, model_dir=model_dir,
+                            fleet_ports=member_metrics_ports(
+                                env, max_ranks
+                            ))
         if status_port is not None else None
     )
     if spawn is None:
@@ -813,7 +826,13 @@ def supervise_elastic(
                 p.wait()
         # The final gateable scrape, while the coordinator still answers
         # (launch/job.py `metrics_checks:` reads this post-run).
-        dump_metrics(log_path, coord, budget, model_dir)
+        dump_metrics(
+            log_path, coord, budget, model_dir,
+            members=(
+                status_server.fleet_cache["members"]
+                if status_server is not None else None
+            ),
+        )
         coord.stop()
         if status_server is not None:
             status_server.shutdown()
@@ -1228,6 +1247,26 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
     return reg
 
 
+def member_metrics_ports(env, n_slots: int):
+    """The fleet-rollup port map: ``{local rank/slot: exporter port}``
+    when the member env exports a non-ephemeral ``HVT_METRICS_PORT``
+    base (each member binds base + its local rank — obs/server.py),
+    else None (base 0 binds ephemerally; the supervisor cannot know the
+    ports, so the rollup stays off). Local/elastic-local launches only:
+    the exporters bind loopback on each HOST, which off-host supervision
+    cannot reach."""
+    raw = (env or {}).get("HVT_METRICS_PORT") or registry.get_raw(
+        "HVT_METRICS_PORT"
+    )
+    try:
+        base = int(raw) if raw else 0
+    except ValueError:
+        return None
+    if base <= 0:
+        return None
+    return {slot: base + slot for slot in range(n_slots)}
+
+
 def default_metrics_dump_path(model_dir: str | None,
                               log_path: str | None) -> str | None:
     """Where the final supervisor scrape lands: beside the checkpoints
@@ -1240,12 +1279,15 @@ def default_metrics_dump_path(model_dir: str | None,
 
 def dump_metrics(log_path: str | None, coord=None, budget=None,
                  model_dir: str | None = None,
-                 path: str | None = None) -> str | None:
+                 path: str | None = None, members: dict | None = None) -> str | None:
     """Write one final text-exposition scrape beside the journal
     (`default_metrics_dump_path`) so metrics survive the supervisor —
     the gateable job output `launch.job`'s ``metrics_checks:`` block
-    reads post-run. Best-effort: a failed dump must never change the
-    job's exit code."""
+    reads post-run. ``members``: the fleet poller's last per-rank
+    exporter scrapes (`start_status_server`'s cache) — merged in with
+    ``rank`` labels so the per-rank step-phase/skew series survive the
+    fleet (its exporters are gone by dump time). Best-effort: a failed
+    dump must never change the job's exit code."""
     if path is None:
         path = default_metrics_dump_path(model_dir, log_path)
         if path is None:
@@ -1254,6 +1296,8 @@ def dump_metrics(log_path: str | None, coord=None, budget=None,
         text = obs_prom.render(
             supervisor_metrics(log_path, coord, budget, model_dir)
         )
+        if members:
+            text = obs_fleet.merge_fleet(text, members)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -1268,7 +1312,7 @@ def dump_metrics(log_path: str | None, coord=None, budget=None,
 
 def start_status_server(port: int, log_path: str | None, coord=None,
                         host: str | None = None, budget=None,
-                        model_dir: str | None = None):
+                        model_dir: str | None = None, fleet_ports=None):
     """Serve the supervisor's own status over HTTP (the ``--status-port``
     surface): fleet state WITHOUT a serving bundle — previously the
     journal was only visible through ``serve --fleet-journal``'s
@@ -1291,6 +1335,20 @@ def start_status_server(port: int, log_path: str | None, coord=None,
       — restart-journal counts, elastic generation, committed
       (epoch, step), per-member heartbeat ages, restart budget
       remaining), built fresh per scrape.
+    * ``GET /fleet``  → the FLEET rollup (``fleet_ports`` launches
+      only): the supervisor exposition spliced with a fresh scrape of
+      every reachable member trainer exporter, each member series
+      re-labeled with its ``rank`` — plus computed fleet series
+      (``hvt_fleet_step_ms{stat="slowest"|"fastest"}``) — so ONE
+      Prometheus scrape target per job sees every rank
+      (`obs.fleet.merge_fleet`). A background poller re-scrapes every
+      ``HVT_FLEET_POLL_S`` seconds into ``server.fleet_cache`` so the
+      final ``dump_metrics`` can carry the per-rank series after the
+      fleet is gone.
+
+    ``fleet_ports``: ``{rank: exporter port}`` or a zero-arg callable
+    returning one (`member_metrics_ports` builds it from the member
+    env); None leaves ``/fleet`` serving 404.
 
     Returns the started server (a daemon thread runs it); callers own
     ``shutdown()``. Port 0 binds an ephemeral port —
@@ -1300,6 +1358,31 @@ def start_status_server(port: int, log_path: str | None, coord=None,
 
     if host is None:
         host = registry.get_str("HVT_STATUS_HOST")
+    fleet_cache: dict = {"members": {}}
+
+    def _scrape_members() -> dict:
+        """One pass over the member exporters; the cache keeps the
+        newest successful scrape per rank, so a member mid-restart
+        drops out of the live rollup but its last-seen series still
+        make the final dump (dump_metrics merges the cache)."""
+        ports = fleet_ports() if callable(fleet_ports) else fleet_ports
+        members: dict = {}
+        for rank in sorted(ports or {}):
+            text = obs_fleet.scrape(
+                f"http://127.0.0.1:{ports[rank]}/metrics"
+            )
+            if text:
+                members[rank] = text
+        if members:
+            fleet_cache["members"].update(members)
+        return members
+
+    def _fleet_rollup() -> str:
+        members = _scrape_members()
+        sup = obs_prom.render(
+            supervisor_metrics(log_path, coord, budget, model_dir)
+        )
+        return obs_fleet.merge_fleet(sup, members)
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):
@@ -1319,6 +1402,20 @@ def start_status_server(port: int, log_path: str | None, coord=None,
                     obs_prom.write_http(self, supervisor_metrics(
                         log_path, coord, budget, model_dir
                     ))
+                elif self.path == "/fleet":
+                    if fleet_ports is None:
+                        self._send(404, {
+                            "error": "no fleet rollup — the members "
+                            "export no known metrics ports (launch with "
+                            "--metrics-port / HVT_METRICS_PORT > 0)",
+                        })
+                        return
+                    body = _fleet_rollup().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", obs_prom.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/status":
                     self._send(200, {
                         "fleet": fleet_status(log_path),
@@ -1336,7 +1433,33 @@ def start_status_server(port: int, log_path: str | None, coord=None,
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
     server = ThreadingHTTPServer((host, port), Handler)
+    server.fleet_cache = fleet_cache  # dump_metrics reads "members"
     threading.Thread(target=server.serve_forever, daemon=True).start()
+    if fleet_ports is not None:
+        poll_s = registry.get_float("HVT_FLEET_POLL_S") or 0.0
+        if poll_s > 0:
+            stop = threading.Event()
+
+            def _poll():
+                # Cache refresh only — the render/merge work is paid on
+                # /fleet requests and the final dump, not every tick.
+                while not stop.wait(poll_s):
+                    try:
+                        _scrape_members()
+                    except Exception:
+                        pass  # a flaky member scrape never kills polling
+
+            threading.Thread(target=_poll, daemon=True).start()
+            # Stop the poller with the server: long-lived test processes
+            # run many supervisors, and an orphan poller re-scraping
+            # dead ports forever is a slow leak.
+            orig_shutdown = server.shutdown
+
+            def shutdown():
+                stop.set()
+                orig_shutdown()
+
+            server.shutdown = shutdown
     return server
 
 

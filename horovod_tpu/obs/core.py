@@ -164,6 +164,12 @@ METRICS: dict[str, MetricSpec] = _decl([
                "Consecutive no-progress restarts left before the "
                "supervisor gives up (resets to max_restarts on progress).",
                "supervisor"),
+    MetricSpec("hvt_fleet_step_ms", "gauge",
+               "Fleet-level step-time summary computed at GET /fleet "
+               "aggregation from the member exporters' "
+               "hvt_step_phase_ms{phase=total}: the slowest and fastest "
+               "rank's step time this scrape.", "supervisor",
+               labels=("stat",)),
     MetricSpec("hvt_committed_epoch", "gauge",
                "Epoch of the best committed progress the supervisor can "
                "see (elastic commit marker or checkpoint manifest).",
@@ -230,6 +236,24 @@ METRICS: dict[str, MetricSpec] = _decl([
     MetricSpec("hvt_step_samples_total", "counter",
                "Times the step-phase sampler ran (one per "
                "HVT_METRICS_EVERY window).", "training"),
+    MetricSpec("hvt_step_skew_ms", "gauge",
+               "Cross-rank skew over the last sampled window: max - "
+               "median of the fleet's per-step blocked times (host "
+               "seconds in the step call + drain — the waiting ranks' "
+               "block IS the straggler's lead, in both dispatch "
+               "regimes). Published by the SkewProbe (HVT_SKEW_PROBE) "
+               "on multi-process runs with the trainer exporter on.",
+               "training"),
+    MetricSpec("hvt_straggler_rank", "gauge",
+               "Process rank the fleet waited on over the last sampled "
+               "window (the rank with the SMALLEST blocked time; "
+               "meaningful when hvt_step_skew_ms is materially > 0).",
+               "training"),
+    MetricSpec("hvt_barrier_wait_ms", "gauge",
+               "THIS rank's per-step blocked time beyond the fleet "
+               "minimum over the last sampled window, ms — its implicit "
+               "wait for the slowest rank (stragglers read ~0 while "
+               "everyone else pays).", "training"),
     # --- data ---------------------------------------------------------------
     MetricSpec("hvt_data_retries_total", "counter",
                "Transient dataset-read faults absorbed by the bounded "
@@ -237,6 +261,11 @@ METRICS: dict[str, MetricSpec] = _decl([
     # --- obs (the export surface itself) ------------------------------------
     MetricSpec("hvt_scrapes_total", "counter",
                "GET /metrics requests this exporter answered.", "obs"),
+    MetricSpec("hvt_trace_spans_dropped_total", "counter",
+               "Trace spans lost to a dead span writer (HVT_TRACE_DIR "
+               "unwritable/torn) — the writer fails once silently to "
+               "protect training, this counter makes the loss visible.",
+               "obs"),
 ])
 
 

@@ -1,0 +1,155 @@
+"""Fleet metrics rollup — ONE Prometheus scrape target per job.
+
+PR 13 put a ``/metrics`` exporter on every long-lived process: the
+supervisor status server and one trainer exporter per rank
+(``HVT_METRICS_PORT + local_rank``). Operationally that is N+1 scrape
+targets per job whose ports depend on fleet size — exactly the config
+sprawl a fleet scheduler (ROADMAP item 5) cannot hand to Prometheus.
+This module is the join the supervisor's ``GET /fleet`` route serves:
+
+* scrape each live member's trainer exporter (`scrape`);
+* re-label every member series with ``rank`` (`merge_fleet` — text-level
+  label injection, because the typed registry rightly refuses label sets
+  that don't match a series' declaration, and the member series are
+  *already* rendered expositions);
+* compute fleet-level summary series the single panes can't see
+  (``hvt_fleet_step_ms{stat="slowest"|"fastest"}`` from the members'
+  ``hvt_step_phase_ms{phase="total"}``);
+* splice it all into the supervisor's own exposition, one HELP/TYPE
+  block per family, so the result is a single valid scrape body.
+
+Deliberately stdlib-only (urllib + re): the supervisor never imports
+jax.
+"""
+
+from __future__ import annotations
+
+import re
+import urllib.request
+
+from horovod_tpu.obs import core, prom
+
+# One exposition sample line: name, optional {labels}, value.
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$"
+)
+# Histogram child-series suffixes — their family is the base name.
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def scrape(url: str, timeout: float = 2.0) -> str | None:
+    """One member exporter's exposition text, or None when the member
+    is unreachable (dead, restarting, not yet bound) — a fleet scrape
+    must degrade to the ranks it can see, never fail outright."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except (OSError, ValueError):
+        return None
+
+
+def inject_rank(line: str, rank) -> str:
+    """Rewrite one sample line to carry ``rank="<rank>"`` alongside its
+    existing labels."""
+    m = _SAMPLE_RE.match(line)
+    if not m:
+        return line
+    name, labels, value = m.groups()
+    inner = labels[1:-1] if labels else ""
+    pair = f'rank="{prom.escape_label_value(str(rank))}"'
+    inner = f"{inner},{pair}" if inner else pair
+    return f"{name}{{{inner}}} {value}"
+
+
+def _family_of(name: str, families: dict) -> str:
+    if name in families:
+        return name
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix) and name[: -len(suffix)] in families:
+            return name[: -len(suffix)]
+    return name
+
+
+def fleet_summary(members: dict) -> str:
+    """The computed fleet series, rendered: slowest/fastest rank step
+    time from the members' ``hvt_step_phase_ms{phase="total"}`` gauges.
+    Empty when no member carries the series yet (samplers warm up)."""
+    totals = []
+    for text in members.values():
+        try:
+            values = prom.parse_text(text)
+        except ValueError:
+            continue  # a torn member scrape must not kill the rollup
+        v = values.get('hvt_step_phase_ms{phase="total"}')
+        if v is not None:
+            totals.append(v)
+    if not totals:
+        return ""
+    reg = core.Registry()
+    reg.gauge("hvt_fleet_step_ms", max(totals), stat="slowest")
+    reg.gauge("hvt_fleet_step_ms", min(totals), stat="fastest")
+    return prom.render(reg)
+
+
+def merge_fleet(supervisor_text: str, members: dict) -> str:
+    """Splice the supervisor's exposition, each member's rank-labeled
+    exposition, and the computed fleet summary into one valid scrape
+    body. ``members`` maps rank (int or str) → that rank's exposition
+    text; family HELP/TYPE blocks are emitted once (first writer wins —
+    every emitter renders from the same declarations, so they agree)."""
+    families: dict[str, dict] = {}
+    order: list[str] = []
+
+    def feed(text: str, rank=None) -> None:
+        for line in text.splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith(("# HELP ", "# TYPE ")):
+                parts = line.split(" ", 3)
+                if len(parts) < 3:
+                    continue
+                name = parts[2]
+                fam = families.get(name)
+                if fam is None:
+                    fam = families[name] = {
+                        "help": None, "type": None, "samples": []
+                    }
+                    order.append(name)
+                key = "help" if parts[1] == "HELP" else "type"
+                if fam[key] is None:
+                    fam[key] = line
+            elif line.startswith("#"):
+                continue
+            else:
+                m = _SAMPLE_RE.match(line)
+                if not m:
+                    continue
+                name = _family_of(m.group(1), families)
+                fam = families.get(name)
+                if fam is None:
+                    fam = families[name] = {
+                        "help": None, "type": None, "samples": []
+                    }
+                    order.append(name)
+                fam["samples"].append(
+                    inject_rank(line, rank) if rank is not None else line
+                )
+
+    feed(supervisor_text)
+    for rank in sorted(members, key=str):
+        feed(members[rank], rank=rank)
+    summary = fleet_summary(members)
+    if summary:
+        feed(summary)
+    lines: list[str] = []
+    for name in order:
+        fam = families[name]
+        if not fam["samples"]:
+            continue
+        if fam["help"]:
+            lines.append(fam["help"])
+        if fam["type"]:
+            lines.append(fam["type"])
+        lines.extend(fam["samples"])
+    return "\n".join(lines) + "\n" if lines else ""

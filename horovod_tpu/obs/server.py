@@ -208,7 +208,12 @@ def ensure_trainer_exporter():
         # start: `obs.reset()` clears collectors, and the once-per-
         # process server guard would otherwise leave the retries series
         # silently absent afterwards. Registration dedupes by callable
-        # identity, so this never stacks.
+        # identity, so this never stacks. Same treatment for the span
+        # writer's drop mirror (trace.py registers it at writer open /
+        # on drops — this covers a reset in between).
+        from horovod_tpu import trace as trace_lib
+
+        core.register_collector(trace_lib._dropped_spans_collector)
         core.register_collector(_retry_collector)
         if _trainer_exporter is None:
             from horovod_tpu import runtime

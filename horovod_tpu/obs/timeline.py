@@ -1,0 +1,599 @@
+"""Cross-rank span timeline — the ``HOROVOD_TIMELINE`` parity layer
+(arXiv:1802.05799 §"Horovod Timeline"), fleet-merged.
+
+PR 13 gave every process a rank-tagged JSONL span stream under
+``HVT_TRACE_DIR`` (`trace.span`) and PR 14 a per-rank collective flight
+record — but nothing ever JOINED ranks: no merged timeline, no straggler
+attribution. Communication-characterization studies of distributed
+training (arXiv:1810.11112) show cross-rank *skew*, not mean step time,
+is what predicts scaling loss, and skew is invisible in any one rank's
+stream. This module is the join:
+
+* `load_spans` / `load_flight` — read every ``spans-rank*-pid*.jsonl``
+  (and, when present, ``flight-*.jsonl``) under one trace dir;
+* `align` — put all ranks on ONE clock. Ranks are grouped by the host
+  that stamped their spans (same host = same clock, offset 0 by
+  construction); cross-host offsets are estimated from the shared
+  per-step span boundaries as correlation anchors — every rank ends
+  optimizer step k at the same TRUE time to within one collective, so
+  the median of per-step end deltas against the reference host IS the
+  clock offset, and the remaining spread (MAD) is the reported residual
+  alignment error. Alignment REFUSES (`TimelineError`) when a host
+  shares no common step anchors with the reference — merging unaligned
+  clocks would fabricate skew.
+* `chrome_trace` — one Chrome trace-event JSON (`chrome://tracing` /
+  Perfetto): one ``pid`` per rank, ``tid`` per span depth, complete
+  (``ph: "X"``) events carrying span attrs in ``args``; flight-recorded
+  collective submissions become instant (``ph: "i"``) events keyed by
+  seq on a dedicated lane, landing under their enclosing step span on
+  the aligned clock.
+* `skew` — per-step cross-rank analytics: end-margin straggler score,
+  barrier-wait attribution (time between a rank's step end and the
+  slowest rank's), duration spreads — and a named straggler with the
+  evidence.
+
+**What "slowest" means here.** A ``step`` span measures the host-side
+call of the compiled step, and that call sits in one of two regimes:
+*synchronous* (the call blocks through the collective — then every
+rank's span ENDS at the barrier together, and the rank the fleet waited
+on is the one that STARTED late and/or ran short while the others' spans
+absorbed the wait), or *async-dispatch* (the call returns at enqueue —
+then the straggler's whole cycle, start AND end, drifts late relative
+to its peers). Measured on this framework (the 2-proc CPU acceptance
+run): sync — a ``slow:50`` rank starts +50 ms late, ends ON the
+barrier, and the victim rank's span is 50 ms LONGER. The signal robust
+in BOTH regimes is the aligned step START margin — the straggler is the
+late starter — with barrier wait estimated as (end gap) + (duration
+beyond the fleet minimum), which collapses to the right quantity in
+each regime. Duration spreads are reported alongside.
+
+**The cross-host blind spot, stated honestly.** End-time attribution is
+authoritative WITHIN a host (shared clock, zero alignment error).
+ACROSS hosts the step anchors are the only clock witness, so a rank
+that is *constantly* late by the same margin is indistinguishable from
+a rank whose clock is behind by that margin — the alignment absorbs a
+constant cross-host lateness into the offset, and only its VARIANCE
+(the residual) and the duration spreads survive. The live `SkewProbe`
+(training/trainer.py) has no such blind spot — its allgather is a true
+cross-host rendezvous — which is the division of labor: spans for
+per-step forensics and same-host attribution, the probe for live
+cross-host skew.
+
+Deliberately stdlib-only: the ``hvt-trace`` CLI and the supervisor both
+import this module without jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import statistics
+
+__all__ = [
+    "TimelineError", "Alignment", "load_spans", "load_flight", "align",
+    "chrome_trace", "phase_table", "render_report", "skew", "render_skew",
+]
+
+SPAN_FILE_RE = re.compile(r"^spans-rank(\d+)-pid(\d+)\.jsonl$")
+FLIGHT_FILE_RE = re.compile(r"^flight-(.+)\.jsonl$")
+# The flight lane's tid — far above any real span depth, so Perfetto
+# renders collective submissions on their own track per rank.
+FLIGHT_TID = 1000
+
+
+class TimelineError(Exception):
+    """A trace dir that cannot be merged: no span files, or a host whose
+    spans share no step anchors with the reference clock."""
+
+
+def load_spans(trace_dir: str) -> dict[int, list[dict]]:
+    """``{rank: [span, ...]}`` from every ``spans-rank*-pid*.jsonl``
+    under ``trace_dir``, each rank's spans sorted by start time. A rank
+    restarted by the supervisor leaves one file per pid — all are
+    loaded (the ``pid`` field stays on each record). Torn trailing
+    lines (a process killed mid-write) are skipped, not fatal: spans
+    are evidence, and the evidence of a crash is exactly when they
+    matter."""
+    by_rank: dict[int, list[dict]] = {}
+    if not os.path.isdir(trace_dir):
+        raise TimelineError(f"{trace_dir} is not a directory")
+    for name in sorted(os.listdir(trace_dir)):
+        m = SPAN_FILE_RE.match(name)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        with open(os.path.join(trace_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line
+                if not isinstance(rec, dict) or "ts" not in rec:
+                    continue
+                by_rank.setdefault(rank, []).append(rec)
+    if not by_rank:
+        raise TimelineError(
+            f"no spans-rank*-pid*.jsonl files under {trace_dir} — was the "
+            "run launched with HVT_TRACE_DIR set?"
+        )
+    for spans in by_rank.values():
+        spans.sort(key=lambda s: s.get("ts", 0.0))
+    return by_rank
+
+
+def load_flight(trace_dir: str) -> dict[int, list[dict]]:
+    """Flight-recorder JSONLs (``flight-<member>.jsonl``, PR 14) living
+    beside the span files, keyed to a rank when the member label carries
+    one (``rank3``, ``m3``); unmappable labels are skipped — the
+    timeline can only place a submission lane under a rank it has spans
+    for. Returns ``{}`` when none exist (flight records are optional
+    garnish on the timeline)."""
+    out: dict[int, list[dict]] = {}
+    if not os.path.isdir(trace_dir):
+        return out
+    for name in sorted(os.listdir(trace_dir)):
+        m = FLIGHT_FILE_RE.match(name)
+        if not m:
+            continue
+        label = m.group(1)
+        lm = re.fullmatch(r"(?:rank|m)(\d+)", label)
+        if not lm:
+            continue
+        rank = int(lm.group(1))
+        recs = []
+        with open(os.path.join(trace_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "seq" in rec and "t" in rec:
+                    recs.append(rec)
+        if recs:
+            recs.sort(key=lambda r: r["seq"])
+            out[rank] = recs
+    return out
+
+
+def _span_host(span: dict, rank: int) -> str:
+    # Pre-host span files (PR 13) get a per-rank pseudo-host: without a
+    # shared-clock witness each rank must be aligned independently.
+    return str(span.get("host") or f"rank{rank}")
+
+
+def _step_table(spans: list[dict]) -> dict[tuple, tuple]:
+    """``{(epoch, step): (start, end, dur_s)}`` from the rank's ``step``
+    spans. Duplicate keys (a restarted epoch re-training the same steps)
+    keep the LATEST occurrence — the run that actually completed."""
+    table: dict[tuple, tuple] = {}
+    for s in spans:
+        if s.get("name") != "step":
+            continue
+        if "epoch" not in s or "step" not in s:
+            continue
+        try:
+            key = (int(s["epoch"]), int(s["step"]))
+            start = float(s["ts"])
+            dur = float(s.get("dur_s", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if key not in table or start >= table[key][0]:
+            table[key] = (start, start + dur, dur)
+    return table
+
+
+def _step_anchors(spans: list[dict]) -> dict[tuple, float]:
+    """``{(epoch, step): end time}`` — the clock-correlation anchors
+    (step ENDS: in the synchronous-dispatch regime they sit exactly on
+    the cross-rank barrier; in the async regime they inherit the same
+    offset as starts)."""
+    return {k: v[1] for k, v in _step_table(spans).items()}
+
+
+@dataclasses.dataclass
+class Alignment:
+    """Per-rank clock offsets onto the reference host's clock.
+
+    ``offsets[rank]`` is ADDED to that rank's timestamps; ranks on the
+    reference host carry 0.0 exactly, ranks sharing any other host carry
+    that host's single estimated offset. ``residual_ms[host]`` is the
+    median absolute deviation of the host's anchor deltas after
+    alignment — the honest error bar on every cross-host comparison
+    (same-host comparisons share a clock and carry no alignment error).
+    """
+
+    ref_rank: int
+    ref_host: str
+    offsets: dict[int, float]
+    residual_ms: dict[str, float]
+    anchor_counts: dict[str, int]
+    hosts: dict[int, str]
+
+    @property
+    def max_residual_ms(self) -> float:
+        return max(self.residual_ms.values(), default=0.0)
+
+
+def align(by_rank: dict[int, list[dict]]) -> Alignment:
+    """Estimate per-rank clock offsets from shared step anchors.
+
+    Reference clock: the host of the lowest rank. Every other host's
+    offset is the median over its ranks' common-step end deltas against
+    the reference rank's ends; refuses with `TimelineError` when a host
+    shares no common steps with the reference (nothing correlates the
+    clocks — emitting a merged timeline anyway would fabricate order).
+    """
+    ranks = sorted(by_rank)
+    ref_rank = ranks[0]
+    hosts = {
+        r: _span_host(by_rank[r][0], r) if by_rank[r] else f"rank{r}"
+        for r in ranks
+    }
+    ref_host = hosts[ref_rank]
+    ref_anchors = _step_anchors(by_rank[ref_rank])
+    offsets: dict[int, float] = {}
+    residual_ms: dict[str, float] = {ref_host: 0.0}
+    anchor_counts: dict[str, int] = {}
+    host_offset: dict[str, float] = {ref_host: 0.0}
+    by_host: dict[str, list[int]] = {}
+    for r in ranks:
+        by_host.setdefault(hosts[r], []).append(r)
+    anchor_counts[ref_host] = len(ref_anchors)
+    for host, members in by_host.items():
+        if host == ref_host:
+            continue
+        deltas: list[float] = []
+        for r in members:
+            anchors = _step_anchors(by_rank[r])
+            common = set(anchors) & set(ref_anchors)
+            deltas.extend(ref_anchors[k] - anchors[k] for k in common)
+        anchor_counts[host] = len(deltas)
+        if not deltas:
+            raise TimelineError(
+                f"cannot align host {host!r} (ranks "
+                f"{members}): no step spans in common with the reference "
+                f"rank {ref_rank} ({ref_host!r}) — the clocks have no "
+                "correlation anchor. Re-run the jobs together (same "
+                "HVT_TRACE_DIR, overlapping steps) or merge per host."
+            )
+        off = statistics.median(deltas)
+        host_offset[host] = off
+        residual_ms[host] = (
+            statistics.median(abs(d - off) for d in deltas) * 1e3
+        )
+    for r in ranks:
+        offsets[r] = host_offset[hosts[r]]
+    return Alignment(
+        ref_rank=ref_rank, ref_host=ref_host, offsets=offsets,
+        residual_ms=residual_ms, anchor_counts=anchor_counts, hosts=hosts,
+    )
+
+
+# --- Chrome trace-event export ----------------------------------------------
+
+
+def chrome_trace(
+    by_rank: dict[int, list[dict]],
+    alignment: Alignment | None = None,
+    flight: dict[int, list[dict]] | None = None,
+) -> dict:
+    """The Chrome trace-event JSON object (``chrome://tracing`` /
+    Perfetto "JSON" format): ``pid`` = rank, ``tid`` = span depth,
+    complete events with span attrs in ``args``; flight submissions as
+    instant events on the `FLIGHT_TID` lane. Timestamps are aligned to
+    the reference clock and rebased so the earliest event sits at 0 µs.
+    """
+    alignment = alignment if alignment is not None else align(by_rank)
+    flight = flight or {}
+    core = {"name", "ts", "dur_s", "rank", "pid", "id", "parent", "depth",
+            "host"}
+    t0 = min(
+        float(s["ts"]) + alignment.offsets[r]
+        for r, spans in by_rank.items() for s in spans
+    )
+    events: list[dict] = []
+    for rank in sorted(by_rank):
+        events.append({
+            "ph": "M", "pid": rank, "tid": 0, "name": "process_name",
+            "args": {"name": f"rank {rank} ({alignment.hosts[rank]})"},
+        })
+        events.append({
+            "ph": "M", "pid": rank, "tid": 0, "name": "process_sort_index",
+            "args": {"sort_index": rank},
+        })
+        off = alignment.offsets[rank]
+        for s in by_rank[rank]:
+            args = {k: v for k, v in s.items() if k not in core}
+            args["span_id"] = s.get("id")
+            if s.get("parent") is not None:
+                args["parent_id"] = s.get("parent")
+            events.append({
+                "ph": "X",
+                "pid": rank,
+                "tid": int(s.get("depth", 0)),
+                "ts": (float(s["ts"]) + off - t0) * 1e6,
+                "dur": float(s.get("dur_s", 0.0)) * 1e6,
+                "name": str(s.get("name", "?")),
+                "cat": "span",
+                "args": args,
+            })
+        if rank in flight:
+            events.append({
+                "ph": "M", "pid": rank, "tid": FLIGHT_TID,
+                "name": "thread_name",
+                "args": {"name": "collective submissions"},
+            })
+            for rec in flight[rank]:
+                args = {k: v for k, v in rec.items() if k != "t"}
+                events.append({
+                    "ph": "i",
+                    "s": "t",
+                    "pid": rank,
+                    "tid": FLIGHT_TID,
+                    "ts": (float(rec["t"]) + off - t0) * 1e6,
+                    "name": f"{rec.get('kind', '?')}#{rec['seq']}",
+                    "cat": "collective",
+                    "args": args,
+                })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "hvt-trace",
+            "ref_rank": alignment.ref_rank,
+            "clock_offsets_s": {
+                str(r): alignment.offsets[r] for r in sorted(by_rank)
+            },
+            "alignment_residual_ms": dict(alignment.residual_ms),
+        },
+    }
+
+
+# --- per-phase report --------------------------------------------------------
+
+
+def phase_table(by_rank: dict[int, list[dict]]) -> dict[str, dict[int, dict]]:
+    """``{span name: {rank: {count, total_s, mean_ms, max_ms}}}`` —
+    the `hvt-trace report` payload, name-major so one row compares a
+    phase across the fleet."""
+    table: dict[str, dict[int, dict]] = {}
+    for rank, spans in by_rank.items():
+        for s in spans:
+            name = str(s.get("name", "?"))
+            cell = table.setdefault(name, {}).setdefault(
+                rank, {"count": 0, "total_s": 0.0, "max_ms": 0.0}
+            )
+            dur = float(s.get("dur_s", 0.0))
+            cell["count"] += 1
+            cell["total_s"] += dur
+            cell["max_ms"] = max(cell["max_ms"], dur * 1e3)
+    for cells in table.values():
+        for cell in cells.values():
+            cell["mean_ms"] = cell["total_s"] * 1e3 / max(1, cell["count"])
+    return table
+
+
+def render_report(by_rank: dict[int, list[dict]]) -> str:
+    ranks = sorted(by_rank)
+    table = phase_table(by_rank)
+    lines = ["phase              " + "".join(f"rank{r:<12}" for r in ranks)]
+    order = sorted(
+        table,
+        key=lambda n: -max(c["total_s"] for c in table[n].values()),
+    )
+    for name in order:
+        cells = []
+        for r in ranks:
+            c = table[name].get(r)
+            cells.append(
+                f"{c['mean_ms']:8.2f}ms x{c['count']:<5}" if c
+                else " " * 16
+            )
+        lines.append(f"{name:<19}" + "".join(cells))
+    lines.append(
+        "(mean duration x count per rank, phases ordered by total time)"
+    )
+    return "\n".join(lines)
+
+
+# --- skew analytics ----------------------------------------------------------
+
+
+def skew(
+    by_rank: dict[int, list[dict]],
+    alignment: Alignment | None = None,
+    threshold_pct: float = 5.0,
+) -> dict:
+    """Per-step cross-rank skew over the common steps of all ranks.
+
+    For each (epoch, step) present on EVERY rank, on the aligned clock:
+
+    * **start margin** — each rank's step START minus the fleet median
+      start: the regime-robust straggler signal (module docstring — in
+      the synchronous-dispatch regime ends sit on the barrier together
+      and only the starts discriminate; in the async regime starts and
+      ends drift late together).
+    * **straggler score** — the fraction of common steps a rank is the
+      LAST to start by more than ``threshold_pct`` of the fleet's
+      median step period (floored at 1 ms so sub-ms CI steps don't
+      flag on scheduler noise).
+    * **barrier wait** — per rank, the mean of (latest end − own end)
+      + (own duration − fleet-min duration): the time the rank spent
+      beyond the fleet's fastest cycle, i.e. waiting. Collapses to the
+      end gap in the async regime and to the duration gap in the sync
+      regime; the straggler's is ~0 while everyone else pays — the
+      attribution evidence.
+    * **duration spread** — max − median of per-rank mean durations for
+      ``step`` (and ``reduction`` when sampled).
+
+    The named ``straggler`` requires a majority score (> 0.5); below
+    that the verdict is None ("no consistent straggler") — one noisy
+    step must not name a culprit.
+    """
+    alignment = alignment if alignment is not None else align(by_rank)
+    ranks = sorted(by_rank)
+    tables = {r: _step_table(by_rank[r]) for r in ranks}
+    common = sorted(set.intersection(*(set(tables[r]) for r in ranks)))
+    if not common:
+        raise TimelineError(
+            "no (epoch, step) step spans common to every rank — skew "
+            "needs at least one step the whole fleet trained"
+        )
+    off = alignment.offsets
+    starts = {
+        r: [tables[r][k][0] + off[r] for k in common] for r in ranks
+    }
+    ends = {
+        r: [tables[r][k][1] + off[r] for k in common] for r in ranks
+    }
+    durs = {r: [tables[r][k][2] for k in common] for r in ranks}
+    # Fleet step period: median spacing of the fleet-max end times —
+    # the threshold's denominator (durations can be dispatch-thin).
+    fleet_end = [max(ends[r][i] for r in ranks) for i in range(len(common))]
+    period = (
+        statistics.median(
+            fleet_end[i + 1] - fleet_end[i]
+            for i in range(len(fleet_end) - 1)
+        ) if len(fleet_end) > 1 else 0.0
+    )
+    tau = max(threshold_pct / 100.0 * period, 1e-3)
+    per_rank: dict[int, dict] = {
+        r: {"straggler_steps": 0, "barrier_wait_s": 0.0, "margin_s": []}
+        for r in ranks
+    }
+    spread_ms: list[float] = []
+    for i in range(len(common)):
+        step_starts = {r: starts[r][i] for r in ranks}
+        med = statistics.median(step_starts.values())
+        latest = max(step_starts.values())
+        last_rank = max(step_starts, key=lambda r: (step_starts[r], r))
+        latest_end = max(ends[r][i] for r in ranks)
+        min_dur = min(durs[r][i] for r in ranks)
+        spread_ms.append((latest - med) * 1e3)
+        for r in ranks:
+            per_rank[r]["barrier_wait_s"] += (
+                (latest_end - ends[r][i]) + (durs[r][i] - min_dur)
+            )
+            per_rank[r]["margin_s"].append(step_starts[r] - med)
+        if latest - med > tau:
+            per_rank[last_rank]["straggler_steps"] += 1
+    n = len(common)
+    dur_means = {r: statistics.mean(durs[r]) * 1e3 for r in ranks}
+    table = phase_table(by_rank)
+    red_means = {
+        r: c["mean_ms"] for r, c in table.get("reduction", {}).items()
+    }
+    out_ranks = {}
+    for r in ranks:
+        margins = per_rank[r]["margin_s"]
+        out_ranks[r] = {
+            "straggler_score": per_rank[r]["straggler_steps"] / n,
+            "barrier_wait_ms_mean": per_rank[r]["barrier_wait_s"] / n * 1e3,
+            "start_margin_ms_median": statistics.median(margins) * 1e3,
+            "step_dur_ms_mean": dur_means[r],
+        }
+    best = max(ranks, key=lambda r: out_ranks[r]["straggler_score"])
+    # Majority score AND a minimum sample: at n < 3 common steps the
+    # period (and so the threshold) is meaningless and a single jittery
+    # step would name a culprit with 100% confidence — the documented
+    # "one noisy step must not name a culprit" invariant.
+    straggler = (
+        best
+        if n >= 3 and out_ranks[best]["straggler_score"] > 0.5
+        else None
+    )
+
+    def _dur_spread(means: dict) -> float:
+        if len(means) < 2:
+            return 0.0
+        vals = sorted(means.values())
+        return vals[-1] - statistics.median(vals)
+
+    report = {
+        "ranks": ranks,
+        "common_steps": n,
+        "threshold_ms": tau * 1e3,
+        "step_period_ms": period * 1e3,
+        "alignment_residual_ms": dict(alignment.residual_ms),
+        "skew_ms_mean": statistics.mean(spread_ms),
+        "skew_ms_max": max(spread_ms),
+        "dur_spread_ms": {
+            "step": _dur_spread(dur_means),
+            "reduction": _dur_spread(red_means),
+        },
+        "per_rank": out_ranks,
+        "straggler": straggler,
+    }
+    if straggler is not None:
+        waiters = [r for r in ranks if r != straggler]
+        wait = statistics.mean(
+            out_ranks[r]["barrier_wait_ms_mean"] for r in waiters
+        ) if waiters else 0.0
+        report["evidence"] = (
+            f"rank {straggler} was the last to start "
+            f"{out_ranks[straggler]['straggler_score']:.0%} of {n} common "
+            f"steps (median start margin "
+            f"{out_ranks[straggler]['start_margin_ms_median']:+.1f} ms vs "
+            f"fleet median); the other ranks waited "
+            f"{wait:.1f} ms per step at the barrier while rank "
+            f"{straggler} waited "
+            f"{out_ranks[straggler]['barrier_wait_ms_mean']:.1f} ms"
+        )
+    elif n < 3:
+        report["evidence"] = (
+            f"only {n} common step(s) — too few to name a straggler "
+            "(one noisy step must not name a culprit); collect a longer "
+            "trace"
+        )
+    else:
+        report["evidence"] = (
+            f"no rank lagged the fleet's step starts in a majority of "
+            f"{n} common steps (best score "
+            f"{out_ranks[best]['straggler_score']:.0%} by rank {best}) — "
+            "no consistent straggler"
+        )
+    return report
+
+
+def render_skew(report: dict) -> str:
+    lines = [
+        f"common steps: {report['common_steps']}   "
+        f"step period: {report['step_period_ms']:.2f} ms   "
+        f"threshold: {report['threshold_ms']:.2f} ms",
+        f"cross-rank skew (max start - median start): "
+        f"mean {report['skew_ms_mean']:.2f} ms, "
+        f"max {report['skew_ms_max']:.2f} ms",
+        f"duration spread (max - median of per-rank means): "
+        f"step {report['dur_spread_ms']['step']:.2f} ms, "
+        f"reduction {report['dur_spread_ms']['reduction']:.2f} ms",
+        "rank   straggler-score   barrier-wait(ms)   start-margin(ms)  "
+        "step-dur(ms)",
+    ]
+    for r in report["ranks"]:
+        c = report["per_rank"][r]
+        lines.append(
+            f"{r:<7}"
+            + f"{c['straggler_score']:.0%}".ljust(18)
+            + f"{c['barrier_wait_ms_mean']:.2f}".ljust(19)
+            + f"{c['start_margin_ms_median']:+.2f}".ljust(18)
+            + f"{c['step_dur_ms_mean']:.2f}"
+        )
+    if report["straggler"] is not None:
+        lines.append(f"STRAGGLER: rank {report['straggler']}")
+    lines.append(report["evidence"])
+    res = report.get("alignment_residual_ms") or {}
+    worst = max(res.values(), default=0.0)
+    if worst:
+        lines.append(
+            f"(clock-alignment residual up to {worst:.2f} ms — cross-host "
+            "comparisons carry that error bar)"
+        )
+    return "\n".join(lines)

@@ -16,6 +16,15 @@ Kinds:
 * ``hang``  — stop making progress while staying alive: the wedged-collective
   failure mode (arXiv:1810.11112) that produces no exit code and is only
   detectable via stale heartbeats.
+* ``slow:MS`` — a per-step host-side sleep of MS milliseconds on one rank,
+  every batch end from the target epoch ON (``1:0:slow:50`` = rank 1,
+  epoch 0 onward, +50 ms/step): the STRAGGLER shape — one rank pacing the
+  whole fleet through its collectives while producing no error, no stale
+  heartbeat, and (thanks to async dispatch) not even a longer step span of
+  its own. The deterministic ground truth for skew detection: ``hvt-trace
+  skew`` must name the rank, the live `SkewProbe` must point
+  ``hvt_straggler_rank`` at it. Unlike every other kind this fault is
+  RECURRING (a straggler is a rate, not an event) — stamps don't apply.
 * ``reorder`` — swap the last two flight-recorded collective submissions'
   payloads in THIS rank's record (`flight.FlightRecorder.swap_last_two`),
   then wedge exactly like ``hang``: the deterministic reproduction of the
@@ -87,8 +96,8 @@ from horovod_tpu.training.callbacks import Callback
 ENV_FAULT = "HVT_FAULT"
 ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
 
-KINDS = ("kill", "hang", "leave", "corrupt", "reorder")  # plus exitN
-# and corrupt@<target> (parse_plan / corrupt_target)
+KINDS = ("kill", "hang", "leave", "corrupt", "reorder")  # plus exitN,
+# corrupt@<target> (parse_plan / corrupt_target) and slow:MS (slow_ms)
 
 # Process-wide leave intent (the `leave` fault kind under an elastic
 # launch). The elastic epoch-end agreement consumes it; tests reset it.
@@ -128,12 +137,21 @@ class FaultPlan:
             return int(self.kind[4:])
         return None
 
+    @property
+    def slow_ms(self) -> float | None:
+        """The per-step sleep of a ``slow:MS`` plan, or None."""
+        if self.kind.startswith("slow:"):
+            return float(self.kind[5:])
+        return None
+
 
 def parse_plan(spec: str) -> FaultPlan:
     """Parse ``rank:epoch[.step]:kind`` (kind: ``kill`` | ``hang`` |
-    ``exitN`` | ``leave`` | ``corrupt[@target]``)."""
-    parts = spec.split(":")
-    if len(parts) != 3:
+    ``exitN`` | ``leave`` | ``corrupt[@target]`` | ``slow:MS`` — the
+    last carries its own colon, so the kind field is everything past
+    the second separator)."""
+    parts = spec.split(":", 2)
+    if len(parts) != 3 or not parts[2]:
         raise ValueError(
             f"HVT_FAULT must be rank:epoch[.step]:kind, got {spec!r}"
         )
@@ -169,10 +187,23 @@ def parse_plan(spec: str) -> FaultPlan:
                 ) from None
         elif kind.startswith("corrupt@"):
             corrupt_target(kind)  # validates; raises on a bad target
+        elif kind.startswith("slow:"):
+            try:
+                ms = float(kind[5:])
+            except ValueError:
+                raise ValueError(
+                    f"HVT_FAULT slow kind needs a millisecond count "
+                    f"(slow:50), got {kind!r}"
+                ) from None
+            if ms <= 0:
+                raise ValueError(
+                    f"HVT_FAULT slow:MS needs MS > 0, got {kind!r}"
+                )
         else:
             raise ValueError(
                 f"HVT_FAULT kind must be kill, hang, leave, reorder, "
-                f"corrupt[@epochN][/shardM] or exitN, got {kind!r}"
+                f"corrupt[@epochN][/shardM], slow:MS or exitN, "
+                f"got {kind!r}"
             )
     return FaultPlan(rank=rank, epoch=epoch, kind=kind, step=step)
 
@@ -292,6 +323,17 @@ class FaultInjectionCallback(Callback):
         self._epoch = epoch
 
     def on_batch_end(self, batch: int, logs=None):
+        if self.plan.slow_ms is not None:
+            # The straggler fault is RECURRING: every batch end from the
+            # target epoch on, this rank drags its feet by MS — stamps
+            # and step filters don't apply (a straggler is a rate).
+            if (
+                self._epoch is not None
+                and self._epoch >= self.plan.epoch
+                and runtime.rank() == self.plan.rank
+            ):
+                time.sleep(self.plan.slow_ms / 1e3)
+            return
         if self._epoch != self.plan.epoch:
             return
         if runtime.rank() != self.plan.rank:

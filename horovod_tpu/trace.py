@@ -228,7 +228,12 @@ def trace(log_dir: str, primary_only: bool = True):
 # set. Writes are per-record appends with a flush — span cadence is the
 # optimizer step at its finest, never per-microbatch. Span emission must
 # never take training down: write failures are swallowed after the
-# first (the writer disables itself).
+# first (the writer disables itself) — but never SILENTLY: every span a
+# dead writer loses is counted and exported as
+# `hvt_trace_spans_dropped_total` through the obs registry, so a torn
+# trace dir reads as a climbing counter on /metrics instead of a
+# mysteriously empty timeline. Records carry the writing HOST so
+# `hvt-trace` (obs/timeline.py) knows which ranks share a clock.
 
 
 def span_dir() -> str | None:
@@ -236,8 +241,17 @@ def span_dir() -> str | None:
     return registry.get_str("HVT_TRACE_DIR")
 
 
+def _dropped_spans_collector(reg) -> None:
+    """Mirror the span writer's drop count at scrape time (the
+    `obs.register_collector` idiom — a NAMED module-level function so
+    re-registration dedupes by identity). Reads the module attribute, so
+    tests that swap `_span_writer` stay covered."""
+    reg.counter_set("hvt_trace_spans_dropped_total", _span_writer.drops)
+
+
 class _SpanWriter:
-    """This process's span file (lazy; thread-safe; fail-once-silent)."""
+    """This process's span file (lazy; thread-safe; fail-once-silent —
+    but drop-counted: see the section comment above)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -245,14 +259,28 @@ class _SpanWriter:
         self._dead = False
         self._seq = 0
         self._tls = threading.local()
+        self.drops = 0  # spans lost to a dead/torn writer
 
     def _stack(self) -> list:
         if not hasattr(self._tls, "stack"):
             self._tls.stack = []
         return self._tls.stack
 
+    @staticmethod
+    def _register_drop_mirror() -> None:
+        # Idempotent (collector registration dedupes by identity); NOT
+        # on the healthy write path — asserted once at writer open and
+        # again on every drop, which also re-covers an obs.reset()
+        # between fits (any post-reset drop re-registers).
+        from horovod_tpu import obs
+
+        obs.register_collector(_dropped_spans_collector)
+
     def write(self, record: dict) -> None:
         if self._dead:
+            with self._lock:
+                self.drops += 1
+            self._register_drop_mirror()
             return
         try:
             with self._lock:
@@ -266,10 +294,18 @@ class _SpanWriter:
                         ),
                         "a",
                     )
+                    register = True
+                else:
+                    register = False
                 self._fh.write(json.dumps(record) + "\n")
                 self._fh.flush()
+            if register:
+                self._register_drop_mirror()
         except OSError:
-            self._dead = True  # observability must never kill training
+            with self._lock:
+                self._dead = True  # observability must never kill training
+                self.drops += 1
+            self._register_drop_mirror()
 
     def next_id(self) -> int:
         with self._lock:
@@ -278,6 +314,48 @@ class _SpanWriter:
 
 
 _span_writer = _SpanWriter()
+_HOST = None
+
+
+def _host() -> str:
+    """The span-stamping hostname (cached): ranks sharing it share a
+    clock, which is what lets `hvt-trace` skip cross-host clock
+    alignment for them (obs/timeline.py)."""
+    global _HOST
+    if _HOST is None:
+        import socket
+
+        try:
+            _HOST = socket.gethostname() or "unknown"
+        except OSError:
+            _HOST = "unknown"
+    return _HOST
+
+
+def emit_span(name: str, ts: float, dur_s: float, **attrs) -> None:
+    """Write one span record with CALLER-supplied timings — an interval
+    measured somewhere the ``with`` form can't sit (another thread's
+    queue wait, a retroactive split of a blocking call). Parent/depth
+    come from the calling thread's open-span stack, exactly like
+    `span`; no-op when ``HVT_TRACE_DIR`` is unset."""
+    if not span_dir():
+        return
+    stack = _span_writer._stack()
+    # Core fields LAST so a caller attr can never clobber the span
+    # schema (an `id=` attr silently breaking parent linkage was a real
+    # bug — timeline merge keys on these).
+    _span_writer.write({
+        **attrs,
+        "name": name,
+        "ts": ts,
+        "dur_s": dur_s,
+        "rank": runtime.process_rank(),
+        "pid": os.getpid(),
+        "host": _host(),
+        "id": _span_writer.next_id(),
+        "parent": stack[-1] if stack else None,
+        "depth": len(stack),
+    })
 
 
 @contextlib.contextmanager
@@ -299,16 +377,18 @@ def span(name: str, **attrs):
         yield
     finally:
         stack.pop()
+        # Core fields LAST — see emit_span.
         _span_writer.write({
+            **attrs,
             "name": name,
             "ts": t0,
             "dur_s": time.perf_counter() - p0,
             "rank": runtime.process_rank(),
             "pid": os.getpid(),
+            "host": _host(),
             "id": sid,
             "parent": parent,
             "depth": len(stack),
-            **attrs,
         })
 
 

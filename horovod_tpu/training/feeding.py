@@ -609,12 +609,17 @@ def fit_epochs(trainer, it, pending, zero_acc, epochs, initial_epoch, steps_per_
                         )
                 else:
                     chunk = next(prefetcher)
+                t_run = time.perf_counter() if sampler is not None else 0.0
                 with trace_lib.span("step", epoch=epoch, step=step,
                                     steps=k):
                     trainer.state, metrics, metric_acc = run(
                         trainer.state, chunk, scale, metric_acc
                     )
                 if sampler is not None:
+                    # Step-call host time feeds the SkewProbe's blocked
+                    # signal (sync-dispatch backends block HERE, not in
+                    # the drain).
+                    sampler.add_step_time(time.perf_counter() - t_run)
                     sampler.maybe_sample(trainer.state, k)
                 step += k
                 # Once per execution, with the last step's metrics —
@@ -706,6 +711,9 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
                 at = start
                 while at < steps:
                     n = min(c, steps - at)
+                    t_run = (
+                        time.perf_counter() if sampler is not None else 0.0
+                    )
                     with trace_lib.span("step", epoch=epoch, step=at,
                                         steps=n):
                         trainer.state, metrics, metric_acc = (
@@ -716,6 +724,7 @@ def fit_device_cached(trainer, x, y, batch_size, epochs, initial_epoch, steps_pe
                             )
                         )
                     if sampler is not None:
+                        sampler.add_step_time(time.perf_counter() - t_run)
                         sampler.maybe_sample(trainer.state, n)
                     at += n
                     # Once per chunk, with the chunk's last step metrics

@@ -1143,6 +1143,7 @@ class StepPhaseSampler:
         self.comm_refresh = max(1, int(comm_refresh))
         self._steps = 0            # optimizer steps since the window edge
         self._input_s = 0.0        # host input-wait inside the window
+        self._step_call_s = 0.0    # host time inside step calls (window)
         self._window_t0 = None     # None until the first drained edge
         self._step_shapes = None   # ShapeDtypeStructs of the step args
         self._steps_per_exec = 1
@@ -1151,6 +1152,7 @@ class StepPhaseSampler:
         self._flops = None         # FLOPs per optimizer step (cost model)
         self._peak = None          # (per-chip peak, source)
         self.samples = 0
+        self.skew_probe = SkewProbe.maybe()
 
     # -- hooks the feeding loops call ---------------------------------------
 
@@ -1183,6 +1185,14 @@ class StepPhaseSampler:
     def add_input_wait(self, seconds: float) -> None:
         self._input_s += seconds
 
+    def add_step_time(self, seconds: float) -> None:
+        """Host time spent INSIDE the step call (the feeding loops time
+        each dispatch when the sampler is on). On a synchronous-dispatch
+        backend this is where a victim rank's barrier wait hides — the
+        `SkewProbe`'s blocked-time signal needs it (the drain alone
+        reads ~0 for everyone there)."""
+        self._step_call_s += seconds
+
     def maybe_sample(self, state, steps: int) -> None:
         """After each execution's dispatch: account ``steps`` optimizer
         steps; at the cadence boundary, drain and publish."""
@@ -1192,8 +1202,10 @@ class StepPhaseSampler:
         self._steps += steps
         if self._window_t0 is not None and self._steps < self.every:
             return
+        t_drain = time.perf_counter()
         jax.block_until_ready(state)
         now = time.perf_counter()
+        drain_s = now - t_drain
         if self._window_t0 is None:
             # First edge: one-time warmups OUTSIDE any window, so their
             # cost never pollutes a published step time.
@@ -1201,6 +1213,7 @@ class StepPhaseSampler:
             self._window_t0 = time.perf_counter()
             self._steps = 0
             self._input_s = 0.0
+            self._step_call_s = 0.0
             return
         total_s = (now - self._window_t0) / self._steps
         input_s = min(self._input_s / self._steps, total_s)
@@ -1224,12 +1237,24 @@ class StepPhaseSampler:
             )
         obs.counter("hvt_step_samples_total")
         self.samples += 1
+        if self.skew_probe is not None:
+            # One tiny allgather of host timings per sample window —
+            # OUTSIDE the published window (the re-edge below restarts
+            # the clock after it), its cost charged to the sampler and
+            # covered by the bench sampler-overhead A/B gate. The
+            # signal is per-step BLOCKED time: host seconds inside the
+            # step calls plus the drain, covering both dispatch regimes
+            # (SkewProbe docstring).
+            self.skew_probe.publish(
+                (self._step_call_s + drain_s) / self._steps
+            )
         # Re-edge AFTER the sampling work: the published step time
         # measures training, not the sampler; the sampler's own cost is
         # what the bench overhead A/B measures.
         self._window_t0 = time.perf_counter()
         self._steps = 0
         self._input_s = 0.0
+        self._step_call_s = 0.0
 
     # -- internals ----------------------------------------------------------
 
@@ -1269,3 +1294,75 @@ class StepPhaseSampler:
             jax.block_until_ready(f(grads))
             self._comm_s = time.perf_counter() - t0
         return self._comm_s
+
+
+class SkewProbe:
+    """Live cross-rank straggler detection riding the `StepPhaseSampler`
+    cadence (the offline counterpart is ``hvt-trace skew``,
+    obs/timeline.py).
+
+    The honest live skew signal is NOT each rank's own step time — a
+    data-parallel fleet is paced by its slowest rank, so every rank's
+    drained window reads fleet speed. What discriminates is per-step
+    BLOCKED time: host seconds spent inside the step call plus the
+    window-edge drain (``add_step_time`` + the ``block_until_ready``).
+    Whichever dispatch regime the backend is in — synchronous (the
+    step call blocks through the collective; the victims' CALLS run
+    long) or async (the calls return at enqueue; the victims' DRAIN
+    runs long) — the ranks waiting on the straggler carry the extra
+    blocked time, while the straggler itself (sleeping, starved, or
+    busy elsewhere BETWEEN steps) blocks least. So every sample window,
+    each rank contributes ``(rank, blocked s/step, wall time)`` to ONE
+    tiny host allgather (`collectives.allgather_object` — the KV-store
+    transport, a few dozen bytes), and every rank publishes:
+
+    * ``hvt_step_skew_ms``   — max − median of the fleet's per-step
+      blocked times;
+    * ``hvt_straggler_rank`` — the rank with the SMALLEST blocked time
+      (deterministic lowest-rank tie-break; read it together with the
+      skew gauge — at ~0 skew the "straggler" is just the fastest of
+      equals);
+    * ``hvt_barrier_wait_ms`` — this rank's blocked time beyond the
+      fleet minimum (stragglers read ~0 while everyone else pays).
+
+    A rank slow INSIDE its own compute is invisible here (every rank
+    then blocks equally — sync or async); that case needs real per-op
+    profiles (``POST /profile``), not host timing.
+
+    Cadence safety: every rank's sampler fires at the same optimizer
+    step counts (same ``HVT_METRICS_EVERY``, SPMD feeding), so the
+    allgather is submission-order-agreed by construction. Off unless
+    the trainer exporter is on (the probe only exists inside the
+    sampler) AND the run is multi-process; ``HVT_SKEW_PROBE=0`` is the
+    kill switch. Cost: one object allgather per sample window, outside
+    the published timing window, charged to the sampler overhead the
+    bench A/B gates."""
+
+    def __init__(self):
+        self.rank = runtime.process_rank()
+
+    @staticmethod
+    def maybe() -> "SkewProbe | None":
+        if not registry.get_flag("HVT_SKEW_PROBE"):
+            return None
+        if jax.process_count() <= 1:
+            return None  # nothing to be skewed against
+        return SkewProbe()
+
+    def publish(self, blocked_s: float) -> None:
+        from horovod_tpu import obs
+
+        rows = collectives.allgather_object(
+            (self.rank, float(blocked_s), time.time())
+        )
+        waits = {int(r): float(d) for r, d, _t in rows}
+        vals = sorted(waits.values())
+        med = vals[len(vals) // 2] if len(vals) % 2 else (
+            (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2.0
+        )
+        straggler = min(waits, key=lambda r: (waits[r], r))
+        obs.gauge("hvt_step_skew_ms", (vals[-1] - med) * 1e3)
+        obs.gauge("hvt_straggler_rank", straggler)
+        obs.gauge(
+            "hvt_barrier_wait_ms", (waits[self.rank] - vals[0]) * 1e3
+        )

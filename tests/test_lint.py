@@ -1292,6 +1292,48 @@ class TestHVT009MetricRegistryDiscipline:
         """)
         assert found == []
 
+    def test_trace_span_inside_jit_flagged(self):
+        # ISSUE 15: a span entered inside a traced body clocks the TRACE
+        # and fires once at compile time — a frozen span poisoning the
+        # merged timeline's clock anchors.
+        found = findings_of(MetricRegistryDiscipline, """
+            import jax
+            from horovod_tpu import trace
+            @jax.jit
+            def step(x):
+                with trace.span("step"):
+                    x = x + 1
+                return x
+        """)
+        assert len(found) == 1
+        assert "clocks the TRACE" in found[0].message
+
+    def test_trace_span_alias_inside_scan_flagged(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            from jax import lax
+            from horovod_tpu import trace as trace_lib
+            def body(c, t):
+                trace_lib.emit_span("decode", 0.0, 0.1)
+                return c, t
+            lax.scan(body, 0, None)
+        """)
+        assert len(found) == 1
+        assert "emit_span" in found[0].message
+
+    def test_trace_span_on_host_side_clean(self):
+        found = findings_of(MetricRegistryDiscipline, """
+            import jax
+            from horovod_tpu import trace
+            @jax.jit
+            def step(x):
+                return x + 1
+            def loop(x):
+                with trace.span("step", epoch=0):
+                    x = step(x)
+                return x
+        """)
+        assert found == []
+
     def test_noqa_suppresses(self, tmp_path):
         res = lint_tree(tmp_path, {
             "pkg/mod.py": """

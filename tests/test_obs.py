@@ -548,8 +548,11 @@ class TestSupervisorMetrics:
         checks = spec["metrics_checks"]
         assert "hvt_committed_step" in checks
         assert checks["hvt_restarts_total"]["target"] == "0..0"
+        # ISSUE 15: the skew-series presence gate over the /fleet-merged
+        # dump (rank-labeled — parse_text keys carry rendered labels).
+        assert 'hvt_step_skew_ms{rank="0"}' in checks
         for name in checks:
-            assert core.is_declared(name)
+            assert core.is_declared(name.split("{", 1)[0])
 
 
 FAKE_DIR = os.path.join(REPO, "tests")
