@@ -141,6 +141,11 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_RESCALE_EVERY_STEPS", "int", 0, "elastic",
          "Sub-epoch membership-agreement cadence in optimizer steps "
          "(0 = epoch boundaries only)."),
+    Knob("HVT_ELASTIC_SPARE", "flag", False, "elastic",
+         "Member-side warm-standby parking (supervisor-set when spares "
+         "are configured): a 'world is full' rendezvous rejection makes "
+         "the client wait and re-knock instead of failing, so spare "
+         "processes stay parked until an eviction frees a slot."),
     # --- launch / supervision ----------------------------------------------
     Knob("HVT_HEARTBEAT_DIR", "path", None, "launch",
          "Per-rank liveness dir (supervisor-set); fit() auto-installs "
@@ -152,6 +157,26 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_STATUS_HOST", "str", "127.0.0.1", "launch",
          "Bind host for the supervisor status endpoint (`--status-port`); "
          "loopback by default — set 0.0.0.0 to expose off-host."),
+    Knob("HVT_POLICY", "str", "off", "launch",
+         "Supervisor policy engine mode: off | dry-run | on. dry-run "
+         "journals every decision (policy_* events) without acting; on "
+         "closes the observe->act loop (straggler evict-and-shrink, "
+         "hot-spare promotion, hang auto-triage)."),
+    Knob("HVT_POLICY_STRAGGLER_WINDOWS", "int", 3, "launch",
+         "Consecutive fresh metric windows a majority-named straggler "
+         "must persist before the policy engine evicts it."),
+    Knob("HVT_POLICY_STRAGGLER_WAIT_MS", "float", 100.0, "launch",
+         "Minimum peak hvt_barrier_wait_ms across the fleet for a "
+         "straggler window to count toward eviction."),
+    Knob("HVT_POLICY_EVICT_BUDGET", "int", 1, "launch",
+         "Policy-initiated evictions allowed per supervised run "
+         "(separate from the restart budget)."),
+    Knob("HVT_POLICY_COOLDOWN_S", "float", 60.0, "launch",
+         "Minimum seconds between policy actions (eviction cooldown)."),
+    Knob("HVT_POLICY_SPARES", "int", 0, "launch",
+         "Warm standby processes the elastic supervisor keeps parked at "
+         "rendezvous; an eviction frees a slot and a spare joins the "
+         "next generation so world size is preserved."),
     # --- data --------------------------------------------------------------
     Knob("HVT_NO_NATIVE", "flag", False, "data",
          "Disable the native C++ loader; fall back to the pure-python "

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 
 from horovod_tpu.analysis import core
@@ -99,8 +98,8 @@ def _entry_rows(result: core.LintResult) -> list:
 def _run_replay(args) -> int:
     from horovod_tpu import flight
 
-    files = flight.record_files(args.dir)
-    if not files:
+    by_member = flight.load_members(args.dir)
+    if not by_member:
         print(
             f"hvt-sched: no flight-*.jsonl records under {args.dir} — "
             "was HVT_FLIGHT_RECORD set on the run, and did the "
@@ -108,14 +107,14 @@ def _run_replay(args) -> int:
             file=sys.stderr,
         )
         return 2
-    by_member = {}
-    for path in files:
-        label = os.path.basename(path)[len("flight-"):-len(".jsonl")]
-        by_member[label] = flight.read_records(path)
     counts = ", ".join(
         f"{lb}={len(rs)}" for lb, rs in sorted(by_member.items())
     )
-    if len(by_member) < 2:
+    # The verdict itself is shared with the supervisor policy engine's
+    # hang auto-triage (`launch.policy.PolicyEngine.on_hang` journals
+    # the same shape) — this CLI only adds the human rendering.
+    verdict = flight.replay_verdict(by_member)
+    if verdict is None:
         print(
             f"hvt-sched: only one member's record under {args.dir} "
             f"({counts}) — replay needs at least two ranks to "
@@ -123,26 +122,25 @@ def _run_replay(args) -> int:
             file=sys.stderr,
         )
         return 2
-    div = flight.first_divergence(by_member)
-    if div is None:
+    if verdict["status"] == "agree":
         print(
             f"hvt-sched: replay ok — {len(by_member)} member(s) agree "
             f"op-for-op ({counts})"
         )
         return 0
-    a, b = div["member_a"], div["member_b"]
+    a, b = verdict["member_a"], verdict["member_b"]
     print(
         f"hvt-sched: replay FAILED — first divergent submission at "
-        f"seq {div['seq']} ({div['kind']}):"
+        f"seq {verdict['seq']} ({verdict['kind']}):"
     )
-    print(f"  member {a}: {flight.format_op(div['op_a'])}")
-    print(f"  member {b}: {flight.format_op(div['op_b'])}")
+    print(f"  member {a}: {verdict['op_a']}")
+    print(f"  member {b}: {verdict['op_b']}")
     for label in (a, b):
         print(f"  --- {label} context (seq ±{args.window}) ---")
         for rec in flight.context_window(
-            by_member[label], div["seq"], args.window
+            by_member[label], verdict["seq"], args.window
         ):
-            marker = ">>" if rec["seq"] == div["seq"] else "  "
+            marker = ">>" if rec["seq"] == verdict["seq"] else "  "
             print(f"  {marker} [{rec['seq']}] {flight.format_op(rec)}")
     return 1
 

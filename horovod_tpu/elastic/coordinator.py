@@ -651,7 +651,23 @@ class ElasticClient:
         rendezvous (the server supersedes the stale waiter slot), so a
         slow epoch elsewhere cannot crash a joiner while a half-open
         connection still cannot wedge it. Pass an explicit ``timeout`` to
-        bound the total wait instead."""
+        bound the total wait instead.
+
+        **Warm-standby parking** (``HVT_ELASTIC_SPARE``, set on members
+        by `supervise_elastic(spares=K)`): a sync the coordinator
+        rejects because the world is already full parks — sleep, knock
+        again — instead of failing. The rejection happens BEFORE
+        membership, so a parked spare never appears on the coordinator;
+        the moment an eviction or death frees a slot, the next knock
+        joins the rendezvous and the spare is promoted into the new
+        generation. With an explicit ``timeout`` the parking is bounded
+        by the same deadline."""
+        from horovod_tpu.analysis import registry
+
+        park = registry.get_flag("HVT_ELASTIC_SPARE")
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
         retry = False
         while True:
             try:
@@ -664,6 +680,12 @@ class ElasticClient:
                 if timeout is not None:
                     raise
                 retry = True
+            except ElasticError as e:
+                if not park or "world is full" not in str(e):
+                    raise
+                if deadline is not None and time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
         self.synced_generation = world.generation
         return world
 

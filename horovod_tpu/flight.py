@@ -302,6 +302,45 @@ def read_records(path: str) -> list:
     return out
 
 
+def load_members(directory: str) -> dict:
+    """``{member label: [records]}`` for every ``flight-<member>.jsonl``
+    under ``directory`` — the replay cross-check's input shape, shared by
+    `hvt-sched replay` and the supervisor policy engine's hang triage."""
+    out = {}
+    for path in record_files(directory):
+        label = os.path.basename(path)[len("flight-"):-len(".jsonl")]
+        out[label] = read_records(path)
+    return out
+
+
+def replay_verdict(by_member: dict) -> dict | None:
+    """Machine-shaped verdict of the replay cross-check over
+    `load_members` output — what `hvt-sched replay` prints and what the
+    policy engine journals into the restart journal before a relaunch:
+
+    * ``None`` — nothing to cross-check (fewer than two members);
+    * ``{"status": "agree", "members": N}`` — every member matches
+      op-for-op;
+    * ``{"status": "diverged", "members": N, "member_a", "member_b",
+      "seq", "kind", "op_a", "op_b"}`` — `first_divergence`'s witness
+      with the ops pre-formatted (`format_op`), JSON-journal-safe."""
+    if len(by_member) < 2:
+        return None
+    div = first_divergence(by_member)
+    if div is None:
+        return {"status": "agree", "members": len(by_member)}
+    return {
+        "status": "diverged",
+        "members": len(by_member),
+        "member_a": div["member_a"],
+        "member_b": div["member_b"],
+        "seq": div["seq"],
+        "kind": div["kind"],
+        "op_a": format_op(div["op_a"]),
+        "op_b": format_op(div["op_b"]),
+    }
+
+
 def op_key(rec: dict) -> tuple:
     """What must MATCH across ranks for a submission to agree: the op's
     identity (kind/dtype/shape/bucket/caller tag). Payload BYTES are

@@ -16,10 +16,62 @@ import yaml
 from horovod_tpu.launch import ci_gate, launcher
 
 
+def validate_spec(spec) -> list:
+    """Validate a parsed job spec BEFORE any side effect (fresh-dir wipe,
+    metrics reset, process spawn). Returns a list of error strings — empty
+    means the spec is launchable. Each supervised block (``restart:``,
+    ``elastic:``, ``policy:``) is dry-built through the same
+    ``from_mapping`` constructor the launch path uses, so a typo'd key
+    fails here with the constructor's own message (which names the bad
+    key and the valid set) instead of mid-run."""
+    errors: list = []
+    if not isinstance(spec, dict):
+        return [f"spec must be a mapping, got {type(spec).__name__}"]
+    job = spec.get("job")
+    if not isinstance(job, dict):
+        return [f"job: must be a mapping, got {job!r}"]
+    if not job.get("command"):
+        errors.append("job command: is required")
+
+    from horovod_tpu.launch import supervisor
+    from horovod_tpu.launch import policy as policy_lib
+
+    builders = {
+        "restart": lambda m: supervisor.RestartPolicy.from_mapping(
+            {k: v for k, v in m.items() if k != "log"}
+        ),
+        "elastic": supervisor.ElasticPolicy.from_mapping,
+        "policy": policy_lib.PolicyConfig.from_mapping,
+    }
+    for key, build in builders.items():
+        if key not in job:
+            continue
+        block = job[key] or {}
+        if not isinstance(block, dict):
+            errors.append(f"job {key}: must be a mapping, got {block!r}")
+            continue
+        try:
+            build(block)
+        except (TypeError, ValueError) as e:
+            errors.append(f"job {key}: {e}")
+    if "policy" in job and not ("restart" in job or "elastic" in job):
+        errors.append(
+            "job policy: needs a supervised launch — add a restart: or "
+            "elastic: block (the policy engine lives in the supervisor)"
+        )
+    return errors
+
+
 def run_job(spec_path: str) -> int:
     """Execute a job spec: launch, then gate. Returns a shell exit code."""
     with open(spec_path) as f:
         spec = yaml.safe_load(f)
+
+    problems = validate_spec(spec)
+    if problems:
+        for p in problems:
+            print(f"{spec_path}: {p}")
+        return 1
 
     job = spec.get("job", {})
     command = job["command"]
@@ -109,6 +161,17 @@ def run_job(spec_path: str) -> int:
     # events the gate and /healthz read. A top-level `status_port: N` under
     # job: serves the supervisor's own HTTP status (GET /status, /journal,
     # /healthz — supervisor.start_status_server) for the run's duration.
+    # `policy:` block — the supervisor policy engine (launch/policy.py):
+    #   policy:
+    #     mode: "on"              # off | dry-run | on
+    #     straggler_windows: 3    # confirmed windows before eviction
+    #     straggler_wait_ms: 100  # min peak barrier wait to count a window
+    #     evict_budget: 1         # evictions per run (not restart budget)
+    #     cooldown_s: 60          # seconds between policy actions
+    #     spares: 0               # warm standbys (elastic: only)
+    # Requires a restart:/elastic: block (validated up front); decisions
+    # land in the journal as policy_* events and in the metrics dump as
+    # hvt_policy_actions_total{action,outcome}.
     log_path = None  # set by the supervised branches; journal_checks needs it
     status_port = int(job["status_port"]) if job.get("status_port") else None
     if status_port is not None and not ("elastic" in job or "restart" in job):
@@ -120,6 +183,13 @@ def run_job(spec_path: str) -> int:
         print("job status_port: needs a supervised launch — add a "
               "restart: or elastic: block")
         return 1
+    pcfg = None
+    if "policy" in job:
+        from horovod_tpu.launch import policy as policy_lib
+
+        # validate_spec already dry-built this mapping; a failure here
+        # would be a programming error, not a user one.
+        pcfg = policy_lib.PolicyConfig.from_mapping(job["policy"] or {})
     if "elastic" in job:
         elastic_map = job["elastic"] or {}
         if not isinstance(elastic_map, dict):
@@ -142,13 +212,13 @@ def run_job(spec_path: str) -> int:
                 list(hosts), argv, env=env, policy=policy, elastic=elastic,
                 sync_port_base=int(job.get("coordinator_port", 9981)),
                 workdir=job.get("workdir"), log_path=log_path,
-                status_port=status_port,
+                status_port=status_port, policy_config=pcfg,
             )
         else:
             code = supervisor.supervise_elastic(
                 int(job.get("nprocs", 1)), argv, env=env, policy=policy,
                 elastic=elastic, log_path=log_path,
-                status_port=status_port,
+                status_port=status_port, policy_config=pcfg,
             )
     elif "restart" in job:
         # Key-present-but-empty (`restart:` with every knob commented out)
@@ -173,12 +243,13 @@ def run_job(spec_path: str) -> int:
                 list(hosts), argv, env=env, policy=policy,
                 coordinator_port=int(job.get("coordinator_port", 9981)),
                 workdir=job.get("workdir"), log_path=log_path,
-                status_port=status_port,
+                status_port=status_port, policy_config=pcfg,
             )
         else:
             code = supervisor.supervise_local(
                 int(job.get("nprocs", 1)), argv, env=env, policy=policy,
                 log_path=log_path, status_port=status_port,
+                policy_config=pcfg,
             )
     elif hosts:
         code = launcher.run_hosts(

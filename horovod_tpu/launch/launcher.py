@@ -340,6 +340,19 @@ def main(argv: list[str] | None = None) -> int:
             "--max-ranks", type=int, default=None, metavar="N",
             help="largest world the elastic fleet may grow to "
             "(default: the launch size)")
+        # Policy engine (launch/policy.py): the supervisor's observe->act
+        # loop over the /fleet metric cache.
+        p.add_argument(
+            "--policy", choices=("off", "dry-run", "on"), default=None,
+            help="supervisor policy engine: straggler evict-and-shrink, "
+            "hot-spare promotion, hang auto-triage. dry-run journals "
+            "every decision (policy_* events) without acting; thresholds "
+            "ride HVT_POLICY_* env knobs. Needs a supervised launch")
+        p.add_argument(
+            "--spares", type=int, default=None, metavar="K",
+            help="keep K warm standby processes parked at rendezvous; an "
+            "evicted straggler's slot is refilled by a spare in the next "
+            "generation, preserving world size (elastic only)")
 
     p_gate = sub.add_parser("gate", help="CI metric range check")
     p_gate.add_argument("--metrics", required=True, help="metrics.jsonl path")
@@ -391,19 +404,47 @@ def main(argv: list[str] | None = None) -> int:
             "max_ranks": a.max_ranks,
         })
 
+    def policy_config(a, env, policy, elastic):
+        """None unless --policy/--spares was given — the supervisor's own
+        from_env fallback still honors HVT_POLICY* without the flags. CLI
+        values override the env-derived config field-for-field."""
+        if a.policy is None and a.spares is None:
+            return None
+        if a.spares is not None and elastic is None:
+            parser.error("--spares needs --elastic (spares park at the "
+                         "rendezvous and join on shrink)")
+        if policy is None and elastic is None:
+            parser.error(
+                "--policy needs a supervised launch: add a restart flag "
+                "(--max-restarts/--backoff/--heartbeat-timeout/"
+                "--restart-log) or --elastic"
+            )
+        import dataclasses
+
+        from horovod_tpu.launch import policy as policy_lib
+
+        cfg = policy_lib.PolicyConfig.from_env(env)
+        overrides = {}
+        if a.policy is not None:
+            overrides["mode"] = a.policy
+        if a.spares is not None:
+            overrides["spares"] = a.spares
+        return dataclasses.replace(cfg, **overrides)
+
     if args.cmd == "run":
         env = dict(kv.split("=", 1) for kv in args.env)
         if args.metrics_port is not None:
             env["HVT_METRICS_PORT"] = str(args.metrics_port)
         policy = restart_policy(args)
         elastic = elastic_policy(args)
+        pcfg = policy_config(args, env, policy, elastic)
         if elastic is not None:
             from horovod_tpu.launch import supervisor
 
             return supervisor.supervise_elastic(
                 args.nprocs, command, env=env, policy=policy,
                 elastic=elastic, log_path=args.restart_log,
-                status_port=args.status_port,
+                status_port=args.status_port, policy_config=pcfg,
             )
         if policy is not None:
             from horovod_tpu.launch import supervisor
@@ -411,6 +452,7 @@ def main(argv: list[str] | None = None) -> int:
             return supervisor.supervise_local(
                 args.nprocs, command, env=env, policy=policy,
                 log_path=args.restart_log, status_port=args.status_port,
+                policy_config=pcfg,
             )
         if args.status_port is not None:
             parser.error(
@@ -435,6 +477,7 @@ def main(argv: list[str] | None = None) -> int:
             env["HVT_METRICS_PORT"] = str(args.metrics_port)
         policy = restart_policy(args)
         elastic = elastic_policy(args)
+        pcfg = policy_config(args, env, policy, elastic)
         if elastic is not None:
             from horovod_tpu.launch import supervisor
 
@@ -442,6 +485,7 @@ def main(argv: list[str] | None = None) -> int:
                 hosts, command, env=env, policy=policy, elastic=elastic,
                 sync_port_base=args.port, workdir=args.workdir,
                 log_path=args.restart_log, status_port=args.status_port,
+                spares=(args.spares or 0), policy_config=pcfg,
             )
         if args.heartbeat_timeout is not None and not (
             env.get("PS_MODEL_PATH") or os.environ.get("PS_MODEL_PATH")
@@ -464,6 +508,7 @@ def main(argv: list[str] | None = None) -> int:
                 hosts, command, env=env, policy=policy,
                 coordinator_port=args.port, workdir=args.workdir,
                 log_path=args.restart_log, status_port=args.status_port,
+                policy_config=pcfg,
             )
         if args.status_port is not None:
             parser.error(

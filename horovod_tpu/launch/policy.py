@@ -1,0 +1,367 @@
+"""Supervisor policy engine — closing the observe→act loop (ROADMAP
+item 4's control-plane half).
+
+PRs 13–15 built the sensing tier: live `SkewProbe` straggler gauges on
+every member exporter, the supervisor's ``/fleet`` poller caching those
+scrapes, flight records quarantined on hang classifications, and
+`hvt-sched replay` naming the first divergent collective submission. All
+of it terminated at a human reading a dashboard. This module is the
+actuator that reads the SAME signals the supervisor already owns and
+drives the elastic shrink/grow machinery from them:
+
+* **Straggler eviction** (`PolicyEngine.poll`): the `/fleet` poller's
+  cached member expositions carry ``hvt_straggler_rank`` /
+  ``hvt_barrier_wait_ms`` / ``hvt_step_samples_total``. A new *window*
+  opens only when a sample counter advances (scrapes between SkewProbe
+  publishes are identical — wall-clock polls must not inflate the
+  evidence); a majority-named straggler across
+  ``straggler_windows`` consecutive windows with barrier-wait above
+  ``straggler_wait_ms`` triggers evict-and-shrink: SIGTERM the named
+  member so the elastic callback's existing ``leave``→shrink path
+  re-slices its work — or, when warm spares are parked at rendezvous
+  (``supervise_elastic(spares=K)``), hot-spare promotion: the freed
+  slot admits a knocking spare and world size is preserved.
+* **Hang auto-triage** (`PolicyEngine.on_hang`): the supervisor's hang
+  path already quarantine-copies flight records; the engine runs the
+  `hvt-sched replay` cross-check over the copies and journals the
+  first-divergence verdict (members, seq, op) BEFORE the relaunch
+  decision — a ``reorder`` hang is diagnosed, not just restarted.
+* **Safety rails** — an actuator that misfires is worse than none:
+  a per-action eviction budget and cooldown SEPARATE from the restart
+  budget, an escalation ladder (observe → journal warning →
+  evict/promote → the existing restart machinery), and
+  ``HVT_POLICY=off|dry-run|on`` where ``dry-run`` journals every
+  decision it *would* take without acting.
+
+Every decision is one ``policy_<action>`` journal record (same JSONL
+journal the restart supervisor writes, so `ci_gate` gates it with the
+existing ``journal_checks:`` grammar) and surfaces as
+``hvt_policy_actions_total{action,outcome}`` on the supervisor's
+``/metrics`` and ``/fleet`` panes (`supervisor.supervisor_metrics`
+counts the journal).
+
+The engine is deliberately pure over its inputs: ``members`` is a
+``{slot: exposition text}`` dict (the fleet cache), the actuator is an
+injected callable, and the clock is injectable — every ladder rung unit
+tests without a process tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.obs import prom as obs_prom
+
+MODES = ("off", "dry-run", "on")
+
+# The SkewProbe gauges the detector reads from each member exposition
+# (trainer.py publishes them at every step-phase sample window).
+_SAMPLES = "hvt_step_samples_total"
+_STRAGGLER = "hvt_straggler_rank"
+_BARRIER_WAIT = "hvt_barrier_wait_ms"
+
+
+def _check_mode(mode: str) -> str:
+    if mode not in MODES:
+        raise ValueError(
+            f"unknown policy mode {mode!r}; valid: {list(MODES)}"
+        )
+    return mode
+
+
+@dataclasses.dataclass
+class PolicyConfig:
+    """Knobs for the policy engine (CLI: ``--policy``/``--spares``; YAML:
+    the job's ``policy:`` block; env: the ``HVT_POLICY*`` knobs).
+
+    ``mode``: ``off`` (engine never constructed), ``dry-run`` (every
+    decision journaled with ``outcome="dry-run"``, nothing acted on), or
+    ``on``. The action knobs are separate from `RestartPolicy`'s restart
+    budget by design — the whole point of eviction is rescuing a run
+    WITHOUT spending a restart:
+
+    * ``straggler_windows``: consecutive fresh sample windows the same
+      rank must be majority-named (with barrier-wait over
+      ``straggler_wait_ms``) before the evict rung fires;
+    * ``straggler_warn_windows``: the observe→warn rung — streak length
+      at which a ``policy_warn`` is journaled (once per rank);
+    * ``evict_budget``: evictions per supervisor lifetime (the budget is
+      also charged in dry-run, so a dry run journals exactly what a real
+      run would do);
+    * ``cooldown_s``: minimum seconds between policy ACTIONS — the fleet
+      must be given time to re-settle before the next intervention;
+    * ``spares``: warm standbys for `supervise_elastic` — K extra
+      members spawned at launch that park at rendezvous (world full) and
+      join the generation an eviction frees a slot in, preserving world
+      size instead of shrinking."""
+
+    mode: str = "off"
+    straggler_windows: int = 3
+    straggler_warn_windows: int = 1
+    straggler_wait_ms: float = 100.0
+    evict_budget: int = 1
+    cooldown_s: float = 60.0
+    spares: int = 0
+
+    @classmethod
+    def from_mapping(cls, mapping) -> "PolicyConfig":
+        """Build a config from a partial dict — the single constructor the
+        CLI flags and the YAML ``policy:`` block funnel through (the
+        `RestartPolicy.from_mapping` contract: unknown keys rejected
+        loudly, ``None`` values keep the default)."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(mapping) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown policy keys {sorted(unknown)}; "
+                f"valid: {sorted(fields)}"
+            )
+        config = cls()
+        for key, value in mapping.items():
+            if value is None:
+                continue
+            if key == "mode":
+                config.mode = _check_mode(str(value))
+            elif key in ("straggler_wait_ms", "cooldown_s"):
+                setattr(config, key, float(value))
+            else:
+                setattr(config, key, int(value))
+        return config
+
+    @classmethod
+    def from_env(cls, env=None) -> "PolicyConfig":
+        """Resolve from the ``HVT_POLICY*`` knobs, the job env overlay
+        winning over the supervisor's own environment (the
+        `resolve_flight_dir` precedence)."""
+        environ = dict(os.environ)
+        environ.update(env or {})
+        return cls(
+            mode=_check_mode(
+                registry.get_str("HVT_POLICY", environ=environ) or "off"
+            ),
+            straggler_windows=registry.get_int(
+                "HVT_POLICY_STRAGGLER_WINDOWS", environ=environ
+            ),
+            straggler_wait_ms=registry.get_float(
+                "HVT_POLICY_STRAGGLER_WAIT_MS", environ=environ
+            ),
+            evict_budget=registry.get_int(
+                "HVT_POLICY_EVICT_BUDGET", environ=environ
+            ),
+            cooldown_s=registry.get_float(
+                "HVT_POLICY_COOLDOWN_S", environ=environ
+            ),
+            spares=registry.get_int("HVT_POLICY_SPARES", environ=environ),
+        )
+
+    @property
+    def active(self) -> bool:
+        return self.mode != "off"
+
+    @property
+    def dry_run(self) -> bool:
+        return self.mode == "dry-run"
+
+
+class StragglerDetector:
+    """Windowed majority vote over the fleet cache's member expositions.
+
+    Pure state machine: `observe` takes ``{slot: exposition text}`` and
+    returns None until a FRESH sample window exists (some member's
+    ``hvt_step_samples_total`` advanced since the last observation),
+    else a window summary with the running confirmation ``streak``. The
+    freshness gate is what makes ``straggler_windows`` mean "N distinct
+    SkewProbe publishes", not "N wall-clock polls of the same cached
+    scrape"."""
+
+    def __init__(self, windows: int, wait_ms: float):
+        self.windows = windows
+        self.wait_ms = wait_ms
+        self._samples: dict = {}   # member key -> last sample counter
+        self.candidate: int | None = None
+        self.streak = 0
+
+    def observe(self, members: dict | None) -> dict | None:
+        parsed = {}
+        for key, text in (members or {}).items():
+            try:
+                parsed[key] = obs_prom.parse_text(text)
+            except ValueError:
+                continue  # a torn member scrape must not kill the vote
+        fresh = False
+        for key, vals in parsed.items():
+            samples = vals.get(_SAMPLES)
+            if samples is None:
+                continue
+            if samples != self._samples.get(key):
+                self._samples[key] = samples
+                fresh = True
+        if not fresh:
+            return None
+        votes: dict = {}
+        waits = []
+        for vals in parsed.values():
+            named = vals.get(_STRAGGLER)
+            if named is not None and named >= 0:
+                votes[int(named)] = votes.get(int(named), 0) + 1
+            wait = vals.get(_BARRIER_WAIT)
+            if wait is not None:
+                waits.append(wait)
+        voters = sum(votes.values())
+        # Smallest rank wins a tie — deterministic, and matches the
+        # probe's own tie-break.
+        rank, count = (
+            min(votes.items(), key=lambda kv: (-kv[1], kv[0]))
+            if votes else (None, 0)
+        )
+        max_wait = max(waits, default=0.0)
+        # >= 2 voters: one member's self-report is not cross-rank
+        # evidence — and after a shrink to one rank the survivor's
+        # LAST-published gauges go stale at the old verdict, which must
+        # never re-trigger the ladder.
+        confirmed = (
+            rank is not None
+            and voters >= 2
+            and count * 2 > voters
+            and max_wait >= self.wait_ms
+        )
+        if confirmed:
+            self.streak = self.streak + 1 if rank == self.candidate else 1
+            self.candidate = rank
+        else:
+            self.candidate, self.streak = None, 0
+        return {
+            "confirmed": confirmed,
+            "rank": self.candidate,
+            "streak": self.streak,
+            "wait_ms": round(max_wait, 3),
+            "voters": voters,
+        }
+
+
+class PolicyEngine:
+    """The supervisor-resident observe→act loop.
+
+    ``journal``: a `RestartLog.write`-shaped callable — every decision
+    lands as ``policy_<action>`` with an ``outcome`` field.
+    ``evict``: optional actuator ``(world_rank) -> outcome str``; None
+    means this supervise mode has no per-member actuator (whole-fleet
+    `supervise`), so the evict rung journals ``outcome="unsupported"``.
+    ``spare_count``: optional zero-arg callable counting currently
+    parked warm standbys (`supervise_elastic` wires it); a successful
+    eviction with spares available additionally journals
+    ``policy_promote`` — the freed slot's knocking spare preserves world
+    size.
+
+    The engine throttles its own parsing (``min_poll_s``) so wiring it
+    into a 10 Hz supervision loop costs nothing between windows."""
+
+    def __init__(self, config: PolicyConfig, journal, *, evict=None,
+                 spare_count=None, min_poll_s: float = 1.0,
+                 clock=time.monotonic):
+        self.config = config
+        self._journal = journal
+        self._evict = evict
+        self._spare_count = spare_count
+        self._clock = clock
+        self._min_poll_s = min_poll_s
+        self._next_poll = 0.0
+        self.detector = StragglerDetector(
+            config.straggler_windows, config.straggler_wait_ms
+        )
+        self.evicts_used = 0
+        self._last_action_at: float | None = None
+        self._warned: set = set()
+        self._decided: set = set()
+
+    def _record(self, action: str, outcome: str, **fields) -> None:
+        self._journal(
+            f"policy_{action}", 1.0, mode=self.config.mode,
+            outcome=outcome, **fields,
+        )
+
+    def poll(self, members: dict | None) -> None:
+        """One observation of the fleet cache; walks the ladder when a
+        fresh window confirms a straggler."""
+        now = self._clock()
+        if now < self._next_poll:
+            return
+        self._next_poll = now + self._min_poll_s
+        window = self.detector.observe(members)
+        if not window or not window["confirmed"]:
+            return
+        rank, streak = window["rank"], window["streak"]
+        cfg = self.config
+        if streak >= cfg.straggler_warn_windows and rank not in self._warned:
+            # The warn rung is journal-only in every mode — it IS the
+            # dry half of the ladder.
+            self._warned.add(rank)
+            self._record(
+                "warn", "journaled", rank=rank, streak=streak,
+                wait_ms=window["wait_ms"], voters=window["voters"],
+            )
+        if streak < cfg.straggler_windows or rank in self._decided:
+            return
+        if (
+            self._last_action_at is not None
+            and now - self._last_action_at < cfg.cooldown_s
+        ):
+            return  # cooling down; the streak keeps the evidence warm
+        if self.evicts_used >= cfg.evict_budget:
+            # Decide once, then defer to the restart machinery — the
+            # ladder's final rung is the budget the supervisor already
+            # owns, not an unbounded actuator.
+            self._decided.add(rank)
+            self._record(
+                "evict", "budget-exhausted", rank=rank, streak=streak,
+                wait_ms=window["wait_ms"], voters=window["voters"],
+            )
+            return
+        spares = int(self._spare_count()) if self._spare_count else 0
+        self._decided.add(rank)
+        self.evicts_used += 1
+        self._last_action_at = now
+        if cfg.dry_run:
+            self._record(
+                "evict", "dry-run", rank=rank, streak=streak,
+                wait_ms=window["wait_ms"], voters=window["voters"],
+                spares=spares,
+            )
+            if spares:
+                self._record("promote", "dry-run", rank=rank, spares=spares)
+            return
+        if self._evict is None:
+            self._record(
+                "evict", "unsupported", rank=rank, streak=streak,
+                wait_ms=window["wait_ms"], voters=window["voters"],
+            )
+            return
+        outcome = self._evict(rank) or "error"
+        self._record(
+            "evict", outcome, rank=rank, streak=streak,
+            wait_ms=window["wait_ms"], voters=window["voters"],
+            spares=spares,
+        )
+        if spares and outcome == "sigterm":
+            self._record("promote", "released", rank=rank, spares=spares)
+
+    def on_hang(self, dump_dir: str | None) -> dict | None:
+        """Auto-triage one quarantined hang collection: run the
+        `hvt-sched replay` cross-check over ``dump_dir`` and journal the
+        verdict as ``policy_triage`` — called by the supervise loops
+        right after `collect_flight_records`, BEFORE the relaunch
+        decision is journaled. Returns the verdict (or None when there
+        was nothing to cross-check)."""
+        if not dump_dir:
+            return None
+        from horovod_tpu import flight
+
+        verdict = flight.replay_verdict(flight.load_members(dump_dir))
+        if verdict is None:
+            return None
+        fields = {k: v for k, v in verdict.items() if k != "status"}
+        self._record("triage", verdict["status"], dir=dump_dir, **fields)
+        return verdict

@@ -56,6 +56,7 @@ import time
 
 from horovod_tpu.analysis import registry
 from horovod_tpu.launch import launcher
+from horovod_tpu.launch import policy as policy_lib
 from horovod_tpu.obs import core as obs_core
 from horovod_tpu.obs import fleet as obs_fleet
 from horovod_tpu.obs import prom as obs_prom
@@ -92,6 +93,13 @@ class RestartPolicy:
     heartbeat_timeout: float | None = None
     startup_timeout: float | None = None
     grace_seconds: float = 30.0
+    # Consecutive no-progress OOM-KILL restarts (`classify` kind
+    # "oom-kill": exit 137 / SIGKILL, the host OOM killer's signature)
+    # before giving up — None shares `max_restarts`. An OOM loop is
+    # near-deterministic (the same footprint re-exceeds the same host
+    # limit every relaunch), so a tighter budget stops it burning the
+    # full restart budget on faults a relaunch can never fix.
+    oom_kill_budget: int | None = None
 
     @classmethod
     def from_mapping(cls, mapping) -> "RestartPolicy":
@@ -113,7 +121,8 @@ class RestartPolicy:
                 continue
             setattr(
                 policy, key,
-                int(value) if key == "max_restarts" else float(value),
+                int(value) if key in ("max_restarts", "oom_kill_budget")
+                else float(value),
             )
         return policy
 
@@ -122,11 +131,17 @@ def classify(exit_code: int, hang: bool = False) -> str:
     """Map a fleet outcome to a restart-log kind.
 
     143 (= 128 + SIGTERM, the `PreemptionCheckpointCallback` convention) and
-    a raw SIGTERM death both read as the scheduler reclaiming the slice."""
+    a raw SIGTERM death both read as the scheduler reclaiming the slice.
+    137 (= 128 + SIGKILL) and a raw SIGKILL death read as the host OOM
+    killer — the one external kill a scheduler never sends politely — and
+    get their own kind (and, via ``RestartPolicy.oom_kill_budget``, their
+    own restart budget) rather than lumping in with generic crashes."""
     if hang:
         return "hang"
     if exit_code in (143, -signal.SIGTERM):
         return "preemption"
+    if exit_code in (137, -signal.SIGKILL):
+        return "oom-kill"
     return "crash"
 
 
@@ -383,6 +398,8 @@ def supervise(
     status_port: int | None = None,
     flight_dir: str | None = None,
     fleet_ports=None,
+    fleet_env: dict | None = None,
+    policy_config: "policy_lib.PolicyConfig | None" = None,
     sleep=time.sleep,
     verbose: bool = True,
 ) -> int:
@@ -394,7 +411,14 @@ def supervise(
     exhausted. ``status_port`` serves `start_status_server` from this
     supervisor for the run's duration (fleet status + journal over HTTP,
     no serving bundle required); ``fleet_ports`` additionally lights up
-    its ``GET /fleet`` rollup (`member_metrics_ports`)."""
+    its ``GET /fleet`` rollup (`member_metrics_ports`).
+
+    ``policy_config`` (mode != off) runs the policy engine
+    (`launch.policy`) alongside: straggler OBSERVATION over the fleet
+    cache (whole-fleet mode has no per-member actuator, so the evict
+    rung journals ``unsupported`` — or ``dry-run``) and hang auto-triage
+    (the `hvt-sched replay` verdict journaled before every
+    hang-relaunch decision)."""
     policy = policy or RestartPolicy()
     log = RestartLog(log_path)
     log.touch()
@@ -404,18 +428,28 @@ def supervise(
     budget = {"max": policy.max_restarts, "used": 0}
     status_server = (
         start_status_server(status_port, log_path, budget=budget,
-                            model_dir=model_dir, fleet_ports=fleet_ports)
+                            model_dir=model_dir, fleet_ports=fleet_ports,
+                            env=fleet_env)
         if status_port is not None else None
     )
     marker = newest_checkpoint_marker(model_dir)
     total_restarts = 0  # lifetime count — what the log/gate report
     backoff = policy.backoff
     attempt = 0
+    engine = (
+        policy_lib.PolicyEngine(policy_config, log.write)
+        if policy_config is not None and policy_config.active else None
+    )
 
     try:
         return _supervise_loop(
             start, policy, log, model_dir, heartbeat_dir, sleep, verbose,
             marker, budget, total_restarts, backoff, attempt, flight_dir,
+            engine=engine,
+            members_fn=(
+                (lambda: status_server.fleet_cache["members"])
+                if status_server is not None else None
+            ),
         )
     finally:
         dump_metrics(
@@ -431,8 +465,10 @@ def supervise(
 
 def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
                     verbose, marker, budget, total_restarts, backoff,
-                    attempt, flight_dir=None) -> int:
+                    attempt, flight_dir=None, engine=None,
+                    members_fn=None) -> int:
     restarts_used = budget["used"]  # consecutive no-progress restarts
+    oom_used = 0  # consecutive no-progress oom-kill restarts
     while True:
         attempt += 1
         abort = None
@@ -444,6 +480,15 @@ def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
                 if policy.startup_timeout is not None
                 else 10.0 * policy.heartbeat_timeout,
             )
+        if engine is not None and members_fn is not None:
+            # Ride the fleet's abort-poll cadence for the engine's
+            # observation tick (it throttles internally) — whole-fleet
+            # mode gets the observe/warn/dry-run rungs without a thread.
+            inner_abort = abort
+
+            def abort(inner=inner_abort):
+                engine.poll(members_fn())
+                return inner() if inner is not None else False
         fleet = start()
         code = fleet.wait(policy.grace_seconds, abort=abort)
         if code == 0 and not fleet.aborted:
@@ -458,7 +503,14 @@ def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
             # flight-dump handler (and write-through covers ranks
             # wedged in native collectives): quarantine the evidence
             # before the relaunch truncates the live files.
-            collect_flight_records(flight_dir, log, attempt, kind=kind)
+            files = collect_flight_records(
+                flight_dir, log, attempt, kind=kind
+            )
+            if engine is not None and files:
+                # Auto-triage the quarantined evidence: the replay
+                # verdict lands in the journal BEFORE the restart
+                # decision below.
+                engine.on_hang(os.path.dirname(files[0]))
         new_marker = newest_checkpoint_marker(model_dir)
         progressed = model_dir is not None and new_marker != marker
         marker = new_marker
@@ -466,24 +518,37 @@ def _supervise_loop(start, policy, log, model_dir, heartbeat_dir, sleep,
             # Fresh checkpoint since launch: the fault is not a
             # deterministic loop — full budget and backoff again.
             restarts_used = 0
+            oom_used = 0
             backoff = policy.backoff
         budget["used"] = restarts_used
-        if restarts_used >= policy.max_restarts:
+        oom_exhausted = (
+            kind == "oom-kill"
+            and policy.oom_kill_budget is not None
+            and oom_used >= policy.oom_kill_budget
+        )
+        if restarts_used >= policy.max_restarts or oom_exhausted:
             log.write(
                 "supervisor_gave_up", 1.0, attempt=attempt, kind=kind,
                 exit_code=code, restarts=total_restarts,
+                **({"budget": "oom-kill"} if oom_exhausted else {}),
             )
             if verbose:
+                spent = (
+                    f"oom-kill budget ({policy.oom_kill_budget}) spent"
+                    if oom_exhausted else
+                    f"no progress in the last {restarts_used} restart(s)"
+                )
                 print(
                     f"supervisor: giving up after {total_restarts} "
                     f"restart(s) — attempt {attempt} {kind} "
-                    f"(exit {code}), no progress in the last "
-                    f"{restarts_used} restart(s)"
+                    f"(exit {code}), {spent}"
                 )
             # `or 1`: a hang-killed rank that trapped SIGTERM and exited 0
             # must still surface as failure.
             return shell_code(code) or 1
         restarts_used += 1
+        if kind == "oom-kill":
+            oom_used += 1
         budget["used"] = restarts_used
         total_restarts += 1
         log.write(
@@ -548,6 +613,7 @@ def supervise_local(
     heartbeat_dir: str | None = None,
     log_path: str | None = None,
     status_port: int | None = None,
+    policy_config: "policy_lib.PolicyConfig | None" = None,
     tag_output: bool = True,
     sleep=time.sleep,
 ) -> int:
@@ -557,6 +623,8 @@ def supervise_local(
     env, model_dir, heartbeat_dir, log_path = _resolve_dirs(
         env, model_dir, heartbeat_dir, log_path, policy
     )
+    if policy_config is None:
+        policy_config = policy_lib.PolicyConfig.from_env(env)
     return supervise(
         lambda: launcher.start_local(
             nprocs, argv, env=env, tag_output=tag_output
@@ -568,6 +636,8 @@ def supervise_local(
         status_port=status_port,
         flight_dir=resolve_flight_dir(env),
         fleet_ports=member_metrics_ports(env, nprocs),
+        fleet_env=env,
+        policy_config=policy_config,
         sleep=sleep,
     )
 
@@ -677,6 +747,8 @@ def supervise_elastic(
     coordinator_host: str = "127.0.0.1",
     sync_port_base: int | None = None,
     spawn=None,
+    spares: int = 0,
+    policy_config: "policy_lib.PolicyConfig | None" = None,
     tag_output: bool = True,
     sleep=time.sleep,
     verbose: bool = True,
@@ -717,7 +789,24 @@ def supervise_elastic(
     path's hook). It receives the RESOLVED env overlay — including
     ``HVT_ELASTIC_COORDINATOR``, which only exists once the coordinator
     here has started — and must apply it to the child; a closure over the
-    caller's own env dict would silently miss the coordinator address."""
+    caller's own env dict would silently miss the coordinator address.
+
+    ``spares`` (or the policy config's ``spares``): K extra members
+    spawned beyond ``nprocs`` as WARM STANDBYS. The world still caps at
+    ``max_ranks`` (default ``nprocs``), so whichever K members lose the
+    initial rendezvous race park at the coordinator's door (the
+    ``HVT_ELASTIC_SPARE`` knock-and-retry in `ElasticClient.sync` —
+    processes up, imports warm, re-syncing every half second) and join
+    the generation an eviction or death frees a slot in: world size is
+    PRESERVED instead of shrunk, without spending a restart.
+
+    ``policy_config`` (default: resolved from the env's ``HVT_POLICY*``
+    knobs) runs the policy engine (`launch.policy`) inside this loop —
+    this mode owns the full actuator: a confirmed straggler's member is
+    SIGTERMed so the elastic callback's leave→shrink path re-slices its
+    work (no restart-budget spend, no respawn; a parked spare grows the
+    world back), and every hang collection is auto-triaged with the
+    `hvt-sched replay` verdict journaled before the respawn decision."""
     from horovod_tpu.elastic.coordinator import Coordinator
     from horovod_tpu.runtime import ENV_ELASTIC_COORDINATOR
 
@@ -728,6 +817,15 @@ def supervise_elastic(
         dict(env or {}), model_dir, None,
         log_path, RestartPolicy(heartbeat_timeout=None),
     )
+    if policy_config is None:
+        policy_config = policy_lib.PolicyConfig.from_env(env)
+    spares = spares if spares > 0 else policy_config.spares
+    if spares > 0:
+        # Every member gets the park-when-full retry: any member that
+        # loses a rendezvous race to a full world (an initial spare, OR
+        # a respawn whose slot a promoted spare already took) becomes
+        # the next warm standby instead of dying on ElasticError.
+        env["HVT_ELASTIC_SPARE"] = "1"
     flight_dir = resolve_flight_dir(env)
     log = RestartLog(log_path)
     log.touch()
@@ -754,9 +852,15 @@ def supervise_elastic(
     status_server = (
         start_status_server(status_port, log_path, coord=coord,
                             budget=budget, model_dir=model_dir,
+                            # Spares ride slots PAST the world (their
+                            # exporters bind base + slot too), so the
+                            # scrape map must cover every spawnable slot
+                            # or a promoted spare's rank — and any rank
+                            # whose slot shifted past a parked spare —
+                            # goes unobserved.
                             fleet_ports=member_metrics_ports(
-                                env, max_ranks
-                            ))
+                                env, min(nprocs, max_ranks) + spares
+                            ), env=env)
         if status_port is not None else None
     )
     if spawn is None:
@@ -776,6 +880,56 @@ def supervise_elastic(
             "spawned": time.monotonic(),
         }
         return member_id
+
+    # --- policy engine (launch.policy) ----------------------------------
+    # Members the engine deliberately evicted: their exits must spend NO
+    # restart budget and queue NO respawn — the eviction IS the remedy
+    # (a parked spare grows the world back, or the fleet deliberately
+    # stays smaller).
+    policy_evicted: set = set()
+
+    def parked_spares() -> int:
+        """Live member processes the coordinator has never admitted —
+        with ``spares`` those are the warm standbys knocking at a full
+        world. (A respawn mid-join counts too, briefly: equally
+        promotable, so the promote accounting stays honest.)"""
+        return sum(
+            1 for mid, rec in members.items()
+            if rec["proc"].poll() is None
+            and coord.member_status(mid)[0] == "unknown"
+        )
+
+    def evict_member(world_rank: int) -> str:
+        """The engine's actuator: SIGTERM the live member holding
+        ``world_rank``. The elastic callback's flag-only handler turns
+        that into a clean leave at the next commit/rescale boundary —
+        the coordinator's existing shrink path re-slices the work."""
+        for mid, m in coord.snapshot()["members"].items():
+            if m.get("status") != "live" or m.get("rank") != world_rank:
+                continue
+            rec = members.get(mid)
+            if rec is None or rec["proc"].poll() is not None:
+                return "no-process"
+            policy_evicted.add(mid)
+            # Arm the existing grace escalation: an evictee too wedged
+            # to honor its own leave still gets reaped.
+            rec["terminated_at"] = time.monotonic()
+            rec["proc"].terminate()
+            if verbose:
+                print(
+                    f"supervisor: policy evicting {mid} (rank "
+                    f"{world_rank}) — confirmed straggler"
+                )
+            return "sigterm"
+        return "no-member"
+
+    engine = (
+        policy_lib.PolicyEngine(
+            policy_config, log.write, evict=evict_member,
+            spare_count=parked_spares,
+        )
+        if policy_config.active else None
+    )
 
     marker = newest_checkpoint_marker(model_dir)
     # STEP-granular progress: members report their committed
@@ -797,6 +951,7 @@ def supervise_elastic(
         )
 
     restarts_used = 0
+    oom_used = 0
     total_restarts = 0
     backoff = policy.backoff
     hang_killed: set[str] = set()
@@ -839,7 +994,9 @@ def supervise_elastic(
         return code
 
     try:
-        for slot in range(min(nprocs, max_ranks)):
+        # Spares ride extra slots past the world: rendezvous admits the
+        # first max_ranks joiners, the rest park (HVT_ELASTIC_SPARE).
+        for slot in range(min(nprocs, max_ranks) + spares):
             launch(slot)
         while True:
             now = time.monotonic()
@@ -853,6 +1010,17 @@ def supervise_elastic(
                 status, reason = coord.member_status(member_id)
                 if status == "left" and reason == "done":
                     job_done = True
+                    continue
+                if member_id in policy_evicted:
+                    # Deliberate policy eviction: the engine already
+                    # journaled the decision; the coordinator journaled
+                    # the leave/shrink. No budget spend, no respawn —
+                    # a parked spare (if any) takes the freed slot.
+                    policy_evicted.discard(member_id)
+                    if status != "left":
+                        # The evictee was too wedged for a clean leave
+                        # and the grace escalation killed it.
+                        coord.mark_dead(member_id, reason="evicted")
                     continue
                 if code == 0:
                     # Finished without the leave handshake (a non-elastic
@@ -879,10 +1047,14 @@ def supervise_elastic(
                         # episode's members while a LATER hang (after
                         # respawns) still collects fresh evidence.
                         flight_collected.add(seq)
-                        collect_flight_records(
+                        files = collect_flight_records(
                             flight_dir, log, seq, kind=kind,
                             member=member_id,
                         )
+                        if engine is not None and files:
+                            # Replay verdict into the journal BEFORE
+                            # the respawn decision below.
+                            engine.on_hang(os.path.dirname(files[0]))
                     coord.mark_dead(member_id, reason=kind)
                     last_failure = code if code else 1
                 if not job_done:
@@ -899,24 +1071,40 @@ def supervise_elastic(
                     best_progress = max(best_progress, cur_progress)
                     if progressed:
                         restarts_used = 0
+                        oom_used = 0
                         backoff = policy.backoff
                     budget["used"] = restarts_used
-                    if restarts_used >= policy.max_restarts:
+                    oom_exhausted = (
+                        kind == "oom-kill"
+                        and policy.oom_kill_budget is not None
+                        and oom_used >= policy.oom_kill_budget
+                    )
+                    if restarts_used >= policy.max_restarts \
+                            or oom_exhausted:
                         log.write(
                             "supervisor_gave_up", 1.0, member=member_id,
                             kind=kind, exit_code=code,
                             generation=coord.generation,
                             restarts=total_restarts,
+                            **({"budget": "oom-kill"}
+                               if oom_exhausted else {}),
                         )
                         if verbose:
+                            spent = (
+                                f"oom-kill budget "
+                                f"({policy.oom_kill_budget}) spent"
+                                if oom_exhausted else "no-progress "
+                                "budget spent"
+                            )
                             print(
                                 f"supervisor: not replacing {member_id} "
-                                f"({kind}, exit {code}) — no-progress "
-                                f"budget spent after {total_restarts} "
-                                "restart(s)"
+                                f"({kind}, exit {code}) — {spent} after "
+                                f"{total_restarts} restart(s)"
                             )
                         continue
                     restarts_used += 1
+                    if kind == "oom-kill":
+                        oom_used += 1
                     budget["used"] = restarts_used
                     total_restarts += 1
                     log.write(
@@ -973,6 +1161,12 @@ def supervise_elastic(
                     and now - rec["terminated_at"] > policy.grace_seconds
                 ):
                     rec["proc"].kill()
+            # --- policy engine: observe → (warn → evict/promote) ------------
+            if engine is not None and not job_done:
+                engine.poll(
+                    status_server.fleet_cache["members"]
+                    if status_server is not None else {}
+                )
             # --- grow back --------------------------------------------------
             if not job_done:
                 due = [r for r in respawn_queue if r[0] <= now]
@@ -1157,7 +1351,9 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
 
     * the restart journal → ``hvt_restarts_total`` /
       ``hvt_fleet_shrinks_total`` / ``hvt_fleet_grows_total`` /
-      ``hvt_supervisor_gave_up_total`` and the last settled
+      ``hvt_supervisor_gave_up_total`` /
+      ``hvt_policy_actions_total{action,outcome}`` (the policy engine's
+      ``policy_*`` decision records) and the last settled
       generation/size;
     * the live rendezvous coordinator (elastic mode) →
       ``hvt_fleet_live_members``, per-member
@@ -1171,6 +1367,7 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
     reg = obs_core.Registry()
     records = journal_records(log_path)
     restarts = gave_up = shrinks = grows = flight_dumps = 0
+    policy_actions: dict = {}  # (action, outcome) -> count
     generation = size = None
     for rec in records:
         name = rec.get("name")
@@ -1184,6 +1381,10 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
             grows += 1
         elif name == "flight_dump":
             flight_dumps += 1
+        elif isinstance(name, str) and name.startswith("policy_"):
+            key = (name[len("policy_"):],
+                   str(rec.get("outcome", "applied")))
+            policy_actions[key] = policy_actions.get(key, 0) + 1
         if name in ("start", "shrink", "grow", "steady"):
             generation = rec.get("generation")
             size = rec.get("size")
@@ -1192,6 +1393,10 @@ def supervisor_metrics(log_path: str | None, coord=None, budget=None,
     reg.counter_set("hvt_fleet_grows_total", grows)
     reg.counter_set("hvt_supervisor_gave_up_total", gave_up)
     reg.counter_set("hvt_flight_dumps_total", flight_dumps)
+    for (action, outcome), n in sorted(policy_actions.items()):
+        reg.counter_set(
+            "hvt_policy_actions_total", n, action=action, outcome=outcome,
+        )
     epoch, step, total, spe = manifest_progress(model_dir)
     if coord is not None:
         snap = coord.snapshot()
@@ -1312,7 +1517,8 @@ def dump_metrics(log_path: str | None, coord=None, budget=None,
 
 def start_status_server(port: int, log_path: str | None, coord=None,
                         host: str | None = None, budget=None,
-                        model_dir: str | None = None, fleet_ports=None):
+                        model_dir: str | None = None, fleet_ports=None,
+                        env=None):
     """Serve the supervisor's own status over HTTP (the ``--status-port``
     surface): fleet state WITHOUT a serving bundle — previously the
     journal was only visible through ``serve --fleet-journal``'s
@@ -1348,7 +1554,10 @@ def start_status_server(port: int, log_path: str | None, coord=None,
 
     ``fleet_ports``: ``{rank: exporter port}`` or a zero-arg callable
     returning one (`member_metrics_ports` builds it from the member
-    env); None leaves ``/fleet`` serving 404.
+    env); None leaves ``/fleet`` serving 404. ``env``: the job env
+    mapping, overlaid on the supervisor's own environ when reading the
+    poll cadence (``HVT_FLEET_POLL_S``) — so a job spec's ``env:``
+    block tunes its own fleet polling.
 
     Returns the started server (a daemon thread runs it); callers own
     ``shutdown()``. Port 0 binds an ephemeral port —
@@ -1436,7 +1645,11 @@ def start_status_server(port: int, log_path: str | None, coord=None,
     server.fleet_cache = fleet_cache  # dump_metrics reads "members"
     threading.Thread(target=server.serve_forever, daemon=True).start()
     if fleet_ports is not None:
-        poll_s = registry.get_float("HVT_FLEET_POLL_S") or 0.0
+        environ = dict(os.environ)
+        environ.update(env or {})
+        poll_s = registry.get_float(
+            "HVT_FLEET_POLL_S", environ=environ
+        ) or 0.0
         if poll_s > 0:
             stop = threading.Event()
 
@@ -1513,6 +1726,7 @@ def supervise_hosts(
     heartbeat_dir: str | None = None,
     log_path: str | None = None,
     status_port: int | None = None,
+    policy_config: "policy_lib.PolicyConfig | None" = None,
     sleep=time.sleep,
 ) -> int:
     """`launcher.start_hosts` under supervision (the ``hvt-launch pod
@@ -1564,6 +1778,8 @@ def supervise_hosts(
             hosts, argv, env=env, coordinator_port=port, workdir=workdir,
         )
 
+    if policy_config is None:
+        policy_config = policy_lib.PolicyConfig.from_env(env)
     return supervise(
         start,
         policy,
@@ -1572,6 +1788,7 @@ def supervise_hosts(
         log_path=log_path,
         status_port=status_port,
         flight_dir=resolve_flight_dir(env),
+        policy_config=policy_config,
         sleep=sleep,
     )
 
@@ -1588,6 +1805,8 @@ def supervise_elastic_hosts(
     model_dir: str | None = None,
     log_path: str | None = None,
     status_port: int | None = None,
+    spares: int = 0,
+    policy_config: "policy_lib.PolicyConfig | None" = None,
     ssh_args: tuple[str, ...] = ("-o", "StrictHostKeyChecking=no"),
     sleep=time.sleep,
     verbose: bool = True,
@@ -1640,6 +1859,7 @@ def supervise_elastic_hosts(
         len(hosts), argv, env=env, policy=policy, elastic=elastic,
         model_dir=model_dir, log_path=log_path, status_port=status_port,
         coordinator_host=socket_lib.gethostname(),
-        sync_port_base=sync_port_base, spawn=spawn, sleep=sleep,
+        sync_port_base=sync_port_base, spawn=spawn, spares=spares,
+        policy_config=policy_config, sleep=sleep,
         verbose=verbose,
     )

@@ -160,6 +160,13 @@ METRICS: dict[str, MetricSpec] = _decl([
                "hang classifications (each = one hang whose per-rank "
                "collective submission records were quarantined for "
                "`hvt-sched replay`).", "supervisor"),
+    MetricSpec("hvt_policy_actions_total", "counter",
+               "Supervisor policy-engine decisions journaled as "
+               "policy_* events (launch/policy.py), by action "
+               "(warn/evict/promote/triage) and outcome — outcome "
+               "'dry-run' means the decision was journaled without "
+               "acting (HVT_POLICY=dry-run).", "supervisor",
+               labels=("action", "outcome")),
     MetricSpec("hvt_restart_budget_remaining", "gauge",
                "Consecutive no-progress restarts left before the "
                "supervisor gives up (resets to max_restarts on progress).",

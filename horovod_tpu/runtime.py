@@ -194,6 +194,16 @@ def init(
             num_processes=num_processes,
             process_id=process_id,
         )
+    else:
+        # The gloo config above is process-global and STICKY: a reinit
+        # back to single-process (a fleet evicted/shrunk down to one
+        # survivor has no coordinator) would otherwise create the CPU
+        # backend with collectives that demand the distributed client
+        # torn down two lines ago.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "none")
+        except (AttributeError, ValueError):
+            pass
     _initialized = True
     return world()
 

@@ -1059,9 +1059,11 @@ class TestWiring:
         calls = {}
 
         def fake(nprocs, command, env=None, policy=None, elastic=None,
-                 log_path=None, status_port=None):
+                 log_path=None, status_port=None, policy_config=None,
+                 spares=0):
             calls.update(nprocs=nprocs, command=command, policy=policy,
-                         elastic=elastic, status_port=status_port)
+                         elastic=elastic, status_port=status_port,
+                         policy_config=policy_config)
             return 0
 
         monkeypatch.setattr(supervisor, "supervise_elastic", fake)
@@ -1211,7 +1213,8 @@ class TestWiring:
         from horovod_tpu.launch import job as job_lib
 
         def fake_supervise(nprocs, argv, env=None, policy=None,
-                           elastic=None, log_path=None, status_port=None):
+                           elastic=None, log_path=None, status_port=None,
+                           policy_config=None, spares=0):
             log = supervisor.RestartLog(log_path)
             log.touch()
             if env.get("DO_SHRINK") == "1":

@@ -1,0 +1,965 @@
+"""Supervisor policy engine (launch/policy.py): config plumbing, the
+windowed straggler detector, every ladder rung (warn → evict/promote →
+budget), hang auto-triage, the oom-kill classification budget, job-spec
+validation (satellite: typo'd specs fail before any process spawns),
+RestartPolicy backoff edges, the warm-standby park path, and the
+policy_* journal → hvt_policy_actions_total metric rendering."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+import yaml
+
+from horovod_tpu.launch import job as job_mod
+from horovod_tpu.launch import launcher, supervisor
+from horovod_tpu.launch.policy import (
+    PolicyConfig, PolicyEngine, StragglerDetector,
+)
+from horovod_tpu.launch.supervisor import RestartPolicy
+from horovod_tpu.obs import prom as obs_prom
+
+NO_SLEEP = lambda s: None  # noqa: E731
+
+
+def _expo(samples, straggler=None, wait=None):
+    """A synthetic member exposition carrying the SkewProbe gauges."""
+    lines = [f"hvt_step_samples_total {samples}"]
+    if straggler is not None:
+        lines.append(f"hvt_straggler_rank {straggler}")
+    if wait is not None:
+        lines.append(f"hvt_barrier_wait_ms {wait}")
+    return "\n".join(lines) + "\n"
+
+
+def _fleet(samples, straggler, wait, n=2):
+    """n members unanimously naming ``straggler`` at ``wait`` ms."""
+    return {slot: _expo(samples, straggler, wait) for slot in range(n)}
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=2.0):
+        self.t += dt
+
+
+def _engine(records, config, **kwargs):
+    journal = lambda name, value, **f: records.append(  # noqa: E731
+        {"name": name, "value": value, **f}
+    )
+    clock = kwargs.pop("clock", _Clock())
+    return PolicyEngine(config, journal, clock=clock, **kwargs), clock
+
+
+def _by_name(records, name):
+    return [r for r in records if r["name"] == name]
+
+
+class TestPolicyConfig:
+    def test_defaults(self):
+        cfg = PolicyConfig()
+        assert cfg.mode == "off" and not cfg.active and not cfg.dry_run
+        assert cfg.straggler_windows == 3
+        assert cfg.evict_budget == 1 and cfg.spares == 0
+
+    def test_from_mapping_partial_and_none_keeps_default(self):
+        cfg = PolicyConfig.from_mapping(
+            {"mode": "dry-run", "straggler_wait_ms": "50",
+             "cooldown_s": None}
+        )
+        assert cfg.mode == "dry-run" and cfg.dry_run and cfg.active
+        assert cfg.straggler_wait_ms == 50.0
+        assert cfg.cooldown_s == 60.0  # None = keep default
+
+    def test_from_mapping_rejects_unknown_keys_loudly(self):
+        with pytest.raises(ValueError) as e:
+            PolicyConfig.from_mapping({"straggler_window": 2})
+        # The error names the bad key AND the valid set.
+        assert "straggler_window" in str(e.value)
+        assert "straggler_windows" in str(e.value)
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="dry-run"):
+            PolicyConfig.from_mapping({"mode": "auto"})
+        with pytest.raises(ValueError, match="unknown policy mode"):
+            PolicyConfig.from_env({"HVT_POLICY": "bogus"})
+
+    def test_from_env_overlay_wins(self):
+        cfg = PolicyConfig.from_env({
+            "HVT_POLICY": "on",
+            "HVT_POLICY_STRAGGLER_WINDOWS": "5",
+            "HVT_POLICY_STRAGGLER_WAIT_MS": "25.5",
+            "HVT_POLICY_EVICT_BUDGET": "2",
+            "HVT_POLICY_COOLDOWN_S": "7",
+            "HVT_POLICY_SPARES": "1",
+        })
+        assert cfg.mode == "on" and cfg.active and not cfg.dry_run
+        assert cfg.straggler_windows == 5
+        assert cfg.straggler_wait_ms == 25.5
+        assert cfg.evict_budget == 2
+        assert cfg.cooldown_s == 7.0
+        assert cfg.spares == 1
+
+    def test_from_env_defaults_off(self, monkeypatch):
+        monkeypatch.delenv("HVT_POLICY", raising=False)
+        cfg = PolicyConfig.from_env({})
+        assert cfg.mode == "off" and not cfg.active
+
+
+class TestStragglerDetector:
+    def test_no_fresh_window_returns_none(self):
+        det = StragglerDetector(windows=2, wait_ms=100.0)
+        fleet = _fleet(samples=4, straggler=1, wait=150.0)
+        assert det.observe(fleet)["confirmed"]
+        # Same cached scrapes again: no sample advance, no window — the
+        # wall-clock poll must not inflate the streak.
+        assert det.observe(fleet) is None
+        assert det.streak == 1
+
+    def test_streak_counts_fresh_windows(self):
+        det = StragglerDetector(windows=2, wait_ms=100.0)
+        for n, samples in enumerate((4, 8, 12), start=1):
+            w = det.observe(_fleet(samples, straggler=1, wait=150.0))
+            assert w["confirmed"] and w["rank"] == 1 and w["streak"] == n
+
+    def test_single_voter_never_confirms(self):
+        # One member's self-report is not cross-rank evidence — and the
+        # stale-gauge survivor after a shrink-to-1 looks exactly like
+        # this.
+        det = StragglerDetector(windows=1, wait_ms=10.0)
+        w = det.observe({0: _expo(4, straggler=1, wait=500.0)})
+        assert not w["confirmed"] and w["rank"] is None
+
+    def test_wait_threshold_gates_confirmation(self):
+        det = StragglerDetector(windows=1, wait_ms=100.0)
+        w = det.observe(_fleet(4, straggler=1, wait=99.0))
+        assert not w["confirmed"]
+        w = det.observe(_fleet(8, straggler=1, wait=100.0))
+        assert w["confirmed"]
+
+    def test_candidate_change_resets_streak(self):
+        det = StragglerDetector(windows=3, wait_ms=10.0)
+        assert det.observe(_fleet(4, straggler=1, wait=50.0))["streak"] == 1
+        assert det.observe(_fleet(8, straggler=1, wait=50.0))["streak"] == 2
+        w = det.observe(_fleet(12, straggler=0, wait=50.0))
+        assert w["rank"] == 0 and w["streak"] == 1
+
+    def test_unconfirmed_window_resets_streak(self):
+        det = StragglerDetector(windows=3, wait_ms=100.0)
+        assert det.observe(_fleet(4, straggler=1, wait=150.0))["streak"] == 1
+        # A calm window (wait below threshold) clears the evidence.
+        assert not det.observe(_fleet(8, straggler=1, wait=5.0))["confirmed"]
+        assert det.observe(_fleet(12, straggler=1, wait=150.0))["streak"] == 1
+
+    def test_majority_not_plurality(self):
+        det = StragglerDetector(windows=1, wait_ms=10.0)
+        members = {
+            0: _expo(4, straggler=1, wait=50.0),
+            1: _expo(4, straggler=1, wait=50.0),
+            2: _expo(4, straggler=2, wait=50.0),
+            3: _expo(4, straggler=2, wait=50.0),
+        }
+        w = det.observe(members)  # 2-2 split: no majority
+        assert not w["confirmed"]
+        members = {
+            0: _expo(8, straggler=1, wait=50.0),
+            1: _expo(8, straggler=1, wait=50.0),
+            2: _expo(8, straggler=2, wait=50.0),
+        }
+        w = det.observe(members)  # 2 of 3
+        assert w["confirmed"] and w["rank"] == 1 and w["voters"] == 3
+
+    def test_torn_scrape_skipped_not_fatal(self):
+        det = StragglerDetector(windows=1, wait_ms=10.0)
+        members = _fleet(4, straggler=1, wait=50.0, n=2)
+        members[2] = "hvt_step_samples_total not-a-float\n"
+        w = det.observe(members)
+        assert w["confirmed"] and w["rank"] == 1
+
+    def test_negative_straggler_rank_is_no_vote(self):
+        # SkewProbe publishes -1 when no rank stands out.
+        det = StragglerDetector(windows=1, wait_ms=10.0)
+        w = det.observe(_fleet(4, straggler=-1, wait=50.0))
+        assert not w["confirmed"] and w["voters"] == 0
+
+
+class TestPolicyEngineLadder:
+    def test_warn_rung_journals_once_per_rank(self):
+        records = []
+        engine, clock = _engine(records, PolicyConfig.from_mapping(
+            {"mode": "on", "straggler_windows": 5,
+             "straggler_wait_ms": 10}
+        ))
+        for samples in (4, 8, 12):
+            clock.tick()
+            engine.poll(_fleet(samples, straggler=1, wait=50.0))
+        warns = _by_name(records, "policy_warn")
+        assert len(warns) == 1
+        assert warns[0]["rank"] == 1 and warns[0]["outcome"] == "journaled"
+        assert not _by_name(records, "policy_evict")  # streak < 5
+
+    def test_dry_run_journals_decision_without_acting(self):
+        records = []
+        evicted = []
+        engine, clock = _engine(
+            records,
+            PolicyConfig.from_mapping(
+                {"mode": "dry-run", "straggler_windows": 2,
+                 "straggler_wait_ms": 10}
+            ),
+            evict=lambda rank: evicted.append(rank) or "sigterm",
+            spare_count=lambda: 1,
+        )
+        for samples in (4, 8, 12):
+            clock.tick()
+            engine.poll(_fleet(samples, straggler=1, wait=50.0))
+        evicts = _by_name(records, "policy_evict")
+        assert len(evicts) == 1  # decided once, not re-decided per window
+        assert evicts[0]["outcome"] == "dry-run" and evicts[0]["rank"] == 1
+        promotes = _by_name(records, "policy_promote")
+        assert len(promotes) == 1 and promotes[0]["outcome"] == "dry-run"
+        assert evicted == []           # the actuator was never touched
+        assert engine.evicts_used == 1  # ... but the budget was charged
+
+    def test_evict_rung_calls_actuator_and_promotes(self):
+        records = []
+        evicted = []
+        engine, clock = _engine(
+            records,
+            PolicyConfig.from_mapping(
+                {"mode": "on", "straggler_windows": 2,
+                 "straggler_wait_ms": 10}
+            ),
+            evict=lambda rank: evicted.append(rank) or "sigterm",
+            spare_count=lambda: 2,
+        )
+        for samples in (4, 8):
+            clock.tick()
+            engine.poll(_fleet(samples, straggler=1, wait=50.0))
+        assert evicted == [1]
+        evicts = _by_name(records, "policy_evict")
+        assert len(evicts) == 1 and evicts[0]["outcome"] == "sigterm"
+        assert evicts[0]["spares"] == 2
+        promotes = _by_name(records, "policy_promote")
+        assert len(promotes) == 1 and promotes[0]["outcome"] == "released"
+
+    def test_no_actuator_journals_unsupported(self):
+        records = []
+        engine, clock = _engine(records, PolicyConfig.from_mapping(
+            {"mode": "on", "straggler_windows": 1,
+             "straggler_wait_ms": 10}
+        ))
+        clock.tick()
+        engine.poll(_fleet(4, straggler=0, wait=50.0))
+        evicts = _by_name(records, "policy_evict")
+        assert len(evicts) == 1 and evicts[0]["outcome"] == "unsupported"
+
+    def test_budget_exhausted_defers_to_restart_machinery(self):
+        records = []
+        evicted = []
+        engine, clock = _engine(
+            records,
+            PolicyConfig.from_mapping(
+                {"mode": "on", "straggler_windows": 1,
+                 "straggler_wait_ms": 10, "evict_budget": 1,
+                 "cooldown_s": 0}
+            ),
+            evict=lambda rank: evicted.append(rank) or "sigterm",
+        )
+        clock.tick()
+        engine.poll(_fleet(4, straggler=1, wait=50.0))
+        # A SECOND straggler emerges with the budget spent.
+        clock.tick()
+        engine.poll(_fleet(8, straggler=0, wait=50.0))
+        clock.tick()
+        engine.poll(_fleet(12, straggler=0, wait=50.0))
+        assert evicted == [1]
+        evicts = _by_name(records, "policy_evict")
+        outcomes = [r["outcome"] for r in evicts]
+        assert outcomes == ["sigterm", "budget-exhausted"]
+
+    def test_cooldown_delays_second_action(self):
+        records = []
+        evicted = []
+        engine, clock = _engine(
+            records,
+            PolicyConfig.from_mapping(
+                {"mode": "on", "straggler_windows": 1,
+                 "straggler_wait_ms": 10, "evict_budget": 2,
+                 "cooldown_s": 60}
+            ),
+            evict=lambda rank: evicted.append(rank) or "sigterm",
+        )
+        clock.tick()
+        engine.poll(_fleet(4, straggler=1, wait=50.0))
+        assert evicted == [1]
+        # Rank 0 confirmed inside the cooldown: no action yet.
+        clock.tick(5.0)
+        engine.poll(_fleet(8, straggler=0, wait=50.0))
+        assert evicted == [1]
+        # Past the cooldown (streak kept the evidence warm).
+        clock.tick(60.0)
+        engine.poll(_fleet(12, straggler=0, wait=50.0))
+        assert evicted == [1, 0]
+
+    def test_min_poll_throttle(self):
+        records = []
+        engine, clock = _engine(records, PolicyConfig.from_mapping(
+            {"mode": "on", "straggler_windows": 1,
+             "straggler_wait_ms": 10}
+        ))
+        clock.t = 10.0
+        engine.poll(_fleet(4, straggler=0, wait=50.0))
+        # Same instant (a 10 Hz supervise loop): the second poll is a
+        # no-op even with fresh evidence queued.
+        engine.poll(_fleet(8, straggler=0, wait=50.0))
+        assert len(_by_name(records, "policy_evict")) == 1
+
+
+class TestHangTriage:
+    def _write(self, directory, label, records):
+        path = os.path.join(directory, f"flight-{label}.jsonl")
+        with open(path, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+
+    def _ops(self, kinds):
+        return [
+            {"seq": i, "kind": k, "dtype": "float32", "shape": [4]}
+            for i, k in enumerate(kinds)
+        ]
+
+    def test_divergence_verdict_journaled(self, tmp_path):
+        self._write(tmp_path, "m0",
+                    self._ops(["all_reduce", "all_reduce"]))
+        self._write(tmp_path, "m1",
+                    self._ops(["all_reduce", "all_gather"]))
+        records = []
+        engine, _ = _engine(records, PolicyConfig.from_mapping(
+            {"mode": "on"}
+        ))
+        verdict = engine.on_hang(str(tmp_path))
+        assert verdict["status"] == "diverged" and verdict["seq"] == 1
+        triage = _by_name(records, "policy_triage")
+        assert len(triage) == 1
+        assert triage[0]["outcome"] == "diverged"
+        assert triage[0]["seq"] == 1 and triage[0]["kind"] == "mismatch"
+        assert "all_gather" in triage[0]["op_b"]
+
+    def test_agreeing_records_journal_agree(self, tmp_path):
+        ops = self._ops(["all_reduce", "broadcast"])
+        self._write(tmp_path, "m0", ops)
+        self._write(tmp_path, "m1", ops)
+        records = []
+        engine, _ = _engine(records, PolicyConfig.from_mapping(
+            {"mode": "on"}
+        ))
+        assert engine.on_hang(str(tmp_path))["status"] == "agree"
+        assert _by_name(records, "policy_triage")[0]["outcome"] == "agree"
+
+    def test_single_member_is_no_verdict(self, tmp_path):
+        self._write(tmp_path, "m0", self._ops(["all_reduce"]))
+        records = []
+        engine, _ = _engine(records, PolicyConfig.from_mapping(
+            {"mode": "on"}
+        ))
+        assert engine.on_hang(str(tmp_path)) is None
+        assert engine.on_hang(None) is None
+        assert not records
+
+
+class TestSpecValidation:
+    def _spec(self, **job):
+        return {"job": {"command": "python train.py", **job}}
+
+    def test_valid_spec_passes(self):
+        assert job_mod.validate_spec(self._spec(
+            restart={"max_restarts": 2},
+            elastic={"min_ranks": 1},
+            policy={"mode": "dry-run"},
+        )) == []
+
+    def test_typoed_policy_key_names_key_and_valid_set(self):
+        errors = job_mod.validate_spec(self._spec(
+            restart={}, policy={"evict_budgte": 1}
+        ))
+        assert len(errors) == 1
+        assert "evict_budgte" in errors[0] and "evict_budget" in errors[0]
+        assert errors[0].startswith("job policy:")
+
+    def test_typoed_restart_key_fails(self):
+        errors = job_mod.validate_spec(self._spec(
+            restart={"max_restart": 3}
+        ))
+        assert len(errors) == 1
+        assert "max_restart" in errors[0] and "max_restarts" in errors[0]
+
+    def test_non_mapping_blocks_fail(self):
+        errors = job_mod.validate_spec(self._spec(restart=True))
+        assert errors and "must be a mapping" in errors[0]
+
+    def test_policy_without_supervision_fails(self):
+        errors = job_mod.validate_spec(self._spec(policy={"mode": "on"}))
+        assert errors and "restart: or" in errors[0]
+
+    def test_missing_command_fails(self):
+        assert job_mod.validate_spec({"job": {"nprocs": 2}}) == [
+            "job command: is required"
+        ]
+        assert job_mod.validate_spec({"job": None}) != []
+        assert job_mod.validate_spec([]) != []
+
+    def test_run_job_rejects_before_side_effects(self, tmp_path, capsys):
+        # `fresh: true` + an invalid block: the model dir must SURVIVE —
+        # validation runs before the wipe (or any spawn).
+        model_dir = tmp_path / "models"
+        model_dir.mkdir()
+        sentinel = model_dir / "precious.ckpt"
+        sentinel.write_text("do not wipe")
+        spec = {
+            "job": {
+                "command": "python train.py",
+                "fresh": True,
+                "restart": {},
+                "policy": {"mode": "on", "bogus_knob": 1},
+                "env": {"PS_MODEL_PATH": str(model_dir)},
+            },
+        }
+        spec_path = tmp_path / "bad.yaml"
+        spec_path.write_text(yaml.safe_dump(spec))
+        assert job_mod.run_job(str(spec_path)) == 1
+        assert sentinel.exists()
+        out = capsys.readouterr().out
+        assert "bogus_knob" in out and str(spec_path) in out
+
+
+class TestRestartPolicyEdges:
+    def test_oom_kill_budget_key_accepted(self):
+        p = RestartPolicy.from_mapping({"oom_kill_budget": "2"})
+        assert p.oom_kill_budget == 2
+        assert RestartPolicy().oom_kill_budget is None
+        with pytest.raises(ValueError, match="oom_budget"):
+            RestartPolicy.from_mapping({"oom_budget": 2})
+
+    def test_backoff_max_clamps_growth(self, tmp_path):
+        # A deterministic crash loop: backoff doubles per restart but
+        # must clamp at backoff_max. Sleeps observed: [10, 15, 15].
+        log = tmp_path / "restarts.jsonl"
+        sleeps = []
+        code = supervisor.supervise(
+            lambda: launcher.start_local(
+                1, [sys.executable, "-c", "import sys; sys.exit(3)"],
+                tag_output=False,
+            ),
+            policy=RestartPolicy(
+                max_restarts=3, backoff=10.0, backoff_factor=1.5,
+                backoff_max=15.0, grace_seconds=5.0,
+            ),
+            log_path=str(log), sleep=sleeps.append, verbose=False,
+        )
+        assert code == 3
+        assert sleeps == [10.0, 15.0, 15.0]
+        backoffs = [
+            r["backoff_s"] for r in _journal(log) if r["name"] == "restarts"
+        ]
+        assert backoffs == [10.0, 15.0, 15.0]
+
+    def test_budget_resets_on_progress(self, tmp_path):
+        # Each attempt writes a FRESH checkpoint then crashes; with
+        # max_restarts=1 the run still reaches attempt 3's success —
+        # progress must refill the budget (and reset the backoff).
+        model_dir = tmp_path / "models"
+        model_dir.mkdir()
+        script = tmp_path / "child.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "d = os.environ['PS_MODEL_PATH']\n"
+            "n = len([f for f in os.listdir(d) if 'checkpoint' in f])\n"
+            "open(os.path.join(d, f'checkpoint-{n + 1}.msgpack'), 'w')"
+            ".write('x')\n"
+            "sys.exit(0 if n + 1 >= 3 else 1)\n"
+        )
+        log = tmp_path / "restarts.jsonl"
+        sleeps = []
+        code = supervisor.supervise(
+            lambda: launcher.start_local(
+                1, [sys.executable, str(script)],
+                env={"PS_MODEL_PATH": str(model_dir)}, tag_output=False,
+            ),
+            policy=RestartPolicy(max_restarts=1, backoff=2.0,
+                                 backoff_factor=2.0, grace_seconds=5.0),
+            model_dir=str(model_dir), log_path=str(log),
+            sleep=sleeps.append, verbose=False,
+        )
+        assert code == 0
+        restarts = [r for r in _journal(log) if r["name"] == "restarts"]
+        assert len(restarts) == 2
+        assert all(r["progressed"] for r in restarts)
+        # Backoff reset with the budget: both sleeps at the base value.
+        assert sleeps == [2.0, 2.0]
+        assert not [
+            r for r in _journal(log) if r["name"] == "supervisor_gave_up"
+        ]
+
+    def test_startup_timeout_defaults_to_10x_heartbeat(
+        self, tmp_path, monkeypatch
+    ):
+        captured = {}
+
+        def fake_check(heartbeat_dir, timeout, startup_timeout):
+            captured["timeout"] = timeout
+            captured["startup"] = startup_timeout
+            return lambda: False
+
+        monkeypatch.setattr(
+            supervisor, "_throttled_staleness_check", fake_check
+        )
+        code = supervisor.supervise(
+            lambda: launcher.start_local(
+                1, [sys.executable, "-c", "pass"], tag_output=False
+            ),
+            policy=RestartPolicy(heartbeat_timeout=2.0, grace_seconds=5.0),
+            heartbeat_dir=str(tmp_path / "hb"),
+            log_path=str(tmp_path / "r.jsonl"),
+            sleep=NO_SLEEP, verbose=False,
+        )
+        assert code == 0
+        assert captured["timeout"] == 2.0
+        assert captured["startup"] == 20.0  # the documented 10x default
+        # An explicit startup_timeout wins over the derived default.
+        supervisor.supervise(
+            lambda: launcher.start_local(
+                1, [sys.executable, "-c", "pass"], tag_output=False
+            ),
+            policy=RestartPolicy(heartbeat_timeout=2.0,
+                                 startup_timeout=7.0, grace_seconds=5.0),
+            heartbeat_dir=str(tmp_path / "hb2"),
+            log_path=str(tmp_path / "r2.jsonl"),
+            sleep=NO_SLEEP, verbose=False,
+        )
+        assert captured["startup"] == 7.0
+
+    def test_oom_budget_gives_up_before_restart_budget(self, tmp_path):
+        # SIGKILL-self loop: oom_kill_budget=1 must stop it after ONE
+        # oom restart even with max_restarts=5 left.
+        log = tmp_path / "restarts.jsonl"
+        code = supervisor.supervise(
+            lambda: launcher.start_local(
+                1, [sys.executable, "-c",
+                    "import os, signal; os.kill(os.getpid(), "
+                    "signal.SIGKILL)"],
+                tag_output=False,
+            ),
+            policy=RestartPolicy(max_restarts=5, backoff=0.0,
+                                 oom_kill_budget=1, grace_seconds=5.0),
+            log_path=str(log), sleep=NO_SLEEP, verbose=False,
+        )
+        assert code == 137
+        records = _journal(log)
+        restarts = [r for r in records if r["name"] == "restarts"]
+        assert len(restarts) == 1
+        assert restarts[0]["kind"] == "oom-kill"
+        gave_up = [r for r in records if r["name"] == "supervisor_gave_up"]
+        assert len(gave_up) == 1
+        assert gave_up[0]["budget"] == "oom-kill"
+        assert gave_up[0]["kind"] == "oom-kill"
+
+
+class TestPolicyMetrics:
+    def test_journal_renders_action_outcome_counters(self, tmp_path):
+        log = supervisor.RestartLog(str(tmp_path / "restarts.jsonl"))
+        log.write("policy_warn", 1.0, mode="on", outcome="journaled",
+                  rank=1)
+        log.write("policy_evict", 1.0, mode="on", outcome="sigterm",
+                  rank=1)
+        log.write("policy_evict", 1.0, mode="on",
+                  outcome="budget-exhausted", rank=0)
+        log.write("policy_triage", 1.0, mode="on", outcome="diverged",
+                  seq=7)
+        text = obs_prom.render(
+            supervisor.supervisor_metrics(log.path, None, None, None)
+        )
+        assert ('hvt_policy_actions_total{action="warn",'
+                'outcome="journaled"} 1') in text
+        assert ('hvt_policy_actions_total{action="evict",'
+                'outcome="sigterm"} 1') in text
+        assert ('hvt_policy_actions_total{action="evict",'
+                'outcome="budget-exhausted"} 1') in text
+        assert ('hvt_policy_actions_total{action="triage",'
+                'outcome="diverged"} 1') in text
+
+
+class TestSparePark:
+    def test_world_full_parks_then_joins(self, monkeypatch):
+        from horovod_tpu.elastic.coordinator import (
+            Coordinator, ElasticClient, ElasticError,
+        )
+
+        coord = Coordinator(
+            expected=1, max_ranks=1, rendezvous_timeout=10.0
+        ).start()
+        try:
+            first = ElasticClient(coord.address, "a")
+            assert first.sync().size == 1
+            # Without the spare flag, a full world is a hard error.
+            with pytest.raises(ElasticError, match="world is full"):
+                ElasticClient(coord.address, "b").sync()
+            # With it, the spare parks — then joins once a slot frees.
+            monkeypatch.setenv("HVT_ELASTIC_SPARE", "1")
+            result = {}
+            t = threading.Thread(
+                target=lambda: result.update(
+                    world=ElasticClient(coord.address, "b").sync(
+                        timeout=30.0
+                    )
+                )
+            )
+            t.start()
+            time.sleep(1.2)  # at least one rejected knock while parked
+            assert "world" not in result  # still parked, still alive
+            first.leave("evicted")
+            t.join(30.0)
+            assert result["world"].size == 1
+            assert result["world"].rank == 0
+        finally:
+            coord.stop()
+
+    def test_park_respects_deadline(self, monkeypatch):
+        from horovod_tpu.elastic.coordinator import (
+            Coordinator, ElasticClient, ElasticError,
+        )
+
+        coord = Coordinator(
+            expected=1, max_ranks=1, rendezvous_timeout=10.0
+        ).start()
+        try:
+            ElasticClient(coord.address, "a").sync()
+            monkeypatch.setenv("HVT_ELASTIC_SPARE", "1")
+            t0 = time.monotonic()
+            with pytest.raises((ElasticError, OSError)):
+                ElasticClient(coord.address, "b").sync(timeout=1.5)
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            coord.stop()
+
+
+def _journal(log_path):
+    with open(log_path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# The full actuator loop needs members that speak the coordinator wire
+# protocol AND serve a trainer-shaped /metrics exposition (the fleet
+# poller feeds the engine from those scrapes) — import-free, like
+# test_elastic.py's FAKE_WORKER. The exporter starts only after first
+# admission, so straggler votes never precede a rank the actuator can
+# find; the sample counter advances per scrape, so every engine poll
+# sees a fresh window. Runs until FAKE_DONE_FILE appears (the TEST
+# decides when the scenario is over), a SIGTERM turns into the elastic
+# callback's clean leave(sigterm)/exit-143, and a parked spare retries a
+# full world exactly like `ElasticClient.sync`.
+POLICY_WORKER = """
+import json, os, signal, socket, sys, threading, time
+from types import SimpleNamespace
+
+member = os.environ["HVT_ELASTIC_MEMBER"]
+slot = int(os.environ["HVT_LOCAL_RANK"])
+host, port = os.environ["HVT_ELASTIC_COORDINATOR"].rsplit(":", 1)
+spare_park = bool(os.environ.get("HVT_ELASTIC_SPARE"))
+
+
+class MiniClient:
+    def _call(self, **msg):
+        with socket.create_connection((host, int(port)), timeout=60) as s:
+            s.sendall(json.dumps(msg).encode() + b"\\n")
+            buf = b""
+            while not buf.endswith(b"\\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise OSError("closed")
+                buf += chunk
+        reply = json.loads(buf)
+        if "error" in reply:
+            raise RuntimeError(f"coordinator error: {reply['error']}")
+        return reply
+
+    def sync(self, progress=-1):
+        while True:
+            try:
+                r = self._call(cmd="sync", member=member,
+                               host="127.0.0.1", progress=progress)
+            except RuntimeError as e:
+                if spare_park and "world is full" in str(e):
+                    time.sleep(0.5)
+                    continue
+                raise
+            return SimpleNamespace(generation=r["generation"])
+
+    def beat(self, progress=None):
+        return self._call(cmd="beat", member=member,
+                          progress=progress)["generation"]
+
+    def leave(self, reason):
+        self._call(cmd="leave", member=member, reason=reason)
+
+
+flag = {"term": False}
+signal.signal(
+    signal.SIGTERM, lambda *a: flag.__setitem__("term", True)
+)
+
+client = MiniClient()
+world = client.sync()
+
+import http.server
+count = [0]
+
+
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        count[0] += 1
+        body = (
+            "hvt_step_samples_total %d\\n" % count[0]
+            + "hvt_straggler_rank %s\\n"
+            % os.environ.get("FAKE_STRAGGLER_RANK", "-1")
+            + "hvt_barrier_wait_ms %s\\n"
+            % os.environ.get("FAKE_WAIT_MS", "0")
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+srv = http.server.HTTPServer(
+    ("127.0.0.1", int(os.environ["HVT_METRICS_PORT"]) + slot), H
+)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+done_file = os.environ["FAKE_DONE_FILE"]
+deadline = time.monotonic() + 120  # leak guard; the test drives done
+progress = 0
+while time.monotonic() < deadline:
+    if flag["term"]:
+        client.leave("sigterm")
+        sys.exit(143)
+    if os.path.exists(done_file):
+        client.leave("done")
+        print("POLICY-WORKER-DONE " + member, flush=True)
+        sys.exit(0)
+    progress += 1
+    if client.beat(progress=progress) != world.generation:
+        world = client.sync(progress=progress)
+    time.sleep(0.1)
+sys.exit(3)
+"""
+
+
+def _write_policy_worker(tmp_path):
+    import textwrap
+
+    path = tmp_path / "policy_worker.py"
+    path.write_text(textwrap.dedent(POLICY_WORKER))
+    return [sys.executable, str(path)]
+
+
+def _port_base(n):
+    """A window of n consecutive free loopback ports (member exporters
+    bind HVT_METRICS_PORT + slot, so the window must be contiguous)."""
+    import socket as socket_mod
+
+    for base in range(29850, 60000, 41):
+        socks = []
+        try:
+            for i in range(n):
+                s = socket_mod.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port window")
+
+
+def _run_elastic_until(tmp_path, done_file, journal_path, trigger,
+                       timeout=60.0, **kwargs):
+    """Drive supervise_elastic in a thread until ``trigger(records)``
+    holds on the journal (then release the workers via ``done_file``);
+    returns (exit code, journal records)."""
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(
+            code=supervisor.supervise_elastic(**kwargs)
+        )
+    )
+    t.start()
+    fired = False
+    deadline = time.monotonic() + timeout
+    try:
+        while time.monotonic() < deadline and t.is_alive():
+            if not fired and os.path.exists(journal_path) and trigger(
+                _journal(journal_path)
+            ):
+                fired = True
+                open(done_file, "w").close()
+            time.sleep(0.1)
+    finally:
+        # Always release the workers — a failed trigger must not leave
+        # the fleet (and the test) wedged for the worker's leak guard.
+        open(done_file, "w").close()
+        t.join(60.0)
+    assert fired, (
+        f"trigger never held on the journal within {timeout}s: "
+        f"{_journal(journal_path) if os.path.exists(journal_path) else []}"
+    )
+    assert not t.is_alive(), "supervise_elastic did not finish"
+    return result["code"], _journal(journal_path)
+
+
+class TestSuperviseElasticPolicy:
+    """The closed loop against real member processes: fleet poller →
+    detector → SIGTERM actuator → clean leave → shrink (or spare
+    promotion), with zero restart-budget spend."""
+
+    def _env(self, tmp_path, base, done_file, straggler="1"):
+        return {
+            "HVT_METRICS_PORT": str(base),
+            "HVT_FLEET_POLL_S": "0.2",
+            "FAKE_DONE_FILE": str(done_file),
+            "FAKE_STRAGGLER_RANK": straggler,
+            "FAKE_WAIT_MS": "150",
+        }
+
+    def _pcfg(self, mode, **over):
+        return PolicyConfig.from_mapping({
+            "mode": mode, "straggler_windows": 2,
+            "straggler_wait_ms": 50, "evict_budget": 1,
+            "cooldown_s": 1, **over,
+        })
+
+    def test_straggler_evicted_and_shrunk_without_restart_spend(
+        self, tmp_path
+    ):
+        argv = _write_policy_worker(tmp_path)
+        base = _port_base(3)
+        done_file = tmp_path / "done"
+        log = tmp_path / "restarts.jsonl"
+        code, records = _run_elastic_until(
+            tmp_path, done_file, str(log),
+            trigger=lambda rs: any(
+                r["name"] == "policy_evict" for r in rs
+            ) and any(r["name"] == "shrink" for r in rs),
+            nprocs=2, argv=argv,
+            env=self._env(tmp_path, base, done_file),
+            policy=RestartPolicy(max_restarts=3, backoff=0.1,
+                                 grace_seconds=5.0),
+            elastic=supervisor.ElasticPolicy(min_ranks=1, max_ranks=2,
+                                             rendezvous_timeout=20.0),
+            log_path=str(log), status_port=base + 2,
+            policy_config=self._pcfg("on"),
+            tag_output=False,
+        )
+        assert code == 0
+        evicts = [r for r in records if r["name"] == "policy_evict"]
+        assert evicts and evicts[0]["outcome"] == "sigterm"
+        assert evicts[0]["rank"] == 1
+        assert any(r["name"] == "policy_warn" for r in records)
+        assert any(r["name"] == "shrink" for r in records)
+        # The whole point: the rescue spent NO restart budget.
+        assert not [r for r in records if r["name"] == "restarts"]
+        assert not [
+            r for r in records if r["name"] == "supervisor_gave_up"
+        ]
+
+    def test_dry_run_journals_the_decision_but_keeps_the_fleet(
+        self, tmp_path
+    ):
+        argv = _write_policy_worker(tmp_path)
+        base = _port_base(3)
+        done_file = tmp_path / "done"
+        log = tmp_path / "restarts.jsonl"
+        code, records = _run_elastic_until(
+            tmp_path, done_file, str(log),
+            trigger=lambda rs: any(
+                r["name"] == "policy_evict" for r in rs
+            ),
+            nprocs=2, argv=argv,
+            env=self._env(tmp_path, base, done_file),
+            policy=RestartPolicy(max_restarts=3, backoff=0.1,
+                                 grace_seconds=5.0),
+            elastic=supervisor.ElasticPolicy(min_ranks=1, max_ranks=2,
+                                             rendezvous_timeout=20.0),
+            log_path=str(log), status_port=base + 2,
+            policy_config=self._pcfg("dry-run"),
+            tag_output=False,
+        )
+        assert code == 0
+        evicts = [r for r in records if r["name"] == "policy_evict"]
+        assert evicts and evicts[0]["outcome"] == "dry-run"
+        assert evicts[0]["rank"] == 1
+        # Nothing acted: no leave-shrink, no restarts — both members ran
+        # to the release signal.
+        assert not [r for r in records if r["name"] == "shrink"]
+        assert not [r for r in records if r["name"] == "restarts"]
+
+    def test_spare_promotion_preserves_world_size(self, tmp_path):
+        argv = _write_policy_worker(tmp_path)
+        base = _port_base(4)
+        done_file = tmp_path / "done"
+        log = tmp_path / "restarts.jsonl"
+
+        def trigger(rs):
+            promoted = any(r["name"] == "policy_promote" for r in rs)
+            # Wait for the freed slot to be refilled (a settle at full
+            # size AFTER the eviction) before releasing the workers.
+            if not promoted:
+                return False
+            evict_at = next(
+                i for i, r in enumerate(rs)
+                if r["name"] == "policy_evict"
+            )
+            return any(
+                r["name"] in ("grow", "steady") and r.get("size") == 2
+                for r in rs[evict_at:]
+            )
+
+        code, records = _run_elastic_until(
+            tmp_path, done_file, str(log), trigger,
+            nprocs=2, argv=argv,
+            env=self._env(tmp_path, base, done_file),
+            policy=RestartPolicy(max_restarts=3, backoff=0.1,
+                                 grace_seconds=5.0),
+            elastic=supervisor.ElasticPolicy(min_ranks=1, max_ranks=2,
+                                             rendezvous_timeout=20.0),
+            log_path=str(log), status_port=base + 3,
+            policy_config=self._pcfg("on", spares=1),
+            tag_output=False,
+        )
+        assert code == 0
+        evicts = [r for r in records if r["name"] == "policy_evict"]
+        assert evicts and evicts[0]["outcome"] == "sigterm"
+        promotes = [r for r in records if r["name"] == "policy_promote"]
+        assert promotes and promotes[0]["outcome"] == "released"
+        assert promotes[0]["spares"] >= 1
+        # World size was PRESERVED (the spare filled the freed slot) and
+        # no restart budget was spent doing it.
+        evict_at = records.index(evicts[0])
+        assert any(
+            r["name"] in ("grow", "steady") and r.get("size") == 2
+            for r in records[evict_at:]
+        )
+        assert not [r for r in records if r["name"] == "restarts"]

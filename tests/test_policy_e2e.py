@@ -1,0 +1,407 @@
+"""The policy engine's acceptance runs, end-to-end on CPU (ISSUE 16):
+
+* a 2-proc elastic fleet with ``HVT_FAULT=1:0:slow:200`` (the recurring
+  straggler shape — rank 1 pays +200 ms per step, forever): the fleet
+  poller's member scrapes carry the SkewProbe verdict, the policy engine
+  confirms it across consecutive fresh windows, SIGTERMs the member, the
+  elastic leave→shrink path re-slices the work, training completes at
+  size 1 with the loss gate green and the restart budget UNSPENT;
+* the dry-run variant journals the identical decision and touches
+  nothing — both ranks finish;
+* a ``reorder``-wedged supervised fleet journals the `hvt-sched replay`
+  first-divergence verdict (``policy_triage``) BEFORE the relaunch
+  decision;
+* the spare-promotion run (``spares=1``): the evicted straggler's slot
+  is refilled by the parked warm standby, so world size is preserved.
+
+All chaos is injected through env vars (`horovod_tpu.testing.faults`);
+the training script is the plain `elastic.run` idiom plus the metrics
+exporter the observe half of the loop reads."""
+
+import json
+import os
+import re
+import socket
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.launch import ci_gate, supervisor
+from horovod_tpu.launch.policy import PolicyConfig
+from horovod_tpu.launch.supervisor import ElasticPolicy, RestartPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = 14
+
+# The synthetic elastic trainer from test_elastic_e2e, with the policy
+# loop's sensing tier wired on: metrics exporter (HVT_METRICS_PORT +
+# local rank), the step-phase sampler feeding SkewProbe every
+# HVT_METRICS_EVERY steps, and per-epoch loss pushed to the CI-gate
+# metrics stream (the mnist-policy-2proc.yaml `checks:` shape).
+TRAIN_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, __REPO__)
+import numpy as np
+import optax
+import flax.linen as nn
+import horovod_tpu as hvt
+from horovod_tpu import checkpoint, elastic, metrics
+
+metrics.init()
+print(f"BOOT member={os.environ['HVT_ELASTIC_MEMBER']}", flush=True)
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(4)(x)
+
+
+def train(state, world):
+    print(
+        f"GEN member={os.environ['HVT_ELASTIC_MEMBER']} rank={world.rank} "
+        f"size={world.size} gen={world.generation}", flush=True,
+    )
+    model_dir = os.path.join(os.environ["PS_MODEL_PATH"], "run")
+    rng = np.random.RandomState(0)
+    # Separable on purpose: the loss gate asserts the eviction did not
+    # cost convergence, so the task must actually converge.
+    y = (np.arange(96) % 4).astype("int64")
+    x = (np.eye(8, dtype="float32")[y] + 0.1 * rng.rand(96, 8)).astype(
+        "float32")
+    trainer = hvt.Trainer(Tiny(), hvt.DistributedOptimizer(optax.adam(0.1)))
+    trainer.build(x[:1], y[:1])
+    if state.state is not None:
+        trainer.install_state(state.state)
+    else:
+        trainer.state, done = checkpoint.restore_latest_and_broadcast(
+            model_dir, trainer.state, mesh=trainer.mesh)
+        state.epoch = max(state.epoch, done)
+    cbs = []
+    if world.rank == 0:
+        cbs.append(hvt.callbacks.ModelCheckpoint(
+            os.path.join(model_dir, "checkpoint-{epoch}.msgpack")))
+
+    class Status(hvt.callbacks.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            import jax
+            step = int(jax.device_get(self.trainer.state.step))
+            print(
+                f"STATUS epoch={epoch + 1} step={step} rank={world.rank} "
+                f"size={world.size} gen={world.generation}", flush=True,
+            )
+            if logs and "loss" in logs and world.rank == 0:
+                metrics.push("loss", float(logs["loss"]))
+
+    cbs.append(Status())
+    cbs.append(elastic.ElasticStateCallback(state, state.client))
+    trainer.fit(
+        x=x, y=y, batch_size=8, epochs=__EPOCHS__,
+        initial_epoch=state.epoch, steps_per_epoch=2, callbacks=cbs,
+        verbose=0,
+    )
+
+
+elastic.run(train)
+print("TRAINING COMPLETE", flush=True)
+"""
+
+
+def _write_script(tmp_path, epochs=EPOCHS):
+    path = tmp_path / "elastic_train.py"
+    path.write_text(
+        textwrap.dedent(TRAIN_SCRIPT)
+        .replace("__REPO__", repr(REPO))
+        .replace("__EPOCHS__", str(epochs))
+    )
+    return [sys.executable, str(path)]
+
+
+def _journal(log):
+    with open(log) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _port_base(n):
+    """A window of n consecutive free loopback ports (member exporters
+    bind HVT_METRICS_PORT + local rank)."""
+    for base in range(30850, 60000, 43):
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port window")
+
+
+def _env(tmp_path, model_dir, base):
+    return {
+        "HVT_PLATFORM": "cpu",
+        "HVT_NUM_CPU_DEVICES": "1",
+        "PS_MODEL_PATH": str(model_dir),
+        # The recurring straggler: rank 1, epoch 0 onward, +200 ms per
+        # step — no stamp, the fault never "spends".
+        "HVT_FAULT": "1:0:slow:200",
+        # The sensing tier: member exporters at base + local rank, the
+        # step-phase sampler (and so the SkewProbe allgather) every 2
+        # optimizer steps = every epoch here, fleet poller at 0.2 s.
+        "HVT_METRICS_PORT": str(base),
+        "HVT_METRICS_EVERY": "2",
+        "HVT_FLEET_POLL_S": "0.2",
+        # Chaos children stay out of the suite's shared persistent XLA
+        # cache (see test_supervisor_e2e._env for the torn-entry
+        # SEGFAULT).
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
+    }
+
+
+def _policy_config(mode, **over):
+    return PolicyConfig.from_mapping({
+        "mode": mode, "straggler_windows": 2, "straggler_wait_ms": 50,
+        "evict_budget": 1, "cooldown_s": 5, **over,
+    })
+
+
+@pytest.mark.slow
+def test_slow_straggler_evicted_shrinks_and_completes(tmp_path, capfd):
+    """THE acceptance run: the observe→act loop closed over a real
+    fleet. The eviction must spend ZERO restart budget — that is the
+    entire point of acting below the restart rung."""
+    argv = _write_script(tmp_path)
+    model_dir = tmp_path / "models"
+    log = tmp_path / "restarts.jsonl"
+    base = _port_base(3)
+    code = supervisor.supervise_elastic(
+        2, argv, env=_env(tmp_path, model_dir, base),
+        policy=RestartPolicy(max_restarts=4, backoff=0.5,
+                             grace_seconds=10.0),
+        elastic=ElasticPolicy(min_ranks=1, max_ranks=2,
+                              rendezvous_timeout=180.0),
+        model_dir=str(model_dir), log_path=str(log),
+        status_port=base + 2,
+        policy_config=_policy_config("on"),
+    )
+    out = capfd.readouterr().out
+    assert code == 0, out[-4000:]
+
+    records = _journal(log)
+    evicts = [r for r in records if r["name"] == "policy_evict"]
+    assert evicts, out[-4000:]
+    assert evicts[0]["outcome"] == "sigterm"
+    assert evicts[0]["rank"] == 1  # the fault's target, named by vote
+    assert evicts[0]["voters"] >= 2
+    assert any(r["name"] == "policy_warn" and r["rank"] == 1
+               for r in records)
+    # The evictee left CLEANLY and the world shrank in place.
+    assert any(r["name"] == "shrink" and r["size"] == 1 for r in records)
+    # Restart budget unspent: the rescue was an eviction, not a restart.
+    assert not [r for r in records if r["name"] == "restarts"]
+    assert not [r for r in records if r["name"] == "supervisor_gave_up"]
+
+    # Training completed (the survivor ran every epoch) with the loss
+    # gate green — the mnist-policy-2proc.yaml `checks:` contract.
+    assert "TRAINING COMPLETE" in out
+    statuses = [
+        int(m.group(1))
+        for m in re.finditer(r"STATUS epoch=(\d+)", out)
+    ]
+    assert statuses and max(statuses) == EPOCHS
+    ok, value = ci_gate.check_metrics(
+        os.path.join(str(model_dir), "metrics.jsonl"),
+        "loss", (0.0, 0.3), how="last",
+    )
+    assert ok, f"final loss {value} outside the gate"
+    # Some epoch actually trained at the shrunken size.
+    assert re.search(r"STATUS epoch=\d+ step=\d+ rank=0 size=1", out)
+
+
+@pytest.mark.slow
+def test_dry_run_journals_decision_without_evicting(tmp_path, capfd):
+    """HVT_POLICY=dry-run: the identical decision lands in the journal
+    (budget charged, rank named) but the fleet is untouched — both
+    ranks run every epoch at size 2."""
+    epochs = 10
+    argv = _write_script(tmp_path, epochs=epochs)
+    model_dir = tmp_path / "models"
+    log = tmp_path / "restarts.jsonl"
+    base = _port_base(3)
+    code = supervisor.supervise_elastic(
+        2, argv, env=_env(tmp_path, model_dir, base),
+        policy=RestartPolicy(max_restarts=4, backoff=0.5,
+                             grace_seconds=10.0),
+        elastic=ElasticPolicy(min_ranks=1, max_ranks=2,
+                              rendezvous_timeout=180.0),
+        model_dir=str(model_dir), log_path=str(log),
+        status_port=base + 2,
+        policy_config=_policy_config("dry-run"),
+    )
+    out = capfd.readouterr().out
+    assert code == 0, out[-4000:]
+    records = _journal(log)
+    evicts = [r for r in records if r["name"] == "policy_evict"]
+    assert evicts, out[-4000:]
+    assert evicts[0]["outcome"] == "dry-run"
+    assert evicts[0]["rank"] == 1
+    assert evicts[0]["mode"] == "dry-run"
+    # Nothing acted: no shrink, no restarts, the straggler ran to the
+    # end at full size.
+    assert not [r for r in records if r["name"] == "shrink"]
+    assert not [r for r in records if r["name"] == "restarts"]
+    statuses = [
+        (int(m.group(1)), int(m.group(2)))
+        for m in re.finditer(r"STATUS epoch=(\d+) .*size=(\d+)", out)
+    ]
+    assert statuses and max(e for e, _ in statuses) == epochs
+    assert all(s == 2 for _, s in statuses), statuses
+    assert out.count("TRAINING COMPLETE") == 2
+
+
+@pytest.mark.slow
+def test_spare_promotion_preserves_world_size(tmp_path, capfd):
+    """``spares=1``: three processes launch, one parks at the full
+    world's door; the straggler eviction frees its slot and the spare
+    joins — world size is PRESERVED instead of shrunk, still without a
+    restart-budget spend."""
+    argv = _write_script(tmp_path)
+    model_dir = tmp_path / "models"
+    log = tmp_path / "restarts.jsonl"
+    base = _port_base(4)
+    code = supervisor.supervise_elastic(
+        2, argv, env=_env(tmp_path, model_dir, base),
+        policy=RestartPolicy(max_restarts=4, backoff=0.5,
+                             grace_seconds=10.0),
+        elastic=ElasticPolicy(min_ranks=1, max_ranks=2,
+                              rendezvous_timeout=180.0),
+        model_dir=str(model_dir), log_path=str(log),
+        status_port=base + 3,
+        policy_config=_policy_config("on", spares=1),
+    )
+    out = capfd.readouterr().out
+    assert code == 0, out[-4000:]
+    records = _journal(log)
+    evicts = [r for r in records if r["name"] == "policy_evict"]
+    assert evicts and evicts[0]["outcome"] == "sigterm", out[-4000:]
+    promotes = [r for r in records if r["name"] == "policy_promote"]
+    assert promotes and promotes[0]["outcome"] == "released"
+    assert promotes[0]["spares"] >= 1
+    # The freed slot was refilled: a settle at FULL size after the
+    # eviction decision.
+    evict_at = records.index(evicts[0])
+    assert any(
+        r["name"] in ("grow", "steady") and r.get("size") == 2
+        for r in records[evict_at:]
+    ), [r["name"] for r in records]
+    # Still zero restart-budget spend: the spare was a warm standby,
+    # not a respawn.
+    assert not [r for r in records if r["name"] == "restarts"]
+    # Three boots exactly: 2 members + 1 spare; nobody was respawned.
+    boots = re.findall(r"BOOT member=(\S+)", out)
+    assert len(set(boots)) == 3, boots
+    assert "TRAINING COMPLETE" in out
+
+
+@pytest.mark.slow
+def test_reorder_hang_triage_journaled_before_relaunch(tmp_path, capfd):
+    """The hang auto-triage leg: rank 0 reorders its collective
+    submissions and wedges; the supervisor collects the flight records,
+    and the engine journals the `hvt-sched replay` first-divergence
+    verdict (``policy_triage``) BEFORE the relaunch decision — a
+    ``reorder`` hang is diagnosed, not just restarted."""
+    from tests.test_supervisor import write_train_script
+
+    argv = write_train_script(tmp_path)
+    model_dir = tmp_path / "models"
+    flight_dir = tmp_path / "flight"
+    log = tmp_path / "restarts.jsonl"
+    env = {
+        "HVT_PLATFORM": "cpu",
+        "HVT_NUM_CPU_DEVICES": "2",
+        "PS_MODEL_PATH": str(model_dir),
+        "DRIVE_EPOCHS": "2",
+        "HVT_FAULT": "0:1:reorder",
+        "HVT_FAULT_STAMP": str(tmp_path / "fault-stamp"),
+        "HVT_FLIGHT_RECORD": str(flight_dir),
+        # The engine rides the supervise loop via the env knob — the
+        # whole-fleet mode has no actuator, so dry-run IS the mode.
+        "HVT_POLICY": "dry-run",
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
+    }
+    code = supervisor.supervise_local(
+        2, argv, env=env,
+        policy=RestartPolicy(
+            max_restarts=4, backoff=0.0, grace_seconds=5.0,
+            heartbeat_timeout=20.0,
+        ),
+        model_dir=str(model_dir), log_path=str(log),
+        sleep=lambda s: None,
+    )
+    assert code == 0, capfd.readouterr().out[-4000:]
+    records = _journal(log)
+    names = [r["name"] for r in records]
+    triage = [r for r in records if r["name"] == "policy_triage"]
+    assert triage, names
+    # The verdict names the seeded divergence...
+    assert triage[0]["outcome"] == "diverged"
+    assert triage[0]["kind"] == "mismatch"
+    assert {triage[0]["member_a"], triage[0]["member_b"]} == {
+        "rank0", "rank1"
+    }
+    assert triage[0]["op_a"] != triage[0]["op_b"]
+    # ... and lands BEFORE the relaunch decision for that hang.
+    hang_restart_at = next(
+        i for i, r in enumerate(records)
+        if r["name"] == "restarts" and r["kind"] == "hang"
+    )
+    assert records.index(triage[0]) < hang_restart_at
+    # The collection the verdict was computed over is the journaled one.
+    dumps = [r for r in records if r["name"] == "flight_dump"]
+    assert dumps and triage[0]["dir"] == dumps[0]["dir"]
+
+
+class TestShippedPolicyJobSpec:
+    """mnist-policy-2proc.yaml parses through the same validators the
+    launch path uses (tier-1 — the slow run above proves the scenario
+    itself against the synthetic trainer)."""
+
+    def _spec(self):
+        import yaml
+
+        path = os.path.join(
+            REPO, "horovod_tpu", "launch", "jobs",
+            "mnist-policy-2proc.yaml",
+        )
+        with open(path) as f:
+            return yaml.safe_load(f)
+
+    def test_spec_validates_clean(self):
+        from horovod_tpu.launch import job as job_mod
+
+        assert job_mod.validate_spec(self._spec()) == []
+
+    def test_blocks_carry_the_scenario(self):
+        from horovod_tpu.testing import faults
+
+        spec = self._spec()
+        pcfg = PolicyConfig.from_mapping(spec["job"]["policy"])
+        assert pcfg.mode == "on" and pcfg.active
+        assert pcfg.evict_budget == 1
+        plan = faults.parse_plan(spec["job"]["env"]["HVT_FAULT"])
+        assert plan.rank == 1 and plan.slow_ms == 200.0
+        # The gates encode the acceptance: an eviction happened, the
+        # world shrank, the restart budget was untouched, loss landed.
+        assert spec["journal_checks"]["policy_evict"]["target"] == "1..9"
+        assert spec["journal_checks"]["shrink"]["target"] == "1..9"
+        assert spec["metrics_checks"]["hvt_restarts_total"][
+            "target"] == "0..0"
+        assert "loss" in spec["checks"]

@@ -39,7 +39,8 @@ class TestClassification:
     def test_exit_codes(self):
         assert supervisor.classify(1) == "crash"
         assert supervisor.classify(7) == "crash"
-        assert supervisor.classify(-9) == "crash"       # SIGKILL death
+        assert supervisor.classify(-9) == "oom-kill"     # SIGKILL death
+        assert supervisor.classify(137) == "oom-kill"    # 128+SIGKILL
         assert supervisor.classify(143) == "preemption"  # 128+SIGTERM
         assert supervisor.classify(-15) == "preemption"  # raw SIGTERM
         assert supervisor.classify(0, hang=True) == "hang"

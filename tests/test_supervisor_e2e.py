@@ -80,7 +80,7 @@ def test_sigkill_mid_epoch_restart_resume_complete(tmp_path, capfd):
     # exactly.)
     assert len(restarts) >= 1
     assert any(
-        r["kind"] == "crash" and r["exit_code"] == -9  # the SIGKILL death
+        r["kind"] == "oom-kill" and r["exit_code"] == -9  # the SIGKILL death
         for r in restarts
     )
     # The rerun resumed (epoch-1 checkpoint survived the crash) and ran to
