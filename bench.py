@@ -41,8 +41,11 @@ Modes (BENCH_MODEL):
               greedy vs speculative (prompt-lookup draft) on copy prompts —
               exact-output speedup + acceptance rate
   input       host input pipeline A/B: native C++ batch assembly vs Python
-  serve       HTTP serving A/B: coalescing queue vs serialized requests —
-              requests/sec through the real server (launch/serve.py)
+  serve       serving-tier tail-latency A/B: continuous batching vs the
+              legacy coalescing path through the real server
+              (launch/serve.py), same open-loop arrival schedule both
+              legs — TTFT/TPOT p50/p95/p99; exits 1 unless continuous
+              wins p95 TTFT at equal offered load
 
 HVT_PROFILE=<dir> captures a jax.profiler trace of the measured loop.
 """
@@ -1675,102 +1678,157 @@ def bench_spec() -> dict:
 
 
 def bench_serve() -> dict:
-    """HTTP serving A/B: coalescing queue vs serialized requests.
+    """Serving-tier tail-latency A/B: continuous batching vs the legacy
+    coalescing path, at EQUAL offered load.
 
-    Spins up the real server (launch/serve.py) over a small predict
-    bundle, fires BENCH_SERVE_CLIENTS concurrent single-row clients for a
-    fixed request count, and measures requests/sec with the coalescing
-    worker on and off. The device call is the real exported program; the
-    win is shared dispatches (ceil(N/batch) instead of N), which matters
-    exactly when per-call latency (tunnel RTT / dispatch overhead)
-    dominates tiny-model compute.
+    Spins up the real server (launch/serve.py) over a tiny streaming
+    generation bundle and drives the SAME precomputed open-loop arrival
+    schedule through both modes — open-loop (each request fires at its
+    scheduled wall time regardless of completions), because a closed
+    loop lets a slow server throttle its own offered load and hide its
+    queueing tail. Per request, the client measures TTFT (first NDJSON
+    line) and TPOT (per-token decode tail past the first chunk); the
+    report is p50/p95/p99 of both, per mode.
+
+    The offered rate is set to ~2x the legacy path's measured solo
+    throughput: the legacy streaming path serializes every chunk
+    dispatch of every concurrent request through one device lock (K
+    single-row streams = K near-empty dispatches per chunk), so its
+    queue grows and its tail TTFT blows up — while the continuous engine
+    shares each dispatch across up to batch_size live rows and sustains
+    the rate. The gate (`serve_gate_ok`, enforced by main): continuous
+    p95 TTFT must not exceed the coalescing baseline's.
     """
     import tempfile
     import threading
     import urllib.request
 
-    import flax.linen as nn
     import jax
     import numpy as np
 
-    from horovod_tpu import checkpoint
+    from horovod_tpu import serving
     from horovod_tpu.launch.serve import make_server
+    from horovod_tpu.models.transformer import TransformerLM
 
-    batch = int(os.environ.get("BENCH_SERVE_BATCH", 8))
-    dim = int(os.environ.get("BENCH_SERVE_DIM", 64))
-    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
-    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 200))
-
-    class Tiny(nn.Module):
-        @nn.compact
-        def __call__(self, x, train: bool = False):
-            return nn.Dense(10)(nn.relu(nn.Dense(128)(x)))
-
-    model = Tiny()
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 48))
+    batch, t0_len, n_new, chunk = 4, 8, 8, 2
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, dropout=0.0
+    )
     params = model.init(
-        jax.random.PRNGKey(0), np.zeros((batch, dim), np.float32)
+        jax.random.PRNGKey(0), np.zeros((batch, t0_len), np.int32)
     )["params"]
     tmp = tempfile.mkdtemp(prefix="hvt-bench-serve-")
-    bundle = checkpoint.export_serving(
-        tmp,
-        lambda p, x: model.apply({"params": p}, x),
-        params,
-        input_shape=(batch, dim),
+    bundle = serving.export_generate(
+        tmp, model, params, batch_size=batch, prompt_len=t0_len,
+        max_new_tokens=n_new, streaming_chunk=chunk, timestamp="bench",
     )
 
-    def measure(coalesce: bool) -> tuple:
-        srv = make_server(bundle, port=0, coalesce=coalesce)
+    rs = np.random.RandomState(0)
+    prompts = [
+        [int(t) for t in rs.randint(1, 60, size=1 + i % 6)]
+        for i in range(n_requests)
+    ]
+
+    def one_stream(url: str, prompt: list) -> tuple:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({"prompt": [prompt], "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        t_start = time.perf_counter()
+        ttft, n_tok = None, 0
+        with urllib.request.urlopen(req, timeout=300) as r:
+            for line in r:
+                now = time.perf_counter()
+                obj = json.loads(line)
+                if obj.get("error"):
+                    raise RuntimeError(obj["error"])
+                if ttft is None:
+                    ttft = now - t_start
+                if "tokens" in obj and not obj.get("done"):
+                    n_tok += sum(len(x) for x in obj["tokens"])
+        total = time.perf_counter() - t_start
+        # Decode tail per token, past the first chunk (the TTFT edge).
+        tpot = (total - ttft) / max(1, n_tok - chunk)
+        return ttft, tpot
+
+    def pct(values: list, q: float) -> float:
+        return float(np.percentile(np.asarray(values), q))
+
+    def measure(continuous: bool, gap: float) -> dict:
+        srv = make_server(bundle, port=0, continuous=continuous)
         threading.Thread(target=srv.serve_forever, daemon=True).start()
-        url = f"http://127.0.0.1:{srv.server_address[1]}/v1/predict"
-        row = json.dumps(
-            {"input": np.random.RandomState(0).randn(1, dim).tolist()}
-        ).encode()
+        url = f"http://127.0.0.1:{srv.server_address[1]}/v1/generate"
+        for p in prompts[:2]:
+            one_stream(url, p)  # warm the compiled programs
+        results: list = [None] * n_requests
+        t_begin = time.perf_counter() + 0.05
 
-        def one():
-            req = urllib.request.Request(
-                url, data=row, headers={"Content-Type": "application/json"}
-            )
-            with urllib.request.urlopen(req) as r:
-                r.read()
+        def client(i: int) -> None:
+            # Open loop: fire at the SCHEDULED time, late or not.
+            delay = t_begin + i * gap - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            results[i] = one_stream(url, prompts[i])
 
-        one()  # warm the compiled call
-        remaining = [n_requests]
-        lock = threading.Lock()
-
-        def client():
-            while True:
-                with lock:
-                    if remaining[0] <= 0:
-                        return
-                    remaining[0] -= 1
-                one()
-
-        t0 = time.perf_counter()
         threads = [
-            threading.Thread(target=client) for _ in range(n_clients)
+            threading.Thread(target=client, args=(i,))
+            for i in range(n_requests)
         ]
+        t0 = time.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
         elapsed = time.perf_counter() - t0
-        calls = srv.app.stats["device_calls"]
+        engine = getattr(srv.app, "engine", None)
+        calls = (
+            engine.stats()["device_calls_total"]
+            if engine is not None else srv.app.stats["device_calls"]
+        )
+        if engine is not None:
+            engine.stop()
         srv.shutdown()
-        return n_requests / elapsed, calls
+        ttfts = [r[0] for r in results]
+        tpots = [r[1] for r in results]
+        return {
+            "p50_ttft_ms": round(pct(ttfts, 50) * 1e3, 2),
+            "p95_ttft_ms": round(pct(ttfts, 95) * 1e3, 2),
+            "p99_ttft_ms": round(pct(ttfts, 99) * 1e3, 2),
+            "p50_tpot_ms": round(pct(tpots, 50) * 1e3, 3),
+            "p95_tpot_ms": round(pct(tpots, 95) * 1e3, 3),
+            "device_calls": calls,
+            "elapsed_s": round(elapsed, 2),
+        }
 
-    rps_serial, calls_serial = measure(coalesce=False)
-    rps_coalesce, calls_coalesce = measure(coalesce=True)
+    # Calibrate the offered rate off the LEGACY path's solo service time
+    # so the schedule oversubscribes it ~2x on any host.
+    srv = make_server(bundle, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/v1/generate"
+    one_stream(url, prompts[0])  # compile
+    t0 = time.perf_counter()
+    for p in prompts[:4]:
+        one_stream(url, p)
+    solo = (time.perf_counter() - t0) / 4
+    srv.shutdown()
+    gap = solo / 2.0
+
+    coalesce = measure(continuous=False, gap=gap)
+    continuous = measure(continuous=True, gap=gap)
+    gate_ok = continuous["p95_ttft_ms"] <= coalesce["p95_ttft_ms"]
     return {
-        "metric": "serve_requests_per_sec",
-        "value": round(rps_coalesce, 1),
-        "unit": "requests/sec",
-        "serialized_requests_per_sec": round(rps_serial, 1),
-        "speedup": round(rps_coalesce / rps_serial, 2),
-        "device_calls_coalesced": calls_coalesce,
-        "device_calls_serialized": calls_serial,
-        "clients": n_clients,
+        "metric": "serve_p95_ttft_ms",
+        "value": continuous["p95_ttft_ms"],
+        "unit": "ms",
+        "continuous": continuous,
+        "coalescing": coalesce,
+        "offered_rps": round(1.0 / gap, 1),
         "requests": n_requests,
         "batch": batch,
+        "new_tokens": n_new,
+        "serve_gate_ok": gate_ok,
     }
 
 
@@ -1922,6 +1980,18 @@ def main() -> None:
             "at the same K (byte-EQUAL for quantized wires, whose dense "
             "layout is deliberate) "
             f"({result.get('wire_bytes_per_opt_step')})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    if result.get("serve_gate_ok") is False:
+        import sys
+
+        print(
+            "bench: continuous batching LOST to the coalescing baseline "
+            "on tail TTFT at equal offered load "
+            f"(continuous p95 {result.get('continuous', {}).get('p95_ttft_ms')} ms "
+            f"vs coalescing p95 {result.get('coalescing', {}).get('p95_ttft_ms')} ms) "
+            "— per-step admission is not cashing in",
             file=sys.stderr,
         )
         sys.exit(1)

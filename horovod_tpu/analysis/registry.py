@@ -54,7 +54,7 @@ class Knob:
 
 _SUBSYSTEM_ORDER = (
     "runtime", "parallel", "training", "checkpoint", "elastic",
-    "launch", "data", "observability", "testing", "examples",
+    "launch", "serving", "data", "observability", "testing", "examples",
 )
 
 
@@ -177,6 +177,43 @@ KNOBS: dict[str, Knob] = _decl([
          "Warm standby processes the elastic supervisor keeps parked at "
          "rendezvous; an eviction frees a slot and a spare joins the "
          "next generation so world size is preserved."),
+    # --- serving (continuous batching engine + replica fleet) ---------------
+    Knob("HVT_SERVE_MAX_SEQS", "int", 0, "serving",
+         "Continuous batching: max concurrently scheduled sequences per "
+         "replica (decode slots). 0 = the bundle's compiled batch size; "
+         "values above it clamp to the compiled shape."),
+    Knob("HVT_SERVE_BLOCK_TOKENS", "int", 16, "serving",
+         "Paged-KV block granularity in tokens: admission reserves "
+         "ceil((prompt+max_new)/block) blocks for a sequence's whole "
+         "lifetime, so a running sequence can never hit OOM mid-decode."),
+    Knob("HVT_SERVE_KV_BLOCKS", "int", 0, "serving",
+         "Total paged-KV blocks in the admission budget. 0 = auto-size "
+         "to max_seqs full-length sequences (admission then gates purely "
+         "on slots); smaller budgets make the allocator the gate — "
+         "exhaustion queues new sequences and 429s past the queue."),
+    Knob("HVT_SERVE_QUEUE_DEPTH", "int", 64, "serving",
+         "Admission wait-queue depth per replica: sequences past the "
+         "block/slot budget wait here FIFO; a full queue answers 429 "
+         "(AdmissionError) instead of stacking unbounded memory."),
+    Knob("HVT_SERVE_REPLICAS", "int", 2, "serving",
+         "`hvt-launch serve` fleet width: replica server processes "
+         "behind the router (each with its own engine + KV budget)."),
+    Knob("HVT_SERVE_DRAIN_TIMEOUT_S", "float", 30.0, "serving",
+         "Drain budget in seconds: how long a replica waits for in-flight "
+         "requests to finish on SIGTERM, and how long a weight reload "
+         "waits for the engine to empty before refusing the swap."),
+    Knob("HVT_SERVE_SWAP_TIMEOUT_S", "float", 120.0, "serving",
+         "Zero-downtime weight swap budget per replica: router drain + "
+         "reload + health check must fit here or the swap aborts and the "
+         "replica is readmitted on its OLD weights (journaled)."),
+    Knob("HVT_SERVE_AUTOSCALE", "str", "off", "serving",
+         "Fleet autoscale hook: off / dry-run (journal "
+         "policy_scale_up/down without acting) / on (spawn or drain a "
+         "replica). Decisions come from the policy engine's "
+         "ServeAutoscaler over the router's TTFT histogram."),
+    Knob("HVT_SERVE_TTFT_P95_MS", "float", 250.0, "serving",
+         "Autoscale SLO: windowed p95 TTFT (ms) above this for "
+         "consecutive windows scales up; far below (x0.3) scales down."),
     # --- data --------------------------------------------------------------
     Knob("HVT_NO_NATIVE", "flag", False, "data",
          "Disable the native C++ loader; fall back to the pure-python "

@@ -30,6 +30,32 @@ def validate_spec(spec) -> list:
     job = spec.get("job")
     if not isinstance(job, dict):
         return [f"job: must be a mapping, got {job!r}"]
+    if "serve" in job:
+        serve = job["serve"] or {}
+        if not isinstance(serve, dict):
+            errors.append(f"job serve: must be a mapping, got {serve!r}")
+        else:
+            unknown = set(serve) - {
+                "bundle", "demo", "replicas", "requests", "swap",
+                "coalesce", "journal", "port", "host",
+            }
+            if unknown:
+                errors.append(
+                    f"job serve: unknown keys {sorted(unknown)}"
+                )
+            if not (serve.get("demo") or serve.get("bundle")):
+                errors.append("job serve: needs bundle: or demo: true")
+        if job.get("command"):
+            errors.append(
+                "job serve: replaces command: — a serve job IS the fleet"
+            )
+        for key in ("restart", "elastic", "policy"):
+            if key in job:
+                errors.append(
+                    f"job serve: conflicts with {key}: (the fleet "
+                    "supervises its own replicas)"
+                )
+        return errors
     if not job.get("command"):
         errors.append("job command: is required")
 
@@ -74,8 +100,10 @@ def run_job(spec_path: str) -> int:
         return 1
 
     job = spec.get("job", {})
-    command = job["command"]
-    argv = command if isinstance(command, list) else shlex.split(command)
+    command = job.get("command")
+    argv = (
+        command if isinstance(command, list) else shlex.split(command)
+    ) if command else []
     env = {str(k): str(v) for k, v in (job.get("env") or {}).items()}
 
     checks = spec.get("checks") or {}
@@ -190,7 +218,49 @@ def run_job(spec_path: str) -> int:
         # validate_spec already dry-built this mapping; a failure here
         # would be a programming error, not a user one.
         pcfg = policy_lib.PolicyConfig.from_mapping(job["policy"] or {})
-    if "elastic" in job:
+    # `serve:` block — a serving-fleet job (serving/fleet.py): N
+    # continuous-batching replicas behind one router, smoke traffic, an
+    # optional zero-downtime weight swap mid-load. The fleet journals to
+    # the restart-journal grammar and dumps its router registry to
+    # metrics.prom at stop, so `journal_checks:` and `metrics_checks:`
+    # gate it exactly like a supervised training job:
+    #   serve:
+    #     demo: true        # self-export a tiny streaming bundle
+    #     # bundle: path    # ... or serve this exported bundle
+    #     replicas: 2
+    #     requests: 40      # drive N requests through the router
+    #     swap: true        # weight-swap mid-traffic (demo re-exports)
+    #     # journal: path   # default $PS_MODEL_PATH/restarts.jsonl
+    if "serve" in job:
+        from horovod_tpu.launch import supervisor
+        from horovod_tpu.serving import fleet as serve_fleet
+
+        serve = job["serve"] or {}
+        log_path = serve.get("journal") or supervisor.default_log_path(env)
+        if not log_path:
+            print("job serve: needs journal: or env PS_MODEL_PATH "
+                  "(the journal is the job's gateable output)")
+            return 1
+        _reset_journal(log_path, supervisor.default_model_dir(env))
+        # The fleet reads knobs and spawns replica subprocesses from
+        # THIS process's environment — a serve job is always local.
+        os.environ.update(env)
+        serve_argv = ["--replicas", str(serve.get("replicas", 2)),
+                      "--journal", log_path,
+                      "--port", str(serve.get("port", 0)),
+                      "--host", str(serve.get("host", "127.0.0.1"))]
+        if serve.get("demo"):
+            serve_argv.append("--demo")
+        else:
+            serve_argv.insert(0, str(serve["bundle"]))
+        if serve.get("requests"):
+            serve_argv += ["--requests", str(serve["requests"])]
+        if serve.get("swap"):
+            serve_argv.append("--swap")
+        if serve.get("coalesce"):
+            serve_argv.append("--coalesce")
+        code = serve_fleet.main(serve_argv)
+    elif "elastic" in job:
         elastic_map = job["elastic"] or {}
         if not isinstance(elastic_map, dict):
             print(f"job elastic: must be a mapping, got {elastic_map!r}")

@@ -266,6 +266,14 @@ def main(argv: list[str] | None = None) -> int:
 
         return lint_main(argv[1:] + (["--"] + command if command else []))
 
+    if argv and argv[0] == "serve":
+        # Same delegation: the serving fleet owns its flags (see
+        # `python -m horovod_tpu.serving.fleet --help`) — replica count,
+        # router port, journal, --swap/--requests smoke harness.
+        from horovod_tpu.serving.fleet import main as serve_main
+
+        return serve_main(argv[1:])
+
     parser = argparse.ArgumentParser(prog="python -m horovod_tpu.launch")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -369,6 +377,11 @@ def main(argv: list[str] | None = None) -> int:
         "lint",
         help="hvt-lint: distributed-correctness static analysis "
         "(see `hvt-lint --help`)")
+    sub.add_parser(
+        "serve",
+        help="elastic serving fleet: N continuous-batching replicas "
+        "behind one router, zero-downtime weight swaps "
+        "(see `python -m horovod_tpu.serving.fleet --help`)")
 
     args = parser.parse_args(argv)
     if args.cmd in ("run", "pod") and not command:

@@ -348,6 +348,12 @@ class PolicyEngine:
         if spares and outcome == "sigterm":
             self._record("promote", "released", rank=rank, spares=spares)
 
+    def serve_autoscaler(self) -> "ServeAutoscaler":
+        """The serving-tier rung: an autoscaler sharing this engine's
+        mode/cooldown discipline (the `ServeFleet` autoscale thread
+        constructs one directly when it runs without a PolicyEngine)."""
+        return ServeAutoscaler(cooldown_s=self.config.cooldown_s)
+
     def on_hang(self, dump_dir: str | None) -> dict | None:
         """Auto-triage one quarantined hang collection: run the
         `hvt-sched replay` cross-check over ``dump_dir`` and journal the
@@ -365,3 +371,139 @@ class PolicyEngine:
         fields = {k: v for k, v in verdict.items() if k != "status"}
         self._record("triage", verdict["status"], dir=dump_dir, **fields)
         return verdict
+
+
+# --- serving-tier autoscaling (the ServeFleet hook) -------------------------
+
+_TTFT_COUNT = "hvt_serve_ttft_seconds_count"
+_TTFT_BUCKET = "hvt_serve_ttft_seconds_bucket"
+
+
+def histogram_quantile(series: dict, name: str, q: float,
+                       window_floor: dict | None = None) -> float | None:
+    """Prometheus-style ``histogram_quantile`` over one parsed exposition
+    (`obs_prom.parse_text` output): linear interpolation inside the
+    winning cumulative bucket, the standard over-estimate for ``+Inf``
+    (the last finite edge). ``window_floor``: per-``le`` counts to
+    SUBTRACT first — pass the previous scrape's buckets to get the
+    quantile of just the window between two scrapes (counters only grow,
+    so lifetime buckets would let the fleet's good first hour mask a bad
+    last minute). Returns None with no observations."""
+    prefix = f"{name}_bucket{{le=\""
+    edges: list[tuple[float, float]] = []
+    for key, value in series.items():
+        if not key.startswith(prefix):
+            continue
+        le = key[len(prefix):-2]
+        edge = float("inf") if le == "+Inf" else float(le)
+        value -= (window_floor or {}).get(edge, 0.0)
+        edges.append((edge, value))
+    if not edges:
+        return None
+    edges.sort()
+    total = edges[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_edge, prev_cum = 0.0, 0.0
+    for edge, cum in edges:
+        if cum >= target:
+            if edge == float("inf"):
+                return prev_edge  # the standard +Inf clamp
+            span = cum - prev_cum
+            if span <= 0:
+                return edge
+            return prev_edge + (edge - prev_edge) * (
+                (target - prev_cum) / span
+            )
+        prev_edge, prev_cum = edge, cum
+    return edges[-1][0]
+
+
+class ServeAutoscaler:
+    """TTFT-driven scale decision over the serving router's exposition.
+
+    The same shape as `StragglerDetector`: a pure state machine whose
+    `observe` takes one parsed exposition (`obs_prom.parse_text` of the
+    router registry — the tier-level TTFT histogram every request
+    crosses) and returns ``"up"``, ``"down"``, or None. Discipline
+    ported from the training-side ladder:
+
+    * **freshness gate** — a window only opens when
+      ``hvt_serve_ttft_seconds_count`` ADVANCED since the last one
+      (idle fleets neither scale up on stale tails nor scale down to
+      zero on no evidence);
+    * **windowed quantile** — p95 is computed over just the requests
+      since the previous window (bucket deltas), not lifetime counts;
+    * **streak** — ``streak_windows`` consecutive breaches (p95 above
+      ``ttft_p95_ms``) scale up; the same streak of p95 under
+      ``ttft_p95_ms * down_factor`` scales down;
+    * **cooldown** — ``cooldown_s`` between decisions either way.
+
+    Thresholds default from the ``HVT_SERVE_TTFT_P95_MS`` knob; the
+    caller (`serving.fleet.ServeFleet`) journals every decision as
+    ``policy_scale_up`` / ``policy_scale_down`` and owns the actuators.
+    """
+
+    def __init__(self, ttft_p95_ms: float | None = None,
+                 streak_windows: int = 3, down_factor: float = 0.3,
+                 cooldown_s: float = 30.0, clock=time.monotonic):
+        if ttft_p95_ms is None:
+            ttft_p95_ms = registry.get_float("HVT_SERVE_TTFT_P95_MS")
+        self.ttft_p95_ms = ttft_p95_ms
+        self.streak_windows = streak_windows
+        self.down_factor = down_factor
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._count: float | None = None
+        self._buckets: dict = {}
+        self.up_streak = 0
+        self.down_streak = 0
+        self._last_action_at: float | None = None
+        self.last_p95_ms: float | None = None
+
+    def _bucket_counts(self, series: dict) -> dict:
+        prefix = f"{_TTFT_BUCKET}{{le=\""
+        out = {}
+        for key, value in series.items():
+            if key.startswith(prefix):
+                le = key[len(prefix):-2]
+                out[float("inf") if le == "+Inf" else float(le)] = value
+        return out
+
+    def observe(self, series: dict) -> str | None:
+        count = series.get(_TTFT_COUNT)
+        if count is None or count == self._count:
+            return None  # no fresh evidence — not a window
+        floor = self._buckets if self._count is not None else None
+        self._count = count
+        self._buckets = self._bucket_counts(series)
+        p95 = histogram_quantile(
+            series, "hvt_serve_ttft_seconds", 0.95, window_floor=floor
+        )
+        if p95 is None:
+            return None
+        self.last_p95_ms = p95 * 1000.0
+        if self.last_p95_ms > self.ttft_p95_ms:
+            self.up_streak += 1
+            self.down_streak = 0
+        elif self.last_p95_ms < self.ttft_p95_ms * self.down_factor:
+            self.down_streak += 1
+            self.up_streak = 0
+        else:
+            self.up_streak = self.down_streak = 0
+        now = self._clock()
+        if (
+            self._last_action_at is not None
+            and now - self._last_action_at < self.cooldown_s
+        ):
+            return None  # cooling down; streaks keep accumulating
+        if self.up_streak >= self.streak_windows:
+            self._last_action_at = now
+            self.up_streak = 0
+            return "up"
+        if self.down_streak >= self.streak_windows:
+            self._last_action_at = now
+            self.down_streak = 0
+            return "down"
+        return None

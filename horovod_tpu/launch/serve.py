@@ -148,14 +148,28 @@ class _Batcher:
                 )
         return out
 
+    def stop(self):
+        """Retire the worker (weight reload rebuilds the batcher —
+        the old worker must not keep draining the dead queue)."""
+        self.q.put(_Batcher._STOP)
+
+    _STOP = object()
+
     def _loop(self):
         while True:
-            group = [self.q.get()]
+            first = self.q.get()
+            if first is _Batcher._STOP:
+                return
+            group = [first]
             while len(group) < self.batch:
                 try:
-                    group.append(self.q.get_nowait())
+                    item = self.q.get_nowait()
                 except queue_lib.Empty:
                     break
+                if item is _Batcher._STOP:
+                    self.q.put(item)  # honor it after this group
+                    break
+                group.append(item)
             self.stats["device_calls"] += 1
             self.stats["rows"] += len(group)
             started = (time.time(), time.perf_counter())
@@ -227,17 +241,37 @@ class _ModelApp:
 
 
 class _GenerateApp:
-    """A generation bundle behind the coalescing worker.
+    """A generation bundle behind the coalescing worker — or, with
+    ``continuous=True``, behind the per-decode-step scheduler
+    (`horovod_tpu.serving.engine.ContinuousBatchingEngine`).
 
-    Greedy bundles (temperature == 0: the rng is dead code in the exported
-    program) coalesce rows across concurrent requests exactly like predict
-    bundles. Sampled bundles serialize whole requests: the rng seed is a
-    per-CALL input, so rows from different seeds cannot share a dispatch.
+    Coalescing (the default): greedy bundles (temperature == 0: the rng
+    is dead code in the exported program) coalesce rows across concurrent
+    requests exactly like predict bundles; sampled bundles serialize
+    whole requests. Continuous (streaming bundles only): every request
+    row is an independently scheduled sequence — admitted into free
+    decode capacity mid-flight, retired the chunk it finishes, refused
+    with 429 (`AdmissionError`) when the paged-KV wait queue is full.
+    Sized by the ``HVT_SERVE_MAX_SEQS`` / ``HVT_SERVE_BLOCK_TOKENS`` /
+    ``HVT_SERVE_KV_BLOCKS`` / ``HVT_SERVE_QUEUE_DEPTH`` knobs.
     """
 
     kind = "generate"
+    # Class-level defaults so partially-constructed instances (tests
+    # stub the app without running _load) take the legacy path.
+    engine = None
+    continuous = False
 
-    def __init__(self, bundle_dir: str, coalesce: bool = True):
+    def __init__(self, bundle_dir: str, coalesce: bool = True,
+                 continuous: bool = False):
+        self.continuous = continuous
+        self._coalesce = coalesce
+        self._lock = threading.Lock()
+        self._load(bundle_dir)
+
+    def _load(self, bundle_dir: str) -> None:
+        """(Re)build the app around ``bundle_dir`` — the boot path AND
+        the ``/admin/reload`` weight-swap target."""
         from horovod_tpu import serving
 
         self.bundle_dir = bundle_dir
@@ -253,8 +287,23 @@ class _GenerateApp:
             "meta": self.bundle.meta,
         }
         self.stats = {"device_calls": 0, "rows": 0}
+        if getattr(self, "_batcher", None) is not None:
+            self._batcher.stop()  # reload: retire the old worker
+        if self.continuous:
+            from horovod_tpu.analysis import registry as knobs
+            from horovod_tpu.serving.engine import ContinuousBatchingEngine
+
+            self.engine = ContinuousBatchingEngine(
+                self.bundle,
+                max_seqs=knobs.get_int("HVT_SERVE_MAX_SEQS"),
+                block_tokens=knobs.get_int("HVT_SERVE_BLOCK_TOKENS"),
+                kv_blocks=knobs.get_int("HVT_SERVE_KV_BLOCKS"),
+                queue_depth=knobs.get_int("HVT_SERVE_QUEUE_DEPTH"),
+            )
+            self._batcher = None
+            return
+        self.engine = None
         greedy = float(self.bundle.meta.get("temperature", 0.0)) == 0.0
-        self._lock = threading.Lock()
         # The batcher's dispatches take the SAME lock the sampled and
         # streaming paths use, so the compiled programs never run
         # re-entrantly whatever mix of request kinds is in flight.
@@ -264,8 +313,31 @@ class _GenerateApp:
                 self.bundle.batch_size,
                 self.stats,
             )
-            if (coalesce and greedy) else None
+            if (self._coalesce and greedy) else None
         )
+
+    def reload(self, bundle_dir: str) -> None:
+        """Swap weights in place: drain the engine (continuous) or hold
+        the device lock (coalescing) while the new bundle loads. The
+        fleet drains this replica at the ROUTER first, so by the time
+        reload arrives nothing should be in flight — the engine drain
+        here is the belt to that suspender."""
+        from horovod_tpu.analysis import registry as knobs
+
+        if self.engine is not None:
+            timeout = knobs.get_float("HVT_SERVE_DRAIN_TIMEOUT_S")
+            if not self.engine.drain(timeout):
+                raise RuntimeError(
+                    f"engine still busy after {timeout}s drain — refusing "
+                    "to swap weights under live sequences"
+                )
+            self.engine.stop()
+            self._load(bundle_dir)
+            return
+        with self._lock:
+            # Coalescing path: the lock serializes against every
+            # dispatch; requests queued behind it resume on new weights.
+            self._load(bundle_dir)
 
     def _locked_generate_batch(self, rows: list) -> list:
         with self._lock:
@@ -297,7 +369,23 @@ class _GenerateApp:
         from horovod_tpu import trace as trace_lib
 
         seed = int(payload.get("seed", 0))
-        prompts = self._payload_prompts(payload)
+        # Validate BEFORE any slot/lock/submit: a request that can never
+        # run must be rejected at the door, not after it holds device
+        # capacity (the head-of-line accounting fix — previously the
+        # first dispatch validated inside the device lock).
+        prompts = self.bundle.validate_prompts(
+            self._payload_prompts(payload)
+        )
+        if not prompts:
+            raise ValueError("need at least one prompt")
+        if self.engine is not None:
+            yield from self._engine_stream(prompts)
+            return
+        if len(prompts) > self.bundle.batch_size:
+            raise ValueError(
+                f"streaming takes 1..{self.bundle.batch_size} prompts "
+                f"per request, got {len(prompts)}"
+            )
         rows = [[] for _ in prompts]
         it = self.bundle.stream_chunks(prompts, seed=seed)
         while True:
@@ -327,20 +415,54 @@ class _GenerateApp:
             ]
         yield final
 
+    def _engine_stream(self, prompts: list):
+        """Continuous streaming: each prompt row is its own scheduled
+        sequence. Single-row requests keep the legacy NDJSON schema
+        exactly; multi-row requests tag each chunk line with its
+        ``row`` (rows finish independently under the scheduler, so
+        chunks cannot be zipped across rows the way one compiled
+        dispatch used to guarantee)."""
+        reqs = [self.engine.submit(p, stream=True) for p in prompts]
+        multi = len(reqs) > 1
+        for i, r in enumerate(reqs):
+            for piece in r.iter_chunks():
+                line = {"tokens": [piece]}
+                if multi:
+                    line["row"] = i
+                yield line
+        self.stats["rows"] += len(prompts)
+        trimmed = [r.tokens for r in reqs]
+        final = {"done": True, "tokens": trimmed}
+        if self.bundle.tokenizer is not None:
+            final["text"] = [
+                self.bundle.tokenizer.decode(g) for g in trimmed
+            ]
+        yield final
+
     def generate(self, payload: dict) -> dict:
         from horovod_tpu import trace as trace_lib
 
         seed = int(payload.get("seed", 0))
-        # Tokenize OUTSIDE the lock — only the compiled call needs
-        # serializing through the device; CPU encode/decode of one request
-        # must not block another's device run.
-        prompts = self._payload_prompts(payload)
-        if self._batcher is not None:
-            # Validate on the handler thread; rows coalesce across
-            # requests (greedy: the seed is dead code in the program).
-            # The batcher emits this request's queue_wait/decode spans.
-            rows = self.bundle.validate_prompts(prompts)
-            tokens = self._batcher.submit(rows) if rows else []
+        # Tokenize and validate OUTSIDE the lock — only the compiled
+        # call needs serializing through the device, and a request that
+        # fails validation must be 400'd BEFORE it occupies a batch slot
+        # or bumps the dispatch accounting (the head-of-line fix: the
+        # sampled path used to count device_calls/rows and take the
+        # device lock first, then discover the prompts were invalid).
+        prompts = self.bundle.validate_prompts(
+            self._payload_prompts(payload)
+        )
+        if self.engine is not None:
+            # Continuous scheduling: every row an independent sequence;
+            # the engine owns dispatch accounting and trace spans.
+            reqs = [self.engine.submit(p) for p in prompts]
+            tokens = [r.result() for r in reqs]
+            self.stats["rows"] += len(prompts)
+        elif self._batcher is not None:
+            # Rows coalesce across requests (greedy: the seed is dead
+            # code in the program). The batcher emits this request's
+            # queue_wait/decode spans.
+            tokens = self._batcher.submit(prompts) if prompts else []
         else:
             t_q, p_q = time.time(), time.perf_counter()
             with self._lock:
@@ -363,19 +485,32 @@ class _GenerateApp:
         return out
 
 
-def _make_app(bundle_dir: str, coalesce: bool = True):
+def _make_app(bundle_dir: str, coalesce: bool = True,
+              continuous: bool = False):
     from horovod_tpu import serving
 
     if serving.is_generate_bundle(bundle_dir):
-        return _GenerateApp(bundle_dir, coalesce=coalesce)
+        return _GenerateApp(bundle_dir, coalesce=coalesce,
+                            continuous=continuous)
+    if continuous:
+        raise ValueError(
+            "continuous batching serves generation bundles only — "
+            f"{bundle_dir} is a predict bundle"
+        )
     return _ModelApp(bundle_dir, coalesce=coalesce)
 
 
 def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
-                coalesce: bool = True, fleet_journal: str | None = None):
+                coalesce: bool = True, fleet_journal: str | None = None,
+                continuous: bool = False, allow_reload: bool = False):
     """Build (but don't start) the HTTP server; ``server.server_address``
     carries the bound port when ``port=0``. ``coalesce=False`` keeps the
-    legacy serialize-whole-requests path (the bench baseline).
+    legacy serialize-whole-requests path (the bench baseline);
+    ``continuous=True`` routes /v1/generate through the per-decode-step
+    scheduler (streaming bundles only; full admissions answer 429).
+    ``allow_reload=True`` mounts ``POST /admin/reload`` (the fleet's
+    zero-downtime weight-swap hook — opt-in, because it lets any client
+    point the server at a new bundle path).
 
     ``fleet_journal``: path to a supervisor restart/rescale journal
     (``restarts.jsonl``); when given, ``GET /healthz`` grows a ``fleet``
@@ -388,11 +523,26 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
     several servers in one process never share instruments): request
     counts by route/code, queue depth (sampled at scrape), device-call /
     row totals, request-latency and TTFT/TPOT histograms."""
-    app = _make_app(bundle_dir, coalesce=coalesce)
+    app = _make_app(bundle_dir, coalesce=coalesce, continuous=continuous)
     reg = obs_core.Registry()
 
     def _collect(r):
         # stats/queue are owned by the app; the scrape mirrors them.
+        engine = getattr(app, "engine", None)
+        if engine is not None:
+            s = engine.stats()
+            r.counter_set(
+                "hvt_serve_device_calls_total", s["device_calls_total"]
+            )
+            r.counter_set("hvt_serve_rows_total", app.stats["rows"])
+            r.counter_set("hvt_serve_admitted_total", s["admitted_total"])
+            r.counter_set("hvt_serve_retired_total", s["retired_total"])
+            r.counter_set("hvt_serve_rejected_total", s["rejected_total"])
+            r.gauge("hvt_serve_live_seqs", s["live_seqs"])
+            r.gauge("hvt_serve_queue_depth", s["queue_depth"])
+            r.gauge("hvt_serve_kv_blocks_used", s["kv_blocks_used"])
+            r.gauge("hvt_serve_kv_blocks_free", s["kv_blocks_free"])
+            return
         r.counter_set(
             "hvt_serve_device_calls_total", app.stats["device_calls"]
         )
@@ -409,7 +559,10 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
     # 0.0.0.0 by default, and labeling by the raw client-supplied path
     # would let any scanner mint unbounded (route, code) series — a
     # memory leak and scrape-payload blowup driven by untrusted input.
-    _KNOWN_ROUTES = ("/healthz", "/metrics", "/v1/predict", "/v1/generate")
+    _KNOWN_ROUTES = ("/healthz", "/metrics", "/v1/predict", "/v1/generate",
+                     "/admin/reload")
+    inflight = {"n": 0}
+    inflight_lock = threading.Lock()
 
     def _route(path: str) -> str:
         path = path.split("?", 1)[0]
@@ -435,9 +588,15 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
             if self.path == "/metrics":
                 obs_prom.write_http(self, reg)
             elif self.path == "/healthz":
+                with inflight_lock:
+                    n_inflight = inflight["n"]
                 payload = {"status": "ok", "bundle": app.bundle_dir,
                            "kind": app.kind, "signature": app.signature,
-                           "stats": dict(app.stats)}
+                           "stats": dict(app.stats),
+                           "inflight": n_inflight}
+                engine = getattr(app, "engine", None)
+                if engine is not None:
+                    payload["scheduler"] = engine.stats()
                 if fleet_journal is not None:
                     from horovod_tpu.launch.supervisor import fleet_status
 
@@ -447,6 +606,9 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/admin/reload":
+                self._handle_reload()
+                return
             route = (app.kind, self.path)
             if route not in (
                 ("predict", "/v1/predict"), ("generate", "/v1/generate")
@@ -464,10 +626,42 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
             # just histograms.
             from horovod_tpu import trace as trace_lib
 
-            with trace_lib.span(
-                "request", req=next(_request_ids), route=_route(self.path)
-            ):
-                self._handle_post()
+            with inflight_lock:
+                inflight["n"] += 1
+            try:
+                with trace_lib.span(
+                    "request", req=next(_request_ids),
+                    route=_route(self.path),
+                ):
+                    self._handle_post()
+            finally:
+                with inflight_lock:
+                    inflight["n"] -= 1
+
+        def _handle_reload(self):
+            """The fleet's weight-swap hook: swap to a new bundle dir in
+            place. Opt-in (``allow_reload``) and mutually journaled by
+            the caller — the server itself only validates and swaps."""
+            if not allow_reload:
+                self._send(
+                    404, {"error": "reload not enabled on this server "
+                          "(--allow-reload)"}
+                )
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length))
+                new_dir = payload["bundle_dir"]
+                if not hasattr(app, "reload"):
+                    raise ValueError(
+                        f"{app.kind} bundles do not support reload"
+                    )
+                app.reload(new_dir)
+                self._send(200, {"ok": True, "bundle": new_dir})
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
         def _handle_post(self):
             t0 = time.perf_counter()
@@ -558,19 +752,69 @@ def make_server(bundle_dir: str, port: int = 0, host: str = "127.0.0.1",
             except Exception as e:  # device/runtime failures -> 5xx JSON,
                 # never a dropped socket (the module's errors-are-JSON
                 # contract; XlaRuntimeError does not subclass ValueError).
-                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                from horovod_tpu.serving import engine as engine_mod
+
+                if isinstance(e, engine_mod.AdmissionError):
+                    # Admission refused (wait queue full behind the paged
+                    # KV budget) is back-pressure, not failure: 429 tells
+                    # the client to retry later, and keeps the zero-500s
+                    # CI gate honest about actual server faults.
+                    self._send(429, {"error": str(e)})
+                else:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
     server = ThreadingHTTPServer((host, port), Handler)
     server.app = app  # tests reach the model through the server handle
     server.metrics_registry = reg  # tests + the --metrics-port exporter
+
+    def _inflight_count() -> int:
+        with inflight_lock:
+            return inflight["n"]
+
+    server.inflight_count = _inflight_count  # the SIGTERM drain barrier
     return server
+
+
+def _join_fleet(coordinator: str, member: str, stop: threading.Event):
+    """Replica-side membership: sync into the rendezvous coordinator,
+    then heartbeat until told to stop. Returns the `ElasticClient` so
+    the SIGTERM path can send an explicit, journaled `leave` (the fleet
+    watchdog treats leave/dead as the drain trigger)."""
+    from horovod_tpu.elastic.coordinator import ElasticClient
+
+    client = ElasticClient(coordinator, member)
+
+    def _beat_loop():
+        try:
+            client.sync()  # blocks until the rendezvous admits us
+        except Exception:
+            return  # coordinator gone before we joined — nothing to beat
+        while not stop.wait(1.0):
+            try:
+                client.beat()
+                if client.last_beat_pending:
+                    # A new generation formed (peer joined/left) — re-sync
+                    # so the coordinator's ledger keeps us 'live'.
+                    client.sync()
+            except Exception:
+                return  # coordinator gone; the fleet owns that story
+    threading.Thread(target=_beat_loop, daemon=True).start()
+    return client
 
 
 def serve_forever(bundle_dir: str, port: int = 8000, host: str = "0.0.0.0",
                   fleet_journal: str | None = None,
-                  metrics_port: int | None = None):
+                  metrics_port: int | None = None,
+                  continuous: bool = False, allow_reload: bool = False,
+                  coordinator: str | None = None,
+                  member: str | None = None):
+    import signal
+
+    from horovod_tpu.analysis import registry as knobs
+
     server = make_server(bundle_dir, port=port, host=host,
-                         fleet_journal=fleet_journal)
+                         fleet_journal=fleet_journal,
+                         continuous=continuous, allow_reload=allow_reload)
     if metrics_port is not None:
         # The same per-server registry on a dedicated scrape port, for
         # deployments that keep the serving port client-facing and the
@@ -581,17 +825,52 @@ def serve_forever(bundle_dir: str, port: int = 8000, host: str = "0.0.0.0",
         obs_server.start_metrics_server(
             metrics_port, registry=server.metrics_registry
         )
+    stop_beats = threading.Event()
+    client = (
+        _join_fleet(coordinator, member or f"serve-{port}", stop_beats)
+        if coordinator else None
+    )
+
+    def _graceful(_signum, _frame):
+        """SIGTERM = drain-then-exit: announce departure to the
+        coordinator FIRST (the router stops dispatching here), finish
+        what is already in flight, then stop accepting. Runs the
+        shutdown from a helper thread — signal handlers run on the main
+        thread, which is inside serve_forever()."""
+        def _drain_and_stop():
+            stop_beats.set()
+            if client is not None:
+                try:
+                    client.leave()
+                except Exception:
+                    pass
+            deadline = time.monotonic() + knobs.get_float(
+                "HVT_SERVE_DRAIN_TIMEOUT_S"
+            )
+            while server.inflight_count() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            engine = getattr(server.app, "engine", None)
+            if engine is not None:
+                engine.drain(max(0.0, deadline - time.monotonic()))
+                engine.stop()
+            server.shutdown()
+        threading.Thread(target=_drain_and_stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
     inputs = server.app.signature["inputs"]
     shape = next(iter(inputs.values()))["shape"]
     print(
         f"serving {bundle_dir} ({server.app.kind}) on "
-        f"http://{host}:{server.server_address[1]} (input {shape})",
+        f"http://{host}:{server.server_address[1]} (input {shape})"
+        + (" [continuous]" if continuous else ""),
         flush=True,
     )
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+    finally:
+        stop_beats.set()
 
 
 def main(argv=None) -> None:
@@ -617,10 +896,34 @@ def main(argv=None) -> None:
         "dedicated port (loopback by default, HVT_STATUS_HOST to "
         "expose); GET /metrics on the main port works regardless",
     )
+    p.add_argument(
+        "--continuous", action="store_true",
+        help="per-decode-step continuous batching (streaming generation "
+        "bundles only): admit/evict at every decode chunk, paged-KV "
+        "admission control, 429 on exhaustion",
+    )
+    p.add_argument(
+        "--allow-reload", action="store_true",
+        help="mount POST /admin/reload (zero-downtime weight swap; the "
+        "fleet drives it during `hvt-launch serve` swaps)",
+    )
+    p.add_argument(
+        "--coordinator", default=None, metavar="HOST:PORT",
+        help="rendezvous coordinator address: join the serving fleet as "
+        "a member (heartbeats + journaled leave on SIGTERM)",
+    )
+    p.add_argument(
+        "--member", default=None, metavar="NAME",
+        help="member name to present to the coordinator "
+        "(default serve-<port>)",
+    )
     args = p.parse_args(argv)
     serve_forever(args.bundle_dir, port=args.port, host=args.host,
                   fleet_journal=args.fleet_journal,
-                  metrics_port=args.metrics_port)
+                  metrics_port=args.metrics_port,
+                  continuous=args.continuous,
+                  allow_reload=args.allow_reload,
+                  coordinator=args.coordinator, member=args.member)
 
 
 if __name__ == "__main__":

@@ -231,6 +231,17 @@ def make_chunked_generate_fns(model, *, max_new_tokens: int, chunk: int,
     to `make_generate_fn`'s for the same knobs (one compiled scan cut at
     chunk boundaries; greedy/sampling/eos semantics unchanged — parity
     tested).
+
+    CONTRACT (load-bearing for `horovod_tpu/serving/decoder.py`): every
+    ``state`` leaf except ``rng`` carries a leading batch axis and each
+    row's trajectory depends only on its own row (ragged lengths make a
+    row generate exactly as if alone) — that per-row independence is
+    what lets the continuous-batching engine admit sequences mid-flight
+    by splicing rows of a fresh ``start`` state into a live state. The
+    ``rng`` leaf (shape [2]) is shared by the whole batch and is NOT
+    spliceable; the engine keeps the live rng and folds an admission
+    counter into each prefill's seed instead. Reordering this tuple or
+    giving rng a batch axis changes that downstream contract.
     """
     if chunk < 1:
         raise ValueError("chunk must be >= 1")

@@ -212,6 +212,43 @@ METRICS: dict[str, MetricSpec] = _decl([
                "tail / generated tokens).", "serving",
                buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                         0.05, 0.1, 0.25, 0.5, 1.0)),
+    # --- serving: continuous batching engine (serving/engine.py) ------------
+    MetricSpec("hvt_serve_admitted_total", "counter",
+               "Sequences the continuous-batching scheduler admitted "
+               "into a decode slot (prefill spliced into the live "
+               "batch).", "serving"),
+    MetricSpec("hvt_serve_retired_total", "counter",
+               "Sequences retired from the live batch (eos or "
+               "generation budget) — their KV blocks returned the same "
+               "tick.", "serving"),
+    MetricSpec("hvt_serve_rejected_total", "counter",
+               "Admissions refused with 429 because the bounded wait "
+               "queue was full (the allocator saying no at the door "
+               "instead of OOMing mid-decode).", "serving"),
+    MetricSpec("hvt_serve_live_seqs", "gauge",
+               "Sequences currently holding a decode slot (sampled at "
+               "scrape time).", "serving"),
+    MetricSpec("hvt_serve_kv_blocks_used", "gauge",
+               "Paged-KV blocks reserved by live + waiting-admitted "
+               "sequences.", "serving"),
+    MetricSpec("hvt_serve_kv_blocks_free", "gauge",
+               "Paged-KV blocks available for admission.", "serving"),
+    # --- serving: replica fleet (serving/router.py, serving/fleet.py) -------
+    MetricSpec("hvt_serve_replicas", "gauge",
+               "Replicas currently admitting traffic at the router "
+               "(draining and dead replicas excluded).", "serving"),
+    MetricSpec("hvt_serve_replica_inflight", "gauge",
+               "Requests in flight per replica (the router's "
+               "least-loaded dispatch key; 0 is the drain barrier).",
+               "serving", labels=("replica",)),
+    MetricSpec("hvt_serve_router_retries_total", "counter",
+               "Requests the router re-dispatched to another replica "
+               "after a connect failure (before any response bytes — "
+               "mid-stream failures surface to the client).", "serving"),
+    MetricSpec("hvt_serve_swaps_total", "counter",
+               "Zero-downtime weight swaps completed across the fleet "
+               "(drain -> swap -> readmit, journaled per replica).",
+               "serving"),
     # --- training (the HVT_METRICS_PORT trainer exporter) -------------------
     MetricSpec("hvt_step_phase_ms", "gauge",
                "Live per-step phase attribution in ms (labels: total / "

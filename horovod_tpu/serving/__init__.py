@@ -1,0 +1,55 @@
+"""The serving tier: export/reload bundles, and the production hot path.
+
+Grown from the single-module ``serving.py`` (whose bundle surface lives
+on unchanged in `serving.bundle` — every ``from horovod_tpu import
+serving; serving.export_generate(...)`` call keeps working) into the
+subsystem the north star asks for ("serves heavy traffic from millions
+of users"):
+
+* `bundle`  — export the compiled decode loop, reload it anywhere (the
+  original module: `export_generate`, `GenerateBundle`, `load_generate`);
+* `blocks`  — the paged KV-cache accounting: fixed-size token blocks, a
+  free-list allocator that refuses admission instead of OOMing, and
+  per-sequence block tables;
+* `decoder` — `ChunkedBundleDecoder`, the row-splice adapter that turns a
+  streaming bundle's two compiled programs (prefill+first-chunk,
+  continue) into an admit/evict-capable step decoder;
+* `engine`  — `ContinuousBatchingEngine`: the per-decode-step scheduler
+  (admit into free capacity, retire finished rows immediately, one
+  device dispatch per step for every live sequence);
+* `router`  — the front-end: per-replica in-flight accounting,
+  least-loaded dispatch, drain/readmit, failover retry;
+* `fleet`   — the elastic replica fleet: rendezvous-coordinated
+  membership, zero-downtime weight swap (drain → swap → readmit,
+  journaled), and the TTFT-driven autoscale hook.
+
+HTTP serving of a single replica stays in `horovod_tpu.launch.serve`;
+`python -m horovod_tpu.serving.fleet` (or ``hvt-launch serve``) runs the
+multi-replica tier.
+"""
+
+from horovod_tpu.serving.bundle import (  # noqa: F401 — the public surface
+    GEN_CONT_FILE,
+    GEN_GRAPH_FILE,
+    GEN_META_FILE,
+    GEN_START_FILE,
+    GEN_WEIGHTS_FILE,
+    TOKENIZER_FILE,
+    GenerateBundle,
+    export_generate,
+    is_generate_bundle,
+    load_generate,
+)
+
+__all__ = [
+    "GEN_CONT_FILE",
+    "GEN_GRAPH_FILE",
+    "GEN_META_FILE",
+    "GEN_START_FILE",
+    "GEN_WEIGHTS_FILE",
+    "TOKENIZER_FILE",
+    "GenerateBundle",
+    "export_generate",
+    "is_generate_bundle",
+    "load_generate",
+]

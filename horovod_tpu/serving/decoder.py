@@ -1,0 +1,137 @@
+"""`ChunkedBundleDecoder`: the row-splice adapter the engine steps.
+
+A streaming bundle carries two compiled programs
+(`models/decoding.make_chunked_generate_fns`):
+
+* ``start(params, prompt [B, T0], rng, lengths [B]) -> (tokens, state)``
+  — prefill + first ``chunk`` tokens;
+* ``cont(params, state) -> (tokens, state)`` — the next ``chunk``
+  tokens against the carried cache.
+
+Both are compiled for ONE static ``[B, T0]`` shape, and the decode state
+is a per-row pytree: ``(cache, last_tok, rng, done)`` where every cache
+leaf, ``last_tok`` and ``done`` carry a leading batch axis. The ragged
+contract (each row generates exactly as if alone at its own length) is
+what makes continuous batching legitimate as ROW SPLICING: to admit a
+sequence mid-flight, run ``start`` on a fresh batch with the new prompts
+in it, then copy the admitted rows' slices of (cache, tok, done) into
+the live state. The live batch never stops; admission costs one prefill
+dispatch, not a drain.
+
+The one leaf that is NOT per-row is the rng (shape ``[2]``, shared by
+the whole batch). Splicing it would corrupt every live row, so the live
+rng is kept as-is and freshness comes from folding a monotone admission
+counter into each prefill's seed. Greedy bundles are bit-exact either
+way; sampled bundles draw valid (per-step fresh) but not
+seed-reproducible-per-request samples — the documented trade of a
+shared-rng compiled program.
+
+Free/retired slots keep computing garbage until the next admission
+overwrites them — harmless (the cache index clamps at the boundary via
+``dynamic_update_slice``) and cheaper than a masked program shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ChunkedBundleDecoder:
+    """Step/splice interface over a streaming `GenerateBundle`.
+
+    The engine owns WHICH rows are live; this class owns HOW a batch of
+    rows advances one chunk and how fresh rows enter a live state. All
+    methods are eager host-side calls around the two jitted programs —
+    no obs/trace here (the engine annotates its own spans).
+    """
+
+    def __init__(self, bundle):
+        chunk = int(bundle.meta.get("streaming_chunk") or 0)
+        if not chunk:
+            raise ValueError(
+                "continuous batching needs a streaming bundle "
+                "(export_generate(..., streaming_chunk=K)) — this bundle "
+                "carries the one-shot program only"
+            )
+        self.bundle = bundle
+        self.chunk = chunk
+        self.batch_size = bundle.batch_size
+        self.prompt_len = bundle.prompt_len
+        self.max_new_tokens = int(bundle.meta["max_new_tokens"])
+        self.total_chunks = self.max_new_tokens // chunk
+        self.eos_id = bundle.meta.get("eos_id")
+        self.pad_id = int(bundle.meta.get("pad_id") or 0)
+        # One fused select program instead of an eager dispatch per
+        # state leaf — eager splices cost more than a decode step and
+        # dominate the tick. The row set rides in as a fixed-shape
+        # (perm, mask) pair so EVERY admission count hits the same
+        # cached executable; a per-row-count scatter would recompile
+        # mid-traffic on the first 2-row, 3-row, ... admission, stalling
+        # the whole live batch behind XLA.
+        self._splice_fn = jax.jit(self._splice_impl)
+
+    def prefill(self, prompts, seed: int, admission: int):
+        """Run the start program with ``prompts`` packed into rows
+        ``0..len(prompts)-1`` of a full batch (pad rows elsewhere).
+        ``admission`` is the engine's monotone admission counter, folded
+        into the seed so consecutive sampled prefills draw fresh streams.
+        Returns ``(tokens [B, chunk] np, fresh_state)``."""
+        b, t0 = self.batch_size, self.prompt_len
+        if not 1 <= len(prompts) <= b:
+            raise ValueError(
+                f"prefill takes 1..{b} prompts, got {len(prompts)}"
+            )
+        padded = np.full((b, t0), self.pad_id, np.int32)
+        lengths = np.ones((b,), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+            lengths[i] = len(p)
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), admission)
+        tokens, state = self.bundle._start(
+            self.bundle._params, padded, rng, lengths
+        )
+        return np.asarray(tokens), state
+
+    def splice(self, live_state, fresh_state, src_rows, dst_rows):
+        """Copy rows ``src_rows`` of ``fresh_state`` into rows
+        ``dst_rows`` of ``live_state`` across every per-row leaf (cache,
+        last_tok, done). The live rng is kept (see module docstring).
+        Returns the new live state."""
+        if len(src_rows) != len(dst_rows):
+            raise ValueError(
+                f"src/dst row counts differ: {src_rows} vs {dst_rows}"
+            )
+        perm = np.zeros((self.batch_size,), np.int32)
+        mask = np.zeros((self.batch_size,), bool)
+        for s, d in zip(src_rows, dst_rows):
+            perm[d] = s
+            mask[d] = True
+        return self._splice_fn(live_state, fresh_state, perm, mask)
+
+    @staticmethod
+    def _splice_impl(live_state, fresh_state, perm, mask):
+        cache_l, tok_l, rng_l, done_l = live_state
+        cache_f, tok_f, _, done_f = fresh_state
+
+        def put(a, b):
+            m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, jnp.take(b, perm, axis=0), a)
+
+        return (
+            jax.tree.map(put, cache_l, cache_f),
+            put(tok_l, tok_f),
+            rng_l,
+            put(done_l, done_f),
+        )
+
+    def step(self, state):
+        """One cont dispatch: every live row advances ``chunk`` tokens.
+        Returns ``(tokens [B, chunk] np, state)``."""
+        tokens, state = self.bundle._cont(self.bundle._params, state)
+        return np.asarray(tokens), state
+
+    def done_flags(self, state) -> np.ndarray:
+        """Per-row eos-done booleans (all-False when no eos_id)."""
+        return np.asarray(state[3])

@@ -1334,6 +1334,61 @@ class TestHVT009MetricRegistryDiscipline:
         """)
         assert found == []
 
+    def test_serving_tier_names_declared_clean(self):
+        # PR 17: the serving tier's scheduler/router series are declared
+        # in obs/core like every other subsystem — the scrape collectors
+        # and the router's pre-materialized zero-500s series lint clean,
+        # and a typo'd serve series is caught like any other.
+        found = findings_of(MetricRegistryDiscipline, """
+            def collect(reg, s):
+                reg.counter_set("hvt_serve_admitted_total", s["a"])
+                reg.counter_set("hvt_serve_retired_total", s["r"])
+                reg.counter_set("hvt_serve_rejected_total", s["x"])
+                reg.gauge("hvt_serve_live_seqs", s["live"])
+                reg.gauge("hvt_serve_kv_blocks_free", s["free"])
+                reg.gauge("hvt_serve_replica_inflight", 1, replica="r0")
+                reg.histogram("hvt_serve_ttft_seconds", 0.05)
+                reg.counter("hvt_serve_swaps_total")
+        """)
+        assert found == []
+        found = findings_of(MetricRegistryDiscipline, """
+            def collect(reg):
+                reg.gauge("hvt_serve_kv_block_free", 3)  # typo'd: block
+        """)
+        assert len(found) == 1
+        assert "hvt_serve_kv_block_free" in found[0].message
+
+    def test_engine_tick_span_shape_clean_but_not_inside_cont(self):
+        # The continuous-batching engine's tick emits a `decode` span
+        # with a caller-timed `step` child (admitted/evicted attrs) —
+        # legal exactly because both wrap the HOST-side dispatch of the
+        # compiled cont program. The same emit_span moved INSIDE the
+        # compiled body would clock the trace once and freeze.
+        found = findings_of(MetricRegistryDiscipline, """
+            import time
+            from horovod_tpu import trace as trace_lib
+            def tick(decoder, state):
+                with trace_lib.span("decode", rows=2):
+                    t0w, t0p = time.time(), time.perf_counter()
+                    tokens, state = decoder.step(state)
+                    trace_lib.emit_span(
+                        "step", t0w, time.perf_counter() - t0p,
+                        admitted=1, evicted=0, live=2,
+                    )
+                return tokens, state
+        """)
+        assert found == []
+        found = findings_of(MetricRegistryDiscipline, """
+            import jax
+            from horovod_tpu import trace as trace_lib
+            @jax.jit
+            def cont(params, state):
+                trace_lib.emit_span("step", 0.0, 0.1, admitted=1)
+                return state
+        """)
+        assert len(found) == 1
+        assert "emit_span" in found[0].message
+
     def test_noqa_suppresses(self, tmp_path):
         res = lint_tree(tmp_path, {
             "pkg/mod.py": """

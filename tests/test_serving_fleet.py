@@ -1,0 +1,90 @@
+"""Slow-lane e2e for the elastic serving fleet: 2 continuous-batching
+replicas behind the router, SIGTERM lands on one MID-TRAFFIC, and the
+contract under test is the operator story — the dying replica announces
+a clean `leave` to the coordinator, the router drains it (in-flight
+requests finish, nothing new lands), every driven request succeeds, and
+the journal records the departure as a leave, not a crash."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.serving import fleet as fleet_mod
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def demo_bundle(tmp_path_factory):
+    return fleet_mod._export_demo_bundle(
+        str(tmp_path_factory.mktemp("serve-fleet-bundle"))
+    )
+
+
+def _journal_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_sigterm_mid_traffic_drains_cleanly(demo_bundle, tmp_path):
+    journal = str(tmp_path / "restarts.jsonl")
+    fleet = fleet_mod.ServeFleet(
+        demo_bundle, replicas=2, log_path=journal,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    ).start()
+    try:
+        out = {}
+
+        def load():
+            out["result"] = fleet_mod._drive_load(
+                fleet.router_url, 30, n_threads=4
+            )
+
+        t = threading.Thread(target=load)
+        t.start()
+        # Let traffic establish, then kill one replica under it.
+        time.sleep(1.0)
+        victim = fleet.replicas["serve-0"]
+        victim.proc.send_signal(signal.SIGTERM)
+        t.join(timeout=180)
+        assert not t.is_alive(), "load generator wedged"
+        ok, failed, failures = out["result"]
+        assert failed == 0, f"requests failed through the drain: {failures}"
+        assert ok == 30
+
+        # The replica exited on its own terms (rc 0, not a kill).
+        assert victim.proc.wait(timeout=30) == 0
+        # ... and the watchdog removed it from rotation.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if fleet.router.replicas.get("serve-0") is None:
+                break
+            time.sleep(0.1)
+        assert fleet.router.replicas.get("serve-0") is None
+        assert fleet.router.replicas.live_count() == 1
+
+        # Traffic still flows on the survivor.
+        ok2, failed2, failures2 = fleet_mod._drive_load(
+            fleet.router_url, 6, n_threads=2
+        )
+        assert (ok2, failed2) == (6, 0), failures2
+    finally:
+        fleet.stop()
+
+    events = _journal_events(journal)
+    names = [e["name"] for e in events]
+    assert names.count("serve_replica_up") == 2
+    # The SIGTERM'd replica LEFT — a journaled clean leave, and the
+    # watchdog's removal cites the leave, not a crash/exit.
+    leaves = [e for e in events if e["name"] == "leave"
+              and e.get("member") == "serve-0"]
+    assert leaves, f"no clean leave in journal: {names}"
+    downs = [e for e in events if e["name"] == "serve_replica_down"
+             and e.get("member") == "serve-0"]
+    assert downs and downs[0]["reason"] == "leave", downs
+    # The survivor's own stop is also a leave (fleet.stop SIGTERMs it).
+    assert "serve_stop" in names
