@@ -177,6 +177,16 @@ KNOBS: dict[str, Knob] = _decl([
          "Warm standby processes the elastic supervisor keeps parked at "
          "rendezvous; an eviction frees a slot and a spare joins the "
          "next generation so world size is preserved."),
+    Knob("HVT_FLEET_TICK_S", "float", 0.5, "launch",
+         "hvt-launch fleet scheduler cadence in seconds (reap exits, "
+         "scrape job controller ledgers, place/preempt/regrow)."),
+    Knob("HVT_FLEET_QUARANTINE_S", "float", 60.0, "launch",
+         "Cooldown before a host declared lost (all co-resident ranks "
+         "died together) returns to the fleet scheduler's pool."),
+    Knob("HVT_FLEET_HOST", "str", None, "launch",
+         "The pool host this rank was placed on (fleetd-set via the "
+         "member env) — host identity for host-loss classification and "
+         "the hostdown fault's blast radius."),
     # --- serving (continuous batching engine + replica fleet) ---------------
     Knob("HVT_SERVE_MAX_SEQS", "int", 0, "serving",
          "Continuous batching: max concurrently scheduled sequences per "
@@ -293,7 +303,10 @@ KNOBS: dict[str, Knob] = _decl([
     # --- testing / chaos ----------------------------------------------------
     Knob("HVT_FAULT", "spec", None, "testing",
          "Deterministic fault injection, `rank:epoch[.step]:kind` (kinds "
-         "kill/exitN/hang/leave/reorder/corrupt[@target]/slow:MS; "
+         "kill/exitN/hang/leave/reorder/corrupt[@target]/slow:MS/"
+         "hostdown; `hostdown` SIGKILLs every rank sharing the firing "
+         "rank's host via the HVT_FAULT_HOST_PIDS registry — the "
+         "host-loss ground truth for hvt-launch fleet; "
          "`reorder` swaps the rank's last two flight-recorded "
          "submissions, then wedges like `hang` — the hvt-sched replay "
          "acceptance fault; `slow:MS` makes the rank sleep MS ms per "
@@ -302,6 +315,13 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_FAULT_STAMP", "path", None, "testing",
          "One-shot stamp file: the fault fires once, never while the "
          "stamp exists — across relaunches."),
+    Knob("HVT_FAULT_HOST_PIDS", "path", None, "testing",
+         "Per-host pid registry directory for the `hostdown` fault kind "
+         "(fleetd points every rank placed on host H at `<dir>/H`); each "
+         "rank's fault callback registers its pid there at epoch begin, "
+         "and a firing `hostdown` SIGKILLs every registered live pid — "
+         "peers first, self last. Unset degrades hostdown to a "
+         "self-SIGKILL."),
     Knob("HVT_DATA_FAULT_READS", "int", 0, "testing",
          "Inject N deterministic TRANSIENT read faults (OSError) into "
          "the dataset-read retry path (data.stream.read_with_retries) — "

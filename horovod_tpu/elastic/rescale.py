@@ -15,6 +15,8 @@ snapshot — params, optimizer state, epoch — moved to (re)joiners by
 from __future__ import annotations
 
 import os
+import signal
+import threading
 
 from horovod_tpu import runtime
 from horovod_tpu.elastic import state as state_lib
@@ -96,12 +98,30 @@ def run(
     from horovod_tpu import trace
 
     for _ in range(max_generations):
+        if state_lib.leave_signaled():
+            # A scheduler SIGTERM landed between generations (or during
+            # the previous teardown): leave NOW instead of joining a
+            # rendezvous we'd only depart again at the first boundary.
+            try:
+                client.leave(reason="sigterm")
+            except state_lib.CONTROL_PLANE_ERRORS:
+                pass
+            state_lib.clear_leave_signal()
+            raise SystemExit(143)
         # One span per rescale boundary: rendezvous wait + runtime
         # rebuild + state sync — the wall-clock a membership change
         # costs this worker before training resumes.
         with trace.span("rescale"):
             world = client.sync(progress=state.progress)
             ensure_world(world)
+            # `jax.distributed.initialize` (inside ensure_world) installs
+            # XLA's preemption notifier over SIGTERM; claim the signal
+            # back IMMEDIATELY so a preemption arriving before fit()'s
+            # own handler (trainer build, data setup, first compile) is
+            # recorded as sticky leave intent instead of being eaten —
+            # see `state.signal_leave`.
+            if threading.current_thread() is threading.main_thread():
+                signal.signal(signal.SIGTERM, state_lib.signal_leave)
             state.sync(world.root_rank)
         try:
             result = train_fn(state, world)

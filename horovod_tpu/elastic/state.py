@@ -67,6 +67,38 @@ class LeaveInterrupt(BaseException):
     the 143 exit-status convention the supervisor classifies as clean."""
 
 
+# Process-wide leave intent, STICKY across generations. The per-fit()
+# handler below covers the common case, but a scheduler's SIGTERM can
+# land in the rendezvous -> runtime-init -> trainer-build window where
+# fit() hasn't installed it yet — and `jax.distributed.initialize`
+# re-registers XLA's own preemption notifier over whatever handler was
+# active, silently eating the signal. `elastic.run` therefore re-installs
+# `signal_leave` right AFTER every runtime (re)build, and every
+# membership agreement reads `leave_signaled()` alongside the
+# callback-local flag, so a preemption can never be dropped on the floor
+# (the failure mode is ugly: the victim trains on until the scheduler's
+# grace escalation SIGKILLs it mid-collective, crashing the survivors).
+_LEAVE_SIGNALED = False
+
+
+def signal_leave(signum=None, frame=None) -> None:
+    """SIGTERM handler (also callable directly): record leave intent."""
+    global _LEAVE_SIGNALED
+    _LEAVE_SIGNALED = True
+
+
+def leave_signaled() -> bool:
+    return _LEAVE_SIGNALED
+
+
+def clear_leave_signal() -> None:
+    """Reset after the leave is CONSUMED (the departing boundary ran, or
+    `elastic.run` exited 143) — so in-process reuse (tests, nested runs)
+    doesn't inherit a stale intent."""
+    global _LEAVE_SIGNALED
+    _LEAVE_SIGNALED = False
+
+
 def progress_marker(epoch: int, step: int = 0) -> int:
     """Total order over committed progress: epochs dominate, steps break
     ties within an epoch (the every-N-steps commit cadence). Used to elect
@@ -567,6 +599,7 @@ class ElasticStateCallback(Callback):
 
     def _handler(self, signum, frame):
         self._leave_requested = True
+        signal_leave()
 
     def on_train_begin(self, logs=None):
         # Fail fast — at elastic.run entry of every generation, before a
@@ -647,7 +680,8 @@ class ElasticStateCallback(Callback):
             return
         self._last_rescale_step = done
         gen = self._beat(force=True)
-        leaving = self._leave_requested or faults.leave_requested()
+        leaving = (self._leave_requested or leave_signaled()
+                   or faults.leave_requested())
         pending = bool(
             leaving
             or getattr(self.client, "last_beat_pending", False)
@@ -704,6 +738,7 @@ class ElasticStateCallback(Callback):
                 )
             except CONTROL_PLANE_ERRORS:
                 pass
+            clear_leave_signal()
             raise LeaveInterrupt()
         raise HostsUpdatedInterrupt()
 
@@ -717,7 +752,8 @@ class ElasticStateCallback(Callback):
         self.state.step = 0
         self.state.cursor = self._stream_cursor(epoch + 1, 0)
         gen = self._beat(force=True)
-        leaving = self._leave_requested or faults.leave_requested()
+        leaving = (self._leave_requested or leave_signaled()
+                   or faults.leave_requested())
         if jax.process_count() > 1:
             votes = collectives.allgather_object(
                 (gen if gen is not None else -1, bool(leaving))

@@ -18,11 +18,17 @@ def parse_target(target: str) -> tuple[float, float]:
     return float(lo), float(hi)
 
 
-def read_metric(path: str, name: str) -> list[float]:
+def read_metric(path: str, name: str, job: str | None = None) -> list[float]:
     """All values of ``name`` in the stream, in write order. Reads a
     rotated ``.1`` predecessor (the supervisor's `RestartLog` rotation)
     before the live file, so count/last aggregates see the full window
-    across the rotation boundary."""
+    across the rotation boundary.
+
+    ``job``: restrict to records whose ``job`` field equals it — the
+    multi-job scoping for fleet journals (`hvt-launch fleet` tags every
+    placement/preempt/regrow record with the job it concerns). ``None``
+    keeps the classic single-job semantics: every record of ``name``
+    counts, tagged or not."""
     values = []
     for part in (path + ".1", path):
         if not os.path.exists(part):
@@ -39,8 +45,11 @@ def read_metric(path: str, name: str) -> list[float]:
                     # reader racing the appender) must not crash the gate —
                     # the fail-on-empty-stream semantics still hold below.
                     continue
-                if rec.get("name") == name:
-                    values.append(float(rec["value"]))
+                if rec.get("name") != name:
+                    continue
+                if job is not None and rec.get("job") != job:
+                    continue
+                values.append(float(rec["value"]))
     return values
 
 
@@ -68,6 +77,7 @@ def check_metrics(
     name: str,
     target: tuple[float, float],
     how: str = "mean",
+    job: str | None = None,
 ) -> tuple[bool, float]:
     """Return (passed, aggregated value). Missing metric — or a missing
     metrics file entirely — fails the gate rather than crashing it (a run
@@ -78,7 +88,7 @@ def check_metrics(
         # (A rotated-away live file with a `.1` predecessor still counts
         # as present: the stream exists, its newest window is just empty.)
         return False, float("nan")
-    values = read_metric(path, name)
+    values = read_metric(path, name, job=job)
     if not values and how != "count":
         # count is the exception *for an existing file*: zero matching
         # records is a legitimate answer (e.g. asserting a supervised run
@@ -124,16 +134,21 @@ def run_prom_checks(prom_path: str, checks: dict) -> bool:
 def run_checks(metrics_path: str, checks: dict) -> bool:
     """Evaluate a ``{name: {target, aggregate}}`` block (the config.yaml:8-11
     shape), printing one verdict line per check. Shared by the CLI and the
-    YAML job runner."""
+    YAML job runner. A rule may carry ``job: <name>`` to scope the
+    aggregate to one job's records in a multi-job (fleet) journal —
+    single-job specs omit it and behave exactly as before."""
     ok = True
     for name, rule in checks.items():
         how = rule.get("aggregate", "mean")
+        job = rule.get("job")
         passed, value = check_metrics(
-            metrics_path, name, parse_target(str(rule["target"])), how=how
+            metrics_path, name, parse_target(str(rule["target"])), how=how,
+            job=job,
         )
+        scope = f" job={job}" if job is not None else ""
         print(
-            f"check {name}: {how}={value:.6g} target={rule['target']} "
-            f"{'PASS' if passed else 'FAIL'}"
+            f"check {name}{scope}: {how}={value:.6g} "
+            f"target={rule['target']} {'PASS' if passed else 'FAIL'}"
         )
         ok = ok and passed
     return ok

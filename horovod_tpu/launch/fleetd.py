@@ -1,0 +1,1280 @@
+"""`hvt-launch fleet` — the multi-job control plane (ROADMAP item 3).
+
+One fleetd process owns a declared HOST POOL and runs N job specs over
+it — the step past everything before this, which operated exactly one
+job (one supervisor, one policy engine, one serving fleet). A fleet
+spec is a pool plus a list of job entries, each a complete single-job
+spec (`launch.job` grammar: ``job:`` + ``checks:``/``journal_checks:``/
+``metrics_checks:``) with three fleet-level keys on top:
+
+.. code-block:: yaml
+
+    fleet:
+      pool:            # host -> slot count (one slot = one rank/unit)
+        h0: {slots: 2}
+        h1: {slots: 2}
+      dir: ./fleet-state   # fleet journal + per-host pid registries
+      tick_s: 0.5          # scheduler cadence  (HVT_FLEET_TICK_S)
+      quarantine_s: 60     # dead-host cooldown (HVT_FLEET_QUARANTINE_S)
+    jobs:
+      - name: lm-soak
+        priority: 1        # higher wins hosts
+        # delay_s: 0       # arrival offset from fleet start
+        job: {command: [...], elastic: {min_ranks: 1, max_ranks: 4}, ...}
+        journal_checks: {...}
+
+Semantics, in order of importance:
+
+* **Priority placement + preemption-as-elastic-shrink.** The scheduler
+  (`schedule`, a pure function — unit-testable without processes)
+  places demand by priority. When a higher-priority job needs hosts —
+  admission OR regrow after host loss — it reclaims them from strictly
+  lower-priority *elastic* jobs via ``POST /shrink`` on the victim's
+  control port: the victim's supervisor SIGTERMs members, the elastic
+  callback turns that into a clean leave at the commit boundary, and
+  the exit spends ZERO restart budget (a ``preempt`` journal record,
+  not a ``restarts`` one). Freed hosts flow back through the victim's
+  ``released`` ledger; when the pool frees up again the victim is
+  regrown to full size (``POST /grow`` → `supervise_elastic`'s
+  ``take_grows``). Preemption is capacity reclamation, not failure.
+* **Per-job budget isolation.** Every job runs under its OWN
+  `supervise_elastic` (as a separate child process) with its OWN
+  restart/evict/oom budgets and its OWN journal, every record stamped
+  ``job=<name>`` (`RestartLog` ``extra``). Cross-charging is a bug:
+  `assert_budget_isolation` scans a finished job's journal and fails
+  the fleet if any record names a different job.
+* **Host-level failure is one event.** The ``hostdown`` fault kind
+  (testing/faults.py) kills every rank sharing a host in one stroke;
+  the job's `JobController.classify_exit` reclassifies the co-resident
+  deaths as ONE ``host_lost`` — first death charged once, siblings
+  free — and reports the host up to fleetd, which quarantines it for
+  ``quarantine_s`` before its slots are schedulable again. Rank→host
+  membership rides `HVT_FAULT_HOST_PIDS` (a per-host pid directory
+  under ``<dir>/hostpids/``) so the blast radius is real even on a
+  local pool.
+* **fleetd itself is crash-recoverable.** Every placement / preempt /
+  release / regrow / host-loss / budget / completion decision lands in
+  ``fleet-journal.jsonl`` (append-only, metric-shaped — `ci_gate`
+  gates it with ``job=`` scoping). Job children are spawned in their
+  OWN sessions, so a SIGKILLed fleetd leaves them training; a
+  restarted fleetd replays the journal, probes each recorded pid +
+  control port, and ADOPTS the survivors (an ``adopt`` record) instead
+  of relaunching them — monitoring adopted jobs by pid liveness and
+  judging them purely by their gates.
+
+Observability: ``GET /fleetd`` (jobs, placements, per-job budget
+remaining, host states) and ``GET /metrics`` (the declared
+``hvt_fleetd_*`` series, obs/core.py) on ``fleet.status_port``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.launch import ci_gate, launcher, supervisor
+from horovod_tpu.obs import core as obs_core, prom as obs_prom
+
+JOURNAL_NAME = "fleet-journal.jsonl"
+# Exit codes subprocess reports for a SIGKILLed child (raw signal, or
+# 128+9 when a shell wrapper re-reports it) — the host-loss shape.
+_SIGKILL_CODES = (-signal.SIGKILL, 128 + signal.SIGKILL)
+
+
+# --------------------------------------------------------------------------
+# fleet spec
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class JobEntry:
+    """One parsed ``jobs:`` entry — the fleet-level keys plus the
+    embedded single-job spec (validated through `job.validate_spec`,
+    so a typo'd restart:/elastic:/policy: block fails at load)."""
+
+    name: str
+    priority: int
+    delay_s: float
+    spec: dict            # the single-job spec mapping (job: + gates)
+    min_units: int        # smallest schedulable world
+    target_units: int     # full-size world (regrow goal)
+    elastic: bool         # preemptible / controller-driven
+    env: dict
+    log_path: str | None  # the job's own journal (budget isolation unit)
+
+
+def load_entries(spec: dict) -> tuple[dict, list[JobEntry]]:
+    """Parse + validate a fleet spec mapping → (fleet config, entries).
+    Raises ``ValueError`` naming every problem (all of them, not the
+    first — a fleet spec is long enough that one-at-a-time hurts)."""
+    from horovod_tpu.launch import job as job_lib
+
+    errors: list[str] = []
+    fleet = spec.get("fleet") or {}
+    if not isinstance(fleet, dict):
+        raise ValueError(f"fleet: must be a mapping, got {fleet!r}")
+    pool_raw = fleet.get("pool") or {}
+    pool: dict[str, int] = {}
+    if not isinstance(pool_raw, dict) or not pool_raw:
+        errors.append("fleet pool: needs a {host: {slots: N}} mapping")
+    else:
+        for host, cfg in pool_raw.items():
+            slots = cfg.get("slots", 1) if isinstance(cfg, dict) else cfg
+            try:
+                slots = int(slots)
+            except (TypeError, ValueError):
+                slots = 0
+            if slots <= 0:
+                errors.append(f"fleet pool {host}: slots must be >= 1")
+            pool[str(host)] = slots
+    entries: list[JobEntry] = []
+    jobs = spec.get("jobs")
+    if not isinstance(jobs, list) or not jobs:
+        errors.append("jobs: needs a non-empty list of job entries")
+        jobs = []
+    seen: set[str] = set()
+    for i, e in enumerate(jobs):
+        if not isinstance(e, dict) or not e.get("name"):
+            errors.append(f"jobs[{i}]: needs a name:")
+            continue
+        name = str(e["name"])
+        if name in seen:
+            errors.append(f"jobs[{i}]: duplicate name {name!r}")
+            continue
+        seen.add(name)
+        sub = {k: v for k, v in e.items()
+               if k not in ("name", "priority", "delay_s")}
+        for p in job_lib.validate_spec(sub):
+            errors.append(f"job {name}: {p}")
+        j = sub.get("job") if isinstance(sub.get("job"), dict) else {}
+        if j.get("hosts"):
+            errors.append(
+                f"job {name}: hosts: conflicts with the fleet pool — "
+                "fleetd owns placement"
+            )
+        env = {str(k): str(v) for k, v in (j.get("env") or {}).items()}
+        elastic = "elastic" in j
+        if "serve" in j:
+            serve = j.get("serve") or {}
+            target = int(serve.get("replicas", 2))
+            minimum = target
+            log_path = serve.get("journal") or supervisor.default_log_path(
+                env
+            )
+        elif elastic:
+            try:
+                pol = supervisor.ElasticPolicy.from_mapping(
+                    j.get("elastic") or {}
+                )
+            except (TypeError, ValueError):
+                continue  # validate_spec already reported it
+            target = pol.max_ranks or int(j.get("nprocs", 1))
+            minimum = pol.min_ranks
+            restart = j.get("restart") or {}
+            log_path = (restart.get("log") if isinstance(restart, dict)
+                        else None) or supervisor.default_log_path(env)
+        else:
+            target = int(j.get("nprocs", 1))
+            minimum = target
+            restart = j.get("restart") or {}
+            log_path = (restart.get("log") if isinstance(restart, dict)
+                        else None) or supervisor.default_log_path(env)
+        if not log_path:
+            errors.append(
+                f"job {name}: needs restart.log or env PS_MODEL_PATH — "
+                "the per-job journal is the budget-isolation unit"
+            )
+        entries.append(JobEntry(
+            name=name, priority=int(e.get("priority", 0)),
+            delay_s=float(e.get("delay_s", 0.0)), spec=sub,
+            min_units=minimum, target_units=target, elastic=elastic,
+            env=env, log_path=log_path,
+        ))
+    if errors:
+        raise ValueError("; ".join(errors))
+    return {
+        "pool": pool,
+        "dir": str(fleet.get("dir") or "./fleet-state"),
+        "tick_s": fleet.get("tick_s"),
+        "quarantine_s": fleet.get("quarantine_s"),
+        "status_port": fleet.get("status_port"),
+    }, entries
+
+
+# --------------------------------------------------------------------------
+# the scheduler — pure, deterministic, unit-testable without processes
+# --------------------------------------------------------------------------
+
+def free_units(pool: dict, allocs: dict, now: float) -> dict:
+    """Schedulable units per host: declared slots minus allocated,
+    zero while quarantined (``until`` in wall-clock seconds)."""
+    used: dict[str, int] = {}
+    for hosts in allocs.values():
+        for h in hosts:
+            used[h] = used.get(h, 0) + 1
+    free: dict[str, int] = {}
+    for h in sorted(pool):
+        if pool[h].get("until", 0.0) > now:
+            continue
+        n = pool[h]["slots"] - used.get(h, 0)
+        if n > 0:
+            free[h] = n
+    return free
+
+
+def schedule(jobs: list, pool: dict, now: float) -> list:
+    """One scheduling pass over plain state → a list of action dicts.
+
+    ``jobs``: ``{name, priority, state, arrival, alloc: [host,...],
+    min, target, requested, preemptible}`` per job.  ``pool``:
+    ``{host: {slots, until}}``.  Actions:
+
+    * ``{"op": "place", "job", "hosts"}`` — admit a pending job.
+    * ``{"op": "grow", "job", "hosts"}`` — regrow a running job.
+    * ``{"op": "shrink", "job", "target", "for"}`` — preempt a
+      lower-priority elastic job down to ``target`` units (idempotent:
+      the actor only acts when ``target`` drops below what it already
+      requested).
+    * ``{"op": "wait", "job", "need"}`` — demand acknowledged, no
+      capacity yet (preemption in flight, or genuinely full).
+
+    Demand is served priority-descending (name-tiebroken); free units
+    pack host-name order. Preemption reclaims from STRICTLY
+    lower-priority running elastic jobs, lowest priority first, never
+    below each victim's ``min``. A pending job is placed at full
+    target when possible, degraded to whatever is free (>= its min)
+    when nothing can be reclaimed, and otherwise waits.
+    """
+    allocs = {j["name"]: j["alloc"] for j in jobs if j["state"] == "running"}
+    free = free_units(pool, allocs, now)
+
+    def take(n: int) -> list:
+        got: list = []
+        # Most-free host first: gang jobs pack onto whole hosts (the
+        # shape preemption vacates), not one slot each across the pool.
+        for h in sorted(free, key=lambda h: (-free[h], h)):
+            while free[h] > 0 and len(got) < n:
+                free[h] -= 1
+                got.append(h)
+        return got
+
+    def plan_preempts(claimant: dict, want: int) -> int:
+        """Queue shrink actions against lower-priority elastic jobs;
+        returns the unit count expected to free up (asynchronously —
+        the victims leave cleanly, they are not killed here)."""
+        freed = 0
+        victims = [v for v in jobs
+                   if v["state"] == "running" and v["preemptible"]
+                   and v["priority"] < claimant["priority"]]
+        for v in sorted(victims, key=lambda v: (v["priority"], v["name"])):
+            if freed >= want:
+                break
+            cur = shrunk.get(
+                v["name"], min(v["requested"], len(v["alloc"])))
+            give = min(cur - v["min"], want - freed)
+            if give <= 0:
+                continue
+            shrunk[v["name"]] = cur - give
+            freed += give
+            actions.append({"op": "shrink", "job": v["name"],
+                            "target": cur - give, "for": claimant["name"]})
+        return freed
+
+    actions: list = []
+    shrunk: dict = {}  # victim -> planned target this pass
+    # Units already preempted but not yet vacated (shrink acknowledged,
+    # members still mid-clean-leave). A claimant counts these against
+    # its deficit BEFORE planning new preemption — otherwise every tick
+    # of a slow clean leave squeezes the victim one unit further.
+    pending_free = sum(
+        max(0, len(v["alloc"]) - min(v["requested"], len(v["alloc"])))
+        for v in jobs if v["state"] == "running" and v["preemptible"]
+    )
+    demand = [j for j in jobs
+              if (j["state"] == "pending" and now >= j["arrival"])
+              or (j["state"] == "running" and j["preemptible"]
+                  and len(j["alloc"]) < j["target"])]
+    for j in sorted(demand, key=lambda j: (-j["priority"], j["name"])):
+        need = j["target"] - len(j["alloc"])
+        nfree = sum(free.values())
+        if j["state"] == "pending":
+            if nfree >= need:
+                actions.append({"op": "place", "job": j["name"],
+                                "hosts": take(need)})
+                continue
+            short = need - nfree
+            claimed = min(short, pending_free)
+            pending_free -= claimed
+            short -= claimed
+            reclaim = plan_preempts(j, short) if short > 0 else 0
+            if claimed or reclaim or nfree < j["min"]:
+                # Preemption in flight (or hopeless): don't grab a
+                # partial allocation that would leave the freed units
+                # fragmented — admit in one piece next pass.
+                actions.append({"op": "wait", "job": j["name"],
+                                "need": need})
+            else:
+                # Nothing to reclaim but >= min is free: admit degraded,
+                # regrow later like any shrunken elastic job.
+                actions.append({"op": "place", "job": j["name"],
+                                "hosts": take(nfree)})
+        else:
+            got = take(min(need, nfree))
+            if got:
+                actions.append({"op": "grow", "job": j["name"],
+                                "hosts": got})
+            short = need - len(got)
+            claimed = min(short, pending_free)
+            pending_free -= claimed
+            short -= claimed
+            if short > 0:
+                plan_preempts(j, short)
+    return actions
+
+
+# --------------------------------------------------------------------------
+# per-job controller — lives in the job child, drives supervise_elastic
+# --------------------------------------------------------------------------
+
+class JobController:
+    """`supervise_elastic`'s fleet hook for ONE job (the ``controller``
+    duck type its docstring specifies), plus the fleetd-facing ledger
+    served over the job's control port.
+
+    The unit of accounting is a HOST UNIT (one slot on one host):
+    ``alloc`` is the multiset of units the scheduler has granted,
+    ``capacity()`` is its size, and every spawned member is pinned to a
+    unit — the member env carries ``HVT_FLEET_HOST`` and the host's
+    shared pid registry (`HVT_FAULT_HOST_PIDS`), which is what gives
+    the ``hostdown`` fault its real blast radius and this controller
+    its ground truth for ``host_lost`` classification.
+
+    ``released`` and ``lost_hosts`` are APPEND-ONLY ledgers: fleetd
+    keeps a seen-cursor per ledger (journal-reconstructible), so a
+    scrape lost to a fleetd crash is re-read, never double-counted.
+    """
+
+    def __init__(self, name: str, hosts: list, fleet_dir: str,
+                 argv: list, tag_output: bool = True):
+        self.name = name
+        self.alloc: list = list(hosts)
+        self.fleet_dir = fleet_dir
+        self.argv = list(argv)
+        self.tag_output = tag_output
+        self._target = len(self.alloc)
+        self._members: dict = {}   # member_id -> {host, proc, preempting}
+        self._released: list = []  # append-only host units given back
+        self._lost: list = []      # append-only hosts declared dead
+        self._lost_set: set = set()
+        self._pending_grow = 0
+        self._lock = threading.RLock()
+
+    # -- spawn: pin each member to a unit, wire the host identity ----------
+    def _live_per_host(self) -> dict:
+        counts: dict = {}
+        for rec in self._members.values():
+            if rec["proc"].poll() is None:
+                counts[rec["host"]] = counts.get(rec["host"], 0) + 1
+        return counts
+
+    def _assign(self) -> str:
+        live = self._live_per_host()
+        for h in sorted(set(self.alloc)):
+            if self.alloc.count(h) > live.get(h, 0):
+                return h
+        # Capacity gating upstream should prevent this; pile onto the
+        # least-loaded granted host rather than refuse to spawn.
+        return min(sorted(set(self.alloc)) or ["?"],
+                   key=lambda h: live.get(h, 0))
+
+    def spawn(self, member_id: str, slot: int, env: dict):
+        with self._lock:
+            host = self._assign()
+            env = dict(env)
+            env["HVT_FLEET_HOST"] = host
+            env["HVT_FAULT_HOST_PIDS"] = os.path.join(
+                self.fleet_dir, "hostpids", host
+            )
+            proc = supervisor._spawn_member_local(
+                self.argv, env, member_id, slot, tag_output=self.tag_output
+            )
+            self._members[member_id] = {
+                "host": host, "proc": proc, "preempting": False,
+            }
+            return proc
+
+    # -- fleetd-driven transitions (control server) ------------------------
+    def shrink(self, target: int) -> None:
+        with self._lock:
+            self._target = min(self._target, int(target))
+
+    def grow(self, hosts: list) -> None:
+        with self._lock:
+            for h in hosts:
+                self.alloc.append(h)
+                self._lost_set.discard(h)
+            self._target = len(self.alloc)
+            self._pending_grow += len(hosts)
+
+    # -- the supervise_elastic controller protocol -------------------------
+    def capacity(self):
+        with self._lock:
+            return len(self.alloc)
+
+    def take_preempts(self) -> list:
+        with self._lock:
+            excess = len(self.alloc) - self._target
+            if excess <= 0:
+                return []
+            live = self._live_per_host()
+            victims: list = []
+            # Unoccupied units go straight back — nothing to SIGTERM.
+            for h in sorted(set(self.alloc), reverse=True):
+                while excess > 0 and self.alloc.count(h) > live.get(h, 0):
+                    self.alloc.remove(h)
+                    self._released.append(h)
+                    excess -= 1
+            # Then live members, reverse host order / newest member
+            # first, so releases concentrate on whole hosts (the shape
+            # an admission-blocked peer can actually use).
+            candidates = sorted(
+                (m for m, rec in self._members.items()
+                 if rec["proc"].poll() is None and not rec["preempting"]),
+                key=lambda m: (self._members[m]["host"], m), reverse=True,
+            )
+            for m in candidates:
+                if excess <= 0:
+                    break
+                rec = self._members[m]
+                if rec["host"] not in self.alloc:
+                    continue
+                rec["preempting"] = True
+                # The unit leaves the allocation NOW (capacity drops so
+                # the supervisor won't respawn into it); the host label
+                # reaches `released` only when the member's clean leave
+                # lands (on_exit) — released means actually vacated.
+                self.alloc.remove(rec["host"])
+                victims.append(m)
+                excess -= 1
+            return victims
+
+    def take_grows(self) -> int:
+        with self._lock:
+            n = self._pending_grow
+            self._pending_grow = 0
+            return n
+
+    def classify_exit(self, member_id: str, code: int, kind: str):
+        with self._lock:
+            rec = self._members.get(member_id)
+            if rec is None or rec["preempting"]:
+                return None
+            if code not in _SIGKILL_CODES:
+                return None
+            host = rec["host"]
+            if host in self._lost_set:
+                # A sibling of an already-declared loss. This check must
+                # run BEFORE the cohort gate: by the time the sibling's
+                # death is classified, the first victim has been reaped
+                # (popped by on_exit), so the sibling is the host's LAST
+                # live member and the cohort test alone would misread it
+                # as a lone oom-kill — double-charging the incident.
+                return ("host_lost", False)
+            cohort = [m for m, r in self._members.items()
+                      if r["host"] == host and not r["preempting"]]
+            if len(cohort) < 2:
+                # A lone SIGKILL keeps its classic classification
+                # (oom-kill) — host loss means co-residents died
+                # together.
+                return None
+            # The host's ranks die peers-first-self-last within
+            # microseconds, but the reap loop can observe a sibling
+            # before the killer finishes itself — give the cohort a
+            # beat to die together before ruling host loss out.
+            deadline = time.monotonic() + 0.5
+            while True:
+                codes = [self._members[m]["proc"].poll() for m in cohort]
+                if all(c is not None for c in codes):
+                    break
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.02)
+            for c in codes:
+                if c is None or c not in _SIGKILL_CODES:
+                    return None
+            if host in self._lost_set:
+                return (kind if kind == "host_lost" else "host_lost",
+                        False)
+            # First co-resident death: declare the host, purge its
+            # units (capacity drops; the scheduler quarantines and
+            # later regrows), charge the incident ONCE.
+            self._lost_set.add(host)
+            self._lost.append(host)
+            self.alloc = [h for h in self.alloc if h != host]
+            return ("host_lost", True)
+
+    def on_exit(self, member_id: str, kind: str) -> None:
+        with self._lock:
+            rec = self._members.pop(member_id, None)
+            if rec is None:
+                return
+            if rec["preempting"]:
+                # Clean leave landed (or the grace escalation did):
+                # either way the unit is vacated — give it back.
+                self._released.append(rec["host"])
+
+    # -- the fleetd-facing ledger ------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "job": self.name,
+                "alloc": list(self.alloc),
+                "capacity": len(self.alloc),
+                "target": self._target,
+                "released": list(self._released),
+                "lost_hosts": list(self._lost),
+                "members": {
+                    m: rec["host"] for m, rec in self._members.items()
+                    if rec["proc"].poll() is None
+                },
+            }
+
+
+def start_ctl_server(controller: JobController, port: int):
+    """The job child's control surface, loopback-only: ``GET /fleetctl``
+    (the controller ledger), ``POST /shrink {"target": K}``, ``POST
+    /grow {"hosts": [...]}``. Returns the started server (daemon
+    thread); callers own ``shutdown()``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            if self.path == "/fleetctl":
+                self._send(200, controller.snapshot())
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, TypeError):
+                self._send(400, {"error": "bad JSON body"})
+                return
+            if self.path == "/shrink":
+                controller.shrink(int(body.get("target", 0)))
+                self._send(200, {"ok": True, "target": body.get("target")})
+            elif self.path == "/grow":
+                hosts = [str(h) for h in (body.get("hosts") or [])]
+                controller.grow(hosts)
+                self._send(200, {"ok": True, "hosts": hosts})
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+# --------------------------------------------------------------------------
+# the job child — one supervised job, adoptable after a fleetd crash
+# --------------------------------------------------------------------------
+
+def _job_main(cfg_path: str) -> int:
+    """Entry point of ``python -m horovod_tpu.launch.fleetd _job CFG`` —
+    one job under its own supervisor, in its OWN session (fleetd spawns
+    with ``start_new_session=True``), so a dead fleetd never takes the
+    job with it and a SIGTERM from fleetd tears down the whole process
+    group cleanly (the handler raises SystemExit → the supervise loop's
+    teardown reaps every member)."""
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+
+    def _term(signum, frame):
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _term)
+    from horovod_tpu.launch import job as job_lib
+
+    spec = cfg["spec"]
+    j = spec.get("job") or {}
+    name = cfg["name"]
+    hosts = list(cfg["hosts"])
+    env = {str(k): str(v) for k, v in (j.get("env") or {}).items()}
+    command = j.get("command")
+    argv = (
+        command if isinstance(command, list) else shlex.split(command)
+    ) if command else []
+    log_path = cfg.get("log_path")
+    metrics_path = spec.get("metrics", os.path.join(
+        env.get("PS_MODEL_PATH", "./models"), "metrics.jsonl"
+    ))
+    if spec.get("checks") and os.path.exists(metrics_path):
+        os.remove(metrics_path)
+    if j.get("fresh"):
+        import shutil
+
+        norm = os.path.normpath(
+            os.path.abspath(env.get("PS_MODEL_PATH", "./models"))
+        )
+        if norm in ("/", os.path.expanduser("~")) or norm.count(os.sep) < 2:
+            print(f"fleet job {name}: refusing to wipe suspicious "
+                  f"fresh dir {norm}")
+            return 1
+        shutil.rmtree(norm, ignore_errors=True)
+    if "serve" in j:
+        from horovod_tpu.serving import fleet as serve_fleet
+
+        serve = j["serve"] or {}
+        os.environ.update(env)
+        job_lib._reset_journal(log_path, supervisor.default_model_dir(env))
+        serve_argv = ["--replicas", str(serve.get("replicas", 2)),
+                      "--journal", log_path,
+                      "--port", str(serve.get("port", 0)),
+                      "--host", str(serve.get("host", "127.0.0.1"))]
+        if serve.get("demo"):
+            serve_argv.append("--demo")
+        else:
+            serve_argv.insert(0, str(serve["bundle"]))
+        if serve.get("requests"):
+            serve_argv += ["--requests", str(serve["requests"])]
+        if serve.get("swap"):
+            serve_argv.append("--swap")
+        if serve.get("coalesce"):
+            serve_argv.append("--coalesce")
+        return serve_fleet.main(serve_argv)
+    pcfg = None
+    if "policy" in j:
+        from horovod_tpu.launch import policy as policy_lib
+
+        pcfg = policy_lib.PolicyConfig.from_mapping(j["policy"] or {})
+    restart = j.get("restart") or {}
+    policy = supervisor.RestartPolicy.from_mapping(
+        {k: v for k, v in restart.items() if k != "log"}
+    )
+    job_lib._reset_journal(log_path, supervisor.default_model_dir(env))
+    if "elastic" in j:
+        elastic = supervisor.ElasticPolicy.from_mapping(j["elastic"] or {})
+        ctl = JobController(name, hosts, cfg["fleet_dir"], argv)
+        server = start_ctl_server(ctl, int(cfg["ctl_port"]))
+        try:
+            return supervisor.supervise_elastic(
+                len(hosts), argv, env=env, policy=policy, elastic=elastic,
+                log_path=log_path, status_port=cfg.get("status_port"),
+                policy_config=pcfg, spawn=ctl.spawn, controller=ctl,
+                journal_tags={"job": name},
+            )
+        finally:
+            server.shutdown()
+    return supervisor.supervise_local(
+        len(hosts), argv, env=env, policy=policy, log_path=log_path,
+        status_port=cfg.get("status_port"), policy_config=pcfg,
+    )
+
+
+# --------------------------------------------------------------------------
+# budget isolation — cross-charging is a bug, asserted
+# --------------------------------------------------------------------------
+
+def budget_isolation_violations(name: str, log_path: str | None) -> list:
+    """Records in job ``name``'s journal attributed to a DIFFERENT job.
+    Every record the job's supervisor writes is stamped ``job=<name>``
+    (`RestartLog` ``extra``); any other attribution means two jobs
+    shared a journal — exactly the cross-charging the per-job budget
+    isolation exists to prevent."""
+    bad = []
+    for rec in supervisor.journal_records(log_path):
+        if "job" in rec and rec.get("job") != name:
+            bad.append(rec)
+    return bad
+
+
+# --------------------------------------------------------------------------
+# fleetd metrics (the declared hvt_fleetd_* series)
+# --------------------------------------------------------------------------
+
+def fleetd_metrics(journal_path: str | None, jobs: dict | None = None,
+                   pool: dict | None = None,
+                   now: float | None = None) -> obs_core.Registry:
+    """One scrape of the control plane, as a fresh obs registry —
+    journal-derived counters (so they survive a fleetd restart) plus
+    live job/host gauges."""
+    reg = obs_core.Registry()
+    preempts = regrows = lost = 0
+    for rec in supervisor.journal_records(journal_path):
+        n = rec.get("name")
+        if n == "preempt":
+            preempts += 1
+        elif n == "regrow":
+            regrows += 1
+        elif n == "host_lost":
+            lost += 1
+    reg.counter_set("hvt_fleetd_preempts_total", preempts)
+    reg.counter_set("hvt_fleetd_regrows_total", regrows)
+    reg.counter_set("hvt_fleetd_host_lost_total", lost)
+    if jobs is not None:
+        states: dict = {}
+        for name, st in sorted(jobs.items()):
+            states[st["state"]] = states.get(st["state"], 0) + 1
+            reg.gauge("hvt_fleetd_job_size", len(st["alloc"]), job=name)
+            if st.get("budget") is not None:
+                reg.gauge("hvt_fleetd_job_restart_budget_remaining",
+                          st["budget"], job=name)
+        for state, n in sorted(states.items()):
+            reg.gauge("hvt_fleetd_jobs", n, state=state)
+    if pool is not None:
+        now = time.time() if now is None else now
+        up = sum(1 for p in pool.values() if p.get("until", 0.0) <= now)
+        reg.gauge("hvt_fleetd_hosts", up, state="up")
+        reg.gauge("hvt_fleetd_hosts", len(pool) - up, state="quarantined")
+    return reg
+
+
+# --------------------------------------------------------------------------
+# HTTP plumbing (tiny, timeout-bounded, failure == None)
+# --------------------------------------------------------------------------
+
+def _http_json(url: str, timeout: float = 2.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def _http_text(url: str, timeout: float = 2.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def _http_post(url: str, payload: dict, timeout: float = 2.0) -> bool:
+    try:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout):
+            return True
+    except Exception:
+        return False
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+# --------------------------------------------------------------------------
+# the daemon
+# --------------------------------------------------------------------------
+
+class Fleetd:
+    """The scheduler daemon: tick loop over (reap → scrape → schedule →
+    act), journaling every decision. Construct from a parsed spec
+    mapping (tests) or via `run_fleet` (the CLI)."""
+
+    def __init__(self, spec: dict, status_port: int | None = None,
+                 verbose: bool = True):
+        cfg, entries = load_entries(spec)
+        self.fleet_dir = os.path.abspath(cfg["dir"])
+        self.journal_path = os.path.join(self.fleet_dir, JOURNAL_NAME)
+        self.tick_s = float(
+            cfg["tick_s"] if cfg["tick_s"] is not None
+            else registry.get_float("HVT_FLEET_TICK_S")
+        )
+        self.quarantine_s = float(
+            cfg["quarantine_s"] if cfg["quarantine_s"] is not None
+            else registry.get_float("HVT_FLEET_QUARANTINE_S")
+        )
+        self.status_port = (
+            status_port if status_port is not None else cfg["status_port"]
+        )
+        self.verbose = verbose
+        self.pool = {
+            h: {"slots": n, "until": 0.0} for h, n in cfg["pool"].items()
+        }
+        self.fleet_checks = spec.get("journal_checks") or {}
+        self.jobs: dict = {}
+        for e in entries:
+            self.jobs[e.name] = {
+                "entry": e, "state": "pending", "alloc": [],
+                "requested": 0, "pid": None, "proc": None,
+                "ctl_port": None, "status_port": None,
+                "seen_released": 0, "seen_lost": 0, "budget": None,
+                "exit_code": None, "adopted": False, "gates_ok": None,
+            }
+        self.start_wall: float | None = None
+        self.log: supervisor.RestartLog | None = None
+
+    # -- journal replay + survivor adoption --------------------------------
+    def _maybe_recover(self) -> bool:
+        records = supervisor.journal_records(self.journal_path)
+        names = {r.get("name") for r in records}
+        if "fleet_start" not in names or "fleet_done" in names:
+            # No interrupted run to resume: a finished (or absent)
+            # journal means this is a FRESH fleet — stale state must
+            # not feed this run's gates.
+            for p in (self.journal_path, self.journal_path + ".1"):
+                if os.path.exists(p):
+                    os.remove(p)
+            return False
+        for rec in records:
+            n = rec.get("name")
+            if n == "fleet_start":
+                self.start_wall = rec.get("start") or rec.get("wall_time")
+            elif n in ("place", "adopt", "release", "regrow", "host_lost",
+                       "preempt", "job_done"):
+                job = rec.get("job") or rec.get("victim")
+                st = self.jobs.get(job)
+                if st is None:
+                    continue
+                if n == "place":
+                    st.update(
+                        state="running", alloc=list(rec.get("hosts") or []),
+                        requested=len(rec.get("hosts") or []),
+                        pid=rec.get("pid"), ctl_port=rec.get("ctl_port"),
+                        status_port=rec.get("status_port"),
+                        seen_released=0, seen_lost=0,
+                    )
+                elif n == "adopt":
+                    st["pid"] = rec.get("pid")
+                elif n == "release":
+                    for h in rec.get("hosts") or []:
+                        if h in st["alloc"]:
+                            st["alloc"].remove(h)
+                    if rec.get("source") == "ctl":
+                        st["seen_released"] += len(rec.get("hosts") or [])
+                elif n == "regrow":
+                    st["alloc"].extend(rec.get("hosts") or [])
+                    st["requested"] = len(st["alloc"])
+                elif n == "host_lost":
+                    h = rec.get("host")
+                    st["seen_lost"] += 1
+                    st["alloc"] = [x for x in st["alloc"] if x != h]
+                    if h in self.pool:
+                        self.pool[h]["until"] = max(
+                            self.pool[h]["until"],
+                            float(rec.get("until") or 0.0),
+                        )
+                elif n == "preempt" and rec.get("target") is not None:
+                    st["requested"] = min(
+                        st["requested"], int(rec["target"])
+                    )
+                elif n == "job_done":
+                    st.update(state="done" if rec.get("gates") else "failed",
+                              alloc=[], exit_code=rec.get("exit_code"),
+                              gates_ok=bool(rec.get("gates")))
+        # Probe survivors: a recorded pid that still answers (and whose
+        # control port still serves, for elastic jobs) is ADOPTED —
+        # monitored by pid liveness from here on, judged by its gates.
+        for name, st in self.jobs.items():
+            if st["state"] != "running":
+                continue
+            alive = _pid_alive(st["pid"])
+            if alive and st["ctl_port"]:
+                alive = _http_json(
+                    f"http://127.0.0.1:{st['ctl_port']}/fleetctl"
+                ) is not None
+            st["adopted"] = True
+            st["proc"] = None
+            if not alive:
+                # Died while fleetd was down; the first tick finishes
+                # it through the normal path (gates decide).
+                st["pid"] = None
+        return True
+
+    # -- actions -----------------------------------------------------------
+    def _say(self, msg: str) -> None:
+        if self.verbose:
+            print(f"fleetd: {msg}")
+
+    def _place(self, name: str, hosts: list) -> None:
+        st = self.jobs[name]
+        e: JobEntry = st["entry"]
+        ctl_port = launcher.pick_free_port() if e.elastic else None
+        status_port = (
+            launcher.pick_free_port()
+            if (e.elastic or "restart" in (e.spec.get("job") or {}))
+            else None
+        )
+        cfg = {
+            "name": name, "spec": e.spec, "hosts": hosts,
+            "fleet_dir": self.fleet_dir, "ctl_port": ctl_port,
+            "status_port": status_port, "log_path": e.log_path,
+        }
+        cfg_path = os.path.join(self.fleet_dir, f"job-{name}.json")
+        with open(cfg_path, "w") as f:  # hvt: noqa[HVT005] — a relaunch
+            # rewrites this config whole; a torn file only fails a
+            # placement, never corrupts training state.
+            json.dump(cfg, f)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.launch.fleetd", "_job",
+             cfg_path],
+            start_new_session=True,  # survives fleetd; killpg tears down
+        )
+        st.update(
+            state="running", alloc=list(hosts), requested=len(hosts),
+            proc=proc, pid=proc.pid, ctl_port=ctl_port,
+            status_port=status_port, seen_released=0, seen_lost=0,
+            adopted=False,
+        )
+        self.log.write(
+            "place", float(len(hosts)), job=name, hosts=hosts,
+            pid=proc.pid, ctl_port=ctl_port, status_port=status_port,
+            priority=e.priority,
+        )
+        self._say(f"placed {name} on {hosts} (pid {proc.pid})")
+
+    def _finish_job(self, name: str, code) -> None:
+        st = self.jobs[name]
+        if st["alloc"]:
+            self.log.write(
+                "release", float(len(st["alloc"])), job=name,
+                hosts=list(st["alloc"]), source="exit",
+            )
+            st["alloc"] = []
+        gates = self._run_gates(name)
+        ok = (code in (0, None)) and gates
+        st.update(state="done" if ok else "failed", exit_code=code,
+                  gates_ok=gates, proc=None, pid=None)
+        self.log.write("job_done", 1.0, job=name, exit_code=code,
+                       gates=gates)
+        self._say(
+            f"{name} finished (exit {code}, gates "
+            f"{'green' if gates else 'RED'})"
+        )
+
+    def _run_gates(self, name: str) -> bool:
+        st = self.jobs[name]
+        e: JobEntry = st["entry"]
+        ok = True
+        bad = budget_isolation_violations(name, e.log_path)
+        if bad:
+            print(f"fleetd: BUDGET ISOLATION VIOLATION — {len(bad)} "
+                  f"record(s) in {name}'s journal attributed to another "
+                  f"job (first: {bad[0]})")
+            ok = False
+        jc = e.spec.get("journal_checks") or {}
+        if jc:
+            ok = ci_gate.run_checks(e.log_path, jc) and ok
+        mc = e.spec.get("metrics_checks") or {}
+        if mc:
+            prom_path = supervisor.default_metrics_dump_path(
+                supervisor.default_model_dir(e.env), e.log_path
+            )
+            ok = ci_gate.run_prom_checks(prom_path, mc) and ok
+        checks = e.spec.get("checks") or {}
+        if checks:
+            metrics_path = e.spec.get("metrics", os.path.join(
+                e.env.get("PS_MODEL_PATH", "./models"), "metrics.jsonl"
+            ))
+            ok = ci_gate.run_checks(metrics_path, checks) and ok
+        return ok
+
+    # -- the tick ----------------------------------------------------------
+    def _scrape(self, name: str, now: float) -> None:
+        st = self.jobs[name]
+        if not st["ctl_port"]:
+            return
+        snap = _http_json(f"http://127.0.0.1:{st['ctl_port']}/fleetctl")
+        if snap is not None:
+            rel = (snap.get("released") or [])[st["seen_released"]:]
+            if rel:
+                st["seen_released"] += len(rel)
+                for h in rel:
+                    if h in st["alloc"]:
+                        st["alloc"].remove(h)
+                self.log.write("release", float(len(rel)), job=name,
+                               hosts=rel, source="ctl")
+                self._say(f"{name} released {rel}")
+            for h in (snap.get("lost_hosts") or [])[st["seen_lost"]:]:
+                st["seen_lost"] += 1
+                until = now + self.quarantine_s
+                if h in self.pool:
+                    self.pool[h]["until"] = max(
+                        self.pool[h]["until"], until
+                    )
+                st["alloc"] = [x for x in st["alloc"] if x != h]
+                self.log.write("host_lost", 1.0, job=name, host=h,
+                               until=until)
+                self._say(
+                    f"host {h} LOST under {name} — quarantined "
+                    f"{self.quarantine_s:.0f}s"
+                )
+        if st["status_port"]:
+            text = _http_text(
+                f"http://127.0.0.1:{st['status_port']}/metrics"
+            )
+            if text:
+                try:
+                    values = obs_prom.parse_text(text)
+                except ValueError:
+                    return
+                remaining = values.get("hvt_restart_budget_remaining")
+                if remaining is not None and remaining != st["budget"]:
+                    st["budget"] = remaining
+                    self.log.write("job_budget", remaining, job=name,
+                                   remaining=remaining)
+
+    def _sched_view(self, now: float) -> list:
+        view = []
+        for name, st in sorted(self.jobs.items()):
+            e: JobEntry = st["entry"]
+            view.append({
+                "name": name, "priority": e.priority,
+                "state": st["state"],
+                "arrival": (self.start_wall or now) + e.delay_s,
+                "alloc": list(st["alloc"]), "min": e.min_units,
+                "target": e.target_units, "requested": st["requested"],
+                "preemptible": e.elastic,
+            })
+        return view
+
+    def _tick(self) -> None:
+        now = time.time()
+        # 1. reap owned children / probe adopted survivors
+        for name, st in self.jobs.items():
+            if st["state"] != "running":
+                continue
+            if st["proc"] is not None:
+                code = st["proc"].poll()
+                if code is not None:
+                    self._finish_job(name, code)
+            elif not _pid_alive(st["pid"]):
+                self._finish_job(name, None)
+        # 2. scrape controller ledgers + budget gauges
+        for name, st in self.jobs.items():
+            if st["state"] == "running":
+                self._scrape(name, now)
+        # 3. schedule + act
+        for act in schedule(self._sched_view(now), self.pool, now):
+            name = act["job"]
+            st = self.jobs[name]
+            if act["op"] == "place":
+                self._place(name, act["hosts"])
+            elif act["op"] == "grow":
+                if st["ctl_port"] and _http_post(
+                    f"http://127.0.0.1:{st['ctl_port']}/grow",
+                    {"hosts": act["hosts"]},
+                ):
+                    st["alloc"].extend(act["hosts"])
+                    st["requested"] = len(st["alloc"])
+                    self.log.write(
+                        "regrow", float(len(act["hosts"])), job=name,
+                        hosts=act["hosts"],
+                    )
+                    self._say(f"regrew {name} with {act['hosts']}")
+            elif act["op"] == "shrink":
+                if act["target"] < st["requested"] and st["ctl_port"]:
+                    if _http_post(
+                        f"http://127.0.0.1:{st['ctl_port']}/shrink",
+                        {"target": act["target"]},
+                    ):
+                        st["requested"] = act["target"]
+                        self.log.write(
+                            "preempt", 1.0, victim=name, job=name,
+                            target=act["target"], **{"for": act["for"]},
+                        )
+                        self._say(
+                            f"preempting {name} -> {act['target']} "
+                            f"unit(s) for {act['for']}"
+                        )
+
+    def snapshot(self) -> dict:
+        now = time.time()
+        return {
+            "start": self.start_wall,
+            "jobs": {
+                name: {
+                    "state": st["state"], "priority":
+                        st["entry"].priority,
+                    "alloc": list(st["alloc"]),
+                    "target": st["entry"].target_units,
+                    "min": st["entry"].min_units,
+                    "pid": st["pid"], "adopted": st["adopted"],
+                    "budget_remaining": st["budget"],
+                    "exit_code": st["exit_code"],
+                    "gates_ok": st["gates_ok"],
+                }
+                for name, st in sorted(self.jobs.items())
+            },
+            "hosts": {
+                h: {
+                    "slots": p["slots"],
+                    "state": "quarantined" if p["until"] > now else "up",
+                    "until": p["until"] or None,
+                }
+                for h, p in sorted(self.pool.items())
+            },
+            "journal": self.journal_path,
+        }
+
+    def _start_status_server(self, port: int):
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        fleetd = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    if self.path == "/fleetd":
+                        self._send(200, fleetd.snapshot())
+                    elif self.path == "/metrics":
+                        obs_prom.write_http(self, fleetd_metrics(
+                            fleetd.journal_path, fleetd.jobs, fleetd.pool,
+                        ))
+                    elif self.path == "/healthz":
+                        self._send(200, {"status": "ok"})
+                    else:
+                        self._send(404, {"error": f"no route {self.path}"})
+                except Exception as e:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server
+
+    def _teardown_children(self) -> None:
+        """Abnormal-exit cleanup of OWNED children only: adopted jobs
+        were deliberately left running across one fleetd death already —
+        a second fleetd death leaves them for the next recovery too."""
+        for st in self.jobs.values():
+            if st["proc"] is None or st["proc"].poll() is not None:
+                continue
+            try:
+                os.killpg(st["proc"].pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                continue
+        deadline = time.monotonic() + 10.0
+        for st in self.jobs.values():
+            p = st["proc"]
+            if p is None:
+                continue
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.wait()
+
+    def run(self) -> int:
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        recovered = self._maybe_recover()
+        self.log = supervisor.RestartLog(self.journal_path,
+                                         max_lines=1_000_000)
+        self.log.touch()
+        if recovered:
+            self._say(f"recovered from {self.journal_path}")
+            for name, st in self.jobs.items():
+                if st["state"] == "running" and st["pid"]:
+                    self.log.write("adopt", 1.0, job=name, pid=st["pid"])
+                    self._say(f"adopted {name} (pid {st['pid']})")
+        else:
+            self.start_wall = time.time()
+            self.log.write(
+                "fleet_start", 1.0, start=self.start_wall,
+                pool={h: p["slots"] for h, p in self.pool.items()},
+                jobs=sorted(self.jobs),
+            )
+        server = (
+            self._start_status_server(int(self.status_port))
+            if self.status_port is not None else None
+        )
+        try:
+            while any(st["state"] in ("pending", "running")
+                      for st in self.jobs.values()):
+                self._tick()
+                time.sleep(self.tick_s)
+            ok = all(st["state"] == "done" for st in self.jobs.values())
+            if self.fleet_checks:
+                ok = ci_gate.run_checks(
+                    self.journal_path, self.fleet_checks
+                ) and ok
+            self.log.write("fleet_done", 1.0, ok=ok)
+            self._say(f"fleet done ({'all green' if ok else 'FAILED'})")
+            return 0 if ok else 1
+        except BaseException:
+            self._teardown_children()
+            raise
+        finally:
+            if server is not None:
+                server.shutdown()
+
+
+def run_fleet(spec_path: str, status_port: int | None = None) -> int:
+    import yaml
+
+    with open(spec_path) as f:
+        spec = yaml.safe_load(f)
+    try:
+        fleetd = Fleetd(spec, status_port=status_port)
+    except ValueError as e:
+        print(f"{spec_path}: {e}")
+        return 1
+    return fleetd.run()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "_job":
+        return _job_main(argv[1])
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="hvt-launch fleet",
+        description="Run N job specs over a shared host pool: priority "
+        "placement, preemption-as-elastic-shrink, per-job restart-budget "
+        "isolation, host quarantine, journal-recoverable.",
+    )
+    ap.add_argument("spec", help="fleet spec YAML (fleet: pool + jobs:)")
+    ap.add_argument("--status-port", type=int, default=None,
+                    help="serve GET /fleetd + /metrics on this port")
+    args = ap.parse_args(argv)
+    return run_fleet(args.spec, status_port=args.status_port)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -274,6 +274,15 @@ def main(argv: list[str] | None = None) -> int:
 
         return serve_main(argv[1:])
 
+    if argv and argv[0] == "fleet":
+        # Same delegation: the multi-job control plane owns its flags
+        # (see `python -m horovod_tpu.launch.fleetd --help`) — a fleet
+        # spec (shared host pool + prioritized job entries), preemption
+        # as elastic shrink, per-job budget isolation, journal recovery.
+        from horovod_tpu.launch.fleetd import main as fleet_main
+
+        return fleet_main(argv[1:])
+
     parser = argparse.ArgumentParser(prog="python -m horovod_tpu.launch")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -382,6 +391,13 @@ def main(argv: list[str] | None = None) -> int:
         help="elastic serving fleet: N continuous-batching replicas "
         "behind one router, zero-downtime weight swaps "
         "(see `python -m horovod_tpu.serving.fleet --help`)")
+    sub.add_parser(
+        "fleet",
+        help="multi-job control plane: run N job specs over a shared "
+        "host pool with priorities, preemption-as-elastic-shrink, "
+        "per-job restart budgets, host quarantine, and a "
+        "crash-recoverable fleet journal "
+        "(see `python -m horovod_tpu.launch.fleetd --help`)")
 
     args = parser.parse_args(argv)
     if args.cmd in ("run", "pod") and not command:

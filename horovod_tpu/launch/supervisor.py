@@ -285,8 +285,13 @@ class RestartLog:
     lines / 64 MB; 0 disables that bound)."""
 
     def __init__(self, path: str | None, max_lines: int | None = None,
-                 max_bytes: int | None = None):
+                 max_bytes: int | None = None,
+                 extra: dict | None = None):
         self.path = path
+        # Fields stamped onto EVERY record (e.g. ``job=`` for the per-job
+        # journals of a fleet launch, so a merged/aggregated view stays
+        # attributable). Per-write fields win on collision.
+        self.extra = dict(extra or {})
         if max_lines is None:
             max_lines = registry.get_int("HVT_RESTART_LOG_MAX_LINES")
         if max_bytes is None:
@@ -344,7 +349,7 @@ class RestartLog:
             except OSError:
                 self._lines = 0
         record = {"name": name, "value": value, "wall_time": time.time(),
-                  **fields}
+                  **self.extra, **fields}
         with open(self.path, "a") as f:
             f.write(json.dumps(record) + "\n")
             f.flush()
@@ -753,6 +758,8 @@ def supervise_elastic(
     sleep=time.sleep,
     verbose: bool = True,
     poll_interval: float = 0.1,
+    controller=None,
+    journal_tags: dict | None = None,
 ) -> int:
     """Elastic launch-and-supervise loop: continue-through-failure.
 
@@ -800,6 +807,42 @@ def supervise_elastic(
     the generation an eviction or death frees a slot in: world size is
     PRESERVED instead of shrunk, without spending a restart.
 
+    ``controller``: the fleet scheduler's duck-typed hook
+    (`launch.fleetd.JobController`) — how `hvt-launch fleet` drives one
+    job's supervisor from outside without reimplementing it. The
+    contract, every method optional-free and called from this loop only:
+
+    * ``take_preempts() -> list[member_id]`` — members the scheduler
+      wants preempted NOW. Each gets the clean-leave treatment the
+      policy engine's eviction gets (SIGTERM → the elastic callback's
+      flag → leave at the next commit boundary, grace-escalated): the
+      exit spends NO restart budget and queues NO respawn — a
+      ``preempt`` record is journaled instead. Preemption is capacity
+      reclamation, not failure.
+    * ``capacity() -> int | None`` — a dynamic world-size cap below
+      ``max_ranks`` (the job's current host allocation). Respawns and
+      grows are dropped while live+joining members would exceed it.
+    * ``take_grows() -> int`` — fresh members to launch immediately
+      (the scheduler granted hosts back); launched into the smallest
+      free slots, budget-free (a grow restores capacity, it does not
+      remedy a failure).
+    * ``classify_exit(member_id, code, kind) -> (kind, charge) | None``
+      — reclassify a death (the ``host_lost`` path: every rank on a
+      dead host is one event; the first co-resident death returns
+      ``("host_lost", True)`` — charged once — the rest
+      ``("host_lost", False)``, journaled as ``host_lost`` records and
+      respawned capacity-permitting without touching the budget).
+    * ``on_exit(member_id, kind)`` — post-reap notification with the
+      final classification (host bookkeeping).
+
+    With a controller attached an EMPTY fleet is a wait state, not
+    extinction: a job whose only host just died idles (coordinator up,
+    zero members) until the scheduler regrows it or tears it down.
+
+    ``journal_tags``: fields stamped on every journal record (the fleet
+    launch tags ``job=<name>`` so multi-job aggregation stays
+    attributable — `ci_gate` scopes counts by it).
+
     ``policy_config`` (default: resolved from the env's ``HVT_POLICY*``
     knobs) runs the policy engine (`launch.policy`) inside this loop —
     this mode owns the full actuator: a confirmed straggler's member is
@@ -827,7 +870,7 @@ def supervise_elastic(
         # the next warm standby instead of dying on ElasticError.
         env["HVT_ELASTIC_SPARE"] = "1"
     flight_dir = resolve_flight_dir(env)
-    log = RestartLog(log_path)
+    log = RestartLog(log_path, extra=journal_tags)
     log.touch()
     coord = Coordinator(
         host=coordinator_host,
@@ -887,6 +930,15 @@ def supervise_elastic(
     # (a parked spare grows the world back, or the fleet deliberately
     # stays smaller).
     policy_evicted: set = set()
+    # Members the fleet CONTROLLER deliberately preempted (capacity
+    # reclamation for a higher-priority job): same zero-budget/no-respawn
+    # semantics as a policy eviction, but journaled as `preempt` — the
+    # scheduler regrows the job later via take_grows().
+    preempted: set = set()
+
+    def notify_exit(member_id: str, kind: str) -> None:
+        if controller is not None:
+            controller.on_exit(member_id, kind)
 
     def parked_spares() -> int:
         """Live member processes the coordinator has never admitted —
@@ -1010,6 +1062,7 @@ def supervise_elastic(
                 status, reason = coord.member_status(member_id)
                 if status == "left" and reason == "done":
                     job_done = True
+                    notify_exit(member_id, "done")
                     continue
                 if member_id in policy_evicted:
                     # Deliberate policy eviction: the engine already
@@ -1021,6 +1074,17 @@ def supervise_elastic(
                         # The evictee was too wedged for a clean leave
                         # and the grace escalation killed it.
                         coord.mark_dead(member_id, reason="evicted")
+                    notify_exit(member_id, "evicted")
+                    continue
+                if member_id in preempted:
+                    # Scheduler-initiated preemption completed: the host
+                    # goes back to the pool (on_exit), the budget stays
+                    # untouched, and NO respawn queues — take_grows()
+                    # will regrow the job when hosts free up.
+                    preempted.discard(member_id)
+                    if status != "left":
+                        coord.mark_dead(member_id, reason="preempted")
+                    notify_exit(member_id, "preempt")
                     continue
                 if code == 0:
                     # Finished without the leave handshake (a non-elastic
@@ -1028,7 +1092,9 @@ def supervise_elastic(
                     # success signal; unblock any pending rendezvous.
                     job_done = True
                     coord.mark_dead(member_id, reason="exit0-no-leave")
+                    notify_exit(member_id, "done")
                     continue
+                charge = True
                 if status == "left":
                     # Planned departure (preemption/leave): the coordinator
                     # already journaled the leave and survivors shrink in
@@ -1038,6 +1104,12 @@ def supervise_elastic(
                     kind = "hang" if member_id in hang_killed else classify(
                         code
                     )
+                    if controller is not None:
+                        override = controller.classify_exit(
+                            member_id, code, kind
+                        )
+                        if override is not None:
+                            kind, charge = override
                     if kind == "hang" and seq not in flight_collected:
                         # ONE collection per hang episode: a fleet-wide
                         # wedge reaps every member as `hang` in one
@@ -1057,7 +1129,21 @@ def supervise_elastic(
                             engine.on_hang(os.path.dirname(files[0]))
                     coord.mark_dead(member_id, reason=kind)
                     last_failure = code if code else 1
+                notify_exit(member_id, kind)
                 if not job_done:
+                    if not charge:
+                        # A host-loss sibling: the incident was already
+                        # charged ONCE (the first co-resident death).
+                        # Journal the event, queue the replacement —
+                        # capacity-gated below, since the dead host's
+                        # units are gone until the scheduler regrows —
+                        # and leave every budget untouched.
+                        log.write(
+                            "host_lost", 1.0, member=member_id, kind=kind,
+                            exit_code=code, generation=coord.generation,
+                        )
+                        respawn_queue.append((now + backoff, rec["slot"]))
+                        continue
                     new_marker = newest_checkpoint_marker(model_dir)
                     cur_progress = committed_progress()
                     progressed = (
@@ -1136,6 +1222,27 @@ def supervise_elastic(
                     rec["terminated_at"] = now
                     rec["proc"].terminate()
 
+            # --- scheduler preemption (fleet controller) --------------------
+            if controller is not None and not job_done:
+                for victim in controller.take_preempts():
+                    vrec = members.get(victim)
+                    if (vrec is None or vrec["proc"].poll() is not None
+                            or victim in preempted):
+                        continue
+                    preempted.add(victim)
+                    log.write(
+                        "preempt", 1.0, member=victim,
+                        generation=coord.generation,
+                    )
+                    if verbose:
+                        print(
+                            f"supervisor: preempting {victim} — the "
+                            "scheduler is reclaiming its host"
+                        )
+                    # Clean-leave path with the same grace escalation an
+                    # eviction gets: SIGTERM → elastic flag → leave at
+                    # the commit boundary; a wedged victim is killed.
+                    soft_kill(vrec)
             # --- hang detection over TCP beats ------------------------------
             if policy.heartbeat_timeout is not None:
                 for member_id in coord.stale_members(
@@ -1155,12 +1262,24 @@ def supervise_elastic(
                         hang_killed.add(member_id)
                         soft_kill(rec)
             for rec in members.values():
-                if (
-                    rec.get("terminated_at") is not None
-                    and rec["proc"].poll() is None
-                    and now - rec["terminated_at"] > policy.grace_seconds
-                ):
+                t0 = rec.get("terminated_at")
+                if t0 is None or rec["proc"].poll() is not None:
+                    continue
+                if now - t0 > policy.grace_seconds:
                     rec["proc"].kill()
+                elif now - rec.get("resignaled_at", t0) > 3.0:
+                    # One SIGTERM is not guaranteed delivery: if it lands
+                    # inside jax.distributed.initialize, XLA's preemption
+                    # notifier owns the signal and silently eats it (the
+                    # elastic loop only re-installs its own handler after
+                    # ensure_world returns). Keep re-sending TERM through
+                    # the grace window so a late one still triggers the
+                    # clean leave — otherwise the SIGKILL escalation
+                    # strands the peers in a collective until the gloo
+                    # timeout aborts them, turning a free preemption into
+                    # charged crashes.
+                    rec["resignaled_at"] = now
+                    rec["proc"].terminate()
             # --- policy engine: observe → (warn → evict/promote) ------------
             if engine is not None and not job_done:
                 engine.poll(
@@ -1169,14 +1288,40 @@ def supervise_elastic(
                 )
             # --- grow back --------------------------------------------------
             if not job_done:
+                cap = max_ranks
+                if controller is not None:
+                    ctrl_cap = controller.capacity()
+                    if ctrl_cap is not None:
+                        # The job's live host allocation is the real
+                        # ceiling: a respawn with no host unit to land on
+                        # is dropped (take_grows() relaunches when the
+                        # scheduler grants hosts back).
+                        cap = min(cap, ctrl_cap)
+
+                def joining() -> int:
+                    return sum(
+                        1 for m in members
+                        if coord.member_status(m)[0] == "unknown"
+                    )
+
                 due = [r for r in respawn_queue if r[0] <= now]
                 respawn_queue = [r for r in respawn_queue if r[0] > now]
                 for _, slot in due:
-                    if coord.live_count() + sum(
-                        1 for m in members
-                        if coord.member_status(m)[0] == "unknown"
-                    ) < max_ranks:
+                    if coord.live_count() + joining() < cap:
                         launch(slot)
+                if controller is not None:
+                    for _ in range(controller.take_grows()):
+                        if coord.live_count() + joining() >= cap:
+                            break
+                        used = {rec["slot"] for rec in members.values()}
+                        slot = 0
+                        while slot in used:
+                            slot += 1
+                        launch(slot)
+                        log.write(
+                            "regrow", 1.0, slot=slot,
+                            generation=coord.generation,
+                        )
             # --- end states -------------------------------------------------
             if not job_done:
                 # A member that reported leave(done) over TCP finished
@@ -1208,7 +1353,11 @@ def supervise_elastic(
                         f"{total_restarts} per-rank restart(s)"
                     )
                 return teardown(0)
-            if not members and not respawn_queue:
+            if not members and not respawn_queue and controller is None:
+                # With a fleet controller an empty world is a WAIT state
+                # (the job's hosts died or were reclaimed; take_grows()
+                # will repopulate it) — the budget-spent check below still
+                # ends a job that can never recover.
                 if verbose:
                     print(
                         f"supervisor: fleet extinct (last failure "

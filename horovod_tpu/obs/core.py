@@ -186,6 +186,34 @@ METRICS: dict[str, MetricSpec] = _decl([
                "checkpoint manifest carries the stream geometry "
                "(epoch x steps_per_epoch + step), the within-epoch step "
                "otherwise.", "supervisor"),
+    # --- fleetd (launch/fleetd.py GET /fleetd + /metrics) -------------------
+    MetricSpec("hvt_fleetd_jobs", "gauge",
+               "Jobs under the fleet scheduler, by lifecycle state "
+               "(pending/running/done/failed).", "supervisor",
+               labels=("state",)),
+    MetricSpec("hvt_fleetd_hosts", "gauge",
+               "Pool hosts by state: up (schedulable) or quarantined "
+               "(declared lost, cooling down).", "supervisor",
+               labels=("state",)),
+    MetricSpec("hvt_fleetd_preempts_total", "counter",
+               "Preemption decisions journaled: a lower-priority elastic "
+               "job shrunk (clean leave, zero budget spend) to free "
+               "hosts for a higher-priority one.", "supervisor"),
+    MetricSpec("hvt_fleetd_regrows_total", "counter",
+               "Regrow grants journaled: freed hosts handed back to a "
+               "shrunken job (POST /grow -> take_grows).", "supervisor"),
+    MetricSpec("hvt_fleetd_host_lost_total", "counter",
+               "Host-loss events journaled: every rank on the host died "
+               "together, charged to the owning job ONCE, host "
+               "quarantined.", "supervisor"),
+    MetricSpec("hvt_fleetd_job_size", "gauge",
+               "Host units currently allocated to each job.",
+               "supervisor", labels=("job",)),
+    MetricSpec("hvt_fleetd_job_restart_budget_remaining", "gauge",
+               "Each job's OWN remaining no-progress restart budget "
+               "(scraped from its supervisor; isolation means a peer's "
+               "failures never move it).", "supervisor",
+               labels=("job",)),
     # --- serving (launch/serve.py /metrics) ---------------------------------
     MetricSpec("hvt_serve_requests_total", "counter",
                "HTTP requests served, by route and status code.",

@@ -44,6 +44,18 @@ Kinds:
   `PreemptionCheckpointCallback` installed that is the graceful save-and-
   stop path, without it the process dies of SIGTERM and the supervisor
   classifies a preemption.
+* ``hostdown`` — whole-HOST failure: SIGKILL every rank sharing the
+  firing rank's host in one stroke (peers first, self last), so a fleet
+  supervisor sees the co-resident deaths land together — the node-loss
+  shape `hvt-launch fleet` must reclassify as ONE ``host_lost`` event
+  (charged once, host quarantined) instead of N independent crashes.
+  Host membership comes from a pid registry: when the launcher exports
+  ``HVT_FAULT_HOST_PIDS`` (a per-host directory — the fleet scheduler
+  points every rank it places on host H at ``<dir>/H``), each rank's
+  fault callback registers its pid there at epoch begin, and the firing
+  rank kills every registered pid that is still alive (stale files from
+  exited members are skipped and swept). Without the registry the kind
+  degrades to a self-SIGKILL — a one-rank host going down.
 * ``corrupt`` — damage the newest checkpoint file/shard under
   ``PS_MODEL_PATH`` (truncate to half, bit-flip the first surviving byte
   — both without touching its ``.sha256`` sidecar), then SIGKILL self: the
@@ -95,9 +107,11 @@ from horovod_tpu.training.callbacks import Callback
 
 ENV_FAULT = "HVT_FAULT"
 ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
+ENV_FAULT_HOST_PIDS = "HVT_FAULT_HOST_PIDS"
 
-KINDS = ("kill", "hang", "leave", "corrupt", "reorder")  # plus exitN,
-# corrupt@<target> (parse_plan / corrupt_target) and slow:MS (slow_ms)
+KINDS = ("kill", "hang", "leave", "corrupt", "reorder", "hostdown")
+# plus exitN, corrupt@<target> (parse_plan / corrupt_target) and
+# slow:MS (slow_ms)
 
 # Process-wide leave intent (the `leave` fault kind under an elastic
 # launch). The elastic epoch-end agreement consumes it; tests reset it.
@@ -202,10 +216,47 @@ def parse_plan(spec: str) -> FaultPlan:
         else:
             raise ValueError(
                 f"HVT_FAULT kind must be kill, hang, leave, reorder, "
-                f"corrupt[@epochN][/shardM], slow:MS or exitN, "
+                f"hostdown, corrupt[@epochN][/shardM], slow:MS or exitN, "
                 f"got {kind!r}"
             )
     return FaultPlan(rank=rank, epoch=epoch, kind=kind, step=step)
+
+
+def register_host_pid(pid_dir: str, pid: int | None = None) -> str:
+    """Record ``pid`` (default: this process) as resident on the host the
+    ``pid_dir`` stands for — one empty file named after the pid, existence
+    is the payload. Called by every rank's fault callback when the
+    launcher exports ``HVT_FAULT_HOST_PIDS``; the ``hostdown`` kind reads
+    the directory back to find its co-resident victims. Registration
+    sweeps entries whose processes are gone, so a respawned member's
+    stale predecessor can never be 'killed' again (pid-reuse hygiene)."""
+    pid = os.getpid() if pid is None else pid
+    os.makedirs(pid_dir, exist_ok=True)
+    for name in os.listdir(pid_dir):
+        if not name.isdigit():
+            continue
+        try:
+            os.kill(int(name), 0)
+        except ProcessLookupError:
+            try:
+                os.remove(os.path.join(pid_dir, name))
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # alive, not ours to probe — keep it
+    path = os.path.join(pid_dir, str(pid))
+    # Empty marker touch: the filename IS the record, nothing to tear.
+    open(path, "w").close()  # hvt: noqa[HVT005]
+    return path
+
+
+def host_pids(pid_dir: str) -> list[int]:
+    """Every pid registered in a host's pid directory, sorted."""
+    try:
+        names = os.listdir(pid_dir)
+    except OSError:
+        return []
+    return sorted(int(n) for n in names if n.isdigit())
 
 
 def corrupt_target(kind: str) -> tuple:
@@ -321,6 +372,16 @@ class FaultInjectionCallback(Callback):
 
     def on_epoch_begin(self, epoch: int, logs=None):
         self._epoch = epoch
+        pid_dir = registry.get_str(ENV_FAULT_HOST_PIDS)
+        if pid_dir:
+            # EVERY rank (not just the fault's target) keeps its host
+            # residency registered — the `hostdown` stroke needs the
+            # victims' pids, and a registry refreshed per epoch also
+            # covers members respawned onto the host mid-run.
+            try:
+                register_host_pid(pid_dir)
+            except OSError:
+                pass  # chaos bookkeeping must never fail training
 
     def on_batch_end(self, batch: int, logs=None):
         if self.plan.slow_ms is not None:
@@ -378,6 +439,27 @@ class FaultInjectionCallback(Callback):
         )
         if self.plan.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
+        elif self.plan.kind == "hostdown":
+            # Whole-host stroke: SIGKILL every co-resident rank first,
+            # self last, so the supervisor's next poll sees the host's
+            # deaths together (the one-`host_lost` classification window).
+            pid_dir = registry.get_str(ENV_FAULT_HOST_PIDS)
+            me = os.getpid()
+            host = registry.get_str("HVT_FLEET_HOST")
+            for pid in (host_pids(pid_dir) if pid_dir else []):
+                if pid == me:
+                    continue
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    print(
+                        f"FaultInjection: hostdown"
+                        f"{f' ({host})' if host else ''} killed "
+                        f"co-resident pid {pid}",
+                        flush=True,
+                    )
+                except (ProcessLookupError, PermissionError):
+                    continue  # stale registration — already gone
+            os.kill(me, signal.SIGKILL)
         elif self.plan.kind == "hang":
             self._wedge()
         elif self.plan.kind == "reorder":

@@ -722,7 +722,8 @@ class TestLeaveFault:
 # tier-1 time. Behavior knobs via env: FAKE_EPOCHS/FAKE_PACE, FAKE_LEAVER
 # (member id that leaves after one epoch; one-shot via FAKE_STAMP; "ALL"
 # matches every member), FAKE_CRASHER (exits 7 instead), FAKE_WEDGER
-# (joins, then stops beating forever).
+# (joins, then stops beating forever), FAKE_DEAF (swallows the first
+# SIGTERM, leaves cleanly on the second — stamps via FAKE_DEAF_STAMP).
 FAKE_WORKER = """
 import json, os, socket, sys, time
 from types import SimpleNamespace
@@ -766,6 +767,27 @@ client = MiniClient()
 epochs = int(os.environ.get("FAKE_EPOCHS", "4"))
 pace = float(os.environ.get("FAKE_PACE", "0.1"))
 stamp = os.environ.get("FAKE_STAMP")
+
+if os.environ.get("FAKE_DEAF") == member:
+    # Impersonates XLA's preemption notifier swallowing the FIRST
+    # SIGTERM (as jax.distributed.initialize does mid-startup): the
+    # first TERM only re-arms the handler; a SECOND one is honored as
+    # a clean leave. Exercises the supervisor's in-grace TERM re-send.
+    import signal
+
+    def _honor(signum, frame):
+        open(os.environ["FAKE_DEAF_STAMP"] + ".left", "w").close()
+        try:
+            client.leave(reason="preempted")
+        except Exception:
+            pass
+        sys.exit(143)
+
+    def _swallow(signum, frame):
+        signal.signal(signal.SIGTERM, _honor)
+
+    signal.signal(signal.SIGTERM, _swallow)
+    open(os.environ["FAKE_DEAF_STAMP"], "w").close()  # armed marker
 
 def fire_once(kind_env):
     target = os.environ.get(kind_env)

@@ -1,0 +1,856 @@
+"""Unit tests for `hvt-launch fleet` (launch/fleetd.py) — the multi-job
+control plane: the pure scheduler (priority, placement math, preemption
+planning, quarantine), the per-job `JobController` (host units,
+host-loss classification, preempt/regrow ledgers), budget isolation,
+fleet-journal crash recovery, spec validation — plus the satellites
+that ride along: the ``hostdown`` fault kind and `ci_gate`'s ``job=``
+scoping. No training processes anywhere in this file; the full fleet
+e2e lives in tests/test_fleetd_e2e.py (slow lane)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.launch import ci_gate, fleetd, supervisor
+from horovod_tpu.obs import prom as obs_prom
+from horovod_tpu.testing import faults
+
+
+# --------------------------------------------------------------------------
+# satellite: the hostdown fault kind
+# --------------------------------------------------------------------------
+
+class TestHostdownFault:
+    def test_parse_plan_accepts_hostdown(self):
+        plan = faults.parse_plan("0:4:hostdown")
+        assert plan.kind == "hostdown"
+        assert plan.rank == 0 and plan.epoch == 4
+        assert "hostdown" in faults.KINDS
+
+    def test_parse_plan_rejects_unknown_kind(self):
+        with pytest.raises(ValueError, match="hostdown"):
+            faults.parse_plan("0:0:hostdowner")
+
+    def test_register_host_pid_and_listing(self, tmp_path):
+        pid_dir = str(tmp_path / "h0")
+        path = faults.register_host_pid(pid_dir)
+        assert os.path.exists(path)
+        assert faults.host_pids(pid_dir) == [os.getpid()]
+        # Non-pid noise in the directory is ignored.
+        (tmp_path / "h0" / "README").write_text("not a pid")
+        assert faults.host_pids(pid_dir) == [os.getpid()]
+        assert faults.host_pids(str(tmp_path / "missing")) == []
+
+    def test_registration_sweeps_dead_pids(self, tmp_path):
+        pid_dir = str(tmp_path / "h0")
+        dead = subprocess.Popen(["true"])
+        dead.wait()
+        faults.register_host_pid(pid_dir, pid=dead.pid)
+        # Registering the live self sweeps the dead predecessor.
+        faults.register_host_pid(pid_dir)
+        assert faults.host_pids(pid_dir) == [os.getpid()]
+
+    def test_hostdown_inert_on_wrong_rank_and_epoch(self, tmp_path):
+        # Wrong rank: never fires (we are rank 0 in-process).
+        cb = faults.FaultInjectionCallback(faults.parse_plan("5:0:hostdown"))
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)
+        # Wrong epoch: never fires.
+        cb = faults.FaultInjectionCallback(faults.parse_plan("0:3:hostdown"))
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)
+        # Still alive — the faults were inert.
+
+    def test_hostdown_one_shot_stamp(self, tmp_path):
+        stamp = tmp_path / "stamp"
+        stamp.write_text("")
+        cb = faults.FaultInjectionCallback(
+            faults.parse_plan("0:0:hostdown"), stamp=str(stamp)
+        )
+        cb.on_epoch_begin(0)
+        cb.on_batch_end(0)  # pre-existing stamp: spent — must not fire
+
+    def test_hostdown_fires_kills_registered_cohort(self, tmp_path):
+        """The whole-host stroke, in a sacrificial child: the firing rank
+        SIGKILLs every registered co-resident pid, then itself."""
+        pid_dir = str(tmp_path / "h0")
+        sleeper = subprocess.Popen([sys.executable, "-c",
+                                    "import time; time.sleep(600)"])
+        try:
+            faults.register_host_pid(pid_dir, pid=sleeper.pid)
+            script = textwrap.dedent("""
+                from horovod_tpu.testing import faults
+                cb = faults.FaultInjectionCallback(
+                    faults.parse_plan("0:0:hostdown"))
+                cb.on_epoch_begin(0)
+                cb.on_batch_end(0)
+                raise SystemExit(7)  # unreachable: _fire SIGKILLs self
+            """)
+            env = dict(os.environ, HVT_FAULT_HOST_PIDS=pid_dir,
+                       JAX_PLATFORMS="cpu")
+            proc = subprocess.run([sys.executable, "-c", script], env=env,
+                                  timeout=60)
+            assert proc.returncode == -signal.SIGKILL
+            assert sleeper.wait(timeout=10) == -signal.SIGKILL
+        finally:
+            if sleeper.poll() is None:
+                sleeper.kill()
+                sleeper.wait()
+
+    def test_hostdown_degrades_to_self_kill_without_registry(self):
+        script = textwrap.dedent("""
+            from horovod_tpu.testing import faults
+            cb = faults.FaultInjectionCallback(
+                faults.parse_plan("0:0:hostdown"))
+            cb.on_epoch_begin(0)
+            cb.on_batch_end(0)
+        """)
+        env = {k: v for k, v in os.environ.items()
+               if k != "HVT_FAULT_HOST_PIDS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              timeout=60)
+        assert proc.returncode == -signal.SIGKILL
+
+
+# --------------------------------------------------------------------------
+# satellite: ci_gate job= scoping
+# --------------------------------------------------------------------------
+
+def _write_journal(path, records):
+    with open(path, "w") as f:  # hvt: noqa[HVT005] — test fixture
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+class TestCiGateJobScoping:
+    def test_read_metric_filters_by_job(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        _write_journal(path, [
+            {"name": "preempt", "value": 1.0, "job": "a"},
+            {"name": "preempt", "value": 2.0, "job": "b"},
+            {"name": "preempt", "value": 3.0},
+            {"name": "other", "value": 9.0, "job": "a"},
+        ])
+        assert ci_gate.read_metric(path, "preempt") == [1.0, 2.0, 3.0]
+        assert ci_gate.read_metric(path, "preempt", job="a") == [1.0]
+        assert ci_gate.read_metric(path, "preempt", job="b") == [2.0]
+        # A scoped read never matches records without attribution.
+        assert ci_gate.read_metric(path, "preempt", job="c") == []
+
+    def test_check_metrics_scoped_count(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        _write_journal(path, [
+            {"name": "regrow", "value": 1.0, "job": "lm"},
+            {"name": "regrow", "value": 1.0, "job": "lm"},
+            {"name": "regrow", "value": 1.0, "job": "hi"},
+        ])
+        ok, value = ci_gate.check_metrics(
+            path, "regrow", (2.0, 2.0), "count", job="lm")
+        assert ok and value == 2.0
+        ok, value = ci_gate.check_metrics(path, "regrow", (3.0, 3.0),
+                                          "count")
+        assert ok and value == 3.0
+
+    def test_run_checks_rule_job_key(self, tmp_path, capsys):
+        path = str(tmp_path / "j.jsonl")
+        _write_journal(path, [
+            {"name": "preempt", "value": 1.0, "job": "lm"},
+            {"name": "preempt", "value": 1.0, "job": "hi"},
+        ])
+        assert ci_gate.run_checks(path, {
+            "preempt": {"target": "1..1", "aggregate": "count",
+                        "job": "lm"},
+        })
+        assert "job=lm" in capsys.readouterr().out
+        # The same rule WITHOUT scoping sees both jobs' records — the
+        # single-job grammar is unchanged, it just counts everything.
+        assert not ci_gate.run_checks(path, {
+            "preempt": {"target": "1..1", "aggregate": "count"},
+        })
+
+
+# --------------------------------------------------------------------------
+# the pure scheduler
+# --------------------------------------------------------------------------
+
+def _pool(**hosts):
+    return {h: {"slots": n, "until": 0.0} for h, n in hosts.items()}
+
+
+def _job(name, priority, state, alloc=(), minimum=1, target=2,
+         requested=None, preemptible=True, arrival=0.0):
+    alloc = list(alloc)
+    return {
+        "name": name, "priority": priority, "state": state,
+        "arrival": arrival, "alloc": alloc, "min": minimum,
+        "target": target,
+        "requested": len(alloc) if requested is None else requested,
+        "preemptible": preemptible,
+    }
+
+
+class TestFreeUnits:
+    def test_subtracts_allocations(self):
+        free = fleetd.free_units(_pool(h0=2, h1=2),
+                                 {"a": ["h0", "h1"]}, now=100.0)
+        assert free == {"h0": 1, "h1": 1}
+
+    def test_quarantined_host_contributes_nothing(self):
+        pool = _pool(h0=2, h1=2)
+        pool["h0"]["until"] = 200.0
+        assert fleetd.free_units(pool, {}, now=100.0) == {"h1": 2}
+        # Cooldown expiry makes it schedulable again.
+        assert fleetd.free_units(pool, {}, now=200.5) == {"h0": 2, "h1": 2}
+
+    def test_full_host_omitted(self):
+        assert fleetd.free_units(_pool(h0=1), {"a": ["h0"]}, 0.0) == {}
+
+
+class TestSchedule:
+    def test_places_pending_at_full_target(self):
+        acts = fleetd.schedule(
+            [_job("a", 1, "pending", target=3)], _pool(h0=2, h1=2), 0.0)
+        assert acts == [{"op": "place", "job": "a",
+                         "hosts": ["h0", "h0", "h1"]}]
+
+    def test_placement_packs_most_free_host_first(self):
+        # h1 has more free units: a 2-unit gang lands whole on h1, not
+        # one slot on each host.
+        acts = fleetd.schedule(
+            [_job("busy", 1, "running", alloc=["h0"], target=1),
+             _job("a", 2, "pending", target=2)],
+            _pool(h0=2, h1=2), 0.0)
+        assert {"op": "place", "job": "a", "hosts": ["h1", "h1"]} in acts
+
+    def test_priority_order_when_capacity_for_one(self):
+        acts = fleetd.schedule(
+            [_job("lo", 1, "pending", target=2, minimum=2),
+             _job("hi", 9, "pending", target=2, minimum=2)],
+            _pool(h0=2), 0.0)
+        assert acts[0] == {"op": "place", "job": "hi",
+                          "hosts": ["h0", "h0"]}
+        assert {"op": "wait", "job": "lo", "need": 2} in acts
+
+    def test_arrival_delay_holds_admission(self):
+        acts = fleetd.schedule(
+            [_job("a", 1, "pending", target=1, arrival=50.0)],
+            _pool(h0=1), now=10.0)
+        assert acts == []
+        acts = fleetd.schedule(
+            [_job("a", 1, "pending", target=1, arrival=50.0)],
+            _pool(h0=1), now=50.0)
+        assert acts == [{"op": "place", "job": "a", "hosts": ["h0"]}]
+
+    def test_preempts_lower_priority_elastic_to_min(self):
+        acts = fleetd.schedule(
+            [_job("lm", 1, "running", alloc=["h0", "h0", "h1", "h1"],
+                  minimum=1, target=4),
+             _job("hi", 10, "pending", target=2, minimum=2)],
+            _pool(h0=2, h1=2), 0.0)
+        assert {"op": "shrink", "job": "lm", "target": 2,
+                "for": "hi"} in acts
+        assert {"op": "wait", "job": "hi", "need": 2} in acts
+
+    def test_never_preempts_below_min(self):
+        acts = fleetd.schedule(
+            [_job("lm", 1, "running", alloc=["h0", "h0"], minimum=2,
+                  target=2),
+             _job("hi", 10, "pending", target=2, minimum=2)],
+            _pool(h0=2), 0.0)
+        assert all(a["op"] != "shrink" for a in acts)
+
+    def test_never_preempts_equal_or_higher_priority(self):
+        acts = fleetd.schedule(
+            [_job("peer", 5, "running", alloc=["h0", "h0"], minimum=1,
+                  target=2),
+             _job("hi", 5, "pending", target=2, minimum=2)],
+            _pool(h0=2), 0.0)
+        assert all(a["op"] != "shrink" for a in acts)
+
+    def test_non_elastic_job_is_not_preemptible(self):
+        acts = fleetd.schedule(
+            [_job("static", 1, "running", alloc=["h0", "h0"],
+                  preemptible=False),
+             _job("hi", 10, "pending", target=2, minimum=2)],
+            _pool(h0=2), 0.0)
+        assert all(a["op"] != "shrink" for a in acts)
+
+    def test_in_flight_preemption_is_not_repeated(self):
+        """The over-preemption regression: once a victim's requested size
+        is below its allocation (shrink acknowledged, clean leave still
+        landing), the claimant counts those in-flight units instead of
+        squeezing the victim further every tick."""
+        acts = fleetd.schedule(
+            [_job("lm", 1, "running", alloc=["h0", "h0", "h1", "h1"],
+                  minimum=1, target=4, requested=2),
+             _job("hi", 10, "pending", target=2, minimum=2)],
+            _pool(h0=2, h1=2), 0.0)
+        assert all(a["op"] != "shrink" for a in acts)
+        assert {"op": "wait", "job": "hi", "need": 2} in acts
+
+    def test_degraded_admission_when_nothing_reclaimable(self):
+        acts = fleetd.schedule(
+            [_job("a", 1, "pending", target=4, minimum=1)],
+            _pool(h0=1), 0.0)
+        assert acts == [{"op": "place", "job": "a", "hosts": ["h0"]}]
+
+    def test_waits_when_below_min_and_nothing_reclaimable(self):
+        acts = fleetd.schedule(
+            [_job("a", 1, "pending", target=4, minimum=2)],
+            _pool(h0=1), 0.0)
+        assert acts == [{"op": "wait", "job": "a", "need": 4}]
+
+    def test_grows_shrunken_job_when_units_free(self):
+        acts = fleetd.schedule(
+            [_job("lm", 1, "running", alloc=["h0", "h0"], minimum=1,
+                  target=4)],
+            _pool(h0=2, h1=2), 0.0)
+        assert acts == [{"op": "grow", "job": "lm",
+                         "hosts": ["h1", "h1"]}]
+
+    def test_high_priority_regrow_preempts_lower(self):
+        # Host loss shrank `hi`; regrowing it may preempt `lo`.
+        acts = fleetd.schedule(
+            [_job("lo", 1, "running", alloc=["h1", "h1"], minimum=1,
+                  target=2),
+             _job("hi", 10, "running", alloc=["h0"], minimum=1,
+                  target=2)],
+            _pool(h0=2, h1=2), 0.0)
+        assert {"op": "grow", "job": "hi", "hosts": ["h0"]} in acts
+
+    def test_quarantined_host_not_schedulable(self):
+        pool = _pool(h0=2)
+        pool["h0"]["until"] = 500.0
+        acts = fleetd.schedule(
+            [_job("a", 1, "pending", target=2, minimum=1)], pool, 100.0)
+        assert acts == [{"op": "wait", "job": "a", "need": 2}]
+        acts = fleetd.schedule(
+            [_job("a", 1, "pending", target=2, minimum=1)], pool, 500.5)
+        assert acts == [{"op": "place", "job": "a",
+                         "hosts": ["h0", "h0"]}]
+
+
+# --------------------------------------------------------------------------
+# JobController — host units, preempt/regrow ledgers, host_lost rules
+# --------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self, code=None):
+        self.code = code
+        self.pid = 12345
+
+    def poll(self):
+        return self.code
+
+
+def _controller(monkeypatch, hosts, fleet_dir="/tmp/fleet-unit"):
+    spawned = []
+
+    def fake_spawn(argv, env, member_id, slot, tag_output=True):
+        spawned.append((member_id, slot, dict(env)))
+        return _FakeProc()
+
+    monkeypatch.setattr(supervisor, "_spawn_member_local", fake_spawn)
+    ctl = fleetd.JobController("job", hosts, fleet_dir, ["python", "x.py"])
+    return ctl, spawned
+
+
+class TestJobController:
+    def test_spawn_fills_hosts_in_sorted_order(self, monkeypatch):
+        ctl, spawned = _controller(monkeypatch, ["h1", "h0", "h0"])
+        for i in range(3):
+            ctl.spawn(f"m{i}", i, {})
+        assert [ctl._members[f"m{i}"]["host"] for i in range(3)] == \
+            ["h0", "h0", "h1"]
+        env = spawned[0][2]
+        assert env["HVT_FLEET_HOST"] == "h0"
+        assert env["HVT_FAULT_HOST_PIDS"].endswith(
+            os.path.join("hostpids", "h0"))
+        assert ctl.capacity() == 3
+
+    def test_take_preempts_releases_unoccupied_units_first(
+            self, monkeypatch):
+        ctl, _ = _controller(monkeypatch, ["h0", "h0", "h1"])
+        ctl.spawn("m0", 0, {})  # h0 — the only live member
+        ctl.shrink(1)
+        assert ctl.take_preempts() == []  # two empty units freed, no kill
+        snap = ctl.snapshot()
+        assert sorted(snap["released"]) == ["h0", "h1"]
+        assert snap["alloc"] == ["h0"]
+
+    def test_take_preempts_victims_reverse_host_order(self, monkeypatch):
+        ctl, _ = _controller(monkeypatch, ["h0", "h0", "h1", "h1"])
+        for i in range(4):
+            ctl.spawn(f"m{i}", i, {})
+        ctl.shrink(2)
+        victims = ctl.take_preempts()
+        # Live victims come off the highest-named host first, newest
+        # member first — releases concentrate on whole hosts.
+        assert victims == ["m3", "m2"]
+        assert ctl.alloc == ["h0", "h0"]
+        # The units left the allocation immediately; the RELEASE ledger
+        # waits for the members to actually vacate.
+        assert ctl.snapshot()["released"] == []
+        ctl.on_exit("m3", "preempt")
+        ctl.on_exit("m2", "preempt")
+        assert ctl.snapshot()["released"] == ["h1", "h1"]
+
+    def test_take_preempts_idempotent(self, monkeypatch):
+        ctl, _ = _controller(monkeypatch, ["h0", "h0"])
+        ctl.spawn("m0", 0, {})
+        ctl.spawn("m1", 1, {})
+        ctl.shrink(1)
+        assert ctl.take_preempts() == ["m1"]
+        assert ctl.take_preempts() == []  # already at target
+
+    def test_classify_lone_sigkill_stays_oom(self, monkeypatch):
+        ctl, _ = _controller(monkeypatch, ["h0", "h1"])
+        ctl.spawn("m0", 0, {})
+        ctl.spawn("m1", 1, {})
+        ctl._members["m0"]["proc"].code = -signal.SIGKILL
+        # m0 is alone on h0: no cohort, classic classification keeps.
+        assert ctl.classify_exit("m0", -signal.SIGKILL, "oom-kill") is None
+
+    def test_classify_host_cohort_charges_once(self, monkeypatch):
+        ctl, _ = _controller(monkeypatch, ["h0", "h0", "h1"])
+        for i in range(3):
+            ctl.spawn(f"m{i}", i, {})
+        ctl._members["m0"]["proc"].code = -signal.SIGKILL
+        ctl._members["m1"]["proc"].code = 128 + signal.SIGKILL
+        first = ctl.classify_exit("m0", -signal.SIGKILL, "oom-kill")
+        assert first == ("host_lost", True)
+        assert "h0" not in ctl.alloc and ctl.alloc == ["h1"]
+        assert ctl.snapshot()["lost_hosts"] == ["h0"]
+        sibling = ctl.classify_exit(
+            "m1", 128 + signal.SIGKILL, "oom-kill")
+        assert sibling == ("host_lost", False)
+        # The incident reported the host exactly once.
+        assert ctl.snapshot()["lost_hosts"] == ["h0"]
+
+    def test_classify_sibling_after_first_reap_rides_free(
+            self, monkeypatch):
+        # The real reap interleaving: the first victim is classified AND
+        # popped (on_exit) before the sibling's death is looked at. The
+        # sibling is then the host's last live member — the lost-host
+        # ledger, not the cohort size, must carry the classification.
+        ctl, _ = _controller(monkeypatch, ["h0", "h0", "h1"])
+        for i in range(3):
+            ctl.spawn(f"m{i}", i, {})
+        ctl._members["m0"]["proc"].code = -signal.SIGKILL
+        ctl._members["m1"]["proc"].code = -signal.SIGKILL
+        assert ctl.classify_exit(
+            "m0", -signal.SIGKILL, "oom-kill") == ("host_lost", True)
+        ctl.on_exit("m0", "host_lost")
+        assert ctl.classify_exit(
+            "m1", -signal.SIGKILL, "oom-kill") == ("host_lost", False)
+
+    def test_regrown_host_sheds_lost_marker(self, monkeypatch):
+        # After quarantine the scheduler may hand the SAME host back; a
+        # later lone SIGKILL there is an oom-kill again, not a free ride
+        # on the old incident.
+        ctl, _ = _controller(monkeypatch, ["h0", "h0"])
+        ctl.spawn("m0", 0, {})
+        ctl.spawn("m1", 1, {})
+        ctl._members["m0"]["proc"].code = -signal.SIGKILL
+        ctl._members["m1"]["proc"].code = -signal.SIGKILL
+        assert ctl.classify_exit(
+            "m0", -signal.SIGKILL, "oom-kill") == ("host_lost", True)
+        ctl.on_exit("m0", "host_lost")
+        ctl.on_exit("m1", "host_lost")
+        ctl.grow(["h0", "h1"])
+        ctl.spawn("m2", 0, {})  # lands on h0 again
+        ctl._members["m2"]["proc"].code = -signal.SIGKILL
+        assert ctl._members["m2"]["host"] == "h0"
+        assert ctl.classify_exit(
+            "m2", -signal.SIGKILL, "oom-kill") is None
+
+    def test_classify_ignores_non_sigkill_and_preempting(
+            self, monkeypatch):
+        ctl, _ = _controller(monkeypatch, ["h0", "h0"])
+        ctl.spawn("m0", 0, {})
+        ctl.spawn("m1", 1, {})
+        assert ctl.classify_exit("m0", 1, "crash") is None
+        ctl._members["m0"]["preempting"] = True
+        ctl._members["m0"]["proc"].code = -signal.SIGKILL
+        ctl._members["m1"]["proc"].code = -signal.SIGKILL
+        assert ctl.classify_exit(
+            "m0", -signal.SIGKILL, "oom-kill") is None
+        # The surviving cohort is just m1 — lone, so no host_lost either.
+        assert ctl.classify_exit(
+            "m1", -signal.SIGKILL, "oom-kill") is None
+
+    def test_grow_queues_budget_free_launches(self, monkeypatch):
+        ctl, _ = _controller(monkeypatch, ["h0"])
+        ctl.spawn("m0", 0, {})
+        ctl.grow(["h1", "h1"])
+        assert ctl.capacity() == 3
+        assert ctl.take_grows() == 2
+        assert ctl.take_grows() == 0  # drained
+        ctl.spawn("m1", 1, {})
+        assert ctl._members["m1"]["host"] == "h1"
+
+
+# --------------------------------------------------------------------------
+# budget isolation
+# --------------------------------------------------------------------------
+
+class TestBudgetIsolation:
+    def test_flags_foreign_attribution(self, tmp_path):
+        path = str(tmp_path / "restarts.jsonl")
+        _write_journal(path, [
+            {"name": "restarts", "value": 1.0, "job": "mine"},
+            {"name": "restarts", "value": 1.0, "job": "other"},
+            {"name": "join", "value": 1.0},
+        ])
+        bad = fleetd.budget_isolation_violations("mine", path)
+        assert len(bad) == 1 and bad[0]["job"] == "other"
+
+    def test_clean_journal_passes(self, tmp_path):
+        path = str(tmp_path / "restarts.jsonl")
+        _write_journal(path, [
+            {"name": "restarts", "value": 1.0, "job": "mine"},
+        ])
+        assert fleetd.budget_isolation_violations("mine", path) == []
+        assert fleetd.budget_isolation_violations("mine", None) == []
+
+
+# --------------------------------------------------------------------------
+# spec validation
+# --------------------------------------------------------------------------
+
+def _fleet_spec(tmp_path, **overrides):
+    spec = {
+        "fleet": {"pool": {"h0": {"slots": 2}, "h1": {"slots": 2}},
+                  "dir": str(tmp_path / "state")},
+        "jobs": [
+            {"name": "lm", "priority": 1, "job": {
+                "command": "python train.py",
+                "elastic": {"min_ranks": 1, "max_ranks": 4},
+                "env": {"PS_MODEL_PATH": str(tmp_path / "lm")},
+            }},
+            {"name": "hi", "priority": 10, "delay_s": 5, "job": {
+                "command": "python train.py",
+                "elastic": {"min_ranks": 2, "max_ranks": 2},
+                "env": {"PS_MODEL_PATH": str(tmp_path / "hi")},
+            }},
+        ],
+    }
+    spec.update(overrides)
+    return spec
+
+
+class TestLoadEntries:
+    def test_parses_valid_spec(self, tmp_path):
+        cfg, entries = fleetd.load_entries(_fleet_spec(tmp_path))
+        assert cfg["pool"] == {"h0": 2, "h1": 2}
+        lm, hi = entries
+        assert (lm.min_units, lm.target_units, lm.elastic) == (1, 4, True)
+        assert (hi.min_units, hi.target_units) == (2, 2)
+        assert hi.delay_s == 5.0 and hi.priority == 10
+        assert lm.log_path.endswith("restarts.jsonl")
+
+    def test_static_job_min_equals_nprocs(self, tmp_path):
+        spec = _fleet_spec(tmp_path, jobs=[
+            {"name": "s", "job": {
+                "command": "python t.py", "nprocs": 3,
+                "env": {"PS_MODEL_PATH": str(tmp_path / "s")},
+            }},
+        ])
+        _, entries = fleetd.load_entries(spec)
+        assert (entries[0].min_units, entries[0].target_units) == (3, 3)
+        assert not entries[0].elastic
+
+    def test_reports_every_error_at_once(self, tmp_path):
+        spec = _fleet_spec(tmp_path)
+        spec["fleet"]["pool"] = {}
+        spec["jobs"][0]["job"]["hosts"] = ["a", "b"]
+        spec["jobs"][1]["name"] = "lm"  # duplicate
+        spec["jobs"].append({"priority": 3})  # nameless
+        with pytest.raises(ValueError) as err:
+            fleetd.load_entries(spec)
+        msg = str(err.value)
+        assert "pool" in msg
+        assert "hosts: conflicts" in msg
+        assert "duplicate name" in msg
+        assert "needs a name" in msg
+
+    def test_missing_journal_path_is_an_error(self, tmp_path):
+        spec = _fleet_spec(tmp_path, jobs=[
+            {"name": "j", "job": {"command": "python t.py",
+                                  "nprocs": 1}},
+        ])
+        with pytest.raises(ValueError, match="budget-isolation"):
+            fleetd.load_entries(spec)
+
+    def test_launcher_delegates_fleet_subcommand(self, tmp_path, capsys):
+        from horovod_tpu.launch import launcher
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("fleet: {pool: {}}\njobs: []\n")
+        assert launcher.main(["fleet", str(bad)]) == 1
+        assert "pool" in capsys.readouterr().out
+
+    def test_fleet_knobs_registered(self):
+        assert registry.get_float("HVT_FLEET_TICK_S") == 0.5
+        assert registry.get_float("HVT_FLEET_QUARANTINE_S") == 60.0
+        assert registry.get_raw("HVT_FLEET_HOST") is None
+        assert registry.get_raw("HVT_FAULT_HOST_PIDS") is None
+
+
+# --------------------------------------------------------------------------
+# fleetd journal recovery
+# --------------------------------------------------------------------------
+
+def _dead_pid():
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    return proc.pid
+
+
+class TestFleetdRecovery:
+    def test_fresh_run_wipes_finished_journal(self, tmp_path):
+        spec = _fleet_spec(tmp_path)
+        state_dir = str(tmp_path / "state")
+        os.makedirs(state_dir, exist_ok=True)
+        journal = os.path.join(state_dir, fleetd.JOURNAL_NAME)
+        _write_journal(journal, [
+            {"name": "fleet_start", "value": 1.0, "start": 100.0},
+            {"name": "fleet_done", "value": 1.0, "ok": True},
+        ])
+        daemon = fleetd.Fleetd(spec, verbose=False)
+        assert daemon._maybe_recover() is False
+        assert not os.path.exists(journal)
+        assert all(st["state"] == "pending"
+                   for st in daemon.jobs.values())
+
+    def test_recovery_replays_state_and_cursors(self, tmp_path):
+        spec = _fleet_spec(tmp_path)
+        state_dir = str(tmp_path / "state")
+        os.makedirs(state_dir, exist_ok=True)
+        journal = os.path.join(state_dir, fleetd.JOURNAL_NAME)
+        dead = _dead_pid()
+        _write_journal(journal, [
+            {"name": "fleet_start", "value": 1.0, "start": 100.0},
+            {"name": "place", "value": 4.0, "job": "lm",
+             "hosts": ["h0", "h0", "h1", "h1"], "pid": dead,
+             "ctl_port": 1, "status_port": 2},
+            {"name": "preempt", "value": 1.0, "victim": "lm", "job": "lm",
+             "target": 2, "for": "hi"},
+            {"name": "release", "value": 2.0, "job": "lm",
+             "hosts": ["h1", "h1"], "source": "ctl"},
+            {"name": "place", "value": 2.0, "job": "hi",
+             "hosts": ["h1", "h1"], "pid": dead, "ctl_port": 3,
+             "status_port": 4},
+            {"name": "host_lost", "value": 1.0, "job": "lm", "host": "h0",
+             "until": 9e12},
+            {"name": "regrow", "value": 1.0, "job": "lm",
+             "hosts": ["h1"]},
+        ])
+        daemon = fleetd.Fleetd(spec, verbose=False)
+        assert daemon._maybe_recover() is True
+        assert daemon.start_wall == 100.0
+        lm, hi = daemon.jobs["lm"], daemon.jobs["hi"]
+        # lm: placed on 4, shrunk to 2 (preempt), released 2, lost h0,
+        # regrown 1 — allocation is the journal's net: just the regrow.
+        assert lm["alloc"] == ["h1"]
+        assert lm["requested"] == 1  # regrow reset it to len(alloc)
+        assert lm["seen_released"] == 2  # ctl cursor survives the crash
+        assert lm["seen_lost"] == 1
+        assert hi["alloc"] == ["h1", "h1"]
+        # The lost host is still quarantined.
+        assert daemon.pool["h0"]["until"] == 9e12
+        # Both recorded pids are dead: adopted, then finished by the
+        # first tick through the normal gates path.
+        assert lm["adopted"] and hi["adopted"]
+        assert lm["pid"] is None and hi["pid"] is None
+
+    def test_recovery_marks_done_jobs_done(self, tmp_path):
+        spec = _fleet_spec(tmp_path)
+        state_dir = str(tmp_path / "state")
+        os.makedirs(state_dir, exist_ok=True)
+        journal = os.path.join(state_dir, fleetd.JOURNAL_NAME)
+        _write_journal(journal, [
+            {"name": "fleet_start", "value": 1.0, "start": 100.0},
+            {"name": "place", "value": 2.0, "job": "hi",
+             "hosts": ["h0", "h0"], "pid": _dead_pid()},
+            {"name": "release", "value": 2.0, "job": "hi",
+             "hosts": ["h0", "h0"], "source": "exit"},
+            {"name": "job_done", "value": 1.0, "job": "hi",
+             "exit_code": 0, "gates": True},
+        ])
+        daemon = fleetd.Fleetd(spec, verbose=False)
+        assert daemon._maybe_recover() is True
+        assert daemon.jobs["hi"]["state"] == "done"
+        assert daemon.jobs["hi"]["alloc"] == []
+        assert daemon.jobs["lm"]["state"] == "pending"
+
+
+# --------------------------------------------------------------------------
+# fleetd metrics
+# --------------------------------------------------------------------------
+
+class TestFleetdMetrics:
+    def test_series_from_journal_and_state(self, tmp_path):
+        journal = str(tmp_path / "fleet-journal.jsonl")
+        _write_journal(journal, [
+            {"name": "preempt", "value": 1.0, "job": "lm"},
+            {"name": "regrow", "value": 1.0, "job": "lm"},
+            {"name": "regrow", "value": 1.0, "job": "lm"},
+            {"name": "host_lost", "value": 1.0, "job": "lm"},
+        ])
+        jobs = {
+            "lm": {"state": "running", "alloc": ["h0", "h0"],
+                   "budget": 2.0},
+            "hi": {"state": "done", "alloc": [], "budget": None},
+        }
+        pool = {"h0": {"slots": 2, "until": 0.0},
+                "h1": {"slots": 2, "until": 9e12}}
+        text = obs_prom.render(fleetd.fleetd_metrics(
+            journal, jobs, pool, now=100.0))
+        assert "hvt_fleetd_preempts_total 1" in text
+        assert "hvt_fleetd_regrows_total 2" in text
+        assert "hvt_fleetd_host_lost_total 1" in text
+        assert 'hvt_fleetd_job_size{job="lm"} 2' in text
+        assert ('hvt_fleetd_job_restart_budget_remaining{job="lm"} 2'
+                in text)
+        assert 'hvt_fleetd_jobs{state="running"} 1' in text
+        assert 'hvt_fleetd_jobs{state="done"} 1' in text
+        assert 'hvt_fleetd_hosts{state="up"} 1' in text
+        assert 'hvt_fleetd_hosts{state="quarantined"} 1' in text
+
+
+# --------------------------------------------------------------------------
+# sticky leave intent: a preemption SIGTERM can never be dropped
+# --------------------------------------------------------------------------
+
+class TestStickyLeaveIntent:
+    """The fleet's preemption contract end: SIGTERM may land in the
+    rendezvous -> runtime-init -> trainer-build window where fit()'s
+    handler isn't installed yet (and `jax.distributed.initialize`
+    re-claims the signal for XLA's notifier). The intent must stick at
+    module scope and be honored at the next boundary — the alternative
+    is the grace escalation SIGKILLing the victim mid-collective and
+    crashing (and CHARGING) the survivors."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_flag(self):
+        from horovod_tpu.elastic import state as elastic_state
+        elastic_state.clear_leave_signal()
+        yield
+        elastic_state.clear_leave_signal()
+
+    def test_signal_leave_sticks_until_cleared(self):
+        from horovod_tpu.elastic import state as elastic_state
+        assert not elastic_state.leave_signaled()
+        elastic_state.signal_leave()
+        assert elastic_state.leave_signaled()
+        elastic_state.signal_leave(signal.SIGTERM, None)  # handler shape
+        assert elastic_state.leave_signaled()
+        elastic_state.clear_leave_signal()
+        assert not elastic_state.leave_signaled()
+
+    def test_callback_handler_sets_module_flag(self):
+        from horovod_tpu.elastic import state as elastic_state
+        cb = elastic_state.ElasticStateCallback(
+            elastic_state.ElasticState(), client=None,
+            commit_every=1, commit_every_steps=0, rescale_every_steps=0,
+        )
+        cb._handler(signal.SIGTERM, None)
+        assert cb._leave_requested
+        assert elastic_state.leave_signaled()
+
+    def test_run_exits_143_on_pending_leave_before_rendezvous(self):
+        from horovod_tpu.elastic import rescale
+        from horovod_tpu.elastic import state as elastic_state
+
+        class _Client:
+            member_id = "m0"
+
+            def __init__(self):
+                self.left = []
+
+            def leave(self, reason="leave"):
+                self.left.append(reason)
+
+            def sync(self, progress=0):
+                raise AssertionError(
+                    "a leave-pending member must not re-rendezvous")
+
+        client = _Client()
+        elastic_state.signal_leave()
+        with pytest.raises(SystemExit) as ex:
+            rescale.run(lambda state, world: None, client=client)
+        assert ex.value.code == 143
+        assert client.left == ["sigterm"]
+        # The intent was CONSUMED — a later in-process run starts clean.
+        assert not elastic_state.leave_signaled()
+
+    def test_preempt_term_resent_through_swallowed_first_signal(
+        self, tmp_path
+    ):
+        """Regression for the fleet e2e's charged-crash failure: the
+        victim's first SIGTERM is swallowed (exactly what XLA's
+        preemption notifier does while jax.distributed.initialize is in
+        flight). The supervisor must RE-SEND TERM inside the grace
+        window so the clean leave still happens — escalating straight
+        to SIGKILL strands the survivors in a collective until the gloo
+        timeout aborts them, turning a free preemption into charged
+        crashes."""
+        from test_elastic import write_fake_worker
+
+        class _DeafPreempter:
+            """Preempts m1 only once its deaf TERM trap is armed, then
+            caps capacity at 1 so the freed slot is not backfilled."""
+
+            def __init__(self, armed_path):
+                self.armed_path = armed_path
+                self.fired = False
+
+            def take_preempts(self):
+                if not self.fired and os.path.exists(self.armed_path):
+                    self.fired = True
+                    return ["m1"]
+                return []
+
+            def capacity(self):
+                return 1 if self.fired else 2
+
+            def take_grows(self):
+                return 0
+
+            def classify_exit(self, member_id, code, kind):
+                return None
+
+            def on_exit(self, member_id, kind):
+                pass
+
+        argv = write_fake_worker(tmp_path)
+        log = tmp_path / "restarts.jsonl"
+        armed = tmp_path / "deaf-armed"
+        code = supervisor.supervise_elastic(
+            2, argv,
+            env={"FAKE_EPOCHS": "60", "FAKE_PACE": "0.1",
+                 "FAKE_DEAF": "m1", "FAKE_DEAF_STAMP": str(armed)},
+            policy=supervisor.RestartPolicy(
+                max_restarts=3, backoff=0.1, grace_seconds=20.0),
+            elastic=supervisor.ElasticPolicy(
+                min_ranks=1, max_ranks=2, rendezvous_timeout=20.0),
+            log_path=str(log),
+            controller=_DeafPreempter(str(armed)),
+        )
+        assert code == 0
+        # The re-sent TERM (not a SIGKILL at grace expiry, 20s out) was
+        # honored: the victim left cleanly and stamped on its way.
+        assert (tmp_path / "deaf-armed.left").exists()
+        with open(log) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        assert [r["member"] for r in records
+                if r["name"] == "preempt"] == ["m1"]
+        # ZERO budget spent: no restarts records at all.
+        assert not [r for r in records if r["name"] == "restarts"]

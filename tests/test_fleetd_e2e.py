@@ -1,0 +1,224 @@
+"""The fleet control plane's acceptance run (slow lane), end-to-end on
+CPU over the SHIPPED two-job scenario (launch/jobs/fleet-two-jobs.yaml):
+
+* high-priority MNIST (world exactly 2, with a recurring ``slow:MS``
+  straggler) arrives mid-run and PREEMPTS the low-priority packed-LM
+  soak — clean elastic shrink, journaled ``preempt``, ZERO restart
+  budget spent on the victims;
+* the ``hostdown`` fault then takes the soak's whole surviving host in
+  one stroke — classified as ONE ``host_lost`` (charged once, sibling
+  free), the host quarantined for the spec's cooldown;
+* when units free up (cooldown expiry, then MNIST finishing) fleetd
+  REGROWS the soak back to its FULL world size and it completes;
+* mid-run, fleetd itself is SIGKILLed and relaunched: the restarted
+  daemon replays ``fleet-journal.jsonl``, probes the recorded pids +
+  control ports, and ADOPTS both still-running jobs instead of
+  relaunching them (place count stays exactly 2);
+* per-job budgets stay isolated: every journal record carries its own
+  job's name, asserted by `budget_isolation_violations` and re-checked
+  here;
+* ``GET /fleetd`` serves the rollup while the recovered daemon runs.
+
+Everything below drives the real `hvt-launch fleet` CLI in
+subprocesses — no scheduler internals are touched."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu.launch import fleetd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEC = os.path.join(REPO, "horovod_tpu", "launch", "jobs",
+                    "fleet-two-jobs.yaml")
+
+
+def _journal(path):
+    try:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except OSError:
+        return []
+
+
+def _named(records, name, **fields):
+    return [r for r in records if r.get("name") == name
+            and all(r.get(k) == v for k, v in fields.items())]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # Chaos children stay out of the suite's shared persistent XLA
+        # cache (see test_supervisor_e2e._env for the torn-entry
+        # SEGFAULT).
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
+    })
+    return env
+
+
+def _wait_for(predicate, timeout, what, poll=0.5):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+def _reap_fleet(journal_path):
+    """Best-effort teardown of every job process group the journal ever
+    named — the cleanup net under the SIGKILL choreography."""
+    for rec in _journal(journal_path):
+        pid = rec.get("pid")
+        if rec.get("name") in ("place", "adopt") and pid:
+            for sig in (signal.SIGTERM, signal.SIGKILL):
+                try:
+                    os.killpg(int(pid), sig)
+                except (ProcessLookupError, PermissionError, OSError):
+                    break
+                time.sleep(0.2)
+
+
+@pytest.mark.slow
+def test_fleet_two_jobs_preempt_hostdown_recovery(tmp_path, capfd):
+    """THE fleet acceptance run — shipped spec, real CLI, one mid-run
+    fleetd SIGKILL, all gates green."""
+    with open(SPEC) as f:
+        text = f.read()
+    assert "/tmp/hvt-fleet-ci" in text  # the paths this test relocates
+    root = str(tmp_path / "fleet-ci")
+    spec_path = str(tmp_path / "fleet-two-jobs.yaml")
+    with open(spec_path, "w") as f:  # hvt: noqa[HVT005] — test fixture
+        f.write(text.replace("/tmp/hvt-fleet-ci", root))
+    journal = os.path.join(root, "fleet-state", fleetd.JOURNAL_NAME)
+    status_port = _free_port()
+    argv = [sys.executable, "-m", "horovod_tpu.launch", "fleet",
+            spec_path, "--status-port", str(status_port)]
+
+    first = subprocess.Popen(argv, cwd=REPO, env=_env())
+    second = None
+    try:
+        # Phase A: let the story start — soak admitted at full size,
+        # MNIST arrives, preemption lands, MNIST placed. Then kill the
+        # daemon the hard way, mid-flight.
+        _wait_for(
+            lambda: (first.poll() is None
+                     and _named(_journal(journal), "place",
+                                job="mnist-hi")),
+            timeout=180, what="both jobs placed",
+        )
+        assert first.poll() is None, "fleetd died before the kill point"
+        pids = {r["job"]: r["pid"]
+                for r in _named(_journal(journal), "place")}
+        first.send_signal(signal.SIGKILL)
+        first.wait(timeout=30)
+        # The job children live in their OWN sessions: a dead fleetd
+        # must not have taken them down.
+        time.sleep(1.0)
+        for job, pid in pids.items():
+            assert fleetd._pid_alive(pid), \
+                f"{job} (pid {pid}) died with fleetd"
+
+        # Phase B: same command again — recovery, not a fresh fleet.
+        second = subprocess.Popen(argv, cwd=REPO, env=_env())
+        _wait_for(
+            lambda: _named(_journal(journal), "adopt"),
+            timeout=60, what="journal adoption records",
+        )
+        # The recovered daemon serves the rollup for the adopted fleet.
+        snap = _wait_for(
+            lambda: _fleetd_snapshot(status_port),
+            timeout=30, what="GET /fleetd",
+        )
+        assert set(snap["jobs"]) == {"lm-soak", "mnist-hi"}
+        rc = second.wait(timeout=540)
+        assert rc == 0, capfd.readouterr().out[-6000:]
+    finally:
+        for proc in (first, second):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        _reap_fleet(journal)
+
+    records = _journal(journal)
+    # One fleet, told once: a single start, a green finish.
+    assert len(_named(records, "fleet_start")) == 1
+    done = _named(records, "fleet_done")
+    assert len(done) == 1 and done[0]["ok"] is True
+
+    # Adoption, not relaunch: both jobs were running at the kill point,
+    # both were adopted, and NO job was ever placed twice.
+    adopted = {r["job"] for r in _named(records, "adopt")}
+    assert adopted == {"lm-soak", "mnist-hi"}
+    places = _named(records, "place")
+    assert len(places) == 2
+    assert {r["job"] for r in places} == {"lm-soak", "mnist-hi"}
+
+    # Preemption-as-elastic-shrink: the scheduler reclaimed soak units
+    # for the high-priority arrival — and never touched mnist-hi.
+    assert _named(records, "preempt", job="lm-soak")
+    assert not _named(records, "preempt", job="mnist-hi")
+    assert _named(records, "release", job="lm-soak", source="ctl")
+
+    # Host failure is ONE event: a single host_lost, quarantine stamped.
+    lost = _named(records, "host_lost", job="lm-soak")
+    assert len(lost) == 1
+    assert lost[0]["until"] > lost[0]["wall_time"]
+
+    # ... and the victim was regrown once capacity freed.
+    assert _named(records, "regrow", job="lm-soak")
+
+    # Per-job journals: the budget story, strictly isolated.
+    lm_log = os.path.join(root, "lm", "restarts.jsonl")
+    mnist_log = os.path.join(root, "mnist", "restarts.jsonl")
+    lm = _journal(lm_log)
+    mnist = _journal(mnist_log)
+    assert lm and mnist
+    assert fleetd.budget_isolation_violations("lm-soak", lm_log) == []
+    assert fleetd.budget_isolation_violations("mnist-hi", mnist_log) == []
+
+    # The soak's clean-leave preemption spent NOTHING; the host loss
+    # charged exactly ONCE (the sibling's death rode free).
+    assert _named(lm, "preempt")
+    charges = _named(lm, "restarts")
+    assert len(charges) == 1, charges
+    assert charges[0]["kind"] == "host_lost"
+    assert len(_named(lm, "host_lost")) == 1  # the free sibling
+    assert not _named(lm, "supervisor_gave_up")
+    # Full-size regrow: the coordinator settled back at world size 4.
+    assert any(r["name"] == "grow" and r.get("size") == 4 for r in lm)
+
+    # The high-priority job never restarted, never shrank, finished
+    # with its whole budget: total isolation from the soak's chaos.
+    assert not _named(mnist, "restarts")
+    assert not _named(mnist, "preempt")
+    assert not _named(mnist, "supervisor_gave_up")
+
+
+def _fleetd_snapshot(port):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleetd", timeout=2.0
+        ) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
