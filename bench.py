@@ -1012,30 +1012,21 @@ def _sampler_overhead(hvt, module, x, y, K, compression, compression_ici,
     pairs_cap = max(pairs_min, int(os.environ.get(
         "BENCH_SAMPLER_MAX_PAIRS", 9
     )))
-    diffs, t_offs = [], []
-    while True:
-        # Alternate which leg goes first: monotone machine drift
-        # (thermal, cache warming) otherwise systematically favors
-        # whichever leg always runs second.
-        p = len(diffs)
-        order = (False, True) if p % 2 == 0 else (True, False)
-        t = {}
-        for with_sampler in order:
-            t[with_sampler] = leg(with_sampler, n)
-        diffs.append((t[True] - t[False]) / t[False] * 100.0)
-        t_offs.append(t[False])
-        if len(diffs) >= pairs_min:
-            med = sorted(diffs)[len(diffs) // 2]
-            spread = sorted(abs(d - med) for d in diffs)[len(diffs) // 2]
-            # Adaptive stop: keep adding pairs until the median is
-            # stable (median absolute deviation <= 0.75%) or the cap is
-            # hit — a 2% gate needs sub-percent resolution.
-            if spread <= 0.75 or len(diffs) >= pairs_cap:
-                break
-    drain_pct = sorted(diffs)[len(diffs) // 2]
+    # Paired-leg discipline (alternating order, median of per-pair
+    # diffs, MAD-adaptive stop) — extracted to horovod_tpu.tune.probe
+    # in PR 19 so the autotuner races candidate configs with the exact
+    # machinery this gate was trusted with. A 2% gate needs
+    # sub-percent resolution, hence the 0.75% MAD stop.
+    from horovod_tpu.tune import probe as tune_probe
+
+    res = tune_probe.paired_compare(
+        lambda: leg(False, n), lambda: leg(True, n),
+        pairs_min=pairs_min, pairs_cap=pairs_cap, mad_stop_pct=0.75,
+    )
+    drain_pct = res.median_pct
     # Amortized comm re-time (see docstring): one isolated reduction
     # every comm_refresh x every steps, against the OFF leg's step time.
-    sec_per_step = min(t_offs) / n
+    sec_per_step = min(res.a_times) / n
     comm_pct = (
         sampler._comm_s / (sampler.comm_refresh * every * sec_per_step)
         * 100.0
@@ -1096,8 +1087,15 @@ def bench_zero1() -> dict:
     # aligned buckets — one monolithic bucket has nothing to issue
     # bucket-by-bucket (the per-bucket schedule degenerates and the
     # peel only costs); ~4 MB gives the probe ~7 buckets.
+    # BENCH_ZERO1_BUCKET_BYTES pins the probe shape; otherwise a
+    # tuner-set HVT_BUCKET_BYTES (hvt-tune writes it into the resolved
+    # env) reaches the bench the same way it reaches a real job.
+    from horovod_tpu.analysis import registry as _registry
+
     bucket_bytes = int(
-        os.environ.get("BENCH_ZERO1_BUCKET_BYTES", 4 << 20)
+        os.environ.get("BENCH_ZERO1_BUCKET_BYTES", "")
+        or _registry.get_int("HVT_BUCKET_BYTES")
+        or (4 << 20)
     )
     n_steps = int(os.environ.get("BENCH_STEPS", 8))
     global_batch = per_chip_batch * n_chips
@@ -1132,20 +1130,27 @@ def bench_zero1() -> dict:
         return total
 
     def measure(k: int, zero1: bool, overlap=None,
-                buckets: bool = False, defer_timing: bool = False) -> dict:
+                buckets: bool = False, defer_timing: bool = False,
+                cfg: dict | None = None) -> dict:
+        # cfg overrides the ambient tunable values for ONE leg — how the
+        # BENCH_TUNE_AB race builds its registry-default opponent.
+        cfg = cfg or {}
+        leg_bucket_bytes = int(cfg.get("bucket_bytes", bucket_bytes))
+        leg_compression = cfg.get("compression", compression)
+        leg_compression_ici = cfg.get("compression_ici", compression_ici)
         trainer = hvt.Trainer(
             Mlp(),
             hvt.DistributedOptimizer(
                 optax.adam(hvt.scale_lr(1e-3)),
                 backward_passes_per_step=k,
                 average_aggregated_gradients=True,
-                compression=compression,
-                compression_ici=compression_ici,
+                compression=leg_compression,
+                compression_ici=leg_compression_ici,
             ),
             loss="sparse_categorical_crossentropy",
             shard_update=zero1,
             overlap_reduction=overlap,
-            bucket_bytes=bucket_bytes,
+            bucket_bytes=leg_bucket_bytes,
         )
 
         def draw():
@@ -1215,7 +1220,7 @@ def bench_zero1() -> dict:
         comm_s = _timed_reduction(
             trainer, state.params, max(4, n_steps)
         )
-        quantized_wire = compression.lower() in ("int8", "fp8")
+        quantized_wire = leg_compression.lower() in ("int8", "fp8")
         comm_buckets = (
             _per_bucket_comm_ms(
                 trainer, state.params, max(4, n_steps)
@@ -1264,6 +1269,66 @@ def bench_zero1() -> dict:
         leg["examples_per_sec_per_chip"] = (
             K * global_batch / leg["sec_per_opt_step"] / n_chips
         )
+    # BENCH_TUNE_AB=1 — the hvt-tune acceptance race (ISSUE 19): the
+    # config in the CURRENT env (what the tuner selected) against the
+    # registry-default config at the same K/model, decided by the
+    # paired-leg discipline. main() exits non-zero when the tuned
+    # config does not win.
+    tuned_vs_default = None
+    if os.environ.get("BENCH_TUNE_AB", "").lower() not in (
+            "", "0", "false", "no"):
+        from horovod_tpu.tune import probe as tune_probe
+        from horovod_tpu.tune import space as tune_space
+
+        tuned_cfg = {
+            "HVT_BUCKET_BYTES": bucket_bytes,
+            "HVT_BACKWARD_PASSES": K,
+            "HVT_COMPRESSION": compression,
+            "HVT_COMPRESSION_ICI": compression_ici,
+            "HVT_OVERLAP_REDUCTION": _registry.get_flag(
+                "HVT_OVERLAP_REDUCTION"),
+        }
+        default_cfg = dict(tune_space.default_config())
+        default_cfg["HVT_BACKWARD_PASSES"] = K  # same model: K pinned
+        # The tuned leg already exists: the lead (overlap-on) or the
+        # serialized compile, whichever the env picked.
+        tuned_leg = (lead if tuned_cfg["HVT_OVERLAP_REDUCTION"]
+                     else serialized)
+        default_leg = measure(
+            K, True, overlap=default_cfg["HVT_OVERLAP_REDUCTION"],
+            defer_timing=True,
+            cfg={"bucket_bytes": default_cfg["HVT_BUCKET_BYTES"],
+                 "compression": default_cfg["HVT_COMPRESSION"],
+                 "compression_ici": default_cfg["HVT_COMPRESSION_ICI"]},
+        )
+
+        def _honest(leg):
+            # Data-dependent fetch: the clock can't stop before the
+            # device finished (see _timed's docstring).
+            return lambda: float(jax.device_get(leg["run_once"]()))
+
+        _honest(default_leg)()  # settle the fresh leg before pairing
+        ab = tune_probe.paired_compare(
+            _honest(tuned_leg), _honest(default_leg),
+            pairs_min=max(3, int(os.environ.get("BENCH_TUNE_PAIRS", 5))),
+            pairs_cap=max(3, int(os.environ.get(
+                "BENCH_TUNE_MAX_PAIRS", 9))),
+        )
+        identical = tuned_cfg == default_cfg
+        tuned_vs_default = {
+            "tuned_config": tuned_cfg,
+            "default_config": default_cfg,
+            # median of per-pair (default - tuned) / tuned: positive
+            # means the registry-default config is SLOWER.
+            "median_pct": round(ab.median_pct, 3),
+            "mad_pct": round(ab.mad_pct, 3),
+            "pairs": ab.pairs,
+            "converged": ab.converged,
+            "default_step_ms_total": round(
+                tune_probe.median(ab.b_times) / n_steps * 1e3, 3),
+            # A race of a config against itself can't gate anything.
+            "gate_ok": None if identical else ab.median_pct > 0.0,
+        }
     for leg in (lead, serialized, legs[(1, False)], legs[(1, True)],
                 legs[(K, False)]):
         leg["comm_s"] = min(leg["comm_s"], leg["sec_per_opt_step"])
@@ -1374,6 +1439,17 @@ def bench_zero1() -> dict:
         "hidden": hidden,
         "bucket_bytes": bucket_bytes,
         "n_chips": n_chips,
+        # Self-describing tuner input (ISSUE 19): the fully-resolved
+        # tunable-knob values the HEADLINE leg (overlapped zero1) ran
+        # under — hvt-tune reads this instead of re-inferring.
+        "config": {
+            "HVT_BUCKET_BYTES": bucket_bytes,
+            "HVT_BACKWARD_PASSES": K,
+            "HVT_COMPRESSION": compression,
+            "HVT_COMPRESSION_ICI": compression_ici,
+            "HVT_OVERLAP_REDUCTION": True,
+        },
+        "tuned_vs_default": tuned_vs_default,
     }
 
 
@@ -1960,6 +2036,24 @@ def main() -> None:
                 with open(baseline_path) as f:
                     vs = round(result["value"] / json.load(f)["images_per_sec"], 2)
         result["vs_baseline"] = vs
+    if "config" not in result:
+        # Every row is a self-describing tuner input: stamp the
+        # fully-resolved tunable-knob values it ran under. Modes that
+        # pick their own values (zero1) stamp explicitly above; the
+        # rest resolve from the registry, overridden by whatever the
+        # row itself reports it used.
+        from horovod_tpu.tune import space as _tune_space
+
+        cfg = _tune_space.resolved_config()
+        for knob_name, row_key in (
+            ("HVT_BUCKET_BYTES", "bucket_bytes"),
+            ("HVT_BACKWARD_PASSES", "k"),
+            ("HVT_COMPRESSION", "compression"),
+            ("HVT_COMPRESSION_ICI", "compression_ici"),
+        ):
+            if result.get(row_key) is not None:
+                cfg[knob_name] = result[row_key]
+        result["config"] = cfg
     print(json.dumps(result))
     overruns = _phase_overruns(result.get("step_ms", {}))
     if overruns:
@@ -2004,6 +2098,20 @@ def main() -> None:
             f"(overlapped {result.get('step_ms', {}).get('total')} ms vs "
             f"serialized {result.get('serialized_step_ms_total')} ms) — "
             "the per-bucket scatter overlap is not cashing in",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    if (result.get("tuned_vs_default") or {}).get("gate_ok") is False:
+        import sys
+
+        tvd = result["tuned_vs_default"]
+        print(
+            "bench: the hvt-tune-selected config did NOT beat the "
+            "registry-default config on step_ms.total at the same K "
+            f"(tuned {result.get('step_ms', {}).get('total')} ms vs "
+            f"default {tvd.get('default_step_ms_total')} ms, paired "
+            f"median {tvd.get('median_pct')}% over {tvd.get('pairs')} "
+            "pairs) — the tuner crowned a loser",
             file=sys.stderr,
         )
         sys.exit(1)

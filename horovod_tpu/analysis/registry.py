@@ -35,10 +35,72 @@ import dataclasses
 import os
 
 __all__ = [
-    "Knob", "KNOBS", "UnknownKnobError", "knob", "is_registered",
-    "get_raw", "get_str", "get_int", "get_float", "get_flag",
-    "flag_like", "generate_doc",
+    "Knob", "Tunable", "KNOBS", "UnknownKnobError", "knob", "is_registered",
+    "tunable_knobs", "get_raw", "get_str", "get_int", "get_float",
+    "get_flag", "flag_like", "generate_doc",
 ]
+
+
+@dataclasses.dataclass(frozen=True)
+class Tunable:
+    """Machine-readable search domain for a knob the autotuner may set.
+
+    `hvt-tune` enumerates its candidate space from these rows — a knob
+    without a `Tunable` is invisible to the tuner by construction, and
+    rule HVT012 rejects raw env reads of any knob that carries one (a
+    read the registry resolver doesn't mediate is a value the tuner
+    cannot override).
+
+    kind:
+      * ``int``    — integer range [lo, hi]; ``scale`` says how to walk
+        it: ``log`` enumerates powers of two, ``linear`` every value.
+      * ``choice`` — explicit value set (``choices``).
+      * ``flag``   — boolean; candidates are off/on.
+    """
+
+    kind: str                      # "int" | "choice" | "flag"
+    lo: int | None = None          # int kind: inclusive bounds
+    hi: int | None = None
+    scale: str = "linear"          # int kind: "log" | "linear"
+    choices: tuple = ()            # choice kind: the value set
+
+    def __post_init__(self):
+        if self.kind not in ("int", "choice", "flag"):
+            raise ValueError(f"unknown tunable kind {self.kind!r}")
+        if self.kind == "int":
+            if self.lo is None or self.hi is None or self.lo > self.hi:
+                raise ValueError(f"int tunable needs lo <= hi, got "
+                                 f"[{self.lo}, {self.hi}]")
+            if self.scale not in ("log", "linear"):
+                raise ValueError(f"unknown tunable scale {self.scale!r}")
+        if self.kind == "choice" and not self.choices:
+            raise ValueError("choice tunable needs a non-empty choice set")
+
+    def values(self) -> tuple:
+        """The concrete candidate values the tuner enumerates."""
+        if self.kind == "flag":
+            return (False, True)
+        if self.kind == "choice":
+            return tuple(self.choices)
+        if self.scale == "log":
+            out, v = [], 1
+            while v < self.lo:
+                v *= 2
+            while v <= self.hi:
+                out.append(v)
+                v *= 2
+            if not out:
+                out = [self.lo]
+            return tuple(out)
+        return tuple(range(self.lo, self.hi + 1))
+
+    def domain_str(self) -> str:
+        """Human-readable domain for generated docs and reports."""
+        if self.kind == "flag":
+            return "off/on"
+        if self.kind == "choice":
+            return "/".join(str(c) for c in self.choices)
+        return f"[{self.lo}, {self.hi}] ({self.scale})"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +112,7 @@ class Knob:
     default: object    # the value accessors return when unset ('' == unset)
     subsystem: str     # owning layer (the ENVVARS.md grouping)
     description: str
+    tunable: Tunable | None = None   # autotuner search domain (hvt-tune)
 
 
 _SUBSYSTEM_ORDER = (
@@ -106,13 +169,15 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_BUCKET_BYTES", "int", None, "parallel",
          "Gradient-fusion bucket cap in bytes for the explicit-collective "
          "boundary reduction (default: collectives.DEFAULT_BUCKET_BYTES, "
-         "64 MB — Horovod's fusion threshold)."),
+         "64 MB — Horovod's fusion threshold).",
+         tunable=Tunable("int", lo=1 << 18, hi=1 << 28, scale="log")),
     Knob("HVT_OVERLAP_REDUCTION", "flag", True, "parallel",
          "Overlap the boundary reduction with the backward: peel the last "
          "microbatch out of the accumulation scan so bucket-wise "
          "reductions issue inside the same schedulable region as its "
          "backward (async start/done overlap on TPU). Off = serialize "
-         "the reduction after the scan (identical arithmetic)."),
+         "the reduction after the scan (identical arithmetic).",
+         tunable=Tunable("flag")),
     Knob("HVT_BUCKET_ORDER", "str", "reverse", "parallel",
          "Boundary-reduction bucket issue order: `reverse` (last-produced "
          "gradients reduce first — Horovod's fusion order, overlappable "
@@ -187,6 +252,16 @@ KNOBS: dict[str, Knob] = _decl([
          "The pool host this rank was placed on (fleetd-set via the "
          "member env) — host identity for host-loss classification and "
          "the hostdown fault's blast radius."),
+    Knob("HVT_TUNE_EVIDENCE", "path", None, "launch",
+         "Evidence directory for the `hvt-tune` offline model (BENCH_* "
+         "rows, trace spans); unset = the working directory. The job "
+         "spec `tune: {evidence}` key travels as this."),
+    Knob("HVT_TUNE_STEPS", "int", 3, "launch",
+         "In-situ probe: real optimizer steps per timed leg when "
+         "`hvt-tune probe` A/B-races candidate configs at job start."),
+    Knob("HVT_TUNE_CANDIDATES", "int", 3, "launch",
+         "In-situ probe shortlist size: the offline model ranks the "
+         "candidate space and only the top N race real steps."),
     # --- serving (continuous batching engine + replica fleet) ---------------
     Knob("HVT_SERVE_MAX_SEQS", "int", 0, "serving",
          "Continuous batching: max concurrently scheduled sequences per "
@@ -329,17 +404,22 @@ KNOBS: dict[str, Knob] = _decl([
     # --- examples / bench (read by entry scripts, not the package) ----------
     Knob("HVT_BACKWARD_PASSES", "int", 1, "examples",
          "Gradient-accumulation factor K for the example entry scripts "
-         "(DistributedOptimizer backward_passes_per_step)."),
+         "(DistributedOptimizer backward_passes_per_step).",
+         tunable=Tunable("int", lo=1, hi=8, scale="log")),
     Knob("HVT_COMPRESSION", "str", "none", "examples",
          "Gradient wire compression for the example/bench entry scripts "
          "(none/bf16/fp16/int8/fp8 — DistributedOptimizer(compression=); "
-         "int8/fp8 carry error-feedback residuals by default)."),
+         "int8/fp8 carry error-feedback residuals by default).",
+         tunable=Tunable("choice",
+                         choices=("none", "bf16", "fp16", "int8", "fp8"))),
     Knob("HVT_COMPRESSION_ICI", "str", "none", "examples",
          "ICI-hop gradient wire for the example/bench entry scripts "
          "(none/bf16/fp16/int8/fp8 — DistributedOptimizer("
          "compression_ici=): the hierarchical two-hop reduction's "
          "intra-slice hop, error-feedback-charged per hop for int8/fp8; "
-         "inert on single-slice meshes where dcn == 1)."),
+         "inert on single-slice meshes where dcn == 1).",
+         tunable=Tunable("choice",
+                         choices=("none", "bf16", "fp16", "int8", "fp8"))),
     Knob("HVT_DEVICE_CACHE", "flag", False, "examples",
          "Examples: stage the dataset into HBM once (`cache='device'`)."),
     Knob("HVT_EXPORT_FORMAT", "str", "stablehlo", "examples",
@@ -367,6 +447,12 @@ def knob(name: str) -> Knob:
 
 def is_registered(name: str) -> bool:
     return name in KNOBS
+
+
+def tunable_knobs() -> dict[str, Knob]:
+    """The knobs carrying autotuner domain metadata, name-sorted — the
+    whole candidate space `hvt-tune` is allowed to search."""
+    return {name: k for name, k in sorted(KNOBS.items()) if k.tunable}
 
 
 def flag_like(value: str | None) -> bool:
@@ -459,6 +545,22 @@ def generate_doc() -> str:
             parts.append(
                 f"| `{k.name}` | {k.type} | {_fmt_default(k)} "
                 f"| {k.description} |"
+            )
+    tunables = tunable_knobs()
+    if tunables:
+        parts.append("\n## autotuner domains\n")
+        parts.append(
+            "Knobs carrying machine-readable `tunable=` domain metadata — "
+            "the candidate space `hvt-tune` enumerates (offline model "
+            "search and in-situ probe shortlist). A knob not listed here "
+            "is invisible to the tuner by construction."
+        )
+        parts.append("")
+        parts.append("| name | kind | domain |")
+        parts.append("|---|---|---|")
+        for name, k in tunables.items():
+            parts.append(
+                f"| `{name}` | {k.tunable.kind} | {k.tunable.domain_str()} |"
             )
     return "\n".join(parts) + "\n"
 

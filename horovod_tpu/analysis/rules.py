@@ -49,6 +49,12 @@ designed around, loudly, in CHANGES.md/docstrings) — not generic style:
   (flight-recorded, `hvt-audit alltoalls=N`-auditable), never raw
   ``lax.all_to_all`` at the model layer — the HVT008 pattern for the
   MoE dispatch/combine wire (ROADMAP item 4).
+* HVT012 — tunable-knob resolver discipline: a raw ``os.environ``/
+  ``os.getenv`` read of a knob carrying registry ``tunable=`` domain
+  metadata, anywhere outside the registry resolver itself, is a silent
+  autotuning blind spot — `hvt-tune` selects configs by writing the
+  resolver's env surface, so a bypassing read sees stale values the
+  tuner can neither observe nor override (ROADMAP item 5).
 
 Rules are interprocedural where the bug class demands it (HVT001 taints
 rank-gated CALLS whose callee transitively issues a collective; HVT007
@@ -1047,6 +1053,69 @@ class ExpertAllToAllDiscipline(Rule):
                 "program auditable (`hvt-audit --expect alltoalls=N`); a "
                 "model-layer `lax.all_to_all` is invisible to both "
                 "(ROADMAP item 4's wire discipline)",
+            )
+
+
+# --- HVT012 -----------------------------------------------------------------
+
+# The one module allowed to touch the raw environment for tunable knobs:
+# the typed resolver every other read (and the autotuner's overrides)
+# funnel through.
+_REGISTRY_MODULE = "horovod_tpu/analysis/registry.py"
+
+
+@register_rule
+class TunableKnobResolverOnly(Rule):
+    rule_id = "HVT012"
+    title = "raw environ read of a tunable HVT_* knob outside the resolver"
+    rationale = (
+        "Knobs carrying registry `tunable=` domain metadata are the "
+        "autotuner's search space: `hvt-tune` selects a config by "
+        "writing the resolver-visible env surface (job env, probe "
+        "legs), so a raw `os.environ`/`os.getenv` read that bypasses "
+        "the typed accessors is a silent tuning blind spot — the site "
+        "keeps its own notion of the knob's value, which the tuner can "
+        "neither observe nor override. Sharper than HVT004's generic "
+        "inline-read finding: a tunable-knob bypass is never "
+        "baseline-able, because it breaks `hvt-tune` semantics, not "
+        "just doc hygiene."
+    )
+    provenance = (
+        "PR 19 (hvt-tune; the registry `tunable=` domains the search "
+        "enumerates from — ROADMAP item 5)."
+    )
+    example = (
+        "b = int(os.environ.get(\"HVT_BUCKET_BYTES\", \"0\"))   # tuner-blind\n"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        if module.relpath == _REGISTRY_MODULE:
+            return  # the resolver owns the raw read by definition
+        for node in ast.walk(module.tree):
+            key = None
+            if isinstance(node, ast.Call):
+                key = EnvKnobRegistry._env_read_key(module, node)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if (
+                    resolved_dotted(module, node.value) == "os.environ"
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and _KNOB_RE.match(node.slice.value)
+                ):
+                    key = node.slice.value
+            if key is None or not registry.is_registered(key):
+                continue
+            if registry.knob(key).tunable is None:
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                f"raw environ read of tunable knob `{key}` outside the "
+                "registry resolver — `hvt-tune` selects this knob's "
+                "value by writing the resolver-visible env surface, so "
+                "a bypassing read is a silent tuning blind spot; go "
+                "through `horovod_tpu.analysis.registry.get_*`",
             )
 
 

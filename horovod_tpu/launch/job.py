@@ -55,6 +55,11 @@ def validate_spec(spec) -> list:
                     f"job serve: conflicts with {key}: (the fleet "
                     "supervises its own replicas)"
                 )
+        if "tune" in job:
+            errors.append(
+                "job serve: conflicts with tune: (the autotuner races "
+                "training configs; a serve fleet has none)"
+            )
         return errors
     if not job.get("command"):
         errors.append("job command: is required")
@@ -85,6 +90,16 @@ def validate_spec(spec) -> list:
             "job policy: needs a supervised launch — add a restart: or "
             "elastic: block (the policy engine lives in the supervisor)"
         )
+    # `tune:` — dry-validated through the same constructor-style hook as
+    # the supervised blocks, so a typo'd key or a non-tunable knob name
+    # fails here, before any probe runs.
+    if "tune" in job:
+        from horovod_tpu.tune import insitu as tune_insitu
+
+        try:
+            tune_insitu.validate_block(job["tune"] or {})
+        except tune_insitu.TuneError as e:
+            errors.append(f"job tune: {e}")
     return errors
 
 
@@ -105,6 +120,45 @@ def run_job(spec_path: str) -> int:
         command if isinstance(command, list) else shlex.split(command)
     ) if command else []
     env = {str(k): str(v) for k, v in (job.get("env") or {}).items()}
+
+    # `tune:` block — resolve the autotuner BEFORE launching (ISSUE 19):
+    #   tune:
+    #     mode: probe            # offline | probe | off
+    #     # knobs: [HVT_BUCKET_BYTES, HVT_OVERLAP_REDUCTION]
+    #     # evidence: .          # BENCH_* evidence dir
+    #     # steps: 3             # probe: real steps per timed leg
+    #     # candidates: 3        # probe: shortlist size
+    #     # store: path          # default <PS_MODEL_PATH>/tune.json
+    # The winning config lands in the resolved env (spec-pinned env
+    # still wins — an operator's explicit knob is a decision, not a
+    # suggestion) and is persisted to the store keyed by a fingerprint,
+    # so a RESTART of the same job reuses it instead of re-probing; the
+    # journal records tune_selected / tune_reused.
+    tune_event = None
+    if "tune" in job:
+        from horovod_tpu.tune import insitu as tune_insitu
+
+        try:
+            tuned_env, tune_event = tune_insitu.resolve(
+                job["tune"] or {}, env, workdir=job.get("workdir")
+            )
+        except tune_insitu.TuneError as e:
+            print(f"job tune: {e}")
+            return 1
+        for name, value in tuned_env.items():
+            env.setdefault(name, value)
+
+    def _fresh_journal(lp, model_dir):
+        # Every supervised branch resets the journal through here, so
+        # the tune event survives the reset into THIS run's journal.
+        _reset_journal(lp, model_dir)
+        if tune_event and lp:
+            from horovod_tpu.launch import supervisor as _sup
+
+            _sup.RestartLog(lp).write(
+                tune_event["event"], 1,
+                **{k: v for k, v in tune_event.items() if k != "event"}
+            )
 
     checks = spec.get("checks") or {}
     metrics_path = spec.get(
@@ -241,7 +295,7 @@ def run_job(spec_path: str) -> int:
             print("job serve: needs journal: or env PS_MODEL_PATH "
                   "(the journal is the job's gateable output)")
             return 1
-        _reset_journal(log_path, supervisor.default_model_dir(env))
+        _fresh_journal(log_path, supervisor.default_model_dir(env))
         # The fleet reads knobs and spawns replica subprocesses from
         # THIS process's environment — a serve job is always local.
         os.environ.update(env)
@@ -276,7 +330,7 @@ def run_job(spec_path: str) -> int:
             {k: v for k, v in restart.items() if k != "log"}
         )
         log_path = restart.get("log") or supervisor.default_log_path(env)
-        _reset_journal(log_path, supervisor.default_model_dir(env))
+        _fresh_journal(log_path, supervisor.default_model_dir(env))
         if hosts:
             code = supervisor.supervise_elastic_hosts(
                 list(hosts), argv, env=env, policy=policy, elastic=elastic,
@@ -307,7 +361,7 @@ def run_job(spec_path: str) -> int:
         log_path = restart.get("log") or supervisor.default_log_path(env)
         # Same hygiene as the metrics stream above: a previous run's
         # restart journal must not feed this run's log/gate.
-        _reset_journal(log_path, supervisor.default_model_dir(env))
+        _fresh_journal(log_path, supervisor.default_model_dir(env))
         if hosts:
             code = supervisor.supervise_hosts(
                 list(hosts), argv, env=env, policy=policy,

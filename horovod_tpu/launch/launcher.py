@@ -370,6 +370,17 @@ def main(argv: list[str] | None = None) -> int:
             help="keep K warm standby processes parked at rendezvous; an "
             "evicted straggler's slot is refilled by a spare in the next "
             "generation, preserving world size (elastic only)")
+        # Autotuner (horovod_tpu.tune): the CLI twin of the job spec's
+        # tune: block — resolve a machine-found config into the launch
+        # env before any process spawns.
+        p.add_argument(
+            "--tune", choices=("off", "offline", "probe"), default=None,
+            help="hvt-tune at launch: `offline` trusts the analytic "
+            "model over recorded BENCH_* evidence; `probe` races the "
+            "model's shortlist with a few real steps (paired-leg A/B) "
+            "before picking. The winner lands in the launch env "
+            "(explicit --env pins still win) and is persisted to "
+            "<PS_MODEL_PATH>/tune.json so a relaunch reuses it")
 
     p_gate = sub.add_parser("gate", help="CI metric range check")
     p_gate.add_argument("--metrics", required=True, help="metrics.jsonl path")
@@ -460,10 +471,25 @@ def main(argv: list[str] | None = None) -> int:
             overrides["spares"] = a.spares
         return dataclasses.replace(cfg, **overrides)
 
+    def apply_tune(a, env):
+        """Resolve --tune into the launch env in place (see the job
+        spec's tune: block for the journaled variant)."""
+        if not a.tune or a.tune == "off":
+            return
+        from horovod_tpu.tune import insitu as tune_insitu
+
+        try:
+            tuned_env, _ = tune_insitu.resolve({"mode": a.tune}, env)
+        except tune_insitu.TuneError as e:
+            parser.error(f"--tune: {e}")
+        for name, value in tuned_env.items():
+            env.setdefault(name, value)
+
     if args.cmd == "run":
         env = dict(kv.split("=", 1) for kv in args.env)
         if args.metrics_port is not None:
             env["HVT_METRICS_PORT"] = str(args.metrics_port)
+        apply_tune(args, env)
         policy = restart_policy(args)
         elastic = elastic_policy(args)
         pcfg = policy_config(args, env, policy, elastic)
@@ -504,6 +530,7 @@ def main(argv: list[str] | None = None) -> int:
         env = dict(kv.split("=", 1) for kv in args.env)
         if args.metrics_port is not None:
             env["HVT_METRICS_PORT"] = str(args.metrics_port)
+        apply_tune(args, env)
         policy = restart_policy(args)
         elastic = elastic_policy(args)
         pcfg = policy_config(args, env, policy, elastic)

@@ -73,7 +73,8 @@ import statistics
 
 __all__ = [
     "TimelineError", "Alignment", "load_spans", "load_flight", "align",
-    "chrome_trace", "phase_table", "render_report", "skew", "render_skew",
+    "chrome_trace", "phase_table", "phase_attribution", "render_report",
+    "skew", "render_skew",
 ]
 
 SPAN_FILE_RE = re.compile(r"^spans-rank(\d+)-pid(\d+)\.jsonl$")
@@ -379,6 +380,27 @@ def phase_table(by_rank: dict[int, list[dict]]) -> dict[str, dict[int, dict]]:
         for cell in cells.values():
             cell["mean_ms"] = cell["total_s"] * 1e3 / max(1, cell["count"])
     return table
+
+
+def phase_attribution(trace_dir: str) -> dict[str, dict]:
+    """Fleet-collapsed per-phase attribution — the `hvt-tune` evidence
+    loader: ``{span name: {count, mean_ms, max_ms}}`` where ``mean_ms``
+    is the MEDIAN of per-rank means (one slow rank cannot move the
+    fleet's attribution) and ``count`` sums occurrences across ranks.
+    Returns {} when the dir holds no span files."""
+    try:
+        table = phase_table(load_spans(trace_dir))
+    except (TimelineError, OSError):
+        return {}
+    out: dict[str, dict] = {}
+    for name, cells in table.items():
+        means = sorted(c["mean_ms"] for c in cells.values())
+        out[name] = {
+            "count": sum(c["count"] for c in cells.values()),
+            "mean_ms": means[len(means) // 2],
+            "max_ms": max(c["max_ms"] for c in cells.values()),
+        }
+    return out
 
 
 def render_report(by_rank: dict[int, list[dict]]) -> str:

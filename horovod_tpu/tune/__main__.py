@@ -1,0 +1,3 @@
+from horovod_tpu.tune.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,144 @@
+"""`hvt-tune` — the trace-replay autotuner CLI.
+
+Subcommands:
+
+* ``offline`` — fit the analytic comm/compute model from recorded
+  evidence (BENCH_* rows) and rank the registry-enumerated candidate
+  space without running anything. ``--check`` is the tier-1 self-test.
+* ``probe`` — execute a probe plan (race candidate configs with real
+  steps, paired-leg discipline). Normally invoked as a subprocess by
+  `insitu.resolve`; jax-heavy.
+
+Exit contract, shared with hvt-lint / hvt-audit / hvt-sched /
+hvt-trace: 0 = clean/winner found, 1 = a finding (check failed, no
+evidenced winner, probe crowned nobody), 2 = usage error (no usable
+evidence, bad plan).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.tune import evidence as evidence_lib
+from horovod_tpu.tune import model as model_lib
+from horovod_tpu.tune import offline as offline_lib
+from horovod_tpu.tune import space as space_lib
+
+__all__ = ["main", "cli"]
+
+
+def _cmd_offline(a) -> int:
+    evidence_dir = (a.evidence
+                    or registry.get_str("HVT_TUNE_EVIDENCE") or ".")
+    if a.check:
+        code, msg = offline_lib.check(evidence_dir)
+        print(msg)
+        return code
+    rows = evidence_lib.load_rows(evidence_dir)
+    try:
+        cost = model_lib.fit(rows, trace=evidence_lib.load_trace(a.trace))
+    except model_lib.FitError as e:
+        print(f"hvt-tune: {e}", file=sys.stderr)
+        return 2
+    knobs = a.knobs.split(",") if a.knobs else [
+        n for n in space_lib.domains() if n != "HVT_BACKWARD_PASSES"
+    ]
+    try:
+        configs = space_lib.enumerate_configs(knobs=knobs)
+    except ValueError as e:
+        print(f"hvt-tune: {e}", file=sys.stderr)
+        return 2
+    scored = offline_lib.rank(cost, configs)
+    win = offline_lib.best(scored)
+    if a.json:
+        out = {
+            "winner": win.config if win else None,
+            "predicted": (dataclasses_dict(win.prediction)
+                          if win else None),
+            "provenance": cost.provenance,
+            "candidates": len(scored),
+        }
+        print(json.dumps(out))
+    else:
+        print(offline_lib.render_report(cost, scored, top=a.top))
+    return 0 if win else 1
+
+
+def dataclasses_dict(pred) -> dict:
+    import dataclasses
+
+    d = dataclasses.asdict(pred)
+    d["exposed_ms"] = pred.exposed_ms
+    return d
+
+
+def _cmd_probe(a) -> int:
+    from horovod_tpu.tune import insitu
+
+    try:
+        with open(a.plan, encoding="utf-8") as f:
+            plan = json.load(f)
+        if "default" not in plan:
+            raise ValueError("plan needs a 'default' config")
+    except (OSError, ValueError) as e:
+        print(f"hvt-tune probe: unreadable plan: {e}", file=sys.stderr)
+        return 2
+    if a.steps:
+        plan["steps"] = a.steps
+    out = insitu.run_probe_plan(plan)
+    text = json.dumps(out)
+    if a.out:
+        # Probe-result handoff, re-printed on stdout anyway; a torn
+        # write just fails the caller's JSON parse.
+        with open(a.out, "w", encoding="utf-8") as f:  # hvt: noqa[HVT005]
+            f.write(text)
+    print(text)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hvt-tune",
+        description="trace-replay autotuner: offline analytic search "
+                    "over recorded evidence, in-situ probe racing",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    off = sub.add_parser(
+        "offline", help="fit the model from BENCH_* rows and rank the "
+                        "candidate space without running the fleet")
+    off.add_argument("--evidence", default=None,
+                     help="evidence dir (default: HVT_TUNE_EVIDENCE or .)")
+    off.add_argument("--trace", default=None,
+                     help="hvt-trace span dir for phase attribution")
+    off.add_argument("--knobs", default=None,
+                     help="comma-separated knobs to vary (default: every "
+                          "tunable knob except HVT_BACKWARD_PASSES)")
+    off.add_argument("--top", type=int, default=10,
+                     help="report rows (default 10)")
+    off.add_argument("--check", action="store_true",
+                     help="tier-1 self-test: evidence loads, the model "
+                          "reproduces the anchor, the search beats it")
+    off.add_argument("--json", action="store_true",
+                     help="machine-readable winner instead of the report")
+    pr = sub.add_parser(
+        "probe", help="race candidate configs with real steps "
+                      "(paired-leg discipline); used by the launcher")
+    pr.add_argument("--plan", required=True,
+                    help="JSON plan: {default, candidates, steps}")
+    pr.add_argument("--out", default=None, help="write result JSON here")
+    pr.add_argument("--steps", type=int, default=None,
+                    help="override steps per timed leg")
+    a = p.parse_args(argv)
+    return _cmd_offline(a) if a.cmd == "offline" else _cmd_probe(a)
+
+
+def cli() -> None:
+    """Console entry point (`hvt-tune`, pyproject.toml)."""
+    raise SystemExit(main())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

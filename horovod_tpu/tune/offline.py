@@ -1,0 +1,173 @@
+"""Offline mode: search the knob space without running the fleet.
+
+``hvt-tune offline`` fits the analytic model from recorded evidence
+(`model.fit`), enumerates the candidate space from registry domain
+metadata (`space.enumerate_configs`), ranks every config by predicted
+per-example step cost, and reports the winner with its predicted
+``step_ms.total`` decomposition and the evidence each term came from.
+
+Configs whose effect NO recorded evidence covers (e.g. a quantized wire
+when every row ran f32) are still ranked — the report shows them — but
+are excluded from winner selection unless ``require_evidence=False``:
+an autotuner must not crown a config on a term it invented.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from horovod_tpu.tune import evidence as evidence_lib
+from horovod_tpu.tune import model as model_lib
+from horovod_tpu.tune import space as space_lib
+
+__all__ = ["Scored", "rank", "best", "render_report", "check"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Scored:
+    config: dict
+    prediction: model_lib.Prediction
+    deviations: int
+
+    @property
+    def score(self) -> float:
+        return self.prediction.per_example
+
+
+def rank(model: model_lib.CostModel, configs: list[dict]) -> list[Scored]:
+    """Predict every config, best (lowest per-example ms) first; ties
+    break toward the config deviating least from registry defaults."""
+    scored = [
+        Scored(config=c, prediction=model.predict(c),
+               deviations=space_lib.deviations(c))
+        for c in configs
+    ]
+    scored.sort(key=lambda s: (s.score, s.deviations))
+    return scored
+
+
+def best(scored: list[Scored], *, require_evidence: bool = True
+         ) -> Scored | None:
+    for s in scored:
+        if s.prediction.evidenced or not require_evidence:
+            return s
+    return None
+
+
+def _cfg_str(config: dict) -> str:
+    short = {
+        "HVT_BUCKET_BYTES": "bucket",
+        "HVT_BACKWARD_PASSES": "k",
+        "HVT_COMPRESSION": "wire",
+        "HVT_COMPRESSION_ICI": "wire_ici",
+        "HVT_OVERLAP_REDUCTION": "overlap",
+    }
+    parts = []
+    for name, label in short.items():
+        v = config.get(name)
+        if name == "HVT_BUCKET_BYTES" and v:
+            v = f"{int(v) >> 20}MB" if int(v) >= (1 << 20) else f"{int(v)}B"
+        if isinstance(v, bool):
+            v = "on" if v else "off"
+        parts.append(f"{label}={v}")
+    return " ".join(parts)
+
+
+def render_report(model: model_lib.CostModel, scored: list[Scored],
+                  *, top: int = 10) -> str:
+    """The human report: winner, decomposition, provenance, top table."""
+    lines = []
+    win = best(scored)
+    lines.append("hvt-tune offline — analytic search over "
+                 f"{len(scored)} candidate configs")
+    lines.append(f"model: alpha={model.alpha_ms:.3f} ms/bucket, "
+                 f"beta={model.beta_ms_per_byte * 1e6:.3f} ms/MB, "
+                 f"payload={int(model.payload_bytes)} B, "
+                 f"{model.n_points} comm samples")
+    lines.append("")
+    if win is None:
+        lines.append("winner: NONE — no evidenced candidate "
+                     "(record more BENCH rows)")
+    else:
+        p = win.prediction
+        lines.append(f"winner: {_cfg_str(win.config)}")
+        lines.append(f"  predicted step_ms.total = {p.total_ms:.1f}")
+        lines.append(f"    compute  {p.compute_ms:9.1f} ms   "
+                     f"[{model.provenance['compute']}]")
+        lines.append(f"    comm     {p.comm_ms:9.1f} ms over "
+                     f"{p.n_buckets} bucket(s)   "
+                     f"[{model.provenance['alpha/beta']}]")
+        lines.append(f"    hidden  -{p.hidden_ms:9.1f} ms by overlap   "
+                     f"[{model.provenance['hide_rate']}]")
+        lines.append(f"    input    {p.input_ms:9.1f} ms   "
+                     f"[{model.provenance['input']}]")
+        lines.append(f"  per-example objective = {p.per_example:.2f} "
+                     "ms/opt-step/K")
+        if win.config.get("HVT_BACKWARD_PASSES") != model.anchor_k:
+            lines.append("  note: K differs from the anchor — changes the "
+                         "effective batch (numerics), not just speed")
+    lines.append("")
+    lines.append(f"top {min(top, len(scored))} of {len(scored)} "
+                 "(pred ms/step | per-example | evidence):")
+    for s in scored[:top]:
+        p = s.prediction
+        tag = "ok" if p.evidenced else (
+            "UNEVIDENCED:" + ",".join(p.unevidenced))
+        lines.append(f"  {p.total_ms:8.1f} | {p.per_example:8.2f} | "
+                     f"{tag:14s} | {_cfg_str(s.config)}")
+    anchor = model.predict(model.anchor_config)
+    lines.append("")
+    lines.append(f"anchor [{model.provenance['anchor']}]: measured "
+                 f"{model.anchor_total_ms:.1f} ms, model reproduces "
+                 f"{anchor.total_ms:.1f} ms")
+    return "\n".join(lines)
+
+
+def check(evidence_dir: str, *, tolerance_pct: float = 5.0) -> tuple[int, str]:
+    """The ``--check`` self-test: (exit_code, message).
+
+    2 = no usable evidence (can't even fit); 1 = the model or domain
+    metadata is broken (fit doesn't reproduce the measured anchor, the
+    search can't beat its own anchor, or a tuned knob lost its domain);
+    0 = the tuner is trustworthy on the recorded evidence.
+    """
+    rows = evidence_lib.load_rows(evidence_dir)
+    try:
+        model = model_lib.fit(rows)
+    except model_lib.FitError as e:
+        return 2, f"hvt-tune check: {e}"
+    msgs = []
+    doms = space_lib.domains()
+    for name in ("HVT_BUCKET_BYTES", "HVT_BACKWARD_PASSES",
+                 "HVT_COMPRESSION", "HVT_COMPRESSION_ICI",
+                 "HVT_OVERLAP_REDUCTION"):
+        if name not in doms:
+            msgs.append(f"{name} lost its tunable domain metadata")
+    anchor_pred = model.predict(model.anchor_config)
+    err = abs(anchor_pred.total_ms - model.anchor_total_ms) \
+        / model.anchor_total_ms * 100.0
+    if err > tolerance_pct:
+        msgs.append(
+            f"model does not reproduce the anchor row: predicted "
+            f"{anchor_pred.total_ms:.1f} ms vs measured "
+            f"{model.anchor_total_ms:.1f} ms ({err:.1f}% > "
+            f"{tolerance_pct}%)"
+        )
+    scored = rank(model, space_lib.enumerate_configs(
+        pin={"HVT_BACKWARD_PASSES": model.anchor_k}))
+    win = best(scored)
+    if win is None:
+        msgs.append("no evidenced candidate in the search space")
+    elif win.score > anchor_pred.per_example * (1 + tolerance_pct / 100.0):
+        msgs.append(
+            f"search lost to its own anchor: winner "
+            f"{win.score:.2f} vs anchor {anchor_pred.per_example:.2f} "
+            "per-example ms"
+        )
+    if msgs:
+        return 1, "hvt-tune check: FAIL\n  " + "\n  ".join(msgs)
+    return 0, (
+        f"hvt-tune check: ok — {len(rows)} evidence rows, "
+        f"{model.n_points} comm samples, anchor reproduced within "
+        f"{err:.2f}%, winner {_cfg_str(win.config)}"
+    )

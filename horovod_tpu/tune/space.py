@@ -1,0 +1,112 @@
+"""Candidate-space enumeration for the autotuner.
+
+The space is METADATA-DRIVEN: every knob `hvt-tune` may set is a
+registry row carrying ``tunable=`` domain metadata
+(`analysis/registry.py`), and the candidate values come from
+``Tunable.values()``. Growing the tuner's reach is a registry edit, not
+a tuner edit — and a knob without domain metadata cannot be touched by
+the tuner at all (the same property rule HVT012 polices from the other
+side: no raw env read of a tunable knob outside the resolver).
+
+A "config" throughout the tune package is a plain dict mapping the
+tunable knob NAMES to concrete resolved values, e.g.::
+
+    {"HVT_BUCKET_BYTES": 4194304, "HVT_BACKWARD_PASSES": 4,
+     "HVT_COMPRESSION": "none", "HVT_COMPRESSION_ICI": "none",
+     "HVT_OVERLAP_REDUCTION": True}
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from horovod_tpu.analysis import registry
+
+__all__ = [
+    "DEFAULT_BUCKET_BYTES", "domains", "default_config", "resolved_config",
+    "enumerate_configs", "env_of", "deviations",
+]
+
+# Mirrors collectives.DEFAULT_BUCKET_BYTES (Horovod's 64 MB fusion
+# threshold) without importing the jax-heavy collectives module into the
+# CLI path; a tier-1 test asserts the two never drift.
+DEFAULT_BUCKET_BYTES = 64 * 1024 * 1024
+
+
+def domains() -> dict[str, tuple]:
+    """name -> candidate values, for every tunable knob (name-sorted)."""
+    return {name: k.tunable.values()
+            for name, k in registry.tunable_knobs().items()}
+
+
+def _resolve_one(name: str, environ=None):
+    k = registry.knob(name)
+    if k.type == "int":
+        v = registry.get_int(name, environ=environ)
+    elif k.type == "flag":
+        v = registry.get_flag(name, environ=environ)
+    else:
+        v = registry.get_str(name, environ=environ)
+    if v is None and name == "HVT_BUCKET_BYTES":
+        v = DEFAULT_BUCKET_BYTES
+    return v
+
+
+def default_config() -> dict:
+    """The registry-default values of every tunable knob — the config a
+    job runs under when nobody sets anything (the tuner's baseline)."""
+    return resolved_config(environ={})
+
+
+def resolved_config(environ=None) -> dict:
+    """The fully-resolved tunable-knob values under ``environ`` (the
+    process env by default) — what BENCH rows stamp as ``config:``."""
+    return {name: _resolve_one(name, environ=environ)
+            for name in registry.tunable_knobs()}
+
+
+def enumerate_configs(*, knobs=None, pin=None, environ=None) -> list[dict]:
+    """The candidate configs, as the cross product of tunable domains.
+
+    ``knobs`` restricts which knobs VARY (the rest hold their resolved
+    value under ``environ``); ``pin`` forces specific values outright.
+    Unknown or non-tunable names in either are an error — the caller
+    asked the tuner to touch a knob it cannot see.
+    """
+    base = resolved_config(environ=environ)
+    doms = domains()
+    pin = dict(pin or {})
+    vary = list(doms) if knobs is None else list(knobs)
+    for name in list(pin) + vary:
+        if name not in doms:
+            raise ValueError(
+                f"{name} is not a tunable knob — give it `tunable=` domain "
+                "metadata in analysis/registry.py to put it in the "
+                "tuner's reach"
+            )
+    vary = [n for n in vary if n not in pin]
+    out = []
+    for combo in itertools.product(*(doms[n] for n in vary)):
+        cfg = dict(base)
+        cfg.update(pin)
+        cfg.update(zip(vary, combo))
+        out.append(cfg)
+    return out
+
+
+def env_of(config: dict) -> dict[str, str]:
+    """Render a config as env-var strings (what the launcher exports)."""
+    out = {}
+    for name, v in config.items():
+        if isinstance(v, bool):
+            out[name] = "1" if v else "0"
+        else:
+            out[name] = str(v)
+    return out
+
+
+def deviations(config: dict) -> int:
+    """How many knobs differ from the registry default — the tiebreak
+    (prefer the config that changes the least) for equal predictions."""
+    base = default_config()
+    return sum(1 for n, v in config.items() if base.get(n) != v)

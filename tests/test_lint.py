@@ -26,6 +26,7 @@ from horovod_tpu.analysis.rules import (
     ScheduleDivergence,
     TeardownDiscipline,
     TracingHazards,
+    TunableKnobResolverOnly,
 )
 
 
@@ -973,6 +974,54 @@ class TestHVT011ExpertAllToAllDiscipline:
             ExpertAllToAllDiscipline, self.EP_SRC,
             relpath="horovod_tpu/parallel/collectives.py",
         ) == []
+
+
+class TestHVT012TunableKnobResolverOnly:
+    """Raw environ reads of knobs carrying `tunable=` domain metadata are
+    autotuning blind spots (ISSUE 19): `hvt-tune` writes the
+    resolver-visible env surface, so a bypassing read sees values the
+    tuner can neither observe nor override."""
+
+    def test_tunable_knob_raw_reads_flagged_all_shapes(self):
+        found = findings_of(TunableKnobResolverOnly, """
+            import os
+            a = os.environ.get("HVT_BUCKET_BYTES", "0")
+            b = os.getenv("HVT_OVERLAP_REDUCTION")
+            c = os.environ["HVT_COMPRESSION"]
+        """)
+        assert [f.rule for f in found] == ["HVT012"] * 3
+        assert all("tuning blind spot" in f.message for f in found)
+
+    def test_non_tunable_registered_knob_out_of_scope(self):
+        # HVT_FAULT has no tunable= domain — an inline read is HVT004's
+        # generic finding, not this rule's.
+        assert findings_of(TunableKnobResolverOnly, """
+            import os
+            a = os.environ.get("HVT_FAULT")
+        """) == []
+
+    def test_registry_accessor_and_literal_clean(self):
+        assert findings_of(TunableKnobResolverOnly, """
+            from horovod_tpu.analysis import registry
+            a = registry.get_int("HVT_BUCKET_BYTES")
+            DOC = "tune HVT_BUCKET_BYTES via hvt-tune"  # bare literal: fine
+        """) == []
+
+    def test_registry_resolver_module_exempt(self):
+        assert findings_of(TunableKnobResolverOnly, """
+            import os
+            raw = os.environ.get("HVT_BUCKET_BYTES")
+        """, relpath="horovod_tpu/analysis/registry.py") == []
+
+    def test_every_tunable_knob_is_in_scope(self):
+        # The rule's key set IS the registry's tunable set — a knob
+        # gaining tunable= metadata gains the protection automatically.
+        names = sorted(registry.tunable_knobs())
+        src = "import os\n" + "\n".join(
+            f"v{i} = os.getenv({n!r})" for i, n in enumerate(names)
+        )
+        found = findings_of(TunableKnobResolverOnly, src)
+        assert len(found) == len(names) == 5
 
 
 class TestRulesDocAndExplain:
