@@ -1,0 +1,180 @@
+"""Service-fed training — the hvt-data dispatcher's acceptance workload.
+
+A deliberately small fit whose ENTIRE data path runs through the
+distributed data service (`horovod_tpu.data.service` +
+`data.client.ServiceClient`): each process builds the same npz-backed
+source spec, admits it to the dispatcher named by ``HVT_DATA_SERVICE``,
+and consumes served batches through the anchored-batches hook. With
+``HVT_DATA_SERVICE`` unset the client is a pure local passthrough — the
+SAME script is the uninterrupted locally-fed control the chaos e2e
+compares against, because served and local streams are byte-identical
+by construction (one `build_source` recipe, one ``(seed, epoch, pass)``
+derivation).
+
+What the chaos e2e (tests/test_data_service_e2e.py) drives through it:
+
+* dispatcher SIGKILLed mid-run → the client's bounded retries
+  (`HVT_DATA_RETRIES` × `HVT_DATA_BACKOFF_S`) ride out the outage or
+  degrade to rank-local feeding from the same cursor;
+* dispatcher restarted on the same ``--dir`` → journal recovery; the
+  client re-attaches SPEC-LESS at the next epoch boundary (the
+  recovery proof);
+* ``HVT_FAULT=RANK:EPOCH:netdrop:MS`` → one rank's connection drops on
+  every fetch of that epoch; that rank degrades, feeds itself locally,
+  re-attaches — and the final checkpoint still matches the control
+  byte for byte.
+
+``DIGEST_LOG=<path>`` appends one JSONL record per CONSUMED batch —
+``{"epoch", "step", "rank", "world", "sha256"}`` — the per-batch
+byte-identity audit (the packed-LM soak's DigestTee, on the served
+path). The client's failover audit trail (degrade/re-attach events)
+lands at ``$PS_MODEL_PATH/client-events.rank<R>.jsonl``.
+
+Smoke knobs: N_ROWS, BATCH, DRIVE_STEPS, DRIVE_EPOCHS, SEED_DATA,
+DIGEST_LOG.
+"""
+
+import hashlib
+import json
+import os
+import time
+
+try:
+    import horovod_tpu  # noqa: F401 — installed (`pip install -e .`)
+except ModuleNotFoundError:  # bare source checkout
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import flax.linen as nn
+import numpy as np
+import optax
+
+import horovod_tpu as hvt
+from horovod_tpu import checkpoint
+from horovod_tpu.data.client import ServiceClient, build_source
+
+
+def ensure_corpus(root: str, n_rows: int, rank: int) -> str:
+    """Materialize the deterministic npz corpus exactly once, atomically
+    (tmp + os.replace); losers/waiters poll for the file."""
+    path = os.path.join(root, "corpus.npz")
+    if not os.path.exists(path) and rank == 0:
+        rng = np.random.RandomState(0)
+        x = rng.rand(n_rows, 8).astype(np.float32)
+        y = (np.arange(n_rows) % 4).astype(np.int64)
+        os.makedirs(root, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.npz"
+        np.savez(tmp, x=x, y=y)
+        os.replace(tmp, path)
+    deadline = time.time() + 60
+    while not os.path.exists(path):
+        if time.time() > deadline:
+            raise RuntimeError(f"corpus never appeared at {path}")
+        time.sleep(0.05)
+    return path
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(4)(x)
+
+
+class DigestTee:
+    """Append a sha256 per CONSUMED batch to a JSONL — the byte-identity
+    audit trail (same record shape as packed_lm_pretrain.py's). Exposes
+    the anchored ``batches(skip=, start_epoch=, batches_per_epoch=)``
+    hook, passing the fast-forward straight through."""
+
+    def __init__(self, inner, path: str, rank: int, world: int):
+        self.inner = inner
+        self.path = path
+        self.rank, self.world = rank, world
+
+    def batches(self, skip: int = 0, *, start_epoch: int = 0,
+                batches_per_epoch: int | None = None):
+        epoch, step = int(start_epoch), int(skip)
+        for x, y in self.inner.batches(
+            skip=skip, start_epoch=start_epoch,
+            batches_per_epoch=batches_per_epoch,
+        ):
+            sha = hashlib.sha256()
+            sha.update(np.ascontiguousarray(x).tobytes())
+            sha.update(np.ascontiguousarray(y).tobytes())
+            with open(self.path, "a") as f:  # append-only audit stream
+                f.write(json.dumps({
+                    "epoch": epoch, "step": step, "rank": self.rank,
+                    "world": self.world, "sha256": sha.hexdigest(),
+                }) + "\n")
+            step += 1
+            if batches_per_epoch and step >= batches_per_epoch:
+                epoch, step = epoch + 1, 0
+            yield x, y
+
+    def __iter__(self):
+        return self.batches()
+
+
+def main() -> None:
+    hvt.init()
+    root = os.environ.get("PS_MODEL_PATH", "./models")
+    model_dir = os.path.join(root, "service-fed")
+    rank, world = hvt.process_rank(), hvt.process_count()
+
+    corpus = ensure_corpus(root, int(os.environ.get("N_ROWS", 256)), rank)
+    batch = int(os.environ.get("BATCH", 8))
+    spec = {
+        "source": "npz", "path": corpus, "keys": ["x", "y"],
+        "batch_size": batch, "seed": int(os.environ.get("SEED_DATA", 11)),
+        "shuffle_buffer": 0,  # full permutation per epoch
+        "shard": [rank, world],
+    }
+    # The client owns a LOCAL copy of the exact source the dispatcher
+    # serves from — its degraded mode is byte-identical by construction.
+    client = ServiceClient(build_source(spec), spec, shard=(rank, world))
+    stream = client
+    digest_log = os.environ.get("DIGEST_LOG")
+    if digest_log:
+        stream = DigestTee(client, f"{digest_log}.rank{rank}", rank, world)
+
+    trainer = hvt.Trainer(
+        Tiny(), hvt.DistributedOptimizer(optax.adam(1e-2)), seed=7
+    )
+    sample_x = np.zeros((batch, 8), np.float32)
+    sample_y = np.zeros((batch,), np.int64)
+    trainer.build(sample_x, sample_y)
+    trainer.state, e0, s0 = checkpoint.restore_latest_and_broadcast(
+        model_dir, trainer.state, mesh=trainer.mesh, with_step=True
+    )
+    print(f"RESUME epoch={e0} step={s0}", flush=True)
+
+    callbacks = []
+    if rank == 0:
+        callbacks.append(hvt.callbacks.ModelCheckpoint(
+            os.path.join(model_dir, "checkpoint-{epoch}.msgpack"),
+            save_every_steps=1,
+        ))
+    steps = int(os.environ.get("DRIVE_STEPS", 4))
+    epochs = int(os.environ.get("DRIVE_EPOCHS", 5))
+    trainer.fit(
+        stream,
+        steps_per_epoch=steps,
+        epochs=epochs,
+        initial_epoch=e0,
+        initial_step=s0,
+        callbacks=callbacks,
+        verbose=0,
+    )
+    client.close()
+
+    # The failover audit trail the chaos e2e asserts on.
+    events_path = os.path.join(root, f"client-events.rank{rank}.jsonl")
+    with open(events_path, "a") as f:
+        for ev in client.events:
+            f.write(json.dumps(ev) + "\n")
+    print("TRAINING COMPLETE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

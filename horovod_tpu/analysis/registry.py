@@ -317,6 +317,25 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_DATA_BACKOFF_S", "float", 0.05, "data",
          "Base backoff in seconds between dataset-read retries; doubles "
          "per attempt (exponential)."),
+    Knob("HVT_DATA_SERVICE", "str", None, "data",
+         "hvt-data dispatcher address (`host:port`): a service client "
+         "(data/client.py) with this set fetches batches from the "
+         "shared dispatcher under the HVT_DATA_RETRIES budget, "
+         "degrading to rank-local feeding FROM THE SAME CURSOR "
+         "(byte-identical) when the budget is exhausted and "
+         "re-attaching at the next epoch boundary. Unset = pure local "
+         "feeding. fleetd injects it into every job when the fleet "
+         "spec carries a `data_service:` block."),
+    Knob("HVT_DATA_JOB", "str", "default", "data",
+         "Job name a service client admits its stream under on the "
+         "hvt-data dispatcher — the per-job isolation and "
+         "hvt_data_*{job=} metrics key (give each fleet job a distinct "
+         "name)."),
+    Knob("HVT_DATA_TIMEOUT_S", "float", 5.0, "data",
+         "Per-socket-operation timeout (seconds) for hvt-data client "
+         "fetches: a hung dispatcher surfaces as a retriable timeout "
+         "inside the HVT_DATA_RETRIES budget instead of wedging the "
+         "fed rank."),
     # --- observability ------------------------------------------------------
     Knob("HVT_PROFILE", "path", None, "observability",
          "Capture a jax.profiler trace of fit()/bench into this dir — the "
@@ -379,14 +398,21 @@ KNOBS: dict[str, Knob] = _decl([
     Knob("HVT_FAULT", "spec", None, "testing",
          "Deterministic fault injection, `rank:epoch[.step]:kind` (kinds "
          "kill/exitN/hang/leave/reorder/corrupt[@target]/slow:MS/"
-         "hostdown; `hostdown` SIGKILLs every rank sharing the firing "
+         "netdrop:MS/dataslow:MS/hostdown; `hostdown` SIGKILLs every "
+         "rank sharing the firing "
          "rank's host via the HVT_FAULT_HOST_PIDS registry — the "
          "host-loss ground truth for hvt-launch fleet; "
          "`reorder` swaps the rank's last two flight-recorded "
          "submissions, then wedges like `hang` — the hvt-sched replay "
          "acceptance fault; `slow:MS` makes the rank sleep MS ms per "
          "step from the target epoch on, recurring — the hvt-trace "
-         "straggler-detection ground truth)."),
+         "straggler-detection ground truth; the data-plane kinds "
+         "`netdrop:MS` (hvt-data client drops its dispatcher "
+         "connection + delays reconnect MS ms before every fetch "
+         "DURING the target epoch) and `dataslow:MS` (dispatcher "
+         "delays every batch response MS ms from the target epoch on) "
+         "fire in data/client.py and data/service.py via "
+         "faults.data_fault_ms, not in the trainer callback)."),
     Knob("HVT_FAULT_STAMP", "path", None, "testing",
          "One-shot stamp file: the fault fires once, never while the "
          "stamp exists — across relaunches."),

@@ -55,6 +55,12 @@ designed around, loudly, in CHANGES.md/docstrings) — not generic style:
   autotuning blind spot — `hvt-tune` selects configs by writing the
   resolver's env surface, so a bypassing read sees stale values the
   tuner can neither observe nor override (ROADMAP item 5).
+* HVT013 — data-layer retried-read discipline: a raw read-mode
+  ``open()`` / ``np.load`` / ``np.memmap`` of corpus files inside
+  ``horovod_tpu/data/`` outside the `stream.read_with_retries` wrapper
+  turns one transient NFS/FUSE blip into a dead rank — the bounded
+  retry-with-backoff contract (``HVT_DATA_RETRIES``) the hvt-data
+  failover arc is built on must be checked, not convention.
 
 Rules are interprocedural where the bug class demands it (HVT001 taints
 rank-gated CALLS whose callee transitively issues a collective; HVT007
@@ -1117,6 +1123,126 @@ class TunableKnobResolverOnly(Rule):
                 "a bypassing read is a silent tuning blind spot; go "
                 "through `horovod_tpu.analysis.registry.get_*`",
             )
+
+
+# --- HVT013 -----------------------------------------------------------------
+
+# Dotted read entry points into corpus bytes (import-alias-resolved;
+# `np.*` kept alongside `numpy.*` because resolved_dotted preserves the
+# module alias the call site used — the HVT006 precedent).
+_RAW_READ_DOTTED = {
+    "numpy.load", "np.load", "numpy.memmap", "np.memmap",
+    "numpy.lib.format.open_memmap", "mmap.mmap",
+}
+
+# Mode characters that make an `open()` a WRITER — HVT005's atomicity
+# domain, not this rule's: the retried-read discipline covers reads.
+_NON_READ_MODES = "wxa+"
+
+
+@register_rule
+class DataLayerRetriedReads(Rule):
+    rule_id = "HVT013"
+    title = "raw corpus read in the data layer outside read_with_retries"
+    rationale = (
+        "Dataset reads ride shared filesystems that blip (NFS/FUSE "
+        "EIO/ESTALE, a shard vanishing mid-replace): an unwrapped read "
+        "turns one transient fault into a dead rank, while "
+        "`data.stream.read_with_retries` absorbs it under the bounded "
+        "HVT_DATA_RETRIES x HVT_DATA_BACKOFF_S budget and escalates "
+        "actionably when the budget is spent — the exact discipline the "
+        "hvt-data service client's degrade-to-local failover is built "
+        "on. Inside `horovod_tpu/data/`, every read-mode `open()` / "
+        "`np.load` / `np.memmap` must run inside the wrapper (a lambda "
+        "or a named function passed to it); write/append opens are "
+        "HVT005's domain."
+    )
+    provenance = (
+        "PR 20 (hvt-data distributed data service; the transient-I/O "
+        "convention from PR 8 became checked)."
+    )
+    example = (
+        "with open(index_path) as f:   # one NFS blip kills the rank\n"
+        "    index = json.load(f)\n"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        if not module.relpath.startswith(_DATA_LAYER_PREFIX):
+            return
+        wrapped = self._wrapped_nodes(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or id(node) in wrapped:
+                continue
+            what = self._raw_read(module, node)
+            if what is None:
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                f"raw {what} outside `stream.read_with_retries` — a "
+                "transient filesystem fault here kills the rank instead "
+                "of being absorbed by the bounded retry budget "
+                "(HVT_DATA_RETRIES); wrap the read in a callable passed "
+                "to `read_with_retries` (deliberate exceptions: "
+                "suppress with `# hvt: noqa[HVT013]` and say why)",
+            )
+
+    @staticmethod
+    def _wrapped_nodes(module: ModuleSource) -> set[int]:
+        """ids of AST nodes lexically covered by the wrapper: every
+        argument subtree of a `read_with_retries(...)` call (the lambda
+        idiom), plus the bodies of functions whose NAME is passed as an
+        argument to one (the named-closure idiom — filedataset's
+        `read_index`)."""
+        wrapped: set[int] = set()
+        named_fns: set[str] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolved_dotted(module, node.func)
+            name = (
+                resolved.split(".")[-1] if resolved is not None
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            )
+            if name != "read_with_retries":
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                if isinstance(arg, ast.Name):
+                    named_fns.add(arg.id)
+                for sub in ast.walk(arg):
+                    wrapped.add(id(sub))
+        if named_fns:
+            for node in ast.walk(module.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and node.name in named_fns:
+                    for sub in ast.walk(node):
+                        wrapped.add(id(sub))
+        return wrapped
+
+    @staticmethod
+    def _raw_read(module: ModuleSource, call: ast.Call) -> str | None:
+        """A human-readable description of the raw read this call
+        performs, or None when it is not one."""
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            mode = call.args[1] if len(call.args) >= 2 else None
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if mode is None:
+                return "read-mode `open()`"
+            if (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and not any(c in mode.value for c in _NON_READ_MODES)
+            ):
+                return f"read-mode `open(..., {mode.value!r})`"
+            return None  # a writer (HVT005's domain) or a dynamic mode
+        resolved = resolved_dotted(module, call.func)
+        if resolved in _RAW_READ_DOTTED:
+            return f"`{resolved}(...)`"
+        return None
 
 
 if __name__ == "__main__":

@@ -15,3 +15,19 @@ from horovod_tpu.data.stream import (  # noqa: F401
     StreamCursorError,
     epoch_seed,
 )
+
+# The distributed data service (PR 20): dispatcher + trainer-side client.
+# Imported lazily-by-name here, not at package import — the service module
+# is socket/daemon machinery most training paths never touch.
+
+
+def __getattr__(name):
+    if name in ("ServiceClient", "build_source"):
+        from horovod_tpu.data import client as _client
+
+        return getattr(_client, name)
+    if name == "DataService":
+        from horovod_tpu.data import service as _service
+
+        return _service.DataService
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,302 @@
+"""Trainer-side client for the hvt-data dispatcher (`data.service`).
+
+`ServiceClient` is a drop-in anchored-batches source: it exposes the
+same ``batches(skip=, start_epoch=, batches_per_epoch=)`` hook
+`Trainer.fit(dataset=)` probes for, so a fit is service-fed with zero
+trainer changes — the client is just another positionally-addressable
+stream.
+
+The client owns a LOCAL copy of the source (`service.build_source` on
+the same spec the dispatcher admits), which buys the two failover
+properties the tentpole demands:
+
+* **Bounded-retry fetches.** Every service interaction — connect, hello,
+  next — runs under `stream.read_with_retries` (the
+  ``HVT_DATA_RETRIES`` × ``HVT_DATA_BACKOFF_S`` discipline): transient
+  socket failures (a dispatcher restarting, a dropped connection) are
+  absorbed, each retry re-attaching from the CURRENT cursor, so a
+  dispatcher that comes back serves the exact next batch.
+* **Graceful degradation.** When the budget is exhausted the client
+  falls back to rank-local feeding *from the same cursor* — byte-
+  identically, because local and served streams are the same pure
+  ``(seed, epoch, pass)`` derivation — and re-attaches to the service at
+  the next epoch boundary. A data-plane outage slows the fit; it never
+  corrupts or kills it.
+
+Re-attach hellos carry NO spec: the dispatcher must know the job from
+its own memory or its admission journal — which is what makes a
+successful re-attach after a dispatcher SIGKILL the proof of journal
+recovery. `StreamCursor` refusals coming back over the wire re-raise as
+`StreamCursorError` (loud, never retried, never silently re-anchored).
+
+Knobs: ``HVT_DATA_SERVICE`` (``host:port``; unset → the client is a
+pure local passthrough), ``HVT_DATA_JOB`` (admission name, default
+"default"), ``HVT_DATA_TIMEOUT_S`` (per-socket-op timeout).
+
+The ``netdrop:MS`` chaos fault (`testing.faults`) is applied HERE — a
+client-side connection drop plus reconnect delay before each fetch
+during the fault's target epoch — because the trainer callback cannot
+reach into the data plane's sockets.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import numpy as np
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.data import service as service_lib
+from horovod_tpu.data import stream as stream_lib
+from horovod_tpu.obs import core as obs_core
+
+build_source = service_lib.build_source  # re-export: the shared recipe
+
+
+class ServiceClient:
+    """A service-fed anchored-batches source with byte-exact local
+    fallback. ``source`` is the local `ArrayDataset` chain (built from
+    the SAME ``spec`` the dispatcher is given); ``shard`` is this rank's
+    ``(index, count)`` split — its index doubles as the fault-plan rank
+    for the ``netdrop`` chaos kind."""
+
+    def __init__(self, source, spec: dict | None = None, *,
+                 job: str | None = None, shard=(0, 1),
+                 address: str | None = None):
+        self.source = source
+        self.spec = dict(spec) if spec is not None else None
+        self.job = job or registry.get_str("HVT_DATA_JOB") or "default"
+        self.shard = (int(shard[0]), int(shard[1]))
+        if address is None:
+            address = registry.get_str("HVT_DATA_SERVICE")
+        self.address = address or None
+        timeout = registry.get_float("HVT_DATA_TIMEOUT_S")
+        self.timeout = 5.0 if timeout is None else float(timeout)
+        self._sock: socket.socket | None = None
+        self._ever_admitted = False
+        # Failover audit trail (the chaos e2e asserts on it): dicts of
+        # {"event": "degrade"|"reattach", "epoch": e, "step": s, ...}.
+        self.events: list[dict] = []
+
+    # -- connection management ------------------------------------------------
+
+    def _close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        self._close()
+
+    def _connect(self) -> socket.socket:
+        host, _, port = self.address.rpartition(":")
+        sock = socket.create_connection(
+            (host, int(port)), timeout=self.timeout
+        )
+        return sock
+
+    def _netdrop(self, epoch: int) -> None:
+        from horovod_tpu.testing import faults
+
+        ms = faults.data_fault_ms(
+            "netdrop", epoch=epoch, rank=self.shard[0]
+        )
+        if ms is not None:
+            self._close()
+            time.sleep(ms / 1e3)
+            raise OSError(
+                "injected connection drop (HVT_FAULT netdrop) — "
+                f"reconnect delayed {ms:g} ms"
+            )
+
+    def _roundtrip(self, header: dict, epoch: int) -> tuple[dict, bytes]:
+        """One request/response on the live connection, re-attaching
+        first if there is none. Raises OSError on any transport failure
+        (retriable) and `StreamCursorError` on a wire refusal (loud,
+        final)."""
+        self._netdrop(epoch)
+        if self._sock is None:
+            self._sock = self._connect()
+            try:
+                self._hello()
+            except BaseException:
+                self._close()
+                raise
+        try:
+            service_lib.send_frame(self._sock, header)
+            resp, payload = service_lib.recv_frame(self._sock)
+        except OSError:
+            self._close()
+            raise
+        if resp is None:
+            self._close()
+            raise OSError("hvt-data service closed the connection")
+        if not resp.get("ok"):
+            if resp.get("refusal"):
+                raise stream_lib.StreamCursorError(
+                    f"hvt-data service refused the presented cursor: "
+                    f"{resp.get('error')}"
+                )
+            self._close()
+            raise OSError(f"hvt-data service error: {resp.get('error')}")
+        return resp, payload
+
+    def _hello(self) -> None:
+        """Attach this (job, shard) on the fresh connection. The FIRST
+        successful attach carries the source spec (the admission); every
+        later one carries none — adopting must come from the
+        dispatcher's memory or journal."""
+        hello = {
+            "op": "hello", "job": self.job, "shard": list(self.shard),
+        }
+        if not self._ever_admitted:
+            if self.spec is None:
+                raise ValueError(
+                    "ServiceClient needs a source spec for its first "
+                    "admission (spec=...)"
+                )
+            hello["spec"] = self.spec
+        service_lib.send_frame(self._sock, hello)
+        resp, _ = service_lib.recv_frame(self._sock)
+        if resp is None:
+            raise OSError("connection closed during hvt-data hello")
+        if not resp.get("ok"):
+            if resp.get("refusal"):
+                raise stream_lib.StreamCursorError(
+                    f"hvt-data service refused this client's stream: "
+                    f"{resp.get('error')}"
+                )
+            raise OSError(f"hvt-data hello failed: {resp.get('error')}")
+        self._ever_admitted = True
+
+    # -- batch transport ------------------------------------------------------
+
+    def _cursor(self, epoch: int, step: int,
+                batches_per_epoch: int | None):
+        return self.source.stream_cursor(
+            epoch, step, batches_per_epoch=batches_per_epoch
+        )
+
+    def _decode(self, resp: dict, payload: bytes):
+        leaves = []
+        offset = 0
+        for leaf in resp["leaves"]:
+            dt = np.dtype(leaf["dtype"])
+            shape = tuple(int(d) for d in leaf["shape"])
+            count = int(np.prod(shape)) if shape else 1
+            a = np.frombuffer(
+                payload, dtype=dt, count=count, offset=offset
+            ).reshape(shape)
+            offset += a.nbytes
+            leaves.append(np.array(a))  # writable copy off the buffer
+        structure = getattr(self.source, "structure", None)
+        if structure is not None:
+            import jax.tree_util
+
+            return jax.tree_util.tree_unflatten(structure, leaves)
+        return tuple(leaves) if len(leaves) != 1 else leaves[0]
+
+    def _fetch(self, epoch: int, step: int,
+               batches_per_epoch: int | None):
+        """One served batch at (epoch, step), under the bounded-retry
+        budget. RuntimeError = budget exhausted (the degrade trigger);
+        StreamCursorError = wire refusal (propagates loudly)."""
+        cursor = self._cursor(epoch, step, batches_per_epoch).to_dict()
+
+        def do():
+            resp, payload = self._roundtrip({
+                "op": "next", "job": self.job,
+                "shard": list(self.shard), "cursor": cursor,
+            }, epoch)
+            return self._decode(resp, payload)
+
+        return stream_lib.read_with_retries(
+            do,
+            f"hvt-data batch (job {self.job!r}, epoch {epoch}, "
+            f"step {step}) from {self.address}",
+        )
+
+    def _try_reattach(self, epoch: int) -> bool:
+        """One epoch-boundary re-attach attempt (single shot, no retry
+        budget — a down service just means one more local epoch)."""
+        try:
+            self._netdrop(epoch)
+            self._sock = self._connect()
+            self._hello()
+            return True
+        except (OSError, ValueError):
+            self._close()
+            return False
+
+    # -- the anchored-batches hook --------------------------------------------
+
+    def batches(self, skip: int = 0, *, start_epoch: int = 0,
+                batches_per_epoch: int | None = None):
+        """The `run_fit` anchored-batches contract. Service-fed while
+        attached; on an exhausted retry budget, degrades to the LOCAL
+        source from the same cursor (byte-identical by construction) and
+        re-attaches at the next epoch boundary."""
+        B = int(batches_per_epoch) if batches_per_epoch else None
+        epoch, step = int(start_epoch), int(skip)
+        if B:
+            epoch, step = epoch + step // B, step % B
+        local_it = None
+        if self.address is None:
+            # No service configured: a pure local passthrough — the
+            # degraded mode IS the normal mode.
+            local_it = self._local_iter(epoch, step, B)
+        while True:
+            if local_it is not None:
+                batch = next(local_it)
+            else:
+                try:
+                    batch = self._fetch(epoch, step, B)
+                except RuntimeError as e:
+                    self._degrade(epoch, step, e)
+                    local_it = self._local_iter(epoch, step, B)
+                    batch = next(local_it)
+            yield batch
+            step += 1
+            if B and step >= B:
+                epoch, step = epoch + 1, 0
+                if local_it is not None and self.address is not None:
+                    if self._try_reattach(epoch):
+                        obs_core.counter("hvt_data_reattach_total")
+                        self.events.append({
+                            "event": "reattach", "epoch": epoch,
+                            "step": step,
+                        })
+                        print(
+                            f"hvt-data client: re-attached to "
+                            f"{self.address} at epoch {epoch} "
+                            f"(job {self.job!r})",
+                            flush=True,
+                        )
+                        local_it = None
+
+    def _local_iter(self, epoch: int, step: int, B: int | None):
+        return self.source.batches(
+            skip=step, start_epoch=epoch, batches_per_epoch=B
+        )
+
+    def _degrade(self, epoch: int, step: int, err: Exception) -> None:
+        self._close()
+        obs_core.counter("hvt_data_degraded_total")
+        self.events.append({
+            "event": "degrade", "epoch": epoch, "step": step,
+            "error": str(err),
+        })
+        print(
+            f"hvt-data client: retry budget exhausted at epoch {epoch} "
+            f"step {step} — degrading to rank-local feeding from the "
+            f"same cursor (byte-identical); will re-attach at the next "
+            f"epoch boundary ({err})",
+            flush=True,
+        )
+
+    def __iter__(self):
+        return self.batches()

@@ -31,6 +31,7 @@ import os
 import numpy as np
 
 from horovod_tpu.analysis import registry
+from horovod_tpu.data import stream as stream_lib
 
 # 5x7 bitmap font for digits 0-9 (rows top→bottom, 5 bits per row).
 _DIGIT_FONT = {
@@ -85,8 +86,14 @@ def _load_or_create(path: str, cache_dir: str | None, synthesize):
     )
     full = path if os.path.isabs(path) else os.path.join(cache_dir, path)
     if os.path.exists(full):
-        with np.load(full) as f:
-            return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+        def read_npz():
+            with np.load(full) as f:
+                return (
+                    (f["x_train"], f["y_train"]),
+                    (f["x_test"], f["y_test"]),
+                )
+
+        return stream_lib.read_with_retries(read_npz, full)
     (x_train, y_train), (x_test, y_test) = synthesize()
     os.makedirs(os.path.dirname(full), exist_ok=True)
     tmp = f"{full}.tmp.{os.getpid()}.npz"  # keep .npz: savez appends it otherwise

@@ -1,0 +1,561 @@
+"""hvt-data — the distributed data service dispatcher (ROADMAP item 6).
+
+Every feeding engine in this repo is rank-local: N fleet jobs re-read
+and re-shuffle the same corpora, and a data-side fault is invisible to
+the supervisor. This daemon centralizes feeding WITHOUT centralizing
+failure: the dispatcher owns ``(seed, epoch, pass)`` order per job and
+streams packed batches to ranks over a length-prefixed socket protocol,
+but — because PR 8 made batch order a PURE function of position
+(`data.stream.epoch_seed`) — it holds no state a client cannot
+reconstruct. Three consequences the whole design leans on:
+
+* **Crash-recoverable.** Admissions (job, shard, source spec) are
+  journaled to ``<dir>/data-journal.jsonl`` as they happen; a SIGKILLed
+  dispatcher restarts with the same ``--dir`` and adopts every in-flight
+  job from the journal plus the cursors its re-attaching clients
+  present. No handshake state survives the crash and none is needed.
+* **Split-brain-free.** Any dispatcher instance can serve any batch by
+  POSITION (the client's `StreamCursor`), never by connection state: two
+  dispatchers serving the same job from the same spec produce the same
+  bytes, so a failover can never fork the stream.
+* **Gracefully degradable.** The trainer-side client
+  (`data.client.ServiceClient`) falls back to rank-local feeding *from
+  the same cursor* when its retry budget is exhausted — byte-identically,
+  because both sides derive the stream from the same ``(seed, epoch,
+  pass)`` derivation via `build_source`.
+
+Per-job isolation: every job carries its own lock; the dispatcher-wide
+lock guards only dict lookups, and each connection is served by its own
+thread (`ThreadingTCPServer`) — a wedged or backlogged job blocks its
+own queue, never another job's admission or serving path.
+
+Wire protocol (version `PROTOCOL_VERSION`): each frame is a fixed
+``!II`` prefix (header length, payload length), a JSON header, then raw
+payload bytes. Ops:
+
+* ``hello`` — register/adopt ``(job, shard)``. A first attach carries
+  ``spec`` (the `build_source` recipe); a RE-attach carries none — the
+  dispatcher must already know the job (its own memory or the journal),
+  which is exactly what makes a successful spec-less re-attach the proof
+  of journal recovery. An optional ``cursor`` is validated loudly.
+* ``next`` — serve the batch at ``cursor``. The response header carries
+  per-leaf dtype/shape; the payload is the concatenated contiguous
+  bytes of the batch's flattened leaves.
+* ``ping`` — liveness + admitted-job census.
+
+`StreamCursor` refusals (foreign format version, wrong engine kind,
+mismatched geometry) survive serialization: they come back as
+``{"ok": false, "refusal": true}`` and the client re-raises
+`StreamCursorError` — never retried, never silently re-anchored.
+
+Observability: a private `obs.core.Registry` serves ``hvt_data_*``
+series on ``GET /metrics`` (``--metrics-port``), reusing
+`obs.server.start_metrics_server`. ``hvt_data_cursor_refusals_total`` is
+pre-seeded to 0 at startup so a fleet gate of ``0..0`` can distinguish
+"no refusals" from "series absent".
+
+CLI: ``hvt-data serve --dir DIR [--port P] [--metrics-port M]`` (also
+``python -m horovod_tpu.data.service``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+
+from horovod_tpu.analysis import registry
+from horovod_tpu.data import stream as stream_lib
+from horovod_tpu.obs import core as obs_core
+
+PROTOCOL_VERSION = 1
+JOURNAL_NAME = "data-journal.jsonl"
+
+_FRAME = struct.Struct("!II")
+
+
+# --- wire protocol -----------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int, *, mid_frame: bool) -> bytes | None:
+    """Read exactly ``n`` bytes. Clean EOF at a frame boundary returns
+    None; EOF mid-frame is a torn frame and raises (retriable for the
+    client — the connection died, the position did not)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf or mid_frame:
+                raise ConnectionError(
+                    "connection closed mid-frame (torn hvt-data frame)"
+                )
+            return None
+        buf += chunk
+    return buf
+
+
+def send_frame(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    data = json.dumps(header).encode()
+    sock.sendall(_FRAME.pack(len(data), len(payload)) + data + payload)
+
+
+def recv_frame(sock: socket.socket) -> tuple[dict | None, bytes]:
+    """One frame off the socket: ``(header, payload)``, or ``(None, b"")``
+    on clean EOF."""
+    head = _recv_exact(sock, _FRAME.size, mid_frame=False)
+    if head is None:
+        return None, b""
+    hlen, plen = _FRAME.unpack(head)
+    header = json.loads(_recv_exact(sock, hlen, mid_frame=True))
+    payload = _recv_exact(sock, plen, mid_frame=True) if plen else b""
+    return header, payload
+
+
+# --- the shared source recipe ------------------------------------------------
+
+
+def build_source(spec: dict):
+    """Construct the batch source a spec describes — the SAME function on
+    the dispatcher and in every client, so a degraded client feeding
+    itself rank-locally produces byte-identically what the service would
+    have served (both are the pure ``(seed, epoch, pass)`` stream of an
+    identical `ArrayDataset` chain).
+
+    Spec fields: ``source`` ("npz"), ``path``, ``keys`` (npz member
+    names, in batch-leaf order; default: the archive's own order),
+    ``batch_size``, ``seed``, ``shuffle_buffer`` (falsy → full
+    permutation), ``shard`` ([index, count] or null)."""
+    from horovod_tpu.data import loader
+
+    kind = spec.get("source", "npz")
+    if kind != "npz":
+        raise ValueError(
+            f"unknown data-service source kind {kind!r} (only 'npz' specs "
+            "are servable today)"
+        )
+    path = spec["path"]
+    keys = list(spec.get("keys") or [])
+
+    def load_npz():
+        with np.load(path) as f:
+            names = keys or list(f.files)
+            return tuple(np.asarray(f[k]) for k in names)
+
+    arrays = stream_lib.read_with_retries(load_npz, f"corpus npz {path}")
+    ds = loader.ArrayDataset(arrays)
+    shard = spec.get("shard")
+    if shard:
+        ds = ds.shard(int(shard[0]), int(shard[1]))
+    ds = ds.repeat()
+    buf = spec.get("shuffle_buffer")
+    ds = ds.shuffle(int(buf) if buf else ds.num_examples,
+                    seed=int(spec.get("seed", 0)))
+    return ds.batch(int(spec["batch_size"]))
+
+
+def _shard_key(shard) -> str:
+    if not shard:
+        return "0/1"
+    return f"{int(shard[0])}/{int(shard[1])}"
+
+
+# --- the dispatcher ----------------------------------------------------------
+
+
+class DataService:
+    """One dispatcher instance: admitted jobs, their per-shard stream
+    state, the admission journal, and the metrics registry. `start()`
+    binds and serves on background threads (in-process tests drive it
+    directly); the CLI wraps it in a foreground daemon."""
+
+    def __init__(self, root_dir: str, host: str | None = None,
+                 port: int = 0, metrics_port: int | None = None):
+        self.root_dir = root_dir
+        self.host = host if host is not None else (
+            registry.get_str("HVT_STATUS_HOST") or "127.0.0.1"
+        )
+        self.port = port
+        self.metrics_port = metrics_port
+        self.journal_path = os.path.join(root_dir, JOURNAL_NAME)
+        self.registry = obs_core.Registry()
+        self._lock = threading.Lock()        # the job MAP only — never
+        self._journal_lock = threading.Lock()  # held across stream work
+        # job -> {"lock": RLock, "shards": {shard_key: {"spec", "src",
+        #         "it", "pos"}}}
+        self._jobs: dict[str, dict] = {}
+        self._server = None
+        self._metrics_server = None
+        self._conns: set = set()  # live client sockets, severed on stop()
+        os.makedirs(root_dir, exist_ok=True)
+        # Pre-seed the refusal series: the fleet gate asserts 0..0, and
+        # an ABSENT series fails `ci_gate.run_prom_checks` by design.
+        self.registry.counter("hvt_data_cursor_refusals_total", 0)
+        self._recover()
+
+    # -- admission / recovery -------------------------------------------------
+
+    def _journal(self, record: dict) -> None:
+        record = dict(record, wall_time=time.time())
+        with self._journal_lock:
+            with open(self.journal_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+
+    def _recover(self) -> None:
+        """Adopt every job the journal admitted: the SIGKILL-survival
+        path. Sources are rebuilt lazily at first request — a dispatcher
+        can adopt a hundred jobs without loading a hundred corpora."""
+
+        def read_journal():
+            if not os.path.exists(self.journal_path):
+                return []
+            with open(self.journal_path) as f:
+                return f.readlines()
+
+        lines = stream_lib.read_with_retries(
+            read_journal, f"data-service journal {self.journal_path}"
+        )
+        adopted = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from the crash — admissions
+                # before it are intact (append-only discipline)
+            if rec.get("name") != "admit":
+                continue
+            job, sk = str(rec.get("job")), str(rec.get("shard_key"))
+            entry = self._job_entry(job)
+            entry["shards"][sk] = {
+                "spec": rec.get("spec"), "src": None, "it": None,
+                "pos": None,
+            }
+            adopted += 1
+        if adopted:
+            self._journal({"name": "recover", "value": float(adopted)})
+        for job in self._jobs:
+            self.registry.counter("hvt_data_admissions_total", 0, job=job)
+            self.registry.counter(
+                "hvt_data_batches_served_total", 0, job=job
+            )
+        self.registry.gauge("hvt_data_jobs", len(self._jobs))
+
+    def _job_entry(self, job: str) -> dict:
+        with self._lock:
+            entry = self._jobs.get(job)
+            if entry is None:
+                entry = self._jobs[job] = {
+                    "lock": threading.RLock(), "shards": {},
+                }
+            return entry
+
+    def admit(self, job: str, shard, spec: dict) -> None:
+        """Register ``spec`` as the source recipe for ``(job, shard)``
+        and journal it — the durable admission a restarted dispatcher
+        adopts."""
+        sk = _shard_key(shard)
+        entry = self._job_entry(job)
+        with entry["lock"]:
+            entry["shards"][sk] = {
+                "spec": dict(spec), "src": None, "it": None, "pos": None,
+            }
+        self._journal({
+            "name": "admit", "value": 1.0, "job": job, "shard_key": sk,
+            "spec": dict(spec),
+        })
+        self.registry.counter("hvt_data_admissions_total", job=job)
+        self.registry.counter("hvt_data_batches_served_total", 0, job=job)
+        with self._lock:
+            n_jobs = len(self._jobs)
+        self.registry.gauge("hvt_data_jobs", n_jobs)
+
+    def register_local(self, job: str, shard, source) -> None:
+        """Test hook: admit a pre-built in-memory source (no spec, no
+        journal durability) — how the isolation unit wedges one job's
+        stream without touching the filesystem."""
+        sk = _shard_key(shard)
+        entry = self._job_entry(job)
+        with entry["lock"]:
+            entry["shards"][sk] = {
+                "spec": None, "src": source, "it": None, "pos": None,
+            }
+        self.registry.counter("hvt_data_admissions_total", job=job)
+        self.registry.counter("hvt_data_batches_served_total", 0, job=job)
+        self.registry.gauge("hvt_data_jobs", len(self._jobs))
+
+    # -- serving --------------------------------------------------------------
+
+    def _shard_state(self, job: str, shard) -> tuple[dict, dict]:
+        """(job entry, shard state) or a loud KeyError naming what is
+        unknown — the client treats it as transient (the dispatcher may
+        be a fresh instance that has not seen this job's admission) and
+        stays on its local fallback."""
+        sk = _shard_key(shard)
+        with self._lock:
+            entry = self._jobs.get(job)
+        if entry is None:
+            raise KeyError(
+                f"unknown job {job!r} — not admitted to this dispatcher "
+                "and absent from its journal"
+            )
+        with entry["lock"]:
+            sh = entry["shards"].get(sk)
+        if sh is None:
+            raise KeyError(
+                f"job {job!r} has no admission for shard {sk} on this "
+                "dispatcher"
+            )
+        return entry, sh
+
+    @staticmethod
+    def _source_of(sh: dict):
+        if sh["src"] is None:
+            sh["src"] = build_source(sh["spec"])
+        return sh["src"]
+
+    def _validate_cursor(self, job: str, shard, cursor_dict: dict) -> None:
+        """Loud `StreamCursorError` when a presented cursor cannot be
+        honoured byte-exactly by this (job, shard)'s source — the PR 8
+        refusal semantics, surviving serialization."""
+        entry, sh = self._shard_state(job, shard)
+        with entry["lock"]:
+            src = self._source_of(sh)
+            # `batches_from` validates format/kind/seed/geometry EAGERLY
+            # (the generator it returns is lazy, the require() is not) —
+            # building and discarding it is exactly the validation.
+            src.batches_from(cursor_dict)
+
+    def _next_batch(self, job: str, shard, cursor_dict: dict):
+        """The batch at ``cursor`` — by POSITION. The per-shard iterator
+        is a cache: when the requested position is exactly where the
+        cached iterator stands, serving is one `next()`; any other
+        position (client retry, re-attach after OUR crash, a rewound
+        cursor) rebuilds the stream from the cursor — same bytes either
+        way, which is the whole failover argument."""
+        entry, sh = self._shard_state(job, shard)
+        with entry["lock"]:
+            src = self._source_of(sh)
+            cursor = stream_lib.StreamCursor.from_dict(cursor_dict)
+            pos = (cursor.epoch, cursor.step)
+            if sh["it"] is None or sh["pos"] != pos:
+                sh["it"] = src.batches_from(cursor)
+            batch = next(sh["it"])
+            b_per_epoch = cursor.position.get("batches_per_epoch")
+            epoch, step = pos[0], pos[1] + 1
+            if b_per_epoch and step >= int(b_per_epoch):
+                epoch, step = epoch + 1, 0
+            sh["pos"] = (epoch, step)
+        self.registry.counter("hvt_data_batches_served_total", job=job)
+        return batch
+
+    # -- the socket server ----------------------------------------------------
+
+    def _handle_request(self, req: dict) -> tuple[dict, bytes]:
+        op = req.get("op")
+        job = str(req.get("job") or "default")
+        shard = req.get("shard")
+        if op == "ping":
+            with self._lock:
+                jobs = {
+                    j: sorted(e["shards"]) for j, e in self._jobs.items()
+                }
+            return {"ok": True, "protocol": PROTOCOL_VERSION,
+                    "jobs": jobs}, b""
+        if op == "hello":
+            spec = req.get("spec")
+            if spec is not None:
+                self.admit(job, shard, spec)
+            else:
+                self._shard_state(job, shard)  # must already be admitted
+            if req.get("cursor") is not None:
+                self._validate_cursor(job, shard, req["cursor"])
+            return {"ok": True, "job": job,
+                    "adopted": spec is None}, b""
+        if op == "next":
+            cursor = req["cursor"]
+            ms = _dataslow_ms(int(cursor.get("epoch", 0)), shard)
+            if ms is not None:
+                time.sleep(ms / 1e3)
+            batch = self._next_batch(job, shard, cursor)
+            import jax.tree_util
+
+            leaves = [
+                np.ascontiguousarray(a)
+                for a in jax.tree_util.tree_leaves(batch)
+            ]
+            payload = b"".join(a.tobytes() for a in leaves)
+            return {
+                "ok": True,
+                "leaves": [
+                    {"dtype": str(a.dtype), "shape": list(a.shape)}
+                    for a in leaves
+                ],
+            }, payload
+        return {"ok": False, "error": f"unknown op {op!r}"}, b""
+
+    def start(self):
+        """Bind and serve on background threads; returns self. The bound
+        port lands in ``self.port`` (``port=0`` binds ephemerally)."""
+        service = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with service._lock:
+                    service._conns.add(self.request)
+
+            def finish(self):
+                with service._lock:
+                    service._conns.discard(self.request)
+
+            def handle(self):
+                while True:
+                    try:
+                        req, _ = recv_frame(self.request)
+                    except (OSError, ValueError):
+                        return  # torn/garbled frame: drop the connection
+                    if req is None:
+                        return
+                    try:
+                        header, payload = service._handle_request(req)
+                    except stream_lib.StreamCursorError as e:
+                        service.registry.counter(
+                            "hvt_data_cursor_refusals_total"
+                        )
+                        header, payload = {
+                            "ok": False, "refusal": True, "error": str(e),
+                        }, b""
+                    except Exception as e:
+                        header, payload = {
+                            "ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                        }, b""
+                    try:
+                        send_frame(self.request, header, payload)
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+        if self.metrics_port is not None:
+            from horovod_tpu.obs import server as obs_server
+
+            self._metrics_server = obs_server.start_metrics_server(
+                self.metrics_port, host=self.host, registry=self.registry
+            )
+            self.metrics_port = self._metrics_server.server_address[1]
+        self._journal({
+            "name": "serve_start", "value": 1.0, "port": self.port,
+            "metrics_port": self.metrics_port, "pid": os.getpid(),
+        })
+        return self
+
+    def stop(self) -> None:
+        """Tear down like a crash would: the listener AND every live
+        connection die (in-process tests rely on stop() being
+        indistinguishable from a SIGKILL at the socket layer)."""
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()
+            self._metrics_server = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+def _dataslow_ms(epoch: int, shard) -> float | None:
+    """The ``dataslow:MS`` fault's per-response delay applying to this
+    request, or None (`testing.faults.data_fault_ms`; the fault's rank is
+    matched against the requesting client's shard INDEX — the dispatcher
+    has no rank of its own)."""
+    from horovod_tpu.testing import faults
+
+    rank = int(shard[0]) if shard else 0
+    return faults.data_fault_ms("dataslow", epoch=epoch, rank=rank)
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def serve(args) -> int:
+    svc = DataService(
+        args.dir, host=args.host, port=args.port,
+        metrics_port=args.metrics_port,
+    ).start()
+    print(
+        f"hvt-data: serving on {svc.address} "
+        f"(journal {svc.journal_path}"
+        + (f", metrics :{svc.metrics_port}" if svc.metrics_port is not None
+           else "")
+        + ")",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        svc.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hvt-data",
+        description="fault-tolerant distributed data service dispatcher",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser(
+        "serve", help="run the dispatcher daemon (foreground)"
+    )
+    sp.add_argument("--dir", required=True,
+                    help="journal/state directory (restart with the same "
+                    "dir to adopt in-flight jobs)")
+    sp.add_argument("--host", default=None)
+    sp.add_argument("--port", type=int, default=0,
+                    help="bind port (0 = ephemeral, printed on start)")
+    sp.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics (hvt_data_* series) here")
+    args = p.parse_args(argv)
+    return serve(args)
+
+
+def cli() -> None:
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    cli()

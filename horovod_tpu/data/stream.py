@@ -159,8 +159,12 @@ class StreamCursor:
 # --- transient-I/O hardening -------------------------------------------------
 
 # Observable retry telemetry (tests assert it; ops can log it): total
-# transient read failures retried since import.
-RETRY_STATS = {"retried": 0}
+# transient read failures retried since import, and total reads whose
+# retry budget was EXHAUSTED (the degrade/fail-fast escalations).
+# Exported per outcome as `hvt_data_retries_total{outcome=...}` by the
+# trainer exporter's collector (obs/server.py) — a silently retrying
+# fleet must not look healthy on /metrics.
+RETRY_STATS = {"retried": 0, "exhausted": 0}
 
 # Deterministic fault injection for the chaos tests: the first
 # HVT_DATA_FAULT_READS guarded reads raise a (retriable) OSError. Lazily
@@ -216,6 +220,7 @@ def read_with_retries(fn, what: str):
             if attempt < retries:
                 RETRY_STATS["retried"] += 1
                 time.sleep(backoff * (2 ** attempt))
+    RETRY_STATS["exhausted"] += 1
     raise RuntimeError(
         f"transient I/O failure reading {what} persisted through "
         f"{retries} retr{'y' if retries == 1 else 'ies'} "

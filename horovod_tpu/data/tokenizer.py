@@ -34,6 +34,8 @@ import os
 
 import numpy as np
 
+from horovod_tpu.data import stream as stream_lib
+
 
 def _pretokenize(text: str) -> list[bytes]:
     """Whitespace-split with the space glued to the next word: the units
@@ -261,8 +263,11 @@ class ByteBPETokenizer:
 
     @classmethod
     def load(cls, path: str) -> "ByteBPETokenizer":
-        with open(path) as f:
-            payload = json.load(f)
+        def read_payload():
+            with open(path) as f:
+                return json.load(f)
+
+        payload = stream_lib.read_with_retries(read_payload, path)
         if payload.get("format") != "hvt-bbpe-v1":
             raise ValueError(f"not a tokenizer file: {path}")
         return cls(

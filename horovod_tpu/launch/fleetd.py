@@ -198,6 +198,13 @@ def load_entries(spec: dict) -> tuple[dict, list[JobEntry]]:
             min_units=minimum, target_units=target, elastic=elastic,
             env=env, log_path=log_path,
         ))
+    data_service = fleet.get("data_service")
+    if data_service is not None and not isinstance(data_service, dict):
+        errors.append(
+            "fleet data_service: must be a mapping "
+            "({dir:, port:, metrics_port:} — all optional)"
+        )
+        data_service = None
     if errors:
         raise ValueError("; ".join(errors))
     return {
@@ -206,6 +213,7 @@ def load_entries(spec: dict) -> tuple[dict, list[JobEntry]]:
         "tick_s": fleet.get("tick_s"),
         "quarantine_s": fleet.get("quarantine_s"),
         "status_port": fleet.get("status_port"),
+        "data_service": data_service,
     }, entries
 
 
@@ -823,6 +831,14 @@ class Fleetd:
             h: {"slots": n, "until": 0.0} for h, n in cfg["pool"].items()
         }
         self.fleet_checks = spec.get("journal_checks") or {}
+        # Fleet-level metrics gates run against the shared hvt-data
+        # dispatcher's final /metrics scrape (per-job batches-served,
+        # zero cursor refusals).
+        self.fleet_metrics_checks = spec.get("metrics_checks") or {}
+        self.data_service_cfg = cfg.get("data_service")
+        self.data_proc = None
+        self.data_port: int | None = None
+        self.data_metrics_port: int | None = None
         self.jobs: dict = {}
         for e in entries:
             self.jobs[e.name] = {
@@ -916,6 +932,137 @@ class Fleetd:
     def _say(self, msg: str) -> None:
         if self.verbose:
             print(f"fleetd: {msg}")
+
+    # -- shared data service -----------------------------------------------
+    def _start_data_service(self, recovered: bool) -> None:
+        """Bring up (or adopt) the fleet's shared hvt-data dispatcher and
+        point every job at it via HVT_DATA_SERVICE.
+
+        The dispatcher address is journaled so a recovered fleetd restarts
+        a dead dispatcher on the SAME port — adopted jobs hold that
+        address and must be able to re-attach without reconfiguration.
+        """
+        cfg = self.data_service_cfg
+        if cfg is None:
+            return
+        dsdir = os.path.abspath(
+            str(cfg.get("dir") or os.path.join(self.fleet_dir,
+                                               "data-service"))
+        )
+        port = cfg.get("port")
+        metrics_port = cfg.get("metrics_port")
+        adopted_pid = None
+        if recovered:
+            for rec in supervisor.journal_records(self.journal_path):
+                if rec.get("name") != "data_service":
+                    continue
+                port = int(rec.get("port") or 0) or port
+                metrics_port = (
+                    int(rec.get("metrics_port") or 0) or metrics_port
+                )
+                adopted_pid = None
+                if (
+                    metrics_port
+                    and _pid_alive(rec.get("pid"))
+                    and _http_json(
+                        f"http://127.0.0.1:{metrics_port}/healthz"
+                    ) is not None
+                ):
+                    adopted_pid = rec.get("pid")
+        if port is None:
+            port = launcher.pick_free_port()
+        if metrics_port is None:
+            metrics_port = launcher.pick_free_port()
+        self.data_port = int(port)
+        self.data_metrics_port = int(metrics_port)
+        if adopted_pid is not None:
+            self._say(
+                f"adopted data service (pid {adopted_pid}, "
+                f":{self.data_port})"
+            )
+        else:
+            os.makedirs(dsdir, exist_ok=True)
+            self.data_proc = subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.data.service",
+                 "serve", "--dir", dsdir,
+                 "--port", str(self.data_port),
+                 "--metrics-port", str(self.data_metrics_port)],
+                start_new_session=True,
+            )
+            deadline = time.monotonic() + 20.0
+            healthy = False
+            while time.monotonic() < deadline:
+                if _http_json(
+                    f"http://127.0.0.1:{self.data_metrics_port}/healthz"
+                ) is not None:
+                    healthy = True
+                    break
+                if self.data_proc.poll() is not None:
+                    raise RuntimeError(
+                        "hvt-data dispatcher exited during startup "
+                        f"(code {self.data_proc.returncode})"
+                    )
+                time.sleep(0.05)
+            if not healthy:
+                self._stop_data_service()
+                raise RuntimeError(
+                    "hvt-data dispatcher never became healthy on "
+                    f"127.0.0.1:{self.data_metrics_port}"
+                )
+            self._say(
+                f"data service up (pid {self.data_proc.pid}, "
+                f":{self.data_port}, journal at {dsdir})"
+            )
+        self.log.write(
+            "data_service", float(self.data_port), port=self.data_port,
+            metrics_port=self.data_metrics_port, dir=dsdir,
+            pid=(adopted_pid if adopted_pid is not None
+                 else self.data_proc.pid),
+        )
+        addr = f"127.0.0.1:{self.data_port}"
+        for st in self.jobs.values():
+            e: JobEntry = st["entry"]
+            e.env.setdefault("HVT_DATA_SERVICE", addr)
+            env = e.spec.setdefault("job", {}).setdefault("env", {})
+            env.setdefault("HVT_DATA_SERVICE", addr)
+
+    def _stop_data_service(self) -> None:
+        p, self.data_proc = self.data_proc, None
+        if p is None or p.poll() is not None:
+            return
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.monotonic() + 5.0
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if p.poll() is None:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait()
+
+    def _data_gates(self) -> bool:
+        """Fleet-level metrics_checks, evaluated against the dispatcher's
+        final /metrics scrape (dumped for post-mortem). No scrape (no
+        dispatcher, or it is down) leaves the dump absent — and an absent
+        dump FAILS run_prom_checks, so a configured gate cannot silently
+        pass."""
+        if not self.fleet_metrics_checks:
+            return True
+        dump = os.path.join(self.fleet_dir, "data-metrics.prom")
+        text = None
+        if self.data_metrics_port is not None:
+            text = _http_text(
+                f"http://127.0.0.1:{self.data_metrics_port}/metrics"
+            )
+        if text:
+            with open(dump, "w") as f:  # hvt: noqa[HVT005] — gate input;
+                # a torn dump fails the gate, never corrupts state.
+                f.write(text)
+        return ci_gate.run_prom_checks(dump, self.fleet_metrics_checks)
 
     def _place(self, name: str, hosts: list) -> None:
         st = self.jobs[name]
@@ -1219,6 +1366,7 @@ class Fleetd:
                 pool={h: p["slots"] for h, p in self.pool.items()},
                 jobs=sorted(self.jobs),
             )
+        self._start_data_service(recovered)
         server = (
             self._start_status_server(int(self.status_port))
             if self.status_port is not None else None
@@ -1233,6 +1381,10 @@ class Fleetd:
                 ok = ci_gate.run_checks(
                     self.journal_path, self.fleet_checks
                 ) and ok
+            # Scrape + gate the shared dispatcher while it is still up,
+            # THEN retire it.
+            ok = self._data_gates() and ok
+            self._stop_data_service()
             self.log.write("fleet_done", 1.0, ok=ok)
             self._say(f"fleet done ({'all green' if ok else 'FAILED'})")
             return 0 if ok else 1
@@ -1240,6 +1392,7 @@ class Fleetd:
             self._teardown_children()
             raise
         finally:
+            self._stop_data_service()
             if server is not None:
                 server.shutdown()
 

@@ -328,8 +328,34 @@ METRICS: dict[str, MetricSpec] = _decl([
                "everyone else pays).", "training"),
     # --- data ---------------------------------------------------------------
     MetricSpec("hvt_data_retries_total", "counter",
-               "Transient dataset-read faults absorbed by the bounded "
-               "retry path (data.stream.RETRY_STATS).", "data"),
+               "Bounded-retry outcomes of the data layer's transient-"
+               "read discipline (data.stream.RETRY_STATS): "
+               "outcome=retried counts absorbed faults, "
+               "outcome=exhausted counts reads whose whole budget was "
+               "spent (the degrade/fail-fast escalations).", "data",
+               labels=("outcome",)),
+    MetricSpec("hvt_data_batches_served_total", "counter",
+               "Batches the hvt-data dispatcher streamed to clients, "
+               "per admitted job.", "data", labels=("job",)),
+    MetricSpec("hvt_data_admissions_total", "counter",
+               "hvt-data (job, shard) admissions — spec-carrying hellos "
+               "registered (and journaled) by the dispatcher.", "data",
+               labels=("job",)),
+    MetricSpec("hvt_data_cursor_refusals_total", "counter",
+               "StreamCursor refusals the dispatcher sent over the wire "
+               "(foreign format version, wrong engine kind, mismatched "
+               "geometry) — pre-seeded to 0 at startup so a zero gate "
+               "can distinguish 'none' from 'series absent'.", "data"),
+    MetricSpec("hvt_data_jobs", "gauge",
+               "Jobs currently admitted to this hvt-data dispatcher "
+               "(journal-adopted jobs count).", "data"),
+    MetricSpec("hvt_data_degraded_total", "counter",
+               "Times this process's service client exhausted its retry "
+               "budget and degraded to rank-local feeding from the same "
+               "cursor (byte-identical fallback).", "data"),
+    MetricSpec("hvt_data_reattach_total", "counter",
+               "Times a degraded service client re-attached to the "
+               "hvt-data dispatcher at an epoch boundary.", "data"),
     # --- obs (the export surface itself) ------------------------------------
     MetricSpec("hvt_scrapes_total", "counter",
                "GET /metrics requests this exporter answered.", "obs"),

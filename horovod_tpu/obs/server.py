@@ -184,7 +184,12 @@ def _retry_collector(reg) -> None:
     from horovod_tpu.data import stream as stream_lib
 
     reg.counter_set(
-        "hvt_data_retries_total", stream_lib.RETRY_STATS["retried"]
+        "hvt_data_retries_total", stream_lib.RETRY_STATS["retried"],
+        outcome="retried",
+    )
+    reg.counter_set(
+        "hvt_data_retries_total", stream_lib.RETRY_STATS["exhausted"],
+        outcome="exhausted",
     )
 
 

@@ -34,6 +34,22 @@ Kinds:
   replay`` must then name this rank, the swapped seq, and the op — the
   acceptance run for the recorder. Requires ``HVT_FLIGHT_RECORD`` (the
   swap is a no-op with the recorder off; the wedge still fires).
+* ``netdrop:MS`` — a client-side DATA-PLANE fault: the hvt-data service
+  client (`data.client.ServiceClient`) drops its dispatcher connection
+  and delays the reconnect by MS milliseconds before EVERY service fetch
+  DURING the target epoch on the target rank — a bounded data-plane
+  brownout. A short window is absorbed by the `read_with_retries`
+  budget; a window longer than the budget forces the graceful-degrade
+  arc (fall back to rank-local feeding from the same cursor, re-attach
+  at the next epoch boundary) deterministically. Fired by the data
+  plane, not this callback (`data_fault_ms`); window-bounded by
+  construction, so stamps are not needed (honoured if set).
+* ``dataslow:MS`` — the dispatcher-side twin: the hvt-data dispatcher
+  (`data.service`) delays every batch response to the target rank's
+  shard by MS milliseconds from the target epoch ON (a slow data
+  service is a rate, like ``slow:MS``) — the data-plane straggler
+  shape, visible as input-phase time on the fed ranks. Also fired by
+  the data plane via `data_fault_ms`.
 * ``leave`` — clean SIGTERM-style self-removal: the planned-departure shape
   (scheduler preemption honored gracefully, elastic shrink testing). Under
   an elastic launch (``HVT_ELASTIC_COORDINATOR`` set) it only RECORDS leave
@@ -110,8 +126,9 @@ ENV_FAULT_STAMP = "HVT_FAULT_STAMP"
 ENV_FAULT_HOST_PIDS = "HVT_FAULT_HOST_PIDS"
 
 KINDS = ("kill", "hang", "leave", "corrupt", "reorder", "hostdown")
-# plus exitN, corrupt@<target> (parse_plan / corrupt_target) and
-# slow:MS (slow_ms)
+# plus exitN, corrupt@<target> (parse_plan / corrupt_target), slow:MS
+# (slow_ms), and the data-plane kinds netdrop:MS / dataslow:MS
+# (netdrop_ms / dataslow_ms, fired via data_fault_ms)
 
 # Process-wide leave intent (the `leave` fault kind under an elastic
 # launch). The elastic epoch-end agreement consumes it; tests reset it.
@@ -158,6 +175,20 @@ class FaultPlan:
             return float(self.kind[5:])
         return None
 
+    @property
+    def netdrop_ms(self) -> float | None:
+        """The reconnect delay of a ``netdrop:MS`` plan, or None."""
+        if self.kind.startswith("netdrop:"):
+            return float(self.kind[8:])
+        return None
+
+    @property
+    def dataslow_ms(self) -> float | None:
+        """The per-response delay of a ``dataslow:MS`` plan, or None."""
+        if self.kind.startswith("dataslow:"):
+            return float(self.kind[9:])
+        return None
+
 
 def parse_plan(spec: str) -> FaultPlan:
     """Parse ``rank:epoch[.step]:kind`` (kind: ``kill`` | ``hang`` |
@@ -201,25 +232,76 @@ def parse_plan(spec: str) -> FaultPlan:
                 ) from None
         elif kind.startswith("corrupt@"):
             corrupt_target(kind)  # validates; raises on a bad target
-        elif kind.startswith("slow:"):
+        elif kind.startswith(("slow:", "netdrop:", "dataslow:")):
+            prefix, ms_s = kind.split(":", 1)
             try:
-                ms = float(kind[5:])
+                ms = float(ms_s)
             except ValueError:
                 raise ValueError(
-                    f"HVT_FAULT slow kind needs a millisecond count "
-                    f"(slow:50), got {kind!r}"
+                    f"HVT_FAULT {prefix} kind needs a millisecond count "
+                    f"({prefix}:50), got {kind!r}"
                 ) from None
             if ms <= 0:
                 raise ValueError(
-                    f"HVT_FAULT slow:MS needs MS > 0, got {kind!r}"
+                    f"HVT_FAULT {prefix}:MS needs MS > 0, got {kind!r}"
                 )
         else:
             raise ValueError(
                 f"HVT_FAULT kind must be kill, hang, leave, reorder, "
-                f"hostdown, corrupt[@epochN][/shardM], slow:MS or exitN, "
-                f"got {kind!r}"
+                f"hostdown, corrupt[@epochN][/shardM], slow:MS, "
+                f"netdrop:MS, dataslow:MS or exitN, got {kind!r}"
             )
     return FaultPlan(rank=rank, epoch=epoch, kind=kind, step=step)
+
+
+def data_fault_ms(kind: str, *, epoch: int,
+                  rank: int | None = None) -> float | None:
+    """The active ``HVT_FAULT`` plan's data-plane delay (ms) applying at
+    this position, or None — how the hvt-data client (``netdrop``) and
+    dispatcher (``dataslow``) consult the fault plan, since the trainer
+    callback cannot reach into the data plane's sockets.
+
+    ``netdrop`` fires for every service fetch DURING the target epoch
+    (``==`` — a bounded brownout window, so degrade → local → re-attach
+    is deterministic); a set ``HVT_FAULT_STAMP`` makes it one-shot
+    instead (touched before the first fire, never fires while it
+    exists). ``dataslow`` fires from the target epoch ON (``>=`` — a
+    slow dispatcher is a rate, like ``slow:MS``; stamps don't apply).
+    ``rank`` is matched against the plan's rank when given (the client
+    passes its shard index). Parsed fresh per call, so a test's
+    monkeypatched env is honoured; an unset or unparseable plan is
+    simply no fault."""
+    if kind not in ("netdrop", "dataslow"):
+        raise ValueError(
+            f"data_fault_ms kind must be netdrop or dataslow, got {kind!r}"
+        )
+    spec = registry.get_str(ENV_FAULT)
+    if not spec:
+        return None
+    try:
+        plan = parse_plan(spec)
+    except ValueError:
+        return None
+    if rank is not None and plan.rank != rank:
+        return None
+    if kind == "netdrop":
+        ms = plan.netdrop_ms
+        if ms is None or epoch != plan.epoch:
+            return None
+        stamp = registry.get_str(ENV_FAULT_STAMP)
+        if stamp:
+            if os.path.exists(stamp):
+                return None  # one-shot spent in an earlier launch
+            d = os.path.dirname(stamp)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # Empty stamp touch: existence IS the payload.
+            open(stamp, "w").close()  # hvt: noqa[HVT005]
+        return ms
+    ms = plan.dataslow_ms
+    if ms is None or epoch < plan.epoch:
+        return None
+    return ms
 
 
 def register_host_pid(pid_dir: str, pid: int | None = None) -> str:
@@ -384,6 +466,14 @@ class FaultInjectionCallback(Callback):
                 pass  # chaos bookkeeping must never fail training
 
     def on_batch_end(self, batch: int, logs=None):
+        if (
+            self.plan.netdrop_ms is not None
+            or self.plan.dataslow_ms is not None
+        ):
+            # Data-plane kinds: fired by the hvt-data client/dispatcher
+            # (`data_fault_ms`), not by the trainer callback — the
+            # callback cannot reach into the data plane's sockets.
+            return
         if self.plan.slow_ms is not None:
             # The straggler fault is RECURRING: every batch end from the
             # target epoch on, this rank drags its feet by MS — stamps

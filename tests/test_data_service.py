@@ -1,0 +1,660 @@
+"""hvt-data — the distributed data service, tier-1 lane (PR 20).
+
+In-process, bounded units over the dispatcher (`data.service.DataService`)
+and the trainer-side client (`data.client.ServiceClient`):
+
+* wire protocol framing (round-trip, torn-frame detection);
+* byte identity: served batches == the client's local stream, batch for
+  batch (the failover argument's foundation);
+* `StreamCursor` refusals SURVIVE serialization — foreign format, wrong
+  engine kind, wrong geometry all come back as loud, never-retried
+  `StreamCursorError`s and count on ``hvt_data_cursor_refusals_total``;
+* journal recovery: a stopped dispatcher restarted on the same ``--dir``
+  adopts its admissions and serves a SPEC-LESS re-attach;
+* the degrade → rank-local → re-attach arc, byte-identical throughout;
+* per-job isolation: a wedged job never delays another job's serving;
+* the ``netdrop``/``dataslow`` fault kinds (parse + firing windows);
+* the retries-outcome collector export and the fleet data_service spec
+  plumbing.
+
+The subprocess chaos runs (dispatcher SIGKILL mid-fit, checkpoint
+byte-identity against a locally-fed control) live in
+tests/test_data_service_e2e.py, slow lane.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.data import client as client_lib
+from horovod_tpu.data import service as service_lib
+from horovod_tpu.data import stream as stream_lib
+from horovod_tpu.data.client import ServiceClient, build_source
+from horovod_tpu.data.service import DataService
+from horovod_tpu.obs import prom as obs_prom
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype(np.float32)
+    y = (np.arange(64) % 4).astype(np.int64)
+    path = str(tmp_path / "corpus.npz")
+    np.savez(path, x=x, y=y)
+    return path
+
+
+def _spec(path, batch=8, seed=11, shard=None):
+    return {
+        "source": "npz", "path": path, "keys": ["x", "y"],
+        "batch_size": batch, "seed": seed, "shuffle_buffer": 0,
+        "shard": shard,
+    }
+
+
+@pytest.fixture()
+def svc(tmp_path):
+    s = DataService(str(tmp_path / "svc")).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture(autouse=True)
+def _retry_stats_hygiene():
+    """RETRY_STATS is process-global (the trainer exporter mirrors it);
+    the degrade/retry arcs exercised here must not leak counts into
+    later tests' scrapes."""
+    saved = dict(stream_lib.RETRY_STATS)
+    yield
+    stream_lib.RETRY_STATS.clear()
+    stream_lib.RETRY_STATS.update(saved)
+
+
+@pytest.fixture()
+def fast_retries(monkeypatch):
+    monkeypatch.setenv("HVT_DATA_RETRIES", "1")
+    monkeypatch.setenv("HVT_DATA_BACKOFF_S", "0.001")
+    monkeypatch.delenv("HVT_FAULT", raising=False)
+    monkeypatch.delenv("HVT_FAULT_STAMP", raising=False)
+
+
+def _batch_bytes(batch):
+    import jax.tree_util
+
+    return b"".join(
+        np.ascontiguousarray(a).tobytes()
+        for a in jax.tree_util.tree_leaves(batch)
+    )
+
+
+def _refusals(svc):
+    values = obs_prom.parse_text(obs_prom.render(svc.registry))
+    return values.get("hvt_data_cursor_refusals_total")
+
+
+# --- wire protocol ---------------------------------------------------------
+
+
+class TestFraming:
+    def test_frame_round_trip_with_payload(self):
+        a, b = socket.socketpair()
+        try:
+            service_lib.send_frame(a, {"op": "x", "n": 3}, b"\x00\x01pay")
+            header, payload = service_lib.recv_frame(b)
+            assert header == {"op": "x", "n": 3}
+            assert payload == b"\x00\x01pay"
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_eof_is_none_torn_frame_raises(self):
+        a, b = socket.socketpair()
+        a.close()
+        assert service_lib.recv_frame(b) == (None, b"")
+        b.close()
+        a, b = socket.socketpair()
+        try:
+            # A header promising more bytes than ever arrive: EOF lands
+            # MID-frame and must raise, not read as a clean close.
+            a.sendall(service_lib._FRAME.pack(100, 0) + b"{}")
+            a.close()
+            with pytest.raises(ConnectionError, match="mid-frame"):
+                service_lib.recv_frame(b)
+        finally:
+            b.close()
+
+
+# --- serving: byte identity ------------------------------------------------
+
+
+class TestServedByteIdentity:
+    def test_served_batches_equal_local_stream(self, corpus, svc):
+        spec = _spec(corpus)
+        client = ServiceClient(
+            build_source(spec), spec, job="idjob", address=svc.address
+        )
+        served = client.batches(batches_per_epoch=4)
+        local = build_source(spec).batches(batches_per_epoch=4)
+        # 10 batches = two epoch boundaries crossed while ATTACHED.
+        for _ in range(10):
+            assert _batch_bytes(next(served)) == _batch_bytes(next(local))
+        client.close()
+        assert client.events == []  # no degrade, no re-attach
+
+    def test_sharded_specs_stay_disjoint_per_client(self, corpus, svc):
+        specs = [_spec(corpus, shard=[i, 2]) for i in range(2)]
+        got = []
+        for i, spec in enumerate(specs):
+            c = ServiceClient(
+                build_source(spec), spec, job="shards", shard=(i, 2),
+                address=svc.address,
+            )
+            it = c.batches(batches_per_epoch=2)
+            got.append([_batch_bytes(next(it)) for _ in range(2)])
+            c.close()
+        assert got[0] != got[1]  # distinct shards, distinct bytes
+        for i, spec in enumerate(specs):
+            local = build_source(spec).batches(batches_per_epoch=2)
+            assert got[i] == [_batch_bytes(next(local)) for _ in range(2)]
+
+
+# --- refusals over the wire ------------------------------------------------
+
+
+class TestCursorRefusalsOverTheWire:
+    def _attach(self, svc, spec, job="refuse"):
+        sock = socket.create_connection(
+            ("127.0.0.1", svc.port), timeout=5
+        )
+        service_lib.send_frame(sock, {
+            "op": "hello", "job": job, "shard": [0, 1], "spec": spec,
+        })
+        resp, _ = service_lib.recv_frame(sock)
+        assert resp["ok"]
+        return sock
+
+    def _next(self, sock, job, cursor):
+        service_lib.send_frame(sock, {
+            "op": "next", "job": job, "shard": [0, 1], "cursor": cursor,
+        })
+        resp, _ = service_lib.recv_frame(sock)
+        return resp
+
+    def test_foreign_format_wrong_kind_wrong_geometry_all_refused(
+        self, corpus, svc
+    ):
+        spec = _spec(corpus, batch=8)
+        good = build_source(spec).stream_cursor(
+            0, 0, batches_per_epoch=4
+        ).to_dict()
+        sock = self._attach(svc, spec)
+        try:
+            assert _refusals(svc) == 0  # pre-seeded, not absent
+            foreign = dict(good, format=99)
+            wrong_kind = dict(good, kind="file")
+            wrong_geometry = build_source(
+                _spec(corpus, batch=4)
+            ).stream_cursor(0, 0, batches_per_epoch=4).to_dict()
+            for i, cursor in enumerate(
+                [foreign, wrong_kind, wrong_geometry], start=1
+            ):
+                resp = self._next(sock, "refuse", cursor)
+                assert resp["ok"] is False
+                assert resp["refusal"] is True
+                assert _refusals(svc) == i
+            # The connection SURVIVES a refusal — the good cursor still
+            # serves on it (refusal is a verdict, not a transport error).
+            resp = self._next(sock, "refuse", good)
+            assert resp["ok"] is True
+        finally:
+            sock.close()
+
+    def test_client_raises_refusals_without_spending_retries(
+        self, corpus, svc, fast_retries
+    ):
+        # Admit batch_size=8; present cursors from a batch_size=4 source:
+        # geometry refusal, surfaced as StreamCursorError, NOT retried.
+        client = ServiceClient(
+            build_source(_spec(corpus, batch=4)), _spec(corpus, batch=8),
+            job="georefuse", address=svc.address,
+        )
+        before = dict(stream_lib.RETRY_STATS)
+        with pytest.raises(stream_lib.StreamCursorError, match="refused"):
+            next(client.batches(batches_per_epoch=4))
+        assert stream_lib.RETRY_STATS == before  # no retry spent
+        client.close()
+
+    def test_first_admission_requires_a_spec(self, corpus, svc):
+        client = ServiceClient(
+            build_source(_spec(corpus)), None, job="specless",
+            address=svc.address,
+        )
+        with pytest.raises(ValueError, match="spec"):
+            next(client.batches(batches_per_epoch=4))
+        client.close()
+
+
+# --- journal recovery ------------------------------------------------------
+
+
+class TestJournalRecovery:
+    def test_restarted_dispatcher_adopts_and_serves_specless_reattach(
+        self, corpus, tmp_path, fast_retries
+    ):
+        root = str(tmp_path / "svc")
+        spec = _spec(corpus)
+        svc1 = DataService(root).start()
+        client = ServiceClient(
+            build_source(spec), spec, job="durable", address=svc1.address
+        )
+        it = client.batches(batches_per_epoch=4)
+        first = [_batch_bytes(next(it)) for _ in range(2)]
+        port = svc1.port
+        svc1.stop()  # indistinguishable from SIGKILL at the socket layer
+
+        svc2 = DataService(root, port=port).start()
+        try:
+            # The journal recorded the admission; the restarted instance
+            # journals its adoption...
+            with open(svc2.journal_path) as f:
+                names = [json.loads(l)["name"] for l in f if l.strip()]
+            assert "recover" in names
+            # ...and the client's NEXT fetch rides a retry through a
+            # reconnect + SPEC-LESS hello (`_ever_admitted` is set) — the
+            # recovery proof — continuing the stream byte-exactly.
+            assert client._ever_admitted
+            client.spec = None  # a re-attach hello must not need it
+            more = [_batch_bytes(next(it)) for _ in range(2)]
+            local = build_source(spec).batches(batches_per_epoch=4)
+            want = [_batch_bytes(next(local)) for _ in range(4)]
+            assert first + more == want
+            assert client.events == []  # absorbed by retries, no degrade
+        finally:
+            svc2.stop()
+            client.close()
+
+    def test_fresh_dispatcher_does_not_adopt_unknown_jobs(
+        self, corpus, tmp_path, fast_retries
+    ):
+        # A dispatcher with a DIFFERENT (empty) journal must refuse to
+        # guess: the spec-less hello errors, the client's budget drains,
+        # and it degrades to local rather than forking the stream.
+        svc = DataService(str(tmp_path / "other")).start()
+        spec = _spec(corpus)
+        client = ServiceClient(
+            build_source(spec), spec, job="ghost", address=svc.address
+        )
+        client._ever_admitted = True  # simulate a pre-crash admission
+        client.spec = None
+        try:
+            it = client.batches(batches_per_epoch=4)
+            batch = next(it)  # degraded, still correct bytes
+            local = build_source(spec).batches(batches_per_epoch=4)
+            assert _batch_bytes(batch) == _batch_bytes(next(local))
+            assert [e["event"] for e in client.events] == ["degrade"]
+        finally:
+            svc.stop()
+            client.close()
+
+
+# --- the degrade → local → re-attach arc -----------------------------------
+
+
+class TestDegradeAndReattach:
+    def test_outage_degrades_byte_identically_and_reattaches(
+        self, corpus, tmp_path, fast_retries
+    ):
+        root = str(tmp_path / "svc")
+        spec = _spec(corpus)
+        B = 3
+        svc = DataService(root).start()
+        port = svc.port
+        client = ServiceClient(
+            build_source(spec), spec, job="arc", address=svc.address
+        )
+        it = client.batches(batches_per_epoch=B)
+        control = build_source(spec).batches(batches_per_epoch=B)
+
+        got = [_batch_bytes(next(it)) for _ in range(2)]  # served
+        svc.stop()
+        # Budget (1 retry) drains on the outage → degrade; the stream
+        # continues LOCALLY from the same cursor, byte-identically.
+        got += [_batch_bytes(next(it)) for _ in range(B)]
+        assert [e["event"] for e in client.events] == ["degrade"]
+        # Restart on the SAME dir + port; the next epoch BOUNDARY
+        # re-attaches (mid-epoch stays local — order never forks).
+        svc2 = DataService(root, port=port).start()
+        try:
+            got += [_batch_bytes(next(it)) for _ in range(2 * B)]
+            events = [e["event"] for e in client.events]
+            assert events == ["degrade", "reattach"]
+            assert client.events[1]["epoch"] >= 1
+            want = [_batch_bytes(next(control)) for _ in range(len(got))]
+            assert got == want
+        finally:
+            svc2.stop()
+            client.close()
+
+    def test_unset_service_is_pure_local_passthrough(
+        self, corpus, monkeypatch
+    ):
+        monkeypatch.delenv("HVT_DATA_SERVICE", raising=False)
+        spec = _spec(corpus)
+        client = ServiceClient(build_source(spec), spec, job="local")
+        assert client.address is None
+        it = client.batches(batches_per_epoch=4)
+        local = build_source(spec).batches(batches_per_epoch=4)
+        for _ in range(5):
+            assert _batch_bytes(next(it)) == _batch_bytes(next(local))
+        assert client.events == []
+
+
+# --- per-job isolation -----------------------------------------------------
+
+
+class _WedgedSource:
+    """A source whose stream blocks on a gate INSIDE the dispatcher's
+    serving path — the pathological job of the isolation unit."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+
+    def batches_from(self, cursor):
+        def gen():
+            self.gate.wait()
+            while True:
+                yield (np.zeros((2, 2), np.float32),)
+
+        return gen()
+
+
+class TestPerJobIsolation:
+    def test_wedged_job_never_delays_another_jobs_serving(
+        self, corpus, svc
+    ):
+        wedge = _WedgedSource()
+        svc.register_local("wedged", (0, 1), wedge)
+        cursor = stream_lib.StreamCursor(
+            kind="array", seed=0, epoch=0, step=0, position={}
+        ).to_dict()
+
+        wedged_done = threading.Event()
+
+        def fetch_wedged():
+            sock = socket.create_connection(
+                ("127.0.0.1", svc.port), timeout=60
+            )
+            try:
+                service_lib.send_frame(sock, {
+                    "op": "next", "job": "wedged", "shard": [0, 1],
+                    "cursor": cursor,
+                })
+                resp, _ = service_lib.recv_frame(sock)
+                if resp and resp.get("ok"):
+                    wedged_done.set()
+            finally:
+                sock.close()
+
+        t = threading.Thread(target=fetch_wedged, daemon=True)
+        t.start()
+        # The wedged request is now parked inside job A's serving path.
+        time.sleep(0.2)
+        assert not wedged_done.is_set()
+
+        # Job B — admission AND serving — completes promptly regardless.
+        spec = _spec(corpus)
+        client = ServiceClient(
+            build_source(spec), spec, job="brisk", address=svc.address
+        )
+        start = time.monotonic()
+        batch = next(client.batches(batches_per_epoch=4))
+        elapsed = time.monotonic() - start
+        client.close()
+        assert elapsed < 5.0
+        local = build_source(spec).batches(batches_per_epoch=4)
+        assert _batch_bytes(batch) == _batch_bytes(next(local))
+        assert not wedged_done.is_set()  # A is still parked...
+
+        wedge.gate.set()  # ...and completes once its own job unwedges
+        t.join(timeout=10)
+        assert wedged_done.is_set()
+
+
+# --- the netdrop / dataslow fault kinds ------------------------------------
+
+
+class TestDataFaultKinds:
+    def test_parse_plan_accepts_both_kinds(self):
+        from horovod_tpu.testing import faults
+
+        plan = faults.parse_plan("1:2:netdrop:50")
+        assert (plan.rank, plan.epoch) == (1, 2)
+        assert plan.netdrop_ms == 50.0
+        assert plan.dataslow_ms is None
+        plan = faults.parse_plan("0:3:dataslow:25")
+        assert plan.dataslow_ms == 25.0
+        assert plan.netdrop_ms is None
+        with pytest.raises(ValueError, match="netdrop"):
+            faults.parse_plan("0:1:netdrop:nope")
+        with pytest.raises(ValueError, match="dataslow:MS"):
+            faults.parse_plan("0:1:sever")
+
+    def test_netdrop_window_is_the_target_epoch_only(self, monkeypatch):
+        from horovod_tpu.testing import faults
+
+        monkeypatch.setenv("HVT_FAULT", "1:2:netdrop:40")
+        monkeypatch.delenv("HVT_FAULT_STAMP", raising=False)
+        ms = faults.data_fault_ms
+        assert ms("netdrop", epoch=2, rank=1) == 40.0
+        assert ms("netdrop", epoch=2, rank=1) == 40.0  # stamp-less: recurs
+        assert ms("netdrop", epoch=1, rank=1) is None  # before the window
+        assert ms("netdrop", epoch=3, rank=1) is None  # bounded brownout
+        assert ms("netdrop", epoch=2, rank=0) is None  # other rank
+        assert ms("dataslow", epoch=2, rank=1) is None  # other kind
+
+    def test_netdrop_stamp_makes_it_one_shot(self, tmp_path, monkeypatch):
+        from horovod_tpu.testing import faults
+
+        monkeypatch.setenv("HVT_FAULT", "0:1:netdrop:10")
+        monkeypatch.setenv("HVT_FAULT_STAMP", str(tmp_path / "stamp"))
+        assert faults.data_fault_ms("netdrop", epoch=1, rank=0) == 10.0
+        assert faults.data_fault_ms("netdrop", epoch=1, rank=0) is None
+
+    def test_dataslow_fires_from_target_epoch_on(self, monkeypatch):
+        from horovod_tpu.testing import faults
+
+        monkeypatch.setenv("HVT_FAULT", "0:2:dataslow:15")
+        ms = faults.data_fault_ms
+        assert ms("dataslow", epoch=1, rank=0) is None
+        assert ms("dataslow", epoch=2, rank=0) == 15.0
+        assert ms("dataslow", epoch=9, rank=0) == 15.0  # a rate, like slow
+
+    def test_unset_or_foreign_plan_is_no_fault(self, monkeypatch):
+        from horovod_tpu.testing import faults
+
+        monkeypatch.delenv("HVT_FAULT", raising=False)
+        assert faults.data_fault_ms("netdrop", epoch=0) is None
+        monkeypatch.setenv("HVT_FAULT", "0:1:kill")
+        assert faults.data_fault_ms("netdrop", epoch=1, rank=0) is None
+        with pytest.raises(ValueError, match="netdrop or dataslow"):
+            faults.data_fault_ms("kill", epoch=1)
+
+    def test_client_netdrop_drops_the_connection_during_the_epoch(
+        self, corpus, svc, monkeypatch
+    ):
+        monkeypatch.setenv("HVT_FAULT", "0:1:netdrop:1")
+        monkeypatch.delenv("HVT_FAULT_STAMP", raising=False)
+        monkeypatch.setenv("HVT_DATA_RETRIES", "4")
+        monkeypatch.setenv("HVT_DATA_BACKOFF_S", "0.001")
+        spec = _spec(corpus)
+        client = ServiceClient(
+            build_source(spec), spec, job="dropjob", shard=(0, 1),
+            address=svc.address,
+        )
+        before = stream_lib.RETRY_STATS["retried"]
+        it = client.batches(batches_per_epoch=2)
+        control = build_source(spec).batches(batches_per_epoch=2)
+        # Epoch 0 serves cleanly; EVERY epoch-1 fetch hits the injected
+        # drop and retries also hit it → budget drains → degrade → local,
+        # byte-identical; epoch 2 re-attaches (the window closed).
+        got = [_batch_bytes(next(it)) for _ in range(6)]
+        want = [_batch_bytes(next(control)) for _ in range(6)]
+        assert got == want
+        assert stream_lib.RETRY_STATS["retried"] > before
+        events = [e["event"] for e in client.events]
+        assert events == ["degrade", "reattach"]
+        assert client.events[0]["epoch"] == 1
+        assert client.events[1]["epoch"] == 2
+        client.close()
+
+
+# --- observability ---------------------------------------------------------
+
+
+class TestObservability:
+    def test_retry_outcome_collector_mirrors_stream_stats(self):
+        from horovod_tpu.obs import core as obs_core
+        from horovod_tpu.obs.server import _retry_collector
+
+        reg = obs_core.Registry()
+        reg.register_collector(_retry_collector)
+        saved = dict(stream_lib.RETRY_STATS)
+        try:
+            stream_lib.RETRY_STATS["retried"] = 7
+            stream_lib.RETRY_STATS["exhausted"] = 2
+            values = obs_prom.parse_text(obs_prom.render(reg))
+            assert values['hvt_data_retries_total{outcome="retried"}'] == 7
+            assert (
+                values['hvt_data_retries_total{outcome="exhausted"}'] == 2
+            )
+        finally:
+            stream_lib.RETRY_STATS.update(saved)
+
+    def test_dispatcher_metrics_series(self, corpus, svc):
+        spec = _spec(corpus)
+        client = ServiceClient(
+            build_source(spec), spec, job="metered", address=svc.address
+        )
+        it = client.batches(batches_per_epoch=4)
+        for _ in range(3):
+            next(it)
+        client.close()
+        values = obs_prom.parse_text(obs_prom.render(svc.registry))
+        assert values['hvt_data_batches_served_total{job="metered"}'] == 3
+        assert values['hvt_data_admissions_total{job="metered"}'] == 1
+        assert values["hvt_data_cursor_refusals_total"] == 0
+        assert values["hvt_data_jobs"] >= 1
+
+    def test_metrics_server_serves_healthz_and_series(
+        self, corpus, tmp_path
+    ):
+        import urllib.request
+
+        svc = DataService(str(tmp_path / "m"), metrics_port=0).start()
+        try:
+            base = f"http://127.0.0.1:{svc.metrics_port}"
+            with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+                values = obs_prom.parse_text(r.read().decode())
+            assert values["hvt_data_cursor_refusals_total"] == 0
+        finally:
+            svc.stop()
+
+
+# --- fleet spec plumbing ---------------------------------------------------
+
+
+class TestFleetDataServiceSpec:
+    SPEC = os.path.join(REPO, "horovod_tpu", "launch", "jobs",
+                        "fleet-shared-data-2job.yaml")
+
+    def test_shipped_shared_data_fleet_spec_loads(self):
+        import yaml
+
+        from horovod_tpu.launch import fleetd
+
+        with open(self.SPEC) as f:
+            spec = yaml.safe_load(f)
+        cfg, entries = fleetd.load_entries(spec)
+        assert cfg["data_service"]["dir"].endswith("data-service")
+        assert sorted(e.name for e in entries) == ["alpha", "beta"]
+        jobs = {e.name: e for e in entries}
+        assert {jobs[n].env["HVT_DATA_JOB"] for n in jobs} == {
+            "alpha", "beta"
+        }
+        mc = spec["metrics_checks"]
+        assert 'hvt_data_batches_served_total{job="alpha"}' in mc
+        assert 'hvt_data_batches_served_total{job="beta"}' in mc
+        assert mc["hvt_data_cursor_refusals_total"]["target"] == "0..0"
+
+    def test_data_service_must_be_a_mapping(self):
+        from horovod_tpu.launch import fleetd
+
+        spec = {
+            "fleet": {"pool": {"h0": {"slots": 1}},
+                      "data_service": "yes please"},
+            "jobs": [{"name": "j", "job": {
+                "command": "true",
+                "env": {"PS_MODEL_PATH": "/tmp/x"},
+            }}],
+        }
+        with pytest.raises(ValueError, match="data_service"):
+            fleetd.load_entries(spec)
+
+    def test_fleetd_injects_service_address_into_job_envs(
+        self, corpus, tmp_path, monkeypatch
+    ):
+        import yaml
+
+        from horovod_tpu.launch import fleetd
+
+        with open(self.SPEC) as f:
+            text = f.read()
+        assert "/tmp/hvt-fleet-data" in text  # the relocatable paths
+        spec = yaml.safe_load(
+            text.replace("/tmp/hvt-fleet-data", str(tmp_path))
+        )
+        daemon = fleetd.Fleetd(spec, verbose=False)
+        from horovod_tpu.launch import supervisor
+
+        daemon.log = supervisor.RestartLog(daemon.journal_path)
+        os.makedirs(daemon.fleet_dir, exist_ok=True)
+        daemon._start_data_service(recovered=False)
+        try:
+            addr = f"127.0.0.1:{daemon.data_port}"
+            for st in daemon.jobs.values():
+                e = st["entry"]
+                assert e.env["HVT_DATA_SERVICE"] == addr
+                assert e.spec["job"]["env"]["HVT_DATA_SERVICE"] == addr
+            # The address is journaled for same-port restart on recovery.
+            with open(daemon.journal_path) as f:
+                recs = [json.loads(l) for l in f if l.strip()]
+            ds = [r for r in recs if r.get("name") == "data_service"]
+            assert ds and ds[0]["port"] == daemon.data_port
+            # And the dispatcher is really up: gate PASSES on a live
+            # scrape once a served batch lands for each gated job.
+            for jobname in ("alpha", "beta"):
+                s = _spec(corpus)
+                c = ServiceClient(
+                    build_source(s), s, job=jobname, address=addr
+                )
+                next(c.batches(batches_per_epoch=2))
+                c.close()
+            assert daemon._data_gates() is True
+            assert os.path.exists(
+                os.path.join(daemon.fleet_dir, "data-metrics.prom")
+            )
+        finally:
+            daemon._stop_data_service()
+        # With the dispatcher gone and the dump removed, the gate FAILS
+        # loudly instead of passing vacuously.
+        os.remove(os.path.join(daemon.fleet_dir, "data-metrics.prom"))
+        assert daemon._data_gates() is False

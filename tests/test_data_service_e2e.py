@@ -1,0 +1,283 @@
+"""hvt-data chaos acceptance (slow lane) — the ISSUE 20 e2e runs.
+
+* **The dispatcher-kill chaos run**: a REAL 2-process service-fed fit
+  (`examples/service_fed_fit.py`) against an external `hvt-data serve`
+  dispatcher subprocess. Mid-run (once epoch 2 is underway) the
+  dispatcher is SIGKILLed and restarted on the SAME ``--dir`` + port
+  (journal recovery); separately, ``HVT_FAULT=1:1:netdrop:MS`` drops
+  rank 1's connection on every fetch of epoch 1 → that rank degrades to
+  rank-local feeding from the same cursor and re-attaches at the next
+  epoch boundary. The FINAL checkpoint must be byte-identical to an
+  uninterrupted, locally-fed control run's, and the per-batch DIGEST_LOG
+  sha256 maps must match exactly — the strongest possible statement that
+  served, degraded-local, and recovered-dispatcher batches are ONE byte
+  stream. The dispatcher also carries ``dataslow`` (its own HVT_FAULT),
+  so the per-batch delay path runs under the same roof.
+
+* **The shared-data fleet scenario**: the shipped
+  `launch/jobs/fleet-shared-data-2job.yaml` through the real
+  `hvt-launch fleet` CLI — fleetd owns one dispatcher, injects
+  HVT_DATA_SERVICE into both jobs, and the fleet-level metrics gates
+  (per-job ``hvt_data_batches_served_total`` ≥ 1, zero cursor refusals)
+  must come back green against the dispatcher's final scrape.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.launch import launcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "service_fed_fit.py")
+
+STEPS, EPOCHS = 25, 6
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_tcp(port, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return
+        except OSError:
+            time.sleep(0.05)
+    raise AssertionError(f"dispatcher never listened on :{port}")
+
+
+def _fit_env(root, **extra):
+    env = {
+        **os.environ,
+        "HVT_PLATFORM": "cpu",
+        "HVT_NUM_CPU_DEVICES": "1",
+        "PS_MODEL_PATH": str(root),
+        "DIGEST_LOG": str(root / "digests"),
+        "DRIVE_STEPS": str(STEPS),
+        "DRIVE_EPOCHS": str(EPOCHS),
+        "N_ROWS": "400",
+        # SIGKILL choreography must not share the suite's persistent XLA
+        # cache (torn writes poison later runs — conftest caveat).
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
+        **{k: str(v) for k, v in extra.items()},
+    }
+    for k in ("HVT_FAULT", "HVT_FAULT_STAMP", "HVT_DATA_SERVICE"):
+        env.pop(k, None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _digests(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            key = (rec["epoch"], rec["step"])
+            # A key logged twice (consumed again around a failover) must
+            # carry the SAME bytes.
+            if key in out:
+                assert out[key] == rec["sha256"], (
+                    f"replayed batch {key} differs"
+                )
+            out[key] = rec["sha256"]
+    return out
+
+
+def _spawn_dispatcher(dirpath, port):
+    env = {**os.environ,
+           # The dispatcher-side per-batch delay fault rides along: every
+           # shard-0 'next' from epoch 0 on is delayed — which also paces
+           # the tiny fit enough to SIGKILL it mid-flight reliably.
+           "HVT_FAULT": "0:0:dataslow:20"}
+    env.pop("HVT_FAULT_STAMP", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.data.service", "serve",
+         "--dir", str(dirpath), "--port", str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.slow
+def test_dispatcher_sigkill_and_netdrop_end_byte_identical(tmp_path):
+    """THE chaos acceptance run: dispatcher SIGKILL + journal-recovered
+    restart, a netdrop brownout degrading one rank to local feeding, and
+    a FINAL checkpoint byte-identical to the locally-fed control."""
+    # Control: same script, no HVT_DATA_SERVICE — pure local feeding.
+    ctrl = tmp_path / "ctrl"
+    code = launcher.run_local(
+        2, [sys.executable, EXAMPLE], env=_fit_env(ctrl), tag_output=False
+    )
+    assert code == 0
+
+    # Chaos: external dispatcher, netdrop on rank 1 during epoch 1.
+    chaos = tmp_path / "chaos"
+    dsdir = tmp_path / "dispatch"
+    port = _free_port()
+    disp = _spawn_dispatcher(dsdir, port)
+    killed = restarted = None
+    fit = None
+    try:
+        _wait_tcp(port)
+        fit = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.launch", "run",
+             "--nprocs", "2", "--", sys.executable, EXAMPLE],
+            env=_fit_env(
+                chaos,
+                HVT_DATA_SERVICE=f"127.0.0.1:{port}",
+                HVT_DATA_RETRIES="2",
+                HVT_DATA_BACKOFF_S="0.05",
+                HVT_FAULT="1:1:netdrop:5",
+            ),
+            cwd=REPO,
+        )
+        # SIGKILL the dispatcher once epoch 2 is underway (the digest
+        # audit stream is the ground truth for "underway").
+        digest0 = chaos / "digests.rank0"
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if fit.poll() is not None:
+                break
+            try:
+                with open(digest0) as f:
+                    if any(json.loads(l)["epoch"] >= 2
+                           for l in f if l.strip()):
+                        break
+            except OSError:
+                pass
+            time.sleep(0.02)
+        assert fit.poll() is None, "fit finished before the kill window"
+        disp.kill()
+        disp.wait()
+        killed = True
+        time.sleep(0.5)  # a real outage: retries drain, ranks degrade
+        disp = _spawn_dispatcher(dsdir, port)  # SAME dir + port: recovery
+        _wait_tcp(port)
+        restarted = True
+        assert fit.wait(timeout=600) == 0
+        # The restarted dispatcher ADOPTED the journaled admissions: a
+        # SPEC-LESS hello (the re-attach form) succeeds, and the batch it
+        # serves is byte-identical to the local derivation — journal
+        # recovery, proven at the byte level.
+        from horovod_tpu.data import service as service_lib
+        from horovod_tpu.data.client import build_source
+
+        spec = {
+            "source": "npz", "path": str(chaos / "corpus.npz"),
+            "keys": ["x", "y"], "batch_size": 8, "seed": 11,
+            "shuffle_buffer": 0, "shard": [0, 2],
+        }
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            service_lib.send_frame(sock, {
+                "op": "hello", "job": "default", "shard": [0, 2],
+            })
+            resp, _ = service_lib.recv_frame(sock)
+            assert resp["ok"] and resp["adopted"], resp
+            cursor = build_source(spec).stream_cursor(
+                0, 0, batches_per_epoch=STEPS
+            ).to_dict()
+            service_lib.send_frame(sock, {
+                "op": "next", "job": "default", "shard": [0, 2],
+                "cursor": cursor,
+            })
+            resp, payload = service_lib.recv_frame(sock)
+            assert resp["ok"], resp
+            import numpy as np
+
+            x, y = next(build_source(spec).batches(batches_per_epoch=STEPS))
+            want = (np.ascontiguousarray(x).tobytes()
+                    + np.ascontiguousarray(y).tobytes())
+            assert payload == want
+        finally:
+            sock.close()
+    finally:
+        if fit is not None and fit.poll() is None:
+            fit.kill()
+        disp.kill()
+        disp.wait()
+    assert killed and restarted
+
+    # Byte-identity, the strongest form first: the FINAL checkpoint.
+    final = f"checkpoint-{EPOCHS}.msgpack"
+    a = (ctrl / "service-fed" / final).read_bytes()
+    b = (chaos / "service-fed" / final).read_bytes()
+    assert a == b
+
+    # Per-batch digest identity on BOTH ranks, across served, degraded-
+    # local, and recovered-dispatcher stretches.
+    for rank in (0, 1):
+        want = _digests(ctrl / f"digests.rank{rank}")
+        got = _digests(chaos / f"digests.rank{rank}")
+        assert set(want) == set(got)
+        diff = [k for k in want if want[k] != got[k]]
+        assert not diff, f"byte-divergent batches at {sorted(diff)[:5]}"
+
+    # The failover arcs really happened: rank 1 degraded (netdrop epoch
+    # 1) and re-attached at an epoch boundary.
+    with open(chaos / "client-events.rank1.jsonl") as f:
+        events = [json.loads(l) for l in f if l.strip()]
+    kinds = [e["event"] for e in events]
+    assert "degrade" in kinds and "reattach" in kinds
+    first_degrade = next(e for e in events if e["event"] == "degrade")
+    assert first_degrade["epoch"] == 1  # the netdrop window
+
+    # And the restarted dispatcher genuinely recovered from its journal.
+    with open(dsdir / "data-journal.jsonl") as f:
+        names = [json.loads(l)["name"] for l in f if l.strip()]
+    assert "recover" in names
+    assert names.count("serve_start") == 2
+
+
+@pytest.mark.slow
+def test_fleet_shared_data_two_jobs_gates_green(tmp_path):
+    """The shipped shared-data fleet spec through the real CLI: one
+    fleetd-owned dispatcher feeds both jobs; the fleet-level metrics
+    gates against its final scrape come back green (exit 0)."""
+    spec_src = os.path.join(REPO, "horovod_tpu", "launch", "jobs",
+                            "fleet-shared-data-2job.yaml")
+    with open(spec_src) as f:
+        text = f.read()
+    assert "/tmp/hvt-fleet-data" in text  # the paths this test relocates
+    root = str(tmp_path / "fleet-data")
+    spec_path = str(tmp_path / "fleet-shared-data-2job.yaml")
+    with open(spec_path, "w") as f:  # hvt: noqa[HVT005] — test fixture
+        f.write(text.replace("/tmp/hvt-fleet-data", root))
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "JAX_ENABLE_COMPILATION_CACHE": "0",
+        "JAX_COMPILATION_CACHE_DIR": "",
+    })
+    for k in ("HVT_FAULT", "HVT_FAULT_STAMP", "HVT_DATA_SERVICE"):
+        env.pop(k, None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "fleet", spec_path],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    # The gate verdicts are in the output — and the scrape dump exists
+    # for post-mortem.
+    assert "metrics check hvt_data_cursor_refusals_total" in res.stdout
+    assert os.path.exists(
+        os.path.join(root, "fleet-state", "data-metrics.prom")
+    )
+    journal = os.path.join(root, "fleet-state", "fleet-journal.jsonl")
+    with open(journal) as f:
+        names = [json.loads(l)["name"] for l in f if l.strip()]
+    assert "data_service" in names
+    assert "fleet_done" in names

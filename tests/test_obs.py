@@ -701,7 +701,8 @@ class TestTrainerExporter:
         assert values["hvt_optimizer_steps_total"] == 3 * steps_per_epoch
         assert values["hvt_step_samples_total"] >= 1
         assert values["hvt_step_seconds_count"] >= 1
-        assert values["hvt_data_retries_total"] == 0
+        assert values['hvt_data_retries_total{outcome="retried"}'] == 0
+        assert values['hvt_data_retries_total{outcome="exhausted"}'] == 0
         # The step/reduction spans landed in HVT_TRACE_DIR.
         span_dir = tmp_path / "spans"
         files = [
